@@ -1,0 +1,103 @@
+"""Benchmark: ResNet-50 training throughput, images/sec/chip.
+
+BASELINE config #2 (the north-star metric). Runs the full jitted
+training step (forward + backward + SGD-momentum update, bf16 compute /
+f32 master math where it matters) on synthetic ImageNet-shaped data on
+ONE chip and prints a single JSON line.
+
+``vs_baseline`` is computed against the historical upstream-MXNet
+fp32 claim of ~375 img/s/GPU (BASELINE.md: the reference mount was
+empty, "published": {} — 375 is the midpoint of the remembered
+360–390 range, flagged there as unverified).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+BASELINE_IMGS_PER_SEC = 375.0
+BATCH = int(os.environ.get("BENCH_BATCH", "64"))
+IMAGE = 224
+STEPS = int(os.environ.get("BENCH_STEPS", "20"))
+WARMUP = 3
+DTYPE = os.environ.get("BENCH_DTYPE", "bfloat16")
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    # persistent compile cache: the ResNet-50 train step takes minutes to
+    # compile through axon's remote compiler; cache it across runs/rounds
+    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             ".jax_cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.block import functionalize
+    from mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
+
+    ctx = mx.current_context()
+    net = resnet50_v1(classes=1000)
+    net.initialize(init=mx.initializer.Xavier(), ctx=ctx)
+    if DTYPE != "float32":
+        net.cast(DTYPE)
+    warm = mx.nd.zeros((2, 3, IMAGE, IMAGE), ctx=ctx, dtype=DTYPE)
+    with mx.autograd.predict_mode():
+        net(warm)
+
+    fn, params = functionalize(net, training=True, ctx=ctx)
+
+    def loss_fn(params, rng, x, y):
+        logits = fn(params, rng, x)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        return -jnp.take_along_axis(logp, y[:, None], axis=-1).mean()
+
+    def train_step(params, moms, rng, x, y):
+        loss, grads = jax.value_and_grad(loss_fn)(params, rng, x, y)
+        new_moms = jax.tree_util.tree_map(
+            lambda m, g: 0.9 * m + g.astype(jnp.float32), moms, grads)
+        new_params = jax.tree_util.tree_map(
+            lambda p, m: (p.astype(jnp.float32) - 0.1 * m).astype(p.dtype),
+            params, new_moms)
+        return new_params, new_moms, loss
+
+    step = jax.jit(train_step, donate_argnums=(0, 1))
+
+    moms = jax.tree_util.tree_map(
+        lambda p: jnp.zeros(p.shape, jnp.float32), params)
+    rng = jax.random.PRNGKey(0)
+    x = jnp.asarray(np.random.RandomState(0)
+                    .rand(BATCH, 3, IMAGE, IMAGE).astype(np.float32)
+                    .astype(np.dtype("float32")), dtype=DTYPE)
+    y = jnp.asarray(np.random.RandomState(1).randint(0, 1000, BATCH), jnp.int32)
+
+    for _ in range(WARMUP):
+        params, moms, loss = step(params, moms, rng, x, y)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        params, moms, loss = step(params, moms, rng, x, y)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    imgs_per_sec = BATCH * STEPS / dt
+    print(json.dumps({
+        "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": round(imgs_per_sec, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(imgs_per_sec / BASELINE_IMGS_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

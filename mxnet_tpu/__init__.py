@@ -1,0 +1,72 @@
+"""mxnet_tpu — a TPU-native deep-learning framework with MXNet's API.
+
+A ground-up re-design of Apache-MXNet-v1.x capabilities (reference:
+junshipeng/mxnet) for TPU: the compute path is JAX/XLA/Pallas, device
+parallelism is jax.sharding meshes with ICI/DCN collectives, and the
+imperative engine contract (async ops, futures, sync points) rides on
+PJRT's asynchronous dispatch. See SURVEY.md for the layer-by-layer
+mapping to the reference.
+
+Typical use mirrors mxnet::
+
+    import mxnet_tpu as mx
+    x = mx.nd.zeros((2, 3), ctx=mx.tpu(0))
+    with mx.autograd.record():
+        ...
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from .base import MXNetError
+from .context import (
+    Context, cpu, gpu, tpu, cpu_pinned, current_context, num_gpus, num_tpus,
+)
+from . import engine
+from . import random
+from . import autograd
+from . import ndarray
+from . import ndarray as nd
+from .ndarray import NDArray
+
+from . import optimizer
+from .optimizer import Optimizer
+from . import lr_scheduler
+from . import metric
+from . import initializer
+from .initializer import init  # alias namespace mx.init
+from . import kvstore as kv
+from . import kvstore
+from . import gluon
+from . import io
+from . import recordio
+from . import image
+from . import callback
+from . import monitor
+from . import model
+from . import profiler
+from . import parallel
+from . import test_utils
+from . import runtime
+from .util import is_np_array
+
+from .attribute import AttrScope
+from .name import NameManager
+
+# mx.sym / mx.symbol — symbolic graph API
+from . import symbol
+from . import symbol as sym
+from .symbol import Symbol
+from .executor import Executor
+from . import module
+
+
+def waitall():
+    """Block until all asynchronously dispatched work completes
+    (MXNDArrayWaitAll)."""
+    engine.engine.wait_all()
+
+
+def cpu_count():
+    import os
+    return os.cpu_count()

@@ -1,0 +1,52 @@
+"""AttrScope — scoped symbol attributes (python/mxnet/attribute.py).
+
+Used by the symbolic API to attach attributes (e.g. ``ctx_group`` for
+manual model parallelism, ``__layout__``) to symbols created inside the
+scope. On TPU, ctx_group placement maps to sharding annotations; the
+scope mechanics are preserved for API parity.
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["AttrScope", "current"]
+
+
+class AttrScope:
+    _current = threading.local()
+
+    def __init__(self, **kwargs):
+        self._attr = {str(k): str(v) for k, v in kwargs.items()}
+        self._old = None
+
+    def get(self, attr=None):
+        merged = dict(getattr(AttrScope._current, "value", None)._attr
+                      if getattr(AttrScope._current, "value", None) else {})
+        if attr:
+            merged.update(attr)
+        return merged
+
+    def __enter__(self):
+        self._old = getattr(AttrScope._current, "value", None)
+        if self._old is not None:
+            merged = dict(self._old._attr)
+            merged.update(self._attr)
+            self._attr = merged
+        AttrScope._current.value = self
+        return self
+
+    def __exit__(self, *exc):
+        AttrScope._current.value = self._old
+        return False
+
+    @staticmethod
+    def current() -> "AttrScope":
+        cur = getattr(AttrScope._current, "value", None)
+        if cur is None:
+            cur = AttrScope()
+            AttrScope._current.value = cur
+        return cur
+
+
+def current() -> AttrScope:
+    return AttrScope.current()

@@ -1,0 +1,136 @@
+"""Base utilities: dtypes, errors, registries.
+
+TPU-native analog of the reference's FFI/base layer
+(``python/mxnet/base.py`` in apache/mxnet v1.x). There is no C ABI here:
+the "backend" is JAX/XLA, so this module only carries the shared dtype
+tables, error types, and the generic registry that powers op-namespace
+codegen (the ``_init_op_module`` analog).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+try:  # jax is the required backend
+    import jax
+    # MXNet exposes float64/int64 tensors natively (int64 indexing is a
+    # nightly test tier in the reference); enable x64 so dtypes round-trip.
+    # Python-float inputs still default to float32 in mx.nd.array.
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+except ImportError as e:  # pragma: no cover
+    raise ImportError("mxnet_tpu requires jax") from e
+
+__all__ = [
+    "MXNetError",
+    "string_types",
+    "numeric_types",
+    "integer_types",
+    "DTYPE_NAME_TO_NP",
+    "NP_TO_DTYPE_NAME",
+    "dtype_np",
+    "dtype_name",
+]
+
+
+class MXNetError(RuntimeError):
+    """Framework error type (analog of ``mxnet.base.MXNetError``)."""
+
+
+string_types = (str,)
+numeric_types = (float, int, np.generic)
+integer_types = (int, np.integer)
+
+# dtype tables — mirrors the reference's mshadow type enum surface
+# (int dtype codes from include/mxnet/base.h / mshadow), extended with
+# bfloat16 which is the TPU-native half type.
+DTYPE_NAME_TO_NP = {
+    "float32": np.float32,
+    "float64": np.float64,
+    "float16": np.float16,
+    "bfloat16": jnp.bfloat16,
+    "uint8": np.uint8,
+    "int8": np.int8,
+    "int16": np.int16,
+    "int32": np.int32,
+    "int64": np.int64,
+    "bool": np.bool_,
+}
+
+NP_TO_DTYPE_NAME = {np.dtype(v): k for k, v in DTYPE_NAME_TO_NP.items() if k != "bfloat16"}
+NP_TO_DTYPE_NAME[jnp.dtype(jnp.bfloat16)] = "bfloat16"
+
+# Legacy integer dtype codes (reference: mshadow/base.h kFloat32=0 etc.)
+# kept so serialized .params files / user code using int codes round-trip.
+DTYPE_CODE_TO_NAME = {
+    0: "float32",
+    1: "float64",
+    2: "float16",
+    3: "uint8",
+    4: "int32",
+    5: "int8",
+    6: "int64",
+    7: "bool",
+    12: "bfloat16",
+}
+DTYPE_NAME_TO_CODE = {v: k for k, v in DTYPE_CODE_TO_NAME.items()}
+
+
+def dtype_np(dtype):
+    """Normalize a user-provided dtype (str | np.dtype | type | int code) to a dtype object."""
+    if dtype is None:
+        return np.dtype(np.float32)
+    if isinstance(dtype, int):
+        dtype = DTYPE_CODE_TO_NAME[dtype]
+    if isinstance(dtype, str):
+        if dtype == "bfloat16":
+            return jnp.dtype(jnp.bfloat16)
+        return np.dtype(DTYPE_NAME_TO_NP[dtype])
+    return jnp.dtype(dtype)
+
+
+def dtype_name(dtype) -> str:
+    """Canonical string name of a dtype."""
+    d = jnp.dtype(dtype)
+    if d == jnp.dtype(jnp.bfloat16):
+        return "bfloat16"
+    return NP_TO_DTYPE_NAME.get(np.dtype(d.name), d.name)
+
+
+class _Registry:
+    """Name → object registry with alias support.
+
+    Analog of ``dmlc::Registry`` (reference: 3rdparty/dmlc-core
+    include/dmlc/registry.h), which the reference uses for ops,
+    optimizers, iterators and initializers alike.
+    """
+
+    def __init__(self, kind: str):
+        self.kind = kind
+        self._map: dict[str, object] = {}
+
+    def register(self, name=None, *aliases):
+        def _do(obj, nm):
+            key = (nm or getattr(obj, "__name__", None)).lower()
+            self._map[key] = obj
+            for a in aliases:
+                self._map[a.lower()] = obj
+            return obj
+
+        if callable(name):  # used as bare decorator
+            return _do(name, None)
+        return lambda obj: _do(obj, name)
+
+    def get(self, name: str):
+        key = name.lower()
+        if key not in self._map:
+            raise MXNetError(
+                f"{self.kind} '{name}' is not registered. "
+                f"Known: {sorted(self._map)}"
+            )
+        return self._map[key]
+
+    def find(self, name: str):
+        return self._map.get(name.lower())
+
+    def list(self):
+        return sorted(self._map)

@@ -1,0 +1,3 @@
+"""mx.contrib — AMP, quantization, ONNX-stub (python/mxnet/contrib analog)."""
+from . import amp
+from . import quantization

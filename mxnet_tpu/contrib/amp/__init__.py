@@ -1,0 +1,2 @@
+from .amp import init, init_trainer, scale_loss, unscale, convert_model, LossScaler
+from . import lists

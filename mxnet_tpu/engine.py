@@ -1,0 +1,109 @@
+"""Execution-engine shim: async dispatch semantics over PJRT.
+
+The reference's dependency engine (src/engine/threaded_engine.{h,cc},
+ThreadedEnginePerDevice) exists to give an eager API async execution:
+ops return immediately, writes are serialized per-variable, and Python
+blocks only at sync points (WaitToRead/WaitForAll). On TPU, PJRT + XLA
+already provide exactly this contract — `jax` op dispatch is
+asynchronous, each jax.Array is a future, and `block_until_ready` is
+WaitToRead. What remains of the engine is therefore thin and lives here:
+
+- a **sync mode** flag — the NaiveEngine analog
+  (``MXNET_ENGINE_TYPE=NaiveEngine``): when on, every op blocks at
+  dispatch so async bugs/errors surface at the faulting op;
+- a bounded registry of in-flight outputs so ``wait_all()`` can
+  implement Engine::WaitForAll;
+- deferred exception capture: PJRT raises device errors at sync points;
+  we translate them at wait()/asnumpy() like the reference re-throws
+  worker-thread exceptions at WaitForVar
+  (src/engine/threaded_engine.cc OnComplete path,
+  tests/python/unittest/test_exc_handling.py).
+"""
+from __future__ import annotations
+
+import collections
+import os
+import threading
+
+import jax
+
+__all__ = ["Engine", "engine", "set_bulk_size", "bulk"]
+
+
+class Engine:
+    """Singleton engine shim. ``MXNET_ENGINE_TYPE=NaiveEngine`` selects
+    fully synchronous dispatch, mirroring the reference env var."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._inflight = collections.deque(maxlen=256)
+        self.sync = os.environ.get("MXNET_ENGINE_TYPE", "") == "NaiveEngine"
+
+    # -- dispatch hooks (called by the op dispatch layer) ------------------
+    def on_dispatch(self, arrays):
+        """Record op outputs; block immediately in sync mode. Tracers
+        (ops running inside a jit trace — hybridize/functionalize) are
+        never tracked: they aren't device work, and blocking on one
+        later would raise an escaped-tracer error."""
+        arrays = [a for a in arrays if not isinstance(a, jax.core.Tracer)]
+        if not arrays:
+            return
+        if self.sync:
+            for a in arrays:
+                jax.block_until_ready(a)
+        else:
+            with self._lock:
+                self._inflight.extend(arrays)
+
+    # -- sync points -------------------------------------------------------
+    def wait_for_var(self, array):
+        """Engine::WaitForVar — block until this buffer is computed."""
+        jax.block_until_ready(array)
+
+    def wait_all(self):
+        """Engine::WaitForAll — block until all tracked work completes."""
+        with self._lock:
+            pending = list(self._inflight)
+            self._inflight.clear()
+        for a in pending:
+            try:
+                jax.block_until_ready(a)
+            except Exception:
+                raise
+
+    def set_sync(self, flag: bool):
+        self.sync = bool(flag)
+
+
+engine = Engine()
+
+# --- bulking (MXNET_EXEC_BULK_EXEC_* analog) -----------------------------
+# In the reference, engine op bulking batches many small ops into one
+# engine opr to cut scheduling overhead (src/imperative/cached_op.cc
+# segments). Under XLA the analog is tracing a region into one jitted
+# computation; `hybridize()` is the real mechanism. `bulk` is kept as an
+# API-compatible context manager (mx.engine.bulk) that is currently a
+# hint only.
+_BULK_SIZE = int(os.environ.get("MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN", "15"))
+
+
+def set_bulk_size(size: int) -> int:
+    global _BULK_SIZE
+    prev, _BULK_SIZE = _BULK_SIZE, int(size)
+    return prev
+
+
+class bulk:
+    """Context manager: `with mx.engine.bulk(16): ...` (compat shim)."""
+
+    def __init__(self, size: int):
+        self.size = size
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = set_bulk_size(self.size)
+        return self
+
+    def __exit__(self, *exc):
+        set_bulk_size(self._prev)
+        return False

@@ -1,0 +1,130 @@
+"""Executor — bound symbolic computation.
+
+Analog of the reference GraphExecutor (src/executor/graph_executor.cc)
++ python/mxnet/executor.py. Where the reference runs nnvm passes
+(InferShape/PlanMemory/attach_op_execs) at bind time and pushes cached
+opr segments to the engine per call, here ``forward`` evaluates the
+Symbol DAG through the imperative dispatch layer under the autograd
+tape, and ``backward`` replays it — XLA's async dispatch + fusion play
+the role of the engine + memory planner. (The jit-compiled whole-graph
+path lives in Gluon ``hybridize``/CachedOp, matching the reference
+split between Module and Gluon.)
+"""
+from __future__ import annotations
+
+from .base import MXNetError
+from .context import current_context
+
+__all__ = ["Executor"]
+
+
+class Executor:
+    def __init__(self, symbol, ctx, args, args_grad=None, grad_req="write",
+                 aux_states=None):
+        from . import ndarray as nd
+
+        self._symbol = symbol
+        self._ctx = ctx or current_context()
+        arg_names = symbol.list_arguments()
+        if isinstance(args, (list, tuple)):
+            args = dict(zip(arg_names, args))
+        self.arg_dict = dict(args)
+        self.arg_arrays = [self.arg_dict.get(n) for n in arg_names]
+        if isinstance(args_grad, (list, tuple)):
+            args_grad = dict(zip(arg_names, args_grad))
+        self.grad_req = grad_req if isinstance(grad_req, dict) else \
+            {n: grad_req for n in arg_names}
+        self.grad_dict = dict(args_grad) if args_grad else {}
+        for n in arg_names:
+            req = self.grad_req.get(n, "null")
+            if req != "null" and n not in self.grad_dict and n in self.arg_dict:
+                self.grad_dict[n] = nd.zeros_like(self.arg_dict[n])
+        self.grad_arrays = [self.grad_dict.get(n) for n in arg_names]
+        self.aux_dict = dict(aux_states or {})
+        self.aux_arrays = list(self.aux_dict.values())
+        self.outputs = []
+        self._monitor_callback = None
+        self._recording = False
+
+    @property
+    def symbol(self):
+        return self._symbol
+
+    def set_monitor_callback(self, callback, monitor_all=False):
+        self._monitor_callback = callback
+
+    def forward(self, is_train=False, **kwargs):
+        from . import autograd
+
+        for k, v in kwargs.items():
+            if k in self.arg_dict:
+                v.copyto(self.arg_dict[k])
+            else:
+                self.arg_dict[k] = v
+        # attach grads for backward
+        if is_train:
+            for n, req in self.grad_req.items():
+                if req != "null" and n in self.arg_dict:
+                    arr = self.arg_dict[n]
+                    arr._grad = self.grad_dict.get(n)
+                    arr._grad_req = req
+                    arr._is_leaf = True
+            with autograd.record(train_mode=True):
+                self.outputs = self._symbol._eval(self.arg_dict, training=True)
+            self._recording = True
+        else:
+            with autograd.pause(train_mode=False):
+                self.outputs = self._symbol._eval(self.arg_dict, training=False)
+            self._recording = False
+        if self._monitor_callback is not None:
+            for name, out in zip(self._symbol.list_outputs(), self.outputs):
+                self._monitor_callback(name, out)
+        return self.outputs
+
+    def backward(self, out_grads=None, is_train=True):
+        from . import autograd
+        from .ndarray import NDArray
+
+        if not self._recording:
+            raise MXNetError("backward called without forward(is_train=True)")
+        if out_grads is None:
+            heads = self.outputs
+            head_grads = None
+        else:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            heads = self.outputs
+            head_grads = out_grads
+        autograd.backward(heads, head_grads)
+        self._recording = False
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        from . import ndarray as nd
+        new_args = {}
+        for n, arr in self.arg_dict.items():
+            if n in kwargs:
+                new_args[n] = nd.zeros(kwargs[n], ctx=self._ctx, dtype=arr.dtype)
+            else:
+                new_args[n] = arr
+        return Executor(self._symbol, self._ctx, new_args,
+                        {n: nd.zeros_like(a) for n, a in new_args.items()
+                         if self.grad_req.get(n, "null") != "null"},
+                        self.grad_req, self.aux_dict)
+
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        for name, arr in arg_params.items():
+            if name in self.arg_dict:
+                arr.copyto(self.arg_dict[name])
+            elif not allow_extra_params:
+                raise MXNetError(f"Found name \"{name}\" that is not in the arguments")
+        if aux_params:
+            for name, arr in aux_params.items():
+                if name in self.aux_dict:
+                    arr.copyto(self.aux_dict[name])
+                elif not allow_extra_params:
+                    raise MXNetError(f"Found name \"{name}\" that is not in the auxiliary states")
+
+    @property
+    def output_dict(self):
+        return dict(zip(self._symbol.list_outputs(), self.outputs))

@@ -1,0 +1,13 @@
+"""Gluon — the imperative high-level API (python/mxnet/gluon analog)."""
+from .parameter import Parameter, Constant, ParameterDict, DeferredInitializationError
+from .block import Block, HybridBlock, SymbolBlock, functionalize
+from .trainer import Trainer
+from . import nn
+from . import rnn
+from . import loss
+from .loss import SoftmaxCrossEntropyLoss, L2Loss, L1Loss
+from . import data
+from . import utils
+from .utils import split_and_load, split_data, clip_global_norm
+from . import model_zoo
+from . import contrib

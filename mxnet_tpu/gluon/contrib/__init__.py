@@ -1,0 +1,3 @@
+from . import nn
+from . import rnn
+from . import estimator

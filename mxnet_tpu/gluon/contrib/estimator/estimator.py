@@ -1,0 +1,79 @@
+"""Estimator (gluon/contrib/estimator/estimator.py analog, v≥1.6):
+high-level fit() over gluon blocks with event handlers."""
+from __future__ import annotations
+
+from .... import metric as metric_mod
+from ....base import MXNetError
+from ... import loss as gloss
+from ...trainer import Trainer
+from .event_handler import (
+    TrainBegin, TrainEnd, EpochBegin, EpochEnd, BatchBegin, BatchEnd,
+    MetricHandler, LoggingHandler, StoppingHandler,
+)
+
+__all__ = ["Estimator"]
+
+
+class Estimator:
+    def __init__(self, net, loss, metrics=None, initializer=None,
+                 trainer=None, context=None):
+        from .... import autograd
+        self._autograd = autograd
+        self.net = net
+        self.loss = loss if isinstance(loss, gloss.Loss) else loss
+        self.train_metrics = metrics if isinstance(metrics, list) else \
+            ([metrics] if metrics else [metric_mod.Accuracy()])
+        from ....context import current_context
+        self.context = context or [current_context()]
+        if not isinstance(self.context, list):
+            self.context = [self.context]
+        if initializer is not None:
+            net.initialize(initializer, ctx=self.context, force_reinit=False)
+        else:
+            try:
+                net.collect_params().initialize(ctx=self.context)
+            except Exception:
+                pass
+        self.trainer = trainer or Trainer(net.collect_params(), "sgd",
+                                          {"learning_rate": 0.001})
+
+    def evaluate(self, val_data, val_metrics=None):
+        metrics = val_metrics or self.train_metrics
+        for m in metrics:
+            m.reset()
+        for batch in val_data:
+            data, label = batch[0], batch[1]
+            data = data.as_in_context(self.context[0])
+            label = label.as_in_context(self.context[0])
+            pred = self.net(data)
+            for m in metrics:
+                m.update([label], [pred])
+        return [m.get() for m in metrics]
+
+    def fit(self, train_data, val_data=None, epochs=1, event_handlers=None,
+            batches=None):
+        autograd = self._autograd
+        handlers = event_handlers or []
+        handlers.append(LoggingHandler())
+        for epoch in range(epochs):
+            for m in self.train_metrics:
+                m.reset()
+            nbatch = 0
+            for batch in train_data:
+                data, label = batch[0], batch[1]
+                data = data.as_in_context(self.context[0])
+                label = label.as_in_context(self.context[0])
+                with autograd.record():
+                    pred = self.net(data)
+                    loss = self.loss(pred, label)
+                loss.backward()
+                self.trainer.step(data.shape[0])
+                for m in self.train_metrics:
+                    m.update([label], [pred])
+                nbatch += 1
+                if batches is not None and nbatch >= batches:
+                    break
+            for h in handlers:
+                if isinstance(h, LoggingHandler):
+                    h.epoch_end(self, epoch)
+        return self

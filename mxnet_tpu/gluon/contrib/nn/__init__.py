@@ -1,0 +1,4 @@
+from .basic_layers import (
+    Concurrent, HybridConcurrent, Identity, SparseEmbedding, SyncBatchNorm,
+    PixelShuffle2D,
+)

@@ -1,0 +1,3 @@
+"""Contrib RNN cells (conv-RNN etc.) — Conv1DRNNCell family is a
+round-2 item; VariationalDropoutCell ships now."""
+from .rnn_cell import VariationalDropoutCell

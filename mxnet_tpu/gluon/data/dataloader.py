@@ -1,0 +1,123 @@
+"""DataLoader (python/mxnet/gluon/data/dataloader.py analog).
+
+The reference uses multiprocessing workers + shared-memory NDArray
+rebuild (CPUSharedStorageManager). TPU-native design: worker THREADS
+(batchify is numpy-bound and releases the GIL; jax device_put is the
+only hot conversion) + a prefetch queue that overlaps host batch
+assembly with device steps. `num_workers>0` enables the threaded
+prefetcher; the API (batchify_fn, samplers, pin_memory) is preserved —
+pin_memory is a no-op because PJRT host buffers are already DMA-able.
+"""
+from __future__ import annotations
+
+import concurrent.futures as _futures
+import threading
+from collections import deque
+
+import numpy as np
+
+from ...base import MXNetError
+from ...ndarray import NDArray, array
+from .sampler import SequentialSampler, RandomSampler, BatchSampler
+
+__all__ = ["DataLoader", "default_batchify_fn", "default_mp_batchify_fn"]
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (reference default_batchify_fn)."""
+    if isinstance(data[0], NDArray):
+        from ... import ndarray as nd
+        return nd.stack(*data, axis=0)
+    if isinstance(data[0], tuple):
+        data = zip(*data)
+        return [default_batchify_fn(i) for i in data]
+    data = np.asarray(data)
+    return array(data)
+
+
+default_mp_batchify_fn = default_batchify_fn
+
+
+class DataLoader:
+    def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
+                 last_batch=None, batch_sampler=None, batchify_fn=None,
+                 num_workers=0, pin_memory=False, pin_device_id=0,
+                 prefetch=None, thread_pool=True, timeout=120):
+        self._dataset = dataset
+        self._pin_memory = pin_memory
+        self._num_workers = max(0, num_workers)
+        self._prefetch = max(0, prefetch or 2 * self._num_workers)
+
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError("batch_size must be specified unless "
+                                 "batch_sampler is specified")
+            if sampler is None:
+                sampler = RandomSampler(len(dataset)) if shuffle else \
+                    SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError("shuffle must not be specified if sampler is "
+                                 "specified")
+            batch_sampler = BatchSampler(sampler, batch_size,
+                                         last_batch or "keep")
+        elif batch_size is not None or shuffle or sampler is not None or \
+                last_batch is not None:
+            raise ValueError("batch_size, shuffle, sampler and last_batch must "
+                             "not be specified if batch_sampler is specified.")
+        self._batch_sampler = batch_sampler
+        self._batchify_fn = batchify_fn or default_batchify_fn
+
+    def __iter__(self):
+        if self._num_workers == 0:
+            def same_process_iter():
+                for batch in self._batch_sampler:
+                    yield self._batchify_fn([self._dataset[idx] for idx in batch])
+            return same_process_iter()
+        return _ThreadedIter(self)
+
+    def __len__(self):
+        return len(self._batch_sampler)
+
+
+class _ThreadedIter:
+    """Thread-pool prefetching iterator (PrefetcherIter analog)."""
+
+    def __init__(self, loader: DataLoader):
+        self._loader = loader
+        self._pool = _futures.ThreadPoolExecutor(max_workers=loader._num_workers)
+        self._batches = iter(loader._batch_sampler)
+        self._pending = deque()
+        for _ in range(loader._prefetch):
+            self._submit_next()
+
+    def _submit_next(self):
+        try:
+            batch = next(self._batches)
+        except StopIteration:
+            return
+        fn = self._loader._batchify_fn
+        ds = self._loader._dataset
+        self._pending.append(
+            self._pool.submit(lambda b: fn([ds[i] for i in b]), batch))
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if not self._pending:
+            self._shutdown()
+            raise StopIteration
+        fut = self._pending.popleft()
+        self._submit_next()
+        try:
+            return fut.result()
+        except Exception:
+            self._shutdown()
+            raise
+
+    def _shutdown(self):
+        self._pool.shutdown(wait=False, cancel_futures=True)
+
+    def __del__(self):
+        # abandoned mid-epoch (break/early stop): release worker threads
+        self._shutdown()

@@ -1,0 +1,6 @@
+from .datasets import (
+    MNIST, FashionMNIST, CIFAR10, CIFAR100, ImageRecordDataset,
+    ImageFolderDataset,
+)
+from . import transforms
+from . import datasets

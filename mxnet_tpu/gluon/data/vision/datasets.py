@@ -1,0 +1,238 @@
+"""Vision datasets (python/mxnet/gluon/data/vision/datasets.py analog).
+
+No network egress in the TPU sandbox: datasets load from local files
+(`root` must contain the standard archives/idx files); when files are
+absent and `synthetic_fallback` is on (default for tests), a
+deterministic synthetic replacement with the right shapes is generated
+— keeps the training-loop surface exercisable offline.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+
+from ....base import MXNetError
+from ...data.dataset import Dataset, ArrayDataset
+from ....ndarray import array
+
+__all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100",
+           "ImageRecordDataset", "ImageFolderDataset"]
+
+
+class _DownloadedDataset(Dataset):
+    def __init__(self, root, transform, synthetic_fallback=True):
+        self._transform = transform
+        self._data = None
+        self._label = None
+        self._root = os.path.expanduser(root)
+        self._synthetic = synthetic_fallback
+        self._get_data()
+
+    def __getitem__(self, idx):
+        if self._transform is not None:
+            return self._transform(self._data[idx], self._label[idx])
+        return self._data[idx], self._label[idx]
+
+    def __len__(self):
+        return len(self._label)
+
+    def _get_data(self):
+        raise NotImplementedError
+
+
+class MNIST(_DownloadedDataset):
+    """MNIST from local idx files (train-images-idx3-ubyte(.gz) etc.)."""
+
+    _shape = (28, 28, 1)
+    _nclass = 10
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets", "mnist"),
+                 train=True, transform=None, synthetic_fallback=True):
+        self._train = train
+        super().__init__(root, transform, synthetic_fallback)
+
+    def _file_names(self):
+        if self._train:
+            return "train-images-idx3-ubyte", "train-labels-idx1-ubyte"
+        return "t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte"
+
+    def _get_data(self):
+        img_name, lbl_name = self._file_names()
+        img_path = self._find(img_name)
+        lbl_path = self._find(lbl_name)
+        if img_path is None or lbl_path is None:
+            if not self._synthetic:
+                raise MXNetError(
+                    f"MNIST files not found under {self._root} and network "
+                    "download is unavailable")
+            n = 6000 if self._train else 1000
+            rng = np.random.default_rng(42 + int(self._train))
+            self._label = rng.integers(0, self._nclass, n).astype(np.int32)
+            base = rng.normal(0, 0.05, (self._nclass,) + self._shape)
+            noise = rng.normal(0, 0.1, (n,) + self._shape)
+            data = np.clip(base[self._label] + noise + 0.1307, 0, 1)
+            self._data = array((data * 255).astype(np.uint8))
+            return
+        self._label = _read_idx(lbl_path).astype(np.int32)
+        self._data = array(_read_idx(img_path).reshape(-1, 28, 28, 1))
+
+    def _find(self, name):
+        for cand in (name, name + ".gz"):
+            p = os.path.join(self._root, cand)
+            if os.path.isfile(p):
+                return p
+        return None
+
+
+def _read_idx(path):
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        _, _, dims = struct.unpack(">HBB", f.read(4))
+        shape = tuple(struct.unpack(">I", f.read(4))[0] for _ in range(dims))
+        return np.frombuffer(f.read(), dtype=np.uint8).reshape(shape)
+
+
+class FashionMNIST(MNIST):
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets",
+                                         "fashion-mnist"),
+                 train=True, transform=None, synthetic_fallback=True):
+        super().__init__(root, train, transform, synthetic_fallback)
+
+
+class CIFAR10(_DownloadedDataset):
+    _shape = (32, 32, 3)
+    _nclass = 10
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets", "cifar10"),
+                 train=True, transform=None, synthetic_fallback=True):
+        self._train = train
+        super().__init__(root, transform, synthetic_fallback)
+
+    def _get_data(self):
+        # expects cifar-10-binary.tar.gz extracted or the .bin files present
+        files = [f"data_batch_{i}.bin" for i in range(1, 6)] if self._train \
+            else ["test_batch.bin"]
+        paths = []
+        for f in files:
+            for cand in (os.path.join(self._root, f),
+                         os.path.join(self._root, "cifar-10-batches-bin", f)):
+                if os.path.isfile(cand):
+                    paths.append(cand)
+                    break
+        if len(paths) != len(files):
+            if not self._synthetic:
+                raise MXNetError(f"CIFAR10 files not found under {self._root}")
+            n = 5000 if self._train else 1000
+            rng = np.random.default_rng(1234 + int(self._train))
+            self._label = rng.integers(0, self._nclass, n).astype(np.int32)
+            base = rng.normal(0, 0.08, (self._nclass,) + self._shape)
+            data = np.clip(base[self._label] +
+                           rng.normal(0, 0.15, (n,) + self._shape) + 0.45, 0, 1)
+            self._data = array((data * 255).astype(np.uint8))
+            return
+        data_list, label_list = [], []
+        for p in paths:
+            raw = np.frombuffer(open(p, "rb").read(), dtype=np.uint8)
+            raw = raw.reshape(-1, 3073)
+            label_list.append(raw[:, 0].astype(np.int32))
+            data_list.append(raw[:, 1:].reshape(-1, 3, 32, 32)
+                             .transpose(0, 2, 3, 1))
+        self._label = np.concatenate(label_list)
+        self._data = array(np.concatenate(data_list))
+
+
+class CIFAR100(CIFAR10):
+    _nclass = 100
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets", "cifar100"),
+                 fine_label=False, train=True, transform=None,
+                 synthetic_fallback=True):
+        self._fine_label = fine_label
+        super().__init__(root, train, transform, synthetic_fallback)
+
+    def _get_data(self):
+        fname = "train.bin" if self._train else "test.bin"
+        path = None
+        for cand in (os.path.join(self._root, fname),
+                     os.path.join(self._root, "cifar-100-binary", fname)):
+            if os.path.isfile(cand):
+                path = cand
+                break
+        if path is None:
+            if not self._synthetic:
+                raise MXNetError(f"CIFAR100 files not found under {self._root}")
+            CIFAR10._get_data(self)
+            return
+        raw = np.frombuffer(open(path, "rb").read(), dtype=np.uint8)
+        raw = raw.reshape(-1, 3074)
+        self._label = raw[:, 1 if self._fine_label else 0].astype(np.int32)
+        self._data = array(raw[:, 2:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1))
+
+
+class ImageRecordDataset(Dataset):
+    """Dataset over a RecordIO file of packed images."""
+
+    def __init__(self, filename, flag=1, transform=None):
+        from ...data.dataset import RecordFileDataset
+        self._record = RecordFileDataset(filename)
+        self._flag = flag
+        self._transform = transform
+
+    def __getitem__(self, idx):
+        from .... import recordio, image
+        record = self._record[idx]
+        header, img_bytes = recordio.unpack(record)
+        img = image.imdecode(img_bytes, self._flag)
+        label = header.label
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+    def __len__(self):
+        return len(self._record)
+
+
+class ImageFolderDataset(Dataset):
+    """A dataset of images arranged root/class/image.ext."""
+
+    def __init__(self, root, flag=1, transform=None):
+        self._root = os.path.expanduser(root)
+        self._flag = flag
+        self._transform = transform
+        self._exts = [".jpg", ".jpeg", ".png", ".npy"]
+        self._list_images(self._root)
+
+    def _list_images(self, root):
+        self.synsets = []
+        self.items = []
+        for folder in sorted(os.listdir(root)):
+            path = os.path.join(root, folder)
+            if not os.path.isdir(path):
+                continue
+            label = len(self.synsets)
+            self.synsets.append(folder)
+            for filename in sorted(os.listdir(path)):
+                ext = os.path.splitext(filename)[1]
+                if ext.lower() not in self._exts:
+                    continue
+                self.items.append((os.path.join(path, filename), label))
+
+    def __getitem__(self, idx):
+        from .... import image
+        path, label = self.items[idx]
+        if path.endswith(".npy"):
+            img = array(np.load(path))
+        else:
+            with open(path, "rb") as f:
+                img = image.imdecode(f.read(), self._flag)
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+    def __len__(self):
+        return len(self.items)

@@ -1,0 +1,155 @@
+"""Vision transforms (python/mxnet/gluon/data/vision/transforms.py analog)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ....base import MXNetError
+from ....ndarray import NDArray, array
+from ...block import Block, HybridBlock
+from ...nn import Sequential, HybridSequential
+
+__all__ = ["Compose", "Cast", "ToTensor", "Normalize", "RandomResizedCrop",
+           "CenterCrop", "Resize", "RandomFlipLeftRight", "RandomFlipTopBottom",
+           "RandomBrightness", "RandomContrast", "RandomSaturation"]
+
+
+class Compose(Sequential):
+    """Sequentially composes multiple transforms."""
+
+    def __init__(self, transforms):
+        super().__init__()
+        for t in transforms:
+            self.add(t)
+
+
+class Cast(HybridBlock):
+    def __init__(self, dtype="float32"):
+        super().__init__()
+        self._dtype = dtype
+
+    def hybrid_forward(self, F, x):
+        return F.Cast(x, dtype=self._dtype)
+
+
+class ToTensor(HybridBlock):
+    """HWC uint8 [0,255] → CHW float32 [0,1]."""
+
+    def __init__(self):
+        super().__init__()
+
+    def hybrid_forward(self, F, x):
+        out = F.Cast(x, dtype="float32") / 255.0
+        if out.ndim == 3:
+            return out.transpose((2, 0, 1))
+        return out.transpose((0, 3, 1, 2))
+
+
+class Normalize(HybridBlock):
+    def __init__(self, mean=0.0, std=1.0):
+        super().__init__()
+        self._mean = np.asarray(mean, np.float32).reshape(-1, 1, 1)
+        self._std = np.asarray(std, np.float32).reshape(-1, 1, 1)
+
+    def hybrid_forward(self, F, x):
+        mean = array(self._mean, ctx=x.ctx) if isinstance(x, NDArray) else self._mean
+        std = array(self._std, ctx=x.ctx) if isinstance(x, NDArray) else self._std
+        return (x - mean) / std
+
+
+class Resize(Block):
+    def __init__(self, size, keep_ratio=False, interpolation=1):
+        super().__init__()
+        self._size = size if isinstance(size, (tuple, list)) else (size, size)
+        self._keep = keep_ratio
+
+    def forward(self, x):
+        from .... import image
+        img = x.asnumpy() if isinstance(x, NDArray) else np.asarray(x)
+        return array(image._resize_np(img, self._size[0], self._size[1]))
+
+
+class CenterCrop(Block):
+    def __init__(self, size, interpolation=1):
+        super().__init__()
+        self._size = size if isinstance(size, (tuple, list)) else (size, size)
+
+    def forward(self, x):
+        from .... import image
+        out, _ = image.center_crop(x, self._size)
+        return out
+
+
+class RandomResizedCrop(Block):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3.0 / 4.0, 4.0 / 3.0),
+                 interpolation=1):
+        super().__init__()
+        self._size = size if isinstance(size, (tuple, list)) else (size, size)
+        self._scale = scale
+        self._ratio = ratio
+
+    def forward(self, x):
+        from .... import image
+        img = x.asnumpy() if isinstance(x, NDArray) else np.asarray(x)
+        h, w = img.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target_area = np.random.uniform(*self._scale) * area
+            log_ratio = (np.log(self._ratio[0]), np.log(self._ratio[1]))
+            aspect = np.exp(np.random.uniform(*log_ratio))
+            new_w = int(round(np.sqrt(target_area * aspect)))
+            new_h = int(round(np.sqrt(target_area / aspect)))
+            if new_w <= w and new_h <= h:
+                x0 = np.random.randint(0, w - new_w + 1)
+                y0 = np.random.randint(0, h - new_h + 1)
+                crop = img[y0:y0 + new_h, x0:x0 + new_w]
+                return array(image._resize_np(crop, self._size[0], self._size[1]))
+        return array(image._resize_np(img, self._size[0], self._size[1]))
+
+
+class RandomFlipLeftRight(Block):
+    def forward(self, x):
+        if np.random.random() < 0.5:
+            img = x.asnumpy() if isinstance(x, NDArray) else np.asarray(x)
+            return array(np.ascontiguousarray(img[:, ::-1]))
+        return x
+
+
+class RandomFlipTopBottom(Block):
+    def forward(self, x):
+        if np.random.random() < 0.5:
+            img = x.asnumpy() if isinstance(x, NDArray) else np.asarray(x)
+            return array(np.ascontiguousarray(img[::-1]))
+        return x
+
+
+class _RandomColorJitterBase(Block):
+    def __init__(self, brightness):
+        super().__init__()
+        self._jitter = brightness
+
+    def _alpha(self):
+        return 1.0 + np.random.uniform(-self._jitter, self._jitter)
+
+
+class RandomBrightness(_RandomColorJitterBase):
+    def forward(self, x):
+        img = x.asnumpy().astype(np.float32) if isinstance(x, NDArray) \
+            else np.asarray(x, np.float32)
+        return array(np.clip(img * self._alpha(), 0, 255))
+
+
+class RandomContrast(_RandomColorJitterBase):
+    def forward(self, x):
+        img = x.asnumpy().astype(np.float32) if isinstance(x, NDArray) \
+            else np.asarray(x, np.float32)
+        mean = img.mean()
+        return array(np.clip((img - mean) * self._alpha() + mean, 0, 255))
+
+
+class RandomSaturation(_RandomColorJitterBase):
+    def forward(self, x):
+        img = x.asnumpy().astype(np.float32) if isinstance(x, NDArray) \
+            else np.asarray(x, np.float32)
+        gray = img.mean(axis=-1, keepdims=True)
+        a = self._alpha()
+        return array(np.clip(img * a + gray * (1 - a), 0, 255))

@@ -1,0 +1,427 @@
+"""Gluon Parameter / ParameterDict / Constant
+(python/mxnet/gluon/parameter.py analog).
+
+Preserved semantics: deferred shape inference (shape with 0s finalized
+at first forward), ``grad_req`` ('write'/'add'/'null'), per-context
+replicas (``list_data``/``list_grad``), ``_reduce`` for multi-device
+averaging, sharing via ParameterDict prefix/shared, ``row_sparse``
+stype hooks. On a TPU slice, per-context replicas are per-chip copies
+of one process; the sharded Trainer path keeps a single mesh-sharded
+array instead (replicas collapse to views) — both live behind this API.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import numpy as np
+
+from ..base import MXNetError, dtype_np
+from ..context import Context, cpu, current_context
+from ..initializer import InitDesc, create as init_create
+from .. import ndarray as nd
+from ..ndarray import NDArray
+
+__all__ = ["DeferredInitializationError", "Parameter", "Constant", "ParameterDict",
+           "tensor_types"]
+
+tensor_types = (NDArray,)
+
+
+class DeferredInitializationError(MXNetError):
+    """Raised when accessing a parameter whose shape is not yet known."""
+
+
+class Parameter:
+    def __init__(self, name, grad_req="write", shape=None, dtype="float32",
+                 lr_mult=1.0, wd_mult=1.0, init=None, allow_deferred_init=False,
+                 differentiable=True, stype="default", grad_stype="default"):
+        self._var = None
+        self._data = None  # OrderedDict ctx→NDArray
+        self._grad = None
+        self.name = name
+        self._shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.init = init
+        self.allow_deferred_init = allow_deferred_init
+        self._differentiable = differentiable
+        if not differentiable:
+            grad_req = "null"
+        self._grad_req = grad_req
+        self._stype = stype
+        self._grad_stype = grad_stype
+        self._deferred_init = ()
+
+    def __repr__(self):
+        return f"Parameter {self.name} (shape={self._shape}, dtype={self.dtype})"
+
+    # -- properties --------------------------------------------------------
+    @property
+    def grad_req(self):
+        return self._grad_req
+
+    @grad_req.setter
+    def grad_req(self, req):
+        assert req in ("write", "add", "null")
+        if not self._differentiable:
+            req = "null"
+        if self._grad_req == req:
+            return
+        self._grad_req = req
+        if req == "null":
+            self._grad = None
+            if self._data:
+                for arr in self._data.values():
+                    arr._grad = None
+                    arr._grad_req = "null"
+        elif self._data is not None:
+            self._init_grad()
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @shape.setter
+    def shape(self, new_shape):
+        if self._shape is None:
+            self._shape = tuple(new_shape)
+            return
+        assert len(self._shape) == len(new_shape) and \
+            all(j in (0, i) or i == j for i, j in zip(new_shape, self._shape)), \
+            f"Expected shape {new_shape} is incompatible with given shape {self._shape}."
+        self._shape = tuple(new_shape)
+
+    @property
+    def stype(self):
+        return self._stype
+
+    # -- initialization ----------------------------------------------------
+    def initialize(self, init=None, ctx=None, default_init=None,
+                   force_reinit=False):
+        if self._data is not None and not force_reinit:
+            return
+        if default_init is None:
+            from ..initializer import Uniform
+            default_init = Uniform()
+        if ctx is None:
+            ctx = [current_context()]
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        if self._shape is None or any(s == 0 for s in self._shape):
+            if self.allow_deferred_init:
+                self._deferred_init = (init, ctx, default_init, None)
+                return
+            raise MXNetError(
+                f"Cannot initialize Parameter '{self.name}' because it has "
+                f"invalid shape: {self._shape}.")
+        self._finish_init(init, ctx, default_init)
+
+    def _finish_init(self, init, ctx, default_init):
+        data = nd.zeros(self._shape, ctx=ctx[0], dtype=self.dtype)
+        initializer = init_create(init) if init is not None else \
+            (init_create(self.init) if self.init is not None else
+             init_create(default_init) if isinstance(default_init, str) else default_init)
+        initializer(InitDesc(self.name), data)
+        self._data = OrderedDict((c, data if c == ctx[0] else data.copyto(c))
+                                 for c in ctx)
+        self._deferred_init = ()
+        if self._grad_req != "null":
+            self._init_grad()
+
+    def _finish_deferred_init(self):
+        if not self._deferred_init:
+            return
+        init, ctx, default_init, data = self._deferred_init
+        if self._shape is None or any(s == 0 for s in self._shape):
+            raise DeferredInitializationError(
+                f"Parameter '{self.name}' has not been initialized yet")
+        self._finish_init(init, ctx, default_init)
+        if data is not None:
+            # set_data() was called while init was deferred — apply it
+            self.set_data(data)
+
+    def _init_grad(self):
+        self._grad = OrderedDict(
+            (c, nd.zeros(self._shape, ctx=c, dtype=self.dtype))
+            for c in self._data)
+        for c, arr in self._data.items():
+            arr._grad = self._grad[c]
+            arr._grad_req = self._grad_req
+            arr._is_leaf = True
+
+    def _check_initialized(self, ctx=None):
+        if self._data is None:
+            if self._deferred_init:
+                raise DeferredInitializationError(
+                    f"Parameter '{self.name}' has not been initialized yet "
+                    "because initialization was deferred. Actual "
+                    "initialization happens during the first forward pass.")
+            raise MXNetError(
+                f"Parameter '{self.name}' has not been initialized. You "
+                "should initialize parameters and create Trainer first.")
+
+    # -- data access -------------------------------------------------------
+    def data(self, ctx=None) -> NDArray:
+        self._check_initialized(ctx)
+        if ctx is None:
+            return next(iter(self._data.values()))
+        if ctx not in self._data:
+            raise MXNetError(
+                f"Parameter '{self.name}' was not initialized on context {ctx}. "
+                f"It was only initialized on {list(self._data)}.")
+        return self._data[ctx]
+
+    def list_data(self):
+        self._check_initialized()
+        return list(self._data.values())
+
+    def grad(self, ctx=None) -> NDArray:
+        self._check_initialized()
+        if self._grad is None:
+            raise MXNetError(
+                f"Cannot get gradient array for Parameter '{self.name}' "
+                "because grad_req='null'")
+        if ctx is None:
+            return next(iter(self._grad.values()))
+        return self._grad[ctx]
+
+    def list_grad(self):
+        self._check_initialized()
+        assert self._grad is not None
+        return list(self._grad.values())
+
+    def list_ctx(self):
+        if self._data is None and self._deferred_init:
+            return self._deferred_init[1]
+        self._check_initialized()
+        return list(self._data.keys())
+
+    def set_data(self, data):
+        self.shape = data.shape
+        if self._data is None:
+            assert self._deferred_init, \
+                f"Parameter '{self.name}' has not been initialized"
+            self._deferred_init = self._deferred_init[:3] + (data,)
+            return
+        for arr in self._data.values():
+            arr._set_data(data._data if isinstance(data, NDArray) else data)
+
+    def zero_grad(self):
+        if self._grad is None:
+            return
+        for g in self._grad.values():
+            g._set_data(nd.zeros_like(g)._data)
+
+    def _reduce(self) -> NDArray:
+        """Average value over contexts (for save_parameters)."""
+        ctx = cpu()
+        if self._stype == "default":
+            block = self.list_data()
+            if len(block) == 1:
+                return block[0].copyto(ctx)
+            out = block[0].copyto(ctx)
+            for b in block[1:]:
+                out += b.as_in_context(ctx)
+            return out / len(block)
+        return self.data().copyto(ctx)
+
+    def reset_ctx(self, ctx):
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        if self._data:
+            data = next(iter(self._data.values()))
+            self._data = OrderedDict((c, data.as_in_context(c)) for c in ctx)
+            if self._grad_req != "null":
+                self._init_grad()
+        elif self._deferred_init:
+            init, _, default_init, data = self._deferred_init
+            self._deferred_init = (init, ctx, default_init, data)
+
+    def cast(self, dtype):
+        self.dtype = dtype
+        if self._data is None:
+            return
+        self._data = OrderedDict((c, a.astype(dtype)) for c, a in self._data.items())
+        if self._grad is not None:
+            self._init_grad()
+
+    def var(self):
+        from .. import symbol
+        if self._var is None:
+            self._var = symbol.var(self.name, shape=self.shape,
+                                   dtype=self.dtype, lr_mult=self.lr_mult,
+                                   wd_mult=self.wd_mult)
+        return self._var
+
+    def row_sparse_data(self, row_id):
+        from ..ndarray import sparse
+        dense = self.data()
+        return sparse.cast_storage(dense, "row_sparse")
+
+    def list_row_sparse_data(self, row_id):
+        return [self.row_sparse_data(row_id)]
+
+
+class Constant(Parameter):
+    """Non-differentiable constant parameter."""
+
+    def __init__(self, name, value):
+        if not isinstance(value, NDArray):
+            value = nd.array(value)
+        self.value = value
+
+        class _ConstInit:
+            def __call__(self, _, arr):
+                value.copyto(arr)
+
+            def dumps(self):
+                import json
+                return json.dumps(["constant", {"value": value.asnumpy().tolist()}])
+
+        super().__init__(name, grad_req="null", shape=value.shape,
+                         dtype=str(value.dtype) if value.dtype != np.float32 else "float32",
+                         init=_ConstInit(), differentiable=False)
+
+
+class ParameterDict:
+    """Ordered name→Parameter mapping with prefixing & sharing."""
+
+    def __init__(self, prefix="", shared=None):
+        self._prefix = prefix
+        self._params = OrderedDict()
+        self._shared = shared
+
+    def __repr__(self):
+        s = "\n".join(f"  {v}" for v in self._params.values())
+        return f"{type(self).__name__} '{self._prefix}' (\n{s}\n)"
+
+    def __getitem__(self, key):
+        return self._params[key]
+
+    def __iter__(self):
+        return iter(self._params)
+
+    def __len__(self):
+        return len(self._params)
+
+    def items(self):
+        return self._params.items()
+
+    def keys(self):
+        return self._params.keys()
+
+    def values(self):
+        return self._params.values()
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    def _get_impl(self, name):
+        if name in self._params:
+            return self._params[name]
+        if self._shared is not None and name in self._shared._params:
+            self._params[name] = self._shared._params[name]
+            return self._params[name]
+        return None
+
+    def get(self, name, **kwargs):
+        name = self._prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            param = Parameter(name, **kwargs)
+            self._params[name] = param
+        else:
+            for k, v in kwargs.items():
+                if hasattr(param, k) and getattr(param, k) is not None:
+                    existing = getattr(param, k)
+                    if k == "shape" and v is not None and existing is not None:
+                        # merge unknown dims
+                        if len(v) == len(existing):
+                            merged = tuple(ev if sv in (0, None) else sv
+                                           for sv, ev in zip(v, existing))
+                            param._shape = merged
+                        continue
+                elif v is not None:
+                    setattr(param, k, v)
+        return param
+
+    def get_constant(self, name, value=None):
+        name = self._prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            if value is None:
+                raise MXNetError(f"No constant named '{name}'")
+            param = Constant(name, value)
+            self._params[name] = param
+        return param
+
+    def update(self, other):
+        for k, v in other.items():
+            if k in self._params and self._params[k] is not v:
+                raise MXNetError(f"Cannot update self with other because they "
+                                 f"have different Parameters with the same name '{k}'")
+            self._params[k] = v
+
+    def initialize(self, init=None, ctx=None, verbose=False, force_reinit=False):
+        if init is None:
+            from ..initializer import Uniform
+            init = Uniform()
+        for _, v in self.items():
+            v.initialize(None, ctx, init, force_reinit=force_reinit)
+
+    def zero_grad(self):
+        for v in self.values():
+            v.zero_grad()
+
+    def reset_ctx(self, ctx):
+        for v in self.values():
+            v.reset_ctx(ctx)
+
+    def list_ctx(self):
+        s = set()
+        for v in self.values():
+            s.update(v.list_ctx() if v._data or v._deferred_init else [])
+        return list(s)
+
+    def setattr(self, name, value):
+        for v in self.values():
+            setattr(v, name, value)
+
+    def save(self, filename, strip_prefix=""):
+        arg_dict = {}
+        for param in self.values():
+            weight = param._reduce()
+            if not param.name.startswith(strip_prefix):
+                raise MXNetError(f"Prefix '{strip_prefix}' is to be striped "
+                                 f"before saving, but Parameter's name "
+                                 f"'{param.name}' does not start with it")
+            arg_dict[param.name[len(strip_prefix):]] = weight
+        nd.save(filename, arg_dict)
+
+    def load(self, filename, ctx=None, allow_missing=False,
+             ignore_extra=False, restore_prefix=""):
+        arg_dict = nd.load(filename)
+        if not isinstance(arg_dict, dict):
+            raise MXNetError(f"{filename} contains unnamed arrays")
+        arg_dict = {restore_prefix + k: v for k, v in arg_dict.items()}
+        if not allow_missing:
+            for name in self.keys():
+                assert name in arg_dict, \
+                    f"Parameter '{name}' is missing in file '{filename}'"
+        for name, arr in arg_dict.items():
+            if name not in self._params:
+                if not ignore_extra:
+                    raise MXNetError(
+                        f"Parameter '{name}' loaded from file '{filename}' is "
+                        "not present in ParameterDict")
+                continue
+            param = self._params[name]
+            if param._data is None and param._deferred_init:
+                param.shape = arr.shape
+                param._finish_deferred_init()
+            elif param._data is None:
+                param._shape = arr.shape
+                param.initialize(ctx=ctx or [current_context()])
+            param.set_data(arr)

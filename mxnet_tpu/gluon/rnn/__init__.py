@@ -1,0 +1,6 @@
+from .rnn_cell import (
+    RecurrentCell, HybridRecurrentCell, RNNCell, LSTMCell, GRUCell,
+    SequentialRNNCell, DropoutCell, ZoneoutCell, ResidualCell,
+    BidirectionalCell, ModifierCell,
+)
+from .rnn_layer import RNN, LSTM, GRU

@@ -1,0 +1,210 @@
+"""Fused recurrent layers (python/mxnet/gluon/rnn/rnn_layer.py analog).
+
+gluon.rnn.LSTM/GRU/RNN wrap the fused RNN op (ndarray/op_impl_rnn.py —
+the cuDNN-RNN-analog lax.scan kernel). Parameter naming matches the
+reference ({l,r}{i}_{i2h,h2h}_{weight,bias}) so checkpoints port.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...base import MXNetError
+from ...ndarray import NDArray
+from ...ndarray.register import invoke, get_op
+from ... import autograd as _autograd
+from ..block import HybridBlock
+from ..parameter import tensor_types
+
+__all__ = ["RNN", "LSTM", "GRU"]
+
+
+class _RNNLayer(HybridBlock):
+    def __init__(self, hidden_size, num_layers, layout, dropout,
+                 bidirectional, input_size, i2h_weight_initializer,
+                 h2h_weight_initializer, i2h_bias_initializer,
+                 h2h_bias_initializer, mode, projection_size=None, **kwargs):
+        super().__init__(**kwargs)
+        assert layout in ("TNC", "NTC"), \
+            f"Invalid layout {layout}; must be one of ['TNC' or 'NTC']"
+        self._hidden_size = hidden_size
+        self._projection_size = projection_size
+        self._num_layers = num_layers
+        self._mode = mode
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        self._gates = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}[mode]
+        ng, ni, nh = self._gates, input_size, hidden_size
+        with self.name_scope():
+            for i in range(num_layers):
+                for j in ["l", "r"][:self._dir]:
+                    self._register_param(f"{j}{i}_i2h_weight",
+                                         (ng * nh, ni), i2h_weight_initializer)
+                    self._register_param(f"{j}{i}_h2h_weight",
+                                         (ng * nh, nh), h2h_weight_initializer)
+                    self._register_param(f"{j}{i}_i2h_bias",
+                                         (ng * nh,), i2h_bias_initializer)
+                    self._register_param(f"{j}{i}_h2h_bias",
+                                         (ng * nh,), h2h_bias_initializer)
+                ni = nh * self._dir
+
+    def _register_param(self, name, shape, init):
+        p = self.params.get(name, shape=shape, init=init,
+                            allow_deferred_init=True)
+        setattr(self, name, p)
+        return p
+
+    def infer_shape(self, x, *args):
+        isz = x.shape[2] if self._layout == "TNC" else x.shape[2]
+        ni = isz
+        ng, nh = self._gates, self._hidden_size
+        for i in range(self._num_layers):
+            for j in ["l", "r"][:self._dir]:
+                getattr(self, f"{j}{i}_i2h_weight").shape = (ng * nh, ni)
+            ni = nh * self._dir
+        self._input_size = isz
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        from ... import ndarray as nd
+        if func is None:
+            func = nd.zeros
+        states = []
+        for i, info in enumerate(self.state_info(batch_size)):
+            info = dict(info)
+            info.pop("__layout__", None)
+            info.update(kwargs)
+            states.append(func(name=f"{self.prefix}h0_{i}", **info))
+        return states
+
+    def __call__(self, inputs, states=None, sequence_length=None, **kwargs):
+        self.skip_states = states is None
+        if states is None:
+            if isinstance(inputs, NDArray):
+                batch_size = inputs.shape[self._layout.find("N")]
+                states = self.begin_state(batch_size, ctx=inputs.ctx,
+                                          dtype=str(inputs.dtype))
+            else:
+                raise MXNetError("states required for symbolic input")
+        if isinstance(states, tensor_types):
+            states = [states]
+        return super().__call__(inputs, states)
+
+    def forward(self, inputs, states):
+        """Run the fused RNN op."""
+        from ... import ndarray as nd
+
+        if self._layout == "NTC":
+            inputs = inputs.swapaxes(0, 1)
+        # finalize deferred params
+        try:
+            flat = self._flat_params(inputs.ctx)
+        except Exception:
+            self.infer_shape(inputs)
+            for _, p in self.params.items():
+                p._finish_deferred_init()
+            flat = self._flat_params(inputs.ctx)
+
+        params = {"state_size": self._hidden_size,
+                  "num_layers": self._num_layers,
+                  "bidirectional": self._dir == 2,
+                  "mode": self._mode, "p": self._dropout,
+                  "state_outputs": True,
+                  "_training": _autograd.is_training()}
+        inputs_list = [inputs, flat, states[0]]
+        if self._mode == "lstm":
+            inputs_list.append(states[1])
+        res = invoke(get_op("RNN"), inputs_list, params)
+        if self._mode == "lstm":
+            out, h, c = res
+            out_states = [h, c]
+        else:
+            out, h = res
+            out_states = [h]
+        if self._layout == "NTC":
+            out = out.swapaxes(0, 1)
+        return out if self.skip_states else (out, out_states)
+
+    def _flat_params(self, ctx):
+        """Pack per-layer params into the cuDNN-canonical flat vector."""
+        from ... import ndarray as nd
+        ws = []
+        bs = []
+        for i in range(self._num_layers):
+            for j in ["l", "r"][:self._dir]:
+                ws.append(getattr(self, f"{j}{i}_i2h_weight").data(ctx).reshape(-1))
+                ws.append(getattr(self, f"{j}{i}_h2h_weight").data(ctx).reshape(-1))
+        for i in range(self._num_layers):
+            for j in ["l", "r"][:self._dir]:
+                bs.append(getattr(self, f"{j}{i}_i2h_bias").data(ctx))
+                bs.append(getattr(self, f"{j}{i}_h2h_bias").data(ctx))
+        return nd.concat(*(ws + bs), dim=0)
+
+    def __repr__(self):
+        s = "{name}({mapping}, {_layout}"
+        if self._num_layers != 1:
+            s += ", num_layers={_num_layers}"
+        if self._dropout != 0:
+            s += ", dropout={_dropout}"
+        if self._dir == 2:
+            s += ", bidirectional"
+        s += ")"
+        mapping = f"{self._input_size or None} -> {self._hidden_size}"
+        return s.format(name=self.__class__.__name__, mapping=mapping,
+                        **self.__dict__)
+
+
+class RNN(_RNNLayer):
+    """Vanilla (Elman) multi-layer RNN with relu/tanh activation."""
+
+    def __init__(self, hidden_size, num_layers=1, activation="relu",
+                 layout="TNC", dropout=0, bidirectional=False,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, i2h_weight_initializer,
+                         h2h_weight_initializer, i2h_bias_initializer,
+                         h2h_bias_initializer, "rnn_" + activation, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]
+
+
+class LSTM(_RNNLayer):
+    """Fused multi-layer LSTM (the cuDNN-LSTM analog; WikiText-2 config)."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 projection_size=None, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, i2h_weight_initializer,
+                         h2h_weight_initializer, i2h_bias_initializer,
+                         h2h_bias_initializer, "lstm", projection_size, **kwargs)
+
+    def state_info(self, batch_size=0):
+        shape = (self._num_layers * self._dir, batch_size, self._hidden_size)
+        return [{"shape": shape, "__layout__": "LNC"},
+                {"shape": shape, "__layout__": "LNC"}]
+
+
+class GRU(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, i2h_weight_initializer,
+                         h2h_weight_initializer, i2h_bias_initializer,
+                         h2h_bias_initializer, "gru", **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]

@@ -1,0 +1,393 @@
+"""Image loading & augmentation (python/mxnet/image/image.py analog).
+
+The reference pipeline (src/io/image_aug_default.cc DefaultImageAugmenter
++ iter_image_recordio_2.cc) does decode→resize→crop→flip→color-jitter→
+normalize on CPU worker threads. Here the augmenter chain is numpy
+(PIL for codecs), run in the iterator's prefetch thread; the output
+lands as one batched device array per step (single H2D per batch beats
+the reference's per-image copies).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .base import MXNetError
+from .context import current_context
+from .io.io import DataIter, DataBatch, DataDesc
+from .ndarray import array as nd_array
+from . import recordio as _recordio
+
+__all__ = [
+    "imresize", "imdecode", "resize_short", "fixed_crop", "center_crop",
+    "random_crop", "color_normalize", "HorizontalFlipAug", "CastAug",
+    "ColorNormalizeAug", "ForceResizeAug", "ResizeAug", "CenterCropAug",
+    "RandomCropAug", "CreateAugmenter", "Augmenter", "ImageIter",
+    "ImageRecordIterPy",
+]
+
+
+def imdecode(buf, to_rgb=1, **kwargs):
+    raw = np.frombuffer(buf, dtype=np.uint8) if isinstance(buf, (bytes, bytearray)) else buf
+    img = _recordio._decode_image(raw)
+    if img.ndim == 2:
+        img = np.stack([img] * 3, axis=-1)
+    return nd_array(img)
+
+
+def imresize(src, w, h, interp=1):
+    img = src.asnumpy() if hasattr(src, "asnumpy") else np.asarray(src)
+    out = _resize_np(img, w, h)
+    return nd_array(out)
+
+
+def _resize_np(img, w, h):
+    """Bilinear resize in numpy (no OpenCV in the TPU image)."""
+    ih, iw = img.shape[:2]
+    if (ih, iw) == (h, w):
+        return img.copy()
+    ys = np.linspace(0, ih - 1, h)
+    xs = np.linspace(0, iw - 1, w)
+    y0 = np.floor(ys).astype(int); y1 = np.minimum(y0 + 1, ih - 1)
+    x0 = np.floor(xs).astype(int); x1 = np.minimum(x0 + 1, iw - 1)
+    wy = (ys - y0)[:, None, None]
+    wx = (xs - x0)[None, :, None]
+    im = img.astype(np.float32)
+    if im.ndim == 2:
+        im = im[:, :, None]
+    top = im[y0][:, x0] * (1 - wx) + im[y0][:, x1] * wx
+    bot = im[y1][:, x0] * (1 - wx) + im[y1][:, x1] * wx
+    out = top * (1 - wy) + bot * wy
+    return out.astype(img.dtype) if img.dtype == np.uint8 else out
+
+
+def resize_short(src, size, interp=2):
+    img = src.asnumpy() if hasattr(src, "asnumpy") else np.asarray(src)
+    h, w = img.shape[:2]
+    if h > w:
+        new_h, new_w = size * h // w, size
+    else:
+        new_h, new_w = size, size * w // h
+    return nd_array(_resize_np(img, new_w, new_h))
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
+    img = src.asnumpy() if hasattr(src, "asnumpy") else np.asarray(src)
+    out = img[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != size:
+        out = _resize_np(out, size[0], size[1])
+    return nd_array(out)
+
+
+def center_crop(src, size, interp=2):
+    img = src.asnumpy() if hasattr(src, "asnumpy") else np.asarray(src)
+    h, w = img.shape[:2]
+    new_w, new_h = size
+    x0 = max(0, (w - new_w) // 2)
+    y0 = max(0, (h - new_h) // 2)
+    return fixed_crop(src, x0, y0, min(new_w, w), min(new_h, h), size), (x0, y0, new_w, new_h)
+
+
+def random_crop(src, size, interp=2):
+    img = src.asnumpy() if hasattr(src, "asnumpy") else np.asarray(src)
+    h, w = img.shape[:2]
+    new_w, new_h = size
+    x0 = np.random.randint(0, max(1, w - new_w + 1))
+    y0 = np.random.randint(0, max(1, h - new_h + 1))
+    return fixed_crop(src, x0, y0, min(new_w, w), min(new_h, h), size), (x0, y0, new_w, new_h)
+
+
+def color_normalize(src, mean, std=None):
+    img = src.asnumpy() if hasattr(src, "asnumpy") else np.asarray(src)
+    img = img.astype(np.float32) - np.asarray(mean, np.float32)
+    if std is not None:
+        img = img / np.asarray(std, np.float32)
+    return nd_array(img)
+
+
+class Augmenter:
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        import json
+        return json.dumps([type(self).__name__, self._kwargs])
+
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class ForceResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size)
+        self.size = size
+
+    def __call__(self, src):
+        img = np.asarray(src)
+        return _resize_np(img, self.size[0], self.size[1])
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size)
+        self.size = size
+
+    def __call__(self, src):
+        return np.asarray(resize_short(src, self.size))
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size)
+        self.size = size
+
+    def __call__(self, src):
+        out, _ = center_crop(src, self.size)
+        return np.asarray(out)
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size)
+        self.size = size
+
+    def __call__(self, src):
+        out, _ = random_crop(src, self.size)
+        return np.asarray(out)
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p=0.5):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        img = np.asarray(src)
+        if np.random.random() < self.p:
+            img = img[:, ::-1]
+        return img
+
+
+class CastAug(Augmenter):
+    def __init__(self, typ="float32"):
+        super().__init__(typ=typ)
+        self.typ = typ
+
+    def __call__(self, src):
+        return np.asarray(src).astype(self.typ)
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        super().__init__(mean=list(np.atleast_1d(mean)), std=list(np.atleast_1d(std)))
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+
+    def __call__(self, src):
+        return (np.asarray(src).astype(np.float32) - self.mean) / self.std
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, hue=0, pca_noise=0,
+                    rand_gray=0, inter_method=2):
+    """Build the standard augmenter chain (python/mxnet/image CreateAugmenter)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if mean is True:
+        mean = np.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = np.array([58.395, 57.12, 57.375])
+    if mean is not None and std is not None:
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+class ImageIter(DataIter):
+    """Python-side image iterator over RecordIO or image list
+    (python/mxnet/image/image.py ImageIter)."""
+
+    def __init__(self, batch_size, data_shape, label_width=1,
+                 path_imgrec=None, path_imglist=None, path_root=None,
+                 path_imgidx=None, shuffle=False, part_index=0, num_parts=1,
+                 aug_list=None, imglist=None, data_name="data",
+                 label_name="softmax_label", dtype="float32", ctx=None, **kwargs):
+        super().__init__(batch_size)
+        assert path_imgrec or path_imglist or imglist is not None
+        self.data_shape = tuple(data_shape)
+        self.batch_size = batch_size
+        self.label_width = label_width
+        self.ctx = ctx or current_context()
+        self.dtype = dtype
+        self.data_name = data_name
+        self.label_name = label_name
+        self.imgrec = None
+        self.seq = None
+        self.imglist = {}
+        if path_imgrec:
+            if path_imgidx:
+                self.imgrec = _recordio.MXIndexedRecordIO(path_imgidx, path_imgrec, "r")
+                self.seq = list(self.imgrec.keys)
+            else:
+                self.imgrec = _recordio.MXRecordIO(path_imgrec, "r")
+        elif path_imglist:
+            with open(path_imglist) as fin:
+                for line in fin:
+                    parts = line.strip().split("\t")
+                    label = np.array(parts[1:-1], dtype=np.float32)
+                    self.imglist[int(parts[0])] = (label, parts[-1])
+            self.seq = list(self.imglist.keys())
+            self.path_root = path_root
+        elif imglist is not None:
+            for i, (label, path) in enumerate(imglist):
+                self.imglist[i] = (np.atleast_1d(np.asarray(label, np.float32)), path)
+            self.seq = list(self.imglist.keys())
+            self.path_root = path_root
+        if num_parts > 1 and self.seq is not None:
+            self.seq = self.seq[part_index::num_parts]
+        self.shuffle = shuffle
+        self.auglist = aug_list if aug_list is not None else \
+            CreateAugmenter((data_shape[0], data_shape[1], data_shape[2]), **{
+                k: v for k, v in kwargs.items()
+                if k in ("resize", "rand_crop", "rand_mirror", "mean", "std")})
+        self.cur = 0
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc(self.data_name, (self.batch_size,) + self.data_shape, self.dtype)]
+
+    @property
+    def provide_label(self):
+        shape = (self.batch_size,) if self.label_width == 1 \
+            else (self.batch_size, self.label_width)
+        return [DataDesc(self.label_name, shape, self.dtype)]
+
+    def reset(self):
+        if self.shuffle and self.seq is not None:
+            np.random.shuffle(self.seq)
+        if self.imgrec is not None and self.seq is None:
+            self.imgrec.reset()
+        self.cur = 0
+
+    def next_sample(self):
+        if self.seq is not None:
+            if self.cur >= len(self.seq):
+                raise StopIteration
+            idx = self.seq[self.cur]
+            self.cur += 1
+            if self.imgrec is not None:
+                s = self.imgrec.read_idx(idx)
+                header, img = _recordio.unpack(s)
+                return header.label, img
+            label, fname = self.imglist[idx]
+            with open(f"{self.path_root}/{fname}", "rb") as f:
+                return label, f.read()
+        s = self.imgrec.read()
+        if s is None:
+            raise StopIteration
+        header, img = _recordio.unpack(s)
+        return header.label, img
+
+    def next(self):
+        batch_data = np.zeros((self.batch_size,) + self.data_shape, dtype=self.dtype)
+        batch_label = np.zeros((self.batch_size, self.label_width), dtype=np.float32)
+        i = 0
+        pad = 0
+        try:
+            while i < self.batch_size:
+                label, raw = self.next_sample()
+                img = np.asarray(imdecode(raw))
+                for aug in self.auglist:
+                    img = aug(img)
+                batch_data[i] = np.transpose(img, (2, 0, 1))  # HWC→CHW
+                batch_label[i] = np.atleast_1d(np.asarray(label, np.float32))[:self.label_width]
+                i += 1
+        except StopIteration:
+            if i == 0:
+                raise
+            pad = self.batch_size - i
+        label_out = batch_label[:, 0] if self.label_width == 1 else batch_label
+        return DataBatch(data=[nd_array(batch_data, ctx=self.ctx)],
+                         label=[nd_array(label_out, ctx=self.ctx)], pad=pad)
+
+    def iter_next(self):
+        try:
+            self._next_batch = self.next()
+            return True
+        except StopIteration:
+            return False
+
+
+class ImageRecordIterPy(ImageIter):
+    """ImageRecordIter with the native IO fast path (same kwargs surface
+    as the reference C++ iterator). Record framing + shuffling +
+    threaded batch prefetch run in the C++ library
+    (src/cc/recordio.cc); decode+augment run per batch on the Python
+    side; the batch lands as one contiguous device_put."""
+
+    def __init__(self, path_imgrec=None, path_imgidx=None, data_shape=None,
+                 batch_size=1, shuffle=False, rand_crop=False,
+                 rand_mirror=False, mean_r=0, mean_g=0, mean_b=0,
+                 std_r=1, std_g=1, std_b=1, num_parts=1, part_index=0,
+                 preprocess_threads=4, prefetch_buffer=4, label_width=1,
+                 resize=0, seed=0, **kwargs):
+        mean = None
+        std = None
+        if mean_r or mean_g or mean_b:
+            mean = np.array([mean_r, mean_g, mean_b], np.float32)
+            std = np.array([std_r or 1, std_g or 1, std_b or 1], np.float32)
+        aug = CreateAugmenter((data_shape[0], data_shape[1], data_shape[2]),
+                              resize=resize, rand_crop=rand_crop,
+                              rand_mirror=rand_mirror, mean=mean, std=std)
+        self._native = None  # before super().__init__ — it calls reset()
+        super().__init__(batch_size, data_shape, label_width=label_width,
+                         path_imgrec=path_imgrec, path_imgidx=path_imgidx,
+                         shuffle=shuffle, num_parts=num_parts,
+                         part_index=part_index, aug_list=aug)
+        if path_imgrec:
+            try:
+                from .io.native import NativeBatcher
+                self._native = NativeBatcher(
+                    path_imgrec, path_imgidx, batch_size=batch_size,
+                    num_threads=preprocess_threads, shuffle=shuffle,
+                    seed=seed, num_parts=num_parts, part_index=part_index)
+            except Exception:
+                self._native = None  # python fallback path
+
+    def reset(self):
+        if self._native is not None:
+            self._native.reset()
+            return
+        super().reset()
+
+    def next(self):
+        if self._native is None:
+            return super().next()
+        records = self._native.next()
+        if records is None:
+            raise StopIteration
+        batch_data = np.zeros((self.batch_size,) + self.data_shape,
+                              dtype=self.dtype)
+        batch_label = np.zeros((self.batch_size, self.label_width), np.float32)
+        from . import recordio as _rio
+        for i, raw in enumerate(records):
+            header, img_bytes = _rio.unpack(raw)
+            img = np.asarray(imdecode(img_bytes))
+            for aug in self.auglist:
+                img = aug(img)
+            batch_data[i] = np.transpose(img, (2, 0, 1))
+            batch_label[i] = np.atleast_1d(
+                np.asarray(header.label, np.float32))[:self.label_width]
+        pad = self.batch_size - len(records)
+        label_out = batch_label[:, 0] if self.label_width == 1 else batch_label
+        return DataBatch(data=[nd_array(batch_data, ctx=self.ctx)],
+                         label=[nd_array(label_out, ctx=self.ctx)], pad=pad)

@@ -1,0 +1,4 @@
+from .io import (
+    DataDesc, DataBatch, DataIter, NDArrayIter, ResizeIter, PrefetchingIter,
+    CSVIter, MNISTIter, ImageRecordIter,
+)

@@ -1,0 +1,415 @@
+"""Data iterators (python/mxnet/io/io.py + src/io/ analog).
+
+The reference's C++ iterator stack (MXDataIter over
+iter_image_recordio_2.cc decode/augment workers + PrefetcherIter +
+BatchLoader) is re-designed for TPU as: numpy-side batching with a
+background prefetch thread that overlaps host work with device steps
+(double-buffered device put — the PrefetcherIter analog). RecordIO
+parsing lives in recordio.py (+ C++ fast path in src/cc when built).
+"""
+from __future__ import annotations
+
+import collections
+import threading
+from collections import namedtuple
+
+import numpy as np
+
+from ..base import MXNetError
+from ..context import Context, cpu, current_context
+from ..ndarray import NDArray, array
+
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
+           "PrefetchingIter", "CSVIter", "MNISTIter", "ImageRecordIter"]
+
+
+class DataDesc(namedtuple("DataDesc", ["name", "shape"])):
+    """Name+shape(+dtype/layout) descriptor (python/mxnet/io DataDesc)."""
+
+    def __new__(cls, name, shape, dtype=np.float32, layout="NCHW"):
+        ret = super().__new__(cls, name, shape)
+        ret.dtype = dtype
+        ret.layout = layout
+        return ret
+
+    def __repr__(self):
+        return f"DataDesc[{self.name},{self.shape},{self.dtype},{self.layout}]"
+
+    @staticmethod
+    def get_batch_axis(layout):
+        if layout is None:
+            return 0
+        return layout.find("N")
+
+
+class DataBatch:
+    def __init__(self, data, label=None, pad=None, index=None,
+                 bucket_key=None, provide_data=None, provide_label=None):
+        if data is not None and not isinstance(data, (list, tuple)):
+            raise TypeError(f"Data must be list of NDArrays, got {type(data)}")
+        if label is not None and not isinstance(label, (list, tuple)):
+            raise TypeError(f"Label must be list of NDArrays, got {type(label)}")
+        self.data = data
+        self.label = label
+        self.pad = pad
+        self.index = index
+        self.bucket_key = bucket_key
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+    def __str__(self):
+        data_shapes = [d.shape for d in self.data]
+        if self.label:
+            label_shapes = [l.shape for l in self.label]
+        else:
+            label_shapes = None
+        return f"{type(self).__name__}: data shapes: {data_shapes} label shapes: {label_shapes}"
+
+
+class DataIter:
+    """Base iterator (python/mxnet/io DataIter)."""
+
+    def __init__(self, batch_size=0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def next(self):
+        if self.iter_next():
+            return DataBatch(data=self.getdata(), label=self.getlabel(),
+                             pad=self.getpad(), index=self.getindex())
+        raise StopIteration
+
+    def __next__(self):
+        return self.next()
+
+    def iter_next(self):
+        raise NotImplementedError
+
+    def getdata(self):
+        raise NotImplementedError
+
+    def getlabel(self):
+        raise NotImplementedError
+
+    def getindex(self):
+        return None
+
+    def getpad(self):
+        raise NotImplementedError
+
+
+def _init_data(data, allow_empty, default_name):
+    """Normalize input to list of (name, numpy) — reference io.py _init_data."""
+    assert data is not None or allow_empty
+    if data is None:
+        data = []
+    if isinstance(data, (np.ndarray, NDArray)):
+        data = [data]
+    if isinstance(data, list):
+        if not allow_empty:
+            assert len(data) > 0
+        if len(data) == 1:
+            data = collections.OrderedDict([(default_name, data[0])])
+        else:
+            data = collections.OrderedDict(
+                [(f"_{i}_{default_name}", d) for i, d in enumerate(data)])
+    if not isinstance(data, dict):
+        raise TypeError("Input must be NDArray, numpy.ndarray, a list of them or dict")
+    out = collections.OrderedDict()
+    for k, v in data.items():
+        out[k] = v.asnumpy() if isinstance(v, NDArray) else np.asarray(v)
+    return list(out.items())
+
+
+class NDArrayIter(DataIter):
+    """Iterate over in-memory arrays with optional shuffle and padding
+    (python/mxnet/io NDArrayIter, incl. pad/discard/roll_over)."""
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data",
+                 label_name="softmax_label", ctx=None):
+        super().__init__(batch_size)
+        self.data = _init_data(data, allow_empty=False, default_name=data_name)
+        self.label = _init_data(label, allow_empty=True, default_name=label_name)
+        self.idx = np.arange(self.data[0][1].shape[0])
+        self.shuffle = shuffle
+        self.last_batch_handle = last_batch_handle
+        self.ctx = ctx or current_context()
+        self.num_data = self.idx.shape[0]
+        if last_batch_handle == "discard":
+            self.num_data = (self.num_data // batch_size) * batch_size
+        assert self.num_data >= batch_size, "batch_size needs to be smaller than data size"
+        self.cursor = -batch_size
+        self._cache_data = None
+        self._cache_label = None
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.label]
+
+    def reset(self):
+        if self.shuffle:
+            np.random.shuffle(self.idx)
+        if self.last_batch_handle == "roll_over" and \
+                0 < self.cursor < self.num_data:
+            self.cursor = self.cursor - self.num_data - self.batch_size
+        else:
+            self.cursor = -self.batch_size
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        return self.cursor < self.num_data
+
+    def _getdata(self, data_source):
+        start = max(self.cursor, 0)
+        end = min(self.cursor + self.batch_size, self.num_data)
+        sel = self.idx[start:end]
+        out = []
+        for _, arr in data_source:
+            part = arr[sel]
+            if part.shape[0] < self.batch_size and self.last_batch_handle == "pad":
+                pad_n = self.batch_size - part.shape[0]
+                wrap = arr[self.idx[:pad_n]]
+                part = np.concatenate([part, wrap], axis=0)
+            out.append(array(part, ctx=self.ctx))
+        return out
+
+    def getdata(self):
+        return self._getdata(self.data)
+
+    def getlabel(self):
+        return self._getdata(self.label)
+
+    def getpad(self):
+        if self.last_batch_handle == "pad" and \
+                self.cursor + self.batch_size > self.num_data:
+            return self.cursor + self.batch_size - self.num_data
+        return 0
+
+    def getindex(self):
+        start = max(self.cursor, 0)
+        end = min(self.cursor + self.batch_size, self.num_data)
+        return self.idx[start:end]
+
+
+class ResizeIter(DataIter):
+    """Resize another iterator to `size` batches per epoch."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__(data_iter.batch_size)
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+        self.current_batch = None
+        self.provide_data = data_iter.provide_data
+        self.provide_label = data_iter.provide_label
+        if hasattr(data_iter, "default_bucket_key"):
+            self.default_bucket_key = data_iter.default_bucket_key
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def iter_next(self):
+        if self.cur == self.size:
+            return False
+        try:
+            self.current_batch = self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            self.current_batch = self.data_iter.next()
+        self.cur += 1
+        return True
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getindex(self):
+        return self.current_batch.index
+
+    def getpad(self):
+        return self.current_batch.pad
+
+
+class PrefetchingIter(DataIter):
+    """Background-thread prefetch (src/io/prefetcher.h analog): overlaps
+    host-side batch assembly + H2D with device compute."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None, depth=2):
+        if not isinstance(iters, (list, tuple)):
+            iters = [iters]
+        super().__init__(iters[0].batch_size)
+        self.iters = iters
+        self.rename_data = rename_data
+        self.rename_label = rename_label
+        self._queue: collections.deque = collections.deque()
+        self._depth = depth
+        self._lock = threading.Lock()
+        self._stop = False
+        self._exhausted = False
+        self._cv = threading.Condition(self._lock)
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()
+
+    @property
+    def provide_data(self):
+        if self.rename_data is None:
+            return sum([i.provide_data for i in self.iters], [])
+        return sum([[DataDesc(r[x.name], x.shape, x.dtype)
+                     if isinstance(r, dict) else x
+                     for x in i.provide_data]
+                    for r, i in zip(self.rename_data, self.iters)], [])
+
+    @property
+    def provide_label(self):
+        if self.rename_label is None:
+            return sum([i.provide_label for i in self.iters], [])
+        return sum([[DataDesc(r[x.name], x.shape, x.dtype)
+                     if isinstance(r, dict) else x
+                     for x in i.provide_label]
+                    for r, i in zip(self.rename_label, self.iters)], [])
+
+    def _worker(self):
+        while True:
+            with self._cv:
+                while len(self._queue) >= self._depth and not self._stop:
+                    self._cv.wait()
+                if self._stop:
+                    return
+            try:
+                batches = [i.next() for i in self.iters]
+                item = DataBatch(
+                    data=sum([b.data for b in batches], []),
+                    label=sum([(b.label or []) for b in batches], []),
+                    pad=batches[0].pad, index=batches[0].index)
+            except StopIteration:
+                item = None
+            with self._cv:
+                self._queue.append(item)
+                self._cv.notify_all()
+                if item is None:
+                    while not self._stop and len(self._queue) > 0 and self._queue[-1] is None:
+                        self._cv.wait()
+                    if self._stop:
+                        return
+
+    def reset(self):
+        with self._cv:
+            self._queue.clear()
+        for i in self.iters:
+            i.reset()
+        with self._cv:
+            self._cv.notify_all()
+
+    def next(self):
+        with self._cv:
+            while not self._queue:
+                self._cv.wait()
+            item = self._queue.popleft()
+            self._cv.notify_all()
+        if item is None:
+            raise StopIteration
+        return item
+
+    def iter_next(self):
+        try:
+            self._peek = self.next()
+            return True
+        except StopIteration:
+            return False
+
+    def __del__(self):
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+
+
+class CSVIter(DataIter):
+    """CSV file iterator (src/io/iter_csv.cc analog)."""
+
+    def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,),
+                 batch_size=1, round_batch=True, dtype="float32", ctx=None, **kwargs):
+        super().__init__(batch_size)
+        data = np.loadtxt(data_csv, delimiter=",", dtype=dtype)
+        data = data.reshape((-1,) + tuple(data_shape))
+        label = None
+        if label_csv is not None:
+            label = np.loadtxt(label_csv, delimiter=",", dtype=dtype)
+            label = label.reshape((-1,) + tuple(label_shape))
+        self._inner = NDArrayIter(data, label, batch_size,
+                                  last_batch_handle="roll_over" if round_batch else "pad",
+                                  ctx=ctx)
+        self.provide_data = self._inner.provide_data
+        self.provide_label = self._inner.provide_label
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()
+
+    def iter_next(self):
+        return self._inner.iter_next()
+
+
+class MNISTIter(DataIter):
+    """MNIST idx-format iterator (src/io/iter_mnist.cc analog)."""
+
+    def __init__(self, image, label, batch_size=128, shuffle=True, flat=False,
+                 seed=0, silent=False, num_parts=1, part_index=0, ctx=None, **kwargs):
+        super().__init__(batch_size)
+        import gzip
+        import struct
+
+        def read_idx(path):
+            opener = gzip.open if path.endswith(".gz") else open
+            with opener(path, "rb") as f:
+                _, _, dims = struct.unpack(">HBB", f.read(4))
+                shape = tuple(struct.unpack(">I", f.read(4))[0] for _ in range(dims))
+                return np.frombuffer(f.read(), dtype=np.uint8).reshape(shape)
+
+        img = read_idx(image).astype(np.float32) / 255.0
+        lbl = read_idx(label).astype(np.float32)
+        if num_parts > 1:
+            img = img[part_index::num_parts]
+            lbl = lbl[part_index::num_parts]
+        if not flat:
+            img = img.reshape(-1, 1, 28, 28)
+        else:
+            img = img.reshape(-1, 784)
+        self._inner = NDArrayIter(img, lbl, batch_size, shuffle=shuffle, ctx=ctx)
+        self.provide_data = self._inner.provide_data
+        self.provide_label = self._inner.provide_label
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()
+
+    def iter_next(self):
+        return self._inner.iter_next()
+
+
+def ImageRecordIter(**kwargs):
+    """RecordIO image pipeline (iter_image_recordio_2.cc analog) — built
+    on the recordio/image modules; see image.py ImageRecordIterPy."""
+    from ..image import ImageRecordIterPy
+    return ImageRecordIterPy(**kwargs)

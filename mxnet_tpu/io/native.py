@@ -1,0 +1,141 @@
+"""ctypes binding to the native IO library (src/cc/recordio.cc).
+
+The reference's IO hot path is C++ (dmlc recordio + threaded iter);
+this binds the TPU-native equivalent. The library is built on first use
+with the repo Makefile (g++ is in the image; no pybind11 — plain C ABI
+via ctypes, per the environment constraints).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_LIB = None
+_LOCK = threading.Lock()
+_SRC_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "src", "cc")
+_LIB_PATH = os.path.join(_SRC_DIR, "libmxtpu_io.so")
+
+
+class NativeIOUnavailable(RuntimeError):
+    pass
+
+
+def _load():
+    global _LIB
+    with _LOCK:
+        if _LIB is not None:
+            return _LIB
+        if not os.path.exists(_LIB_PATH) or \
+                os.path.getmtime(_LIB_PATH) < os.path.getmtime(
+                    os.path.join(_SRC_DIR, "recordio.cc")):
+            try:
+                subprocess.run(["make", "-C", _SRC_DIR], check=True,
+                               capture_output=True)
+            except (subprocess.CalledProcessError, FileNotFoundError) as e:
+                raise NativeIOUnavailable(
+                    f"could not build native IO library: {e}") from e
+        lib = ctypes.CDLL(_LIB_PATH)
+        lib.mxio_reader_open.restype = ctypes.c_void_p
+        lib.mxio_reader_open.argtypes = [ctypes.c_char_p]
+        lib.mxio_reader_next.restype = ctypes.c_int64
+        lib.mxio_reader_next.argtypes = [ctypes.c_void_p,
+                                         ctypes.POINTER(ctypes.c_char_p)]
+        lib.mxio_reader_close.argtypes = [ctypes.c_void_p]
+        lib.mxio_batcher_create.restype = ctypes.c_void_p
+        lib.mxio_batcher_create.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int64, ctypes.c_int,
+            ctypes.c_int, ctypes.c_uint64, ctypes.c_int64, ctypes.c_int64]
+        lib.mxio_batcher_num_batches.restype = ctypes.c_int64
+        lib.mxio_batcher_num_batches.argtypes = [ctypes.c_void_p]
+        lib.mxio_batcher_next.restype = ctypes.c_int64
+        lib.mxio_batcher_next.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_int64))]
+        lib.mxio_batcher_free_batch.argtypes = [ctypes.c_void_p]
+        lib.mxio_batcher_reset.argtypes = [ctypes.c_void_p]
+        lib.mxio_batcher_close.argtypes = [ctypes.c_void_p]
+        _LIB = lib
+        return lib
+
+
+def available() -> bool:
+    try:
+        _load()
+        return True
+    except NativeIOUnavailable:
+        return False
+
+
+class NativeRecordReader:
+    """Sequential reader over a RecordIO file (native framing)."""
+
+    def __init__(self, path):
+        self._lib = _load()
+        self._h = self._lib.mxio_reader_open(path.encode())
+        if not self._h:
+            raise IOError(f"cannot open {path}")
+
+    def read(self):
+        buf = ctypes.c_char_p()
+        n = self._lib.mxio_reader_next(self._h, ctypes.byref(buf))
+        if n < 0:
+            return None
+        return ctypes.string_at(buf, n)
+
+    def close(self):
+        if self._h:
+            self._lib.mxio_reader_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        self.close()
+
+
+class NativeBatcher:
+    """Threaded prefetching record batcher (iter_image_recordio_2 analog)."""
+
+    def __init__(self, rec_path, idx_path=None, batch_size=32, num_threads=4,
+                 shuffle=False, seed=0, num_parts=1, part_index=0):
+        self._lib = _load()
+        self._h = self._lib.mxio_batcher_create(
+            rec_path.encode(), (idx_path or "").encode(), batch_size,
+            num_threads, int(shuffle), seed, num_parts, part_index)
+        if not self._h:
+            raise IOError(f"cannot open {rec_path}")
+
+    @property
+    def num_batches(self):
+        return self._lib.mxio_batcher_num_batches(self._h)
+
+    def next(self):
+        """Returns list[bytes] for one batch, or None at epoch end."""
+        batch = ctypes.c_void_p()
+        data = ctypes.c_char_p()
+        offsets = ctypes.POINTER(ctypes.c_int64)()
+        n = self._lib.mxio_batcher_next(self._h, ctypes.byref(batch),
+                                        ctypes.byref(data),
+                                        ctypes.byref(offsets))
+        if n == 0:
+            return None
+        records = []
+        base = ctypes.cast(data, ctypes.c_void_p).value
+        for i in range(n):
+            lo, hi = offsets[i], offsets[i + 1]
+            records.append(ctypes.string_at(base + lo, hi - lo))
+        self._lib.mxio_batcher_free_batch(batch)
+        return records
+
+    def reset(self):
+        self._lib.mxio_batcher_reset(self._h)
+
+    def close(self):
+        if self._h:
+            self._lib.mxio_batcher_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        self.close()

@@ -1,0 +1,262 @@
+"""KVStore — data-parallel parameter/gradient store.
+
+API-compatible re-design of the reference KVStore
+(include/mxnet/kvstore.h, src/kvstore/kvstore_local.h `KVStoreLocal`,
+comm.h `CommCPU/CommDevice`, kvstore_nccl.h `KVStoreNCCL`,
+kvstore_dist.h + ps-lite for multi-node) per SURVEY §5.8: one backend,
+XLA collectives. Semantics preserved:
+
+- ``init/push/pull/pushpull/broadcast``, ``set_optimizer``/``_set_updater``
+  (update_on_kvstore), ``rank``/``num_workers``, sparse ``row_sparse_pull``;
+- push aggregates the per-device values (the CommDevice reduce / NCCL
+  allreduce analog) and either overwrites the stored value or runs the
+  updater on it — matching KVStoreLocal::PushImpl;
+- 'local'/'device'/'nccl' are single-process modes. On TPU the
+  per-device gradient copies of one process are already on chips of one
+  slice, so the reduce is a jitted sum that XLA lowers to ICI
+  collectives when inputs are sharded (no P2P ring code: the XLA
+  partitioner emits AllReduce).
+- 'dist_sync'/'dist_async'/'dist_device_sync' are multi-process modes:
+  ``jax.distributed.initialize`` (driven by tools/launch.py setting
+  coordinator env vars — the dmlc tracker analog) gives every process
+  the global device view; cross-host aggregation is a psum over the
+  global mesh's data axis riding DCN. No server processes exist:
+  `update_on_kvstore` means "run the optimizer on the aggregated value
+  locally, identically on every worker" — bitwise-identical by SPMD
+  construction, replacing the parameter-server role.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Optional
+
+import jax
+import numpy as np
+
+from .base import MXNetError
+from .ndarray import NDArray
+from .ndarray.ndarray import _wrap
+
+__all__ = ["KVStore", "create"]
+
+
+def create(name="local") -> "KVStore":
+    """mx.kv.create factory (src/kvstore/kvstore.cc KVStore::Create)."""
+    name = name.lower()
+    if name in ("local", "local_update_cpu", "local_allreduce_cpu",
+                "device", "local_allreduce_device", "nccl"):
+        return KVStore(name)
+    if name in ("dist_sync", "dist_async", "dist_device_sync", "dist_sync_device", "dist"):
+        return DistKVStore(name)
+    if name == "horovod":
+        raise MXNetError("horovod kvstore is not supported on the TPU backend; "
+                         "use 'device' (ICI) or 'dist_sync' (multi-host)")
+    raise MXNetError(f"unknown kvstore type {name!r}")
+
+
+class KVStore:
+    """Single-process store: aggregates across this process's devices."""
+
+    def __init__(self, kind="local"):
+        self._kind = kind
+        self._store: dict = {}
+        self._updater = None
+        self._optimizer = None
+        self._grad_compression = None
+
+    # -- identity ----------------------------------------------------------
+    @property
+    def type(self):
+        return self._kind
+
+    @property
+    def rank(self):
+        return 0
+
+    @property
+    def num_workers(self):
+        return 1
+
+    # -- core ops ----------------------------------------------------------
+    def init(self, key, value):
+        keys, values = _normalize(key, value)
+        for k, v in zip(keys, values):
+            vs = v if isinstance(v, (list, tuple)) else [v]
+            if k in self._store:
+                raise MXNetError(f"key {k} already initialized")
+            self._store[k] = vs[0].copy()
+
+    def push(self, key, value, priority=0):
+        keys, values = _normalize(key, value)
+        for k, v in zip(keys, values):
+            merged = self._reduce(v if isinstance(v, (list, tuple)) else [v])
+            self._apply(k, merged)
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        keys, outs = _normalize(key, out)
+        for k, o in zip(keys, outs):
+            stored = self._get(k)
+            for dst in (o if isinstance(o, (list, tuple)) else [o]):
+                stored.copyto(dst)
+
+    def pushpull(self, key, value, out=None, priority=0):
+        self.push(key, value, priority)
+        self.pull(key, out if out is not None else value, priority)
+
+    def broadcast(self, key, value, out=None, priority=0):
+        self.init(key, value)
+        if out is not None:
+            self.pull(key, out, priority)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Pull only the requested rows (sparse embedding path —
+        reference kvstore sparse pull; here a gather)."""
+        import jax.numpy as jnp
+        from .ndarray import sparse as _sp
+        keys, outs = _normalize(key, out)
+        _, rids = _normalize(key, row_ids)
+        for k, o, r in zip(keys, outs, rids):
+            stored = self._get(k)
+            dense = stored.todense().asnumpy() \
+                if isinstance(stored, _sp.BaseSparseNDArray) else stored.asnumpy()
+            dsts = o if isinstance(o, (list, tuple)) else [o]
+            rows = r if isinstance(r, (list, tuple)) else [r] * len(dsts)
+            for dst, rid in zip(dsts, rows):
+                ids = rid.asnumpy().astype(np.int64).reshape(-1)
+                uniq = np.unique(ids)
+                if isinstance(dst, _sp.RowSparseNDArray):
+                    # rebuild the row_sparse triple in place
+                    dst._data = jnp.asarray(dense[uniq], dst._data.dtype)
+                    dst._aux = jnp.asarray(uniq, jnp.int64)
+                    dst._version += 1
+                else:
+                    full = jnp.zeros(stored.shape, dst.dtype)
+                    full = full.at[jnp.asarray(uniq)].set(
+                        jnp.asarray(dense[uniq], dst.dtype))
+                    dst._set_data(full)
+
+    # -- optimizer / updater ----------------------------------------------
+    def set_optimizer(self, optimizer):
+        from .optimizer import get_updater
+        self._optimizer = optimizer
+        self._updater = get_updater(optimizer)
+
+    def _set_updater(self, updater):
+        self._updater = updater
+
+    def set_gradient_compression(self, compression_params):
+        # reference: 2-bit stochastic quantization worker↔server
+        # (src/kvstore/gradient_compression.cc). Stored for API parity;
+        # single-slice ICI allreduce needs no compression.
+        self._grad_compression = dict(compression_params)
+
+    # -- optimizer state io (reference save/load via updater pickle) ------
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        if self._updater is None:
+            raise MXNetError("there is no optimizer set to this kvstore")
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("there is no optimizer set to this kvstore")
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+    # -- internals ---------------------------------------------------------
+    def _get(self, k):
+        if k not in self._store:
+            raise MXNetError(f"key {k} was not initialized")
+        return self._store[k]
+
+    def _reduce(self, arrays):
+        """Sum per-device values. The jitted add tree is XLA's problem;
+        with sharded inputs it lowers to an ICI AllReduce (the
+        CommDevice/NCCL analog)."""
+        merged = arrays[0]
+        if len(arrays) > 1:
+            ctx = merged.ctx
+            acc = merged._data
+            for a in arrays[1:]:
+                other = a._data
+                if other.device != acc.device:
+                    other = jax.device_put(other, acc.device)
+                acc = acc + other
+            merged = _wrap(acc, ctx)
+        return merged
+
+    def _apply(self, k, merged):
+        stored = self._get(k)
+        if self._updater is not None:
+            self._updater(k, merged.astype(stored.dtype), stored)
+        else:
+            stored._set_data(merged._data.astype(stored.dtype))
+
+    def __repr__(self):
+        return f"<KVStore {self._kind} rank={self.rank}/{self.num_workers}>"
+
+
+class DistKVStore(KVStore):
+    """Multi-process store over jax.distributed (the ps-lite analog —
+    but serverless: every worker holds the aggregated value by SPMD)."""
+
+    def __init__(self, kind="dist_sync"):
+        super().__init__(kind)
+        self._initialized = _maybe_init_distributed()
+
+    @property
+    def rank(self):
+        return jax.process_index() if self._initialized else 0
+
+    @property
+    def num_workers(self):
+        return jax.process_count() if self._initialized else 1
+
+    def _reduce(self, arrays):
+        merged = super()._reduce(arrays)
+        if self.num_workers > 1:
+            merged = _cross_process_allreduce(merged)
+        return merged
+
+    def barrier(self):
+        """_barrier analog (ps-lite Barrier): sync all workers."""
+        if self.num_workers > 1:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("mxnet_tpu_kvstore_barrier")
+
+
+def _maybe_init_distributed() -> bool:
+    """jax.distributed.initialize from DMLC-compatible env (tools/launch.py
+    sets MXNET_TPU_COORDINATOR / DMLC_PS_ROOT_URI+PORT, num/id)."""
+    if jax.process_count() > 1:
+        return True
+    coord = os.environ.get("MXNET_TPU_COORDINATOR")
+    n = os.environ.get("MXNET_TPU_NUM_PROCS") or os.environ.get("DMLC_NUM_WORKER")
+    pid = os.environ.get("MXNET_TPU_PROC_ID") or os.environ.get("DMLC_WORKER_ID")
+    if not coord and os.environ.get("DMLC_PS_ROOT_URI"):
+        coord = (os.environ["DMLC_PS_ROOT_URI"] + ":"
+                 + os.environ.get("DMLC_PS_ROOT_PORT", "9000"))
+    if coord and n and pid is not None:
+        try:
+            jax.distributed.initialize(coordinator_address=coord,
+                                       num_processes=int(n),
+                                       process_id=int(pid))
+            return True
+        except Exception:  # already initialized or single-proc fallback
+            return jax.process_count() > 1
+    return jax.process_count() > 1
+
+
+def _cross_process_allreduce(merged: NDArray) -> NDArray:
+    """psum across processes over the global mesh data axis (DCN/ICI)."""
+    from jax.experimental import multihost_utils
+    # simplest correct eager path: gather-to-all then sum locally.
+    summed = multihost_utils.process_allgather(merged._data).sum(axis=0)
+    return _wrap(jax.device_put(summed, merged._data.device), merged.ctx)
+
+
+def _normalize(key, value):
+    if isinstance(key, (list, tuple)):
+        return list(key), list(value)
+    return [key], [value]

@@ -1,0 +1,461 @@
+"""Evaluation metrics (python/mxnet/metric.py analog).
+
+Same API surface: ``EvalMetric`` base with update/get/reset,
+``CompositeEvalMetric``, a ``create`` registry, and the classes the
+reference ships: Accuracy, TopKAccuracy, F1, MCC, MAE, MSE, RMSE,
+CrossEntropy, NegativeLogLikelihood, PearsonCorrelation, Perplexity,
+Loss, Torch/Caffe placeholders omitted. Metric math runs on host numpy
+— metrics are sync points by nature (reference does the same via
+asnumpy in each update)."""
+from __future__ import annotations
+
+import math
+
+import numpy
+
+from .base import _Registry
+from .ndarray import NDArray
+
+__all__ = [
+    "EvalMetric", "CompositeEvalMetric", "Accuracy", "TopKAccuracy", "F1",
+    "MCC", "MAE", "MSE", "RMSE", "CrossEntropy", "NegativeLogLikelihood",
+    "PearsonCorrelation", "Perplexity", "Loss", "CustomMetric", "np",
+    "create", "register",
+]
+
+_REG = _Registry("metric")
+
+
+def register(klass=None, name=None):
+    if klass is None:
+        return lambda k: register(k, name)
+    _REG.register((name or klass.__name__).lower())(klass)
+    return klass
+
+
+def create(metric, *args, **kwargs):
+    if callable(metric):
+        return CustomMetric(metric, *args, **kwargs)
+    if isinstance(metric, EvalMetric):
+        return metric
+    if isinstance(metric, list):
+        composite = CompositeEvalMetric()
+        for child in metric:
+            composite.add(create(child, *args, **kwargs))
+        return composite
+    return _REG.get(metric)(*args, **kwargs)
+
+
+def _as_np(x):
+    if isinstance(x, NDArray):
+        return x.asnumpy()
+    return numpy.asarray(x)
+
+
+def check_label_shapes(labels, preds, wrap=False, shape=False):
+    if isinstance(labels, NDArray):
+        labels = [labels]
+    if isinstance(preds, NDArray):
+        preds = [preds]
+    if len(labels) != len(preds):
+        raise ValueError(f"Shape of labels {len(labels)} does not match shape of predictions {len(preds)}")
+    return labels, preds
+
+
+class EvalMetric:
+    def __init__(self, name, output_names=None, label_names=None, **kwargs):
+        self.name = str(name)
+        self.output_names = output_names
+        self.label_names = label_names
+        self._kwargs = kwargs
+        self.reset()
+
+    def __str__(self):
+        return f"EvalMetric: {dict(zip(*self.get()))}"
+
+    def get_config(self):
+        config = dict(self._kwargs)
+        config.update({"metric": type(self).__name__, "name": self.name,
+                       "output_names": self.output_names,
+                       "label_names": self.label_names})
+        return config
+
+    def update_dict(self, label, pred):
+        if self.output_names is not None:
+            pred = [pred[name] for name in self.output_names if name in pred]
+        else:
+            pred = list(pred.values())
+        if self.label_names is not None:
+            label = [label[name] for name in self.label_names if name in label]
+        else:
+            label = list(label.values())
+        self.update(label, pred)
+
+    def update(self, labels, preds):
+        raise NotImplementedError
+
+    def reset(self):
+        self.num_inst = 0
+        self.sum_metric = 0.0
+        self.global_num_inst = 0
+        self.global_sum_metric = 0.0
+
+    def reset_local(self):
+        self.num_inst = 0
+        self.sum_metric = 0.0
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float("nan"))
+        return (self.name, self.sum_metric / self.num_inst)
+
+    def get_global(self):
+        if self.global_num_inst == 0:
+            return (self.name, float("nan"))
+        return (self.name, self.global_sum_metric / self.global_num_inst)
+
+    def get_name_value(self):
+        name, value = self.get()
+        if not isinstance(name, list):
+            name = [name]
+        if not isinstance(value, list):
+            value = [value]
+        return list(zip(name, value))
+
+    def _inc(self, metric, inst):
+        self.sum_metric += metric
+        self.num_inst += inst
+        self.global_sum_metric += metric
+        self.global_num_inst += inst
+
+
+class CompositeEvalMetric(EvalMetric):
+    def __init__(self, metrics=None, name="composite", output_names=None,
+                 label_names=None):
+        super().__init__(name, output_names, label_names)
+        self.metrics = [create(m) for m in (metrics or [])]
+
+    def add(self, metric):
+        self.metrics.append(create(metric))
+
+    def get_metric(self, index):
+        return self.metrics[index]
+
+    def update_dict(self, labels, preds):
+        for metric in self.metrics:
+            metric.update_dict(labels, preds)
+
+    def update(self, labels, preds):
+        for metric in self.metrics:
+            metric.update(labels, preds)
+
+    def reset(self):
+        for metric in getattr(self, "metrics", []):
+            metric.reset()
+
+    def reset_local(self):
+        for metric in getattr(self, "metrics", []):
+            metric.reset_local()
+
+    def get(self):
+        names, values = [], []
+        for metric in self.metrics:
+            name, value = metric.get()
+            if isinstance(name, str):
+                name = [name]
+            if isinstance(value, (float, int, numpy.generic)):
+                value = [value]
+            names.extend(name)
+            values.extend(value)
+        return (names, values)
+
+
+@register(name="acc")
+@register
+class Accuracy(EvalMetric):
+    def __init__(self, axis=1, name="accuracy", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names, axis=axis)
+        self.axis = axis
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            pred, label = _as_np(pred), _as_np(label)
+            if pred.ndim > label.ndim:
+                pred = numpy.argmax(pred, axis=self.axis)
+            pred = pred.astype(numpy.int32).flat
+            label = label.astype(numpy.int32).flat
+            correct = int((numpy.asarray(pred) == numpy.asarray(label)).sum())
+            self._inc(correct, len(numpy.asarray(label)))
+
+
+@register(name="top_k_accuracy")
+@register(name="top_k_acc")
+@register
+class TopKAccuracy(EvalMetric):
+    def __init__(self, top_k=1, name="top_k_accuracy", output_names=None,
+                 label_names=None):
+        super().__init__(name, output_names, label_names, top_k=top_k)
+        self.top_k = top_k
+        assert self.top_k > 1, "Please use Accuracy if top_k is no more than 1"
+        self.name += f"_{self.top_k}"
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            pred, label = _as_np(pred), _as_np(label).astype(numpy.int32)
+            assert pred.ndim == 2
+            arg = numpy.argsort(pred, axis=1)[:, ::-1][:, :self.top_k]
+            correct = int((arg == label.reshape(-1, 1)).any(axis=1).sum())
+            self._inc(correct, len(label))
+
+
+class _BinaryClassificationHelper:
+    def __init__(self):
+        self.reset_stats()
+
+    def reset_stats(self):
+        self.tp = self.fp = self.tn = self.fn = 0
+
+    def update(self, label, pred):
+        pred_label = numpy.argmax(pred, axis=1) if pred.ndim > 1 else (pred > 0.5)
+        label = label.astype(numpy.int32).reshape(-1)
+        pred_label = numpy.asarray(pred_label).astype(numpy.int32).reshape(-1)
+        self.tp += int(((pred_label == 1) & (label == 1)).sum())
+        self.fp += int(((pred_label == 1) & (label == 0)).sum())
+        self.tn += int(((pred_label == 0) & (label == 0)).sum())
+        self.fn += int(((pred_label == 0) & (label == 1)).sum())
+
+    @property
+    def precision(self):
+        return self.tp / (self.tp + self.fp) if self.tp + self.fp else 0.0
+
+    @property
+    def recall(self):
+        return self.tp / (self.tp + self.fn) if self.tp + self.fn else 0.0
+
+    @property
+    def fscore(self):
+        p, r = self.precision, self.recall
+        return 2 * p * r / (p + r) if p + r else 0.0
+
+    @property
+    def matthewscc(self):
+        terms = [(self.tp + self.fp), (self.tp + self.fn),
+                 (self.tn + self.fp), (self.tn + self.fn)]
+        denom = 1.0
+        for t in terms:
+            denom *= t if t else 1.0
+        return ((self.tp * self.tn) - (self.fp * self.fn)) / math.sqrt(denom)
+
+    @property
+    def total_examples(self):
+        return self.tp + self.fp + self.tn + self.fn
+
+
+@register
+class F1(EvalMetric):
+    def __init__(self, name="f1", output_names=None, label_names=None,
+                 average="macro"):
+        super().__init__(name, output_names, label_names)
+        self.average = average
+        self.metrics = _BinaryClassificationHelper()
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            self.metrics.update(_as_np(label), _as_np(pred))
+            if self.average == "macro":
+                self._inc(self.metrics.fscore, 1)
+                self.metrics.reset_stats()
+
+    def get(self):
+        if self.average == "macro":
+            return super().get()
+        if self.metrics.total_examples == 0:
+            return (self.name, float("nan"))
+        return (self.name, self.metrics.fscore)
+
+    def reset(self):
+        super().reset()
+        if hasattr(self, "metrics"):
+            self.metrics.reset_stats()
+
+
+@register
+class MCC(F1):
+    def __init__(self, name="mcc", output_names=None, label_names=None,
+                 average="macro"):
+        super().__init__(name, output_names, label_names, average)
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            self.metrics.update(_as_np(label), _as_np(pred))
+            if self.average == "macro":
+                self._inc(self.metrics.matthewscc, 1)
+                self.metrics.reset_stats()
+
+    def get(self):
+        if self.average == "macro":
+            return EvalMetric.get(self)
+        if self.metrics.total_examples == 0:
+            return (self.name, float("nan"))
+        return (self.name, self.metrics.matthewscc)
+
+
+@register
+class MAE(EvalMetric):
+    def __init__(self, name="mae", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            label, pred = _as_np(label), _as_np(pred)
+            if label.ndim == 1:
+                label = label.reshape(label.shape[0], 1)
+            if pred.ndim == 1:
+                pred = pred.reshape(pred.shape[0], 1)
+            self._inc(float(numpy.abs(label - pred).mean()), 1)
+
+
+@register
+class MSE(EvalMetric):
+    def __init__(self, name="mse", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            label, pred = _as_np(label), _as_np(pred)
+            if label.ndim == 1:
+                label = label.reshape(label.shape[0], 1)
+            if pred.ndim == 1:
+                pred = pred.reshape(pred.shape[0], 1)
+            self._inc(float(((label - pred) ** 2).mean()), 1)
+
+
+@register
+class RMSE(MSE):
+    def __init__(self, name="rmse", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+    def get(self):
+        name, value = super().get()
+        return (name, math.sqrt(value) if not math.isnan(value) else value)
+
+
+@register(name="ce")
+@register
+class CrossEntropy(EvalMetric):
+    def __init__(self, eps=1e-12, name="cross-entropy", output_names=None,
+                 label_names=None):
+        super().__init__(name, output_names, label_names, eps=eps)
+        self.eps = eps
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            label = _as_np(label).ravel().astype(numpy.int64)
+            pred = _as_np(pred)
+            assert label.shape[0] == pred.shape[0]
+            prob = pred[numpy.arange(label.shape[0]), label]
+            self._inc(float((-numpy.log(prob + self.eps)).sum()), label.shape[0])
+
+
+@register(name="nll_loss")
+@register
+class NegativeLogLikelihood(CrossEntropy):
+    def __init__(self, eps=1e-12, name="nll-loss", output_names=None,
+                 label_names=None):
+        super().__init__(eps, name, output_names, label_names)
+
+
+@register(name="pearsonr")
+@register
+class PearsonCorrelation(EvalMetric):
+    def __init__(self, name="pearsonr", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            label, pred = _as_np(label).ravel(), _as_np(pred).ravel()
+            self._inc(float(numpy.corrcoef(pred, label)[0, 1]), 1)
+
+
+@register
+class Perplexity(EvalMetric):
+    def __init__(self, ignore_label=None, axis=-1, name="perplexity",
+                 output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names,
+                         ignore_label=ignore_label, axis=axis)
+        self.ignore_label = ignore_label
+        self.axis = axis
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        loss = 0.0
+        num = 0
+        for label, pred in zip(labels, preds):
+            label = _as_np(label).reshape(-1).astype(numpy.int64)
+            pred = _as_np(pred).reshape(label.shape[0], -1)
+            probs = pred[numpy.arange(label.shape[0]), label]
+            if self.ignore_label is not None:
+                ignore = (label == self.ignore_label)
+                probs = numpy.where(ignore, 1.0, probs)
+                num -= int(ignore.sum())
+            loss -= float(numpy.log(numpy.maximum(1e-10, probs)).sum())
+            num += label.shape[0]
+        self._inc(loss, num)
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float("nan"))
+        return (self.name, math.exp(self.sum_metric / self.num_inst))
+
+
+@register
+class Loss(EvalMetric):
+    def __init__(self, name="loss", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+    def update(self, _, preds):
+        if isinstance(preds, NDArray):
+            preds = [preds]
+        for pred in preds:
+            loss = float(_as_np(pred).sum())
+            self._inc(loss, int(numpy.prod(_as_np(pred).shape)))
+
+
+class CustomMetric(EvalMetric):
+    def __init__(self, feval, name=None, allow_extra_outputs=False,
+                 output_names=None, label_names=None):
+        if name is None:
+            name = getattr(feval, "__name__", "custom")
+            if name.find("<") != -1:
+                name = "custom(%s)" % name
+        super().__init__(name, output_names, label_names)
+        self._feval = feval
+        self._allow_extra_outputs = allow_extra_outputs
+
+    def update(self, labels, preds):
+        if not self._allow_extra_outputs:
+            labels, preds = check_label_shapes(labels, preds, True)
+        for pred, label in zip(preds, labels):
+            label, pred = _as_np(label), _as_np(pred)
+            reval = self._feval(label, pred)
+            if isinstance(reval, tuple):
+                sum_metric, num_inst = reval
+                self._inc(sum_metric, num_inst)
+            else:
+                self._inc(reval, 1)
+
+
+def np(numpy_feval, name=None, allow_extra_outputs=False):
+    """Wrap a numpy eval function into a CustomMetric (mx.metric.np)."""
+    def feval(label, pred):
+        return numpy_feval(label, pred)
+    feval.__name__ = getattr(numpy_feval, "__name__", "custom")
+    return CustomMetric(feval, name, allow_extra_outputs)

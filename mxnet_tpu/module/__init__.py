@@ -1,0 +1,3 @@
+from .module import Module
+from .base_module import BaseModule
+from .bucketing_module import BucketingModule

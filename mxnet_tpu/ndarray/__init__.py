@@ -1,0 +1,154 @@
+"""mx.nd — the imperative NDArray namespace.
+
+Import-time op-namespace codegen: the reference generates
+``mxnet.ndarray.*`` functions from the C op registry at import
+(python/mxnet/base.py ``_init_op_module`` reading MXListAllOpNames);
+here :func:`register.populate_namespace` does the same from the Python
+op registry.
+"""
+from __future__ import annotations
+
+import sys
+
+import numpy as _np
+
+from .ndarray import NDArray, array, empty, zeros, ones, full, arange, _wrap
+from . import register as _register
+
+# op implementations — importing registers them
+from . import op_impl_basic  # noqa: F401
+from . import op_impl_nn  # noqa: F401
+from . import op_impl_optimizer  # noqa: F401
+from . import op_impl_random  # noqa: F401
+from . import op_impl_rnn  # noqa: F401
+
+# generate mx.nd.<op> functions into this module
+_GENERATED = _register.populate_namespace(__name__)
+
+from .register import invoke as _invoke, get_op as _get_op  # noqa: E402
+
+
+def zeros_like(data, **kwargs):
+    return _invoke(_get_op("zeros_like"), [data])
+
+
+def ones_like(data, **kwargs):
+    return _invoke(_get_op("ones_like"), [data])
+
+
+# ----------------------------------------------------------------------
+# stateful-op eager wrappers (training-mode injection; reference does
+# this inside the op via Imperative::is_training())
+# ----------------------------------------------------------------------
+def Dropout(data, p=0.5, mode="training", axes=None, cudnn_off=False, **kwargs):
+    from .. import autograd
+    return _invoke(_get_op("Dropout"), [data],
+                   {"p": p, "mode": mode, "axes": axes,
+                    "_training": autograd.is_training()})
+
+
+def BatchNorm(data, gamma, beta, moving_mean, moving_var, eps=1e-5,
+              momentum=0.9, fix_gamma=True, use_global_stats=False,
+              output_mean_var=False, axis=1, cudnn_off=False, **kwargs):
+    """Eager BatchNorm with reference semantics: batch stats + moving-stat
+    in-place update in train mode, moving stats in predict mode
+    (reference src/operator/nn/batch_norm.cc aux-state update)."""
+    from .. import autograd
+
+    ax = int(axis) % data.ndim
+    red = tuple(i for i in range(data.ndim) if i != ax)
+    if autograd.is_training() and not use_global_stats:
+        mean = _invoke(_get_op("mean"), [data], {"axis": red})
+        diff = data - mean.reshape([1 if i != ax else -1 for i in range(data.ndim)])
+        var = _invoke(_get_op("mean"), [diff * diff], {"axis": red})
+        with autograd.pause():
+            m = float(momentum)
+            moving_mean._set_data((m * moving_mean._data
+                                   + (1 - m) * mean._data.astype(moving_mean.dtype)))
+            moving_var._set_data((m * moving_var._data
+                                  + (1 - m) * var._data.astype(moving_var.dtype)))
+    else:
+        mean, var = moving_mean, moving_var
+    out = _invoke(_get_op("BatchNorm"), [data, gamma, beta, mean, var],
+                  {"eps": eps, "momentum": momentum, "fix_gamma": fix_gamma,
+                   "axis": axis})
+    if output_mean_var:
+        return out, mean, var
+    return out
+
+
+# ----------------------------------------------------------------------
+# save / load (NDArray file format; serialization.py implements the
+# reference binary layout — src/ndarray/ndarray.cc Save/Load)
+# ----------------------------------------------------------------------
+def save(fname, data):
+    from .serialization import save as _save
+    _save(fname, data)
+
+
+def load(fname):
+    from .serialization import load as _load
+    return _load(fname)
+
+
+def concatenate(arrays, axis=0, always_copy=True):
+    return _invoke(_get_op("concat"), list(arrays), {"dim": axis})
+
+
+def split(data, num_outputs=1, axis=1, squeeze_axis=False):
+    res = _invoke(_get_op("split"), [data],
+                  {"num_outputs": num_outputs, "axis": axis,
+                   "squeeze_axis": squeeze_axis})
+    return res
+
+
+def split_v2(data, indices_or_sections, axis=0, squeeze_axis=False):
+    return _invoke(_get_op("split_v2"), [data],
+                   {"indices_or_sections": indices_or_sections, "axis": axis,
+                    "squeeze_axis": squeeze_axis})
+
+
+def topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
+    return _invoke(_get_op("topk"), [data],
+                   {"axis": axis, "k": k, "ret_typ": ret_typ,
+                    "is_ascend": is_ascend, "dtype": dtype})
+
+
+def waitall():
+    from ..engine import engine
+    engine.wait_all()
+
+
+def moveaxis(data, source, destination):
+    import jax.numpy as jnp
+    return _wrap(jnp.moveaxis(data._data, source, destination), data.ctx)
+
+
+def stack(*data, axis=0):
+    if len(data) == 1 and isinstance(data[0], (list, tuple)):
+        data = tuple(data[0])
+    return _invoke(_get_op("stack"), list(data), {"axis": axis})
+
+
+def concat(*data, dim=1):
+    if len(data) == 1 and isinstance(data[0], (list, tuple)):
+        data = tuple(data[0])
+    return _invoke(_get_op("concat"), list(data), {"dim": dim})
+
+
+def add_n(*data):
+    if len(data) == 1 and isinstance(data[0], (list, tuple)):
+        data = tuple(data[0])
+    return _invoke(_get_op("add_n"), list(data))
+
+
+ElementWiseSum = add_n
+
+
+# random / sparse / linalg / contrib sub-namespaces
+from . import random  # noqa: E402,F401
+from . import sparse  # noqa: E402,F401
+from . import linalg  # noqa: E402,F401
+from . import contrib  # noqa: E402,F401
+
+ndarray = sys.modules[__name__]  # self-alias (mx.ndarray is mx.nd)

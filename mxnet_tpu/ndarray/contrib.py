@@ -1,0 +1,67 @@
+"""mx.nd.contrib namespace (python/mxnet/ndarray/contrib.py analog):
+control flow, arange_like, and misc contrib ops."""
+from __future__ import annotations
+
+from ..ops.control_flow import foreach, while_loop, cond  # noqa: F401
+from .register import invoke as _invoke, get_op as _get_op
+
+
+def arange_like(data, start=0.0, step=1.0, repeat=1, axis=None):
+    return _invoke(_get_op("_arange_like"), [data],
+                   {"start": start, "step": step, "repeat": repeat,
+                    "axis": axis})
+
+
+def index_copy(old_tensor, index_vector, new_tensor):
+    import jax.numpy as jnp
+    from .ndarray import _wrap
+    idx = index_vector._data.astype(jnp.int32)
+    return _wrap(old_tensor._data.at[idx].set(new_tensor._data),
+                 old_tensor.ctx)
+
+
+def index_array(data, axes=None):
+    import jax.numpy as jnp
+    import numpy as np
+    from .ndarray import _wrap
+    shape = data.shape
+    axes = tuple(np.atleast_1d(axes)) if axes is not None else tuple(range(len(shape)))
+    grids = jnp.meshgrid(*[jnp.arange(shape[a]) for a in axes], indexing="ij")
+    out = jnp.stack(grids, axis=-1).astype(jnp.int64)
+    return _wrap(out, data.ctx)
+
+
+def getnnz(data, axis=None):
+    from . import sparse
+    if isinstance(data, sparse.CSRNDArray):
+        from .ndarray import _wrap
+        import jax.numpy as jnp
+        return _wrap(jnp.asarray([data._aux.shape[0]], jnp.int64), data.ctx)
+    raise NotImplementedError
+
+
+def quantize(data, min_range, max_range, out_type="uint8"):
+    """INT8 quantization (reference src/operator/quantization/quantize.cc)."""
+    import jax.numpy as jnp
+    from .ndarray import _wrap
+    lo = float(min_range.asscalar())
+    hi = float(max_range.asscalar())
+    if out_type == "uint8":
+        scale = 255.0 / max(hi - lo, 1e-8)
+        q = jnp.clip(jnp.round((data._data - lo) * scale), 0, 255).astype(jnp.uint8)
+    else:  # int8
+        scale = 127.0 / max(abs(hi), abs(lo), 1e-8)
+        q = jnp.clip(jnp.round(data._data * scale), -127, 127).astype(jnp.int8)
+    return (_wrap(q, data.ctx), min_range, max_range)
+
+
+def dequantize(data, min_range, max_range, out_type="float32"):
+    import jax.numpy as jnp
+    from .ndarray import _wrap
+    lo = float(min_range.asscalar())
+    hi = float(max_range.asscalar())
+    if data.dtype == jnp.uint8:
+        scale = (hi - lo) / 255.0
+        return _wrap(data._data.astype(jnp.float32) * scale + lo, data.ctx)
+    scale = max(abs(hi), abs(lo)) / 127.0
+    return _wrap(data._data.astype(jnp.float32) * scale, data.ctx)

@@ -1,0 +1,575 @@
+"""NDArray: the imperative tensor type.
+
+Analog of the reference's ``include/mxnet/ndarray.h`` +
+``src/ndarray/ndarray.cc`` + ``python/mxnet/ndarray/ndarray.py``. Design
+per SURVEY §7: an NDArray wraps an immutable ``jax.Array`` plus a
+version counter — the engine-variable analog. Mutation (in-place ops,
+``x[...] = v``, ``out=`` kwargs, optimizer updates) rebinds ``_data`` to
+a new buffer and bumps ``_version``; readers that captured the old
+buffer (autograd tape residuals, views) keep a consistent snapshot by
+construction, which is how the reference's versioned ThreadedVar
+serializes writers against readers — here immutability gives it for
+free.
+
+Async semantics: every jax.Array is a future (PJRT async dispatch ≈
+ThreadedEngine worker queues); ``wait_to_read`` = block_until_ready;
+``asnumpy`` is the implicit sync point, exactly the reference contract
+(src/c_api: MXNDArrayWaitToRead / MXNDArraySyncCopyToCPU).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError, dtype_np, dtype_name
+from ..context import Context, current_context
+from ..engine import engine
+
+__all__ = ["NDArray", "_wrap", "array", "empty", "zeros", "ones", "full", "arange"]
+
+
+def _op(name):
+    from .register import get_op
+    return get_op(name)
+
+
+def _invoke(name, inputs, params=None, out=None, ctx=None):
+    from .register import invoke
+    return invoke(_op(name), inputs, params, out=out, ctx=ctx)
+
+
+class NDArray:
+    """A multi-dimensional array with asynchronous execution and autograd.
+
+    Not constructed directly by users — use ``mx.nd.array`` /
+    ``mx.nd.zeros`` / op outputs (same as the reference, where NDArray
+    handles come from the C API).
+    """
+
+    __slots__ = (
+        "_data", "_ctx", "_version", "_grad", "_grad_req", "_is_leaf",
+        "_in_graph", "__weakref__",
+    )
+
+    # numpy should defer binary-op dispatch to us
+    __array_priority__ = 1000.0
+
+    def __init__(self, data, ctx: Context | None = None):
+        if ctx is None:
+            ctx = current_context()
+        self._data = data
+        self._ctx = ctx
+        self._version = 0
+        self._grad = None
+        self._grad_req = "null"
+        self._is_leaf = False
+        self._in_graph = False
+
+    # ------------------------------------------------------------------
+    # internal plumbing
+    # ------------------------------------------------------------------
+    def _set_data(self, arr):
+        """Rebind the backing buffer (a write: version bump)."""
+        if arr.dtype != self._data.dtype:
+            arr = arr.astype(self._data.dtype)
+        if arr.shape != self._data.shape:
+            raise MXNetError(
+                f"in-place write shape mismatch: {arr.shape} vs {self._data.shape}")
+        self._data = arr
+        self._version += 1
+
+    def _requires_grad_somewhere(self):
+        return (self._is_leaf and self._grad_req != "null") or self._in_graph
+
+    # ------------------------------------------------------------------
+    # basic properties
+    # ------------------------------------------------------------------
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    @property
+    def dtype(self):
+        return self._data.dtype
+
+    @property
+    def size(self):
+        return int(np.prod(self._data.shape)) if self._data.shape else 1
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def context(self) -> Context:
+        return self._ctx
+
+    ctx = context
+
+    @property
+    def stype(self):
+        return "default"
+
+    @property
+    def T(self):
+        return self.transpose()
+
+    @property
+    def handle(self):  # legacy compat: opaque identity
+        return id(self)
+
+    # ------------------------------------------------------------------
+    # sync / host transfer
+    # ------------------------------------------------------------------
+    def wait_to_read(self):
+        engine.wait_for_var(self._data)
+
+    def asnumpy(self) -> np.ndarray:
+        return np.asarray(self._data)
+
+    def asscalar(self):
+        if self.size != 1:
+            raise MXNetError("The current array is not a scalar")
+        return self.asnumpy().reshape(()).item()
+
+    def item(self):
+        return self.asscalar()
+
+    def __float__(self):
+        return float(self.asscalar())
+
+    def __int__(self):
+        return int(self.asscalar())
+
+    def __bool__(self):
+        if self.size == 0:
+            return False
+        if self.size == 1:
+            return bool(self.asscalar())
+        raise MXNetError("ambiguous truth value of multi-element NDArray")
+
+    def __len__(self):
+        if not self.shape:
+            raise TypeError("len() of unsized object")
+        return self.shape[0]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __repr__(self):
+        return f"\n{self.asnumpy()}\n<NDArray {'x'.join(map(str, self.shape))} @{self._ctx}>"
+
+    # ------------------------------------------------------------------
+    # copies / context movement
+    # ------------------------------------------------------------------
+    def copy(self) -> "NDArray":
+        return _wrap(self._data + 0, self._ctx)
+
+    def copyto(self, other):
+        """Copy to a Context or into another NDArray (CopyFromTo analog,
+        src/ndarray/ndarray.cc)."""
+        if isinstance(other, Context):
+            arr = jax.device_put(self._data, other.jax_device)
+            return _wrap(arr, other)
+        if isinstance(other, NDArray):
+            if other is self:
+                return other
+            arr = jax.device_put(self._data, other._ctx.jax_device)
+            if arr.dtype != other.dtype:
+                arr = arr.astype(other.dtype)
+            other._set_data(arr)
+            return other
+        raise MXNetError(f"cannot copyto {type(other)}")
+
+    def as_in_context(self, ctx: Context) -> "NDArray":
+        if ctx == self._ctx:
+            return self
+        return self.copyto(ctx)
+
+    as_in_ctx = as_in_context
+
+    def as_nd_ndarray(self):
+        return self
+
+    def astype(self, dtype, copy=True) -> "NDArray":
+        dt = dtype_np(dtype)
+        if not copy and self.dtype == dt:
+            return self
+        return _wrap(self._data.astype(dt), self._ctx)
+
+    def cast(self, dtype):
+        return self.astype(dtype)
+
+    # ------------------------------------------------------------------
+    # autograd
+    # ------------------------------------------------------------------
+    def attach_grad(self, grad_req="write", stype=None):
+        """Allocate a gradient buffer; this array becomes a leaf."""
+        from . import zeros
+        self._grad = zeros(self.shape, ctx=self._ctx, dtype=self.dtype)
+        self._grad_req = grad_req
+        self._is_leaf = True
+
+    @property
+    def grad(self):
+        return self._grad
+
+    def detach(self) -> "NDArray":
+        out = _wrap(self._data, self._ctx)
+        return out
+
+    def backward(self, out_grad=None, retain_graph=False, train_mode=True):
+        from .. import autograd
+        autograd.backward([self], [out_grad] if out_grad is not None else None,
+                          retain_graph=retain_graph, train_mode=train_mode)
+
+    def zero_grad(self):
+        if self._grad is not None:
+            self._grad._set_data(jnp.zeros_like(self._grad._data))
+
+    # ------------------------------------------------------------------
+    # indexing
+    # ------------------------------------------------------------------
+    def _index_to_jax(self, key):
+        def conv(k):
+            if isinstance(k, NDArray):
+                return k._data
+            return k
+        if isinstance(key, tuple):
+            return tuple(conv(k) for k in key)
+        return conv(key)
+
+    def __getitem__(self, key):
+        key = self._index_to_jax(key)
+        return _invoke("_slice_get", [self], {"key": key})
+
+    def __setitem__(self, key, value):
+        key = self._index_to_jax(key)
+        if isinstance(value, NDArray):
+            value = value._data
+        elif isinstance(value, np.ndarray):
+            value = jnp.asarray(value)
+        if hasattr(value, "dtype") and hasattr(value, "astype") and \
+                value.dtype != self.dtype:
+            value = value.astype(self.dtype)
+        new = self._data.at[key].set(value)
+        self._set_data(new)
+
+    def slice(self, begin, end, step=None):
+        return _invoke("slice", [self], {"begin": begin, "end": end, "step": step})
+
+    def slice_axis(self, axis, begin, end):
+        return _invoke("slice_axis", [self], {"axis": axis, "begin": begin, "end": end})
+
+    def take(self, indices, axis=0, mode="clip"):
+        return _invoke("take", [self, indices], {"axis": axis, "mode": mode})
+
+    def pick(self, index, axis=-1, keepdims=False):
+        return _invoke("pick", [self, index], {"axis": axis, "keepdims": keepdims})
+
+    # ------------------------------------------------------------------
+    # shape manipulation
+    # ------------------------------------------------------------------
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        shape = kwargs.get("shape", shape)
+        return _invoke("reshape", [self], {"shape": shape})
+
+    def reshape_like(self, other):
+        return _invoke("reshape_like", [self, other])
+
+    def transpose(self, *axes):
+        if len(axes) == 1 and isinstance(axes[0], (tuple, list)):
+            axes = tuple(axes[0])
+        return _invoke("transpose", [self], {"axes": axes or None})
+
+    def swapaxes(self, dim1, dim2):
+        return _invoke("swapaxes", [self], {"dim1": dim1, "dim2": dim2})
+
+    def flatten(self):
+        return _invoke("Flatten", [self])
+
+    def expand_dims(self, axis):
+        return _invoke("expand_dims", [self], {"axis": axis})
+
+    def squeeze(self, axis=None):
+        return _invoke("squeeze", [self], {"axis": axis})
+
+    def broadcast_to(self, shape):
+        return _invoke("broadcast_to", [self], {"shape": shape})
+
+    def broadcast_like(self, other):
+        return _invoke("broadcast_like", [self, other])
+
+    def tile(self, reps):
+        return _invoke("tile", [self], {"reps": reps})
+
+    def repeat(self, repeats, axis=None):
+        return _invoke("repeat", [self], {"repeats": repeats, "axis": axis})
+
+    def flip(self, axis):
+        return _invoke("flip", [self], {"axis": axis})
+
+    def split(self, num_outputs, axis=1, squeeze_axis=False):
+        return _invoke("split", [self], {"num_outputs": num_outputs, "axis": axis,
+                                         "squeeze_axis": squeeze_axis})
+
+    # ------------------------------------------------------------------
+    # math methods (delegate to ops)
+    # ------------------------------------------------------------------
+    def sum(self, axis=None, keepdims=False, **kw):
+        return _invoke("sum", [self], {"axis": axis, "keepdims": keepdims})
+
+    def nansum(self, axis=None, keepdims=False):
+        return _invoke("nansum", [self], {"axis": axis, "keepdims": keepdims})
+
+    def mean(self, axis=None, keepdims=False, **kw):
+        return _invoke("mean", [self], {"axis": axis, "keepdims": keepdims})
+
+    def max(self, axis=None, keepdims=False):
+        return _invoke("max", [self], {"axis": axis, "keepdims": keepdims})
+
+    def min(self, axis=None, keepdims=False):
+        return _invoke("min", [self], {"axis": axis, "keepdims": keepdims})
+
+    def prod(self, axis=None, keepdims=False):
+        return _invoke("prod", [self], {"axis": axis, "keepdims": keepdims})
+
+    def argmax(self, axis=None, keepdims=False):
+        return _invoke("argmax", [self], {"axis": axis, "keepdims": keepdims})
+
+    def argmin(self, axis=None, keepdims=False):
+        return _invoke("argmin", [self], {"axis": axis, "keepdims": keepdims})
+
+    def argsort(self, axis=-1, is_ascend=True):
+        return _invoke("argsort", [self], {"axis": axis, "is_ascend": is_ascend})
+
+    def sort(self, axis=-1, is_ascend=True):
+        return _invoke("sort", [self], {"axis": axis, "is_ascend": is_ascend})
+
+    def topk(self, axis=-1, k=1, ret_typ="indices", is_ascend=False):
+        return _invoke("topk", [self], {"axis": axis, "k": k, "ret_typ": ret_typ,
+                                        "is_ascend": is_ascend})
+
+    def norm(self, ord=2, axis=None, keepdims=False):
+        return _invoke("norm", [self], {"ord": ord, "axis": axis, "keepdims": keepdims})
+
+    def abs(self):
+        return _invoke("abs", [self])
+
+    def exp(self):
+        return _invoke("exp", [self])
+
+    def log(self):
+        return _invoke("log", [self])
+
+    def sqrt(self):
+        return _invoke("sqrt", [self])
+
+    def square(self):
+        return _invoke("square", [self])
+
+    def sigmoid(self):
+        return _invoke("sigmoid", [self])
+
+    def relu(self):
+        return _invoke("relu", [self])
+
+    def softmax(self, axis=-1):
+        return _invoke("softmax", [self], {"axis": axis})
+
+    def log_softmax(self, axis=-1):
+        return _invoke("log_softmax", [self], {"axis": axis})
+
+    def tanh(self):
+        return _invoke("tanh", [self])
+
+    def clip(self, a_min, a_max):
+        return _invoke("clip", [self], {"a_min": a_min, "a_max": a_max})
+
+    def round(self):
+        return _invoke("round", [self])
+
+    def floor(self):
+        return _invoke("floor", [self])
+
+    def ceil(self):
+        return _invoke("ceil", [self])
+
+    def sign(self):
+        return _invoke("sign", [self])
+
+    def one_hot(self, depth, on_value=1.0, off_value=0.0, dtype="float32"):
+        return _invoke("one_hot", [self], {"depth": depth, "on_value": on_value,
+                                           "off_value": off_value, "dtype": dtype})
+
+    def dot(self, other, transpose_a=False, transpose_b=False):
+        return _invoke("dot", [self, other],
+                       {"transpose_a": transpose_a, "transpose_b": transpose_b})
+
+    def zeros_like(self):
+        return _invoke("zeros_like", [self])
+
+    def ones_like(self):
+        return _invoke("ones_like", [self])
+
+    def tostype(self, stype):
+        if stype == "default":
+            return self
+        from .sparse import cast_storage
+        return cast_storage(self, stype)
+
+    # ------------------------------------------------------------------
+    # NumPy interop / pickling
+    # ------------------------------------------------------------------
+    def __array__(self, dtype=None):
+        a = self.asnumpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def __reduce__(self):
+        # optimizer states & gluon params must pickle (kvstore server
+        # updater round-trip in the reference pickles them too)
+        return (_unpickle, (self.asnumpy(), dtype_name(self.dtype),
+                            self._ctx.device_type, self._ctx.device_id))
+
+
+def _unpickle(npv, dtype, dev_type, dev_id):
+    ctx = Context(dev_type, dev_id)
+    return array(npv, ctx=ctx, dtype=dtype)
+
+
+def _binary_dunder(op_name, scalar_name=None, reverse=False):
+    def fn(self, other):
+        if isinstance(other, NDArray):
+            return _invoke(op_name, [other, self] if reverse else [self, other])
+        if isinstance(other, (np.ndarray, list, tuple)):
+            other = array(other, ctx=self._ctx)
+            return _invoke(op_name, [other, self] if reverse else [self, other])
+        if isinstance(other, (int, float, bool, np.generic)):
+            nm = scalar_name or (op_name + "_scalar")
+            return _invoke(nm, [self], {"scalar": other, "reverse": reverse})
+        return NotImplemented
+
+    return fn
+
+
+def _inplace_dunder(op_name):
+    def fn(self, other):
+        res = _binary_dunder(op_name)(self, other)
+        if res is NotImplemented:
+            return res
+        self._set_data(res._data)
+        return self
+
+    return fn
+
+
+# arithmetic
+NDArray.__add__ = _binary_dunder("broadcast_add")
+NDArray.__radd__ = _binary_dunder("broadcast_add", reverse=True)
+NDArray.__sub__ = _binary_dunder("broadcast_sub")
+NDArray.__rsub__ = _binary_dunder("broadcast_sub", reverse=True)
+NDArray.__mul__ = _binary_dunder("broadcast_mul")
+NDArray.__rmul__ = _binary_dunder("broadcast_mul", reverse=True)
+NDArray.__truediv__ = _binary_dunder("broadcast_div")
+NDArray.__rtruediv__ = _binary_dunder("broadcast_div", reverse=True)
+NDArray.__mod__ = _binary_dunder("broadcast_mod")
+NDArray.__rmod__ = _binary_dunder("broadcast_mod", reverse=True)
+NDArray.__pow__ = _binary_dunder("broadcast_power")
+NDArray.__rpow__ = _binary_dunder("broadcast_power", reverse=True)
+NDArray.__matmul__ = lambda self, other: _invoke("matmul", [self, other])
+NDArray.__iadd__ = _inplace_dunder("broadcast_add")
+NDArray.__isub__ = _inplace_dunder("broadcast_sub")
+NDArray.__imul__ = _inplace_dunder("broadcast_mul")
+NDArray.__itruediv__ = _inplace_dunder("broadcast_div")
+NDArray.__neg__ = lambda self: _invoke("negative", [self])
+NDArray.__abs__ = lambda self: _invoke("abs", [self])
+# comparisons
+NDArray.__eq__ = _binary_dunder("broadcast_equal")
+NDArray.__ne__ = _binary_dunder("broadcast_not_equal")
+NDArray.__lt__ = _binary_dunder("broadcast_lesser")
+NDArray.__le__ = _binary_dunder("broadcast_lesser_equal")
+NDArray.__gt__ = _binary_dunder("broadcast_greater")
+NDArray.__ge__ = _binary_dunder("broadcast_greater_equal")
+NDArray.__hash__ = lambda self: id(self)
+
+
+def _has(name):
+    from .register import _OPS
+    return name in _OPS
+
+
+def _wrap(arr, ctx: Context | None = None) -> NDArray:
+    """Wrap a jax array (no copy) into an NDArray."""
+    if ctx is None:
+        ctx = current_context()
+    if not isinstance(arr, (jnp.ndarray, jax.Array)):
+        arr = jnp.asarray(arr)
+    return NDArray(arr, ctx)
+
+
+# ----------------------------------------------------------------------
+# creation functions (src/operator/tensor/init_op.cc analogs)
+# ----------------------------------------------------------------------
+def array(source, ctx: Context | None = None, dtype=None) -> NDArray:
+    if ctx is None:
+        ctx = current_context()
+    if isinstance(source, NDArray):
+        src = source._data
+        dt = dtype_np(dtype) if dtype is not None else src.dtype
+        return _wrap(jax.device_put(src.astype(dt), ctx.jax_device), ctx)
+    npv = np.asarray(source)
+    if dtype is None:
+        # MXNet defaults python floats to float32 (not float64)
+        dt = np.float32 if npv.dtype == np.float64 else npv.dtype
+    else:
+        dt = dtype_np(dtype)
+    arr = jax.device_put(jnp.asarray(npv, dt), ctx.jax_device)
+    return _wrap(arr, ctx)
+
+
+def empty(shape, ctx=None, dtype=None) -> NDArray:
+    return zeros(shape, ctx, dtype)
+
+
+def zeros(shape, ctx=None, dtype=None, **kwargs) -> NDArray:
+    if ctx is None:
+        ctx = current_context()
+    if isinstance(shape, int):
+        shape = (shape,)
+    with jax.default_device(ctx.jax_device):
+        arr = jnp.zeros(shape, dtype_np(dtype))
+    return _wrap(arr, ctx)
+
+
+def ones(shape, ctx=None, dtype=None, **kwargs) -> NDArray:
+    if ctx is None:
+        ctx = current_context()
+    if isinstance(shape, int):
+        shape = (shape,)
+    with jax.default_device(ctx.jax_device):
+        arr = jnp.ones(shape, dtype_np(dtype))
+    return _wrap(arr, ctx)
+
+
+def full(shape, val, ctx=None, dtype=None) -> NDArray:
+    if ctx is None:
+        ctx = current_context()
+    if isinstance(shape, int):
+        shape = (shape,)
+    with jax.default_device(ctx.jax_device):
+        arr = jnp.full(shape, val, dtype_np(dtype))
+    return _wrap(arr, ctx)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype=None) -> NDArray:
+    if ctx is None:
+        ctx = current_context()
+    with jax.default_device(ctx.jax_device):
+        arr = jnp.arange(start, stop, step, dtype_np(dtype))
+        if repeat > 1:
+            arr = jnp.repeat(arr, repeat)
+    return _wrap(arr, ctx)

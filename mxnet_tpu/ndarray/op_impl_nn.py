@@ -1,0 +1,475 @@
+"""Neural-network operators.
+
+TPU-native implementations of the reference's ``src/operator/nn/``
+family (fully_connected.cc, convolution.cc, deconvolution.cc,
+pooling.cc, batch_norm.cc, layer_norm.cc, softmax.cc, dropout.cc,
+activation.cc, leaky_relu.cc, upsampling.cc, embedding via
+indexing_op.cc) and their cuDNN variants (src/operator/nn/cudnn/*) —
+here a single XLA path: conv lowers through
+``lax.conv_general_dilated`` (cuDNN-autotune's job is done by XLA's
+conv emitter on the MXU), pooling through ``lax.reduce_window``,
+normalizations as fusable elementwise+reduce graphs. bfloat16 flows
+through every op (the AMP/fp16 analog).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..base import dtype_np
+from .register import register_op
+
+
+def _tup(v, n=None):
+    if v is None:
+        return None
+    t = tuple(int(x) for x in np.atleast_1d(v))
+    if n is not None and len(t) == 1:
+        t = t * n
+    return t
+
+
+# ----------------------------------------------------------------------
+# FullyConnected (src/operator/nn/fully_connected.cc) — MXU matmul
+# ----------------------------------------------------------------------
+@register_op("FullyConnected")
+def fully_connected(data, weight, bias=None, num_hidden=None, no_bias=False, flatten=True):
+    x = data.reshape(data.shape[0], -1) if flatten and data.ndim > 2 else data
+    out = jnp.matmul(x, weight.T)
+    if bias is not None and not no_bias:
+        out = out + bias
+    return out
+
+
+# ----------------------------------------------------------------------
+# Convolution family
+# ----------------------------------------------------------------------
+_CONV_DN = {1: ("NCW", "OIW", "NCW"), 2: ("NCHW", "OIHW", "NCHW"),
+            3: ("NCDHW", "OIDHW", "NCDHW")}
+
+
+@register_op("Convolution")
+def convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
+                pad=None, num_filter=None, num_group=1, no_bias=False,
+                workspace=1024, cudnn_tune=None, cudnn_off=False, layout=None):
+    nd_ = len(_tup(kernel))
+    stride = _tup(stride, nd_) or (1,) * nd_
+    dilate = _tup(dilate, nd_) or (1,) * nd_
+    pad = _tup(pad, nd_) or (0,) * nd_
+    dn = lax.conv_dimension_numbers(data.shape, weight.shape, _CONV_DN[nd_])
+    # bf16 convs accumulate in f32 on the MXU natively; forcing
+    # preferred_element_type would break the VJP's dtype contract
+    out = lax.conv_general_dilated(
+        data, weight,
+        window_strides=stride,
+        padding=[(p, p) for p in pad],
+        rhs_dilation=dilate,
+        dimension_numbers=dn,
+        feature_group_count=int(num_group),
+    )
+    if bias is not None and not no_bias:
+        out = out + bias.reshape((1, -1) + (1,) * nd_)
+    return out
+
+
+@register_op("Deconvolution")
+def deconvolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
+                  pad=None, adj=None, target_shape=None, num_filter=None,
+                  num_group=1, no_bias=True, workspace=512, cudnn_tune=None,
+                  cudnn_off=False, layout=None):
+    nd_ = len(_tup(kernel))
+    k = _tup(kernel)
+    stride = _tup(stride, nd_) or (1,) * nd_
+    dilate = _tup(dilate, nd_) or (1,) * nd_
+    pad = _tup(pad, nd_) or (0,) * nd_
+    adj = _tup(adj, nd_) or (0,) * nd_
+    # weight layout (C_in, C_out/group, *k); flip spatial, swap in/out via
+    # IOHW dimension spec → gradient-of-conv formulation
+    spec = {1: "IOW", 2: "IOHW", 3: "IODHW"}[nd_]
+    dn = lax.conv_dimension_numbers(data.shape, weight.shape,
+                                    (_CONV_DN[nd_][0], spec, _CONV_DN[nd_][2]))
+    padding = [
+        (d * (kk - 1) - p, d * (kk - 1) - p + a)
+        for kk, p, d, a in zip(k, pad, dilate, adj)
+    ]
+    wflip = weight
+    for ax in range(2, 2 + nd_):
+        wflip = jnp.flip(wflip, ax)
+    out = lax.conv_general_dilated(
+        data, wflip,
+        window_strides=(1,) * nd_,
+        padding=padding,
+        lhs_dilation=stride,
+        rhs_dilation=dilate,
+        dimension_numbers=dn,
+        feature_group_count=int(num_group),
+    )
+    if bias is not None and not no_bias:
+        out = out + bias.reshape((1, -1) + (1,) * nd_)
+    return out
+
+
+# ----------------------------------------------------------------------
+# Pooling (src/operator/nn/pooling.cc)
+# ----------------------------------------------------------------------
+@register_op("Pooling")
+def pooling(data, kernel=None, pool_type="max", global_pool=False,
+            pooling_convention="valid", stride=None, pad=None,
+            count_include_pad=True, cudnn_off=False, layout=None):
+    nd_ = data.ndim - 2
+    if global_pool:
+        ax = tuple(range(2, data.ndim))
+        if pool_type == "max":
+            return jnp.max(data, axis=ax, keepdims=True)
+        if pool_type == "sum":
+            return jnp.sum(data, axis=ax, keepdims=True)
+        return jnp.mean(data, axis=ax, keepdims=True)
+    k = _tup(kernel, nd_)
+    stride = _tup(stride, nd_) or (1,) * nd_
+    pad = _tup(pad, nd_) or (0,) * nd_
+    window = (1, 1) + k
+    strides = (1, 1) + stride
+    if pooling_convention == "full":
+        # ceil-mode: pad high edge so the last partial window is included
+        pads = []
+        for i in range(nd_):
+            in_sz = data.shape[2 + i]
+            out_sz = int(np.ceil((in_sz + 2 * pad[i] - k[i]) / stride[i])) + 1
+            needed = (out_sz - 1) * stride[i] + k[i] - in_sz - pad[i]
+            pads.append((pad[i], max(needed, pad[i])))
+    else:
+        pads = [(p, p) for p in pad]
+    padding = ((0, 0), (0, 0)) + tuple(pads)
+
+    # init values MUST be concrete numpy scalars: under an outer jit a
+    # jnp constant becomes a tracer and lax can no longer recognize the
+    # max/add monoid → falls to generic reduce_window with no VJP rule
+    if pool_type == "max":
+        if jnp.issubdtype(data.dtype, jnp.floating):
+            init = np.asarray(-np.inf, data.dtype)
+        else:
+            init = np.asarray(np.iinfo(data.dtype).min, data.dtype)
+        return lax.reduce_window(data, init, lax.max, window, strides, padding)
+    zero = np.asarray(0, data.dtype)
+    summed = lax.reduce_window(data, zero, lax.add, window, strides, padding)
+    if pool_type == "sum":
+        return summed
+    # avg
+    if count_include_pad:
+        denom = np.prod(k)
+        return summed / np.asarray(denom, data.dtype)
+    ones = jnp.ones_like(data)
+    counts = lax.reduce_window(ones, zero, lax.add, window, strides, padding)
+    return summed / counts
+
+
+@register_op("UpSampling")
+def upsampling(*args, scale=1, sample_type="nearest", num_args=1, num_filter=0,
+               multi_input_mode="concat", workspace=512):
+    data = args[0]
+    s = int(scale)
+    out = jnp.repeat(jnp.repeat(data, s, axis=2), s, axis=3)
+    return out
+
+
+# ----------------------------------------------------------------------
+# Activations
+# ----------------------------------------------------------------------
+_ACT = {
+    "relu": jax.nn.relu,
+    "sigmoid": jax.nn.sigmoid,
+    "log_sigmoid": jax.nn.log_sigmoid,
+    "tanh": jnp.tanh,
+    "softrelu": jax.nn.softplus,
+    "softsign": jax.nn.soft_sign,
+    "mish": lambda x: x * jnp.tanh(jax.nn.softplus(x)),
+}
+
+
+@register_op("Activation")
+def activation(data, act_type="relu"):
+    return _ACT[act_type](data)
+
+
+@register_op("LeakyReLU")
+def leaky_relu(data, gamma=None, act_type="leaky", slope=0.25, lower_bound=0.125,
+               upper_bound=0.334, _rng_key=None):
+    """LeakyReLU family (src/operator/leaky_relu.cc): leaky/prelu/elu/
+    selu/gelu/rrelu. GELU is the BERT-critical one (v≥1.5)."""
+    if act_type == "leaky":
+        return jnp.where(data > 0, data, slope * data)
+    if act_type == "prelu":
+        return jnp.where(data > 0, data, gamma.reshape((1, -1) + (1,) * (data.ndim - 2)) * data)
+    if act_type == "elu":
+        return jnp.where(data > 0, data, slope * (jnp.exp(data) - 1.0))
+    if act_type == "selu":
+        alpha, lam = 1.6732632423543772, 1.0507009873554805
+        return lam * jnp.where(data > 0, data, alpha * (jnp.exp(data) - 1.0))
+    if act_type == "gelu":
+        return jax.nn.gelu(data, approximate=False)
+    if act_type == "rrelu":
+        mid = (lower_bound + upper_bound) / 2.0
+        return jnp.where(data > 0, data, mid * data)
+    raise ValueError(f"unknown act_type {act_type}")
+
+
+@register_op("gelu")
+def gelu(data, approximate=False):
+    return jax.nn.gelu(data, approximate=bool(approximate))
+
+
+@register_op("swish")
+def swish(data, beta=1.0):
+    return data * jax.nn.sigmoid(beta * data)
+
+
+# ----------------------------------------------------------------------
+# softmax family (src/operator/nn/softmax.cc)
+# ----------------------------------------------------------------------
+@register_op("softmax")
+def softmax(data, axis=-1, temperature=None, length=None, dtype=None, use_length=False):
+    x = data if temperature in (None, 1.0) else data / temperature
+    if length is not None:
+        T = x.shape[int(axis)]
+        steps = jnp.arange(T)
+        mask_shape = [1] * x.ndim
+        mask_shape[int(axis)] = T
+        lens = length.reshape(tuple(length.shape) + (1,) * (x.ndim - length.ndim))
+        mask = steps.reshape(mask_shape) < lens
+        x = jnp.where(mask, x, -jnp.inf)
+        out = jax.nn.softmax(x, axis=int(axis))
+        return jnp.where(mask, out, 0.0)
+    out = jax.nn.softmax(x, axis=int(axis))
+    if dtype is not None:
+        out = out.astype(dtype_np(dtype))
+    return out
+
+
+@register_op("log_softmax")
+def log_softmax(data, axis=-1, temperature=None, dtype=None, use_length=False):
+    x = data if temperature in (None, 1.0) else data / temperature
+    out = jax.nn.log_softmax(x, axis=int(axis))
+    if dtype is not None:
+        out = out.astype(dtype_np(dtype))
+    return out
+
+
+@register_op("softmin")
+def softmin(data, axis=-1, temperature=None, dtype=None):
+    return softmax(-data, axis=axis, temperature=temperature, dtype=dtype)
+
+
+@register_op("SoftmaxActivation")
+def softmax_activation(data, mode="instance"):
+    if mode == "channel":
+        return jax.nn.softmax(data, axis=1)
+    return jax.nn.softmax(data.reshape(data.shape[0], -1), axis=-1).reshape(data.shape)
+
+
+@register_op("SoftmaxOutput", aliases=("Softmax",))
+def softmax_output(data, label, grad_scale=1.0, ignore_label=-1.0,
+                   use_ignore=False, preserve_shape=False, multi_output=False,
+                   out_grad=False, normalization="null", smooth_alpha=0.0):
+    """Legacy Module-API loss head: forward=softmax, backward=p−onehot
+    (reference src/operator/softmax_output.cc). Non-tensor params are
+    closed over (custom_vjp args must be JAX types)."""
+    ax = 1 if multi_output else -1
+
+    @jax.custom_vjp
+    def fwd(d, l):
+        return jax.nn.softmax(d, axis=ax)
+
+    def f(d, l):
+        out = jax.nn.softmax(d, axis=ax)
+        return out, (out, l)
+
+    def b(res, g):
+        out, l = res
+        n_class = out.shape[ax]
+        oh = jax.nn.one_hot(l.astype(jnp.int32), n_class, axis=ax,
+                            dtype=out.dtype)
+        if smooth_alpha:
+            oh = oh * (1.0 - smooth_alpha) \
+                + smooth_alpha / (n_class - 1) * (1.0 - oh)
+        grad = out - oh
+        if use_ignore:
+            keep = (l != ignore_label).astype(out.dtype)
+            keep = jnp.expand_dims(keep, ax) if keep.ndim < out.ndim else keep
+            grad = grad * keep
+        if normalization == "batch":
+            grad = grad / out.shape[0]
+        elif normalization == "valid" and use_ignore:
+            cnt = jnp.maximum(jnp.sum(l != ignore_label), 1)
+            grad = grad / cnt
+        return (grad * grad_scale, jnp.zeros_like(l))
+
+    fwd.defvjp(f, b)
+    return fwd(data, label)
+
+
+@register_op("softmax_cross_entropy")
+def softmax_cross_entropy(data, label):
+    logp = jax.nn.log_softmax(data, axis=-1)
+    picked = jnp.take_along_axis(logp, label.astype(jnp.int32)[:, None], axis=-1)
+    return -jnp.sum(picked).reshape(1)
+
+
+# ----------------------------------------------------------------------
+# normalization (batch_norm.cc, layer_norm.cc, instance_norm.cc, l2_norm)
+# ----------------------------------------------------------------------
+@register_op("BatchNorm", wrap=False)
+def batch_norm(data, gamma, beta, mean, var, eps=1e-5, momentum=0.9,
+               fix_gamma=True, use_global_stats=False, output_mean_var=False,
+               axis=1, cudnn_off=False):
+    """Normalize with the given stats (stat selection/update is done by
+    the eager wrapper or the Gluon layer — see gluon/nn/basic_layers.py)."""
+    ax = int(axis) % data.ndim
+    shape = [1] * data.ndim
+    shape[ax] = data.shape[ax]
+    g = jnp.ones_like(gamma) if fix_gamma else gamma
+    # stats/scale may be fp32 while data is bf16 (mixed precision: the
+    # cudnn path does the same) — normalize in fp32, emit data's dtype
+    x_hat = (data.astype(jnp.float32)
+             - mean.astype(jnp.float32).reshape(shape)) * \
+        lax.rsqrt(var.astype(jnp.float32).reshape(shape) + eps)
+    out = x_hat * g.astype(jnp.float32).reshape(shape) \
+        + beta.astype(jnp.float32).reshape(shape)
+    return out.astype(data.dtype)
+
+
+@register_op("LayerNorm")
+def layer_norm(data, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False):
+    ax = int(axis)
+    mean = jnp.mean(data, axis=ax, keepdims=True)
+    var = jnp.var(data, axis=ax, keepdims=True)
+    x_hat = (data - mean) * lax.rsqrt(var + eps)
+    shape = [1] * data.ndim
+    shape[ax] = data.shape[ax]
+    out = x_hat * gamma.reshape(shape) + beta.reshape(shape)
+    if output_mean_var:
+        return out, jnp.squeeze(mean, ax), jnp.squeeze(var, ax)
+    return out
+
+
+@register_op("InstanceNorm")
+def instance_norm(data, gamma, beta, eps=1e-3):
+    ax = tuple(range(2, data.ndim))
+    mean = jnp.mean(data, axis=ax, keepdims=True)
+    var = jnp.var(data, axis=ax, keepdims=True)
+    shape = (1, -1) + (1,) * (data.ndim - 2)
+    return (data - mean) * lax.rsqrt(var + eps) * gamma.reshape(shape) + beta.reshape(shape)
+
+
+@register_op("GroupNorm")
+def group_norm(data, gamma, beta, num_groups=1, eps=1e-5):
+    n, c = data.shape[:2]
+    g = int(num_groups)
+    x = data.reshape((n, g, c // g) + data.shape[2:])
+    ax = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=ax, keepdims=True)
+    var = jnp.var(x, axis=ax, keepdims=True)
+    x = (x - mean) * lax.rsqrt(var + eps)
+    x = x.reshape(data.shape)
+    shape = (1, -1) + (1,) * (data.ndim - 2)
+    return x * gamma.reshape(shape) + beta.reshape(shape)
+
+
+# ----------------------------------------------------------------------
+# Dropout (src/operator/nn/dropout.cc) — functional RNG via random.py
+# ----------------------------------------------------------------------
+@register_op("Dropout", wrap=False)
+def dropout(data, p=0.5, mode="training", axes=None, _training=True, _rng_key=None):
+    if not _training and mode != "always":
+        return data + 0
+    if p <= 0.0:
+        return data + 0
+    if _rng_key is None:
+        from .. import random as _random
+        _rng_key = _random._next_key()
+    shape = list(data.shape)
+    if axes:
+        for a in np.atleast_1d(axes):
+            shape[int(a)] = 1
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(_rng_key, keep, tuple(shape)).astype(data.dtype)
+    return data * mask / keep
+
+
+# ----------------------------------------------------------------------
+# Embedding (src/operator/tensor/indexing_op.cc Embedding)
+# ----------------------------------------------------------------------
+@register_op("Embedding")
+def embedding(data, weight, input_dim=None, output_dim=None, dtype="float32",
+              sparse_grad=False):
+    idx = data.astype(jnp.int32)
+    return jnp.take(weight, idx, axis=0)
+
+
+# ----------------------------------------------------------------------
+# losses as ops
+# ----------------------------------------------------------------------
+@register_op("MakeLoss")
+def make_loss(data, grad_scale=1.0, valid_thresh=0.0, normalization="null"):
+    return data * 1.0
+
+
+@register_op("LinearRegressionOutput")
+def linear_regression_output(data, label, grad_scale=1.0):
+    return _regression_out(data, label, grad_scale, "linear")
+
+
+@register_op("MAERegressionOutput")
+def mae_regression_output(data, label, grad_scale=1.0):
+    return _regression_out(data, label, grad_scale, "mae")
+
+
+@register_op("LogisticRegressionOutput")
+def logistic_regression_output(data, label, grad_scale=1.0):
+    return _regression_out(data, label, grad_scale, "logistic")
+
+
+def _regression_out(data, label, grad_scale, kind):
+    @jax.custom_vjp
+    def fwd(d, l):
+        return jax.nn.sigmoid(d) if kind == "logistic" else d + 0
+
+    def f(d, l):
+        return fwd(d, l), (d, l)
+
+    def b(res, g):
+        d, l = res
+        out = jax.nn.sigmoid(d) if kind == "logistic" else d
+        if kind == "mae":
+            grad = jnp.sign(out - l)
+        else:
+            grad = out - l
+        return (grad * grad_scale / d.shape[0] * 1.0, jnp.zeros_like(l))
+
+    fwd.defvjp(f, b)
+    return fwd(data, label)
+
+
+# ----------------------------------------------------------------------
+# correlation-ish / misc nn
+# ----------------------------------------------------------------------
+@register_op("BilinearSampler")
+def bilinear_sampler(data, grid, cudnn_off=False):
+    n, c, h, w = data.shape
+    gx = (grid[:, 0] + 1.0) * (w - 1) / 2.0
+    gy = (grid[:, 1] + 1.0) * (h - 1) / 2.0
+    x0 = jnp.floor(gx); y0 = jnp.floor(gy)
+    x1, y1 = x0 + 1, y0 + 1
+    wx1 = gx - x0; wy1 = gy - y0
+    wx0 = 1.0 - wx1; wy0 = 1.0 - wy1
+
+    def sample(y, x):
+        xi = jnp.clip(x, 0, w - 1).astype(jnp.int32)
+        yi = jnp.clip(y, 0, h - 1).astype(jnp.int32)
+        bidx = jnp.arange(n)[:, None, None]
+        return data[bidx, :, yi, xi].transpose(0, 3, 1, 2)
+
+    out = (sample(y0, x0) * (wy0 * wx0)[:, None] + sample(y0, x1) * (wy0 * wx1)[:, None]
+           + sample(y1, x0) * (wy1 * wx0)[:, None] + sample(y1, x1) * (wy1 * wx1)[:, None])
+    return out

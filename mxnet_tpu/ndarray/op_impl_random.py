@@ -1,0 +1,148 @@
+"""Random sampling operators.
+
+Analog of the reference's ``src/operator/random/sample_op.{cc,cu}``
+(uniform/normal/gamma/exponential/poisson/negative_binomial/
+generalized_negative_binomial/randint), ``multinomial``/``sample_*``
+distribution ops and ``shuffle``. The per-device curand/Philox resource
+(src/common/random_generator.h) maps to the threefry key chain in
+mxnet_tpu/random.py — functional splitting instead of stateful streams,
+which is what makes these ops safe under XLA tracing.
+
+All sampling ops are non-differentiable (matches reference: no
+FGradient on sample ops).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import dtype_np
+from .register import register_op
+from .. import random as _random
+
+
+def _key(k):
+    return _random._next_key() if k is None else k
+
+
+def _shape(shape):
+    if shape is None:
+        return ()
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(shape)
+
+
+@register_op("random_uniform", aliases=("_random_uniform", "uniform"),
+             differentiable=False)
+def random_uniform(low=0.0, high=1.0, shape=None, dtype="float32", ctx=None,
+                   _rng_key=None):
+    dt = dtype_np(dtype)
+    return jax.random.uniform(_key(_rng_key), _shape(shape), dt, low, high)
+
+
+@register_op("random_normal", aliases=("_random_normal", "normal"),
+             differentiable=False)
+def random_normal(loc=0.0, scale=1.0, shape=None, dtype="float32", ctx=None,
+                  _rng_key=None):
+    dt = dtype_np(dtype)
+    return loc + scale * jax.random.normal(_key(_rng_key), _shape(shape), dt)
+
+
+@register_op("random_gamma", aliases=("_random_gamma",), differentiable=False)
+def random_gamma(alpha=1.0, beta=1.0, shape=None, dtype="float32", ctx=None,
+                 _rng_key=None):
+    dt = dtype_np(dtype)
+    return beta * jax.random.gamma(_key(_rng_key), alpha, _shape(shape), dt)
+
+
+@register_op("random_exponential", aliases=("_random_exponential", "exponential"),
+             differentiable=False)
+def random_exponential(lam=1.0, shape=None, dtype="float32", ctx=None, _rng_key=None):
+    dt = dtype_np(dtype)
+    return jax.random.exponential(_key(_rng_key), _shape(shape), dt) / lam
+
+
+@register_op("random_poisson", aliases=("_random_poisson", "poisson"),
+             differentiable=False)
+def random_poisson(lam=1.0, shape=None, dtype="float32", ctx=None, _rng_key=None):
+    return jax.random.poisson(_key(_rng_key), lam, _shape(shape)).astype(dtype_np(dtype))
+
+
+@register_op("random_negative_binomial", aliases=("_random_negative_binomial",),
+             differentiable=False)
+def random_negative_binomial(k=1, p=1.0, shape=None, dtype="float32", ctx=None,
+                             _rng_key=None):
+    key1, key2 = jax.random.split(_key(_rng_key))
+    lam = jax.random.gamma(key1, k, _shape(shape)) * ((1 - p) / p)
+    return jax.random.poisson(key2, lam, _shape(shape)).astype(dtype_np(dtype))
+
+
+@register_op("random_randint", aliases=("_random_randint", "randint"),
+             differentiable=False)
+def random_randint(low=0, high=100, shape=None, dtype="int32", ctx=None,
+                   _rng_key=None):
+    return jax.random.randint(_key(_rng_key), _shape(shape), int(low), int(high),
+                              dtype_np(dtype))
+
+
+@register_op("sample_uniform", differentiable=False)
+def sample_uniform(low, high, shape=None, dtype=None, _rng_key=None):
+    s = _shape(shape)
+    out_shape = low.shape + s
+    u = jax.random.uniform(_key(_rng_key), out_shape, low.dtype)
+    low_b = low.reshape(low.shape + (1,) * len(s))
+    high_b = high.reshape(high.shape + (1,) * len(s))
+    return low_b + u * (high_b - low_b)
+
+
+@register_op("sample_normal", differentiable=False)
+def sample_normal(mu, sigma, shape=None, dtype=None, _rng_key=None):
+    s = _shape(shape)
+    out_shape = mu.shape + s
+    z = jax.random.normal(_key(_rng_key), out_shape, mu.dtype)
+    return mu.reshape(mu.shape + (1,) * len(s)) + z * sigma.reshape(sigma.shape + (1,) * len(s))
+
+
+@register_op("sample_gamma", differentiable=False)
+def sample_gamma(alpha, beta, shape=None, dtype=None, _rng_key=None):
+    s = _shape(shape)
+    a = alpha.reshape(alpha.shape + (1,) * len(s))
+    g = jax.random.gamma(_key(_rng_key), jnp.broadcast_to(a, alpha.shape + s))
+    return g * beta.reshape(beta.shape + (1,) * len(s))
+
+
+@register_op("sample_multinomial", aliases=("_sample_multinomial", "multinomial"),
+             differentiable=False)
+def sample_multinomial(data, shape=None, get_prob=False, dtype="int32", _rng_key=None):
+    s = _shape(shape)
+    n = int(np.prod(s)) if s else 1
+    logits = jnp.log(jnp.maximum(data, 1e-37))
+    idx = jax.random.categorical(_key(_rng_key), logits, axis=-1,
+                                 shape=(n,) + data.shape[:-1])
+    if data.ndim == 1:
+        out = idx.reshape(s) if s else idx.reshape(())
+    else:
+        out = jnp.moveaxis(idx, 0, -1).reshape(data.shape[:-1] + (s if s else ()))
+    out = out.astype(dtype_np(dtype))
+    if get_prob:
+        logp = jnp.log(jnp.maximum(data, 1e-37))
+        p = jnp.take_along_axis(
+            jnp.broadcast_to(logp, out.shape + (data.shape[-1],)),
+            out.astype(jnp.int32)[..., None], axis=-1).squeeze(-1)
+        return out, p
+    return out
+
+
+@register_op("shuffle", aliases=("_shuffle",), differentiable=False)
+def shuffle(data, _rng_key=None):
+    return jax.random.permutation(_key(_rng_key), data, axis=0)
+
+
+@register_op("bernoulli", aliases=("_sample_bernoulli",), differentiable=False)
+def bernoulli(prob=None, logit=None, shape=None, dtype="float32", _rng_key=None):
+    if prob is None and logit is not None:
+        prob = jax.nn.sigmoid(logit)
+    s = _shape(shape) or (prob.shape if hasattr(prob, "shape") else ())
+    return jax.random.bernoulli(_key(_rng_key), prob, s or None).astype(dtype_np(dtype))

@@ -1,0 +1,172 @@
+"""NDArray binary serialization — the ``.params`` / ``mx.nd.save`` format.
+
+Re-implements the reference's NDArray file layout
+(src/ndarray/ndarray.cc NDArray::Save/Load + c_api MXNDArraySave:
+kMXAPINDArrayListMagic list header, per-array NDARRAY_V2_MAGIC blob with
+storage type, shape, context, dtype and raw little-endian data) so
+checkpoints written by reference MXNet load here and vice versa. The V3
+(int64-shape) variant is accepted on load and is the default on save
+only for arrays needing it.
+
+Note: the reference mount was empty during the survey (SURVEY.md §0);
+this layout follows upstream apache/mxnet v1.x. Round-trip is covered by
+tests; cross-loading against a real reference checkpoint should be
+re-verified when one is available.
+"""
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+from ..base import MXNetError, DTYPE_NAME_TO_CODE, DTYPE_CODE_TO_NAME, dtype_np, dtype_name
+from ..context import Context, current_context
+from .ndarray import NDArray, array as nd_array
+
+LIST_MAGIC = 0x112
+NDARRAY_V1_MAGIC = 0xF993FAC8
+NDARRAY_V2_MAGIC = 0xF993FAC9
+NDARRAY_V3_MAGIC = 0xF993FACA
+
+
+def _write_shape(buf, shape, int64=False):
+    buf += struct.pack("<I", len(shape))
+    fmt = "<q" if int64 else "<I"
+    for d in shape:
+        buf += struct.pack(fmt, d)
+    return buf
+
+
+def _save_ndarray(arr: NDArray) -> bytes:
+    npv = np.ascontiguousarray(arr.asnumpy())
+    int64_shape = any(d > 0x7FFFFFFF for d in npv.shape)
+    magic = NDARRAY_V3_MAGIC if int64_shape else NDARRAY_V2_MAGIC
+    buf = struct.pack("<I", magic)
+    buf += struct.pack("<i", 0)  # stype: kDefaultStorage
+    buf = _write_shape(bytearray(buf), npv.shape, int64=int64_shape)
+    # context: saved as CPU like the reference (load re-places arrays)
+    buf += struct.pack("<ii", 1, 0)  # dev_type=kCPU, dev_id=0
+    code = DTYPE_NAME_TO_CODE.get(dtype_name(arr.dtype))
+    if code is None:
+        raise MXNetError(f"cannot serialize dtype {arr.dtype}")
+    buf += struct.pack("<i", code)
+    if dtype_name(arr.dtype) == "bfloat16":
+        buf += npv.view(np.uint16).tobytes()
+    else:
+        buf += npv.tobytes()
+    return bytes(buf)
+
+
+class _Reader:
+    def __init__(self, data: bytes):
+        self.data = data
+        self.pos = 0
+
+    def read(self, fmt):
+        size = struct.calcsize(fmt)
+        vals = struct.unpack_from(fmt, self.data, self.pos)
+        self.pos += size
+        return vals if len(vals) > 1 else vals[0]
+
+    def read_bytes(self, n):
+        out = self.data[self.pos:self.pos + n]
+        self.pos += n
+        return out
+
+
+def _load_ndarray(r: _Reader, ctx: Context) -> NDArray:
+    magic = r.read("<I")
+    if magic == NDARRAY_V1_MAGIC:
+        int64_shape = False
+    elif magic == NDARRAY_V2_MAGIC:
+        r.read("<i")  # stype
+        int64_shape = False
+    elif magic == NDARRAY_V3_MAGIC:
+        r.read("<i")
+        int64_shape = True
+    else:
+        raise MXNetError(f"bad NDArray magic {magic:#x}")
+    ndim = r.read("<I")
+    fmt = "<q" if int64_shape else "<I"
+    shape = tuple(r.read(fmt) for _ in range(ndim))
+    r.read("<ii")  # dev_type, dev_id — ignored; placed on ctx
+    code = r.read("<i")
+    name = DTYPE_CODE_TO_NAME[code]
+    if name == "bfloat16":
+        import jax.numpy as jnp
+        n = int(np.prod(shape)) if shape else 1
+        raw = np.frombuffer(r.read_bytes(n * 2), np.uint16).reshape(shape)
+        npv = raw.view(jnp.bfloat16)
+    else:
+        dt = np.dtype(dtype_np(name))
+        n = int(np.prod(shape)) if shape else 1
+        npv = np.frombuffer(r.read_bytes(n * dt.itemsize), dt).reshape(shape)
+    return nd_array(npv, ctx=ctx, dtype=name)
+
+
+def save(fname: str, data):
+    """mx.nd.save — accepts NDArray, list of NDArray, or dict name→NDArray."""
+    if isinstance(data, NDArray):
+        arrays, names = [data], []
+    elif isinstance(data, dict):
+        names = list(data.keys())
+        arrays = [data[k] for k in names]
+    elif isinstance(data, (list, tuple)):
+        arrays, names = list(data), []
+    else:
+        raise MXNetError("save expects NDArray | list | dict")
+
+    with open(fname, "wb") as f:
+        f.write(struct.pack("<QQ", LIST_MAGIC, 0))
+        f.write(struct.pack("<Q", len(arrays)))
+        for a in arrays:
+            f.write(_save_ndarray(a))
+        f.write(struct.pack("<Q", len(names)))
+        for nm in names:
+            b = nm.encode("utf-8")
+            f.write(struct.pack("<Q", len(b)))
+            f.write(b)
+
+
+def load(fname: str):
+    """mx.nd.load — returns list or dict matching how it was saved."""
+    with open(fname, "rb") as f:
+        data = f.read()
+    r = _Reader(data)
+    magic, _ = r.read("<QQ")
+    if magic != LIST_MAGIC:
+        raise MXNetError(f"invalid NDArray file {fname!r} (magic {magic:#x})")
+    count = r.read("<Q")
+    ctx = current_context()
+    arrays = [_load_ndarray(r, ctx) for _ in range(count)]
+    n_names = r.read("<Q")
+    if n_names == 0:
+        return arrays
+    names = []
+    for _ in range(n_names):
+        ln = r.read("<Q")
+        names.append(r.read_bytes(ln).decode("utf-8"))
+    return dict(zip(names, arrays))
+
+
+def save_bytes(data) -> bytes:
+    """In-memory variant (MXNDArraySaveRawBytes analog)."""
+    import io
+    import tempfile, os
+    # reuse the file writer via a temp buffer
+    buf = bytearray()
+    if isinstance(data, dict):
+        names = list(data.keys())
+        arrays = [data[k] for k in names]
+    else:
+        arrays, names = list(data), []
+    buf += struct.pack("<QQ", LIST_MAGIC, 0)
+    buf += struct.pack("<Q", len(arrays))
+    for a in arrays:
+        buf += _save_ndarray(a)
+    buf += struct.pack("<Q", len(names))
+    for nm in names:
+        b = nm.encode("utf-8")
+        buf += struct.pack("<Q", len(b))
+        buf += b
+    return bytes(buf)

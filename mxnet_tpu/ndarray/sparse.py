@@ -1,0 +1,239 @@
+"""Sparse NDArray types: row_sparse and csr.
+
+Analog of the reference's sparse storage support
+(include/mxnet/ndarray.h storage types kRowSparseStorage/kCSRStorage,
+src/operator/tensor/cast_storage-inl.h, python/mxnet/ndarray/sparse.py).
+
+TPU-native design (SURVEY §7 phase 7): XLA has no native sparse, so a
+RowSparseNDArray is an (indices, values) pair of dense jax arrays and
+every sparse op is a gather/scatter/segment composition. That is
+exactly how the reference's GPU kernels treat row_sparse anyway
+(unique-rowid merge in src/kvstore/kvstore_local.h; sparse dot via
+per-row kernels in dot-inl.cuh) — here XLA fuses the compositions.
+
+This module carries the core types; sparse optimizer/kvstore paths land
+with the Wide&Deep config.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError, dtype_np
+from ..context import current_context
+from .ndarray import NDArray, _wrap, array as _dense_array
+
+
+class BaseSparseNDArray(NDArray):
+    """Common base for sparse storage types."""
+
+    __slots__ = ("_aux", "_shape")
+
+    @property
+    def stype(self):
+        raise NotImplementedError
+
+    def asnumpy(self):
+        return self.todense().asnumpy()
+
+    def todense(self) -> NDArray:
+        return tostype_dense(self)
+
+    def tostype(self, stype):
+        if stype == self.stype:
+            return self
+        return cast_storage(self, stype)
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """row_sparse: a subset of rows present; `indices` sorted unique int64
+    row ids, `data` of shape (len(indices),) + dense_shape[1:]."""
+
+    __slots__ = ()
+
+    def __init__(self, data, indices, shape, ctx=None):
+        # _data holds values; _aux holds indices
+        super().__init__(data, ctx or current_context())
+        self._aux = indices
+        self._shape = tuple(shape)
+
+    @property
+    def stype(self):
+        return "row_sparse"
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @shape.setter
+    def shape(self, v):
+        self._shape = tuple(v)
+
+    @property
+    def indices(self) -> NDArray:
+        return _wrap(self._aux, self._ctx)
+
+    @property
+    def data(self) -> NDArray:
+        return _wrap(self._data, self._ctx)
+
+    def __repr__(self):
+        return (f"\n<RowSparseNDArray {'x'.join(map(str, self.shape))} "
+                f"({self._aux.shape[0]} rows) @{self._ctx}>")
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """csr: 2-D compressed sparse row."""
+
+    __slots__ = ("_indptr",)
+
+    def __init__(self, data, indices, indptr, shape, ctx=None):
+        super().__init__(data, ctx or current_context())
+        self._aux = indices
+        self._indptr = indptr
+        self._shape = tuple(shape)
+
+    @property
+    def stype(self):
+        return "csr"
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @shape.setter
+    def shape(self, v):
+        self._shape = tuple(v)
+
+    @property
+    def indices(self) -> NDArray:
+        return _wrap(self._aux, self._ctx)
+
+    @property
+    def indptr(self) -> NDArray:
+        return _wrap(self._indptr, self._ctx)
+
+    @property
+    def data(self) -> NDArray:
+        return _wrap(self._data, self._ctx)
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    """Create a RowSparseNDArray from (data, indices) or a dense source."""
+    ctx = ctx or current_context()
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        data, indices = arg1
+        data = jnp.asarray(np.asarray(data), dtype_np(dtype) if dtype else None)
+        indices = jnp.asarray(np.asarray(indices), jnp.int64)
+        out = RowSparseNDArray.__new__(RowSparseNDArray)
+        NDArray.__init__(out, data, ctx)
+        out._aux = indices
+        out.shape = shape if shape is not None else (int(indices.max()) + 1,) + data.shape[1:]
+        return out
+    dense = _dense_array(arg1, ctx=ctx, dtype=dtype)
+    return cast_storage(dense, "row_sparse")
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    ctx = ctx or current_context()
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        out = CSRNDArray.__new__(CSRNDArray)
+        NDArray.__init__(out, jnp.asarray(np.asarray(data), dtype_np(dtype) if dtype else None), ctx)
+        out._aux = jnp.asarray(np.asarray(indices), jnp.int64)
+        out._indptr = jnp.asarray(np.asarray(indptr), jnp.int64)
+        if shape is None:
+            raise MXNetError("csr_matrix from (data, indices, indptr) needs shape")
+        out.shape = shape
+        return out
+    dense = _dense_array(arg1, ctx=ctx, dtype=dtype)
+    return cast_storage(dense, "csr")
+
+
+def tostype_dense(sp) -> NDArray:
+    if isinstance(sp, RowSparseNDArray):
+        out = jnp.zeros(sp.shape, sp._data.dtype)
+        out = out.at[sp._aux].set(sp._data)
+        return _wrap(out, sp._ctx)
+    if isinstance(sp, CSRNDArray):
+        m, n = sp.shape
+        indptr = np.asarray(sp._indptr)
+        rows = np.repeat(np.arange(m), np.diff(indptr))
+        out = jnp.zeros((m, n), sp._data.dtype)
+        out = out.at[jnp.asarray(rows), sp._aux].set(sp._data)
+        return _wrap(out, sp._ctx)
+    return sp
+
+
+def cast_storage(arr, stype):
+    """reference: src/operator/tensor/cast_storage-inl.h"""
+    if stype == "default":
+        return tostype_dense(arr)
+    if stype == "row_sparse":
+        dense = arr if not isinstance(arr, BaseSparseNDArray) else tostype_dense(arr)
+        npv = dense.asnumpy()
+        nz = np.where(np.any(npv.reshape(npv.shape[0], -1) != 0, axis=1))[0]
+        out = RowSparseNDArray.__new__(RowSparseNDArray)
+        NDArray.__init__(out, jnp.asarray(npv[nz]), dense._ctx)
+        out._aux = jnp.asarray(nz, jnp.int64)
+        out.shape = dense.shape
+        return out
+    if stype == "csr":
+        dense = arr if not isinstance(arr, BaseSparseNDArray) else tostype_dense(arr)
+        npv = dense.asnumpy()
+        if npv.ndim != 2:
+            raise MXNetError("csr requires 2-D")
+        rows, cols = np.nonzero(npv)
+        indptr = np.searchsorted(rows, np.arange(npv.shape[0] + 1))
+        out = CSRNDArray.__new__(CSRNDArray)
+        NDArray.__init__(out, jnp.asarray(npv[rows, cols]), dense._ctx)
+        out._aux = jnp.asarray(cols, jnp.int64)
+        out._indptr = jnp.asarray(indptr, jnp.int64)
+        out.shape = npv.shape
+        return out
+    raise MXNetError(f"unknown stype {stype}")
+
+
+def zeros(stype, shape, ctx=None, dtype=None):
+    ctx = ctx or current_context()
+    dt = dtype_np(dtype)
+    if stype == "row_sparse":
+        out = RowSparseNDArray.__new__(RowSparseNDArray)
+        NDArray.__init__(out, jnp.zeros((0,) + tuple(shape[1:]), dt), ctx)
+        out._aux = jnp.zeros((0,), jnp.int64)
+        out.shape = tuple(shape)
+        return out
+    if stype == "csr":
+        out = CSRNDArray.__new__(CSRNDArray)
+        NDArray.__init__(out, jnp.zeros((0,), dt), ctx)
+        out._aux = jnp.zeros((0,), jnp.int64)
+        out._indptr = jnp.zeros((shape[0] + 1,), jnp.int64)
+        out.shape = tuple(shape)
+        return out
+    from . import zeros as dzeros
+    return dzeros(shape, ctx, dtype)
+
+
+def retain(data, indices):
+    """sparse_retain: keep only the given rows of a RowSparseNDArray."""
+    if not isinstance(data, RowSparseNDArray):
+        raise MXNetError("retain expects row_sparse input")
+    want = jnp.asarray(np.asarray(indices.asnumpy() if isinstance(indices, NDArray) else indices),
+                       jnp.int64)
+    mask = jnp.isin(data._aux, want)
+    keep = np.where(np.asarray(mask))[0]
+    out = RowSparseNDArray.__new__(RowSparseNDArray)
+    NDArray.__init__(out, data._data[jnp.asarray(keep)], data._ctx)
+    out._aux = data._aux[jnp.asarray(keep)]
+    out.shape = data.shape
+    return out
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """Sparse-aware dot (reference dot-inl.h sparse branches)."""
+    from . import dot as dense_dot
+    if isinstance(lhs, BaseSparseNDArray):
+        lhs = tostype_dense(lhs)
+    if isinstance(rhs, BaseSparseNDArray):
+        rhs = tostype_dense(rhs)
+    return dense_dot(lhs, rhs, transpose_a=transpose_a, transpose_b=transpose_b)

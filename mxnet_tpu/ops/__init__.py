@@ -1,0 +1,7 @@
+"""TPU kernel & composite-op library.
+
+Home of ops implemented beyond simple jnp/lax compositions: CTC
+(ctc.py), Pallas fused kernels (pallas/), control-flow op wrappers
+(control_flow.py). The op registry in ndarray/ exposes them to the
+mx.nd / mx.sym namespaces.
+"""

@@ -1,0 +1,103 @@
+"""Control-flow operators: foreach / while_loop / cond.
+
+Analog of the reference's subgraph control-flow ops
+(src/operator/control_flow.cc: `_foreach`, `_while_loop`, `_cond` used
+via mxnet.ndarray.contrib). TPU-native design: these are thin adapters
+from the MXNet callback signatures onto jax.lax.scan / while_loop /
+cond, so hybridized graphs containing them compile to single XLA
+loops — the reference executes the subgraph per-iteration on the
+engine; XLA rolls it into the computation.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ndarray import NDArray
+from ..ndarray.ndarray import _wrap
+from ..context import current_context
+
+__all__ = ["foreach", "while_loop", "cond"]
+
+
+def _unwrap(x):
+    if isinstance(x, NDArray):
+        return x._data
+    if isinstance(x, (list, tuple)):
+        return [_unwrap(i) for i in x]
+    return x
+
+
+def _wrap_tree(x, ctx):
+    if isinstance(x, (list, tuple)):
+        return [_wrap_tree(i, ctx) for i in x]
+    return _wrap(x, ctx)
+
+
+def foreach(body, data, init_states):
+    """mx.nd.contrib.foreach: scan `body(data_t, states) -> (out, states)`
+    over axis 0 of data."""
+    ctx = (data[0] if isinstance(data, (list, tuple)) else data).ctx
+    data_arr = _unwrap(data)
+    states_arr = _unwrap(init_states)
+    multi_data = isinstance(data, (list, tuple))
+
+    def step(states, xt):
+        xs = _wrap_tree(xt, ctx) if multi_data else _wrap(xt, ctx)
+        st = _wrap_tree(states, ctx)
+        out, new_states = body(xs, st)
+        out_arr = _unwrap(out)
+        return _unwrap(new_states), out_arr
+
+    final_states, outs = lax.scan(step, states_arr, data_arr)
+    outs_nd = jax.tree_util.tree_map(lambda a: _wrap(a, ctx), outs)
+    states_nd = _wrap_tree(final_states, ctx)
+    return outs_nd, states_nd
+
+
+def while_loop(cond_fn, func, loop_vars, max_iterations=None):
+    """mx.nd.contrib.while_loop. Bounded loop: XLA needs static trip
+    bounds for stacked outputs, so outputs are collected up to
+    max_iterations (reference has the same parameter)."""
+    if max_iterations is None:
+        raise ValueError("while_loop requires max_iterations on the TPU "
+                         "backend (static shapes)")
+    ctx = loop_vars[0].ctx
+    vars_arr = [v._data for v in loop_vars]
+
+    def c(state):
+        i, vs = state
+        keep = cond_fn(*_wrap_tree(vs, ctx))
+        keep_val = keep._data if isinstance(keep, NDArray) else jnp.asarray(keep)
+        return jnp.logical_and(i < max_iterations,
+                               keep_val.astype(bool).reshape(()))
+
+    def b(state):
+        i, vs = state
+        _, new_vs = func(*_wrap_tree(vs, ctx))
+        if isinstance(new_vs, NDArray):
+            new_vs = [new_vs]
+        return (i + 1, [v._data for v in new_vs])
+
+    _, final = lax.while_loop(c, b, (jnp.asarray(0), vars_arr))
+    return None, _wrap_tree(final, ctx)
+
+
+def cond(pred_fn, then_func, else_func, inputs):
+    """mx.nd.contrib.cond."""
+    ctx = inputs[0].ctx
+    arrs = [x._data for x in inputs]
+    p = pred_fn(*_wrap_tree(arrs, ctx))
+    p_val = p._data if isinstance(p, NDArray) else jnp.asarray(p)
+
+    def t(vs):
+        out = then_func(*_wrap_tree(vs, ctx))
+        return _unwrap(out)
+
+    def e(vs):
+        out = else_func(*_wrap_tree(vs, ctx))
+        return _unwrap(out)
+
+    out = lax.cond(p_val.astype(bool).reshape(()), t, e, arrs)
+    return jax.tree_util.tree_map(lambda a: _wrap(a, ctx), out)

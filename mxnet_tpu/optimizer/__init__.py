@@ -1,0 +1,7 @@
+from .optimizer import (
+    Optimizer, Updater, get_updater, create, register,
+    SGD, NAG, Adam, AdaGrad, AdaDelta, RMSProp, Ftrl, Signum, SGLD, DCASGD,
+    LBSGD, LAMB, AdamW, Test,
+)
+
+opt = Optimizer  # legacy alias

@@ -1,0 +1,481 @@
+"""Optimizers (python/mxnet/optimizer/optimizer.py analog).
+
+Same surface as the reference: an ``Optimizer`` registry, per-parameter
+state creation (``create_state``), index-keyed ``update``, lr/wd
+multipliers, gradient rescale/clipping, multi-precision (fp32 master
+weights for bf16/fp16 params — the mp_sgd path), and an ``Updater``
+wrapper that KVStore server-side updates use. The update math itself
+dispatches to the fused optimizer ops (ndarray/op_impl_optimizer.py),
+which write back through ``out=``: on TPU each update is one XLA
+computation per parameter (and Trainer's jitted path fuses whole
+buckets).
+"""
+from __future__ import annotations
+
+import math
+import pickle
+
+import numpy as np
+
+from ..base import _Registry, MXNetError
+from ..ndarray import NDArray, zeros
+from ..ndarray.register import invoke as _invoke, get_op as _get_op
+
+__all__ = ["Optimizer", "Updater", "get_updater", "create", "register"]
+
+_REG = _Registry("optimizer")
+
+
+def register(klass):
+    _REG.register(klass.__name__.lower())(klass)
+    return klass
+
+
+def create(name, **kwargs):
+    if isinstance(name, Optimizer):
+        return name
+    return _REG.get(name)(**kwargs)
+
+
+class Optimizer:
+    """Base optimizer. Subclasses implement create_state + update."""
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 sym=None, begin_num_update=0, multi_precision=False,
+                 param_dict=None):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.lr_mult = {}
+        self.wd_mult = {}
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count = {}
+        self.clip_gradient = clip_gradient
+        self.multi_precision = multi_precision
+        self.idx2name = dict(param_idx2name or {})
+        self.param_dict = param_dict or {}
+        self.aggregate_num = 0
+
+    # -- registry-compat
+    create_optimizer = staticmethod(create)
+
+    def create_state(self, index, weight):
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        """fp32 master weight for low-precision params (mp_* ops)."""
+        if self.multi_precision and str(weight.dtype) in ("float16", "bfloat16"):
+            weight_master_copy = weight.astype("float32")
+            return (self.create_state(index, weight_master_copy), weight_master_copy)
+        return self.create_state(index, weight)
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self.multi_precision and str(weight.dtype) in ("float16", "bfloat16"):
+            inner_state, weight32 = state
+            grad32 = grad.astype("float32")
+            self.update(index, weight32, grad32, inner_state)
+            weight32.copyto(weight)
+        else:
+            self.update(index, weight, grad, state)
+
+    # -- lr/wd plumbing (mirrors reference semantics)
+    def set_learning_rate(self, lr):
+        if self.lr_scheduler is not None:
+            raise MXNetError("lr_scheduler is set; cannot set learning rate directly")
+        self.lr = lr
+
+    @property
+    def learning_rate(self):
+        if self.lr_scheduler is not None:
+            return self.lr_scheduler(self.num_update)
+        return self.lr
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = dict(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = dict(args_wd_mult)
+
+    def _update_count(self, index):
+        if not isinstance(index, (list, tuple)):
+            index = [index]
+        for idx in index:
+            if idx not in self._index_update_count:
+                self._index_update_count[idx] = self.begin_num_update
+            self._index_update_count[idx] += 1
+            self.num_update = max(self._index_update_count[idx], self.num_update)
+
+    def _get_lr(self, index):
+        lr = self.lr_scheduler(self.num_update) if self.lr_scheduler else self.lr
+        name = self.idx2name.get(index, index)
+        if name in self.param_dict:
+            lr *= self.param_dict[name].lr_mult
+        elif index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif name in self.lr_mult:
+            lr *= self.lr_mult[name]
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        name = self.idx2name.get(index, index)
+        if name in self.param_dict:
+            wd *= self.param_dict[name].wd_mult
+        elif index in self.wd_mult:
+            wd *= self.wd_mult[index]
+        elif name in self.wd_mult:
+            wd *= self.wd_mult[name]
+        return wd
+
+    def _common_kwargs(self, index):
+        kw = {"lr": self._get_lr(index), "wd": self._get_wd(index),
+              "rescale_grad": self.rescale_grad}
+        if self.clip_gradient is not None:
+            kw["clip_gradient"] = self.clip_gradient
+        return kw
+
+
+@register
+class SGD(Optimizer):
+    """SGD (+momentum, multi-precision) — sgd_update / sgd_mom_update ops."""
+
+    def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return zeros(weight.shape, weight.ctx, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        if state is None:
+            _invoke(_get_op("sgd_update"), [weight, grad], kw, out=weight)
+        else:
+            kw["momentum"] = self.momentum
+            _invoke(_get_op("sgd_mom_update"), [weight, grad, state], kw, out=weight)
+
+
+@register
+class NAG(SGD):
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        if state is None:
+            _invoke(_get_op("sgd_update"), [weight, grad], kw, out=weight)
+        else:
+            kw["momentum"] = self.momentum
+            _invoke(_get_op("nag_mom_update"), [weight, grad, state], kw, out=weight)
+
+
+@register
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_update=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, weight.ctx, dtype=weight.dtype),
+                zeros(weight.shape, weight.ctx, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        kw = self._common_kwargs(index)
+        # bias correction folded into lr (reference Adam does the same)
+        coef1 = 1.0 - self.beta1 ** t
+        coef2 = 1.0 - self.beta2 ** t
+        kw["lr"] = kw["lr"] * math.sqrt(coef2) / coef1
+        kw.update(beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon)
+        mean, var = state
+        _invoke(_get_op("adam_update"), [weight, grad, mean, var], kw, out=weight)
+
+
+@register
+class AdamW(Adam):
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        kw = self._common_kwargs(index)
+        coef1 = 1.0 - self.beta1 ** t
+        coef2 = 1.0 - self.beta2 ** t
+        kw["lr"] = kw["lr"] * math.sqrt(coef2) / coef1
+        kw.update(beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon)
+        mean, var = state
+        _invoke(_get_op("adamw_update"), [weight, grad, mean, var], kw, out=weight)
+
+
+@register
+class AdaGrad(Optimizer):
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, weight.ctx, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        kw["epsilon"] = self.float_stable_eps
+        _invoke(_get_op("adagrad_update"), [weight, grad, state], kw, out=weight)
+
+
+@register
+class AdaDelta(Optimizer):
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho, self.epsilon = rho, epsilon
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, weight.ctx, dtype=weight.dtype),
+                zeros(weight.shape, weight.ctx, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = {"wd": self._get_wd(index), "rescale_grad": self.rescale_grad,
+              "rho": self.rho, "epsilon": self.epsilon}
+        if self.clip_gradient is not None:
+            kw["clip_gradient"] = self.clip_gradient
+        acc_g, acc_delta = state
+        _invoke(_get_op("adadelta_update"), [weight, grad, acc_g, acc_delta], kw,
+                out=weight)
+
+
+@register
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1, self.gamma2 = gamma1, gamma2
+        self.centered = centered
+        self.epsilon = epsilon
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        if self.centered:
+            return (zeros(weight.shape, weight.ctx, dtype=weight.dtype),
+                    zeros(weight.shape, weight.ctx, dtype=weight.dtype),
+                    zeros(weight.shape, weight.ctx, dtype=weight.dtype))
+        return zeros(weight.shape, weight.ctx, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        kw.update(gamma1=self.gamma1, epsilon=self.epsilon)
+        if self.clip_weights:
+            kw["clip_weights"] = self.clip_weights
+        if self.centered:
+            n, g, delta = state
+            kw["gamma2"] = self.gamma2
+            _invoke(_get_op("rmspropalex_update"), [weight, grad, n, g, delta], kw,
+                    out=weight)
+        else:
+            _invoke(_get_op("rmsprop_update"), [weight, grad, state], kw, out=weight)
+
+
+@register
+class Ftrl(Optimizer):
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1, self.beta = lamda1, beta
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, weight.ctx, dtype=weight.dtype),
+                zeros(weight.shape, weight.ctx, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        kw.update(lamda1=self.lamda1, beta=self.beta)
+        z, n = state
+        _invoke(_get_op("ftrl_update"), [weight, grad, z, n], kw, out=weight)
+
+
+@register
+class Signum(Optimizer):
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return zeros(weight.shape, weight.ctx, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        if state is None:
+            _invoke(_get_op("signsgd_update"), [weight, grad], kw, out=weight)
+        else:
+            kw.update(momentum=self.momentum, wd_lh=self.wd_lh)
+            _invoke(_get_op("signum_update"), [weight, grad, state], kw, out=weight)
+
+
+@register
+class LAMB(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, lower_bound=None, upper_bound=None,
+                 bias_correction=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.lower_bound, self.upper_bound = lower_bound, upper_bound
+        self.bias_correction = bias_correction
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, weight.ctx, dtype=weight.dtype),
+                zeros(weight.shape, weight.ctx, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        mean, var = state
+        kw = {"beta1": self.beta1, "beta2": self.beta2, "epsilon": self.epsilon,
+              "t": t, "bias_correction": self.bias_correction,
+              "wd": self._get_wd(index), "rescale_grad": self.rescale_grad}
+        if self.clip_gradient is not None:
+            kw["clip_gradient"] = self.clip_gradient
+        g = _invoke(_get_op("lamb_update_phase1"), [weight, grad, mean, var], kw)
+        r1 = weight.norm()
+        r2 = g.norm()
+        kw2 = {"lr": self._get_lr(index)}
+        if self.lower_bound:
+            kw2["lower_bound"] = self.lower_bound
+        if self.upper_bound:
+            kw2["upper_bound"] = self.upper_bound
+        _invoke(_get_op("lamb_update_phase2"), [weight, g, r1, r2], kw2, out=weight)
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic Gradient Langevin Dynamics."""
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        from .. import ndarray as nd
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = g.clip(-self.clip_gradient, self.clip_gradient)
+        noise = nd.random.normal(0, math.sqrt(lr), weight.shape,
+                                 dtype=str(weight.dtype), ctx=weight.ctx)
+        weight._set_data(
+            (weight - lr / 2 * (g + wd * weight) + noise)._data)
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (reference optimizer.py DCASGD)."""
+
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return (None, weight.copy())
+        return (zeros(weight.shape, weight.ctx, dtype=weight.dtype), weight.copy())
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        mom, previous_weight = state
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = g.clip(-self.clip_gradient, self.clip_gradient)
+        comp = g + self.lamda * g * g * (weight - previous_weight)
+        if mom is None:
+            new = weight - lr * (comp + wd * weight)
+        else:
+            mom._set_data((self.momentum * mom - lr * (comp + wd * weight))._data)
+            new = weight + mom
+        previous_weight._set_data(weight._data)
+        weight._set_data(new._data)
+
+
+@register
+class LBSGD(SGD):
+    """Large-batch SGD with LARS-style layer-wise scaling (reference LBSGD)."""
+
+    def __init__(self, momentum=0.0, warmup_strategy="linear",
+                 warmup_epochs=5, batch_scale=1, updates_per_epoch=32,
+                 begin_epoch=0, num_epochs=60, **kwargs):
+        super().__init__(momentum=momentum, **kwargs)
+        self.warmup_strategy = warmup_strategy
+
+    def update(self, index, weight, grad, state):
+        # LARS trust ratio
+        w_norm = float(weight.norm().asscalar())
+        g_norm = float((grad * self.rescale_grad).norm().asscalar())
+        trust = 1.0
+        if w_norm > 0 and g_norm > 0:
+            trust = 0.001 * w_norm / (g_norm + self._get_wd(index) * w_norm)
+        saved_lr = self.lr
+        try:
+            if self.lr_scheduler is None:
+                self.lr = self.lr * trust
+            super().update(index, weight, grad, state)
+        finally:
+            self.lr = saved_lr
+
+
+@register
+class Test(Optimizer):
+    """Trivial optimizer used by reference unit tests."""
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, weight.ctx, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        weight._set_data((weight + grad * self.rescale_grad)._data)
+
+
+class Updater:
+    """Applies an optimizer by key — used by KVStore server-side updates
+    (reference python/mxnet/optimizer/optimizer.py get_updater +
+    kvstore server pickling round-trip)."""
+
+    def __init__(self, optimizer: Optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+        self.states_synced = {}
+        self.aggregate_updates = optimizer.aggregate_num > 0
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = self.optimizer.create_state_multi_precision(index, weight)
+            self.states_synced[index] = True
+        self.optimizer.update_multi_precision(index, weight, grad, self.states[index])
+
+    def set_states(self, states):
+        payload = pickle.loads(states)
+        if isinstance(payload, tuple) and len(payload) == 2:
+            self.states, self.optimizer.num_update = payload
+        else:
+            self.states = payload
+        self.states_synced = {k: False for k in self.states}
+
+    def get_states(self, dump_optimizer=False):
+        return pickle.dumps(
+            (self.states, self.optimizer.num_update) if not dump_optimizer
+            else (self.states, self.optimizer))
+
+
+def get_updater(optimizer: Optimizer) -> Updater:
+    return Updater(optimizer)

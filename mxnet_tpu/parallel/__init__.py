@@ -1,0 +1,25 @@
+"""Parallelism over device meshes.
+
+The reference scales via KVStore allreduce (NCCL/ps-lite — SURVEY §2.4);
+the TPU-native design scales via jax.sharding: pick a Mesh, annotate
+shardings, let XLA insert ICI/DCN collectives. This package is the home
+of that machinery:
+
+- mesh.py      — Mesh construction helpers (dp/tp/pp/sp/ep axes)
+- collectives.py — named-axis collective wrappers (psum/all_gather/…)
+- spmd.py      — sharded train-step builders (the `pjit` path Trainer
+                 and the benchmarks use)
+
+These are deliberately *new* surface beyond the reference's API: MXNet
+v1.x has no tensor/pipeline/sequence parallelism (SURVEY §2.4); here
+they are first-class because the mesh makes them nearly free to expose.
+"""
+from .mesh import (
+    build_mesh, local_mesh, data_parallel_mesh, current_mesh, set_current_mesh,
+)
+from .collectives import (
+    allreduce, allgather, reduce_scatter, ppermute, alltoall, axis_index, axis_size,
+)
+from .spmd import (
+    shard_params, replicate, make_data_parallel_step, make_sharded_train_step,
+)

@@ -1,0 +1,63 @@
+"""Device-mesh construction.
+
+Replaces the reference's device-topology machinery
+(src/kvstore/gpu_topology.h PCIe/NVLink tree planning, comm.h device
+lists): on TPU the fabric is the ICI torus and XLA's partitioner plans
+the routes, so "topology planning" reduces to choosing mesh axis sizes.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+__all__ = ["build_mesh", "local_mesh", "data_parallel_mesh",
+           "current_mesh", "set_current_mesh"]
+
+_CURRENT: Optional[Mesh] = None
+
+
+def build_mesh(axis_shapes: dict[str, int], devices=None) -> Mesh:
+    """Build a Mesh from {axis_name: size}. Use -1 for one axis to absorb
+    the remaining devices (like a reshape).
+
+    Example: build_mesh({"dp": -1, "tp": 4}) on 32 chips → 8×4 mesh.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    names = list(axis_shapes.keys())
+    sizes = list(axis_shapes.values())
+    n = len(devices)
+    if -1 in sizes:
+        known = math.prod(s for s in sizes if s != -1)
+        sizes[sizes.index(-1)] = max(1, n // known)
+    total = math.prod(sizes)
+    if total > n:
+        raise ValueError(f"mesh {dict(zip(names, sizes))} needs {total} devices, have {n}")
+    dev_array = np.asarray(devices[:total]).reshape(sizes)
+    return Mesh(dev_array, tuple(names))
+
+
+def local_mesh(axis_name: str = "dp", devices=None) -> Mesh:
+    """1-D mesh over this process's addressable devices."""
+    devices = list(devices if devices is not None else jax.local_devices())
+    return Mesh(np.asarray(devices), (axis_name,))
+
+
+def data_parallel_mesh(devices=None) -> Mesh:
+    """1-D global mesh over all devices — the KVStore-allreduce analog
+    (data axis rides ICI within a slice, DCN across slices)."""
+    devices = list(devices if devices is not None else jax.devices())
+    return Mesh(np.asarray(devices), ("dp",))
+
+
+def current_mesh() -> Optional[Mesh]:
+    return _CURRENT
+
+
+def set_current_mesh(mesh: Optional[Mesh]):
+    global _CURRENT
+    _CURRENT = mesh
+    return mesh

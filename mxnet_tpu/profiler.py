@@ -1,0 +1,195 @@
+"""Profiler (python/mxnet/profiler.py + src/profiler/ analog).
+
+Keeps the reference's Python API (`set_config`, `set_state('run'/'stop')`,
+`dump`, scopes/markers, aggregate per-op stats) while delegating the
+device timeline to jax.profiler (XProf/TensorBoard traces) — the
+SURVEY §5.1 plan. Op-level wall stats are collected at the dispatch
+layer when profiling is on and dumped as Chrome trace-event JSON, same
+consumption path (chrome://tracing) as the reference's profiler output.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+import jax
+
+__all__ = ["set_config", "set_state", "state", "dump", "dumps", "pause",
+           "resume", "Task", "Frame", "Event", "Counter", "Marker",
+           "profiler_set_config", "profiler_set_state", "Scope"]
+
+_CONFIG = {
+    "filename": "profile.json",
+    "profile_all": False,
+    "profile_symbolic": True,
+    "profile_imperative": True,
+    "profile_memory": False,
+    "profile_api": False,
+    "aggregate_stats": False,
+    "xprof_dir": None,
+}
+_STATE = {"running": False, "jax_trace": False}
+_EVENTS: list = []
+_AGGREGATE: dict = {}
+_LOCK = threading.Lock()
+
+
+def set_config(**kwargs):
+    _CONFIG.update(kwargs)
+
+
+profiler_set_config = set_config
+
+
+def set_state(state_name="stop", profile_process="worker"):
+    if state_name == "run":
+        _STATE["running"] = True
+        if os.environ.get("MXNET_PROFILER_AUTOSTART") != "0" and _CONFIG.get("xprof_dir"):
+            try:
+                jax.profiler.start_trace(_CONFIG["xprof_dir"])
+                _STATE["jax_trace"] = True
+            except Exception:
+                _STATE["jax_trace"] = False
+    elif state_name == "stop":
+        _STATE["running"] = False
+        if _STATE.get("jax_trace"):
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            _STATE["jax_trace"] = False
+    else:
+        raise ValueError("state must be 'run' or 'stop'")
+
+
+profiler_set_state = set_state
+
+
+def state():
+    return "run" if _STATE["running"] else "stop"
+
+
+def is_running():
+    return _STATE["running"]
+
+
+def record_op(name, begin_us, end_us, category="operator"):
+    """Called from the dispatch layer (ThreadedEngine ProfileOperator analog)."""
+    if not _STATE["running"]:
+        return
+    with _LOCK:
+        _EVENTS.append({"name": name, "cat": category, "ph": "X",
+                        "ts": begin_us, "dur": end_us - begin_us,
+                        "pid": os.getpid(), "tid": threading.get_ident()})
+        if _CONFIG["aggregate_stats"]:
+            agg = _AGGREGATE.setdefault(name, [0, 0.0, float("inf"), 0.0])
+            dur = (end_us - begin_us) / 1e3
+            agg[0] += 1
+            agg[1] += dur
+            agg[2] = min(agg[2], dur)
+            agg[3] = max(agg[3], dur)
+
+
+def dump(finished=True, profile_process="worker"):
+    """Write Chrome trace-event JSON to the configured filename."""
+    with _LOCK:
+        payload = {"traceEvents": list(_EVENTS), "displayTimeUnit": "ms"}
+        with open(_CONFIG["filename"], "w") as f:
+            json.dump(payload, f)
+        if finished:
+            _EVENTS.clear()
+
+
+def dumps(reset=False, format="table"):
+    """Aggregate per-op stats table (src/profiler/aggregate_stats.cc)."""
+    with _LOCK:
+        lines = [f"{'Name':<40}{'Count':>8}{'Total(ms)':>12}{'Min(ms)':>10}{'Max(ms)':>10}{'Avg(ms)':>10}"]
+        for name, (cnt, tot, mn, mx) in sorted(_AGGREGATE.items(),
+                                               key=lambda kv: -kv[1][1]):
+            lines.append(f"{name:<40}{cnt:>8}{tot:>12.3f}{mn:>10.3f}{mx:>10.3f}{tot / cnt:>10.3f}")
+        if reset:
+            _AGGREGATE.clear()
+        return "\n".join(lines)
+
+
+def pause(profile_process="worker"):
+    _STATE["running"] = False
+
+
+def resume(profile_process="worker"):
+    _STATE["running"] = True
+
+
+class _Named:
+    def __init__(self, name):
+        self.name = name
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.name})"
+
+
+class Task(_Named):
+    def __init__(self, domain=None, name="task"):
+        super().__init__(name)
+        self._start = None
+
+    def start(self):
+        self._start = time.perf_counter_ns() // 1000
+
+    def stop(self):
+        if self._start is not None:
+            record_op(self.name, self._start, time.perf_counter_ns() // 1000, "task")
+            self._start = None
+
+
+class Frame(Task):
+    pass
+
+
+class Event(Task):
+    pass
+
+
+class Counter(_Named):
+    def __init__(self, domain=None, name="counter", value=0):
+        super().__init__(name)
+        self.value = value
+
+    def set_value(self, value):
+        self.value = value
+
+    def increment(self, delta=1):
+        self.value += delta
+
+    def decrement(self, delta=1):
+        self.value -= delta
+
+
+class Marker(_Named):
+    def __init__(self, domain=None, name="marker"):
+        super().__init__(name)
+
+    def mark(self, scope="process"):
+        now = time.perf_counter_ns() // 1000
+        record_op(self.name, now, now, "marker")
+
+
+class Scope:
+    """with profiler.Scope('fwd'): ... — custom range."""
+
+    def __init__(self, name="scope"):
+        self.name = name
+
+    def __enter__(self):
+        self._t = Task(name=self.name)
+        self._t.start()
+        self._jax_ctx = jax.profiler.TraceAnnotation(self.name)
+        self._jax_ctx.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        self._jax_ctx.__exit__(*exc)
+        self._t.stop()
+        return False

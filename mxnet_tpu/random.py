@@ -1,0 +1,70 @@
+"""Global PRNG state.
+
+The reference gives every device a persistent PRNG resource
+(src/common/random_generator.h, ResourceRequest::kRandom,
+src/resource.cc) seeded by ``mx.random.seed``. TPU-native analog: a
+process-global threefry key chain — ``seed()`` resets the chain, every
+sampling op splits one subkey off it. Under ``hybridize()`` tracing, the
+chain can be overridden with a traced key (``push_trace_key``) so
+compiled graphs get a fresh key argument per call instead of a baked-in
+constant — the functional-RNG discipline XLA requires.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+import jax
+
+__all__ = ["seed", "get_state"]
+
+
+class _RandomState:
+    """Process-global key chain (mx.random.seed must govern ALL threads,
+    like the reference seeding every device RNG resource) with a lock;
+    trace-key overrides are per-thread (a jit trace runs on one thread).
+    """
+
+    def __init__(self):
+        self.key = jax.random.PRNGKey(int(time.time() * 1e6) % (2**31))
+        self.lock = threading.Lock()
+        self._tls = threading.local()
+
+    @property
+    def trace_keys(self):
+        if not hasattr(self._tls, "trace_keys"):
+            self._tls.trace_keys = []
+        return self._tls.trace_keys
+
+
+_STATE = _RandomState()
+
+
+def seed(seed_state: int, ctx="all"):
+    """mx.random.seed — reset the global key chain (all threads)."""
+    with _STATE.lock:
+        _STATE.key = jax.random.PRNGKey(int(seed_state))
+
+
+def _next_key():
+    """Split one subkey off the chain (or off the traced key in a trace)."""
+    tk = _STATE.trace_keys
+    if tk:
+        k, sub = jax.random.split(tk[-1])
+        tk[-1] = k
+        return sub
+    with _STATE.lock:
+        _STATE.key, sub = jax.random.split(_STATE.key)
+    return sub
+
+
+def push_trace_key(key):
+    _STATE.trace_keys.append(key)
+
+
+def pop_trace_key():
+    return _STATE.trace_keys.pop()
+
+
+def get_state():
+    return _STATE

@@ -1,0 +1,52 @@
+"""Runtime feature detection (src/libinfo.cc → mx.runtime.Features)."""
+from __future__ import annotations
+
+import jax
+
+__all__ = ["Feature", "Features", "feature_list"]
+
+
+class Feature:
+    def __init__(self, name, enabled):
+        self.name = name
+        self.enabled = enabled
+
+    def __repr__(self):
+        return f"[{'✔' if self.enabled else '✖'} {self.name}]"
+
+
+def _detect():
+    plats = set()
+    for kind in ("tpu", "gpu", "cpu"):
+        try:
+            if jax.devices(kind):
+                plats.add(kind)
+        except RuntimeError:
+            pass
+    feats = {
+        "TPU": "tpu" in plats,
+        "CUDA": "gpu" in plats,
+        "CUDNN": False,
+        "XLA": True,
+        "PALLAS": "tpu" in plats,
+        "BLAS_OPEN": True,
+        "DIST_KVSTORE": True,
+        "INT64_TENSOR_SIZE": True,
+        "SIGNAL_HANDLER": False,
+        "MKLDNN": False,
+        "OPENCV": False,
+        "F16C": True,
+    }
+    return {k: Feature(k, v) for k, v in feats.items()}
+
+
+class Features(dict):
+    def __init__(self):
+        super().__init__(_detect())
+
+    def is_enabled(self, name):
+        return self[name].enabled
+
+
+def feature_list():
+    return list(Features().values())

@@ -1,0 +1,15 @@
+from .symbol import (
+    Symbol, Variable, var, Group, load, load_json, zeros, ones, arange,
+)
+from . import symbol as _symbol_mod
+import sys as _sys
+
+# op namespace codegen (mirrors mx.sym.<op>)
+from .symbol import _populate_symbol_ops
+
+_populate_symbol_ops(_sys.modules[__name__])
+
+# sub-namespaces for parity
+from . import random  # noqa: E402
+from . import linalg  # noqa: E402
+from . import sparse  # noqa: E402

@@ -1,0 +1,11 @@
+"""mx.sym.contrib namespace — symbolic control flow + contrib ops."""
+from __future__ import annotations
+
+from .symbol import _make_node
+from ..ndarray.register import get_op
+
+
+def arange_like(data, start=0.0, step=1.0, repeat=1, axis=None, name=None):
+    return _make_node(get_op("_arange_like"), [data],
+                      {"start": start, "step": step, "repeat": repeat,
+                       "axis": axis}, name=name)

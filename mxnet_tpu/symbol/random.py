@@ -1,0 +1,17 @@
+"""mx.sym.random — symbolic sampling namespace."""
+from __future__ import annotations
+
+from .symbol import _make_node
+from ..ndarray.register import get_op
+
+
+def uniform(low=0.0, high=1.0, shape=None, dtype="float32", name=None, **kwargs):
+    return _make_node(get_op("random_uniform"), [],
+                      {"low": low, "high": high, "shape": shape, "dtype": dtype},
+                      name=name)
+
+
+def normal(loc=0.0, scale=1.0, shape=None, dtype="float32", name=None, **kwargs):
+    return _make_node(get_op("random_normal"), [],
+                      {"loc": loc, "scale": scale, "shape": shape, "dtype": dtype},
+                      name=name)

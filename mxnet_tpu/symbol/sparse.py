@@ -1,0 +1,18 @@
+"""mx.sym.sparse namespace (storage-type-aware symbolic ops).
+
+Symbolically everything is dense under XLA; these exist for API parity
+with python/mxnet/symbol/sparse.py."""
+from __future__ import annotations
+
+from .symbol import _make_node
+from ..ndarray.register import get_op
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False, name=None):
+    return _make_node(get_op("dot"), [lhs, rhs],
+                      {"transpose_a": transpose_a, "transpose_b": transpose_b},
+                      name=name)
+
+
+def retain(data, indices, name=None):
+    return _make_node(get_op("take"), [data, indices], {"axis": 0}, name=name)

@@ -1,0 +1,42 @@
+"""Misc utilities (python/mxnet/util.py analog)."""
+from __future__ import annotations
+
+import functools
+
+__all__ = ["is_np_array", "is_np_shape", "set_np", "reset_np", "makedirs", "use_np"]
+
+_NUMPY_ARRAY = False
+_NUMPY_SHAPE = False
+
+
+def is_np_array() -> bool:
+    """Whether the numpy-semantics array mode is active (mx.npx.set_np).
+    The TPU frontend keeps classic NDArray semantics by default."""
+    return _NUMPY_ARRAY
+
+
+def is_np_shape() -> bool:
+    return _NUMPY_SHAPE
+
+
+def set_np(shape=True, array=True):
+    global _NUMPY_ARRAY, _NUMPY_SHAPE
+    _NUMPY_ARRAY, _NUMPY_SHAPE = bool(array), bool(shape)
+
+
+def reset_np():
+    set_np(False, False)
+
+
+def use_np(func):
+    return func
+
+
+def makedirs(d):
+    import os
+    os.makedirs(d, exist_ok=True)
+
+
+def getenv(name, default=None):
+    import os
+    return os.environ.get(name, default)

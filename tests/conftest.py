@@ -1,0 +1,27 @@
+"""Test harness config.
+
+Tests run on a virtual 8-device CPU mesh (SURVEY §4: the analog of the
+reference's localhost multi-process ps-lite tests) so multi-device
+code paths (KVStore reduce, shard_map psum, Mesh builds) execute
+without TPU hardware. Set MXNET_TPU_TEST_REAL_DEVICE=1 to run the suite
+against the real backend instead.
+"""
+import os
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+import jax
+
+if os.environ.get("MXNET_TPU_TEST_REAL_DEVICE") != "1":
+    jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    import numpy as np
+    import mxnet_tpu as mx
+    np.random.seed(0)
+    mx.random.seed(0)
+    yield

@@ -1,0 +1,221 @@
+"""Gluon blocks/trainer (reference tests/python/unittest/test_gluon.py scope)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd, gluon
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_parameter_basic():
+    p = gluon.Parameter("weight", shape=(2, 3))
+    p.initialize(init="ones", ctx=mx.current_context())
+    assert p.data().shape == (2, 3)
+    assert (p.data().asnumpy() == 1).all()
+    assert p.grad() is not None
+    p.zero_grad()
+    assert p.grad().asnumpy().sum() == 0
+
+
+def test_parameter_deferred_init():
+    d = nn.Dense(4)
+    d.initialize()
+    x = nd.ones((2, 5))
+    out = d(x)
+    assert out.shape == (2, 4)
+    assert d.weight.shape == (4, 5)
+
+
+def test_dense_forward_values():
+    d = nn.Dense(3, use_bias=True, in_units=2)
+    d.initialize(init="ones")
+    x = nd.array([[1.0, 2.0]])
+    out = d(x)
+    assert_almost_equal(out, np.full((1, 3), 3.0, np.float32))
+
+
+def test_sequential_mlp_trains():
+    """BASELINE config #1: Gluon MLP on (synthetic) MNIST converges."""
+    np.random.seed(0)
+    mx.random.seed(0)
+    n, d = 400, 20
+    w_true = np.random.randn(d, 3).astype(np.float32)
+    x_np = np.random.randn(n, d).astype(np.float32)
+    logits = x_np @ w_true
+    y_np = logits.argmax(1).astype(np.float32)
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation="relu"), nn.Dense(3))
+    net.initialize(init=mx.initializer.Xavier())
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.5})
+
+    batch = 50
+    first_loss = last_loss = None
+    for epoch in range(12):
+        for i in range(0, n, batch):
+            xb = nd.array(x_np[i:i + batch])
+            yb = nd.array(y_np[i:i + batch])
+            with autograd.record():
+                out = net(xb)
+                loss = loss_fn(out, yb)
+            loss.backward()
+            trainer.step(batch)
+        cur = float(loss.mean().asscalar())
+        if first_loss is None:
+            first_loss = cur
+        last_loss = cur
+    assert last_loss < first_loss * 0.5, (first_loss, last_loss)
+    # accuracy check
+    pred = net(nd.array(x_np)).asnumpy().argmax(1)
+    acc = (pred == y_np).mean()
+    assert acc > 0.8, acc
+
+
+def test_hybridize_matches_eager():
+    np.random.seed(1)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"), nn.Dense(4))
+    net.initialize()
+    x = nd.array(np.random.rand(3, 6).astype(np.float32))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    hybrid = net(x).asnumpy()
+    assert_almost_equal(eager, hybrid, rtol=1e-5, atol=1e-6)
+    # second call hits the cache
+    hybrid2 = net(x).asnumpy()
+    assert_almost_equal(eager, hybrid2, rtol=1e-5, atol=1e-6)
+
+
+def test_hybridize_grads_match_eager():
+    np.random.seed(2)
+    x_np = np.random.rand(4, 5).astype(np.float32)
+
+    def run(hybrid):
+        np.random.seed(3)
+        mx.random.seed(3)
+        net = nn.HybridSequential(prefix="gnet_")
+        with net.name_scope():
+            net.add(nn.Dense(6, activation="tanh"), nn.Dense(2))
+        net.initialize()
+        if hybrid:
+            net.hybridize()
+        x = nd.array(x_np)
+        with autograd.record():
+            loss = (net(x) ** 2).sum()
+        loss.backward()
+        return {name: p.grad().asnumpy()
+                for name, p in net.collect_params().items()}
+
+    g_eager = run(False)
+    g_hybrid = run(True)
+    assert set(g_eager) == set(g_hybrid)
+    for k in g_eager:
+        assert_almost_equal(g_eager[k], g_hybrid[k], rtol=1e-4, atol=1e-5,
+                            names=(f"eager:{k}", f"hybrid:{k}"))
+
+
+def test_batchnorm_running_stats_update():
+    bn = nn.BatchNorm(in_channels=3)
+    bn.initialize()
+    x = nd.array(np.random.rand(4, 3, 2, 2).astype(np.float32) * 5)
+    with autograd.record():
+        out = bn(x)
+    rm = bn.running_mean.data().asnumpy()
+    assert not np.allclose(rm, 0)  # moved toward batch mean
+    # predict mode uses running stats (no crash, deterministic)
+    out2 = bn(x)
+    assert out2.shape == x.shape
+
+
+def test_dropout_train_vs_predict():
+    do = nn.Dropout(0.5)
+    do.initialize()
+    x = nd.ones((100, 100))
+    y_pred = do(x)
+    assert_almost_equal(y_pred, x.asnumpy())  # identity in predict mode
+    with autograd.record():
+        y_train = do(x)
+    frac_zero = (y_train.asnumpy() == 0).mean()
+    assert 0.3 < frac_zero < 0.7
+
+
+def test_save_load_parameters(tmp_path):
+    f = str(tmp_path / "net.params")
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4), nn.Dense(2))
+    net.initialize()
+    x = nd.ones((1, 3))
+    ref = net(x).asnumpy()
+    net.save_parameters(f)
+
+    net2 = nn.HybridSequential()
+    net2.add(nn.Dense(4), nn.Dense(2))
+    net2.load_parameters(f)
+    out = net2(x).asnumpy()
+    assert_almost_equal(ref, out)
+
+
+def test_constant_param():
+    class Net(nn.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self.const = self.params.get_constant("const", [[1.0, 2.0]])
+
+        def hybrid_forward(self, F, x, const):
+            return x + const
+
+    net = Net()
+    net.initialize()
+    out = net(nd.zeros((1, 2)))
+    assert (out.asnumpy() == [[1, 2]]).all()
+
+
+def test_collect_params_select():
+    net = nn.HybridSequential(prefix="model_")
+    with net.name_scope():
+        net.add(nn.Dense(4), nn.Dense(2))
+    params = net.collect_params()
+    assert len(params) == 4
+    only_w = net.collect_params(".*weight")
+    assert len(only_w) == 2
+    assert all(k.endswith("weight") for k in only_w.keys())
+
+
+def test_trainer_adam():
+    net = nn.Dense(1, in_units=2)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.1})
+    x = nd.array([[1.0, 2.0]])
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    w0 = net.weight.data().asnumpy().copy()
+    trainer.step(1)
+    assert not np.allclose(w0, net.weight.data().asnumpy())
+
+
+def test_lr_scheduler_with_trainer():
+    from mxnet_tpu.lr_scheduler import FactorScheduler
+    net = nn.Dense(1, in_units=1)
+    net.initialize()
+    sched = FactorScheduler(step=2, factor=0.5, base_lr=1.0)
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 1.0, "lr_scheduler": sched})
+    x = nd.array([[1.0]])
+    for _ in range(5):
+        with autograd.record():
+            loss = (net(x) ** 2).sum()
+        loss.backward()
+        trainer.step(1)
+    assert trainer.learning_rate < 1.0
+
+
+def test_split_and_load():
+    data = nd.array(np.arange(8).reshape(4, 2))
+    parts = gluon.utils.split_and_load(data, [mx.current_context()])
+    assert len(parts) == 1
+    assert parts[0].shape == (4, 2)

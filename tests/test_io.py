@@ -1,0 +1,146 @@
+"""IO: NDArrayIter, RecordIO (python + native), image pipeline
+(reference tests/python/unittest/test_io.py scope)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, recordio
+from mxnet_tpu.io import NDArrayIter, DataBatch, DataDesc
+
+
+def test_ndarrayiter_basic():
+    x = np.arange(40).reshape(10, 4).astype(np.float32)
+    y = np.arange(10).astype(np.float32)
+    it = NDArrayIter(x, y, batch_size=3, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 4
+    assert batches[0].data[0].shape == (3, 4)
+    assert batches[-1].pad == 2
+    it.reset()
+    assert len(list(it)) == 4
+
+
+def test_ndarrayiter_discard():
+    x = np.zeros((10, 2), np.float32)
+    it = NDArrayIter(x, None, batch_size=3, last_batch_handle="discard")
+    assert len(list(it)) == 3
+
+
+def test_ndarrayiter_shuffle_deterministic_reset():
+    x = np.arange(20).reshape(10, 2).astype(np.float32)
+    it = NDArrayIter(x, None, batch_size=5, shuffle=True)
+    a = np.concatenate([b.data[0].asnumpy() for b in it])
+    assert sorted(a[:, 0].tolist()) == sorted(x[:, 0].tolist())
+
+
+def test_provide_data_desc():
+    x = np.zeros((8, 3, 4, 4), np.float32)
+    it = NDArrayIter(x, np.zeros(8), batch_size=2)
+    desc = it.provide_data[0]
+    assert desc.name == "data"
+    assert desc.shape == (2, 3, 4, 4)
+
+
+def test_recordio_roundtrip(tmp_path):
+    path = str(tmp_path / "a.rec")
+    w = recordio.MXRecordIO(path, "w")
+    items = [b"hello", b"x" * 1000, b"", b"abc"]
+    for it_ in items:
+        w.write(it_)
+    w.close()
+    r = recordio.MXRecordIO(path, "r")
+    out = []
+    while True:
+        rec = r.read()
+        if rec is None:
+            break
+        out.append(rec)
+    assert out == items
+
+
+def test_indexed_recordio(tmp_path):
+    path = str(tmp_path / "b.rec")
+    idxp = str(tmp_path / "b.idx")
+    w = recordio.MXIndexedRecordIO(idxp, path, "w")
+    for i in range(10):
+        w.write_idx(i, bytes([i]) * (i + 1))
+    w.close()
+    r = recordio.MXIndexedRecordIO(idxp, path, "r")
+    assert r.read_idx(7) == bytes([7]) * 8
+    assert r.read_idx(0) == b"\x00"
+    assert len(r.keys) == 10
+
+
+def test_pack_unpack_header():
+    h = recordio.IRHeader(0, 3.0, 7, 0)
+    s = recordio.pack(h, b"payload")
+    h2, payload = recordio.unpack(s)
+    assert payload == b"payload"
+    assert h2.label == 3.0
+    assert h2.id == 7
+    # vector label
+    h = recordio.IRHeader(0, np.array([1.0, 2.0, 3.0], np.float32), 1, 0)
+    s = recordio.pack(h, b"xy")
+    h2, payload = recordio.unpack(s)
+    assert payload == b"xy"
+    assert np.allclose(h2.label, [1, 2, 3])
+
+
+def test_native_reader_matches_python(tmp_path):
+    from mxnet_tpu.io import native
+    if not native.available():
+        pytest.skip("native IO unavailable")
+    path = str(tmp_path / "n.rec")
+    idxp = str(tmp_path / "n.idx")
+    w = recordio.MXIndexedRecordIO(idxp, path, "w")
+    payloads = [np.random.bytes(np.random.randint(1, 200)) for _ in range(31)]
+    for i, p in enumerate(payloads):
+        w.write_idx(i, p)
+    w.close()
+
+    r = native.NativeRecordReader(path)
+    got = []
+    while (rec := r.read()) is not None:
+        got.append(rec)
+    assert got == payloads
+
+    b = native.NativeBatcher(path, idxp, batch_size=8, num_threads=3)
+    got2 = []
+    while (batch := b.next()) is not None:
+        got2.extend(batch)
+    assert got2 == payloads
+
+
+def test_image_record_iter(tmp_path):
+    """Full image pipeline: pack → native batcher → decode → augment."""
+    path = str(tmp_path / "img.rec")
+    idxp = str(tmp_path / "img.idx")
+    w = recordio.MXIndexedRecordIO(idxp, path, "w")
+    rng = np.random.default_rng(0)
+    for i in range(12):
+        img = rng.integers(0, 255, (36, 36, 3), dtype=np.uint8)
+        packed = recordio.pack_img(recordio.IRHeader(0, float(i % 3), i, 0),
+                                   img, img_fmt=".png")
+        w.write_idx(i, packed)
+    w.close()
+
+    from mxnet_tpu.io import ImageRecordIter
+    it = ImageRecordIter(path_imgrec=path, path_imgidx=idxp,
+                         data_shape=(3, 32, 32), batch_size=4,
+                         rand_crop=True, rand_mirror=True)
+    n = 0
+    for batch in it:
+        assert batch.data[0].shape == (4, 3, 32, 32)
+        assert batch.label[0].shape == (4,)
+        n += 1
+    assert n == 3
+
+
+def test_csv_iter(tmp_path):
+    f = str(tmp_path / "d.csv")
+    np.savetxt(f, np.arange(12).reshape(6, 2), delimiter=",")
+    from mxnet_tpu.io import CSVIter
+    it = CSVIter(data_csv=f, data_shape=(2,), batch_size=2)
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].data[0].shape == (2, 2)

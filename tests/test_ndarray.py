@@ -1,0 +1,191 @@
+"""NDArray basics (reference tests/python/unittest/test_ndarray.py scope)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_creation():
+    x = nd.zeros((2, 3))
+    assert x.shape == (2, 3)
+    assert x.dtype == np.float32
+    assert x.asnumpy().sum() == 0
+    y = nd.ones((4,), dtype="int32")
+    assert y.dtype == np.int32
+    z = nd.full((2, 2), 7.0)
+    assert (z.asnumpy() == 7).all()
+    a = nd.array([[1, 2], [3, 4]])
+    assert a.shape == (2, 2)
+    r = nd.arange(0, 10, 2)
+    assert (r.asnumpy() == np.arange(0, 10, 2)).all()
+
+
+def test_python_float_defaults_to_f32():
+    a = nd.array([1.5, 2.5])
+    assert a.dtype == np.float32
+
+
+def test_arith_broadcast():
+    a = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    b = nd.array([10.0, 20.0])
+    c = a + b
+    assert_almost_equal(c, np.array([[11, 22], [13, 24]], np.float32))
+    d = a * 2 + 1
+    assert_almost_equal(d, a.asnumpy() * 2 + 1)
+    e = 1 - a
+    assert_almost_equal(e, 1 - a.asnumpy())
+    f = a / b
+    assert_almost_equal(f, a.asnumpy() / b.asnumpy())
+    g = a ** 2
+    assert_almost_equal(g, a.asnumpy() ** 2)
+
+
+def test_inplace_ops_bump_version():
+    a = nd.ones((3,))
+    v0 = a._version
+    a += 1
+    assert a._version == v0 + 1
+    assert_almost_equal(a, np.full(3, 2.0, np.float32))
+    a *= 3
+    assert_almost_equal(a, np.full(3, 6.0, np.float32))
+
+
+def test_indexing():
+    a = nd.array(np.arange(24).reshape(2, 3, 4))
+    assert_almost_equal(a[1], np.arange(24).reshape(2, 3, 4)[1])
+    assert_almost_equal(a[:, 1:3], np.arange(24).reshape(2, 3, 4)[:, 1:3])
+    assert float(a[1, 2, 3].asscalar()) == 23
+    a[0] = 0
+    assert a.asnumpy()[0].sum() == 0
+    a[1, 0] = nd.array([9., 9, 9, 9])
+    assert (a.asnumpy()[1, 0] == 9).all()
+
+
+def test_reshape_special_codes():
+    a = nd.zeros((2, 3, 4))
+    assert a.reshape((4, -1)).shape == (4, 6)
+    assert a.reshape((0, -1)).shape == (2, 12)
+    assert a.reshape((-2,)).shape == (2, 3, 4)
+    assert a.reshape((-3, 4)).shape == (6, 4)
+    assert a.reshape((2, -4, 1, 3, 4)).shape == (2, 1, 3, 4)
+
+
+def test_reductions():
+    a = nd.array(np.arange(12, dtype=np.float32).reshape(3, 4))
+    assert float(a.sum().asscalar()) == 66
+    assert_almost_equal(a.sum(axis=0), a.asnumpy().sum(0))
+    assert_almost_equal(a.mean(axis=1, keepdims=True), a.asnumpy().mean(1, keepdims=True))
+    assert float(a.max().asscalar()) == 11
+    assert float(a.min().asscalar()) == 0
+    assert_almost_equal(a.argmax(axis=1), a.asnumpy().argmax(1).astype(np.float32))
+    assert abs(float(a.norm().asscalar()) - np.linalg.norm(a.asnumpy())) < 1e-4
+
+
+def test_dot():
+    a = nd.array(np.random.rand(3, 4).astype(np.float32))
+    b = nd.array(np.random.rand(4, 5).astype(np.float32))
+    assert_almost_equal(nd.dot(a, b), a.asnumpy() @ b.asnumpy())
+    assert_almost_equal(nd.dot(a, b.T, transpose_b=True).shape, (3, 4) and nd.dot(a, b).shape)
+    bd = nd.batch_dot(nd.array(np.random.rand(2, 3, 4).astype(np.float32)),
+                      nd.array(np.random.rand(2, 4, 5).astype(np.float32)))
+    assert bd.shape == (2, 3, 5)
+
+
+def test_concat_split_stack():
+    a = nd.ones((2, 3))
+    b = nd.zeros((2, 3))
+    c = nd.concat(a, b, dim=0)
+    assert c.shape == (4, 3)
+    s = nd.stack(a, b, axis=0)
+    assert s.shape == (2, 2, 3)
+    parts = nd.split(c, num_outputs=2, axis=0)
+    assert len(parts) == 2 and parts[0].shape == (2, 3)
+
+
+def test_topk_sort():
+    a = nd.array([[3.0, 1.0, 2.0], [0.0, 5.0, 4.0]])
+    idx = nd.topk(a, k=2)
+    assert idx.shape == (2, 2)
+    assert (idx.asnumpy()[0] == [0, 2]).all()
+    vals = nd.topk(a, k=1, ret_typ="value")
+    assert (vals.asnumpy().ravel() == [3, 5]).all()
+    srt = nd.sort(a, is_ascend=False)
+    assert (srt.asnumpy()[0] == [3, 2, 1]).all()
+
+
+def test_take_pick_onehot():
+    w = nd.array(np.arange(12, dtype=np.float32).reshape(4, 3))
+    idx = nd.array([0, 2], dtype="int32")
+    t = nd.take(w, idx)
+    assert_almost_equal(t, w.asnumpy()[[0, 2]])
+    p = nd.pick(w, nd.array([0, 1, 2, 0]), axis=1)
+    assert (p.asnumpy() == [0, 4, 8, 9]).all()
+    oh = nd.one_hot(nd.array([1, 0], dtype="int32"), 3)
+    assert (oh.asnumpy() == [[0, 1, 0], [1, 0, 0]]).all()
+
+
+def test_astype_copyto_context():
+    a = nd.ones((2, 2))
+    b = a.astype("float64")
+    assert b.dtype == np.float64
+    c = a.copyto(mx.current_context())
+    assert (c.asnumpy() == 1).all()
+    d = nd.zeros((2, 2))
+    a.copyto(d)
+    assert (d.asnumpy() == 1).all()
+
+
+def test_wait_and_waitall():
+    a = nd.ones((10, 10))
+    b = a * 2
+    b.wait_to_read()
+    mx.waitall()
+    assert (b.asnumpy() == 2).all()
+
+
+def test_save_load_roundtrip(tmp_path):
+    f = str(tmp_path / "arrs.params")
+    d = {"w": nd.array([[1.0, 2.0]]), "b": nd.arange(0, 5)}
+    nd.save(f, d)
+    loaded = nd.load(f)
+    assert set(loaded) == {"w", "b"}
+    assert_almost_equal(loaded["w"], d["w"])
+    assert_almost_equal(loaded["b"], d["b"])
+    # list save
+    nd.save(f, [nd.ones((2,))])
+    lst = nd.load(f)
+    assert isinstance(lst, list) and len(lst) == 1
+
+
+def test_serialization_bf16_roundtrip(tmp_path):
+    import jax.numpy as jnp
+    f = str(tmp_path / "bf16.params")
+    a = nd.ones((3, 3)).astype("bfloat16")
+    nd.save(f, {"a": a})
+    out = nd.load(f)["a"]
+    assert str(out.dtype) == "bfloat16"
+    assert (out.asnumpy().astype(np.float32) == 1).all()
+
+
+def test_where_clip():
+    a = nd.array([-1.0, 0.5, 2.0])
+    assert (a.clip(0, 1).asnumpy() == [0, 0.5, 1]).all()
+    w = nd.where(a > 0, a, nd.zeros_like(a))
+    assert (w.asnumpy() == [0, 0.5, 2.0]).all()
+
+
+def test_comparison_returns_input_dtype():
+    a = nd.array([1.0, 2.0])
+    b = nd.array([1.0, 3.0])
+    eq = (a == b)
+    assert eq.dtype == np.float32
+    assert (eq.asnumpy() == [1, 0]).all()
+
+
+def test_iter_len():
+    a = nd.array(np.arange(6).reshape(3, 2))
+    assert len(a) == 3
+    rows = list(a)
+    assert rows[1].shape == (2,)

@@ -1,0 +1,75 @@
+"""Regression tests for code-review findings (round 1)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd, gluon
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_set_data_on_deferred_param_survives_init():
+    p = gluon.Parameter("w", shape=(0, 4), allow_deferred_init=True)
+    p.initialize()
+    p.set_data(nd.ones((3, 4)) * 5)
+    p.shape = (3, 4)
+    p._finish_deferred_init()
+    assert (p.data().asnumpy() == 5).all()
+
+
+def test_waitall_after_hybridized_forward():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(3))
+    net.initialize()
+    net.hybridize()
+    net(nd.ones((2, 4)))
+    mx.waitall()  # must not crash on leaked tracers
+
+
+def test_out_aliasing_input_grad_correct():
+    x = nd.array([0.3, 0.7])
+    x.attach_grad()
+    x0 = x.asnumpy().copy()
+    with autograd.record():
+        y = nd.sin(x, out=x)  # out= aliases the input
+    y.backward()
+    assert_almost_equal(x.grad, np.cos(x0), rtol=1e-5)
+
+
+def test_reverse_scalar_ops():
+    x = nd.array([2.0, 4.0])
+    assert_almost_equal(nd._rminus_scalar(x, scalar=1.0),
+                        1.0 - x.asnumpy())
+    assert_almost_equal(nd._rdiv_scalar(x, scalar=8.0), 8.0 / x.asnumpy())
+    assert_almost_equal(nd._rpower_scalar(x, scalar=2.0),
+                        2.0 ** x.asnumpy())
+    # dunder path
+    assert_almost_equal(1.0 - x, 1.0 - x.asnumpy())
+    assert_almost_equal(8.0 / x, 8.0 / x.asnumpy())
+
+
+def test_seed_affects_other_threads():
+    import threading
+    mx.random.seed(123)
+    main_val = nd.random.uniform(shape=(4,)).asnumpy()
+    mx.random.seed(123)
+    result = {}
+
+    def worker():
+        result["val"] = nd.random.uniform(shape=(4,)).asnumpy()
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join()
+    assert np.allclose(main_val, result["val"])
+
+
+def test_dataloader_early_break_releases():
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+    ds = ArrayDataset(np.arange(100).reshape(50, 2).astype(np.float32))
+    dl = DataLoader(ds, batch_size=5, num_workers=2)
+    for batch in dl:
+        break  # early exit must not hang or leak
+    it = iter(dl)
+    n = sum(1 for _ in it)
+    assert n == 10
