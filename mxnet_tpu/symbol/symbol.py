@@ -513,6 +513,23 @@ def _hint_embedding(in_shapes, attrs):
                 int(_parse_attr(attrs.get("output_dim", 0))))}
 
 
+def _hint_softmax_label(in_shapes, attrs):
+    # label = data shape minus the class axis (reference back-infers it)
+    data = in_shapes[0]
+    if data is None:
+        return {}
+    if _parse_attr(attrs.get("multi_output", False)):
+        return {1: (data[0],) + tuple(data[2:])}
+    return {1: tuple(data[:-1])}
+
+
+def _hint_regression_label(in_shapes, attrs):
+    data = in_shapes[0]
+    if data is None:
+        return {}
+    return {1: tuple(data)}
+
+
 _PARAM_SHAPE_HINTS = {
     "FullyConnected": _hint_fully_connected,
     "Convolution": _hint_convolution,
@@ -522,6 +539,10 @@ _PARAM_SHAPE_HINTS = {
     "GroupNorm": _hint_channel_params,
     "LayerNorm": _hint_layer_norm,
     "Embedding": _hint_embedding,
+    "SoftmaxOutput": _hint_softmax_label,
+    "LinearRegressionOutput": _hint_regression_label,
+    "LogisticRegressionOutput": _hint_regression_label,
+    "MAERegressionOutput": _hint_regression_label,
 }
 
 
@@ -630,6 +651,13 @@ _OP_INPUT_NAMES = {
     "Embedding": ["data", "weight"],
     "LeakyReLU": ["data", "gamma"],
     "RNN": ["data", "parameters", "state", "state_cell"],
+    # loss heads: the label input is auto-created as {name}_label when
+    # omitted (reference: SoftmaxOutput's FListInputNames + symbol
+    # composition), which is how Module's label binding finds it
+    "SoftmaxOutput": ["data", "label"],
+    "LinearRegressionOutput": ["data", "label"],
+    "LogisticRegressionOutput": ["data", "label"],
+    "MAERegressionOutput": ["data", "label"],
 }
 
 
