@@ -288,6 +288,9 @@ def softmax_output(data, label, grad_scale=1.0, ignore_label=-1.0,
     def b(res, g):
         out, l = res
         n_class = out.shape[ax]
+        if multi_output and l.shape != out.shape[:1] + out.shape[2:]:
+            # reference convention: flattened spatial label (n, d1*...*dk)
+            l = l.reshape(out.shape[:1] + out.shape[2:])
         oh = jax.nn.one_hot(l.astype(jnp.int32), n_class, axis=ax,
                             dtype=out.dtype)
         if smooth_alpha:
@@ -311,9 +314,56 @@ def softmax_output(data, label, grad_scale=1.0, ignore_label=-1.0,
 
 @register_op("softmax_cross_entropy")
 def softmax_cross_entropy(data, label):
+    from ..ops import pallas as _pallas
+
+    if (_pallas.pallas_enabled()
+            and data.dtype in (jnp.float32, jnp.bfloat16, jnp.float16)):
+        loss = _pallas.softmax_xent_fused(data, label,
+                                          _pallas.interpret_mode())
+        return jnp.sum(loss).reshape(1).astype(data.dtype)
     logp = jax.nn.log_softmax(data, axis=-1)
     picked = jnp.take_along_axis(logp, label.astype(jnp.int32)[:, None], axis=-1)
     return -jnp.sum(picked).reshape(1)
+
+
+# ----------------------------------------------------------------------
+# Fused scaled-dot-product attention — NEW op, no reference analog
+# (SURVEY §5.7: upstream composes attention from batch_dot+softmax).
+# Exposed as mx.nd.flash_attention.
+# ----------------------------------------------------------------------
+@register_op("flash_attention")
+def flash_attention_op(query, key, value, causal=False, sm_scale=None):
+    """softmax(Q K^T * scale) V over (B, H, S, D) inputs.
+
+    Pallas flash kernel on TPU (O(S) memory); jnp fallback elsewhere.
+    """
+    from ..ops import pallas as _pallas
+
+    if (_pallas.pallas_enabled()
+            and query.dtype in (jnp.float32, jnp.bfloat16, jnp.float16)
+            and query.ndim == 4):
+        # end-aligned causal mask for sq != skv (KV-cache decode): q row
+        # 0 is global position skv - sq, matching the tril(k=sk-sq)
+        # fallback below
+        q_off = key.shape[2] - query.shape[2] if causal else 0
+        return _pallas.flash_attention(query, key, value, sm_scale,
+                                       bool(causal), q_off,
+                                       _pallas.interpret_mode())
+    d = query.shape[-1]
+    scale = sm_scale if sm_scale is not None else 1.0 / (d ** 0.5)
+    s = jnp.einsum("bhqd,bhkd->bhqk",
+                   query.astype(jnp.float32),
+                   key.astype(jnp.float32)) * scale
+    p = jax.nn.softmax(s, axis=-1)
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        cm = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        p = jax.nn.softmax(jnp.where(cm, s, -1e30), axis=-1)
+        # fully-masked rows (sq > skv): emit zeros, matching the Pallas
+        # kernel's l==0 guard
+        p = jnp.where(cm.any(-1, keepdims=True), p, 0.0)
+    return jnp.einsum("bhqk,bhkd->bhqd", p,
+                      value.astype(jnp.float32)).astype(query.dtype)
 
 
 # ----------------------------------------------------------------------
@@ -341,7 +391,16 @@ def batch_norm(data, gamma, beta, mean, var, eps=1e-5, momentum=0.9,
 
 @register_op("LayerNorm")
 def layer_norm(data, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False):
-    ax = int(axis)
+    ax = int(axis) % data.ndim
+    # Pallas fused path (cuDNN-analog): last-axis norm, TPU dtypes only
+    if (not output_mean_var and ax == data.ndim - 1
+            and data.dtype in (jnp.float32, jnp.bfloat16, jnp.float16)):
+        from ..ops import pallas as _pallas
+
+        if _pallas.pallas_enabled():
+            return _pallas.layer_norm_fused(
+                data, gamma, beta, float(eps),
+                _pallas.interpret_mode())
     mean = jnp.mean(data, axis=ax, keepdims=True)
     var = jnp.var(data, axis=ax, keepdims=True)
     x_hat = (data - mean) * lax.rsqrt(var + eps)

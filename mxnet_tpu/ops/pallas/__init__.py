@@ -1,0 +1,58 @@
+"""Pallas TPU kernel library.
+
+The TPU-native analog of the reference's hand-written CUDA kernels —
+the cuDNN operator family (src/operator/nn/cudnn/) and the fused
+mshadow elementwise kernels (src/operator/mshadow_op.h). Where the
+reference reaches for cuDNN/cuBLAS because XLA-era fusion didn't exist,
+we only drop to Pallas where XLA's own fusion genuinely loses:
+
+- ``layer_norm``  — one-pass fused normalize (HBM-bandwidth bound;
+  keeps x in VMEM across the mean/var/normalize passes).
+- ``flash_attention`` — blockwise softmax(QK^T)V with O(S) memory,
+  the kernel the reference era composed out of batch_dot+softmax
+  (SURVEY §5.7: no fused attention op exists upstream; this is the
+  performance play for the BERT north star).
+- ``softmax_xent`` — fused large-vocab softmax cross-entropy (LM
+  heads: avoids materializing the (N, V) log-softmax for backward).
+
+Dispatch contract: every kernel here has a pure-jnp twin used when the
+backend is not TPU (tests run on the CPU mesh) or when
+``MXNET_TPU_DISABLE_PALLAS=1``. ``MXNET_TPU_PALLAS_INTERPRET=1`` forces
+the Pallas path in interpreter mode so the kernels themselves are
+exercised off-TPU (the numerics tests do this).
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+_TRUE = ("1", "true", "yes", "on")
+
+
+def pallas_enabled() -> bool:
+    """Should ops dispatch to the Pallas kernel path?"""
+    if os.environ.get("MXNET_TPU_DISABLE_PALLAS", "").lower() in _TRUE:
+        return False
+    if interpret_mode():
+        return True
+    return jax.default_backend() == "tpu"
+
+
+def interpret_mode() -> bool:
+    """Run pallas_call in interpreter mode (CPU testing of kernels)."""
+    return os.environ.get("MXNET_TPU_PALLAS_INTERPRET", "").lower() in _TRUE
+
+
+from .layer_norm import layer_norm_fused  # noqa: E402
+from .flash_attention import flash_attention, flash_attention_with_lse  # noqa: E402
+from .softmax_xent import softmax_xent_fused  # noqa: E402
+
+__all__ = [
+    "pallas_enabled",
+    "interpret_mode",
+    "layer_norm_fused",
+    "flash_attention",
+    "flash_attention_with_lse",
+    "softmax_xent_fused",
+]

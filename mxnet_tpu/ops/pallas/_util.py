@@ -1,0 +1,25 @@
+"""Shared helpers for the Pallas kernel wrappers."""
+from __future__ import annotations
+
+import functools
+
+import jax
+
+
+def x32(fn):
+    """Trace ``fn`` with x64 disabled.
+
+    The framework enables jax_enable_x64 globally (MXNet exposes
+    int64/float64 NDArrays — base.py), but Mosaic requires i32 grid
+    index maps and TPU hardware has no f64 anyway; tracing the kernel
+    call under enable_x64(False) keeps every constant/iota i32. Tensor
+    operands keep their concrete dtypes — the op layer only routes
+    f32/bf16 here.
+    """
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        with jax.enable_x64(False):
+            return fn(*args, **kwargs)
+
+    return wrapper

@@ -1,0 +1,396 @@
+"""Flash attention Pallas kernel (fwd + bwd, causal, O(S) memory).
+
+Reference analog: upstream MXNet has NO fused attention op (SURVEY
+§5.7) — BERT-era attention is composed from batch_dot+softmax
+(src/operator/tensor/dot-inl.h + nn/softmax.cc), materializing the
+(S, S) score matrix in HBM. This kernel is the TPU-first replacement:
+blockwise online-softmax with the query block resident in VMEM, scores
+never leaving the chip.
+
+Also exports ``flash_attention_with_lse`` returning the per-row
+log-sum-exp, which is the combiner state ring attention needs
+(parallel/ring_attention.py merges per-ring-step (o, lse) pairs).
+
+Shapes: q (B, H, Sq, D), k/v (B, H, Skv, D). ``q_offset`` is the
+global position of q row 0 relative to k row 0 (ring attention passes
+the rotating chunk offset; 0 for vanilla causal).
+
+Variable-length / arbitrary additive masks are NOT handled here — the
+op layer falls back to the jnp path when a mask tensor is supplied.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ._util import x32
+
+_NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                acc_sc, m_sc, l_sc, *,
+                sm_scale, causal, q_offset, kv_len, block_q, block_k):
+    i, j = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _():
+        acc_sc[:] = jnp.zeros_like(acc_sc)
+        m_sc[:] = jnp.full_like(m_sc, _NEG_INF)
+        l_sc[:] = jnp.zeros_like(l_sc)
+
+    # causal skip: block is visible iff its first k column can be seen
+    # by the last q row of this block
+    q_last = (i + 1) * block_q - 1 + q_offset
+    visible = jnp.logical_or(not causal, j * block_k <= q_last)
+
+    @pl.when(visible)
+    def _():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+
+        col = j * block_k + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = col < kv_len
+        if causal:
+            row = i * block_q + q_offset + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            mask = jnp.logical_and(mask, col <= row)
+        s = jnp.where(mask, s, _NEG_INF)
+
+        m_prev = m_sc[:]
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_cur)
+        p = jnp.exp(s - m_cur)
+        l_sc[:] = l_sc[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        v = v_ref[0].astype(jnp.float32)
+        acc_sc[:] = acc_sc[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_sc[:] = m_cur
+
+    @pl.when(j == nk - 1)
+    def _():
+        l = l_sc[:]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_sc[:] / l_safe).astype(o_ref.dtype)
+        lse = jnp.where(l == 0.0, _NEG_INF, m_sc[:] + jnp.log(l_safe))
+        lse_ref[0] = lse
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_sc, *,
+                   sm_scale, causal, q_offset, kv_len, block_q, block_k):
+    i, j = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _():
+        dq_sc[:] = jnp.zeros_like(dq_sc)
+
+    q_last = (i + 1) * block_q - 1 + q_offset
+    visible = jnp.logical_or(not causal, j * block_k <= q_last)
+
+    @pl.when(visible)
+    def _():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]
+        delta = delta_ref[0]
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        col = j * block_k + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = col < kv_len
+        if causal:
+            row = i * block_q + q_offset + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            mask = jnp.logical_and(mask, col <= row)
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        dq_sc[:] = dq_sc[:] + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(j == nk - 1)
+    def _():
+        dq_ref[0] = dq_sc[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_sc, dv_sc, *,
+                    sm_scale, causal, q_offset, kv_len, block_q, block_k):
+    # grid: (BH, nk, nq) — q is the inner (sequential) axis
+    j, i = pl.program_id(1), pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(i == 0)
+    def _():
+        dk_sc[:] = jnp.zeros_like(dk_sc)
+        dv_sc[:] = jnp.zeros_like(dv_sc)
+
+    q_last = (i + 1) * block_q - 1 + q_offset
+    visible = jnp.logical_or(not causal, j * block_k <= q_last)
+
+    @pl.when(visible)
+    def _():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]
+        delta = delta_ref[0]
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        col = j * block_k + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = col < kv_len
+        if causal:
+            row = i * block_q + q_offset + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            mask = jnp.logical_and(mask, col <= row)
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+
+        dv_sc[:] = dv_sc[:] + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        dk_sc[:] = dk_sc[:] + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(i == nq - 1)
+    def _():
+        dk_ref[0] = dk_sc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_sc[:].astype(dv_ref.dtype)
+
+
+def _pad_len(s, block):
+    return ((s + block - 1) // block) * block
+
+
+def _pick_blocks(sq, skv):
+    bq = min(128, _pad_len(sq, 8))
+    bk = min(128, _pad_len(skv, 128))
+    return bq, bk
+
+
+@x32
+def _flash_fwd(q, k, v, sm_scale, causal, q_offset, interpret,
+               block_q=None, block_k=None):
+    b, h, sq, d = q.shape
+    skv = k.shape[2]
+    bq0, bk0 = _pick_blocks(sq, skv)
+    block_q = block_q or bq0
+    block_k = block_k or bk0
+    sq_p, skv_p = _pad_len(sq, block_q), _pad_len(skv, block_k)
+
+    qf = q.reshape(b * h, sq, d)
+    kf = k.reshape(b * h, skv, d)
+    vf = v.reshape(b * h, skv, d)
+    if sq_p != sq:
+        qf = jnp.pad(qf, ((0, 0), (0, sq_p - sq), (0, 0)))
+    if skv_p != skv:
+        kf = jnp.pad(kf, ((0, 0), (0, skv_p - skv), (0, 0)))
+        vf = jnp.pad(vf, ((0, 0), (0, skv_p - skv), (0, 0)))
+
+    bh = b * h
+    nq, nk = sq_p // block_q, skv_p // block_k
+    kern = functools.partial(
+        _fwd_kernel, sm_scale=sm_scale, causal=causal,
+        q_offset=q_offset, kv_len=skv, block_q=block_q, block_k=block_k)
+    o, lse = pl.pallas_call(
+        kern,
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b_, i, j: (b_, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda b_, i, j: (b_, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda b_, i, j: (b_, j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b_, i, j: (b_, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, 1), lambda b_, i, j: (b_, i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq_p, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, sq_p, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+    o = o[:, :sq].reshape(b, h, sq, d)
+    lse = lse[:, :sq, 0].reshape(b, h, sq)
+    return o, lse
+
+
+@x32
+def _flash_bwd(q, k, v, o, lse, do, sm_scale, causal, q_offset, interpret,
+               block_q=None, block_k=None):
+    b, h, sq, d = q.shape
+    skv = k.shape[2]
+    bq0, bk0 = _pick_blocks(sq, skv)
+    block_q = block_q or bq0
+    block_k = block_k or bk0
+    sq_p, skv_p = _pad_len(sq, block_q), _pad_len(skv, block_k)
+    bh = b * h
+
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1).reshape(bh, sq, 1)
+    qf = q.reshape(bh, sq, d)
+    kf = k.reshape(bh, skv, d)
+    vf = v.reshape(bh, skv, d)
+    dof = do.reshape(bh, sq, d)
+    lsef = lse.reshape(bh, sq, 1)
+    if sq_p != sq:
+        pad = ((0, 0), (0, sq_p - sq), (0, 0))
+        qf, dof = jnp.pad(qf, pad), jnp.pad(dof, pad)
+        # padded q rows: lse=-inf would give exp(s - -inf)=inf; use +inf
+        # so p=exp(-inf)=0 for those rows
+        lsef = jnp.pad(lsef, ((0, 0), (0, sq_p - sq), (0, 0)),
+                       constant_values=jnp.inf)
+        delta = jnp.pad(delta, ((0, 0), (0, sq_p - sq), (0, 0)))
+    if skv_p != skv:
+        pad = ((0, 0), (0, skv_p - skv), (0, 0))
+        kf, vf = jnp.pad(kf, pad), jnp.pad(vf, pad)
+
+    nq, nk = sq_p // block_q, skv_p // block_k
+    common = dict(sm_scale=sm_scale, causal=causal, q_offset=q_offset,
+                  kv_len=skv, block_q=block_q, block_k=block_k)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, **common),
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b_, i, j: (b_, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda b_, i, j: (b_, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda b_, i, j: (b_, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, d), lambda b_, i, j: (b_, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, 1), lambda b_, i, j: (b_, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, 1), lambda b_, i, j: (b_, i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b_, i, j: (b_, i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((bh, sq_p, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lsef, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, **common),
+        grid=(bh, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b_, j, i: (b_, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda b_, j, i: (b_, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda b_, j, i: (b_, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, d), lambda b_, j, i: (b_, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, 1), lambda b_, j, i: (b_, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, 1), lambda b_, j, i: (b_, i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b_, j, i: (b_, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda b_, j, i: (b_, j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, skv_p, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, skv_p, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lsef, delta)
+
+    dq = dq[:, :sq].reshape(b, h, sq, d)
+    dk = dk[:, :skv].reshape(*k.shape)
+    dv = dv[:, :skv].reshape(*v.shape)
+    return dq, dk, dv
+
+
+def flash_attention_with_lse(q, k, v, sm_scale=None, causal=False,
+                             q_offset=0, interpret=False):
+    """Forward-only flash attention returning (out, lse).
+
+    lse has shape (B, H, Sq), fp32 — the ring-attention combiner state.
+    Not differentiable through JAX autodiff (use flash_attention); ring
+    attention defines its own VJP over the combined result.
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    return _flash_fwd(q, k, v, sm_scale, bool(causal), int(q_offset),
+                      interpret)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q, k, v, sm_scale=None, causal=False, q_offset=0,
+                    interpret=False):
+    """softmax(q k^T * scale [+causal mask]) v, blockwise in VMEM."""
+    if sm_scale is None:
+        sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    o, _ = _flash_fwd(q, k, v, sm_scale, bool(causal), int(q_offset),
+                      interpret)
+    return o
+
+
+def _flash_vjp_fwd(q, k, v, sm_scale, causal, q_offset, interpret):
+    if sm_scale is None:
+        sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    o, lse = _flash_fwd(q, k, v, sm_scale, bool(causal), int(q_offset),
+                        interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_vjp_bwd(sm_scale, causal, q_offset, interpret, res, do):
+    q, k, v, o, lse = res
+    if sm_scale is None:
+        sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    dq, dk, dv = _flash_bwd(q, k, v, o, lse, do, sm_scale, bool(causal),
+                            int(q_offset), interpret)
+    return dq, dk, dv
+
+
+flash_attention.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)

@@ -519,7 +519,11 @@ def _hint_softmax_label(in_shapes, attrs):
     if data is None:
         return {}
     if _parse_attr(attrs.get("multi_output", False)):
-        return {1: (data[0],) + tuple(data[2:])}
+        # reference infers the FLATTENED spatial label (n, d1*...*dk)
+        n = 1
+        for d in data[2:]:
+            n *= d
+        return {1: (data[0], n) if len(data) > 2 else (data[0],)}
     return {1: tuple(data[:-1])}
 
 
