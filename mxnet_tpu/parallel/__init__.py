@@ -23,3 +23,7 @@ from .collectives import (
 from .spmd import (
     shard_params, replicate, make_data_parallel_step, make_sharded_train_step,
 )
+from .ring_attention import (
+    ring_attention, ulysses_attention,
+    make_ring_attention_fn, make_ulysses_attention_fn,
+)

@@ -1,0 +1,148 @@
+"""Ring attention & all-to-all (Ulysses-style) sequence parallelism.
+
+Long-context support the reference does not have in any form (SURVEY
+§5.7: MXNet v1.x has no fused attention, no sequence/context
+parallelism) — first-class here because the TPU mesh makes it natural:
+
+- :func:`ring_attention` — the sequence axis is sharded over a mesh
+  axis; K/V chunks rotate around the ring via ``lax.ppermute`` (ICI
+  neighbor exchanges) while each device folds incoming chunks into an
+  online-softmax accumulator (the flash-attention merge). Peak memory
+  per device is O(S·C) for the score blocks (C = S/P chunk), and with
+  ``remat=True`` (default) the score blocks are recomputed in backward
+  — the blockwise-attention memory profile.
+- :func:`ulysses_attention` — all-to-all over the mesh axis re-shards
+  (B, H, S/P, D) → (B, H/P, S, D) so each device computes full-sequence
+  attention for a head subset (single flash kernel call on TPU), then
+  all-to-all back. Two collectives per call; cheaper than the ring when
+  H ≥ P and the ICI all-to-all bandwidth is good.
+
+Both are differentiable (ppermute/all_to_all have transposes; the ring
+uses lax.scan) and are meant to be called INSIDE ``shard_map`` with the
+sequence dimension sharded over ``axis_name``. The shard_map wrapper
+:func:`make_ring_attention_fn` is the convenience entry the tests and
+models use.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["ring_attention", "ulysses_attention",
+           "make_ring_attention_fn", "make_ulysses_attention_fn"]
+
+_NEG_INF = -1e30
+
+
+def _axis_size_static(axis_name):
+    size = lax.axis_size(axis_name) if hasattr(lax, "axis_size") else None
+    if size is None or not isinstance(size, int):
+        raise ValueError(
+            f"static size of mesh axis {axis_name!r} unavailable; pass "
+            "axis_size= explicitly")
+    return size
+
+
+def ring_attention(q, k, v, axis_name, causal=False, sm_scale=None,
+                   axis_size=None, remat=True):
+    """Blockwise self-attention over a ring of sequence shards.
+
+    Parameters
+    ----------
+    q, k, v : (B, H, C, D) local sequence chunks; the global sequence
+        (S = C * P) is sharded over mesh axis ``axis_name`` in order.
+    causal : global causal mask (chunk offsets are accounted for).
+    remat : recompute score blocks in backward (flash-style memory).
+    """
+    P_ = axis_size if axis_size is not None else _axis_size_static(axis_name)
+    b, h, c, d = q.shape
+    scale = sm_scale if sm_scale is not None else 1.0 / (d ** 0.5)
+    idx = lax.axis_index(axis_name)
+    perm = [(j, (j + 1) % P_) for j in range(P_)]
+
+    qf = q.astype(jnp.float32)
+    row = idx * c + lax.broadcasted_iota(jnp.int32, (c, c), 0)
+
+    def step(carry, t):
+        kc, vc, m, l, acc = carry
+        # this device currently holds chunk (idx - t) mod P
+        src = (idx - t) % P_
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, kc.astype(jnp.float32),
+                       preferred_element_type=jnp.float32) * scale
+        if causal:
+            col = src * c + lax.broadcasted_iota(jnp.int32, (c, c), 1)
+            s = jnp.where(col <= row, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, vc.astype(jnp.float32),
+            preferred_element_type=jnp.float32)
+        kc, vc = lax.ppermute((kc, vc), axis_name, perm)
+        return (kc, vc, m_new, l_new, acc_new), None
+
+    if remat:
+        step = jax.checkpoint(step)
+
+    m0 = jnp.full((b, h, c, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, c, 1), jnp.float32)
+    acc0 = jnp.zeros((b, h, c, d), jnp.float32)
+    (_, _, m, l, acc), _ = lax.scan(
+        step, (k, v, m0, l0, acc0), jnp.arange(P_))
+
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    out = jnp.where(l == 0.0, 0.0, acc / l_safe)
+    return out.astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis_name, causal=False, sm_scale=None):
+    """All-to-all sequence parallelism (DeepSpeed-Ulysses pattern).
+
+    q, k, v : (B, H, C, D) sequence chunks, H divisible by the axis
+    size. Re-shards to (B, H/P, S, D), runs full-sequence attention
+    locally (Pallas flash kernel on TPU via the op-layer impl), and
+    re-shards back.
+    """
+    qg = lax.all_to_all(q, axis_name, split_axis=1, concat_axis=2, tiled=True)
+    kg = lax.all_to_all(k, axis_name, split_axis=1, concat_axis=2, tiled=True)
+    vg = lax.all_to_all(v, axis_name, split_axis=1, concat_axis=2, tiled=True)
+    from ..ndarray.op_impl_nn import flash_attention_op
+
+    og = flash_attention_op(qg, kg, vg, causal=causal, sm_scale=sm_scale)
+    return lax.all_to_all(og, axis_name, split_axis=2, concat_axis=1,
+                          tiled=True)
+
+
+def _seq_sharded_wrapper(fn, mesh, axis_name, **kw):
+    from jax import shard_map
+
+    spec = P(None, None, axis_name, None)
+    wrapped = shard_map(
+        functools.partial(fn, axis_name=axis_name, **kw),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return wrapped
+
+
+def make_ring_attention_fn(mesh, axis_name="sp", causal=False,
+                           sm_scale=None, remat=True):
+    """shard_map-wrapped ring attention over ``mesh[axis_name]``.
+
+    Returns fn(q, k, v) on GLOBAL (B, H, S, D) arrays with S sharded
+    over ``axis_name``; jit/grad-compatible.
+    """
+    return _seq_sharded_wrapper(
+        ring_attention, mesh, axis_name, causal=causal, sm_scale=sm_scale,
+        axis_size=int(mesh.shape[axis_name]), remat=remat)
+
+
+def make_ulysses_attention_fn(mesh, axis_name="sp", causal=False,
+                              sm_scale=None):
+    """shard_map-wrapped Ulysses attention over ``mesh[axis_name]``."""
+    return _seq_sharded_wrapper(
+        ulysses_attention, mesh, axis_name, causal=causal, sm_scale=sm_scale)
