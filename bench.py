@@ -27,12 +27,11 @@ WARMUP = 3
 DTYPE = os.environ.get("BENCH_DTYPE", "bfloat16")
 
 
-def main():
-    import jax
-    import jax.numpy as jnp
 
-    # persistent compile cache: the ResNet-50 train step takes minutes to
-    # compile through axon's remote compiler; cache it across runs/rounds
+def _setup_cache():
+    """Persistent compile cache — axon remote-compiles are minutes-slow."""
+    import jax
+
     cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              ".jax_cache")
     try:
@@ -40,6 +39,52 @@ def main():
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     except Exception:
         pass
+
+
+def _make_momentum_sgd(loss_fn, lr):
+    """Jitted momentum-SGD train step over (params, moms) pytrees."""
+    import jax
+    import jax.numpy as jnp
+
+    def train_step(params, moms, *args):
+        loss, grads = jax.value_and_grad(loss_fn)(params, *args)
+        new_moms = jax.tree_util.tree_map(
+            lambda m, g: 0.9 * m + g.astype(jnp.float32), moms, grads)
+        new_params = jax.tree_util.tree_map(
+            lambda p, m: (p.astype(jnp.float32) - lr * m).astype(p.dtype),
+            params, new_moms)
+        return new_params, new_moms, loss
+
+    return jax.jit(train_step, donate_argnums=(0, 1))
+
+
+def _zeros_moms(params):
+    import jax
+    import jax.numpy as jnp
+
+    return jax.tree_util.tree_map(
+        lambda p: jnp.zeros(p.shape, jnp.float32), params)
+
+
+def _time_steps(step, params, moms, *args):
+    """Warmup then time STEPS iterations; returns (elapsed_sec)."""
+    import jax
+
+    for _ in range(WARMUP):
+        params, moms, loss = step(params, moms, *args)
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        params, moms, loss = step(params, moms, *args)
+    jax.block_until_ready(loss)
+    return time.perf_counter() - t0
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    _setup_cache()
 
     import mxnet_tpu as mx
     from mxnet_tpu.gluon.block import functionalize
@@ -61,34 +106,15 @@ def main():
         logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
         return -jnp.take_along_axis(logp, y[:, None], axis=-1).mean()
 
-    def train_step(params, moms, rng, x, y):
-        loss, grads = jax.value_and_grad(loss_fn)(params, rng, x, y)
-        new_moms = jax.tree_util.tree_map(
-            lambda m, g: 0.9 * m + g.astype(jnp.float32), moms, grads)
-        new_params = jax.tree_util.tree_map(
-            lambda p, m: (p.astype(jnp.float32) - 0.1 * m).astype(p.dtype),
-            params, new_moms)
-        return new_params, new_moms, loss
-
-    step = jax.jit(train_step, donate_argnums=(0, 1))
-
-    moms = jax.tree_util.tree_map(
-        lambda p: jnp.zeros(p.shape, jnp.float32), params)
+    step = _make_momentum_sgd(loss_fn, 0.1)
+    moms = _zeros_moms(params)
     rng = jax.random.PRNGKey(0)
     x = jnp.asarray(np.random.RandomState(0)
                     .rand(BATCH, 3, IMAGE, IMAGE).astype(np.float32)
                     .astype(np.dtype("float32")), dtype=DTYPE)
     y = jnp.asarray(np.random.RandomState(1).randint(0, 1000, BATCH), jnp.int32)
 
-    for _ in range(WARMUP):
-        params, moms, loss = step(params, moms, rng, x, y)
-    jax.block_until_ready(loss)
-
-    t0 = time.perf_counter()
-    for _ in range(STEPS):
-        params, moms, loss = step(params, moms, rng, x, y)
-    jax.block_until_ready(loss)
-    dt = time.perf_counter() - t0
+    dt = _time_steps(step, params, moms, rng, x, y)
 
     imgs_per_sec = BATCH * STEPS / dt
     print(json.dumps({
@@ -99,5 +125,80 @@ def main():
     }))
 
 
+def main_bert():
+    """BERT-base MLM pretraining step, tokens/sec/chip (BASELINE #3).
+
+    bf16 trunk, fused Pallas flash-attention/LayerNorm/softmax-CE path.
+    No per-chip reference number exists (BASELINE.md: BERT lives in
+    GluonNLP, mount empty) — vs_baseline reports 0.0.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    _setup_cache()
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.block import functionalize
+    from mxnet_tpu.gluon.model_zoo import bert_base
+    from mxnet_tpu.gluon.model_zoo.bert import BERTMLMHead
+
+    batch = int(os.environ.get("BENCH_BATCH", "32"))
+    seqlen = int(os.environ.get("BENCH_SEQLEN", "128"))
+    vocab = 30522
+    ctx = mx.current_context()
+
+    net = bert_base(vocab_size=vocab, max_length=512, dropout=0.0)
+    head = BERTMLMHead(vocab, 768)
+    net.initialize(init=mx.initializer.Normal(0.02), ctx=ctx)
+    head.initialize(init=mx.initializer.Normal(0.02), ctx=ctx)
+    if DTYPE != "float32":
+        net.cast(DTYPE)
+        head.cast(DTYPE)
+
+    ids = mx.nd.zeros((2, seqlen), ctx=ctx, dtype="int32")
+    tt = mx.nd.zeros((2, seqlen), ctx=ctx, dtype="int32")
+    with mx.autograd.predict_mode():
+        head(net(ids, tt)[0])
+
+    fn, params = functionalize(net, training=True, ctx=ctx)
+    hfn, hparams = functionalize(head, training=True, ctx=ctx)
+
+    def loss_fn(ps, rng, ids, tt, labels):
+        p1, p2 = ps
+        seq, _ = fn(p1, rng, ids, tt)
+        logits = hfn(p2, rng, seq).astype(jnp.float32)
+        from mxnet_tpu.ops import pallas as _pallas
+        flat = logits.reshape(-1, vocab)
+        if _pallas.pallas_enabled():
+            loss = _pallas.softmax_xent_fused(flat, labels.reshape(-1))
+        else:
+            logp = jax.nn.log_softmax(flat, axis=-1)
+            loss = -jnp.take_along_axis(
+                logp, labels.reshape(-1)[:, None], axis=-1)[:, 0]
+        return loss.mean()
+
+    step = _make_momentum_sgd(loss_fn, 1e-3)
+    ps = (params, hparams)
+    moms = _zeros_moms(ps)
+    rng = jax.random.PRNGKey(0)
+    npr = np.random.RandomState(0)
+    ids = jnp.asarray(npr.randint(0, vocab, (batch, seqlen)), jnp.int32)
+    tt = jnp.zeros((batch, seqlen), jnp.int32)
+    labels = jnp.asarray(npr.randint(0, vocab, (batch, seqlen)), jnp.int32)
+
+    dt = _time_steps(step, ps, moms, rng, ids, tt, labels)
+
+    tok_per_sec = batch * seqlen * STEPS / dt
+    print(json.dumps({
+        "metric": "bert_base_train_tokens_per_sec_per_chip",
+        "value": round(tok_per_sec, 2),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": 0.0,
+    }))
+
+
 if __name__ == "__main__":
-    main()
+    if os.environ.get("BENCH_MODEL", "resnet50") == "bert":
+        main_bert()
+    else:
+        main()

@@ -1,2 +1,5 @@
 from . import vision
 from .vision import get_model
+from . import bert
+from .bert import (BERTModel, BERTMLMHead, BERTNSPHead, bert_base,
+                   bert_large, get_bert)

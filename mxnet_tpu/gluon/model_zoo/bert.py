@@ -1,0 +1,149 @@
+"""BERT model family (the BASELINE config #3 flagship).
+
+The reference-era BERT lives in GluonNLP (external repo, composed from
+batch_dot+softmax primitive ops — SURVEY §6); here it is a first-class
+model-zoo member built on the fused TransformerEncoder
+(gluon/nn/transformer.py → Pallas flash attention + fused LayerNorm).
+
+API mirrors GluonNLP's BERTModel: ``model(inputs, token_types)`` →
+(sequence_output, pooled_output); MLM/NSP heads are separate blocks so
+pretraining and fine-tuning share the trunk.
+"""
+from __future__ import annotations
+
+from ... import initializer as init
+from ..block import HybridBlock
+from ..nn import Dense, Dropout, Embedding, LayerNorm, TransformerEncoder
+from ..nn.basic_layers import Activation
+
+__all__ = ["BERTModel", "BERTMLMHead", "BERTNSPHead", "bert_base", "bert_large",
+           "get_bert"]
+
+
+class BERTEmbeddings(HybridBlock):
+    """token + position + segment embeddings, LN, dropout."""
+
+    def __init__(self, vocab_size, units, max_length, token_types=2,
+                 dropout=0.1, layer_norm_eps=1e-12, dtype="float32",
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._max_length = max_length
+        with self.name_scope():
+            self.word_embed = Embedding(vocab_size, units, dtype=dtype,
+                                        prefix="word_")
+            self.token_type_embed = Embedding(token_types, units, dtype=dtype,
+                                              prefix="type_")
+            self.position_embed = Embedding(max_length, units, dtype=dtype,
+                                            prefix="pos_")
+            self.ln = LayerNorm(epsilon=layer_norm_eps, prefix="ln_")
+            self.dropout = Dropout(dropout) if dropout else None
+
+    def hybrid_forward(self, F, inputs, token_types):
+        # positions 0..S-1 derived from the input itself (jit-static).
+        # Embedding's take() clips out-of-range ids, which would silently
+        # alias every position past max_length — reject instead.
+        try:
+            seq_len = inputs.shape[1]
+        except Exception:
+            seq_len = None
+        if seq_len is not None and seq_len > self._max_length:
+            raise ValueError(
+                f"sequence length {seq_len} exceeds max_length "
+                f"{self._max_length} of the position table")
+        pos = F.arange_like(inputs, axis=1)
+        x = self.word_embed(inputs) + self.token_type_embed(token_types)
+        x = x + F.expand_dims(self.position_embed(pos), 0)
+        x = self.ln(x)
+        if self.dropout is not None:
+            x = self.dropout(x)
+        return x
+
+
+class BERTModel(HybridBlock):
+    """Trunk: embeddings → TransformerEncoder → (seq_out, pooled_out)."""
+
+    def __init__(self, vocab_size=30522, units=768, hidden_size=3072,
+                 num_layers=12, num_heads=12, max_length=512,
+                 token_types=2, dropout=0.1, attention_dropout=0.1,
+                 layer_norm_eps=1e-12, use_pooler=True, dtype="float32",
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.units = units
+        self.vocab_size = vocab_size
+        with self.name_scope():
+            self.embeddings = BERTEmbeddings(
+                vocab_size, units, max_length, token_types=token_types,
+                dropout=dropout, layer_norm_eps=layer_norm_eps, dtype=dtype,
+                prefix="embed_")
+            self.encoder = TransformerEncoder(
+                num_layers, units, hidden_size, num_heads, dropout=dropout,
+                attention_dropout=attention_dropout, activation="gelu",
+                pre_norm=False, layer_norm_eps=layer_norm_eps, dtype=dtype,
+                prefix="enc_")
+            self.pooler = (Dense(units, flatten=False, activation="tanh",
+                                 dtype=dtype, prefix="pooler_")
+                           if use_pooler else None)
+
+    def hybrid_forward(self, F, inputs, token_types, mask=None):
+        x = self.embeddings(inputs, token_types)
+        seq = self.encoder(x, mask)
+        if self.pooler is None:
+            return seq
+        pooled = self.pooler(F.slice_axis(seq, axis=1, begin=0, end=1)
+                             .reshape((0, -1)))
+        return seq, pooled
+
+
+class BERTMLMHead(HybridBlock):
+    """transform (dense+gelu+LN) then decode to vocab logits."""
+
+    def __init__(self, vocab_size, units, layer_norm_eps=1e-12,
+                 dtype="float32", prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        with self.name_scope():
+            self.transform = Dense(units, flatten=False, dtype=dtype,
+                                   prefix="transform_")
+            self.act = Activation("gelu")
+            self.ln = LayerNorm(epsilon=layer_norm_eps, prefix="ln_")
+            self.decoder = Dense(vocab_size, flatten=False, dtype=dtype,
+                                 prefix="decoder_")
+
+    def hybrid_forward(self, F, seq):
+        return self.decoder(self.ln(self.act(self.transform(seq))))
+
+
+class BERTNSPHead(HybridBlock):
+    def __init__(self, dtype="float32", prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        with self.name_scope():
+            self.classifier = Dense(2, flatten=False, dtype=dtype,
+                                    prefix="cls_")
+
+    def hybrid_forward(self, F, pooled):
+        return self.classifier(pooled)
+
+
+_BERT_SPECS = {
+    "bert_base": dict(units=768, hidden_size=3072, num_layers=12,
+                      num_heads=12),
+    "bert_large": dict(units=1024, hidden_size=4096, num_layers=24,
+                       num_heads=16),
+}
+
+
+def get_bert(spec="bert_base", vocab_size=30522, max_length=512,
+             dropout=0.1, dtype="float32", **kwargs):
+    cfg = dict(_BERT_SPECS[spec])
+    cfg.update(kwargs)
+    return BERTModel(vocab_size=vocab_size, max_length=max_length,
+                     dropout=dropout, attention_dropout=dropout,
+                     dtype=dtype, **cfg)
+
+
+def bert_base(**kwargs):
+    """BERT-base (L=12, H=768, A=12) — the v5p north-star config."""
+    return get_bert("bert_base", **kwargs)
+
+
+def bert_large(**kwargs):
+    return get_bert("bert_large", **kwargs)

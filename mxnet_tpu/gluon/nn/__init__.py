@@ -4,6 +4,10 @@ from .basic_layers import (
     Activation, LeakyReLU, PReLU, ELU, SELU, Swish, GELU, SiLU, Identity,
     HybridBlock, Block,
 )
+from .transformer import (
+    MultiHeadAttention, PositionwiseFFN, TransformerEncoderCell,
+    TransformerEncoder,
+)
 from .conv_layers import (
     Conv1D, Conv2D, Conv3D, Conv1DTranspose, Conv2DTranspose, Conv3DTranspose,
     MaxPool1D, MaxPool2D, MaxPool3D, AvgPool1D, AvgPool2D, AvgPool3D,

@@ -13,6 +13,8 @@ through every op (the AMP/fp16 analog).
 """
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -185,6 +187,9 @@ _ACT = {
     "softrelu": jax.nn.softplus,
     "softsign": jax.nn.soft_sign,
     "mish": lambda x: x * jnp.tanh(jax.nn.softplus(x)),
+    "gelu": functools.partial(jax.nn.gelu, approximate=False),
+    "gelu_tanh": functools.partial(jax.nn.gelu, approximate=True),
+    "silu": jax.nn.silu,
 }
 
 
@@ -324,6 +329,30 @@ def softmax_cross_entropy(data, label):
     logp = jax.nn.log_softmax(data, axis=-1)
     picked = jnp.take_along_axis(logp, label.astype(jnp.int32)[:, None], axis=-1)
     return -jnp.sum(picked).reshape(1)
+
+
+# ----------------------------------------------------------------------
+# Attention helpers for the composed (masked) path — the 4D batched
+# forms of the reference-era batch_dot attention (dot-inl.h + softmax.cc)
+# ----------------------------------------------------------------------
+@register_op("batch_dot_attention_scores")
+def batch_dot_attention_scores(query, key):
+    """(B,H,Sq,D),(B,H,Sk,D) -> (B,H,Sq,Sk) score matrix (unscaled)."""
+    return jnp.einsum("bhqd,bhkd->bhqk", query, key)
+
+
+@register_op("batch_dot_attention_apply")
+def batch_dot_attention_apply(probs, value):
+    """(B,H,Sq,Sk),(B,H,Sk,D) -> (B,H,Sq,D)."""
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, value)
+
+
+@register_op("causal_mask_scores")
+def causal_mask_scores(scores):
+    """End-aligned causal mask over the last two axes of (…,Sq,Sk)."""
+    sq, sk = scores.shape[-2], scores.shape[-1]
+    cm = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+    return jnp.where(cm, scores, -1e30)
 
 
 # ----------------------------------------------------------------------

@@ -67,10 +67,9 @@ def ring_attention(q, k, v, axis_name, causal=False, sm_scale=None,
     qf = q.astype(jnp.float32)
     row = idx * c + lax.broadcasted_iota(jnp.int32, (c, c), 0)
 
-    def step(carry, t):
-        kc, vc, m, l, acc = carry
-        # this device currently holds chunk (idx - t) mod P
-        src = (idx - t) % P_
+    def fold(carry, kc, vc, src):
+        """Online-softmax fold of chunk ``src`` into the accumulator."""
+        m, l, acc = carry
         s = jnp.einsum("bhqd,bhkd->bhqk", qf, kc.astype(jnp.float32),
                        preferred_element_type=jnp.float32) * scale
         if causal:
@@ -83,17 +82,27 @@ def ring_attention(q, k, v, axis_name, causal=False, sm_scale=None,
         acc_new = acc * alpha + jnp.einsum(
             "bhqk,bhkd->bhqd", p, vc.astype(jnp.float32),
             preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    def step(carry, t):
+        # permute-then-compute: after t rotations this device holds
+        # chunk (idx - t) mod P; exactly P-1 neighbor exchanges total
+        kc, vc, m, l, acc = carry
         kc, vc = lax.ppermute((kc, vc), axis_name, perm)
-        return (kc, vc, m_new, l_new, acc_new), None
+        m, l, acc = fold((m, l, acc), kc, vc, (idx - t) % P_)
+        return (kc, vc, m, l, acc), None
 
     if remat:
+        fold = jax.checkpoint(fold)
         step = jax.checkpoint(step)
 
     m0 = jnp.full((b, h, c, 1), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((b, h, c, 1), jnp.float32)
     acc0 = jnp.zeros((b, h, c, d), jnp.float32)
-    (_, _, m, l, acc), _ = lax.scan(
-        step, (k, v, m0, l0, acc0), jnp.arange(P_))
+    m, l, acc = fold((m0, l0, acc0), k, v, idx)  # own chunk, no comm
+    if P_ > 1:
+        (_, _, m, l, acc), _ = lax.scan(
+            step, (k, v, m, l, acc), jnp.arange(1, P_))
 
     l_safe = jnp.where(l == 0.0, 1.0, l)
     out = jnp.where(l == 0.0, 0.0, acc / l_safe)

@@ -1,0 +1,146 @@
+"""Transformer layers + BERT model family tests (BASELINE config #3)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon.model_zoo import bert_base
+from mxnet_tpu.gluon.model_zoo.bert import BERTMLMHead, BERTNSPHead
+
+
+def _mha_ref(x, qkv_w, qkv_b, out_w, out_b, heads, causal=False, mask=None):
+    b, s, c = x.shape
+    d = c // heads
+    qkv = x @ qkv_w.T + qkv_b
+    q, k, v = np.split(qkv, 3, axis=-1)
+
+    def split(t):
+        return t.reshape(b, s, heads, d).transpose(0, 2, 1, 3)
+
+    q, k, v = split(q), split(k), split(v)
+    sc = np.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(d)
+    if mask is not None:
+        sc = sc + mask
+    if causal:
+        cm = np.tril(np.ones((s, s), bool))
+        sc = np.where(cm, sc, -1e30)
+    sc = sc - sc.max(-1, keepdims=True)
+    p = np.exp(sc)
+    p /= p.sum(-1, keepdims=True)
+    o = np.einsum("bhqk,bhkd->bhqd", p, v)
+    o = o.transpose(0, 2, 1, 3).reshape(b, s, c)
+    return o @ out_w.T + out_b
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("use_mask", [False, True])
+def test_multi_head_attention_matches_numpy(causal, use_mask):
+    rng = np.random.RandomState(0)
+    B, S, C, H = 2, 24, 32, 4
+    layer = nn.MultiHeadAttention(C, H, causal=causal)
+    layer.initialize(init=mx.initializer.Normal(0.1))
+    x = mx.nd.array(rng.randn(B, S, C).astype(np.float32))
+    mask = None
+    m_nd = None
+    if use_mask:
+        mask = np.zeros((B, 1, S, S), np.float32)
+        mask[:, :, :, S - 6:] = -1e9
+        m_nd = mx.nd.array(mask)
+    with autograd.predict_mode():
+        out = layer(x, m_nd)
+
+    get = lambda suffix: next(v.data().asnumpy() for k, v in
+                              layer.collect_params().items()
+                              if k.endswith(suffix))
+    ref = _mha_ref(x.asnumpy(), get("qkv_weight"), get("qkv_bias"),
+                   get("out_weight"), get("out_bias"), H,
+                   causal=causal, mask=mask)
+    np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_transformer_encoder_shapes_and_grad():
+    rng = np.random.RandomState(1)
+    enc = nn.TransformerEncoder(num_layers=2, units=32, hidden_size=64,
+                                num_heads=4, dropout=0.0)
+    enc.initialize(init=mx.initializer.Normal(0.05))
+    x = mx.nd.array(rng.randn(2, 16, 32).astype(np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = enc(x)
+        loss = (y * y).sum()
+    loss.backward()
+    assert y.shape == (2, 16, 32)
+    g = x.grad.asnumpy()
+    assert np.isfinite(g).all() and np.abs(g).max() > 0
+
+
+def test_bert_forward_and_hybridize():
+    rng = np.random.RandomState(2)
+    net = bert_base(vocab_size=200, max_length=32, num_layers=2, units=32,
+                    hidden_size=64, num_heads=4, dropout=0.0)
+    net.initialize(init=mx.initializer.Normal(0.02))
+    ids = mx.nd.array(rng.randint(0, 200, (2, 16)), dtype="int32")
+    tt = mx.nd.zeros((2, 16), dtype="int32")
+    with autograd.predict_mode():
+        seq_e, pooled_e = net(ids, tt)
+    net.hybridize()
+    with autograd.predict_mode():
+        seq_h, pooled_h = net(ids, tt)
+    assert seq_e.shape == (2, 16, 32) and pooled_e.shape == (2, 32)
+    np.testing.assert_allclose(seq_e.asnumpy(), seq_h.asnumpy(),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_bert_mlm_nsp_training_step():
+    rng = np.random.RandomState(3)
+    V = 100
+    net = bert_base(vocab_size=V, max_length=32, num_layers=1, units=32,
+                    hidden_size=64, num_heads=4, dropout=0.0)
+    mlm = BERTMLMHead(V, 32)
+    nsp = BERTNSPHead()
+    for b in (net, mlm, nsp):
+        b.initialize(init=mx.initializer.Normal(0.02))
+    params = {}
+    for b in (net, mlm, nsp):
+        params.update(b.collect_params())
+    trainer = gluon.Trainer(params, "adam", {"learning_rate": 1e-3})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    ids = mx.nd.array(rng.randint(0, V, (4, 16)), dtype="int32")
+    tt = mx.nd.zeros((4, 16), dtype="int32")
+    mlm_lab = mx.nd.array(rng.randint(0, V, (4, 16)), dtype="int32")
+    nsp_lab = mx.nd.array(rng.randint(0, 2, (4,)), dtype="int32")
+
+    losses = []
+    for _ in range(5):
+        with autograd.record():
+            seq, pooled = net(ids, tt)
+            l_mlm = loss_fn(mlm(seq).reshape((-1, V)), mlm_lab.reshape((-1,)))
+            l_nsp = loss_fn(nsp(pooled), nsp_lab)
+            loss = l_mlm.mean() + l_nsp.mean()
+        loss.backward()
+        trainer.step(4)
+        losses.append(float(loss.asnumpy()))
+    assert losses[-1] < losses[0], losses
+
+
+def test_bert_padding_mask_isolates_padding():
+    rng = np.random.RandomState(4)
+    net = bert_base(vocab_size=50, max_length=32, num_layers=2, units=32,
+                    hidden_size=64, num_heads=4, dropout=0.0)
+    net.initialize(init=mx.initializer.Normal(0.02))
+    ids = rng.randint(0, 50, (2, 16))
+    tt = mx.nd.zeros((2, 16), dtype="int32")
+    mask = np.zeros((2, 1, 16, 16), np.float32)
+    mask[:, :, :, 12:] = -1e9
+    m = mx.nd.array(mask)
+    with autograd.predict_mode():
+        s1, _ = net(mx.nd.array(ids, dtype="int32"), tt, m)
+        ids2 = ids.copy()
+        ids2[:, 12:] = 3
+        s2, _ = net(mx.nd.array(ids2, dtype="int32"), tt, m)
+    np.testing.assert_allclose(s1.asnumpy()[:, :12], s2.asnumpy()[:, :12],
+                               rtol=1e-6, atol=1e-6)
