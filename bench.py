@@ -197,8 +197,142 @@ def main_bert():
     }))
 
 
+def main_lstm():
+    """LSTM LM training step, tokens/sec/chip (BASELINE #4).
+
+    The classic MXNet word-LM config (example/rnn/word_lm on
+    WikiText-2): embed 650 → 2×LSTM(650) → tied-size decoder over a
+    33k vocab; fused scan RNN op (cuDNN-RNN analog). No reference
+    per-chip number (mount empty) — vs_baseline 0.0.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    _setup_cache()
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.block import functionalize
+
+    batch = int(os.environ.get("BENCH_BATCH", "32"))
+    seqlen = int(os.environ.get("BENCH_SEQLEN", "35"))
+    vocab, emb, hid, layers = 33278, 650, 650, 2
+    ctx = mx.current_context()
+
+    class WordLM(mx.gluon.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.embed = mx.gluon.nn.Embedding(vocab, emb)
+                self.rnn = mx.gluon.rnn.LSTM(hid, num_layers=layers,
+                                             layout="NTC")
+                self.decoder = mx.gluon.nn.Dense(vocab, flatten=False)
+
+        def hybrid_forward(self, F, x):
+            return self.decoder(self.rnn(self.embed(x)))
+
+    net = WordLM()
+    net.initialize(init=mx.initializer.Xavier(), ctx=ctx)
+    if DTYPE != "float32":
+        net.cast(DTYPE)
+    warm = mx.nd.zeros((2, seqlen), ctx=ctx, dtype="int32")
+    with mx.autograd.predict_mode():
+        net(warm)
+    fn, params = functionalize(net, training=True, ctx=ctx)
+
+    def loss_fn(params, rng, ids, labels):
+        logits = fn(params, rng, ids).astype(jnp.float32)
+        from mxnet_tpu.ops import pallas as _pallas
+        flat = logits.reshape(-1, vocab)
+        if _pallas.pallas_enabled():
+            loss = _pallas.softmax_xent_fused(flat, labels.reshape(-1))
+        else:
+            logp = jax.nn.log_softmax(flat, axis=-1)
+            loss = -jnp.take_along_axis(
+                logp, labels.reshape(-1)[:, None], axis=-1)[:, 0]
+        return loss.mean()
+
+    step = _make_momentum_sgd(loss_fn, 1.0)
+    moms = _zeros_moms(params)
+    rng = jax.random.PRNGKey(0)
+    npr = np.random.RandomState(0)
+    ids = jnp.asarray(npr.randint(0, vocab, (batch, seqlen)), jnp.int32)
+    labels = jnp.asarray(npr.randint(0, vocab, (batch, seqlen)), jnp.int32)
+
+    dt = _time_steps(step, params, moms, rng, ids, labels)
+
+    tok_per_sec = batch * seqlen * STEPS / dt
+    print(json.dumps({
+        "metric": "lstm_lm_train_tokens_per_sec_per_chip",
+        "value": round(tok_per_sec, 2),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": 0.0,
+    }))
+
+
+def main_widedeep():
+    """Wide&Deep CTR training, examples/sec/chip (BASELINE #5).
+
+    Criteo-shaped synthetic: 26 categorical fields + multi-hot wide
+    features + 13 continuous. The sparse showcase (reference
+    example/sparse/wide_deep); embedding gathers + fused MLP.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    _setup_cache()
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.block import functionalize
+    from mxnet_tpu.gluon.model_zoo import wide_deep
+
+    batch = int(os.environ.get("BENCH_BATCH", "2048"))
+    wide_dim, n_fields, field_dim = 100000, 26, 10000
+    n_wide, n_cont = 50, 13
+    ctx = mx.current_context()
+
+    net = wide_deep(wide_dim=wide_dim, num_fields=n_fields,
+                    field_dim=field_dim, embed_dim=16)
+    net.initialize(init=mx.initializer.Xavier(), ctx=ctx)
+
+    npr = np.random.RandomState(0)
+    warm = (mx.nd.zeros((2, n_wide), ctx=ctx, dtype="int32"),
+            mx.nd.zeros((2, n_fields), ctx=ctx, dtype="int32"),
+            mx.nd.zeros((2, n_cont), ctx=ctx))
+    with mx.autograd.predict_mode():
+        net(*warm)
+    fn, params = functionalize(net, training=True, ctx=ctx)
+
+    def loss_fn(params, rng, wx, cx, ct, y):
+        logits = fn(params, rng, wx, cx, ct).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.take_along_axis(logp, y[:, None], axis=-1).mean()
+
+    step = _make_momentum_sgd(loss_fn, 0.05)
+    moms = _zeros_moms(params)
+    rng = jax.random.PRNGKey(0)
+    wx = jnp.asarray(npr.randint(0, wide_dim, (batch, n_wide)), jnp.int32)
+    cx = jnp.asarray(npr.randint(0, field_dim, (batch, n_fields)), jnp.int32)
+    ct = jnp.asarray(npr.rand(batch, n_cont), jnp.float32)
+    y = jnp.asarray(npr.randint(0, 2, batch), jnp.int32)
+
+    dt = _time_steps(step, params, moms, rng, wx, cx, ct, y)
+
+    ex_per_sec = batch * STEPS / dt
+    print(json.dumps({
+        "metric": "wide_deep_train_examples_per_sec_per_chip",
+        "value": round(ex_per_sec, 2),
+        "unit": "examples/sec/chip",
+        "vs_baseline": 0.0,
+    }))
+
+
 if __name__ == "__main__":
-    if os.environ.get("BENCH_MODEL", "resnet50") == "bert":
+    _model = os.environ.get("BENCH_MODEL", "resnet50")
+    if _model == "bert":
         main_bert()
+    elif _model == "lstm":
+        main_lstm()
+    elif _model == "widedeep":
+        main_widedeep()
     else:
         main()

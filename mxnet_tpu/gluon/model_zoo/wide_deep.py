@@ -1,0 +1,68 @@
+"""Wide & Deep recommender (BASELINE config #5).
+
+Reference analog: example/sparse/wide_deep (the row_sparse +
+sparse-kvstore showcase: wide = sparse linear over multi-hot
+categorical features, deep = embeddings + MLP). TPU-native: the wide
+part is an embedding-sum (one gather + segment-sum — how the reference
+GPU path treats csr dot anyway), the deep part concatenated field
+embeddings into a fused MLP; large tables pair with
+Trainer.row_sparse_pull / lazy sparse optimizer updates.
+"""
+from __future__ import annotations
+
+from ..block import HybridBlock
+from ..nn import Dense, Embedding, HybridSequential
+
+__all__ = ["WideDeep", "wide_deep"]
+
+
+class WideDeep(HybridBlock):
+    """
+    Parameters
+    ----------
+    wide_dim : size of the wide (multi-hot) feature space
+    field_dims : vocab size per categorical field (deep part)
+    embed_dim : embedding width per field
+    hidden_units : MLP widths
+    num_classes : output classes (2 for CTR)
+    """
+
+    def __init__(self, wide_dim, field_dims, embed_dim=16,
+                 hidden_units=(256, 128, 64), num_classes=2,
+                 sparse_grad=True, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._num_fields = len(field_dims)
+        with self.name_scope():
+            # wide: linear weights as a (wide_dim, num_classes) table;
+            # a multi-hot sample is the sum of its active rows
+            self.wide = Embedding(wide_dim, num_classes,
+                                  sparse_grad=sparse_grad, prefix="wide_")
+            self.embeddings = []
+            for i, dim in enumerate(field_dims):
+                emb = Embedding(dim, embed_dim, sparse_grad=sparse_grad,
+                                prefix=f"embed{i}_")
+                self.register_child(emb)
+                self.embeddings.append(emb)
+            self.deep = HybridSequential(prefix="deep_")
+            with self.deep.name_scope():
+                for h in hidden_units:
+                    self.deep.add(Dense(h, activation="relu"))
+                self.deep.add(Dense(num_classes))
+
+    def hybrid_forward(self, F, wide_x, cat_x, cont_x=None):
+        """wide_x: (B, Nw) int multi-hot indices; cat_x: (B, F) one id
+        per field; cont_x: optional (B, C) continuous features."""
+        wide_out = F.sum(self.wide(wide_x), axis=1)      # (B, classes)
+        embs = [emb(F.slice_axis(cat_x, axis=1, begin=i, end=i + 1)
+                    .reshape((-1,)))
+                for i, emb in enumerate(self.embeddings)]
+        deep_in = F.concat(*embs, dim=-1)
+        if cont_x is not None:
+            deep_in = F.concat(deep_in, cont_x, dim=-1)
+        return wide_out + self.deep(deep_in)
+
+
+def wide_deep(wide_dim=100000, num_fields=26, field_dim=10000,
+              embed_dim=16, **kwargs):
+    return WideDeep(wide_dim, [field_dim] * num_fields,
+                    embed_dim=embed_dim, **kwargs)

@@ -237,3 +237,73 @@ def dot(lhs, rhs, transpose_a=False, transpose_b=False):
     if isinstance(rhs, BaseSparseNDArray):
         rhs = tostype_dense(rhs)
     return dense_dot(lhs, rhs, transpose_a=transpose_a, transpose_b=transpose_b)
+
+
+# ----------------------------------------------------------------------
+# Lazy sparse optimizer updates (reference optimizer_op.cc row_sparse
+# FComputeEx branches: SGDUpdateRspImpl / SGDMomLazyUpdateRspImpl /
+# AdamLazyUpdateRspImpl). Only the rows present in the row_sparse grad
+# are touched — on TPU these lower to one gather + fused math + one
+# scatter, which XLA keeps entirely on-chip.
+# ----------------------------------------------------------------------
+def _prep_sparse_grad(grad, rescale_grad, clip_gradient):
+    idx = grad._aux
+    g = grad._data * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return idx, g
+
+
+def sgd_update_rsp(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=None):
+    """weight[rows] -= lr * (g + wd * weight[rows]); other rows untouched."""
+    idx, g = _prep_sparse_grad(grad, rescale_grad, clip_gradient)
+    w = weight._data
+    rows = w[idx]
+    new = rows - lr * (g.astype(rows.dtype) + wd * rows)
+    weight._set_data(w.at[idx].set(new))
+    return weight
+
+
+def sgd_mom_update_rsp(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                       rescale_grad=1.0, clip_gradient=None,
+                       lazy_update=True):
+    """Lazy momentum: only touched rows decay their momentum (reference
+    SGDMomLazyUpdateRspImpl semantics when lazy_update=True)."""
+    idx, g = _prep_sparse_grad(grad, rescale_grad, clip_gradient)
+    w, m = weight._data, mom._data
+    rows_w, rows_m = w[idx], m[idx]
+    new_m = momentum * rows_m + g.astype(rows_w.dtype) + wd * rows_w
+    new_w = rows_w - lr * new_m
+    mom._set_data(m.at[idx].set(new_m))
+    weight._set_data(w.at[idx].set(new_w))
+    return weight
+
+
+def adam_update_rsp(weight, grad, mean, var, lr=0.001, beta1=0.9,
+                    beta2=0.999, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                    clip_gradient=None, lazy_update=True):
+    idx, g = _prep_sparse_grad(grad, rescale_grad, clip_gradient)
+    w, m, v = weight._data, mean._data, var._data
+    rows_w = w[idx]
+    g = g.astype(rows_w.dtype) + wd * rows_w
+    new_m = beta1 * m[idx] + (1.0 - beta1) * g
+    new_v = beta2 * v[idx] + (1.0 - beta2) * g * g
+    new_w = rows_w - lr * new_m / (jnp.sqrt(new_v) + epsilon)
+    mean._set_data(m.at[idx].set(new_m))
+    var._set_data(v.at[idx].set(new_v))
+    weight._set_data(w.at[idx].set(new_w))
+    return weight
+
+
+def adagrad_update_rsp(weight, grad, history, lr=0.01, epsilon=1e-7,
+                       wd=0.0, rescale_grad=1.0, clip_gradient=None):
+    idx, g = _prep_sparse_grad(grad, rescale_grad, clip_gradient)
+    w, h = weight._data, history._data
+    rows_w = w[idx]
+    g = g.astype(rows_w.dtype)
+    new_h = h[idx] + g * g
+    new_w = rows_w - lr * (g / jnp.sqrt(new_h + epsilon) + wd * rows_w)
+    history._set_data(h.at[idx].set(new_h))
+    weight._set_data(w.at[idx].set(new_w))
+    return weight

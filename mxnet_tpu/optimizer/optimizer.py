@@ -160,6 +160,18 @@ class SGD(Optimizer):
     def update(self, index, weight, grad, state):
         self._update_count(index)
         kw = self._common_kwargs(index)
+        from ..ndarray.sparse import RowSparseNDArray, sgd_update_rsp, \
+            sgd_mom_update_rsp
+
+        if isinstance(grad, RowSparseNDArray):
+            kw.pop("wd_lh", None)
+            if state is None:
+                sgd_update_rsp(weight, grad, **kw)
+            else:
+                sgd_mom_update_rsp(weight, grad, state,
+                                   momentum=self.momentum,
+                                   lazy_update=self.lazy_update, **kw)
+            return
         if state is None:
             _invoke(_get_op("sgd_update"), [weight, grad], kw, out=weight)
         else:
@@ -201,6 +213,12 @@ class Adam(Optimizer):
         kw["lr"] = kw["lr"] * math.sqrt(coef2) / coef1
         kw.update(beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon)
         mean, var = state
+        from ..ndarray.sparse import RowSparseNDArray, adam_update_rsp
+
+        if isinstance(grad, RowSparseNDArray):
+            adam_update_rsp(weight, grad, mean, var,
+                            lazy_update=self.lazy_update, **kw)
+            return
         _invoke(_get_op("adam_update"), [weight, grad, mean, var], kw, out=weight)
 
 
@@ -231,6 +249,11 @@ class AdaGrad(Optimizer):
         self._update_count(index)
         kw = self._common_kwargs(index)
         kw["epsilon"] = self.float_stable_eps
+        from ..ndarray.sparse import RowSparseNDArray, adagrad_update_rsp
+
+        if isinstance(grad, RowSparseNDArray):
+            adagrad_update_rsp(weight, grad, state, **kw)
+            return
         _invoke(_get_op("adagrad_update"), [weight, grad, state], kw, out=weight)
 
 
