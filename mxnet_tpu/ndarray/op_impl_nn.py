@@ -323,8 +323,7 @@ def softmax_cross_entropy(data, label):
 
     if (_pallas.pallas_enabled()
             and data.dtype in (jnp.float32, jnp.bfloat16, jnp.float16)):
-        loss = _pallas.softmax_xent_fused(data, label,
-                                          _pallas.interpret_mode())
+        loss = _pallas.softmax_xent_fused(data, label)
         return jnp.sum(loss).reshape(1).astype(data.dtype)
     logp = jax.nn.log_softmax(data, axis=-1)
     picked = jnp.take_along_axis(logp, label.astype(jnp.int32)[:, None], axis=-1)
@@ -376,8 +375,7 @@ def flash_attention_op(query, key, value, causal=False, sm_scale=None):
         # fallback below
         q_off = key.shape[2] - query.shape[2] if causal else 0
         return _pallas.flash_attention(query, key, value, sm_scale,
-                                       bool(causal), q_off,
-                                       _pallas.interpret_mode())
+                                       bool(causal), q_off)
     d = query.shape[-1]
     scale = sm_scale if sm_scale is not None else 1.0 / (d ** 0.5)
     s = jnp.einsum("bhqd,bhkd->bhqk",
@@ -428,8 +426,7 @@ def layer_norm(data, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False):
 
         if _pallas.pallas_enabled():
             return _pallas.layer_norm_fused(
-                data, gamma, beta, float(eps),
-                _pallas.interpret_mode())
+                data, gamma, beta, float(eps))
     mean = jnp.mean(data, axis=ax, keepdims=True)
     var = jnp.var(data, axis=ax, keepdims=True)
     x_hat = (data - mean) * lax.rsqrt(var + eps)

@@ -273,8 +273,11 @@ def sgd_mom_update_rsp(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
     idx, g = _prep_sparse_grad(grad, rescale_grad, clip_gradient)
     w, m = weight._data, mom._data
     rows_w, rows_m = w[idx], m[idx]
-    new_m = momentum * rows_m + g.astype(rows_w.dtype) + wd * rows_w
-    new_w = rows_w - lr * new_m
+    # lr-inside convention, matching the dense sgd_mom_update op (and the
+    # reference SGDMomLazyUpdateRspImpl) so momentum state stays
+    # interchangeable with the dense path under any lr schedule.
+    new_m = momentum * rows_m - lr * (g.astype(rows_w.dtype) + wd * rows_w)
+    new_w = rows_w + new_m
     mom._set_data(m.at[idx].set(new_m))
     weight._set_data(w.at[idx].set(new_w))
     return weight

@@ -23,26 +23,7 @@ exercised off-TPU (the numerics tests do this).
 """
 from __future__ import annotations
 
-import os
-
-import jax
-
-_TRUE = ("1", "true", "yes", "on")
-
-
-def pallas_enabled() -> bool:
-    """Should ops dispatch to the Pallas kernel path?"""
-    if os.environ.get("MXNET_TPU_DISABLE_PALLAS", "").lower() in _TRUE:
-        return False
-    if interpret_mode():
-        return True
-    return jax.default_backend() == "tpu"
-
-
-def interpret_mode() -> bool:
-    """Run pallas_call in interpreter mode (CPU testing of kernels)."""
-    return os.environ.get("MXNET_TPU_PALLAS_INTERPRET", "").lower() in _TRUE
-
+from ._util import interpret_mode, pallas_enabled  # noqa: F401
 
 from .layer_norm import layer_norm_fused  # noqa: E402
 from .flash_attention import flash_attention, flash_attention_with_lse  # noqa: E402

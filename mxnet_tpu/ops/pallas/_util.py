@@ -2,8 +2,32 @@
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
+
+_TRUE = ("1", "true", "yes", "on")
+
+
+def interpret_mode() -> bool:
+    """Run pallas_call in interpreter mode (CPU testing of kernels)."""
+    return os.environ.get("MXNET_TPU_PALLAS_INTERPRET", "").lower() in _TRUE
+
+
+def pallas_enabled() -> bool:
+    """Should ops dispatch to the Pallas kernel path?"""
+    if os.environ.get("MXNET_TPU_DISABLE_PALLAS", "").lower() in _TRUE:
+        return False
+    if interpret_mode():
+        return True
+    return jax.default_backend() == "tpu"
+
+
+def resolve_interpret(interpret):
+    """``interpret=None`` (the public-entry default) means "whatever
+    MXNET_TPU_PALLAS_INTERPRET says" — so call sites can't forget to
+    thread the flag and crash compiling Mosaic off-TPU."""
+    return interpret_mode() if interpret is None else interpret
 
 
 def x32(fn):

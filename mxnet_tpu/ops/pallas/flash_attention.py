@@ -8,8 +8,11 @@ blockwise online-softmax with the query block resident in VMEM, scores
 never leaving the chip.
 
 Also exports ``flash_attention_with_lse`` returning the per-row
-log-sum-exp, which is the combiner state ring attention needs
-(parallel/ring_attention.py merges per-ring-step (o, lse) pairs).
+log-sum-exp — the combiner state blockwise/ring schemes need. Note:
+parallel/ring_attention.py currently folds chunks with a pure-jnp
+online-softmax (differentiable through lax.scan) rather than this
+forward-only kernel; this entry point serves external combiners and
+golden tests.
 
 Shapes: q (B, H, Sq, D), k/v (B, H, Skv, D). ``q_offset`` is the
 global position of q row 0 relative to k row 0 (ring attention passes
@@ -28,7 +31,7 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from ._util import x32
+from ._util import resolve_interpret, x32
 
 _NEG_INF = -1e30
 
@@ -70,7 +73,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         m_prev = m_sc[:]
         m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         alpha = jnp.exp(m_prev - m_cur)
-        p = jnp.exp(s - m_cur)
+        # rows with no visible key yet keep m_cur at the -1e30 sentinel;
+        # exp(s - m_cur) would be exp(0)=1 there, polluting l/acc with an
+        # average of V. Force p (and alpha) to 0 until a real score lands.
+        seen = m_cur > _NEG_INF / 2
+        alpha = jnp.where(seen, alpha, 0.0)
+        p = jnp.where(seen, jnp.exp(s - m_cur), 0.0)
         l_sc[:] = l_sc[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
         v = v_ref[0].astype(jnp.float32)
         acc_sc[:] = acc_sc[:] * alpha + jax.lax.dot_general(
@@ -352,7 +360,7 @@ def _flash_bwd(q, k, v, o, lse, do, sm_scale, causal, q_offset, interpret,
 
 
 def flash_attention_with_lse(q, k, v, sm_scale=None, causal=False,
-                             q_offset=0, interpret=False):
+                             q_offset=0, interpret=None):
     """Forward-only flash attention returning (out, lse).
 
     lse has shape (B, H, Sq), fp32 — the ring-attention combiner state.
@@ -362,17 +370,17 @@ def flash_attention_with_lse(q, k, v, sm_scale=None, causal=False,
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
     return _flash_fwd(q, k, v, sm_scale, bool(causal), int(q_offset),
-                      interpret)
+                      resolve_interpret(interpret))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def flash_attention(q, k, v, sm_scale=None, causal=False, q_offset=0,
-                    interpret=False):
+                    interpret=None):
     """softmax(q k^T * scale [+causal mask]) v, blockwise in VMEM."""
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
     o, _ = _flash_fwd(q, k, v, sm_scale, bool(causal), int(q_offset),
-                      interpret)
+                      resolve_interpret(interpret))
     return o
 
 
@@ -380,7 +388,7 @@ def _flash_vjp_fwd(q, k, v, sm_scale, causal, q_offset, interpret):
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
     o, lse = _flash_fwd(q, k, v, sm_scale, bool(causal), int(q_offset),
-                        interpret)
+                        resolve_interpret(interpret))
     return o, (q, k, v, o, lse)
 
 
@@ -389,7 +397,7 @@ def _flash_vjp_bwd(sm_scale, causal, q_offset, interpret, res, do):
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
     dq, dk, dv = _flash_bwd(q, k, v, o, lse, do, sm_scale, bool(causal),
-                            int(q_offset), interpret)
+                            int(q_offset), resolve_interpret(interpret))
     return dq, dk, dv
 
 

@@ -21,7 +21,7 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from ._util import x32
+from ._util import resolve_interpret, x32
 
 
 def _pick_tile_r(n_rows: int, d: int) -> int:
@@ -140,13 +140,14 @@ def _ln_bwd(x2, gamma, mu, rs, dy2, interpret):
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def layer_norm_fused(x, gamma, beta, eps=1e-5, interpret=False):
+def layer_norm_fused(x, gamma, beta, eps=1e-5, interpret=None):
     """Fused LayerNorm over the last axis. Any leading shape."""
     out, _, _ = _ln_res(x, gamma, beta, eps, interpret)
     return out
 
 
 def _ln_res(x, gamma, beta, eps, interpret):
+    interpret = resolve_interpret(interpret)
     shape = x.shape
     d = shape[-1]
     x2 = x.reshape(-1, d)
@@ -160,6 +161,7 @@ def _layer_norm_vjp_fwd(x, gamma, beta, eps, interpret):
 
 
 def _layer_norm_vjp_bwd(eps, interpret, res, dy):
+    interpret = resolve_interpret(interpret)
     x, gamma, mu, rs = res
     shape = x.shape
     d = shape[-1]

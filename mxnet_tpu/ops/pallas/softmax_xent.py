@@ -17,7 +17,7 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from ._util import x32
+from ._util import resolve_interpret, x32
 
 _NEG_INF = -1e30
 
@@ -80,6 +80,7 @@ def _xent_fwd(logits, labels, interpret):
     """No explicit padding: Mosaic masks partial edge blocks (reads of
     the out-of-bounds tail are garbage but the kernel's col < v_len
     mask and the caller's row slice neutralize them)."""
+    interpret = resolve_interpret(interpret)
     n, v = logits.shape
     bn, bv = _blocks(n, v)
     lab = labels.astype(jnp.int32).reshape(n, 1)
@@ -115,6 +116,7 @@ def _xent_fwd(logits, labels, interpret):
 
 @x32
 def _xent_bwd(logits, labels, lse, g, interpret):
+    interpret = resolve_interpret(interpret)
     n, v = logits.shape
     bn, bv = _blocks(n, v)
     lab = labels.astype(jnp.int32).reshape(n, 1)
@@ -143,7 +145,7 @@ def _xent_bwd(logits, labels, lse, g, interpret):
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
-def softmax_xent_fused(logits, labels, interpret=False):
+def softmax_xent_fused(logits, labels, interpret=None):
     """Per-row -log softmax(logits)[labels]. logits (N, V), labels (N,)."""
     loss, _ = _xent_fwd(logits, labels, interpret)
     return loss

@@ -7,10 +7,12 @@ parallelism) — first-class here because the TPU mesh makes it natural:
 - :func:`ring_attention` — the sequence axis is sharded over a mesh
   axis; K/V chunks rotate around the ring via ``lax.ppermute`` (ICI
   neighbor exchanges) while each device folds incoming chunks into an
-  online-softmax accumulator (the flash-attention merge). Peak memory
-  per device is O(S·C) for the score blocks (C = S/P chunk), and with
-  ``remat=True`` (default) the score blocks are recomputed in backward
-  — the blockwise-attention memory profile.
+  online-softmax accumulator. The fold is a plain jnp einsum +
+  online-softmax update (NOT the Pallas flash kernel): it materializes
+  one (B, H, C, C) score block per ring step, so peak memory per
+  device is O(C^2) per (batch, head) — bounded by the chunk size, not
+  the global sequence. ``remat=True`` (default) recomputes the score
+  blocks in backward.
 - :func:`ulysses_attention` — all-to-all over the mesh axis re-shards
   (B, H, S/P, D) → (B, H/P, S, D) so each device computes full-sequence
   attention for a head subset (single flash kernel call on TPU), then

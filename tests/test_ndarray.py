@@ -189,3 +189,37 @@ def test_iter_len():
     assert len(a) == 3
     rows = list(a)
     assert rows[1].shape == (2,)
+
+
+def test_sgd_mom_update_rsp_matches_dense():
+    # Sparse lazy momentum must use the same lr-inside convention as the
+    # dense sgd_mom_update op, so momentum state is interchangeable and
+    # trajectories agree on touched rows under an lr schedule
+    # (ADVICE r1: sparse.py used lr-outside and diverged).
+    from mxnet_tpu.ndarray.sparse import row_sparse_array, sgd_mom_update_rsp
+
+    rng = np.random.RandomState(7)
+    n, d = 10, 4
+    w0 = rng.randn(n, d).astype(np.float32)
+    m0 = rng.randn(n, d).astype(np.float32)
+    rows = np.array([1, 4, 7])
+    g = rng.randn(len(rows), d).astype(np.float32)
+
+    w_s = nd.array(w0.copy())
+    m_s = nd.array(m0.copy())
+    grad = row_sparse_array((g, rows), shape=(n, d))
+    w_d = nd.array(w0[rows].copy())
+    m_d = nd.array(m0[rows].copy())
+
+    for lr in (0.1, 0.03):  # schedule: convention mismatch shows up here
+        sgd_mom_update_rsp(w_s, grad, m_s, lr=lr, momentum=0.9, wd=0.01)
+        nd.sgd_mom_update(w_d, nd.array(g), m_d, lr=lr, momentum=0.9,
+                          wd=0.01, out=w_d)
+
+    assert_almost_equal(w_s.asnumpy()[rows], w_d.asnumpy(), rtol=1e-6,
+                        atol=1e-6)
+    assert_almost_equal(m_s.asnumpy()[rows], m_d.asnumpy(), rtol=1e-6,
+                        atol=1e-6)
+    untouched = np.setdiff1d(np.arange(n), rows)
+    assert (w_s.asnumpy()[untouched] == w0[untouched]).all()
+    assert (m_s.asnumpy()[untouched] == m0[untouched]).all()

@@ -81,6 +81,43 @@ def test_flash_attention_fwd_bwd(causal, sq, skv):
                                    rtol=1e-4, atol=1e-4)
 
 
+def test_flash_attention_fully_masked_rows():
+    # sq > skv causal (negative q_offset — the KV-cache shape
+    # op_impl_nn.flash_attention_op produces): rows before the first
+    # visible key must return exactly zero (not an average of V), with
+    # lse at the -inf sentinel and zero gradients through those rows.
+    rng = np.random.RandomState(4)
+    B, H, sq, skv, D = 1, 2, 120, 48, 32
+    q = jnp.asarray(rng.randn(B, H, sq, D).astype(np.float32)) * 0.3
+    k = jnp.asarray(rng.randn(B, H, skv, D).astype(np.float32)) * 0.3
+    v = jnp.asarray(rng.randn(B, H, skv, D).astype(np.float32))
+    q_off = skv - sq
+    nm = sq - skv  # rows 0..nm-1 see no keys
+
+    o, lse = flash_attention_with_lse(q, k, v, causal=True,
+                                      q_offset=q_off, interpret=True)
+    assert np.all(np.asarray(o[:, :, :nm]) == 0.0)
+    assert np.all(np.asarray(lse[:, :, :nm]) <= -1e29)
+
+    # visible region matches the jnp fallback (op_impl_nn masking)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(D)
+    m = jnp.tril(jnp.ones((sq, skv), bool), k=skv - sq)
+    p = jax.nn.softmax(jnp.where(m, s, -1e30), -1)
+    p = jnp.where(m.any(-1, keepdims=True), p, 0.0)
+    ref = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    np.testing.assert_allclose(np.asarray(o[:, :, nm:]),
+                               np.asarray(ref[:, :, nm:]),
+                               rtol=1e-4, atol=1e-5)
+
+    w = jnp.asarray(rng.randn(B, H, sq, D).astype(np.float32))
+    g = jax.grad(lambda q, k, v: (flash_attention(
+        q, k, v, None, True, q_off, True) * w).sum(),
+        argnums=(0, 1, 2))(q, k, v)
+    assert np.all(np.asarray(g[0][:, :, :nm]) == 0.0)
+    for a in g:
+        assert np.all(np.isfinite(np.asarray(a)))
+
+
 def test_flash_attention_lse():
     rng = np.random.RandomState(2)
     B, H, S, D = 1, 2, 100, 32
