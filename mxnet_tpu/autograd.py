@@ -26,6 +26,7 @@ distinct value node and cannot corrupt earlier gradients.
 from __future__ import annotations
 
 import threading
+import weakref
 from typing import Optional
 
 import jax
@@ -50,13 +51,19 @@ __all__ = [
 
 
 class _TapeNode:
-    __slots__ = ("op_name", "in_keys", "in_arrays", "out_keys", "vjp_fn", "raw_multi", "n_raw_out", "out_shapes")
+    __slots__ = ("op_name", "in_keys", "in_arrays", "out_keys", "out_refs",
+                 "vjp_fn", "raw_multi", "n_raw_out", "out_shapes")
 
-    def __init__(self, op_name, in_keys, in_arrays, out_keys, vjp_fn, raw_multi, n_raw_out, out_shapes):
+    def __init__(self, op_name, in_keys, in_arrays, out_keys, out_refs,
+                 vjp_fn, raw_multi, n_raw_out, out_shapes):
         self.op_name = op_name
         self.in_keys = in_keys        # [(key, ndarray-or-None), ...] aligned w/ vjp positionals
         self.in_arrays = in_arrays    # NDArray refs (leaves need .grad writes)
         self.out_keys = out_keys
+        self.out_refs = out_refs      # weakrefs to output NDArrays: a node
+        # whose outputs were ALL collected is unreachable (consumers hold
+        # strong input refs) — pruned at the next backward; this also
+        # prevents a recycled id() from colliding with a stale out_key.
         self.vjp_fn = vjp_fn
         self.raw_multi = raw_multi
         self.n_raw_out = n_raw_out
@@ -151,13 +158,16 @@ def _record_op(op, inputs, outputs, vjp_fn, raw_multi, n_raw_out,
         else:
             in_arrays.append(None)
     out_keys = []
+    out_refs = []
     for o in outputs:
         o._in_graph = True
         out_keys.append(_key(o))
+        out_refs.append(weakref.ref(o))
     # raw outputs may exceed visible outputs (e.g. BatchNorm aux); vjp
     # needs cotangents for all of them — remember avals for zero-fill.
     _STATE.tape.append(
-        _TapeNode(op.name, in_keys, in_arrays, out_keys, vjp_fn, raw_multi, n_raw_out, raw_avals)
+        _TapeNode(op.name, in_keys, in_arrays, out_keys, out_refs, vjp_fn,
+                  raw_multi, n_raw_out, raw_avals)
     )
 
 
@@ -200,10 +210,18 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
     tape = _STATE.tape
     touched_leaves = []
     leaf_slots: dict = {}  # id(leaf) → set of tape value-keys it fed
+    used_nodes: set = set()  # nodes this sweep consumed (freed below)
     for node in reversed(tape):
-        outs_cot = [cot.get(k) for k in node.out_keys]
+        if all(r() is None for r in node.out_refs):
+            # every output collected → no live head/consumer can reach
+            # this node; prune it (and never match a recycled id)
+            used_nodes.add(id(node))
+            continue
+        outs_cot = [cot.get(k) if r() is not None else None
+                    for k, r in zip(node.out_keys, node.out_refs)]
         if all(c is None for c in outs_cot):
             continue
+        used_nodes.add(id(node))
         # assemble cotangent structure matching the vjp output structure
         if node.raw_multi:
             # visible outputs lead; hidden raw outputs get zeros. We can
@@ -223,11 +241,21 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
             in_cots = node.vjp_fn(tuple(cots))
         else:
             in_cots = node.vjp_fn(outs_cot[0])
-        for slot, g in zip(node.in_keys, in_cots):
+        for slot, g, x in zip(node.in_keys, in_cots, node.in_arrays):
             if slot is None or g is None:
                 continue
             if getattr(g, "dtype", None) == jax.dtypes.float0:
                 continue  # integer-typed input (indices): no gradient
+            if x is not None and getattr(x, "_released", False):
+                # the producer subgraph of this value was freed by an
+                # earlier backward — continuing would yield silently
+                # partial gradients. Mirror the reference's error
+                # (Imperative::Backward on released AGInfo).
+                raise MXNetError(
+                    f"cannot differentiate through input of op "
+                    f"'{node.op_name}': its computation history was "
+                    "already freed by a previous backward; pass "
+                    "retain_graph=True to keep it")
             cot[slot] = cot[slot] + g if slot in cot else g
         for slot, x in zip(node.in_keys, node.in_arrays):
             if x is not None and getattr(x, "_is_leaf", False):
@@ -258,7 +286,22 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
             x._grad._set_data(jnp.asarray(g, x._grad.dtype))
 
     if not retain_graph:
-        _STATE.tape = []
+        # free only the subgraph this sweep consumed: other heads recorded
+        # in the same scope (the per-device losses of a DP step — the
+        # reference's `for l in losses: l.backward()` pattern) keep their
+        # nodes until their own backward runs. Outputs of freed nodes are
+        # marked released so a later backward that reaches one raises
+        # instead of silently dropping the upstream gradient. Arrays a
+        # user keeps alive without ever calling backward keep their nodes
+        # (same retention as the reference's per-array AGInfo); dropped
+        # arrays are pruned at the next sweep via the weakrefs.
+        for n in tape:
+            if id(n) in used_nodes:
+                for r in n.out_refs:
+                    o = r()
+                    if o is not None:
+                        o._released = True
+        _STATE.tape = [n for n in _STATE.tape if id(n) not in used_nodes]
 
 
 def _fill_zeros(node, cots):

@@ -139,16 +139,26 @@ class Trainer:
     def _allreduce_grads(self):
         if self._kvstore is None:
             return
+        if self._update_on_kvstore:
+            for i, param in enumerate(self._params):
+                if param.grad_req != "null":
+                    # push grads; optimizer runs in kvstore; pull weights
+                    self._kvstore.push(i, param.list_grad())
+            return
+        # batch every key into ONE fused pushpull: the kvstore reduces the
+        # whole gradient set in a single compiled XLA computation (the
+        # kvstore_nccl.h fused-pushpull analog; bucketing is the
+        # compiler's all-reduce combiner). Key order is the stable param
+        # index order — identical on every worker by construction.
+        keys, grads = [], []
         for i, param in enumerate(self._params):
             if param.grad_req != "null":
-                grads = param.list_grad()
-                if self._update_on_kvstore:
-                    # push grads; optimizer runs in kvstore; pull weights
-                    self._kvstore.push(i, grads)
-                else:
-                    if len(grads) > 1 or self._kvstore.num_workers > 1:
-                        self._kvstore.push(i, grads)
-                        self._kvstore.pull(i, grads, ignore_sparse=False)
+                g = param.list_grad()
+                if len(g) > 1 or self._kvstore.num_workers > 1:
+                    keys.append(i)
+                    grads.append(g)
+        if keys:
+            self._kvstore.pushpull(keys, grads, out=grads)
 
     def update(self, batch_size, ignore_stale_grad=False):
         if not self._kv_initialized:

@@ -37,6 +37,7 @@ import numpy as np
 from .base import MXNetError
 from .ndarray import NDArray
 from .ndarray.ndarray import _wrap
+from .parallel import comm as _allreduce
 
 __all__ = ["KVStore", "create"]
 
@@ -101,8 +102,59 @@ class KVStore:
                 stored.copyto(dst)
 
     def pushpull(self, key, value, out=None, priority=0):
+        """Fused push+pull (reference MXKVStorePushPullEx / the NCCL
+        fused-pushpull of kvstore_nccl.h). When no server-side updater is
+        set, ALL keys reduce in ONE compiled XLA computation
+        (parallel/comm.py) whose all-reduces the compiler buckets —
+        Trainer.step dispatches exactly one executable per step."""
+        keys, values = _normalize(key, value)
+        outs = values if out is None else _normalize(key, out)[1]
+        if self._updater is None and self._try_fused_pushpull(keys, values, outs):
+            return
         self.push(key, value, priority)
         self.pull(key, out if out is not None else value, priority)
+
+    # -- fused reduce fast path -------------------------------------------
+    def _reduce_devices(self, value_lists):
+        """Participating device tuple for the fused reduce, or None when
+        the layout doesn't qualify. Single-process: the devices of the
+        per-context replicas (must agree across keys)."""
+        if not _allreduce.can_fast_reduce(value_lists):
+            return None
+        devs = tuple(v.device for v in value_lists[0])
+        return devs if len(devs) > 1 else None
+
+    def _try_fused_pushpull(self, keys, values, outs) -> bool:
+        from .ndarray import sparse as _sp
+        vlists = []
+        for v in values:
+            vs = v if isinstance(v, (list, tuple)) else [v]
+            if any(isinstance(a, _sp.BaseSparseNDArray) for a in vs):
+                return False
+            vlists.append([a._data for a in vs])
+        devices = self._reduce_devices(vlists)
+        if devices is None:
+            return False
+        # every read-back target must sit inside the reduce mesh; a
+        # stored value or out on a foreign device takes the copyto path
+        devset = set(devices)
+        for k, o in zip(keys, outs):
+            if self._get(k)._data.device not in devset:
+                return False
+            for dst in (o if isinstance(o, (list, tuple)) else [o]):
+                if dst._data.device not in devset:
+                    return False
+        reduced = _allreduce.reduce_replica_lists(vlists, devices=devices)
+        for k, garr, o in zip(keys, reduced, outs):
+            stored = self._get(k)
+            sh = _allreduce.shard_for_device(garr, stored._data.device)
+            stored._set_data(sh.astype(stored._data.dtype)
+                             if sh.dtype != stored._data.dtype else sh)
+            for dst in (o if isinstance(o, (list, tuple)) else [o]):
+                sh = _allreduce.shard_for_device(garr, dst._data.device)
+                dst._set_data(sh.astype(dst._data.dtype)
+                              if sh.dtype != dst._data.dtype else sh)
+        return True
 
     def broadcast(self, key, value, out=None, priority=0):
         self.init(key, value)
@@ -111,29 +163,33 @@ class KVStore:
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
         """Pull only the requested rows (sparse embedding path —
-        reference kvstore sparse pull; here a gather)."""
+        reference kvstore sparse pull, src/kvstore/kvstore_local.h
+        unique-rowid merge). TPU-native: sort/unique/gather run
+        ON-DEVICE (XLA); the only host sync is the unique count that
+        sizes the row_sparse result — no value round-trips through
+        numpy (the Wide&Deep hot loop stays on-chip)."""
         import jax.numpy as jnp
         from .ndarray import sparse as _sp
         keys, outs = _normalize(key, out)
         _, rids = _normalize(key, row_ids)
         for k, o, r in zip(keys, outs, rids):
             stored = self._get(k)
-            dense = stored.todense().asnumpy() \
-                if isinstance(stored, _sp.BaseSparseNDArray) else stored.asnumpy()
+            dense = stored.todense()._data \
+                if isinstance(stored, _sp.BaseSparseNDArray) else stored._data
             dsts = o if isinstance(o, (list, tuple)) else [o]
             rows = r if isinstance(r, (list, tuple)) else [r] * len(dsts)
             for dst, rid in zip(dsts, rows):
-                ids = rid.asnumpy().astype(np.int64).reshape(-1)
-                uniq = np.unique(ids)
+                ids = rid._data.reshape(-1).astype(jnp.int64)
+                uniq = jnp.unique(ids)
+                picked = jnp.take(dense, uniq, axis=0)
                 if isinstance(dst, _sp.RowSparseNDArray):
                     # rebuild the row_sparse triple in place
-                    dst._data = jnp.asarray(dense[uniq], dst._data.dtype)
-                    dst._aux = jnp.asarray(uniq, jnp.int64)
+                    dst._data = picked.astype(dst._data.dtype)
+                    dst._aux = uniq
                     dst._version += 1
                 else:
                     full = jnp.zeros(stored.shape, dst.dtype)
-                    full = full.at[jnp.asarray(uniq)].set(
-                        jnp.asarray(dense[uniq], dst.dtype))
+                    full = full.at[uniq].set(picked.astype(dst.dtype))
                     dst._set_data(full)
 
     # -- optimizer / updater ----------------------------------------------
@@ -171,11 +227,18 @@ class KVStore:
         return self._store[k]
 
     def _reduce(self, arrays):
-        """Sum per-device values. The jitted add tree is XLA's problem;
-        with sharded inputs it lowers to an ICI AllReduce (the
-        CommDevice/NCCL analog)."""
+        """Sum per-device values — a single compiled stacked-sum whose
+        output sharding is replicated, which the XLA SPMD partitioner
+        lowers to an ICI AllReduce (the CommDevice/NCCL analog)."""
         merged = arrays[0]
         if len(arrays) > 1:
+            datas = [a._data for a in arrays]
+            devices = self._reduce_devices([datas])
+            if devices is not None:
+                garr = _allreduce.reduce_replica_lists([datas], devices=devices)[0]
+                return _wrap(_allreduce.shard_for_device(garr, datas[0].device),
+                             merged.ctx)
+            # fallback: replicas sharing a device (tests) — eager add tree
             ctx = merged.ctx
             acc = merged._data
             for a in arrays[1:]:
@@ -213,11 +276,30 @@ class DistKVStore(KVStore):
     def num_workers(self):
         return jax.process_count() if self._initialized else 1
 
-    def _reduce(self, arrays):
-        merged = super()._reduce(arrays)
+    def _reduce_devices(self, value_lists):
+        """Cross-process fused reduce: when every process's local arrays
+        cover exactly its addressable devices, the global device list
+        forms the 1-D reduce mesh and the compiled sum IS the DCN/ICI
+        AllReduce (every worker runs the same SPMD program — no server,
+        no host gather)."""
         if self.num_workers > 1:
-            merged = _cross_process_allreduce(merged)
-        return merged
+            if not _allreduce.can_fast_reduce(value_lists):
+                return None
+            if len(value_lists[0]) == jax.local_device_count():
+                return tuple(jax.devices())
+            return None
+        return super()._reduce_devices(value_lists)
+
+    def _reduce(self, arrays):
+        if self.num_workers > 1:
+            datas = [a._data for a in arrays]
+            devices = self._reduce_devices([datas])
+            if devices is not None:
+                garr = _allreduce.reduce_replica_lists([datas], devices=devices)[0]
+                return _wrap(_allreduce.shard_for_device(garr, datas[0].device),
+                             arrays[0].ctx)
+            return _cross_process_allreduce(super()._reduce(arrays))
+        return super()._reduce(arrays)
 
     def barrier(self):
         """_barrier analog (ps-lite Barrier): sync all workers."""

@@ -49,7 +49,7 @@ class NDArray:
 
     __slots__ = (
         "_data", "_ctx", "_version", "_grad", "_grad_req", "_is_leaf",
-        "_in_graph", "__weakref__",
+        "_in_graph", "_released", "__weakref__",
     )
 
     # numpy should defer binary-op dispatch to us
@@ -65,6 +65,7 @@ class NDArray:
         self._grad_req = "null"
         self._is_leaf = False
         self._in_graph = False
+        self._released = False
 
     # ------------------------------------------------------------------
     # internal plumbing

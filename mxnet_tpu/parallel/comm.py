@@ -1,0 +1,131 @@
+"""Jitted bucketed gradient allreduce — the CommDevice/NCCL analog.
+
+Reference behavior being replaced (SURVEY §2.1/§3.2): KVStore 'device'
+reduces per-GPU gradients with a P2P add tree (src/kvstore/comm.h
+CommDevice) and 'nccl' with ncclAllReduce (kvstore_nccl.h), both fusing
+many small tensors into buckets. TPU-first redesign: the per-context
+gradient replicas of one logical parameter already live on distinct
+chips, so we view them as ONE global array whose leading "replica" axis
+is sharded over a 1-D device mesh, and compile `sum(axis=0)` with a
+replicated output sharding. The XLA SPMD partitioner turns that into an
+ICI/DCN AllReduce, and its all-reduce combiner pass fuses the reduces
+of every parameter in the bucket — the NCCL-bucketing analog, but done
+by the compiler.
+
+One AOT-compiled executable is cached per (device tuple, shapes/dtypes)
+structure — the whole parameter set is one bucket, so Trainer.step
+dispatches ONE compiled computation per step regardless of param count.
+Multi-process (DistKVStore) uses the same mechanism over the global
+device list: every process contributes its local shards and executes
+the same SPMD program, which is exactly jax multihost jit semantics.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["reduce_replica_lists", "can_fast_reduce", "last_hlo_text"]
+
+# (devices, shapes/dtypes) -> (executable, stack_sharding, hlo text)
+_CACHE: dict = {}
+_LAST_HLO: list = [None]
+
+
+def last_hlo_text():
+    """HLO of the most recently used reduce executable (test hook: the
+    multi-device tests assert an all-reduce is in the compiled text)."""
+    return _LAST_HLO[0]
+
+
+def can_fast_reduce(value_lists: Sequence[Sequence]) -> bool:
+    """True when every key's per-context arrays sit on the same tuple of
+    distinct devices (the Trainer layout) — the jitted stacked-psum path
+    applies. Single-element lists are fine (pure multi-process reduce).
+    """
+    if not value_lists:
+        return False
+    dev0 = None
+    for vlist in value_lists:
+        devs = tuple(v.device for v in vlist)
+        if len(set(devs)) != len(devs):
+            return False
+        if dev0 is None:
+            dev0 = devs
+        elif devs != dev0:
+            return False
+    return True
+
+
+def _build(devices, shapes_dtypes):
+    mesh = Mesh(np.asarray(devices), ("dp",))
+    stack_sh = NamedSharding(mesh, P("dp"))
+    repl_sh = NamedSharding(mesh, P())
+
+    def reduce_all(stacked):
+        return [x.sum(axis=0) for x in stacked]
+
+    avals = [jax.ShapeDtypeStruct((len(devices),) + tuple(s), d,
+                                  sharding=stack_sh)
+             for s, d in shapes_dtypes]
+    lowered = jax.jit(
+        reduce_all, out_shardings=[repl_sh] * len(shapes_dtypes)).lower(avals)
+    compiled = lowered.compile()
+    try:
+        hlo = compiled.as_text()
+    except Exception:
+        hlo = ""
+    return compiled, stack_sh, hlo
+
+
+def reduce_replica_lists(value_lists, devices=None):
+    """Sum each key's per-device replica arrays in ONE compiled call.
+
+    value_lists: list (over keys) of lists of same-shape jax.Arrays,
+    each inner list holding one array per device of ``devices`` (order
+    irrelevant — arrays are matched to mesh positions by .device).
+    devices: the participating device tuple; defaults to the devices of
+    the first list (single-process). For multi-process reduce pass the
+    GLOBAL device list — local arrays are the addressable shards.
+
+    Returns a list of globally-replicated jax.Arrays (one per key);
+    read per-device copies off ``.addressable_shards``.
+    """
+    if devices is None:
+        devices = tuple(a.device for a in value_lists[0])
+    devices = tuple(devices)
+    n = len(devices)
+    shapes_dtypes = tuple(
+        (tuple(v[0].shape), jnp.dtype(v[0].dtype)) for v in value_lists)
+    key = (devices, shapes_dtypes)
+    entry = _CACHE.get(key)
+    if entry is None:
+        entry = _build(devices, shapes_dtypes)
+        _CACHE[key] = entry
+    compiled, stack_sh, hlo = entry
+    _LAST_HLO[0] = hlo
+
+    stacked = []
+    for vlist, (shape, dtype) in zip(value_lists, shapes_dtypes):
+        # device_put commits an (possibly uncommitted) array to its own
+        # device so the reshape below cannot migrate it to the default
+        # device (no copy is made for an already-resident buffer).
+        shards = [jax.device_put(v, v.device).reshape((1,) + shape)
+                  for v in vlist]
+        stacked.append(jax.make_array_from_single_device_arrays(
+            (n,) + shape, stack_sh, shards))
+    return compiled(stacked)
+
+
+def shard_for_device(garr, device):
+    """The addressable shard of a replicated global array on ``device``
+    (zero-copy view — this is how reduced gradients get written back
+    into each context's NDArray)."""
+    for s in garr.addressable_shards:
+        if s.data.device == device:
+            return s.data
+    raise ValueError(f"device {device} not addressable in {garr.sharding}")

@@ -200,3 +200,52 @@ def test_sgd_update_inplace_during_record():
     # in-place update outside record
     nd.sgd_update(w, w.grad, lr=0.1, out=w)
     assert_almost_equal(w, np.array([1.0, 2.0]) - 0.1 * old_grad)
+
+
+def test_multi_head_disjoint_backward():
+    """`for l in losses: l.backward()` (the DP pattern): disjoint heads
+    recorded in one scope each get a full, correct sweep."""
+    x1 = nd.array([1.0, 2.0])
+    x2 = nd.array([3.0, 4.0])
+    x1.attach_grad()
+    x2.attach_grad()
+    with autograd.record():
+        l1 = (x1 * x1).sum()
+        l2 = (x2 * 3.0).sum()
+    l1.backward()
+    l2.backward()
+    assert np.allclose(x1.grad.asnumpy(), [2.0, 4.0])
+    assert np.allclose(x2.grad.asnumpy(), [3.0, 3.0])
+
+
+def test_second_backward_through_freed_subgraph_raises():
+    """Two heads SHARING a subgraph: the first non-retain backward frees
+    the shared nodes; the second must raise (reference
+    Imperative::Backward on released AGInfo) — never silently return a
+    partial gradient."""
+    import pytest
+    from mxnet_tpu.base import MXNetError
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2.0
+        l1 = (y * 3.0).sum()
+        l2 = (y * 5.0).sum()
+    l1.backward()
+    with pytest.raises(MXNetError, match="already freed"):
+        l2.backward()
+
+
+def test_shared_subgraph_retain_graph():
+    """retain_graph=True keeps the shared subgraph usable for the
+    second head."""
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2.0
+        l1 = (y * 3.0).sum()
+        l2 = (y * 5.0).sum()
+    l1.backward(retain_graph=True)
+    assert np.allclose(x.grad.asnumpy(), [6.0, 6.0])
+    l2.backward()
+    assert np.allclose(x.grad.asnumpy(), [10.0, 10.0])
