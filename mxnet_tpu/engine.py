@@ -24,6 +24,7 @@ from __future__ import annotations
 import collections
 import os
 import threading
+import weakref
 
 import jax
 
@@ -36,7 +37,10 @@ class Engine:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._inflight = collections.deque(maxlen=256)
+        # weakrefs, unbounded: WaitForAll must cover EVERY in-flight
+        # buffer (the old 256-cap deque silently forgot older work);
+        # collected arrays cost nothing and are dropped at the next wait
+        self._inflight = collections.deque()
         self.sync = os.environ.get("MXNET_ENGINE_TYPE", "") == "NaiveEngine"
 
     # -- dispatch hooks (called by the op dispatch layer) ------------------
@@ -52,8 +56,20 @@ class Engine:
             for a in arrays:
                 jax.block_until_ready(a)
         else:
+            refs = []
+            for a in arrays:
+                try:
+                    refs.append(weakref.ref(a))
+                except TypeError:  # non-weakrefable value (scalar)
+                    pass
             with self._lock:
-                self._inflight.extend(arrays)
+                self._inflight.extend(refs)
+                # amortized compaction: drop collected buffers so a loop
+                # that never calls waitall() can't grow the queue without
+                # bound (live work is always kept)
+                if len(self._inflight) > 4096:
+                    self._inflight = collections.deque(
+                        r for r in self._inflight if r() is not None)
 
     # -- sync points -------------------------------------------------------
     def wait_for_var(self, array):
@@ -65,11 +81,10 @@ class Engine:
         with self._lock:
             pending = list(self._inflight)
             self._inflight.clear()
-        for a in pending:
-            try:
+        for ref in pending:
+            a = ref()
+            if a is not None:
                 jax.block_until_ready(a)
-            except Exception:
-                raise
 
     def set_sync(self, flag: bool):
         self.sync = bool(flag)

@@ -1,16 +1,18 @@
 """Executor — bound symbolic computation.
 
 Analog of the reference GraphExecutor (src/executor/graph_executor.cc)
-+ python/mxnet/executor.py. Where the reference runs nnvm passes
-(InferShape/PlanMemory/attach_op_execs) at bind time and pushes cached
-opr segments to the engine per call, here ``forward`` evaluates the
-Symbol DAG through the imperative dispatch layer under the autograd
-tape, and ``backward`` replays it — XLA's async dispatch + fusion play
-the role of the engine + memory planner. (The jit-compiled whole-graph
-path lives in Gluon ``hybridize``/CachedOp, matching the reference
-split between Module and Gluon.)
++ python/mxnet/executor.py. Bind-time compilation parity: ``forward``
+traces the whole Symbol DAG into ONE jitted XLA computation per
+(shapes, dtypes, training) key — the SimpleBind memory-plan/compile
+analog — and dispatches it through the op layer so autograd tapes the
+single fused computation (its pullback is the compiled backward graph).
+XLA's fusion + buffer planner replace nnvm PlanMemory; set
+``MXNET_TPU_SYMBOLIC_JIT=0`` to fall back to the eager per-op DAG walk
+(the NaiveEngine-style debug ladder).
 """
 from __future__ import annotations
+
+import os
 
 from .base import MXNetError
 from .context import current_context
@@ -20,7 +22,7 @@ __all__ = ["Executor"]
 
 class Executor:
     def __init__(self, symbol, ctx, args, args_grad=None, grad_req="write",
-                 aux_states=None):
+                 aux_states=None, _graph_cache=None):
         from . import ndarray as nd
 
         self._symbol = symbol
@@ -45,6 +47,45 @@ class Executor:
         self.outputs = []
         self._monitor_callback = None
         self._recording = False
+        self._jit = os.environ.get("MXNET_TPU_SYMBOLIC_JIT", "1") == "1"
+        # (shape/dtype/training key) -> Op wrapping the jitted graph fn;
+        # shared across reshape()-derived executors (BucketingModule: one
+        # compiled computation per bucket, nothing re-allocated)
+        self._graph_cache = _graph_cache if _graph_cache is not None else {}
+
+    def _graph_op(self, names, arrays, training):
+        """The compiled-graph Op for this binding signature."""
+        import jax
+
+        from .ndarray.register import Op
+        from . import random as _random
+        from . import autograd
+
+        key = (training,
+               tuple((n, tuple(a.shape), str(a.dtype))
+                     for n, a in zip(names, arrays)))
+        op = self._graph_cache.get(key)
+        if op is not None:
+            return op
+        sym = self._symbol
+        nm = tuple(names)
+
+        def graph_fn(rng_key, *arrs):
+            _random.push_trace_key(rng_key)
+            prev_t = autograd.set_training(training)
+            prev_r = autograd.set_recording(False)
+            try:
+                outs = sym._eval_raw(dict(zip(nm, arrs)))
+            finally:
+                autograd.set_recording(prev_r)
+                autograd.set_training(prev_t)
+                _random.pop_trace_key()
+            return tuple(outs)
+
+        op = Op(f"GraphExecutor_{sym.name or 'sym'}", jax.jit(graph_fn),
+                differentiable=True)
+        self._graph_cache[key] = op
+        return op
 
     @property
     def symbol(self):
@@ -69,17 +110,40 @@ class Executor:
                     arr._grad = self.grad_dict.get(n)
                     arr._grad_req = req
                     arr._is_leaf = True
+        if self._jit:
+            self.outputs = self._forward_jit(is_train)
+        elif is_train:
             with autograd.record(train_mode=True):
                 self.outputs = self._symbol._eval(self.arg_dict, training=True)
-            self._recording = True
         else:
             with autograd.pause(train_mode=False):
                 self.outputs = self._symbol._eval(self.arg_dict, training=False)
-            self._recording = False
+        self._recording = is_train
         if self._monitor_callback is not None:
             for name, out in zip(self._symbol.list_outputs(), self.outputs):
                 self._monitor_callback(name, out)
         return self.outputs
+
+    def _forward_jit(self, is_train):
+        """One invoke of the compiled whole-graph op: the hot loop does a
+        single dispatch per step (reference: bulked opr segments of
+        GraphExecutor::RunOps), and the autograd tape holds its compiled
+        pullback as the backward graph."""
+        from . import autograd
+        from . import random as _random
+        from .ndarray.ndarray import _wrap
+        from .ndarray.register import invoke
+
+        bindings = {**self.arg_dict, **self.aux_dict}
+        names = list(bindings.keys())
+        arrays = [bindings[n] for n in names]
+        op = self._graph_op(names, [a._data for a in arrays], bool(is_train))
+        rng = _wrap(_random._next_key(), self._ctx)
+        scope = autograd.record(train_mode=True) if is_train \
+            else autograd.pause(train_mode=False)
+        with scope:
+            outs = invoke(op, [rng] + arrays, {}, ctx=self._ctx)
+        return outs if isinstance(outs, list) else [outs]
 
     def backward(self, out_grads=None, is_train=True):
         from . import autograd
@@ -106,10 +170,17 @@ class Executor:
                 new_args[n] = nd.zeros(kwargs[n], ctx=self._ctx, dtype=arr.dtype)
             else:
                 new_args[n] = arr
+        # share the compiled-graph cache: a BucketingModule switching
+        # shapes per batch reuses one compiled computation per bucket and
+        # keeps existing grad buffers for unchanged shapes
         return Executor(self._symbol, self._ctx, new_args,
-                        {n: nd.zeros_like(a) for n, a in new_args.items()
+                        {n: (self.grad_dict[n]
+                             if n in self.grad_dict and n not in kwargs
+                             else nd.zeros_like(a))
+                         for n, a in new_args.items()
                          if self.grad_req.get(n, "null") != "null"},
-                        self.grad_req, self.aux_dict)
+                        self.grad_req, self.aux_dict,
+                        _graph_cache=self._graph_cache)
 
     def copy_params_from(self, arg_params, aux_params=None,
                          allow_extra_params=False):

@@ -1,12 +1,12 @@
 """Module — symbolic data-parallel training module
 (python/mxnet/module/module.py + executor_group.py analog).
 
-The reference slices each batch across a context list
-(DataParallelExecutorGroup) and reduces gradients via KVStore. Here a
-single Executor evaluates the bound symbol on the primary context —
-device-level data parallelism on TPU belongs to the sharded Gluon
-Trainer / pjit path (SURVEY §7), while Module keeps exact legacy API
-behavior for porting old training scripts.
+DataParallelExecutorGroup parity: a context LIST binds one compiled
+executor per device, ``forward`` slices the batch across them,
+``update`` reduces all parameter gradients in ONE fused kvstore
+pushpull (the compiled all-reduce of parallel/comm.py) and applies the
+optimizer to every replica — the reference's kvstore 'device' training
+loop, with XLA collectives in place of P2P reduce trees.
 """
 from __future__ import annotations
 
@@ -31,9 +31,9 @@ class Module(BaseModule):
         self._symbol = symbol
         self._data_names = list(data_names or [])
         self._label_names = list(label_names or [])
-        self._context = context if context is not None else current_context()
-        if isinstance(self._context, (list, tuple)):
-            self._context = self._context[0]  # see module docstring
+        ctx = context if context is not None else current_context()
+        self._contexts = list(ctx) if isinstance(ctx, (list, tuple)) else [ctx]
+        self._context = self._contexts[0]
         self._fixed_param_names = list(fixed_param_names or [])
         arg_names = symbol.list_arguments()
         self._param_names = [n for n in arg_names
@@ -97,24 +97,37 @@ class Module(BaseModule):
             for desc in self._label_shapes:
                 shape_kwargs[desc[0]] = desc[1]
 
+        n_ctx = len(self._contexts)
+        if n_ctx > 1:
+            # executor_group batch slicing: per-device shapes divide the
+            # batch axis evenly across the context list
+            def _slice(shape):
+                assert shape[0] % n_ctx == 0, \
+                    f"batch size {shape[0]} must divide across {n_ctx} contexts"
+                return (shape[0] // n_ctx,) + tuple(shape[1:])
+            shape_kwargs = {k: _slice(v) for k, v in shape_kwargs.items()}
+
         arg_shapes, _, _ = self._symbol.infer_shape(**shape_kwargs)
         if arg_shapes is None:
             raise MXNetError(f"cannot infer shapes from {shape_kwargs}")
-        args = {}
-        grads = {}
-        req = {}
-        for name, shape in zip(self._symbol.list_arguments(), arg_shapes):
-            args[name] = nd.zeros(shape, ctx=self._context)
-            if for_training and name in self._param_names and \
-                    name not in self._fixed_param_names:
-                grads[name] = nd.zeros(shape, ctx=self._context)
-                req[name] = grad_req if isinstance(grad_req, str) else grad_req.get(name, "write")
-            elif inputs_need_grad and name in self._data_names:
-                grads[name] = nd.zeros(shape, ctx=self._context)
-                req[name] = "write"
-            else:
-                req[name] = "null"
-        self._exec = self._symbol.bind(self._context, args, grads, req)
+        self._execs = []
+        for ctx in self._contexts:
+            args = {}
+            grads = {}
+            req = {}
+            for name, shape in zip(self._symbol.list_arguments(), arg_shapes):
+                args[name] = nd.zeros(shape, ctx=ctx)
+                if for_training and name in self._param_names and \
+                        name not in self._fixed_param_names:
+                    grads[name] = nd.zeros(shape, ctx=ctx)
+                    req[name] = grad_req if isinstance(grad_req, str) else grad_req.get(name, "write")
+                elif inputs_need_grad and name in self._data_names:
+                    grads[name] = nd.zeros(shape, ctx=ctx)
+                    req[name] = "write"
+                else:
+                    req[name] = "null"
+            self._execs.append(self._symbol.bind(ctx, args, grads, req))
+        self._exec = self._execs[0]
         self.binded = True
         if shared_module is not None and shared_module.params_initialized:
             arg_p, aux_p = shared_module.get_params()
@@ -137,6 +150,8 @@ class Module(BaseModule):
                 initializer(InitDesc(name), arr)
             elif not allow_missing:
                 raise MXNetError(f"parameter {name} missing and no initializer given")
+            for ex in self._execs[1:]:  # broadcast to replicas
+                arr.copyto(ex.arg_dict[name])
         for name in self._aux_names:
             arr = self._exec.aux_dict.get(name)
             if arr is None:
@@ -145,6 +160,9 @@ class Module(BaseModule):
                 aux_params[name].copyto(arr)
             elif initializer is not None:
                 initializer(InitDesc(name), arr)
+            for ex in self._execs[1:]:
+                if name in ex.aux_dict:
+                    arr.copyto(ex.aux_dict[name])
         self.params_initialized = True
 
     def get_params(self):
@@ -163,7 +181,15 @@ class Module(BaseModule):
             return
         if isinstance(optimizer, str):
             optimizer_params = dict(optimizer_params)
-            idx2name = {i: n for i, n in enumerate(self._param_names)}
+            # per-device optimizer state: replica j of param i is keyed
+            # i*n_ctx+j (reference executor_group convention
+            # index*num_device+k) so momentum/Adam state is NOT shared
+            # across replicas and update-count schedules advance once
+            # per step per key
+            n_ctx = len(self._contexts)
+            idx2name = {i * n_ctx + j: n
+                        for i, n in enumerate(self._param_names)
+                        for j in range(n_ctx)}
             optimizer = opt.create(optimizer, param_idx2name=idx2name,
                                    **optimizer_params)
         self._optimizer = optimizer
@@ -179,44 +205,115 @@ class Module(BaseModule):
         assert self.binded and self.params_initialized
         if is_train is None:
             is_train = self.for_training
-        bindings = {}
+        n_ctx = len(self._execs)
+        if n_ctx == 1:
+            bindings = {}
+            for name, arr in zip(self._data_names, data_batch.data):
+                bindings[name] = arr.as_in_context(self._context)
+            if data_batch.label:
+                for name, arr in zip(self._label_names, data_batch.label):
+                    bindings[name] = arr.as_in_context(self._context)
+            self._exec.forward(is_train=is_train, **bindings)
+            return
+        # DataParallelExecutorGroup: slice the batch across contexts
+        from ..gluon.utils import split_and_load
+        sliced = [dict() for _ in range(n_ctx)]
         for name, arr in zip(self._data_names, data_batch.data):
-            bindings[name] = arr.as_in_context(self._context)
+            for b, part in zip(sliced, split_and_load(arr, self._contexts)):
+                b[name] = part
         if data_batch.label:
             for name, arr in zip(self._label_names, data_batch.label):
-                bindings[name] = arr.as_in_context(self._context)
-        self._exec.forward(is_train=is_train, **bindings)
+                for b, part in zip(sliced, split_and_load(arr, self._contexts)):
+                    b[name] = part
+        for ex, b in zip(self._execs, sliced):
+            ex.forward(is_train=is_train, **b)
 
     def backward(self, out_grads=None):
         assert self.binded and self.params_initialized
-        self._exec.backward(out_grads=out_grads)
+        if len(self._execs) == 1:
+            self._exec.backward(out_grads=out_grads)
+            return
+        assert out_grads is None, \
+            "multi-context Module.backward with explicit out_grads is not supported"
+        for ex in self._execs:
+            ex.backward()
 
     def update(self):
         assert self.binded and self.params_initialized and self.optimizer_initialized
+        n_ctx = len(self._execs)
+        if n_ctx > 1 or self._kvstore is not None:
+            # ONE fused reduce over every key: all-reduces compiled and
+            # bucketed by XLA (kvstore_nccl.h fused-pushpull analog).
+            # Without a kvstore the reduce still must happen (reference
+            # executor_group sums before update) — use the comm layer
+            # directly.
+            keys, grads = [], []
+            for i, name in enumerate(self._param_names):
+                g = [ex.grad_dict.get(name) for ex in self._execs]
+                if g[0] is None:
+                    continue
+                keys.append(i)
+                grads.append(g)
+            if keys:
+                if self._kvstore is not None:
+                    self._kvstore.pushpull(keys, grads, out=grads)
+                elif n_ctx > 1:
+                    self._reduce_without_kvstore(grads)
         for i, name in enumerate(self._param_names):
-            weight = self._exec.arg_dict[name]
-            grad = self._exec.grad_dict.get(name)
-            if grad is None:
-                continue
-            if self._kvstore is not None:
-                self._kvstore.push(i, grad)
-                self._kvstore.pull(i, grad)
-            self._updater(i, grad, weight)
+            for j, ex in enumerate(self._execs):
+                weight = ex.arg_dict[name]
+                grad = ex.grad_dict.get(name)
+                if grad is None:
+                    continue
+                self._updater(i * n_ctx + j, grad, weight)
+
+    @staticmethod
+    def _reduce_without_kvstore(grads):
+        """Sum replica grads in one compiled all-reduce, write back."""
+        from ..parallel import comm
+        vlists = [[g._data for g in glist] for glist in grads]
+        if comm.can_fast_reduce(vlists) and len(vlists[0]) > 1 and \
+                len({a.device for a in vlists[0]}) == len(vlists[0]):
+            reduced = comm.reduce_replica_lists(vlists)
+            for glist, garr in zip(grads, reduced):
+                for g in glist:
+                    g._set_data(comm.shard_for_device(garr, g._data.device))
+        else:  # replicas sharing one device (tests): eager sum
+            for glist in grads:
+                total = glist[0]
+                for g in glist[1:]:
+                    total = total + g.as_in_context(total.ctx)
+                for g in glist:
+                    g._set_data(total._data if g.ctx == total.ctx
+                                else total.as_in_context(g.ctx)._data)
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized
-        return list(self._exec.outputs)
+        if len(self._execs) == 1 or not merge_multi_context:
+            return list(self._exec.outputs)
+        from .. import ndarray as nd
+        merged = []
+        for outs in zip(*(ex.outputs for ex in self._execs)):
+            merged.append(nd.concat(
+                *[o.as_in_context(self._context) for o in outs], dim=0))
+        return merged
 
     def get_input_grads(self, merge_multi_context=True):
         assert self.binded and self.inputs_need_grad
-        return [self._exec.grad_dict[n] for n in self._data_names]
+        if len(self._execs) == 1 or not merge_multi_context:
+            return [self._exec.grad_dict[n] for n in self._data_names]
+        from .. import ndarray as nd
+        return [nd.concat(*[ex.grad_dict[n].as_in_context(self._context)
+                            for ex in self._execs], dim=0)
+                for n in self._data_names]
 
     def update_metric(self, eval_metric, labels, pre_sliced=False):
-        eval_metric.update(labels, self._exec.outputs)
+        eval_metric.update(labels, self.get_outputs())
 
     def install_monitor(self, mon):
         assert self.binded
-        mon.install(self._exec)
+        for ex in self._execs:
+            mon.install(ex)
 
     def save_checkpoint(self, prefix, epoch, save_optimizer_states=False,
                         remove_amp_cast=True):

@@ -14,6 +14,7 @@ through every op (the AMP/fp16 analog).
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -51,6 +52,19 @@ def fully_connected(data, weight, bias=None, num_hidden=None, no_bias=False, fla
 _CONV_DN = {1: ("NCW", "OIW", "NCW"), 2: ("NCHW", "OIHW", "NCHW"),
             3: ("NCDHW", "OIDHW", "NCDHW")}
 
+# Internal NHWC execution for 2-D convs (MXNET_TPU_CONV_NHWC=1): the API
+# stays NCHW (MXNet contract) but each conv transposes to NHWC — the
+# layout the TPU vector unit natively tiles — and back. Consecutive
+# convs' transpose pairs cancel in XLA; measured as a bench.py knob.
+# Read per call (at trace time) so setting the env before building a
+# model takes effect even if mxnet_tpu was imported earlier. NOTE:
+# already-compiled jit caches are keyed on shapes only — toggling the
+# knob affects new traces, not cached executables.
+
+
+def _conv_nhwc():
+    return os.environ.get("MXNET_TPU_CONV_NHWC", "0") == "1"
+
 
 @register_op("Convolution")
 def convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
@@ -60,17 +74,32 @@ def convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
     stride = _tup(stride, nd_) or (1,) * nd_
     dilate = _tup(dilate, nd_) or (1,) * nd_
     pad = _tup(pad, nd_) or (0,) * nd_
-    dn = lax.conv_dimension_numbers(data.shape, weight.shape, _CONV_DN[nd_])
     # bf16 convs accumulate in f32 on the MXU natively; forcing
     # preferred_element_type would break the VJP's dtype contract
-    out = lax.conv_general_dilated(
-        data, weight,
-        window_strides=stride,
-        padding=[(p, p) for p in pad],
-        rhs_dilation=dilate,
-        dimension_numbers=dn,
-        feature_group_count=int(num_group),
-    )
+    if _conv_nhwc() and nd_ == 2:
+        xt = jnp.transpose(data, (0, 2, 3, 1))
+        wt = jnp.transpose(weight, (2, 3, 1, 0))
+        dn = lax.conv_dimension_numbers(xt.shape, wt.shape,
+                                        ("NHWC", "HWIO", "NHWC"))
+        out = lax.conv_general_dilated(
+            xt, wt,
+            window_strides=stride,
+            padding=[(p, p) for p in pad],
+            rhs_dilation=dilate,
+            dimension_numbers=dn,
+            feature_group_count=int(num_group),
+        )
+        out = jnp.transpose(out, (0, 3, 1, 2))
+    else:
+        dn = lax.conv_dimension_numbers(data.shape, weight.shape, _CONV_DN[nd_])
+        out = lax.conv_general_dilated(
+            data, weight,
+            window_strides=stride,
+            padding=[(p, p) for p in pad],
+            rhs_dilation=dilate,
+            dimension_numbers=dn,
+            feature_group_count=int(num_group),
+        )
     if bias is not None and not no_bias:
         out = out + bias.reshape((1, -1) + (1,) * nd_)
     return out

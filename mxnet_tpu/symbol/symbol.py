@@ -211,11 +211,12 @@ class Symbol:
         return _make_node(get_op("Cast"), [self], {"dtype": str(dtype)})
 
     # -- evaluation --------------------------------------------------------
-    def _eval(self, bindings: dict, training=False):
-        """Evaluate the DAG with NDArray bindings (used by Executor)."""
-        from .. import ndarray as nd
-        from ..ndarray.register import invoke
-
+    def _walk(self, bindings: dict, apply):
+        """The one DAG evaluator: traverse base/group/variable/op nodes,
+        memoize by node identity, and delegate op application to
+        ``apply(op, flat_inputs, raw_attrs)``. Both the eager and the
+        jit-traced executors run through here so traversal semantics
+        cannot diverge."""
         cache: dict[int, object] = {}
 
         def ev(node):
@@ -235,9 +236,9 @@ class Symbol:
                 flat = []
                 for x in ins:
                     flat.extend(x if isinstance(x, (list, tuple)) else [x])
-                params = {k: v for k, v in node._attrs.items()
-                          if not k.startswith("__")}
-                out = invoke(node._op, flat, params)
+                attrs = {k: v for k, v in node._attrs.items()
+                         if not k.startswith("__")}
+                out = apply(node._op, flat, attrs)
             cache[id(node)] = out
             return out
 
@@ -245,6 +246,35 @@ class Symbol:
         if not isinstance(result, (list, tuple)):
             result = [result]
         return list(result)
+
+    def _eval(self, bindings: dict, training=False):
+        """Evaluate the DAG with NDArray bindings (eager per-op dispatch;
+        the MXNET_TPU_SYMBOLIC_JIT=0 debug ladder)."""
+        from ..ndarray.register import invoke
+
+        return self._walk(bindings, invoke)
+
+    def _eval_raw(self, bindings: dict):
+        """Evaluate the DAG with RAW jax arrays through the ops' pure jax
+        impls — the jit-traceable walk behind the compiled executor
+        (GraphExecutor analog: the whole graph becomes ONE XLA
+        computation instead of a per-op engine push). Visible-output
+        slicing mirrors invoke(); in-place `mutates` have no meaning on
+        traced values and are skipped."""
+        from ..ndarray.register import _parse_param
+
+        def apply(op, flat, attrs):
+            params = {k: _parse_param(v) for k, v in attrs.items()
+                      if v is not None}
+            out = op.fn(*flat, **params)
+            vis = op.num_visible_outputs
+            if vis is not None and isinstance(out, (tuple, list)):
+                out = list(out[:vis])
+                if len(out) == 1:
+                    out = out[0]
+            return out
+
+        return self._walk(bindings, apply)
 
     def eval(self, ctx=None, **kwargs):
         ex = self.bind(ctx or current_context(), kwargs)

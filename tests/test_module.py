@@ -1,0 +1,216 @@
+"""Module API tests (reference tests/python/unittest/test_module.py
+scope): compiled symbolic execution + multi-context data parallelism
+(executor_group parity) + checkpoint round-trip.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+CTXS = [mx.cpu(0), mx.cpu(1)]
+
+
+def _mlp_symbol(hidden=16, classes=3):
+    data = mx.sym.var("data")
+    net = mx.sym.FullyConnected(data, num_hidden=hidden, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=classes, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _toy_data(n=128, d=8, c=3, seed=0):
+    rs = np.random.RandomState(seed)
+    w = rs.randn(d, c).astype(np.float32)
+    x = rs.randn(n, d).astype(np.float32)
+    y = (x @ w).argmax(1).astype(np.float32)
+    return x, y
+
+
+def test_module_fit_compiled_single_dispatch():
+    """Module.fit's hot loop must dispatch ONE compiled graph op per
+    forward, not per-op eager calls (VERDICT #5: SimpleBind compiles)."""
+    from mxnet_tpu.ndarray import register as reg
+    x, y = _toy_data()
+    it = mx.io.NDArrayIter(x, y, batch_size=32, label_name="softmax_label")
+    mod = mx.module.Module(_mlp_symbol(), label_names=["softmax_label"])
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(initializer=mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.05})
+
+    calls = []
+    orig = reg.invoke
+
+    def spy(op, inputs, params=None, **kw):
+        calls.append(op.name)
+        return orig(op, inputs, params, **kw)
+
+    reg.invoke = spy
+    try:
+        it.reset()
+        batch = next(iter(it))
+        mod.forward(batch, is_train=True)
+    finally:
+        reg.invoke = orig
+    graph_calls = [c for c in calls if c.startswith("GraphExecutor")]
+    assert len(graph_calls) == 1, calls
+    # eager per-op dispatches (FullyConnected, Activation, ...) must not
+    # appear in the compiled hot path
+    assert not any(c in ("FullyConnected", "Activation", "SoftmaxOutput")
+                   for c in calls), calls
+
+
+def test_module_fit_converges_and_predicts():
+    x, y = _toy_data()
+    it = mx.io.NDArrayIter(x, y, batch_size=32, shuffle=True,
+                           label_name="softmax_label")
+    mod = mx.module.Module(_mlp_symbol(), label_names=["softmax_label"])
+    mod.fit(it, num_epoch=10, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05})
+    eval_it = mx.io.NDArrayIter(x, y, batch_size=32, label_name="softmax_label")
+    preds = mod.predict(eval_it).asnumpy().argmax(1)
+    assert (preds == y).mean() > 0.9
+
+
+def test_module_multi_context_matches_single():
+    """One fit step on [cpu(0), cpu(1)] with a split batch equals the
+    single-context step (DataParallelExecutorGroup semantics), and the
+    gradient reduce compiles to an all-reduce."""
+    from mxnet_tpu.parallel import comm
+
+    def one_step(ctx):
+        mx.random.seed(3)
+        x, y = _toy_data(n=32)
+        it = mx.io.NDArrayIter(x, y, batch_size=32, label_name="softmax_label")
+        mod = mx.module.Module(_mlp_symbol(), label_names=["softmax_label"],
+                               context=ctx)
+        mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+        mod.init_params(initializer=mx.initializer.Uniform(0.1))
+        mod.init_optimizer(kvstore="device", optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.1,
+                                             "rescale_grad": 1.0 / 32})
+        batch = next(iter(it))
+        mod.forward(batch, is_train=True)
+        out = mod.get_outputs()[0].asnumpy()
+        mod.backward()
+        mod.update()
+        params, _ = mod.get_params()
+        return out, {k: v.asnumpy() for k, v in params.items()}
+
+    out1, p1 = one_step(mx.cpu(0))
+    comm._LAST_HLO[0] = None
+    out2, p2 = one_step(CTXS)
+    assert_almost_equal(out2, out1, rtol=1e-5, atol=1e-6)
+    for k in p1:
+        assert_almost_equal(p2[k], p1[k], rtol=1e-5, atol=1e-6)
+    hlo = comm.last_hlo_text()
+    assert hlo and "all-reduce" in hlo
+
+
+def test_module_multi_context_replicas_stay_synced():
+    x, y = _toy_data(n=64)
+    it = mx.io.NDArrayIter(x, y, batch_size=32, label_name="softmax_label")
+    mod = mx.module.Module(_mlp_symbol(), label_names=["softmax_label"],
+                           context=CTXS)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(initializer=mx.initializer.Xavier())
+    mod.init_optimizer(kvstore="device", optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.05})
+    for batch in it:
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update()
+    w0 = mod._execs[0].arg_dict["fc1_weight"].asnumpy()
+    w1 = mod._execs[1].arg_dict["fc1_weight"].asnumpy()
+    assert_almost_equal(w0, w1)
+
+
+def test_module_multi_context_momentum_state_per_replica(caplog):
+    """Optimizer state must be keyed per (param, replica) — shared state
+    mutated n_ctx times per step diverges replicas and double-advances
+    lr schedules (review regression; reference executor_group keys
+    index*num_device+k)."""
+    x, y = _toy_data(n=64)
+    it = mx.io.NDArrayIter(x, y, batch_size=32, label_name="softmax_label")
+    mod = mx.module.Module(_mlp_symbol(), label_names=["softmax_label"],
+                           context=CTXS)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(initializer=mx.initializer.Xavier())
+    mod.init_optimizer(kvstore="device", optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.05,
+                                         "momentum": 0.9})
+    for _ in range(3):
+        it.reset()
+        for batch in it:
+            mod.forward(batch, is_train=True)
+            mod.backward()
+            mod.update()
+    w0 = mod._execs[0].arg_dict["fc1_weight"].asnumpy()
+    w1 = mod._execs[1].arg_dict["fc1_weight"].asnumpy()
+    assert_almost_equal(w0, w1)
+    # 3 epochs x 2 batches = 6 updates per key, regardless of replica count
+    assert mod._optimizer.num_update == 6, mod._optimizer.num_update
+
+
+def test_module_multi_context_no_kvstore_still_reduces():
+    """kvstore=None with a context list must still sum replica grads
+    before the update (reference executor_group semantics)."""
+    def one_step(ctx, kvstore):
+        mx.random.seed(3)
+        x, y = _toy_data(n=32)
+        it = mx.io.NDArrayIter(x, y, batch_size=32, label_name="softmax_label")
+        mod = mx.module.Module(_mlp_symbol(), label_names=["softmax_label"],
+                               context=ctx)
+        mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+        mod.init_params(initializer=mx.initializer.Uniform(0.1))
+        mod.init_optimizer(kvstore=kvstore, optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.1,
+                                             "rescale_grad": 1.0 / 32})
+        batch = next(iter(it))
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update()
+        params, _ = mod.get_params()
+        return {k: v.asnumpy() for k, v in params.items()}
+
+    ref = one_step(mx.cpu(0), None)
+    multi = one_step(CTXS, None)
+    for k in ref:
+        assert_almost_equal(multi[k], ref[k], rtol=1e-5, atol=1e-6)
+
+
+def test_module_checkpoint_roundtrip(tmp_path):
+    x, y = _toy_data(n=32)
+    it = mx.io.NDArrayIter(x, y, batch_size=32, label_name="softmax_label")
+    mod = mx.module.Module(_mlp_symbol(), label_names=["softmax_label"])
+    mod.fit(it, num_epoch=1, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05})
+    prefix = str(tmp_path / "m")
+    mod.save_checkpoint(prefix, 1)
+    mod2 = mx.module.Module.load(prefix, 1, label_names=["softmax_label"])
+    it.reset()
+    mod2.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod2.init_params(arg_params=mod2._preloaded_params[0],
+                     aux_params=mod2._preloaded_params[1])
+    eval_it = mx.io.NDArrayIter(x, y, batch_size=32, label_name="softmax_label")
+    p1 = mod.predict(eval_it).asnumpy()
+    eval_it.reset()
+    p2 = mod2.predict(eval_it).asnumpy()
+    assert_almost_equal(p2, p1, rtol=1e-5, atol=1e-6)
+
+
+def test_executor_reshape_shares_compiled_cache():
+    sym = _mlp_symbol()
+    ex = sym.simple_bind(mx.cpu(0), data=(8, 4), softmax_label=(8,))
+    ex.forward(is_train=False)
+    n_before = len(ex._graph_cache)
+    ex2 = ex.reshape(data=(4, 4), softmax_label=(4,))
+    assert ex2._graph_cache is ex._graph_cache
+    ex2.forward(is_train=False)
+    assert len(ex._graph_cache) == n_before + 1
+    # same shape again: cache hit, no growth
+    ex3 = ex2.reshape(data=(8, 4), softmax_label=(8,))
+    ex3.forward(is_train=False)
+    assert len(ex._graph_cache) == n_before + 1

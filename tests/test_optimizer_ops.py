@@ -1,0 +1,247 @@
+"""Golden tests for every fused optimizer update op vs hand-written
+numpy (reference src/operator/optimizer_op.cc update formulas; SURVEY
+§2.1 optimizer row). Also checks the in-place `mutates` contract: state
+inputs (mom/mean/var/...) are updated in place like the reference's
+aux-state writes, and `out=` writes the new weight.
+"""
+import numpy as np
+import pytest
+
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+RS = np.random.RandomState(11)
+SHAPE = (4, 3)
+
+
+def _wg():
+    return (RS.randn(*SHAPE).astype(np.float32),
+            RS.randn(*SHAPE).astype(np.float32))
+
+
+def _run(op_name, arrays, params):
+    """Invoke the op with out= pointing at the weight (the Updater call
+    convention) and return (new_weight, state NDArrays)."""
+    nds = [nd.array(a) for a in arrays]
+    out = nd.zeros(SHAPE)
+    getattr(nd, op_name)(*nds, out=out, **params)
+    return out.asnumpy(), [x.asnumpy() for x in nds]
+
+
+def _clip(g, c):
+    return np.clip(g, -c, c) if c > 0 else g
+
+
+def test_sgd_update():
+    w, g = _wg()
+    new_w, _ = _run("sgd_update", [w, g],
+                    {"lr": 0.1, "wd": 0.01, "rescale_grad": 0.5,
+                     "clip_gradient": 0.4})
+    gs = _clip(g * 0.5, 0.4)
+    assert_almost_equal(new_w, w - 0.1 * (gs + 0.01 * w), rtol=1e-5, atol=1e-6)
+
+
+def test_sgd_mom_update():
+    w, g = _wg()
+    mom = RS.randn(*SHAPE).astype(np.float32)
+    new_w, states = _run("sgd_mom_update", [w, g, mom.copy()],
+                         {"lr": 0.1, "momentum": 0.9, "wd": 0.01})
+    want_mom = 0.9 * mom - 0.1 * (g + 0.01 * w)
+    assert_almost_equal(new_w, w + want_mom, rtol=1e-5, atol=1e-6)
+    assert_almost_equal(states[2], want_mom, rtol=1e-5, atol=1e-6)  # in-place
+
+
+def test_nag_mom_update():
+    w, g = _wg()
+    mom = RS.randn(*SHAPE).astype(np.float32)
+    new_w, states = _run("nag_mom_update", [w, g, mom.copy()],
+                         {"lr": 0.1, "momentum": 0.9, "wd": 0.01})
+    gw = g + 0.01 * w
+    want_mom = 0.9 * mom + gw
+    assert_almost_equal(new_w, w - 0.1 * (gw + 0.9 * want_mom), rtol=1e-5, atol=1e-6)
+    assert_almost_equal(states[2], want_mom, rtol=1e-5, atol=1e-6)
+
+
+def test_mp_sgd_update():
+    w32, g = _wg()
+    w16 = w32.astype(np.float16)
+    nds = [nd.array(w16.astype(np.float16)), nd.array(g.astype(np.float16)),
+           nd.array(w32)]
+    out = nd.zeros(SHAPE, dtype="float16")
+    nd.mp_sgd_update(*nds, out=out, lr=0.1, wd=0.01)
+    want32 = w32 - 0.1 * (g.astype(np.float16).astype(np.float32) + 0.01 * w32)
+    assert_almost_equal(nds[2].asnumpy(), want32, rtol=1e-3, atol=1e-4)
+    assert_almost_equal(out.asnumpy().astype(np.float32), want32,
+                        rtol=1e-2, atol=1e-2)  # half-precision copy
+
+
+def test_mp_sgd_mom_update():
+    w32, g = _wg()
+    mom = np.zeros(SHAPE, np.float32)
+    nds = [nd.array(w32.astype(np.float16)), nd.array(g.astype(np.float16)),
+           nd.array(mom), nd.array(w32)]
+    out = nd.zeros(SHAPE, dtype="float16")
+    nd.mp_sgd_mom_update(*nds, out=out, lr=0.1, momentum=0.9, wd=0.0)
+    g32 = g.astype(np.float16).astype(np.float32)
+    want_mom = -0.1 * g32
+    assert_almost_equal(nds[2].asnumpy(), want_mom, rtol=1e-3, atol=1e-4)
+    assert_almost_equal(nds[3].asnumpy(), w32 + want_mom, rtol=1e-3, atol=1e-4)
+
+
+def test_adam_update():
+    w, g = _wg()
+    mean = RS.randn(*SHAPE).astype(np.float32) * 0.1
+    var = np.abs(RS.randn(*SHAPE)).astype(np.float32) * 0.1
+    new_w, states = _run("adam_update", [w, g, mean.copy(), var.copy()],
+                         {"lr": 0.01, "beta1": 0.9, "beta2": 0.999,
+                          "epsilon": 1e-8, "wd": 0.05})
+    gw = g + 0.05 * w
+    want_mean = 0.9 * mean + 0.1 * gw
+    want_var = 0.999 * var + 0.001 * gw ** 2
+    want_w = w - 0.01 * want_mean / (np.sqrt(want_var) + 1e-8)
+    assert_almost_equal(new_w, want_w, rtol=1e-5, atol=1e-6)
+    assert_almost_equal(states[2], want_mean, rtol=1e-5, atol=1e-6)
+    assert_almost_equal(states[3], want_var, rtol=1e-5, atol=1e-6)
+
+
+def test_adamw_update():
+    w, g = _wg()
+    mean = np.zeros(SHAPE, np.float32)
+    var = np.zeros(SHAPE, np.float32)
+    new_w, _ = _run("adamw_update", [w, g, mean, var],
+                    {"lr": 0.01, "wd": 0.1, "eta": 1.0})
+    want_mean = 0.1 * g
+    want_var = 0.001 * g ** 2
+    upd = want_mean / (np.sqrt(want_var) + 1e-8) + 0.1 * w
+    assert_almost_equal(new_w, w - 0.01 * upd, rtol=1e-4, atol=1e-5)
+
+
+def test_rmsprop_update():
+    w, g = _wg()
+    n = np.abs(RS.randn(*SHAPE)).astype(np.float32)
+    new_w, states = _run("rmsprop_update", [w, g, n.copy()],
+                         {"lr": 0.01, "gamma1": 0.9, "epsilon": 1e-8})
+    want_n = 0.9 * n + 0.1 * g ** 2
+    assert_almost_equal(new_w, w - 0.01 * g / np.sqrt(want_n + 1e-8),
+                        rtol=1e-5, atol=1e-6)
+    assert_almost_equal(states[2], want_n, rtol=1e-5, atol=1e-6)
+
+
+def test_rmspropalex_update():
+    w, g = _wg()
+    n = np.abs(RS.randn(*SHAPE)).astype(np.float32)
+    gacc = RS.randn(*SHAPE).astype(np.float32) * 0.1
+    delta = np.zeros(SHAPE, np.float32)
+    new_w, states = _run("rmspropalex_update",
+                         [w, g, n.copy(), gacc.copy(), delta.copy()],
+                         {"lr": 0.01, "gamma1": 0.95, "gamma2": 0.9})
+    want_n = 0.95 * n + 0.05 * g ** 2
+    want_g = 0.95 * gacc + 0.05 * g
+    want_d = -0.01 * g / np.sqrt(want_n - want_g ** 2 + 1e-8)
+    assert_almost_equal(new_w, w + want_d, rtol=1e-4, atol=1e-5)
+    assert_almost_equal(states[2], want_n, rtol=1e-5, atol=1e-6)
+    assert_almost_equal(states[3], want_g, rtol=1e-5, atol=1e-6)
+    assert_almost_equal(states[4], want_d, rtol=1e-4, atol=1e-5)
+
+
+def test_ftrl_update():
+    w, g = _wg()
+    z = RS.randn(*SHAPE).astype(np.float32) * 0.1
+    n = np.abs(RS.randn(*SHAPE)).astype(np.float32) * 0.1
+    new_w, states = _run("ftrl_update", [w, g, z.copy(), n.copy()],
+                         {"lr": 0.1, "lamda1": 0.01, "beta": 1.0, "wd": 0.01})
+    want_n = n + g ** 2
+    sigma = (np.sqrt(want_n) - np.sqrt(n)) / 0.1
+    want_z = z + g - sigma * w
+    want_w = np.where(np.abs(want_z) <= 0.01, 0.0,
+                      -(want_z - np.sign(want_z) * 0.01)
+                      / ((1.0 + np.sqrt(want_n)) / 0.1 + 0.01))
+    assert_almost_equal(new_w, want_w, rtol=1e-4, atol=1e-5)
+    assert_almost_equal(states[2], want_z, rtol=1e-4, atol=1e-5)
+    assert_almost_equal(states[3], want_n, rtol=1e-5, atol=1e-6)
+
+
+def test_signsgd_update():
+    w, g = _wg()
+    new_w, _ = _run("signsgd_update", [w, g], {"lr": 0.1, "wd": 0.01})
+    assert_almost_equal(new_w, w - 0.1 * (np.sign(g) + 0.01 * w),
+                        rtol=1e-5, atol=1e-6)
+
+
+def test_signum_update():
+    w, g = _wg()
+    mom = RS.randn(*SHAPE).astype(np.float32)
+    new_w, states = _run("signum_update", [w, g, mom.copy()],
+                         {"lr": 0.1, "momentum": 0.9, "wd": 0.01})
+    gw = g + 0.01 * w
+    want_mom = 0.9 * mom - 0.1 * gw
+    assert_almost_equal(new_w, w + 0.1 * np.sign(want_mom), rtol=1e-5, atol=1e-6)
+    assert_almost_equal(states[2], want_mom, rtol=1e-5, atol=1e-6)
+
+
+def test_adagrad_update():
+    w, g = _wg()
+    hist = np.abs(RS.randn(*SHAPE)).astype(np.float32) * 0.1
+    new_w, states = _run("adagrad_update", [w, g, hist.copy()],
+                         {"lr": 0.1, "epsilon": 1e-7})
+    want_h = hist + g ** 2
+    assert_almost_equal(new_w, w - 0.1 * g / np.sqrt(want_h + 1e-7),
+                        rtol=1e-5, atol=1e-6)
+    assert_almost_equal(states[2], want_h, rtol=1e-5, atol=1e-6)
+
+
+def test_adadelta_update():
+    w, g = _wg()
+    ag = np.abs(RS.randn(*SHAPE)).astype(np.float32) * 0.1
+    ad = np.abs(RS.randn(*SHAPE)).astype(np.float32) * 0.1
+    new_w, states = _run("adadelta_update", [w, g, ag.copy(), ad.copy()],
+                         {"rho": 0.9, "epsilon": 1e-5})
+    want_ag = 0.9 * ag + 0.1 * g ** 2
+    delta = np.sqrt(ad + 1e-5) / np.sqrt(want_ag + 1e-5) * g
+    want_ad = 0.9 * ad + 0.1 * delta ** 2
+    assert_almost_equal(new_w, w - delta, rtol=1e-4, atol=1e-5)
+    assert_almost_equal(states[2], want_ag, rtol=1e-5, atol=1e-6)
+    assert_almost_equal(states[3], want_ad, rtol=1e-5, atol=1e-6)
+
+
+def test_lamb_update_phases():
+    w, g = _wg()
+    mean = np.zeros(SHAPE, np.float32)
+    var = np.zeros(SHAPE, np.float32)
+    upd, states = _run("lamb_update_phase1", [w, g, mean, var],
+                       {"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-6,
+                        "t": 1, "wd": 0.01})
+    m = (0.1 * g) / (1 - 0.9)
+    v = (0.001 * g ** 2) / (1 - 0.999)
+    want_upd = m / (np.sqrt(v) + 1e-6) + 0.01 * w
+    assert_almost_equal(upd, want_upd, rtol=1e-4, atol=1e-5)
+    r1 = np.linalg.norm(w)
+    r2 = np.linalg.norm(want_upd)
+    out = nd.zeros(SHAPE)
+    nd.lamb_update_phase2(nd.array(w), nd.array(want_upd),
+                          nd.array(np.array([r1], np.float32)),
+                          nd.array(np.array([r2], np.float32)),
+                          out=out, lr=0.01)
+    assert_almost_equal(out.asnumpy(), w - 0.01 * (r1 / r2) * want_upd,
+                        rtol=1e-4, atol=1e-5)
+
+
+def test_sparse_rsp_updates_match_dense():
+    """row_sparse lazy updates touch only the rows present in the
+    gradient and agree with the dense op on those rows (reference
+    SGDMomLazyUpdateRspImpl contract; sparse.py convention was aligned
+    with the dense op in round 1's advisor fix)."""
+    from mxnet_tpu.ndarray import sparse as sp
+    w = RS.randn(6, 3).astype(np.float32)
+    rows = np.array([1, 4], np.int64)
+    gval = RS.randn(2, 3).astype(np.float32)
+    grad = sp.row_sparse_array((gval, rows), shape=(6, 3))
+    weight = nd.array(w.copy())
+    mom = nd.zeros((6, 3))
+    out = sp.sgd_mom_update_rsp(weight, grad, mom, lr=0.1, momentum=0.9)
+    dense_mom = np.zeros((6, 3), np.float32)
+    dense_w = w.copy()
+    dense_mom[rows] = 0.9 * dense_mom[rows] - 0.1 * gval
+    dense_w[rows] += dense_mom[rows]
+    assert_almost_equal(out.asnumpy() if hasattr(out, "asnumpy") else weight.asnumpy(),
+                        dense_w, rtol=1e-5, atol=1e-6)
