@@ -162,6 +162,11 @@ def main():
     y = jnp.asarray(np.random.RandomState(1).randint(0, 1000, BATCH), jnp.int32)
 
     flops = _step_flops(step, params, moms, rng, x, y)
+
+    if os.environ.get("BENCH_DATA") == "recordio":
+        _resnet_from_recordio(loss_fn, params, moms, rng, flops)
+        return
+
     dt = _time_steps(step, params, moms, rng, x, y)
 
     imgs_per_sec = BATCH * STEPS / dt
@@ -170,6 +175,90 @@ def main():
             flops_per_step=flops, sec_per_step=dt / STEPS,
             batch=BATCH, dtype=DTYPE,
             conv_nhwc=os.environ.get("MXNET_TPU_CONV_NHWC", "0") == "1")
+
+
+def _resnet_from_recordio(loss_fn, params, moms, rng, flops):
+    """End-to-end input-pipeline bench (SURVEY §7 hard part #6): feed the
+    same jitted ResNet step from a generated JPEG RecordIO file through
+    the multiprocess decode pipeline + device prefetch, and report
+    img/s plus pipeline-vs-compute utilization (the reference's
+    iter_image_recordio_2.cc role)."""
+    import tempfile
+
+    import jax
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.data import DataLoader, DevicePrefetcher
+    from mxnet_tpu.gluon.data.dataset import Dataset
+
+    n_img = int(os.environ.get("BENCH_PIPELINE_IMAGES", str(BATCH * (STEPS + WARMUP))))
+    workers = int(os.environ.get("BENCH_WORKERS", "8"))
+    tmp = tempfile.mkdtemp(prefix="bench_rec_")
+    rec_path = os.path.join(tmp, "synthetic.rec")
+    idx_path = os.path.join(tmp, "synthetic.idx")
+    rs = np.random.RandomState(0)
+    rec = mx.recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    for i in range(n_img):
+        img = rs.randint(0, 255, (IMAGE, IMAGE, 3), dtype=np.uint8)
+        header = mx.recordio.IRHeader(0, float(i % 1000), i, 0)
+        rec.write_idx(i, mx.recordio.pack_img(header, img, quality=90))
+    rec.close()
+
+    class RecDataset(Dataset):
+        """JPEG decode in the worker process. Ships uint8 CHW — 4x less
+        IPC traffic than float32 (the shared-memory lesson of
+        iter_image_recordio_2.cc); normalization happens on-device in
+        the jitted step."""
+
+        def __init__(self):
+            self._rec = None  # opened lazily per worker process
+
+        def __len__(self):
+            return n_img
+
+        def __getitem__(self, i):
+            if self._rec is None:
+                self._rec = mx.recordio.MXIndexedRecordIO(idx_path, rec_path, "r")
+            header, img = mx.recordio.unpack_img(self._rec.read_idx(i))
+            return img.transpose(2, 0, 1), np.float32(header.label)
+
+    loader = DataLoader(RecDataset(), batch_size=BATCH, shuffle=False,
+                        num_workers=workers, last_batch="discard")
+
+    # uint8→dtype normalize + label cast live INSIDE the jitted step:
+    # eager per-batch conversion ops would each be a round-trip to the
+    # (possibly remote) accelerator
+    import jax.numpy as jnp
+
+    def loss_u8(p, rng, x_u8, y_f32):
+        x = x_u8.astype(jnp.dtype(DTYPE)) * np.asarray(1.0 / 255.0,
+                                                       np.dtype(DTYPE))
+        return loss_fn(p, rng, x, y_f32.astype(jnp.int32))
+
+    step = _make_momentum_sgd(loss_u8, 0.1)
+
+    def run_epoch(p, m):
+        n_steps = 0
+        loss = None
+        for xb, yb in DevicePrefetcher(loader, depth=3):
+            p, m, loss = step(p, m, rng, xb._data, yb._data)
+            n_steps += 1
+        if loss is not None:
+            jax.block_until_ready(loss)
+        return n_steps, p, m
+
+    # warmup epoch: compile + page cache (params are donated — thread
+    # the returned state into the timed epoch)
+    _, p, m = run_epoch(params, moms)
+    t0 = time.perf_counter()
+    n_steps, p, m = run_epoch(p, m)
+    dt = time.perf_counter() - t0
+    imgs_per_sec = n_steps * BATCH / dt
+    _report("resnet50_recordio_images_per_sec_per_chip", imgs_per_sec,
+            "images/sec/chip", imgs_per_sec / BASELINE_IMGS_PER_SEC,
+            flops_per_step=flops, sec_per_step=dt / max(n_steps, 1),
+            batch=BATCH, dtype=DTYPE, workers=workers,
+            pipeline_images=n_img)
 
 
 def main_bert():

@@ -67,12 +67,17 @@ class Context:
     # -- PJRT mapping -----------------------------------------------------
     @property
     def jax_device(self):
-        """The PJRT device backing this context."""
+        """The PJRT device backing this context.
+
+        Resolution is PROCESS-LOCAL (jax.local_devices): under
+        multi-process launch every worker's mx.cpu(0)/mx.tpu(0) is its
+        own addressable chip — the reference's per-worker device ids —
+        never another host's device from the global list."""
         kind = self.device_type
         if kind in ("cpu_pinned", "cpu_shared"):
             kind = "cpu"
         try:
-            devs = jax.devices(kind)
+            devs = jax.local_devices(backend=kind)
         except RuntimeError:
             # Requested backend not present. Mirror the reference's
             # behavior of allowing mx.gpu(0) objects to exist without a
@@ -80,9 +85,9 @@ class Context:
             # fall back: tpu→any accelerator→cpu.
             if kind != "cpu":
                 try:
-                    devs = jax.devices()
+                    devs = jax.local_devices()
                 except RuntimeError:
-                    devs = jax.devices("cpu")
+                    devs = jax.local_devices(backend="cpu")
             else:
                 raise
         if self.device_id >= len(devs):
@@ -131,14 +136,14 @@ def cpu_pinned(device_id: int = 0) -> Context:
 
 def num_gpus() -> int:
     try:
-        return len(jax.devices("gpu"))
+        return len(jax.local_devices(backend="gpu"))
     except RuntimeError:
         return 0
 
 
 def num_tpus() -> int:
     try:
-        return len(jax.devices("tpu"))
+        return len(jax.local_devices(backend="tpu"))
     except RuntimeError:
         return 0
 

@@ -1,13 +1,20 @@
 """INT8 quantization (python/mxnet/contrib/quantization.py analog).
 
-The reference's INT8 path: quantize/dequantize ops, calibration
-(minmax/entropy) collecting layer output ranges, and a graph rewrite
-to quantized kernels. TPU-native scope: per-tensor min-max calibration
-+ quantize/dequantize ops (ndarray/contrib.py) — native int8 matmul
-kernels are a Pallas work item (the v5e MXU supports int8); until then
-`quantize_model` produces a simulated-quantization model (quantize →
-dequantize around MXU ops), which is what the reference's calibration
-mode computes numerics with too.
+The reference's INT8 path (src/operator/quantization/*): quantize/
+dequantize ops, calibration (minmax/entropy) collecting layer ranges,
+and a graph rewrite to quantized kernels. TPU-native design:
+
+- fused int8 compute ops (ndarray/op_impl_quant.py) whose matmul/conv
+  run s8×s8→s32 on the MXU (``preferred_element_type=int32``);
+- :func:`quantize_net` REWRITES a Gluon net in place, swapping every
+  ``nn.Dense`` / ``nn.Conv2D`` child for a :class:`QuantizedDense` /
+  :class:`QuantizedConv2D` holding int8 weights; activations use the
+  calibrated per-layer input range when calibration data is given
+  (static quantization) and the per-batch max otherwise (dynamic);
+- :func:`quantize_model` keeps the legacy symbol-API signature; the
+  symbol graph is annotated (the compute rewrite is the Gluon path —
+  reference parity note: the legacy path there also rides a subgraph
+  backend that this design replaces with block rewriting).
 """
 from __future__ import annotations
 
@@ -16,7 +23,7 @@ import numpy as np
 from ..base import MXNetError
 
 __all__ = ["CalibrationCollector", "calib_graph", "quantize_model",
-           "quantize_net"]
+           "quantize_net", "QuantizedDense", "QuantizedConv2D"]
 
 
 class CalibrationCollector:
@@ -35,18 +42,29 @@ class CalibrationCollector:
         self.min_max[name] = (lo, hi)
 
 
-def calib_graph(net, calib_data, num_batches=10):
-    """Run calibration batches through a Block, hooking layer outputs."""
+def calib_graph(net, calib_data, num_batches=10, inputs=False):
+    """Run calibration batches through a Block, hooking layer outputs
+    (or inputs with ``inputs=True`` — what the int8 layers consume)."""
     collector = CalibrationCollector()
     handles = []
 
-    def make_hook(name):
-        def hook(block, inputs, output):
-            collector.collect(name, output)
-        return hook
+    def walk(block):
+        for name, child in block._children.items():
+            if inputs:
+                def make_pre(n):
+                    def hook(blk, ins):
+                        collector.collect(n, ins[0])
+                    return hook
+                handles.append(child.register_forward_pre_hook(make_pre(child.name)))
+            else:
+                def make_hook(n):
+                    def hook(blk, ins, output):
+                        collector.collect(n, output)
+                    return hook
+                handles.append(child.register_forward_hook(make_hook(child.name)))
+            walk(child)
 
-    for name, child in net._children.items():
-        handles.append(child.register_forward_hook(make_hook(name)))
+    walk(net)
     seen = 0
     for batch in calib_data:
         data = batch[0] if isinstance(batch, (list, tuple)) else batch.data[0]
@@ -59,25 +77,153 @@ def calib_graph(net, calib_data, num_batches=10):
     return collector.min_max
 
 
+from ..gluon.block import HybridBlock  # noqa: E402
+from ..gluon import nn as _nn  # noqa: E402
+
+
+class _QuantizedBase(HybridBlock):
+    """Holds int8 weight + scale quantized ONCE from a float layer.
+
+    All state lives in registered Parameters (weight_q/weight_scale/
+    act_amax/bias) so quantized nets checkpoint through the normal
+    save_parameters/load_parameters path; act_amax <= 0 means dynamic
+    per-batch activation ranges (resolved in-graph, no sync)."""
+
+    def _quantize_weight(self, float_layer, ctx, act_range):
+        from .. import ndarray as nd
+        from ..ndarray.op_impl_quant import quantize_weight
+        from ..ndarray.ndarray import _wrap
+        w = float_layer.weight.data(ctx)
+        q, s = quantize_weight(w._data)
+        with self.name_scope():
+            self.weight_q = self.params.get(
+                "weight_q", shape=q.shape, dtype="int8", init="zeros",
+                grad_req="null")
+            self.weight_scale = self.params.get(
+                "weight_scale", shape=(1,), dtype="float32", init="zeros",
+                grad_req="null")
+            self.act_amax = self.params.get(
+                "act_amax", shape=(1,), dtype="float32", init="zeros",
+                grad_req="null")
+            self.bias = None
+            if float_layer.bias is not None:
+                self.bias = self.params.get(
+                    "bias", shape=float_layer.bias.shape, dtype="float32",
+                    init="zeros", grad_req="null")
+        self.collect_params().initialize(ctx=ctx)
+        self.weight_q.set_data(_wrap(q, ctx))
+        self.weight_scale.set_data(_wrap(s, ctx))
+        amax = (max(abs(act_range[0]), abs(act_range[1]))
+                if act_range is not None else -1.0)  # <=0 → dynamic
+        self.act_amax.set_data(nd.array([amax], ctx=ctx))
+        if self.bias is not None:
+            self.bias.set_data(float_layer.bias.data(ctx))
+
+
+class QuantizedDense(_QuantizedBase):
+    """int8 replacement for nn.Dense (reference
+    quantized_fully_connected): weights pre-quantized, activations
+    quantized per call (static range when calibrated)."""
+
+    def __init__(self, float_layer, act_range=None, ctx=None, prefix=None):
+        super().__init__(prefix=prefix or (float_layer.name + "_int8_"))
+        from ..context import current_context
+        ctx = ctx or current_context()
+        self._units = float_layer._units
+        self._flatten = float_layer._flatten
+        self._act = float_layer.act
+        self._quantize_weight(float_layer, ctx, act_range)
+
+    def forward(self, x):
+        from ..ndarray.register import get_op, invoke
+        from ..ndarray.op_impl_quant import quantize_act
+        from ..ndarray.ndarray import _wrap
+        q, s = quantize_act(x._data, self.act_amax.data(x.ctx)._data)
+        bias = self.bias.data(x.ctx) if self.bias is not None else None
+        out = invoke(get_op("quantized_fully_connected"),
+                     [_wrap(q, x.ctx), self.weight_q.data(x.ctx),
+                      _wrap(s, x.ctx), self.weight_scale.data(x.ctx), bias],
+                     {"num_hidden": self._units, "flatten": self._flatten,
+                      "no_bias": bias is None})
+        return self._act(out) if self._act is not None else out
+
+
+class QuantizedConv2D(_QuantizedBase):
+    """int8 replacement for nn.Conv2D (reference quantized_conv)."""
+
+    def __init__(self, float_layer, act_range=None, ctx=None, prefix=None):
+        super().__init__(prefix=prefix or (float_layer.name + "_int8_"))
+        from ..context import current_context
+        ctx = ctx or current_context()
+        self._kwargs = dict(float_layer._kwargs)
+        self._act = float_layer.act
+        self._quantize_weight(float_layer, ctx, act_range)
+
+    def forward(self, x):
+        from ..ndarray.register import get_op, invoke
+        from ..ndarray.op_impl_quant import quantize_act
+        from ..ndarray.ndarray import _wrap
+        q, s = quantize_act(x._data, self.act_amax.data(x.ctx)._data)
+        bias = self.bias.data(x.ctx) if self.bias is not None else None
+        kw = {k: v for k, v in self._kwargs.items()
+              if k in ("kernel", "stride", "dilate", "pad", "num_filter",
+                       "num_group")}
+        out = invoke(get_op("quantized_conv"),
+                     [_wrap(q, x.ctx), self.weight_q.data(x.ctx),
+                      _wrap(s, x.ctx), self.weight_scale.data(x.ctx), bias],
+                     {**kw, "no_bias": bias is None})
+        return self._act(out) if self._act is not None else out
+
+
+def quantize_net(net, quantized_dtype="int8", calib_data=None,
+                 calib_mode="naive", num_calib_examples=32, ctx=None,
+                 exclude_layers=(), **kwargs):
+    """Rewrite ``net`` so Dense/Conv2D children execute in int8.
+
+    With ``calib_data``: per-layer INPUT ranges are collected first
+    (static activation scales). Without: dynamic per-batch ranges.
+    Returns the same net object (rewritten in place), reference-API
+    compatible."""
+    if quantized_dtype != "int8":
+        raise MXNetError(f"only int8 is supported, got {quantized_dtype}")
+    ranges = {}
+    if calib_data is not None:
+        ranges = calib_graph(net, calib_data,
+                             num_batches=max(1, num_calib_examples // 32),
+                             inputs=True)
+
+    def rewrite(block):
+        for name, child in list(block._children.items()):
+            rewrite(child)
+            if child.name in exclude_layers:
+                continue
+            if type(child) is _nn.Dense:
+                qlayer = QuantizedDense(child, ranges.get(child.name), ctx)
+            elif type(child) is _nn.Conv2D:
+                qlayer = QuantizedConv2D(child, ranges.get(child.name), ctx)
+            else:
+                continue
+            block._children[name] = qlayer
+            # attribute access (net.fc1) must resolve to the new layer
+            for attr, val in list(vars(block).items()):
+                if val is child:
+                    object.__setattr__(block, attr, qlayer)
+
+    rewrite(net)
+    net._quantized_dtype = quantized_dtype
+    net._quant_ranges = ranges
+    return net
+
+
 def quantize_model(sym, arg_params, aux_params, data_names=("data",),
                    ctx=None, calib_mode="naive", calib_data=None,
                    num_calib_examples=None, quantized_dtype="int8", **kwargs):
     """Legacy-API entry: returns (sym, arg_params, aux_params) with
-    simulated quantization annotations (attrs record the chosen dtype)."""
+    quantization annotations; the executing int8 path is the Gluon
+    :func:`quantize_net` rewrite."""
     qsym = sym
     for node in qsym._topo():
         if node._op is not None and node._op.name in ("FullyConnected",
                                                       "Convolution", "dot"):
             node._attrs["__quantized_dtype__"] = quantized_dtype
     return qsym, arg_params, aux_params
-
-
-def quantize_net(net, quantized_dtype="int8", calib_data=None,
-                 calib_mode="naive", num_calib_examples=32, **kwargs):
-    """Gluon entry: calibrate a Block and attach quantization ranges."""
-    if calib_data is not None:
-        ranges = calib_graph(net, calib_data,
-                             num_batches=max(1, num_calib_examples // 32))
-        net._quant_ranges = ranges
-    net._quantized_dtype = quantized_dtype
-    return net

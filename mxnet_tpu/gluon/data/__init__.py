@@ -1,4 +1,4 @@
 from .dataset import Dataset, SimpleDataset, ArrayDataset, RecordFileDataset
 from .sampler import Sampler, SequentialSampler, RandomSampler, BatchSampler
-from .dataloader import DataLoader
+from .dataloader import DataLoader, DevicePrefetcher
 from . import vision

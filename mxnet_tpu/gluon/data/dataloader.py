@@ -1,16 +1,23 @@
 """DataLoader (python/mxnet/gluon/data/dataloader.py analog).
 
-The reference uses multiprocessing workers + shared-memory NDArray
-rebuild (CPUSharedStorageManager). TPU-native design: worker THREADS
-(batchify is numpy-bound and releases the GIL; jax device_put is the
-only hot conversion) + a prefetch queue that overlaps host batch
-assembly with device steps. `num_workers>0` enables the threaded
-prefetcher; the API (batchify_fn, samplers, pin_memory) is preserved —
-pin_memory is a no-op because PJRT host buffers are already DMA-able.
+Worker model parity with the reference (multiprocessing workers +
+shared-memory NDArray rebuild, CPUSharedStorageManager):
+
+- ``num_workers>0, thread_pool=False`` (the reference default): a
+  forked PROCESS pool decodes and batchifies to numpy outside the GIL
+  (Python/PIL decode does not scale on threads — SURVEY §7 hard part
+  #6); the parent converts to device arrays. Workers never touch JAX
+  (fork + XLA runtime don't mix); ``default_mp_batchify_fn`` therefore
+  stacks to numpy, the parent wraps.
+- ``thread_pool=True``: thread workers — cheaper startup, right when
+  __getitem__ is numpy-bound and GIL-releasing.
+- :class:`DevicePrefetcher` overlaps host→device transfer with compute
+  (the PrefetcherIter/pin-memory role; PJRT device_put is async).
 """
 from __future__ import annotations
 
 import concurrent.futures as _futures
+import multiprocessing as _mp
 import threading
 from collections import deque
 
@@ -20,7 +27,8 @@ from ...base import MXNetError
 from ...ndarray import NDArray, array
 from .sampler import SequentialSampler, RandomSampler, BatchSampler
 
-__all__ = ["DataLoader", "default_batchify_fn", "default_mp_batchify_fn"]
+__all__ = ["DataLoader", "DevicePrefetcher", "default_batchify_fn",
+           "default_mp_batchify_fn"]
 
 
 def default_batchify_fn(data):
@@ -35,17 +43,113 @@ def default_batchify_fn(data):
     return array(data)
 
 
-default_mp_batchify_fn = default_batchify_fn
+def default_mp_batchify_fn(data):
+    """Worker-side batchify: numpy ONLY. A forked worker must never
+    touch JAX — the parent holds a multithreaded XLA client and any
+    device call after fork can deadlock — so NDArray samples are
+    rejected with a fix-it message instead of being converted."""
+    if isinstance(data[0], NDArray):
+        raise MXNetError(
+            "Dataset.__getitem__ returned an NDArray but the DataLoader "
+            "uses forked process workers, which must not touch device "
+            "arrays. Return numpy from the dataset/transforms, or pass "
+            "thread_pool=True (thread workers), or num_workers=0.")
+    if isinstance(data[0], tuple):
+        data = zip(*data)
+        return [default_mp_batchify_fn(i) for i in data]
+    return np.asarray(data)
+
+
+def _to_nd(batch):
+    if isinstance(batch, np.ndarray):
+        return array(batch)
+    if isinstance(batch, (list, tuple)):
+        return [_to_nd(b) for b in batch]
+    return batch
+
+
+# worker globals installed by the pool initializer (fork start method:
+# the dataset is inherited copy-on-write — no per-task pickling)
+_WORKER_DATASET = None
+_WORKER_FN = None
+
+# arrays above this size ride shared memory instead of the result pipe —
+# the CPUSharedStorageManager role: pickling a 20MB batch through a pipe
+# costs more than the decode itself
+_SHM_MIN_BYTES = 1 << 20
+
+
+def _worker_init(dataset, batchify_fn):
+    global _WORKER_DATASET, _WORKER_FN
+    _WORKER_DATASET = dataset
+    _WORKER_FN = batchify_fn
+
+
+def _ship(obj):
+    """Replace large numpy arrays with shared-memory descriptors."""
+    if isinstance(obj, np.ndarray) and obj.nbytes >= _SHM_MIN_BYTES:
+        from multiprocessing import shared_memory
+        shm = shared_memory.SharedMemory(create=True, size=obj.nbytes)
+        np.ndarray(obj.shape, obj.dtype, buffer=shm.buf)[...] = obj
+        name = shm.name
+        shm.close()
+        return ("__shm__", name, obj.shape, str(obj.dtype))
+    if isinstance(obj, (list, tuple)):
+        return [_ship(o) for o in obj]
+    return obj
+
+
+def _receive(obj):
+    """Materialize shared-memory descriptors (device copy, then unlink)."""
+    if isinstance(obj, tuple) and len(obj) == 4 and obj[0] == "__shm__":
+        from multiprocessing import shared_memory
+        _, name, shape, dtype = obj
+        shm = shared_memory.SharedMemory(name=name)
+        try:
+            view = np.ndarray(shape, np.dtype(dtype), buffer=shm.buf)
+            out = array(view)  # host→device copy happens here
+            out._data.block_until_ready()
+        finally:
+            shm.close()
+            shm.unlink()
+        return out
+    if isinstance(obj, (list, tuple)):
+        return [_receive(o) for o in obj]
+    return obj
+
+
+def _discard_shm(obj):
+    """Unlink shared-memory descriptors without materializing them."""
+    if isinstance(obj, tuple) and len(obj) == 4 and obj[0] == "__shm__":
+        from multiprocessing import shared_memory
+        try:
+            shm = shared_memory.SharedMemory(name=obj[1])
+            shm.close()
+            shm.unlink()
+        except FileNotFoundError:
+            pass
+        return
+    if isinstance(obj, (list, tuple)):
+        for o in obj:
+            _discard_shm(o)
+
+
+def _worker_task(indices):
+    return _ship(_WORKER_FN([_WORKER_DATASET[i] for i in indices]))
 
 
 class DataLoader:
     def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
                  last_batch=None, batch_sampler=None, batchify_fn=None,
                  num_workers=0, pin_memory=False, pin_device_id=0,
-                 prefetch=None, thread_pool=True, timeout=120):
+                 prefetch=None, thread_pool=False, timeout=120):
+        self._mp_pool = None  # persistent worker pool (created lazily);
+        # assigned FIRST so __del__ is safe if validation below raises
         self._dataset = dataset
         self._pin_memory = pin_memory
         self._num_workers = max(0, num_workers)
+        self._thread_pool = thread_pool
+        self._timeout = timeout
         self._prefetch = max(0, prefetch or 2 * self._num_workers)
 
         if batch_sampler is None:
@@ -65,7 +169,30 @@ class DataLoader:
             raise ValueError("batch_size, shuffle, sampler and last_batch must "
                              "not be specified if batch_sampler is specified.")
         self._batch_sampler = batch_sampler
-        self._batchify_fn = batchify_fn or default_batchify_fn
+        if batchify_fn is None:
+            batchify_fn = default_mp_batchify_fn \
+                if (self._num_workers > 0 and not thread_pool) \
+                else default_batchify_fn
+        self._batchify_fn = batchify_fn
+
+    def _get_mp_pool(self):
+        """Fork the worker pool ONCE and keep it across epochs
+        (reference keeps workers alive too; forking a parent that holds
+        an accelerator client is expensive — seconds per worker)."""
+        if self._mp_pool is None:
+            ctx = _mp.get_context("fork")
+            self._mp_pool = ctx.Pool(
+                self._num_workers, initializer=_worker_init,
+                initargs=(self._dataset, self._batchify_fn))
+        return self._mp_pool
+
+    def __del__(self):
+        pool = getattr(self, "_mp_pool", None)
+        if pool is not None:
+            try:
+                pool.terminate()
+            except Exception:
+                pass  # interpreter teardown: helpers may be gone already
 
     def __iter__(self):
         if self._num_workers == 0:
@@ -73,6 +200,8 @@ class DataLoader:
                 for batch in self._batch_sampler:
                     yield self._batchify_fn([self._dataset[idx] for idx in batch])
             return same_process_iter()
+        if not self._thread_pool:
+            return _MultiProcessIter(self)
         return _ThreadedIter(self)
 
     def __len__(self):
@@ -121,3 +250,99 @@ class _ThreadedIter:
     def __del__(self):
         # abandoned mid-epoch (break/early stop): release worker threads
         self._shutdown()
+
+
+class _MultiProcessIter:
+    """Forked process-pool iterator (reference multiprocessing workers):
+    decode/batchify run outside the GIL; batches come back as numpy and
+    are wrapped to NDArrays in the parent."""
+
+    def __init__(self, loader: DataLoader):
+        self._loader = loader
+        self._pool = loader._get_mp_pool()
+        self._batches = iter(loader._batch_sampler)
+        self._pending = deque()
+        for _ in range(max(loader._prefetch, loader._num_workers)):
+            self._submit_next()
+
+    def _submit_next(self):
+        try:
+            batch = next(self._batches)
+        except StopIteration:
+            return
+        self._pending.append(self._pool.apply_async(_worker_task, (list(batch),)))
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if not self._pending:
+            self._shutdown()
+            raise StopIteration
+        res = self._pending.popleft()
+        self._submit_next()
+        try:
+            out = res.get(timeout=self._loader._timeout)
+        except Exception:
+            self._shutdown()
+            raise
+        return _to_nd(_receive(out))
+
+    def _shutdown(self):
+        # the pool belongs to the DataLoader (persistent across epochs),
+        # but in-flight results hold shared-memory segments that only
+        # _receive unlinks — drain and discard them or /dev/shm leaks a
+        # batch per abandoned epoch
+        while self._pending:
+            res = self._pending.popleft()
+            try:
+                _discard_shm(res.get(timeout=self._loader._timeout))
+            except Exception:
+                pass
+
+    def __del__(self):
+        try:
+            self._shutdown()
+        except Exception:
+            pass
+
+
+class DevicePrefetcher:
+    """Wraps a batch iterable; keeps ``depth`` batches already
+    device_put to ``ctx`` so the accelerator never waits on H2D
+    (reference PrefetcherIter + pin_memory role; PJRT transfers are
+    async so 'prefetch' is simply converting early)."""
+
+    def __init__(self, it, ctx=None, depth=2):
+        from ...context import current_context
+        self._src = iter(it)
+        self._ctx = ctx or current_context()
+        self._depth = max(1, depth)
+        self._queue = deque()
+
+    def _to_device(self, batch):
+        if isinstance(batch, NDArray):
+            return batch.as_in_context(self._ctx)
+        if isinstance(batch, np.ndarray):
+            return array(batch, ctx=self._ctx)
+        if isinstance(batch, (list, tuple)):
+            return [self._to_device(b) for b in batch]
+        return batch
+
+    def _fill(self):
+        while len(self._queue) < self._depth:
+            try:
+                self._queue.append(self._to_device(next(self._src)))
+            except StopIteration:
+                break
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        self._fill()
+        if not self._queue:
+            raise StopIteration
+        out = self._queue.popleft()
+        self._fill()
+        return out

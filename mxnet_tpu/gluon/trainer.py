@@ -138,6 +138,29 @@ class Trainer:
 
     def _allreduce_grads(self):
         if self._kvstore is None:
+            # no kvstore configured, but multi-replica params still need
+            # the sum — otherwise _update's update-once-and-broadcast
+            # would silently drop every other replica's gradient
+            from ..ndarray.sparse import BaseSparseNDArray
+            from ..parallel import comm
+            pending = []
+            for param in self._params:
+                if param.grad_req == "null":
+                    continue
+                g = param.list_grad()
+                if len(g) > 1:
+                    if isinstance(g[0], BaseSparseNDArray):
+                        # reference contract: multi-device row_sparse
+                        # training REQUIRES a kvstore (sparse grads
+                        # cannot ride the dense stacked reduce)
+                        raise MXNetError(
+                            f"Parameter '{param.name}' has row_sparse "
+                            "gradients on multiple contexts; Trainer "
+                            "needs a kvstore for sparse multi-device "
+                            "training (kvstore=None was given)")
+                    pending.append(g)
+            if pending:
+                comm.reduce_grad_ndarrays_inplace(pending)
             return
         if self._update_on_kvstore:
             for i, param in enumerate(self._params):
@@ -172,6 +195,16 @@ class Trainer:
         self._update(ignore_stale_grad)
 
     def _update(self, ignore_stale_grad=False):
+        """Apply the optimizer ONCE per parameter (replica 0) and
+        broadcast the new weight to the other replicas — gradients are
+        identical after _allreduce_grads, so one update + copy keeps
+        optimizer state/schedules exact (no shared-state mutation per
+        replica) at the same traffic as a kvstore pull. Dense params
+        batch into a single fused multi-tensor op
+        (multi_sgd_* analog; Updater.update_multi)."""
+        from ..ndarray.sparse import BaseSparseNDArray
+
+        batch_idx, batch_w, batch_g, batch_bcast = [], [], [], []
         for i, param in enumerate(self._params):
             if param.grad_req == "null":
                 continue
@@ -179,10 +212,20 @@ class Trainer:
                 # weights now live in the kvstore; pull them back
                 self._kvstore.pull(i, param.list_data(), ignore_sparse=False)
                 continue
-            for upd, arr, grad in zip(
-                    self._updaters * len(param.list_data()),
-                    param.list_data(), param.list_grad()):
-                upd(i, grad, arr)
+            datas, grads = param.list_data(), param.list_grad()
+            if isinstance(grads[0], BaseSparseNDArray):
+                # sparse updates keep the per-key path (rsp ops)
+                self._updaters[0](i, grads[0], datas[0])
+            else:
+                batch_idx.append(i)
+                batch_w.append(datas[0])
+                batch_g.append(grads[0])
+            batch_bcast.append((datas[0], datas[1:]))
+        if batch_idx:
+            self._updaters[0].update_multi(batch_idx, batch_g, batch_w)
+        for src, rest in batch_bcast:
+            for dst in rest:
+                src.copyto(dst)
 
     def save_states(self, fname):
         assert self._optimizer is not None

@@ -391,3 +391,160 @@ class ImageRecordIterPy(ImageIter):
         label_out = batch_label[:, 0] if self.label_width == 1 else batch_label
         return DataBatch(data=[nd_array(batch_data, ctx=self.ctx)],
                          label=[nd_array(label_out, ctx=self.ctx)], pad=pad)
+
+
+# ----------------------------------------------------------------------
+# Detection augmenters (python/mxnet/image/detection.py analog).
+# Labels are MXNet detection format: (N, 5+) float rows
+# [class_id, xmin, ymin, xmax, ymax, ...] with coordinates normalized
+# to [0, 1]. Each augmenter maps (img, label) -> (img, label).
+# ----------------------------------------------------------------------
+class DetAugmenter:
+    """Detection augmenter base (reference DetAugmenter)."""
+
+    def __call__(self, src, label):
+        raise NotImplementedError
+
+
+class DetBorrowAug(DetAugmenter):
+    """Lift an image-only augmenter into the detection chain
+    (geometry-preserving ops only — reference DetBorrowAug)."""
+
+    def __init__(self, augmenter):
+        self.augmenter = augmenter
+
+    def __call__(self, src, label):
+        return self.augmenter(src), label
+
+
+class DetHorizontalFlipAug(DetAugmenter):
+    """Flip image AND box x-coordinates with probability p."""
+
+    def __init__(self, p=0.5):
+        self.p = p
+
+    def __call__(self, src, label):
+        if np.random.rand() < self.p:
+            src = src[:, ::-1]
+            label = label.copy()
+            xmin = label[:, 1].copy()
+            label[:, 1] = 1.0 - label[:, 3]
+            label[:, 3] = 1.0 - xmin
+        return src, label
+
+
+class DetRandomCropAug(DetAugmenter):
+    """Random crop keeping enough box overlap (simplified reference
+    DetRandomCropAug: IOU-style constraint via min box coverage)."""
+
+    def __init__(self, min_object_covered=0.5, min_crop_scale=0.5,
+                 max_attempts=25):
+        self.min_object_covered = min_object_covered
+        self.min_crop_scale = min_crop_scale
+        self.max_attempts = max_attempts
+
+    def __call__(self, src, label):
+        h, w = src.shape[:2]
+        for _ in range(self.max_attempts):
+            s = np.random.uniform(self.min_crop_scale, 1.0)
+            cw, ch = int(w * s), int(h * s)
+            x0 = np.random.randint(0, w - cw + 1)
+            y0 = np.random.randint(0, h - ch + 1)
+            new_label = self._crop_boxes(label, x0 / w, y0 / h, cw / w, ch / h)
+            if len(new_label):
+                return src[y0:y0 + ch, x0:x0 + cw], new_label
+        return src, label
+
+    def _crop_boxes(self, label, cx, cy, cw, ch):
+        out = []
+        for row in label:
+            xmin, ymin, xmax, ymax = row[1:5]
+            ixmin, iymin = max(xmin, cx), max(ymin, cy)
+            ixmax, iymax = min(xmax, cx + cw), min(ymax, cy + ch)
+            iw, ih = max(ixmax - ixmin, 0.0), max(iymax - iymin, 0.0)
+            area = (xmax - xmin) * (ymax - ymin)
+            if area <= 0 or iw * ih / area < self.min_object_covered:
+                continue
+            new = row.copy()
+            new[1] = (ixmin - cx) / cw
+            new[2] = (iymin - cy) / ch
+            new[3] = (ixmax - cx) / cw
+            new[4] = (iymax - cy) / ch
+            out.append(new)
+        return np.asarray(out, label.dtype).reshape(-1, label.shape[1])
+
+
+class DetRandomPadAug(DetAugmenter):
+    """Random expand-pad; boxes shrink into the padded canvas
+    (reference DetRandomPadAug)."""
+
+    def __init__(self, max_pad_scale=2.0, pad_val=(127, 127, 127)):
+        self.max_pad_scale = max_pad_scale
+        self.pad_val = pad_val
+
+    def __call__(self, src, label):
+        h, w, c = src.shape
+        s = np.random.uniform(1.0, self.max_pad_scale)
+        nh, nw = int(h * s), int(w * s)
+        y0 = np.random.randint(0, nh - h + 1)
+        x0 = np.random.randint(0, nw - w + 1)
+        canvas = np.empty((nh, nw, c), src.dtype)
+        canvas[...] = np.asarray(self.pad_val, src.dtype)
+        canvas[y0:y0 + h, x0:x0 + w] = src
+        label = label.copy()
+        label[:, 1] = (label[:, 1] * w + x0) / nw
+        label[:, 3] = (label[:, 3] * w + x0) / nw
+        label[:, 2] = (label[:, 2] * h + y0) / nh
+        label[:, 4] = (label[:, 4] * h + y0) / nh
+        return canvas, label
+
+
+class DetRandomSelectAug(DetAugmenter):
+    """Randomly pick one augmenter from a list (or skip)."""
+
+    def __init__(self, aug_list, skip_prob=0.0):
+        self.aug_list = list(aug_list)
+        self.skip_prob = skip_prob
+
+    def __call__(self, src, label):
+        if np.random.rand() >= self.skip_prob and self.aug_list:
+            aug = self.aug_list[np.random.randint(len(self.aug_list))]
+            return aug(src, label)
+        return src, label
+
+
+def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,
+                       rand_mirror=False, mean=None, std=None,
+                       min_object_covered=0.5, max_pad_scale=2.0,
+                       inter_method=2, **kwargs):
+    """Build the detection augmenter chain (reference
+    CreateDetAugmenter): geometric det augmenters + borrowed pixel
+    augmenters + final resize to data_shape."""
+    auglist = []
+    if resize > 0:
+        auglist.append(DetBorrowAug(ResizeAug(resize, inter_method)))
+    if rand_crop > 0:
+        auglist.append(DetRandomSelectAug(
+            [DetRandomCropAug(min_object_covered=min_object_covered)],
+            skip_prob=1.0 - rand_crop))
+    if rand_pad > 0:
+        auglist.append(DetRandomSelectAug(
+            [DetRandomPadAug(max_pad_scale=max_pad_scale)],
+            skip_prob=1.0 - rand_pad))
+    if rand_mirror:
+        auglist.append(DetHorizontalFlipAug(0.5))
+    auglist.append(DetBorrowAug(ForceResizeAug((data_shape[2], data_shape[1]),
+                                               inter_method)))
+    auglist.append(DetBorrowAug(CastAug()))
+    if mean is True:
+        mean = np.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = np.array([58.395, 57.12, 57.375])
+    if mean is not None and std is not None:
+        auglist.append(DetBorrowAug(ColorNormalizeAug(mean, std)))
+    return auglist
+
+
+__all__ += ["DetAugmenter", "DetBorrowAug", "DetHorizontalFlipAug",
+            "DetRandomCropAug", "DetRandomPadAug", "DetRandomSelectAug",
+            "CreateDetAugmenter"]

@@ -65,6 +65,9 @@ class KVStore:
         self._updater = None
         self._optimizer = None
         self._grad_compression = None
+        # error-feedback residual state per reduce signature (stacked
+        # sharded arrays living on their devices)
+        self._comp_state: dict = {}
 
     # -- identity ----------------------------------------------------------
     @property
@@ -91,7 +94,8 @@ class KVStore:
     def push(self, key, value, priority=0):
         keys, values = _normalize(key, value)
         for k, v in zip(keys, values):
-            merged = self._reduce(v if isinstance(v, (list, tuple)) else [v])
+            merged = self._reduce(v if isinstance(v, (list, tuple)) else [v],
+                                  key=k)
             self._apply(k, merged)
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
@@ -144,7 +148,7 @@ class KVStore:
             for dst in (o if isinstance(o, (list, tuple)) else [o]):
                 if dst._data.device not in devset:
                     return False
-        reduced = _allreduce.reduce_replica_lists(vlists, devices=devices)
+        reduced = self._compiled_reduce(tuple(keys), vlists, devices)
         for k, garr, o in zip(keys, reduced, outs):
             stored = self._get(k)
             sh = _allreduce.shard_for_device(garr, stored._data.device)
@@ -202,10 +206,19 @@ class KVStore:
         self._updater = updater
 
     def set_gradient_compression(self, compression_params):
-        # reference: 2-bit stochastic quantization worker↔server
-        # (src/kvstore/gradient_compression.cc). Stored for API parity;
-        # single-slice ICI allreduce needs no compression.
-        self._grad_compression = dict(compression_params)
+        """Enable compressed gradient reduce with error feedback
+        (reference src/kvstore/gradient_compression.cc 2-bit path).
+        {'type': '2bit', 'threshold': t} maps each (grad+residual)
+        element to {±t, 0}; {'type': 'int8'} uses symmetric per-tensor
+        int8 with in-graph scales. The quantize/residual-update/reduce
+        pipeline compiles into the fused all-reduce program
+        (parallel/comm.py reduce_compressed_replica_lists)."""
+        params = dict(compression_params)
+        ctype = params.get("type", "2bit")
+        if ctype not in ("2bit", "int8", "none"):
+            raise MXNetError(f"unsupported gradient compression type {ctype!r}")
+        self._grad_compression = None if ctype == "none" else params
+        self._comp_state.clear()
 
     # -- optimizer state io (reference save/load via updater pickle) ------
     def save_optimizer_states(self, fname, dump_optimizer=False):
@@ -226,7 +239,24 @@ class KVStore:
             raise MXNetError(f"key {k} was not initialized")
         return self._store[k]
 
-    def _reduce(self, arrays):
+    def _compiled_reduce(self, sig, vlists, devices):
+        """Fused reduce for a batch of keys — compressed (with
+        per-signature error-feedback state) when set_gradient_compression
+        configured a supported type, plain stacked-sum otherwise."""
+        comp = self._grad_compression
+        if comp and comp.get("type") in ("2bit", "int8"):
+            state_key = (sig, devices,
+                         tuple((tuple(v[0].shape), str(v[0].dtype))
+                               for v in vlists))
+            reduced, new_res = _allreduce.reduce_compressed_replica_lists(
+                vlists, self._comp_state.get(state_key), devices=devices,
+                ctype=comp["type"],
+                threshold=float(comp.get("threshold", 0.5)))
+            self._comp_state[state_key] = new_res
+            return reduced
+        return _allreduce.reduce_replica_lists(vlists, devices=devices)
+
+    def _reduce(self, arrays, key=None):
         """Sum per-device values — a single compiled stacked-sum whose
         output sharding is replicated, which the XLA SPMD partitioner
         lowers to an ICI AllReduce (the CommDevice/NCCL analog)."""
@@ -235,7 +265,7 @@ class KVStore:
             datas = [a._data for a in arrays]
             devices = self._reduce_devices([datas])
             if devices is not None:
-                garr = _allreduce.reduce_replica_lists([datas], devices=devices)[0]
+                garr = self._compiled_reduce((key,), [datas], devices)[0]
                 return _wrap(_allreduce.shard_for_device(garr, datas[0].device),
                              merged.ctx)
             # fallback: replicas sharing a device (tests) — eager add tree
@@ -290,16 +320,16 @@ class DistKVStore(KVStore):
             return None
         return super()._reduce_devices(value_lists)
 
-    def _reduce(self, arrays):
+    def _reduce(self, arrays, key=None):
         if self.num_workers > 1:
             datas = [a._data for a in arrays]
             devices = self._reduce_devices([datas])
             if devices is not None:
-                garr = _allreduce.reduce_replica_lists([datas], devices=devices)[0]
+                garr = self._compiled_reduce((key,), [datas], devices)[0]
                 return _wrap(_allreduce.shard_for_device(garr, datas[0].device),
                              arrays[0].ctx)
-            return _cross_process_allreduce(super()._reduce(arrays))
-        return super()._reduce(arrays)
+            return _cross_process_allreduce(super()._reduce(arrays, key=key))
+        return super()._reduce(arrays, key=key)
 
     def barrier(self):
         """_barrier analog (ps-lite Barrier): sync all workers."""
@@ -310,9 +340,12 @@ class DistKVStore(KVStore):
 
 def _maybe_init_distributed() -> bool:
     """jax.distributed.initialize from DMLC-compatible env (tools/launch.py
-    sets MXNET_TPU_COORDINATOR / DMLC_PS_ROOT_URI+PORT, num/id)."""
-    if jax.process_count() > 1:
-        return True
+    sets MXNET_TPU_COORDINATOR / DMLC_PS_ROOT_URI+PORT, num/id).
+
+    The env check runs FIRST: merely asking jax.process_count() would
+    initialize the local XLA backend, after which the multi-process
+    rendezvous is impossible (initialize() must precede any backend
+    use)."""
     coord = os.environ.get("MXNET_TPU_COORDINATOR")
     n = os.environ.get("MXNET_TPU_NUM_PROCS") or os.environ.get("DMLC_NUM_WORKER")
     pid = os.environ.get("MXNET_TPU_PROC_ID") or os.environ.get("DMLC_WORKER_ID")
@@ -325,7 +358,10 @@ def _maybe_init_distributed() -> bool:
                                        num_processes=int(n),
                                        process_id=int(pid))
             return True
-        except Exception:  # already initialized or single-proc fallback
+        except Exception as e:  # already initialized or single-proc fallback
+            import sys
+            print(f"mxnet_tpu: jax.distributed.initialize failed: {e!r}",
+                  file=sys.stderr)
             return jax.process_count() > 1
     return jax.process_count() > 1
 

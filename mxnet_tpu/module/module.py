@@ -271,21 +271,7 @@ class Module(BaseModule):
     def _reduce_without_kvstore(grads):
         """Sum replica grads in one compiled all-reduce, write back."""
         from ..parallel import comm
-        vlists = [[g._data for g in glist] for glist in grads]
-        if comm.can_fast_reduce(vlists) and len(vlists[0]) > 1 and \
-                len({a.device for a in vlists[0]}) == len(vlists[0]):
-            reduced = comm.reduce_replica_lists(vlists)
-            for glist, garr in zip(grads, reduced):
-                for g in glist:
-                    g._set_data(comm.shard_for_device(garr, g._data.device))
-        else:  # replicas sharing one device (tests): eager sum
-            for glist in grads:
-                total = glist[0]
-                for g in glist[1:]:
-                    total = total + g.as_in_context(total.ctx)
-                for g in glist:
-                    g._set_data(total._data if g.ctx == total.ctx
-                                else total.as_in_context(g.ctx)._data)
+        comm.reduce_grad_ndarrays_inplace(grads)
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized

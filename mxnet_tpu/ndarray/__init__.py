@@ -21,6 +21,7 @@ from . import op_impl_nn  # noqa: F401
 from . import op_impl_optimizer  # noqa: F401
 from . import op_impl_random  # noqa: F401
 from . import op_impl_rnn  # noqa: F401
+from . import op_impl_quant  # noqa: F401
 
 # generate mx.nd.<op> functions into this module
 _GENERATED = _register.populate_namespace(__name__)
@@ -89,6 +90,18 @@ def save(fname, data):
 def load(fname):
     from .serialization import load as _load
     return _load(fname)
+
+
+def save_sharded(prefix, data):
+    """Multi-host sharded checkpoint: each process writes its shards
+    (serialization.py save_sharded — SURVEY §5.4 extension)."""
+    from .serialization import save_sharded as _ss
+    return _ss(prefix, data)
+
+
+def load_sharded(prefix, ctx=None):
+    from .serialization import load_sharded as _ls
+    return _ls(prefix, ctx)
 
 
 def concatenate(arrays, axis=0, always_copy=True):

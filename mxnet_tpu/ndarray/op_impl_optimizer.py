@@ -194,6 +194,87 @@ def lamb_update_phase1(weight, grad, mean, var, beta1=0.9, beta2=0.999,
     return m / (jnp.sqrt(v) + epsilon) + wd * weight, new_mean, new_var
 
 
+# ----------------------------------------------------------------------
+# multi-tensor fused updates (reference multi_sgd_update/multi_sgd_mom_
+# update/multi_mp_sgd_*: one kernel updating MANY parameters — the
+# anti-small-op-overhead device for Trainer.step; here one XLA
+# computation covering the whole parameter list)
+# ----------------------------------------------------------------------
+def _per_weight(vals, i, default):
+    try:
+        return float(vals[i])
+    except (TypeError, IndexError):
+        return float(vals) if vals is not None else default
+
+
+@register_op("multi_sgd_update", differentiable=False)
+def multi_sgd_update(*args, lrs=(), wds=(), rescale_grad=1.0,
+                     clip_gradient=-1.0, num_weights=None):
+    """args = (w0, g0, w1, g1, ...); returns the updated weights."""
+    n = int(num_weights) if num_weights is not None else len(args) // 2
+    outs = []
+    for i in range(n):
+        w, g = args[2 * i], args[2 * i + 1]
+        gs = _rescale_clip(g, rescale_grad, clip_gradient)
+        outs.append(w - _per_weight(lrs, i, 0.01)
+                    * (gs + _per_weight(wds, i, 0.0) * w))
+    return tuple(outs)
+
+
+@register_op("multi_sgd_mom_update", differentiable=False)
+def multi_sgd_mom_update(*args, lrs=(), wds=(), momentum=0.0,
+                         rescale_grad=1.0, clip_gradient=-1.0,
+                         num_weights=None):
+    """args = (w0, g0, m0, w1, g1, m1, ...); returns
+    (w0', m0', w1', m1', ...) — moms are written back via out=/mutates
+    at the caller."""
+    n = int(num_weights) if num_weights is not None else len(args) // 3
+    outs = []
+    for i in range(n):
+        w, g, m = args[3 * i], args[3 * i + 1], args[3 * i + 2]
+        gs = _rescale_clip(g, rescale_grad, clip_gradient)
+        new_m = momentum * m - _per_weight(lrs, i, 0.01) \
+            * (gs + _per_weight(wds, i, 0.0) * w)
+        outs.append(w + new_m)
+        outs.append(new_m)
+    return tuple(outs)
+
+
+@register_op("multi_mp_sgd_update", differentiable=False)
+def multi_mp_sgd_update(*args, lrs=(), wds=(), rescale_grad=1.0,
+                        clip_gradient=-1.0, num_weights=None):
+    """args = (w0, g0, w32_0, ...); returns (w0', w32_0', ...)."""
+    n = int(num_weights) if num_weights is not None else len(args) // 3
+    outs = []
+    for i in range(n):
+        w, g, w32 = args[3 * i], args[3 * i + 1], args[3 * i + 2]
+        gs = _rescale_clip(g.astype(jnp.float32), rescale_grad, clip_gradient)
+        new32 = w32 - _per_weight(lrs, i, 0.01) \
+            * (gs + _per_weight(wds, i, 0.0) * w32)
+        outs.append(new32.astype(w.dtype))
+        outs.append(new32)
+    return tuple(outs)
+
+
+@register_op("multi_mp_sgd_mom_update", differentiable=False)
+def multi_mp_sgd_mom_update(*args, lrs=(), wds=(), momentum=0.0,
+                            rescale_grad=1.0, clip_gradient=-1.0,
+                            num_weights=None):
+    """args = (w0, g0, m0, w32_0, ...); returns (w0', m0', w32_0', ...)."""
+    n = int(num_weights) if num_weights is not None else len(args) // 4
+    outs = []
+    for i in range(n):
+        w, g, m, w32 = args[4 * i:4 * i + 4]
+        gs = _rescale_clip(g.astype(jnp.float32), rescale_grad, clip_gradient)
+        new_m = momentum * m - _per_weight(lrs, i, 0.01) \
+            * (gs + _per_weight(wds, i, 0.0) * w32)
+        new32 = w32 + new_m
+        outs.append(new32.astype(w.dtype))
+        outs.append(new_m)
+        outs.append(new32)
+    return tuple(outs)
+
+
 @register_op("lamb_update_phase2", differentiable=False)
 def lamb_update_phase2(weight, g, r1, r2, lr=0.01, lower_bound=-1.0,
                        upper_bound=-1.0):

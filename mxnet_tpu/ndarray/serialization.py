@@ -149,6 +149,73 @@ def load(fname: str):
     return dict(zip(names, arrays))
 
 
+# ----------------------------------------------------------------------
+# Sharded (multi-host) checkpointing — the SURVEY §5.4 extension beyond
+# the reference: each process writes ONLY its addressable shards, so a
+# pod-sized model checkpoints without gathering weights to one host.
+# Every shard file is itself a valid .params NDArray file whose entry
+# names encode (param, global shape, shard start offsets).
+# ----------------------------------------------------------------------
+def _shard_entry_name(name, global_shape, starts):
+    return f"{name}::shape={tuple(global_shape)}::start={tuple(starts)}"
+
+
+def _parse_shard_entry(entry):
+    name, shape_s, start_s = entry.split("::")
+    shape = tuple(int(x) for x in shape_s[len("shape=("):-1].split(",") if x.strip())
+    start = tuple(int(x) for x in start_s[len("start=("):-1].split(",") if x.strip())
+    return name, shape, start
+
+
+def save_sharded(prefix: str, data: dict):
+    """Write this process's addressable shards of each (possibly
+    sharded) array to ``{prefix}.shard-R-of-N.params``. Replicated
+    values are written once (replica_id 0 only). All processes must
+    call this (SPMD)."""
+    import jax
+
+    from .ndarray import _wrap
+
+    rank, nproc = jax.process_index(), jax.process_count()
+    entries = {}
+    for name, arr in data.items():
+        ja = arr._data
+        gshape = ja.shape
+        for s in ja.addressable_shards:
+            if s.replica_id != 0:
+                continue
+            starts = tuple((idx.start or 0) if isinstance(idx, slice) else 0
+                           for idx in s.index) if s.index else (0,) * ja.ndim
+            entries[_shard_entry_name(name, gshape, starts)] = \
+                _wrap(s.data, arr.ctx)
+    fname = f"{prefix}.shard-{rank:05d}-of-{nproc:05d}.params"
+    save(fname, entries)
+    return fname
+
+
+def load_sharded(prefix: str, ctx: Context | None = None) -> dict:
+    """Reassemble a sharded checkpoint written by :func:`save_sharded`.
+    Reads every shard file under the prefix (single reader or each host
+    reading all shards — loading only local shards is an optimization
+    for the trainer restore path)."""
+    import glob
+
+    files = sorted(glob.glob(f"{prefix}.shard-*.params"))
+    if not files:
+        raise MXNetError(f"no shard files found for prefix {prefix!r}")
+    buffers: dict = {}
+    for f in files:
+        for entry, arr in load(f).items():
+            name, gshape, start = _parse_shard_entry(entry)
+            npv = arr.asnumpy()
+            if name not in buffers:
+                buffers[name] = np.zeros(gshape, npv.dtype)
+            sel = tuple(slice(st, st + sz) for st, sz in zip(start, npv.shape))
+            buffers[name][sel] = npv
+    ctx = ctx or current_context()
+    return {k: nd_array(v, ctx=ctx) for k, v in buffers.items()}
+
+
 def save_bytes(data) -> bytes:
     """In-memory variant (MXNDArraySaveRawBytes analog)."""
     import io

@@ -86,6 +86,13 @@ class Optimizer:
         else:
             self.update(index, weight, grad, state)
 
+    def update_multi(self, indices, weights, grads, states):
+        """Aggregated update over many parameters — base: a loop;
+        optimizers with multi-tensor fused ops (SGD → multi_sgd_*)
+        override to one op call (reference aggregate_num path)."""
+        for i, w, g, s in zip(indices, weights, grads, states):
+            self.update_multi_precision(i, w, g, s)
+
     # -- lr/wd plumbing (mirrors reference semantics)
     def set_learning_rate(self, lr):
         if self.lr_scheduler is not None:
@@ -178,9 +185,42 @@ class SGD(Optimizer):
             kw["momentum"] = self.momentum
             _invoke(_get_op("sgd_mom_update"), [weight, grad, state], kw, out=weight)
 
+    def update_multi(self, indices, weights, grads, states):
+        """ONE fused multi-tensor op over the whole parameter list
+        (reference multi_sgd_update/multi_sgd_mom_update — SURVEY §2.1
+        optimizer row): one XLA computation, one dispatch, per step."""
+        from ..ndarray.sparse import BaseSparseNDArray
+        if (self.multi_precision
+                or any(isinstance(g, BaseSparseNDArray) for g in grads)
+                or any(isinstance(w, BaseSparseNDArray) for w in weights)):
+            return super().update_multi(indices, weights, grads, states)
+        self._update_count(list(indices))
+        lrs = [self._get_lr(i) for i in indices]
+        wds = [self._get_wd(i) for i in indices]
+        kw = {"lrs": lrs, "wds": wds, "rescale_grad": self.rescale_grad,
+              "num_weights": len(indices)}
+        if self.clip_gradient is not None:
+            kw["clip_gradient"] = self.clip_gradient
+        if self.momentum == 0.0:
+            args = []
+            for w, g in zip(weights, grads):
+                args += [w, g]
+            _invoke(_get_op("multi_sgd_update"), args, kw, out=list(weights))
+        else:
+            kw["momentum"] = self.momentum
+            args = []
+            outs = []
+            for w, g, m in zip(weights, grads, states):
+                args += [w, g, m]
+                outs += [w, m]
+            _invoke(_get_op("multi_sgd_mom_update"), args, kw, out=outs)
+
 
 @register
 class NAG(SGD):
+    # NAG math differs from SGD — no multi_sgd fusion
+    update_multi = Optimizer.update_multi
+
     def update(self, index, weight, grad, state):
         self._update_count(index)
         kw = self._common_kwargs(index)
@@ -436,6 +476,9 @@ class DCASGD(Optimizer):
 class LBSGD(SGD):
     """Large-batch SGD with LARS-style layer-wise scaling (reference LBSGD)."""
 
+    # LARS trust-ratio math differs per layer — no multi_sgd fusion
+    update_multi = Optimizer.update_multi
+
     def __init__(self, momentum=0.0, warmup_strategy="linear",
                  warmup_epochs=5, batch_scale=1, updates_per_epoch=32,
                  begin_epoch=0, num_epochs=60, **kwargs):
@@ -485,6 +528,17 @@ class Updater:
             self.states[index] = self.optimizer.create_state_multi_precision(index, weight)
             self.states_synced[index] = True
         self.optimizer.update_multi_precision(index, weight, grad, self.states[index])
+
+    def update_multi(self, indices, grads, weights):
+        """Aggregated entry (Trainer fast path): one fused op for
+        optimizers that support it."""
+        for index, weight in zip(indices, weights):
+            if index not in self.states:
+                self.states[index] = \
+                    self.optimizer.create_state_multi_precision(index, weight)
+                self.states_synced[index] = True
+        self.optimizer.update_multi(indices, weights, grads,
+                                    [self.states[i] for i in indices])
 
     def set_states(self, states):
         payload = pickle.loads(states)

@@ -26,9 +26,25 @@ class _RandomState:
     """
 
     def __init__(self):
-        self.key = jax.random.PRNGKey(int(time.time() * 1e6) % (2**31))
+        # the key is materialized LAZILY: creating a PRNGKey initializes
+        # the JAX backend, and that must not happen at import time —
+        # mx.kv.create('dist_sync') needs to run
+        # jax.distributed.initialize first (multi-process rendezvous is
+        # impossible once the local backend is up)
+        self._seed = int(time.time() * 1e6) % (2**31)
+        self._key = None
         self.lock = threading.Lock()
         self._tls = threading.local()
+
+    @property
+    def key(self):
+        if self._key is None:
+            self._key = jax.random.PRNGKey(self._seed)
+        return self._key
+
+    @key.setter
+    def key(self, k):
+        self._key = k
 
     @property
     def trace_keys(self):

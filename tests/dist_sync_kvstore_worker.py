@@ -1,0 +1,72 @@
+"""Worker body for the distributed kvstore test — the
+tests/nightly/dist_sync_kvstore.py analog (SURVEY §4): launched via
+tools/launch.py with 2 local processes, each holding 2 virtual CPU
+devices, asserting DistKVStore invariants over the REAL multi-process
+jax.distributed stack (loopback rendezvous = the ps-lite scheduler
+role).
+
+Invariants (reference nightly test):
+- rank/num_workers reflect the launch;
+- init + pull broadcasts the initial value;
+- push sums gradients across every device of every worker;
+- fused pushpull reduces all keys in one compiled program whose HLO
+  contains an all-reduce;
+- barrier() synchronizes.
+"""
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, kvstore
+from mxnet_tpu.parallel import comm
+
+
+def main():
+    kv = kvstore.create("dist_sync")
+    rank, nw = kv.rank, kv.num_workers
+    assert nw == 2, f"expected 2 workers, got {nw}"
+    assert jax.device_count() == 4, jax.device_count()
+    ctxs = [mx.cpu(0), mx.cpu(1)]
+
+    # init + broadcast
+    kv.init("a", nd.full((4, 3), 7.0))
+    out = nd.zeros((4, 3))
+    kv.pull("a", out=out)
+    assert (out.asnumpy() == 7.0).all()
+
+    # push: worker r contributes 2r+1 and 2r+2 from its two devices
+    vals = [nd.full((4, 3), float(rank * 2 + i + 1), ctx=c)
+            for i, c in enumerate(ctxs)]
+    kv.push("a", vals)
+    kv.pull("a", out=out)
+    assert (out.asnumpy() == 10.0).all(), out.asnumpy()  # 1+2+3+4
+
+    kv.barrier()
+
+    # fused multi-key pushpull across processes
+    kv.init(0, nd.zeros((2,)))
+    kv.init(1, nd.zeros((3, 2)))
+    grads = [[nd.full((2,), float(rank + 1), ctx=c) for c in ctxs],
+             [nd.full((3, 2), float(10 * (rank + 1)), ctx=c) for c in ctxs]]
+    kv.pushpull([0, 1], grads, out=grads)
+    assert np.allclose(grads[0][0].asnumpy(), 6.0), grads[0][0].asnumpy()
+    assert np.allclose(grads[1][1].asnumpy(), 60.0), grads[1][1].asnumpy()
+    hlo = comm.last_hlo_text()
+    assert hlo and "all-reduce" in hlo, "cross-process reduce not compiled to all-reduce"
+
+    kv.barrier()
+    print(f"DIST_WORKER_{rank}_OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,58 @@
+"""Detection augmenter tests (python/mxnet/image/detection.py scope)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import image
+
+
+def _img_label():
+    img = np.random.RandomState(0).randint(0, 255, (40, 60, 3), np.uint8)
+    label = np.array([[1.0, 0.25, 0.25, 0.5, 0.75],
+                      [3.0, 0.0, 0.0, 0.2, 0.2]], np.float32)
+    return img, label
+
+
+def test_det_horizontal_flip():
+    img, label = _img_label()
+    aug = image.DetHorizontalFlipAug(p=1.0)
+    out, lab = aug(img, label)
+    assert np.array_equal(out, img[:, ::-1])
+    assert np.allclose(lab[0, [1, 3]], [1 - 0.5, 1 - 0.25])
+    assert np.allclose(lab[:, [2, 4]], label[:, [2, 4]])  # y unchanged
+    # flip twice = identity
+    out2, lab2 = aug(out, lab)
+    assert np.array_equal(out2, img)
+    assert np.allclose(lab2, label, atol=1e-6)
+
+
+def test_det_random_pad_keeps_boxes_inside():
+    np.random.seed(1)
+    img, label = _img_label()
+    out, lab = image.DetRandomPadAug(max_pad_scale=2.0)(img, label)
+    assert out.shape[0] >= img.shape[0] and out.shape[1] >= img.shape[1]
+    assert (lab[:, 1:5] >= 0).all() and (lab[:, 1:5] <= 1).all()
+    # box areas shrink by the pad ratio
+    a0 = (label[:, 3] - label[:, 1]) * (label[:, 4] - label[:, 2])
+    a1 = (lab[:, 3] - lab[:, 1]) * (lab[:, 4] - lab[:, 2])
+    assert (a1 <= a0 + 1e-6).all()
+
+
+def test_det_random_crop_covers_objects():
+    np.random.seed(2)
+    img, label = _img_label()
+    aug = image.DetRandomCropAug(min_object_covered=0.5, min_crop_scale=0.7)
+    out, lab = aug(img, label)
+    assert lab.shape[1] == 5
+    assert (lab[:, 1:5] >= -1e-6).all() and (lab[:, 1:5] <= 1 + 1e-6).all()
+
+
+def test_create_det_augmenter_chain():
+    np.random.seed(3)
+    img, label = _img_label()
+    chain = image.CreateDetAugmenter((3, 32, 32), rand_crop=0.5, rand_pad=0.5,
+                                     rand_mirror=True, mean=True, std=True)
+    out, lab = img, label
+    for aug in chain:
+        out, lab = aug(out, lab)
+    assert out.shape == (32, 32, 3)
+    assert out.dtype == np.float32

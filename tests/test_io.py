@@ -144,3 +144,46 @@ def test_csv_iter(tmp_path):
     batches = list(it)
     assert len(batches) == 3
     assert batches[0].data[0].shape == (2, 2)
+
+
+def test_dataloader_multiprocess_workers():
+    """num_workers>0 with thread_pool=False runs forked decode workers
+    (reference multiprocessing+shared-mem contract): batches match the
+    single-process loader exactly, in order."""
+    from mxnet_tpu.gluon.data import DataLoader
+    from mxnet_tpu.gluon.data.dataset import ArrayDataset
+    x = np.arange(96, dtype=np.float32).reshape(24, 4)
+    y = np.arange(24, dtype=np.float32)
+    ds = ArrayDataset(x, y)
+    ref = [(bx.asnumpy(), by.asnumpy())
+           for bx, by in DataLoader(ds, batch_size=8, shuffle=False)]
+    got = [(bx.asnumpy(), by.asnumpy())
+           for bx, by in DataLoader(ds, batch_size=8, shuffle=False,
+                                    num_workers=3, thread_pool=False)]
+    assert len(got) == len(ref) == 3
+    for (rx, ry), (gx, gy) in zip(ref, got):
+        assert np.array_equal(rx, gx) and np.array_equal(ry, gy)
+
+
+def test_dataloader_threaded_workers_still_work():
+    from mxnet_tpu.gluon.data import DataLoader
+    from mxnet_tpu.gluon.data.dataset import ArrayDataset
+    x = np.arange(32, dtype=np.float32).reshape(8, 4)
+    ds = ArrayDataset(x)
+    got = [b.asnumpy() for b in DataLoader(ds, batch_size=4, shuffle=False,
+                                           num_workers=2, thread_pool=True)]
+    assert np.array_equal(np.concatenate(got), x)
+
+
+def test_device_prefetcher():
+    from mxnet_tpu.gluon.data import DataLoader, DevicePrefetcher
+    from mxnet_tpu.gluon.data.dataset import ArrayDataset
+    import mxnet_tpu as mx
+    x = np.arange(32, dtype=np.float32).reshape(8, 4)
+    ds = ArrayDataset(x)
+    ctx = mx.cpu(1)
+    batches = list(DevicePrefetcher(
+        DataLoader(ds, batch_size=4, shuffle=False), ctx=ctx, depth=2))
+    assert len(batches) == 2
+    assert all(b.ctx == ctx for b in batches)
+    assert np.array_equal(np.concatenate([b.asnumpy() for b in batches]), x)

@@ -184,3 +184,106 @@ def test_fused_pushpull_foreign_device_falls_back():
     kv.pushpull("w", vals, out=vals)
     for v in vals:
         assert_almost_equal(v, np.full((2, 2), 3.0, np.float32))
+
+
+def test_gradient_compression_2bit_error_feedback():
+    """2-bit compression: per step each element reduces to a multiple of
+    the threshold; over many steps error feedback preserves the total
+    gradient mass (reference gradient_compression.cc contract)."""
+    kv = kvstore.create("device")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 1.0})
+    kv.init("w", nd.zeros((4,)))
+    g = 0.3
+    total = np.zeros(4, np.float32)
+    steps = 10
+    for _ in range(steps):
+        vals = [nd.full((4,), g, ctx=c) for c in CTXS]
+        out = [nd.zeros((4,), ctx=c) for c in CTXS]
+        kv.pushpull("w", vals, out=out)
+        r = out[0].asnumpy()
+        # each device contributes an element of {−1, 0, +1}·threshold
+        assert np.all(np.isin(r, [-2.0, -1.0, 0.0, 1.0, 2.0])), r
+        total += r
+    want = steps * g * len(CTXS)
+    assert np.all(np.abs(total - want) <= 1.0 + 1e-6), (total, want)
+
+
+def test_gradient_compression_int8_close_to_exact():
+    kv = kvstore.create("device")
+    kv.set_gradient_compression({"type": "int8"})
+    kv.init("w", nd.zeros((8,)))
+    rs = np.random.RandomState(0)
+    a = rs.randn(8).astype(np.float32)
+    b = rs.randn(8).astype(np.float32)
+    vals = [nd.array(a, ctx=CTXS[0]), nd.array(b, ctx=CTXS[1])]
+    out = [nd.zeros((8,), ctx=c) for c in CTXS]
+    kv.pushpull("w", vals, out=out)
+    want = a + b
+    amax = max(np.abs(a).max(), np.abs(b).max())
+    assert np.abs(out[0].asnumpy() - want).max() <= 2 * amax / 127 + 1e-6
+
+
+def test_gradient_compression_rejects_unknown_type():
+    kv = kvstore.create("device")
+    with pytest.raises(Exception, match="unsupported"):
+        kv.set_gradient_compression({"type": "4bit"})
+
+
+def test_trainer_with_compression_still_trains():
+    np.random.seed(8)
+    x = np.random.randn(16, 6).astype(np.float32)
+    y = np.random.randint(0, 3, (16,)).astype(np.float32)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu", in_units=6), nn.Dense(3, in_units=8))
+    net.initialize(init=mx.initializer.Xavier(), ctx=CTXS)
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1}, kvstore="device",
+                            compression_params={"type": "int8"})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    before = net[0].weight.data(CTXS[0]).asnumpy().copy()
+    for _ in range(3):
+        xs = split_and_load(nd.array(x), CTXS)
+        ys = split_and_load(nd.array(y), CTXS)
+        with autograd.record():
+            losses = [loss_fn(net(xi), yi) for xi, yi in zip(xs, ys)]
+        for l in losses:
+            l.backward()
+        trainer.step(16)
+    after0 = net[0].weight.data(CTXS[0]).asnumpy()
+    after1 = net[0].weight.data(CTXS[1]).asnumpy()
+    assert not np.allclose(after0, before)
+    assert_almost_equal(after0, after1)
+
+
+def test_trainer_no_kvstore_still_reduces_replicas():
+    """kvstore=None with multi-device replicas: grads must still sum
+    (review regression — update-once-and-broadcast would otherwise drop
+    every other replica's half of the batch)."""
+    np.random.seed(9)
+    x = np.random.randn(8, 6).astype(np.float32)
+    y = np.random.randint(0, 3, (8,)).astype(np.float32)
+
+    def one_step(ctx_list, kvstore):
+        mx.random.seed(7)
+        np.random.seed(7)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(8, activation="relu", in_units=6),
+                nn.Dense(3, in_units=8))
+        net.initialize(init=mx.initializer.Xavier(), ctx=ctx_list)
+        loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.1}, kvstore=kvstore)
+        xs = split_and_load(nd.array(x), ctx_list)
+        ys = split_and_load(nd.array(y), ctx_list)
+        with autograd.record():
+            losses = [loss_fn(net(xi), yi) for xi, yi in zip(xs, ys)]
+        for l in losses:
+            l.backward()
+        trainer.step(8)
+        return [p.data(ctx_list[0]).asnumpy()
+                for p in net.collect_params().values()]
+
+    ref = one_step([mx.cpu(0)], None)
+    multi = one_step(CTXS, None)
+    for r, m in zip(ref, multi):
+        assert_almost_equal(m, r, rtol=1e-5, atol=1e-6)

@@ -767,6 +767,14 @@ COVERED_ELSEWHERE = {
     "signum_update": "tests/test_optimizer_ops.py",
     "lamb_update_phase1": "tests/test_optimizer_ops.py",
     "lamb_update_phase2": "tests/test_optimizer_ops.py",
+    "multi_sgd_update": "tests/test_optimizer_ops.py",
+    "multi_sgd_mom_update": "tests/test_optimizer_ops.py",
+    "multi_mp_sgd_update": "tests/test_optimizer_ops.py",
+    "multi_mp_sgd_mom_update": "tests/test_optimizer_ops.py",
+    "quantize_v2": "tests/test_quantization.py",
+    "dequantize_v2": "tests/test_quantization.py",
+    "quantized_fully_connected": "tests/test_quantization.py",
+    "quantized_conv": "tests/test_quantization.py",
 }
 
 _HERE_TABLES = (set(UNARY) | set(BINARY) | set(SCALAR) | set(REDUCE))

@@ -1,0 +1,140 @@
+#!/usr/bin/env python
+"""im2rec — build RecordIO image datasets (reference tools/im2rec.py).
+
+Two modes, same CLI shape as the reference:
+
+  # 1. create a .lst file from an image directory tree
+  python tools/im2rec.py mydata ./images --list --recursive
+
+  # 2. pack the listed images into mydata.rec/mydata.idx
+  python tools/im2rec.py mydata ./images --resize 256 --quality 95 \
+      --num-thread 8
+
+Labels come from the directory structure in --list mode (one class per
+subdirectory, sorted) or from the .lst file (index\\tlabel\\tpath).
+Decode/encode runs on a thread pool (PIL releases the GIL for
+encode/decode); records are written in .lst order.
+"""
+from __future__ import annotations
+
+import argparse
+import concurrent.futures as futures
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+IMG_EXTS = (".jpg", ".jpeg", ".png", ".bmp", ".webp")
+
+
+def make_list(args):
+    """Scan the image root and write prefix.lst (reference make_list)."""
+    root = args.root
+    classes = []
+    if args.recursive:
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+    entries = []
+    if classes:
+        for label, cls in enumerate(classes):
+            for dirpath, _, files in os.walk(os.path.join(root, cls)):
+                for f in sorted(files):
+                    if f.lower().endswith(IMG_EXTS):
+                        rel = os.path.relpath(os.path.join(dirpath, f), root)
+                        entries.append((float(label), rel))
+    else:
+        for f in sorted(os.listdir(root)):
+            if f.lower().endswith(IMG_EXTS):
+                entries.append((0.0, f))
+    if args.shuffle:
+        import random
+        random.Random(407).shuffle(entries)
+    lst = args.prefix + ".lst"
+    with open(lst, "w") as fo:
+        for i, (label, rel) in enumerate(entries):
+            fo.write(f"{i}\t{label}\t{rel}\n")
+    print(f"wrote {len(entries)} entries to {lst}")
+    if classes:
+        with open(args.prefix + "_classes.txt", "w") as fo:
+            fo.write("\n".join(classes) + "\n")
+
+
+def read_list(path):
+    with open(path) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            idx = int(parts[0])
+            label = [float(x) for x in parts[1:-1]]
+            yield idx, label[0] if len(label) == 1 else label, parts[-1]
+
+
+def make_record(args):
+    """Encode listed images into prefix.rec/prefix.idx."""
+    import numpy as np
+    from PIL import Image
+
+    from mxnet_tpu import recordio
+
+    lst = args.prefix + ".lst"
+    if not os.path.exists(lst):
+        sys.exit(f"{lst} not found — run with --list first")
+
+    def load(item):
+        idx, label, rel = item
+        path = os.path.join(args.root, rel)
+        img = Image.open(path).convert("RGB")
+        if args.resize:
+            w, h = img.size
+            s = args.resize / min(w, h)
+            img = img.resize((max(1, int(w * s)), max(1, int(h * s))),
+                             Image.BILINEAR)
+        if args.center_crop:
+            w, h = img.size
+            c = min(w, h)
+            img = img.crop(((w - c) // 2, (h - c) // 2,
+                            (w + c) // 2, (h + c) // 2))
+        header = recordio.IRHeader(0, label, idx, 0)
+        return idx, recordio.pack_img(header, np.asarray(img),
+                                      quality=args.quality,
+                                      img_fmt=args.encoding)
+
+    items = list(read_list(lst))
+    rec = recordio.MXIndexedRecordIO(args.prefix + ".idx",
+                                     args.prefix + ".rec", "w")
+    n = 0
+    with futures.ThreadPoolExecutor(max_workers=args.num_thread) as pool:
+        for idx, payload in pool.map(load, items):
+            rec.write_idx(idx, payload)
+            n += 1
+            if n % 1000 == 0:
+                print(f"packed {n} images")
+    rec.close()
+    print(f"wrote {n} records to {args.prefix}.rec")
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("prefix", help="output prefix (prefix.lst/.rec/.idx)")
+    ap.add_argument("root", help="image root directory")
+    ap.add_argument("--list", action="store_true",
+                    help="create the .lst file instead of packing")
+    ap.add_argument("--recursive", action="store_true",
+                    help="one class per subdirectory")
+    ap.add_argument("--shuffle", action="store_true")
+    ap.add_argument("--resize", type=int, default=0,
+                    help="resize shorter side to this many pixels")
+    ap.add_argument("--center-crop", action="store_true")
+    ap.add_argument("--quality", type=int, default=95)
+    ap.add_argument("--encoding", default=".jpg", choices=[".jpg", ".png"])
+    ap.add_argument("--num-thread", type=int, default=4)
+    args = ap.parse_args()
+    if args.list:
+        make_list(args)
+    else:
+        make_record(args)
+
+
+if __name__ == "__main__":
+    main()
