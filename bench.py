@@ -348,7 +348,10 @@ def main_lstm():
     import mxnet_tpu as mx
     from mxnet_tpu.gluon.block import functionalize
 
-    batch = int(os.environ.get("BENCH_BATCH", "32"))
+    # batch 128 measured fastest (sweep r2: 32→126k, 64→144k,
+    # 128→213k tok/s — the 650-wide cell matmuls need the batch to
+    # fill the MXU; reference cuDNN word_lm used 32-80)
+    batch = int(os.environ.get("BENCH_BATCH", "128"))
     seqlen = int(os.environ.get("BENCH_SEQLEN", "35"))
     vocab, emb, hid, layers = 33278, 650, 650, 2
     ctx = mx.current_context()

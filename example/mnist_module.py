@@ -1,0 +1,61 @@
+#!/usr/bin/env python
+"""Legacy Module-API MNIST training
+(reference example/image-classification/train_mnist.py): Symbol graph +
+Module.fit over an NDArrayIter, compiled executor underneath.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def build_symbol():
+    data = mx.sym.var("data")
+    net = mx.sym.Flatten(data)
+    net = mx.sym.FullyConnected(net, num_hidden=128, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=64, name="fc2")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=10, name="fc3")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=8)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args()
+    n = 512 if args.quick else 60000
+    if args.quick:
+        args.epochs = min(args.epochs, 4)
+
+    rs = np.random.RandomState(0)
+    w = rs.randn(784, 10).astype(np.float32)
+    x = rs.rand(n, 1, 28, 28).astype(np.float32)
+    y = (x.reshape(n, -1) @ w).argmax(1).astype(np.float32)
+
+    train_iter = mx.io.NDArrayIter(x, y, batch_size=args.batch_size,
+                                   shuffle=True, label_name="softmax_label")
+    mod = mx.module.Module(build_symbol(), label_names=["softmax_label"])
+    mod.fit(train_iter, num_epoch=args.epochs, optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr},
+            eval_metric="acc",
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 50))
+    eval_iter = mx.io.NDArrayIter(x, y, batch_size=args.batch_size,
+                                  label_name="softmax_label")
+    preds = mod.predict(eval_iter).asnumpy().argmax(1)
+    acc = (preds == y[:len(preds)]).mean()
+    print(f"final accuracy: {acc:.4f}")
+    return acc
+
+
+if __name__ == "__main__":
+    final_acc = main()
+    assert final_acc > 0.8, f"did not converge: {final_acc}"

@@ -1,0 +1,141 @@
+#!/usr/bin/env python
+"""ResNet ImageNet-style training
+(reference example/image-classification/train_imagenet.py): model-zoo
+ResNet, multi-device data parallelism through the KVStore fused
+all-reduce, RecordIO input via the multiprocess pipeline or synthetic
+resident batches, bf16 compute.
+
+    # single device, synthetic data, small smoke run
+    python example/train_imagenet.py --quick
+
+    # all local devices, RecordIO input
+    python example/train_imagenet.py --data-train train.rec --num-devices 4
+
+    # 2 processes (dist_sync over loopback / DCN)
+    python tools/launch.py -n 2 --launcher local \
+        python example/train_imagenet.py --kv-store dist_sync --quick
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon.model_zoo import vision
+from mxnet_tpu.gluon.utils import split_and_load
+
+
+def get_ctx_list(num_devices):
+    plat = "tpu" if mx.context.num_tpus() else "cpu"
+    avail = mx.context.num_tpus() or 8
+    n = min(num_devices, avail)
+    return [mx.Context(plat, i) for i in range(n)]
+
+
+def synthetic_batches(batch_size, image, steps, classes):
+    rs = np.random.RandomState(0)
+    x = rs.rand(batch_size, 3, image, image).astype(np.float32)
+    y = rs.randint(0, classes, batch_size).astype(np.float32)
+    for _ in range(steps):
+        yield nd.array(x), nd.array(y)
+
+
+def recordio_batches(path, batch_size, image, workers):
+    from mxnet_tpu.gluon.data import DataLoader, DevicePrefetcher
+    from mxnet_tpu.gluon.data.dataset import Dataset
+    from mxnet_tpu import recordio
+
+    idx = os.path.splitext(path)[0] + ".idx"
+
+    class RecDataset(Dataset):
+        def __init__(self):
+            self._rec = None
+            with open(idx) as f:
+                self._len = sum(1 for _ in f)
+
+        def __len__(self):
+            return self._len
+
+        def __getitem__(self, i):
+            if self._rec is None:
+                self._rec = recordio.MXIndexedRecordIO(idx, path, "r")
+            header, img = recordio.unpack_img(self._rec.read_idx(i))
+            return img.transpose(2, 0, 1), np.float32(header.label)
+
+    loader = DataLoader(RecDataset(), batch_size=batch_size, shuffle=True,
+                        num_workers=workers, last_batch="discard")
+    for xb, yb in DevicePrefetcher(loader, depth=3):
+        yield xb.astype("float32") / 255.0, yb
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--network", default="resnet50_v1",
+                    choices=[n for n in dir(vision) if n.startswith(("resnet", "mobilenet"))])
+    ap.add_argument("--classes", type=int, default=1000)
+    ap.add_argument("--batch-size", type=int, default=128,
+                    help="GLOBAL batch (split across devices)")
+    ap.add_argument("--image-shape", type=int, default=224)
+    ap.add_argument("--num-devices", type=int, default=1)
+    ap.add_argument("--kv-store", default="device")
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--epochs", type=int, default=1)
+    ap.add_argument("--steps-per-epoch", type=int, default=50)
+    ap.add_argument("--data-train", default=None, help=".rec file (synthetic if absent)")
+    ap.add_argument("--data-workers", type=int, default=8)
+    ap.add_argument("--dtype", default="bfloat16")
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args()
+    if args.quick:
+        args.batch_size, args.image_shape, args.classes = 16, 64, 10
+        args.steps_per_epoch, args.epochs = 5, 1
+        args.network, args.dtype = "resnet18_v1", "float32"
+
+    ctxs = get_ctx_list(args.num_devices)
+    net = getattr(vision, args.network)(classes=args.classes)
+    net.initialize(init=mx.initializer.Xavier(), ctx=ctxs)
+    if args.dtype != "float32":
+        net.cast(args.dtype)
+    net.hybridize()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": args.lr, "momentum": 0.9,
+                             "wd": 1e-4}, kvstore=args.kv_store)
+    metric = mx.metric.Accuracy()
+
+    for epoch in range(args.epochs):
+        metric.reset()
+        tic = time.time()
+        seen = 0
+        batches = (recordio_batches(args.data_train, args.batch_size,
+                                    args.image_shape, args.data_workers)
+                   if args.data_train else
+                   synthetic_batches(args.batch_size, args.image_shape,
+                                     args.steps_per_epoch, args.classes))
+        for i, (xb, yb) in enumerate(batches):
+            if args.dtype != "float32":
+                xb = xb.astype(args.dtype)
+            xs = split_and_load(xb, ctxs)
+            ys = split_and_load(yb, ctxs)
+            with autograd.record():
+                outs = [net(x) for x in xs]
+                losses = [loss_fn(o, y) for o, y in zip(outs, ys)]
+            for l in losses:
+                l.backward()
+            trainer.step(xb.shape[0])
+            metric.update(ys, outs)
+            seen += xb.shape[0]
+            if args.data_train and i + 1 >= args.steps_per_epoch:
+                break
+        name, acc = metric.get()
+        dt = time.time() - tic
+        print(f"epoch {epoch}: {seen / dt:.1f} img/s {name}={acc:.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -48,6 +48,7 @@ from . import profiler
 from . import parallel
 from . import test_utils
 from . import runtime
+from . import checkpoint
 from .util import is_np_array
 
 from .attribute import AttrScope

@@ -1,0 +1,181 @@
+"""Preemption-aware checkpoint / resume.
+
+The reference's recovery story is "checkpoint + manual restart"
+(SURVEY §5.3: ps-lite heartbeats exist but nothing elastic; §5.4:
+save_checkpoint/load_checkpoint). TPU fleets add a harder requirement —
+preemption with a short grace window — so this module is the planned
+§5.3 extension: a :class:`CheckpointManager` that
+
+- saves periodically (``every_n_steps``) through the normal parameter/
+  trainer-state serialization (``.params``/``.states`` + a JSON meta
+  sidecar);
+- installs signal handlers (SIGTERM by default — the preemption notice)
+  that snapshot IMMEDIATELY and then re-deliver to any previous
+  handler;
+- prunes to the newest ``max_keep`` checkpoints;
+- discovers the latest checkpoint at startup (``latest_step`` /
+  ``restore``) so a restarted job resumes where it died.
+
+Multi-host: every process calls ``step()`` at the same cadence (SPMD);
+only process 0 writes the single-file checkpoint unless
+``sharded=True``, in which case each process writes its shards through
+``nd.save_sharded``.
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+import signal as _signal
+import time
+
+from .base import MXNetError
+
+__all__ = ["CheckpointManager"]
+
+
+class CheckpointManager:
+    def __init__(self, prefix, net=None, trainer=None, max_keep=5,
+                 every_n_steps=None, signals=(_signal.SIGTERM,),
+                 sharded=False):
+        self._prefix = prefix
+        self._net = net
+        self._trainer = trainer
+        self._max_keep = max_keep
+        self._every = every_n_steps
+        self._sharded = sharded
+        self._step = 0
+        self._preempted = False
+        self._prev_handlers = {}
+        for sig in signals or ():
+            try:
+                self._prev_handlers[sig] = _signal.signal(sig, self._on_signal)
+            except (ValueError, OSError):
+                pass  # not on the main thread / unsupported signal
+
+    # -- signal path -------------------------------------------------------
+    def _on_signal(self, signum, frame):
+        """Preemption notice: snapshot NOW (the grace window may be
+        seconds), then re-deliver with the previous disposition — a
+        SIG_DFL SIGTERM must still terminate the process (swallowing it
+        would make the job ignore kill requests)."""
+        self._preempted = True
+        try:
+            self.save(tag="preempt")
+        finally:
+            prev = self._prev_handlers.get(signum)
+            if callable(prev):
+                prev(signum, frame)
+            elif prev == _signal.SIG_DFL:
+                _signal.signal(signum, _signal.SIG_DFL)
+                os.kill(os.getpid(), signum)
+            # SIG_IGN: swallow, matching the prior disposition
+
+    @property
+    def preempted(self):
+        return self._preempted
+
+    # -- cadence -----------------------------------------------------------
+    def step(self, increment=1):
+        """Advance the step counter; save when the cadence fires. Call
+        once per optimizer step (or per epoch with every_n_steps=1)."""
+        self._step += increment
+        if self._every and self._step % self._every == 0:
+            self.save()
+        return self._step
+
+    # -- save / prune ------------------------------------------------------
+    def _rank(self):
+        try:
+            import jax
+            return jax.process_index(), jax.process_count()
+        except Exception:
+            return 0, 1
+
+    def save(self, tag=None):
+        from . import ndarray as nd
+
+        rank, nproc = self._rank()
+        base = f"{self._prefix}-{self._step:07d}"
+        wrote = []
+        if self._net is not None:
+            if self._sharded:
+                params = {name: p.data()
+                          for name, p in self._net.collect_params().items()}
+                wrote.append(nd.save_sharded(base, params))
+            elif rank == 0:
+                self._net.save_parameters(base + ".params")
+                wrote.append(base + ".params")
+        if self._trainer is not None and rank == 0:
+            self._trainer.save_states(base + ".states")
+            wrote.append(base + ".states")
+        if rank == 0:
+            meta = {"step": self._step, "time": time.time(),
+                    "tag": tag or "periodic", "sharded": self._sharded,
+                    "num_processes": nproc}
+            with open(base + ".meta.json", "w") as f:
+                json.dump(meta, f)
+            wrote.append(base + ".meta.json")
+            self._prune()
+        return wrote
+
+    def _checkpoints(self):
+        metas = sorted(glob.glob(f"{self._prefix}-*.meta.json"))
+        out = []
+        for m in metas:
+            try:
+                with open(m) as f:
+                    out.append((json.load(f)["step"], m[:-len(".meta.json")]))
+            except (ValueError, KeyError):
+                continue
+        return sorted(out)
+
+    def _prune(self):
+        ckpts = self._checkpoints()
+        for _, base in ckpts[:-self._max_keep] if self._max_keep else []:
+            for f in glob.glob(base + ".*") + glob.glob(base + ".shard-*"):
+                try:
+                    os.remove(f)
+                except OSError:
+                    pass
+
+    # -- resume ------------------------------------------------------------
+    def latest_step(self):
+        """Step of the newest checkpoint, or None if none exist."""
+        ckpts = self._checkpoints()
+        return ckpts[-1][0] if ckpts else None
+
+    def restore(self, net=None, trainer=None):
+        """Load the newest checkpoint into net/trainer; returns its step
+        (0 when nothing to restore — fresh start)."""
+        from . import ndarray as nd
+
+        ckpts = self._checkpoints()
+        if not ckpts:
+            return 0
+        step, base = ckpts[-1]
+        with open(base + ".meta.json") as f:
+            meta = json.load(f)
+        net = net or self._net
+        trainer = trainer or self._trainer
+        if net is not None:
+            if meta.get("sharded"):
+                params = nd.load_sharded(base)
+                pd = net.collect_params()
+                for name, arr in params.items():
+                    pd[name].set_data(arr)
+            else:
+                net.load_parameters(base + ".params")
+        if trainer is not None and os.path.exists(base + ".states"):
+            trainer.load_states(base + ".states")
+        self._step = step
+        return step
+
+    def close(self):
+        """Restore the previous signal handlers."""
+        for sig, prev in self._prev_handlers.items():
+            try:
+                _signal.signal(sig, prev)
+            except (ValueError, OSError):
+                pass
+        self._prev_handlers.clear()

@@ -1,2 +1,3 @@
-from .amp import init, init_trainer, scale_loss, unscale, convert_model, LossScaler
+from .amp import (init, disable, is_initialized, target_dtype, init_trainer,
+                  scale_loss, unscale, convert_model, LossScaler)
 from . import lists

@@ -1,31 +1,82 @@
 """Automatic mixed precision (python/mxnet/contrib/amp analog, v≥1.5).
 
-The reference rewrites graphs to insert amp_cast/amp_multicast around
-an allow/deny op list and adds dynamic loss scaling. TPU-native design:
-the half type is bfloat16, whose exponent range equals fp32 — so
-dynamic loss scaling is unnecessary (kept as an API-compatible no-op
-path that still works if the user opts into float16). ``init()``
-switches the default cast policy; ``convert_model`` casts a Block's
-params per the allow/deny lists in lists.py.
+The reference rewrites the GRAPH, inserting amp_cast/amp_multicast
+around an allow/deny op list. TPU-native form of the same rewrite:
+``init()`` installs a dispatch-level cast hook
+(ndarray/register.set_dispatch_cast_hook) that casts every op's tensor
+inputs per the lists — MXU-bound ops (matmul/conv/RNN) to the half
+type, numerics-sensitive ops to fp32, widest-type ops to a common
+float. Because hybridize/CachedOp traces and the compiled symbolic
+executor both run through the hooked dispatch, compiled graphs carry
+the casts exactly like the reference's rewritten symbols. The half type
+is bfloat16 — exponent range equals fp32, so dynamic loss scaling stays
+at 1.0 (the fp16 scaler is kept API-compatible).
 """
 from __future__ import annotations
 
 import logging
 
+import jax.numpy as jnp
+
 from ...base import MXNetError
 from . import lists
 
-_STATE = {"initialized": False, "target_dtype": "bfloat16"}
+_STATE = {"initialized": False, "target_dtype": "bfloat16",
+          "target_ops": None, "fp32_ops": None, "widest_ops": None}
+
+_HALF = {"bfloat16": jnp.bfloat16, "float16": jnp.float16}
+_FLOATS = (jnp.float32, jnp.bfloat16, jnp.float16, jnp.float64)
+
+
+def _is_float(a):
+    return getattr(a, "dtype", None) in _FLOATS
+
+
+def _cast_hook(op, arrays):
+    """The amp_cast/amp_multicast insertion, applied at dispatch."""
+    name = op.name
+    if name in _STATE["target_ops"]:
+        half = _HALF[_STATE["target_dtype"]]
+        return [a.astype(half) if _is_float(a) and a.dtype != half else a
+                for a in arrays]
+    if name in _STATE["fp32_ops"]:
+        return [a.astype(jnp.float32)
+                if _is_float(a) and a.dtype != jnp.float32 else a
+                for a in arrays]
+    if name in _STATE["widest_ops"]:
+        dts = [a.dtype for a in arrays if _is_float(a)]
+        if len(set(dts)) > 1:
+            widest = jnp.float32 if jnp.float32 in dts else max(
+                dts, key=lambda d: jnp.finfo(d).bits)
+            return [a.astype(widest) if _is_float(a) else a for a in arrays]
+    return arrays
 
 
 def init(target_dtype="bfloat16", target_precision_ops=None,
          conditional_fp32_ops=None, fp32_ops=None):
-    """Enable AMP. On TPU the natural target is bfloat16."""
+    """Enable AMP: install the dispatch cast hook (reference
+    amp.init graph-patching analog). Extra op lists extend the
+    defaults in lists.py."""
+    from ...ndarray.register import set_dispatch_cast_hook
+
     if target_dtype not in ("bfloat16", "float16"):
         raise MXNetError("target_dtype must be bfloat16 or float16")
     _STATE["initialized"] = True
     _STATE["target_dtype"] = target_dtype
+    _STATE["target_ops"] = set(lists.TARGET_DTYPE_OPS) | set(target_precision_ops or ())
+    _STATE["fp32_ops"] = set(lists.FP32_OPS) | set(fp32_ops or ()) \
+        | set(conditional_fp32_ops or ())
+    _STATE["widest_ops"] = set(lists.WIDEST_TYPE_CASTS)
+    set_dispatch_cast_hook(_cast_hook)
     logging.info("AMP initialized (target %s)", target_dtype)
+
+
+def disable():
+    """Remove the cast hook (mainly for tests)."""
+    from ...ndarray.register import set_dispatch_cast_hook
+
+    _STATE["initialized"] = False
+    set_dispatch_cast_hook(None)
 
 
 def is_initialized():
@@ -37,11 +88,16 @@ def target_dtype():
 
 
 class LossScaler:
-    """Dynamic loss scaling (needed for fp16 only; bf16 scale stays 1)."""
+    """Dynamic loss scaling. Only active for float16 — bf16's exponent
+    range equals fp32, so the scale pins to 1 and the per-step overflow
+    scan (a host sync over every gradient) is skipped entirely."""
+
+    MAX_SCALE = 2.0 ** 24
 
     def __init__(self, init_scale=2.0 ** 16, scale_factor=2.0,
                  scale_window=2000):
-        self._scale = 1.0 if _STATE["target_dtype"] == "bfloat16" else init_scale
+        self.active = _STATE["target_dtype"] == "float16"
+        self._scale = init_scale if self.active else 1.0
         self._factor = scale_factor
         self._window = scale_window
         self._unskipped = 0
@@ -52,6 +108,8 @@ class LossScaler:
 
     def has_overflow(self, params):
         import numpy as np
+        if not self.active:
+            return False
         for p in params:
             if p.grad_req != "null" and p._grad is not None:
                 g = p.grad().asnumpy()
@@ -66,15 +124,31 @@ class LossScaler:
         else:
             self._unskipped += 1
             if self._unskipped >= self._window:
-                self._scale *= self._factor
+                self._scale = min(self._scale * self._factor, self.MAX_SCALE)
                 self._unskipped = 0
 
 
 def init_trainer(trainer):
-    """Attach a loss scaler to a gluon Trainer."""
-    trainer._amp_loss_scaler = LossScaler()
+    """Attach a loss scaler to a gluon Trainer and wrap step() so an
+    overflowed iteration SKIPS the weight update (reference AMP
+    contract) instead of applying inf/NaN gradients."""
+    scaler = LossScaler()
+    trainer._amp_loss_scaler = scaler
     trainer._amp_original_scale = trainer._scale
-    trainer._scale = trainer._scale / trainer._amp_loss_scaler.loss_scale
+    trainer._scale = trainer._scale / scaler.loss_scale
+    orig_step = trainer.step
+
+    def amp_step(batch_size, ignore_stale_grad=False):
+        skip = scaler.has_overflow(trainer._params)
+        scaler.update_scale(skip)
+        trainer._scale = trainer._amp_original_scale / scaler.loss_scale
+        if skip:
+            logging.warning("AMP: gradient overflow, skipping update "
+                            "(loss scale -> %g)", scaler.loss_scale)
+            return
+        return orig_step(batch_size, ignore_stale_grad)
+
+    trainer.step = amp_step
     return trainer
 
 
@@ -82,24 +156,31 @@ class scale_loss:
     """with amp.scale_loss(loss, trainer) as scaled: scaled.backward()"""
 
     def __init__(self, loss, trainer):
+        from ... import autograd
+
         self._trainer = trainer
         scaler = getattr(trainer, "_amp_loss_scaler", None)
         s = scaler.loss_scale if scaler else 1.0
-        if isinstance(loss, (list, tuple)):
-            self._scaled = [l * s for l in loss]
+        if s == 1.0:
+            # bf16 default: no scaling needed — pass the taped loss
+            # through untouched (a multiply here would sit OUTSIDE the
+            # record scope and detach the graph)
+            self._scaled = loss
         else:
-            self._scaled = loss * s
+            # fp16: the scaling multiply must be ON the tape even though
+            # scale_loss is conventionally entered after record() closes
+            with autograd.record(train_mode=autograd.is_training()):
+                if isinstance(loss, (list, tuple)):
+                    self._scaled = [l * s for l in loss]
+                else:
+                    self._scaled = loss * s
 
     def __enter__(self):
         return self._scaled
 
     def __exit__(self, *exc):
-        scaler = getattr(self._trainer, "_amp_loss_scaler", None)
-        if scaler is not None:
-            skip = scaler.has_overflow(self._trainer._params)
-            scaler.update_scale(skip)
-            self._trainer._scale = (self._trainer._amp_original_scale
-                                    / scaler.loss_scale)
+        # overflow handling moved into the wrapped trainer.step (which
+        # must SKIP the update); nothing to do at scope exit
         return False
 
 

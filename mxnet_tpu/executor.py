@@ -61,7 +61,8 @@ class Executor:
         from . import random as _random
         from . import autograd
 
-        key = (training,
+        from .ndarray.register import dispatch_cast_generation
+        key = (training, dispatch_cast_generation(),  # AMP state
                tuple((n, tuple(a.shape), str(a.dtype))
                      for n, a in zip(names, arrays)))
         op = self._graph_cache.get(key)

@@ -413,7 +413,9 @@ class HybridBlock(Block):
             param_arrays = [p.data(ctx) for p in params]
 
         training = _autograd.is_training()
-        key = (tuple((tuple(a.shape), str(a.dtype)) for a in inputs), training)
+        from ..ndarray.register import dispatch_cast_generation
+        key = (tuple((tuple(a.shape), str(a.dtype)) for a in inputs), training,
+               dispatch_cast_generation())  # AMP on/off → fresh trace
         entry = self._cached_graph.get(key)
         if entry is None:
             entry = self._build_cached_op(args, inputs, params, ctx, training)

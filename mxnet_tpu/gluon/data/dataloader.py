@@ -100,15 +100,17 @@ def _ship(obj):
 
 
 def _receive(obj):
-    """Materialize shared-memory descriptors (device copy, then unlink)."""
+    """Materialize shared-memory descriptors: one host memcpy out of
+    the segment, unlink immediately, return numpy — the (async) device
+    transfer happens downstream (_to_nd / DevicePrefetcher), so the
+    result-drain loop never blocks on H2D."""
     if isinstance(obj, tuple) and len(obj) == 4 and obj[0] == "__shm__":
         from multiprocessing import shared_memory
         _, name, shape, dtype = obj
         shm = shared_memory.SharedMemory(name=name)
         try:
-            view = np.ndarray(shape, np.dtype(dtype), buffer=shm.buf)
-            out = array(view)  # host→device copy happens here
-            out._data.block_until_ready()
+            out = np.array(np.ndarray(shape, np.dtype(dtype), buffer=shm.buf),
+                           copy=True)
         finally:
             shm.close()
             shm.unlink()
@@ -151,6 +153,7 @@ class DataLoader:
         self._thread_pool = thread_pool
         self._timeout = timeout
         self._prefetch = max(0, prefetch or 2 * self._num_workers)
+        self._fork_safe = None  # probed lazily on first __iter__
 
         if batch_sampler is None:
             if batch_size is None:
@@ -200,9 +203,29 @@ class DataLoader:
                 for batch in self._batch_sampler:
                     yield self._batchify_fn([self._dataset[idx] for idx in batch])
             return same_process_iter()
-        if not self._thread_pool:
+        if not self._thread_pool and self._dataset_is_fork_safe():
             return _MultiProcessIter(self)
         return _ThreadedIter(self)
+
+    def _dataset_is_fork_safe(self):
+        """Forked workers must not touch JAX: probe one sample and fall
+        back to thread workers (with the eager batchify) when
+        __getitem__ produces device arrays (e.g. the vision datasets'
+        NDArray transforms)."""
+        if self._fork_safe is None:
+            def has_nd(x):
+                if isinstance(x, NDArray):
+                    return True
+                if isinstance(x, (list, tuple)):
+                    return any(has_nd(i) for i in x)
+                return False
+            try:
+                self._fork_safe = not has_nd(self._dataset[0])
+            except Exception:
+                self._fork_safe = False
+            if not self._fork_safe and self._batchify_fn is default_mp_batchify_fn:
+                self._batchify_fn = default_batchify_fn
+        return self._fork_safe
 
     def __len__(self):
         return len(self._batch_sampler)

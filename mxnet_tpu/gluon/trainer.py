@@ -252,4 +252,9 @@ class Trainer:
                 states = f.read()
             for updater in self._updaters:
                 updater.set_states(states)
-                updater.optimizer = self._optimizer
+            # adopt the restored optimizer (it carries num_update /
+            # index counts — resetting to the fresh one would restart
+            # Adam bias correction and lr schedules)
+            self._optimizer = self._updaters[0].optimizer
+        self._optimizer.param_dict = {i: p for i, p in
+                                      enumerate(self._params)}

@@ -201,10 +201,11 @@ def lamb_update_phase1(weight, grad, mean, var, beta1=0.9, beta2=0.999,
 # computation covering the whole parameter list)
 # ----------------------------------------------------------------------
 def _per_weight(vals, i, default):
-    try:
-        return float(vals[i])
-    except (TypeError, IndexError):
-        return float(vals) if vals is not None else default
+    if vals is None:
+        return default
+    if isinstance(vals, (tuple, list)):
+        return float(vals[i]) if i < len(vals) else default
+    return float(vals)  # one scalar for all weights
 
 
 @register_op("multi_sgd_update", differentiable=False)

@@ -140,6 +140,30 @@ def _as_jax(x, ctx: Context | None):
     raise MXNetError(f"cannot convert {type(x)} to tensor input")
 
 
+# AMP dispatch-cast hook (contrib/amp): when installed, every op's
+# tensor inputs pass through it before execution — the TPU-native form
+# of the reference's amp_cast/amp_multicast graph rewrite. It applies
+# during BOTH eager dispatch and hybridize/CachedOp tracing (traces run
+# through invoke), so compiled graphs carry the casts.
+_DISPATCH_CAST_HOOK = None
+# bumped on every hook change: compiled-graph caches (CachedOp, the
+# symbolic executor) key on this so traces built before amp.init() are
+# not served after it (and vice versa)
+_DISPATCH_CAST_GENERATION = 0
+
+
+def set_dispatch_cast_hook(fn):
+    """Install (or clear with None) the AMP cast hook:
+    fn(op, [jax arrays]) -> [jax arrays]."""
+    global _DISPATCH_CAST_HOOK, _DISPATCH_CAST_GENERATION
+    _DISPATCH_CAST_HOOK = fn
+    _DISPATCH_CAST_GENERATION += 1
+
+
+def dispatch_cast_generation():
+    return _DISPATCH_CAST_GENERATION
+
+
 def invoke(op: Op, inputs, params=None, out=None, ctx: Context | None = None, name=None):
     """Eager dispatch of one op — `Imperative::Invoke` analog.
 
@@ -182,10 +206,10 @@ def invoke(op: Op, inputs, params=None, out=None, ctx: Context | None = None, na
     device = ctx.jax_device
     with jax.default_device(device):
         if record:
-            fn = functools.partial(_call_positional, op.fn, params, len(arrays))
+            fn = functools.partial(_call_positional, op, params, len(arrays))
             raw_out, vjp_fn = jax.vjp(fn, *arrays)
         else:
-            raw_out = op.fn(*arrays, **params)
+            raw_out = _call_positional(op, params, len(arrays), *arrays)
             vjp_fn = None
 
     multi = isinstance(raw_out, (tuple, list))
@@ -229,9 +253,14 @@ def invoke(op: Op, inputs, params=None, out=None, ctx: Context | None = None, na
     return results
 
 
-def _call_positional(fn, params, nargs, *arrays):
-    """Closure helper so jax.vjp sees only tensor positionals."""
-    return fn(*arrays, **params)
+def _call_positional(op, params, nargs, *arrays):
+    """Closure helper so jax.vjp sees only tensor positionals. The AMP
+    cast hook applies HERE — inside the differentiated function — so
+    vjp transposes the casts and cotangent dtypes line up with each
+    producer's output dtype."""
+    if _DISPATCH_CAST_HOOK is not None:
+        arrays = _DISPATCH_CAST_HOOK(op, arrays)
+    return op.fn(*arrays, **params)
 
 
 def _make_ns_function(op: Op, fname: str):

@@ -543,7 +543,13 @@ class Updater:
     def set_states(self, states):
         payload = pickle.loads(states)
         if isinstance(payload, tuple) and len(payload) == 2:
-            self.states, self.optimizer.num_update = payload
+            second = payload[1]
+            if isinstance(second, Optimizer):
+                # dump_optimizer=True payload: the optimizer itself
+                # (with its schedules/num_update) rides along
+                self.states, self.optimizer = payload
+            else:
+                self.states, self.optimizer.num_update = payload
         else:
             self.states = payload
         self.states_synced = {k: False for k in self.states}

@@ -261,9 +261,12 @@ class Symbol:
         computation instead of a per-op engine push). Visible-output
         slicing mirrors invoke(); in-place `mutates` have no meaning on
         traced values and are skipped."""
+        from ..ndarray import register as _reg
         from ..ndarray.register import _parse_param
 
         def apply(op, flat, attrs):
+            if _reg._DISPATCH_CAST_HOOK is not None:  # AMP rewrite
+                flat = _reg._DISPATCH_CAST_HOOK(op, flat)
             params = {k: _parse_param(v) for k, v in attrs.items()
                       if v is not None}
             out = op.fn(*flat, **params)

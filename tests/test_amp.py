@@ -1,0 +1,93 @@
+"""AMP cast-insertion tests (reference contrib/amp graph rewrite):
+the dispatch hook must half-cast MXU ops, fp32-pin numerics-sensitive
+ops, widest-cast mixed elementwise ops, apply inside compiled graphs,
+and train stably.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.contrib import amp
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+@pytest.fixture
+def amp_on():
+    amp.init(target_dtype="bfloat16")
+    yield
+    amp.disable()
+
+
+def test_target_op_runs_half(amp_on):
+    x = nd.ones((2, 4))
+    w = nd.ones((3, 4))
+    out = nd.FullyConnected(x, w, None, num_hidden=3, no_bias=True)
+    assert str(out.dtype) == "bfloat16"
+
+
+def test_fp32_op_pinned(amp_on):
+    x = nd.ones((2, 4), dtype="bfloat16")
+    out = nd.softmax(x)
+    assert str(out.dtype) == "float32"
+
+
+def test_widest_cast(amp_on):
+    a = nd.ones((2, 2), dtype="bfloat16")
+    b = nd.ones((2, 2), dtype="float32")
+    out = nd.broadcast_add(a, b)
+    assert str(out.dtype) == "float32"
+
+
+def test_no_cast_when_disabled():
+    x = nd.ones((2, 4))
+    w = nd.ones((3, 4))
+    out = nd.FullyConnected(x, w, None, num_hidden=3, no_bias=True)
+    assert str(out.dtype) == "float32"
+
+
+def test_amp_inside_hybridized_graph(amp_on):
+    """The cast rides the CachedOp trace — compiled forward emits the
+    half type for the matmul (graph-rewrite parity)."""
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, in_units=4))
+    net.initialize()
+    net.hybridize()
+    out = net(nd.ones((2, 4)))
+    assert str(out.dtype) == "bfloat16"
+
+
+def test_amp_symbolic_executor(amp_on):
+    data = mx.sym.var("data")
+    s = mx.sym.FullyConnected(data, num_hidden=4, no_bias=True, name="fc")
+    ex = s.simple_bind(mx.cpu(0), data=(2, 6), fc_weight=(4, 6))
+    outs = ex.forward()
+    assert str(outs[0].dtype) == "bfloat16"
+
+
+def test_amp_training_converges(amp_on):
+    np.random.seed(0)
+    mx.random.seed(0)
+    n, d, c = 256, 10, 3
+    w = np.random.randn(d, c).astype(np.float32)
+    x = np.random.randn(n, d).astype(np.float32)
+    y = (x @ w).argmax(1).astype(np.float32)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation="relu"), nn.Dense(c))
+    net.initialize(init=mx.initializer.Xavier())
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 5e-3})
+    amp.init_trainer(trainer)
+    for _ in range(25):
+        for i in range(0, n, 64):
+            xb, yb = nd.array(x[i:i + 64]), nd.array(y[i:i + 64])
+            with autograd.record():
+                out = net(xb)
+                loss = loss_fn(out, yb)
+            with amp.scale_loss(loss, trainer) as scaled:
+                scaled.backward()
+            trainer.step(64)
+    pred = net(nd.array(x)).asnumpy().argmax(1)
+    assert (pred == y).mean() > 0.8

@@ -1,0 +1,34 @@
+"""Example-script checks (reference example/ tree): every script
+compiles; the fastest one runs end-to-end --quick as a subprocess.
+Full --quick runs of the other examples are exercised out-of-band
+(they take minutes on the CPU mesh)."""
+import os
+import py_compile
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLES = ["mnist_gluon.py", "mnist_module.py", "train_imagenet.py",
+            "word_lm.py", "wide_deep.py"]
+
+
+@pytest.mark.parametrize("script", EXAMPLES)
+def test_example_compiles(script):
+    py_compile.compile(os.path.join(ROOT, "example", script), doraise=True)
+
+
+@pytest.mark.timeout(400)
+def test_mnist_module_quick_runs():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT
+    env.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=2")
+    script = os.path.join(ROOT, "example", "mnist_module.py")
+    code = ("import jax; jax.config.update('jax_platforms','cpu');"
+            "import sys, runpy; sys.argv=['m','--quick'];"
+            f"runpy.run_path(r'{script}', run_name='__main__')")
+    res = subprocess.run([sys.executable, "-c", code], env=env, cwd=ROOT,
+                         capture_output=True, text=True, timeout=380)
+    assert res.returncode == 0, res.stdout[-2000:] + res.stderr[-2000:]
+    assert "final accuracy" in res.stdout

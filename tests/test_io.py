@@ -187,3 +187,26 @@ def test_device_prefetcher():
     assert len(batches) == 2
     assert all(b.ctx == ctx for b in batches)
     assert np.array_equal(np.concatenate([b.asnumpy() for b in batches]), x)
+
+
+def test_dataloader_ndarray_dataset_falls_back_to_threads():
+    """A dataset whose __getitem__ yields NDArrays must not be run by
+    forked workers (fork + XLA deadlock hazard) — the loader probes and
+    falls back to thread workers (review regression)."""
+    from mxnet_tpu.gluon.data import DataLoader
+    from mxnet_tpu.gluon.data.dataset import Dataset
+    from mxnet_tpu import nd as _nd
+
+    class NDDataset(Dataset):
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, i):
+            return _nd.full((4,), float(i))
+
+    loader = DataLoader(NDDataset(), batch_size=4, shuffle=False,
+                        num_workers=2, thread_pool=False)
+    batches = [b.asnumpy() for b in loader]
+    assert len(batches) == 2
+    assert np.allclose(batches[0][:, 0], [0, 1, 2, 3])
+    assert loader._fork_safe is False

@@ -336,3 +336,12 @@ def test_trainer_fused_update_single_dispatch():
         opt_mod._invoke = orig
     assert calls.count("multi_sgd_mom_update") == 1, calls
     assert "sgd_mom_update" not in calls, calls
+
+
+def test_multi_sgd_default_lrs_usable():
+    """Declared defaults lrs=()/wds=() must fall back to the op's
+    default hyperparameters, not crash (review regression)."""
+    w, g = _wg()
+    out = nd.zeros(SHAPE)
+    nd.multi_sgd_update(nd.array(w), nd.array(g), out=[out], num_weights=1)
+    assert_almost_equal(out.asnumpy(), w - 0.01 * g, rtol=1e-5, atol=1e-6)
