@@ -31,9 +31,10 @@ from mxnet_tpu.gluon.utils import split_and_load
 
 
 def get_ctx_list(num_devices):
+    import jax
     plat = "tpu" if mx.context.num_tpus() else "cpu"
-    avail = mx.context.num_tpus() or 8
-    n = min(num_devices, avail)
+    avail = mx.context.num_tpus() or len(jax.local_devices())
+    n = max(1, min(num_devices, avail))
     return [mx.Context(plat, i) for i in range(n)]
 
 

@@ -20,7 +20,7 @@ from mxnet_tpu.gluon import nn, rnn
 from mxnet_tpu.gluon.utils import clip_global_norm
 
 
-class WordLM(gluon.HybridBlock):
+class WordLM(gluon.Block):
     def __init__(self, vocab, emb, hid, layers, dropout=0.2, **kw):
         super().__init__(**kw)
         with self.name_scope():
@@ -30,8 +30,16 @@ class WordLM(gluon.HybridBlock):
                                 dropout=dropout)
             self.decoder = nn.Dense(vocab, flatten=False)
 
-    def hybrid_forward(self, F, x):
-        return self.decoder(self.drop(self.rnn(self.drop(self.embed(x)))))
+    def forward(self, x, states):
+        """Stateful forward: hidden state threads across BPTT segments
+        (the reference example detaches and carries it — truncated BPTT
+        over contiguous text)."""
+        h = self.drop(self.embed(x))
+        out, new_states = self.rnn(h, states)
+        return self.decoder(self.drop(out)), new_states
+
+    def begin_state(self, batch_size, ctx):
+        return self.rnn.begin_state(batch_size, ctx=ctx)
 
 
 def synthetic_corpus(n_tokens, vocab):
@@ -90,13 +98,17 @@ def main():
     params = [p for p in net.collect_params().values()
               if p.grad_req != "null"]
 
+    ctx = mx.current_context()
     for epoch in range(args.epochs):
         total_loss, n_batches = 0.0, 0
+        states = net.begin_state(args.batch_size, ctx)
         for i in range(0, data.shape[1] - 1 - args.bptt, args.bptt):
             xb = nd.array(data[:, i:i + args.bptt].astype(np.int32))
             yb = nd.array(data[:, i + 1:i + 1 + args.bptt].astype(np.float32))
+            # detach: truncate BPTT at the segment boundary
+            states = [s.detach() for s in states]
             with autograd.record():
-                logits = net(xb)
+                logits, states = net(xb, states)
                 loss = loss_fn(logits, yb)
             loss.backward()
             clip_global_norm([p.grad() for p in params],

@@ -133,7 +133,7 @@ class CheckpointManager:
     def _prune(self):
         ckpts = self._checkpoints()
         for _, base in ckpts[:-self._max_keep] if self._max_keep else []:
-            for f in glob.glob(base + ".*") + glob.glob(base + ".shard-*"):
+            for f in glob.glob(base + ".*"):  # incl. .shard-* files
                 try:
                     os.remove(f)
                 except OSError:

@@ -1,3 +1,4 @@
-"""mx.contrib — AMP, quantization, ONNX-stub (python/mxnet/contrib analog)."""
+"""mx.contrib — AMP, quantization, ONNX (python/mxnet/contrib analog)."""
 from . import amp
 from . import quantization
+from . import onnx

@@ -140,8 +140,10 @@ def init_trainer(trainer):
 
     def amp_step(batch_size, ignore_stale_grad=False):
         skip = scaler.has_overflow(trainer._params)
-        scaler.update_scale(skip)
+        # unscale with the scale that was IN EFFECT during backward;
+        # only then adjust it for the next iteration
         trainer._scale = trainer._amp_original_scale / scaler.loss_scale
+        scaler.update_scale(skip)
         if skip:
             logging.warning("AMP: gradient overflow, skipping update "
                             "(loss scale -> %g)", scaler.loss_scale)

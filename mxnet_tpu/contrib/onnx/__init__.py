@@ -1,0 +1,448 @@
+"""ONNX export/import (python/mxnet/contrib/onnx analog).
+
+Self-contained: the ONNX IR schema subset is compiled from
+``onnx_minimal.proto`` (field layout matches the public onnx.proto, so
+the files are real ONNX) — no onnx-package dependency. Scope: the op
+set used by the model-zoo MLP/CNN families (Gemm/Conv/BatchNorm/
+pooling/activations/elementwise/shape ops), opset 13.
+
+- :func:`export_model` — Symbol + params → ``model.onnx``
+- :func:`import_model` — ``model.onnx`` → (Symbol, arg_params, aux_params)
+
+Round-trip is covered by tests through the compiled executor;
+cross-validation against onnxruntime requires an environment that has
+it installed.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from . import onnx_minimal_pb2 as pb  # noqa: E402
+
+from ...base import MXNetError  # noqa: E402
+
+__all__ = ["export_model", "import_model"]
+
+_DT = {"float32": pb.TensorProto.FLOAT, "float64": pb.TensorProto.DOUBLE,
+       "float16": pb.TensorProto.FLOAT16, "bfloat16": pb.TensorProto.BFLOAT16,
+       "int32": pb.TensorProto.INT32, "int64": pb.TensorProto.INT64,
+       "int8": pb.TensorProto.INT8, "uint8": pb.TensorProto.UINT8,
+       "bool": pb.TensorProto.BOOL}
+_DT_REV = {v: k for k, v in _DT.items()}
+
+_UNARY_EXPORT = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+                 "exp": "Exp", "log": "Log", "sqrt": "Sqrt", "abs": "Abs",
+                 "negative": "Neg", "identity": "Identity",
+                 "copy": "Identity", "Flatten": "Flatten", "erf": "Erf",
+                 "floor": "Floor", "ceil": "Ceil", "round": "Round"}
+_BINARY_EXPORT = {"broadcast_add": "Add", "broadcast_sub": "Sub",
+                  "broadcast_mul": "Mul", "broadcast_div": "Div",
+                  "elemwise_add": "Add", "maximum": "Max", "minimum": "Min",
+                  "broadcast_maximum": "Max", "broadcast_minimum": "Min"}
+
+
+def _np_tensor(name, arr):
+    t = pb.TensorProto()
+    t.name = name
+    t.dims.extend(arr.shape)
+    dt = str(arr.dtype) if str(arr.dtype) in _DT else "float32"
+    t.data_type = _DT[dt]
+    a = np.ascontiguousarray(arr)
+    if dt == "bfloat16":
+        t.raw_data = a.view(np.uint16).tobytes()
+    else:
+        t.raw_data = a.astype(np.dtype(dt)).tobytes()
+    return t
+
+
+def _tensor_np(t):
+    dtype = _DT_REV.get(t.data_type, "float32")
+    shape = tuple(t.dims)
+    if t.raw_data:
+        if dtype == "bfloat16":
+            import jax.numpy as jnp
+            return np.frombuffer(t.raw_data, np.uint16).reshape(shape).view(jnp.bfloat16)
+        return np.frombuffer(t.raw_data, np.dtype(dtype)).reshape(shape).copy()
+    if t.float_data:
+        return np.asarray(t.float_data, np.float32).reshape(shape)
+    if t.int64_data:
+        return np.asarray(t.int64_data, np.int64).reshape(shape)
+    if t.int32_data:
+        return np.asarray(t.int32_data, np.int32).reshape(shape)
+    return np.zeros(shape, np.dtype(dtype))
+
+
+def _attr(name, value):
+    a = pb.AttributeProto()
+    a.name = name
+    if isinstance(value, float):
+        a.type = pb.AttributeProto.FLOAT
+        a.f = value
+    elif isinstance(value, bool):
+        a.type = pb.AttributeProto.INT
+        a.i = int(value)
+    elif isinstance(value, int):
+        a.type = pb.AttributeProto.INT
+        a.i = value
+    elif isinstance(value, str):
+        a.type = pb.AttributeProto.STRING
+        a.s = value.encode()
+    elif isinstance(value, (list, tuple)):
+        if value and isinstance(value[0], float):
+            a.type = pb.AttributeProto.FLOATS
+            a.floats.extend(value)
+        else:
+            a.type = pb.AttributeProto.INTS
+            a.ints.extend(int(v) for v in value)
+    else:
+        raise MXNetError(f"unsupported attribute {name}={value!r}")
+    return a
+
+
+class _Exporter:
+    def __init__(self, graph):
+        self.g = graph
+        self.counter = 0
+        self.extra_inits = []
+
+    def uniq(self, base):
+        self.counter += 1
+        return f"{base}_{self.counter}"
+
+    def node(self, op_type, inputs, outputs=None, name=None, **attrs):
+        n = pb.NodeProto()
+        n.op_type = op_type
+        n.name = name or self.uniq(op_type.lower())
+        n.input.extend(inputs)
+        out = outputs or [n.name + "_out"]
+        n.output.extend(out)
+        for k, v in attrs.items():
+            if v is not None:
+                n.attribute.append(_attr(k, v))
+        self.g.node.append(n)
+        return out[0]
+
+    def const_i64(self, vals):
+        name = self.uniq("const")
+        self.g.initializer.append(
+            _np_tensor(name, np.asarray(vals, np.int64)))
+        return name
+
+
+def _tup(v, n=None):
+    if v is None:
+        return None
+    t = tuple(int(x) for x in (v if isinstance(v, (list, tuple)) else (v,)))
+    return t
+
+
+def _export_node(ex, op_name, attrs, ins, out_name=None):
+    """Map one mxnet op application to ONNX node(s); returns output name."""
+    a = {k: v for k, v in attrs.items() if v is not None}
+    if op_name in _UNARY_EXPORT:
+        return ex.node(_UNARY_EXPORT[op_name], ins, [out_name] if out_name else None)
+    if op_name in _BINARY_EXPORT:
+        return ex.node(_BINARY_EXPORT[op_name], ins, [out_name] if out_name else None)
+    if op_name == "FullyConnected":
+        x = ins[0]
+        if str(a.get("flatten", True)) not in ("False", "0"):
+            x = ex.node("Flatten", [x], axis=1)
+        inputs = [x, ins[1]] + (ins[2:3] if len(ins) > 2 else [])
+        return ex.node("Gemm", inputs, [out_name] if out_name else None,
+                       alpha=1.0, beta=1.0, transB=1)
+    if op_name == "Convolution":
+        k = _tup(a.get("kernel"))
+        nd_ = len(k)
+        pads = _tup(a.get("pad")) or (0,) * nd_
+        return ex.node("Conv", ins, [out_name] if out_name else None,
+                       kernel_shape=k,
+                       strides=_tup(a.get("stride")) or (1,) * nd_,
+                       pads=pads + pads,
+                       dilations=_tup(a.get("dilate")) or (1,) * nd_,
+                       group=int(a.get("num_group", 1)))
+    if op_name == "Activation":
+        t = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+             "softrelu": "Softplus"}[a.get("act_type", "relu")]
+        return ex.node(t, ins, [out_name] if out_name else None)
+    if op_name == "LeakyReLU":
+        act = a.get("act_type", "leaky")
+        if act == "leaky":
+            return ex.node("LeakyRelu", ins, [out_name] if out_name else None,
+                           alpha=float(a.get("slope", 0.25)))
+        if act == "elu":
+            return ex.node("Elu", ins, [out_name] if out_name else None,
+                           alpha=float(a.get("slope", 0.25)))
+        raise MXNetError(f"LeakyReLU act_type {act} not exportable")
+    if op_name in ("softmax", "SoftmaxActivation", "SoftmaxOutput", "Softmax"):
+        return ex.node("Softmax", ins[:1], [out_name] if out_name else None,
+                       axis=int(a.get("axis", -1)))
+    if op_name == "log_softmax":
+        return ex.node("LogSoftmax", ins, [out_name] if out_name else None,
+                       axis=int(a.get("axis", -1)))
+    if op_name == "Pooling":
+        global_pool = str(a.get("global_pool", False)) in ("True", "1")
+        ptype = a.get("pool_type", "max")
+        if global_pool:
+            t = "GlobalMaxPool" if ptype == "max" else "GlobalAveragePool"
+            return ex.node(t, ins, [out_name] if out_name else None)
+        k = _tup(a.get("kernel"))
+        pads = _tup(a.get("pad")) or (0,) * len(k)
+        kw = dict(kernel_shape=k,
+                  strides=_tup(a.get("stride")) or (1,) * len(k),
+                  pads=pads + pads)
+        if ptype == "max":
+            return ex.node("MaxPool", ins, [out_name] if out_name else None, **kw)
+        kw["count_include_pad"] = int(str(a.get("count_include_pad", True))
+                                      in ("True", "1"))
+        return ex.node("AveragePool", ins, [out_name] if out_name else None, **kw)
+    if op_name == "BatchNorm":
+        return ex.node("BatchNormalization", ins[:5],
+                       [out_name] if out_name else None,
+                       epsilon=float(a.get("eps", 1e-5)),
+                       momentum=float(a.get("momentum", 0.9)))
+    if op_name == "Dropout":
+        return ex.node("Dropout", ins[:1], [out_name] if out_name else None)
+    if op_name in ("concat", "Concat"):
+        return ex.node("Concat", ins, [out_name] if out_name else None,
+                       axis=int(a.get("dim", 1)))
+    if op_name == "add_n":
+        return ex.node("Sum", ins, [out_name] if out_name else None)
+    if op_name in ("reshape", "Reshape"):
+        shape = ex.const_i64(_tup(a.get("shape")))
+        return ex.node("Reshape", [ins[0], shape],
+                       [out_name] if out_name else None)
+    if op_name == "transpose":
+        return ex.node("Transpose", ins, [out_name] if out_name else None,
+                       perm=_tup(a.get("axes")))
+    if op_name == "dot":
+        return ex.node("MatMul", ins, [out_name] if out_name else None)
+    if op_name == "Embedding":
+        # onnx Gather(data=table, indices)
+        return ex.node("Gather", [ins[1], ins[0]],
+                       [out_name] if out_name else None, axis=0)
+    if op_name == "clip":
+        lo = ex.const_i64 if False else None  # Clip uses float inputs
+        ex_lo = ex.uniq("clip_min")
+        ex_hi = ex.uniq("clip_max")
+        ex.g.initializer.append(_np_tensor(
+            ex_lo, np.asarray(float(a.get("a_min", 0.0)), np.float32)))
+        ex.g.initializer.append(_np_tensor(
+            ex_hi, np.asarray(float(a.get("a_max", 0.0)), np.float32)))
+        return ex.node("Clip", [ins[0], ex_lo, ex_hi],
+                       [out_name] if out_name else None)
+    raise MXNetError(f"op {op_name!r} has no ONNX export mapping")
+
+
+def export_model(sym, params, input_shapes=None, input_types=None,
+                 onnx_file_path="model.onnx", opset_version=13, **kwargs):
+    """Export a Symbol + params dict to an ONNX file.
+
+    params: dict name→NDArray covering every non-data argument.
+    input_shapes: dict name→shape (or list matching free inputs)."""
+    from ...symbol.symbol import Symbol
+
+    if not isinstance(sym, Symbol):
+        raise MXNetError("export_model expects a Symbol")
+    params = {k.split(":", 1)[-1]: v for k, v in (params or {}).items()}
+    model = pb.ModelProto()
+    model.ir_version = 8
+    model.producer_name = "mxnet_tpu"
+    opset = model.opset_import.add()
+    opset.domain = ""
+    opset.version = opset_version
+    g = model.graph
+    g.name = sym.name or "mxnet_tpu_graph"
+    ex = _Exporter(g)
+
+    for name, arr in params.items():
+        g.initializer.append(_np_tensor(name, arr.asnumpy()))
+
+    shapes = dict(input_shapes or {})
+    names: dict = {}
+
+    def emit(node):
+        if node._base is not None:
+            return emit(node._base)  # single-output subset
+        if id(node) in names:
+            return names[id(node)]
+        if node._op is None:
+            names[id(node)] = node._name
+            if node._name not in params:
+                vi = g.input.add()
+                vi.name = node._name
+                vi.type.tensor_type.elem_type = pb.TensorProto.FLOAT
+                for d in shapes.get(node._name, ()):
+                    vi.type.tensor_type.shape.dim.add().dim_value = int(d)
+            return node._name
+        ins = [emit(i) for i in node._inputs]
+        attrs = {k: v for k, v in node._attrs.items() if not k.startswith("__")}
+        out = _export_node(ex, node._op.name, attrs, ins,
+                           out_name=node._name + "_out" if node._name else None)
+        names[id(node)] = out
+        return out
+
+    outputs = sym._inputs if sym._is_group() else [sym]
+    for o in outputs:
+        out_name = emit(o)
+        vi = g.output.add()
+        vi.name = out_name
+        vi.type.tensor_type.elem_type = pb.TensorProto.FLOAT
+
+    with open(onnx_file_path, "wb") as f:
+        f.write(model.SerializeToString())
+    return onnx_file_path
+
+
+# ---------------------------------------------------------------------------
+# import
+# ---------------------------------------------------------------------------
+_UNARY_IMPORT = {v: k for k, v in _UNARY_EXPORT.items() if v != "Identity"}
+_UNARY_IMPORT["Identity"] = "identity"
+_BINARY_IMPORT = {"Add": "broadcast_add", "Sub": "broadcast_sub",
+                  "Mul": "broadcast_mul", "Div": "broadcast_div",
+                  "Max": "broadcast_maximum", "Min": "broadcast_minimum"}
+
+
+def _get_attrs(n):
+    out = {}
+    for a in n.attribute:
+        if a.type == pb.AttributeProto.FLOAT:
+            out[a.name] = a.f
+        elif a.type == pb.AttributeProto.INT:
+            out[a.name] = a.i
+        elif a.type == pb.AttributeProto.STRING:
+            out[a.name] = a.s.decode()
+        elif a.type == pb.AttributeProto.INTS:
+            out[a.name] = tuple(a.ints)
+        elif a.type == pb.AttributeProto.FLOATS:
+            out[a.name] = tuple(a.floats)
+    return out
+
+
+def import_model(onnx_file_path):
+    """Load an ONNX file → (Symbol, arg_params, aux_params)."""
+    from ... import symbol as symmod
+    from ... import ndarray as nd
+
+    model = pb.ModelProto()
+    with open(onnx_file_path, "rb") as f:
+        model.ParseFromString(f.read())
+    g = model.graph
+    inits = {t.name: _tensor_np(t) for t in g.initializer}
+    env: dict = {}
+    arg_params = {}
+    for name, arr in inits.items():
+        if arr.dtype == np.int64 and arr.ndim <= 1:
+            env[name] = ("const", arr)  # shape/axes constants
+        else:
+            env[name] = ("var", symmod.var(name))
+            arg_params[name] = nd.array(arr)
+    for vi in g.input:
+        if vi.name not in env:
+            env[vi.name] = ("var", symmod.var(vi.name))
+
+    def val(name):
+        kind, v = env[name]
+        if kind == "const":
+            return v
+        return v
+
+    def sym_of(name):
+        kind, v = env[name]
+        if kind == "const":
+            raise MXNetError(f"{name} is a constant, not a tensor input")
+        return v
+
+    for n in g.node:
+        a = _get_attrs(n)
+        t = n.op_type
+        ins = list(n.input)
+        if t in _UNARY_IMPORT:
+            res = getattr(symmod, "flatten" if t == "Flatten" else _UNARY_IMPORT[t])(sym_of(ins[0])) \
+                if t != "Flatten" else symmod.Flatten(sym_of(ins[0]))
+        elif t in _BINARY_IMPORT:
+            res = getattr(symmod, _BINARY_IMPORT[t])(sym_of(ins[0]), sym_of(ins[1]))
+        elif t == "Gemm":
+            bias = sym_of(ins[2]) if len(ins) > 2 else None
+            w_arr = arg_params.get(ins[1])
+            num_hidden = int(w_arr.shape[0]) if w_arr is not None else 0
+            res = symmod.FullyConnected(sym_of(ins[0]), sym_of(ins[1]), bias,
+                                        num_hidden=num_hidden,
+                                        no_bias=bias is None, flatten=False)
+        elif t == "MatMul":
+            res = symmod.dot(sym_of(ins[0]), sym_of(ins[1]))
+        elif t == "Conv":
+            k = tuple(a["kernel_shape"])
+            nd_ = len(k)
+            pads = tuple(a.get("pads", (0,) * (2 * nd_)))[:nd_]
+            bias = sym_of(ins[2]) if len(ins) > 2 else None
+            w_arr = arg_params.get(ins[1])
+            res = symmod.Convolution(
+                sym_of(ins[0]), sym_of(ins[1]), bias, kernel=k,
+                stride=tuple(a.get("strides", (1,) * nd_)), pad=pads,
+                dilate=tuple(a.get("dilations", (1,) * nd_)),
+                num_filter=int(w_arr.shape[0]) if w_arr is not None else 0,
+                num_group=int(a.get("group", 1)), no_bias=bias is None)
+        elif t in ("MaxPool", "AveragePool"):
+            k = tuple(a["kernel_shape"])
+            pads = tuple(a.get("pads", (0,) * (2 * len(k))))[:len(k)]
+            res = symmod.Pooling(
+                sym_of(ins[0]), kernel=k,
+                pool_type="max" if t == "MaxPool" else "avg",
+                stride=tuple(a.get("strides", (1,) * len(k))), pad=pads,
+                count_include_pad=bool(a.get("count_include_pad", 1)))
+        elif t in ("GlobalMaxPool", "GlobalAveragePool"):
+            res = symmod.Pooling(sym_of(ins[0]), global_pool=True,
+                                 pool_type="max" if t == "GlobalMaxPool" else "avg")
+        elif t == "BatchNormalization":
+            res = symmod.BatchNorm(*[sym_of(i) for i in ins[:5]],
+                                   eps=float(a.get("epsilon", 1e-5)),
+                                   momentum=float(a.get("momentum", 0.9)),
+                                   fix_gamma=False, use_global_stats=True)
+        elif t == "Softmax":
+            res = symmod.softmax(sym_of(ins[0]), axis=int(a.get("axis", -1)))
+        elif t == "LogSoftmax":
+            res = symmod.log_softmax(sym_of(ins[0]), axis=int(a.get("axis", -1)))
+        elif t == "Dropout":
+            res = symmod.Dropout(sym_of(ins[0]))
+        elif t == "Concat":
+            res = symmod.concat(*[sym_of(i) for i in ins],
+                                dim=int(a.get("axis", 1)))
+        elif t == "Sum":
+            res = symmod.add_n(*[sym_of(i) for i in ins])
+        elif t == "Reshape":
+            shape = tuple(int(x) for x in val(ins[1]))
+            res = symmod.reshape(sym_of(ins[0]), shape=shape)
+        elif t == "Transpose":
+            res = symmod.transpose(sym_of(ins[0]), axes=tuple(a.get("perm", ())))
+        elif t == "Gather":
+            res = symmod.Embedding(sym_of(ins[1]), sym_of(ins[0]),
+                                   input_dim=0, output_dim=0)
+        elif t == "Clip":
+            lo = float(val(ins[1])) if len(ins) > 1 else a.get("min", 0.0)
+            hi = float(val(ins[2])) if len(ins) > 2 else a.get("max", 0.0)
+            res = symmod.clip(sym_of(ins[0]), a_min=lo, a_max=hi)
+        elif t in ("LeakyRelu", "Elu"):
+            res = symmod.LeakyReLU(
+                sym_of(ins[0]),
+                act_type="leaky" if t == "LeakyRelu" else "elu",
+                slope=float(a.get("alpha", 0.25)))
+        elif t == "Softplus":
+            res = symmod.Activation(sym_of(ins[0]), act_type="softrelu")
+        else:
+            raise MXNetError(f"ONNX op {t!r} has no import mapping")
+        env[n.output[0]] = ("var", res)
+
+    outputs = [sym_of(vi.name) for vi in g.output]
+    out_sym = outputs[0] if len(outputs) == 1 else symmod.Group(outputs)
+    # split aux (BN running stats) from args by conventional names
+    aux_params = {k: v for k, v in arg_params.items()
+                  if k.endswith(("moving_mean", "moving_var",
+                                 "running_mean", "running_var"))}
+    for k in aux_params:
+        arg_params.pop(k)
+    return out_sym, arg_params, aux_params

@@ -203,15 +203,23 @@ class DataLoader:
                 for batch in self._batch_sampler:
                     yield self._batchify_fn([self._dataset[idx] for idx in batch])
             return same_process_iter()
-        if not self._thread_pool and self._dataset_is_fork_safe():
-            return _MultiProcessIter(self)
+        if not self._thread_pool:
+            if self._fork_safe is None:
+                # fork the pool BEFORE probing: the probe may materialize
+                # lazy dataset state (open record files) in the parent,
+                # and forked workers must inherit the clean instance —
+                # a shared fd means interleaved seek/read corruption
+                self._get_mp_pool()
+            if self._dataset_is_fork_safe():
+                return _MultiProcessIter(self)
         return _ThreadedIter(self)
 
     def _dataset_is_fork_safe(self):
         """Forked workers must not touch JAX: probe one sample and fall
         back to thread workers (with the eager batchify) when
         __getitem__ produces device arrays (e.g. the vision datasets'
-        NDArray transforms)."""
+        NDArray transforms). Call only AFTER the pool forked (see
+        __iter__)."""
         if self._fork_safe is None:
             def has_nd(x):
                 if isinstance(x, NDArray):
