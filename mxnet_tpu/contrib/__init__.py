@@ -1,4 +1,12 @@
 """mx.contrib — AMP, quantization, ONNX (python/mxnet/contrib analog)."""
 from . import amp
 from . import quantization
-from . import onnx
+
+
+def __getattr__(name):
+    # onnx loads lazily: it needs google.protobuf, which must not become
+    # a hard dependency of unrelated contrib users (amp/quantization)
+    if name == "onnx":
+        import importlib
+        return importlib.import_module(".onnx", __name__)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

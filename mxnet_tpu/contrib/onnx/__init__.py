@@ -15,15 +15,10 @@ it installed.
 """
 from __future__ import annotations
 
-import os
-import sys
-
 import numpy as np
 
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-from . import onnx_minimal_pb2 as pb  # noqa: E402
-
-from ...base import MXNetError  # noqa: E402
+from . import onnx_minimal_pb2 as pb
+from ...base import MXNetError
 
 __all__ = ["export_model", "import_model"]
 
@@ -225,7 +220,6 @@ def _export_node(ex, op_name, attrs, ins, out_name=None):
         return ex.node("Gather", [ins[1], ins[0]],
                        [out_name] if out_name else None, axis=0)
     if op_name == "clip":
-        lo = ex.const_i64 if False else None  # Clip uses float inputs
         ex_lo = ex.uniq("clip_min")
         ex_hi = ex.uniq("clip_max")
         ex.g.initializer.append(_np_tensor(
@@ -307,6 +301,19 @@ _BINARY_IMPORT = {"Add": "broadcast_add", "Sub": "broadcast_sub",
                   "Max": "broadcast_maximum", "Min": "broadcast_minimum"}
 
 
+def _sym_pads(pads, nd_, op_type):
+    """ONNX pads = [begin..., end...]; the mxnet ops take symmetric pads.
+    Asymmetric padding raises loudly instead of silently truncating."""
+    if not pads:
+        return (0,) * nd_
+    pads = tuple(pads)
+    begin, end = pads[:nd_], pads[nd_:]
+    if end and begin != end:
+        raise MXNetError(
+            f"{op_type}: asymmetric ONNX pads {pads} are not supported")
+    return begin
+
+
 def _get_attrs(n):
     out = {}
     for a in n.attribute:
@@ -336,8 +343,10 @@ def import_model(onnx_file_path):
     env: dict = {}
     arg_params = {}
     for name, arr in inits.items():
-        if arr.dtype == np.int64 and arr.ndim <= 1:
-            env[name] = ("const", arr)  # shape/axes constants
+        if (arr.dtype == np.int64 and arr.ndim <= 1) or arr.ndim == 0:
+            # shape/axes constants and scalar attrs-as-inputs (Clip
+            # min/max): plain python-side values, never parameters
+            env[name] = ("const", arr)
         else:
             env[name] = ("var", symmod.var(name))
             arg_params[name] = nd.array(arr)
@@ -367,18 +376,37 @@ def import_model(onnx_file_path):
         elif t in _BINARY_IMPORT:
             res = getattr(symmod, _BINARY_IMPORT[t])(sym_of(ins[0]), sym_of(ins[1]))
         elif t == "Gemm":
+            alpha = float(a.get("alpha", 1.0))
+            beta = float(a.get("beta", 1.0))
+            trans_a = bool(a.get("transA", 0))
+            trans_b = bool(a.get("transB", 0))
             bias = sym_of(ins[2]) if len(ins) > 2 else None
             w_arr = arg_params.get(ins[1])
-            num_hidden = int(w_arr.shape[0]) if w_arr is not None else 0
-            res = symmod.FullyConnected(sym_of(ins[0]), sym_of(ins[1]), bias,
-                                        num_hidden=num_hidden,
-                                        no_bias=bias is None, flatten=False)
+            if (trans_b and not trans_a and alpha == 1.0
+                    and (bias is None or beta == 1.0)):
+                # the common (and our exporter's) convention → FC op
+                num_hidden = int(w_arr.shape[0]) if w_arr is not None else 0
+                res = symmod.FullyConnected(
+                    sym_of(ins[0]), sym_of(ins[1]), bias,
+                    num_hidden=num_hidden, no_bias=bias is None,
+                    flatten=False)
+            else:
+                # general Gemm: alpha*op(A)·op(B) + beta*C
+                A = sym_of(ins[0])
+                B = sym_of(ins[1])
+                res = symmod.dot(A, B, transpose_a=trans_a,
+                                 transpose_b=trans_b)
+                if alpha != 1.0:
+                    res = res * alpha
+                if bias is not None:
+                    res = symmod.broadcast_add(
+                        res, bias * beta if beta != 1.0 else bias)
         elif t == "MatMul":
             res = symmod.dot(sym_of(ins[0]), sym_of(ins[1]))
         elif t == "Conv":
             k = tuple(a["kernel_shape"])
             nd_ = len(k)
-            pads = tuple(a.get("pads", (0,) * (2 * nd_)))[:nd_]
+            pads = _sym_pads(a.get("pads"), nd_, t)
             bias = sym_of(ins[2]) if len(ins) > 2 else None
             w_arr = arg_params.get(ins[1])
             res = symmod.Convolution(
@@ -389,12 +417,13 @@ def import_model(onnx_file_path):
                 num_group=int(a.get("group", 1)), no_bias=bias is None)
         elif t in ("MaxPool", "AveragePool"):
             k = tuple(a["kernel_shape"])
-            pads = tuple(a.get("pads", (0,) * (2 * len(k))))[:len(k)]
+            pads = _sym_pads(a.get("pads"), len(k), t)
             res = symmod.Pooling(
                 sym_of(ins[0]), kernel=k,
                 pool_type="max" if t == "MaxPool" else "avg",
                 stride=tuple(a.get("strides", (1,) * len(k))), pad=pads,
-                count_include_pad=bool(a.get("count_include_pad", 1)))
+                # ONNX spec default: EXCLUDE padding from the average
+                count_include_pad=bool(a.get("count_include_pad", 0)))
         elif t in ("GlobalMaxPool", "GlobalAveragePool"):
             res = symmod.Pooling(sym_of(ins[0]), global_pool=True,
                                  pool_type="max" if t == "GlobalMaxPool" else "avg")

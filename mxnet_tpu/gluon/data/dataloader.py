@@ -210,7 +210,11 @@ class DataLoader:
                 # and forked workers must inherit the clean instance —
                 # a shared fd means interleaved seek/read corruption
                 self._get_mp_pool()
-            if self._dataset_is_fork_safe():
+                if not self._dataset_is_fork_safe():
+                    # probe says thread fallback: don't keep idle forks
+                    self._mp_pool.terminate()
+                    self._mp_pool = None
+            if self._fork_safe:
                 return _MultiProcessIter(self)
         return _ThreadedIter(self)
 

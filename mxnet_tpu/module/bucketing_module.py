@@ -43,17 +43,41 @@ class BucketingModule(BaseModule):
             mod = self._gen_module(bucket_key)
             mod.bind(data_shapes, label_shapes, for_training=self.for_training)
             if self._curr_module is not None and self._curr_module.params_initialized:
-                arg_p, aux_p = self._curr_module.get_params()
-                mod.init_params(arg_params=arg_p, aux_params=aux_p,
-                                allow_missing=False, force_init=True)
+                self._share_into(mod)
+                mod.params_initialized = True
             elif self._init_args is not None:
                 mod.init_params(**self._init_args)
             if self._curr_module is not None and self._curr_module.optimizer_initialized:
-                mod.init_optimizer(kvstore=None,
-                                   optimizer=self._curr_module._optimizer)
+                # ONE optimizer/updater across buckets (shared state,
+                # update counts advance once per step)
+                mod._optimizer = self._curr_module._optimizer
+                mod._updater = self._curr_module._updater
+                mod._kvstore = self._curr_module._kvstore
+                mod.optimizer_initialized = True
             self._buckets[bucket_key] = mod
         self._curr_module = self._buckets[bucket_key]
         self._curr_bucket_key = bucket_key
+
+    def _share_into(self, mod):
+        """Share the default bucket's parameter ARRAYS with a new bucket
+        module (reference: bucket executors share memory via
+        shared_module). Updates through any bucket are then visible to
+        all — no copy-on-switch drift."""
+        src = self._buckets[self._default_bucket_key]
+        missing = [n for n in mod._param_names
+                   if n not in src._execs[0].arg_dict]
+        if missing:
+            raise MXNetError(
+                f"bucket parameters {missing} do not exist in the default "
+                f"bucket {self._default_bucket_key!r}; the default bucket's "
+                "graph must cover every parameter (reference contract)")
+        for name in mod._param_names:
+            for ex_dst, ex_src in zip(mod._execs, src._execs):
+                ex_dst.arg_dict[name] = ex_src.arg_dict[name]
+        for name in mod._aux_names:
+            for ex_dst, ex_src in zip(mod._execs, src._execs):
+                if name in ex_src.aux_dict:
+                    ex_dst.aux_dict[name] = ex_src.aux_dict[name]
 
     def bind(self, data_shapes, label_shapes=None, for_training=True,
              inputs_need_grad=False, force_rebind=False, shared_module=None,

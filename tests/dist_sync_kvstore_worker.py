@@ -65,6 +65,29 @@ def main():
     assert hlo and "all-reduce" in hlo, "cross-process reduce not compiled to all-reduce"
 
     kv.barrier()
+
+    # sharded checkpoint across processes: each worker writes the shards
+    # of a globally-sharded array; rank 0 reassembles (SURVEY §5.4
+    # extension exercised multi-host)
+    import tempfile
+
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from mxnet_tpu.ndarray.ndarray import _wrap
+
+    tmpdir = os.environ.get("DIST_TEST_TMPDIR") or tempfile.gettempdir()
+    prefix = os.path.join(tmpdir, "dist_ckpt")
+    mesh = Mesh(np.asarray(jax.devices()), ("dp",))
+    want = np.arange(16, dtype=np.float32).reshape(4, 4)
+    garr = jax.make_array_from_callback(
+        (4, 4), NamedSharding(mesh, P("dp", None)),
+        lambda idx: want[idx])
+    nd.save_sharded(prefix, {"w": _wrap(garr, mx.current_context())})
+    kv.barrier()
+    if rank == 0:
+        back = nd.load_sharded(prefix)
+        assert np.allclose(back["w"].asnumpy(), want), back["w"].asnumpy()
+    kv.barrier()
     print(f"DIST_WORKER_{rank}_OK", flush=True)
 
 

@@ -15,9 +15,11 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 @pytest.mark.timeout(600)
 def test_dist_sync_kvstore_two_workers():
+    import tempfile
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)  # worker sets its own device count
     env["PYTHONPATH"] = ROOT
+    env["DIST_TEST_TMPDIR"] = tempfile.mkdtemp(prefix="dist_ckpt_")
     port = 9361 + (os.getpid() % 500)  # avoid collisions across runs
     cmd = [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
            "-n", "2", "--launcher", "local", "--port", str(port),

@@ -214,3 +214,50 @@ def test_executor_reshape_shares_compiled_cache():
     ex3 = ex2.reshape(data=(8, 4), softmax_label=(8,))
     ex3.forward(is_train=False)
     assert len(ex._graph_cache) == n_before + 1
+
+
+def test_bucketing_module_trains_across_buckets():
+    """BucketingModule: per-bucket compiled executors sharing parameters
+    (reference bucketing_module.py; variable-length training)."""
+    rs = np.random.RandomState(0)
+    w = rs.randn(6, 3).astype(np.float32)
+
+    def sym_gen(seq_len):
+        # variable-length input (N, seq_len, 6) mean-pooled over time —
+        # parameter shapes are bucket-independent, as in RNN bucketing
+        data = mx.sym.var("data")
+        pooled = mx.sym.mean(data, axis=1)
+        net = mx.sym.FullyConnected(pooled, num_hidden=16, name="fc1")
+        net = mx.sym.Activation(net, act_type="relu")
+        net = mx.sym.FullyConnected(net, num_hidden=3, name="fc2")
+        net = mx.sym.SoftmaxOutput(net, name="softmax")
+        return net, ("data",), ("softmax_label",)
+
+    mod = mx.module.BucketingModule(sym_gen, default_bucket_key=6)
+    mod.bind(data_shapes=[("data", (16, 6, 6))],
+             label_shapes=[("softmax_label", (16,))])
+    mod.init_params(initializer=mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+
+    from mxnet_tpu.io import DataBatch
+    losses = []
+    for step in range(30):
+        bucket = 6 if step % 2 == 0 else 4
+        x = rs.randn(16, bucket, 6).astype(np.float32)
+        y = (x.mean(1) @ w).argmax(1).astype(np.float32)
+        batch = DataBatch(data=[nd.array(x)], label=[nd.array(y)],
+                          bucket_key=bucket,
+                          provide_data=[("data", (16, bucket, 6))],
+                          provide_label=[("softmax_label", (16,))])
+        mod.forward(batch, is_train=True)
+        out = mod.get_outputs()[0].asnumpy()
+        losses.append(-np.log(out[np.arange(16), y.astype(int)] + 1e-9).mean())
+        mod.backward()
+        mod.update()
+    assert len(mod._buckets) == 2
+    # both buckets' modules share the same improving parameters
+    assert np.mean(losses[-6:]) < np.mean(losses[:6]), losses
+    p6, _ = mod._buckets[6].get_params()
+    p4, _ = mod._buckets[4].get_params()
+    assert_almost_equal(p6["fc2_weight"].asnumpy(), p4["fc2_weight"].asnumpy())

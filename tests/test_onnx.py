@@ -114,3 +114,27 @@ def test_onnx_file_is_wellformed_protobuf(tmp_path):
     ops = [n.op_type for n in m.graph.node]
     assert "Gemm" in ops
     assert any(t.name == "fc_weight" for t in m.graph.initializer)
+
+
+def test_clip_roundtrip(tmp_path):
+    """Clip min/max ride as scalar initializers; import must resolve
+    them as constants, not parameters (review regression)."""
+    data = mx.sym.var("data")
+    net = mx.sym.clip(data, a_min=-0.5, a_max=0.5)
+    f = str(tmp_path / "clip.onnx")
+    onnx_mx.export_model(net, {}, input_shapes={"data": (2, 3)},
+                         onnx_file_path=f)
+    sym2, args2, _ = onnx_mx.import_model(f)
+    assert not args2  # scalar bounds are NOT parameters
+    x = RS.randn(2, 3).astype(np.float32) * 2
+    got = _run_sym(sym2, {"data": x})
+    assert_almost_equal(got[0], np.clip(x, -0.5, 0.5))
+
+
+def test_import_asymmetric_pads_raises(tmp_path):
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu.contrib.onnx import pb, _sym_pads
+    with pytest.raises(MXNetError, match="asymmetric"):
+        _sym_pads((1, 1, 0, 0), 2, "Conv")
+    assert _sym_pads((1, 2, 1, 2), 2, "Conv") == (1, 2)
+    assert _sym_pads(None, 2, "Conv") == (0, 0)
