@@ -28,9 +28,11 @@ def _load():
     with _LOCK:
         if _LIB is not None:
             return _LIB
+        srcs = [os.path.join(_SRC_DIR, f)
+                for f in ("recordio.cc", "image_batcher.cc")]
         if not os.path.exists(_LIB_PATH) or \
-                os.path.getmtime(_LIB_PATH) < os.path.getmtime(
-                    os.path.join(_SRC_DIR, "recordio.cc")):
+                os.path.getmtime(_LIB_PATH) < max(
+                    os.path.getmtime(s) for s in srcs if os.path.exists(s)):
             try:
                 subprocess.run(["make", "-C", _SRC_DIR], check=True,
                                capture_output=True)
@@ -58,6 +60,23 @@ def _load():
         lib.mxio_batcher_free_batch.argtypes = [ctypes.c_void_p]
         lib.mxio_batcher_reset.argtypes = [ctypes.c_void_p]
         lib.mxio_batcher_close.argtypes = [ctypes.c_void_p]
+        # image pipeline (decode+resize+batch on C++ threads)
+        lib.mximg_batcher_create.restype = ctypes.c_void_p
+        lib.mximg_batcher_create.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int64, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_uint64,
+            ctypes.c_int64, ctypes.c_int64]
+        lib.mximg_batcher_num_batches.restype = ctypes.c_int64
+        lib.mximg_batcher_num_batches.argtypes = [ctypes.c_void_p]
+        lib.mximg_batcher_next.restype = ctypes.c_int64
+        lib.mximg_batcher_next.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
+        lib.mximg_batcher_reset.argtypes = [ctypes.c_void_p]
+        lib.mximg_batcher_close.argtypes = [ctypes.c_void_p]
+        lib.mximg_decode.restype = ctypes.c_int
+        lib.mximg_decode.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                                     ctypes.c_int, ctypes.c_int,
+                                     ctypes.c_void_p]
         _LIB = lib
         return lib
 
@@ -139,3 +158,75 @@ class NativeBatcher:
 
     def __del__(self):
         self.close()
+
+
+class NativeImageBatcher:
+    """Full native image pipeline (src/cc/image_batcher.cc — the
+    iter_image_recordio_2.cc equivalent): RecordIO framing, IRHeader
+    parse, libjpeg decode, bilinear resize and CHW batch assembly on
+    C++ threads. Each next() fills caller-owned numpy buffers — one
+    contiguous uint8 (B,3,H,W) batch + float32 labels, ready for a
+    single device_put. Partial final batches are discarded
+    (last_batch='discard')."""
+
+    def __init__(self, rec_path, idx_path, batch_size=32, data_shape=(3, 224, 224),
+                 num_threads=4, shuffle=False, seed=0, num_parts=1,
+                 part_index=0):
+        import numpy as np
+        self._np = np
+        self._lib = _load()
+        c, h, w = data_shape
+        assert c == 3, "native image pipeline decodes RGB (3 channels)"
+        self._shape = (batch_size, c, h, w)
+        self._h = self._lib.mximg_batcher_create(
+            rec_path.encode(), idx_path.encode(), batch_size, h, w,
+            num_threads, int(shuffle), seed, num_parts, part_index)
+        if not self._h:
+            raise IOError(f"cannot open {rec_path} (or fewer records than "
+                          "one batch)")
+
+    @property
+    def num_batches(self):
+        return self._lib.mximg_batcher_num_batches(self._h)
+
+    def next(self):
+        """(data uint8 (n,3,H,W), labels float32 (n,)) or None at epoch
+        end. n < batch_size when corrupt records were skipped (the
+        native layer compacts the batch)."""
+        np = self._np
+        data = np.empty(self._shape, np.uint8)
+        labels = np.empty(self._shape[0], np.float32)
+        n = self._lib.mximg_batcher_next(
+            self._h, data.ctypes.data_as(ctypes.c_void_p),
+            labels.ctypes.data_as(ctypes.c_void_p))
+        if n < 0:
+            return None
+        if n < self._shape[0]:
+            import warnings
+            warnings.warn(f"native image batcher: {self._shape[0] - n} "
+                          "corrupt record(s) skipped in batch")
+            return data[:n], labels[:n]
+        return data, labels
+
+    def reset(self):
+        self._lib.mximg_batcher_reset(self._h)
+
+    def close(self):
+        if self._h:
+            self._lib.mximg_batcher_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        self.close()
+
+
+def decode_jpeg(buf, out_h, out_w):
+    """Native single-image decode+resize → uint8 (3, out_h, out_w)."""
+    import numpy as np
+    lib = _load()
+    out = np.empty((3, out_h, out_w), np.uint8)
+    rc = lib.mximg_decode(buf, len(buf), out_h, out_w,
+                          out.ctypes.data_as(ctypes.c_void_p))
+    if rc != 0:
+        raise ValueError("corrupt JPEG")
+    return out

@@ -27,6 +27,7 @@ from __future__ import annotations
 import ast
 import functools
 import sys
+import time
 
 import jax
 import jax.numpy as jnp
@@ -160,6 +161,15 @@ def set_dispatch_cast_hook(fn):
     _DISPATCH_CAST_GENERATION += 1
 
 
+def _profiler_running():
+    """Cheap hot-path probe: bound once so op dispatch pays one call,
+    not a module import, when profiling is off."""
+    global _profiler_running
+    from ..profiler import is_running
+    _profiler_running = is_running
+    return is_running()
+
+
 def dispatch_cast_generation():
     return _DISPATCH_CAST_GENERATION
 
@@ -203,6 +213,10 @@ def invoke(op: Op, inputs, params=None, out=None, ctx: Context | None = None, na
         and any(isinstance(x, NDArray) for x in inputs)
     )
 
+    profiling = _profiler_running()
+    if profiling:
+        from .. import profiler as _profiler
+        t0_us = time.perf_counter_ns() // 1000
     device = ctx.jax_device
     with jax.default_device(device):
         if record:
@@ -211,6 +225,12 @@ def invoke(op: Op, inputs, params=None, out=None, ctx: Context | None = None, na
         else:
             raw_out = _call_positional(op, params, len(arrays), *arrays)
             vjp_fn = None
+    if profiling:
+        # dispatch-side op event (ThreadedEngine ProfileOperator analog;
+        # device timeline comes from the XProf delegation — execution is
+        # async under PJRT, so this measures trace+dispatch, which equals
+        # execution under MXNET_ENGINE_TYPE=NaiveEngine)
+        _profiler.record_op(op.name, t0_us, time.perf_counter_ns() // 1000)
 
     multi = isinstance(raw_out, (tuple, list))
     out_arrays = list(raw_out) if multi else [raw_out]

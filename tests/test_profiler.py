@@ -1,0 +1,81 @@
+"""Profiler / Monitor / Estimator tests (reference
+tests/python/unittest/test_profiler.py + monitor/estimator scope)."""
+import json
+import os
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd, profiler
+from mxnet_tpu.gluon import nn
+
+
+def test_profiler_chrome_trace(tmp_path):
+    f = str(tmp_path / "trace.json")
+    profiler.set_config(filename=f, profile_symbolic=True,
+                        profile_imperative=True)
+    profiler.set_state("run")
+    x = nd.ones((8, 8))
+    for _ in range(3):
+        x = nd.dot(x, x)
+    x.asnumpy()
+    profiler.set_state("stop")
+    profiler.dump()
+    with open(f) as fh:
+        trace = json.load(fh)
+    events = trace["traceEvents"] if isinstance(trace, dict) else trace
+    assert any(e.get("name") == "dot" for e in events
+               if isinstance(e, dict)), "no op events captured"
+
+
+def test_profiler_dumps_table():
+    profiler.set_config(aggregate_stats=True)  # reference requires this too
+    profiler.set_state("run")
+    nd.exp(nd.ones((4, 4))).asnumpy()
+    profiler.set_state("stop")
+    s = profiler.dumps()
+    assert "exp" in s
+
+
+def test_profiler_scopes():
+    profiler.set_state("run")
+    t = profiler.Task(name="mytask")
+    t.start()
+    nd.ones((2, 2)).asnumpy()
+    t.stop()
+    profiler.set_state("stop")
+
+
+def test_monitor_collects_stats():
+    from mxnet_tpu.monitor import Monitor
+    x, _ = np.random.randn(16, 4).astype(np.float32), None
+    sym = mx.sym.FullyConnected(mx.sym.var("data"), num_hidden=3, name="fc")
+    ex = sym.simple_bind(mx.cpu(0), data=(16, 4), fc_weight=(3, 4),
+                         fc_bias=(3,))
+    mon = Monitor(interval=1)
+    mon.install(ex)
+    mon.tic()
+    ex.forward(data=nd.array(x))
+    stats = mon.toc()
+    assert stats, "monitor captured nothing"
+    names = [n for _, n, _ in stats]
+    assert any("fc" in n or "output" in n for n in names), names
+
+
+def test_estimator_fit():
+    from mxnet_tpu.gluon.contrib.estimator import Estimator
+    rs = np.random.RandomState(0)
+    x = rs.randn(64, 6).astype(np.float32)
+    w = rs.randn(6, 3).astype(np.float32)
+    y = (x @ w).argmax(1).astype(np.float32)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(3))
+    net.initialize(init=mx.initializer.Xavier())
+    loss = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 5e-3})
+    est = Estimator(net=net, loss=loss, trainer=trainer,
+                    metrics=mx.metric.Accuracy())
+    loader = gluon.data.DataLoader(
+        gluon.data.ArrayDataset(x, y), batch_size=16)
+    est.fit(train_data=loader, epochs=3)
