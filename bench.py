@@ -328,7 +328,9 @@ def main_bert():
     from mxnet_tpu.gluon.model_zoo import bert_base
     from mxnet_tpu.gluon.model_zoo.bert import BERTMLMHead
 
-    batch = int(os.environ.get("BENCH_BATCH", "32"))
+    # batch 64 measured fastest (sweep r2: 32→103k, 64→109k, 128→108.5k
+    # tok/s at 36.4% MFU)
+    batch = int(os.environ.get("BENCH_BATCH", "64"))
     seqlen = int(os.environ.get("BENCH_SEQLEN", "128"))
     vocab = 30522
     ctx = mx.current_context()

@@ -813,3 +813,36 @@ def test_every_op_is_covered():
     covered = _HERE_TABLES | _HERE_EXPLICIT | set(COVERED_ELSEWHERE)
     missing = sorted(canonical - covered)
     assert not missing, f"ops with no test coverage: {missing}"
+
+
+# ---------------------------------------------------------------------------
+# cross-dtype consistency (SURVEY §4: check_consistency is the
+# cpu-vs-backend golden gate; here f32 vs bf16 on the default backend —
+# under MXNET_TPU_TEST_REAL_DEVICE=1 the same cases run on the chip)
+# ---------------------------------------------------------------------------
+from mxnet_tpu.test_utils import check_consistency
+
+
+def _consistency_ctx_list():
+    return [{"ctx": mx.cpu(0), "dtype": "float32"},
+            {"ctx": mx.cpu(0), "dtype": "bfloat16"}]
+
+
+@pytest.mark.parametrize("case", [
+    ("fc", lambda x, w: nd.FullyConnected(x, w, None, num_hidden=4,
+                                          no_bias=True),
+     [(3, 6), (4, 6)], None),
+    ("conv", lambda x, w: nd.Convolution(x, w, kernel=(3, 3), num_filter=2,
+                                         pad=(1, 1), no_bias=True),
+     [(1, 2, 6, 6), (2, 2, 3, 3)], None),
+    # sum-loss makes softmax/normalization grads ~0: the comparison is
+    # absolute-error dominated, so bf16 needs a looser atol
+    ("softmax", lambda x: nd.softmax(x), [(4, 7)], 5e-3),
+    ("layernorm", lambda x, g, b: nd.LayerNorm(x, g, b),
+     [(3, 8), (8,), (8,)], 2e-2),
+    ("tanh_chain", lambda x: nd.tanh(nd.exp(x) * 0.3), [(4, 5)], None),
+])
+def test_check_consistency_f32_vs_bf16(case):
+    name, fn, shapes, atol = case
+    inputs = [RS.randn(*s).astype(np.float32) * 0.5 for s in shapes]
+    check_consistency(fn, _consistency_ctx_list(), inputs, atol=atol)
