@@ -16,7 +16,23 @@ Typical use mirrors mxnet::
 """
 from __future__ import annotations
 
+import os as _os
+
 __version__ = "0.1.0"
+
+# float32 numerics parity: TPU's default matmul precision is ONE bf16
+# pass (~1-3% rel error) — reference users expect cuDNN-f32-class
+# accuracy from f32 ops. 'high' (multi-pass bf16) restores ~f32 accuracy
+# for f32 inputs and does not change bf16 compute (the perf path).
+# Override with MXNET_TPU_MATMUL_PRECISION=default for max f32 speed.
+import jax as _jax
+
+try:
+    _jax.config.update(
+        "jax_default_matmul_precision",
+        _os.environ.get("MXNET_TPU_MATMUL_PRECISION", "high"))
+except Exception:  # unknown value: leave jax defaults
+    pass
 
 from .base import MXNetError
 from .context import (

@@ -350,7 +350,7 @@ def softmax_output(data, label, grad_scale=1.0, ignore_label=-1.0,
 def softmax_cross_entropy(data, label):
     from ..ops import pallas as _pallas
 
-    if (_pallas.pallas_enabled()
+    if (_pallas.pallas_ok_for(data)
             and data.dtype in (jnp.float32, jnp.bfloat16, jnp.float16)):
         loss = _pallas.softmax_xent_fused(data, label)
         return jnp.sum(loss).reshape(1).astype(data.dtype)
@@ -396,7 +396,7 @@ def flash_attention_op(query, key, value, causal=False, sm_scale=None):
     """
     from ..ops import pallas as _pallas
 
-    if (_pallas.pallas_enabled()
+    if (_pallas.pallas_ok_for(query)
             and query.dtype in (jnp.float32, jnp.bfloat16, jnp.float16)
             and query.ndim == 4):
         # end-aligned causal mask for sq != skv (KV-cache decode): q row
@@ -453,7 +453,7 @@ def layer_norm(data, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False):
             and data.dtype in (jnp.float32, jnp.bfloat16, jnp.float16)):
         from ..ops import pallas as _pallas
 
-        if _pallas.pallas_enabled():
+        if _pallas.pallas_ok_for(data):
             return _pallas.layer_norm_fused(
                 data, gamma, beta, float(eps))
     mean = jnp.mean(data, axis=ax, keepdims=True)

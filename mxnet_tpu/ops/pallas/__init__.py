@@ -23,7 +23,7 @@ exercised off-TPU (the numerics tests do this).
 """
 from __future__ import annotations
 
-from ._util import interpret_mode, pallas_enabled  # noqa: F401
+from ._util import interpret_mode, pallas_enabled, pallas_ok_for  # noqa: F401
 
 from .layer_norm import layer_norm_fused  # noqa: E402
 from .flash_attention import flash_attention, flash_attention_with_lse  # noqa: E402

@@ -23,6 +23,30 @@ def pallas_enabled() -> bool:
     return jax.default_backend() == "tpu"
 
 
+def pallas_ok_for(data) -> bool:
+    """pallas_enabled() AND the value actually lives on (or is being
+    traced for) a TPU device. In a TPU-backend process an op invoked on
+    a cpu(0) context must NOT take the Mosaic path — it would crash at
+    lowering ('Only interpret mode is supported on CPU backend')."""
+    if not pallas_enabled():
+        return False
+    if interpret_mode():
+        return True
+    dev = getattr(data, "device", None)  # tracers have no device
+    if dev is None:
+        dev = jax.config.jax_default_device  # trace-time placement
+    plat = getattr(dev, "platform", None)
+    if plat is None and dev is not None:
+        # multi-device arrays: .device returns a Sharding — inspect its
+        # device set (a CPU-mesh-sharded array in a TPU process must
+        # still refuse the Mosaic path)
+        devs = getattr(dev, "device_set", None)
+        if devs:
+            plats = {getattr(d, "platform", None) for d in devs}
+            return plats <= {"tpu"}
+    return plat is None or plat == "tpu"
+
+
 def resolve_interpret(interpret):
     """``interpret=None`` (the public-entry default) means "whatever
     MXNET_TPU_PALLAS_INTERPRET says" — so call sites can't forget to

@@ -43,6 +43,40 @@ _TOLS = {
     "float64": (1e-5, 1e-8),
 }
 
+# Per-DEVICE tolerance widening (the reference's check_consistency keys
+# tolerances on (device, dtype) for the same reason): on TPU, float32
+# matmuls execute as bf16 MXU passes and transcendentals are polynomial
+# approximations, so f32 results carry ~1e-3 relative error vs CPU.
+_TPU_TOLS = {
+    "float32": (5e-3, 2e-3),
+    "float64": (5e-3, 2e-3),
+}
+
+
+_ON_TPU_CACHE = None
+
+
+def _on_tpu():
+    """LAZY backend probe: jax.default_backend() initializes the XLA
+    backend, which must never happen at mxnet_tpu import time
+    (jax.distributed.initialize has to come first in dist workers)."""
+    global _ON_TPU_CACHE
+    if _ON_TPU_CACHE is None:
+        try:
+            import jax
+            _ON_TPU_CACHE = jax.default_backend() in ("tpu", "axon", "gpu")
+        except Exception:
+            _ON_TPU_CACHE = False
+    return _ON_TPU_CACHE
+
+
+def device_tols(dtype="float32"):
+    """(rtol, atol) for comparing `dtype` results on the active backend
+    — use in tests that call numpy asserts directly."""
+    if _on_tpu() and str(dtype) in _TPU_TOLS:
+        return _TPU_TOLS[str(dtype)]
+    return _TOLS.get(str(dtype), (1e-4, 1e-6))
+
 
 def default_context() -> Context:
     return _DEFAULT_CTX or current_context()
@@ -77,7 +111,8 @@ def _resolve_tols(a, b, rtol, atol):
         names = {str(a.dtype), str(b.dtype)}
         worst = (1e-5, 1e-8)
         for nm in names:
-            t = _TOLS.get(nm, (1e-4, 1e-6))
+            t = _TPU_TOLS.get(nm) if _on_tpu() else None
+            t = t or _TOLS.get(nm, (1e-4, 1e-6))
             worst = (max(worst[0], t[0]), max(worst[1], t[1]))
         rtol = worst[0] if rtol is None else rtol
         atol = worst[1] if atol is None else atol
@@ -133,7 +168,12 @@ def check_numeric_gradient(fn, inputs, grad_outputs=None, eps=1e-3,
 
     fn: callable(*NDArrays) -> NDArray (scalar or any shape; reduced by
     sum for the check). inputs: list of numpy arrays.
+
+    On an accelerator the tolerances widen (reference: per-device tol
+    tables) — finite differences amplify the backend's f32 rounding.
     """
+    if _on_tpu():
+        rtol, atol = max(rtol, 5e-2), max(atol, 5e-3)
     from . import autograd
 
     ctx = ctx or default_context()
@@ -192,7 +232,7 @@ def check_consistency(fn, ctx_list, inputs, rtol=None, atol=None,
     ref_out, ref_grads, ref_combo = results[0]
     for out, grads, combo in results[1:]:
         dt = combo.get("dtype", "float32")
-        t = _TOLS.get(dt, (1e-4, 1e-6))
+        t = device_tols(dt)  # per-(device, dtype) — the harness's point
         r = rtol if rtol is not None else t[0]
         a = atol if atol is not None else t[1]
         assert_almost_equal(out, ref_out, rtol=r, atol=a,

@@ -21,7 +21,13 @@ import pytest
 import mxnet_tpu as mx
 from mxnet_tpu import nd
 from mxnet_tpu.ndarray.register import _OPS, get_op, invoke
-from mxnet_tpu.test_utils import assert_almost_equal, check_numeric_gradient
+from mxnet_tpu.test_utils import (assert_almost_equal,
+                                  check_numeric_gradient, device_tols)
+
+# device-aware float32 tolerances: tight on CPU, widened on TPU where
+# f32 matmuls ride bf16 MXU passes (reference per-device tol tables)
+RTOL_F32, ATOL_F32 = device_tols("float32")
+RTOL_L, ATOL_L = max(1e-3, RTOL_F32), max(1e-4, ATOL_F32)
 
 RS = np.random.RandomState(42)
 
@@ -134,14 +140,14 @@ def test_unary_forward(name):
     if ref is None:
         return
     assert_almost_equal(out.astype(np.float32), ref(x).astype(np.float32),
-                        rtol=1e-4, atol=1e-5)
+                        rtol=RTOL_F32, atol=ATOL_F32)
 
 
 def test_erfinv_inverts_erf():
     x = _unit((3, 4))
     y = nd.erfinv(nd.array(x))
     back = nd.erf(y).asnumpy()
-    assert_almost_equal(back, x, rtol=1e-3, atol=1e-4)
+    assert_almost_equal(back, x, rtol=RTOL_L, atol=ATOL_L)
 
 
 # ---------------------------------------------------------------------------
@@ -177,7 +183,7 @@ def test_binary_broadcast_forward(name):
         b = (RS.rand(1, 4) * 2).astype(np.float32)
     out = getattr(nd, name)(nd.array(a), nd.array(b)).asnumpy()
     assert_almost_equal(out.astype(np.float32), ref(a, b).astype(np.float32),
-                        rtol=1e-4, atol=1e-5)
+                        rtol=RTOL_F32, atol=ATOL_F32)
 
 
 SCALAR = {
@@ -209,7 +215,7 @@ def test_scalar_op_forward(name):
     s = 1.5
     out = invoke(get_op(name), [nd.array(x)], {"scalar": s}).asnumpy()
     assert_almost_equal(out.astype(np.float32), ref(x, s).astype(np.float32),
-                        rtol=1e-4, atol=1e-5)
+                        rtol=RTOL_F32, atol=ATOL_F32)
 
 
 # ---------------------------------------------------------------------------
@@ -231,15 +237,15 @@ def test_reduce_forward(name, axis, keepdims):
     out = getattr(nd, name)(nd.array(x), axis=axis, keepdims=keepdims).asnumpy()
     want = ref(x, axis=axis, keepdims=keepdims)
     assert_almost_equal(np.asarray(out, np.float32).reshape(np.shape(want)),
-                        np.asarray(want, np.float32), rtol=1e-4, atol=1e-5)
+                        np.asarray(want, np.float32), rtol=RTOL_F32, atol=ATOL_F32)
 
 
 def test_norm_argmax_argmin():
     x = _any((3, 4))
     assert_almost_equal(nd.norm(nd.array(x)).asnumpy().reshape(()),
-                        np.linalg.norm(x).astype(np.float32), rtol=1e-4, atol=1e-5)
+                        np.linalg.norm(x).astype(np.float32), rtol=RTOL_F32, atol=ATOL_F32)
     assert_almost_equal(nd.norm(nd.array(x), ord=1, axis=1).asnumpy(),
-                        np.abs(x).sum(1), rtol=1e-4, atol=1e-5)
+                        np.abs(x).sum(1), rtol=RTOL_F32, atol=ATOL_F32)
     assert (nd.argmax(nd.array(x), axis=1).asnumpy() == x.argmax(1)).all()
     assert (nd.argmin(nd.array(x), axis=1).asnumpy() == x.argmin(1)).all()
     x4 = _any((2, 3, 4))
@@ -250,7 +256,7 @@ def test_l2_normalization():
     x = _any((3, 4))
     out = nd.L2Normalization(nd.array(x)).asnumpy()
     want = x / (np.sqrt((x ** 2).sum(axis=1, keepdims=True)) + 1e-10)
-    assert_almost_equal(out, want, rtol=1e-4, atol=1e-5)
+    assert_almost_equal(out, want, rtol=RTOL_F32, atol=ATOL_F32)
 
 
 # ---------------------------------------------------------------------------
@@ -391,14 +397,14 @@ def test_ordering_ops():
 def test_matmul_family():
     a, b = _any((3, 4)), _any((4, 5))
     assert_almost_equal(nd.dot(nd.array(a), nd.array(b)).asnumpy(), a @ b,
-                        rtol=1e-4, atol=1e-5)
+                        rtol=RTOL_F32, atol=ATOL_F32)
     assert_almost_equal(nd.dot(nd.array(a.T), nd.array(b), transpose_a=True).asnumpy(),
-                        a @ b, rtol=1e-4, atol=1e-5)
+                        a @ b, rtol=RTOL_F32, atol=ATOL_F32)
     assert_almost_equal(nd.matmul(nd.array(a), nd.array(b)).asnumpy(), a @ b,
-                        rtol=1e-4, atol=1e-5)
+                        rtol=RTOL_F32, atol=ATOL_F32)
     ba, bb = _any((2, 3, 4)), _any((2, 4, 5))
     assert_almost_equal(nd.batch_dot(nd.array(ba), nd.array(bb)).asnumpy(),
-                        ba @ bb, rtol=1e-4, atol=1e-5)
+                        ba @ bb, rtol=RTOL_F32, atol=ATOL_F32)
     k = nd.khatri_rao(nd.array(_any((2, 3))), nd.array(_any((4, 3))))
     assert k.shape == (8, 3)
 
@@ -407,23 +413,23 @@ def test_linalg_ops():
     a, b, c = _any((3, 4)), _any((4, 5)), _any((3, 5))
     assert_almost_equal(
         nd.linalg_gemm(nd.array(a), nd.array(b), nd.array(c), alpha=2.0, beta=0.5).asnumpy(),
-        2.0 * (a @ b) + 0.5 * c, rtol=1e-4, atol=1e-5)
+        2.0 * (a @ b) + 0.5 * c, rtol=RTOL_F32, atol=ATOL_F32)
     assert_almost_equal(nd.linalg_gemm2(nd.array(a), nd.array(b)).asnumpy(),
-                        a @ b, rtol=1e-4, atol=1e-5)
+                        a @ b, rtol=RTOL_F32, atol=ATOL_F32)
     m = _any((3, 3))
     spd = m @ m.T + 3.0 * np.eye(3, dtype=np.float32)
     L = nd.linalg_potrf(nd.array(spd)).asnumpy()
-    assert_almost_equal(L @ L.T, spd, rtol=1e-3, atol=1e-4)
+    assert_almost_equal(L @ L.T, spd, rtol=RTOL_L, atol=ATOL_L)
     # trsm: solve L X = B
     B = _any((3, 2))
     X = nd.linalg_trsm(nd.array(L), nd.array(B)).asnumpy()
-    assert_almost_equal(L @ X, B, rtol=1e-3, atol=1e-4)
+    assert_almost_equal(L @ X, B, rtol=RTOL_L, atol=ATOL_L)
     assert_almost_equal(
         nd.linalg_sumlogdiag(nd.array(spd)).asnumpy().reshape(()),
-        np.log(np.diag(spd)).sum().astype(np.float32), rtol=1e-4, atol=1e-5)
+        np.log(np.diag(spd)).sum().astype(np.float32), rtol=RTOL_F32, atol=ATOL_F32)
     assert_almost_equal(nd.linalg_extractdiag(nd.array(spd)).asnumpy(), np.diag(spd))
     assert_almost_equal(nd.linalg_syrk(nd.array(a)).asnumpy(), a @ a.T,
-                        rtol=1e-4, atol=1e-5)
+                        rtol=RTOL_F32, atol=ATOL_F32)
 
 
 # ---------------------------------------------------------------------------
@@ -432,7 +438,7 @@ def test_linalg_ops():
 def test_fully_connected():
     x, w, b = _any((4, 6)), _any((3, 6)), _any((3,))
     out = nd.FullyConnected(nd.array(x), nd.array(w), nd.array(b), num_hidden=3)
-    assert_almost_equal(out.asnumpy(), x @ w.T + b, rtol=1e-4, atol=1e-5)
+    assert_almost_equal(out.asnumpy(), x @ w.T + b, rtol=RTOL_F32, atol=ATOL_F32)
 
 
 def test_convolution_1x1_golden():
@@ -440,7 +446,7 @@ def test_convolution_1x1_golden():
     out = nd.Convolution(nd.array(x), nd.array(w), kernel=(1, 1), num_filter=4,
                          no_bias=True)
     want = np.einsum("bchw,oc->bohw", x, w[:, :, 0, 0])
-    assert_almost_equal(out.asnumpy(), want, rtol=1e-3, atol=1e-4)
+    assert_almost_equal(out.asnumpy(), want, rtol=RTOL_L, atol=ATOL_L)
 
 
 def test_convolution_3x3_vs_manual():
@@ -454,7 +460,7 @@ def test_convolution_3x3_vs_manual():
         for i in range(4):
             for j in range(4):
                 want[0, o, i, j] = (xp[0, :, i:i + 3, j:j + 3] * w[o]).sum() + b[o]
-    assert_almost_equal(out, want, rtol=1e-3, atol=1e-4)
+    assert_almost_equal(out, want, rtol=RTOL_L, atol=ATOL_L)
 
 
 def test_deconvolution_shape_and_grad_of_conv():
@@ -471,9 +477,9 @@ def test_pooling_golden():
     assert_almost_equal(mx_out, want)
     avg = nd.Pooling(nd.array(x), kernel=(2, 2), stride=(2, 2), pool_type="avg").asnumpy()
     assert_almost_equal(avg, x.reshape(1, 2, 2, 2, 2, 2).mean(axis=(3, 5)),
-                        rtol=1e-5, atol=1e-6)
+                        rtol=RTOL_F32, atol=ATOL_F32)
     gp = nd.Pooling(nd.array(x), global_pool=True, pool_type="avg").asnumpy()
-    assert_almost_equal(gp, x.mean(axis=(2, 3), keepdims=True), rtol=1e-5, atol=1e-6)
+    assert_almost_equal(gp, x.mean(axis=(2, 3), keepdims=True), rtol=RTOL_F32, atol=ATOL_F32)
 
 
 def test_upsampling():
@@ -489,11 +495,11 @@ def test_activation_variants():
                      ("tanh", np.tanh),
                      ("softrelu", lambda v: np.log1p(np.exp(v)))]:
         out = nd.Activation(nd.array(x), act_type=act).asnumpy()
-        assert_almost_equal(out, ref(x).astype(np.float32), rtol=1e-4, atol=1e-5)
+        assert_almost_equal(out, ref(x).astype(np.float32), rtol=RTOL_F32, atol=ATOL_F32)
     lr = nd.LeakyReLU(nd.array(x), act_type="leaky", slope=0.1).asnumpy()
-    assert_almost_equal(lr, np.where(x > 0, x, 0.1 * x), rtol=1e-4, atol=1e-5)
+    assert_almost_equal(lr, np.where(x > 0, x, 0.1 * x), rtol=RTOL_F32, atol=ATOL_F32)
     el = nd.LeakyReLU(nd.array(x), act_type="elu", slope=1.0).asnumpy()
-    assert_almost_equal(el, np.where(x > 0, x, np.exp(x) - 1), rtol=1e-4, atol=1e-5)
+    assert_almost_equal(el, np.where(x > 0, x, np.exp(x) - 1), rtol=RTOL_F32, atol=ATOL_F32)
 
 
 def _np_softmax(x, axis=-1):
@@ -504,29 +510,29 @@ def _np_softmax(x, axis=-1):
 def test_softmax_family():
     x = _any((3, 5))
     assert_almost_equal(nd.softmax(nd.array(x)).asnumpy(), _np_softmax(x),
-                        rtol=1e-4, atol=1e-5)
+                        rtol=RTOL_F32, atol=ATOL_F32)
     assert_almost_equal(nd.log_softmax(nd.array(x)).asnumpy(),
-                        np.log(_np_softmax(x)), rtol=1e-4, atol=1e-5)
+                        np.log(_np_softmax(x)), rtol=RTOL_F32, atol=ATOL_F32)
     assert_almost_equal(nd.softmin(nd.array(x)).asnumpy(), _np_softmax(-x),
-                        rtol=1e-4, atol=1e-5)
+                        rtol=RTOL_F32, atol=ATOL_F32)
     assert_almost_equal(nd.SoftmaxActivation(nd.array(x)).asnumpy(),
-                        _np_softmax(x), rtol=1e-4, atol=1e-5)
+                        _np_softmax(x), rtol=RTOL_F32, atol=ATOL_F32)
     assert_almost_equal(nd.SoftmaxOutput(nd.array(x), nd.array(np.zeros(3, np.float32))).asnumpy(),
-                        _np_softmax(x), rtol=1e-4, atol=1e-5)
+                        _np_softmax(x), rtol=RTOL_F32, atol=ATOL_F32)
     lbl = np.array([1, 0, 4], np.float32)
     sce = nd.softmax_cross_entropy(nd.array(x), nd.array(lbl)).asnumpy()
     want = -np.log(_np_softmax(x))[np.arange(3), lbl.astype(int)].sum()
-    assert_almost_equal(sce.reshape(()), np.float32(want), rtol=1e-4, atol=1e-5)
+    assert_almost_equal(sce.reshape(()), np.float32(want), rtol=RTOL_F32, atol=ATOL_F32)
 
 
 def test_attention_helper_ops():
     q, k, v = _any((2, 2, 3, 4)), _any((2, 2, 5, 4)), _any((2, 2, 5, 4))
     s = nd.batch_dot_attention_scores(nd.array(q), nd.array(k)).asnumpy()
     assert_almost_equal(s, np.einsum("bhqd,bhkd->bhqk", q, k),
-                        rtol=1e-4, atol=1e-5)
+                        rtol=RTOL_F32, atol=ATOL_F32)
     p = _np_softmax(s)
     o = nd.batch_dot_attention_apply(nd.array(p.astype(np.float32)), nd.array(v)).asnumpy()
-    assert_almost_equal(o, np.einsum("bhqk,bhkd->bhqd", p, v), rtol=1e-4, atol=1e-5)
+    assert_almost_equal(o, np.einsum("bhqk,bhkd->bhqd", p, v), rtol=RTOL_F32, atol=ATOL_F32)
     sq = _any((2, 4, 4))
     masked = nd.causal_mask_scores(nd.array(sq)).asnumpy()
     iu = np.triu_indices(4, 1)
@@ -540,7 +546,7 @@ def test_flash_attention_vs_composed():
     out = nd.flash_attention(nd.array(q), nd.array(k), nd.array(v)).asnumpy()
     s = (q @ k.transpose(0, 1, 3, 2)) / np.sqrt(4.0)
     want = _np_softmax(s) @ v
-    assert_almost_equal(out, want, rtol=1e-3, atol=1e-4)
+    assert_almost_equal(out, want, rtol=RTOL_L, atol=ATOL_L)
 
 
 def test_norm_layers_golden():
@@ -550,7 +556,7 @@ def test_norm_layers_golden():
     mu = x.mean(-1, keepdims=True)
     var = x.var(-1, keepdims=True)
     assert_almost_equal(ln, (x - mu) / np.sqrt(var + 1e-5) * g + b,
-                        rtol=1e-3, atol=1e-4)
+                        rtol=RTOL_L, atol=ATOL_L)
 
     x4 = _any((2, 4, 3, 3))
     g4, b4 = _pos((4,)), _any((4,))
@@ -598,7 +604,7 @@ def test_dropout_modes():
         y = nd.Dropout(nd.array(x), p=0.5)
     kept = (y.asnumpy() != 0)
     assert 0.3 < kept.mean() < 0.7
-    assert_almost_equal(y.asnumpy()[kept], (x * 2.0)[kept], rtol=1e-4, atol=1e-5)
+    assert_almost_equal(y.asnumpy()[kept], (x * 2.0)[kept], rtol=RTOL_F32, atol=ATOL_F32)
     y_eval = nd.Dropout(nd.array(x), p=0.5)  # predict mode: identity
     assert_almost_equal(y_eval.asnumpy(), x)
 
@@ -622,7 +628,7 @@ def test_regression_outputs():
     assert_almost_equal(nd.LinearRegressionOutput(nd.array(x), nd.array(y)).asnumpy(), x)
     assert_almost_equal(nd.MAERegressionOutput(nd.array(x), nd.array(y)).asnumpy(), x)
     assert_almost_equal(nd.LogisticRegressionOutput(nd.array(x), nd.array(y)).asnumpy(),
-                        1 / (1 + np.exp(-x)), rtol=1e-4, atol=1e-5)
+                        1 / (1 + np.exp(-x)), rtol=RTOL_F32, atol=ATOL_F32)
 
 
 def test_bilinear_sampler_identity_grid():
@@ -824,8 +830,13 @@ from mxnet_tpu.test_utils import check_consistency
 
 
 def _consistency_ctx_list():
-    return [{"ctx": mx.cpu(0), "dtype": "float32"},
-            {"ctx": mx.cpu(0), "dtype": "bfloat16"}]
+    # default_context() resolves to the REAL chip under
+    # MXNET_TPU_TEST_REAL_DEVICE=1 and to cpu on the virtual mesh — so
+    # the same cases are the cpu golden run and the on-chip run
+    from mxnet_tpu.test_utils import default_context
+    ctx = default_context()
+    return [{"ctx": ctx, "dtype": "float32"},
+            {"ctx": ctx, "dtype": "bfloat16"}]
 
 
 @pytest.mark.parametrize("case", [
