@@ -27,12 +27,17 @@ __version__ = "0.1.0"
 # Override with MXNET_TPU_MATMUL_PRECISION=default for max f32 speed.
 import jax as _jax
 
+_prec = _os.environ.get("MXNET_TPU_MATMUL_PRECISION", "high")
 try:
-    _jax.config.update(
-        "jax_default_matmul_precision",
-        _os.environ.get("MXNET_TPU_MATMUL_PRECISION", "high"))
-except Exception:  # unknown value: leave jax defaults
-    pass
+    _jax.config.update("jax_default_matmul_precision", _prec)
+except Exception:
+    # an invalid override must not silently demote f32 numerics to the
+    # single-pass-bf16 jax default — warn and keep the documented 'high'
+    import warnings as _warnings
+    _warnings.warn(
+        f"invalid MXNET_TPU_MATMUL_PRECISION={_prec!r}; using 'high'")
+    _jax.config.update("jax_default_matmul_precision", "high")
+del _prec
 
 from .base import MXNetError
 from .context import (

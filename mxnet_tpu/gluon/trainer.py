@@ -75,7 +75,15 @@ class Trainer:
             kv = kvstore if not isinstance(kvstore, str) \
                 else _kvstore_mod.create(kvstore)
             self._kvstore = kv
-            if update_on_kvstore is None:
+            if kv.type == "horovod":
+                # the allreduce-only store never runs the optimizer
+                # (reference trainer.py horovod branch)
+                if update_on_kvstore:
+                    raise ValueError(
+                        "Cannot set update_on_kvstore=True when kvstore "
+                        "is 'horovod'")
+                update_on_kvstore = False
+            elif update_on_kvstore is None:
                 update_on_kvstore = kv.num_workers > 1
             self._update_on_kvstore = update_on_kvstore
             if self._compression_params:

@@ -51,8 +51,7 @@ def create(name="local") -> "KVStore":
     if name in ("dist_sync", "dist_async", "dist_device_sync", "dist_sync_device", "dist"):
         return DistKVStore(name)
     if name == "horovod":
-        raise MXNetError("horovod kvstore is not supported on the TPU backend; "
-                         "use 'device' (ICI) or 'dist_sync' (multi-host)")
+        return HorovodKVStore()
     raise MXNetError(f"unknown kvstore type {name!r}")
 
 
@@ -336,6 +335,83 @@ class DistKVStore(KVStore):
         if self.num_workers > 1:
             from jax.experimental import multihost_utils
             multihost_utils.sync_global_devices("mxnet_tpu_kvstore_barrier")
+
+
+class HorovodKVStore(DistKVStore):
+    """``kvstore='horovod'`` shim (reference python/mxnet/kvstore.py
+    KVStoreHorovod, v>=1.5): the allreduce-only store. Upstream it
+    delegates broadcast/pushpull to horovod.mxnet (MPI/NCCL rings) and
+    supports ONLY ``broadcast`` + ``pushpull`` — no push/pull, no
+    server-side optimizer (Trainer always updates locally). The
+    TPU-native ring is the shared compiled XLA AllReduce: DistKVStore's
+    reduce path covers both fabrics (ICI within a process, global-mesh /
+    DCN psum across processes when jax.distributed is live), so this
+    subclass only applies the horovod API restrictions on top."""
+
+    def __init__(self):
+        super().__init__("horovod")
+
+    @property
+    def local_rank(self):
+        # set per worker by tools/launch.py (rank within this host);
+        # single-process or unlaunched runs are local rank 0
+        return int(os.environ.get("MXNET_TPU_LOCAL_RANK", "0"))
+
+    def push(self, key, value, priority=0):
+        raise MXNetError("push is not supported by horovod kvstore; "
+                         "use pushpull")
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        raise MXNetError("pull is not supported by horovod kvstore; "
+                         "use pushpull or broadcast")
+
+    def pushpull(self, key, value, out=None, priority=0):
+        """hvd.allreduce(sum) analog: reduce values across all replicas
+        into out (or in place). No store-side updater ever runs; the
+        fused multi-key reduce (one compiled XLA program) is shared with
+        the 'device'/'dist' stores. The stored value always ends up as
+        the REDUCED result (so a later broadcast serves fresh data)."""
+        keys, values = _normalize(key, value)
+        outs = values if out is None else _normalize(key, out)[1]
+        for k, v in zip(keys, values):
+            if k not in self._store:
+                vs = v if isinstance(v, (list, tuple)) else [v]
+                self._store[k] = vs[0].copy()
+        if self._try_fused_pushpull(keys, values, outs):
+            return
+        # fallback: the base push (reduce into store — no updater can
+        # ever be set here) + pull (copy out), explicitly bypassing this
+        # class's disabled overrides
+        KVStore.push(self, key, value, priority)
+        KVStore.pull(self, key, out if out is not None else value, priority)
+
+    def broadcast(self, key, value, out=None, priority=0):
+        """hvd.broadcast_parameters analog: the root's CURRENT value
+        wins — the store is overwritten on every call (upstream
+        re-transmits each time; serving a stale stored value would
+        silently drop updates). SPMD construction makes every process
+        hold identical initialized values, so no bytes cross hosts."""
+        keys, values = _normalize(key, value)
+        for k, v in zip(keys, values):
+            vs = v if isinstance(v, (list, tuple)) else [v]
+            if k in self._store:
+                stored = self._store[k]
+                stored._set_data(vs[0]._data.astype(stored.dtype))
+            else:
+                self._store[k] = vs[0].copy()
+        if out is not None:
+            _, outs = _normalize(key, out)
+            for k, o in zip(keys, outs):
+                stored = self._get(k)
+                for dst in (o if isinstance(o, (list, tuple)) else [o]):
+                    stored.copyto(dst)
+
+    def set_optimizer(self, optimizer):
+        raise MXNetError("cannot set optimizer on horovod kvstore "
+                         "(update_on_kvstore is always False)")
+
+    def _set_updater(self, updater):
+        raise MXNetError("cannot set updater on horovod kvstore")
 
 
 def _maybe_init_distributed() -> bool:

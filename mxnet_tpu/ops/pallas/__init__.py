@@ -31,6 +31,7 @@ from .softmax_xent import softmax_xent_fused  # noqa: E402
 
 __all__ = [
     "pallas_enabled",
+    "pallas_ok_for",
     "interpret_mode",
     "layer_norm_fused",
     "flash_attention",

@@ -32,19 +32,31 @@ def pallas_ok_for(data) -> bool:
         return False
     if interpret_mode():
         return True
-    dev = getattr(data, "device", None)  # tracers have no device
-    if dev is None:
-        dev = jax.config.jax_default_device  # trace-time placement
-    plat = getattr(dev, "platform", None)
-    if plat is None and dev is not None:
-        # multi-device arrays: .device returns a Sharding — inspect its
-        # device set (a CPU-mesh-sharded array in a TPU process must
-        # still refuse the Mosaic path)
-        devs = getattr(dev, "device_set", None)
-        if devs:
-            plats = {getattr(d, "platform", None) for d in devs}
-            return plats <= {"tpu"}
-    return plat is None or plat == "tpu"
+    # jax.Array.devices() -> set[Device] classifies single- and
+    # multi-device arrays uniformly (a CPU-mesh-sharded array in a TPU
+    # process must refuse the Mosaic path). Tracers expose neither
+    # .devices nor .device.
+    devs = None
+    devices_fn = getattr(data, "devices", None)
+    if callable(devices_fn):
+        try:
+            devs = devices_fn()
+        except Exception:
+            devs = None
+    if devs is None:
+        dev = getattr(data, "device", None)
+        if dev is not None and not callable(dev):
+            devs = getattr(dev, "device_set", None)
+            if not devs and hasattr(dev, "platform"):
+                devs = [dev]
+    if devs is None:
+        # trace time: placement is the default device / backend
+        dev = jax.config.jax_default_device
+        if dev is None:
+            return jax.default_backend() == "tpu"
+        devs = [dev]
+    # unknown platforms fail CLOSED — jnp fallback is always correct
+    return {getattr(d, "platform", None) for d in devs} == {"tpu"}
 
 
 def resolve_interpret(interpret):

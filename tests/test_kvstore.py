@@ -58,7 +58,8 @@ def test_kvstore_fused_pushpull_multi_key():
     assert hlo and "all-reduce" in hlo, "fused pushpull did not compile to an all-reduce"
 
 
-def _fit_one_step(ctx_list, x_np, y_np, lr=0.1, hybridize=True):
+def _fit_one_step(ctx_list, x_np, y_np, lr=0.1, hybridize=True,
+                  kvstore="device"):
     mx.random.seed(7)
     np.random.seed(7)
     net = nn.HybridSequential()
@@ -68,7 +69,7 @@ def _fit_one_step(ctx_list, x_np, y_np, lr=0.1, hybridize=True):
         net.hybridize()
     loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
     trainer = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": lr},
-                            kvstore="device")
+                            kvstore=kvstore)
     xs = split_and_load(nd.array(x_np), ctx_list)
     ys = split_and_load(nd.array(y_np), ctx_list)
     with autograd.record():
@@ -287,3 +288,43 @@ def test_trainer_no_kvstore_still_reduces_replicas():
     multi = one_step(CTXS, None)
     for r, m in zip(ref, multi):
         assert_almost_equal(m, r, rtol=1e-5, atol=1e-6)
+
+
+def test_horovod_kvstore_pushpull_and_restrictions():
+    """kvstore='horovod' shim (reference KVStoreHorovod, v>=1.5):
+    allreduce-only — pushpull/broadcast work, push/pull/optimizer raise."""
+    kv = kvstore.create("horovod")
+    assert kv.type == "horovod"
+    assert kv.rank == 0 and kv.num_workers >= 1
+    vals = [nd.full((4, 2), float(i + 1), ctx=c) for i, c in enumerate(CTXS)]
+    kv.pushpull("w", vals, out=vals)
+    for v in vals:
+        assert_almost_equal(v, np.full((4, 2), 3.0, np.float32))
+    with pytest.raises(mx.base.MXNetError):
+        kv.push("w", vals)
+    with pytest.raises(mx.base.MXNetError):
+        kv.pull("w", out=nd.zeros((4, 2)))
+    with pytest.raises(mx.base.MXNetError):
+        kv.set_optimizer(mx.optimizer.SGD())
+    # broadcast: root value lands in every out replica
+    outs = [nd.zeros((3,), ctx=c) for c in CTXS]
+    kv.broadcast("b", nd.arange(3), out=outs)
+    for o in outs:
+        assert_almost_equal(o, np.arange(3, dtype=np.float32))
+
+
+def test_trainer_horovod_matches_device():
+    """Trainer over the horovod store trains identically to 'device'
+    (same compiled all-reduce underneath) and forbids
+    update_on_kvstore=True."""
+    np.random.seed(11)
+    x = np.random.randn(8, 6).astype(np.float32)
+    y = np.random.randint(0, 3, (8,)).astype(np.float32)
+    dev = _fit_one_step(CTXS, x, y, kvstore="device")
+    hvd = _fit_one_step(CTXS, x, y, kvstore="horovod")
+    for (_, a), (_, b) in zip(dev.items(), hvd.items()):
+        assert_almost_equal(b, a, rtol=1e-6, atol=1e-7)
+    with pytest.raises(ValueError):
+        gluon.Trainer(
+            nn.Dense(2, in_units=2).collect_params(), "sgd", {},
+            kvstore="horovod", update_on_kvstore=True)._init_kvstore()

@@ -1,0 +1,44 @@
+"""opperf harness tests (reference benchmark/opperf, v>=1.5).
+
+Small shapes on the CPU mesh: the harness must produce timing + bandwidth
+fields for every requested op, forward and backward, with no errors.
+"""
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from benchmark.opperf import run_performance_test, _default_suite
+
+
+def test_opperf_forward_subset():
+    res = run_performance_test(
+        ["elemwise_add", "dot", "softmax", "sgd_mom_update"],
+        runs=2, warmup=1, large=False)
+    assert len(res) == 4
+    for r in res:
+        assert "error" not in r, r
+        assert r["avg_us"] > 0 and r["gb_per_sec"] >= 0
+        assert r["mode"] == "fwd"
+
+
+def test_opperf_backward_subset():
+    res = run_performance_test(
+        ["FullyConnected", "LayerNorm"],
+        runs=2, warmup=1, run_backward=True, large=False)
+    for r in res:
+        assert "error" not in r, r
+        assert r["mode"] == "fwd+bwd"
+
+
+def test_opperf_full_default_suite_has_no_errors():
+    suite = _default_suite(False)
+    res = run_performance_test(sorted(suite), runs=1, warmup=1, large=False)
+    errs = [r for r in res if "error" in r]
+    assert not errs, errs
+
+
+def test_opperf_unknown_op_raises():
+    import pytest
+    with pytest.raises(KeyError):
+        run_performance_test(["no_such_op"], runs=1, warmup=0, large=False)

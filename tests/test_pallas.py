@@ -8,11 +8,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from mxnet_tpu.test_utils import device_tols
+from mxnet_tpu.test_utils import device_tols, _on_tpu
 RTOL, ATOL = device_tols("float32")
-# keep the original CPU/interpret atol floor: near-zero grad rows
-# (layernorm, masked attention) need absolute headroom
-ATOL = max(ATOL, 1e-4)
+# near-zero grad rows (layernorm, masked attention) need absolute
+# headroom on-chip; the CPU/interpret golden path keeps the tight floor
+# so interpreted-kernel numeric regressions stay visible
+ATOL = max(ATOL, 1e-4 if _on_tpu() else 1e-5)
 import pytest
 
 from mxnet_tpu.ops.pallas.flash_attention import (flash_attention,

@@ -48,14 +48,16 @@ def main():
         for rank in range(args.num_workers):
             host = hosts[rank % len(hosts)]
             env = " ".join(
-                f"{k}={v}" for k, v in _env(coord, args.num_workers, rank).items())
+                f"{k}={v}" for k, v in _env(coord, args.num_workers, rank,
+                                            rank // len(hosts)).items())
             cmd = ["ssh", "-o", "StrictHostKeyChecking=no", host,
                    f"cd {os.getcwd()} && {env} {' '.join(args.command)}"]
             procs.append(subprocess.Popen(cmd))
     else:
         for rank in range(args.num_workers):
             env = dict(os.environ)
-            env.update(_env(coord, args.num_workers, rank))
+            # local launcher: every worker shares this host
+            env.update(_env(coord, args.num_workers, rank, rank))
             procs.append(subprocess.Popen(args.command, env=env))
 
     def _term(*_):
@@ -72,11 +74,12 @@ def main():
     sys.exit(rc)
 
 
-def _env(coord, n, rank):
+def _env(coord, n, rank, local_rank=0):
     return {
         "MXNET_TPU_COORDINATOR": coord,
         "MXNET_TPU_NUM_PROCS": str(n),
         "MXNET_TPU_PROC_ID": str(rank),
+        "MXNET_TPU_LOCAL_RANK": str(local_rank),
         # reference-compatible names so old scripts keep working
         "DMLC_PS_ROOT_URI": coord.split(":")[0],
         "DMLC_PS_ROOT_PORT": coord.split(":")[1],
