@@ -108,55 +108,69 @@ def _nbytes(arrs) -> int:
 def run_performance_test(op_names, ctx=None, warmup=3, runs=25,
                          run_backward=False, large=True, suite=None):
     """Benchmark named ops; returns a list of result dicts (reference
-    benchmark_utils.run_performance_test)."""
+    benchmark_utils.run_performance_test). ``ctx`` scopes tensor
+    creation and execution (default: the current/default context)."""
+    import contextlib
     suite = suite or _default_suite(large)
     results = []
-    for name in op_names:
-        if name not in suite:
-            raise KeyError(f"no default config for op {name!r}; "
-                           f"known: {sorted(suite)}")
-        args, kwargs = suite[name]()
-        fn = getattr(mx.nd, name)
+    scope = ctx if ctx is not None else contextlib.nullcontext()
+    with scope:
+        for name in op_names:
+            if name not in suite:
+                raise KeyError(f"no default config for op {name!r}; "
+                               f"known: {sorted(suite)}")
+            args, kwargs = suite[name]()
+            fn = getattr(mx.nd, name)
+            fargs = [a for a in args
+                     if isinstance(a, mx.nd.NDArray)
+                     and "float" in str(a.dtype)]
 
-        def call():
-            out = fn(*args, **kwargs)
-            (out[0] if isinstance(out, (list, tuple)) else out).wait_to_read()
-            return out
-
-        def call_bwd():
-            grads = []
-            for a in args:
-                if isinstance(a, mx.nd.NDArray) and "float" in str(a.dtype):
-                    a.attach_grad()
-            with autograd.record():
+            def call():
                 out = fn(*args, **kwargs)
-                head = out[0] if isinstance(out, (list, tuple)) else out
-                s = head.sum()
-            s.backward()
-            s.wait_to_read()
+                (out[0] if isinstance(out, (list, tuple)) else out).wait_to_read()
+                return out
 
-        target = call_bwd if run_backward else call
-        try:
-            for _ in range(warmup):
+            def call_bwd():
+                for a in fargs:
+                    a.attach_grad()
+                with autograd.record():
+                    out = fn(*args, **kwargs)
+                    head = out[0] if isinstance(out, (list, tuple)) else out
+                    s = head.sum()
+                s.backward()
+                # synchronize on the GRADIENTS, not the (already
+                # materialized) loss — backward dispatch is async
+                for a in fargs:
+                    if a.grad is not None:
+                        a.grad.wait_to_read()
+                return out
+
+            target = call_bwd if run_backward else call
+            try:
+                out = None
+                for _ in range(warmup):
+                    out = target()
+            except Exception as e:  # pragma: no cover - config drift guard
+                results.append({"op": name, "error": str(e)})
+                continue
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            times = []
+            for _ in range(runs):
+                t0 = time.perf_counter()
                 target()
-        except Exception as e:  # pragma: no cover - config drift guard
-            results.append({"op": name, "error": str(e)})
-            continue
-        times = []
-        for _ in range(runs):
-            t0 = time.perf_counter()
-            target()
-            times.append(time.perf_counter() - t0)
-        avg = float(np.mean(times))
-        res = {
-            "op": name,
-            "mode": "fwd+bwd" if run_backward else "fwd",
-            "avg_us": round(avg * 1e6, 2),
-            "p50_us": round(float(np.percentile(times, 50)) * 1e6, 2),
-            "min_us": round(float(np.min(times)) * 1e6, 2),
-            "gb_per_sec": round(_nbytes(args) / avg / 1e9, 3),
-        }
-        results.append(res)
+                times.append(time.perf_counter() - t0)
+            avg = float(np.mean(times))
+            res = {
+                "op": name,
+                "mode": "fwd+bwd" if run_backward else "fwd",
+                "avg_us": round(avg * 1e6, 2),
+                "p50_us": round(float(np.percentile(times, 50)) * 1e6, 2),
+                "min_us": round(float(np.min(times)) * 1e6, 2),
+                # HBM traffic estimate: inputs read + outputs written
+                "gb_per_sec": round(
+                    (_nbytes(args) + _nbytes(outs)) / avg / 1e9, 3),
+            }
+            results.append(res)
     return results
 
 

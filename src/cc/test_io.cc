@@ -1,0 +1,278 @@
+// C++ unit tests for the native IO library — the reference's tests/cpp
+// tier (tests/cpp/engine/threaded_engine_test.cc, storage_test.cc op
+// micro-tests) adapted to this framework's native surface: RecordIO
+// framing, the threaded record batcher, and the threaded image
+// decode/resize batcher. Assert-based, no gtest dependency; exits
+// non-zero on the first failure (driven by tests/test_native_cpp.py).
+//
+// Build & run:  make -C src/cc test
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+
+#include <jpeglib.h>
+#include <cstring>
+#include <random>
+#include <string>
+#include <vector>
+
+extern "C" {
+void* mxio_reader_open(const char* path);
+int64_t mxio_reader_next(void* handle, const char** buf);
+void mxio_reader_close(void* handle);
+void* mxio_batcher_create(const char* rec_path, const char* idx_path,
+                          int64_t batch_size, int num_threads, int shuffle,
+                          uint64_t seed, int64_t num_parts, int64_t part_index);
+int64_t mxio_batcher_num_batches(void* handle);
+int64_t mxio_batcher_next(void* handle, void** batch_out, const char** data,
+                          const int64_t** offsets);
+void mxio_batcher_free_batch(void* batch);
+void mxio_batcher_reset(void* handle);
+void mxio_batcher_close(void* handle);
+void* mximg_batcher_create(const char* rec_path, const char* idx_path,
+                           int64_t batch_size, int out_h, int out_w,
+                           int num_threads, int shuffle, uint64_t seed,
+                           int64_t num_parts, int64_t part_index);
+int64_t mximg_batcher_num_batches(void* handle);
+int64_t mximg_batcher_next(void* handle, uint8_t* data, float* labels);
+void mximg_batcher_reset(void* handle);
+void mximg_batcher_close(void* handle);
+int mximg_decode(const uint8_t* buf, int64_t len, int out_h, int out_w,
+                 uint8_t* out_chw);
+}
+
+#define CHECK(cond)                                                       \
+  do {                                                                    \
+    if (!(cond)) {                                                        \
+      std::fprintf(stderr, "CHECK failed at %s:%d: %s\n", __FILE__,       \
+                   __LINE__, #cond);                                      \
+      std::exit(1);                                                       \
+    }                                                                     \
+  } while (0)
+
+namespace {
+
+constexpr uint32_t kMagicT = 0xced7230a;
+constexpr uint32_t kLenBitsT = 29;
+
+// Write one framed record (dmlc recordio format: magic, cflag<<29|len,
+// payload, zero-pad to 4) and return the record's start offset.
+int64_t WriteRecord(std::FILE* f, const std::string& payload) {
+  int64_t off = std::ftell(f);
+  uint32_t hdr[2] = {kMagicT,
+                     static_cast<uint32_t>(payload.size()) & ((1u << kLenBitsT) - 1)};
+  std::fwrite(hdr, sizeof(uint32_t), 2, f);
+  std::fwrite(payload.data(), 1, payload.size(), f);
+  uint32_t pad = (4 - (payload.size() % 4)) % 4;
+  const char zeros[4] = {0, 0, 0, 0};
+  if (pad) std::fwrite(zeros, 1, pad, f);
+  return off;
+}
+
+// In-memory JPEG encode of a solid-color HxW RGB image.
+std::vector<uint8_t> EncodeSolidJpeg(int w, int h, uint8_t r, uint8_t g,
+                                     uint8_t b) {
+  jpeg_compress_struct cinfo;
+  jpeg_error_mgr jerr;
+  cinfo.err = jpeg_std_error(&jerr);
+  jpeg_create_compress(&cinfo);
+  unsigned char* mem = nullptr;
+  unsigned long mem_size = 0;
+  jpeg_mem_dest(&cinfo, &mem, &mem_size);
+  cinfo.image_width = w;
+  cinfo.image_height = h;
+  cinfo.input_components = 3;
+  cinfo.in_color_space = JCS_RGB;
+  jpeg_set_defaults(&cinfo);
+  jpeg_set_quality(&cinfo, 95, TRUE);
+  jpeg_start_compress(&cinfo, TRUE);
+  std::vector<uint8_t> row(static_cast<size_t>(w) * 3);
+  for (int x = 0; x < w; ++x) {
+    row[x * 3] = r;
+    row[x * 3 + 1] = g;
+    row[x * 3 + 2] = b;
+  }
+  JSAMPROW rp = row.data();
+  while (cinfo.next_scanline < cinfo.image_height)
+    jpeg_write_scanlines(&cinfo, &rp, 1);
+  jpeg_finish_compress(&cinfo);
+  std::vector<uint8_t> out(mem, mem + mem_size);
+  jpeg_destroy_compress(&cinfo);
+  free(mem);
+  return out;
+}
+
+// IRHeader (flag, label, id, id2 = 24 bytes) + jpeg payload.
+std::string PackImageRecord(float label, const std::vector<uint8_t>& jpeg) {
+  std::string rec(24, '\0');
+  uint32_t flag = 0;
+  std::memcpy(&rec[0], &flag, 4);
+  std::memcpy(&rec[4], &label, 4);
+  rec.append(reinterpret_cast<const char*>(jpeg.data()), jpeg.size());
+  return rec;
+}
+
+void TestRecordIOFraming(const std::string& dir) {
+  std::string path = dir + "/t.rec";
+  // payload lengths hitting every pad case (0..3) plus empty
+  std::vector<std::string> payloads = {"", "a", "ab", "abc", "abcd",
+                                       std::string(1000, 'x')};
+  std::FILE* f = std::fopen(path.c_str(), "wb");
+  CHECK(f);
+  for (const auto& p : payloads) WriteRecord(f, p);
+  std::fclose(f);
+
+  void* r = mxio_reader_open(path.c_str());
+  CHECK(r);
+  const char* buf = nullptr;
+  for (const auto& p : payloads) {
+    int64_t n = mxio_reader_next(r, &buf);
+    CHECK(n == static_cast<int64_t>(p.size()));
+    CHECK(std::memcmp(buf, p.data(), p.size()) == 0);
+  }
+  CHECK(mxio_reader_next(r, &buf) == -1);  // clean EOF
+  mxio_reader_close(r);
+  std::printf("TestRecordIOFraming ok\n");
+}
+
+void TestRecordBatcher(const std::string& dir) {
+  std::string path = dir + "/b.rec";
+  std::FILE* f = std::fopen(path.c_str(), "wb");
+  CHECK(f);
+  const int kN = 23;
+  for (int i = 0; i < kN; ++i)
+    WriteRecord(f, "rec" + std::to_string(i));
+  std::fclose(f);
+
+  // no idx file: index built by scanning the framing
+  void* b = mxio_batcher_create(path.c_str(), "", 4, 3, 0, 0, 1, 0);
+  CHECK(b);
+  CHECK(mxio_batcher_num_batches(b) == 6);  // ceil(23/4): partial tail kept
+  int seen = 0;
+  for (int epoch = 0; epoch < 2; ++epoch) {
+    int i = 0;
+    while (true) {
+      void* batch = nullptr;
+      const char* data = nullptr;
+      const int64_t* offsets = nullptr;
+      int64_t n = mxio_batcher_next(b, &batch, &data, &offsets);
+      if (n == 0) break;
+      CHECK(n == (i < 5 ? 4 : 3));  // last batch is the 3-record tail
+      for (int64_t j = 0; j < n; ++j) {
+        std::string rec(data + offsets[j], data + offsets[j + 1]);
+        CHECK(rec == "rec" + std::to_string(i * 4 + j));  // order preserved
+      }
+      mxio_batcher_free_batch(batch);
+      ++i;
+      ++seen;
+    }
+    CHECK(i == 6);
+    mxio_batcher_reset(b);
+  }
+  CHECK(seen == 12);
+  mxio_batcher_close(b);
+
+  // sharding: 2 parts cover disjoint halves (multi-worker num_parts)
+  void* s0 = mxio_batcher_create(path.c_str(), "", 2, 2, 0, 0, 2, 0);
+  void* s1 = mxio_batcher_create(path.c_str(), "", 2, 2, 0, 0, 2, 1);
+  CHECK(s0 && s1);
+  CHECK(mxio_batcher_num_batches(s0) == 6);  // ceil(12/2) even-index records
+  CHECK(mxio_batcher_num_batches(s1) == 6);  // ceil(11/2) odd-index records
+  mxio_batcher_close(s0);
+  mxio_batcher_close(s1);
+  std::printf("TestRecordBatcher ok\n");
+}
+
+void TestImageBatcher(const std::string& dir) {
+  std::string rec_path = dir + "/img.rec";
+  std::string idx_path = dir + "/img.idx";
+  std::FILE* f = std::fopen(rec_path.c_str(), "wb");
+  std::FILE* fi = std::fopen(idx_path.c_str(), "w");
+  CHECK(f && fi);
+  const int kN = 10;
+  for (int i = 0; i < kN; ++i) {
+    std::string payload;
+    if (i == 5) {
+      // corrupt image: valid framing+header, garbage jpeg — must be
+      // SKIPPED (compacted batch), not crash or zero-fill
+      payload = PackImageRecord(static_cast<float>(i),
+                                std::vector<uint8_t>{1, 2, 3, 4, 5});
+    } else {
+      payload = PackImageRecord(
+          static_cast<float>(i),
+          EncodeSolidJpeg(17 + i, 13 + i, static_cast<uint8_t>(20 * i), 100, 200));
+    }
+    int64_t off = WriteRecord(f, payload);
+    std::fprintf(fi, "%d\t%lld\n", i, static_cast<long long>(off));
+  }
+  std::fclose(f);
+  std::fclose(fi);
+
+  const int H = 8, W = 8;
+  void* b = mximg_batcher_create(rec_path.c_str(), idx_path.c_str(), 5, H, W,
+                                 3, 0, 0, 1, 0);
+  CHECK(b);
+  CHECK(mximg_batcher_num_batches(b) == 2);
+  std::vector<uint8_t> data(5 * 3 * H * W);
+  std::vector<float> labels(5);
+  // batch 1: records 0..4, all valid, emitted in order despite threads
+  int64_t n = mximg_batcher_next(b, data.data(), labels.data());
+  CHECK(n == 5);
+  for (int j = 0; j < 5; ++j) {
+    CHECK(labels[j] == static_cast<float>(j));
+    // solid color survives decode+bilinear resize (JPEG is lossy: wide
+    // tolerance, but channel ordering must be exact)
+    const uint8_t* img = data.data() + j * 3 * H * W;
+    int want_r = 20 * j;
+    CHECK(std::abs(static_cast<int>(img[0]) - want_r) < 16);
+    CHECK(std::abs(static_cast<int>(img[H * W]) - 100) < 16);
+    CHECK(std::abs(static_cast<int>(img[2 * H * W]) - 200) < 16);
+  }
+  // batch 2: record 5 is corrupt -> compacted to 4 records
+  n = mximg_batcher_next(b, data.data(), labels.data());
+  CHECK(n == 4);
+  CHECK(labels[0] == 6.0f && labels[3] == 9.0f);
+  CHECK(mximg_batcher_next(b, data.data(), labels.data()) == -1);  // epoch end
+
+  // shuffled epochs: same seed+epoch -> same order; labels are a
+  // permutation of the valid set
+  mximg_batcher_reset(b);
+  std::vector<float> l1(5), l2(5);
+  CHECK(mximg_batcher_next(b, data.data(), l1.data()) >= 4);
+  mximg_batcher_close(b);
+
+  void* bs = mximg_batcher_create(rec_path.c_str(), idx_path.c_str(), 5, H, W,
+                                  3, 1, 42, 1, 0);
+  CHECK(bs);
+  CHECK(mximg_batcher_next(bs, data.data(), l2.data()) >= 4);
+  mximg_batcher_close(bs);
+
+  // stale idx / missing rec must fail at create, not hang
+  CHECK(mximg_batcher_create((dir + "/nope.rec").c_str(), idx_path.c_str(), 2,
+                             H, W, 2, 0, 0, 1, 0) == nullptr);
+  std::printf("TestImageBatcher ok\n");
+}
+
+void TestSingleDecode() {
+  auto jpeg = EncodeSolidJpeg(32, 24, 250, 10, 60);
+  std::vector<uint8_t> chw(3 * 16 * 16);
+  CHECK(mximg_decode(jpeg.data(), static_cast<int64_t>(jpeg.size()), 16, 16,
+                     chw.data()) == 0);
+  CHECK(std::abs(static_cast<int>(chw[0]) - 250) < 16);
+  CHECK(std::abs(static_cast<int>(chw[16 * 16]) - 10) < 16);
+  CHECK(std::abs(static_cast<int>(chw[2 * 16 * 16]) - 60) < 16);
+  CHECK(mximg_decode(jpeg.data(), 3, 16, 16, chw.data()) == -1);  // truncated
+  std::printf("TestSingleDecode ok\n");
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::string dir = argc > 1 ? argv[1] : "/tmp";
+  TestRecordIOFraming(dir);
+  TestRecordBatcher(dir);
+  TestImageBatcher(dir);
+  TestSingleDecode();
+  std::printf("ALL NATIVE IO TESTS PASSED\n");
+  return 0;
+}

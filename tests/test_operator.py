@@ -683,13 +683,18 @@ def test_reduce_numeric_grad(case):
 
 
 def test_nn_numeric_grads():
+    # explicit tolerances are authoritative on every backend, so widen
+    # them here for the real chip (bf16-MXU finite differences)
+    from mxnet_tpu.test_utils import _on_tpu
+    wide = dict(rtol=5e-2, atol=5e-3) if _on_tpu() else \
+        dict(rtol=2e-2, atol=2e-3)
     check_numeric_gradient(
         lambda x, w, b: nd.FullyConnected(x, w, b, num_hidden=3),
         [_any((3, 4)), _any((3, 4)), _any((3,))])
     check_numeric_gradient(
         lambda x, w: nd.Convolution(x, w, kernel=(3, 3), num_filter=2,
                                     pad=(1, 1), no_bias=True),
-        [_any((1, 2, 4, 4)), _any((2, 2, 3, 3))], rtol=2e-2, atol=2e-3)
+        [_any((1, 2, 4, 4)), _any((2, 2, 3, 3))], **wide)
     check_numeric_gradient(lambda x: nd.Pooling(x, kernel=(2, 2), stride=(2, 2),
                                                 pool_type="avg"),
                            [_any((1, 1, 4, 4))])
@@ -697,7 +702,7 @@ def test_nn_numeric_grads():
     check_numeric_gradient(lambda x: nd.log_softmax(x), [_any((3, 5))])
     check_numeric_gradient(
         lambda x, g, b: nd.LayerNorm(x, g, b),
-        [_any((2, 6)), _pos((6,)), _any((6,))], rtol=2e-2, atol=2e-3)
+        [_any((2, 6)), _pos((6,)), _any((6,))], **wide)
     check_numeric_gradient(lambda a, b: nd.dot(a, b), [_any((3, 4)), _any((4, 2))])
     check_numeric_gradient(lambda a, b: nd.batch_dot(a, b),
                            [_any((2, 3, 4)), _any((2, 4, 2))])
