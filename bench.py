@@ -138,7 +138,8 @@ def main():
     from mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
 
     ctx = mx.current_context()
-    net = resnet50_v1(classes=1000)
+    s2d = os.environ.get("BENCH_S2D", "0") == "1"
+    net = resnet50_v1(classes=1000, stem="s2d" if s2d else "conv")
     net.initialize(init=mx.initializer.Xavier(), ctx=ctx)
     if DTYPE != "float32":
         net.cast(DTYPE)
@@ -198,7 +199,8 @@ def main():
             "images/sec/chip", imgs_per_sec / BASELINE_IMGS_PER_SEC,
             flops_per_step=flops, sec_per_step=dt / STEPS,
             batch=BATCH, dtype=DTYPE,
-            conv_nhwc=os.environ.get("MXNET_TPU_CONV_NHWC", "0") == "1")
+            conv_nhwc=os.environ.get("MXNET_TPU_CONV_NHWC", "0") == "1",
+            s2d_stem=s2d)
 
 
 def _resnet_from_recordio(loss_fn, params, moms, rng, flops):

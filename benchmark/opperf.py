@@ -113,6 +113,9 @@ def run_performance_test(op_names, ctx=None, warmup=3, runs=25,
     import contextlib
     suite = suite or _default_suite(large)
     results = []
+    # at least one untimed run is mandatory: it triggers XLA compile and
+    # materializes the outputs whose bytes feed gb_per_sec
+    warmup = max(1, warmup)
     scope = ctx if ctx is not None else contextlib.nullcontext()
     with scope:
         for name in op_names:

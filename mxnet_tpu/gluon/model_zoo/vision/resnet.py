@@ -13,7 +13,7 @@ from ...block import HybridBlock
 from ... import nn
 
 __all__ = ["ResNetV1", "ResNetV2", "BasicBlockV1", "BasicBlockV2",
-           "BottleneckV1", "BottleneckV2", "get_resnet",
+           "BottleneckV1", "BottleneckV2", "SpaceToDepthStem", "get_resnet",
            "resnet18_v1", "resnet34_v1", "resnet50_v1", "resnet101_v1",
            "resnet152_v1", "resnet18_v2", "resnet34_v2", "resnet50_v2",
            "resnet101_v2", "resnet152_v2"]
@@ -22,6 +22,53 @@ __all__ = ["ResNetV1", "ResNetV2", "BasicBlockV1", "BasicBlockV2",
 def _conv3x3(channels, stride, in_channels):
     return nn.Conv2D(channels, kernel_size=3, strides=stride, padding=1,
                      use_bias=False, in_channels=in_channels)
+
+
+class SpaceToDepthStem(HybridBlock):
+    """TPU-first stem: numerically EXACT reformulation of the ImageNet
+    ``Conv2D(channels, 7, strides=2, padding=3)`` stem as a 2x2
+    space-to-depth followed by a 4x4 stride-1 conv over ``4*C`` input
+    channels (the MLPerf ResNet TPU trick). The plain stem wastes MXU
+    lanes (3 input channels, stride-2 access pattern); after
+    space-to-depth the conv is dense and stride-1.
+
+    The learnable parameter keeps the reference shape
+    ``(channels, in_channels, 7, 7)`` so checkpoints interchange with
+    the plain Conv2D stem; the 4x4x(4C) kernel is derived in-graph:
+    pad the 7x7 taps to 8x8 at the front (tap k maps to offset pair
+    ``((k+1)//2, (k+1)%2)``), then a reshape/transpose groups taps by
+    parity to match ``space_to_depth``'s ``(dy*2+dx)*C + c`` channel
+    packing. The asymmetric spatial pad (2 low, 1 high) reproduces the
+    original pad-3 window. Beyond-reference extension (upstream has no
+    such stem); exactness pinned by tests/test_gluon.py."""
+
+    def __init__(self, channels, in_channels=3, weight_initializer=None,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._channels = channels
+        self._in_channels = in_channels
+        with self.name_scope():
+            self.weight = self.params.get(
+                "weight", shape=(channels, in_channels, 7, 7),
+                init=weight_initializer, allow_deferred_init=True)
+
+    def hybrid_forward(self, F, x, weight):
+        o, c = self._channels, self._in_channels
+        wp = F.pad(weight, mode="constant",
+                   pad_width=(0, 0, 0, 0, 1, 0, 1, 0))
+        w2 = wp.reshape((o, c, 4, 2, 4, 2)) \
+               .transpose((0, 3, 5, 1, 2, 4)) \
+               .reshape((o, 4 * c, 4, 4))
+        y = F.space_to_depth(x, block_size=2)
+        y = F.pad(y, mode="constant", pad_width=(0, 0, 0, 0, 2, 1, 2, 1))
+        return F.Convolution(y, w2, None, kernel=(4, 4), stride=(1, 1),
+                             pad=(0, 0), num_filter=o, no_bias=True)
+
+
+def _stem_conv(channels, stem):
+    if stem == "s2d":
+        return SpaceToDepthStem(channels)
+    return nn.Conv2D(channels, 7, 2, 3, use_bias=False)
 
 
 class BasicBlockV1(HybridBlock):
@@ -139,7 +186,7 @@ class BottleneckV2(HybridBlock):
 
 class ResNetV1(HybridBlock):
     def __init__(self, block, layers, channels, classes=1000, thumbnail=False,
-                 **kwargs):
+                 stem="conv", **kwargs):
         super().__init__(**kwargs)
         assert len(layers) == len(channels) - 1
         with self.name_scope():
@@ -147,7 +194,7 @@ class ResNetV1(HybridBlock):
             if thumbnail:
                 self.features.add(_conv3x3(channels[0], 1, 0))
             else:
-                self.features.add(nn.Conv2D(channels[0], 7, 2, 3, use_bias=False))
+                self.features.add(_stem_conv(channels[0], stem))
                 self.features.add(nn.BatchNorm())
                 self.features.add(nn.Activation("relu"))
                 self.features.add(nn.MaxPool2D(3, 2, 1))
@@ -179,7 +226,7 @@ class ResNetV1(HybridBlock):
 
 class ResNetV2(HybridBlock):
     def __init__(self, block, layers, channels, classes=1000, thumbnail=False,
-                 **kwargs):
+                 stem="conv", **kwargs):
         super().__init__(**kwargs)
         assert len(layers) == len(channels) - 1
         with self.name_scope():
@@ -188,7 +235,7 @@ class ResNetV2(HybridBlock):
             if thumbnail:
                 self.features.add(_conv3x3(channels[0], 1, 0))
             else:
-                self.features.add(nn.Conv2D(channels[0], 7, 2, 3, use_bias=False))
+                self.features.add(_stem_conv(channels[0], stem))
                 self.features.add(nn.BatchNorm())
                 self.features.add(nn.Activation("relu"))
                 self.features.add(nn.MaxPool2D(3, 2, 1))

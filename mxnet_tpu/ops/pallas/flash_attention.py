@@ -36,9 +36,20 @@ from ._util import resolve_interpret, x32
 _NEG_INF = -1e30
 
 
+def _dot_precision(dtype):
+    """Explicit per-dot precision: Mosaic rejects the process-wide
+    'high' matmul precision that __init__.py sets for f32 numerics
+    parity. Kernel blocks are f32-cast copies of the caller's data, so
+    for bf16 models a DEFAULT (single-pass bf16) dot is lossless; true
+    f32 inputs get HIGHEST (exact f32 via MXU passes)."""
+    return (lax.Precision.HIGHEST if jnp.dtype(dtype) == jnp.float32
+            else lax.Precision.DEFAULT)
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                 acc_sc, m_sc, l_sc, *,
-                sm_scale, causal, q_offset, kv_len, block_q, block_k):
+                sm_scale, causal, q_offset, kv_len, block_q, block_k,
+                precision):
     i, j = pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
 
@@ -59,7 +70,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         k = k_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * sm_scale
+            preferred_element_type=jnp.float32, precision=precision) * sm_scale
 
         col = j * block_k + lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
@@ -83,7 +94,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         v = v_ref[0].astype(jnp.float32)
         acc_sc[:] = acc_sc[:] * alpha + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            preferred_element_type=jnp.float32, precision=precision)
         m_sc[:] = m_cur
 
     @pl.when(j == nk - 1)
@@ -97,7 +108,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                    dq_ref, dq_sc, *,
-                   sm_scale, causal, q_offset, kv_len, block_q, block_k):
+                   sm_scale, causal, q_offset, kv_len, block_q, block_k,
+                   precision):
     i, j = pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
 
@@ -119,7 +131,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * sm_scale
+            preferred_element_type=jnp.float32, precision=precision) * sm_scale
         col = j * block_k + lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
         mask = col < kv_len
@@ -130,11 +142,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            preferred_element_type=jnp.float32, precision=precision)
         ds = p * (dp - delta) * sm_scale
         dq_sc[:] = dq_sc[:] + jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            preferred_element_type=jnp.float32, precision=precision)
 
     @pl.when(j == nk - 1)
     def _():
@@ -143,7 +155,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_sc, dv_sc, *,
-                    sm_scale, causal, q_offset, kv_len, block_q, block_k):
+                    sm_scale, causal, q_offset, kv_len, block_q, block_k,
+                    precision):
     # grid: (BH, nk, nq) — q is the inner (sequential) axis
     j, i = pl.program_id(1), pl.program_id(2)
     nq = pl.num_programs(2)
@@ -167,7 +180,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * sm_scale
+            preferred_element_type=jnp.float32, precision=precision) * sm_scale
         col = j * block_k + lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
         mask = col < kv_len
@@ -179,14 +192,14 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
         dv_sc[:] = dv_sc[:] + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            preferred_element_type=jnp.float32, precision=precision)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            preferred_element_type=jnp.float32, precision=precision)
         ds = p * (dp - delta) * sm_scale
         dk_sc[:] = dk_sc[:] + jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            preferred_element_type=jnp.float32, precision=precision)
 
     @pl.when(i == nq - 1)
     def _():
@@ -227,7 +240,8 @@ def _flash_fwd(q, k, v, sm_scale, causal, q_offset, interpret,
     nq, nk = sq_p // block_q, skv_p // block_k
     kern = functools.partial(
         _fwd_kernel, sm_scale=sm_scale, causal=causal,
-        q_offset=q_offset, kv_len=skv, block_q=block_q, block_k=block_k)
+        q_offset=q_offset, kv_len=skv, block_q=block_q, block_k=block_k,
+        precision=_dot_precision(q.dtype))
     o, lse = pl.pallas_call(
         kern,
         grid=(bh, nq, nk),
@@ -293,7 +307,8 @@ def _flash_bwd(q, k, v, o, lse, do, sm_scale, causal, q_offset, interpret,
 
     nq, nk = sq_p // block_q, skv_p // block_k
     common = dict(sm_scale=sm_scale, causal=causal, q_offset=q_offset,
-                  kv_len=skv, block_q=block_q, block_k=block_k)
+                  kv_len=skv, block_q=block_q, block_k=block_k,
+                  precision=_dot_precision(q.dtype))
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, **common),

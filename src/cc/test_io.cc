@@ -13,6 +13,7 @@
 #include <jpeglib.h>
 #include <cstring>
 #include <random>
+#include <set>
 #include <string>
 #include <vector>
 
@@ -172,12 +173,33 @@ void TestRecordBatcher(const std::string& dir) {
   CHECK(seen == 12);
   mxio_batcher_close(b);
 
-  // sharding: 2 parts cover disjoint halves (multi-worker num_parts)
+  // sharding: 2 parts must be DISJOINT and their union the full set
+  // (multi-worker num_parts/part_index — duplicated data across
+  // workers is the bug this exists to catch)
   void* s0 = mxio_batcher_create(path.c_str(), "", 2, 2, 0, 0, 2, 0);
   void* s1 = mxio_batcher_create(path.c_str(), "", 2, 2, 0, 0, 2, 1);
   CHECK(s0 && s1);
   CHECK(mxio_batcher_num_batches(s0) == 6);  // ceil(12/2) even-index records
   CHECK(mxio_batcher_num_batches(s1) == 6);  // ceil(11/2) odd-index records
+  std::set<std::string> shard0, shard1;
+  for (void* s : {s0, s1}) {
+    auto& dst = (s == s0) ? shard0 : shard1;
+    while (true) {
+      void* batch = nullptr;
+      const char* data = nullptr;
+      const int64_t* offsets = nullptr;
+      int64_t n = mxio_batcher_next(s, &batch, &data, &offsets);
+      if (n == 0) break;
+      for (int64_t j = 0; j < n; ++j)
+        dst.emplace(data + offsets[j], data + offsets[j + 1]);
+      mxio_batcher_free_batch(batch);
+    }
+  }
+  CHECK(shard0.size() == 12 && shard1.size() == 11);
+  for (const auto& r : shard1) CHECK(shard0.count(r) == 0);  // disjoint
+  std::set<std::string> all(shard0);
+  all.insert(shard1.begin(), shard1.end());
+  CHECK(static_cast<int>(all.size()) == kN);  // union covers everything
   mxio_batcher_close(s0);
   mxio_batcher_close(s1);
   std::printf("TestRecordBatcher ok\n");
@@ -234,18 +256,29 @@ void TestImageBatcher(const std::string& dir) {
   CHECK(labels[0] == 6.0f && labels[3] == 9.0f);
   CHECK(mximg_batcher_next(b, data.data(), labels.data()) == -1);  // epoch end
 
-  // shuffled epochs: same seed+epoch -> same order; labels are a
-  // permutation of the valid set
-  mximg_batcher_reset(b);
-  std::vector<float> l1(5), l2(5);
-  CHECK(mximg_batcher_next(b, data.data(), l1.data()) >= 4);
   mximg_batcher_close(b);
 
-  void* bs = mximg_batcher_create(rec_path.c_str(), idx_path.c_str(), 5, H, W,
-                                  3, 1, 42, 1, 0);
-  CHECK(bs);
-  CHECK(mximg_batcher_next(bs, data.data(), l2.data()) >= 4);
-  mximg_batcher_close(bs);
+  // shuffled epochs: same seed -> identical order across independent
+  // batchers (determinism), and the emitted labels are exactly the
+  // valid record set (a permutation — nothing duplicated or dropped)
+  auto collect_epoch = [&](uint64_t seed) {
+    void* bs = mximg_batcher_create(rec_path.c_str(), idx_path.c_str(), 5, H,
+                                    W, 3, 1, seed, 1, 0);
+    CHECK(bs);
+    std::vector<float> got;
+    std::vector<float> lab(5);
+    int64_t n;
+    while ((n = mximg_batcher_next(bs, data.data(), lab.data())) != -1)
+      got.insert(got.end(), lab.begin(), lab.begin() + n);
+    mximg_batcher_close(bs);
+    return got;
+  };
+  auto e1 = collect_epoch(42);
+  auto e2 = collect_epoch(42);
+  CHECK(e1 == e2);  // same seed, same epoch -> same order
+  CHECK(e1.size() == 9);  // 10 records minus the corrupt one
+  std::multiset<float> want = {0, 1, 2, 3, 4, 6, 7, 8, 9};
+  CHECK(std::multiset<float>(e1.begin(), e1.end()) == want);
 
   // stale idx / missing rec must fail at create, not hang
   CHECK(mximg_batcher_create((dir + "/nope.rec").c_str(), idx_path.c_str(), 2,

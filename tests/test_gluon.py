@@ -219,3 +219,60 @@ def test_split_and_load():
     parts = gluon.utils.split_and_load(data, [mx.current_context()])
     assert len(parts) == 1
     assert parts[0].shape == (4, 2)
+
+
+def test_space_to_depth_stem_exact():
+    """SpaceToDepthStem must be numerically EXACT vs the plain 7x7/s2/p3
+    stem conv it reformulates (same parameter tensor), forward and
+    gradient, eager and hybridized."""
+    from mxnet_tpu.gluon.model_zoo.vision.resnet import SpaceToDepthStem
+    np.random.seed(0)
+    mx.random.seed(0)
+    conv = nn.Conv2D(8, 7, 2, 3, use_bias=False, in_channels=3)
+    conv.initialize(init=mx.initializer.Xavier())
+    stem = SpaceToDepthStem(8)
+    stem.initialize()
+    stem.weight.set_data(conv.weight.data())
+    x_np = np.random.randn(2, 3, 32, 32).astype(np.float32)
+
+    for hyb in (False, True):
+        if hyb:
+            stem.hybridize()
+        x1 = nd.array(x_np)
+        x2 = nd.array(x_np)
+        x1.attach_grad()
+        x2.attach_grad()
+        with autograd.record():
+            a = conv(x1)
+            (a * a).sum().backward()
+        with autograd.record():
+            b = stem(x2)
+            (b * b).sum().backward()
+        assert a.shape == b.shape == (2, 8, 16, 16)
+        assert_almost_equal(b.asnumpy(), a.asnumpy(), rtol=1e-5, atol=1e-5)
+        assert_almost_equal(x2.grad.asnumpy(), x1.grad.asnumpy(),
+                            rtol=1e-4, atol=1e-5)
+        assert_almost_equal(stem.weight.grad().asnumpy(),
+                            conv.weight.grad().asnumpy(),
+                            rtol=1e-4, atol=1e-5)
+
+
+def test_resnet_s2d_stem_matches_plain():
+    """resnet18_v1(stem='s2d') == resnet18_v1() when stem weights are
+    shared (whole-model golden; checkpoint interchange both ways)."""
+    from mxnet_tpu.gluon.model_zoo import vision
+    np.random.seed(1)
+    mx.random.seed(1)
+    plain = vision.resnet18_v1()
+    plain.initialize(init=mx.initializer.Xavier())
+    x = nd.array(np.random.randn(1, 3, 64, 64).astype(np.float32))
+    plain(x)  # materialize deferred shapes
+    import tempfile, os as _os
+    with tempfile.TemporaryDirectory() as td:
+        f = _os.path.join(td, "w.params")
+        plain.save_parameters(f)
+        s2d = vision.resnet18_v1(stem="s2d")
+        s2d.load_parameters(f)
+        s2d.hybridize()
+        assert_almost_equal(s2d(x).asnumpy(), plain(x).asnumpy(),
+                            rtol=1e-4, atol=1e-5)

@@ -187,3 +187,22 @@ def test_op_dispatch_interpret(monkeypatch):
     ref = _attn_ref(q._data, k._data, v._data, causal=True)
     np.testing.assert_allclose(o.asnumpy(), np.asarray(ref),
                                rtol=RTOL, atol=ATOL)
+
+
+def test_flash_attention_under_high_matmul_precision():
+    """Regression: the process-wide jax_default_matmul_precision='high'
+    (set by mxnet_tpu/__init__.py for f32 parity) must not leak into the
+    kernel's dots — Mosaic rejects HIGH ('Unsupported dot precision').
+    Kernel dots carry explicit static precision chosen per input dtype."""
+    from mxnet_tpu.ops.pallas.flash_attention import _dot_precision
+    assert _dot_precision(jnp.float32) == jax.lax.Precision.HIGHEST
+    assert _dot_precision(jnp.bfloat16) == jax.lax.Precision.DEFAULT
+    k = jax.random.PRNGKey(3)
+    q = jax.random.normal(k, (1, 2, 64, 32), jnp.float32)
+    kk = jax.random.normal(jax.random.fold_in(k, 1), (1, 2, 64, 32))
+    v = jax.random.normal(jax.random.fold_in(k, 2), (1, 2, 64, 32))
+    with jax.default_matmul_precision("high"):
+        o = flash_attention(q, kk, v, causal=True, interpret=True)
+    ref = _attn_ref(q, kk, v, causal=True)
+    np.testing.assert_allclose(np.asarray(o), np.asarray(ref),
+                               rtol=RTOL, atol=ATOL)
