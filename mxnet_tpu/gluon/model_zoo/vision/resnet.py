@@ -52,8 +52,24 @@ class SpaceToDepthStem(HybridBlock):
                 "weight", shape=(channels, in_channels, 7, 7),
                 init=weight_initializer, allow_deferred_init=True)
 
+    def infer_shape(self, x, *args):
+        # deferred init parity with the plain Conv2D stem: in_channels
+        # comes from the data
+        self._in_channels = x.shape[1]
+        self.weight.shape = (self._channels, x.shape[1], 7, 7)
+
     def hybrid_forward(self, F, x, weight):
-        o, c = self._channels, self._in_channels
+        o = self._channels
+        wshp = getattr(weight, "shape", None)
+        c = (wshp[1] if wshp and isinstance(wshp[1], int) and wshp[1] > 0
+             else self._in_channels)
+        shp = getattr(x, "shape", None)
+        if shp and len(shp) == 4 and isinstance(shp[2], int) \
+                and (shp[2] % 2 or shp[3] % 2):
+            raise ValueError(
+                "SpaceToDepthStem requires even H and W (2x2 "
+                f"space-to-depth); got {shp} — use stem='conv' for odd "
+                "input sizes")
         wp = F.pad(weight, mode="constant",
                    pad_width=(0, 0, 0, 0, 1, 0, 1, 0))
         w2 = wp.reshape((o, c, 4, 2, 4, 2)) \
@@ -67,7 +83,9 @@ class SpaceToDepthStem(HybridBlock):
 
 def _stem_conv(channels, stem):
     if stem == "s2d":
-        return SpaceToDepthStem(channels)
+        # in_channels=0 -> deferred init infers from data (parity with
+        # the plain Conv2D stem on non-RGB inputs)
+        return SpaceToDepthStem(channels, in_channels=0)
     return nn.Conv2D(channels, 7, 2, 3, use_bias=False)
 
 

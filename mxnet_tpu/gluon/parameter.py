@@ -123,8 +123,12 @@ class Parameter:
             (init_create(self.init) if self.init is not None else
              init_create(default_init) if isinstance(default_init, str) else default_init)
         initializer(InitDesc(self.name), data)
-        self._data = OrderedDict((c, data if c == ctx[0] else data.copyto(c))
-                                 for c in ctx)
+        # pin every replica to its context device with an explicit
+        # device_put (copyto): initializer ops may have produced an
+        # uncommitted array that the runtime placed on the DEFAULT
+        # device (observed on TPU hosts: a cpu-ctx replica landing on
+        # the chip, which silently declines the fused all-reduce path)
+        self._data = OrderedDict((c, data.copyto(c)) for c in ctx)
         self._deferred_init = ()
         if self._grad_req != "null":
             self._init_grad()

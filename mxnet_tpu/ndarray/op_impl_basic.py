@@ -812,3 +812,35 @@ def cumsum(x, axis=None, dtype=None):
     out = jnp.cumsum(x if dtype is None else x.astype(dtype_np(dtype)),
                      axis=None if axis is None else int(axis))
     return out
+
+
+@register_op("digamma")
+def digamma(x):
+    """psi(x) (reference mshadow_op.h gamma family — backward of
+    gammaln, exposed as an op as in upstream unary math)."""
+    return jax.scipy.special.digamma(x)
+
+
+@register_op("unravel_index", aliases=["_unravel_index"])
+def unravel_index(x, shape=()):
+    """Flat index -> multi-index coordinates, stacked on a leading axis
+    (reference src/operator/tensor/ravel.cc UnravelIndex)."""
+    dims = tuple(int(s) for s in shape)
+    coords = jnp.unravel_index(x.astype(jnp.int64), dims)
+    # reference infers output dtype = input dtype (ravel.cc)
+    return jnp.stack(coords, axis=0).astype(x.dtype)
+
+
+@register_op("ravel_multi_index", aliases=["_ravel_multi_index"])
+def ravel_multi_index(x, shape=()):
+    """Multi-index (leading axis = coordinates) -> flat index
+    (reference src/operator/tensor/ravel.cc RavelMultiIndex). Plain
+    stride arithmetic, NO range clipping — out-of-range coordinates
+    produce out-of-range flat indices exactly as the reference does."""
+    dims = tuple(int(s) for s in shape)
+    stride = 1
+    flat = jnp.zeros(x.shape[1:], jnp.int64)
+    for i in range(len(dims) - 1, -1, -1):
+        flat = flat + x[i].astype(jnp.int64) * stride
+        stride *= dims[i]
+    return flat.astype(x.dtype)  # reference: output dtype = input dtype

@@ -587,3 +587,116 @@ def bilinear_sampler(data, grid, cudnn_off=False):
     out = (sample(y0, x0) * (wy0 * wx0)[:, None] + sample(y0, x1) * (wy0 * wx1)[:, None]
            + sample(y1, x0) * (wy1 * wx0)[:, None] + sample(y1, x1) * (wy1 * wx1)[:, None])
     return out
+
+
+@register_op("LRN", aliases=["lrn"])
+def lrn(data, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5):
+    """Local response normalization (reference src/operator/nn/lrn.cc —
+    AlexNet-era cross-channel normalization):
+    ``y = x / (knorm + alpha/nsize * sum_window x^2)^beta`` with the sum
+    over an ``nsize`` channel window. TPU-first: the window sum is a
+    conv-free cumulative-sum difference along C (one pass, XLA-fusable),
+    not the reference's explicit channel loop."""
+    n, c, h, w = data.shape
+    half = int(nsize) // 2
+    sq = (data * data).astype(jnp.float32)
+    # windowed channel sum via padded cumsum difference
+    cs = jnp.cumsum(jnp.pad(sq, ((0, 0), (half + 1, half), (0, 0), (0, 0))),
+                    axis=1)
+    win = (cs[:, nsize:] - cs[:, :-nsize])[:, :c]
+    norm = (knorm + (alpha / nsize) * win) ** beta
+    return (data.astype(jnp.float32) / norm).astype(data.dtype)
+
+
+@register_op("ROIPooling")
+def roi_pooling(data, rois, pooled_size=(1, 1), spatial_scale=1.0):
+    """ROI max pooling (reference src/operator/roi_pooling.cc).
+    data (N,C,H,W); rois (R,5) rows ``[batch_idx, x1, y1, x2, y2]`` in
+    image coordinates. TPU-first: per-bin membership masks reduce along
+    H then W as two masked maxes (static shapes, no per-roi dynamic
+    slicing — XLA sees one fused program for all rois)."""
+    ph, pw = (int(p) for p in pooled_size)
+    n, c, h, w = data.shape
+    r = rois.shape[0]
+    b = rois[:, 0].astype(jnp.int32)
+
+    def _round_c(v):
+        # std::round semantics (half away from zero) — jnp.round is
+        # banker's rounding and disagrees at *.5 coordinates
+        return (jnp.sign(v) * jnp.floor(jnp.abs(v) + 0.5)).astype(jnp.int32)
+
+    x1 = _round_c(rois[:, 1] * spatial_scale)
+    y1 = _round_c(rois[:, 2] * spatial_scale)
+    x2 = _round_c(rois[:, 3] * spatial_scale)
+    y2 = _round_c(rois[:, 4] * spatial_scale)
+    rh = jnp.maximum(y2 - y1 + 1, 1).astype(jnp.float32)
+    rw = jnp.maximum(x2 - x1 + 1, 1).astype(jnp.float32)
+
+    def bin_mask(start, extent, nbins, size):
+        # mask[r, i, s]: spatial index s inside bin i of roi r
+        i = jnp.arange(nbins)[None, :, None].astype(jnp.float32)
+        s = jnp.arange(size)[None, None, :]
+        lo = start[:, None, None] + jnp.floor(i * extent[:, None, None] / nbins)
+        hi = start[:, None, None] + jnp.ceil((i + 1) * extent[:, None, None] / nbins)
+        # reference clips bins to the feature map and forces >=1 cell
+        hi = jnp.maximum(hi, lo + 1)
+        return (s >= lo) & (s < hi) & (s >= 0) & (s < size)
+
+    mh = bin_mask(y1, rh, ph, h)          # (R, ph, H)
+    mw = bin_mask(x1, rw, pw, w)          # (R, pw, W)
+    xr = data.astype(jnp.float32)[b]      # (R, C, H, W)
+    neg = jnp.float32(-3.4e38)
+    t = jnp.where(mh[:, None, :, :, None], xr[:, :, None], neg)  # (R,C,ph,H,W)
+    t = t.max(axis=3)                     # (R, C, ph, W)
+    out = jnp.where(mw[:, None, None], t[:, :, :, None], neg).max(axis=4)
+    # empty rois (all cells clipped away) return 0, matching reference
+    out = jnp.where(out <= neg / 2, 0.0, out)
+    return out.astype(data.dtype)
+
+
+@register_op("GridGenerator")
+def grid_generator(data, transform_type="affine", target_shape=(0, 0)):
+    """Affine/warp sampling-grid generation (reference
+    src/operator/spatial_transformer.cc GridGenerator): produces the
+    normalized (x, y) grid BilinearSampler consumes."""
+    th, tw = (int(t) for t in target_shape)
+    if transform_type == "affine":
+        if th <= 0 or tw <= 0:
+            raise ValueError("GridGenerator(transform_type='affine') "
+                             "requires target_shape (reference: mandatory "
+                             "param)")
+        n = data.shape[0]
+        theta = data.reshape(n, 2, 3).astype(jnp.float32)
+        ys = jnp.linspace(-1.0, 1.0, th)
+        xs = jnp.linspace(-1.0, 1.0, tw)
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        src = jnp.stack([gx, gy, ones], 0).reshape(3, -1)   # (3, th*tw)
+        out = jnp.einsum("nij,jk->nik", theta, src)          # (n, 2, th*tw)
+        return out.reshape(n, 2, th, tw)
+    if transform_type == "warp":
+        # data is (n, 2, h, w) flow; add to the identity pixel grid and
+        # normalize to [-1, 1]
+        n, _, h, w = data.shape
+        gy, gx = jnp.meshgrid(jnp.arange(h), jnp.arange(w), indexing="ij")
+        fx = (data[:, 0] + gx).astype(jnp.float32)
+        fy = (data[:, 1] + gy).astype(jnp.float32)
+        nx = 2.0 * fx / jnp.maximum(w - 1, 1) - 1.0
+        ny = 2.0 * fy / jnp.maximum(h - 1, 1) - 1.0
+        return jnp.stack([nx, ny], 1)
+    raise ValueError(f"unknown transform_type {transform_type!r}")
+
+
+@register_op("SpatialTransformer")
+def spatial_transformer(data, loc, target_shape=(0, 0),
+                        transform_type="affine", sampler_type="bilinear",
+                        cudnn_off=False):
+    """Spatial transformer network op (reference
+    src/operator/spatial_transformer.cc): affine GridGenerator feeding
+    the bilinear sampler, end-to-end differentiable."""
+    if transform_type != "affine" or sampler_type != "bilinear":
+        raise ValueError("SpatialTransformer supports affine/bilinear only "
+                         "(matches the reference)")
+    grid = grid_generator(loc, transform_type="affine",
+                          target_shape=target_shape)
+    return bilinear_sampler(data, grid)

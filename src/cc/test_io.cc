@@ -258,27 +258,37 @@ void TestImageBatcher(const std::string& dir) {
 
   mximg_batcher_close(b);
 
-  // shuffled epochs: same seed -> identical order across independent
-  // batchers (determinism), and the emitted labels are exactly the
-  // valid record set (a permutation — nothing duplicated or dropped)
-  auto collect_epoch = [&](uint64_t seed) {
-    void* bs = mximg_batcher_create(rec_path.c_str(), idx_path.c_str(), 5, H,
-                                    W, 3, 1, seed, 1, 0);
-    CHECK(bs);
+  // shuffled epochs, driven through mximg_batcher_reset (the epoch
+  // boundary path io/native.py uses): same seed -> identical per-epoch
+  // order across independent batchers (determinism), successive epochs
+  // reshuffle, and every epoch's labels are exactly the valid record
+  // set (a permutation — nothing duplicated or dropped)
+  auto drain = [&](void* bs) {
     std::vector<float> got;
     std::vector<float> lab(5);
     int64_t n;
     while ((n = mximg_batcher_next(bs, data.data(), lab.data())) != -1)
       got.insert(got.end(), lab.begin(), lab.begin() + n);
-    mximg_batcher_close(bs);
     return got;
   };
-  auto e1 = collect_epoch(42);
-  auto e2 = collect_epoch(42);
-  CHECK(e1 == e2);  // same seed, same epoch -> same order
-  CHECK(e1.size() == 9);  // 10 records minus the corrupt one
+  auto epochs = [&](uint64_t seed) {
+    void* bs = mximg_batcher_create(rec_path.c_str(), idx_path.c_str(), 5, H,
+                                    W, 3, 1, seed, 1, 0);
+    CHECK(bs);
+    auto ep0 = drain(bs);
+    mximg_batcher_reset(bs);  // production epoch-boundary path
+    auto ep1 = drain(bs);
+    mximg_batcher_close(bs);
+    return std::make_pair(ep0, ep1);
+  };
+  auto a = epochs(42), c = epochs(42);
+  CHECK(a.first == c.first);    // same seed, epoch 0 -> same order
+  CHECK(a.second == c.second);  // same seed, epoch 1 (post-reset) too
   std::multiset<float> want = {0, 1, 2, 3, 4, 6, 7, 8, 9};
-  CHECK(std::multiset<float>(e1.begin(), e1.end()) == want);
+  for (const auto& e : {a.first, a.second}) {
+    CHECK(e.size() == 9);  // 10 records minus the corrupt one
+    CHECK(std::multiset<float>(e.begin(), e.end()) == want);
+  }
 
   // stale idx / missing rec must fail at create, not hang
   CHECK(mximg_batcher_create((dir + "/nope.rec").c_str(), idx_path.c_str(), 2,

@@ -790,6 +790,8 @@ COVERED_ELSEWHERE = {
 
 _HERE_TABLES = (set(UNARY) | set(BINARY) | set(SCALAR) | set(REDUCE))
 _HERE_EXPLICIT = {
+    "LRN", "ROIPooling", "GridGenerator", "SpatialTransformer",
+    "unravel_index", "ravel_multi_index", "digamma",
     "erfinv", "norm", "argmax", "argmin", "argmax_channel", "L2Normalization",
     "reshape", "reshape_like", "shape_array", "size_array", "transpose",
     "swapaxes", "Flatten", "expand_dims", "squeeze", "flip", "tile", "repeat",
@@ -862,3 +864,93 @@ def test_check_consistency_f32_vs_bf16(case):
     name, fn, shapes, atol = case
     inputs = [RS.randn(*s).astype(np.float32) * 0.5 for s in shapes]
     check_consistency(fn, _consistency_ctx_list(), inputs, atol=atol)
+
+
+# ---------------------------------------------------------------------------
+# round-3 op additions: LRN / ROI pooling / STN family / ravel / digamma
+# ---------------------------------------------------------------------------
+def test_lrn_golden():
+    """LRN vs naive channel-window loop (reference src/operator/nn/lrn.cc)."""
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 7, 3, 3).astype(np.float32)
+    nsize, alpha, beta, k = 5, 1e-4, 0.75, 2.0
+    half = nsize // 2
+    ref = np.empty_like(x)
+    for c in range(7):
+        lo, hi = max(0, c - half), min(7, c + half + 1)
+        s = (x[:, lo:hi] ** 2).sum(1)
+        ref[:, c] = x[:, c] / (k + alpha / nsize * s) ** beta
+    got = nd.LRN(nd.array(x), nsize=nsize, alpha=alpha, beta=beta,
+                 knorm=k).asnumpy()
+    assert_almost_equal(got, ref, rtol=1e-5, atol=1e-6)
+    check_numeric_gradient(
+        lambda a: nd.LRN(a, nsize=3, alpha=1e-3, beta=0.5, knorm=1.0),
+        [rng.randn(1, 4, 2, 2).astype(np.float32)])
+
+
+def test_roi_pooling_golden():
+    """ROIPooling vs naive bin loop (reference src/operator/roi_pooling.cc)."""
+    rng = np.random.RandomState(1)
+    x = rng.randn(1, 2, 8, 8).astype(np.float32)
+    rois = np.array([[0, 0, 0, 7, 7], [0, 2, 2, 5, 5], [0, 6, 6, 7, 7]],
+                    np.float32)
+    got = nd.ROIPooling(nd.array(x), nd.array(rois), pooled_size=(2, 2),
+                        spatial_scale=1.0).asnumpy()
+    for r, roi in enumerate(rois):
+        b, x1, y1, x2, y2 = (int(round(v)) for v in roi)
+        rh, rw = max(y2 - y1 + 1, 1), max(x2 - x1 + 1, 1)
+        for i in range(2):
+            for j in range(2):
+                hs = y1 + int(np.floor(i * rh / 2))
+                he = max(y1 + int(np.ceil((i + 1) * rh / 2)), hs + 1)
+                ws = x1 + int(np.floor(j * rw / 2))
+                we = max(x1 + int(np.ceil((j + 1) * rw / 2)), ws + 1)
+                ref = x[b, :, max(hs, 0):min(he, 8),
+                        max(ws, 0):min(we, 8)].max(axis=(1, 2))
+                assert_almost_equal(got[r, :, i, j], ref)
+    # spatial_scale: rois in image coords, features downscaled 2x
+    got2 = nd.ROIPooling(nd.array(x), nd.array(np.array([[0, 0, 0, 15, 15]],
+                                                        np.float32)),
+                         pooled_size=(1, 1), spatial_scale=0.5).asnumpy()
+    assert_almost_equal(got2[0, :, 0, 0], x[0].max(axis=(1, 2)))
+
+
+def test_spatial_transformer_and_grid_generator():
+    """Identity affine reproduces the input; warp with zero flow is the
+    identity grid; gradients flow to the localization input (reference
+    src/operator/spatial_transformer.cc)."""
+    rng = np.random.RandomState(2)
+    x = rng.randn(2, 3, 5, 6).astype(np.float32)
+    theta = np.tile(np.array([1, 0, 0, 0, 1, 0], np.float32), (2, 1))
+    out = nd.SpatialTransformer(nd.array(x), nd.array(theta),
+                                target_shape=(5, 6)).asnumpy()
+    assert_almost_equal(out, x, rtol=1e-4, atol=1e-5)
+
+    g = nd.GridGenerator(nd.array(np.zeros((1, 2, 4, 4), np.float32)),
+                         transform_type="warp").asnumpy()
+    assert np.allclose(g[0, 0, :, 0], -1) and np.allclose(g[0, 0, :, -1], 1)
+    assert np.allclose(g[0, 1, 0, :], -1) and np.allclose(g[0, 1, -1, :], 1)
+
+    from mxnet_tpu import autograd
+    a = nd.array(theta)
+    a.attach_grad()
+    with autograd.record():
+        y = nd.SpatialTransformer(nd.array(x), a, target_shape=(5, 6))
+        s = (y * y).sum()
+    s.backward()
+    assert np.isfinite(a.grad.asnumpy()).all()
+    assert np.abs(a.grad.asnumpy()).sum() > 0
+
+
+def test_ravel_unravel_and_digamma():
+    """ravel.cc pair round-trips; digamma matches scipy-free goldens."""
+    flat = nd.array(np.array([5, 11, 0], np.int64))
+    u = nd.unravel_index(flat, shape=(3, 4))
+    assert u.asnumpy().tolist() == [[1, 2, 0], [1, 3, 0]]
+    r = nd.ravel_multi_index(u, shape=(3, 4))
+    assert r.asnumpy().tolist() == [5, 11, 0]
+    d = nd.digamma(nd.array(np.array([1.0, 0.5, 2.0], np.float32))).asnumpy()
+    # psi(1) = -gamma, psi(1/2) = -gamma - 2 ln 2, psi(2) = 1 - gamma
+    eg = 0.5772156649
+    assert_almost_equal(d, np.array([-eg, -eg - 2 * np.log(2), 1 - eg],
+                                    np.float32), rtol=1e-4, atol=1e-5)

@@ -201,8 +201,11 @@ def test_flash_attention_under_high_matmul_precision():
     q = jax.random.normal(k, (1, 2, 64, 32), jnp.float32)
     kk = jax.random.normal(jax.random.fold_in(k, 1), (1, 2, 64, 32))
     v = jax.random.normal(jax.random.fold_in(k, 2), (1, 2, 64, 32))
+    # on the real chip run NON-interpreted so Mosaic actually compiles
+    # the dots (interpret mode cannot reproduce the crash); the CPU
+    # suite can only exercise the interpreter
     with jax.default_matmul_precision("high"):
-        o = flash_attention(q, kk, v, causal=True, interpret=True)
+        o = flash_attention(q, kk, v, causal=True, interpret=not _on_tpu())
     ref = _attn_ref(q, kk, v, causal=True)
     np.testing.assert_allclose(np.asarray(o), np.asarray(ref),
                                rtol=RTOL, atol=ATOL)
