@@ -81,6 +81,9 @@ from . import symbol as sym
 from .symbol import Symbol
 from .executor import Executor
 from . import module
+from . import rnn
+from . import visualization
+from . import visualization as viz
 
 
 def waitall():

@@ -86,7 +86,10 @@ class BucketingModule(BaseModule):
         self.switch_bucket(self._default_bucket_key, data_shapes, label_shapes)
         self.binded = True
 
-    def init_params(self, **kwargs):
+    def init_params(self, initializer=None, **kwargs):
+        # positional initializer for reference-signature parity
+        # (base_module.py init_params(initializer=Uniform(0.01), ...))
+        kwargs = dict(kwargs, initializer=initializer)
         self._init_args = kwargs
         self._curr_module.init_params(**kwargs)
         self.params_initialized = True

@@ -142,12 +142,16 @@ class Module(BaseModule):
         if initializer is None and not (arg_params or aux_params):
             initializer = Uniform(0.01)
 
+        attrs = self._symbol.attr_dict() if hasattr(self._symbol, "attr_dict") \
+            else {}
         for name in self._param_names:
             arr = self._exec.arg_dict[name]
             if arg_params is not None and name in arg_params:
                 arg_params[name].copyto(arr)
             elif initializer is not None:
-                initializer(InitDesc(name), arr)
+                # per-variable __init__ attrs (e.g. mx.rnn LSTMCell's
+                # LSTMBias forget-gate offset) override the global init
+                initializer(InitDesc(name, attrs.get(name)), arr)
             elif not allow_missing:
                 raise MXNetError(f"parameter {name} missing and no initializer given")
             for ex in self._execs[1:]:  # broadcast to replicas

@@ -836,7 +836,9 @@ def ravel_multi_index(x, shape=()):
     """Multi-index (leading axis = coordinates) -> flat index
     (reference src/operator/tensor/ravel.cc RavelMultiIndex). Plain
     stride arithmetic, NO range clipping — out-of-range coordinates
-    produce out-of-range flat indices exactly as the reference does."""
+    produce out-of-range flat indices exactly as the reference does.
+    True 64-bit arithmetic relies on the package-wide jax_enable_x64
+    (set at import; without it jnp.int64 silently degrades to int32)."""
     dims = tuple(int(s) for s in shape)
     stride = 1
     flat = jnp.zeros(x.shape[1:], jnp.int64)

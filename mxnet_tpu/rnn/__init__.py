@@ -1,0 +1,13 @@
+"""Legacy symbolic RNN cell API (reference python/mxnet/rnn/rnn_cell.py).
+
+Pre-Gluon cells that build SYMBOL graphs — the API behind the
+reference's bucketing examples (example/rnn/bucketing with
+BucketingModule). Gluon models should use ``gluon.rnn``; this namespace
+exists so reference scripts using ``mx.rnn.LSTMCell(...).unroll(...)``
+port unchanged.
+"""
+from .rnn_cell import (BaseRNNCell, RNNCell, LSTMCell, GRUCell,
+                       SequentialRNNCell, DropoutCell, RNNParams)
+
+__all__ = ["BaseRNNCell", "RNNCell", "LSTMCell", "GRUCell",
+           "SequentialRNNCell", "DropoutCell", "RNNParams"]

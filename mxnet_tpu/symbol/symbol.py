@@ -598,6 +598,15 @@ def _make_node(op, inputs, params, name=None):
     nout = 1
     if op.num_visible_outputs is not None:
         nout = op.num_visible_outputs
+    if "num_outputs" in params:
+        # dynamic-arity ops (split/SliceChannel/amp_multicast): the
+        # output count IS the param — without this, sym[0] on a split
+        # returns the whole tuple-producing node and the consumer gets
+        # every output splatted as positional inputs
+        try:
+            nout = int(params["num_outputs"])
+        except (TypeError, ValueError):
+            pass
     return Symbol(op=op, inputs=inputs, attrs=merged, name=name,
                   num_outputs=nout)
 

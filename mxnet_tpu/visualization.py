@@ -1,0 +1,144 @@
+"""Network visualization (reference python/mxnet/visualization.py).
+
+``print_summary`` walks the Symbol DAG and prints the layer table with
+output shapes and parameter counts — the reference's keras-style
+summary. ``plot_network`` renders the DAG via graphviz when available
+(graphviz is not in the TPU image; the call raises ImportError with
+instructions, matching the reference's optional-dependency behavior).
+"""
+from __future__ import annotations
+
+from .base import MXNetError
+
+__all__ = ["print_summary", "plot_network"]
+
+
+def _walk(sym, seen, order):
+    # indexed-output selections ("split0[1]") carry no op/inputs of
+    # their own — traverse their base node or the whole upstream
+    # subgraph silently disappears from the summary
+    sym = sym._base or sym
+    key = id(sym)
+    if key in seen:
+        return
+    seen.add(key)
+    for inp in sym._inputs:
+        _walk(inp, seen, order)
+    order.append(sym)
+
+
+def print_summary(symbol, shape=None, line_length=120, positions=(.44, .64, .74, 1.)):
+    """Print a layer-by-layer summary of the symbol (reference
+    visualization.py print_summary). ``shape`` is a dict of input
+    name -> shape used to infer per-layer output shapes."""
+    if symbol._is_group():
+        raise MXNetError("print_summary expects a single-output symbol")
+    shape_map = {}
+    if shape is not None:
+        # ONE inference over the internals group (not per-node — a
+        # per-node loop re-walks the whole subgraph each time, O(n^2))
+        try:
+            internals = symbol.get_internals()
+            _, out_shapes, _ = internals.infer_shape(**shape)
+            if out_shapes:
+                for s, os_ in zip(internals, out_shapes):
+                    if os_ is not None and s.name:
+                        shape_map[s.name] = os_
+        except Exception:
+            pass
+
+    positions = [int(line_length * p) for p in positions]
+    fields = ["Layer (type)", "Output Shape", "Param #", "Previous Layer"]
+
+    def print_row(cols):
+        line = ""
+        for i, col in enumerate(cols):
+            line = (line + str(col))[:positions[i] - 1].ljust(positions[i])
+        print(line)
+
+    print("_" * line_length)
+    print_row(fields)
+    print("=" * line_length)
+
+    seen, order = set(), []
+    _walk(symbol, seen, order)
+
+    total = 0
+    arg_names = set(symbol.list_arguments())
+    shaped_args = {}
+    if shape is not None:
+        try:
+            arg_shapes, _, _ = symbol.infer_shape(**shape)
+            shaped_args = dict(zip(symbol.list_arguments(), arg_shapes))
+        except Exception:
+            pass
+
+    counted = set()  # weight shared across nodes (unrolled RNNs) counts once
+    for node in order:
+        if node._op is None and shape and node._name in shape:  # data input
+            print_row([f"{node._name} (input)",
+                       shape.get(node._name, ""), 0, ""])
+            print("_" * line_length)
+            continue
+        if node._op is None:
+            continue  # weight/bias variables fold into their consumer
+        params = 0
+        prevs = []
+        for inp in node._inputs:
+            inp = inp._base or inp
+            if inp._op is None and inp._name in arg_names \
+                    and inp._name not in counted \
+                    and not inp._name.endswith("label") \
+                    and (shape is None or inp._name not in shape):
+                counted.add(inp._name)
+                s = shaped_args.get(inp._name)
+                if s:
+                    n = 1
+                    for d in s:
+                        n *= int(d)
+                    params += n
+            else:
+                prevs.append(inp.name or "")
+        total += params
+        out_shape = shape_map.get(node.name, "")
+        print_row([f"{node.name} ({node._op.name})", out_shape, params,
+                   ",".join(p for p in prevs if p)[:40]])
+        print("_" * line_length)
+    print(f"Total params: {total}")
+    print("=" * line_length)
+    return total
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 node_attrs=None, hide_weights=True):
+    """Render the DAG with graphviz (reference plot_network). The TPU
+    image ships no graphviz; install it to use this (the printable
+    fallback is print_summary)."""
+    try:
+        from graphviz import Digraph  # noqa: F401
+    except ImportError as e:
+        raise ImportError(
+            "plot_network requires the optional graphviz package "
+            "(pip install graphviz); use mx.viz.print_summary for a "
+            "text summary") from e
+    dot = Digraph(name=title, format=save_format)
+    seen, order = set(), []
+    _walk(symbol, seen, order)
+    for node in order:
+        if node._op is None:
+            arg = node._name or "var"
+            if hide_weights and node._name not in (shape or {}):
+                continue
+            dot.node(str(id(node)), arg, shape="oval")
+        else:
+            dot.node(str(id(node)), f"{node.name}\n{node._op.name}",
+                     shape="box")
+    for node in order:
+        if node._op is None:
+            continue
+        for inp in node._inputs:
+            if inp._op is None and hide_weights \
+                    and (inp._name not in (shape or {})):
+                continue
+            dot.edge(str(id(inp)), str(id(node)))
+    return dot

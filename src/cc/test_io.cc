@@ -284,6 +284,7 @@ void TestImageBatcher(const std::string& dir) {
   auto a = epochs(42), c = epochs(42);
   CHECK(a.first == c.first);    // same seed, epoch 0 -> same order
   CHECK(a.second == c.second);  // same seed, epoch 1 (post-reset) too
+  CHECK(a.first != a.second);   // reset advances the epoch -> reshuffled
   std::multiset<float> want = {0, 1, 2, 3, 4, 6, 7, 8, 9};
   for (const auto& e : {a.first, a.second}) {
     CHECK(e.size() == 9);  // 10 records minus the corrupt one

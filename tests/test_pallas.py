@@ -199,8 +199,10 @@ def test_flash_attention_under_high_matmul_precision():
     assert _dot_precision(jnp.bfloat16) == jax.lax.Precision.DEFAULT
     k = jax.random.PRNGKey(3)
     q = jax.random.normal(k, (1, 2, 64, 32), jnp.float32)
-    kk = jax.random.normal(jax.random.fold_in(k, 1), (1, 2, 64, 32))
-    v = jax.random.normal(jax.random.fold_in(k, 2), (1, 2, 64, 32))
+    kk = jax.random.normal(jax.random.fold_in(k, 1), (1, 2, 64, 32),
+                           jnp.float32)
+    v = jax.random.normal(jax.random.fold_in(k, 2), (1, 2, 64, 32),
+                          jnp.float32)
     # on the real chip run NON-interpreted so Mosaic actually compiles
     # the dots (interpret mode cannot reproduce the crash); the CPU
     # suite can only exercise the interpreter

@@ -1,0 +1,135 @@
+"""Legacy symbolic mx.rnn cell API (reference python/mxnet/rnn/rnn_cell.py
++ tests/python/unittest/test_rnn.py): unroll shapes, numpy-golden LSTM
+numerics, stacked cells, and BucketingModule integration."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_rnn_cell_unroll_shapes():
+    cell = mx.rnn.RNNCell(num_hidden=8, prefix="r_")
+    data = mx.sym.Variable("data")  # (N, T, C)
+    outputs, states = cell.unroll(3, data, layout="NTC", merge_outputs=True)
+    _, out_shapes, _ = outputs.infer_shape(data=(2, 3, 5))
+    assert out_shapes[0] == (2, 3, 8)
+    assert len(states) == 1
+
+
+def test_lstm_cell_numpy_golden():
+    """Unrolled LSTMCell forward == numpy LSTM with the i,f,c,o gate
+    order, weights injected through the executor arg dict."""
+    H, I, N, T = 4, 3, 2, 3
+    rng = np.random.RandomState(0)
+    wx = rng.randn(4 * H, I).astype(np.float32) * 0.4
+    wh = rng.randn(4 * H, H).astype(np.float32) * 0.4
+    bx = rng.randn(4 * H).astype(np.float32) * 0.1
+    bh = rng.randn(4 * H).astype(np.float32) * 0.1
+    x = rng.randn(N, T, I).astype(np.float32)
+
+    cell = mx.rnn.LSTMCell(num_hidden=H, prefix="l_", forget_bias=0.0)
+    data = mx.sym.Variable("data")
+    outputs, _ = cell.unroll(T, data, layout="NTC", merge_outputs=True)
+    h0 = np.zeros((N, H), np.float32)
+    c0 = np.zeros((N, H), np.float32)
+    args = {"data": mx.nd.array(x),
+            "l_i2h_weight": mx.nd.array(wx), "l_i2h_bias": mx.nd.array(bx),
+            "l_h2h_weight": mx.nd.array(wh), "l_h2h_bias": mx.nd.array(bh)}
+    exe = outputs.bind(mx.current_context(), args)
+    got = exe.forward()[0].asnumpy()
+
+    def sig(v):
+        return 1.0 / (1.0 + np.exp(-v))
+
+    h, c = h0, c0
+    want = []
+    for t in range(T):
+        g = x[:, t] @ wx.T + bx + h @ wh.T + bh
+        i, f, n, o = np.split(g, 4, axis=1)
+        c = sig(f) * c + sig(i) * np.tanh(n)
+        h = sig(o) * np.tanh(c)
+        want.append(h)
+    want = np.stack(want, axis=1)
+    assert_almost_equal(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_stacked_cells_and_dropout():
+    stack = mx.rnn.SequentialRNNCell()
+    stack.add(mx.rnn.LSTMCell(num_hidden=6, prefix="l0_"))
+    stack.add(mx.rnn.DropoutCell(0.0))
+    stack.add(mx.rnn.GRUCell(num_hidden=5, prefix="g0_"))
+    data = mx.sym.Variable("data")
+    outputs, states = stack.unroll(4, data, merge_outputs=True)
+    _, out_shapes, _ = outputs.infer_shape(data=(3, 4, 7))
+    assert out_shapes[0] == (3, 4, 5)
+    assert len(states) == 3  # lstm h,c + gru h
+
+
+def test_rnn_cells_with_bucketing_module():
+    """The upstream pairing: mx.rnn cells + BucketingModule train a tiny
+    variable-length sequence classifier (reference example/rnn/bucketing)."""
+    rng = np.random.RandomState(2)
+    buckets = [5, 3]  # default bucket (5) binds first
+
+    def gen_sym(seq_len):
+        data = mx.sym.Variable("data")
+        label = mx.sym.Variable("softmax_label")
+        cell = mx.rnn.LSTMCell(num_hidden=8, prefix="lstm_")
+        outputs, _ = cell.unroll(seq_len, data, merge_outputs=False)
+        fc = mx.sym.FullyConnected(outputs[-1], num_hidden=2, name="fc")
+        return mx.sym.SoftmaxOutput(fc, label, name="softmax"), \
+            ["data"], ["softmax_label"]
+
+    mod = mx.module.BucketingModule(gen_sym, default_bucket_key=5)
+    # two batches per bucket: class = sign of the sequence mean
+    for epoch in range(30):
+        for blen in buckets:
+            x = rng.randn(8, blen, 4).astype(np.float32) + \
+                (rng.randint(0, 2, (8, 1, 1)) * 2 - 1) * 0.8
+            y = (x.mean(axis=(1, 2)) > 0).astype(np.float32)
+            batch = mx.io.DataBatch(data=[mx.nd.array(x)],
+                                    label=[mx.nd.array(y)],
+                                    bucket_key=blen,
+                                    provide_data=[("data", (8, blen, 4))],
+                                    provide_label=[("softmax_label", (8,))])
+            if not mod.binded:
+                mod.bind(data_shapes=batch.provide_data,
+                         label_shapes=batch.provide_label)
+                mod.init_params(mx.initializer.Xavier())
+                mod.init_optimizer(optimizer="adam",
+                                   optimizer_params={"learning_rate": 5e-3})
+            mod.forward_backward(batch)
+            mod.update()
+    # the trained model must beat chance comfortably on fresh data
+    correct = total = 0
+    for blen in buckets:
+        for _ in range(4):
+            x = rng.randn(8, blen, 4).astype(np.float32) + \
+                (rng.randint(0, 2, (8, 1, 1)) * 2 - 1) * 0.8
+            y = (x.mean(axis=(1, 2)) > 0).astype(np.float32)
+            batch = mx.io.DataBatch(data=[mx.nd.array(x)],
+                                    label=[mx.nd.array(y)],
+                                    bucket_key=blen,
+                                    provide_data=[("data", (8, blen, 4))],
+                                    provide_label=[("softmax_label", (8,))])
+            mod.forward(batch, is_train=False)
+            pred = mod.get_outputs()[0].asnumpy().argmax(axis=1)
+            correct += (pred == y).sum()
+            total += len(y)
+    assert correct / total > 0.8, correct / total
+
+
+def test_lstm_forget_bias_baked_into_init():
+    """forget_bias lands in h2h_bias at INIT (reference init.LSTMBias),
+    not as a runtime add — checkpoint parity with the reference."""
+    H = 4
+    cell = mx.rnn.LSTMCell(num_hidden=H, prefix="fb_", forget_bias=1.0)
+    data = mx.sym.Variable("data")
+    outputs, _ = cell.unroll(2, data, merge_outputs=True)
+    mod = mx.module.Module(outputs, data_names=["data"], label_names=[])
+    mod.bind(data_shapes=[("data", (2, 2, 3))], for_training=False)
+    mod.init_params(mx.initializer.Zero())
+    args, _ = mod.get_params()
+    b = args["fb_h2h_bias"].asnumpy()
+    assert np.allclose(b[H:2 * H], 1.0)       # forget gate slice
+    assert np.allclose(b[:H], 0.0) and np.allclose(b[2 * H:], 0.0)

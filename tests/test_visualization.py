@@ -1,0 +1,48 @@
+"""mx.viz tests (reference python/mxnet/visualization.py)."""
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _mlp():
+    net = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(net, num_hidden=32, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu", name="relu1")
+    net = mx.sym.FullyConnected(net, num_hidden=10, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="sm")
+
+
+def test_print_summary_counts_params(capsys):
+    total = mx.viz.print_summary(_mlp(), shape={"data": (1, 100)})
+    assert total == 100 * 32 + 32 + 32 * 10 + 10
+    out = capsys.readouterr().out
+    assert "fc1" in out and "Total params: 3562" in out
+    assert "(input)" in out  # data row present
+
+
+def test_print_summary_without_shape(capsys):
+    total = mx.viz.print_summary(_mlp())
+    assert total == 0  # no shapes -> params unknown, layers still listed
+    assert "fc2" in capsys.readouterr().out
+
+
+def test_plot_network_gated_or_renders():
+    try:
+        dot = mx.viz.plot_network(_mlp(), shape={"data": (1, 100)})
+    except ImportError as e:
+        assert "graphviz" in str(e)
+    else:
+        assert "fc1" in dot.source
+
+
+def test_print_summary_traverses_multi_output_graphs(capsys):
+    """Indexed-output inputs (SliceChannel/split) must not hide their
+    upstream layers (review regression: _walk resolving _base)."""
+    cell = mx.rnn.LSTMCell(num_hidden=4, prefix="l_")
+    data = mx.sym.Variable("data")
+    outputs, _ = cell.unroll(2, data, merge_outputs=True)
+    total = mx.viz.print_summary(outputs, shape={"data": (2, 2, 3)})
+    out = capsys.readouterr().out
+    assert "i2h" in out and "h2h" in out
+    # i2h: 16x3 + 16; h2h: 16x4 + 16
+    assert total == 16 * 3 + 16 + 16 * 4 + 16
