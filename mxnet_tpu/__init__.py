@@ -82,6 +82,7 @@ from .symbol import Symbol
 from .executor import Executor
 from . import module
 from . import rnn
+from . import contrib
 from . import visualization
 from . import visualization as viz
 

@@ -1,6 +1,8 @@
-"""mx.contrib — AMP, quantization, ONNX (python/mxnet/contrib analog)."""
+"""mx.contrib — AMP, quantization, ONNX, tensorboard
+(python/mxnet/contrib analog)."""
 from . import amp
 from . import quantization
+from . import tensorboard
 
 
 def __getattr__(name):

@@ -341,12 +341,20 @@ class HybridBlock(Block):
         self._cached_graph = {}
 
     def hybridize(self, active=True, static_alloc=False, static_shape=False,
-                  **kwargs):
+                  remat=False, **kwargs):
         """Activate compiled execution. static_alloc/static_shape are
-        accepted for API parity — XLA always plans memory statically."""
+        accepted for API parity — XLA always plans memory statically.
+
+        ``remat=True`` (TPU-first extension, no reference analog) wraps
+        the compiled subgraph in ``jax.checkpoint``: the backward pass
+        recomputes this block's activations instead of storing them —
+        the HBM-for-FLOPs trade for long sequences / deep nets.
+        Hybridize each layer of an UN-hybridized parent for classic
+        per-layer activation checkpointing, or the root block for
+        whole-net remat."""
         self._active = active
         self._flags = dict(static_alloc=static_alloc, static_shape=static_shape,
-                           **kwargs)
+                           remat=remat, **kwargs)
         self._cached_graph = {}
         super().hybridize(active, **kwargs)
 
@@ -478,7 +486,8 @@ class HybridBlock(Block):
             return tuple(x._data if isinstance(x, NDArray) else x
                          for x in flat) + tuple(aux_arrays)
 
-        jitted = jax.jit(traced)
+        fn = jax.checkpoint(traced) if self._flags.get("remat") else traced
+        jitted = jax.jit(fn)
         # learn the output structure abstractly — no device execution
         # (jax.eval_shape runs the python once with avals; the real
         # compile+run happens on the first invoke below)

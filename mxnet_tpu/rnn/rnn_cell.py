@@ -53,8 +53,16 @@ class BaseRNNCell:
     def state_shape(self):
         return [info["shape"] for info in self.state_info]
 
-    def begin_state(self, func=None, **kwargs):
-        """Initial state symbols (zeros variables by default)."""
+    def begin_state(self, func=None, batch_size=None, **kwargs):
+        """Initial state symbols.
+
+        Default (func=None): free Variables — bind them with concrete
+        shapes. With ``func`` (e.g. ``mx.sym.zeros``): the reference
+        leaves batch as 0 and relies on nnvm's bidirectional shape
+        inference; the XLA forward-only inference can't resolve that, so
+        pass ``batch_size`` to substitute it (or omit begin_state
+        entirely in ``unroll`` — the default builds zeros tied to the
+        input's batch dim symbolically)."""
         self._init_counter += 1
         states = []
         for i, info in enumerate(self.state_info):
@@ -62,7 +70,18 @@ class BaseRNNCell:
             if func is None:
                 states.append(sym.Variable(name, **kwargs))
             else:
-                states.append(func(name=name, **info, **kwargs))
+                info = dict(info)
+                shape = tuple(info.pop("shape", ()))
+                if batch_size is not None:
+                    shape = tuple(batch_size if d == 0 else d for d in shape)
+                if any(d == 0 for d in shape):
+                    raise MXNetError(
+                        "begin_state(func=...) needs a concrete batch: "
+                        "pass batch_size=N (the reference resolves the "
+                        "0 dim via nnvm bidirectional inference, which "
+                        "the forward-only XLA walk does not do)")
+                info.pop("__layout__", None)
+                states.append(func(name=name, shape=shape, **info, **kwargs))
         return states
 
     def __call__(self, inputs, states):

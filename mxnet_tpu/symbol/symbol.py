@@ -119,6 +119,10 @@ class Symbol:
                 raise MXNetError(f"no output named {index}")
         if self._num_outputs == 1 and index == 0:
             return self
+        if not 0 <= index < self._num_outputs:
+            raise IndexError(
+                f"output index {index} out of range for {self._name!r} "
+                f"with {self._num_outputs} outputs")
         return Symbol(output_index=index, base=self._base or self,
                       name=f"{self._name}[{index}]")
 
@@ -362,6 +366,9 @@ class Symbol:
             for i in self._inputs:
                 b = i._base or i
                 node_outs = out_shapes_by_node.get(id(b))
+                if node_outs is None and b._op is None:
+                    # variable member: its "output" is its own shape
+                    node_outs = [known.get(b._name)]
                 outs.append(None if node_outs is None
                             else node_outs[i._output_index or 0])
         else:

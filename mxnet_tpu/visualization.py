@@ -13,18 +13,10 @@ from .base import MXNetError
 __all__ = ["print_summary", "plot_network"]
 
 
-def _walk(sym, seen, order):
-    # indexed-output selections ("split0[1]") carry no op/inputs of
-    # their own — traverse their base node or the whole upstream
-    # subgraph silently disappears from the summary
-    sym = sym._base or sym
-    key = id(sym)
-    if key in seen:
-        return
-    seen.add(key)
-    for inp in sym._inputs:
-        _walk(inp, seen, order)
-    order.append(sym)
+def _order(symbol):
+    """Post-order DAG walk — Symbol._topo already resolves indexed-output
+    selections ("split0[1]") to their base node."""
+    return symbol._topo()
 
 
 def print_summary(symbol, shape=None, line_length=120, positions=(.44, .64, .74, 1.)):
@@ -60,18 +52,13 @@ def print_summary(symbol, shape=None, line_length=120, positions=(.44, .64, .74,
     print_row(fields)
     print("=" * line_length)
 
-    seen, order = set(), []
-    _walk(symbol, seen, order)
+    order = _order(symbol)
 
     total = 0
     arg_names = set(symbol.list_arguments())
-    shaped_args = {}
-    if shape is not None:
-        try:
-            arg_shapes, _, _ = symbol.infer_shape(**shape)
-            shaped_args = dict(zip(symbol.list_arguments(), arg_shapes))
-        except Exception:
-            pass
+    # variable internals' output shapes ARE the arg shapes — one
+    # inference pass serves both columns
+    shaped_args = shape_map
 
     counted = set()  # weight shared across nodes (unrolled RNNs) counts once
     for node in order:
@@ -121,15 +108,22 @@ def plot_network(symbol, title="plot", save_format="pdf", shape=None,
             "plot_network requires the optional graphviz package "
             "(pip install graphviz); use mx.viz.print_summary for a "
             "text summary") from e
+    _PARAM_SUFFIXES = ("weight", "bias", "gamma", "beta", "moving_mean",
+                       "moving_var", "running_mean", "running_var")
+
+    def _hidden(var_name):
+        # hide PARAMETER variables only — data/label inputs stay visible
+        # even without a shape dict (reference behavior)
+        return hide_weights and var_name is not None \
+            and var_name.endswith(_PARAM_SUFFIXES)
+
     dot = Digraph(name=title, format=save_format)
-    seen, order = set(), []
-    _walk(symbol, seen, order)
+    order = _order(symbol)
     for node in order:
         if node._op is None:
-            arg = node._name or "var"
-            if hide_weights and node._name not in (shape or {}):
+            if _hidden(node._name):
                 continue
-            dot.node(str(id(node)), arg, shape="oval")
+            dot.node(str(id(node)), node._name or "var", shape="oval")
         else:
             dot.node(str(id(node)), f"{node.name}\n{node._op.name}",
                      shape="box")
@@ -137,8 +131,8 @@ def plot_network(symbol, title="plot", save_format="pdf", shape=None,
         if node._op is None:
             continue
         for inp in node._inputs:
-            if inp._op is None and hide_weights \
-                    and (inp._name not in (shape or {})):
+            inp = inp._base or inp
+            if inp._op is None and _hidden(inp._name):
                 continue
             dot.edge(str(id(inp)), str(id(node)))
     return dot

@@ -276,3 +276,53 @@ def test_resnet_s2d_stem_matches_plain():
         s2d.hybridize()
         assert_almost_equal(s2d(x).asnumpy(), plain(x).asnumpy(),
                             rtol=1e-4, atol=1e-5)
+
+
+def test_hybridize_remat_matches_plain():
+    """hybridize(remat=True) (jax.checkpoint rematerialization): same
+    outputs and gradients as the plain compiled path, and jax.checkpoint
+    actually wraps the traced function."""
+    import jax as _jax
+    import mxnet_tpu.gluon.block as _block
+
+    def build(remat):
+        mx.random.seed(5)
+        np.random.seed(5)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(16, activation="relu", in_units=8),
+                nn.Dense(4, in_units=16))
+        net.initialize(init=mx.initializer.Xavier())
+        net.hybridize(remat=remat)
+        return net
+
+    x_np = np.random.RandomState(9).randn(4, 8).astype(np.float32)
+
+    calls = []
+    orig = _jax.checkpoint
+
+    def spy(fn, *a, **k):
+        calls.append(1)
+        return orig(fn, *a, **k)
+
+    _jax.checkpoint = spy
+    try:
+        results = {}
+        for remat in (False, True):
+            net = build(remat)
+            x = nd.array(x_np)
+            x.attach_grad()
+            with autograd.record():
+                out = net(x)
+                loss = (out * out).sum()
+            loss.backward()
+            results[remat] = (out.asnumpy(), x.grad.asnumpy(),
+                              [p.grad().asnumpy()
+                               for p in net.collect_params().values()])
+    finally:
+        _jax.checkpoint = orig
+    assert len(calls) == 1  # only the remat=True build wrapped
+    assert_almost_equal(results[True][0], results[False][0])
+    assert_almost_equal(results[True][1], results[False][1], rtol=1e-6,
+                        atol=1e-7)
+    for a, b in zip(results[True][2], results[False][2]):
+        assert_almost_equal(a, b, rtol=1e-6, atol=1e-7)
