@@ -134,6 +134,20 @@ def _time_steps(step, params, moms, *args, flops_per_step=0.0):
     for _ in range(WARMUP):
         params, moms, loss = step(params, moms, *args)
     jax.block_until_ready(loss)
+    return _guard_impossible(timed, flops_per_step)
+
+
+def _guard_impossible(timed, flops_per_step):
+    """Run ``timed()``; reject results implying >1.5x chip peak.
+
+    Observed axon-tunnel failure mode: after a VERY slow remote
+    compile, execution futures in that process go bogus and
+    block_until_ready returns immediately (measured 7-18x "MFU");
+    process restart with the persistent compile cache warm measures
+    sanely. So: re-time twice, and if the impossibility persists,
+    raise instead of reporting — rerun the bench (cache-warm) to get
+    a real number.
+    """
     dt = timed()
     peak = _peak_tflops()
     if flops_per_step > 0 and peak > 0:
@@ -144,6 +158,13 @@ def _time_steps(step, params, moms, *args, flops_per_step=0.0):
             print(f"# suspect timing {dt:.4f}s (< physical bound "
                   f"{impossible:.4f}s) — re-timing", file=sys.stderr)
             dt = timed()
+        if dt < impossible:
+            raise RuntimeError(
+                f"measured {STEPS} steps in {dt:.4f}s, below the "
+                f"physical bound {impossible:.4f}s at {peak} TFLOP/s "
+                "peak — axon timing glitch (usually after a minutes-"
+                "long fresh compile); rerun with the compile cache "
+                "warm")
     return dt
 
 
@@ -182,9 +203,30 @@ def main():
                     .astype(np.dtype("float32")), dtype=DTYPE)
     y = jnp.asarray(np.random.RandomState(1).randint(0, 1000, BATCH), jnp.int32)
 
-    if os.environ.get("BENCH_INFER") == "1":
+    if os.environ.get("BENCH_INFER") in ("1", "int8"):
         # forward-only (inference) throughput — fwd runs ~35% MFU vs
-        # ~21% for backward (transposed-conv grads), see BASELINE.md
+        # ~21% for backward (transposed-conv grads), see BASELINE.md.
+        # BENCH_INFER=int8: rewrite Dense/Conv2D to the s8xs8->s32 MXU
+        # path (contrib.quantization) — v5e int8 peak is 2x bf16
+        int8 = os.environ.get("BENCH_INFER") == "int8"
+        # BOTH inference variants run predict-mode BN (training=False)
+        # so the int8-vs-bf16 comparison measures the same forward
+        with mx.autograd.predict_mode():
+            net(warm)
+        fn, params = functionalize(net, training=False, ctx=ctx)
+        if int8:
+            from mxnet_tpu.contrib.quantization import quantize_net
+            with mx.autograd.predict_mode():
+                # CALIBRATED scales (static): dynamic per-batch ranges
+                # add a min/max reduction per layer per step, measured
+                # slower than bf16 (5596 vs 7218 img/s)
+                calib = [[mx.nd.array(
+                    np.random.RandomState(i).rand(8, 3, IMAGE, IMAGE)
+                    .astype(np.float32), ctx=ctx, dtype=DTYPE)]
+                    for i in range(4)]
+                quantize_net(net, calib_data=calib, ctx=ctx)
+                net(warm)  # re-trace materializes int8 weights
+            fn, params = functionalize(net, training=False, ctx=ctx)
         infer = jax.jit(lambda p, rng, x: fn(p, rng, x))
         iflops = 0.0
         try:
@@ -193,17 +235,21 @@ def main():
                            .get("flops", 0.0))
         except Exception:
             pass
+        def timed_infer():
+            t0 = time.perf_counter()
+            for _ in range(STEPS):
+                out = infer(params, rng, x)
+            jax.block_until_ready(out)
+            return time.perf_counter() - t0
+
         for _ in range(WARMUP):
             out = infer(params, rng, x)
         jax.block_until_ready(out)
-        t0 = time.perf_counter()
-        for _ in range(STEPS):
-            out = infer(params, rng, x)
-        jax.block_until_ready(out)
-        dt = time.perf_counter() - t0
+        dt = _guard_impossible(timed_infer, iflops)
         _report("resnet50_infer_images_per_sec_per_chip", BATCH * STEPS / dt,
                 "images/sec/chip", 0.0, flops_per_step=iflops,
-                sec_per_step=dt / STEPS, batch=BATCH, dtype=DTYPE)
+                sec_per_step=dt / STEPS, batch=BATCH,
+                dtype="int8" if int8 else DTYPE)
         return
 
     flops = _step_flops(step, params, moms, rng, x, y)

@@ -62,6 +62,7 @@ from . import gluon
 from . import io
 from . import recordio
 from . import image
+from . import image as img
 from . import callback
 from . import monitor
 from . import model
@@ -81,6 +82,7 @@ from . import symbol as sym
 from .symbol import Symbol
 from .executor import Executor
 from . import module
+from . import module as mod
 from . import rnn
 from . import contrib
 from . import visualization

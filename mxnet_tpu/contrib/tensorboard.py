@@ -97,10 +97,17 @@ class SummaryWriter:
     >>> sw.add_scalar("loss", 0.5, step)
     """
 
+    _SEQ = [0]  # per-process uniquifier
+
     def __init__(self, logdir, filename_suffix=""):
         os.makedirs(logdir, exist_ok=True)
+        # pid + sequence uniquify concurrent writers in one logdir (two
+        # writers in the same second would otherwise truncate each
+        # other — real tensorboard embeds pid for the same reason)
+        SummaryWriter._SEQ[0] += 1
         fname = (f"events.out.tfevents.{int(time.time())}."
-                 f"{socket.gethostname()}{filename_suffix}")
+                 f"{socket.gethostname()}.{os.getpid()}."
+                 f"{SummaryWriter._SEQ[0]}{filename_suffix}")
         self._path = os.path.join(logdir, fname)
         self._f = open(self._path, "wb")
         self._write_record(_event(time.time(), file_version="brain.Event:2"))
@@ -156,3 +163,6 @@ class LogMetricsCallback:
             if self.prefix is not None:
                 name = f"{self.prefix}-{name}"
             self.summary_writer.add_scalar(name, value, self._step)
+        # live tensorboard must see scalars as they land; a crashed run
+        # must not lose its history to the file buffer
+        self.summary_writer.flush()

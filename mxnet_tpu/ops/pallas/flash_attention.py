@@ -212,8 +212,17 @@ def _pad_len(s, block):
 
 
 def _pick_blocks(sq, skv):
-    bq = min(128, _pad_len(sq, 8))
-    bk = min(128, _pad_len(skv, 128))
+    # v5e-measured defaults (BASELINE.md round-3 sweep, seq512):
+    # 128x128 -> 65.5k tok/s (b16), 512x256 -> 96.6k, 512x512 -> 102.7k
+    # (+57%; b64 103.1k = 38.3% MFU) — large tiles amortize the
+    # (q, do, lse, delta) reloads across the k loop in the backward
+    # kernels. VMEM at 512x512 f32 scores (d<=128) stays under the
+    # ~16 MB budget. Override per run with MXNET_TPU_FLASH_BLOCK_Q/K.
+    import os
+    bq_cap = int(os.environ.get("MXNET_TPU_FLASH_BLOCK_Q", "512"))
+    bk_cap = int(os.environ.get("MXNET_TPU_FLASH_BLOCK_K", "512"))
+    bq = min(bq_cap, _pad_len(sq, 8))
+    bk = min(bk_cap, _pad_len(skv, 128))
     return bq, bk
 
 

@@ -11,7 +11,7 @@ import pytest
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 EXAMPLES = ["mnist_gluon.py", "mnist_module.py", "train_imagenet.py",
-            "word_lm.py", "wide_deep.py"]
+            "word_lm.py", "wide_deep.py", "rnn_bucketing.py"]
 
 
 @pytest.mark.parametrize("script", EXAMPLES)
@@ -32,3 +32,19 @@ def test_mnist_module_quick_runs():
                          capture_output=True, text=True, timeout=380)
     assert res.returncode == 0, res.stdout[-2000:] + res.stderr[-2000:]
     assert "final accuracy" in res.stdout
+
+
+@pytest.mark.timeout(400)
+def test_rnn_bucketing_quick_runs():
+    """The mx.rnn + BucketingModule pairing end-to-end (reference
+    example/rnn/bucketing)."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT
+    script = os.path.join(ROOT, "example", "rnn_bucketing.py")
+    code = ("import jax; jax.config.update('jax_platforms','cpu');"
+            "import sys, runpy; sys.argv=['m','--quick'];"
+            f"runpy.run_path(r'{script}', run_name='__main__')")
+    res = subprocess.run([sys.executable, "-c", code], env=env, cwd=ROOT,
+                         capture_output=True, text=True, timeout=380)
+    assert res.returncode == 0, res.stdout[-2000:] + res.stderr[-2000:]
+    assert "final train accuracy" in res.stdout

@@ -211,3 +211,30 @@ def test_flash_attention_under_high_matmul_precision():
     ref = _attn_ref(q, kk, v, causal=True)
     np.testing.assert_allclose(np.asarray(o), np.asarray(ref),
                                rtol=RTOL, atol=ATOL)
+
+
+def test_flash_attention_large_asymmetric_blocks(monkeypatch):
+    """seq 384 with FORCED 256x128 tiles: a genuine multi-block grid
+    with bq != bk and causal block-skip — golden vs jnp. (The defaults
+    clamp to one 384x384 block at this length, which would not cover
+    the multi-block path the 512-cap defaults enable on-chip.)"""
+    monkeypatch.setenv("MXNET_TPU_FLASH_BLOCK_Q", "256")
+    monkeypatch.setenv("MXNET_TPU_FLASH_BLOCK_K", "128")
+    rng = np.random.RandomState(6)
+    B, H, S, D = 1, 2, 384, 64
+    q = jnp.asarray(rng.randn(B, H, S, D).astype(np.float32)) * 0.3
+    k = jnp.asarray(rng.randn(B, H, S, D).astype(np.float32)) * 0.3
+    v = jnp.asarray(rng.randn(B, H, S, D).astype(np.float32))
+    for causal in (False, True):
+        o = flash_attention(q, k, v, None, causal, 0, True)
+        np.testing.assert_allclose(
+            np.asarray(o), np.asarray(_attn_ref(q, k, v, causal)),
+            rtol=RTOL, atol=ATOL)
+    w = jnp.asarray(rng.randn(B, H, S, D).astype(np.float32))
+    g = jax.grad(lambda q, k, v: (flash_attention(
+        q, k, v, None, True, 0, True) * w).sum(), argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda q, k, v: (_attn_ref(q, k, v, True) * w).sum(),
+                  argnums=(0, 1, 2))(q, k, v)
+    for a, c in zip(g, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(c),
+                                   rtol=RTOL, atol=ATOL)

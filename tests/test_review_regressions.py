@@ -73,3 +73,15 @@ def test_dataloader_early_break_releases():
     it = iter(dl)
     n = sum(1 for _ in it)
     assert n == 10
+
+
+def test_top_level_namespace_aliases():
+    """Reference package aliases (mx.mod/mx.img/mx.kv/mx.init/mx.sym/
+    mx.viz) resolve to their modules."""
+    import mxnet_tpu as mx
+    assert mx.mod is mx.module
+    assert mx.img is mx.image
+    assert mx.kv is mx.kvstore
+    assert mx.init is mx.initializer
+    assert mx.sym is mx.symbol
+    assert mx.viz is mx.visualization
