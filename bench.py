@@ -211,9 +211,6 @@ def main():
         int8 = os.environ.get("BENCH_INFER") == "int8"
         # BOTH inference variants run predict-mode BN (training=False)
         # so the int8-vs-bf16 comparison measures the same forward
-        with mx.autograd.predict_mode():
-            net(warm)
-        fn, params = functionalize(net, training=False, ctx=ctx)
         if int8:
             from mxnet_tpu.contrib.quantization import quantize_net
             with mx.autograd.predict_mode():
@@ -226,7 +223,7 @@ def main():
                     for i in range(4)]
                 quantize_net(net, calib_data=calib, ctx=ctx)
                 net(warm)  # re-trace materializes int8 weights
-            fn, params = functionalize(net, training=False, ctx=ctx)
+        fn, params = functionalize(net, training=False, ctx=ctx)
         infer = jax.jit(lambda p, rng, x: fn(p, rng, x))
         iflops = 0.0
         try:
@@ -403,7 +400,8 @@ def main_bert():
     vocab = 30522
     ctx = mx.current_context()
 
-    net = bert_base(vocab_size=vocab, max_length=512, dropout=0.0)
+    net = bert_base(vocab_size=vocab, max_length=max(512, seqlen),
+                    dropout=0.0)
     head = BERTMLMHead(vocab, 768)
     net.initialize(init=mx.initializer.Normal(0.02), ctx=ctx)
     head.initialize(init=mx.initializer.Normal(0.02), ctx=ctx)

@@ -67,18 +67,19 @@ def main():
             provide_label=[("softmax_label", (args.batch_size,))])
 
     train = make_batches(rng, buckets, args.batch_size, 24, feat)
-    # first batch must carry the default bucket key for bind
-    train.sort(key=lambda b: 0 if b[0] == buckets[-1] else 1)
+    # bind explicitly at the DEFAULT bucket's shapes (the largest):
+    # binding from whatever batch comes first would register wrong
+    # default shapes whenever the RNG never drew the max length
+    mod.bind(
+        data_shapes=[("data", (args.batch_size, buckets[-1], feat))],
+        label_shapes=[("softmax_label", (args.batch_size,))])
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="adam",
+                       optimizer_params={"learning_rate": args.lr})
     for epoch in range(args.epochs):
         metric = mx.metric.Accuracy()
         for blen, x, y in train:
             batch = to_batch(blen, x, y)
-            if not mod.binded:
-                mod.bind(data_shapes=batch.provide_data,
-                         label_shapes=batch.provide_label)
-                mod.init_params(mx.initializer.Xavier())
-                mod.init_optimizer(optimizer="adam",
-                                   optimizer_params={"learning_rate": args.lr})
             mod.forward_backward(batch)
             mod.update()
             mod.update_metric(metric, batch.label)

@@ -66,6 +66,23 @@ def main():
 
     kv.barrier()
 
+    # horovod-mode store over the SAME live jax.distributed backend:
+    # allreduce-only API must sum across both workers' devices too
+    # (HorovodKVStore inherits DistKVStore's global-mesh reduce)
+    hkv = kvstore.create("horovod")
+    assert hkv.num_workers == 2 and hkv.rank == rank
+    hvals = [nd.full((3,), float(rank * 2 + i + 1), ctx=c)
+             for i, c in enumerate(ctxs)]
+    hkv.pushpull("h", hvals, out=hvals)
+    assert np.allclose(hvals[0].asnumpy(), 10.0), hvals[0].asnumpy()
+    try:
+        hkv.push("h", hvals)
+        raise AssertionError("horovod push must raise")
+    except mx.base.MXNetError:
+        pass
+
+    kv.barrier()
+
     # sharded checkpoint across processes: each worker writes the shards
     # of a globally-sharded array; rank 0 reassembles (SURVEY §5.4
     # extension exercised multi-host)
