@@ -14,6 +14,11 @@ tables. Differences by design:
   counts so memory-bound elementwise ops report bandwidth (the number
   that matters on HBM) rather than a bare latency.
 
+Caveat: under a REMOTE device tunnel (axon dev environments) each
+eager op costs a network round trip, so per-op latencies measure the
+tunnel, not the chip — run this harness on hosts with local PJRT
+devices for meaningful accelerator numbers.
+
 Usage:
     python benchmark/opperf.py                   # default suite
     python benchmark/opperf.py --ops add,dot     # a subset

@@ -7,7 +7,9 @@ exists so reference scripts using ``mx.rnn.LSTMCell(...).unroll(...)``
 port unchanged.
 """
 from .rnn_cell import (BaseRNNCell, RNNCell, LSTMCell, GRUCell,
-                       SequentialRNNCell, DropoutCell, RNNParams)
+                       SequentialRNNCell, DropoutCell, RNNParams,
+                       ModifierCell, ResidualCell, BidirectionalCell)
 
 __all__ = ["BaseRNNCell", "RNNCell", "LSTMCell", "GRUCell",
-           "SequentialRNNCell", "DropoutCell", "RNNParams"]
+           "SequentialRNNCell", "DropoutCell", "RNNParams",
+           "ModifierCell", "ResidualCell", "BidirectionalCell"]

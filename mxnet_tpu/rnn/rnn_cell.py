@@ -13,7 +13,8 @@ from .. import symbol as sym
 from ..base import MXNetError
 
 __all__ = ["RNNParams", "BaseRNNCell", "RNNCell", "LSTMCell", "GRUCell",
-           "SequentialRNNCell", "DropoutCell"]
+           "SequentialRNNCell", "DropoutCell", "ModifierCell",
+           "ResidualCell", "BidirectionalCell"]
 
 
 class RNNParams:
@@ -300,3 +301,93 @@ class DropoutCell(BaseRNNCell):
         if self._dropout > 0:
             inputs = sym.Dropout(inputs, p=self._dropout)
         return inputs, states
+
+
+class ModifierCell(BaseRNNCell):
+    """Base for cells wrapping another cell (reference ModifierCell)."""
+
+    def __init__(self, base_cell):
+        super().__init__(prefix=base_cell._prefix, params=base_cell.params)
+        self.base_cell = base_cell
+
+    def reset(self):
+        super().reset()
+        if hasattr(self, "base_cell"):
+            self.base_cell.reset()
+
+    @property
+    def state_info(self):
+        return self.base_cell.state_info
+
+    def begin_state(self, func=None, **kwargs):
+        return self.base_cell.begin_state(func=func, **kwargs)
+
+
+class ResidualCell(ModifierCell):
+    """Adds the step input to the cell output (reference ResidualCell)."""
+
+    def __call__(self, inputs, states):
+        out, states = self.base_cell(inputs, states)
+        return out + inputs, states
+
+
+class BidirectionalCell(BaseRNNCell):
+    """Runs one cell forward and one backward over the sequence,
+    concatenating per-step outputs (reference BidirectionalCell).
+    Stepwise ``__call__`` is undefined for a bidirectional cell — use
+    ``unroll`` (the reference raises the same way)."""
+
+    def __init__(self, l_cell, r_cell, params=None, output_prefix="bi_"):
+        # params is accepted for reference-signature parity; the wrapped
+        # cells own their parameters
+        super().__init__(prefix=output_prefix, params=params)
+        self._l = l_cell
+        self._r = r_cell
+
+    def reset(self):
+        super().reset()
+        if hasattr(self, "_l"):
+            self._l.reset()
+            self._r.reset()
+
+    @property
+    def state_info(self):
+        return self._l.state_info + self._r.state_info
+
+    def begin_state(self, func=None, **kwargs):
+        return (self._l.begin_state(func=func, **kwargs)
+                + self._r.begin_state(func=func, **kwargs))
+
+    def __call__(self, inputs, states):
+        raise MXNetError("BidirectionalCell cannot be stepped; use unroll")
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        self.reset()
+        axis = layout.find("T")
+        if axis < 0:
+            raise MXNetError(f"invalid layout {layout!r}")
+        if not isinstance(inputs, (list, tuple)):
+            splitted = sym.split(inputs, num_outputs=length, axis=axis,
+                                 squeeze_axis=True)
+            inputs = [splitted[i] for i in range(length)]
+        if len(inputs) != length:
+            raise MXNetError(
+                f"got {len(inputs)} step inputs, expected {length}")
+        nl = len(self._l.state_info)
+        if begin_state is None:
+            l_states = r_states = None
+        else:
+            l_states, r_states = begin_state[:nl], begin_state[nl:]
+        l_out, l_states = self._l.unroll(length, list(inputs),
+                                         begin_state=l_states, layout=layout,
+                                         merge_outputs=False)
+        r_out, r_states = self._r.unroll(length, list(reversed(inputs)),
+                                         begin_state=r_states, layout=layout,
+                                         merge_outputs=False)
+        outputs = [sym.concat(lo, ro, dim=1)
+                   for lo, ro in zip(l_out, reversed(r_out))]
+        if merge_outputs:
+            outputs = sym.concat(*[sym.expand_dims(o, axis=axis)
+                                   for o in outputs], dim=axis)
+        return outputs, l_states + r_states

@@ -133,3 +133,38 @@ def test_lstm_forget_bias_baked_into_init():
     b = args["fb_h2h_bias"].asnumpy()
     assert np.allclose(b[H:2 * H], 1.0)       # forget gate slice
     assert np.allclose(b[:H], 0.0) and np.allclose(b[2 * H:], 0.0)
+
+
+def test_residual_and_bidirectional_cells():
+    """ResidualCell adds input to output; BidirectionalCell concats a
+    forward and a reversed pass (reference rnn_cell.py ModifierCell
+    family)."""
+    # residual: base RNNCell output + input (needs matching dims)
+    cell = mx.rnn.ResidualCell(mx.rnn.RNNCell(num_hidden=5, prefix="res_"))
+    data = mx.sym.Variable("data")
+    outputs, _ = cell.unroll(3, data, merge_outputs=True)
+    _, out_shapes, _ = outputs.infer_shape(data=(2, 3, 5))
+    assert out_shapes[0] == (2, 3, 5)
+
+    # the residual path really adds the input: zero weights -> tanh(0)=0
+    # -> output == input
+    args = {"data": mx.nd.array(np.ones((2, 3, 5), np.float32) * 0.3)}
+    for n in ("res_i2h_weight", "res_h2h_weight"):
+        args[n] = mx.nd.zeros((5, 5))
+    for n in ("res_i2h_bias", "res_h2h_bias"):
+        args[n] = mx.nd.zeros((5,))
+    exe = outputs.bind(mx.current_context(), args)
+    assert_almost_equal(exe.forward()[0].asnumpy(),
+                        np.ones((2, 3, 5), np.float32) * 0.3)
+
+    # bidirectional: output width = l + r hidden, states from both
+    bi = mx.rnn.BidirectionalCell(mx.rnn.GRUCell(4, prefix="f_"),
+                                  mx.rnn.GRUCell(6, prefix="b_"))
+    outputs, states = bi.unroll(3, mx.sym.Variable("data"),
+                                merge_outputs=True)
+    _, out_shapes, _ = outputs.infer_shape(data=(2, 3, 7))
+    assert out_shapes[0] == (2, 3, 10)
+    assert len(states) == 2
+    import pytest
+    with pytest.raises(mx.base.MXNetError):
+        bi(mx.sym.Variable("x"), [])
