@@ -580,7 +580,7 @@ def main_widedeep():
             batch=batch, dtype=DTYPE)
 
 
-if __name__ == "__main__":
+def _dispatch():
     _model = os.environ.get("BENCH_MODEL", "resnet50")
     if _model == "bert":
         main_bert()
@@ -590,3 +590,21 @@ if __name__ == "__main__":
         main_widedeep()
     else:
         main()
+
+
+if __name__ == "__main__":
+    try:
+        _dispatch()
+    except RuntimeError as e:
+        if "timing glitch" not in str(e) \
+                or os.environ.get("BENCH_NO_REEXEC") == "1":
+            raise
+        # the axon glitch poisons THIS process after a slow fresh
+        # compile, but that compile is now in the persistent cache — a
+        # fresh process measures sanely. Re-exec exactly once so the
+        # driver's single `python bench.py` still yields a real number.
+        import subprocess
+        print(f"# {e}; re-running in a fresh process", file=sys.stderr)
+        env = dict(os.environ, BENCH_NO_REEXEC="1")
+        raise SystemExit(subprocess.call(
+            [sys.executable, os.path.abspath(__file__)], env=env))

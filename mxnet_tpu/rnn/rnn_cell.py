@@ -88,6 +88,21 @@ class BaseRNNCell:
     def __call__(self, inputs, states):
         raise NotImplementedError
 
+    def _normalize_inputs(self, length, inputs, layout):
+        """One Symbol (split on the layout's T axis) or a per-step list
+        -> validated per-step list (shared by every unroll)."""
+        axis = layout.find("T")
+        if axis < 0:
+            raise MXNetError(f"invalid layout {layout!r}")
+        if not isinstance(inputs, (list, tuple)):
+            splitted = sym.split(inputs, num_outputs=length, axis=axis,
+                                 squeeze_axis=True)
+            inputs = [splitted[i] for i in range(length)]
+        if len(inputs) != length:
+            raise MXNetError(
+                f"got {len(inputs)} step inputs, expected {length}")
+        return list(inputs)
+
     def _zero_state_like(self, step_input):
         """Zero initial states derived from one step input symbol
         (keeps the batch dimension symbolically tied to the data)."""
@@ -110,14 +125,7 @@ class BaseRNNCell:
         """
         self.reset()
         axis = layout.find("T")
-        if axis < 0:
-            raise MXNetError(f"invalid layout {layout!r}")
-        if not isinstance(inputs, (list, tuple)):
-            splitted = sym.split(inputs, num_outputs=length, axis=axis,
-                                 squeeze_axis=True)
-            inputs = [splitted[i] for i in range(length)]
-        if len(inputs) != length:
-            raise MXNetError(f"got {len(inputs)} step inputs, expected {length}")
+        inputs = self._normalize_inputs(length, inputs, layout)
         if begin_state is None:
             # default: ZERO states built symbolically FROM the input
             # (batch dim rides along), so the unrolled graph is fully
@@ -330,6 +338,22 @@ class ResidualCell(ModifierCell):
         out, states = self.base_cell(inputs, states)
         return out + inputs, states
 
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        # delegate so unroll-only wrapped cells (BidirectionalCell)
+        # compose, as upstream ResidualCell.unroll does
+        self.reset()
+        axis = layout.find("T")
+        inputs = self._normalize_inputs(length, inputs, layout)
+        outputs, states = self.base_cell.unroll(
+            length, inputs, begin_state=begin_state, layout=layout,
+            merge_outputs=False)
+        outputs = [o + i for o, i in zip(outputs, inputs)]
+        if merge_outputs:
+            outputs = sym.concat(*[sym.expand_dims(o, axis=axis)
+                                   for o in outputs], dim=axis)
+        return outputs, states
+
 
 class BidirectionalCell(BaseRNNCell):
     """Runs one cell forward and one backward over the sequence,
@@ -365,15 +389,7 @@ class BidirectionalCell(BaseRNNCell):
                merge_outputs=None):
         self.reset()
         axis = layout.find("T")
-        if axis < 0:
-            raise MXNetError(f"invalid layout {layout!r}")
-        if not isinstance(inputs, (list, tuple)):
-            splitted = sym.split(inputs, num_outputs=length, axis=axis,
-                                 squeeze_axis=True)
-            inputs = [splitted[i] for i in range(length)]
-        if len(inputs) != length:
-            raise MXNetError(
-                f"got {len(inputs)} step inputs, expected {length}")
+        inputs = self._normalize_inputs(length, inputs, layout)
         nl = len(self._l.state_info)
         if begin_state is None:
             l_states = r_states = None

@@ -859,11 +859,31 @@ def _consistency_ctx_list():
     ("layernorm", lambda x, g, b: nd.LayerNorm(x, g, b),
      [(3, 8), (8,), (8,)], 2e-2),
     ("tanh_chain", lambda x: nd.tanh(nd.exp(x) * 0.3), [(4, 5)], None),
+    ("lrn", lambda x: nd.LRN(x, nsize=3), [(1, 5, 4, 4)], None),
 ])
 def test_check_consistency_f32_vs_bf16(case):
     name, fn, shapes, atol = case
     inputs = [RS.randn(*s).astype(np.float32) * 0.5 for s in shapes]
     check_consistency(fn, _consistency_ctx_list(), inputs, atol=atol)
+
+
+def test_check_consistency_stn_forward():
+    """STN forward f32 vs bf16 with the whole grid path in the leg's
+    dtype. FORWARD ONLY: bilinear-sampling gradients bucket by pixel
+    boundary, so a bf16 grid coordinate that rounds across a boundary
+    legitimately changes the gradient — grad comparison is
+    ill-conditioned for this op by construction."""
+    x = RS.randn(2, 2, 4, 4).astype(np.float32) * 0.5
+    t = RS.randn(2, 6).astype(np.float32) * 0.5
+
+    def fn(x, t):
+        ident = nd.Cast(nd.array(np.array([1, 0, 0, 0, 1, 0], np.float32)),
+                        dtype=str(t.dtype))
+        return nd.SpatialTransformer(x, nd.broadcast_add(t * 0.1, ident),
+                                     target_shape=(4, 4))
+
+    check_consistency(fn, _consistency_ctx_list(), [x, t], atol=2e-2,
+                      grad_check=False)
 
 
 # ---------------------------------------------------------------------------

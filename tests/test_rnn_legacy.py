@@ -168,3 +168,17 @@ def test_residual_and_bidirectional_cells():
     import pytest
     with pytest.raises(mx.base.MXNetError):
         bi(mx.sym.Variable("x"), [])
+
+
+def test_residual_wraps_bidirectional():
+    """ResidualCell.unroll delegates to base_cell.unroll, so it composes
+    with unroll-only cells (reference ResidualCell.unroll contract)."""
+    bi = mx.rnn.BidirectionalCell(mx.rnn.RNNCell(3, prefix="f2_"),
+                                  mx.rnn.RNNCell(3, prefix="b2_"))
+    res = mx.rnn.ResidualCell(bi)
+    outputs, _ = res.unroll(2, mx.sym.Variable("data"), merge_outputs=True)
+    _, out_shapes, _ = outputs.infer_shape(data=(2, 2, 6))
+    assert out_shapes[0] == (2, 2, 6)  # 3+3 concat + residual add
+    import pytest
+    with pytest.raises(mx.base.MXNetError):
+        res.unroll(2, mx.sym.Variable("data"), layout="NC")
