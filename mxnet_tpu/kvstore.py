@@ -386,19 +386,34 @@ class HorovodKVStore(DistKVStore):
         KVStore.pull(self, key, out if out is not None else value, priority)
 
     def broadcast(self, key, value, out=None, priority=0):
-        """hvd.broadcast_parameters analog: the root's CURRENT value
-        wins — the store is overwritten on every call (upstream
-        re-transmits each time; serving a stale stored value would
-        silently drop updates). SPMD construction makes every process
-        hold identical initialized values, so no bytes cross hosts."""
+        """hvd.broadcast_parameters analog: the ROOT's (process 0's)
+        CURRENT value wins — the store is overwritten on every call
+        (upstream re-transmits each time; serving a stale stored value
+        would silently drop updates). With num_workers > 1 the bytes
+        really cross hosts via ``multihost_utils.broadcast_one_to_all``
+        so rank-dependent initialization / rank-0-only checkpoint
+        restores converge instead of silently diverging per worker."""
         keys, values = _normalize(key, value)
-        for k, v in zip(keys, values):
-            vs = v if isinstance(v, (list, tuple)) else [v]
+        firsts = [v[0] if isinstance(v, (list, tuple)) else v
+                  for v in values]
+        datas = [f._data for f in firsts]
+        if self.num_workers > 1:
+            from jax.experimental import multihost_utils
+            # one pytree collective for the whole key list — N keys
+            # cost one DCN round trip, not N host-synced ones
+            datas = list(multihost_utils.broadcast_one_to_all(tuple(datas)))
+        for k, f, new in zip(keys, firsts, datas):
             if k in self._store:
                 stored = self._store[k]
-                stored._set_data(vs[0]._data.astype(stored.dtype))
+                if new.dtype != stored.dtype:
+                    new = new.astype(stored.dtype)
+                # pin onto the stored replica's device (mirrors the
+                # _try_fused_pushpull read-back path) so the store can't
+                # drift off-device and decline the fused fast path later
+                stored._set_data(jax.device_put(new, stored._data.device))
             else:
-                self._store[k] = vs[0].copy()
+                self._store[k] = _wrap(jax.device_put(new, f._data.device),
+                                       f.ctx)
         if out is not None:
             _, outs = _normalize(key, out)
             for k, o in zip(keys, outs):

@@ -102,7 +102,7 @@ def amp_cast(x, dtype="float32"):
     return x.astype(dtype_np(dtype))
 
 
-@register_op("amp_multicast", wrap=False)
+@register_op("amp_multicast", wrap=False, dynamic_arity=True)
 def amp_multicast(*xs, num_outputs=None, cast_narrow=False):
     dts = [x.dtype for x in xs]
     widths = [jnp.dtype(d).itemsize for d in dts]
@@ -603,7 +603,8 @@ def stack(*args, axis=0, num_args=None):
     return jnp.stack(args, axis=int(axis))
 
 
-@register_op("split", aliases=("SliceChannel",), wrap=False)
+@register_op("split", aliases=("SliceChannel",), wrap=False,
+             dynamic_arity=True)
 def split(x, num_outputs=1, axis=1, squeeze_axis=False):
     parts = jnp.split(x, int(num_outputs), axis=int(axis))
     if squeeze_axis:

@@ -63,10 +63,10 @@ class Op:
     """
 
     __slots__ = ("name", "fn", "differentiable", "aliases",
-                 "num_visible_outputs", "mutates")
+                 "num_visible_outputs", "mutates", "dynamic_arity")
 
     def __init__(self, name, fn, differentiable=True, aliases=(),
-                 num_visible_outputs=None, mutates=()):
+                 num_visible_outputs=None, mutates=(), dynamic_arity=False):
         self.name = name
         self.fn = fn
         self.differentiable = differentiable
@@ -76,13 +76,19 @@ class Op:
         # the reference's kWriteInplace/aux-state mutation (optimizer ops
         # update mom/mean/var inputs; see op_impl_optimizer.py)
         self.mutates = tuple(mutates)
+        # True only for ops whose ``num_outputs`` kwarg IS the output
+        # count (split/SliceChannel, amp_multicast); gates the symbolic
+        # arity override so an unrelated param named num_outputs on a
+        # future op can't silently mis-route sym[i] indexing
+        self.dynamic_arity = bool(dynamic_arity)
 
     def __repr__(self):
         return f"<Op {self.name}>"
 
 
 def register_op(name=None, *, differentiable=True, aliases=(),
-                num_visible_outputs=None, mutates=(), wrap=True):
+                num_visible_outputs=None, mutates=(), wrap=True,
+                dynamic_arity=False):
     """Decorator: register a JAX function as an operator.
 
     ``wrap=False`` registers the op but does not expose a generated
@@ -92,7 +98,8 @@ def register_op(name=None, *, differentiable=True, aliases=(),
     def deco(fn):
         op_name = name or fn.__name__
         op = Op(op_name, fn, differentiable=differentiable, aliases=aliases,
-                num_visible_outputs=num_visible_outputs, mutates=mutates)
+                num_visible_outputs=num_visible_outputs, mutates=mutates,
+                dynamic_arity=dynamic_arity)
         _OPS[op_name] = op
         for a in aliases:
             _OPS[a] = op

@@ -605,11 +605,14 @@ def _make_node(op, inputs, params, name=None):
     nout = 1
     if op.num_visible_outputs is not None:
         nout = op.num_visible_outputs
-    if "num_outputs" in params:
-        # dynamic-arity ops (split/SliceChannel/amp_multicast): the
-        # output count IS the param — without this, sym[0] on a split
-        # returns the whole tuple-producing node and the consumer gets
-        # every output splatted as positional inputs
+    if "num_outputs" in params and getattr(op, "dynamic_arity", False):
+        # dynamic-arity ops (split/SliceChannel/amp_multicast, flagged
+        # dynamic_arity=True at registration): the output count IS the
+        # param — without this, sym[0] on a split returns the whole
+        # tuple-producing node and the consumer gets every output
+        # splatted as positional inputs. Ops without the flag keep
+        # their registered arity even if a param happens to share the
+        # name.
         try:
             nout = int(params["num_outputs"])
         except (TypeError, ValueError):

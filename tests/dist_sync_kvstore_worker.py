@@ -81,6 +81,14 @@ def main():
     except mx.base.MXNetError:
         pass
 
+    # broadcast with rank-DIVERGENT inputs: process 0's value must win
+    # on every worker (upstream hvd.broadcast_parameters semantics —
+    # rank-0-only checkpoint restores rely on this; ADVICE r2 medium)
+    bval = nd.full((4,), float(100 * (rank + 1)))
+    bout = nd.zeros((4,))
+    hkv.broadcast("b", bval, out=bout)
+    assert np.allclose(bout.asnumpy(), 100.0), bout.asnumpy()
+
     kv.barrier()
 
     # sharded checkpoint across processes: each worker writes the shards
