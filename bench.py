@@ -60,32 +60,63 @@ def _peak_tflops():
     return 0.0  # unknown (CPU dev runs): mfu reported as 0
 
 
-def _step_flops(step, *args):
-    """HLO flop count of one compiled train step (XLA cost analysis)."""
+def _peak_hbm_gbps():
+    """Per-chip peak HBM bandwidth GB/s (override with
+    MXNET_TPU_PEAK_HBM_GBPS). Sources: public TPU specs."""
+    import jax
+
+    env = os.environ.get("MXNET_TPU_PEAK_HBM_GBPS")
+    if env:
+        return float(env)
+    kind = jax.devices()[0].device_kind.lower()
+    for tag, peak in (("v6e", 1640.0), ("v6", 1640.0), ("v5p", 2765.0),
+                      ("v5e", 819.0), ("v5 lite", 819.0), ("v4", 1228.0),
+                      ("v3", 900.0), ("v2", 700.0)):
+        if tag in kind:
+            return peak
+    return 0.0
+
+
+def _step_cost(step, *args):
+    """(flops, bytes_accessed) of one compiled step (XLA cost analysis).
+    bytes_accessed counts every operand+output touch XLA models — an
+    upper bound on true HBM traffic (re-reads that hit VMEM/fusion are
+    still counted), so achieved-GB/s derived from it is conservative-
+    high; good enough to tell "gather-bound" from "far off roofline"."""
     import jax
 
     try:
         cost = step.lower(*args).compile().cost_analysis()
         if isinstance(cost, (list, tuple)):
             cost = cost[0]
-        return float(cost.get("flops", 0.0))
+        return (float(cost.get("flops", 0.0)),
+                float(cost.get("bytes accessed", 0.0)))
     except Exception:
-        return 0.0
+        return 0.0, 0.0
 
 
 def _report(metric, value, unit, vs_baseline, flops_per_step=0.0,
-            sec_per_step=0.0, **extras):
+            sec_per_step=0.0, bytes_per_step=0.0, **extras):
     """One JSON line for the driver; mfu measures against the chip's
     peak (VERDICT round-1: progress is vs the hardware, not a ghost
-    GPU number)."""
+    GPU number). When bytes_per_step is known the achieved HBM GB/s
+    and fraction of peak bandwidth print too, so memory-bound configs
+    (Wide&Deep gathers) are judged against the right roofline."""
     rec = {"metric": metric, "value": round(value, 2), "unit": unit,
            "vs_baseline": round(vs_baseline, 3)}
     peak = _peak_tflops()
     if flops_per_step and sec_per_step and peak:
         rec["mfu"] = round(flops_per_step / sec_per_step / (peak * 1e12), 4)
         rec["tflops_per_sec"] = round(flops_per_step / sec_per_step / 1e12, 1)
+    hbm_peak = _peak_hbm_gbps()
+    if bytes_per_step and sec_per_step:
+        gbs = bytes_per_step / sec_per_step / 1e9
+        rec["hbm_gbs"] = round(gbs, 1)
+        if hbm_peak:
+            rec["hbm_frac"] = round(gbs / hbm_peak, 4)
     rec.update(extras)
     print(json.dumps(rec))
+    sys.stdout.flush()
 
 
 def _make_momentum_sgd(loss_fn, lr):
@@ -225,13 +256,7 @@ def main():
                 net(warm)  # re-trace materializes int8 weights
         fn, params = functionalize(net, training=False, ctx=ctx)
         infer = jax.jit(lambda p, rng, x: fn(p, rng, x))
-        iflops = 0.0
-        try:
-            c = infer.lower(params, rng, x).compile().cost_analysis()
-            iflops = float((c[0] if isinstance(c, (list, tuple)) else c)
-                           .get("flops", 0.0))
-        except Exception:
-            pass
+        iflops, ibytes = _step_cost(infer, params, rng, x)
         def timed_infer():
             t0 = time.perf_counter()
             for _ in range(STEPS):
@@ -245,11 +270,11 @@ def main():
         dt = _guard_impossible(timed_infer, iflops)
         _report("resnet50_infer_images_per_sec_per_chip", BATCH * STEPS / dt,
                 "images/sec/chip", 0.0, flops_per_step=iflops,
-                sec_per_step=dt / STEPS, batch=BATCH,
-                dtype="int8" if int8 else DTYPE)
+                sec_per_step=dt / STEPS, bytes_per_step=ibytes,
+                batch=BATCH, dtype="int8" if int8 else DTYPE)
         return
 
-    flops = _step_flops(step, params, moms, rng, x, y)
+    flops, nbytes = _step_cost(step, params, moms, rng, x, y)
 
     if os.environ.get("BENCH_DATA") == "recordio":
         _resnet_from_recordio(loss_fn, params, moms, rng, flops)
@@ -261,7 +286,7 @@ def main():
     _report("resnet50_train_images_per_sec_per_chip", imgs_per_sec,
             "images/sec/chip", imgs_per_sec / BASELINE_IMGS_PER_SEC,
             flops_per_step=flops, sec_per_step=dt / STEPS,
-            batch=BATCH, dtype=DTYPE,
+            bytes_per_step=nbytes, batch=BATCH, dtype=DTYPE,
             conv_nhwc=os.environ.get("MXNET_TPU_CONV_NHWC", "0") == "1",
             s2d_stem=s2d)
 
@@ -440,14 +465,14 @@ def main_bert():
     tt = jnp.zeros((batch, seqlen), jnp.int32)
     labels = jnp.asarray(npr.randint(0, vocab, (batch, seqlen)), jnp.int32)
 
-    flops = _step_flops(step, ps, moms, rng, ids, tt, labels)
+    flops, nbytes = _step_cost(step, ps, moms, rng, ids, tt, labels)
     dt = _time_steps(step, ps, moms, rng, ids, tt, labels, flops_per_step=flops)
 
     tok_per_sec = batch * seqlen * STEPS / dt
     _report("bert_base_train_tokens_per_sec_per_chip", tok_per_sec,
             "tokens/sec/chip", 0.0,
             flops_per_step=flops, sec_per_step=dt / STEPS,
-            batch=batch, seqlen=seqlen, dtype=DTYPE)
+            bytes_per_step=nbytes, batch=batch, seqlen=seqlen, dtype=DTYPE)
 
 
 def main_lstm():
@@ -514,14 +539,14 @@ def main_lstm():
     ids = jnp.asarray(npr.randint(0, vocab, (batch, seqlen)), jnp.int32)
     labels = jnp.asarray(npr.randint(0, vocab, (batch, seqlen)), jnp.int32)
 
-    flops = _step_flops(step, params, moms, rng, ids, labels)
+    flops, nbytes = _step_cost(step, params, moms, rng, ids, labels)
     dt = _time_steps(step, params, moms, rng, ids, labels, flops_per_step=flops)
 
     tok_per_sec = batch * seqlen * STEPS / dt
     _report("lstm_lm_train_tokens_per_sec_per_chip", tok_per_sec,
             "tokens/sec/chip", 0.0,
             flops_per_step=flops, sec_per_step=dt / STEPS,
-            batch=batch, seqlen=seqlen, dtype=DTYPE)
+            bytes_per_step=nbytes, batch=batch, seqlen=seqlen, dtype=DTYPE)
 
 
 def main_widedeep():
@@ -570,19 +595,57 @@ def main_widedeep():
     ct = jnp.asarray(npr.rand(batch, n_cont), jnp.float32)
     y = jnp.asarray(npr.randint(0, 2, batch), jnp.int32)
 
-    flops = _step_flops(step, params, moms, rng, wx, cx, ct, y)
+    flops, nbytes = _step_cost(step, params, moms, rng, wx, cx, ct, y)
     dt = _time_steps(step, params, moms, rng, wx, cx, ct, y, flops_per_step=flops)
 
     ex_per_sec = batch * STEPS / dt
     _report("wide_deep_train_examples_per_sec_per_chip", ex_per_sec,
             "examples/sec/chip", 0.0,
             flops_per_step=flops, sec_per_step=dt / STEPS,
-            batch=batch, dtype=DTYPE)
+            bytes_per_step=nbytes, batch=batch, dtype=DTYPE)
+
+
+# The five BASELINE acceptance configs (+ long-seq BERT and predict-mode
+# inference), each run in its OWN subprocess: an axon timing glitch after
+# a slow fresh compile poisons a whole process, so per-config isolation
+# keeps one bad compile from corrupting the rest of the suite. ResNet
+# train runs LAST so the driver's parsed-last-line headline stays the
+# north-star metric.
+_SUITE = (
+    ("bert", {}),
+    ("bert", {"BENCH_SEQLEN": "512", "BENCH_BATCH": "64"}),
+    ("lstm", {}),
+    ("widedeep", {}),
+    ("resnet50", {"BENCH_INFER": "1"}),
+    ("resnet50", {}),
+)
+
+
+def main_suite():
+    """Default `python bench.py`: emit ALL acceptance configs as JSON
+    lines (VERDICT r2 #8 — BENCH_rN.json should record the whole suite,
+    not just ResNet). A config failure prints to stderr and the suite
+    continues; exit is nonzero only if the final (headline) config
+    failed."""
+    import subprocess
+
+    rc = 1
+    for model, extra in _SUITE:
+        env = dict(os.environ, BENCH_MODEL=model, **extra)
+        r = subprocess.call([sys.executable, os.path.abspath(__file__)],
+                            env=env)
+        if r != 0:
+            print(f"# bench config {model} {extra} failed rc={r}",
+                  file=sys.stderr)
+        rc = r
+    raise SystemExit(rc)
 
 
 def _dispatch():
-    _model = os.environ.get("BENCH_MODEL", "resnet50")
-    if _model == "bert":
+    _model = os.environ.get("BENCH_MODEL")
+    if _model is None:
+        main_suite()
+    elif _model == "bert":
         main_bert()
     elif _model == "lstm":
         main_lstm()

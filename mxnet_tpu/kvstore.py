@@ -403,6 +403,11 @@ class HorovodKVStore(DistKVStore):
             # cost one DCN round trip, not N host-synced ones
             datas = list(multihost_utils.broadcast_one_to_all(tuple(datas)))
         for k, f, new in zip(keys, firsts, datas):
+            if self.num_workers == 1:
+                # single-worker: ``new`` IS the caller's buffer and the
+                # device_put below may alias it — the store must own a
+                # copy (the caller may later donate its own buffer)
+                new = new.copy()
             if k in self._store:
                 stored = self._store[k]
                 if new.dtype != stored.dtype:

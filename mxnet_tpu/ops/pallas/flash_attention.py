@@ -46,6 +46,26 @@ def _dot_precision(dtype):
             else lax.Precision.DEFAULT)
 
 
+def _pair_mask(i, j, causal, q_offset, kv_len, block_q, block_k):
+    """Validity mask for the (i, j) score block, or None when every
+    position is statically visible (no kv padding, not causal) — the
+    common BERT shape skips the iota/where entirely."""
+    nk_pad = kv_len % block_k != 0  # padded tail block exists
+    mask = None
+    if nk_pad:
+        col = j * block_k + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = col < kv_len
+    if causal:
+        col = j * block_k + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        row = i * block_q + q_offset + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        cm = col <= row
+        mask = cm if mask is None else jnp.logical_and(mask, cm)
+    return mask
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                 acc_sc, m_sc, l_sc, *,
                 sm_scale, causal, q_offset, kv_len, block_q, block_k,
@@ -66,20 +86,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
 
     @pl.when(visible)
     def _():
+        # q arrives pre-scaled by sm_scale (host side) so no per-pair
+        # (block_q, block_k) elementwise scale runs on the VPU
         q = q_ref[0].astype(jnp.float32)
         k = k_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32, precision=precision) * sm_scale
+            preferred_element_type=jnp.float32, precision=precision)
 
-        col = j * block_k + lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1)
-        mask = col < kv_len
-        if causal:
-            row = i * block_q + q_offset + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            mask = jnp.logical_and(mask, col <= row)
-        s = jnp.where(mask, s, _NEG_INF)
+        mask = _pair_mask(i, j, causal, q_offset, kv_len, block_q, block_k)
+        if mask is not None:
+            s = jnp.where(mask, s, _NEG_INF)
 
         m_prev = m_sc[:]
         m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
@@ -131,26 +148,23 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32, precision=precision) * sm_scale
-        col = j * block_k + lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1)
-        mask = col < kv_len
-        if causal:
-            row = i * block_q + q_offset + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            mask = jnp.logical_and(mask, col <= row)
-        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+            preferred_element_type=jnp.float32, precision=precision)
+        mask = _pair_mask(i, j, causal, q_offset, kv_len, block_q, block_k)
+        p = jnp.exp(s - lse) if mask is None \
+            else jnp.where(mask, jnp.exp(s - lse), 0.0)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32, precision=precision)
-        ds = p * (dp - delta) * sm_scale
+        ds = p * (dp - delta)
         dq_sc[:] = dq_sc[:] + jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32, precision=precision)
 
     @pl.when(j == nk - 1)
     def _():
-        dq_ref[0] = dq_sc[:].astype(dq_ref.dtype)
+        # dq is wrt the ORIGINAL q: rescale once on the small (bq, d)
+        # block (q was pre-scaled; ds here is wrt unscaled scores)
+        dq_ref[0] = (dq_sc[:] * sm_scale).astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -180,15 +194,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32, precision=precision) * sm_scale
-        col = j * block_k + lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1)
-        mask = col < kv_len
-        if causal:
-            row = i * block_q + q_offset + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            mask = jnp.logical_and(mask, col <= row)
-        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+            preferred_element_type=jnp.float32, precision=precision)
+        mask = _pair_mask(i, j, causal, q_offset, kv_len, block_q, block_k)
+        p = jnp.exp(s - lse) if mask is None \
+            else jnp.where(mask, jnp.exp(s - lse), 0.0)
 
         dv_sc[:] = dv_sc[:] + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
@@ -196,10 +205,80 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32, precision=precision)
-        ds = p * (dp - delta) * sm_scale
+        ds = p * (dp - delta)
         dk_sc[:] = dk_sc[:] + jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32, precision=precision)
+
+    @pl.when(i == nq - 1)
+    def _():
+        dk_ref[0] = dk_sc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_sc[:].astype(dv_ref.dtype)
+
+
+def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dq_ref, dk_ref, dv_ref, dk_sc, dv_sc, *,
+                      sm_scale, causal, q_offset, kv_len, block_q, block_k,
+                      precision):
+    """One-pass backward: dq, dk, dv from a SINGLE traversal of the
+    (q block, k block) grid — the score matrix s and dp are computed
+    once per pair instead of once in a dq kernel and again in a dkv
+    kernel (VERDICT r2 #2: 7 block-matmuls per pair drop to 5, and
+    q/do/lse/delta stream through VMEM once, not twice).
+
+    Grid (BH, nk, nq): k outer so dk/dv accumulate in VMEM scratch;
+    each (j, i) step owns a distinct dq partial block (no output
+    revisiting, so no read-modify-write hazard with Pallas's input
+    prefetch pipeline) and the per-k-block partials are summed by XLA
+    outside the kernel.
+    """
+    j, i = pl.program_id(1), pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(i == 0)
+    def _():
+        dk_sc[:] = jnp.zeros_like(dk_sc)
+        dv_sc[:] = jnp.zeros_like(dv_sc)
+
+    q_last = (i + 1) * block_q - 1 + q_offset
+    visible = jnp.logical_or(not causal, j * block_k <= q_last)
+
+    @pl.when(visible)
+    def _():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]
+        delta = delta_ref[0]
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=precision)
+        mask = _pair_mask(i, j, causal, q_offset, kv_len, block_q, block_k)
+        p = jnp.exp(s - lse) if mask is None \
+            else jnp.where(mask, jnp.exp(s - lse), 0.0)
+
+        dv_sc[:] = dv_sc[:] + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=precision)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=precision)
+        ds = p * (dp - delta)
+        dk_sc[:] = dk_sc[:] + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=precision)
+        dq_ref[0, 0] = (jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=precision) * sm_scale).astype(dq_ref.dtype)
+
+    @pl.when(jnp.logical_not(visible))
+    def _():
+        # causally-skipped pair: this step still owns its dq partial
+        # block — zero it (output buffers start uninitialized)
+        dq_ref[0, 0] = jnp.zeros_like(dq_ref[0, 0])
 
     @pl.when(i == nq - 1)
     def _():
@@ -212,15 +291,17 @@ def _pad_len(s, block):
 
 
 def _pick_blocks(sq, skv):
-    # v5e-measured defaults (BASELINE.md round-3 sweep, seq512):
-    # 128x128 -> 65.5k tok/s (b16), 512x256 -> 96.6k, 512x512 -> 102.7k
-    # (+57%; b64 103.1k = 38.3% MFU) — large tiles amortize the
-    # (q, do, lse, delta) reloads across the k loop in the backward
-    # kernels. VMEM at 512x512 f32 scores (d<=128) stays under the
-    # ~16 MB budget. Override per run with MXNET_TPU_FLASH_BLOCK_Q/K.
+    # v5e-measured defaults (BASELINE.md round-3/4 sweeps): 512-wide q
+    # tiles with the k tile as large as fits (cap 2048) — at seq2048
+    # the single-k-block grid (512x2048) measured 87.6k tok/s vs 74.8k
+    # at 512x512 (+17%): k/v stay resident, the fused backward needs no
+    # dq partial-sum, and (q, do, lse, delta) reloads amortize across
+    # the whole row. VMEM: the f32 score block is bq*bk*4 = 4 MB at
+    # 512x2048 (d<=128 keeps operand blocks ~1 MB), inside the ~16 MB
+    # budget. Override per run with MXNET_TPU_FLASH_BLOCK_Q/K.
     import os
     bq_cap = int(os.environ.get("MXNET_TPU_FLASH_BLOCK_Q", "512"))
-    bk_cap = int(os.environ.get("MXNET_TPU_FLASH_BLOCK_K", "512"))
+    bk_cap = int(os.environ.get("MXNET_TPU_FLASH_BLOCK_K", "2048"))
     bq = min(bq_cap, _pad_len(sq, 8))
     bk = min(bk_cap, _pad_len(skv, 128))
     return bq, bk
@@ -236,7 +317,9 @@ def _flash_fwd(q, k, v, sm_scale, causal, q_offset, interpret,
     block_k = block_k or bk0
     sq_p, skv_p = _pad_len(sq, block_q), _pad_len(skv, block_k)
 
-    qf = q.reshape(b * h, sq, d)
+    # pre-scale q so the kernels never run the (block_q, block_k)
+    # elementwise *sm_scale (dq is rescaled on its small output block)
+    qf = (q * sm_scale).astype(q.dtype).reshape(b * h, sq, d)
     kf = k.reshape(b * h, skv, d)
     vf = v.reshape(b * h, skv, d)
     if sq_p != sq:
@@ -286,7 +369,9 @@ def _flash_fwd(q, k, v, sm_scale, causal, q_offset, interpret,
 
 @x32
 def _flash_bwd(q, k, v, o, lse, do, sm_scale, causal, q_offset, interpret,
-               block_q=None, block_k=None):
+               block_q=None, block_k=None, dlse=None):
+    import os
+
     b, h, sq, d = q.shape
     skv = k.shape[2]
     bq0, bk0 = _pick_blocks(sq, skv)
@@ -297,7 +382,13 @@ def _flash_bwd(q, k, v, o, lse, do, sm_scale, causal, q_offset, interpret,
 
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1).reshape(bh, sq, 1)
-    qf = q.reshape(bh, sq, d)
+    if dlse is not None:
+        # d lse/d s = p, so the lse cotangent enters ds = p*(dp - delta)
+        # as delta_eff = delta - dlse (one extra subtract, no new kernel)
+        delta = delta - dlse.astype(jnp.float32).reshape(bh, sq, 1)
+    # pre-scaled q (matches forward): s = q'k^T directly; dk = ds^T q'
+    # IS the original-k gradient, dq rescales by sm_scale at the write
+    qf = (q * sm_scale).astype(q.dtype).reshape(bh, sq, d)
     kf = k.reshape(bh, skv, d)
     vf = v.reshape(bh, skv, d)
     dof = do.reshape(bh, sq, d)
@@ -319,6 +410,83 @@ def _flash_bwd(q, k, v, o, lse, do, sm_scale, causal, q_offset, interpret,
                   kv_len=skv, block_q=block_q, block_k=block_k,
                   precision=_dot_precision(q.dtype))
 
+    # the fused pass writes nk f32 dq-partial copies to HBM; past nk=2
+    # that memory/write cliff outweighs the recompute saving, so long
+    # multi-k-block rows (S > 2*block_k cap) take the split path whose
+    # dq accumulates in VMEM scratch
+    if nk <= 2 and os.environ.get("MXNET_TPU_FLASH_SPLIT_BWD", "0") != "1":
+        return _flash_bwd_fused(qf, kf, vf, dof, lsef, delta,
+                                (b, h, sq, skv, d), nq, nk, common,
+                                interpret, k.dtype, v.dtype, q.dtype)
+    return _flash_bwd_split(qf, kf, vf, dof, lsef, delta,
+                            (b, h, sq, skv, d), nq, nk, common,
+                            interpret, k.dtype, v.dtype, q.dtype)
+
+
+def _flash_bwd_fused(qf, kf, vf, dof, lsef, delta, dims, nq, nk, common,
+                     interpret, k_dtype, v_dtype, q_dtype):
+    """Single-pass dq/dk/dv (default; MXNET_TPU_FLASH_SPLIT_BWD=1
+    selects the two-kernel path for A/B and as a fallback)."""
+    b, h, sq, skv, d = dims
+    bh = b * h
+    block_q, block_k = common["block_q"], common["block_k"]
+    sq_p, skv_p = nq * block_q, nk * block_k
+
+    dq_part, dk, dv = pl.pallas_call(
+        functools.partial(_bwd_fused_kernel, **common),
+        grid=(bh, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b_, j, i: (b_, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda b_, j, i: (b_, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda b_, j, i: (b_, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, d), lambda b_, j, i: (b_, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, 1), lambda b_, j, i: (b_, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, 1), lambda b_, j, i: (b_, i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda b_, j, i: (b_, j, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda b_, j, i: (b_, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda b_, j, i: (b_, j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            # f32 partials: the cross-k-block sum happens outside the
+            # kernel in f32, then casts once to the caller dtype
+            jax.ShapeDtypeStruct((bh, nk, sq_p, d), jnp.float32),
+            jax.ShapeDtypeStruct((bh, skv_p, d), k_dtype),
+            jax.ShapeDtypeStruct((bh, skv_p, d), v_dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lsef, delta)
+
+    dq = dq_part.sum(axis=1).astype(q_dtype) if nk > 1 \
+        else dq_part[:, 0].astype(q_dtype)
+    dq = dq[:, :sq].reshape(b, h, sq, d)
+    dk = dk[:, :skv].reshape(b, h, skv, d)
+    dv = dv[:, :skv].reshape(b, h, skv, d)
+    return dq, dk, dv
+
+
+def _flash_bwd_split(qf, kf, vf, dof, lsef, delta, dims, nq, nk, common,
+                     interpret, k_dtype, v_dtype, q_dtype):
+    b, h, sq, skv, d = dims
+    bh = b * h
+    block_q, block_k = common["block_q"], common["block_k"]
+    sq_p, skv_p = nq * block_q, nk * block_k
+
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, **common),
         grid=(bh, nq, nk),
@@ -338,7 +506,7 @@ def _flash_bwd(q, k, v, o, lse, do, sm_scale, causal, q_offset, interpret,
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b_, i, j: (b_, i, 0),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((bh, sq_p, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((bh, sq_p, d), q_dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
     )(qf, kf, vf, dof, lsef, delta)
@@ -367,8 +535,8 @@ def _flash_bwd(q, k, v, o, lse, do, sm_scale, causal, q_offset, interpret,
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, skv_p, d), k.dtype),
-            jax.ShapeDtypeStruct((bh, skv_p, d), v.dtype),
+            jax.ShapeDtypeStruct((bh, skv_p, d), k_dtype),
+            jax.ShapeDtypeStruct((bh, skv_p, d), v_dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),
@@ -378,23 +546,47 @@ def _flash_bwd(q, k, v, o, lse, do, sm_scale, causal, q_offset, interpret,
     )(qf, kf, vf, dof, lsef, delta)
 
     dq = dq[:, :sq].reshape(b, h, sq, d)
-    dk = dk[:, :skv].reshape(*k.shape)
-    dv = dv[:, :skv].reshape(*v.shape)
+    dk = dk[:, :skv].reshape(b, h, skv, d)
+    dv = dv[:, :skv].reshape(b, h, skv, d)
     return dq, dk, dv
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def flash_attention_with_lse(q, k, v, sm_scale=None, causal=False,
                              q_offset=0, interpret=None):
-    """Forward-only flash attention returning (out, lse).
+    """Flash attention returning (out, lse) — DIFFERENTIABLE in both
+    outputs (the lse cotangent folds into the backward's delta term).
 
-    lse has shape (B, H, Sq), fp32 — the ring-attention combiner state.
-    Not differentiable through JAX autodiff (use flash_attention); ring
-    attention defines its own VJP over the combined result.
+    lse has shape (B, H, Sq), fp32 — the combiner state blockwise/ring
+    schemes need; ring_attention folds per-chunk (out, lse) pairs with
+    the log-sum-exp combiner and lets gradients flow through both.
     """
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
     return _flash_fwd(q, k, v, sm_scale, bool(causal), int(q_offset),
                       resolve_interpret(interpret))
+
+
+def _flash_lse_vjp_fwd(q, k, v, sm_scale, causal, q_offset, interpret):
+    if sm_scale is None:
+        sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    o, lse = _flash_fwd(q, k, v, sm_scale, bool(causal), int(q_offset),
+                        resolve_interpret(interpret))
+    return (o, lse), (q, k, v, o, lse)
+
+
+def _flash_lse_vjp_bwd(sm_scale, causal, q_offset, interpret, res, cts):
+    q, k, v, o, lse = res
+    do, dlse = cts
+    if sm_scale is None:
+        sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    dq, dk, dv = _flash_bwd(q, k, v, o, lse, do, sm_scale, bool(causal),
+                            int(q_offset), resolve_interpret(interpret),
+                            dlse=dlse)
+    return dq, dk, dv
+
+
+flash_attention_with_lse.defvjp(_flash_lse_vjp_fwd, _flash_lse_vjp_bwd)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))

@@ -238,3 +238,59 @@ def test_flash_attention_large_asymmetric_blocks(monkeypatch):
     for a, c in zip(g, gr):
         np.testing.assert_allclose(np.asarray(a), np.asarray(c),
                                    rtol=RTOL, atol=ATOL)
+
+
+def test_flash_attention_fused_vs_split_bwd(monkeypatch):
+    """The single-pass backward (default) and the two-kernel path
+    (MXNET_TPU_FLASH_SPLIT_BWD=1) must produce identical gradients on a
+    genuine multi-block grid (nq=3, nk=3 at 384/128x128 tiles), causal
+    and not, with ragged padding (S=330)."""
+    monkeypatch.setenv("MXNET_TPU_FLASH_BLOCK_Q", "128")
+    monkeypatch.setenv("MXNET_TPU_FLASH_BLOCK_K", "128")
+    rng = np.random.RandomState(7)
+    B, H, S, D = 1, 2, 330, 64
+    q = jnp.asarray(rng.randn(B, H, S, D).astype(np.float32)) * 0.3
+    k = jnp.asarray(rng.randn(B, H, S, D).astype(np.float32)) * 0.3
+    v = jnp.asarray(rng.randn(B, H, S, D).astype(np.float32))
+    w = jnp.asarray(rng.randn(B, H, S, D).astype(np.float32))
+
+    for causal in (False, True):
+        def f(q, k, v):
+            return (flash_attention(q, k, v, None, causal, 0, True) * w).sum()
+
+        monkeypatch.setenv("MXNET_TPU_FLASH_SPLIT_BWD", "0")
+        g_fused = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+        monkeypatch.setenv("MXNET_TPU_FLASH_SPLIT_BWD", "1")
+        g_split = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+        monkeypatch.delenv("MXNET_TPU_FLASH_SPLIT_BWD")
+        gr = jax.grad(lambda q, k, v: (_attn_ref(q, k, v, causal) * w).sum(),
+                      argnums=(0, 1, 2))(q, k, v)
+        for a, c, r in zip(g_fused, g_split, gr):
+            # fused vs split: same math, same f32 accumulation order up
+            # to the cross-k partial sum — tight tolerance
+            np.testing.assert_allclose(np.asarray(a), np.asarray(c),
+                                       rtol=1e-6, atol=1e-6)
+            np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                       rtol=RTOL, atol=ATOL)
+
+
+@pytest.mark.skipif(not _on_tpu(), reason="memory analysis needs the real chip")
+def test_flash_attention_o_of_s_memory():
+    """The flash kernel's compiled temp footprint must be O(S) — far
+    below the composed path's materialized (B,H,S,S) score block (the
+    ring fold relies on this per step: VERDICT r2 #6 'O(C) per-step
+    memory')."""
+    B, H, S, D = 1, 8, 2048, 64
+    q = jnp.zeros((B, H, S, D), jnp.bfloat16)
+    score_bytes = B * H * S * S * 4  # one f32 (S,S) block per (b,h)
+
+    flash = jax.jit(lambda q, k, v: flash_attention(q, k, v, None, True, 0))
+    ref = jax.jit(lambda q, k, v: _attn_ref(q, k, v, True))
+    m_flash = flash.lower(q, q, q).compile().memory_analysis()
+    m_ref = ref.lower(q, q, q).compile().memory_analysis()
+    if m_flash is None or m_ref is None:
+        pytest.skip("memory_analysis unavailable on this backend")
+    assert m_flash.temp_size_in_bytes < score_bytes / 4, (
+        m_flash.temp_size_in_bytes, score_bytes)
+    assert m_ref.temp_size_in_bytes > m_flash.temp_size_in_bytes * 4, (
+        m_ref.temp_size_in_bytes, m_flash.temp_size_in_bytes)
