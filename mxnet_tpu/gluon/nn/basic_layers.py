@@ -227,22 +227,20 @@ class BatchNorm(HybridBlock):
     def hybrid_forward(self, F, x, gamma, beta, running_mean, running_var):
         training = _autograd.is_training()
         if training and not self._use_global_stats:
-            ax = self._axis % x.ndim
-            red = tuple(i for i in range(x.ndim) if i != ax)
-            mean = F.mean(x.astype("float32"), axis=red)
-            shape = [1] * x.ndim
-            shape[ax] = -1
-            diff = x.astype("float32") - mean.reshape(shape)
-            var = F.mean(diff * diff, axis=red)
+            # fused train-mode BN: 2-pass forward, 2-pass hand-written
+            # backward (op_impl_nn.BatchNormTrain) — the composed
+            # mean/diff/var graph costs ~6 HBM-bound passes in autodiff
+            out, mean, var = _bn_train_apply(F, x, gamma, beta,
+                                             running_mean, self._kwargs)
+            mean, var = F.stop_gradient(mean), F.stop_gradient(var)
             m = self._momentum
             defer_aux_update(self.running_mean,
                              running_mean * m + mean.astype(running_mean.dtype) * (1 - m))
             defer_aux_update(self.running_var,
                              running_var * m + var.astype(running_var.dtype) * (1 - m))
-            use_mean, use_var = mean.astype(x.dtype), var.astype(x.dtype)
-        else:
-            use_mean, use_var = running_mean, running_var
-        return _bn_apply(F, x, gamma, beta, use_mean, use_var, self._kwargs)
+            return out
+        return _bn_apply(F, x, gamma, beta, running_mean, running_var,
+                         self._kwargs)
 
     def __repr__(self):
         in_channels = self.gamma.shape[0] if self.gamma.shape else None
@@ -257,6 +255,17 @@ def _bn_apply(F, x, gamma, beta, mean, var, kwargs):
                       {"eps": kwargs["eps"], "momentum": kwargs["momentum"],
                        "fix_gamma": kwargs["fix_gamma"], "axis": kwargs["axis"]})
     return F.BatchNorm(x, gamma, beta, mean, var, **kwargs)
+
+
+def _bn_train_apply(F, x, gamma, beta, running_mean, kwargs):
+    # running_mean re-centers the one-pass variance (cancellation guard)
+    from ...ndarray.register import invoke, get_op
+    params = {"eps": kwargs["eps"], "axis": kwargs["axis"],
+              "fix_gamma": kwargs["fix_gamma"]}
+    if isinstance(x, NDArray):
+        return invoke(get_op("BatchNormTrain"),
+                      [x, gamma, beta, running_mean], params)
+    return F.BatchNormTrain(x, gamma, beta, running_mean, **params)
 
 
 class InstanceNorm(HybridBlock):

@@ -808,7 +808,7 @@ _HERE_EXPLICIT = {
     "SoftmaxActivation", "SoftmaxOutput", "softmax_cross_entropy",
     "batch_dot_attention_scores", "batch_dot_attention_apply",
     "causal_mask_scores", "flash_attention", "LayerNorm", "InstanceNorm",
-    "GroupNorm", "BatchNorm", "Dropout", "SequenceMask", "SequenceLast",
+    "GroupNorm", "BatchNorm", "BatchNormTrain", "Dropout", "SequenceMask", "SequenceLast",
     "SequenceReverse", "LinearRegressionOutput", "MAERegressionOutput",
     "LogisticRegressionOutput", "BilinearSampler",
     "random_uniform", "random_normal", "random_gamma", "random_exponential",
@@ -974,3 +974,117 @@ def test_ravel_unravel_and_digamma():
     eg = 0.5772156649
     assert_almost_equal(d, np.array([-eg, -eg - 2 * np.log(2), 1 - eg],
                                     np.float32), rtol=1e-4, atol=1e-5)
+
+
+def test_batch_norm_train_fused_matches_composed():
+    """BatchNormTrain (fused 2-pass fwd / hand-written 2-pass VJP) vs
+    the composed mean/centered-var/normalize graph: outputs, batch
+    stats, and dx/dgamma/dbeta must agree (reference batch_norm.cc
+    training path semantics)."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ndarray.op_impl_nn import _bn_train_core
+    z16 = jnp.zeros(16, jnp.float32)
+
+    rng = np.random.RandomState(42)
+    x = jnp.asarray(rng.randn(8, 16, 9, 7).astype(np.float32)) * 2.0 + 0.7
+    g = jnp.asarray(rng.rand(16).astype(np.float32) + 0.5)
+    b = jnp.asarray(rng.randn(16).astype(np.float32))
+    eps = 1e-5
+
+    def composed(x, g, b):
+        mean = x.mean((0, 2, 3))
+        diff = x - mean.reshape(1, -1, 1, 1)
+        var = (diff * diff).mean((0, 2, 3))
+        out = diff * jax.lax.rsqrt(var.reshape(1, -1, 1, 1) + eps) \
+            * g.reshape(1, -1, 1, 1) + b.reshape(1, -1, 1, 1)
+        return out, mean, var
+
+    out, mean, var = _bn_train_core(x, g, b, z16, eps, 1, False)
+    ro, rm, rv = composed(x, g, b)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ro), rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(mean), np.asarray(rm), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(var), np.asarray(rv), rtol=2e-5, atol=2e-5)
+
+    w = jnp.asarray(rng.randn(8, 16, 9, 7).astype(np.float32))
+    gf = jax.grad(lambda x, g, b: (_bn_train_core(x, g, b, z16, eps, 1, False)[0] * w).sum(),
+                  argnums=(0, 1, 2))(x, g, b)
+    gr = jax.grad(lambda x, g, b: (composed(x, g, b)[0] * w).sum(),
+                  argnums=(0, 1, 2))(x, g, b)
+    for a, c in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(c),
+                                   rtol=3e-4, atol=3e-4)
+
+    # fix_gamma: gamma ignored (ones) and its grad is exactly zero
+    out_fg, _, _ = _bn_train_core(x, g, b, z16, eps, 1, True)
+    ro_fg, _, _ = composed(x, jnp.ones_like(g), b)
+    np.testing.assert_allclose(np.asarray(out_fg), np.asarray(ro_fg),
+                               rtol=2e-5, atol=2e-5)
+    dg = jax.grad(lambda g: (_bn_train_core(x, g, b, z16, eps, 1, True)[0] * w).sum())(g)
+    assert np.all(np.asarray(dg) == 0.0)
+
+    # external cotangents on the stat outputs flow (mean/var feed the
+    # running-stat EMA when not stop-gradiented)
+    dm = jax.grad(lambda x: _bn_train_core(x, g, b, z16, eps, 1, False)[1].sum())(x)
+    np.testing.assert_allclose(np.asarray(dm),
+                               np.full(x.shape, 1.0 / (8 * 9 * 7)), rtol=1e-6)
+
+    # the stat shift is an exact identity: any per-channel shift gives
+    # the same stats/output (it exists to re-center the one-pass
+    # variance; the layer passes the running mean)
+    shift = jnp.asarray(rng.randn(16).astype(np.float32)) * 10
+    o2, m2, v2 = _bn_train_core(x, g, b, shift, eps, 1, False)
+    # identity holds in real arithmetic; f32 rounding differs by ~1e-4
+    np.testing.assert_allclose(np.asarray(o2), np.asarray(out), rtol=1e-3,
+                               atol=1e-3)
+    np.testing.assert_allclose(np.asarray(m2), np.asarray(mean), rtol=1e-4,
+                               atol=1e-4)
+    np.testing.assert_allclose(np.asarray(v2), np.asarray(var), rtol=1e-3,
+                               atol=1e-4)
+
+    # cancellation guard: |mean| >> std breaks the unshifted one-pass
+    # E[x^2]-E[x]^2 variance (f32), but a mean-scale shift keeps it
+    # accurate — the running mean provides exactly this in steady state
+    big = jnp.asarray((rng.randn(8, 16, 9, 7) * 0.01 + 3000.0)
+                      .astype(np.float32))
+    true_var = np.var(np.asarray(big, np.float64), axis=(0, 2, 3))
+    _, _, v_shift = _bn_train_core(big, g, b,
+                                   jnp.full(16, 3000.0, jnp.float32),
+                                   eps, 1, False)
+    np.testing.assert_allclose(np.asarray(v_shift), true_var, rtol=5e-3)
+    _, _, v_noshift = _bn_train_core(big, g, b, z16, eps, 1, False)
+    assert not np.allclose(np.asarray(v_noshift), true_var, rtol=5e-2), \
+        "unshifted variance unexpectedly survived cancellation"
+
+
+def test_batch_norm_layer_train_vs_eval_running_stats():
+    """Gluon BatchNorm: training uses fused batch stats and updates the
+    EMA; predict mode uses the running stats."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon, nd
+
+    rng = np.random.RandomState(3)
+    bn = gluon.nn.BatchNorm(momentum=0.5)
+    bn.initialize()
+    x = nd.array(rng.randn(4, 5, 6, 6).astype(np.float32) * 3 + 1)
+    with autograd.record():
+        out = bn(x)
+        out.backward()
+    xm = x.asnumpy().mean((0, 2, 3))
+    xv = x.asnumpy().var((0, 2, 3))
+    got = out.asnumpy()
+    want = (x.asnumpy() - xm.reshape(1, -1, 1, 1)) / np.sqrt(
+        xv.reshape(1, -1, 1, 1) + 1e-5)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(bn.running_mean.data().asnumpy(), 0.5 * xm,
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(bn.running_var.data().asnumpy(),
+                               0.5 * 1.0 + 0.5 * xv, rtol=1e-4, atol=1e-4)
+    # predict mode: running stats, not batch stats
+    out_eval = bn(x).asnumpy()
+    rm = bn.running_mean.data().asnumpy()
+    rv = bn.running_var.data().asnumpy()
+    want_eval = (x.asnumpy() - rm.reshape(1, -1, 1, 1)) / np.sqrt(
+        rv.reshape(1, -1, 1, 1) + 1e-5)
+    np.testing.assert_allclose(out_eval, want_eval, rtol=1e-4, atol=1e-4)

@@ -243,12 +243,15 @@ def test_flash_attention_large_asymmetric_blocks(monkeypatch):
 def test_flash_attention_fused_vs_split_bwd(monkeypatch):
     """The single-pass backward (default) and the two-kernel path
     (MXNET_TPU_FLASH_SPLIT_BWD=1) must produce identical gradients on a
-    genuine multi-block grid (nq=3, nk=3 at 384/128x128 tiles), causal
-    and not, with ragged padding (S=330)."""
+    genuine multi-block grid — nq=2, nk=2 at 128x128 tiles (nk=2 is the
+    LARGEST grid the fused path accepts before the nk>2 dq-partial
+    fallback reroutes to split; S=200 with ragged padding exercises the
+    fused kernel's multi-k dq partial sum and the causal invisible-pair
+    zeroing branch), causal and not."""
     monkeypatch.setenv("MXNET_TPU_FLASH_BLOCK_Q", "128")
     monkeypatch.setenv("MXNET_TPU_FLASH_BLOCK_K", "128")
     rng = np.random.RandomState(7)
-    B, H, S, D = 1, 2, 330, 64
+    B, H, S, D = 1, 2, 200, 64
     q = jnp.asarray(rng.randn(B, H, S, D).astype(np.float32)) * 0.3
     k = jnp.asarray(rng.randn(B, H, S, D).astype(np.float32)) * 0.3
     v = jnp.asarray(rng.randn(B, H, S, D).astype(np.float32))
