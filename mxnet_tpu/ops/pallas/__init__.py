@@ -14,6 +14,8 @@ we only drop to Pallas where XLA's own fusion genuinely loses:
   performance play for the BERT north star).
 - ``softmax_xent`` — fused large-vocab softmax cross-entropy (LM
   heads: avoids materializing the (N, V) log-softmax for backward).
+- ``lstm`` — whole-sequence fused LSTM layer (weight-stationary
+  recurrent matmul + gates in one kernel; the cudnn_rnn-inl.h analog).
 
 Dispatch contract: every kernel here has a pure-jnp twin used when the
 backend is not TPU (tests run on the CPU mesh) or when
@@ -28,6 +30,7 @@ from ._util import interpret_mode, pallas_enabled, pallas_ok_for  # noqa: F401
 from .layer_norm import layer_norm_fused  # noqa: E402
 from .flash_attention import flash_attention, flash_attention_with_lse  # noqa: E402
 from .softmax_xent import softmax_xent_fused  # noqa: E402
+from .lstm import lstm_layer_fused  # noqa: E402
 
 __all__ = [
     "pallas_enabled",
@@ -37,4 +40,5 @@ __all__ = [
     "flash_attention",
     "flash_attention_with_lse",
     "softmax_xent_fused",
+    "lstm_layer_fused",
 ]

@@ -1,0 +1,250 @@
+"""Fused whole-sequence LSTM Pallas kernel (the cuDNN-RNN analog).
+
+Reference analog: ``src/operator/rnn.cc`` + ``cudnn_rnn-inl.h`` — the
+fused multi-layer LSTM path behind ``gluon.rnn.LSTM``. The XLA
+``lax.scan`` cell (op_impl_rnn._run_layer) runs the whole recurrence as
+~T tiny dispatches inside a `while` loop: the (H, 4H) recurrent weight
+streams from HBM every step and each iteration pays loop bookkeeping —
+measured on the WikiText-2 LM config (650x2, b128, T=35) as ~0.9 ms of
+scan ops plus ~2.7 ms of inter-iteration device idle per training step.
+
+This kernel runs ONE grid pass over time with the recurrent weight
+RESIDENT in VMEM (weight-stationary, ~3.4 MB at 650x2600 bf16) and the
+(h, c) carry in f32 scratch. Forward emits the per-step h sequence plus
+the (c_seq, gates) residuals the hand-written backward needs; backward
+walks time in reverse via reversed BlockSpec index maps, accumulating
+dW_h2h in a f32 VMEM scratch and emitting per-step pre-activation gate
+gradients (``dgin``) from which the wrapper recovers dx / dW_i2h / db
+with two large MXU matmuls outside the kernel.
+
+Layout contract: gin/x are time-major ``(T, N, 4H)`` — exactly what
+op_impl_rnn._run_layer already computes; w_h2h is ``(H, 4H)`` (the
+transpose of the MXNet ``(4H, H)`` parameter block, done once outside).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ._util import resolve_interpret, x32
+
+
+def _lstm_fwd_kernel(gin_ref, w_ref, h0_ref, c0_ref,
+                     out_ref, cseq_ref, gates_ref,
+                     h_sc, c_sc, *, precision):
+    t = pl.program_id(0)
+
+    @pl.when(t == 0)
+    def _():
+        h_sc[:] = h0_ref[:].astype(jnp.float32)
+        c_sc[:] = c0_ref[:].astype(jnp.float32)
+
+    h = h_sc[:].astype(w_ref.dtype)
+    z = gin_ref[0].astype(jnp.float32) + jax.lax.dot_general(
+        h, w_ref[:], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32, precision=precision)
+    i, f, g, o = jnp.split(z, 4, axis=-1)
+    i = jax.nn.sigmoid(i)
+    f = jax.nn.sigmoid(f)
+    g = jnp.tanh(g)
+    o = jax.nn.sigmoid(o)
+    c_new = f * c_sc[:] + i * g
+    h_new = o * jnp.tanh(c_new)
+    out_ref[0] = h_new.astype(out_ref.dtype)
+    cseq_ref[0] = c_new.astype(cseq_ref.dtype)
+    gates_ref[0] = jnp.concatenate([i, f, g, o], axis=-1).astype(
+        gates_ref.dtype)
+    h_sc[:] = h_new
+    c_sc[:] = c_new
+
+
+def _lstm_bwd_kernel(gates_ref, cseq_ref, cprev_ref, hprev_ref,
+                     dout_ref, dcseq_ref, w_ref, h0_ref, c0_ref,
+                     dgin_ref, dh0_ref, dc0_ref, dw_ref,
+                     dh_sc, dc_sc, dw_sc, *, precision):
+    """Reverse-time step rt = T-1-t (the index maps flip time)."""
+    t = pl.program_id(0)
+    T = pl.num_programs(0)
+
+    @pl.when(t == 0)
+    def _():
+        dh_sc[:] = jnp.zeros_like(dh_sc)
+        dc_sc[:] = jnp.zeros_like(dc_sc)
+        dw_sc[:] = jnp.zeros_like(dw_sc)
+
+    H = dh_sc.shape[-1]
+    gts = gates_ref[0].astype(jnp.float32)
+    i, f, g, o = (gts[:, :H], gts[:, H:2 * H], gts[:, 2 * H:3 * H],
+                  gts[:, 3 * H:])
+    c_t = cseq_ref[0].astype(jnp.float32)
+    # at rt == 0 the "previous" state is the initial state
+    first = t == T - 1
+    c_prev = jnp.where(first, c0_ref[:].astype(jnp.float32),
+                       cprev_ref[0].astype(jnp.float32))
+    h_prev = jnp.where(first, h0_ref[:].astype(jnp.float32),
+                       hprev_ref[0].astype(jnp.float32))
+
+    tanh_c = jnp.tanh(c_t)
+    dh = dout_ref[0].astype(jnp.float32) + dh_sc[:]
+    dc = dh * o * (1.0 - tanh_c * tanh_c) + dc_sc[:] \
+        + dcseq_ref[0].astype(jnp.float32)
+    do_ = dh * tanh_c * o * (1.0 - o)
+    di = dc * g * i * (1.0 - i)
+    df = dc * c_prev * f * (1.0 - f)
+    dg = dc * i * (1.0 - g * g)
+    dgin = jnp.concatenate([di, df, dg, do_], axis=-1)
+    dgin_ref[0] = dgin.astype(dgin_ref.dtype)
+
+    dginc = dgin.astype(w_ref.dtype)
+    # dh_{t-1} = dgin @ W^T : (N, 4H) x (4H, H) contraction on 4H
+    dh_sc[:] = jax.lax.dot_general(
+        dginc, w_ref[:], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32, precision=precision)
+    dc_sc[:] = dc * f
+    # dW += h_{t-1}^T @ dgin : (H, N) x (N, 4H)
+    dw_sc[:] = dw_sc[:] + jax.lax.dot_general(
+        h_prev.astype(w_ref.dtype), dginc, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32, precision=precision)
+
+    @pl.when(t == T - 1)
+    def _():
+        dh0_ref[:] = dh_sc[:].astype(dh0_ref.dtype)
+        dc0_ref[:] = dc_sc[:].astype(dc0_ref.dtype)
+        dw_ref[:] = dw_sc[:].astype(dw_ref.dtype)
+
+
+def _dot_precision(dtype):
+    return (lax.Precision.HIGHEST if jnp.dtype(dtype) == jnp.float32
+            else lax.Precision.DEFAULT)
+
+
+@x32
+def _lstm_fwd(gin, w, h0, c0, interpret):
+    T, N, G = gin.shape
+    H = h0.shape[-1]
+    kern = functools.partial(_lstm_fwd_kernel,
+                             precision=_dot_precision(w.dtype))
+    out, cseq, gates = pl.pallas_call(
+        kern,
+        grid=(T,),
+        in_specs=[
+            pl.BlockSpec((1, N, G), lambda t: (t, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((H, G), lambda t: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((N, H), lambda t: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((N, H), lambda t: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, N, H), lambda t: (t, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, N, H), lambda t: (t, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, N, G), lambda t: (t, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((T, N, H), gin.dtype),
+            jax.ShapeDtypeStruct((T, N, H), gin.dtype),
+            jax.ShapeDtypeStruct((T, N, G), gin.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((N, H), jnp.float32),
+            pltpu.VMEM((N, H), jnp.float32),
+        ],
+        interpret=interpret,
+    )(gin, w, h0, c0)
+    return out, cseq, gates
+
+
+@x32
+def _lstm_bwd(gates, cseq, out, w, h0, c0, dout, dcseq, interpret):
+    T, N, G = gates.shape
+    H = h0.shape[-1]
+    rt = lambda t: (T - 1 - t, 0, 0)  # reversed time
+    rt_prev = lambda t: (jnp.maximum(T - 2 - t, 0), 0, 0)
+    kern = functools.partial(_lstm_bwd_kernel,
+                             precision=_dot_precision(w.dtype))
+    dgin, dh0, dc0, dw = pl.pallas_call(
+        kern,
+        grid=(T,),
+        in_specs=[
+            pl.BlockSpec((1, N, G), rt, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, N, H), rt, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, N, H), rt_prev, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, N, H), rt_prev, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, N, H), rt, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, N, H), rt, memory_space=pltpu.VMEM),
+            pl.BlockSpec((H, G), lambda t: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((N, H), lambda t: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((N, H), lambda t: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, N, G), rt, memory_space=pltpu.VMEM),
+            pl.BlockSpec((N, H), lambda t: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((N, H), lambda t: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((H, G), lambda t: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((T, N, G), gates.dtype),
+            jax.ShapeDtypeStruct((N, H), jnp.float32),
+            jax.ShapeDtypeStruct((N, H), jnp.float32),
+            jax.ShapeDtypeStruct((H, G), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((N, H), jnp.float32),
+            pltpu.VMEM((N, H), jnp.float32),
+            pltpu.VMEM((H, G), jnp.float32),
+        ],
+        interpret=interpret,
+    )(gates, cseq, cseq, out, dout, dcseq, w, h0, c0)
+    return dgin, dh0, dc0, dw
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def lstm_layer_fused(gin, w_h2h_t, h0, c0, interpret=None):
+    """One LSTM layer/direction over the whole sequence in one kernel.
+
+    gin : (T, N, 4H) pre-computed input-side gate projections
+        (x @ W_i2h^T + b_i2h + b_h2h), gate order (i, f, g, o).
+    w_h2h_t : (H, 4H) recurrent weight, already transposed.
+    h0, c0 : (N, H) initial state.
+    Returns (out (T, N, H), c_seq (T, N, H)); the caller takes
+    ``out[-1]`` / ``c_seq[-1]`` for the final state, so those
+    cotangents flow through plain indexing into dout / dcseq.
+    """
+    out, cseq, _ = _lstm_fwd(gin, w_h2h_t, h0, c0,
+                             resolve_interpret(interpret))
+    return out, cseq
+
+
+def _lstm_vjp_fwd(gin, w_h2h_t, h0, c0, interpret):
+    out, cseq, gates = _lstm_fwd(gin, w_h2h_t, h0, c0,
+                                 resolve_interpret(interpret))
+    return (out, cseq), (gates, cseq, out, w_h2h_t, h0, c0)
+
+
+def _lstm_vjp_bwd(interpret, res, cts):
+    gates, cseq, out, w_h2h_t, h0, c0 = res
+    dout, dcseq = cts
+    dgin, dh0, dc0, dw = _lstm_bwd(gates, cseq, out, w_h2h_t, h0, c0,
+                                   dout, dcseq,
+                                   resolve_interpret(interpret))
+    return (dgin, dw.astype(w_h2h_t.dtype), dh0.astype(h0.dtype),
+            dc0.astype(c0.dtype))
+
+
+lstm_layer_fused.defvjp(_lstm_vjp_fwd, _lstm_vjp_bwd)

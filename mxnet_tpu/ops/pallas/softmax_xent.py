@@ -70,8 +70,11 @@ def _pad_to(n, m):
 
 
 def _blocks(n, v):
-    bn = min(128, _pad_to(n, 8))
-    bv = min(512, _pad_to(v, 128))
+    import os
+    bn = min(int(os.environ.get("MXNET_TPU_XENT_BLOCK_N", "128")),
+             _pad_to(n, 8))
+    bv = min(int(os.environ.get("MXNET_TPU_XENT_BLOCK_V", "2048")),
+             _pad_to(v, 128))
     return bn, bv
 
 

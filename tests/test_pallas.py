@@ -297,3 +297,90 @@ def test_flash_attention_o_of_s_memory():
         m_flash.temp_size_in_bytes, score_bytes)
     assert m_ref.temp_size_in_bytes > m_flash.temp_size_in_bytes * 4, (
         m_ref.temp_size_in_bytes, m_flash.temp_size_in_bytes)
+
+
+def test_lstm_layer_fused_vs_scan(monkeypatch):
+    """Whole-sequence fused LSTM kernel (interpret mode) vs the
+    lax.scan cell: outputs, final states, and every gradient (gin,
+    W_h2h, h0, c0 — including cotangents on the final states) agree."""
+    monkeypatch.setenv("MXNET_TPU_PALLAS_INTERPRET", "1")
+    monkeypatch.setenv("MXNET_TPU_FUSED_LSTM", "1")
+    from mxnet_tpu.ops.pallas.lstm import lstm_layer_fused
+
+    rng = np.random.RandomState(21)
+    T, N, H = 7, 8, 24
+    gin = jnp.asarray(rng.randn(T, N, 4 * H).astype(np.float32)) * 0.4
+    w = jnp.asarray(rng.randn(H, 4 * H).astype(np.float32)) * 0.3
+    h0 = jnp.asarray(rng.randn(N, H).astype(np.float32)) * 0.5
+    c0 = jnp.asarray(rng.randn(N, H).astype(np.float32)) * 0.5
+
+    def scan_ref(gin, w, h0, c0):
+        def step(carry, gx):
+            h, c = carry
+            z = gx + h @ w
+            i, f, g, o = jnp.split(z, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            c_new = f * c + i * g
+            h_new = o * jnp.tanh(c_new)
+            return (h_new, c_new), (h_new, c_new)
+        (hl, cl), (out, cseq) = jax.lax.scan(step, (h0, c0), gin)
+        return out, cseq
+
+    out, cseq = lstm_layer_fused(gin, w, h0, c0)
+    ro, rc = scan_ref(gin, w, h0, c0)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ro),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(cseq), np.asarray(rc),
+                               rtol=1e-5, atol=1e-5)
+
+    # weighted loss touching the full sequence AND both final states so
+    # every cotangent path (dout, dcseq, incl. [-1] entries) is live
+    wo = jnp.asarray(rng.randn(T, N, H).astype(np.float32))
+    wc = jnp.asarray(rng.randn(N, H).astype(np.float32))
+
+    def loss_fused(gin, w, h0, c0):
+        out, cseq = lstm_layer_fused(gin, w, h0, c0)
+        return (out * wo).sum() + (cseq[-1] * wc).sum() + out[-1].sum()
+
+    def loss_ref(gin, w, h0, c0):
+        out, cseq = scan_ref(gin, w, h0, c0)
+        return (out * wo).sum() + (cseq[-1] * wc).sum() + out[-1].sum()
+
+    gf = jax.grad(loss_fused, argnums=(0, 1, 2, 3))(gin, w, h0, c0)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2, 3))(gin, w, h0, c0)
+    for a, c in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(c),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_lstm_fused_bidirectional_matches_scan(monkeypatch):
+    """Bidirectional gluon LSTM: the fused kernel path must agree with
+    the lax.scan path on outputs AND final states (the reverse
+    direction's h_last is the last PROCESSED step, not out[-1] after
+    the flip back to forward-time order)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+
+    rng = np.random.RandomState(31)
+    x = rng.randn(5, 4, 12).astype(np.float32)  # (T, N, I), TNC
+
+    def run(fused):
+        if fused:
+            monkeypatch.setenv("MXNET_TPU_PALLAS_INTERPRET", "1")
+            monkeypatch.setenv("MXNET_TPU_FUSED_LSTM", "1")
+        else:
+            monkeypatch.delenv("MXNET_TPU_PALLAS_INTERPRET", raising=False)
+            monkeypatch.delenv("MXNET_TPU_FUSED_LSTM", raising=False)
+        mx.random.seed(7)
+        net = mx.gluon.rnn.LSTM(8, num_layers=2, bidirectional=True)
+        net.initialize()
+        out, (h, c) = net(nd.array(x),
+                          net.begin_state(batch_size=4))
+        return out.asnumpy(), h.asnumpy(), c.asnumpy()
+
+    o_s, h_s, c_s = run(False)
+    o_f, h_f, c_f = run(True)
+    np.testing.assert_allclose(o_f, o_s, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(h_f, h_s, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(c_f, c_s, rtol=1e-5, atol=1e-5)
