@@ -89,24 +89,47 @@ class _QuantizedBase(HybridBlock):
     save_parameters/load_parameters path; act_amax <= 0 means dynamic
     per-batch activation ranges (resolved in-graph, no sync)."""
 
-    def _quantize_weight(self, float_layer, ctx, act_range):
+    def _quantize_weight(self, float_layer, ctx, act_range, fold_bn=None,
+                         channelwise=False):
+        import numpy as np
+
         from .. import ndarray as nd
         from ..ndarray.op_impl_quant import quantize_weight
         from ..ndarray.ndarray import _wrap
         w = float_layer.weight.data(ctx)
-        q, s = quantize_weight(w._data)
+        wf = w._data.astype("float32")
+        fold_bias = None
+        if fold_bn is not None:
+            # fold the BN inference affine into the conv (reference
+            # mkldnn int8 fuses conv+BN the same way): w' = w*g/sigma
+            # per out-channel, b' = beta - mu*g/sigma (+ b*g/sigma)
+            import jax.numpy as jnp
+            gam = fold_bn.gamma.data(ctx)._data.astype("float32")
+            bet = fold_bn.beta.data(ctx)._data.astype("float32")
+            mu = fold_bn.running_mean.data(ctx)._data.astype("float32")
+            var = fold_bn.running_var.data(ctx)._data.astype("float32")
+            bscale = gam / jnp.sqrt(var + fold_bn._epsilon)
+            wf = wf * bscale.reshape((-1,) + (1,) * (wf.ndim - 1))
+            b0 = (float_layer.bias.data(ctx)._data.astype("float32")
+                  if float_layer.bias is not None else 0.0)
+            fold_bias = bet - mu * bscale + b0 * bscale
+        q, s = quantize_weight(wf, channelwise=channelwise)
         with self.name_scope():
             self.weight_q = self.params.get(
                 "weight_q", shape=q.shape, dtype="int8", init="zeros",
                 grad_req="null")
             self.weight_scale = self.params.get(
-                "weight_scale", shape=(1,), dtype="float32", init="zeros",
+                "weight_scale", shape=s.shape, dtype="float32", init="zeros",
                 grad_req="null")
             self.act_amax = self.params.get(
                 "act_amax", shape=(1,), dtype="float32", init="zeros",
                 grad_req="null")
             self.bias = None
-            if float_layer.bias is not None:
+            if fold_bias is not None:
+                self.bias = self.params.get(
+                    "bias", shape=fold_bias.shape, dtype="float32",
+                    init="zeros", grad_req="null")
+            elif float_layer.bias is not None:
                 self.bias = self.params.get(
                     "bias", shape=float_layer.bias.shape, dtype="float32",
                     init="zeros", grad_req="null")
@@ -116,7 +139,10 @@ class _QuantizedBase(HybridBlock):
         amax = (max(abs(act_range[0]), abs(act_range[1]))
                 if act_range is not None else -1.0)  # <=0 → dynamic
         self.act_amax.set_data(nd.array([amax], ctx=ctx))
-        if self.bias is not None:
+        if fold_bias is not None:
+            from ..ndarray.ndarray import _wrap as _w2
+            self.bias.set_data(_w2(fold_bias, ctx))
+        elif self.bias is not None:
             self.bias.set_data(float_layer.bias.data(ctx))
 
 
@@ -145,19 +171,22 @@ class QuantizedDense(_QuantizedBase):
                       _wrap(s, x.ctx), self.weight_scale.data(x.ctx), bias],
                      {"num_hidden": self._units, "flatten": self._flatten,
                       "no_bias": bias is None})
+        out = out.astype(x.dtype)
         return self._act(out) if self._act is not None else out
 
 
 class QuantizedConv2D(_QuantizedBase):
     """int8 replacement for nn.Conv2D (reference quantized_conv)."""
 
-    def __init__(self, float_layer, act_range=None, ctx=None, prefix=None):
+    def __init__(self, float_layer, act_range=None, ctx=None, prefix=None,
+                 fold_bn=None):
         super().__init__(prefix=prefix or (float_layer.name + "_int8_"))
         from ..context import current_context
         ctx = ctx or current_context()
         self._kwargs = dict(float_layer._kwargs)
         self._act = float_layer.act
-        self._quantize_weight(float_layer, ctx, act_range)
+        self._quantize_weight(float_layer, ctx, act_range, fold_bn=fold_bn,
+                              channelwise=True)
 
     def forward(self, x):
         from ..ndarray.register import get_op, invoke
@@ -172,6 +201,7 @@ class QuantizedConv2D(_QuantizedBase):
                      [_wrap(q, x.ctx), self.weight_q.data(x.ctx),
                       _wrap(s, x.ctx), self.weight_scale.data(x.ctx), bias],
                      {**kw, "no_bias": bias is None})
+        out = out.astype(x.dtype)  # keep bf16 interfaces bf16
         return self._act(out) if self._act is not None else out
 
 
@@ -193,14 +223,32 @@ def quantize_net(net, quantized_dtype="int8", calib_data=None,
                              inputs=True)
 
     def rewrite(block):
-        for name, child in list(block._children.items()):
+        items = list(block._children.items())
+        for idx, (name, child) in enumerate(items):
             rewrite(child)
             if child.name in exclude_layers:
                 continue
             if type(child) is _nn.Dense:
                 qlayer = QuantizedDense(child, ranges.get(child.name), ctx)
             elif type(child) is _nn.Conv2D:
-                qlayer = QuantizedConv2D(child, ranges.get(child.name), ctx)
+                # conv immediately followed by BatchNorm in the same
+                # container: fold the BN inference affine into the int8
+                # conv's weight/bias and drop the BN from the graph
+                # (the chain around every conv — dequant->BN->quant —
+                # was the measured reason int8 LOST to bf16)
+                fold_bn = None
+                if idx + 1 < len(items) and \
+                        type(items[idx + 1][1]) is _nn.BatchNorm and \
+                        items[idx + 1][1].name not in exclude_layers:
+                    fold_bn = items[idx + 1][1]
+                qlayer = QuantizedConv2D(child, ranges.get(child.name), ctx,
+                                         fold_bn=fold_bn)
+                if fold_bn is not None:
+                    ident = _nn.Identity(prefix=fold_bn.name + "_folded_")
+                    block._children[items[idx + 1][0]] = ident
+                    for attr, val in list(vars(block).items()):
+                        if val is fold_bn:
+                            object.__setattr__(block, attr, ident)
             else:
                 continue
             block._children[name] = qlayer

@@ -84,14 +84,31 @@ def quantized_conv(data, weight, x_scale, w_scale, bias=None, kernel=None,
         feature_group_count=int(num_group),
         preferred_element_type=jnp.int32,
     )
-    out = acc.astype(jnp.float32) * (x_scale.reshape(()) * w_scale.reshape(()))
+    # w_scale: per-tensor (1,) or PER-OUT-CHANNEL (C,) — the latter is
+    # what BN-folded weights need (the reference's mkldnn int8 conv is
+    # channel-wise too)
+    if w_scale.size == 1:
+        ws = w_scale.reshape(())
+    else:
+        ws = w_scale.reshape((1, -1) + (1,) * nd_)
+    out = acc.astype(jnp.float32) * x_scale.reshape(()) * ws
     if bias is not None and not no_bias:
         out = out + bias.reshape((1, -1) + (1,) * nd_)
     return out
 
 
-def quantize_weight(w):
-    """Per-tensor symmetric int8 weight quantization: (q, scale)."""
+def quantize_weight(w, channelwise=False):
+    """Symmetric int8 weight quantization: (q, scale). With
+    ``channelwise`` the scale is per out-channel (axis 0) — required
+    for BN-folded conv weights whose per-channel magnitudes vary by
+    the folded gamma/sigma factor."""
+    if channelwise:
+        red = tuple(range(1, w.ndim))
+        amax = jnp.max(jnp.abs(w), axis=red)
+        s = _amax_scale(amax)
+        q = jnp.clip(jnp.round(w / s.reshape((-1,) + (1,) * (w.ndim - 1))),
+                     -_QMAX, _QMAX).astype(jnp.int8)
+        return q, s.astype(jnp.float32)
     amax = jnp.max(jnp.abs(w))
     s = _amax_scale(amax)
     q = jnp.clip(jnp.round(w / s), -_QMAX, _QMAX).astype(jnp.int8)

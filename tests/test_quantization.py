@@ -149,3 +149,40 @@ def test_quantize_net_save_load_roundtrip(tmp_path):
     net2.load_parameters(f)
     got = net2(x).asnumpy()
     assert_almost_equal(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_quantize_net_folds_batchnorm():
+    """Conv->BN->relu chains: quantize_net folds the BN inference
+    affine into the int8 conv (per-out-channel weight scales) and
+    removes the BN from the graph; outputs stay close to float
+    predict-mode output."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu.contrib.quantization import quantize_net
+    from mxnet_tpu.gluon import nn
+
+    rng = np.random.RandomState(5)
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, kernel_size=3, padding=1, use_bias=False),
+            nn.BatchNorm(), nn.Activation("relu"),
+            nn.Conv2D(4, kernel_size=1, use_bias=False), nn.BatchNorm())
+    net.initialize(init=mx.initializer.Xavier())
+    x = nd.array(rng.randn(2, 3, 8, 8).astype(np.float32))
+    # give BN non-trivial running stats
+    for _ in range(3):
+        with mx.autograd.record():
+            net(nd.array(rng.randn(2, 3, 8, 8).astype(np.float32) * 2 + 0.3))
+    with mx.autograd.predict_mode():
+        ref = net(x).asnumpy()
+        quantize_net(net, calib_data=[[x]], ctx=mx.current_context())
+        got = net(x).asnumpy()
+
+    kinds = [type(c).__name__ for c in net._children.values()]
+    assert "BatchNorm" not in kinds, kinds
+    assert kinds.count("Identity") == 2, kinds
+    assert kinds.count("QuantizedConv2D") == 2, kinds
+    # int8 tolerance: ~1% of dynamic range
+    tol = 0.02 * max(1e-3, float(np.abs(ref).max()))
+    np.testing.assert_allclose(got, ref, atol=tol, rtol=0.1)
