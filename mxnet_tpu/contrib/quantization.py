@@ -91,8 +91,6 @@ class _QuantizedBase(HybridBlock):
 
     def _quantize_weight(self, float_layer, ctx, act_range, fold_bn=None,
                          channelwise=False):
-        import numpy as np
-
         from .. import ndarray as nd
         from ..ndarray.op_impl_quant import quantize_weight
         from ..ndarray.ndarray import _wrap
@@ -213,9 +211,21 @@ def quantize_net(net, quantized_dtype="int8", calib_data=None,
     With ``calib_data``: per-layer INPUT ranges are collected first
     (static activation scales). Without: dynamic per-batch ranges.
     Returns the same net object (rewritten in place), reference-API
-    compatible."""
+    compatible.
+
+    Conv->BatchNorm pairs inside (Hybrid)Sequential containers are
+    folded into the int8 conv (BN dropped); conv weight scales are
+    per-out-channel. NOTE: int8 checkpoints written before the
+    per-channel change (weight_scale shape (1,)) do not load into
+    newly quantized nets."""
     if quantized_dtype != "int8":
         raise MXNetError(f"only int8 is supported, got {quantized_dtype}")
+    # hybridized nets would run calibration hooks (which read concrete
+    # values) inside a trace, and the cached compiled graph would keep
+    # executing the FLOAT layers after the rewrite — deactivate and
+    # drop caches first (re-hybridize after quantizing if desired)
+    if isinstance(net, HybridBlock):
+        net.hybridize(active=False)
     ranges = {}
     if calib_data is not None:
         ranges = calib_graph(net, calib_data,
@@ -236,10 +246,18 @@ def quantize_net(net, quantized_dtype="int8", calib_data=None,
                 # conv's weight/bias and drop the BN from the graph
                 # (the chain around every conv — dequant->BN->quant —
                 # was the measured reason int8 LOST to bf16)
+                # fold only where adjacency IS dataflow (Sequential
+                # containers), the conv has no inline activation (the
+                # float graph is BN(act(conv)) then — folding would
+                # reorder to act(BN(conv))), and the BN normalizes the
+                # conv out-channel axis
                 fold_bn = None
-                if idx + 1 < len(items) and \
-                        type(items[idx + 1][1]) is _nn.BatchNorm and \
-                        items[idx + 1][1].name not in exclude_layers:
+                if isinstance(block, (_nn.Sequential, _nn.HybridSequential)) \
+                        and child.act is None \
+                        and idx + 1 < len(items) \
+                        and type(items[idx + 1][1]) is _nn.BatchNorm \
+                        and items[idx + 1][1]._axis == 1 \
+                        and items[idx + 1][1].name not in exclude_layers:
                     fold_bn = items[idx + 1][1]
                 qlayer = QuantizedConv2D(child, ranges.get(child.name), ctx,
                                          fold_bn=fold_bn)
