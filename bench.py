@@ -276,7 +276,7 @@ def main():
 
     flops, nbytes = _step_cost(step, params, moms, rng, x, y)
 
-    if os.environ.get("BENCH_DATA") == "recordio":
+    if os.environ.get("BENCH_DATA") in ("recordio", "pipeline"):
         _resnet_from_recordio(loss_fn, params, moms, rng, flops)
         return
 
@@ -387,6 +387,48 @@ def _resnet_from_recordio(loss_fn, params, moms, rng, flops):
             jax.block_until_ready(loss)
         return n_steps, p, m
 
+    pipeline_mode = os.environ.get("BENCH_DATA") == "pipeline"
+    extras = {}
+    if pipeline_mode:
+        # leg 1 — standalone decode rate, measured with the device idle
+        # (the axon tunnel spin-waits across host cores while device
+        # work is in flight, poisoning any overlapped measurement of
+        # host decode; see BASELINE.md "axon" notes)
+        nb = 0
+        t0 = time.perf_counter()
+        for _ in batches():
+            nb += 1
+        t_dec = time.perf_counter() - t0
+        if nb == 0:
+            raise RuntimeError(
+                f"pipeline bench produced no full batches "
+                f"(BENCH_PIPELINE_IMAGES={n_img} < batch {BATCH}?)")
+        decode_rate = nb * BATCH / t_dec
+        # leg 2 — synthetic compute rate on a fixed device batch
+        xs = jnp.zeros((BATCH, 3, IMAGE, IMAGE), jnp.uint8)
+        ys = jnp.zeros((BATCH,), jnp.float32)
+        p, m = params, moms
+        for _ in range(3):
+            p, m, loss = step(p, m, rng, xs, ys)
+        jax.block_until_ready(loss)
+        t0 = time.perf_counter()
+        for _ in range(10):
+            p, m, loss = step(p, m, rng, xs, ys)
+        jax.block_until_ready(loss)
+        t_cmp = time.perf_counter() - t0
+        compute_rate = 10 * BATCH / t_cmp
+        params, moms = p, m
+        try:
+            usable_cores = len(os.sched_getaffinity(0))
+        except AttributeError:
+            usable_cores = os.cpu_count()
+        decode_cores = min(workers, usable_cores)
+        extras = {"decode_img_s": round(decode_rate, 1),
+                  "compute_img_s": round(compute_rate, 1),
+                  "host_cores": usable_cores,
+                  "decode_ms_per_img_per_core":
+                      round(1000.0 * decode_cores / decode_rate, 3)}
+
     # warmup epoch: compile + page cache (params are donated — thread
     # the returned state into the timed epoch)
     _, p, m = run_epoch(params, moms)
@@ -394,11 +436,14 @@ def _resnet_from_recordio(loss_fn, params, moms, rng, flops):
     n_steps, p, m = run_epoch(p, m)
     dt = time.perf_counter() - t0
     imgs_per_sec = n_steps * BATCH / dt
+    if pipeline_mode:
+        bound = min(extras["decode_img_s"], extras["compute_img_s"])
+        extras["pipeline_utilization"] = round(imgs_per_sec / bound, 4)
     _report("resnet50_recordio_images_per_sec_per_chip", imgs_per_sec,
             "images/sec/chip", imgs_per_sec / BASELINE_IMGS_PER_SEC,
             flops_per_step=flops, sec_per_step=dt / max(n_steps, 1),
             batch=BATCH, dtype=DTYPE, workers=workers,
-            pipeline=pipeline, pipeline_images=n_img)
+            pipeline=pipeline, pipeline_images=n_img, **extras)
 
 
 def main_bert():

@@ -224,8 +224,9 @@ def quantize_net(net, quantized_dtype="int8", calib_data=None,
     # values) inside a trace, and the cached compiled graph would keep
     # executing the FLOAT layers after the rewrite — deactivate and
     # drop caches first (re-hybridize after quantizing if desired)
-    if isinstance(net, HybridBlock):
-        net.hybridize(active=False)
+    from ..gluon.block import Block as _Block
+    if isinstance(net, _Block):
+        net.hybridize(active=False)  # recurses; plain Blocks forward it
     ranges = {}
     if calib_data is not None:
         ranges = calib_graph(net, calib_data,
