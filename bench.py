@@ -394,6 +394,8 @@ def _resnet_from_recordio(loss_fn, params, moms, rng, flops):
         # (the axon tunnel spin-waits across host cores while device
         # work is in flight, poisoning any overlapped measurement of
         # host decode; see BASELINE.md "axon" notes)
+        for _ in batches():  # warm pass: worker spawn + readahead
+            pass
         nb = 0
         t0 = time.perf_counter()
         for _ in batches():

@@ -46,6 +46,7 @@ profiler_set_config = set_config
 def set_state(state_name="stop", profile_process="worker"):
     if state_name == "run":
         _STATE["running"] = True
+        _STATE.pop("peak_memory_bytes", None)  # fresh session, fresh peak
         if os.environ.get("MXNET_PROFILER_AUTOSTART") != "0" and _CONFIG.get("xprof_dir"):
             try:
                 jax.profiler.start_trace(_CONFIG["xprof_dir"])
@@ -71,8 +72,33 @@ def state():
     return "run" if _STATE["running"] else "stop"
 
 
+def peak_memory_bytes():
+    """Peak device bytes_in_use observed across profiled ops (requires
+    set_config(profile_memory=True) and a backend with memory stats;
+    returns None if nothing was sampled)."""
+    return _STATE.get("peak_memory_bytes")
+
+
 def is_running():
     return _STATE["running"]
+
+
+def _device_bytes_in_use():
+    """Live device memory (reference src/profiler/ memory profiling
+    analog): PJRT memory_stats when the backend provides them, else the
+    byte total of live jax.Arrays (framework-tracked allocations — the
+    runtime's pool internals aren't visible through the axon tunnel or
+    the CPU backend)."""
+    try:
+        stats = jax.devices()[0].memory_stats()
+        if stats and "bytes_in_use" in stats:
+            return int(stats["bytes_in_use"])
+    except Exception:
+        pass
+    try:
+        return int(sum(a.nbytes for a in jax.live_arrays()))
+    except Exception:
+        return None
 
 
 def record_op(name, begin_us, end_us, category="operator"):
@@ -80,9 +106,16 @@ def record_op(name, begin_us, end_us, category="operator"):
     if not _STATE["running"]:
         return
     with _LOCK:
-        _EVENTS.append({"name": name, "cat": category, "ph": "X",
-                        "ts": begin_us, "dur": end_us - begin_us,
-                        "pid": os.getpid(), "tid": threading.get_ident()})
+        ev = {"name": name, "cat": category, "ph": "X",
+              "ts": begin_us, "dur": end_us - begin_us,
+              "pid": os.getpid(), "tid": threading.get_ident()}
+        if _CONFIG["profile_memory"]:
+            mem = _device_bytes_in_use()
+            if mem is not None:
+                ev["args"] = {"bytes_in_use": mem}
+                peak = _STATE.get("peak_memory_bytes", 0)
+                _STATE["peak_memory_bytes"] = max(peak, mem)
+        _EVENTS.append(ev)
         if _CONFIG["aggregate_stats"]:
             agg = _AGGREGATE.setdefault(name, [0, 0.0, float("inf"), 0.0])
             dur = (end_us - begin_us) / 1e3

@@ -273,6 +273,8 @@ class Symbol:
                 flat = _reg._DISPATCH_CAST_HOOK(op, flat)
             params = {k: _parse_param(v) for k, v in attrs.items()
                       if v is not None}
+            from ..ndarray.register import _note_invocation
+            _note_invocation(op)
             out = op.fn(*flat, **params)
             vis = op.num_visible_outputs
             if vis is not None and isinstance(out, (tuple, list)):
@@ -349,7 +351,8 @@ class Symbol:
                     structs = [jax.ShapeDtypeStruct(s, np.float32)
                                for s in in_shapes]
                     out = jax.eval_shape(
-                        lambda *xs: node._op.fn(*xs, **params), *structs)
+                        lambda *xs: _sym_note(node._op, node._op.fn(
+                            *xs, **params)), *structs)
                 except Exception:
                     continue
                 if not isinstance(out, (tuple, list)):
@@ -588,6 +591,14 @@ _PARAM_SHAPE_HINTS = {
     "LogisticRegressionOutput": _hint_regression_label,
     "MAERegressionOutput": _hint_regression_label,
 }
+
+
+def _sym_note(op, out):
+    # record only AFTER op.fn succeeded — a broken op must not satisfy
+    # the coverage gate just by appearing in a shape-inference graph
+    from ..ndarray.register import _note_invocation
+    _note_invocation(op)
+    return out
 
 
 def _attr_str(v):

