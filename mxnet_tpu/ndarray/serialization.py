@@ -116,7 +116,8 @@ def save(fname: str, data):
     else:
         raise MXNetError("save expects NDArray | list | dict")
 
-    with open(fname, "wb") as f:
+    from ..filesystem import open_uri
+    with open_uri(fname, "wb") as f:
         f.write(struct.pack("<QQ", LIST_MAGIC, 0))
         f.write(struct.pack("<Q", len(arrays)))
         for a in arrays:
@@ -130,7 +131,8 @@ def save(fname: str, data):
 
 def load(fname: str):
     """mx.nd.load — returns list or dict matching how it was saved."""
-    with open(fname, "rb") as f:
+    from ..filesystem import open_uri
+    with open_uri(fname, "rb") as f:
         data = f.read()
     r = _Reader(data)
     magic, _ = r.read("<QQ")

@@ -15,6 +15,7 @@ import struct
 
 import numpy as np
 
+from . import filesystem as _fs
 from .base import MXNetError
 
 __all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
@@ -41,10 +42,10 @@ class MXRecordIO:
         self.uri = uri
         self.flag = flag
         if flag == "w":
-            self.fhandle = open(uri, "wb")
+            self.fhandle = _fs.open_uri(uri, "wb")
             self.writable = True
         elif flag == "r":
-            self.fhandle = open(uri, "rb")
+            self.fhandle = _fs.open_uri(uri, "rb")
             self.writable = False
         else:
             raise ValueError("Invalid flag %s" % flag)
@@ -67,7 +68,7 @@ class MXRecordIO:
     def open(self):
         if getattr(self, "is_open", False):
             return
-        self.fhandle = open(self.uri, "wb" if self.flag == "w" else "rb")
+        self.fhandle = _fs.open_uri(self.uri, "wb" if self.flag == "w" else "rb")
         self.is_open = True
         self.pid = os.getpid()
 
@@ -142,7 +143,7 @@ class MXIndexedRecordIO(MXRecordIO):
         self.key_type = key_type
         super().__init__(uri, flag)
         if not self.writable and os.path.isfile(idx_path):
-            with open(idx_path) as fin:
+            with _fs.open_uri(idx_path, "r") as fin:
                 for line in fin:
                     line = line.strip().split("\t")
                     key = key_type(line[0])
@@ -153,7 +154,7 @@ class MXIndexedRecordIO(MXRecordIO):
         if not getattr(self, "is_open", False):
             return
         if self.writable:
-            with open(self.idx_path, "w") as fout:
+            with _fs.open_uri(self.idx_path, "w") as fout:
                 for k in self.keys:
                     fout.write(f"{k}\t{self.idx[k]}\n")
         super().close()
