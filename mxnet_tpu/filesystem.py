@@ -27,7 +27,8 @@ import threading
 
 from .base import MXNetError
 
-__all__ = ["open_uri", "exists", "register_scheme", "MemoryFileSystem"]
+__all__ = ["open_uri", "exists", "list_prefix", "register_scheme",
+           "MemoryFileSystem"]
 
 _LOCK = threading.Lock()
 
@@ -39,6 +40,34 @@ def _split_scheme(uri):
     return "", str(uri)
 
 
+class _MemWriter(io.BytesIO):
+    def __init__(self, store, path, initial=b""):
+        super().__init__()
+        self._store = store
+        self._path = path
+        if initial:
+            self.write(initial)
+
+    def close(self):
+        if not self.closed:  # idempotent, like real file objects
+            self._store[self._path] = self.getvalue()
+        super().close()
+
+
+class _MemTextWriter(io.StringIO):
+    def __init__(self, store, path, initial=""):
+        super().__init__()
+        self._store = store
+        self._path = path
+        if initial:
+            self.write(initial)
+
+    def close(self):
+        if not self.closed:
+            self._store[self._path] = self.getvalue().encode()
+        super().close()
+
+
 class MemoryFileSystem:
     """In-process byte store behind ``memory://`` URIs."""
 
@@ -46,27 +75,25 @@ class MemoryFileSystem:
         self._files: dict[str, bytes] = {}
 
     def open(self, path, mode):
+        if "+" in mode:
+            raise MXNetError(
+                f"memory:// does not support update mode {mode!r}")
         if "r" in mode:
             if path not in self._files:
                 raise FileNotFoundError(f"memory://{path}")
             data = self._files[path]
             return io.BytesIO(data) if "b" in mode \
                 else io.StringIO(data.decode())
-        store = self._files
-
-        class _Writer(io.BytesIO if "b" in mode else io.StringIO):
-            def close(self2):
-                val = self2.getvalue()
-                store[path] = val if isinstance(val, bytes) else val.encode()
-                super(type(self2), self2).close()
-
-            def __exit__(self2, *exc):
-                self2.close()
-
-        return _Writer()
+        initial = self._files.get(path, b"") if "a" in mode else b""
+        if "b" in mode:
+            return _MemWriter(self._files, path, initial)
+        return _MemTextWriter(self._files, path, initial.decode())
 
     def exists(self, path):
         return path in self._files
+
+    def list(self, prefix):
+        return sorted(p for p in self._files if p.startswith(prefix))
 
     def clear(self):
         self._files.clear()
@@ -77,18 +104,19 @@ _MEMORY = MemoryFileSystem()
 _SCHEMES: dict = {}
 
 
-def register_scheme(scheme, opener, exists_fn=None):
+def register_scheme(scheme, opener, exists_fn=None, list_fn=None):
     """Register a URI scheme handler.
 
-    ``opener(path, mode) -> file-like``; optional ``exists_fn(path)``.
-    This is how an S3/HDFS/GCS client plugs in (dmlc registered its
-    cloud filesystems the same way at build time).
+    ``opener(path, mode) -> file-like``; optional ``exists_fn(path)``
+    and ``list_fn(prefix) -> [path, ...]`` (sharded-checkpoint
+    discovery needs listing). This is how an S3/HDFS/GCS client plugs
+    in (dmlc registered its cloud filesystems the same way).
     """
     with _LOCK:
-        _SCHEMES[scheme.lower()] = (opener, exists_fn)
+        _SCHEMES[scheme.lower()] = (opener, exists_fn, list_fn)
 
 
-register_scheme("memory", _MEMORY.open, _MEMORY.exists)
+register_scheme("memory", _MEMORY.open, _MEMORY.exists, _MEMORY.list)
 
 
 def open_uri(uri, mode="rb"):
@@ -108,12 +136,35 @@ def open_uri(uri, mode="rb"):
     return entry[0](path, mode)
 
 
+def _scheme_entry(scheme, uri, capability, idx):
+    with _LOCK:
+        entry = _SCHEMES.get(scheme)
+    if entry is None:
+        raise MXNetError(
+            f"no filesystem registered for scheme {scheme!r} (uri {uri!r}); "
+            "register one with mxnet_tpu.filesystem.register_scheme")
+    if entry[idx] is None:
+        # a silent False/[] would make existence-gated loads skip REAL
+        # data — signal the capability gap instead
+        raise MXNetError(
+            f"filesystem for scheme {scheme!r} registered no "
+            f"{capability} handler (uri {uri!r})")
+    return entry[idx]
+
+
 def exists(uri):
     scheme, path = _split_scheme(uri)
     if scheme in ("", "file"):
         return os.path.exists(path)
-    with _LOCK:
-        entry = _SCHEMES.get(scheme)
-    if entry is None or entry[1] is None:
-        return False
-    return entry[1](path)
+    return _scheme_entry(scheme, uri, "exists", 1)(path)
+
+
+def list_prefix(uri_prefix):
+    """All URIs under a prefix (sharded-checkpoint discovery; the
+    local scheme globs ``prefix*``)."""
+    scheme, path = _split_scheme(uri_prefix)
+    if scheme in ("", "file"):
+        import glob as _glob
+        return sorted(_glob.glob(path + "*"))
+    lister = _scheme_entry(scheme, uri_prefix, "list", 2)
+    return [f"{scheme}://{p}" for p in lister(path)]

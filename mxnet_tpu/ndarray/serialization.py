@@ -200,9 +200,12 @@ def load_sharded(prefix: str, ctx: Context | None = None) -> dict:
     Reads every shard file under the prefix (single reader or each host
     reading all shards — loading only local shards is an optimization
     for the trainer restore path)."""
-    import glob
+    import fnmatch
 
-    files = sorted(glob.glob(f"{prefix}.shard-*.params"))
+    from ..filesystem import list_prefix
+
+    files = sorted(f for f in list_prefix(f"{prefix}.shard-")
+                   if fnmatch.fnmatch(f, f"{prefix}.shard-*.params"))
     if not files:
         raise MXNetError(f"no shard files found for prefix {prefix!r}")
     buffers: dict = {}

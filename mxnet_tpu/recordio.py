@@ -142,7 +142,7 @@ class MXIndexedRecordIO(MXRecordIO):
         self.keys = []
         self.key_type = key_type
         super().__init__(uri, flag)
-        if not self.writable and os.path.isfile(idx_path):
+        if not self.writable and _fs.exists(idx_path):
             with _fs.open_uri(idx_path, "r") as fin:
                 for line in fin:
                     line = line.strip().split("\t")

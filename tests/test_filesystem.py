@@ -67,3 +67,43 @@ def test_recordio_via_memory_uri():
     assert rec.read() == b"one"
     assert rec.read() == b"two"
     rec.close()
+
+
+def test_memory_append_and_double_close():
+    with fs.open_uri("memory://ap.bin", "wb") as f:
+        f.write(b"ab")
+    f2 = fs.open_uri("memory://ap.bin", "ab")
+    f2.write(b"cd")
+    f2.close()
+    f2.close()  # idempotent, like real files
+    with fs.open_uri("memory://ap.bin", "rb") as f:
+        assert f.read() == b"abcd"
+    with pytest.raises(MXNetError, match="update mode"):
+        fs.open_uri("memory://ap.bin", "r+b")
+
+
+def test_capability_gap_raises_not_false():
+    fs.register_scheme("openonly", lambda p, m: None)
+    with pytest.raises(MXNetError, match="exists"):
+        fs.exists("openonly://x")
+    with pytest.raises(MXNetError, match="list"):
+        fs.list_prefix("openonly://x")
+
+
+def test_indexed_recordio_via_memory_uri():
+    rec = mx.recordio.MXIndexedRecordIO("memory://ix.idx", "memory://ix.rec", "w")
+    for i in range(3):
+        rec.write_idx(i, b"rec%d" % i)
+    rec.close()
+    rec = mx.recordio.MXIndexedRecordIO("memory://ix.idx", "memory://ix.rec", "r")
+    assert rec.read_idx(1) == b"rec1"
+    assert rec.read_idx(2) == b"rec2"
+    rec.close()
+
+
+def test_sharded_checkpoint_via_memory_uri():
+    from mxnet_tpu import nd as _nd
+    data = {"w": _nd.array(np.arange(8, dtype=np.float32).reshape(2, 4))}
+    _nd.save_sharded("memory://shard/ckpt", data)
+    back = _nd.load_sharded("memory://shard/ckpt")
+    np.testing.assert_allclose(back["w"].asnumpy(), data["w"].asnumpy())
