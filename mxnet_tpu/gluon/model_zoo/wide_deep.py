@@ -10,6 +10,7 @@ Trainer.row_sparse_pull / lazy sparse optimizer updates.
 """
 from __future__ import annotations
 
+from ...ndarray import NDArray
 from ..block import HybridBlock
 from ..nn import Dense, Embedding, HybridSequential
 
@@ -29,20 +30,37 @@ class WideDeep(HybridBlock):
 
     def __init__(self, wide_dim, field_dims, embed_dim=16,
                  hidden_units=(256, 128, 64), num_classes=2,
-                 sparse_grad=True, prefix=None, params=None):
+                 sparse_grad=True, fused_fields=True, prefix=None,
+                 params=None):
         super().__init__(prefix=prefix, params=params)
         self._num_fields = len(field_dims)
+        self._embed_dim = embed_dim
+        self._fused = bool(fused_fields)
         with self.name_scope():
             # wide: linear weights as a (wide_dim, num_classes) table;
             # a multi-hot sample is the sum of its active rows
             self.wide = Embedding(wide_dim, num_classes,
                                   sparse_grad=sparse_grad, prefix="wide_")
-            self.embeddings = []
-            for i, dim in enumerate(field_dims):
-                emb = Embedding(dim, embed_dim, sparse_grad=sparse_grad,
-                                prefix=f"embed{i}_")
-                self.register_child(emb)
-                self.embeddings.append(emb)
+            if self._fused:
+                # ONE table over all fields + static id offsets: a
+                # single (B*F)-row gather instead of F separate gathers
+                # — the HBM-roofline fix for the gather-bound config
+                # (each per-field gather is its own fusion with its own
+                # latency; one big take streams at bandwidth)
+                import numpy as _np
+                self._field_offsets = _np.cumsum([0] + list(field_dims[:-1]))
+                self.field_embed = Embedding(int(sum(field_dims)),
+                                             embed_dim,
+                                             sparse_grad=sparse_grad,
+                                             prefix="fields_")
+                self.embeddings = []
+            else:
+                self.embeddings = []
+                for i, dim in enumerate(field_dims):
+                    emb = Embedding(dim, embed_dim, sparse_grad=sparse_grad,
+                                    prefix=f"embed{i}_")
+                    self.register_child(emb)
+                    self.embeddings.append(emb)
             self.deep = HybridSequential(prefix="deep_")
             with self.deep.name_scope():
                 for h in hidden_units:
@@ -53,10 +71,18 @@ class WideDeep(HybridBlock):
         """wide_x: (B, Nw) int multi-hot indices; cat_x: (B, F) one id
         per field; cont_x: optional (B, C) continuous features."""
         wide_out = F.sum(self.wide(wide_x), axis=1)      # (B, classes)
-        embs = [emb(F.slice_axis(cat_x, axis=1, begin=i, end=i + 1)
-                    .reshape((-1,)))
-                for i, emb in enumerate(self.embeddings)]
-        deep_in = F.concat(*embs, dim=-1)
+        if self._fused:
+            offs = F.array(self._field_offsets.reshape(1, -1),
+                           dtype="int32") if isinstance(cat_x, NDArray) \
+                else self._field_offsets.reshape(1, -1)
+            ids = (cat_x + offs).reshape((-1,))
+            deep_in = self.field_embed(ids).reshape(
+                (-1, self._num_fields * self._embed_dim))
+        else:
+            embs = [emb(F.slice_axis(cat_x, axis=1, begin=i, end=i + 1)
+                        .reshape((-1,)))
+                    for i, emb in enumerate(self.embeddings)]
+            deep_in = F.concat(*embs, dim=-1)
         if cont_x is not None:
             deep_in = F.concat(deep_in, cont_x, dim=-1)
         return wide_out + self.deep(deep_in)

@@ -326,3 +326,52 @@ def test_hybridize_remat_matches_plain():
                         atol=1e-7)
     for a, b in zip(results[True][2], results[False][2]):
         assert_almost_equal(a, b, rtol=1e-6, atol=1e-7)
+
+
+def test_wide_deep_fused_fields_matches_per_field():
+    """The fused single-table field embedding (one (B*F)-row gather)
+    must match the per-field gather path exactly when the tables hold
+    the same rows."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    rng = np.random.RandomState(9)
+    fdims = [7, 11, 5]
+    kw = dict(wide_dim=50, num_fields=3, field_dim=0, embed_dim=4,
+              hidden_units=(8,), num_classes=2)
+
+    from mxnet_tpu.gluon.model_zoo.wide_deep import WideDeep
+    net_f = WideDeep(50, fdims, embed_dim=4, hidden_units=(8,),
+                     fused_fields=True)
+    net_p = WideDeep(50, fdims, embed_dim=4, hidden_units=(8,),
+                     fused_fields=False)
+    net_f.initialize(init=mx.initializer.Xavier())
+    net_p.initialize(init=mx.initializer.Xavier())
+    # materialize deferred-init MLP weights before copying
+    warm_w = nd.zeros((2, 6), dtype="int32")
+    warm_c = nd.zeros((2, 3), dtype="int32")
+    warm_x = nd.zeros((2, 3))
+    with mx.autograd.predict_mode():
+        net_f(warm_w, warm_c, warm_x)
+        net_p(warm_w, warm_c, warm_x)
+    # copy fused table rows into the per-field tables (and shared rest)
+    tbl = net_f.field_embed.weight.data().asnumpy()
+    off = 0
+    for emb, d in zip(net_p.embeddings, fdims):
+        emb.weight.set_data(nd.array(tbl[off:off + d]))
+        off += d
+    net_p.wide.weight.set_data(net_f.wide.weight.data())
+    for lf, lp in zip(net_f.deep, net_p.deep):
+        lp.weight.set_data(lf.weight.data())
+        if lp.bias is not None:
+            lp.bias.set_data(lf.bias.data())
+
+    wide_x = nd.array(rng.randint(0, 50, (4, 6)), dtype="int32")
+    cat_x = nd.array(np.stack([rng.randint(0, d, 4) for d in fdims], 1),
+                     dtype="int32")
+    cont = nd.array(rng.rand(4, 3).astype(np.float32))
+    with mx.autograd.predict_mode():
+        of = net_f(wide_x, cat_x, cont).asnumpy()
+        op = net_p(wide_x, cat_x, cont).asnumpy()
+    np.testing.assert_allclose(of, op, rtol=1e-5, atol=1e-6)
