@@ -187,12 +187,12 @@ def _guard_impossible(timed, flops_per_step, bytes_per_step=0.0):
     if flops_per_step > 0 and peak > 0:
         impossible = STEPS * flops_per_step / (1.5 * peak * 1e12)
     if bytes_per_step > 0 and hbm > 0:
-        # memory-bound configs (Wide&Deep) evade the FLOPs bound — a
-        # rate implying >1.5x peak HBM bandwidth is equally impossible
-        # (this caught a 54x-HBM glitch reading that the round-3 422k
-        # ex/s record likely shares)
+        # memory-bound configs (Wide&Deep) evade the FLOPs bound — but
+        # bytes_accessed OVER-counts true HBM traffic (fusion/VMEM
+        # re-reads), so use a wide 8x slack: rejects the observed 54x
+        # glitch class without false-positives on heavily fused steps
         impossible = max(impossible,
-                         STEPS * bytes_per_step / (1.5 * hbm * 1e9))
+                         STEPS * bytes_per_step / (8.0 * hbm * 1e9))
     if impossible > 0:
         for _ in range(2):
             if dt >= impossible:
@@ -278,7 +278,7 @@ def main():
         for _ in range(WARMUP):
             out = infer(params, rng, x)
         jax.block_until_ready(out)
-        dt = _guard_impossible(timed_infer, iflops)
+        dt = _guard_impossible(timed_infer, iflops, ibytes)
         _report("resnet50_infer_images_per_sec_per_chip", BATCH * STEPS / dt,
                 "images/sec/chip", 0.0, flops_per_step=iflops,
                 sec_per_step=dt / STEPS, bytes_per_step=ibytes,

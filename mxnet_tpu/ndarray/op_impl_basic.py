@@ -97,6 +97,14 @@ def cast(x, dtype="float32"):
     return x.astype(dtype_np(dtype))
 
 
+@register_op("_constant", differentiable=False)
+def _constant(value=None, dtype="float32"):
+    """Embed a small static constant into the graph (works on every
+    frontend path: eager, traced, and SYMBOLIC — symbols cannot wrap
+    runtime numpy arrays, so constants must be op parameters)."""
+    return jnp.asarray(np.asarray(value), dtype_np(dtype))
+
+
 @register_op("amp_cast")
 def amp_cast(x, dtype="float32"):
     return x.astype(dtype_np(dtype))

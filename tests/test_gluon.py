@@ -338,9 +338,6 @@ def test_wide_deep_fused_fields_matches_per_field():
     from mxnet_tpu import nd
     rng = np.random.RandomState(9)
     fdims = [7, 11, 5]
-    kw = dict(wide_dim=50, num_fields=3, field_dim=0, embed_dim=4,
-              hidden_units=(8,), num_classes=2)
-
     from mxnet_tpu.gluon.model_zoo.wide_deep import WideDeep
     net_f = WideDeep(50, fdims, embed_dim=4, hidden_units=(8,),
                      fused_fields=True)
@@ -375,3 +372,17 @@ def test_wide_deep_fused_fields_matches_per_field():
         of = net_f(wide_x, cat_x, cont).asnumpy()
         op = net_p(wide_x, cat_x, cont).asnumpy()
     np.testing.assert_allclose(of, op, rtol=1e-5, atol=1e-6)
+
+
+def test_wide_deep_fused_symbolic_path():
+    """The fused gather must also build SYMBOLICALLY (offsets embed via
+    the _constant op — symbols cannot wrap runtime numpy arrays)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo.wide_deep import WideDeep
+
+    net = WideDeep(20, [4, 6], embed_dim=3, hidden_units=(5,),
+                   fused_fields=True)
+    net.initialize()
+    sym = net(mx.sym.Variable("w"), mx.sym.Variable("c"),
+              mx.sym.Variable("x"))
+    assert sym is not None and sym.list_arguments()
