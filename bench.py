@@ -283,7 +283,9 @@ def main():
         for _ in range(WARMUP):
             out = infer(params, rng, x)
         jax.block_until_ready(out)
-        dt = _guard_impossible(timed_infer, iflops, ibytes)
+        dt = _guard_impossible(
+            lambda: sorted(timed_infer() for _ in range(3))[1],
+            iflops, ibytes)
         _report("resnet50_infer_images_per_sec_per_chip", BATCH * STEPS / dt,
                 "images/sec/chip", 0.0, flops_per_step=iflops,
                 sec_per_step=dt / STEPS, bytes_per_step=ibytes,
