@@ -66,6 +66,7 @@ from . import image as img
 from . import callback
 from . import monitor
 from . import model
+from . import operator
 from . import profiler
 from . import parallel
 from . import test_utils

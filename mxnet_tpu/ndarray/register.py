@@ -63,10 +63,12 @@ class Op:
     """
 
     __slots__ = ("name", "fn", "differentiable", "aliases",
-                 "num_visible_outputs", "mutates", "dynamic_arity")
+                 "num_visible_outputs", "mutates", "dynamic_arity",
+                 "infer_num_outputs", "infer_input_names")
 
     def __init__(self, name, fn, differentiable=True, aliases=(),
-                 num_visible_outputs=None, mutates=(), dynamic_arity=False):
+                 num_visible_outputs=None, mutates=(), dynamic_arity=False,
+                 infer_num_outputs=None, infer_input_names=None):
         self.name = name
         self.fn = fn
         self.differentiable = differentiable
@@ -81,6 +83,11 @@ class Op:
         # arity override so an unrelated param named num_outputs on a
         # future op can't silently mis-route sym[i] indexing
         self.dynamic_arity = bool(dynamic_arity)
+        # param-dependent metadata hooks (mx.operator Custom: output
+        # count and input names come from the user's CustomOpProp, keyed
+        # by the op_type param) — callable(params_dict) -> int / [str]
+        self.infer_num_outputs = infer_num_outputs
+        self.infer_input_names = infer_input_names
 
     def __repr__(self):
         return f"<Op {self.name}>"
@@ -88,7 +95,8 @@ class Op:
 
 def register_op(name=None, *, differentiable=True, aliases=(),
                 num_visible_outputs=None, mutates=(), wrap=True,
-                dynamic_arity=False):
+                dynamic_arity=False, infer_num_outputs=None,
+                infer_input_names=None):
     """Decorator: register a JAX function as an operator.
 
     ``wrap=False`` registers the op but does not expose a generated
@@ -99,7 +107,9 @@ def register_op(name=None, *, differentiable=True, aliases=(),
         op_name = name or fn.__name__
         op = Op(op_name, fn, differentiable=differentiable, aliases=aliases,
                 num_visible_outputs=num_visible_outputs, mutates=mutates,
-                dynamic_arity=dynamic_arity)
+                dynamic_arity=dynamic_arity,
+                infer_num_outputs=infer_num_outputs,
+                infer_input_names=infer_input_names)
         _OPS[op_name] = op
         for a in aliases:
             _OPS[a] = op

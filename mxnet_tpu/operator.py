@@ -1,0 +1,327 @@
+"""Custom operator registration — ``mx.operator`` (reference
+python/mxnet/operator.py + src/operator/custom/custom.cc analog).
+
+The reference lets users register a Python operator by name
+(``@mx.operator.register("softmax")`` on a ``CustomOpProp`` subclass)
+and use it from NDArray, Gluon and Symbol/Module via the ``Custom`` op,
+with forward/backward/infer_shape callbacks crossing the C FFI on a
+dedicated worker thread. TPU-native redesign: the user's callbacks run
+*inside the trace* — forward/backward receive NDArrays that wrap JAX
+tracers, so a CustomOp written with ``mx.nd`` ops compiles into the same
+XLA computation as everything around it (no host round-trip per call,
+which on an accelerator-over-network setup would dominate). The
+gradient contract is kept with ``jax.custom_vjp``: autograd/jit call the
+user's ``backward`` instead of differentiating through ``forward``.
+
+Consequences of the traced design (vs the reference's host-side
+callbacks):
+- callbacks must be jit-traceable (no data-dependent Python branching
+  on tensor *values*; shapes/dtypes are concrete as usual);
+- ``declare_backward_dependency`` is accepted but unused — XLA's DCE
+  keeps exactly the residuals the backward needs;
+- auxiliary states are not supported (immutable functional arrays);
+  ``list_auxiliary_states`` must return ``[]``;
+- ``create_operator`` runs once per forward AND once per backward —
+  do not stash tensors on ``self`` in ``forward`` expecting them in
+  ``backward`` (tracer state cannot cross the jax.custom_vjp boundary
+  anyway); everything the backward needs is in its
+  ``in_data``/``out_data``/``out_grad`` arguments.
+
+Example (the classic custom softmax loss, reference
+example/numpy-ops/custom_softmax.py shape):
+
+    @mx.operator.register("mysoftmax")
+    class MySoftmaxProp(mx.operator.CustomOpProp):
+        def __init__(self):
+            super().__init__(need_top_grad=False)
+        def list_arguments(self):
+            return ["data", "label"]
+        def infer_shape(self, in_shape):
+            return in_shape, [in_shape[0]], []
+        def create_operator(self, ctx, shapes, dtypes):
+            return MySoftmax()
+
+    out = mx.nd.Custom(data, label, op_type="mysoftmax")
+    sym = mx.sym.Custom(data=x, label=y, op_type="mysoftmax")
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import numpy as np
+
+from .base import MXNetError
+from .context import current_context
+
+__all__ = ["CustomOp", "CustomOpProp", "register",
+           "get_all_registered_operators"]
+
+# op_type -> CustomOpProp subclass
+_PROP_REGISTRY: dict[str, type] = {}
+
+
+class CustomOp:
+    """Base class for the user's operator implementation (reference
+    mxnet.operator.CustomOp). ``forward``/``backward`` receive lists of
+    NDArrays; results are written into the provided output lists with
+    :meth:`assign`."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    def assign(self, dst, req, src):
+        """Honor the write request: 'write'/'inplace' overwrite, 'add'
+        accumulates, 'null' is a no-op."""
+        if req == "null":
+            return
+        from .ndarray import NDArray
+        if not isinstance(src, NDArray):
+            from .ndarray.ndarray import _wrap
+            src = _wrap(src, dst.ctx)
+        if req == "add":
+            dst._set_data((dst + src)._data)
+        else:  # write / inplace
+            dst._set_data(src._data if src.dtype == dst.dtype
+                          else src.astype(dst.dtype)._data)
+
+
+class CustomOpProp:
+    """Operator property class: declares the interface of a custom op
+    (reference mxnet.operator.CustomOpProp). Subclass and override."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = bool(need_top_grad)
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def infer_shape(self, in_shape):
+        """Default: all inputs share the first input's shape; one output
+        of that shape (reference default)."""
+        return in_shape, [in_shape[0]] * len(self.list_outputs()), []
+
+    def infer_type(self, in_type):
+        """Default: everything takes the first input's dtype."""
+        return (in_type, [in_type[0]] * len(self.list_outputs()),
+                [in_type[0]] * len(self.list_auxiliary_states()))
+
+    def infer_storage_type(self, in_stype):
+        return (in_stype, ["default"] * len(self.list_outputs()),
+                ["default"] * len(self.list_auxiliary_states()))
+
+    def infer_storage_type_backward(self, ograd_stype, in_stype, out_stype,
+                                    igrad_stype, aux_stype):
+        return (ograd_stype, in_stype, out_stype,
+                ["default"] * len(in_stype), aux_stype)
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        """Accepted for parity; residual liveness is XLA's job here."""
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+    def need_top_grad(self):
+        return self.need_top_grad_
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return CustomOp()
+
+
+def register(reg_name):
+    """Class decorator: register a CustomOpProp subclass under a name
+    usable as ``op_type`` (reference mx.operator.register)."""
+
+    def do_register(prop_cls):
+        if not issubclass(prop_cls, CustomOpProp):
+            raise MXNetError(
+                f"mx.operator.register({reg_name!r}) expects a CustomOpProp "
+                f"subclass, got {prop_cls}")
+        _PROP_REGISTRY[reg_name] = prop_cls
+        return prop_cls
+
+    return do_register
+
+
+def get_all_registered_operators():
+    """Names registered via mx.operator.register."""
+    return sorted(_PROP_REGISTRY)
+
+
+def _make_prop(op_type, params):
+    """Instantiate the registered prop with the op's non-tensor params
+    (the reference passes every kwarg to the prop ctor as a string)."""
+    try:
+        prop_cls = _PROP_REGISTRY[op_type]
+    except KeyError:
+        raise MXNetError(
+            f"custom op type {op_type!r} is not registered; known: "
+            f"{get_all_registered_operators()}") from None
+    kwargs = {k: (v if isinstance(v, str) else str(v))
+              for k, v in params.items()}
+    return prop_cls(**kwargs)
+
+
+def _check_no_aux(prop, op_type):
+    if prop.list_auxiliary_states():
+        raise MXNetError(
+            f"custom op {op_type!r}: auxiliary states are not supported in "
+            "the traced CustomOp design (functional arrays are immutable); "
+            "model state belongs in Gluon Parameters")
+
+
+def _np_dtype(d):
+    return np.dtype(d)
+
+
+@functools.lru_cache(maxsize=None)
+def _build_custom_fn(op_type, frozen_params, n_args, is_train):
+    """Build (and cache) the jax.custom_vjp callable for one
+    (op_type, params) instantiation. The forward runs the user's
+    CustomOp.forward on tracer-backed NDArrays; the custom VJP runs the
+    user's backward — so autograd and jit both honor the user's gradient
+    (reference: CustomOperator dispatches forward/backward callbacks,
+    src/operator/custom/custom.cc)."""
+    from . import autograd as _autograd
+    from .ndarray.ndarray import _wrap
+    from .ndarray import zeros as _nd_zeros
+
+    params = dict(frozen_params)
+    prop = _make_prop(op_type, params)
+    _check_no_aux(prop, op_type)
+    arg_names = list(prop.list_arguments())
+    out_names = list(prop.list_outputs())
+    if n_args != len(arg_names):
+        raise MXNetError(
+            f"custom op {op_type!r} declares {len(arg_names)} arguments "
+            f"{arg_names} but was called with {n_args} tensor inputs")
+
+    def _shapes_dtypes(arrays):
+        in_shapes = [list(a.shape) for a in arrays]
+        ret = prop.infer_shape(in_shapes)
+        if len(ret) < 2:
+            raise MXNetError(
+                f"custom op {op_type!r}: infer_shape must return "
+                "(in_shape, out_shape, aux_shape)")
+        out_shapes = ret[1]
+        tret = prop.infer_type([_np_dtype(a.dtype) for a in arrays])
+        out_dtypes = tret[1]
+        return out_shapes, out_dtypes
+
+    def _run_forward(arrays, train):
+        ctx = current_context()
+        out_shapes, out_dtypes = _shapes_dtypes(arrays)
+        in_data = [_wrap(a, ctx) for a in arrays]
+        out_data = [_nd_zeros(tuple(int(d) for d in s), ctx=ctx,
+                              dtype=_np_dtype(t))
+                    for s, t in zip(out_shapes, out_dtypes)]
+        op_inst = prop.create_operator(ctx, [list(a.shape) for a in arrays],
+                                       [_np_dtype(a.dtype) for a in arrays])
+        prev = _autograd.set_recording(False)
+        try:
+            op_inst.forward(is_train=train, req=["write"] * len(out_data),
+                            in_data=in_data, out_data=out_data, aux=[])
+        finally:
+            _autograd.set_recording(prev)
+        return tuple(o._data for o in out_data), op_inst
+
+    @jax.custom_vjp
+    def custom_call(*arrays):
+        outs, _ = _run_forward(arrays, is_train)
+        return outs
+
+    def fwd(*arrays):
+        outs, _ = _run_forward(arrays, True)
+        return outs, (arrays, outs)
+
+    def bwd(res, cotangents):
+        arrays, outs = res
+        ctx = current_context()
+        in_data = [_wrap(a, ctx) for a in arrays]
+        out_data = [_wrap(o, ctx) for o in outs]
+        out_grad = [_wrap(c, ctx) for c in cotangents]
+        in_grad = [_wrap(jax.numpy.zeros(a.shape, a.dtype), ctx)
+                   for a in arrays]
+        op_inst = prop.create_operator(ctx, [list(a.shape) for a in arrays],
+                                       [_np_dtype(a.dtype) for a in arrays])
+        prev = _autograd.set_recording(False)
+        try:
+            op_inst.backward(req=["write"] * len(in_grad),
+                             out_grad=out_grad, in_data=in_data,
+                             out_data=out_data, in_grad=in_grad, aux=[])
+        finally:
+            _autograd.set_recording(prev)
+        grads = []
+        for a, g in zip(arrays, in_grad):
+            if np.issubdtype(np.dtype(a.dtype), np.floating):
+                grads.append(g._data.astype(a.dtype))
+            else:
+                # integer/bool primals take float0 cotangents
+                grads.append(np.zeros(a.shape, jax.dtypes.float0))
+        return tuple(grads)
+
+    custom_call.defvjp(fwd, bwd)
+    return custom_call, len(out_names)
+
+
+def _invoke_custom(*arrays, op_type=None, **params):
+    """Registry impl of the ``Custom`` op (pure-JAX callable)."""
+    if op_type is None:
+        raise MXNetError("Custom requires op_type=")
+    from . import autograd as _autograd
+    # tensors passed as kwargs would bypass differentiation (jax.vjp
+    # wraps positionals only) — reject loudly rather than silently
+    # dropping their gradients
+    bad = [k for k, v in params.items()
+           if hasattr(v, "shape") and hasattr(v, "dtype")]
+    if bad:
+        raise MXNetError(
+            f"Custom: pass tensor inputs positionally (got tensor kwargs "
+            f"{bad}); mx.sym.Custom accepts named tensor kwargs")
+    fn, _ = _build_custom_fn(op_type, tuple(sorted(params.items())),
+                             len(arrays), _autograd.is_training())
+    out = fn(*arrays)
+    return out if len(out) > 1 else out[0]
+
+
+def _custom_num_outputs(params):
+    """Symbol-arity hook: output count from the prop's list_outputs()."""
+    p = dict(params)
+    p.pop("name", None)
+    op_type = p.pop("op_type", None)
+    if op_type is None:
+        return 1
+    return len(_make_prop(op_type, p).list_outputs())
+
+
+def _custom_input_names(params):
+    """Symbol input-name hook: the prop's list_arguments()."""
+    p = dict(params)
+    p.pop("name", None)
+    op_type = p.pop("op_type", None)
+    if op_type is None:
+        return None
+    return list(_make_prop(op_type, p).list_arguments())
+
+
+def _register_custom_op():
+    from .ndarray.register import register_op
+
+    register_op("Custom", differentiable=True,
+                infer_num_outputs=_custom_num_outputs,
+                infer_input_names=_custom_input_names)(_invoke_custom)
+
+
+_register_custom_op()

@@ -628,6 +628,10 @@ def _make_node(op, inputs, params, name=None):
             nout = int(params["num_outputs"])
         except (TypeError, ValueError):
             pass
+    if getattr(op, "infer_num_outputs", None) is not None:
+        # param-dependent arity (mx.operator Custom: output count comes
+        # from the registered CustomOpProp's list_outputs())
+        nout = int(op.infer_num_outputs(params))
     return Symbol(op=op, inputs=inputs, attrs=merged, name=name,
                   num_outputs=nout)
 
@@ -733,10 +737,20 @@ def _populate_symbol_ops(module):
     from ..ndarray.register import _OPS
 
     def make(op):
-        input_names = _OP_INPUT_NAMES.get(op.name)
+        static_input_names = _OP_INPUT_NAMES.get(op.name)
 
         def sym_fn(*args, **kwargs):
             name = kwargs.pop("name", None)
+            input_names = static_input_names
+            if input_names is None and \
+                    getattr(op, "infer_input_names", None) is not None:
+                # param-dependent input names (Custom: the prop's
+                # list_arguments()) — lets tensor kwargs bind by name
+                # in the declared order, and missing ones auto-create
+                # variables (label binding for Module)
+                input_names = op.infer_input_names(
+                    {k: v for k, v in kwargs.items()
+                     if not isinstance(v, Symbol)})
             rest = {}
             named_inputs = {}
             inputs = list(args)

@@ -1,0 +1,249 @@
+"""mx.operator CustomOp/CustomOpProp registration API
+(reference python/mxnet/operator.py + src/operator/custom/custom.cc;
+tests mirror tests/python/unittest/test_operator.py::test_custom_op).
+
+The TPU-native design runs the user's forward/backward inside the
+trace (NDArrays wrap JAX tracers), with jax.custom_vjp holding the
+gradient contract — so the same registration works from nd, Gluon,
+Symbol/Module, and under jit.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+@mx.operator.register("t_sqr")
+class SqrProp(mx.operator.CustomOpProp):
+    """y = x^2 with a deliberately scaled backward (2.5x the true grad)
+    so tests can tell the user backward ran, not autodiff."""
+
+    def __init__(self, scale="2.5"):
+        super().__init__(need_top_grad=True)
+        self.scale = float(scale)
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        prop = self
+
+        class Sqr(mx.operator.CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                self.assign(out_data[0], req[0], in_data[0] * in_data[0])
+
+            def backward(self, req, out_grad, in_data, out_data, in_grad,
+                         aux):
+                self.assign(in_grad[0], req[0],
+                            prop.scale * out_grad[0] * in_data[0])
+
+        return Sqr()
+
+
+@mx.operator.register("t_softmax_loss")
+class SoftmaxLossProp(mx.operator.CustomOpProp):
+    """The classic custom softmax loss (reference
+    example/numpy-ops/custom_softmax.py): outputs the softmax, backward
+    is softmax - onehot(label); no top grad."""
+
+    def __init__(self):
+        super().__init__(need_top_grad=False)
+
+    def list_arguments(self):
+        return ["data", "label"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        data_shape = in_shape[0]
+        label_shape = [data_shape[0]]
+        return [data_shape, label_shape], [data_shape], []
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        class SoftmaxLoss(mx.operator.CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                self.assign(out_data[0], req[0],
+                            nd.softmax(in_data[0], axis=-1))
+
+            def backward(self, req, out_grad, in_data, out_data, in_grad,
+                         aux):
+                label = in_data[1]
+                y = out_data[0]
+                oh = nd.one_hot(label, y.shape[-1], dtype=y.dtype)
+                self.assign(in_grad[0], req[0], y - oh)
+                self.assign(in_grad[1], req[1], nd.zeros_like(label))
+
+        return SoftmaxLoss()
+
+
+def test_custom_registered():
+    names = mx.operator.get_all_registered_operators()
+    assert "t_sqr" in names and "t_softmax_loss" in names
+    assert hasattr(nd, "Custom") and hasattr(mx.sym, "Custom")
+
+
+def test_custom_eager_forward_backward():
+    x = nd.array(np.array([[1.0, -2.0], [3.0, 0.5]], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.Custom(x, op_type="t_sqr")
+        loss = y.sum()
+    loss.backward()
+    assert_almost_equal(y.asnumpy(), x.asnumpy() ** 2)
+    # the user backward emits scale * out_grad * x — deliberately NOT
+    # the true 2x grad, so matching it proves the registered backward
+    # replaced autodiff
+    assert_almost_equal(x.grad.asnumpy(), 2.5 * x.asnumpy())
+
+
+def test_custom_param_reaches_prop():
+    x = nd.array(np.ones((2, 2), np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.Custom(x, scale=4.0, op_type="t_sqr")
+    y.backward()
+    assert_almost_equal(x.grad.asnumpy(), 4.0 * np.ones((2, 2)))
+
+
+def test_custom_softmax_loss_grad():
+    rs = np.random.RandomState(0)
+    logits = rs.randn(4, 5).astype(np.float32)
+    labels = np.array([0, 2, 1, 4], np.float32)
+    x = nd.array(logits)
+    lab = nd.array(labels)
+    x.attach_grad()
+    with autograd.record():
+        out = nd.Custom(x, lab, op_type="t_softmax_loss")
+    out.backward()
+    sm = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+    assert_almost_equal(out.asnumpy(), sm, rtol=1e-5, atol=1e-6)
+    oh = np.eye(5, dtype=np.float32)[labels.astype(int)]
+    assert_almost_equal(x.grad.asnumpy(), sm - oh, rtol=1e-5, atol=1e-6)
+
+
+def test_custom_in_gluon_training_loop():
+    """A Gluon net trained with the custom softmax loss converges."""
+    from mxnet_tpu.gluon import nn, Trainer
+
+    rs = np.random.RandomState(1)
+    w = rs.randn(8, 4).astype(np.float32)
+    xs = rs.rand(256, 8).astype(np.float32)
+    ys = (xs @ w).argmax(1).astype(np.float32)
+
+    mx.random.seed(2)
+    net = nn.Dense(4, in_units=8)
+    net.initialize(init=mx.initializer.Xavier())
+    trainer = Trainer(net.collect_params(), "adam",
+                      {"learning_rate": 2e-2})
+    x_all, y_all = nd.array(xs), nd.array(ys)
+    for _ in range(200):
+        with autograd.record():
+            prob = nd.Custom(net(x_all), y_all, op_type="t_softmax_loss")
+            # backward seeds from the output; the custom bwd ignores the
+            # cotangent (need_top_grad=False) and emits softmax - onehot
+        prob.backward()
+        trainer.step(x_all.shape[0])
+    acc = (prob.asnumpy().argmax(1) == ys).mean()
+    assert acc > 0.85, f"custom-loss Gluon training failed to fit: acc={acc}"
+
+
+def test_custom_under_hybridize_and_jit():
+    """Custom inside a hybridized block: compiles into the cached graph
+    and the user backward still defines the gradient."""
+    from mxnet_tpu.gluon import nn
+
+    class Net(mx.gluon.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.fc = nn.Dense(3, in_units=3)
+
+        def hybrid_forward(self, F, x):
+            return F.Custom(self.fc(x), op_type="t_sqr")
+
+    mx.random.seed(3)
+    net = Net()
+    net.initialize()
+    x = nd.array(np.random.RandomState(2).rand(2, 3).astype(np.float32))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    x.attach_grad()
+    with autograd.record():
+        y = net(x)
+        loss = y.sum()
+    loss.backward()
+    assert_almost_equal(y.asnumpy(), eager, rtol=1e-5, atol=1e-6)
+    assert np.abs(x.grad.asnumpy()).sum() > 0
+
+
+def test_custom_symbol_module_fit():
+    """mx.sym.Custom trains under Module.fit (the reference's symbolic
+    custom-op path: registered by name, label variable auto-created)."""
+    rs = np.random.RandomState(4)
+    w = rs.randn(6, 3).astype(np.float32)
+    xs = rs.rand(240, 6).astype(np.float32)
+    ys = (xs @ w).argmax(1).astype(np.float32)
+
+    data = mx.sym.var("data")
+    net = mx.sym.FullyConnected(data, num_hidden=3, name="fc")
+    out = mx.sym.Custom(data=net, op_type="t_softmax_loss", name="softmax")
+    assert "softmax_label" in out.list_arguments()
+
+    train = mx.io.NDArrayIter(xs, ys, batch_size=48, shuffle=True,
+                              label_name="softmax_label")
+    mod = mx.module.Module(out, label_names=["softmax_label"])
+    mod.fit(train, num_epoch=40, optimizer="adam",
+            optimizer_params={"learning_rate": 2e-2})
+    preds = mod.predict(mx.io.NDArrayIter(xs, ys, batch_size=48,
+                                          label_name="softmax_label"))
+    acc = (preds.asnumpy().argmax(1) == ys).mean()
+    assert acc > 0.85, f"Module.fit with custom loss failed: acc={acc}"
+
+
+def test_custom_symbol_infer_shape():
+    """Shape inference flows through the prop's infer_shape callback."""
+    data = mx.sym.var("data")
+    lab = mx.sym.var("lab")
+    out = mx.sym.Custom(data=data, label=lab, op_type="t_softmax_loss")
+    _, out_shapes, _ = out.infer_shape(data=(7, 9), lab=(7,))
+    assert tuple(out_shapes[0]) == (7, 9)
+
+
+def test_custom_multi_output():
+    @mx.operator.register("t_minmax")
+    class MinMaxProp(mx.operator.CustomOpProp):
+        def list_outputs(self):
+            return ["mn", "mx"]
+
+        def infer_shape(self, in_shape):
+            return in_shape, [[in_shape[0][0]], [in_shape[0][0]]], []
+
+        def create_operator(self, ctx, in_shapes, in_dtypes):
+            class MinMax(mx.operator.CustomOp):
+                def forward(self, is_train, req, in_data, out_data, aux):
+                    self.assign(out_data[0], req[0], in_data[0].min(axis=1))
+                    self.assign(out_data[1], req[1], in_data[0].max(axis=1))
+
+                def backward(self, req, out_grad, in_data, out_data,
+                             in_grad, aux):
+                    self.assign(in_grad[0], req[0],
+                                nd.zeros_like(in_data[0]))
+
+            return MinMax()
+
+    x = nd.array(np.array([[3.0, 1.0, 2.0], [5.0, 9.0, 4.0]], np.float32))
+    mn, mxv = nd.Custom(x, op_type="t_minmax")
+    assert_almost_equal(mn.asnumpy(), np.array([1.0, 4.0]))
+    assert_almost_equal(mxv.asnumpy(), np.array([3.0, 9.0]))
+    # symbolic arity follows list_outputs
+    s = mx.sym.Custom(mx.sym.var("x"), op_type="t_minmax")
+    assert s.num_outputs == 2
+
+
+def test_custom_errors():
+    with pytest.raises(mx.MXNetError, match="not registered"):
+        nd.Custom(nd.zeros((2, 2)), op_type="nope_never_registered")
+    with pytest.raises(mx.MXNetError, match="positionally"):
+        nd.Custom(data=nd.zeros((2, 2)), op_type="t_sqr")
+    with pytest.raises(mx.MXNetError, match="expects a CustomOpProp"):
+        mx.operator.register("t_bad")(object)
