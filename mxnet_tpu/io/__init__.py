@@ -1,4 +1,4 @@
 from .io import (
     DataDesc, DataBatch, DataIter, NDArrayIter, ResizeIter, PrefetchingIter,
-    CSVIter, MNISTIter, ImageRecordIter,
+    CSVIter, LibSVMIter, MNISTIter, ImageRecordIter,
 )

@@ -20,7 +20,8 @@ from ..context import Context, cpu, current_context
 from ..ndarray import NDArray, array
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
-           "PrefetchingIter", "CSVIter", "MNISTIter", "ImageRecordIter"]
+           "PrefetchingIter", "CSVIter", "LibSVMIter", "MNISTIter",
+           "ImageRecordIter"]
 
 
 class DataDesc(namedtuple("DataDesc", ["name", "shape"])):
@@ -367,6 +368,166 @@ class CSVIter(DataIter):
 
     def iter_next(self):
         return self._inner.iter_next()
+
+
+def _parse_libsvm(path, num_features):
+    """Parse LibSVM text into CSR triple + labels.
+
+    Format per line: ``<label...> <idx>:<val> <idx>:<val> ...`` with
+    zero-based feature indices (the reference LibSVMIter contract,
+    src/io/iter_libsvm.cc — NOT the 1-based convention of libsvm
+    itself). Multiple leading bare numbers form a multi-value label.
+    Returns (data, indices, indptr, labels) numpy arrays; labels has
+    shape (n,) when every line has one label else (n, label_width).
+    """
+    data, indices, indptr, labels = [], [], [0], []
+    label_width = None
+    with open(path) as f:
+        for ln, line in enumerate(f):
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            toks = line.split()
+            lab = []
+            k = 0
+            for t in toks:
+                if ":" in t:
+                    break
+                lab.append(float(t))
+                k += 1
+            if label_width is None:
+                label_width = len(lab)
+            elif label_width != len(lab):
+                raise MXNetError(
+                    f"{path}:{ln + 1}: inconsistent label width "
+                    f"({len(lab)} vs {label_width})")
+            labels.append(lab)
+            for t in toks[k:]:
+                i, _, v = t.partition(":")
+                i = int(i)
+                if not 0 <= i < num_features:
+                    raise MXNetError(
+                        f"{path}:{ln + 1}: feature index {i} outside "
+                        f"data_shape ({num_features}); indices are "
+                        "ZERO-based (reference LibSVMIter contract)")
+                indices.append(i)
+                data.append(float(v))
+            indptr.append(len(indices))
+    labels = np.asarray(labels, np.float32)
+    if label_width == 1:
+        labels = labels[:, 0]
+    return (np.asarray(data, np.float32), np.asarray(indices, np.int64),
+            np.asarray(indptr, np.int64), labels)
+
+
+class LibSVMIter(DataIter):
+    """LibSVM text → CSR batch iterator (src/io/iter_libsvm.cc analog;
+    the input path of the reference's sparse linear-classification
+    examples, example/sparse/linear_classification).
+
+    Yields ``DataBatch`` whose data is a :class:`CSRNDArray`; the label
+    comes inline from the data file, or from ``label_libsvm`` (also
+    LibSVM-format, for multi-dimensional labels). Whole-file parse at
+    construction (the reference streams chunks; these files are
+    host-RAM-sized here), per-batch CSR slicing after.
+
+    TPU note: downstream compute wants static shapes — ``max_row_nnz``
+    (the densest row of the file) is exposed so callers can convert
+    batches to fixed-width padded gather form with
+    ``mxnet_tpu.ndarray.sparse.csr_to_ell`` (see example/
+    sparse_linear.py); nnz varies per batch in raw CSR form.
+    """
+
+    def __init__(self, data_libsvm, data_shape, label_libsvm=None,
+                 label_shape=None, batch_size=1, round_batch=True,
+                 num_parts=1, part_index=0, ctx=None, **kwargs):
+        super().__init__(batch_size)
+        if isinstance(data_shape, int):
+            data_shape = (data_shape,)
+        if len(data_shape) != 1:
+            raise MXNetError("LibSVMIter: data_shape must be "
+                             "(num_features,)")
+        self._nfeat = int(data_shape[0])
+        self.ctx = ctx or current_context()
+        d, i, p, lab = _parse_libsvm(data_libsvm, self._nfeat)
+        if label_libsvm is not None:
+            if isinstance(label_shape, int):
+                label_shape = (label_shape,)
+            lw = int(label_shape[0]) if label_shape else 1
+            ld, li, lp, _ = _parse_libsvm(label_libsvm, lw)
+            n = len(lp) - 1
+            dense = np.zeros((n, lw), np.float32)
+            rows = np.repeat(np.arange(n), np.diff(lp))
+            dense[rows, li] = ld
+            lab = dense[:, 0] if lw == 1 else dense
+        n = len(p) - 1
+        if lab.shape[0] != n:
+            raise MXNetError(
+                f"LibSVMIter: {n} data rows vs {lab.shape[0]} labels")
+        # worker sharding (num_parts/part_index — reference dmlc
+        # InputSplit role): contiguous row ranges
+        lo = n * part_index // num_parts
+        hi = n * (part_index + 1) // num_parts
+        self._indptr = p[lo:hi + 1] - p[lo]
+        self._indices = i[p[lo]:p[hi]]
+        self._values = d[p[lo]:p[hi]]
+        self._labels = lab[lo:hi]
+        self.num_data = hi - lo
+        if self.num_data < batch_size:
+            raise MXNetError("batch_size larger than the (sharded) data")
+        self.round_batch = round_batch
+        self.max_row_nnz = int(np.diff(self._indptr).max()) \
+            if self.num_data else 0
+        self.cursor = -batch_size
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size, self._nfeat))]
+
+    @property
+    def provide_label(self):
+        shp = (self.batch_size,) + self._labels.shape[1:]
+        return [DataDesc("softmax_label", shp)]
+
+    def reset(self):
+        self.cursor = -self.batch_size
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        return self.cursor < self.num_data
+
+    def _rows(self):
+        """Row ids of the current batch (wraps when round_batch)."""
+        sel = np.arange(self.cursor,
+                        min(self.cursor + self.batch_size, self.num_data))
+        short = self.batch_size - sel.shape[0]
+        if short > 0 and self.round_batch:
+            sel = np.concatenate([sel, np.arange(short)])
+        return sel
+
+    def getdata(self):
+        from ..ndarray.sparse import csr_matrix
+        sel = self._rows()
+        lens = np.diff(self._indptr)[sel]
+        starts = self._indptr[sel]
+        pos = np.concatenate([np.arange(s, s + l)
+                              for s, l in zip(starts, lens)]) \
+            if sel.shape[0] else np.empty(0, np.int64)
+        indptr = np.concatenate([[0], np.cumsum(lens)])
+        return [csr_matrix((self._values[pos], self._indices[pos], indptr),
+                           shape=(sel.shape[0], self._nfeat), ctx=self.ctx)]
+
+    def getlabel(self):
+        return [array(self._labels[self._rows()], ctx=self.ctx)]
+
+    def getpad(self):
+        end = self.cursor + self.batch_size
+        if self.round_batch and end > self.num_data:
+            return end - self.num_data
+        return 0
+
+    def getindex(self):
+        return self._rows()
 
 
 class MNISTIter(DataIter):

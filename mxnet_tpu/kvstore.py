@@ -259,6 +259,9 @@ class KVStore:
         """Sum per-device values — a single compiled stacked-sum whose
         output sharding is replicated, which the XLA SPMD partitioner
         lowers to an ICI AllReduce (the CommDevice/NCCL analog)."""
+        from .ndarray import sparse as _sp
+        if any(isinstance(a, _sp.RowSparseNDArray) for a in arrays):
+            return _merge_row_sparse(arrays)
         merged = arrays[0]
         if len(arrays) > 1:
             datas = [a._data for a in arrays]
@@ -279,7 +282,18 @@ class KVStore:
         return merged
 
     def _apply(self, k, merged):
+        from .ndarray import sparse as _sp
         stored = self._get(k)
+        if isinstance(merged, _sp.BaseSparseNDArray):
+            # keep the sparse type intact: the updater's optimizer routes
+            # row_sparse grads to the lazy rsp update rules (astype would
+            # silently strip indices and corrupt the update)
+            if self._updater is not None:
+                self._updater(k, merged, stored)
+            else:
+                stored._set_data(
+                    merged.todense()._data.astype(stored.dtype))
+            return
         if self._updater is not None:
             self._updater(k, merged.astype(stored.dtype), stored)
         else:
@@ -468,6 +482,33 @@ def _cross_process_allreduce(merged: NDArray) -> NDArray:
     # simplest correct eager path: gather-to-all then sum locally.
     summed = multihost_utils.process_allgather(merged._data).sum(axis=0)
     return _wrap(jax.device_put(summed, merged._data.device), merged.ctx)
+
+
+def _merge_row_sparse(arrays):
+    """Sum row_sparse replicas by unique row id (the reference
+    kvstore_local.h unique-rowid merge, ComputeMergedRowsFromRsp):
+    concatenate (indices, values), segment-sum into the union rows."""
+    import jax.numpy as jnp
+    import numpy as np
+    from .ndarray import sparse as _sp
+
+    if len(arrays) == 1:
+        return arrays[0]
+    dev = arrays[0]._data.device
+    idx = jnp.concatenate([a._aux if a._aux.device == dev
+                           else jax.device_put(a._aux, dev)
+                           for a in arrays])
+    dat = jnp.concatenate([a._data if a._data.device == dev
+                           else jax.device_put(a._data, dev)
+                           for a in arrays])
+    uniq, inv = jnp.unique(idx, return_inverse=True)
+    summed = jnp.zeros((uniq.shape[0],) + dat.shape[1:], dat.dtype) \
+        .at[inv.reshape(-1)].add(dat)
+    out = _sp.RowSparseNDArray.__new__(_sp.RowSparseNDArray)
+    NDArray.__init__(out, summed, arrays[0].ctx)
+    out._aux = uniq
+    out.shape = arrays[0].shape
+    return out
 
 
 def _normalize(key, value):

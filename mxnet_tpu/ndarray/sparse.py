@@ -230,13 +230,67 @@ def retain(data, indices):
 
 
 def dot(lhs, rhs, transpose_a=False, transpose_b=False):
-    """Sparse-aware dot (reference dot-inl.h sparse branches)."""
+    """Sparse-aware dot (reference dot-inl.h sparse branches).
+
+    TPU-native fast paths never materialize the (batch, num_features)
+    dense lhs (which for Criteo-scale feature spaces would not fit):
+    - ``dot(csr, dense)``: gather rhs rows by the csr column ids,
+      scale by the values, scatter-add by row — one gather + one
+      segment-sum, fully on-device (the reference's DotCsrDnsDns
+      warp-per-row GPU kernel plays this role, dot-inl.cuh).
+    - ``dot(csr.T, dense)``: scatter-add contributions into a dense
+      (num_features, n) result (DotCsrTransDnsDns analog) — callers
+      wanting the row_sparse gradient form use retain/row_sparse_array
+      on the result rows they touched.
+    """
     from . import dot as dense_dot
+    if isinstance(lhs, CSRNDArray) and not isinstance(rhs, BaseSparseNDArray) \
+            and not transpose_b and rhs.ndim == 2:
+        m, _ = lhs.shape
+        indptr = np.asarray(lhs._indptr)
+        rows = jnp.asarray(np.repeat(np.arange(m), np.diff(indptr)))
+        if transpose_a:
+            n_out = lhs.shape[1]
+            gathered = rhs._data[rows] * lhs._data[:, None].astype(rhs.dtype)
+            out = jnp.zeros((n_out, rhs.shape[1]), rhs.dtype) \
+                .at[lhs._aux].add(gathered)
+        else:
+            contrib = rhs._data[lhs._aux] \
+                * lhs._data[:, None].astype(rhs.dtype)
+            out = jnp.zeros((m, rhs.shape[1]), rhs.dtype).at[rows].add(contrib)
+        return _wrap(out, lhs._ctx)
     if isinstance(lhs, BaseSparseNDArray):
         lhs = tostype_dense(lhs)
     if isinstance(rhs, BaseSparseNDArray):
         rhs = tostype_dense(rhs)
     return dense_dot(lhs, rhs, transpose_a=transpose_a, transpose_b=transpose_b)
+
+
+def csr_to_ell(csr, k=None):
+    """Convert a CSR batch to fixed-width padded gather form — (column
+    ids (B, k) int32, values (B, k)) with zero padding.
+
+    The TPU-first representation of a sparse batch: every downstream op
+    is a static-shape gather/einsum (the Wide&Deep fused-field
+    pattern), so jit compiles ONCE for all batches when ``k`` is fixed
+    (e.g. ``LibSVMIter.max_row_nnz``). Rows denser than ``k`` raise.
+    """
+    indptr = np.asarray(csr._indptr)
+    lens = np.diff(indptr)
+    if k is None:
+        k = int(lens.max()) if lens.size else 1
+    if lens.size and int(lens.max()) > k:
+        raise MXNetError(f"csr_to_ell: a row has {int(lens.max())} nnz > "
+                         f"k={k}")
+    b = csr.shape[0]
+    rows = np.repeat(np.arange(b), lens)
+    pos = np.arange(indptr[-1]) - np.repeat(indptr[:-1], lens)
+    cols = np.zeros((b, k), np.int32)
+    vals = np.zeros((b, k), np.asarray(csr._data).dtype)
+    cols[rows, pos] = np.asarray(csr._aux)
+    vals[rows, pos] = np.asarray(csr._data)
+    return (_dense_array(cols, ctx=csr._ctx),
+            _dense_array(vals, ctx=csr._ctx))
 
 
 # ----------------------------------------------------------------------
