@@ -307,6 +307,13 @@ class _trace_guard:
         return False
 
 
+# outermost-wins guard for trace-time remat: hybridize(remat=True)
+# propagates to children, but nesting jax.checkpoint inside an already
+# checkpointed region just re-wraps recompute in recompute — the
+# outermost flagged block claims the wrap and descendants run plain
+_REMAT_GUARD = threading.local()
+
+
 # ----------------------------------------------------------------------
 # deferred aux updates (BatchNorm running stats inside a trace)
 # ----------------------------------------------------------------------
@@ -341,7 +348,7 @@ class HybridBlock(Block):
         self._cached_graph = {}
 
     def hybridize(self, active=True, static_alloc=False, static_shape=False,
-                  remat=False, **kwargs):
+                  remat=None, remat_policy=None, **kwargs):
         """Activate compiled execution. static_alloc/static_shape are
         accepted for API parity — XLA always plans memory statically.
 
@@ -349,12 +356,25 @@ class HybridBlock(Block):
         the compiled subgraph in ``jax.checkpoint``: the backward pass
         recomputes this block's activations instead of storing them —
         the HBM-for-FLOPs trade for long sequences / deep nets.
-        Hybridize each layer of an UN-hybridized parent for classic
-        per-layer activation checkpointing, or the root block for
-        whole-net remat."""
+        Hybridize the root for whole-net remat, or mark children with
+        ``child.hybridize(active=False, remat=True)`` for selective
+        per-block checkpointing — a marked child is wrapped when any
+        ancestor traces it (CachedOp or functionalize;
+        :meth:`_remat_trace`). ``remat``/``remat_policy`` default to
+        None = KEEP the block's existing setting, so a later parent
+        ``net.hybridize()`` does not erase per-child marks; pass
+        ``remat=False`` to clear explicitly. ``remat_policy`` selects
+        what the forward saves (a ``jax.checkpoint_policies`` name, or
+        "names:conv_out" to save conv outputs and recompute only the
+        elementwise chain)."""
+        prev = self._flags
+        if remat is None:
+            remat = prev.get("remat", False)
+        if remat_policy is None:
+            remat_policy = prev.get("remat_policy")
         self._active = active
         self._flags = dict(static_alloc=static_alloc, static_shape=static_shape,
-                           remat=remat, **kwargs)
+                           remat=remat, remat_policy=remat_policy, **kwargs)
         self._cached_graph = {}
         super().hybridize(active, **kwargs)
 
@@ -381,14 +401,10 @@ class HybridBlock(Block):
         if isinstance(x, NDArray):
             if self._active and not _in_cached_call():
                 return self._call_cached_op(x, *args)
-            with x.ctx:
-                try:
-                    params = {k: v.data(x.ctx) for k, v in self._reg_params.items()}
-                except DeferredInitializationError:
-                    self._infer_param_shapes(x, *args)
-                    params = {k: v.data(x.ctx) for k, v in self._reg_params.items()}
-                from .. import ndarray as ndmod
-                return self.hybrid_forward(ndmod, x, *args, **params)
+            if self._flags.get("remat") and _in_cached_call() \
+                    and not getattr(_REMAT_GUARD, "active", False):
+                return self._remat_trace(x, *args)
+            return self._forward_eager(x, *args)
         # symbolic path (Symbol inputs → graph building)
         from .. import symbol as symmod
         from ..symbol import Symbol
@@ -397,6 +413,92 @@ class HybridBlock(Block):
             with self.name_scope():
                 return self.hybrid_forward(symmod, x, *args, **params)
         raise MXNetError(f"unsupported input type {type(x)}")
+
+    def _forward_eager(self, x, *args):
+        with x.ctx:
+            try:
+                params = {k: v.data(x.ctx) for k, v in self._reg_params.items()}
+            except DeferredInitializationError:
+                self._infer_param_shapes(x, *args)
+                params = {k: v.data(x.ctx) for k, v in self._reg_params.items()}
+            from .. import ndarray as ndmod
+            return self.hybrid_forward(ndmod, x, *args, **params)
+
+    def _remat_trace(self, x, *args):
+        """Inside a parent trace, run this block under ``jax.checkpoint``:
+        the backward pass recomputes the block's activations instead of
+        reading them back from HBM (selective activation checkpointing —
+        the TPU-native lever for bandwidth-bound backward passes; the
+        reference has a coarse graph-level analog in mirror mode,
+        docs/faq/env_var.md MXNET_BACKWARD_DO_MIRROR).
+
+        The wrapped function is pure: (rng-key, inputs, params) →
+        (outputs, aux updates). Running-stat updates surface as extra
+        checkpoint outputs and re-enter the outer trace's aux sink; a
+        subkey of the active trace key is passed in explicitly so the
+        backward recompute replays identical randomness (dropout masks
+        match between forward and rebuild). ``remat_policy`` (a
+        ``jax.checkpoint_policies`` name or callable) selects what the
+        forward may save; default saves nothing but the inputs."""
+        ctx = x.ctx
+        try:
+            params = list(self.collect_params().values())
+            p_datas = [p.data(ctx)._data for p in params]
+        except DeferredInitializationError:
+            # shapes not concrete yet (dry-run trace) — plain eager pass;
+            # the real trace after init takes the checkpointed path
+            return self._forward_eager(x, *args)
+        arg_template = [x] + list(args)
+        in_datas = [a._data for a in arg_template if isinstance(a, NDArray)]
+        box = {}
+        block = self
+
+        def pure(rng_key, in_datas, p_datas):
+            it = iter(in_datas)
+            call_args = [_wrap(next(it), ctx) if isinstance(a, NDArray) else a
+                         for a in arg_template]
+            saved = [(p, p._data) for p in params]
+            outer_sink = getattr(_AUX_COLLECT, "sink", None)
+            sink: list = []
+            _AUX_COLLECT.sink = sink
+            _random.push_trace_key(rng_key)
+            prev_remat = getattr(_REMAT_GUARD, "active", False)
+            _REMAT_GUARD.active = True
+            try:
+                for p, d in zip(params, p_datas):
+                    p._data = {c: _wrap(d, c) for c in p._data}
+                out = block._forward_eager(*call_args)
+            finally:
+                _REMAT_GUARD.active = prev_remat
+                for p, d in saved:
+                    p._data = d
+                _AUX_COLLECT.sink = outer_sink
+                _random.pop_trace_key()
+            flat, structure = _flatten(out)
+            box["structure"] = structure
+            box["aux_params"] = [p for p, _ in sink]
+            aux = tuple(n._data if isinstance(n, NDArray) else n
+                        for _, n in sink)
+            return tuple(f._data for f in flat), aux
+
+        policy = self._flags.get("remat_policy")
+        if isinstance(policy, str):
+            if policy.startswith("names:"):
+                # "names:conv_out[,other]" — save only values tagged with
+                # jax.ad_checkpoint.checkpoint_name (Convolution tags its
+                # output 'conv_out'): backward recomputes just the cheap
+                # elementwise chain between saved anchors
+                policy = jax.checkpoint_policies.save_only_these_names(
+                    *policy[len("names:"):].split(","))
+            else:
+                policy = getattr(jax.checkpoint_policies, policy)
+        ckpt = jax.checkpoint(pure, policy=policy)
+        key = _random._next_key()
+        out_datas, aux_datas = ckpt(key, in_datas, p_datas)
+        for p, new in zip(box["aux_params"], aux_datas):
+            defer_aux_update(p, _wrap(new, ctx))
+        flat = [_wrap(d, ctx) for d in out_datas]
+        return _unflatten(flat, box["structure"])
 
     def _infer_param_shapes(self, *args):
         """Finalize deferred init using the layer's shape rule, then retry.
@@ -463,6 +565,12 @@ class HybridBlock(Block):
             saved_data = [(p, p._data) for p in params]
             prev_train = _autograd.set_training(training)
             prev_rec = _autograd.set_recording(False)
+            prev_remat = getattr(_REMAT_GUARD, "active", False)
+            if block._flags.get("remat"):
+                # whole-block remat is applied at the jit level below —
+                # keep forward() from re-wrapping this same block (and
+                # any descendant) in a nested trace-time checkpoint
+                _REMAT_GUARD.active = True
             try:
                 with _trace_guard():
                     for p, arr in zip(params, p_arrays):
@@ -470,6 +578,7 @@ class HybridBlock(Block):
                         p._data = wrappers
                     out = block.forward(*call_args)
             finally:
+                _REMAT_GUARD.active = prev_remat
                 for p, d in saved_data:
                     p._data = d
                 _autograd.set_recording(prev_rec)

@@ -202,9 +202,20 @@ class BottleneckV2(HybridBlock):
         return x + residual
 
 
+def _mark_remat(stage, policy=None):
+    """Flag every residual block of a stage for trace-time activation
+    recompute (jax.checkpoint wraps each block when the net is traced —
+    see HybridBlock._remat_trace). active=False keeps imperative/
+    CachedOp behavior unchanged; only traced training steps see it.
+    ``policy``: optional jax.checkpoint_policies selector (e.g.
+    "names:conv_out" saves conv outputs, recomputing only BN/relu)."""
+    for blk in stage._children.values():
+        blk.hybridize(active=False, remat=True, remat_policy=policy)
+
+
 class ResNetV1(HybridBlock):
     def __init__(self, block, layers, channels, classes=1000, thumbnail=False,
-                 stem="conv", **kwargs):
+                 stem="conv", remat_stages=(), remat_policy=None, **kwargs):
         super().__init__(**kwargs)
         assert len(layers) == len(channels) - 1
         with self.name_scope():
@@ -218,10 +229,13 @@ class ResNetV1(HybridBlock):
                 self.features.add(nn.MaxPool2D(3, 2, 1))
             for i, num_layer in enumerate(layers):
                 stride = 1 if i == 0 else 2
-                self.features.add(self._make_layer(block, num_layer,
-                                                   channels[i + 1], stride,
-                                                   i + 1,
-                                                   in_channels=channels[i]))
+                stage = self._make_layer(block, num_layer,
+                                         channels[i + 1], stride,
+                                         i + 1,
+                                         in_channels=channels[i])
+                if (i + 1) in remat_stages:
+                    _mark_remat(stage, remat_policy)
+                self.features.add(stage)
             self.features.add(nn.GlobalAvgPool2D())
             self.output = nn.Dense(classes, in_units=channels[-1])
 
@@ -244,7 +258,7 @@ class ResNetV1(HybridBlock):
 
 class ResNetV2(HybridBlock):
     def __init__(self, block, layers, channels, classes=1000, thumbnail=False,
-                 stem="conv", **kwargs):
+                 stem="conv", remat_stages=(), remat_policy=None, **kwargs):
         super().__init__(**kwargs)
         assert len(layers) == len(channels) - 1
         with self.name_scope():
@@ -260,9 +274,12 @@ class ResNetV2(HybridBlock):
             in_channels = channels[0]
             for i, num_layer in enumerate(layers):
                 stride = 1 if i == 0 else 2
-                self.features.add(self._make_layer(block, num_layer,
-                                                   channels[i + 1], stride,
-                                                   i + 1, in_channels=in_channels))
+                stage = self._make_layer(block, num_layer,
+                                         channels[i + 1], stride,
+                                         i + 1, in_channels=in_channels)
+                if (i + 1) in remat_stages:
+                    _mark_remat(stage, remat_policy)
+                self.features.add(stage)
                 in_channels = channels[i + 1]
             self.features.add(nn.BatchNorm())
             self.features.add(nn.Activation("relu"))

@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
+from jax.ad_checkpoint import checkpoint_name
 
 from ..base import dtype_np
 from .register import register_op
@@ -102,7 +103,11 @@ def convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
         )
     if bias is not None and not no_bias:
         out = out + bias.reshape((1, -1) + (1,) * nd_)
-    return out
+    # remat-policy anchor: under jax.checkpoint with
+    # save_only_these_names('conv_out') the forward saves conv outputs
+    # and recomputes only the cheap elementwise chain (BN/relu) in the
+    # backward (see HybridBlock._remat_trace); a no-op otherwise
+    return checkpoint_name(out, "conv_out")
 
 
 @register_op("Deconvolution")

@@ -328,6 +328,98 @@ def test_hybridize_remat_matches_plain():
         assert_almost_equal(a, b, rtol=1e-6, atol=1e-7)
 
 
+def test_trace_time_remat_matches_plain():
+    """Selective per-block activation recompute inside a parent trace
+    (HybridBlock._remat_trace): a remat-flagged child of a hybridized
+    parent produces the same loss/gradients, jax.checkpoint appears in
+    the traced jaxpr, and BatchNorm running stats still update through
+    the checkpointed region (aux outputs re-enter the outer sink)."""
+    import jax as _jax
+    import jax.numpy as jnp
+    from mxnet_tpu.gluon.block import functionalize
+
+    class Child(mx.gluon.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.dense = nn.Dense(16, in_units=8)
+                self.bn = nn.BatchNorm(in_channels=16)
+
+        def hybrid_forward(self, F, x):
+            return F.Activation(self.bn(self.dense(x)), act_type="relu")
+
+    class Net(mx.gluon.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.c = Child()
+                self.out = nn.Dense(4, in_units=16)
+
+        def hybrid_forward(self, F, x):
+            return self.out(self.c(x))
+
+    def build(remat):
+        mx.random.seed(7)
+        net = Net()
+        net.initialize(init=mx.initializer.Xavier())
+        if remat:
+            net.c.hybridize(active=False, remat=True)
+        return net
+
+    x_np = np.random.RandomState(3).randn(16, 8).astype(np.float32)
+
+    # --- functionalized (jit/pjit) path: grads match, remat in jaxpr
+    def loss_of(net):
+        fn, params = functionalize(net, training=True)
+
+        def loss(p, rng, x):
+            return (fn(p, rng, x) ** 2).sum()
+
+        return loss, params
+
+    rng = _jax.random.PRNGKey(0)
+    x_j = jnp.asarray(x_np)
+    grads = {}
+    for remat in (False, True):
+        loss, params = loss_of(build(remat))
+        l, g = _jax.value_and_grad(loss)(params, rng, x_j)
+        grads[remat] = (float(l), g)
+        if remat:
+            assert "remat" in str(_jax.make_jaxpr(loss)(params, rng, x_j))
+    assert abs(grads[True][0] - grads[False][0]) < 1e-4
+    for (ka, va), (kb, vb) in zip(sorted(grads[True][1].items()),
+                                  sorted(grads[False][1].items())):
+        assert_almost_equal(np.asarray(va), np.asarray(vb),
+                            rtol=1e-4, atol=1e-5)
+
+    # --- CachedOp path: parent hybridize() must PRESERVE the child's
+    # remat mark (remat=None keeps existing), jax.checkpoint must
+    # actually engage inside the trace, and BN running stats still
+    # update through the checkpointed region
+    net = build(True)
+    net.hybridize()
+    assert net.c._flags.get("remat") is True
+    calls = []
+    orig_ckpt = _jax.checkpoint
+
+    def spy(fn, *a, **k):
+        calls.append(1)
+        return orig_ckpt(fn, *a, **k)
+
+    _jax.checkpoint = spy
+    try:
+        x = nd.array(x_np)
+        with autograd.record():
+            loss = (net(x) ** 2).sum()
+        loss.backward()
+    finally:
+        _jax.checkpoint = orig_ckpt
+    assert calls, "child remat did not engage inside the CachedOp trace"
+    rm = net.c.bn.running_mean.data().asnumpy()
+    assert np.abs(rm).sum() > 0
+    assert np.abs(net.c.dense.weight.grad().asnumpy()).sum() > 0
+
+
 def test_wide_deep_fused_fields_matches_per_field():
     """The fused single-table field embedding (one (B*F)-row gather)
     must match the per-field gather path exactly when the tables hold
