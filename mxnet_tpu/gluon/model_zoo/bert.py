@@ -84,9 +84,15 @@ class BERTModel(HybridBlock):
                                  dtype=dtype, prefix="pooler_")
                            if use_pooler else None)
 
-    def hybrid_forward(self, F, inputs, token_types, mask=None):
+    def hybrid_forward(self, F, inputs, token_types, valid_length=None,
+                       mask=None):
+        """``valid_length`` (B,) per-example token counts — third
+        positional input, matching the GluonNLP BERTModel signature
+        (inputs, token_types, valid_length); rides the flash kernel's
+        native per-row kv-length path. ``mask`` stays the general
+        additive escape hatch (composed attention)."""
         x = self.embeddings(inputs, token_types)
-        seq = self.encoder(x, mask)
+        seq = self.encoder(x, mask, valid_length)
         if self.pooler is None:
             return seq
         pooled = self.pooler(F.slice_axis(seq, axis=1, begin=0, end=1)

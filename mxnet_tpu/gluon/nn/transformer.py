@@ -62,7 +62,7 @@ class MultiHeadAttention(HybridBlock):
         x = F.reshape(x, shape=(0, 0, self._heads, -1))
         return F.transpose(x, axes=(0, 2, 1, 3))
 
-    def hybrid_forward(self, F, x, mask=None):
+    def hybrid_forward(self, F, x, mask=None, valid_length=None):
         from ... import autograd as _autograd
 
         c = self._units
@@ -76,10 +76,17 @@ class MultiHeadAttention(HybridBlock):
 
         # the flash kernel has no attention-prob dropout; honour a
         # configured attention_dropout by taking the composed path while
-        # training (trace-time decision — training mode is static)
+        # training (trace-time decision — training mode is static).
+        # valid_length (B,) padding stays ON the flash path — the kernel
+        # masks per-example lengths natively; only arbitrary additive
+        # masks force the composed path.
         need_drop = bool(self._attn_drop) and _autograd.is_training()
         if mask is None and not need_drop:
-            out = F.flash_attention(q, k, v, causal=self._causal)
+            if valid_length is None:
+                out = F.flash_attention(q, k, v, causal=self._causal)
+            else:
+                out = F.flash_attention(q, k, v, valid_length,
+                                        causal=self._causal)
         else:
             # composed batch_dot+softmax path (reference-era attention);
             # mask is additive, broadcastable to (B, 1|H, S, S)
@@ -87,9 +94,16 @@ class MultiHeadAttention(HybridBlock):
             scores = F.batch_dot_attention_scores(q, k) * scale
             if mask is not None:
                 scores = F.broadcast_add(scores, mask)
+            if valid_length is not None:
+                scores = F.attention_length_mask(scores, valid_length)
             if self._causal:
                 scores = F.causal_mask_scores(scores)
             probs = F.softmax(scores, axis=-1)
+            if valid_length is not None:
+                # an all-masked row softmaxes to uniform — zero it so
+                # the composed path matches the flash kernel's l==0
+                # zeros for empty (valid_len == 0) examples
+                probs = F.attention_zero_empty_rows(probs, valid_length)
             if self.dropout is not None:
                 probs = self.dropout(probs)
             out = F.batch_dot_attention_apply(probs, v)
@@ -149,15 +163,15 @@ class TransformerEncoderCell(HybridBlock):
             self.ffn_ln = LayerNorm(epsilon=layer_norm_eps, prefix="ffn_ln_")
             self.dropout = Dropout(dropout) if dropout else None
 
-    def hybrid_forward(self, F, x, mask=None):
+    def hybrid_forward(self, F, x, mask=None, valid_length=None):
         if self._pre_norm:
-            h = self.attention(self.attn_ln(x), mask)
+            h = self.attention(self.attn_ln(x), mask, valid_length)
             if self.dropout is not None:
                 h = self.dropout(h)
             x = x + h
             h = self.ffn(self.ffn_ln(x))
             return x + h
-        h = self.attention(x, mask)
+        h = self.attention(x, mask, valid_length)
         if self.dropout is not None:
             h = self.dropout(h)
         x = self.attn_ln(x + h)
@@ -191,9 +205,9 @@ class TransformerEncoder(HybridBlock):
             self.final_ln = (LayerNorm(epsilon=layer_norm_eps, prefix="final_ln_")
                              if pre_norm else None)
 
-    def hybrid_forward(self, F, x, mask=None):
+    def hybrid_forward(self, F, x, mask=None, valid_length=None):
         for cell in self.cells:
-            x = cell(x, mask)
+            x = cell(x, mask, valid_length)
         if self.final_ln is not None:
             x = self.final_ln(x)
         return x

@@ -387,6 +387,27 @@ def batch_dot_attention_apply(probs, value):
     return jnp.einsum("bhqk,bhkd->bhqd", probs, value)
 
 
+@register_op("attention_length_mask")
+def attention_length_mask(scores, valid_len):
+    """Mask score columns at/after each example's valid length with
+    -1e30 (additive-mask form of kv_lens, for the composed attention
+    path; scores (B, H|1, Sq, Sk), valid_len (B,))."""
+    sk = scores.shape[-1]
+    m = jnp.arange(sk)[None, None, None, :] \
+        < valid_len.astype(jnp.int32).reshape(-1)[:, None, None, None]
+    return jnp.where(m, scores, jnp.asarray(-1e30, scores.dtype))
+
+
+@register_op("attention_zero_empty_rows")
+def attention_zero_empty_rows(probs, valid_len):
+    """Zero the attention probs of examples whose valid_len == 0:
+    softmax over an all-masked row is uniform (every score is the same
+    -1e30), which would attend the padding — the flash kernel emits
+    exact zeros there (l==0 guard), and the composed path must agree."""
+    ok = valid_len.astype(jnp.int32).reshape(-1) > 0
+    return probs * ok[:, None, None, None].astype(probs.dtype)
+
+
 @register_op("causal_mask_scores")
 def causal_mask_scores(scores):
     """End-aligned causal mask over the last two axes of (…,Sq,Sk)."""
@@ -401,13 +422,20 @@ def causal_mask_scores(scores):
 # Exposed as mx.nd.flash_attention.
 # ----------------------------------------------------------------------
 @register_op("flash_attention")
-def flash_attention_op(query, key, value, causal=False, sm_scale=None):
+def flash_attention_op(query, key, value, valid_len=None, causal=False,
+                       sm_scale=None):
     """softmax(Q K^T * scale) V over (B, H, S, D) inputs.
 
     Pallas flash kernel on TPU (O(S) memory); jnp fallback elsewhere.
+    ``valid_len`` (B,) int masks keys at/after each example's length
+    (padded batches) — the kernel handles it natively (per-example
+    length in SMEM, fully-masked tiles skipped; see
+    ops/pallas/flash_attention.py).
     """
     from ..ops import pallas as _pallas
 
+    if valid_len is not None:
+        valid_len = valid_len.astype(jnp.int32).reshape(-1)
     if (_pallas.pallas_ok_for(query)
             and query.dtype in (jnp.float32, jnp.bfloat16, jnp.float16)
             and query.ndim == 4):
@@ -416,20 +444,28 @@ def flash_attention_op(query, key, value, causal=False, sm_scale=None):
         # fallback below
         q_off = key.shape[2] - query.shape[2] if causal else 0
         return _pallas.flash_attention(query, key, value, sm_scale,
-                                       bool(causal), q_off)
+                                       bool(causal), q_off, None, valid_len)
     d = query.shape[-1]
     scale = sm_scale if sm_scale is not None else 1.0 / (d ** 0.5)
     s = jnp.einsum("bhqd,bhkd->bhqk",
                    query.astype(jnp.float32),
                    key.astype(jnp.float32)) * scale
-    p = jax.nn.softmax(s, axis=-1)
+    sq, sk = s.shape[-2], s.shape[-1]
+    mask = None
+    if valid_len is not None:
+        mask = jnp.arange(sk)[None, None, None, :] \
+            < valid_len[:, None, None, None]
     if causal:
-        sq, sk = s.shape[-2], s.shape[-1]
         cm = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
-        p = jax.nn.softmax(jnp.where(cm, s, -1e30), axis=-1)
-        # fully-masked rows (sq > skv): emit zeros, matching the Pallas
-        # kernel's l==0 guard
-        p = jnp.where(cm.any(-1, keepdims=True), p, 0.0)
+        mask = cm if mask is None else jnp.logical_and(mask, cm)
+    if mask is not None:
+        p = jax.nn.softmax(jnp.where(mask, s, -1e30), axis=-1)
+        # fully-masked rows: emit zeros, matching the Pallas kernel's
+        # l==0 guard
+        p = jnp.where(
+            jnp.broadcast_to(mask, s.shape).any(-1, keepdims=True), p, 0.0)
+    else:
+        p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", p,
                       value.astype(jnp.float32)).astype(query.dtype)
 

@@ -18,8 +18,19 @@ Shapes: q (B, H, Sq, D), k/v (B, H, Skv, D). ``q_offset`` is the
 global position of q row 0 relative to k row 0 (ring attention passes
 the rotating chunk offset; 0 for vanilla causal).
 
-Variable-length / arbitrary additive masks are NOT handled here — the
-op layer falls back to the jnp path when a mask tensor is supplied.
+Variable-length batches ARE handled natively: ``kv_lens`` (B,) int32
+gives each example's valid key/value length. The per-example length
+rides in SMEM; score columns at or beyond it are masked in both the
+forward and the fused backward, and (q, k) tiles that start past the
+length are SKIPPED entirely (no MXU work — short rows in a padded
+batch cost proportionally less). Rows whose query position is padding
+produce zeros through the l==0 guard; with the loss masking padded
+positions (cotangent zero there), their dk/dv contributions vanish
+identically, so gradients match the composed masked softmax exactly.
+
+Arbitrary ADDITIVE masks (relative-position biases etc.) are not
+expressible as lengths — the op layer falls back to the jnp composed
+path for those.
 """
 from __future__ import annotations
 
@@ -46,13 +57,19 @@ def _dot_precision(dtype):
             else lax.Precision.DEFAULT)
 
 
-def _pair_mask(i, j, causal, q_offset, kv_len, block_q, block_k):
+def _pair_mask(i, j, causal, q_offset, kv_len, block_q, block_k,
+               kvl=None):
     """Validity mask for the (i, j) score block, or None when every
-    position is statically visible (no kv padding, not causal) — the
-    common BERT shape skips the iota/where entirely."""
-    nk_pad = kv_len % block_k != 0  # padded tail block exists
+    position is statically visible (no kv padding, not causal, no
+    per-example length) — the common dense shape skips the iota/where
+    entirely. ``kvl`` is the traced per-example valid kv length (SMEM
+    scalar); it subsumes the static tail-pad mask since kvl <= kv_len."""
     mask = None
-    if nk_pad:
+    if kvl is not None:
+        col = j * block_k + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = col < kvl
+    elif kv_len % block_k != 0:  # padded tail block exists
         col = j * block_k + lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
         mask = col < kv_len
@@ -66,12 +83,24 @@ def _pair_mask(i, j, causal, q_offset, kv_len, block_q, block_k):
     return mask
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+def _block_visible(i, j, causal, q_offset, block_q, block_k, kvl):
+    """Whether the (i, j) tile has ANY live score: causal skip plus the
+    per-example length skip (tiles starting at/after kvl are dead —
+    the variable-length fast path's whole-tile saving)."""
+    q_last = (i + 1) * block_q - 1 + q_offset
+    vis = jnp.logical_or(not causal, j * block_k <= q_last)
+    if kvl is not None:
+        vis = jnp.logical_and(vis, j * block_k < kvl)
+    return vis
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, kvl_ref, o_ref, lse_ref,
                 acc_sc, m_sc, l_sc, *,
                 sm_scale, causal, q_offset, kv_len, block_q, block_k,
-                precision):
+                precision, dynamic_kv):
     i, j = pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
+    kvl = kvl_ref[pl.program_id(0)] if dynamic_kv else None
 
     @pl.when(j == 0)
     def _():
@@ -79,10 +108,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         m_sc[:] = jnp.full_like(m_sc, _NEG_INF)
         l_sc[:] = jnp.zeros_like(l_sc)
 
-    # causal skip: block is visible iff its first k column can be seen
-    # by the last q row of this block
-    q_last = (i + 1) * block_q - 1 + q_offset
-    visible = jnp.logical_or(not causal, j * block_k <= q_last)
+    # skip: causal invisibility or a tile past the example's kv length
+    visible = _block_visible(i, j, causal, q_offset, block_q, block_k, kvl)
 
     @pl.when(visible)
     def _():
@@ -94,7 +121,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32, precision=precision)
 
-        mask = _pair_mask(i, j, causal, q_offset, kv_len, block_q, block_k)
+        mask = _pair_mask(i, j, causal, q_offset, kv_len, block_q, block_k,
+                          kvl)
         if mask is not None:
             s = jnp.where(mask, s, _NEG_INF)
 
@@ -124,18 +152,18 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   dq_ref, dq_sc, *,
+                   kvl_ref, dq_ref, dq_sc, *,
                    sm_scale, causal, q_offset, kv_len, block_q, block_k,
-                   precision):
+                   precision, dynamic_kv):
     i, j = pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
+    kvl = kvl_ref[pl.program_id(0)] if dynamic_kv else None
 
     @pl.when(j == 0)
     def _():
         dq_sc[:] = jnp.zeros_like(dq_sc)
 
-    q_last = (i + 1) * block_q - 1 + q_offset
-    visible = jnp.logical_or(not causal, j * block_k <= q_last)
+    visible = _block_visible(i, j, causal, q_offset, block_q, block_k, kvl)
 
     @pl.when(visible)
     def _():
@@ -149,7 +177,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32, precision=precision)
-        mask = _pair_mask(i, j, causal, q_offset, kv_len, block_q, block_k)
+        mask = _pair_mask(i, j, causal, q_offset, kv_len, block_q, block_k,
+                          kvl)
         p = jnp.exp(s - lse) if mask is None \
             else jnp.where(mask, jnp.exp(s - lse), 0.0)
         dp = jax.lax.dot_general(
@@ -168,20 +197,20 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_sc, dv_sc, *,
+                    kvl_ref, dk_ref, dv_ref, dk_sc, dv_sc, *,
                     sm_scale, causal, q_offset, kv_len, block_q, block_k,
-                    precision):
+                    precision, dynamic_kv):
     # grid: (BH, nk, nq) — q is the inner (sequential) axis
     j, i = pl.program_id(1), pl.program_id(2)
     nq = pl.num_programs(2)
+    kvl = kvl_ref[pl.program_id(0)] if dynamic_kv else None
 
     @pl.when(i == 0)
     def _():
         dk_sc[:] = jnp.zeros_like(dk_sc)
         dv_sc[:] = jnp.zeros_like(dv_sc)
 
-    q_last = (i + 1) * block_q - 1 + q_offset
-    visible = jnp.logical_or(not causal, j * block_k <= q_last)
+    visible = _block_visible(i, j, causal, q_offset, block_q, block_k, kvl)
 
     @pl.when(visible)
     def _():
@@ -195,7 +224,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32, precision=precision)
-        mask = _pair_mask(i, j, causal, q_offset, kv_len, block_q, block_k)
+        mask = _pair_mask(i, j, causal, q_offset, kv_len, block_q, block_k,
+                          kvl)
         p = jnp.exp(s - lse) if mask is None \
             else jnp.where(mask, jnp.exp(s - lse), 0.0)
 
@@ -217,9 +247,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                      dq_ref, dk_ref, dv_ref, dk_sc, dv_sc, *,
+                      kvl_ref, dq_ref, dk_ref, dv_ref, dk_sc, dv_sc, *,
                       sm_scale, causal, q_offset, kv_len, block_q, block_k,
-                      precision):
+                      precision, dynamic_kv):
     """One-pass backward: dq, dk, dv from a SINGLE traversal of the
     (q block, k block) grid — the score matrix s and dp are computed
     once per pair instead of once in a dq kernel and again in a dkv
@@ -234,14 +264,14 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     """
     j, i = pl.program_id(1), pl.program_id(2)
     nq = pl.num_programs(2)
+    kvl = kvl_ref[pl.program_id(0)] if dynamic_kv else None
 
     @pl.when(i == 0)
     def _():
         dk_sc[:] = jnp.zeros_like(dk_sc)
         dv_sc[:] = jnp.zeros_like(dv_sc)
 
-    q_last = (i + 1) * block_q - 1 + q_offset
-    visible = jnp.logical_or(not causal, j * block_k <= q_last)
+    visible = _block_visible(i, j, causal, q_offset, block_q, block_k, kvl)
 
     @pl.when(visible)
     def _():
@@ -255,7 +285,8 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32, precision=precision)
-        mask = _pair_mask(i, j, causal, q_offset, kv_len, block_q, block_k)
+        mask = _pair_mask(i, j, causal, q_offset, kv_len, block_q, block_k,
+                          kvl)
         p = jnp.exp(s - lse) if mask is None \
             else jnp.where(mask, jnp.exp(s - lse), 0.0)
 
@@ -276,8 +307,9 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(jnp.logical_not(visible))
     def _():
-        # causally-skipped pair: this step still owns its dq partial
-        # block — zero it (output buffers start uninitialized)
+        # skipped pair (causal or past-kv-length): this step still owns
+        # its dq partial block — zero it (output buffers start
+        # uninitialized)
         dq_ref[0, 0] = jnp.zeros_like(dq_ref[0, 0])
 
     @pl.when(i == nq - 1)
@@ -307,9 +339,18 @@ def _pick_blocks(sq, skv):
     return bq, bk
 
 
+def _expand_kv_lens(kv_lens, b, h):
+    """(B,) per-example lengths -> (B*H,) int32 whole-array SMEM
+    operand (kernels index it by program_id(0); Mosaic requires either
+    tile-aligned blocks or the full array, so the full tiny vector it
+    is)."""
+    return jnp.broadcast_to(
+        kv_lens.astype(jnp.int32).reshape(b, 1), (b, h)).reshape(b * h)
+
+
 @x32
 def _flash_fwd(q, k, v, sm_scale, causal, q_offset, interpret,
-               block_q=None, block_k=None):
+               block_q=None, block_k=None, kv_lens=None):
     b, h, sq, d = q.shape
     skv = k.shape[2]
     bq0, bk0 = _pick_blocks(sq, skv)
@@ -329,11 +370,14 @@ def _flash_fwd(q, k, v, sm_scale, causal, q_offset, interpret,
         vf = jnp.pad(vf, ((0, 0), (0, skv_p - skv), (0, 0)))
 
     bh = b * h
+    dynamic_kv = kv_lens is not None
+    kvlf = _expand_kv_lens(kv_lens, b, h) if dynamic_kv \
+        else jnp.full((bh,), skv, jnp.int32)
     nq, nk = sq_p // block_q, skv_p // block_k
     kern = functools.partial(
         _fwd_kernel, sm_scale=sm_scale, causal=causal,
         q_offset=q_offset, kv_len=skv, block_q=block_q, block_k=block_k,
-        precision=_dot_precision(q.dtype))
+        precision=_dot_precision(q.dtype), dynamic_kv=dynamic_kv)
     o, lse = pl.pallas_call(
         kern,
         grid=(bh, nq, nk),
@@ -344,6 +388,7 @@ def _flash_fwd(q, k, v, sm_scale, causal, q_offset, interpret,
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, block_k, d), lambda b_, i, j: (b_, j, 0),
                          memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b_, i, j: (b_, i, 0),
@@ -361,7 +406,7 @@ def _flash_fwd(q, k, v, sm_scale, causal, q_offset, interpret,
             pltpu.VMEM((block_q, 1), jnp.float32),
         ],
         interpret=interpret,
-    )(qf, kf, vf)
+    )(qf, kf, vf, kvlf)
     o = o[:, :sq].reshape(b, h, sq, d)
     lse = lse[:, :sq, 0].reshape(b, h, sq)
     return o, lse
@@ -369,7 +414,7 @@ def _flash_fwd(q, k, v, sm_scale, causal, q_offset, interpret,
 
 @x32
 def _flash_bwd(q, k, v, o, lse, do, sm_scale, causal, q_offset, interpret,
-               block_q=None, block_k=None, dlse=None):
+               block_q=None, block_k=None, dlse=None, kv_lens=None):
     import os
 
     b, h, sq, d = q.shape
@@ -379,6 +424,9 @@ def _flash_bwd(q, k, v, o, lse, do, sm_scale, causal, q_offset, interpret,
     block_k = block_k or bk0
     sq_p, skv_p = _pad_len(sq, block_q), _pad_len(skv, block_k)
     bh = b * h
+    dynamic_kv = kv_lens is not None
+    kvlf = _expand_kv_lens(kv_lens, b, h) if dynamic_kv \
+        else jnp.full((bh,), skv, jnp.int32)
 
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1).reshape(bh, sq, 1)
@@ -408,23 +456,23 @@ def _flash_bwd(q, k, v, o, lse, do, sm_scale, causal, q_offset, interpret,
     nq, nk = sq_p // block_q, skv_p // block_k
     common = dict(sm_scale=sm_scale, causal=causal, q_offset=q_offset,
                   kv_len=skv, block_q=block_q, block_k=block_k,
-                  precision=_dot_precision(q.dtype))
+                  precision=_dot_precision(q.dtype), dynamic_kv=dynamic_kv)
 
     # the fused pass writes nk f32 dq-partial copies to HBM; past nk=2
     # that memory/write cliff outweighs the recompute saving, so long
     # multi-k-block rows (S > 2*block_k cap) take the split path whose
     # dq accumulates in VMEM scratch
     if nk <= 2 and os.environ.get("MXNET_TPU_FLASH_SPLIT_BWD", "0") != "1":
-        return _flash_bwd_fused(qf, kf, vf, dof, lsef, delta,
+        return _flash_bwd_fused(qf, kf, vf, dof, lsef, delta, kvlf,
                                 (b, h, sq, skv, d), nq, nk, common,
                                 interpret, k.dtype, v.dtype, q.dtype)
-    return _flash_bwd_split(qf, kf, vf, dof, lsef, delta,
+    return _flash_bwd_split(qf, kf, vf, dof, lsef, delta, kvlf,
                             (b, h, sq, skv, d), nq, nk, common,
                             interpret, k.dtype, v.dtype, q.dtype)
 
 
-def _flash_bwd_fused(qf, kf, vf, dof, lsef, delta, dims, nq, nk, common,
-                     interpret, k_dtype, v_dtype, q_dtype):
+def _flash_bwd_fused(qf, kf, vf, dof, lsef, delta, kvlf, dims, nq, nk,
+                     common, interpret, k_dtype, v_dtype, q_dtype):
     """Single-pass dq/dk/dv (default; MXNET_TPU_FLASH_SPLIT_BWD=1
     selects the two-kernel path for A/B and as a fallback)."""
     b, h, sq, skv, d = dims
@@ -448,6 +496,7 @@ def _flash_bwd_fused(qf, kf, vf, dof, lsef, delta, dims, nq, nk, common,
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, block_q, 1), lambda b_, j, i: (b_, i, 0),
                          memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_q, d),
@@ -470,7 +519,7 @@ def _flash_bwd_fused(qf, kf, vf, dof, lsef, delta, dims, nq, nk, common,
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
         interpret=interpret,
-    )(qf, kf, vf, dof, lsef, delta)
+    )(qf, kf, vf, dof, lsef, delta, kvlf)
 
     dq = dq_part.sum(axis=1).astype(q_dtype) if nk > 1 \
         else dq_part[:, 0].astype(q_dtype)
@@ -480,8 +529,8 @@ def _flash_bwd_fused(qf, kf, vf, dof, lsef, delta, dims, nq, nk, common,
     return dq, dk, dv
 
 
-def _flash_bwd_split(qf, kf, vf, dof, lsef, delta, dims, nq, nk, common,
-                     interpret, k_dtype, v_dtype, q_dtype):
+def _flash_bwd_split(qf, kf, vf, dof, lsef, delta, kvlf, dims, nq, nk,
+                     common, interpret, k_dtype, v_dtype, q_dtype):
     b, h, sq, skv, d = dims
     bh = b * h
     block_q, block_k = common["block_q"], common["block_k"]
@@ -503,13 +552,14 @@ def _flash_bwd_split(qf, kf, vf, dof, lsef, delta, dims, nq, nk, common,
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, block_q, 1), lambda b_, i, j: (b_, i, 0),
                          memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b_, i, j: (b_, i, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((bh, sq_p, d), q_dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
-    )(qf, kf, vf, dof, lsef, delta)
+    )(qf, kf, vf, dof, lsef, delta, kvlf)
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, **common),
@@ -527,6 +577,7 @@ def _flash_bwd_split(qf, kf, vf, dof, lsef, delta, dims, nq, nk, common,
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, block_q, 1), lambda b_, j, i: (b_, i, 0),
                          memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b_, j, i: (b_, j, 0),
@@ -543,7 +594,7 @@ def _flash_bwd_split(qf, kf, vf, dof, lsef, delta, dims, nq, nk, common,
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
         interpret=interpret,
-    )(qf, kf, vf, dof, lsef, delta)
+    )(qf, kf, vf, dof, lsef, delta, kvlf)
 
     dq = dq[:, :sq].reshape(b, h, sq, d)
     dk = dk[:, :skv].reshape(b, h, skv, d)
@@ -551,39 +602,50 @@ def _flash_bwd_split(qf, kf, vf, dof, lsef, delta, dims, nq, nk, common,
     return dq, dk, dv
 
 
+def _kv_lens_ct(kv_lens):
+    """Cotangent for the integer kv_lens argument: None when absent,
+    float0 zeros when present (custom_vjp contract for int primals)."""
+    if kv_lens is None:
+        return None
+    import numpy as np
+    return np.zeros(kv_lens.shape, jax.dtypes.float0)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def flash_attention_with_lse(q, k, v, sm_scale=None, causal=False,
-                             q_offset=0, interpret=None):
+                             q_offset=0, interpret=None, kv_lens=None):
     """Flash attention returning (out, lse) — DIFFERENTIABLE in both
     outputs (the lse cotangent folds into the backward's delta term).
 
     lse has shape (B, H, Sq), fp32 — the combiner state blockwise/ring
     schemes need; ring_attention folds per-chunk (out, lse) pairs with
     the log-sum-exp combiner and lets gradients flow through both.
+    ``kv_lens`` (B,) int32 masks keys at/after each example's length.
     """
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
     return _flash_fwd(q, k, v, sm_scale, bool(causal), int(q_offset),
-                      resolve_interpret(interpret))
+                      resolve_interpret(interpret), kv_lens=kv_lens)
 
 
-def _flash_lse_vjp_fwd(q, k, v, sm_scale, causal, q_offset, interpret):
+def _flash_lse_vjp_fwd(q, k, v, sm_scale, causal, q_offset, interpret,
+                       kv_lens=None):
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
     o, lse = _flash_fwd(q, k, v, sm_scale, bool(causal), int(q_offset),
-                        resolve_interpret(interpret))
-    return (o, lse), (q, k, v, o, lse)
+                        resolve_interpret(interpret), kv_lens=kv_lens)
+    return (o, lse), (q, k, v, o, lse, kv_lens)
 
 
 def _flash_lse_vjp_bwd(sm_scale, causal, q_offset, interpret, res, cts):
-    q, k, v, o, lse = res
+    q, k, v, o, lse, kv_lens = res
     do, dlse = cts
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
     dq, dk, dv = _flash_bwd(q, k, v, o, lse, do, sm_scale, bool(causal),
                             int(q_offset), resolve_interpret(interpret),
-                            dlse=dlse)
-    return dq, dk, dv
+                            dlse=dlse, kv_lens=kv_lens)
+    return dq, dk, dv, _kv_lens_ct(kv_lens)
 
 
 flash_attention_with_lse.defvjp(_flash_lse_vjp_fwd, _flash_lse_vjp_bwd)
@@ -591,30 +653,34 @@ flash_attention_with_lse.defvjp(_flash_lse_vjp_fwd, _flash_lse_vjp_bwd)
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def flash_attention(q, k, v, sm_scale=None, causal=False, q_offset=0,
-                    interpret=None):
-    """softmax(q k^T * scale [+causal mask]) v, blockwise in VMEM."""
+                    interpret=None, kv_lens=None):
+    """softmax(q k^T * scale [+causal/length mask]) v, blockwise in
+    VMEM. ``kv_lens`` (B,) int32 masks keys at/after each example's
+    valid length (variable-length batches, e.g. BERT padding)."""
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
     o, _ = _flash_fwd(q, k, v, sm_scale, bool(causal), int(q_offset),
-                      resolve_interpret(interpret))
+                      resolve_interpret(interpret), kv_lens=kv_lens)
     return o
 
 
-def _flash_vjp_fwd(q, k, v, sm_scale, causal, q_offset, interpret):
+def _flash_vjp_fwd(q, k, v, sm_scale, causal, q_offset, interpret,
+                   kv_lens=None):
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
     o, lse = _flash_fwd(q, k, v, sm_scale, bool(causal), int(q_offset),
-                        resolve_interpret(interpret))
-    return o, (q, k, v, o, lse)
+                        resolve_interpret(interpret), kv_lens=kv_lens)
+    return o, (q, k, v, o, lse, kv_lens)
 
 
 def _flash_vjp_bwd(sm_scale, causal, q_offset, interpret, res, do):
-    q, k, v, o, lse = res
+    q, k, v, o, lse, kv_lens = res
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
     dq, dk, dv = _flash_bwd(q, k, v, o, lse, do, sm_scale, bool(causal),
-                            int(q_offset), resolve_interpret(interpret))
-    return dq, dk, dv
+                            int(q_offset), resolve_interpret(interpret),
+                            kv_lens=kv_lens)
+    return dq, dk, dv, _kv_lens_ct(kv_lens)
 
 
 flash_attention.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)

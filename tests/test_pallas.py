@@ -386,3 +386,131 @@ def test_lstm_fused_bidirectional_matches_scan(monkeypatch):
     np.testing.assert_allclose(o_f, o_s, rtol=1e-5, atol=1e-5)
     np.testing.assert_allclose(h_f, h_s, rtol=1e-5, atol=1e-5)
     np.testing.assert_allclose(c_f, c_s, rtol=1e-5, atol=1e-5)
+
+
+def _attn_len_ref(q, k, v, kv_lens, causal=False):
+    d = q.shape[-1]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) / np.sqrt(d)
+    mask = jnp.arange(k.shape[2])[None, None, None, :] \
+        < kv_lens[:, None, None, None]
+    if causal:
+        cm = jnp.tril(jnp.ones((s.shape[-2], s.shape[-1]), bool),
+                      k=s.shape[-1] - s.shape[-2])
+        mask = jnp.logical_and(mask, cm)
+    s = jnp.where(mask, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    p = jnp.where(jnp.broadcast_to(mask, s.shape).any(-1, keepdims=True),
+                  p, 0.0)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("split_bwd", [False, True])
+def test_flash_attention_variable_length(causal, split_bwd, monkeypatch):
+    """Per-example kv_lens (VERDICT r3 #2): forward and all three
+    gradients match the masked composed softmax, on both backward
+    paths, with lengths crossing tile boundaries and the loss masking
+    padded positions (the contract under which padded-row grads vanish
+    identically)."""
+    if split_bwd:
+        monkeypatch.setenv("MXNET_TPU_FLASH_SPLIT_BWD", "1")
+    rng = np.random.RandomState(7)
+    B, H, S, D = 3, 2, 40, 16
+    q = jnp.asarray(rng.randn(B, H, S, D).astype(np.float32)) * 0.3
+    k = jnp.asarray(rng.randn(B, H, S, D).astype(np.float32)) * 0.3
+    v = jnp.asarray(rng.randn(B, H, S, D).astype(np.float32))
+    kv_lens = jnp.asarray([40, 17, 0], jnp.int32)  # incl. an EMPTY example
+
+    o = flash_attention(q, k, v, None, causal, 0, True, kv_lens)
+    ref = _attn_len_ref(q, k, v, kv_lens, causal)
+    np.testing.assert_allclose(np.asarray(o), np.asarray(ref),
+                               rtol=RTOL, atol=ATOL)
+    assert np.all(np.asarray(o[2]) == 0.0)  # empty example -> exact zeros
+
+    wmask = (jnp.arange(S)[None, :] < kv_lens[:, None]) \
+        .astype(jnp.float32)[:, None, :, None]
+    w = jnp.asarray(rng.randn(B, H, S, D).astype(np.float32)) * wmask
+    gf = jax.grad(lambda q, k, v: (flash_attention(
+        q, k, v, None, causal, 0, True, kv_lens) * w).sum(),
+        argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda q, k, v: (_attn_len_ref(
+        q, k, v, kv_lens, causal) * w).sum(), argnums=(0, 1, 2))(q, k, v)
+    for a, c in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(c),
+                                   rtol=RTOL, atol=ATOL)
+    # keys past each example's length get identically-zero dk/dv
+    for g in gf[1:]:
+        arr = np.asarray(g)
+        for b_ in range(B):
+            assert np.all(arr[b_, :, int(kv_lens[b_]):] == 0.0)
+
+
+def test_flash_attention_op_valid_len_dispatch(monkeypatch):
+    """mx.nd.flash_attention(q, k, v, valid_len) routes the length to
+    the kernel AND the jnp fallback identically."""
+    monkeypatch.setenv("MXNET_TPU_PALLAS_INTERPRET", "1")
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+
+    rng = np.random.RandomState(8)
+    B, H, S, D = 2, 2, 24, 8
+    q = mx.nd.array(rng.randn(B, H, S, D).astype(np.float32) * 0.3)
+    k = mx.nd.array(rng.randn(B, H, S, D).astype(np.float32) * 0.3)
+    v = mx.nd.array(rng.randn(B, H, S, D).astype(np.float32))
+    vl = mx.nd.array(np.array([24, 9], np.float32))
+
+    out_kernel = nd.flash_attention(q, k, v, vl)
+    monkeypatch.setenv("MXNET_TPU_DISABLE_PALLAS", "1")
+    out_jnp = nd.flash_attention(q, k, v, vl)
+    np.testing.assert_allclose(out_kernel.asnumpy(), out_jnp.asnumpy(),
+                               rtol=RTOL, atol=ATOL)
+    # sanity: the length actually masks (row attending to only 9 keys
+    # differs from the unmasked result)
+    monkeypatch.delenv("MXNET_TPU_DISABLE_PALLAS")
+    full = nd.flash_attention(q, k, v)
+    assert np.abs(out_kernel.asnumpy()[1] - full.asnumpy()[1]).max() > 1e-3
+
+
+def test_transformer_valid_length_end_to_end(monkeypatch):
+    """BERT-style MultiHeadAttention with valid_length: flash path ==
+    composed attention_length_mask path, gradients included."""
+    monkeypatch.setenv("MXNET_TPU_PALLAS_INTERPRET", "1")
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd
+    from mxnet_tpu.gluon.nn.transformer import MultiHeadAttention
+
+    rng = np.random.RandomState(9)
+    B, S, C, Hd = 2, 20, 32, 4
+    mx.random.seed(11)
+    attn = MultiHeadAttention(C, Hd)
+    x = mx.nd.array(rng.randn(B, S, C).astype(np.float32))
+    attn.initialize(init=mx.initializer.Xavier())
+    vl = mx.nd.array(np.array([20, 0], np.float32))
+
+    wmask = mx.nd.array((np.arange(S)[None, :, None]
+                         < np.array([20, 0])[:, None, None])
+                        .astype(np.float32))
+
+    x.attach_grad()
+    with autograd.record():
+        out_flash = attn(x, None, vl)
+        (out_flash * wmask).sum().backward()
+    g_flash = x.grad.asnumpy().copy()
+
+    # force the composed path via a zero additive mask (same math)
+    zero_mask = mx.nd.zeros((B, 1, S, S))
+    x2 = mx.nd.array(x.asnumpy())
+    x2.attach_grad()
+    with autograd.record():
+        out_comp = attn(x2, zero_mask, vl)
+        (out_comp * wmask).sum().backward()
+
+    # FULL-output agreement, including the empty (valid_len == 0)
+    # example: both paths must emit the zero-attention result there
+    # (attention_zero_empty_rows on the composed path, l==0 guard in
+    # the kernel)
+    np.testing.assert_allclose(out_flash.asnumpy(), out_comp.asnumpy(),
+                               rtol=RTOL, atol=ATOL)
+    np.testing.assert_allclose(g_flash, x2.grad.asnumpy(),
+                               rtol=RTOL, atol=ATOL)

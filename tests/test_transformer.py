@@ -128,6 +128,10 @@ def test_bert_mlm_nsp_training_step():
 
 
 def test_bert_padding_mask_isolates_padding():
+    """Changing token ids in padded positions must not change valid
+    positions' outputs — via the additive mask (fourth positional;
+    the third is valid_length, GluonNLP order) AND via
+    valid_length itself (the flash kernel's native length path)."""
     rng = np.random.RandomState(4)
     net = bert_base(vocab_size=50, max_length=32, num_layers=2, units=32,
                     hidden_size=64, num_heads=4, dropout=0.0)
@@ -137,10 +141,19 @@ def test_bert_padding_mask_isolates_padding():
     mask = np.zeros((2, 1, 16, 16), np.float32)
     mask[:, :, :, 12:] = -1e9
     m = mx.nd.array(mask)
+    ids2 = ids.copy()
+    ids2[:, 12:] = 3
     with autograd.predict_mode():
-        s1, _ = net(mx.nd.array(ids, dtype="int32"), tt, m)
-        ids2 = ids.copy()
-        ids2[:, 12:] = 3
-        s2, _ = net(mx.nd.array(ids2, dtype="int32"), tt, m)
+        s1, _ = net(mx.nd.array(ids, dtype="int32"), tt, None, m)
+        s2, _ = net(mx.nd.array(ids2, dtype="int32"), tt, None, m)
     np.testing.assert_allclose(s1.asnumpy()[:, :12], s2.asnumpy()[:, :12],
                                rtol=1e-6, atol=1e-6)
+    vl = mx.nd.array(np.array([12, 12], np.float32))
+    with autograd.predict_mode():
+        v1, _ = net(mx.nd.array(ids, dtype="int32"), tt, vl)
+        v2, _ = net(mx.nd.array(ids2, dtype="int32"), tt, vl)
+    np.testing.assert_allclose(v1.asnumpy()[:, :12], v2.asnumpy()[:, :12],
+                               rtol=1e-6, atol=1e-6)
+    # the two maskings agree on valid positions
+    np.testing.assert_allclose(v1.asnumpy()[:, :12], s1.asnumpy()[:, :12],
+                               rtol=1e-5, atol=1e-6)
