@@ -27,3 +27,6 @@ from .ring_attention import (
     ring_attention, ulysses_attention,
     make_ring_attention_fn, make_ulysses_attention_fn,
 )
+from .sharded_embedding import (
+    make_sharded_embedding_fn, shard_embedding_table,
+)
