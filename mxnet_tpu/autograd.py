@@ -208,6 +208,19 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
         cot[k] = cot[k] + g if k in cot else g
 
     tape = _STATE.tape
+    # a head no tape node produced yields all-zero gradients — the
+    # reference's documented no-op for unrecorded graphs, but ALSO the
+    # classic silent footgun (loss.sum() OUTSIDE record() drops the
+    # reduction off the tape). Keep the no-op semantics, but say so.
+    taped_keys = {k for node in tape for k in node.out_keys}
+    for h in heads:
+        if _key(h) not in taped_keys:
+            import warnings
+            warnings.warn(
+                "backward() head was not computed inside autograd."
+                "record() (or was mutated since); gradients will not "
+                "flow through it — did you call .sum() on the loss "
+                "AFTER the record block?", stacklevel=2)
     touched_leaves = []
     leaf_slots: dict = {}  # id(leaf) → set of tape value-keys it fed
     used_nodes: set = set()  # nodes this sweep consumed (freed below)

@@ -12,6 +12,35 @@ def arange_like(data, start=0.0, step=1.0, repeat=1, axis=None):
                     "axis": axis})
 
 
+def boolean_mask(data, index, axis=0):
+    """Select the slices of ``data`` along ``axis`` where ``index`` is
+    nonzero (reference src/operator/contrib/boolean_mask.cc).
+
+    The output shape depends on the mask VALUES — inherently dynamic,
+    so this is an eager-only op (the reference's is likewise imperative
+    contrib): the mask syncs to host once, then the pick lowers to a
+    single differentiable ``take`` (gradients scatter back through its
+    VJP; positions masked out get zero gradient). Inside jit/hybridize
+    use ``where``-style masking with a static shape instead.
+    """
+    import numpy as np
+    from .ndarray import NDArray, array as _array
+
+    if not isinstance(index, NDArray) or not isinstance(data, NDArray):
+        raise TypeError("boolean_mask expects NDArray data and index")
+    mask = index.asnumpy()
+    if mask.ndim != 1:
+        raise ValueError(f"index must be 1-D, got shape {mask.shape}")
+    if mask.shape[0] != data.shape[int(axis)]:
+        raise ValueError(
+            f"boolean_mask: index length {mask.shape[0]} != data.shape"
+            f"[{int(axis)}] = {data.shape[int(axis)]}")
+    keep = np.flatnonzero(mask != 0).astype(np.int64)
+    from . import take as _take
+    return _take(data, _array(keep, ctx=data.ctx), axis=int(axis),
+                 mode="clip")
+
+
 def index_copy(old_tensor, index_vector, new_tensor):
     import jax.numpy as jnp
     from .ndarray import _wrap

@@ -779,6 +779,142 @@ def linalg_syrk(A, transpose=False, alpha=1.0):
     return alpha * jnp.matmul(a, jnp.swapaxes(a, -1, -2))
 
 
+@register_op("linalg_potri")
+def linalg_potri(A):
+    """Inverse of B = A A^T from its Cholesky factor A (la_op.cc potri):
+    (A A^T)^{-1} = A^{-T} A^{-1}, via two triangular solves — no
+    general inverse materializes."""
+    eye = jnp.broadcast_to(jnp.eye(A.shape[-1], dtype=A.dtype), A.shape)
+    ainv = jax.scipy.linalg.solve_triangular(A, eye, lower=True)
+    return jnp.matmul(jnp.swapaxes(ainv, -1, -2), ainv)
+
+
+@register_op("linalg_trmm")
+def linalg_trmm(A, B, transpose=False, rightside=False, lower=True,
+                alpha=1.0):
+    """Triangular matrix multiply (la_op.cc trmm): B <- alpha op(tri(A))
+    B, or B op(tri(A)) when rightside."""
+    tri = jnp.tril(A) if lower else jnp.triu(A)
+    if transpose:
+        tri = jnp.swapaxes(tri, -1, -2)
+    out = jnp.matmul(B, tri) if rightside else jnp.matmul(tri, B)
+    return alpha * out
+
+
+@register_op("linalg_makediag")
+def linalg_makediag(A, offset=0):
+    """(..., n) vector(s) -> (..., n+|k|, n+|k|) diagonal matrices."""
+    offset = int(offset)
+    n = A.shape[-1] + abs(offset)
+    rows, cols = np.nonzero(np.eye(n, k=offset, dtype=bool))
+    out = jnp.zeros(A.shape[:-1] + (n, n), A.dtype)
+    return out.at[..., rows, cols].set(A)
+
+
+def _tri_count(n, offset, lower):
+    """Entries in the (lower, k=offset) / (upper, k=offset) triangle of
+    an (n, n) matrix — closed form, no index materialization."""
+    k = offset if lower else -offset  # upper(k) == lower(-k) transposed
+    # lower triangle with diagonal shift k: rows i get
+    # clip(i + k + 1, 0, n) entries
+    c = np.clip(np.arange(n) + k + 1, 0, n)
+    return int(c.sum())
+
+
+def _trian_n(m, offset, lower):
+    """Matrix size n whose triangle has m entries (closed-form count,
+    linear scan over n without building index arrays)."""
+    for n in range(1, 65536):
+        cnt = _tri_count(n, offset, lower)
+        if cnt == m:
+            return n
+        if cnt > m:
+            break
+    raise ValueError(f"no matrix size has a {m}-entry triangle "
+                     f"(offset={offset}, lower={lower})")
+
+
+@register_op("linalg_maketrian")
+def linalg_maketrian(A, offset=0, lower=True):
+    """Packed (..., m) vector -> (..., n, n) triangular matrix, row-major
+    packing (la_op.cc maketrian)."""
+    offset, lower = int(offset), bool(lower)
+    n = _trian_n(A.shape[-1], offset, lower)
+    rows, cols = (np.tril_indices(n, k=offset) if lower
+                  else np.triu_indices(n, k=offset))
+    out = jnp.zeros(A.shape[:-1] + (n, n), A.dtype)
+    return out.at[..., rows, cols].set(A)
+
+
+@register_op("linalg_extracttrian")
+def linalg_extracttrian(A, offset=0, lower=True):
+    """(..., n, n) -> packed (..., m) triangle, row-major (inverse of
+    maketrian)."""
+    offset, lower = int(offset), bool(lower)
+    n = A.shape[-1]
+    rows, cols = (np.tril_indices(n, k=offset) if lower
+                  else np.triu_indices(n, k=offset))
+    return A[..., rows, cols]
+
+
+# ----------------------------------------------------------------------
+# im2col / col2im (src/operator/nn/im2col.h surface ops)
+# ----------------------------------------------------------------------
+def _conv_geom(kernel, stride, dilate, pad):
+    k = tuple(int(v) for v in kernel)
+    nd_ = len(k)
+    as_t = lambda v, d: tuple(int(x) for x in v) if v else (d,) * nd_
+    return k, as_t(stride, 1), as_t(dilate, 1), as_t(pad, 0)
+
+
+@register_op("im2col")
+def im2col(data, kernel=None, stride=None, dilate=None, pad=None):
+    """(N, C, H, W) -> (N, C*kh*kw, out_h*out_w): unfold sliding
+    windows, channel-major then kernel-position row-major — the
+    reference's im2col buffer layout (src/operator/nn/im2col.h), so a
+    conv is im2col + one gemm."""
+    (kh, kw), (sh, sw), (dh, dw), (ph, pw) = _conv_geom(
+        kernel, stride, dilate, pad)
+    x = jnp.pad(data, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+    n, c, hp, wp = x.shape
+    oh = (hp - (dh * (kh - 1) + 1)) // sh + 1
+    ow = (wp - (dw * (kw - 1) + 1)) // sw + 1
+    cols = []
+    for i in range(kh):
+        for j in range(kw):
+            patch = jax.lax.slice(
+                x, (0, 0, i * dh, j * dw),
+                (n, c, i * dh + (oh - 1) * sh + 1, j * dw + (ow - 1) * sw + 1),
+                (1, 1, sh, sw))
+            cols.append(patch)  # (n, c, oh, ow)
+    out = jnp.stack(cols, axis=2)  # (n, c, kh*kw, oh, ow)
+    return out.reshape(n, c * kh * kw, oh * ow)
+
+
+@register_op("col2im")
+def col2im(data, output_size=None, kernel=None, stride=None, dilate=None,
+           pad=None):
+    """(N, C*kh*kw, L) -> (N, C, H, W): scatter-add the unfolded
+    windows back (im2col's adjoint, src/operator/nn/im2col.h col2im)."""
+    (kh, kw), (sh, sw), (dh, dw), (ph, pw) = _conv_geom(
+        kernel, stride, dilate, pad)
+    H, W = (int(v) for v in output_size)
+    n, ckk, L = data.shape
+    c = ckk // (kh * kw)
+    hp, wp = H + 2 * ph, W + 2 * pw
+    oh = (hp - (dh * (kh - 1) + 1)) // sh + 1
+    ow = (wp - (dw * (kw - 1) + 1)) // sw + 1
+    cols = data.reshape(n, c, kh * kw, oh, ow)
+    out = jnp.zeros((n, c, hp, wp), data.dtype)
+    for i in range(kh):
+        for j in range(kw):
+            patch = cols[:, :, i * kw + j]  # (n, c, oh, ow)
+            out = out.at[:, :,
+                         i * dh:i * dh + (oh - 1) * sh + 1:sh,
+                         j * dw:j * dw + (ow - 1) * sw + 1:sw].add(patch)
+    return out[:, :, ph:ph + H, pw:pw + W]
+
+
 # ----------------------------------------------------------------------
 # misc
 # ----------------------------------------------------------------------

@@ -387,6 +387,31 @@ def batch_dot_attention_apply(probs, value):
     return jnp.einsum("bhqk,bhkd->bhqd", probs, value)
 
 
+@register_op("ctc_loss", aliases=("CTCLoss", "_contrib_ctc_loss"))
+def ctc_loss_op(data, label, data_lengths=None, label_lengths=None,
+                use_data_lengths=False, use_label_lengths=False,
+                blank_label="first"):
+    """Connectionist temporal classification loss (reference
+    src/operator/nn/ctc_loss.cc / warp-ctc). data (T, N, C)
+    unnormalized, label (N, L). blank_label='first': index 0 is blank
+    and labels use 1..C-1 (the math in ops/ctc.py); 'last': index C-1
+    is blank and labels use 0..C-2 (mapped by rolling the alphabet).
+    Returns (N,) losses; gradients via autodiff of the lax.scan alpha
+    recursion."""
+    from ..ops.ctc import ctc_loss as _ctc
+
+    if blank_label not in ("first", "last"):
+        raise ValueError(f"blank_label must be first|last, got {blank_label}")
+    if blank_label == "last":
+        # move blank C-1 -> 0; real classes 0..C-2 -> 1..C-1. Padding in
+        # `label` for 'last' mode is -1 (reference convention) -> 0.
+        data = jnp.concatenate([data[..., -1:], data[..., :-1]], axis=-1)
+        label = jnp.where(label < 0, -1, label) + 1
+    dl = data_lengths if use_data_lengths else None
+    ll = label_lengths if use_label_lengths else None
+    return _ctc(data, label, dl, ll)
+
+
 @register_op("attention_length_mask")
 def attention_length_mask(scores, valid_len):
     """Mask score columns at/after each example's valid length with

@@ -85,17 +85,22 @@ def ctc_loss(logits, labels, input_lengths=None, label_lengths=None):
 
 
 def ctc_loss_nd(pred, label, pred_lengths=None, label_lengths=None):
-    """NDArray-facing wrapper used by gluon.loss.CTCLoss."""
-    from ..ndarray.register import invoke, Op
-    from ..ndarray import NDArray
+    """NDArray-facing wrapper used by gluon.loss.CTCLoss — dispatches
+    the REGISTERED ctc_loss op (one implementation, owned by the
+    coverage gate)."""
+    from ..ndarray.register import invoke, get_op
+    from ..ndarray import full as _full
 
-    op = Op("ctc_loss", lambda p, l, *rest: ctc_loss(
-        p, l,
-        rest[0] if len(rest) > 0 else None,
-        rest[1] if len(rest) > 1 else None))
+    if pred_lengths is None and label_lengths is not None:
+        # the registered op takes lengths positionally (data first);
+        # synthesize full-T data lengths so label_lengths can ride
+        pred_lengths = _full((pred.shape[1],), pred.shape[0],
+                             ctx=pred.ctx, dtype="int32")
     inputs = [pred, label]
+    params = {"use_data_lengths": pred_lengths is not None,
+              "use_label_lengths": label_lengths is not None}
     if pred_lengths is not None:
         inputs.append(pred_lengths)
     if label_lengths is not None:
         inputs.append(label_lengths)
-    return invoke(op, inputs, {})
+    return invoke(get_op("ctc_loss"), inputs, params)

@@ -70,8 +70,15 @@ def test_deferred_init_error_message():
 
 
 def test_backward_outside_record_has_no_graph():
+    import warnings
     x = nd.ones((2, 2))
     x.attach_grad()
     y = x * 2.0  # not recorded
-    y.backward()  # reference: no-op backward on unrecorded graph
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        y.backward()  # reference: no-op backward on unrecorded graph
     assert (x.grad.asnumpy() == 0).all()
+    # ... but the silent-zero footgun (e.g. loss.sum() after the record
+    # block) is loudly flagged
+    assert any("not computed inside autograd" in str(wi.message)
+               for wi in w)

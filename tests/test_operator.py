@@ -1045,3 +1045,144 @@ def test_batch_norm_layer_train_vs_eval_running_stats():
     want_eval = (x.asnumpy() - rm.reshape(1, -1, 1, 1)) / np.sqrt(
         rv.reshape(1, -1, 1, 1) + 1e-5)
     np.testing.assert_allclose(out_eval, want_eval, rtol=1e-4, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# Op-breadth tail (VERDICT r3 #6): linalg potri/trmm/makediag/maketrian/
+# extracttrian, im2col/col2im, registered ctc_loss, contrib.boolean_mask
+# ---------------------------------------------------------------------------
+
+def test_linalg_potri_trmm():
+    rs = np.random.RandomState(0)
+    m = rs.rand(4, 4).astype(np.float32)
+    spd = m @ m.T + 4 * np.eye(4, dtype=np.float32)
+    L = nd.linalg_potrf(nd.array(spd))
+    inv = nd.linalg_potri(L)
+    np.testing.assert_allclose(inv.asnumpy(), np.linalg.inv(spd),
+                               rtol=1e-3, atol=1e-4)
+    B = nd.array(rs.rand(4, 3).astype(np.float32))
+    out = nd.linalg_trmm(L, B, alpha=2.0)
+    np.testing.assert_allclose(out.asnumpy(),
+                               2.0 * np.tril(L.asnumpy()) @ B.asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+    # rightside + transpose
+    B2 = nd.array(rs.rand(3, 4).astype(np.float32))
+    out2 = nd.linalg_trmm(L, B2, rightside=True, transpose=True)
+    np.testing.assert_allclose(out2.asnumpy(),
+                               B2.asnumpy() @ np.tril(L.asnumpy()).T,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_linalg_makediag_maketrian_roundtrip():
+    rs = np.random.RandomState(1)
+    v = rs.rand(2, 5).astype(np.float32)
+    d = nd.linalg_makediag(nd.array(v))
+    assert d.shape == (2, 5, 5)
+    np.testing.assert_allclose(d.asnumpy()[1], np.diag(v[1]), rtol=1e-6)
+    d1 = nd.linalg_makediag(nd.array(v), offset=1)
+    assert d1.shape == (2, 6, 6)
+    np.testing.assert_allclose(d1.asnumpy()[0], np.diag(v[0], k=1),
+                               rtol=1e-6)
+
+    m = rs.rand(3, 4, 4).astype(np.float32)
+    packed = nd.linalg_extracttrian(nd.array(m))
+    assert packed.shape == (3, 10)
+    rows, cols = np.tril_indices(4)
+    np.testing.assert_allclose(packed.asnumpy(), m[:, rows, cols],
+                               rtol=1e-6)
+    back = nd.linalg_maketrian(packed)
+    np.testing.assert_allclose(back.asnumpy(), np.tril(m), rtol=1e-6)
+    # upper triangle with offset
+    up = nd.linalg_extracttrian(nd.array(m), offset=1, lower=False)
+    assert up.shape == (3, 6)
+    back_up = nd.linalg_maketrian(up, offset=1, lower=False)
+    np.testing.assert_allclose(back_up.asnumpy(), np.triu(m, k=1),
+                               rtol=1e-6)
+
+
+def test_linalg_tail_numeric_grad():
+    from mxnet_tpu.test_utils import check_numeric_gradient
+    rs = np.random.RandomState(2)
+    L = np.tril(rs.rand(3, 3).astype(np.float32)) + 2 * np.eye(3, dtype=np.float32)
+    check_numeric_gradient(lambda a: nd.linalg_potri(a).sum(), [L])
+    B = nd.array(rs.rand(3, 2).astype(np.float32))
+    check_numeric_gradient(lambda a: nd.linalg_trmm(a, B).sum(), [L])
+    check_numeric_gradient(lambda v: nd.linalg_maketrian(v).sum(),
+                           [rs.rand(6).astype(np.float32)])
+
+
+def test_im2col_col2im():
+    rs = np.random.RandomState(3)
+    x = rs.rand(2, 3, 6, 7).astype(np.float32)
+    cols = nd.im2col(nd.array(x), kernel=(3, 2), stride=(2, 1),
+                     dilate=(1, 1), pad=(1, 0))
+    oh = (6 + 2 - 3) // 2 + 1
+    ow = (7 - 2) // 1 + 1
+    assert cols.shape == (2, 3 * 3 * 2, oh * ow)
+    # golden: manual window extraction, channel-major then (ki, kj)
+    xp = np.pad(x, ((0, 0), (0, 0), (1, 1), (0, 0)))
+    got = cols.asnumpy().reshape(2, 3, 3, 2, oh, ow)
+    for ki in range(3):
+        for kj in range(2):
+            want = xp[:, :, ki:ki + 2 * (oh - 1) + 1:2,
+                      kj:kj + (ow - 1) + 1:1]
+            np.testing.assert_allclose(got[:, :, ki, kj], want, rtol=1e-6)
+
+    # col2im is im2col's adjoint: <col2im(c), x> == <c, im2col(x)>
+    c = rs.rand(2, 18, oh * ow).astype(np.float32)
+    back = nd.col2im(nd.array(c), output_size=(6, 7), kernel=(3, 2),
+                     stride=(2, 1), dilate=(1, 1), pad=(1, 0))
+    lhs = float((back.asnumpy() * x).sum())
+    rhs = float((c * cols.asnumpy()).sum())
+    np.testing.assert_allclose(lhs, rhs, rtol=1e-4)
+
+
+def test_ctc_loss_registered_op():
+    rs = np.random.RandomState(4)
+    T, N, C, L = 10, 3, 6, 4
+    data = rs.randn(T, N, C).astype(np.float32)
+    labels_first = np.array([[1, 2, 3, 0], [2, 2, 0, 0], [5, 4, 3, 2]],
+                            np.float32)  # 0 = padding (blank reserved)
+    out = nd.ctc_loss(nd.array(data), nd.array(labels_first))
+    assert out.shape == (N,)
+    assert np.all(out.asnumpy() > 0)
+
+    # blank_label='last' maps onto the same math: rolled alphabet +
+    # shifted labels must give identical losses
+    data_last = np.concatenate([data[..., 1:], data[..., :1]], axis=-1)
+    labels_last = np.where(labels_first > 0, labels_first - 1, -1)
+    out_last = nd.ctc_loss(nd.array(data_last), nd.array(labels_last),
+                           blank_label="last")
+    np.testing.assert_allclose(out_last.asnumpy(), out.asnumpy(),
+                               rtol=1e-5, atol=1e-5)
+
+    # gradient flows
+    d = nd.array(data)
+    d.attach_grad()
+    with mx.autograd.record():
+        loss = nd.ctc_loss(d, nd.array(labels_first)).sum()
+    loss.backward()
+    g = d.grad.asnumpy()
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+def test_contrib_boolean_mask():
+    rs = np.random.RandomState(5)
+    x = nd.array(rs.rand(6, 4).astype(np.float32))
+    mask = nd.array(np.array([1, 0, 1, 1, 0, 1], np.float32))
+    out = nd.contrib.boolean_mask(x, mask)
+    np.testing.assert_allclose(out.asnumpy(),
+                               x.asnumpy()[[0, 2, 3, 5]], rtol=1e-6)
+    # axis=1
+    m2 = nd.array(np.array([0, 1, 1, 0], np.float32))
+    out2 = nd.contrib.boolean_mask(x, m2, axis=1)
+    np.testing.assert_allclose(out2.asnumpy(), x.asnumpy()[:, [1, 2]],
+                               rtol=1e-6)
+    # gradients scatter back through take's VJP
+    x.attach_grad()
+    with mx.autograd.record():
+        y = nd.contrib.boolean_mask(x, mask)
+        y.sum().backward()
+    g = x.grad.asnumpy()
+    np.testing.assert_allclose(g[[0, 2, 3, 5]], 1.0)
+    np.testing.assert_allclose(g[[1, 4]], 0.0)
