@@ -1,0 +1,153 @@
+"""Local pretrained-weight store
+(python/mxnet/gluon/model_zoo/model_store.py analog).
+
+The reference resolves a model name to ``{name}-{sha1[:8]}.params`` in
+a local root, verifies the SHA-1, and downloads on miss. This
+environment has zero egress, so the TPU-native store is LOCAL-ONLY:
+weights enter the store explicitly (``publish_model_file`` — e.g. from
+a converted checkpoint on shared storage via the filesystem layer),
+the hash registry persists next to the files (``model_index.json``),
+and ``get_model_file`` resolves + verifies exactly like the reference.
+A miss raises with the publish instructions instead of downloading.
+
+Root resolution order: explicit ``root`` arg → $MXNET_TPU_MODEL_STORE →
+$MXNET_HOME/models → ~/.mxnet/models (the reference default).
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+
+from ...base import MXNetError
+
+__all__ = ["get_model_file", "publish_model_file", "purge"]
+
+_INDEX = "model_index.json"
+
+
+def _default_root():
+    env = os.environ.get("MXNET_TPU_MODEL_STORE")
+    if env:
+        return env
+    home = os.environ.get("MXNET_HOME")
+    if home:
+        return os.path.join(home, "models")
+    return os.path.join("~", ".mxnet", "models")
+
+
+def _load_index(root):
+    path = os.path.join(root, _INDEX)
+    if os.path.isfile(path):
+        with open(path) as f:
+            return json.load(f)
+    return {}
+
+
+def _save_index(root, index):
+    with open(os.path.join(root, _INDEX), "w") as f:
+        json.dump(index, f, indent=1, sort_keys=True)
+
+
+def _sha1(path):
+    h = hashlib.sha1()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def short_hash(name, root=None):
+    """First 8 sha1 chars of the stored file for ``name`` (the
+    reference's filename stamp)."""
+    root = os.path.expanduser(root or _default_root())
+    index = _load_index(root)
+    if name not in index:
+        raise ValueError(f"{name} is not present in the model store "
+                         f"at {root}")
+    return index[name]["sha1"][:8]
+
+
+def get_model_file(name, root=None):
+    """Path to the verified ``{name}-{sha1[:8]}.params`` file.
+
+    Exact reference contract minus the download: if the file exists and
+    its SHA-1 matches the index, return it; if it exists but mismatches,
+    raise (corruption is never silently loaded); if absent, raise with
+    the local-publish instructions.
+    """
+    root = os.path.expanduser(root or _default_root())
+    index = _load_index(root)
+    if name in index:
+        # an indexed name NEVER falls through to the unverified bare
+        # file: a missing/corrupt indexed file is an error, not a
+        # silent downgrade
+        entry = index[name]
+        fname = os.path.join(root, entry["file"])
+        if not os.path.isfile(fname):
+            raise MXNetError(
+                f"the model store index at {root} names {entry['file']} "
+                f"for {name!r} but the file is gone — re-publish it "
+                "with publish_model_file")
+        if _sha1(fname) != entry["sha1"]:
+            raise MXNetError(
+                f"checksum mismatch for {fname} (expected "
+                f"{entry['sha1']}); the stored weights are corrupt — "
+                "re-publish them with publish_model_file")
+        return fname
+    # un-indexed fallback: a bare {name}.params dropped into the root
+    # (no hash recorded anywhere, so nothing to verify against — the
+    # reference behaves the same for hand-placed files)
+    bare = os.path.join(root, f"{name}.params")
+    if os.path.isfile(bare):
+        return bare
+    raise MXNetError(
+        f"pretrained weights for {name!r} are not in the local model "
+        f"store at {root} and cannot be downloaded (zero-egress "
+        "environment). Publish them once with\n"
+        f"  mxnet_tpu.gluon.model_zoo.model_store.publish_model_file("
+        f"{name!r}, '/path/to/{name}.params')\n"
+        f"or drop a {name}.params file into {root}.")
+
+
+def publish_model_file(name, path, root=None):
+    """Copy ``path`` into the store as ``{name}-{sha1[:8]}.params`` and
+    record its hash in the index. Returns the stored path."""
+    root = os.path.expanduser(root or _default_root())
+    os.makedirs(root, exist_ok=True)
+    if not os.path.isfile(path):
+        raise MXNetError(f"no weights file at {path}")
+    sha = _sha1(path)
+    fname = f"{name}-{sha[:8]}.params"
+    dst = os.path.join(root, fname)
+    if os.path.abspath(path) != os.path.abspath(dst):
+        shutil.copyfile(path, dst)
+    index = _load_index(root)
+    prev = index.get(name)
+    index[name] = {"file": fname, "sha1": sha}
+    _save_index(root, index)
+    if prev and prev["file"] != fname:
+        # re-publish repoints the index — drop the orphaned old file
+        old = os.path.join(root, prev["file"])
+        if os.path.isfile(old):
+            os.remove(old)
+    return dst
+
+
+def load_pretrained(net, name, ctx=None, root=None):
+    """Resolve ``name`` in the store and load the verified weights into
+    ``net`` (the shared tail of every model-zoo ``pretrained=True``)."""
+    net.load_parameters(get_model_file(name, root=root), ctx=ctx)
+    return net
+
+
+def purge(root=None):
+    """Remove every stored .params file and the index (reference
+    model_store.purge)."""
+    root = os.path.expanduser(root or _default_root())
+    if not os.path.isdir(root):
+        return
+    for f in os.listdir(root):
+        if f.endswith(".params") or f == _INDEX:
+            os.remove(os.path.join(root, f))

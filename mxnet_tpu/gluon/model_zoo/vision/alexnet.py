@@ -42,6 +42,6 @@ class AlexNet(HybridBlock):
 def alexnet(pretrained=False, ctx=None, root=None, **kwargs):
     net = AlexNet(**kwargs)
     if pretrained:
-        from ....base import MXNetError
-        raise MXNetError("pretrained weights unavailable offline")
+        from ..model_store import load_pretrained
+        load_pretrained(net, "alexnet", ctx=ctx, root=root)
     return net

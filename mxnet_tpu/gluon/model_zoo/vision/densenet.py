@@ -81,14 +81,13 @@ densenet_spec = {121: (64, 32, [6, 12, 24, 16]),
                  201: (64, 32, [6, 12, 48, 32])}
 
 
-def get_densenet(num_layers, pretrained=False, **kwargs):
+def get_densenet(num_layers, pretrained=False, ctx=None, root=None,
+                 **kwargs):
     num_init_features, growth_rate, block_config = densenet_spec[num_layers]
-    kwargs.pop("ctx", None)
-    kwargs.pop("root", None)
     net = DenseNet(num_init_features, growth_rate, block_config, **kwargs)
     if pretrained:
-        from ....base import MXNetError
-        raise MXNetError("pretrained weights unavailable offline")
+        from ..model_store import load_pretrained
+        load_pretrained(net, f"densenet{num_layers}", ctx=ctx, root=root)
     return net
 
 

@@ -150,11 +150,9 @@ class Inception3(HybridBlock):
         return x
 
 
-def inception_v3(pretrained=False, **kwargs):
-    kwargs.pop("ctx", None)
-    kwargs.pop("root", None)
+def inception_v3(pretrained=False, ctx=None, root=None, **kwargs):
     net = Inception3(**kwargs)
     if pretrained:
-        from ....base import MXNetError
-        raise MXNetError("pretrained weights unavailable offline")
+        from ..model_store import load_pretrained
+        load_pretrained(net, "inceptionv3", ctx=ctx, root=root)
     return net

@@ -112,16 +112,16 @@ class MobileNetV2(HybridBlock):
 def get_mobilenet(multiplier, pretrained=False, ctx=None, root=None, **kwargs):
     net = MobileNet(multiplier, **kwargs)
     if pretrained:
-        from ....base import MXNetError
-        raise MXNetError("pretrained weights unavailable offline")
+        from ..model_store import load_pretrained
+        load_pretrained(net, f"mobilenet{multiplier}", ctx=ctx, root=root)
     return net
 
 
 def get_mobilenet_v2(multiplier, pretrained=False, ctx=None, root=None, **kwargs):
     net = MobileNetV2(multiplier, **kwargs)
     if pretrained:
-        from ....base import MXNetError
-        raise MXNetError("pretrained weights unavailable offline")
+        from ..model_store import load_pretrained
+        load_pretrained(net, f"mobilenetv2_{multiplier}", ctx=ctx, root=root)
     return net
 
 

@@ -6,9 +6,6 @@ mode for CIFAR) so checkpoints and layer names line up.
 """
 from __future__ import annotations
 
-import os
-
-from ....base import MXNetError
 from ...block import HybridBlock
 from ... import nn
 
@@ -319,7 +316,7 @@ resnet_block_versions = [
 
 
 def get_resnet(version, num_layers, pretrained=False, ctx=None,
-               root=os.path.join("~", ".mxnet", "models"), **kwargs):
+               root=None, **kwargs):
     assert num_layers in resnet_spec, \
         f"Invalid number of layers: {num_layers}. Options are {sorted(resnet_spec)}"
     block_type, layers, channels = resnet_spec[num_layers]
@@ -328,13 +325,9 @@ def get_resnet(version, num_layers, pretrained=False, ctx=None,
     block_class = resnet_block_versions[version - 1][block_type]
     net = resnet_class(block_class, layers, channels, **kwargs)
     if pretrained:
-        fname = os.path.join(os.path.expanduser(root),
-                             f"resnet{num_layers}_v{version}.params")
-        if not os.path.isfile(fname):
-            raise MXNetError(
-                f"pretrained weights not found at {fname}; network download "
-                "is unavailable in the TPU sandbox")
-        net.load_parameters(fname, ctx=ctx)
+        from ..model_store import load_pretrained
+        load_pretrained(net, f"resnet{num_layers}_v{version}", ctx=ctx,
+                        root=root)
     return net
 
 

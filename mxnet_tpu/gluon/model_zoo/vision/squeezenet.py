@@ -4,7 +4,7 @@ from __future__ import annotations
 from ...block import HybridBlock
 from ... import nn
 
-__all__ = ["SqueezeNet", "squeezenet1_0", "squeezenet1_1"]
+__all__ = ["SqueezeNet", "get_squeezenet", "squeezenet1_0", "squeezenet1_1"]
 
 
 def _make_fire(squeeze_channels, expand1x1_channels, expand3x3_channels):
@@ -79,11 +79,18 @@ class SqueezeNet(HybridBlock):
         return x
 
 
+def get_squeezenet(version, pretrained=False, ctx=None, root=None,
+                   **kwargs):
+    net = SqueezeNet(version, **kwargs)
+    if pretrained:
+        from ..model_store import load_pretrained
+        load_pretrained(net, f"squeezenet{version}", ctx=ctx, root=root)
+    return net
+
+
 def squeezenet1_0(**kwargs):
-    kwargs.pop("pretrained", None)
-    return SqueezeNet("1.0", **kwargs)
+    return get_squeezenet("1.0", **kwargs)
 
 
 def squeezenet1_1(**kwargs):
-    kwargs.pop("pretrained", None)
-    return SqueezeNet("1.1", **kwargs)
+    return get_squeezenet("1.1", **kwargs)

@@ -50,8 +50,9 @@ def get_vgg(num_layers, pretrained=False, ctx=None, root=None, **kwargs):
     layers, filters = vgg_spec[num_layers]
     net = VGG(layers, filters, **kwargs)
     if pretrained:
-        from ....base import MXNetError
-        raise MXNetError("pretrained weights unavailable offline")
+        from ..model_store import load_pretrained
+        bn = "_bn" if kwargs.get("batch_norm") else ""
+        load_pretrained(net, f"vgg{num_layers}{bn}", ctx=ctx, root=root)
     return net
 
 

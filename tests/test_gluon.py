@@ -1,4 +1,5 @@
 """Gluon blocks/trainer (reference tests/python/unittest/test_gluon.py scope)."""
+import os
 import numpy as np
 import pytest
 
@@ -478,3 +479,45 @@ def test_wide_deep_fused_symbolic_path():
     sym = net(mx.sym.Variable("w"), mx.sym.Variable("c"),
               mx.sym.Variable("x"))
     assert sym is not None and sym.list_arguments()
+
+
+def test_model_store_roundtrip(tmp_path):
+    """Local pretrained-weight store (model_store.py analog): publish a
+    checkpoint, resolve it hash-stamped via get_model_file, load it
+    through pretrained=True, and catch corruption."""
+    from mxnet_tpu.gluon.model_zoo import model_store, vision
+
+    root = str(tmp_path / "store")
+    # missing weights raise with publish instructions, not a download
+    with pytest.raises(mx.MXNetError, match="zero-egress"):
+        model_store.get_model_file("resnet18_v1", root=root)
+
+    mx.random.seed(0)
+    net = vision.resnet18_v1(classes=10)
+    net.initialize(init=mx.initializer.Xavier())
+    x = nd.array(np.random.RandomState(0).rand(1, 3, 32, 32)
+                 .astype(np.float32))
+    want = net(x).asnumpy()
+    src = str(tmp_path / "w.params")
+    net.save_parameters(src)
+
+    stored = model_store.publish_model_file("resnet18_v1", src, root=root)
+    assert model_store.short_hash("resnet18_v1", root=root) in stored
+    assert model_store.get_model_file("resnet18_v1", root=root) == stored
+
+    loaded = vision.resnet18_v1(classes=10, pretrained=True, root=root)
+    assert_almost_equal(loaded(x).asnumpy(), want, rtol=1e-5, atol=1e-6)
+    # get_model() front door takes the same kwargs
+    loaded2 = vision.get_model("resnet18_v1", classes=10, pretrained=True,
+                               root=root)
+    assert_almost_equal(loaded2(x).asnumpy(), want, rtol=1e-5, atol=1e-6)
+
+    # corruption is never silently loaded
+    with open(stored, "r+b") as f:
+        f.seek(10)
+        f.write(b"\xff\xff\xff")
+    with pytest.raises(mx.MXNetError, match="checksum mismatch"):
+        model_store.get_model_file("resnet18_v1", root=root)
+
+    model_store.purge(root)
+    assert not [f for f in os.listdir(root) if f.endswith(".params")]
