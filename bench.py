@@ -132,10 +132,16 @@ def _report(metric, value, unit, vs_baseline, flops_per_step=0.0,
 def _make_momentum_sgd(loss_fn, lr):
     """Jitted momentum-SGD train step over (params, moms) pytrees.
     CHAIN>1 fuses that many steps into one dispatched executable
-    (mxnet_tpu.engine.chain_steps). Returns (step, single_step) —
-    single_step is the un-chained jit used ONLY for cost analysis (XLA
-    cost_analysis counts a while-loop body once, so per-model-step
-    flops/bytes must come from the single-step executable)."""
+    (mxnet_tpu.engine.chain_steps).
+
+    Cost accounting: XLA cost_analysis counts a lax.scan/while body
+    ONCE regardless of trip count (verified empirically: the chained
+    ResNet executable reports 2.86 TF — exactly the xprof-measured
+    single-step flops), so the chained executable's cost IS the
+    per-model-step cost. If an XLA upgrade ever switches to
+    trip-multiplied counting, every measurement would read CHAIN-times
+    over the physical bound and _guard_impossible would raise loudly
+    rather than record inflated MFU."""
     import jax
     import jax.numpy as jnp
 
@@ -148,11 +154,10 @@ def _make_momentum_sgd(loss_fn, lr):
             params, new_moms)
         return new_params, new_moms, loss
 
-    single = jax.jit(train_step, donate_argnums=(0, 1))
     if CHAIN > 1:
         from mxnet_tpu.engine import chain_steps
-        return chain_steps(train_step, CHAIN, donate_argnums=(0, 1)), single
-    return single, single
+        return chain_steps(train_step, CHAIN, donate_argnums=(0, 1))
+    return jax.jit(train_step, donate_argnums=(0, 1))
 
 
 def _zeros_moms(params):
@@ -275,7 +280,7 @@ def main():
         logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
         return -jnp.take_along_axis(logp, y[:, None], axis=-1).mean()
 
-    step, single = _make_momentum_sgd(loss_fn, 0.1)
+    step = _make_momentum_sgd(loss_fn, 0.1)
     moms = _zeros_moms(params)
     rng = jax.random.PRNGKey(0)
     x = jnp.asarray(np.random.RandomState(0)
@@ -304,28 +309,51 @@ def main():
                 quantize_net(net, calib_data=calib, ctx=ctx)
                 net(warm)  # re-trace materializes int8 weights
         fn, params = functionalize(net, training=False, ctx=ctx)
-        infer = jax.jit(lambda p, rng, x: fn(p, rng, x))
+        if CHAIN > 1:
+            # chain forward passes like the train path. A bare scan of
+            # identical pure forwards would be DCE/dedup bait — thread
+            # a numerically-exact zero (0 * sum(out)) through the input
+            # so every iteration depends on the previous one and must
+            # execute (the axon tunnel also dedupes identical calls;
+            # see SKILL round-4 notes).
+            def infer_fn(p, rng, x):
+                def body(carry_x, _):
+                    out = fn(p, rng, carry_x)
+                    keep = (jnp.sum(out) * 0).astype(carry_x.dtype)
+                    return carry_x + keep, jnp.sum(out)
+                return jax.lax.scan(body, x, None, length=CHAIN)
+        else:
+            def infer_fn(p, rng, x):
+                out = fn(p, rng, x)
+                keep = (jnp.sum(out) * 0).astype(x.dtype)
+                return x + keep, jnp.sum(out)
+        # x threads through every call as a FRESH (numerically equal)
+        # buffer so no two dispatches have identical input ids — the
+        # tunnel dedupes identical executions (SKILL round-4)
+        infer = jax.jit(infer_fn, donate_argnums=(2,))
         iflops, ibytes = _step_cost(infer, params, rng, x)
         def timed_infer():
+            nonlocal x
             t0 = time.perf_counter()
             for _ in range(STEPS):
-                out = infer(params, rng, x)
+                x, out = infer(params, rng, x)
             jax.block_until_ready(out)
             return time.perf_counter() - t0
 
         for _ in range(WARMUP):
-            out = infer(params, rng, x)
+            x, out = infer(params, rng, x)
         jax.block_until_ready(out)
         dt = _guard_impossible(
             lambda: sorted(timed_infer() for _ in range(3))[1],
-            iflops, ibytes)
-        _report("resnet50_infer_images_per_sec_per_chip", BATCH * STEPS / dt,
+            iflops * CHAIN, ibytes * CHAIN)
+        _report("resnet50_infer_images_per_sec_per_chip",
+                BATCH * STEPS * CHAIN / dt,
                 "images/sec/chip", 0.0, flops_per_step=iflops,
-                sec_per_step=dt / STEPS, bytes_per_step=ibytes,
-                batch=BATCH, dtype="int8" if int8 else DTYPE)
+                sec_per_step=dt / STEPS / CHAIN, bytes_per_step=ibytes,
+                batch=BATCH, dtype="int8" if int8 else DTYPE, chain=CHAIN)
         return
 
-    flops, nbytes = _step_cost(single, params, moms, rng, x, y)
+    flops, nbytes = _step_cost(step, params, moms, rng, x, y)
 
     if os.environ.get("BENCH_DATA") in ("recordio", "pipeline"):
         _resnet_from_recordio(loss_fn, params, moms, rng, flops)
@@ -416,7 +444,7 @@ def _resnet_from_recordio(loss_fn, params, moms, rng, flops):
                                                        np.dtype(DTYPE))
         return loss_fn(p, rng, x, y_f32.astype(jnp.int32))
 
-    step, _ = _make_momentum_sgd(loss_u8, 0.1)
+    step = _make_momentum_sgd(loss_u8, 0.1)
 
     def batches():
         if batcher is not None:
@@ -569,7 +597,7 @@ def main_bert():
             return (loss.astype(jnp.float32) * w).sum() / w.sum()
         return loss.mean()
 
-    step, single = _make_momentum_sgd(loss_fn, 1e-3)
+    step = _make_momentum_sgd(loss_fn, 1e-3)
     ps = (params, hparams)
     moms = _zeros_moms(ps)
     rng = jax.random.PRNGKey(0)
@@ -580,7 +608,7 @@ def main_bert():
                        if padded else np.full(batch, seqlen), jnp.int32)
     labels = jnp.asarray(npr.randint(0, vocab, (batch, seqlen)), jnp.int32)
 
-    flops, nbytes = _step_cost(single, ps, moms, rng, ids, tt, lens, labels)
+    flops, nbytes = _step_cost(step, ps, moms, rng, ids, tt, lens, labels)
     dt = _time_steps(step, ps, moms, rng, ids, tt, lens, labels,
                      flops_per_step=flops * CHAIN,
                      bytes_per_step=nbytes * CHAIN)
@@ -671,14 +699,14 @@ def main_lstm():
                 logp, labels.reshape(-1)[:, None], axis=-1)[:, 0]
         return loss.mean()
 
-    step, single = _make_momentum_sgd(loss_fn, 1.0)
+    step = _make_momentum_sgd(loss_fn, 1.0)
     moms = _zeros_moms(params)
     rng = jax.random.PRNGKey(0)
     npr = np.random.RandomState(0)
     ids = jnp.asarray(npr.randint(0, vocab, (batch, seqlen)), jnp.int32)
     labels = jnp.asarray(npr.randint(0, vocab, (batch, seqlen)), jnp.int32)
 
-    flops, nbytes = _step_cost(single, params, moms, rng, ids, labels)
+    flops, nbytes = _step_cost(step, params, moms, rng, ids, labels)
     dt = _time_steps(step, params, moms, rng, ids, labels,
                      flops_per_step=flops * CHAIN,
                      bytes_per_step=nbytes * CHAIN)
@@ -733,7 +761,7 @@ def main_widedeep():
         logp = jax.nn.log_softmax(logits, axis=-1)
         return -jnp.take_along_axis(logp, y[:, None], axis=-1).mean()
 
-    step, single = _make_momentum_sgd(loss_fn, 0.05)
+    step = _make_momentum_sgd(loss_fn, 0.05)
     moms = _zeros_moms(params)
     rng = jax.random.PRNGKey(0)
     wx = jnp.asarray(npr.randint(0, wide_dim, (batch, n_wide)), jnp.int32)
@@ -741,7 +769,7 @@ def main_widedeep():
     ct = jnp.asarray(npr.rand(batch, n_cont), jnp.float32)
     y = jnp.asarray(npr.randint(0, 2, batch), jnp.int32)
 
-    flops, nbytes = _step_cost(single, params, moms, rng, wx, cx, ct, y)
+    flops, nbytes = _step_cost(step, params, moms, rng, wx, cx, ct, y)
     dt = _time_steps(step, params, moms, rng, wx, cx, ct, y,
                      flops_per_step=flops * CHAIN,
                      bytes_per_step=nbytes * CHAIN)
@@ -788,8 +816,16 @@ def main_suite():
         r = subprocess.call([sys.executable, os.path.abspath(__file__)],
                             env=env)
         if r != 0:
-            print(f"# bench config {model} {extra} failed rc={r}",
-                  file=sys.stderr)
+            # one retry: axon remote-compiles fail transiently
+            # ("response body closed" mid-compile) and the partial
+            # compile IS cached, so the retry is usually warm+quick
+            print(f"# bench config {model} {extra} failed rc={r}; "
+                  "retrying once", file=sys.stderr)
+            r = subprocess.call([sys.executable, os.path.abspath(__file__)],
+                                env=env)
+            if r != 0:
+                print(f"# bench config {model} {extra} failed again rc={r}",
+                      file=sys.stderr)
         rc = r
     raise SystemExit(rc)
 
