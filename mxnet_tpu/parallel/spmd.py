@@ -47,23 +47,68 @@ def shard_params(params: dict, mesh: Mesh, rules=None):
     return out
 
 
+def _single_step(loss_fn, optimizer_update):
+    def step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        new_params, new_opt = optimizer_update(params, grads, opt_state)
+        return new_params, new_opt, loss
+
+    return step
+
+
+def _chained_step(loss_fn, optimizer_update, chain):
+    """One dispatched executable running ``chain`` REAL train steps:
+    the batch carries a leading micro-batch axis of size ``chain`` and
+    lax.scan consumes one slice per step (engine.chain_steps semantics,
+    but with distinct data per sub-step — the host enqueues once per
+    ``chain`` optimizer updates, hiding per-dispatch latency the way
+    the reference's threaded engine pipelines ahead). Returns
+    (params, opt_state, losses (chain,))."""
+
+    def step(params, opt_state, batch):
+        leading = {leaf.shape[0] for leaf in jax.tree_util.tree_leaves(batch)}
+        if leading != {chain}:
+            raise ValueError(
+                f"chain={chain} expects every batch leaf to carry a "
+                f"leading stacked-micro-batch axis of that size; got "
+                f"leading dims {sorted(leading)}")
+
+        def body(carry, b):
+            p, o = carry
+            loss, grads = jax.value_and_grad(loss_fn)(p, b)
+            p, o = optimizer_update(p, grads, o)
+            return (p, o), loss
+
+        (p, o), losses = jax.lax.scan(body, (params, opt_state), batch,
+                                      length=chain)
+        return p, o, losses
+
+    return step
+
+
 def make_data_parallel_step(loss_fn: Callable, optimizer_update: Callable,
                             mesh: Mesh, data_axis: str = "dp",
-                            donate: bool = True):
+                            donate: bool = True, chain: int = 1):
     """Build jit(train_step) where the batch is sharded over `data_axis`
     and parameters are replicated — classic DP, gradients allreduced by
     the partitioner (the KVStore-pushpull analog, compiled away).
 
     loss_fn(params, batch) -> scalar loss
     optimizer_update(params, grads, opt_state) -> (params, opt_state)
-    """
-    repl = NamedSharding(mesh, P())
-    batch_sharding = NamedSharding(mesh, P(data_axis))
 
-    def step(params, opt_state, batch):
-        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
-        new_params, new_opt = optimizer_update(params, grads, opt_state)
-        return new_params, new_opt, loss
+    ``chain > 1``: each call runs that many REAL steps in one dispatch;
+    every batch leaf gains a LEADING axis of size ``chain`` (stacked
+    micro-batches), the returned loss becomes a (chain,) vector, and
+    per-dispatch host latency amortizes across the whole chain.
+    """
+    if chain < 1:
+        raise ValueError(f"chain must be >= 1, got {chain}")
+    repl = NamedSharding(mesh, P())
+    bspec = P(None, data_axis) if chain > 1 else P(data_axis)
+    batch_sharding = NamedSharding(mesh, bspec)
+
+    step = (_chained_step(loss_fn, optimizer_update, chain) if chain > 1
+            else _single_step(loss_fn, optimizer_update))
 
     jitted = jax.jit(
         step,
@@ -78,20 +123,22 @@ def make_sharded_train_step(loss_fn: Callable, optimizer_update: Callable,
                             mesh: Mesh,
                             param_spec_fn: Optional[Callable] = None,
                             batch_spec=None,
-                            donate: bool = True):
+                            donate: bool = True, chain: int = 1):
     """Fully general SPMD train step: parameters sharded per
     `param_spec_fn(path, aval) -> PartitionSpec` (tp/ep/zero-style),
     batch sharded per `batch_spec` (dp/sp). XLA inserts all collectives.
+    ``chain > 1`` runs that many real steps per dispatch over a leading
+    stacked-micro-batch axis (see make_data_parallel_step).
     """
     def spec_of(tree, fn):
         flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
         specs = [fn(jax.tree_util.keystr(path), leaf) for path, leaf in flat]
         return jax.tree_util.tree_unflatten(treedef, specs)
 
-    def step(params, opt_state, batch):
-        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
-        new_params, new_opt = optimizer_update(params, grads, opt_state)
-        return new_params, new_opt, loss
+    if chain < 1:
+        raise ValueError(f"chain must be >= 1, got {chain}")
+    step = (_chained_step(loss_fn, optimizer_update, chain) if chain > 1
+            else _single_step(loss_fn, optimizer_update))
 
     def compile_for(params, opt_state, batch):
         pfn = param_spec_fn or (lambda path, aval: P())
@@ -101,9 +148,13 @@ def make_sharded_train_step(loss_fn: Callable, optimizer_update: Callable,
             is_leaf=lambda s: isinstance(s, P))
         p_sh = to_sharding(pspec)
         o_sh = jax.tree_util.tree_map(lambda _: NamedSharding(mesh, P()), opt_state)
+        bs = batch_spec if batch_spec is not None else P()
+        if chain > 1:
+            # leading axis is the chain (scan) axis — never sharded;
+            # shift the caller's per-micro-batch spec right by one
+            bs = P(None, *bs)
         b_sh = jax.tree_util.tree_map(
-            lambda _: NamedSharding(mesh, batch_spec if batch_spec is not None else P()),
-            batch)
+            lambda _: NamedSharding(mesh, bs), batch)
         return jax.jit(step, in_shardings=(p_sh, o_sh, b_sh),
                        out_shardings=(p_sh, o_sh, NamedSharding(mesh, P())),
                        donate_argnums=(0, 1) if donate else ())

@@ -1,0 +1,98 @@
+"""SPMD train-step builders (parallel/spmd.py — the compiled
+Trainer→KVStore→NCCL replacement, SURVEY §3.2) on the virtual 8-device
+mesh, including the chained micro-batch mode."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from mxnet_tpu.parallel import (build_mesh, make_data_parallel_step,
+                                make_sharded_train_step)
+
+N = 8
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    if len(jax.devices()) < N:
+        pytest.skip(f"needs {N} devices")
+    return build_mesh({"dp": N})
+
+
+def _problem():
+    rs = np.random.RandomState(0)
+    params = {"w": jnp.asarray(rs.randn(6, 4), jnp.float32),
+              "b": jnp.zeros((4,), jnp.float32)}
+    opt = jax.tree_util.tree_map(jnp.zeros_like, params)
+
+    def loss_fn(p, batch):
+        x, y = batch
+        pred = x @ p["w"] + p["b"]
+        return ((pred - y) ** 2).mean()
+
+    def sgd(p, g, o):
+        o = jax.tree_util.tree_map(lambda m, gg: 0.9 * m + gg, o, g)
+        p = jax.tree_util.tree_map(lambda pp, m: pp - 0.05 * m, p, o)
+        return p, o
+
+    return params, opt, loss_fn, sgd, rs
+
+
+def test_data_parallel_step_runs(mesh):
+    params, opt, loss_fn, sgd, rs = _problem()
+    step = make_data_parallel_step(loss_fn, sgd, mesh, donate=False)
+    x = jnp.asarray(rs.rand(16, 6), jnp.float32)
+    y = jnp.asarray(rs.rand(16, 4), jnp.float32)
+    p, o, loss = step(params, opt, (x, y))
+    assert np.isfinite(float(loss))
+    assert p["w"].shape == (6, 4)
+
+
+def test_chained_step_matches_sequential(mesh):
+    """chain=k over stacked micro-batches == k sequential dispatches on
+    the same micro-batches (REAL steps, distinct data per sub-step)."""
+    params, opt, loss_fn, sgd, rs = _problem()
+    k = 5
+    xs = jnp.asarray(rs.rand(k, 16, 6), jnp.float32)
+    ys = jnp.asarray(rs.rand(k, 16, 4), jnp.float32)
+
+    seq = make_data_parallel_step(loss_fn, sgd, mesh, donate=False)
+    p1, o1 = params, opt
+    seq_losses = []
+    for i in range(k):
+        p1, o1, l = seq(p1, o1, (xs[i], ys[i]))
+        seq_losses.append(float(l))
+
+    chained = make_data_parallel_step(loss_fn, sgd, mesh, donate=False,
+                                      chain=k)
+    p2, o2, losses = chained(params, opt, (xs, ys))
+    np.testing.assert_allclose(np.asarray(losses), seq_losses,
+                               rtol=1e-5, atol=1e-6)
+    for key in params:
+        np.testing.assert_allclose(np.asarray(p1[key]), np.asarray(p2[key]),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(o1[key]), np.asarray(o2[key]),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_sharded_train_step_chain_and_tp(mesh):
+    """make_sharded_train_step with a tp-style param rule AND chain>1:
+    compiles, runs, and the batch spec shifts past the scan axis."""
+    mesh2 = build_mesh({"dp": N // 2, "tp": 2})
+    params, opt, loss_fn, sgd, rs = _problem()
+    k = 3
+
+    def pspec(path, aval):
+        return P(None, "tp") if "w" in path and aval.ndim == 2 else P()
+
+    builder = make_sharded_train_step(loss_fn, sgd, mesh2,
+                                      param_spec_fn=pspec,
+                                      batch_spec=P("dp"), donate=False,
+                                      chain=k)
+    xs = jnp.asarray(rs.rand(k, 8, 6), jnp.float32)
+    ys = jnp.asarray(rs.rand(k, 8, 4), jnp.float32)
+    step = builder(params, opt, (xs, ys))
+    p, o, losses = step(params, opt, (xs, ys))
+    assert losses.shape == (k,)
+    assert np.isfinite(np.asarray(losses)).all()
