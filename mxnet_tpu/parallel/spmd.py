@@ -47,6 +47,16 @@ def shard_params(params: dict, mesh: Mesh, rules=None):
     return out
 
 
+def _make_step(loss_fn, optimizer_update, chain):
+    """Validate ``chain`` and pick the single or chained step body (the
+    one place chain policy lives)."""
+    if chain < 1:
+        raise ValueError(f"chain must be >= 1, got {chain}")
+    if chain > 1:
+        return _chained_step(loss_fn, optimizer_update, chain)
+    return _single_step(loss_fn, optimizer_update)
+
+
 def _single_step(loss_fn, optimizer_update):
     def step(params, opt_state, batch):
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)
@@ -101,14 +111,10 @@ def make_data_parallel_step(loss_fn: Callable, optimizer_update: Callable,
     micro-batches), the returned loss becomes a (chain,) vector, and
     per-dispatch host latency amortizes across the whole chain.
     """
-    if chain < 1:
-        raise ValueError(f"chain must be >= 1, got {chain}")
+    step = _make_step(loss_fn, optimizer_update, chain)
     repl = NamedSharding(mesh, P())
     bspec = P(None, data_axis) if chain > 1 else P(data_axis)
     batch_sharding = NamedSharding(mesh, bspec)
-
-    step = (_chained_step(loss_fn, optimizer_update, chain) if chain > 1
-            else _single_step(loss_fn, optimizer_update))
 
     jitted = jax.jit(
         step,
@@ -135,10 +141,7 @@ def make_sharded_train_step(loss_fn: Callable, optimizer_update: Callable,
         specs = [fn(jax.tree_util.keystr(path), leaf) for path, leaf in flat]
         return jax.tree_util.tree_unflatten(treedef, specs)
 
-    if chain < 1:
-        raise ValueError(f"chain must be >= 1, got {chain}")
-    step = (_chained_step(loss_fn, optimizer_update, chain) if chain > 1
-            else _single_step(loss_fn, optimizer_update))
+    step = _make_step(loss_fn, optimizer_update, chain)
 
     def compile_for(params, opt_state, batch):
         pfn = param_spec_fn or (lambda path, aval: P())

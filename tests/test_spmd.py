@@ -9,7 +9,9 @@ from jax.sharding import PartitionSpec as P
 
 from mxnet_tpu.parallel import (build_mesh, make_data_parallel_step,
                                 make_sharded_train_step)
+from mxnet_tpu.test_utils import device_tols
 
+RTOL, ATOL = device_tols("float32")
 N = 8
 
 
@@ -68,12 +70,12 @@ def test_chained_step_matches_sequential(mesh):
                                       chain=k)
     p2, o2, losses = chained(params, opt, (xs, ys))
     np.testing.assert_allclose(np.asarray(losses), seq_losses,
-                               rtol=1e-5, atol=1e-6)
+                               rtol=RTOL, atol=ATOL)
     for key in params:
         np.testing.assert_allclose(np.asarray(p1[key]), np.asarray(p2[key]),
-                                   rtol=1e-5, atol=1e-6)
+                                   rtol=RTOL, atol=ATOL)
         np.testing.assert_allclose(np.asarray(o1[key]), np.asarray(o2[key]),
-                                   rtol=1e-5, atol=1e-6)
+                                   rtol=RTOL, atol=ATOL)
 
 
 def test_sharded_train_step_chain_and_tp(mesh):
