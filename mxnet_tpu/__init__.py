@@ -86,6 +86,13 @@ from . import module
 from . import module as mod
 from . import rnn
 from . import contrib
+
+# mx.np / mx.npx — the deep-NumPy frontend (reference python/mxnet/numpy/,
+# numpy_extension/, v>=1.6); imported after nd so registry + autograd exist
+from . import numpy as np  # noqa: A001
+from . import numpy  # noqa: F401  (mx.numpy, as upstream also exposes)
+from . import numpy_extension as npx
+from . import numpy_extension  # noqa: F401
 from . import visualization
 from . import visualization as viz
 

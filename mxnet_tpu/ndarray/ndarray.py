@@ -25,6 +25,7 @@ import numpy as np
 from ..base import MXNetError, dtype_np, dtype_name
 from ..context import Context, current_context
 from ..engine import engine
+from ..util import is_np_array as _is_np_array
 
 __all__ = ["NDArray", "_wrap", "array", "empty", "zeros", "ones", "full", "arange"]
 
@@ -166,14 +167,14 @@ class NDArray:
     # copies / context movement
     # ------------------------------------------------------------------
     def copy(self) -> "NDArray":
-        return _wrap(self._data + 0, self._ctx)
+        return _wrap(self._data + 0, self._ctx, cls=_wrap_cls_of(self))
 
     def copyto(self, other):
         """Copy to a Context or into another NDArray (CopyFromTo analog,
         src/ndarray/ndarray.cc)."""
         if isinstance(other, Context):
             arr = jax.device_put(self._data, other.jax_device)
-            return _wrap(arr, other)
+            return _wrap(arr, other, cls=_wrap_cls_of(self))
         if isinstance(other, NDArray):
             if other is self:
                 return other
@@ -192,13 +193,26 @@ class NDArray:
     as_in_ctx = as_in_context
 
     def as_nd_ndarray(self):
-        return self
+        """Classic-NDArray view of this array (zero-copy; the np
+        subclass overrides the np side — python/mxnet/ndarray/ndarray.py
+        as_np_ndarray/as_nd_ndarray interop contract)."""
+        if type(self) is NDArray:
+            return self
+        return _convert_cls(self, NDArray)
+
+    def as_np_ndarray(self):
+        """mx.np.ndarray view of this array (zero-copy when not
+        recording; routes through an identity op on the tape when
+        recording so gradients flow across the conversion)."""
+        if _NP_CLS is None or isinstance(self, _NP_CLS):
+            return self
+        return _convert_cls(self, _NP_CLS)
 
     def astype(self, dtype, copy=True) -> "NDArray":
         dt = dtype_np(dtype)
         if not copy and self.dtype == dt:
             return self
-        return _wrap(self._data.astype(dt), self._ctx)
+        return _wrap(self._data.astype(dt), self._ctx, cls=_wrap_cls_of(self))
 
     def cast(self, dtype):
         return self.astype(dtype)
@@ -209,7 +223,11 @@ class NDArray:
     def attach_grad(self, grad_req="write", stype=None):
         """Allocate a gradient buffer; this array becomes a leaf."""
         from . import zeros
-        self._grad = zeros(self.shape, ctx=self._ctx, dtype=self.dtype)
+        g = zeros(self.shape, ctx=self._ctx, dtype=self.dtype)
+        cls = _wrap_cls_of(self)
+        if cls is not None:  # np arrays carry np gradients
+            g = cls(g._data, g._ctx)
+        self._grad = g
         self._grad_req = grad_req
         self._is_leaf = True
 
@@ -218,7 +236,7 @@ class NDArray:
         return self._grad
 
     def detach(self) -> "NDArray":
-        out = _wrap(self._data, self._ctx)
+        out = _wrap(self._data, self._ctx, cls=_wrap_cls_of(self))
         return out
 
     def backward(self, out_grad=None, retain_graph=False, train_mode=True):
@@ -503,13 +521,49 @@ def _has(name):
     return name in _OPS
 
 
-def _wrap(arr, ctx: Context | None = None) -> NDArray:
-    """Wrap a jax array (no copy) into an NDArray."""
+# installed by mxnet_tpu.numpy at import: the mx.np.ndarray subclass.
+# invoke() wraps op outputs in this class when numpy semantics are
+# active (mx.npx.set_np) or any input already is one — the analog of the
+# reference routing np-mode handles to mxnet.numpy.ndarray
+# (python/mxnet/numpy/multiarray.py).
+_NP_CLS = None
+
+
+def _wrap_cls_of(x):
+    """Preserve the np-ndarray-ness of ``x`` across methods that wrap
+    raw buffers directly (copy/astype/detach/...). Sparse subclasses
+    keep their own overrides; everything non-np wraps as base NDArray."""
+    if _NP_CLS is not None and isinstance(x, _NP_CLS):
+        return _NP_CLS
+    return None
+
+
+def _convert_cls(x, cls):
+    """Rewrap ``x`` as ``cls`` sharing the buffer; when autograd is
+    recording, route through the identity op so the tape links the two
+    objects (conversion must not silently detach the graph)."""
+    from .. import autograd
+    if autograd.is_recording() and x._requires_grad_somewhere():
+        return _invoke_cls("_copy", [x], cls)
+    return cls(x._data, x._ctx)
+
+
+def _invoke_cls(name, inputs, cls):
+    from .register import invoke
+    return invoke(_op(name), inputs, wrap_cls=cls)
+
+
+def _wrap(arr, ctx: Context | None = None, cls=None) -> NDArray:
+    """Wrap a jax array (no copy) into an NDArray (or subclass). Under
+    mx.npx.set_np the whole world is np-mode, so unclassed wraps
+    (creation fns, loaders) come back as mx.np.ndarray too."""
     if ctx is None:
         ctx = current_context()
     if not isinstance(arr, (jnp.ndarray, jax.Array)):
         arr = jnp.asarray(arr)
-    return NDArray(arr, ctx)
+    if cls is None and _NP_CLS is not None and _is_np_array():
+        cls = _NP_CLS
+    return (cls or NDArray)(arr, ctx)
 
 
 # ----------------------------------------------------------------------

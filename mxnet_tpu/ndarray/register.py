@@ -191,6 +191,19 @@ def dispatch_cast_generation():
     return _DISPATCH_CAST_GENERATION
 
 
+# lazy one-time bind of the np ndarray class holder (the ndarray
+# PACKAGE self-aliases its `ndarray` attr, so the defining module is
+# fetched through sys.modules once, not per dispatch)
+_ND_NDARRAY_MOD = None
+
+
+def _np_cls():
+    global _ND_NDARRAY_MOD
+    if _ND_NDARRAY_MOD is None:
+        _ND_NDARRAY_MOD = sys.modules["mxnet_tpu.ndarray.ndarray"]
+    return _ND_NDARRAY_MOD._NP_CLS
+
+
 # -- op-invocation recording ------------------------------------------
 # The test suite's coverage gate used to trust a hand-maintained list;
 # now conftest.py turns recording on and gates on the ops ACTUALLY
@@ -210,7 +223,8 @@ def _note_invocation(op):
         _INVOCATION_RECORD.add(op.name)
 
 
-def invoke(op: Op, inputs, params=None, out=None, ctx: Context | None = None, name=None):
+def invoke(op: Op, inputs, params=None, out=None, ctx: Context | None = None,
+           name=None, wrap_cls=None):
     """Eager dispatch of one op — `Imperative::Invoke` analog.
 
     Parameters
@@ -297,7 +311,16 @@ def invoke(op: Op, inputs, params=None, out=None, ctx: Context | None = None, na
         results = list(outs)
     else:
         n = len(out_arrays) if visible is None else visible
-        results = [_wrap(a, ctx) for a in out_arrays[:n]]
+        if wrap_cls is None:
+            # np-mode class preservation: outputs are mx.np.ndarray when
+            # any input already is one (mixing np activations with
+            # classic params inside Gluon blocks keeps the np-ness of
+            # the dataflow). The set_np global mode is handled inside
+            # _wrap itself, so only the input rule lives here.
+            np_cls = _np_cls()
+            if np_cls is not None and any(isinstance(x, np_cls) for x in inputs):
+                wrap_cls = np_cls
+        results = [_wrap(a, ctx, cls=wrap_cls) for a in out_arrays[:n]]
 
     if record:
         raw_avals = [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in out_arrays]

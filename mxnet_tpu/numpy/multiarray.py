@@ -1,0 +1,1031 @@
+"""``mx.np`` — the NumPy-compatible array frontend (deep NumPy).
+
+Analog of the reference's ``python/mxnet/numpy/multiarray.py`` (v>=1.6):
+an :class:`ndarray` with true NumPy semantics — zero-dim arrays, boolean
+masking, NumPy operator/broadcasting rules, NumPy function signatures —
+living on the same imperative runtime as the classic ``mx.nd`` frontend.
+
+Every function here dispatches a registered operator (classic ops where
+the kernel already exists, ``_npi_*`` ops from .ops otherwise), so
+autograd recording, AMP casts, the profiler, hybridization traces and
+the op-coverage gate treat np-mode exactly like classic mode. Arrays
+convert losslessly both ways via ``as_np_ndarray``/``as_nd_ndarray``
+(zero-copy; tape-linked under autograd.record).
+"""
+from __future__ import annotations
+
+import numpy as onp
+import jax.numpy as jnp
+
+from ..base import dtype_np
+from ..context import Context, current_context
+from ..ndarray.ndarray import NDArray, _wrap
+from ..ndarray.register import get_op, invoke
+from . import ops as _ops  # registers the _npi_* family  # noqa: F401
+
+__all__ = [
+    "ndarray", "array", "asarray", "zeros", "ones", "empty", "full",
+    "zeros_like", "ones_like", "full_like", "empty_like", "arange",
+    "linspace", "logspace", "eye", "identity", "meshgrid", "tril", "triu",
+    "diag", "diagflat", "diagonal", "trace", "copy",
+    # manipulation
+    "reshape", "ravel", "transpose", "moveaxis", "swapaxes", "concatenate",
+    "stack", "vstack", "hstack", "dstack", "column_stack", "split",
+    "array_split", "hsplit", "vsplit", "expand_dims", "squeeze",
+    "broadcast_to", "broadcast_arrays", "tile", "repeat", "flip", "fliplr",
+    "flipud", "roll", "rot90", "pad", "append", "where", "take",
+    "take_along_axis", "clip", "nonzero", "flatnonzero", "unique", "sort",
+    "argsort", "argmax", "argmin", "searchsorted", "atleast_1d",
+    "atleast_2d", "atleast_3d", "insert_dims_like",
+    # math
+    "add", "subtract", "multiply", "divide", "true_divide", "floor_divide",
+    "mod", "remainder", "fmod", "power", "maximum", "minimum", "fmax",
+    "fmin", "hypot", "arctan2", "logaddexp", "logaddexp2", "copysign",
+    "ldexp", "heaviside", "gcd", "lcm", "bitwise_and", "bitwise_or",
+    "bitwise_xor", "invert", "bitwise_not", "left_shift", "right_shift",
+    "logical_and", "logical_or", "logical_xor", "logical_not", "equal",
+    "not_equal", "greater", "greater_equal", "less", "less_equal",
+    # reductions
+    "sum", "prod", "mean", "std", "var", "median", "quantile", "percentile",
+    "average", "min", "max", "amin", "amax", "nanmin", "nanmax", "nanmean",
+    "nansum", "nanprod", "cumsum", "cumprod", "all", "any", "count_nonzero",
+    "ptp", "diff", "bincount", "histogram", "around", "round", "round_",
+    # contractions
+    "dot", "vdot", "inner", "outer", "matmul", "tensordot", "einsum",
+    "kron", "cross",
+    # logic / misc
+    "isclose", "allclose", "array_equal", "interp", "ediff1d",
+    "nan_to_num", "shape", "size", "ndim", "may_share_memory",
+    "result_type", "promote_types", "finfo", "iinfo", "isnan", "isinf",
+    "isfinite", "signbit",
+]
+
+
+def _np_invoke(name, inputs, params=None, out=None):
+    """Dispatch a registry op, always wrapping outputs as mx.np.ndarray
+    (mx.np functions return np arrays regardless of input flavor)."""
+    return invoke(get_op(name), inputs, params, out=out, wrap_cls=ndarray)
+
+
+def _proc(x, ctx=None):
+    """Coerce a function argument to something invoke accepts, turning
+    lists/numpy into arrays while leaving NDArray/scalars alone."""
+    if isinstance(x, NDArray) or isinstance(x, (int, float, bool)):
+        return x
+    if x is None:
+        return None
+    return array(x, ctx=ctx)
+
+
+# ---------------------------------------------------------------------------
+# the ndarray type
+# ---------------------------------------------------------------------------
+class ndarray(NDArray):
+    """NumPy-semantics array (mx.np.ndarray).
+
+    Shares the NDArray runtime — engine vars, autograd tape, context
+    placement — and differs only in API semantics (reference
+    python/mxnet/numpy/multiarray.py: same handle type under a NumPy
+    calling convention)."""
+
+    __slots__ = ()
+
+    def __repr__(self):
+        a = self.asnumpy()
+        body = onp.array2string(a, separator=", ")
+        dt = f", dtype={self.dtype}" if self.dtype not in (onp.float32,) else ""
+        ctx = "" if self._ctx.device_type == "cpu" else f", ctx={self._ctx}"
+        return f"array({body}{dt}{ctx})"
+
+    # -- conversion ----------------------------------------------------
+    def as_np_ndarray(self):
+        return self
+
+    def tolist(self):
+        return self.asnumpy().tolist()
+
+    # -- numpy-signature overrides ------------------------------------
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        shape = tuple(kwargs.get("shape", shape))
+        return _np_invoke("reshape", [self], {"shape": shape})
+
+    def flatten(self, order="C"):
+        # numpy flatten = raveled copy (NOT the classic (N, -1) Flatten)
+        return self.reshape(-1)
+
+    def ravel(self, order="C"):
+        return self.reshape(-1)
+
+    def mean(self, axis=None, dtype=None, keepdims=False, **kw):
+        r = _np_invoke("mean", [self], {"axis": axis, "keepdims": keepdims})
+        return r.astype(dtype) if dtype is not None else r
+
+    def std(self, axis=None, ddof=0, keepdims=False):
+        return _np_invoke("_npi_std", [self],
+                          {"axis": axis, "ddof": ddof, "keepdims": keepdims})
+
+    def var(self, axis=None, ddof=0, keepdims=False):
+        return _np_invoke("_npi_var", [self],
+                          {"axis": axis, "ddof": ddof, "keepdims": keepdims})
+
+    def all(self, axis=None, keepdims=False):
+        return _np_invoke("_npi_all", [self],
+                          {"axis": axis, "keepdims": keepdims})
+
+    def any(self, axis=None, keepdims=False):
+        return _np_invoke("_npi_any", [self],
+                          {"axis": axis, "keepdims": keepdims})
+
+    def cumsum(self, axis=None, dtype=None):
+        r = _np_invoke("cumsum", [self], {"axis": axis})
+        return r.astype(dtype) if dtype is not None else r
+
+    def round(self, decimals=0):
+        return around(self, decimals)
+
+    def clip(self, min=None, max=None):  # noqa: A002
+        return clip(self, min, max)
+
+    def take(self, indices, axis=None, mode="clip"):
+        return take(self, indices, axis=axis, mode=mode)
+
+    def nonzero(self):
+        return nonzero(self)
+
+    def dot(self, b):
+        return dot(self, b)
+
+    def item(self, *args):
+        a = self.asnumpy()
+        return a.item(*args) if args else a.item()
+
+    def argmax(self, axis=None):
+        return _np_invoke("argmax", [self], {"axis": axis})
+
+    def argmin(self, axis=None):
+        return _np_invoke("argmin", [self], {"axis": axis})
+
+    def sort(self, axis=-1):
+        # in-place by numpy convention; routed through the registered
+        # op so the engine/profiler/AMP see it like any other dispatch
+        r = _np_invoke("sort", [self], {"axis": axis, "is_ascend": True})
+        self._set_data(r._data)
+
+    def argsort(self, axis=-1):
+        return _np_invoke("argsort", [self], {"axis": axis, "is_ascend": True})
+
+    def squeeze(self, axis=None):
+        return _np_invoke("squeeze", [self], {"axis": axis})
+
+    def transpose(self, *axes):
+        if len(axes) == 1 and isinstance(axes[0], (tuple, list)):
+            axes = tuple(axes[0])
+        return _np_invoke("transpose", [self], {"axes": axes or None})
+
+    def sum(self, axis=None, dtype=None, keepdims=False, **kw):
+        r = _np_invoke("sum", [self], {"axis": axis, "keepdims": keepdims})
+        return r.astype(dtype) if dtype is not None else r
+
+    def prod(self, axis=None, keepdims=False):
+        return _np_invoke("prod", [self], {"axis": axis, "keepdims": keepdims})
+
+    def max(self, axis=None, keepdims=False):
+        return _np_invoke("max", [self], {"axis": axis, "keepdims": keepdims})
+
+    def min(self, axis=None, keepdims=False):
+        return _np_invoke("min", [self], {"axis": axis, "keepdims": keepdims})
+
+
+# numpy comparison dunders: bool results (override the classic
+# input-dtype-returning broadcast comparisons)
+def _np_cmp_dunder(opname):
+    def f(self, other):
+        if other is None:
+            return NotImplemented
+        return _np_invoke(opname, [self, _proc(other)])
+    return f
+
+
+ndarray.__eq__ = _np_cmp_dunder("_npi_equal")
+ndarray.__ne__ = _np_cmp_dunder("_npi_not_equal")
+ndarray.__lt__ = _np_cmp_dunder("_npi_less")
+ndarray.__le__ = _np_cmp_dunder("_npi_less_equal")
+ndarray.__gt__ = _np_cmp_dunder("_npi_greater")
+ndarray.__ge__ = _np_cmp_dunder("_npi_greater_equal")
+ndarray.__and__ = _np_cmp_dunder("_npi_bitwise_and")
+ndarray.__or__ = _np_cmp_dunder("_npi_bitwise_or")
+ndarray.__xor__ = _np_cmp_dunder("_npi_bitwise_xor")
+ndarray.__invert__ = lambda self: _np_invoke("_npi_invert", [self])
+ndarray.__hash__ = lambda self: id(self)
+
+
+# install as the np-mode wrap class for the whole runtime. NOTE: the
+# ndarray PACKAGE self-aliases its `ndarray` attribute (mx.nd.ndarray
+# is mx.nd), so target the defining module through sys.modules.
+import sys as _sys  # noqa: E402
+
+_sys.modules["mxnet_tpu.ndarray.ndarray"]._NP_CLS = ndarray
+
+
+# ---------------------------------------------------------------------------
+# creation
+# ---------------------------------------------------------------------------
+def array(object, dtype=None, ctx=None):  # noqa: A002
+    if isinstance(object, NDArray):
+        data = object._data
+        if dtype is not None:
+            data = data.astype(dtype_np(dtype))
+        if ctx is None:
+            ctx = object._ctx  # inherit the source's placement
+        elif ctx != object._ctx:
+            import jax
+            data = jax.device_put(data, ctx.jax_device)
+        return ndarray(data, ctx)
+    ctx = ctx or current_context()
+    a = onp.asarray(object)
+    if dtype is None:
+        dtype = onp.float32 if a.dtype == onp.float64 else a.dtype
+    import jax
+    return ndarray(jax.device_put(jnp.asarray(a, dtype=dtype_np(dtype)),
+                                  ctx.jax_device), ctx)
+
+
+def asarray(a, dtype=None, ctx=None):
+    if isinstance(a, ndarray) and dtype is None and ctx is None:
+        return a
+    return array(a, dtype=dtype, ctx=ctx)
+
+
+def _creation(fill):
+    def f(shape, dtype=None, ctx=None, fill_value=None):
+        ctx = ctx or current_context()
+        dt = dtype_np(dtype or "float32")
+        if isinstance(shape, int):
+            shape = (shape,)
+        val = fill if fill_value is None else fill_value
+        return ndarray(jnp.full(tuple(shape), val, dtype=dt), ctx)
+    return f
+
+
+def zeros(shape, dtype=None, ctx=None):
+    return _creation(0.0)(shape, dtype, ctx)
+
+
+def ones(shape, dtype=None, ctx=None):
+    return _creation(1.0)(shape, dtype, ctx)
+
+
+def empty(shape, dtype=None, ctx=None):
+    return _creation(0.0)(shape, dtype, ctx)
+
+
+def full(shape, fill_value, dtype=None, ctx=None):
+    if dtype is None and isinstance(fill_value, (int, bool)) \
+            and not isinstance(fill_value, float):
+        dtype = onp.asarray(fill_value).dtype
+    return _creation(None)(shape, dtype, ctx, fill_value=fill_value)
+
+
+def zeros_like(a, dtype=None):
+    r = _np_invoke("zeros_like", [_proc(a)])
+    return r.astype(dtype) if dtype is not None else r
+
+
+def ones_like(a, dtype=None):
+    r = _np_invoke("ones_like", [_proc(a)])
+    return r.astype(dtype) if dtype is not None else r
+
+
+def full_like(a, fill_value, dtype=None):
+    r = _np_invoke("_full_like", [_proc(a)], {"value": fill_value})
+    return r.astype(dtype) if dtype is not None else r
+
+
+def empty_like(a, dtype=None):
+    return zeros_like(a, dtype)
+
+
+def arange(start, stop=None, step=1, dtype=None, ctx=None):
+    ctx = ctx or current_context()
+    out = jnp.arange(start, stop, step, dtype and dtype_np(dtype))
+    if out.dtype == jnp.float64:
+        out = out.astype(jnp.float32)
+    return ndarray(out, ctx)
+
+
+def _f32_default(arr):
+    # x64 is enabled package-wide (int64 NDArray parity), so jnp float
+    # defaults land on f64 — the frontend's default float is f32
+    return arr.astype(jnp.float32) if arr.dtype == jnp.float64 else arr
+
+
+def linspace(start, stop, num=50, endpoint=True, retstep=False, dtype=None,
+             axis=0, ctx=None):
+    ctx = ctx or current_context()
+    r = jnp.linspace(start, stop, num, endpoint=endpoint, retstep=retstep,
+                     dtype=dtype and dtype_np(dtype), axis=axis)
+    if retstep:
+        return ndarray(_f32_default(r[0]), ctx), float(r[1])
+    return ndarray(_f32_default(r), ctx)
+
+
+def logspace(start, stop, num=50, endpoint=True, base=10.0, dtype=None,
+             ctx=None):
+    ctx = ctx or current_context()
+    return ndarray(_f32_default(
+        jnp.logspace(start, stop, num, endpoint=endpoint, base=base,
+                     dtype=dtype and dtype_np(dtype))), ctx)
+
+
+def eye(N, M=None, k=0, dtype="float32", ctx=None):
+    ctx = ctx or current_context()
+    return ndarray(jnp.eye(N, M, k=k, dtype=dtype_np(dtype)), ctx)
+
+
+def identity(n, dtype="float32", ctx=None):
+    return eye(n, dtype=dtype, ctx=ctx)
+
+
+def meshgrid(*xi, indexing="xy"):
+    """Composed from registry ops (reshape + broadcast_to) so autograd
+    flows — no dedicated kernel needed."""
+    xs = [asarray(x) for x in xi]
+    n = len(xs)
+    if n == 1:
+        return [xs[0].reshape(-1)]
+    lens = [int(x.size) for x in xs]
+    # axis each input varies along in the output grid
+    pos = list(range(n))
+    if indexing == "xy":
+        pos[0], pos[1] = 1, 0
+    dims = [0] * n
+    for i, p in enumerate(pos):
+        dims[p] = lens[i]
+    outs = []
+    for i, x in enumerate(xs):
+        shp = [1] * n
+        shp[pos[i]] = -1
+        g = x.reshape(-1).reshape(tuple(shp))
+        outs.append(broadcast_to(g, tuple(dims)))
+    return outs
+
+
+def tril(a, k=0):
+    return _np_invoke("_npi_tril", [_proc(a)], {"k": k})
+
+
+def triu(a, k=0):
+    return _np_invoke("_npi_triu", [_proc(a)], {"k": k})
+
+
+def diag(v, k=0):
+    return _np_invoke("diag", [_proc(v)], {"k": k})
+
+
+def diagflat(v, k=0):
+    return _np_invoke("_npi_diagflat", [_proc(v)], {"k": k})
+
+
+def diagonal(a, offset=0, axis1=0, axis2=1):
+    return _np_invoke("_npi_diagonal", [_proc(a)],
+                      {"offset": offset, "axis1": axis1, "axis2": axis2})
+
+
+def trace(a, offset=0, axis1=0, axis2=1):
+    return _np_invoke("_npi_trace", [_proc(a)],
+                      {"offset": offset, "axis1": axis1, "axis2": axis2})
+
+
+def copy(a):
+    return _np_arg(a).copy()
+
+
+# ---------------------------------------------------------------------------
+# manipulation
+# ---------------------------------------------------------------------------
+def _np_arg(x):
+    """Coerce to mx.np.ndarray so method-delegating functions keep the
+    always-np output contract even for classic-NDArray inputs."""
+    if isinstance(x, ndarray):
+        return x
+    if isinstance(x, NDArray):
+        return x.as_np_ndarray()
+    return array(x)
+
+
+def reshape(a, newshape, order="C"):
+    return _np_arg(a).reshape(newshape)
+
+
+def ravel(a, order="C"):
+    return _np_arg(a).reshape(-1)
+
+
+def transpose(a, axes=None):
+    return _np_arg(a).transpose(*(axes or ()))
+
+
+def moveaxis(a, source, destination):
+    return _np_invoke("_npi_moveaxis", [_proc(a)],
+                      {"source": source, "destination": destination})
+
+
+def swapaxes(a, axis1, axis2):
+    return _np_invoke("swapaxes", [_proc(a)], {"dim1": axis1, "dim2": axis2})
+
+
+def concatenate(seq, axis=0, out=None):
+    arrs = [_proc(a) for a in seq]
+    if axis is None:
+        arrs = [a.reshape(-1) for a in arrs]
+        axis = 0
+    return _np_invoke("concat", arrs, {"dim": axis}, out=out)
+
+
+def stack(arrays, axis=0, out=None):
+    return _np_invoke("stack", [_proc(a) for a in arrays], {"axis": axis},
+                      out=out)
+
+
+def vstack(tup):
+    arrs = [atleast_2d(a) for a in tup]
+    return concatenate(arrs, axis=0)
+
+
+def hstack(tup):
+    arrs = [_proc(a) for a in tup]
+    if arrs and arrs[0].ndim == 1:
+        return concatenate(arrs, axis=0)
+    return concatenate(arrs, axis=1)
+
+
+def dstack(tup):
+    arrs = [atleast_3d(a) for a in tup]
+    return concatenate(arrs, axis=2)
+
+
+def column_stack(tup):
+    arrs = []
+    for a in tup:
+        a = _proc(a)
+        if a.ndim < 2:
+            a = a.reshape(-1, 1)
+        arrs.append(a)
+    return concatenate(arrs, axis=1)
+
+
+def _split_points(n, indices_or_sections, even_required):
+    if isinstance(indices_or_sections, int):
+        k = indices_or_sections
+        if even_required and n % k != 0:
+            raise ValueError("array split does not result in an equal division")
+        base, extra = divmod(n, k)
+        pts, acc = [], 0
+        for i in range(k - 1):
+            acc += base + (1 if i < extra else 0)
+            pts.append(acc)
+        return pts
+    return list(indices_or_sections)
+
+
+def _split_impl(a, indices_or_sections, axis, even_required):
+    a = _proc(a)
+    n = a.shape[axis]
+    pts = [0] + _split_points(n, indices_or_sections, even_required) + [n]
+    outs = []
+    for b, e in zip(pts[:-1], pts[1:]):
+        outs.append(_np_invoke("slice_axis", [a],
+                               {"axis": axis, "begin": b, "end": e}))
+    return outs
+
+
+def split(ary, indices_or_sections, axis=0):
+    return _split_impl(ary, indices_or_sections, axis, even_required=True)
+
+
+def array_split(ary, indices_or_sections, axis=0):
+    return _split_impl(ary, indices_or_sections, axis, even_required=False)
+
+
+def hsplit(ary, indices_or_sections):
+    a = _proc(ary)
+    return _split_impl(a, indices_or_sections, 0 if a.ndim == 1 else 1, True)
+
+
+def vsplit(ary, indices_or_sections):
+    return _split_impl(ary, indices_or_sections, 0, True)
+
+
+def expand_dims(a, axis):
+    return _np_invoke("expand_dims", [_proc(a)], {"axis": axis})
+
+
+def squeeze(a, axis=None):
+    return _np_invoke("squeeze", [_proc(a)], {"axis": axis})
+
+
+def broadcast_to(array, shape):  # noqa: A002
+    return _np_invoke("_npi_broadcast_to", [_proc(array)],
+                      {"shape": tuple(shape) if not isinstance(shape, int)
+                       else (shape,)})
+
+
+def broadcast_arrays(*args):
+    arrs = [_proc(a) for a in args]
+    target = onp.broadcast_shapes(*[a.shape for a in arrs])
+    return [broadcast_to(a, target) for a in arrs]
+
+
+def tile(a, reps):
+    return _np_invoke("tile", [_proc(a)], {"reps": reps})
+
+
+def repeat(a, repeats, axis=None):
+    return _np_invoke("repeat", [_proc(a)], {"repeats": repeats, "axis": axis})
+
+
+def flip(m, axis=None):
+    a = _proc(m)
+    if axis is None:
+        axis = tuple(range(a.ndim))
+    return _np_invoke("flip", [a], {"axis": axis})
+
+
+def fliplr(m):
+    return flip(m, 1)
+
+
+def flipud(m):
+    return flip(m, 0)
+
+
+def roll(a, shift, axis=None):
+    return _np_invoke("_npi_roll", [_proc(a)], {"shift": shift, "axis": axis})
+
+
+def rot90(m, k=1, axes=(0, 1)):
+    return _np_invoke("_npi_rot90", [_proc(m)], {"k": k, "axes": tuple(axes)})
+
+
+def pad(array, pad_width, mode="constant", constant_values=0):  # noqa: A002
+    return _np_invoke("_npi_pad", [_proc(array)],
+                      {"pad_width": pad_width, "mode": mode,
+                       "constant_values": constant_values})
+
+
+def append(arr, values, axis=None):
+    return _np_invoke("_npi_append", [_proc(arr), _proc(values)],
+                      {"axis": axis})
+
+
+def where(condition, x=None, y=None):
+    if x is None and y is None:
+        return nonzero(condition)
+    return _np_invoke("_npi_where", [_proc(condition), _proc(x), _proc(y)])
+
+
+def take(a, indices, axis=None, mode="clip", out=None):
+    a = _proc(a)
+    if axis is None:
+        a = a.reshape(-1)
+        axis = 0
+    return _np_invoke("take", [a, _proc(indices)],
+                      {"axis": axis, "mode": mode}, out=out)
+
+
+def take_along_axis(arr, indices, axis):
+    return _np_invoke("_npi_take_along_axis", [_proc(arr), _proc(indices)],
+                      {"axis": axis})
+
+
+def clip(a, a_min=None, a_max=None, out=None):
+    if a_min is None and a_max is None:
+        raise ValueError("One of a_min and a_max must be given")
+    a = _proc(a)
+    if a_min is None:
+        return minimum(a, a_max) if out is None else \
+            _np_invoke("broadcast_minimum", [a, _proc(a_max)], out=out)
+    if a_max is None:
+        return maximum(a, a_min) if out is None else \
+            _np_invoke("broadcast_maximum", [a, _proc(a_min)], out=out)
+    return _np_invoke("clip", [a], {"a_min": a_min, "a_max": a_max}, out=out)
+
+
+def nonzero(a):
+    mat = _np_invoke("_npi_nonzero", [_proc(a)])
+    return tuple(_np_invoke("_slice_get", [mat], {"key": i})
+                 for i in range(mat.shape[0]))
+
+
+def flatnonzero(a):
+    return _np_invoke("_npi_flatnonzero", [_proc(a)])
+
+
+def unique(ar, return_index=False, return_inverse=False, return_counts=False):
+    r = _np_invoke("_npi_unique", [_proc(ar)],
+                   {"return_index": return_index,
+                    "return_inverse": return_inverse,
+                    "return_counts": return_counts})
+    return tuple(r) if isinstance(r, list) else r
+
+
+def sort(a, axis=-1):
+    return _np_invoke("sort", [_proc(a)], {"axis": axis, "is_ascend": True})
+
+
+def argsort(a, axis=-1):
+    return _np_invoke("argsort", [_proc(a)], {"axis": axis, "is_ascend": True})
+
+
+def argmax(a, axis=None, out=None):
+    return _np_invoke("argmax", [_proc(a)], {"axis": axis}, out=out)
+
+
+def argmin(a, axis=None, out=None):
+    return _np_invoke("argmin", [_proc(a)], {"axis": axis}, out=out)
+
+
+def searchsorted(a, v, side="left"):
+    return _np_invoke("_npi_searchsorted", [_proc(a), _proc(v)],
+                      {"side": side})
+
+
+def atleast_1d(*arys):
+    res = []
+    for a in arys:
+        a = _proc(a)
+        if not isinstance(a, NDArray):
+            a = array(a)
+        res.append(a.reshape(1) if a.ndim == 0 else a)
+    return res[0] if len(res) == 1 else res
+
+
+def atleast_2d(*arys):
+    res = []
+    for a in arys:
+        a = _proc(a)
+        if not isinstance(a, NDArray):
+            a = array(a)
+        if a.ndim == 0:
+            a = a.reshape(1, 1)
+        elif a.ndim == 1:
+            a = expand_dims(a, 0)
+        res.append(a)
+    return res[0] if len(res) == 1 else res
+
+
+def atleast_3d(*arys):
+    res = []
+    for a in arys:
+        a = _proc(a)
+        if not isinstance(a, NDArray):
+            a = array(a)
+        if a.ndim == 0:
+            a = a.reshape(1, 1, 1)
+        elif a.ndim == 1:
+            a = a.reshape(1, -1, 1)
+        elif a.ndim == 2:
+            a = expand_dims(a, 2)
+        res.append(a)
+    return res[0] if len(res) == 1 else res
+
+
+def insert_dims_like(a, like):
+    """Convenience (not in numpy): right-pad ``a``'s shape with 1s to
+    match ``like``'s rank for broadcasting."""
+    a = _proc(a)
+    while a.ndim < _proc(like).ndim:
+        a = expand_dims(a, -1)
+    return a
+
+
+# ---------------------------------------------------------------------------
+# elementwise math — factories
+# ---------------------------------------------------------------------------
+def _make_binary(fname, opname):
+    def f(x1, x2, out=None):
+        return _np_invoke(opname, [_proc(x1), _proc(x2)], None, out=out)
+    f.__name__ = fname
+    f.__doc__ = f"numpy.{fname} semantics; dispatches registry op {opname}."
+    return f
+
+
+_BINARY_TABLE = {
+    "add": "broadcast_add", "subtract": "broadcast_sub",
+    "multiply": "broadcast_mul", "divide": "broadcast_div",
+    "true_divide": "broadcast_div", "mod": "broadcast_mod",
+    "remainder": "broadcast_mod", "fmod": "_npi_fmod",
+    "power": "broadcast_power", "maximum": "broadcast_maximum",
+    "minimum": "broadcast_minimum", "fmax": "_npi_fmax",
+    "fmin": "_npi_fmin", "hypot": "broadcast_hypot", "arctan2": "arctan2",
+    "logaddexp": "_npi_logaddexp", "logaddexp2": "_npi_logaddexp2",
+    "copysign": "_npi_copysign", "ldexp": "_npi_ldexp",
+    "heaviside": "_npi_heaviside", "gcd": "_npi_gcd", "lcm": "_npi_lcm",
+    "bitwise_and": "_npi_bitwise_and", "bitwise_or": "_npi_bitwise_or",
+    "bitwise_xor": "_npi_bitwise_xor", "left_shift": "_npi_left_shift",
+    "right_shift": "_npi_right_shift",
+    # numpy comparisons/logicals return bool (the classic broadcast_*
+    # family returns the input dtype, MXNet convention)
+    "logical_and": "_npi_logical_and",
+    "logical_or": "_npi_logical_or",
+    "logical_xor": "_npi_logical_xor",
+    "equal": "_npi_equal", "not_equal": "_npi_not_equal",
+    "greater": "_npi_greater", "greater_equal": "_npi_greater_equal",
+    "less": "_npi_less", "less_equal": "_npi_less_equal",
+    "floor_divide": "_npi_floor_divide",
+}
+
+for _f, _o in _BINARY_TABLE.items():
+    globals()[_f] = _make_binary(_f, _o)
+
+
+def _make_unary(fname, opname):
+    def f(x, out=None):
+        return _np_invoke(opname, [_proc(x)], None, out=out)
+    f.__name__ = fname
+    f.__doc__ = f"numpy.{fname} semantics; dispatches registry op {opname}."
+    return f
+
+
+_UNARY_TABLE = {
+    "absolute": "abs", "abs": "abs", "fabs": "abs", "sign": "sign",
+    "exp": "exp", "expm1": "expm1", "exp2": "_npi_exp2", "log": "log",
+    "log2": "log2", "log10": "log10", "log1p": "log1p", "sqrt": "sqrt",
+    "cbrt": "cbrt", "square": "square", "reciprocal": "reciprocal",
+    "negative": "negative", "positive": "copy", "sin": "sin", "cos": "cos",
+    "tan": "tan", "arcsin": "arcsin", "arccos": "arccos",
+    "arctan": "arctan", "sinh": "sinh", "cosh": "cosh", "tanh": "tanh",
+    "arcsinh": "arcsinh", "arccosh": "arccosh", "arctanh": "arctanh",
+    "degrees": "degrees", "radians": "radians", "deg2rad": "radians",
+    "rad2deg": "degrees", "rint": "rint", "floor": "floor", "ceil": "ceil",
+    "trunc": "trunc", "fix": "fix", "isnan": "isnan", "isinf": "isinf",
+    "isfinite": "isfinite", "logical_not": "_npi_logical_not",
+    "invert": "_npi_invert", "bitwise_not": "_npi_invert",
+    "signbit": "_npi_signbit",
+}
+
+for _f, _o in _UNARY_TABLE.items():
+    globals()[_f] = _make_unary(_f, _o)
+
+__all__ += [f for f in (*_UNARY_TABLE, *_BINARY_TABLE) if f not in __all__]
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None):
+    return _np_invoke("_npi_nan_to_num", [_proc(x)],
+                      {"nan": nan, "posinf": posinf, "neginf": neginf})
+
+
+# ---------------------------------------------------------------------------
+# reductions
+# ---------------------------------------------------------------------------
+def sum(a, axis=None, dtype=None, keepdims=False, out=None):  # noqa: A001
+    r = _np_invoke("sum", [_proc(a)], {"axis": axis, "keepdims": keepdims},
+                   out=out)
+    return r.astype(dtype) if dtype is not None else r
+
+
+def prod(a, axis=None, keepdims=False, out=None):
+    return _np_invoke("prod", [_proc(a)], {"axis": axis, "keepdims": keepdims},
+                      out=out)
+
+
+def mean(a, axis=None, dtype=None, keepdims=False, out=None):
+    r = _np_invoke("mean", [_proc(a)], {"axis": axis, "keepdims": keepdims},
+                   out=out)
+    return r.astype(dtype) if dtype is not None else r
+
+
+def std(a, axis=None, ddof=0, keepdims=False):
+    return _np_invoke("_npi_std", [_proc(a)],
+                      {"axis": axis, "ddof": ddof, "keepdims": keepdims})
+
+
+def var(a, axis=None, ddof=0, keepdims=False):
+    return _np_invoke("_npi_var", [_proc(a)],
+                      {"axis": axis, "ddof": ddof, "keepdims": keepdims})
+
+
+def median(a, axis=None, keepdims=False):
+    return _np_invoke("_npi_median", [_proc(a)],
+                      {"axis": axis, "keepdims": keepdims})
+
+
+def quantile(a, q, axis=None, keepdims=False, interpolation="linear"):
+    return _np_invoke("_npi_quantile", [_proc(a), _proc(q)],
+                      {"axis": axis, "keepdims": keepdims,
+                       "interpolation": interpolation})
+
+
+def percentile(a, q, axis=None, keepdims=False, interpolation="linear"):
+    return _np_invoke("_npi_percentile", [_proc(a), _proc(q)],
+                      {"axis": axis, "keepdims": keepdims,
+                       "interpolation": interpolation})
+
+
+def average(a, axis=None, weights=None):
+    inputs = [_proc(a)]
+    if weights is not None:
+        inputs.append(_proc(weights))
+    return _np_invoke("_npi_average", inputs, {"axis": axis})
+
+
+def max(a, axis=None, keepdims=False, out=None):  # noqa: A001
+    return _np_invoke("max", [_proc(a)], {"axis": axis, "keepdims": keepdims},
+                      out=out)
+
+
+def min(a, axis=None, keepdims=False, out=None):  # noqa: A001
+    return _np_invoke("min", [_proc(a)], {"axis": axis, "keepdims": keepdims},
+                      out=out)
+
+
+amax = max
+amin = min
+
+
+def nanmax(a, axis=None, keepdims=False):
+    return _np_invoke("_npi_nanmax", [_proc(a)],
+                      {"axis": axis, "keepdims": keepdims})
+
+
+def nanmin(a, axis=None, keepdims=False):
+    return _np_invoke("_npi_nanmin", [_proc(a)],
+                      {"axis": axis, "keepdims": keepdims})
+
+
+def nanmean(a, axis=None, keepdims=False):
+    return _np_invoke("_npi_nanmean", [_proc(a)],
+                      {"axis": axis, "keepdims": keepdims})
+
+
+def nansum(a, axis=None, keepdims=False):
+    return _np_invoke("nansum", [_proc(a)], {"axis": axis, "keepdims": keepdims})
+
+
+def nanprod(a, axis=None, keepdims=False):
+    return _np_invoke("nanprod", [_proc(a)], {"axis": axis, "keepdims": keepdims})
+
+
+def cumsum(a, axis=None, dtype=None):
+    r = _np_invoke("cumsum", [_proc(a)], {"axis": axis})
+    return r.astype(dtype) if dtype is not None else r
+
+
+def cumprod(a, axis=None, dtype=None):
+    return _np_invoke("_npi_cumprod", [_proc(a)],
+                      {"axis": axis, "dtype": dtype})
+
+
+def all(a, axis=None, keepdims=False):  # noqa: A001
+    return _np_invoke("_npi_all", [_proc(a)],
+                      {"axis": axis, "keepdims": keepdims})
+
+
+def any(a, axis=None, keepdims=False):  # noqa: A001
+    return _np_invoke("_npi_any", [_proc(a)],
+                      {"axis": axis, "keepdims": keepdims})
+
+
+def count_nonzero(a, axis=None, keepdims=False):
+    return _np_invoke("_npi_count_nonzero", [_proc(a)],
+                      {"axis": axis, "keepdims": keepdims})
+
+
+def ptp(a, axis=None, keepdims=False):
+    return _np_invoke("_npi_ptp", [_proc(a)],
+                      {"axis": axis, "keepdims": keepdims})
+
+
+def diff(a, n=1, axis=-1):
+    return _np_invoke("_npi_diff", [_proc(a)], {"n": n, "axis": axis})
+
+
+def ediff1d(ary):
+    return _np_invoke("_npi_ediff1d", [_proc(ary)])
+
+
+def bincount(x, weights=None, minlength=0):
+    inputs = [_proc(x)]
+    if weights is not None:
+        inputs.append(_proc(weights))
+    return _np_invoke("_npi_bincount", inputs, {"minlength": minlength})
+
+
+def histogram(a, bins=10, range=None):  # noqa: A002
+    r = _np_invoke("_npi_histogram", [_proc(a)],
+                   {"bins": bins, "range": range})
+    return r[0], r[1]
+
+
+def around(a, decimals=0, out=None):
+    if decimals == 0:
+        return _np_invoke("round", [_proc(a)], None, out=out)
+    f = 10.0 ** decimals
+    r = _np_invoke("round", [multiply(_proc(a), f)]) / f
+    if out is not None:
+        out._set_data(r._data)
+        return out
+    return r
+
+
+round = around  # noqa: A001
+round_ = around
+
+
+# ---------------------------------------------------------------------------
+# contractions
+# ---------------------------------------------------------------------------
+def dot(a, b, out=None):
+    return _np_invoke("_npi_dot", [_proc(a), _proc(b)], None, out=out)
+
+
+def vdot(a, b):
+    return _np_invoke("_npi_vdot", [_proc(a), _proc(b)])
+
+
+def inner(a, b):
+    return _np_invoke("_npi_inner", [_proc(a), _proc(b)])
+
+
+def outer(a, b):
+    return _np_invoke("_npi_outer", [_proc(a), _proc(b)])
+
+
+def matmul(a, b, out=None):
+    return _np_invoke("_npi_matmul", [_proc(a), _proc(b)], None, out=out)
+
+
+def tensordot(a, b, axes=2):
+    return _np_invoke("_npi_tensordot", [_proc(a), _proc(b)], {"axes": axes})
+
+
+def einsum(subscripts, *operands, optimize=True):
+    return _np_invoke("_npi_einsum", [_proc(o) for o in operands],
+                      {"subscripts": subscripts, "optimize": optimize})
+
+
+def kron(a, b):
+    return _np_invoke("_npi_kron", [_proc(a), _proc(b)])
+
+
+def cross(a, b, axis=-1):
+    return _np_invoke("_npi_cross", [_proc(a), _proc(b)], {"axis": axis})
+
+
+# ---------------------------------------------------------------------------
+# logic / misc
+# ---------------------------------------------------------------------------
+def isclose(a, b, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return _np_invoke("_npi_isclose", [_proc(a), _proc(b)],
+                      {"rtol": rtol, "atol": atol, "equal_nan": equal_nan})
+
+
+def allclose(a, b, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return bool(isclose(a, b, rtol, atol, equal_nan).all().item())
+
+
+def array_equal(a1, a2):
+    a1, a2 = _proc(a1), _proc(a2)
+    if a1.shape != a2.shape:
+        return False
+    return bool(equal(a1, a2).all().item())
+
+
+def interp(x, xp, fp, left=None, right=None):
+    return _np_invoke("_npi_interp", [_proc(x), _proc(xp), _proc(fp)],
+                      {"left": left, "right": right})
+
+
+def shape(a):
+    return _proc(a).shape
+
+
+def size(a):
+    return _proc(a).size
+
+
+def ndim(a):
+    return _proc(a).ndim
+
+
+def may_share_memory(a, b):
+    return False  # buffers are immutable jax arrays; writes rebind
+
+
+def result_type(*args):
+    return onp.result_type(*[
+        a.dtype if isinstance(a, NDArray) else a for a in args])
+
+
+def promote_types(t1, t2):
+    return onp.promote_types(t1, t2)
+
+
+def finfo(dtype):
+    return onp.finfo(onp.dtype(dtype_np(dtype)))
+
+
+def iinfo(dtype):
+    return onp.iinfo(onp.dtype(dtype_np(dtype)))

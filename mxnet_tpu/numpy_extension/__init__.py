@@ -1,0 +1,140 @@
+"""``mx.npx`` — NumPy-extension namespace (operators NumPy itself lacks).
+
+Analog of the reference's ``python/mxnet/numpy_extension/`` +
+``mx.npx`` (v>=1.6): the np-mode switch (``set_np``/``reset_np``), the
+neural-network operator surface under NumPy calling conventions
+(relu/softmax/batch_norm/convolution/fully_connected/...), special
+``reshape`` codes, and array save/load. Every op dispatches the same
+registry kernels as the classic frontend — np-mode outputs are
+``mx.np.ndarray`` via the dispatch-level wrap rule (see
+ndarray/register.py invoke)."""
+from __future__ import annotations
+
+import functools
+
+from ..util import is_np_array, is_np_shape, set_np, reset_np, use_np  # noqa: F401
+from ..ndarray.register import get_op, invoke
+from ..numpy.multiarray import ndarray, _np_invoke, _proc, asarray
+
+__all__ = [
+    "set_np", "reset_np", "is_np_array", "is_np_shape", "use_np",
+    "relu", "sigmoid", "log_sigmoid", "softmax", "log_softmax", "softmin",
+    "activation", "leaky_relu", "gelu", "erf", "erfinv", "gamma",
+    "gammaln", "digamma", "batch_dot", "batch_norm", "layer_norm",
+    "group_norm", "instance_norm", "l2_normalization", "fully_connected",
+    "convolution", "deconvolution", "pooling", "dropout", "embedding",
+    "one_hot", "pick", "topk", "rnn", "roi_pooling", "sequence_mask",
+    "smooth_l1", "gather_nd", "scatter_nd", "arange_like",
+    "broadcast_like", "reshape", "reshape_like", "ctc_loss",
+    "multibox_prior", "multibox_target", "multibox_detection",
+    "box_nms", "box_iou", "waitall", "save", "load", "seed",
+]
+
+
+def _ns(fname, opname, tensor_args=1):
+    """Build an npx function dispatching a registry op: the leading
+    ``tensor_args`` positionals are tensor inputs (None allowed for
+    optional ones), the rest ride as params."""
+
+    def f(*args, **kwargs):
+        inputs = list(args[:tensor_args])
+        extra = args[tensor_args:]
+        if extra:
+            raise TypeError(f"npx.{fname} takes at most {tensor_args} "
+                            f"positional tensor arguments")
+        inputs = [_proc(x) if x is not None else None for x in inputs]
+        return _np_invoke(opname, inputs, kwargs or None)
+
+    f.__name__ = fname
+    f.__doc__ = f"npx.{fname}: numpy-mode dispatch of registry op {opname}."
+    return f
+
+
+# activations / math extensions
+relu = _ns("relu", "relu")
+sigmoid = _ns("sigmoid", "sigmoid")
+log_sigmoid = _ns("log_sigmoid", "log_sigmoid")
+softmax = _ns("softmax", "softmax")
+log_softmax = _ns("log_softmax", "log_softmax")
+softmin = _ns("softmin", "softmin")
+activation = _ns("activation", "Activation")
+leaky_relu = _ns("leaky_relu", "LeakyReLU")
+gelu = _ns("gelu", "gelu")
+erf = _ns("erf", "erf")
+erfinv = _ns("erfinv", "erfinv")
+gamma = _ns("gamma", "gamma")
+gammaln = _ns("gammaln", "gammaln")
+digamma = _ns("digamma", "digamma")
+smooth_l1 = _ns("smooth_l1", "smooth_l1")
+
+# contractions / nn layers (tensor arity follows the classic ops)
+batch_dot = _ns("batch_dot", "batch_dot", tensor_args=2)
+fully_connected = _ns("fully_connected", "FullyConnected", tensor_args=3)
+convolution = _ns("convolution", "Convolution", tensor_args=3)
+deconvolution = _ns("deconvolution", "Deconvolution", tensor_args=3)
+pooling = _ns("pooling", "Pooling")
+dropout = _ns("dropout", "Dropout")
+embedding = _ns("embedding", "Embedding", tensor_args=2)
+batch_norm = _ns("batch_norm", "BatchNorm", tensor_args=5)
+layer_norm = _ns("layer_norm", "LayerNorm", tensor_args=3)
+group_norm = _ns("group_norm", "GroupNorm", tensor_args=3)
+instance_norm = _ns("instance_norm", "InstanceNorm", tensor_args=3)
+l2_normalization = _ns("l2_normalization", "L2Normalization")
+rnn = _ns("rnn", "RNN", tensor_args=4)
+roi_pooling = _ns("roi_pooling", "ROIPooling", tensor_args=2)
+ctc_loss = _ns("ctc_loss", "ctc_loss", tensor_args=4)
+
+# indexing / shape extensions
+one_hot = _ns("one_hot", "one_hot")
+pick = _ns("pick", "pick", tensor_args=2)
+topk = _ns("topk", "topk")
+gather_nd = _ns("gather_nd", "gather_nd", tensor_args=2)
+scatter_nd = _ns("scatter_nd", "scatter_nd", tensor_args=2)
+arange_like = _ns("arange_like", "arange_like")
+broadcast_like = _ns("broadcast_like", "broadcast_like", tensor_args=2)
+sequence_mask = _ns("sequence_mask", "SequenceMask", tensor_args=2)
+reshape_like = _ns("reshape_like", "reshape_like", tensor_args=2)
+
+
+def reshape(a, newshape, reverse=False, order="C"):
+    """npx.reshape with the classic special codes: 0 keep, -1 infer,
+    -2 copy remainder, -3 merge next two, -4 split (takes two following
+    values) — reference src/operator/tensor/matrix_op.cc semantics."""
+    return _np_invoke("reshape", [_proc(a)],
+                      {"shape": tuple(newshape), "reverse": reverse})
+
+
+multibox_prior = _ns("multibox_prior", "_contrib_MultiBoxPrior")
+multibox_target = _ns("multibox_target", "_contrib_MultiBoxTarget",
+                      tensor_args=3)
+multibox_detection = _ns("multibox_detection", "_contrib_MultiBoxDetection",
+                         tensor_args=3)
+box_nms = _ns("box_nms", "_contrib_box_nms")
+box_iou = _ns("box_iou", "_contrib_box_iou", tensor_args=2)
+
+
+def waitall():
+    from ..engine import engine
+    engine.wait_all()
+
+
+def save(fname, data):
+    """Save np arrays (dict/list/single) in the NDArray-file format."""
+    from ..ndarray import serialization
+    serialization.save(fname, data)
+
+
+def load(fname):
+    """Load arrays saved by :func:`save`, returned as np ndarrays."""
+    from ..ndarray import serialization
+    loaded = serialization.load(fname)
+    if isinstance(loaded, dict):
+        return {k: v.as_np_ndarray() for k, v in loaded.items()}
+    if isinstance(loaded, list):
+        return [v.as_np_ndarray() for v in loaded]
+    return loaded.as_np_ndarray()
+
+
+def seed(seed_state):
+    from .. import random as _r
+    _r.seed(seed_state)

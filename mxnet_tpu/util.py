@@ -3,7 +3,8 @@ from __future__ import annotations
 
 import functools
 
-__all__ = ["is_np_array", "is_np_shape", "set_np", "reset_np", "makedirs", "use_np"]
+__all__ = ["is_np_array", "is_np_shape", "set_np", "reset_np", "makedirs",
+           "use_np", "np_scope"]
 
 _NUMPY_ARRAY = False
 _NUMPY_SHAPE = False
@@ -28,8 +29,43 @@ def reset_np():
     set_np(False, False)
 
 
+class np_scope:
+    """Context manager: numpy semantics active inside, previous mode
+    restored on exit (python/mxnet/util.py use_np_array/use_np_shape
+    scoped form)."""
+
+    def __enter__(self):
+        global _NUMPY_ARRAY, _NUMPY_SHAPE
+        self._saved = (_NUMPY_ARRAY, _NUMPY_SHAPE)
+        set_np()
+        return self
+
+    def __exit__(self, *exc):
+        global _NUMPY_ARRAY, _NUMPY_SHAPE
+        _NUMPY_ARRAY, _NUMPY_SHAPE = self._saved
+        return False
+
+
 def use_np(func):
-    return func
+    """Decorator: run ``func`` — or the entry methods of a class
+    (``__init__``/``__call__``/``forward``/``hybrid_forward``) — with
+    numpy semantics active, restoring the previous mode afterwards
+    (python/mxnet/util.py ``use_np``)."""
+    import inspect
+
+    if inspect.isclass(func):
+        for name in ("__init__", "__call__", "forward", "hybrid_forward"):
+            m = func.__dict__.get(name)
+            if m is not None and callable(m):
+                setattr(func, name, use_np(m))
+        return func
+
+    @functools.wraps(func)
+    def wrapped(*args, **kwargs):
+        with np_scope():
+            return func(*args, **kwargs)
+
+    return wrapped
 
 
 def makedirs(d):
