@@ -94,3 +94,54 @@ def dequantize(data, min_range, max_range, out_type="float32"):
         return _wrap(data._data.astype(jnp.float32) * scale + lo, data.ctx)
     scale = max(abs(hi), abs(lo)) / 127.0
     return _wrap(data._data.astype(jnp.float32) * scale, data.ctx)
+
+
+# -- SSD detection family (ops/detection.py; reference
+# src/operator/contrib/multibox_*.cc + bounding_box.cc) ---------------
+def MultiBoxPrior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                  steps=(-1.0, -1.0), offsets=(0.5, 0.5)):
+    return _invoke(_get_op("_contrib_MultiBoxPrior"), [data],
+                   {"sizes": sizes, "ratios": ratios, "clip": clip,
+                    "steps": steps, "offsets": offsets})
+
+
+def MultiBoxTarget(anchor, label, cls_pred, overlap_threshold=0.5,
+                   ignore_label=-1.0, negative_mining_ratio=-1.0,
+                   negative_mining_thresh=0.5, minimum_negative_samples=0,
+                   variances=(0.1, 0.1, 0.2, 0.2)):
+    return _invoke(_get_op("_contrib_MultiBoxTarget"),
+                   [anchor, label, cls_pred],
+                   {"overlap_threshold": overlap_threshold,
+                    "ignore_label": ignore_label,
+                    "negative_mining_ratio": negative_mining_ratio,
+                    "negative_mining_thresh": negative_mining_thresh,
+                    "minimum_negative_samples": minimum_negative_samples,
+                    "variances": variances})
+
+
+def MultiBoxDetection(cls_prob, loc_pred, anchor, clip=True, threshold=0.01,
+                      background_id=0, nms_threshold=0.5,
+                      force_suppress=False, variances=(0.1, 0.1, 0.2, 0.2),
+                      nms_topk=-1):
+    return _invoke(_get_op("_contrib_MultiBoxDetection"),
+                   [cls_prob, loc_pred, anchor],
+                   {"clip": clip, "threshold": threshold,
+                    "background_id": background_id,
+                    "nms_threshold": nms_threshold,
+                    "force_suppress": force_suppress,
+                    "variances": variances, "nms_topk": nms_topk})
+
+
+def box_nms(data, **kwargs):
+    return _invoke(_get_op("_contrib_box_nms"), [data], kwargs)
+
+
+def box_iou(lhs, rhs, format="corner"):
+    return _invoke(_get_op("_contrib_box_iou"), [lhs, rhs],
+                   {"format": format})
+
+
+def bipartite_matching(dist, is_ascend=False, threshold=None, topk=-1):
+    return _invoke(_get_op("_contrib_bipartite_matching"), [dist],
+                   {"is_ascend": is_ascend, "threshold": threshold,
+                    "topk": topk})

@@ -455,7 +455,7 @@ def vstack(tup):
 
 
 def hstack(tup):
-    arrs = [_proc(a) for a in tup]
+    arrs = [atleast_1d(a) for a in tup]
     if arrs and arrs[0].ndim == 1:
         return concatenate(arrs, axis=0)
     return concatenate(arrs, axis=1)
@@ -469,7 +469,7 @@ def dstack(tup):
 def column_stack(tup):
     arrs = []
     for a in tup:
-        a = _proc(a)
+        a = atleast_1d(a)
         if a.ndim < 2:
             a = a.reshape(-1, 1)
         arrs.append(a)

@@ -1,0 +1,360 @@
+"""SSD-family detection operators (anchors, target matching, NMS).
+
+TPU-native analog of the reference's
+``src/operator/contrib/multibox_prior.{cc,cu}``,
+``multibox_target.{cc,cu}``, ``multibox_detection.{cc,cu}`` and
+``bounding_box.cc`` (box_iou / box_nms / bipartite_matching). The
+reference hand-rolls CUDA kernels with dynamic worklists; here every
+op is a fixed-shape jax computation (sort + masked ``lax.fori_loop``
+suppression instead of dynamic queues) so the whole family jits and
+vmaps over the batch — suppressed entries are marked ``-1`` in place,
+matching the reference's output contract exactly.
+
+All ops are non-differentiable (the reference registers no gradient:
+target generation and NMS backward are zeros).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ndarray.register import register_op
+
+__all__ = []
+
+
+# ---------------------------------------------------------------------------
+# box format helpers
+# ---------------------------------------------------------------------------
+def _corner_to_center(b):
+    w = b[..., 2] - b[..., 0]
+    h = b[..., 3] - b[..., 1]
+    return (b[..., 0] + w * 0.5, b[..., 1] + h * 0.5, w, h)
+
+
+def _iou_corner(a, b, eps=1e-12):
+    """IoU of two corner-format box sets: a (..., N, 4) vs b (..., M, 4)
+    -> (..., N, M)."""
+    ax1, ay1, ax2, ay2 = (a[..., :, None, i] for i in range(4))
+    bx1, by1, bx2, by2 = (b[..., None, :, i] for i in range(4))
+    iw = jnp.maximum(jnp.minimum(ax2, bx2) - jnp.maximum(ax1, bx1), 0.0)
+    ih = jnp.maximum(jnp.minimum(ay2, by2) - jnp.maximum(ay1, by1), 0.0)
+    inter = iw * ih
+    area_a = jnp.maximum(ax2 - ax1, 0.0) * jnp.maximum(ay2 - ay1, 0.0)
+    area_b = jnp.maximum(bx2 - bx1, 0.0) * jnp.maximum(by2 - by1, 0.0)
+    return inter / (area_a + area_b - inter + eps)
+
+
+def _to_corner(b, in_format):
+    if in_format == "center":
+        x, y, w, h = b[..., 0], b[..., 1], b[..., 2], b[..., 3]
+        return jnp.stack([x - w / 2, y - h / 2, x + w / 2, y + h / 2], -1)
+    return b
+
+
+def _from_corner(b, out_format):
+    if out_format == "center":
+        x1, y1, x2, y2 = b[..., 0], b[..., 1], b[..., 2], b[..., 3]
+        return jnp.stack([(x1 + x2) / 2, (y1 + y2) / 2, x2 - x1, y2 - y1], -1)
+    return b
+
+
+# ---------------------------------------------------------------------------
+# box_iou / bipartite matching
+# ---------------------------------------------------------------------------
+@register_op("_contrib_box_iou", aliases=("box_iou",), differentiable=False)
+def box_iou(lhs, rhs, format="corner"):  # noqa: A002
+    lhs = _to_corner(lhs, format)
+    rhs = _to_corner(rhs, format)
+    return _iou_corner(lhs, rhs)
+
+
+@register_op("_contrib_bipartite_matching", aliases=("bipartite_matching",),
+             differentiable=False, num_visible_outputs=2)
+def bipartite_matching(dist, is_ascend=False, threshold=None, topk=-1):
+    """Greedy bipartite matching on a pairwise score matrix
+    (reference bounding_box.cc BipartiteMatching): repeatedly take the
+    globally best (row, col) pair, mark both used. Returns
+    (row_match, col_match): for each row the matched col (or -1), and
+    for each col the matched row (or -1)."""
+    d = dist
+    if d.ndim != 2:
+        raise ValueError("bipartite_matching expects a 2-D dist matrix")
+    n, m = d.shape
+    k = min(n, m) if topk is None or topk < 0 else min(topk, min(n, m))
+    big = jnp.asarray(jnp.inf, d.dtype)
+    sign = 1.0 if not is_ascend else -1.0
+    # sign-flip FIRST, then mask NaN — masking before the flip would
+    # turn NaN into +inf under is_ascend and greedily match it
+    score0 = jnp.where(jnp.isnan(d), -big, d * sign)  # maximize always
+
+    def body(i, carry):
+        score, row_m, col_m = carry
+        flat = jnp.argmax(score)
+        r, c = flat // m, flat % m
+        best = score[r, c]
+        dval = best * sign  # back to the caller's scale
+        ok = best > -big
+        if threshold is not None:
+            ok = jnp.logical_and(
+                ok, dval <= threshold if is_ascend else dval >= threshold)
+        row_m = jnp.where(ok, row_m.at[r].set(c.astype(jnp.int32)), row_m)
+        col_m = jnp.where(ok, col_m.at[c].set(r.astype(jnp.int32)), col_m)
+        score = jnp.where(ok, score.at[r, :].set(-big).at[:, c].set(-big),
+                          score)
+        return score, row_m, col_m
+
+    row_m = jnp.full((n,), -1, jnp.int32)
+    col_m = jnp.full((m,), -1, jnp.int32)
+    _, row_m, col_m = jax.lax.fori_loop(0, k, body, (score0, row_m, col_m))
+    return row_m.astype(d.dtype), col_m.astype(d.dtype)
+
+
+# ---------------------------------------------------------------------------
+# box_nms
+# ---------------------------------------------------------------------------
+def _nms_single(boxes, scores, ids, valid, overlap_thresh, force_suppress):
+    """Greedy NMS over one row set. boxes corner (N,4); returns keep
+    mask (N,) bool, iterating highest-score-first (fixed N steps)."""
+    n = boxes.shape[0]
+    order = jnp.argsort(-scores)
+    b = boxes[order]
+    cid = ids[order]
+    v = valid[order]
+    iou = _iou_corner(b, b)
+    same = jnp.logical_or(force_suppress, cid[:, None] == cid[None, :])
+    sup_pair = jnp.logical_and(iou > overlap_thresh, same)
+
+    def body(i, keep):
+        # i suppresses later j when i itself is kept
+        row = jnp.logical_and(sup_pair[i], jnp.arange(n) > i)
+        row = jnp.logical_and(row, keep[i])
+        return jnp.logical_and(keep, jnp.logical_not(row))
+
+    keep_sorted = jax.lax.fori_loop(0, n, body, v)
+    keep = jnp.zeros((n,), bool).at[order].set(keep_sorted)
+    return keep
+
+
+@register_op("_contrib_box_nms", aliases=("box_nms",), differentiable=False)
+def box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
+            coord_start=2, score_index=1, id_index=-1, background_id=-1,
+            force_suppress=False, in_format="corner", out_format="corner"):
+    """Suppressed entries become all -1 rows; survivors are sorted by
+    score descending (reference bounding_box.cc contract)."""
+    squeeze = data.ndim == 2
+    d = data[None] if squeeze else data
+    batch = d.shape[:-2]
+    d2 = d.reshape((-1,) + d.shape[-2:])
+
+    def one(rows):
+        scores = rows[:, score_index]
+        boxes = _to_corner(rows[:, coord_start:coord_start + 4], in_format)
+        ids = rows[:, id_index] if id_index >= 0 else jnp.zeros(rows.shape[0])
+        valid = scores > valid_thresh
+        if id_index >= 0 and background_id >= 0:
+            valid = jnp.logical_and(valid, ids != background_id)
+        if topk is not None and topk > 0:
+            # rank among VALID rows only (reference filters by
+            # valid_thresh/background before applying topk)
+            rank = jnp.argsort(jnp.argsort(
+                -jnp.where(valid, scores, -jnp.inf)))
+            valid = jnp.logical_and(valid, rank < topk)
+        keep = _nms_single(boxes, scores, ids, valid, overlap_thresh,
+                           bool(force_suppress))
+        out = jnp.where(keep[:, None], rows, -jnp.ones_like(rows))
+        # survivors first, by score desc (suppressed rows sink)
+        order = jnp.argsort(-jnp.where(keep, scores, -jnp.inf))
+        out = out[order]
+        if out_format != in_format:
+            coords = out[:, coord_start:coord_start + 4]
+            conv = _from_corner(_to_corner(coords, in_format), out_format)
+            out = out.at[:, coord_start:coord_start + 4].set(
+                jnp.where(keep[order][:, None], conv, -1.0))
+        return out
+
+    res = jax.vmap(one)(d2).reshape(d.shape)
+    return res[0] if squeeze else res.reshape(batch + d.shape[-2:])
+
+
+# ---------------------------------------------------------------------------
+# MultiBoxPrior
+# ---------------------------------------------------------------------------
+@register_op("_contrib_MultiBoxPrior", aliases=("MultiBoxPrior",),
+             differentiable=False)
+def multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                   steps=(-1.0, -1.0), offsets=(0.5, 0.5)):
+    """Anchor boxes for a (B, C, H, W) feature map, corner format in
+    [0, 1]: per cell, sizes[k] x ratios[0] for all k plus sizes[0] x
+    ratios[j] for j > 0 (reference multibox_prior-inl.h ordering)."""
+    sizes = tuple(float(s) for s in (sizes if isinstance(sizes, (tuple, list))
+                                     else (sizes,)))
+    ratios = tuple(float(r) for r in (ratios if isinstance(ratios, (tuple, list))
+                                      else (ratios,)))
+    h, w = data.shape[-2], data.shape[-1]
+    # steps/offsets are (y, x) per the reference param convention
+    step_y = 1.0 / h if steps[0] < 0 else steps[0]
+    step_x = 1.0 / w if steps[1] < 0 else steps[1]
+    cy = (jnp.arange(h, dtype=jnp.float32) + offsets[0]) * step_y
+    cx = (jnp.arange(w, dtype=jnp.float32) + offsets[1]) * step_x
+    half = []
+    for k, s in enumerate(sizes):
+        half.append((s * (ratios[0] ** 0.5) / 2.0, s / (ratios[0] ** 0.5) / 2.0))
+    for r in ratios[1:]:
+        half.append((sizes[0] * (r ** 0.5) / 2.0, sizes[0] / (r ** 0.5) / 2.0))
+    hw = jnp.asarray([p[0] for p in half], jnp.float32)  # (A,)
+    hh = jnp.asarray([p[1] for p in half], jnp.float32)
+    gy, gx = jnp.meshgrid(cy, cx, indexing="ij")  # (H, W)
+    gx = gx[..., None]
+    gy = gy[..., None]
+    anchors = jnp.stack([gx - hw, gy - hh, gx + hw, gy + hh], -1)  # (H,W,A,4)
+    anchors = anchors.reshape(1, -1, 4)
+    if clip:
+        anchors = jnp.clip(anchors, 0.0, 1.0)
+    return anchors
+
+
+# ---------------------------------------------------------------------------
+# MultiBoxTarget
+# ---------------------------------------------------------------------------
+@register_op("_contrib_MultiBoxTarget", aliases=("MultiBoxTarget",),
+             differentiable=False, num_visible_outputs=3)
+def multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
+                    ignore_label=-1.0, negative_mining_ratio=-1.0,
+                    negative_mining_thresh=0.5, minimum_negative_samples=0,
+                    variances=(0.1, 0.1, 0.2, 0.2)):
+    """Match anchors to ground truth and emit training targets
+    (reference multibox_target-inl.h):
+
+    - anchor (1, N, 4) corner, label (B, M, 5) rows [cls x1 y1 x2 y2]
+      (cls = -1 pads), cls_pred (B, num_cls+1, N) for negative mining.
+    - returns loc_target (B, N*4) variance-encoded offsets, loc_mask
+      (B, N*4) 1 where matched, cls_target (B, N) with class+1 for
+      matched, 0 background, ignore_label for mined-out negatives.
+
+    Matching follows the reference: greedy bipartite pass gives every
+    GT its best anchor, then any unmatched anchor takes its best GT if
+    IoU >= overlap_threshold. Negative mining keeps the
+    ``negative_mining_ratio``x hardest negatives by background score
+    deficit among anchors whose best IoU < negative_mining_thresh.
+    """
+    anchors = anchor.reshape(-1, 4)
+    n = anchors.shape[0]
+    var = jnp.asarray(variances, jnp.float32)
+    acx, acy, aw, ah = _corner_to_center(anchors)
+
+    def one(lab, cpred):
+        m = lab.shape[0]
+        gt_valid = lab[:, 0] >= 0
+        gt_boxes = lab[:, 1:5]
+        iou = _iou_corner(anchors, gt_boxes)  # (N, M)
+        iou = jnp.where(gt_valid[None, :], iou, -1.0)
+
+        # pass 1: greedy bipartite — each valid GT claims its best anchor
+        big = jnp.asarray(jnp.inf, iou.dtype)
+        match = jnp.full((n,), -1, jnp.int32)
+
+        def bip(i, carry):
+            score, match = carry
+            flat = jnp.argmax(score)
+            r, c = flat // m, flat % m
+            ok = score[r, c] > 0.0
+            match = jnp.where(ok, match.at[r].set(c.astype(jnp.int32)), match)
+            score = jnp.where(ok, score.at[r, :].set(-big).at[:, c].set(-big),
+                              score)
+            return score, match
+
+        _, match = jax.lax.fori_loop(0, m, bip, (iou, match))
+
+        # pass 2: threshold matching for still-unmatched anchors
+        best_gt = jnp.argmax(iou, axis=1).astype(jnp.int32)
+        best_iou = jnp.max(iou, axis=1)
+        thr_ok = jnp.logical_and(match < 0, best_iou >= overlap_threshold)
+        match = jnp.where(thr_ok, best_gt, match)
+        matched = match >= 0
+        midx = jnp.maximum(match, 0)
+
+        # classification target (class ids shift +1; 0 = background)
+        cls_t = jnp.where(matched, lab[midx, 0] + 1.0, 0.0)
+
+        # negative mining on background anchors
+        if negative_mining_ratio > 0:
+            num_pos = matched.sum()
+            max_neg = jnp.maximum(
+                (negative_mining_ratio * num_pos).astype(jnp.int32),
+                jnp.asarray(int(minimum_negative_samples), jnp.int32))
+            neg_cand = jnp.logical_and(~matched,
+                                       best_iou < negative_mining_thresh)
+            # hardness: best non-background score minus background score
+            bg = cpred[0]
+            fg = jnp.max(cpred[1:], axis=0)
+            hardness = jnp.where(neg_cand, fg - bg, -jnp.inf)
+            rank = jnp.argsort(jnp.argsort(-hardness))
+            keep_neg = jnp.logical_and(neg_cand, rank < max_neg)
+            cls_t = jnp.where(jnp.logical_and(~matched, ~keep_neg),
+                              jnp.asarray(float(ignore_label)), cls_t)
+
+        # localization target: variance-encoded center-form offsets
+        gcx, gcy, gw, gh = _corner_to_center(gt_boxes[midx])
+        eps = 1e-8
+        tx = (gcx - acx) / jnp.maximum(aw, eps) / var[0]
+        ty = (gcy - acy) / jnp.maximum(ah, eps) / var[1]
+        tw = jnp.log(jnp.maximum(gw, eps) / jnp.maximum(aw, eps)) / var[2]
+        th = jnp.log(jnp.maximum(gh, eps) / jnp.maximum(ah, eps)) / var[3]
+        loc_t = jnp.stack([tx, ty, tw, th], -1)
+        loc_t = jnp.where(matched[:, None], loc_t, 0.0).reshape(-1)
+        loc_m = jnp.where(matched[:, None],
+                          jnp.ones((n, 4), jnp.float32), 0.0).reshape(-1)
+        return loc_t, loc_m, cls_t
+
+    loc_t, loc_m, cls_t = jax.vmap(one)(label, cls_pred)
+    return loc_t, loc_m, cls_t
+
+
+# ---------------------------------------------------------------------------
+# MultiBoxDetection
+# ---------------------------------------------------------------------------
+@register_op("_contrib_MultiBoxDetection", aliases=("MultiBoxDetection",),
+             differentiable=False)
+def multibox_detection(cls_prob, loc_pred, anchor, clip=True, threshold=0.01,
+                       background_id=0, nms_threshold=0.5,
+                       force_suppress=False,
+                       variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1):
+    """Decode predictions into detections (B, N, 6) rows
+    [class_id, score, x1, y1, x2, y2]; pruned/suppressed rows are -1
+    (reference multibox_detection-inl.h)."""
+    anchors = anchor.reshape(-1, 4)
+    n = anchors.shape[0]
+    var = jnp.asarray(variances, jnp.float32)
+    acx, acy, aw, ah = _corner_to_center(anchors)
+
+    def one(cp, lp):
+        # cp (num_cls+1, N), lp (N*4,)
+        off = lp.reshape(n, 4)
+        cx = off[:, 0] * var[0] * aw + acx
+        cy = off[:, 1] * var[1] * ah + acy
+        w = jnp.exp(off[:, 2] * var[2]) * aw
+        h = jnp.exp(off[:, 3] * var[3]) * ah
+        boxes = jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], -1)
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        # best non-background class per anchor
+        fg = jnp.concatenate([cp[:background_id], cp[background_id + 1:]], 0)
+        cls_id = jnp.argmax(fg, axis=0).astype(jnp.float32)
+        score = jnp.max(fg, axis=0)
+        valid = score > threshold
+        if nms_topk is not None and nms_topk > 0:
+            # reference keeps the top-k candidates BEFORE suppression —
+            # discarded ranks can neither survive nor suppress others
+            rank = jnp.argsort(jnp.argsort(
+                -jnp.where(valid, score, -jnp.inf)))
+            valid = jnp.logical_and(valid, rank < nms_topk)
+        rows = jnp.concatenate(
+            [cls_id[:, None], score[:, None], boxes], -1)
+        keep = _nms_single(boxes, jnp.where(valid, score, -jnp.inf),
+                           cls_id, valid, nms_threshold, bool(force_suppress))
+        out = jnp.where(keep[:, None], rows, -jnp.ones_like(rows))
+        order = jnp.argsort(-jnp.where(keep, score, -jnp.inf))
+        return out[order]
+
+    return jax.vmap(one)(cls_prob, loc_pred)

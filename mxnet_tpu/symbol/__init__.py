@@ -13,3 +13,4 @@ _populate_symbol_ops(_sys.modules[__name__])
 from . import random  # noqa: E402
 from . import linalg  # noqa: E402
 from . import sparse  # noqa: E402
+from . import contrib  # noqa: E402

@@ -9,3 +9,18 @@ def arange_like(data, start=0.0, step=1.0, repeat=1, axis=None, name=None):
     return _make_node(get_op("_arange_like"), [data],
                       {"start": start, "step": step, "repeat": repeat,
                        "axis": axis}, name=name)
+
+
+def _contrib_sym(op_name):
+    def f(*inputs, name=None, **params):
+        return _make_node(get_op(op_name), list(inputs), params, name=name)
+    f.__name__ = op_name.replace("_contrib_", "")
+    return f
+
+
+MultiBoxPrior = _contrib_sym("_contrib_MultiBoxPrior")
+MultiBoxTarget = _contrib_sym("_contrib_MultiBoxTarget")
+MultiBoxDetection = _contrib_sym("_contrib_MultiBoxDetection")
+box_nms = _contrib_sym("_contrib_box_nms")
+box_iou = _contrib_sym("_contrib_box_iou")
+bipartite_matching = _contrib_sym("_contrib_bipartite_matching")

@@ -1,0 +1,258 @@
+"""SSD detection op family: MultiBoxPrior/Target/Detection, box_nms,
+box_iou, bipartite_matching.
+
+Goldens are hand-computed small cases mirroring the reference's
+tests/python/unittest/test_contrib_operator.py strategy
+(src/operator/contrib/multibox_*.cc + bounding_box.cc semantics).
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def _nd(x, dtype="float32"):
+    return nd.array(onp.asarray(x, dtype))
+
+
+def test_box_iou_golden():
+    a = _nd([[0.0, 0.0, 2.0, 2.0], [1.0, 1.0, 3.0, 3.0]])
+    b = _nd([[0.0, 0.0, 2.0, 2.0], [10.0, 10.0, 11.0, 11.0]])
+    iou = nd.contrib.box_iou(a, b).asnumpy()
+    assert iou.shape == (2, 2)
+    assert iou[0, 0] == pytest.approx(1.0, abs=1e-6)
+    # boxes [0,0,2,2] vs [1,1,3,3]: inter 1, union 7
+    assert iou[1, 0] == pytest.approx(1.0 / 7.0, abs=1e-6)
+    assert iou[0, 1] == pytest.approx(0.0, abs=1e-6)
+
+
+def test_box_iou_center_format():
+    # same boxes expressed center-form must give identical IoU
+    a_corner = onp.array([[0.0, 0.0, 2.0, 2.0]], "f")
+    a_center = onp.array([[1.0, 1.0, 2.0, 2.0]], "f")
+    i1 = nd.contrib.box_iou(_nd(a_corner), _nd(a_corner)).asnumpy()
+    i2 = nd.contrib.box_iou(_nd(a_center), _nd(a_center),
+                            format="center").asnumpy()
+    assert i1 == pytest.approx(i2)
+
+
+def test_bipartite_matching():
+    d = _nd([[0.9, 0.1], [0.8, 0.7], [0.2, 0.3]])
+    rows, cols = nd.contrib.bipartite_matching(d)
+    rows, cols = rows.asnumpy(), cols.asnumpy()
+    # greedy: (0,0)=0.9 first, then (1,1)=0.7
+    assert rows.tolist() == [0.0, 1.0, -1.0]
+    assert cols.tolist() == [0.0, 1.0]
+    # threshold prunes the weaker pair
+    rows2, _ = nd.contrib.bipartite_matching(d, threshold=0.8)
+    assert rows2.asnumpy().tolist() == [0.0, -1.0, -1.0]
+
+
+def test_box_nms_suppression_and_order():
+    # three boxes: A and B overlap heavily (B weaker), C is separate
+    rows = _nd([[0.0, 0.9, 0.0, 0.0, 1.0, 1.0],     # id, score, x1 y1 x2 y2
+                [0.0, 0.8, 0.05, 0.05, 1.05, 1.05],
+                [0.0, 0.7, 5.0, 5.0, 6.0, 6.0]])
+    out = nd.contrib.box_nms(rows, overlap_thresh=0.5).asnumpy()
+    # survivor rows sorted by score; suppressed row is all -1
+    assert out[0, 1] == pytest.approx(0.9)
+    assert out[1, 1] == pytest.approx(0.7)
+    assert (out[2] == -1).all()
+    # looser threshold keeps all three
+    out2 = nd.contrib.box_nms(rows, overlap_thresh=0.99).asnumpy()
+    assert (out2[:, 1] > 0).all()
+
+
+def test_box_nms_class_aware_vs_force():
+    # same overlap, different class ids: survives unless force_suppress
+    rows = _nd([[0.0, 0.9, 0.0, 0.0, 1.0, 1.0],
+                [1.0, 0.8, 0.0, 0.0, 1.0, 1.0]])
+    keep = nd.contrib.box_nms(rows, overlap_thresh=0.5, id_index=0).asnumpy()
+    assert (keep[:, 1] > 0).all()
+    sup = nd.contrib.box_nms(rows, overlap_thresh=0.5, id_index=0,
+                             force_suppress=True).asnumpy()
+    assert (sup[1] == -1).all()
+
+
+def test_box_nms_batch_and_topk():
+    rs = onp.random.RandomState(3)
+    batch = rs.rand(2, 8, 6).astype("f")
+    batch[:, :, 0] = 0
+    out = nd.contrib.box_nms(_nd(batch), overlap_thresh=0.9, topk=3)
+    assert out.shape == (2, 8, 6)
+    # topk=3 leaves at most 3 survivors per batch row
+    surv = (out.asnumpy()[:, :, 1] >= 0).sum(axis=1)
+    assert (surv <= 3).all()
+
+
+def test_multibox_prior_golden():
+    feat = nd.zeros((1, 3, 2, 2))
+    anchors = nd.contrib.MultiBoxPrior(feat, sizes=(0.5,), ratios=(1.0,))
+    a = anchors.asnumpy()
+    assert a.shape == (1, 4, 4)
+    # first cell center (0.25, 0.25), half-extent 0.25
+    assert a[0, 0] == pytest.approx([0.0, 0.0, 0.5, 0.5], abs=1e-6)
+    # last cell center (0.75, 0.75)
+    assert a[0, 3] == pytest.approx([0.5, 0.5, 1.0, 1.0], abs=1e-6)
+    # sizes+ratios count: len(sizes)+len(ratios)-1 anchors per cell
+    anchors2 = nd.contrib.MultiBoxPrior(feat, sizes=(0.5, 0.25),
+                                        ratios=(1.0, 2.0))
+    assert anchors2.shape == (1, 2 * 2 * 3, 4)
+    # ratio-2 anchor is wider than tall
+    a2 = anchors2.asnumpy().reshape(2, 2, 3, 4)
+    w = a2[0, 0, 2, 2] - a2[0, 0, 2, 0]
+    h = a2[0, 0, 2, 3] - a2[0, 0, 2, 1]
+    assert w > h
+    # clip clamps into [0, 1]
+    clipped = nd.contrib.MultiBoxPrior(feat, sizes=(1.5,), clip=True).asnumpy()
+    assert clipped.min() >= 0.0 and clipped.max() <= 1.0
+
+
+def test_multibox_target_matching():
+    # 4 anchors, 1 GT that exactly matches anchor 0
+    anchors = _nd([[[0.0, 0.0, 0.5, 0.5],
+                    [0.5, 0.5, 1.0, 1.0],
+                    [0.0, 0.5, 0.5, 1.0],
+                    [0.48, 0.48, 0.98, 0.98]]])
+    label = _nd([[[2.0, 0.0, 0.0, 0.5, 0.5]]])  # class 2 at anchor-0's box
+    cls_pred = nd.zeros((1, 4, 4))  # (B, num_cls+1, N)
+    loc_t, loc_m, cls_t = nd.contrib.MultiBoxTarget(anchors, label, cls_pred)
+    cls_t = cls_t.asnumpy()[0]
+    assert cls_t[0] == 3.0          # class id + 1
+    assert cls_t[1] == 0.0 and cls_t[2] == 0.0
+    lm = loc_m.asnumpy()[0].reshape(4, 4)
+    assert (lm[0] == 1).all() and (lm[1] == 0).all()
+    # matched anchor with exact fit has ~zero encoded offset
+    lt = loc_t.asnumpy()[0].reshape(4, 4)
+    assert onp.abs(lt[0]).max() < 1e-4
+    # padded (-1) GT rows are ignored
+    label2 = _nd([[[2.0, 0.0, 0.0, 0.5, 0.5],
+                   [-1.0, 0.0, 0.0, 0.0, 0.0]]])
+    _, _, cls_t2 = nd.contrib.MultiBoxTarget(anchors, label2, cls_pred)
+    assert (cls_t2.asnumpy() == cls_t).all()
+
+
+def test_multibox_target_negative_mining():
+    anchors = _nd([[[0.0, 0.0, 0.5, 0.5],
+                    [0.5, 0.5, 1.0, 1.0],
+                    [0.0, 0.5, 0.5, 1.0],
+                    [0.5, 0.0, 1.0, 0.5]]])
+    label = _nd([[[0.0, 0.0, 0.0, 0.5, 0.5]]])
+    # cls_pred: background row then 1 fg class; anchor 1 is the
+    # "hardest" negative (largest fg-bg margin)
+    cls_pred = _nd([[[0.0, 0.0, 0.0, 0.0],
+                     [0.0, 5.0, 1.0, 0.5]]])
+    _, _, cls_t = nd.contrib.MultiBoxTarget(
+        anchors, label, cls_pred, negative_mining_ratio=1.0,
+        negative_mining_thresh=0.3)
+    c = cls_t.asnumpy()[0]
+    assert c[0] == 1.0              # the positive
+    assert c[1] == 0.0              # hardest negative kept as background
+    assert c[2] == -1.0 and c[3] == -1.0  # mined out -> ignore_label
+
+
+def test_multibox_detection_roundtrip():
+    """Encode GT offsets with MultiBoxTarget, decode with
+    MultiBoxDetection: the recovered box must equal the GT box."""
+    anchors = _nd([[[0.1, 0.1, 0.4, 0.4],
+                    [0.6, 0.6, 0.9, 0.9]]])
+    gt = onp.array([[0.15, 0.12, 0.45, 0.40]], "f")
+    label = _nd([[[1.0, 0.15, 0.12, 0.45, 0.40]]])
+    cls_pred = nd.zeros((1, 3, 2))
+    loc_t, loc_m, cls_t = nd.contrib.MultiBoxTarget(anchors, label, cls_pred)
+    assert cls_t.asnumpy()[0, 0] == 2.0
+    # build cls_prob selecting class 2 (fg id 1) on anchor 0
+    cls_prob = _nd([[[0.05, 0.90],   # background
+                     [0.05, 0.05],   # class 0
+                     [0.90, 0.05]]])  # class 1  (shape B, C+1, N)
+    det = nd.contrib.MultiBoxDetection(cls_prob, loc_t, anchors,
+                                       threshold=0.5).asnumpy()[0]
+    # one detection: class id 1, score 0.9, box == GT
+    assert det[0, 0] == pytest.approx(1.0)
+    assert det[0, 1] == pytest.approx(0.9, abs=1e-6)
+    assert det[0, 2:6] == pytest.approx(gt[0], abs=1e-3)
+    assert (det[1] == -1).all()
+
+
+def test_multibox_detection_threshold_and_nms():
+    anchors = _nd([[[0.1, 0.1, 0.5, 0.5],
+                    [0.12, 0.12, 0.52, 0.52],
+                    [0.6, 0.6, 0.9, 0.9]]])
+    # all three anchors predict the same class; two overlap
+    cls_prob = _nd([[[0.1, 0.2, 0.95],
+                     [0.9, 0.8, 0.05]]])  # (B, 2, 3): bg + 1 class
+    loc_pred = nd.zeros((1, 12))
+    det = nd.contrib.MultiBoxDetection(cls_prob, loc_pred, anchors,
+                                       threshold=0.5,
+                                       nms_threshold=0.5).asnumpy()[0]
+    kept = det[det[:, 0] >= 0]
+    assert kept.shape[0] == 1      # overlapping weaker box suppressed,
+    assert kept[0, 1] == pytest.approx(0.9, abs=1e-6)  # anchor-3 below thresh
+
+
+def test_detection_ops_jit_and_npx():
+    """The family jits whole (static shapes) and rides npx."""
+    import jax
+    feat = mx.np.zeros((1, 3, 4, 4))
+    pri = mx.npx.multibox_prior(feat, sizes=(0.4,), ratios=(1.0, 2.0))
+    assert type(pri).__name__ == "ndarray" and pri.shape == (1, 32, 4)
+    rows = mx.np.array(onp.random.RandomState(0).rand(8, 6).astype("f"))
+    out = mx.npx.box_nms(rows, overlap_thresh=0.7)
+    assert out.shape == (8, 6)
+
+    from mxnet_tpu.ops.detection import box_nms as _nms_fn
+    jitted = jax.jit(lambda d: _nms_fn(d, overlap_thresh=0.7))
+    o2 = jitted(rows._data)
+    assert (onp.asarray(o2) == out.asnumpy()).all()
+
+
+def test_detection_symbol_path():
+    d = mx.sym.Variable("feat")
+    pri = mx.sym.contrib.MultiBoxPrior(d, sizes=(0.5,), ratios=(1.0,))
+    e = pri.bind(mx.current_context(), {"feat": nd.zeros((1, 2, 2, 2))})
+    out = e.forward()[0]
+    assert out.shape == (1, 4, 4)
+
+
+def test_detection_review_regressions():
+    """Review findings: (y, x) steps convention, NaN-safe bipartite
+    matching under is_ascend, topk scoped to valid rows, nms_topk
+    applied before suppression."""
+    # steps/offsets are (y, x): explicit auto-equivalent steps on a
+    # non-square map must reproduce the auto anchors
+    feat = nd.zeros((1, 1, 2, 4))  # H=2, W=4
+    auto = nd.contrib.MultiBoxPrior(feat, sizes=(0.1,)).asnumpy()
+    manual = nd.contrib.MultiBoxPrior(feat, sizes=(0.1,),
+                                      steps=(0.5, 0.25)).asnumpy()
+    assert (auto == manual).all()
+    # NaN never matches under is_ascend
+    d = _nd([[onp.nan, 0.5], [0.2, 0.3]])
+    rows, _ = nd.contrib.bipartite_matching(d, is_ascend=True)
+    r = rows.asnumpy().tolist()
+    assert r[1] == 0.0 and r[0] in (1.0,)  # (1,0)=0.2 first, then (0,1)
+    # box_nms topk ranks only valid rows: high-score background rows
+    # must not consume topk slots
+    rows6 = _nd([[1.0, 0.99, 0.0, 0.0, 1.0, 1.0],     # background id 1
+                 [0.0, 0.5, 3.0, 3.0, 4.0, 4.0],
+                 [0.0, 0.4, 6.0, 6.0, 7.0, 7.0]])
+    out = nd.contrib.box_nms(rows6, overlap_thresh=0.5, topk=2, id_index=0,
+                             background_id=1).asnumpy()
+    assert (out[:, 1] >= 0.4 - 1e-6).sum() == 2  # both real boxes kept
+    # nms_topk prunes BEFORE suppression: a discarded candidate cannot
+    # suppress a kept one
+    anchors = _nd([[[0.1, 0.1, 0.5, 0.5],
+                    [0.12, 0.12, 0.52, 0.52]]])
+    cls_prob = _nd([[[0.1, 0.2], [0.9, 0.8]]])
+    det = nd.contrib.MultiBoxDetection(cls_prob, nd.zeros((1, 8)), anchors,
+                                       threshold=0.5, nms_threshold=0.5,
+                                       nms_topk=1).asnumpy()[0]
+    kept = det[det[:, 0] >= 0]
+    assert kept.shape[0] == 1 and kept[0, 1] == pytest.approx(0.9, abs=1e-6)
+
+
+def test_np_hstack_scalars():
+    out = mx.np.hstack((1, 2))
+    assert out.asnumpy().tolist() == [1, 2]
+    cs = mx.np.column_stack((1.0, 2.0))
+    assert cs.shape == (1, 2)
