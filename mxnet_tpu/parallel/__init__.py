@@ -22,6 +22,7 @@ from .collectives import (
 )
 from .spmd import (
     shard_params, replicate, make_data_parallel_step, make_sharded_train_step,
+    zero1_spec, make_zero1_train_step,
 )
 from .ring_attention import (
     ring_attention, ulysses_attention,

@@ -19,7 +19,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["shard_params", "replicate", "make_data_parallel_step",
-           "make_sharded_train_step"]
+           "make_sharded_train_step", "zero1_spec", "make_zero1_train_step"]
 
 
 def replicate(tree, mesh: Mesh):
@@ -128,11 +128,17 @@ def make_data_parallel_step(loss_fn: Callable, optimizer_update: Callable,
 def make_sharded_train_step(loss_fn: Callable, optimizer_update: Callable,
                             mesh: Mesh,
                             param_spec_fn: Optional[Callable] = None,
-                            batch_spec=None,
+                            batch_spec=None, opt_spec_fn=None,
                             donate: bool = True, chain: int = 1):
     """Fully general SPMD train step: parameters sharded per
-    `param_spec_fn(path, aval) -> PartitionSpec` (tp/ep/zero-style),
-    batch sharded per `batch_spec` (dp/sp). XLA inserts all collectives.
+    `param_spec_fn(path, aval) -> PartitionSpec` (tp/ep-style),
+    batch sharded per `batch_spec` (dp/sp), optimizer state sharded per
+    `opt_spec_fn` (ZeRO-style — see :func:`zero1_spec`). XLA inserts
+    all collectives: with a ZeRO opt spec the partitioner turns the
+    gradient all-reduce into reduce-scatter (each dp shard updates its
+    slice of the moments) + all-gather of the updated params — the
+    ZeRO-1 dataflow, derived from sharding annotations rather than
+    hand-written like the reference's DCASGD/ps-lite update paths.
     ``chain > 1`` runs that many real steps per dispatch over a leading
     stacked-micro-batch axis (see make_data_parallel_step).
     """
@@ -150,7 +156,8 @@ def make_sharded_train_step(loss_fn: Callable, optimizer_update: Callable,
             lambda s: NamedSharding(mesh, s), spec,
             is_leaf=lambda s: isinstance(s, P))
         p_sh = to_sharding(pspec)
-        o_sh = jax.tree_util.tree_map(lambda _: NamedSharding(mesh, P()), opt_state)
+        ofn = opt_spec_fn or (lambda path, aval: P())
+        o_sh = to_sharding(spec_of(opt_state, ofn))
         bs = batch_spec if batch_spec is not None else P()
         if chain > 1:
             # leading axis is the chain (scan) axis — never sharded;
@@ -163,3 +170,39 @@ def make_sharded_train_step(loss_fn: Callable, optimizer_update: Callable,
                        donate_argnums=(0, 1) if donate else ())
 
     return compile_for
+
+
+def zero1_spec(mesh: Mesh, axis: str = "dp"):
+    """Spec function sharding each optimizer-state leaf over ``axis``
+    (ZeRO stage 1: each data-parallel rank owns 1/N of the moments /
+    master weights). Picks the first dimension divisible by the axis
+    size; leaves with no divisible dim stay replicated (tiny biases —
+    not worth a collective). Use as ``opt_spec_fn`` (and as
+    ``param_spec_fn`` too for a ZeRO-3-style fully sharded step)."""
+    n = mesh.shape[axis]
+
+    def spec(path, leaf):
+        shape = getattr(leaf, "shape", ())
+        for i, d in enumerate(shape):
+            if d >= n and d % n == 0:
+                return P(*([None] * i + [axis]))
+        return P()
+
+    return spec
+
+
+def make_zero1_train_step(loss_fn: Callable, optimizer_update: Callable,
+                          mesh: Mesh, data_axis: str = "dp",
+                          donate: bool = True, chain: int = 1):
+    """DP training with ZeRO-1 optimizer-state sharding: params
+    replicated, batch sharded over ``data_axis``, optimizer state
+    sharded over ``data_axis`` via :func:`zero1_spec`. Memory per chip
+    for optimizer state drops ~Nx (the win that matters for Adam-class
+    optimizers where moments are 2x the weights); numerics are
+    bit-identical to the replicated step."""
+    return make_sharded_train_step(
+        loss_fn, optimizer_update, mesh,
+        param_spec_fn=None,
+        batch_spec=P(data_axis),
+        opt_spec_fn=zero1_spec(mesh, data_axis),
+        donate=donate, chain=chain)
