@@ -24,6 +24,7 @@ from . import op_impl_rnn  # noqa: F401
 from . import op_impl_quant  # noqa: F401
 from .. import operator as _operator  # noqa: F401  (registers Custom)
 from ..ops import detection as _detection  # noqa: F401  (SSD op family)
+from ..ops import vision_contrib as _vision_contrib  # noqa: F401
 
 # generate mx.nd.<op> functions into this module
 _GENERATED = _register.populate_namespace(__name__)

@@ -145,3 +145,41 @@ def bipartite_matching(dist, is_ascend=False, threshold=None, topk=-1):
     return _invoke(_get_op("_contrib_bipartite_matching"), [dist],
                    {"is_ascend": is_ascend, "threshold": threshold,
                     "topk": topk})
+
+
+# -- contrib vision tail (ops/vision_contrib.py) ----------------------
+def ROIAlign(data, rois, pooled_size=(7, 7), spatial_scale=1.0,
+             sample_ratio=-1, position_sensitive=False, aligned=False):
+    return _invoke(_get_op("_contrib_ROIAlign"), [data, rois],
+                   {"pooled_size": pooled_size,
+                    "spatial_scale": spatial_scale,
+                    "sample_ratio": sample_ratio,
+                    "position_sensitive": position_sensitive,
+                    "aligned": aligned})
+
+
+def BilinearResize2D(data, height=0, width=0, scale_height=None,
+                     scale_width=None, mode="size"):
+    return _invoke(_get_op("_contrib_BilinearResize2D"), [data],
+                   {"height": height, "width": width,
+                    "scale_height": scale_height,
+                    "scale_width": scale_width, "mode": mode})
+
+
+def AdaptiveAvgPooling2D(data, output_size=(1, 1)):
+    return _invoke(_get_op("_contrib_AdaptiveAvgPooling2D"), [data],
+                   {"output_size": output_size})
+
+
+def box_decode(data, anchors, std0=0.1, std1=0.1, std2=0.2, std3=0.2,
+               clip=-1.0, format="corner"):
+    return _invoke(_get_op("_contrib_box_decode"), [data, anchors],
+                   {"std0": std0, "std1": std1, "std2": std2, "std3": std3,
+                    "clip": clip, "format": format})
+
+
+def box_encode(samples, matches, anchors, refs,
+               means=(0.0, 0.0, 0.0, 0.0), stds=(0.1, 0.1, 0.2, 0.2)):
+    return _invoke(_get_op("_contrib_box_encode"),
+                   [samples, matches, anchors, refs],
+                   {"means": means, "stds": stds})

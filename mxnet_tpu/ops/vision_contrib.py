@@ -1,0 +1,212 @@
+"""Contrib vision operators: ROIAlign, BilinearResize2D,
+AdaptiveAvgPooling2D, box_encode/box_decode.
+
+TPU-native analogs of the reference's ``src/operator/contrib/
+roi_align.{cc,cu}``, ``bilinear_resize.{cc,cu}``,
+``adaptive_avg_pooling.{cc,cu}`` and ``bounding_box.cc``
+(box_encode/box_decode) — the op tail the detection/segmentation model
+families (Faster/Mask R-CNN, FCN) sit on. Each is a fixed-shape jax
+computation (membership-mask reductions and gather-based bilinear
+sampling instead of per-ROI dynamic loops) so everything jits, vmaps
+and differentiates through XLA.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ndarray.register import register_op
+
+__all__ = []
+
+
+def _bilinear_gather(img, ys, xs, zero_outside=False):
+    """Bilinearly sample img (C, H, W) at float coords ys/xs (...,).
+    ``zero_outside`` applies the reference ROIAlign boundary rule
+    (roi_align.cc: samples with y < -1 or y > H contribute 0; in-band
+    coords clamp to the edge pixels); without it coords just clamp
+    (BilinearResize, whose grid is always in-range)."""
+    c, h, w = img.shape
+    if zero_outside:
+        inside = ((ys >= -1.0) & (ys <= h) & (xs >= -1.0) & (xs <= w))
+        ys = jnp.clip(ys, 0.0, h - 1)
+        xs = jnp.clip(xs, 0.0, w - 1)
+    y0 = jnp.floor(ys)
+    x0 = jnp.floor(xs)
+    wy1 = ys - y0
+    wx1 = xs - x0
+    wy0 = 1.0 - wy1
+    wx0 = 1.0 - wx1
+
+    def at(y, x):
+        yi = jnp.clip(y, 0, h - 1).astype(jnp.int32)
+        xi = jnp.clip(x, 0, w - 1).astype(jnp.int32)
+        return img[:, yi, xi]  # (C, ...)
+
+    out = (at(y0, x0) * (wy0 * wx0) + at(y0, x0 + 1) * (wy0 * wx1)
+           + at(y0 + 1, x0) * (wy1 * wx0) + at(y0 + 1, x0 + 1) * (wy1 * wx1))
+    if zero_outside:
+        out = out * inside
+    return out
+
+
+@register_op("_contrib_ROIAlign", aliases=("ROIAlign",))
+def roi_align(data, rois, pooled_size=(7, 7), spatial_scale=1.0,
+              sample_ratio=-1, position_sensitive=False, aligned=False):
+    """ROI align (reference src/operator/contrib/roi_align.cc): average
+    of bilinear samples per bin — no coordinate quantization, fully
+    differentiable through the sampling weights.
+
+    ``sample_ratio <= 0`` means adaptive in the reference (ceil of the
+    bin extent); static XLA shapes need a fixed grid, so it resolves to
+    2 samples per bin axis (the detectron default). rois are
+    ``[batch_idx, x1, y1, x2, y2]`` rows in image coordinates."""
+    if position_sensitive:
+        raise NotImplementedError(
+            "ROIAlign(position_sensitive=True) (R-FCN PS-pooling: "
+            "C/(ph*pw) channel groups) is not implemented — plain "
+            "ROIAlign semantics would silently mis-train such a model")
+    ph, pw = (int(p) for p in pooled_size)
+    s = 2 if sample_ratio is None or int(sample_ratio) <= 0 \
+        else int(sample_ratio)
+    off = 0.5 if aligned else 0.0
+    b = rois[:, 0].astype(jnp.int32)
+    x1 = rois[:, 1] * spatial_scale - off
+    y1 = rois[:, 2] * spatial_scale - off
+    x2 = rois[:, 3] * spatial_scale - off
+    y2 = rois[:, 4] * spatial_scale - off
+    rw = jnp.maximum(x2 - x1, 1.0 if not aligned else 1e-6)
+    rh = jnp.maximum(y2 - y1, 1.0 if not aligned else 1e-6)
+
+    # per-roi sample coordinates: (ph*s,) x (pw*s,)
+    iy = (jnp.arange(ph * s) + 0.5) / s  # bin-fraction positions
+    ix = (jnp.arange(pw * s) + 0.5) / s
+
+    def one(img, yy1, xx1, hh, ww):
+        ys = yy1 + iy * hh / ph
+        xs = xx1 + ix * ww / pw
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")  # (ph*s, pw*s)
+        samp = _bilinear_gather(img.astype(jnp.float32), gy, gx,
+                                zero_outside=True)
+        c = samp.shape[0]
+        samp = samp.reshape(c, ph, s, pw, s)
+        return samp.mean(axis=(2, 4))  # (C, ph, pw)
+
+    out = jax.vmap(one)(data.astype(jnp.float32)[b], y1, x1, rh, rw)
+    return out.astype(data.dtype)
+
+
+@register_op("_contrib_BilinearResize2D", aliases=("BilinearResize2D",))
+def bilinear_resize_2d(data, height=0, width=0, scale_height=None,
+                       scale_width=None, mode="size"):
+    """Bilinear resize of (N, C, H, W) (reference
+    src/operator/contrib/bilinear_resize.cc — align-corners sampling:
+    src = dst * (in-1)/(out-1), the cuDNN/caffe convention the
+    reference uses, which differs from jax.image's half-pixel rule)."""
+    n, c, h, w = data.shape
+    if mode != "size":
+        # odd_scale/like/to_even_* change the output-size computation;
+        # running "size" math for them would be silently wrong shapes
+        raise NotImplementedError(
+            f"BilinearResize2D mode={mode!r}: only 'size' is implemented")
+    # mode='size': explicit height/width win; scales are the fallback
+    # when no explicit size is given (reference ignores scales when a
+    # size is set)
+    if int(height) <= 0 and scale_height is not None:
+        height = int(round(h * float(scale_height)))
+    if int(width) <= 0 and scale_width is not None:
+        width = int(round(w * float(scale_width)))
+    oh, ow = int(height), int(width)
+    if oh <= 0 or ow <= 0:
+        raise ValueError("BilinearResize2D needs height/width or scales")
+    ys = jnp.arange(oh, dtype=jnp.float32) * \
+        ((h - 1) / (oh - 1) if oh > 1 else 0.0)
+    xs = jnp.arange(ow, dtype=jnp.float32) * \
+        ((w - 1) / (ow - 1) if ow > 1 else 0.0)
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    out = jax.vmap(lambda img: _bilinear_gather(img.astype(jnp.float32),
+                                                gy, gx))(data)
+    return out.astype(data.dtype)
+
+
+@register_op("_contrib_AdaptiveAvgPooling2D",
+             aliases=("AdaptiveAvgPooling2D",))
+def adaptive_avg_pooling_2d(data, output_size=(1, 1)):
+    """Adaptive average pooling (reference
+    src/operator/contrib/adaptive_avg_pooling.cc): bin i covers
+    [floor(i*H/oh), ceil((i+1)*H/oh)). Membership-mask matmuls give the
+    whole op as two small contractions — one fused XLA program, exact
+    gradients for free."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = (int(o) for o in output_size)
+    n, c, h, w = data.shape
+
+    def masks(nbins, size):
+        i = jnp.arange(nbins, dtype=jnp.float32)[:, None]
+        s = jnp.arange(size, dtype=jnp.float32)[None, :]
+        lo = jnp.floor(i * size / nbins)
+        hi = jnp.ceil((i + 1) * size / nbins)
+        m = ((s >= lo) & (s < hi)).astype(jnp.float32)
+        return m / jnp.maximum(m.sum(axis=1, keepdims=True), 1.0)
+
+    mh = masks(oh, h)  # (oh, H), row-normalized
+    mw = masks(ow, w)  # (ow, W)
+    x = data.astype(jnp.float32)
+    out = jnp.einsum("ph,nchw,qw->ncpq", mh, x, mw)
+    return out.astype(data.dtype)
+
+
+@register_op("_contrib_box_decode", aliases=("box_decode",))
+def box_decode(data, anchors, std0=0.1, std1=0.1, std2=0.2, std3=0.2,
+               clip=-1.0, format="corner"):  # noqa: A002
+    """Decode center-form offset predictions against anchors
+    (reference bounding_box.cc BoxDecode; gluoncv NormalizedBoxCenterDecoder).
+    data (B, N, 4) offsets; anchors (1, N, 4) in ``format``; returns
+    corner boxes (B, N, 4)."""
+    from .detection import _corner_to_center
+    if format == "corner":
+        ax, ay, aw, ah = _corner_to_center(anchors)
+    else:
+        ax, ay, aw, ah = (anchors[..., i] for i in range(4))
+    cx = data[..., 0] * std0 * aw + ax
+    cy = data[..., 1] * std1 * ah + ay
+    tw = jnp.exp(data[..., 2] * std2)
+    th = jnp.exp(data[..., 3] * std3)
+    if clip is not None and clip > 0:
+        tw = jnp.minimum(tw, clip)
+        th = jnp.minimum(th, clip)
+    w = tw * aw * 0.5
+    h = th * ah * 0.5
+    return jnp.stack([cx - w, cy - h, cx + w, cy + h], -1)
+
+
+@register_op("_contrib_box_encode", aliases=("box_encode",),
+             differentiable=False, num_visible_outputs=2)
+def box_encode(samples, matches, anchors, refs,
+               means=(0.0, 0.0, 0.0, 0.0), stds=(0.1, 0.1, 0.2, 0.2)):
+    """Encode matched ground-truth boxes into regression targets
+    (reference bounding_box.cc BoxEncode). samples (B, N) with 1 for
+    positive anchors; matches (B, N) GT indices; anchors (B, N, 4) and
+    refs (B, M, 4) corner boxes. Returns (targets (B, N, 4),
+    masks (B, N, 4)) — masks zero out non-positive anchors."""
+    means = jnp.asarray(means, jnp.float32)
+    stds = jnp.asarray(stds, jnp.float32)
+
+    from .detection import _corner_to_center
+
+    def one(smp, mat, anc, ref):
+        g = ref[jnp.maximum(mat, 0).astype(jnp.int32)]  # (N, 4)
+        ax, ay, aw, ah = _corner_to_center(anc)
+        gx, gy, gw, gh = _corner_to_center(g)
+        eps = 1e-8
+        t = jnp.stack([
+            (gx - ax) / jnp.maximum(aw, eps),
+            (gy - ay) / jnp.maximum(ah, eps),
+            jnp.log(jnp.maximum(gw, eps) / jnp.maximum(aw, eps)),
+            jnp.log(jnp.maximum(gh, eps) / jnp.maximum(ah, eps))], -1)
+        t = (t - means) / stds
+        m = (smp > 0.5).astype(jnp.float32)[:, None] * jnp.ones((1, 4))
+        return t * m, m
+
+    return jax.vmap(one)(samples, matches, anchors, refs)

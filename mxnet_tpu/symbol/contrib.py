@@ -24,3 +24,9 @@ MultiBoxDetection = _contrib_sym("_contrib_MultiBoxDetection")
 box_nms = _contrib_sym("_contrib_box_nms")
 box_iou = _contrib_sym("_contrib_box_iou")
 bipartite_matching = _contrib_sym("_contrib_bipartite_matching")
+
+ROIAlign = _contrib_sym("_contrib_ROIAlign")
+BilinearResize2D = _contrib_sym("_contrib_BilinearResize2D")
+AdaptiveAvgPooling2D = _contrib_sym("_contrib_AdaptiveAvgPooling2D")
+box_decode = _contrib_sym("_contrib_box_decode")
+box_encode = _contrib_sym("_contrib_box_encode")

@@ -12,7 +12,7 @@ import pytest
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 EXAMPLES = ["mnist_gluon.py", "mnist_module.py", "train_imagenet.py",
             "word_lm.py", "wide_deep.py", "rnn_bucketing.py",
-            "custom_op.py", "sparse_linear.py"]
+            "custom_op.py", "sparse_linear.py", "ssd_detection.py"]
 
 
 @pytest.mark.parametrize("script", EXAMPLES)
@@ -100,3 +100,10 @@ def test_sparse_linear_quick_runs():
     """LibSVMIter → CSR → row_sparse kvstore training end-to-end
     (VERDICT r3 #4 'done' criterion)."""
     _run_quick("sparse_linear.py", "final train accuracy")
+
+
+@pytest.mark.timeout(400)
+def test_ssd_detection_quick_runs():
+    """The SSD toy detector EXECUTES --quick: MultiBoxPrior/Target/
+    Detection in a real train+eval loop."""
+    _run_quick("ssd_detection.py", "mean_top1_iou")

@@ -1,0 +1,171 @@
+"""Contrib vision ops: ROIAlign, BilinearResize2D, AdaptiveAvgPooling2D,
+box_encode/box_decode.
+
+Goldens come from torch (CPU) where torch implements the same
+semantics — torchvision isn't available, so ROIAlign is checked
+against hand rules + gradient flow, while adaptive pooling and
+align-corners bilinear resize check against torch.nn.functional
+exactly. Reference: src/operator/contrib/{roi_align,bilinear_resize,
+adaptive_avg_pooling}.cc + bounding_box.cc.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+torch = pytest.importorskip("torch")
+
+RS = onp.random.RandomState(11)
+
+
+def _nd(x, dtype="float32"):
+    return nd.array(onp.asarray(x, dtype))
+
+
+def test_adaptive_avg_pooling_vs_torch():
+    x = RS.randn(2, 3, 7, 9).astype("f")
+    for out_size in [(1, 1), (2, 3), (7, 9), (3, 3)]:
+        got = nd.contrib.AdaptiveAvgPooling2D(_nd(x), output_size=out_size)
+        want = torch.nn.functional.adaptive_avg_pool2d(
+            torch.from_numpy(x), out_size).numpy()
+        assert_almost_equal(got.asnumpy(), want, rtol=1e-5, atol=1e-5)
+
+
+def test_adaptive_avg_pooling_grad():
+    x = _nd(RS.randn(1, 2, 6, 6).astype("f"))
+    x.attach_grad()
+    with mx.autograd.record():
+        y = nd.contrib.AdaptiveAvgPooling2D(x, output_size=(2, 2)).sum()
+    y.backward()
+    # each input cell participates in exactly one 3x3 bin -> grad 1/9
+    assert_almost_equal(x.grad.asnumpy(),
+                        onp.full((1, 2, 6, 6), 1.0 / 9.0, "f"),
+                        rtol=1e-6, atol=1e-6)
+
+
+def test_bilinear_resize_vs_torch():
+    x = RS.randn(2, 3, 5, 7).astype("f")
+    for oh, ow in [(10, 14), (3, 4), (5, 7), (1, 1)]:
+        got = nd.contrib.BilinearResize2D(_nd(x), height=oh, width=ow)
+        want = torch.nn.functional.interpolate(
+            torch.from_numpy(x), size=(oh, ow), mode="bilinear",
+            align_corners=True).numpy()
+        assert_almost_equal(got.asnumpy(), want, rtol=1e-5, atol=1e-5)
+    # scale mode
+    got = nd.contrib.BilinearResize2D(_nd(x), scale_height=2.0,
+                                      scale_width=2.0)
+    assert got.shape == (2, 3, 10, 14)
+
+
+def test_roi_align_basic():
+    # constant image: any roi pools to the constant
+    x = onp.full((1, 1, 8, 8), 3.5, "f")
+    rois = _nd([[0.0, 1.0, 1.0, 6.0, 6.0]])
+    out = nd.contrib.ROIAlign(_nd(x), rois, pooled_size=(2, 2),
+                              spatial_scale=1.0)
+    assert out.shape == (1, 1, 2, 2)
+    assert_almost_equal(out.asnumpy(), onp.full((1, 1, 2, 2), 3.5, "f"),
+                        rtol=1e-6, atol=1e-6)
+    # linear ramp in x: bin centers reproduce the ramp values
+    ramp = onp.tile(onp.arange(8, dtype="f")[None, None, None, :],
+                    (1, 1, 8, 1))
+    rois2 = _nd([[0.0, 0.0, 0.0, 4.0, 4.0]])
+    out2 = nd.contrib.ROIAlign(_nd(ramp), rois2, pooled_size=(2, 2),
+                               spatial_scale=1.0).asnumpy()
+    # roi [0,4]x[0,4], 2x2 bins, sample mean per bin = bin center x
+    assert_almost_equal(out2[0, 0], onp.array([[1.0, 3.0], [1.0, 3.0]], "f"),
+                        rtol=1e-5, atol=1e-5)
+    # batch routing: roi with batch_idx 1 reads image 1
+    two = onp.stack([onp.zeros((1, 4, 4), "f"), onp.ones((1, 4, 4), "f")])
+    r3 = _nd([[1.0, 0.0, 0.0, 3.0, 3.0]])
+    o3 = nd.contrib.ROIAlign(_nd(two), r3, pooled_size=(1, 1))
+    assert o3.asnumpy()[0, 0, 0, 0] == pytest.approx(1.0)
+
+
+def test_roi_align_gradient_flows():
+    x = _nd(RS.randn(1, 2, 6, 6).astype("f"))
+    rois = _nd([[0.0, 0.5, 0.5, 4.5, 4.5]])
+    x.attach_grad()
+    with mx.autograd.record():
+        y = nd.contrib.ROIAlign(x, rois, pooled_size=(2, 2)).sum()
+    y.backward()
+    g = x.grad.asnumpy()
+    assert onp.isfinite(g).all() and onp.abs(g).sum() > 0
+    # gradient mass is conserved: sum of grads == number of output cells
+    assert g.sum() == pytest.approx(2 * 2 * 2, rel=1e-4)
+
+
+def test_box_decode_encode_roundtrip():
+    anchors = onp.array([[[0.1, 0.1, 0.4, 0.5],
+                          [0.5, 0.4, 0.9, 0.8]]], "f")
+    gt = onp.array([[[0.12, 0.15, 0.45, 0.52],
+                     [0.48, 0.38, 0.88, 0.82]]], "f")
+    samples = _nd([[1.0, 1.0]])
+    matches = _nd([[0, 1]], "int32")
+    targets, masks = nd.contrib.box_encode(
+        samples, matches, _nd(anchors), _nd(gt))
+    assert (masks.asnumpy() == 1).all()
+    # decode the encoded targets back: must reproduce the GT boxes
+    dec = nd.contrib.box_decode(targets, _nd(anchors),
+                                std0=0.1, std1=0.1, std2=0.2, std3=0.2)
+    assert_almost_equal(dec.asnumpy(), gt, rtol=1e-4, atol=1e-5)
+    # non-positive samples mask out
+    t2, m2 = nd.contrib.box_encode(_nd([[0.0, 1.0]]), matches,
+                                   _nd(anchors), _nd(gt))
+    assert (m2.asnumpy()[0, 0] == 0).all()
+    assert (t2.asnumpy()[0, 0] == 0).all()
+
+
+def test_box_decode_center_format_and_clip():
+    anchors_center = onp.array([[[0.25, 0.3, 0.3, 0.4]]], "f")
+    data = onp.zeros((1, 1, 4), "f")
+    dec = nd.contrib.box_decode(_nd(data), _nd(anchors_center),
+                                format="center").asnumpy()
+    assert_almost_equal(dec[0, 0],
+                        onp.array([0.1, 0.1, 0.4, 0.5], "f"),
+                        rtol=1e-5, atol=1e-6)
+    # clip bounds the exp() scale
+    wide = onp.array([[[0.0, 0.0, 99.0, 99.0]]], "f")
+    dec2 = nd.contrib.box_decode(_nd(wide), _nd(anchors_center),
+                                 format="center", clip=2.0).asnumpy()
+    w = dec2[0, 0, 2] - dec2[0, 0, 0]
+    assert w <= 0.3 * 2.0 + 1e-5
+
+
+def test_vision_contrib_jit_whole():
+    import jax
+    from mxnet_tpu.ops.vision_contrib import (adaptive_avg_pooling_2d,
+                                              bilinear_resize_2d)
+    x = RS.randn(1, 2, 5, 5).astype("f")
+    f = jax.jit(lambda a: adaptive_avg_pooling_2d(a, output_size=(2, 2)))
+    g = jax.jit(lambda a: bilinear_resize_2d(a, height=9, width=9))
+    assert f(x).shape == (1, 2, 2, 2)
+    assert g(x).shape == (1, 2, 9, 9)
+
+
+def test_vision_contrib_review_regressions():
+    """Review findings: PS-ROI raises, resize mode guard + size
+    precedence, ROIAlign zero-outside boundary rule."""
+    x = _nd(RS.randn(1, 4, 6, 6).astype("f"))
+    rois = _nd([[0.0, 0.0, 0.0, 3.0, 3.0]])
+    with pytest.raises(NotImplementedError):
+        nd.contrib.ROIAlign(x, rois, pooled_size=(2, 2),
+                            position_sensitive=True)
+    with pytest.raises(NotImplementedError):
+        nd.contrib.BilinearResize2D(x, height=3, width=3, mode="odd_scale")
+    # explicit size wins over scales (reference ignores scales with size)
+    out = nd.contrib.BilinearResize2D(x, height=3, width=3,
+                                      scale_height=2.0, scale_width=2.0)
+    assert out.shape == (1, 4, 3, 3)
+    # samples far outside the image contribute ZERO (not edge values):
+    # an roi fully beyond the border pools to 0 on a constant image
+    const = _nd(onp.full((1, 1, 4, 4), 5.0, "f"))
+    far = _nd([[0.0, 10.0, 10.0, 14.0, 14.0]])
+    out2 = nd.contrib.ROIAlign(const, far, pooled_size=(1, 1))
+    assert out2.asnumpy()[0, 0, 0, 0] == pytest.approx(0.0, abs=1e-6)
+    # while an roi hugging the border (within the 1-px band) still reads
+    near = _nd([[0.0, -0.5, -0.5, 2.0, 2.0]])
+    out3 = nd.contrib.ROIAlign(const, near, pooled_size=(1, 1))
+    assert out3.asnumpy()[0, 0, 0, 0] == pytest.approx(5.0, abs=1e-6)
