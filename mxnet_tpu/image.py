@@ -548,3 +548,131 @@ def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,
 __all__ += ["DetAugmenter", "DetBorrowAug", "DetHorizontalFlipAug",
             "DetRandomCropAug", "DetRandomPadAug", "DetRandomSelectAug",
             "CreateDetAugmenter"]
+
+
+class ImageDetIter(ImageIter):
+    """Detection data iterator (reference python/mxnet/image/detection.py
+    ImageDetIter): images + variable-count box labels, batched with the
+    label tensor padded to ``label_shape`` with -1 rows — exactly the
+    (B, M, 5) format ``contrib.MultiBoxTarget`` consumes.
+
+    Per-sample labels accept either the already-2D (N, obj_width) form
+    or the im2rec flat detection packing ``[A, B, <A-2 extra header>,
+    obj0 ... objN]`` where A is the header width and B the object width
+    (reference ImageDetIter._parse_label).
+    """
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 path_imglist=None, path_root=None, path_imgidx=None,
+                 shuffle=False, part_index=0, num_parts=1, aug_list=None,
+                 imglist=None, data_name="data", label_name="label",
+                 label_shape=None, ctx=None, **kwargs):
+        if aug_list is None:
+            aug_list = CreateDetAugmenter(data_shape, **{
+                k: v for k, v in kwargs.items()
+                if k in ("resize", "rand_crop", "rand_pad", "rand_mirror",
+                         "mean", "std", "min_object_covered",
+                         "max_pad_scale", "inter_method")})
+        # detection augmenters run as (img, label) pairs in next();
+        # pass an EMPTY pixel chain to the base iterator
+        super().__init__(batch_size, data_shape, label_width=1,
+                         path_imgrec=path_imgrec, path_imglist=path_imglist,
+                         path_root=path_root, path_imgidx=path_imgidx,
+                         shuffle=shuffle, part_index=part_index,
+                         num_parts=num_parts, aug_list=[], imglist=imglist,
+                         data_name=data_name, label_name=label_name,
+                         ctx=ctx)
+        self.det_auglist = aug_list
+        if label_shape is None:
+            label_shape = self._estimate_label_shape()
+        self.label_shape = tuple(label_shape)
+
+    @staticmethod
+    def _parse_label(label):
+        """Flat im2rec det packing or 2-D array -> (N, obj_width)."""
+        arr = np.asarray(label, np.float32)
+        if arr.ndim == 2:
+            return arr
+        raw = arr.ravel()
+        if raw.size < 2:
+            raise ValueError("invalid detection label (needs header)")
+        a, b = int(raw[0]), int(raw[1])
+        if b <= 0:
+            raise ValueError(
+                f"detection label: header object width {b} must be positive")
+        if a < 2 or a >= raw.size:
+            raise ValueError(
+                f"detection label: header width {a} out of range for a "
+                f"label of {raw.size} values")
+        objs = raw[a:]
+        n = objs.size // b
+        if n * b != objs.size:
+            raise ValueError(
+                f"detection label: {objs.size} values not divisible by "
+                f"object width {b}")
+        return objs[: n * b].reshape(n, b)
+
+    def _estimate_label_shape(self):
+        """Scan ALL samples for (max_objects, obj_width) — including
+        the RecordIO path, where labels only surface through
+        next_sample (reference _estimate_label_shape does the same
+        full pass, then resets)."""
+        max_n, width = 0, 5
+        self.reset()
+        try:
+            while True:
+                label, _ = self.next_sample()
+                parsed = self._parse_label(label)
+                max_n = max(max_n, parsed.shape[0])
+                width = max(width, parsed.shape[1])
+        except StopIteration:
+            pass
+        self.reset()
+        return (max(max_n, 1), width)
+
+    @property
+    def provide_label(self):
+        return [DataDesc(self.label_name,
+                         (self.batch_size,) + self.label_shape, "float32")]
+
+    def sync_label_shape(self, it, verbose=False):
+        """Synchronize label padding with another ImageDetIter (train /
+        val pairs must agree — reference sync_label_shape)."""
+        shape = (max(self.label_shape[0], it.label_shape[0]),
+                 max(self.label_shape[1], it.label_shape[1]))
+        self.label_shape = shape
+        it.label_shape = shape
+        return it
+
+    def next(self):
+        bs = self.batch_size
+        m, w = self.label_shape
+        batch_data = np.zeros((bs,) + self.data_shape, dtype=self.dtype)
+        batch_label = -np.ones((bs, m, w), np.float32)
+        i = 0
+        pad = 0
+        try:
+            while i < bs:
+                label, raw = self.next_sample()
+                img = np.asarray(imdecode(raw)).astype(np.float32)
+                parsed = self._parse_label(label)
+                for aug in self.det_auglist:
+                    img, parsed = aug(img, parsed)
+                if parsed.shape[1] > w:
+                    raise ValueError(
+                        f"ImageDetIter: sample object width "
+                        f"{parsed.shape[1]} exceeds label_shape width {w} "
+                        f"— pass label_shape=(M, {parsed.shape[1]})")
+                n = min(parsed.shape[0], m)
+                batch_data[i] = np.transpose(img, (2, 0, 1))
+                batch_label[i, :n, :parsed.shape[1]] = parsed[:n]
+                i += 1
+        except StopIteration:
+            if i == 0:
+                raise
+            pad = bs - i
+        return DataBatch(data=[nd_array(batch_data, ctx=self.ctx)],
+                        label=[nd_array(batch_label, ctx=self.ctx)], pad=pad)
+
+
+__all__ += ["ImageDetIter"]

@@ -167,8 +167,12 @@ def box_decode(data, anchors, std0=0.1, std1=0.1, std2=0.2, std3=0.2,
     from .detection import _corner_to_center
     if format == "corner":
         ax, ay, aw, ah = _corner_to_center(anchors)
-    else:
+    elif format == "center":
         ax, ay, aw, ah = (anchors[..., i] for i in range(4))
+    else:
+        raise ValueError(
+            f"box_decode: format must be 'corner' or 'center', "
+            f"got {format!r}")
     cx = data[..., 0] * std0 * aw + ax
     cy = data[..., 1] * std1 * ah + ay
     tw = jnp.exp(data[..., 2] * std2)

@@ -1,5 +1,6 @@
 """Detection augmenter tests (python/mxnet/image/detection.py scope)."""
 import numpy as np
+import pytest
 
 import mxnet_tpu as mx
 from mxnet_tpu import image
@@ -56,3 +57,120 @@ def test_create_det_augmenter_chain():
         out, lab = aug(out, lab)
     assert out.shape == (32, 32, 3)
     assert out.dtype == np.float32
+
+
+def test_image_det_iter(tmp_path):
+    """ImageDetIter end-to-end: flat im2rec-style labels parse, batches
+    pad with -1 rows, and the output feeds MultiBoxTarget directly."""
+    from PIL import Image
+
+    rs = np.random.RandomState(5)
+    labels = []
+    for i in range(5):
+        arr = rs.randint(0, 255, (32 + i, 40, 3)).astype(np.uint8)
+        Image.fromarray(arr).save(tmp_path / f"img{i}.jpg")
+        n_obj = 1 + i % 3
+        objs = []
+        for j in range(n_obj):
+            objs += [float(j % 4), 0.1, 0.1, 0.6, 0.7]
+        # flat packing: header [A=2, B=5] then the objects
+        labels.append((np.array([2.0, 5.0] + objs, np.float32),
+                       f"img{i}.jpg"))
+
+    it = image.ImageDetIter(batch_size=2, data_shape=(3, 24, 24),
+                            imglist=labels, path_root=str(tmp_path))
+    assert it.label_shape == (3, 5)  # max 3 objects seen, width 5
+    batch = next(iter([it.next()]))
+    data, label = batch.data[0], batch.label[0]
+    assert data.shape == (2, 3, 24, 24)
+    assert label.shape == (2, 3, 5)
+    lab = label.asnumpy()
+    # first sample has 1 object -> rows 1,2 are -1 padding
+    assert (lab[0, 1:] == -1).all()
+    assert lab[0, 0, 0] == 0.0  # class id
+    assert np.allclose(lab[0, 0, 1:], [0.1, 0.1, 0.6, 0.7], atol=1e-6)
+    # the batch feeds MultiBoxTarget directly (B, M, 5 with -1 pads)
+    anchors = mx.nd.contrib.MultiBoxPrior(mx.nd.zeros((1, 3, 4, 4)),
+                                          sizes=(0.5,))
+    cls_pred = mx.nd.zeros((2, 2, anchors.shape[1]))
+    _, _, cls_t = mx.nd.contrib.MultiBoxTarget(anchors, label, cls_pred)
+    assert cls_t.shape == (2, anchors.shape[1])
+    # 2-D label form parses too; provide_label advertises the pad shape
+    parsed = image.ImageDetIter._parse_label(
+        np.array([[1.0, 0, 0, 1, 1]], np.float32))
+    assert parsed.shape == (1, 5)
+    assert it.provide_label[0].shape == (2, 3, 5)
+
+
+def test_image_det_iter_sync_label_shape(tmp_path):
+    from PIL import Image
+
+    rs = np.random.RandomState(6)
+    def mk(n_imgs, n_obj):
+        ll = []
+        for i in range(n_imgs):
+            p = f"s{n_obj}_{i}.jpg"
+            Image.fromarray(rs.randint(0, 255, (20, 20, 3)).astype(np.uint8)
+                            ).save(tmp_path / p)
+            ll.append((np.array([2.0, 5.0] + [0.0, 0.1, 0.1, 0.5, 0.5] * n_obj,
+                                np.float32), p))
+        return image.ImageDetIter(batch_size=1, data_shape=(3, 16, 16),
+                                  imglist=ll, path_root=str(tmp_path))
+
+    train, val = mk(2, 4), mk(2, 2)
+    assert train.label_shape == (4, 5) and val.label_shape == (2, 5)
+    train.sync_label_shape(val)
+    assert train.label_shape == val.label_shape == (4, 5)
+
+
+def test_image_det_iter_recordio_label_shape(tmp_path):
+    """RecordIO-backed ImageDetIter scans the record stream for
+    label_shape (review regression: imglist stays empty on that path)."""
+    from PIL import Image
+    import io as _io
+
+    rec_path, idx_path = str(tmp_path / "d.rec"), str(tmp_path / "d.idx")
+    rec = mx.recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    rs = np.random.RandomState(7)
+    for i in range(4):
+        n_obj = 1 + i  # up to 4 objects
+        label = np.array([2.0, 5.0] + [0.0, 0.1, 0.1, 0.5, 0.5] * n_obj,
+                         np.float32)
+        buf = _io.BytesIO()
+        Image.fromarray(rs.randint(0, 255, (16, 16, 3)).astype(np.uint8)
+                        ).save(buf, format="JPEG")
+        header = mx.recordio.IRHeader(0, label, i, 0)
+        rec.write_idx(i, mx.recordio.pack(header, buf.getvalue()))
+    rec.close()
+
+    it = image.ImageDetIter(batch_size=2, data_shape=(3, 16, 16),
+                            path_imgrec=rec_path, path_imgidx=idx_path)
+    assert it.label_shape == (4, 5)
+    batch = it.next()
+    assert batch.label[0].shape == (2, 4, 5)
+    lab = batch.label[0].asnumpy()
+    assert (lab[0, 1:] == -1).all()  # 1-object sample padded
+
+
+def test_image_det_iter_validation_errors(tmp_path):
+    from PIL import Image
+
+    with pytest.raises(ValueError):
+        image.ImageDetIter._parse_label(
+            np.array([2.0, 0.0, 1.0], np.float32))  # width 0
+    with pytest.raises(ValueError):
+        image.ImageDetIter._parse_label(
+            np.array([10.0, 5.0, 1.0], np.float32))  # header beyond label
+    # explicit label_shape narrower than the data raises a NAMED error
+    Image.fromarray(np.zeros((16, 16, 3), np.uint8)).save(tmp_path / "a.jpg")
+    ll = [(np.array([2.0, 6.0, 0.0, 0.1, 0.1, 0.5, 0.5, 1.0], np.float32),
+           "a.jpg")]
+    it = image.ImageDetIter(batch_size=1, data_shape=(3, 16, 16),
+                            imglist=ll, path_root=str(tmp_path),
+                            label_shape=(2, 5))
+    with pytest.raises(ValueError, match="object width"):
+        it.next()
+    # box_decode format typo raises instead of decoding garbage
+    with pytest.raises(ValueError, match="format"):
+        mx.nd.contrib.box_decode(mx.nd.zeros((1, 1, 4)),
+                                 mx.nd.zeros((1, 1, 4)), format="Corner")
