@@ -600,7 +600,9 @@ class ImageDetIter(ImageIter):
         if b <= 0:
             raise ValueError(
                 f"detection label: header object width {b} must be positive")
-        if a < 2 or a >= raw.size:
+        if a < 2 or a > raw.size:
+            # a == raw.size is a legal header-only label: a negative
+            # sample with zero objects -> (0, b)
             raise ValueError(
                 f"detection label: header width {a} out of range for a "
                 f"label of {raw.size} values")
@@ -663,7 +665,13 @@ class ImageDetIter(ImageIter):
                         f"ImageDetIter: sample object width "
                         f"{parsed.shape[1]} exceeds label_shape width {w} "
                         f"— pass label_shape=(M, {parsed.shape[1]})")
-                n = min(parsed.shape[0], m)
+                if parsed.shape[0] > m:
+                    raise ValueError(
+                        f"ImageDetIter: sample has {parsed.shape[0]} "
+                        f"objects but label_shape holds {m} — silently "
+                        f"dropping ground truth would corrupt training; "
+                        f"pass label_shape=({parsed.shape[0]}, {w})")
+                n = parsed.shape[0]
                 batch_data[i] = np.transpose(img, (2, 0, 1))
                 batch_label[i, :n, :parsed.shape[1]] = parsed[:n]
                 i += 1

@@ -183,3 +183,23 @@ def box_encode(samples, matches, anchors, refs,
     return _invoke(_get_op("_contrib_box_encode"),
                    [samples, matches, anchors, refs],
                    {"means": means, "stds": stds})
+
+
+def DeformableConvolution(data, offset, weight, bias=None, kernel=(3, 3),
+                          stride=(1, 1), dilate=(1, 1), pad=(0, 0),
+                          num_filter=0, num_group=1, num_deformable_group=1,
+                          no_bias=False):
+    return _invoke(_get_op("_contrib_DeformableConvolution"),
+                   [data, offset, weight, bias],
+                   {"kernel": kernel, "stride": stride, "dilate": dilate,
+                    "pad": pad, "num_filter": num_filter,
+                    "num_group": num_group,
+                    "num_deformable_group": num_deformable_group,
+                    "no_bias": no_bias})
+
+
+def PSROIPooling(data, rois, spatial_scale=1.0, output_dim=0,
+                 pooled_size=7, group_size=0):
+    return _invoke(_get_op("_contrib_PSROIPooling"), [data, rois],
+                   {"spatial_scale": spatial_scale, "output_dim": output_dim,
+                    "pooled_size": pooled_size, "group_size": group_size})

@@ -20,14 +20,23 @@ from ..ndarray.register import register_op
 __all__ = []
 
 
-def _bilinear_gather(img, ys, xs, zero_outside=False):
+def _bilinear_gather(img, ys, xs, zero_outside=False, boundary=None):
     """Bilinearly sample img (C, H, W) at float coords ys/xs (...,).
-    ``zero_outside`` applies the reference ROIAlign boundary rule
-    (roi_align.cc: samples with y < -1 or y > H contribute 0; in-band
-    coords clamp to the edge pixels); without it coords just clamp
-    (BilinearResize, whose grid is always in-range)."""
+
+    boundary modes (the two references disagree at the border band):
+    - "clamp" (default): coords clamp to the edge — BilinearResize,
+      whose grid is always in-range anyway.
+    - "zero_band" (or zero_outside=True): roi_align.cc rule — samples
+      with y < -1 or y > H contribute 0, in-band coords clamp to the
+      edge pixels at full weight.
+    - "fade": deformable_im2col rule — each of the 4 corner taps
+      contributes only if it lies inside the image, so values fade
+      linearly to 0 across the border (conv zero-padding semantics).
+    """
+    if boundary is None:
+        boundary = "zero_band" if zero_outside else "clamp"
     c, h, w = img.shape
-    if zero_outside:
+    if boundary == "zero_band":
         inside = ((ys >= -1.0) & (ys <= h) & (xs >= -1.0) & (xs <= w))
         ys = jnp.clip(ys, 0.0, h - 1)
         xs = jnp.clip(xs, 0.0, w - 1)
@@ -41,11 +50,15 @@ def _bilinear_gather(img, ys, xs, zero_outside=False):
     def at(y, x):
         yi = jnp.clip(y, 0, h - 1).astype(jnp.int32)
         xi = jnp.clip(x, 0, w - 1).astype(jnp.int32)
-        return img[:, yi, xi]  # (C, ...)
+        v = img[:, yi, xi]  # (C, ...)
+        if boundary == "fade":
+            ok = ((y >= 0) & (y <= h - 1) & (x >= 0) & (x <= w - 1))
+            v = v * ok
+        return v
 
     out = (at(y0, x0) * (wy0 * wx0) + at(y0, x0 + 1) * (wy0 * wx1)
            + at(y0 + 1, x0) * (wy1 * wx0) + at(y0 + 1, x0 + 1) * (wy1 * wx1))
-    if zero_outside:
+    if boundary == "zero_band":
         out = out * inside
     return out
 
@@ -214,3 +227,126 @@ def box_encode(samples, matches, anchors, refs,
         return t * m, m
 
     return jax.vmap(one)(samples, matches, anchors, refs)
+
+
+@register_op("_contrib_DeformableConvolution",
+             aliases=("DeformableConvolution",))
+def deformable_convolution(data, offset, weight, bias=None, kernel=(3, 3),
+                           stride=(1, 1), dilate=(1, 1), pad=(0, 0),
+                           num_filter=0, num_group=1, num_deformable_group=1,
+                           no_bias=False):
+    """Deformable convolution v1 (reference
+    src/operator/contrib/deformable_convolution.cc): each kernel tap
+    samples the input at its regular grid position plus a learned
+    per-location 2D offset, bilinearly interpolated, then the taps
+    contract with the weights as an ordinary convolution.
+
+    TPU-first: deformable im2col is a gather per tap (K*K bilinear
+    sample maps, fully vectorized) followed by ONE einsum contraction
+    — the MXU does the heavy lifting; the reference's custom CUDA
+    im2col kernels become jax gathers. data (B, C, H, W); offset
+    (B, 2*KK*num_deformable_group, OH, OW) with channel order
+    [g0k0_y, g0k0_x, g0k1_y, ...]; weight (O, C/num_group, kh, kw).
+    Everything differentiates (data, offset AND weight) through XLA.
+    """
+    kh, kw = (int(k) for k in kernel)
+    sh, sw = (int(s) for s in stride)
+    dh, dw = (int(d) for d in dilate)
+    ph, pw = (int(p) for p in pad)
+    b, c, h, w = data.shape
+    o = int(num_filter) if num_filter else weight.shape[0]
+    kk = kh * kw
+    g = int(num_group)
+    dg = int(num_deformable_group)
+    if c % g or o % g:
+        raise ValueError("channels must divide num_group")
+    if c % dg:
+        raise ValueError("channels must divide num_deformable_group")
+    oh = (h + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    ow = (w + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    if offset.shape[1] != 2 * kk * dg:
+        raise ValueError(
+            f"offset needs {2 * kk * dg} channels, got {offset.shape[1]}")
+
+    base_y = jnp.arange(oh, dtype=jnp.float32) * sh - ph   # (OH,)
+    base_x = jnp.arange(ow, dtype=jnp.float32) * sw - pw   # (OW,)
+    cg = c // dg  # data channels per deformable group
+
+    def sample_one(img, off):
+        # img (C, H, W), off (2*KK*dg, OH, OW) -> cols (C, KK, OH, OW)
+        taps = []
+        for idx in range(kk):
+            i, j = idx // kw, idx % kw
+            groups = []
+            for gi in range(dg):
+                dy = off[(gi * kk + idx) * 2]       # (OH, OW)
+                dx = off[(gi * kk + idx) * 2 + 1]
+                ys = base_y[:, None] + i * dh + dy
+                xs = base_x[None, :] + j * dw + dx
+                part = _bilinear_gather(img[gi * cg:(gi + 1) * cg],
+                                        ys, xs, boundary="fade")
+                groups.append(part)                 # (cg, OH, OW)
+            taps.append(jnp.concatenate(groups, axis=0))
+        return jnp.stack(taps, axis=1)              # (C, KK, OH, OW)
+
+    cols = jax.vmap(sample_one)(data.astype(jnp.float32),
+                                offset.astype(jnp.float32))
+    wr = weight.astype(jnp.float32).reshape(g, o // g, c // g, kk)
+    colsg = cols.reshape(b, g, c // g, kk, oh, ow)
+    out = jnp.einsum("bgckyx,gock->bgoyx", colsg, wr)
+    out = out.reshape(b, o, oh, ow)
+    if bias is not None and not no_bias:
+        out = out + bias.astype(jnp.float32)[None, :, None, None]
+    return out.astype(data.dtype)
+
+
+@register_op("_contrib_PSROIPooling", aliases=("PSROIPooling",))
+def psroi_pooling(data, rois, spatial_scale=1.0, output_dim=0,
+                  pooled_size=7, group_size=0):
+    """Position-sensitive ROI pooling (reference
+    src/operator/contrib/psroi_pooling.cc, R-FCN): input channels are
+    laid out as (output_dim * group^2); bin (i, j) of the output
+    average-pools the spatial cells of channel group (i*group + j).
+    rois are ``[batch_idx, x1, y1, x2, y2]`` image-coordinate rows."""
+    k = int(pooled_size)
+    gs = int(group_size) if group_size else k
+    od = int(output_dim)
+    b, c, h, w = data.shape
+    if od * gs * gs != c:
+        raise ValueError(
+            f"PSROIPooling: channels {c} != output_dim*group^2 "
+            f"({od}*{gs}^2)")
+    bb = rois[:, 0].astype(jnp.int32)
+    x1 = jnp.round(rois[:, 1]) * spatial_scale
+    y1 = jnp.round(rois[:, 2]) * spatial_scale
+    x2 = jnp.round(rois[:, 3] + 1.0) * spatial_scale
+    y2 = jnp.round(rois[:, 4] + 1.0) * spatial_scale
+    rw = jnp.maximum(x2 - x1, 0.1)
+    rh = jnp.maximum(y2 - y1, 0.1)
+
+    def one(img, yy1, xx1, hh, ww):
+        # img (C, H, W) -> (od, k, k)
+        # bin membership masks over the roi's spatial extent
+        i = jnp.arange(k, dtype=jnp.float32)
+        s_y = jnp.arange(h, dtype=jnp.float32)[None, :]
+        s_x = jnp.arange(w, dtype=jnp.float32)[None, :]
+        lo_y = jnp.floor(yy1 + i[:, None] * hh / k)
+        hi_y = jnp.ceil(yy1 + (i[:, None] + 1) * hh / k)
+        lo_x = jnp.floor(xx1 + i[:, None] * ww / k)
+        hi_x = jnp.ceil(xx1 + (i[:, None] + 1) * ww / k)
+        my = ((s_y >= jnp.clip(lo_y, 0, h)) & (s_y < jnp.clip(hi_y, 0, h)))
+        mx_ = ((s_x >= jnp.clip(lo_x, 0, w)) & (s_x < jnp.clip(hi_x, 0, w)))
+        my = my.astype(jnp.float32)     # (k, H)
+        mx_ = mx_.astype(jnp.float32)   # (k, W)
+        # bin (i, j) pools channel group (floor(i*gs/k), floor(j*gs/k))
+        # — reference psroi_pooling.cc supports group_size != pooled_size
+        imgg = img.reshape(od, gs, gs, h, w)
+        gidx = (jnp.arange(k) * gs // k).astype(jnp.int32)
+        sel = imgg[:, gidx[:, None], gidx[None, :]]  # (od, k, k, h, w)
+        sums = jnp.einsum("ih,dijhw,jw->dij", my, sel, mx_)
+        area = jnp.einsum("ih,jw->ij", my, mx_)
+        out = sums / jnp.maximum(area, 1.0)[None]
+        return out
+
+    out = jax.vmap(one)(data.astype(jnp.float32)[bb], y1, x1, rh, rw)
+    return out.astype(data.dtype)

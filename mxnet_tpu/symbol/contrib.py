@@ -30,3 +30,5 @@ BilinearResize2D = _contrib_sym("_contrib_BilinearResize2D")
 AdaptiveAvgPooling2D = _contrib_sym("_contrib_AdaptiveAvgPooling2D")
 box_decode = _contrib_sym("_contrib_box_decode")
 box_encode = _contrib_sym("_contrib_box_encode")
+DeformableConvolution = _contrib_sym("_contrib_DeformableConvolution")
+PSROIPooling = _contrib_sym("_contrib_PSROIPooling")

@@ -174,3 +174,27 @@ def test_image_det_iter_validation_errors(tmp_path):
     with pytest.raises(ValueError, match="format"):
         mx.nd.contrib.box_decode(mx.nd.zeros((1, 1, 4)),
                                  mx.nd.zeros((1, 1, 4)), format="Corner")
+
+
+def test_image_det_iter_zero_object_and_overflow(tmp_path):
+    """Header-only labels (negative samples) parse to (0, B); object
+    count beyond an explicit label_shape raises a named error."""
+    from PIL import Image
+
+    parsed = image.ImageDetIter._parse_label(np.array([2.0, 5.0], np.float32))
+    assert parsed.shape == (0, 5)
+    Image.fromarray(np.zeros((16, 16, 3), np.uint8)).save(tmp_path / "z.jpg")
+    ll = [(np.array([2.0, 5.0] + [0.0, 0.1, 0.1, 0.5, 0.5] * 3, np.float32),
+           "z.jpg")]
+    it = image.ImageDetIter(batch_size=1, data_shape=(3, 16, 16),
+                            imglist=ll, path_root=str(tmp_path),
+                            label_shape=(2, 5))
+    with pytest.raises(ValueError, match="objects"):
+        it.next()
+    # a negative-only dataset constructs fine (label_shape floor of 1)
+    ll2 = [(np.array([2.0, 5.0], np.float32), "z.jpg")]
+    it2 = image.ImageDetIter(batch_size=1, data_shape=(3, 16, 16),
+                             imglist=ll2, path_root=str(tmp_path))
+    assert it2.label_shape == (1, 5)
+    lab = it2.next().label[0].asnumpy()
+    assert (lab == -1).all()

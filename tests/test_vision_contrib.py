@@ -169,3 +169,115 @@ def test_vision_contrib_review_regressions():
     near = _nd([[0.0, -0.5, -0.5, 2.0, 2.0]])
     out3 = nd.contrib.ROIAlign(const, near, pooled_size=(1, 1))
     assert out3.asnumpy()[0, 0, 0, 0] == pytest.approx(5.0, abs=1e-6)
+
+
+def test_deformable_conv_zero_offset_equals_conv():
+    """DCN with zero offsets IS the ordinary convolution (the defining
+    identity; reference deformable_convolution.cc)."""
+    x = RS.randn(2, 4, 9, 9).astype("f")
+    wgt = (RS.randn(6, 4, 3, 3) * 0.2).astype("f")
+    bias = RS.randn(6).astype("f")
+    for strides, padding, dil in [((1, 1), (1, 1), (1, 1)),
+                                  ((2, 2), (0, 0), (1, 1)),
+                                  ((1, 1), (2, 2), (2, 2))]:
+        ref = nd.Convolution(_nd(x), _nd(wgt), _nd(bias), kernel=(3, 3),
+                             num_filter=6, stride=strides, pad=padding,
+                             dilate=dil).asnumpy()
+        oh, ow = ref.shape[2], ref.shape[3]
+        off = _nd(onp.zeros((2, 18, oh, ow), "f"))
+        got = nd.contrib.DeformableConvolution(
+            _nd(x), off, _nd(wgt), _nd(bias), kernel=(3, 3), num_filter=6,
+            stride=strides, pad=padding, dilate=dil).asnumpy()
+        assert_almost_equal(got, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_deformable_conv_integer_shift():
+    """A constant integer offset (+1 in x on every tap) equals the
+    ordinary conv over the input shifted by one pixel (interior)."""
+    x = RS.randn(1, 2, 8, 8).astype("f")
+    wgt = (RS.randn(3, 2, 3, 3) * 0.3).astype("f")
+    # plain conv on x shifted left by 1 (so tap reads x+1 column)
+    xs = onp.zeros_like(x)
+    xs[..., :, :-1] = x[..., :, 1:]
+    ref = nd.Convolution(_nd(xs), _nd(wgt), None, kernel=(3, 3),
+                         num_filter=3, no_bias=True).asnumpy()
+    off = onp.zeros((1, 18, 6, 6), "f")
+    off[:, 1::2] = 1.0  # dx channels = +1
+    got = nd.contrib.DeformableConvolution(
+        _nd(x), _nd(off), _nd(wgt), None, kernel=(3, 3), num_filter=3,
+        no_bias=True).asnumpy()
+    # interior only: the shifted-input ref zero-pads at the right edge
+    assert_almost_equal(got[..., :, :-1], ref[..., :, :-1],
+                        rtol=1e-4, atol=1e-4)
+
+
+def test_deformable_conv_groups_and_grads():
+    x = _nd(RS.randn(1, 4, 6, 6).astype("f"))
+    wgt = _nd((RS.randn(4, 2, 3, 3) * 0.3).astype("f"))  # num_group=2
+    off = _nd(onp.zeros((1, 36, 6, 6), "f"))  # 2 deformable groups
+    x.attach_grad(), wgt.attach_grad(), off.attach_grad()
+    with mx.autograd.record():
+        y = nd.contrib.DeformableConvolution(
+            x, off, wgt, None, kernel=(3, 3), num_filter=4, pad=(1, 1),
+            num_group=2, num_deformable_group=2, no_bias=True)
+        loss = (y * y).sum()
+    loss.backward()
+    assert y.shape == (1, 4, 6, 6)
+    for t in (x, wgt, off):
+        g = t.grad.asnumpy()
+        assert onp.isfinite(g).all()
+    assert onp.abs(x.grad.asnumpy()).sum() > 0
+    assert onp.abs(wgt.grad.asnumpy()).sum() > 0
+    # offset grad exists (zero-offset is a smooth point of bilinear
+    # sampling; nonzero because neighboring pixels differ)
+    assert onp.abs(off.grad.asnumpy()).sum() > 0
+
+
+def test_psroi_pooling_bin_groups():
+    """Channel group (i, j) feeds ONLY output bin (i, j): constant
+    per-group planes recover the group index at each bin."""
+    k, od = 2, 3
+    c = od * k * k
+    data = onp.zeros((1, c, 8, 8), "f")
+    for d in range(od):
+        for gi in range(k * k):
+            data[0, d * k * k + gi] = d * 10 + gi
+    rois = _nd([[0.0, 0.0, 0.0, 7.0, 7.0]])
+    out = nd.contrib.PSROIPooling(_nd(data), rois, output_dim=od,
+                                  pooled_size=k).asnumpy()
+    assert out.shape == (1, od, k, k)
+    for d in range(od):
+        for i in range(k):
+            for j in range(k):
+                assert out[0, d, i, j] == pytest.approx(d * 10 + i * k + j)
+
+
+def test_deformable_conv_validation():
+    x = _nd(RS.randn(1, 4, 6, 6).astype("f"))
+    wgt = _nd(RS.randn(4, 4, 3, 3).astype("f"))
+    with pytest.raises(ValueError, match="offset"):
+        nd.contrib.DeformableConvolution(
+            x, _nd(onp.zeros((1, 6, 4, 4), "f")), wgt, None,
+            kernel=(3, 3), num_filter=4, no_bias=True)
+    with pytest.raises(ValueError, match="output_dim"):
+        nd.contrib.PSROIPooling(x, _nd([[0.0, 0, 0, 3, 3]]),
+                                output_dim=3, pooled_size=2)
+
+
+def test_psroi_pooling_group_size_differs():
+    """group_size != pooled_size: bin (i, j) pools channel group
+    (floor(i*gs/k), floor(j*gs/k)) — review regression."""
+    k, gs, od = 4, 2, 2
+    c = od * gs * gs
+    data = onp.zeros((1, c, 8, 8), "f")
+    for d in range(od):
+        for gi in range(gs * gs):
+            data[0, d * gs * gs + gi] = d * 10 + gi
+    rois = _nd([[0.0, 0.0, 0.0, 7.0, 7.0]])
+    out = nd.contrib.PSROIPooling(_nd(data), rois, output_dim=od,
+                                  pooled_size=k, group_size=gs).asnumpy()
+    assert out.shape == (1, od, k, k)
+    for i in range(k):
+        for j in range(k):
+            want = 0 * 10 + (i * gs // k) * gs + (j * gs // k)
+            assert out[0, 0, i, j] == pytest.approx(want)
