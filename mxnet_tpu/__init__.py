@@ -93,6 +93,32 @@ from . import numpy as np  # noqa: A001
 from . import numpy  # noqa: F401  (mx.numpy, as upstream also exposes)
 from . import numpy_extension as npx
 from . import numpy_extension  # noqa: F401
+
+# deep-numpy hybrid-forward convention: np-style blocks write
+# F.np.dot(...) / F.npx.relu(...) — install the namespaces on the nd
+# module handed to hybrid_forward (classic F.<op> names untouched).
+# The legacy Symbol graph path gets a proxy raising a CLEAR error:
+# np-style blocks are supported eager + hybridized (the compiled
+# path), not through mx.sym graph building.
+ndarray.np = np
+ndarray.npx = npx
+
+
+class _SymbolNpProxy:
+    def __init__(self, name):
+        self._name = name
+
+    def __getattr__(self, attr):
+        raise NotImplementedError(
+            f"F.{self._name}.{attr}: the deep-numpy namespaces are not "
+            f"available on the legacy Symbol path — np-style hybrid "
+            f"blocks run eagerly and hybridized (jit-compiled); use "
+            f"classic F.<op> names for Symbol graph building/export")
+
+
+symbol.np = _SymbolNpProxy("np")
+symbol.npx = _SymbolNpProxy("npx")
+del _SymbolNpProxy
 from . import visualization
 from . import visualization as viz
 

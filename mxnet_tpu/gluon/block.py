@@ -422,6 +422,10 @@ class HybridBlock(Block):
                 self._infer_param_shapes(x, *args)
                 params = {k: v.data(x.ctx) for k, v in self._reg_params.items()}
             from .. import ndarray as ndmod
+            # np-style hybrid blocks reach the numpy namespaces through
+            # F.np / F.npx (the deep-numpy convention; attributes are
+            # installed on the nd package by mxnet_tpu/__init__) while
+            # classic F.<op> names stay exactly as before
             return self.hybrid_forward(ndmod, x, *args, **params)
 
     def _remat_trace(self, x, *args):

@@ -20,21 +20,19 @@ from ..ndarray.register import register_op
 __all__ = []
 
 
-def _bilinear_gather(img, ys, xs, zero_outside=False, boundary=None):
+def _bilinear_gather(img, ys, xs, boundary="clamp"):
     """Bilinearly sample img (C, H, W) at float coords ys/xs (...,).
 
     boundary modes (the two references disagree at the border band):
     - "clamp" (default): coords clamp to the edge — BilinearResize,
       whose grid is always in-range anyway.
-    - "zero_band" (or zero_outside=True): roi_align.cc rule — samples
-      with y < -1 or y > H contribute 0, in-band coords clamp to the
-      edge pixels at full weight.
+    - "zero_band": roi_align.cc rule — samples with y < -1 or y > H
+      contribute 0, in-band coords clamp to the edge pixels at full
+      weight.
     - "fade": deformable_im2col rule — each of the 4 corner taps
       contributes only if it lies inside the image, so values fade
       linearly to 0 across the border (conv zero-padding semantics).
     """
-    if boundary is None:
-        boundary = "zero_band" if zero_outside else "clamp"
     c, h, w = img.shape
     if boundary == "zero_band":
         inside = ((ys >= -1.0) & (ys <= h) & (xs >= -1.0) & (xs <= w))
@@ -100,7 +98,7 @@ def roi_align(data, rois, pooled_size=(7, 7), spatial_scale=1.0,
         xs = xx1 + ix * ww / pw
         gy, gx = jnp.meshgrid(ys, xs, indexing="ij")  # (ph*s, pw*s)
         samp = _bilinear_gather(img.astype(jnp.float32), gy, gx,
-                                zero_outside=True)
+                                boundary="zero_band")
         c = samp.shape[0]
         samp = samp.reshape(c, ph, s, pw, s)
         return samp.mean(axis=(2, 4))  # (C, ph, pw)
@@ -317,10 +315,14 @@ def psroi_pooling(data, rois, spatial_scale=1.0, output_dim=0,
             f"PSROIPooling: channels {c} != output_dim*group^2 "
             f"({od}*{gs}^2)")
     bb = rois[:, 0].astype(jnp.int32)
-    x1 = jnp.round(rois[:, 1]) * spatial_scale
-    y1 = jnp.round(rois[:, 2]) * spatial_scale
-    x2 = jnp.round(rois[:, 3] + 1.0) * spatial_scale
-    y2 = jnp.round(rois[:, 4] + 1.0) * spatial_scale
+    # C round() semantics (half away from zero; coords are
+    # non-negative) — jnp.round is banker's rounding and disagrees at
+    # *.5 (reference psroi_pooling.cc uses round())
+    _round_c = lambda v: jnp.floor(v + 0.5)
+    x1 = _round_c(rois[:, 1]) * spatial_scale
+    y1 = _round_c(rois[:, 2]) * spatial_scale
+    x2 = _round_c(rois[:, 3] + 1.0) * spatial_scale
+    y2 = _round_c(rois[:, 4] + 1.0) * spatial_scale
     rw = jnp.maximum(x2 - x1, 0.1)
     rh = jnp.maximum(y2 - y1, 0.1)
 

@@ -623,3 +623,47 @@ def test_np_review_regressions():
         if prev is not None:
             prev |= seen
     assert "sort" in seen
+
+
+def test_np_style_hybrid_block():
+    """np-style HybridBlock: F.np / F.npx namespaces inside
+    hybrid_forward (the deep-numpy convention), working eagerly AND
+    hybridized."""
+    npx.set_np()
+    try:
+        class NpBlock(mx.gluon.nn.HybridBlock):
+            def __init__(self, **kw):
+                super().__init__(**kw)
+                with self.name_scope():
+                    self.w = self.params.get("w", shape=(4, 3))
+
+            def hybrid_forward(self, F, x, w):
+                h = F.np.dot(x, w.reshape(3, 4))
+                return F.npx.relu(h) - F.np.mean(h)
+
+        blk = NpBlock()
+        blk.initialize()
+        x = np.random.uniform(size=(2, 3))
+        y1 = blk(x)
+        assert type(y1).__name__ == "ndarray" and y1.shape == (2, 4)
+        blk.hybridize()
+        y2 = blk(x)
+        assert_almost_equal(y1.asnumpy(), y2.asnumpy(), rtol=1e-5, atol=1e-6)
+        # gradients flow through the np-style graph
+        x.attach_grad()
+        with mx.autograd.record():
+            out = blk(x).sum()
+        out.backward()
+        assert onp.abs(x.grad.asnumpy()).sum() > 0
+    finally:
+        npx.reset_np()
+
+
+def test_np_symbol_path_clear_error():
+    """F.np on the legacy Symbol path raises a NAMED error, not a
+    bare AttributeError (review regression)."""
+    import pytest as _pytest
+    with _pytest.raises(NotImplementedError, match="Symbol"):
+        mx.sym.np.dot
+    with _pytest.raises(NotImplementedError, match="Symbol"):
+        mx.sym.npx.relu

@@ -281,3 +281,21 @@ def test_psroi_pooling_group_size_differs():
         for j in range(k):
             want = 0 * 10 + (i * gs // k) * gs + (j * gs // k)
             assert out[0, 0, i, j] == pytest.approx(want)
+
+
+def test_psroi_rounding_half_away_from_zero():
+    """C round() at *.5 coordinates (review regression): roi x1=0.5
+    rounds to 1, not banker's 0."""
+    k, od = 1, 1
+    data = onp.zeros((1, 1, 4, 8), "f")
+    data[0, 0, :, 0] = 100.0  # column 0 is hot
+    # x1=0.5 -> rounds to 1: column 0 EXCLUDED from the pooled window
+    out = nd.contrib.PSROIPooling(_nd(data),
+                                  _nd([[0.0, 0.5, 0.0, 6.0, 3.0]]),
+                                  output_dim=od, pooled_size=k).asnumpy()
+    assert out[0, 0, 0, 0] == pytest.approx(0.0)
+    # x1=0.4 -> rounds to 0: column 0 included
+    out2 = nd.contrib.PSROIPooling(_nd(data),
+                                   _nd([[0.0, 0.4, 0.0, 6.0, 3.0]]),
+                                   output_dim=od, pooled_size=k).asnumpy()
+    assert out2[0, 0, 0, 0] > 0
