@@ -450,14 +450,20 @@ class NDArray:
 
     def __reduce__(self):
         # optimizer states & gluon params must pickle (kvstore server
-        # updater round-trip in the reference pickles them too)
+        # updater round-trip in the reference pickles them too);
+        # np ndarrays round-trip as np ndarrays
+        is_np = _NP_CLS is not None and isinstance(self, _NP_CLS)
         return (_unpickle, (self.asnumpy(), dtype_name(self.dtype),
-                            self._ctx.device_type, self._ctx.device_id))
+                            self._ctx.device_type, self._ctx.device_id,
+                            is_np))
 
 
-def _unpickle(npv, dtype, dev_type, dev_id):
+def _unpickle(npv, dtype, dev_type, dev_id, is_np=False):
     ctx = Context(dev_type, dev_id)
-    return array(npv, ctx=ctx, dtype=dtype)
+    out = array(npv, ctx=ctx, dtype=dtype)
+    if is_np and _NP_CLS is not None:
+        out = _NP_CLS(out._data, out._ctx)
+    return out
 
 
 def _binary_dunder(op_name, scalar_name=None, reverse=False):

@@ -667,3 +667,34 @@ def test_np_symbol_path_clear_error():
         mx.sym.np.dot
     with _pytest.raises(NotImplementedError, match="Symbol"):
         mx.sym.npx.relu
+
+
+def test_np_pickle_roundtrip():
+    import pickle
+    x = np.array(_X)
+    y = pickle.loads(pickle.dumps(x))
+    assert type(y).__name__ == "ndarray"
+    assert (y.asnumpy() == _X).all()
+    c = mx.nd.array(_X)
+    c2 = pickle.loads(pickle.dumps(c))
+    assert type(c2).__name__ == "NDArray"
+
+
+def test_np_mode_dataloader_and_metric():
+    """Under set_np the data pipeline emits np batches and metrics
+    consume them (upstream test_numpy_gluon.py integration shape)."""
+    npx.set_np()
+    try:
+        xs = onp.random.RandomState(0).rand(10, 3).astype("f")
+        ys = onp.arange(10).astype("f") % 2
+        ds = mx.gluon.data.ArrayDataset(xs, ys)
+        loader = mx.gluon.data.DataLoader(ds, batch_size=5)
+        for xb, yb in loader:
+            assert type(xb).__name__ == "ndarray"
+            assert type(yb).__name__ == "ndarray"
+        m = mx.metric.Accuracy()
+        pred = np.array(onp.eye(2)[ys.astype(int)])
+        m.update([mx.nd.array(ys)], [pred.as_nd_ndarray()])
+        assert m.get()[1] == 1.0
+    finally:
+        npx.reset_np()
