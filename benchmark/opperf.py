@@ -97,6 +97,21 @@ def _default_suite(large: bool) -> dict:
         "adam_update": lambda: ((_t((n, n)), _t((n, n)), _t((n, n)),
                                  _t((n, n), low=0.0, high=0.1)),
                                 {"lr": 1e-3}),
+        # detection / contrib-vision family
+        "_contrib_box_nms": lambda: ((_t((b, n // 4, 6), low=0.0, high=1.0),),
+                                     {"overlap_thresh": 0.5}),
+        "_contrib_ROIAlign": lambda: (
+            (_t(img), nd.concat(
+                _ti((b, 1), img[0]).astype("float32"),
+                _t((b, 4), low=0.0, high=float(img[3] - 1)), dim=1)),
+            {"pooled_size": (7, 7)}),
+        "_contrib_DeformableConvolution": lambda: (
+            (_t(img), _t((img[0], 18, img[2], img[3])),
+             _t((64, img[1], 3, 3)), _t((64,))),
+            {"kernel": (3, 3), "num_filter": 64, "pad": (1, 1)}),
+        # numpy-frontend contraction
+        "_npi_einsum": lambda: ((_t((b, n // 4, 64)), _t((b, n // 4, 64))),
+                                {"subscripts": "bik,bjk->bij"}),
     }
 
 
@@ -128,7 +143,15 @@ def run_performance_test(op_names, ctx=None, warmup=3, runs=25,
                 raise KeyError(f"no default config for op {name!r}; "
                                f"known: {sorted(suite)}")
             args, kwargs = suite[name]()
-            fn = getattr(mx.nd, name)
+            if hasattr(mx.nd, name):
+                fn = getattr(mx.nd, name)
+            else:
+                # ops registered after the nd-namespace codegen pass
+                # (_npi_* numpy internals) resolve through the registry
+                from mxnet_tpu.ndarray.register import get_op, invoke
+
+                def fn(*a, _op=get_op(name), **kw):
+                    return invoke(_op, list(a), kw)
             fargs = [a for a in args
                      if isinstance(a, mx.nd.NDArray)
                      and "float" in str(a.dtype)]
