@@ -22,7 +22,9 @@ __all__ = [
     "random_crop", "color_normalize", "HorizontalFlipAug", "CastAug",
     "ColorNormalizeAug", "ForceResizeAug", "ResizeAug", "CenterCropAug",
     "RandomCropAug", "CreateAugmenter", "Augmenter", "ImageIter",
-    "ImageRecordIterPy",
+    "ImageRecordIterPy", "BrightnessJitterAug", "ContrastJitterAug",
+    "SaturationJitterAug", "HueJitterAug", "LightingAug", "RandomGrayAug",
+    "RandomOrderAug", "ColorJitterAug",
 ]
 
 
@@ -186,11 +188,153 @@ class ColorNormalizeAug(Augmenter):
         return (np.asarray(src).astype(np.float32) - self.mean) / self.std
 
 
+_GRAY_COEF = np.array([0.299, 0.587, 0.114], np.float32)
+
+
+class BrightnessJitterAug(Augmenter):
+    """Scale pixel values by 1 + U(-brightness, brightness)
+    (reference BrightnessJitterAug)."""
+
+    def __init__(self, brightness):
+        super().__init__(brightness=brightness)
+        self.brightness = brightness
+
+    def __call__(self, src):
+        alpha = 1.0 + np.random.uniform(-self.brightness, self.brightness)
+        return np.asarray(src).astype(np.float32) * alpha
+
+
+class ContrastJitterAug(Augmenter):
+    """Blend with the mean gray level (reference ContrastJitterAug)."""
+
+    def __init__(self, contrast):
+        super().__init__(contrast=contrast)
+        self.contrast = contrast
+
+    def __call__(self, src):
+        alpha = 1.0 + np.random.uniform(-self.contrast, self.contrast)
+        img = np.asarray(src).astype(np.float32)
+        gray_mean = (img * _GRAY_COEF).sum(axis=-1).mean()
+        return img * alpha + gray_mean * (1.0 - alpha)
+
+
+class SaturationJitterAug(Augmenter):
+    """Blend with the per-pixel gray image (reference
+    SaturationJitterAug)."""
+
+    def __init__(self, saturation):
+        super().__init__(saturation=saturation)
+        self.saturation = saturation
+
+    def __call__(self, src):
+        alpha = 1.0 + np.random.uniform(-self.saturation, self.saturation)
+        img = np.asarray(src).astype(np.float32)
+        gray = (img * _GRAY_COEF).sum(axis=-1, keepdims=True)
+        return img * alpha + gray * (1.0 - alpha)
+
+
+class HueJitterAug(Augmenter):
+    """Rotate hue in YIQ space by U(-hue, hue) (reference HueJitterAug
+    — same tyiq/ityiq matrix approximation)."""
+
+    _TYIQ = np.array([[0.299, 0.587, 0.114],
+                      [0.596, -0.274, -0.321],
+                      [0.211, -0.523, 0.311]], np.float32)
+    _ITYIQ = np.array([[1.0, 0.956, 0.621],
+                       [1.0, -0.272, -0.647],
+                       [1.0, -1.107, 1.705]], np.float32)
+
+    def __init__(self, hue):
+        super().__init__(hue=hue)
+        self.hue = hue
+
+    def __call__(self, src):
+        alpha = np.random.uniform(-self.hue, self.hue)
+        u, w = np.cos(alpha * np.pi), np.sin(alpha * np.pi)
+        rot = np.array([[1.0, 0.0, 0.0],
+                        [0.0, u, -w],
+                        [0.0, w, u]], np.float32)
+        t = self._ITYIQ @ rot @ self._TYIQ
+        img = np.asarray(src).astype(np.float32)
+        return img @ t.T
+
+
+class LightingAug(Augmenter):
+    """AlexNet-style PCA lighting noise (reference LightingAug):
+    add eigvec @ (N(0, alphastd) * eigval) per image."""
+
+    _EIGVAL = np.array([55.46, 4.794, 1.148], np.float32)
+    _EIGVEC = np.array([[-0.5675, 0.7192, 0.4009],
+                        [-0.5808, -0.0045, -0.8140],
+                        [-0.5836, -0.6948, 0.4203]], np.float32)
+
+    def __init__(self, alphastd):
+        super().__init__(alphastd=alphastd)
+        self.alphastd = alphastd
+
+    def __call__(self, src):
+        alpha = np.random.normal(0, self.alphastd, size=(3,)).astype(np.float32)
+        rgb = self._EIGVEC @ (alpha * self._EIGVAL)
+        return np.asarray(src).astype(np.float32) + rgb
+
+
+class RandomGrayAug(Augmenter):
+    """With probability p replace the image by its 3-channel gray
+    version (reference RandomGrayAug)."""
+
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        img = np.asarray(src).astype(np.float32)
+        if np.random.rand() < self.p:
+            gray = (img * _GRAY_COEF).sum(axis=-1, keepdims=True)
+            img = np.repeat(gray, 3, axis=-1)
+        return img
+
+
+class RandomOrderAug(Augmenter):
+    """Apply child augmenters in random order (reference
+    RandomOrderAug — the ColorJitter composition uses it)."""
+
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = list(ts)
+
+    def __call__(self, src):
+        order = np.random.permutation(len(self.ts))
+        for i in order:
+            src = self.ts[i](src)
+        return src
+
+
+class ColorJitterAug(RandomOrderAug):
+    """Random-order brightness/contrast/saturation jitter (reference
+    ColorJitterAug — a RandomOrderAug subclass, so isinstance checks
+    ported from upstream keep working)."""
+
+    def __init__(self, brightness, contrast, saturation):
+        ts = []
+        if brightness > 0:
+            ts.append(BrightnessJitterAug(brightness))
+        if contrast > 0:
+            ts.append(ContrastJitterAug(contrast))
+        if saturation > 0:
+            ts.append(SaturationJitterAug(saturation))
+        super().__init__(ts)
+        self._kwargs = {"brightness": brightness, "contrast": contrast,
+                        "saturation": saturation}
+
+
 def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
                     rand_mirror=False, mean=None, std=None, brightness=0,
                     contrast=0, saturation=0, hue=0, pca_noise=0,
                     rand_gray=0, inter_method=2):
-    """Build the standard augmenter chain (python/mxnet/image CreateAugmenter)."""
+    """Build the standard augmenter chain (python/mxnet/image
+    CreateAugmenter), photometric jitters included in the reference's
+    order: geometric -> cast -> color jitter -> hue -> lighting ->
+    gray -> normalize."""
     auglist = []
     if resize > 0:
         auglist.append(ResizeAug(resize, inter_method))
@@ -202,6 +346,14 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
     if rand_mirror:
         auglist.append(HorizontalFlipAug(0.5))
     auglist.append(CastAug())
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if hue:
+        auglist.append(HueJitterAug(hue))
+    if pca_noise > 0:
+        auglist.append(LightingAug(pca_noise))
+    if rand_gray > 0:
+        auglist.append(RandomGrayAug(rand_gray))
     if mean is True:
         mean = np.array([123.68, 116.28, 103.53])
     if std is True:
@@ -257,7 +409,10 @@ class ImageIter(DataIter):
         self.auglist = aug_list if aug_list is not None else \
             CreateAugmenter((data_shape[0], data_shape[1], data_shape[2]), **{
                 k: v for k, v in kwargs.items()
-                if k in ("resize", "rand_crop", "rand_mirror", "mean", "std")})
+                if k in ("resize", "rand_crop", "rand_resize", "rand_mirror",
+                         "mean", "std", "brightness", "contrast",
+                         "saturation", "hue", "pca_noise", "rand_gray",
+                         "inter_method")})
         self.cur = 0
         self.reset()
 

@@ -198,3 +198,87 @@ def test_image_det_iter_zero_object_and_overflow(tmp_path):
     assert it2.label_shape == (1, 5)
     lab = it2.next().label[0].asnumpy()
     assert (lab == -1).all()
+
+
+def test_photometric_augmenters():
+    """Photometric jitter family (reference python/mxnet/image
+    Brightness/Contrast/Saturation/Hue/Lighting/RandomGray): exact
+    identity at zero jitter, invariants at nonzero."""
+    rs = np.random.RandomState(9)
+    img = rs.randint(0, 255, (8, 8, 3)).astype(np.float32)
+
+    np.random.seed(0)
+    out = image.BrightnessJitterAug(0.0)(img)
+    assert np.allclose(out, img)
+    out = image.BrightnessJitterAug(0.5)(img)
+    # pure scale: out == alpha * img for one global alpha
+    alpha = out.sum() / img.sum()
+    assert np.allclose(out, img * alpha, atol=1e-2)
+
+    out = image.ContrastJitterAug(0.0)(img)
+    assert np.allclose(out, img)
+    # contrast jitter preserves the mean gray level
+    outc = image.ContrastJitterAug(0.7)(img)
+    g = lambda a: (a * np.array([0.299, 0.587, 0.114])).sum(-1).mean()
+    assert abs(g(outc) - g(img)) < 1e-2
+
+    out = image.SaturationJitterAug(0.0)(img)
+    assert np.allclose(out, img)
+    # full desaturation direction keeps per-pixel gray constant
+    outs = image.SaturationJitterAug(0.5)(img)
+    gp = lambda a: (a * np.array([0.299, 0.587, 0.114])).sum(-1)
+    assert np.allclose(gp(outs), gp(img), atol=1e-2)
+
+    # the rounded YIQ matrices are only approximate inverses (same
+    # constants as the reference), so zero-hue identity is approximate
+    out = image.HueJitterAug(0.0)(img)
+    assert np.allclose(out, img, atol=1.0)
+    # hue rotation preserves luma (first YIQ row)
+    outh = image.HueJitterAug(0.4)(img)
+    assert np.allclose(gp(outh), gp(img), atol=0.5)
+
+    out = image.LightingAug(0.0)(img)
+    assert np.allclose(out, img)
+    outl = image.LightingAug(0.1)(img)
+    # per-image constant RGB shift
+    d = outl - img
+    assert np.allclose(d, d[0, 0], atol=1e-4)
+
+    gray = image.RandomGrayAug(1.0)(img)
+    assert np.allclose(gray[..., 0], gray[..., 1])
+    assert np.allclose(image.RandomGrayAug(0.0)(img), img)
+
+    # CreateAugmenter wires them in (kwargs no longer ignored)
+    chain = image.CreateAugmenter((3, 8, 8), brightness=0.1, contrast=0.1,
+                                  saturation=0.1, hue=0.1, pca_noise=0.05,
+                                  rand_gray=0.2)
+    names = [type(a).__name__ for a in chain]
+    assert "ColorJitterAug" in names and "HueJitterAug" in names
+    assert "LightingAug" in names and "RandomGrayAug" in names
+    out = img
+    for a in chain:
+        out = a(out)
+    assert out.shape == (8, 8, 3) and np.isfinite(out).all()
+
+
+def test_photometric_kwargs_reach_image_iter(tmp_path):
+    """Review regression: ImageIter forwards photometric kwargs into
+    its augmenter chain, and the new augmenters dumps()."""
+    from PIL import Image
+
+    Image.fromarray(np.zeros((16, 16, 3), np.uint8)).save(tmp_path / "p.jpg")
+    it = image.ImageIter(batch_size=1, data_shape=(3, 8, 8),
+                         imglist=[(0.0, "p.jpg")], path_root=str(tmp_path),
+                         brightness=0.3, hue=0.1, pca_noise=0.05,
+                         rand_gray=0.2)
+    names = [type(a).__name__ for a in it.auglist]
+    assert "ColorJitterAug" in names and "HueJitterAug" in names
+    assert "LightingAug" in names and "RandomGrayAug" in names
+    # serialization works on every augmenter in the chain
+    for a in it.auglist:
+        assert isinstance(a.dumps(), str)
+    # ColorJitterAug is a real class (isinstance-able), a RandomOrderAug
+    cj = image.ColorJitterAug(0.1, 0.1, 0.1)
+    assert isinstance(cj, image.ColorJitterAug)
+    assert isinstance(cj, image.RandomOrderAug)
+    assert len(cj.ts) == 3
