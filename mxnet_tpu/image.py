@@ -21,7 +21,7 @@ __all__ = [
     "imresize", "imdecode", "resize_short", "fixed_crop", "center_crop",
     "random_crop", "color_normalize", "HorizontalFlipAug", "CastAug",
     "ColorNormalizeAug", "ForceResizeAug", "ResizeAug", "CenterCropAug",
-    "RandomCropAug", "CreateAugmenter", "Augmenter", "ImageIter",
+    "RandomCropAug", "RandomSizedCropAug", "CreateAugmenter", "Augmenter", "ImageIter",
     "ImageRecordIterPy", "BrightnessJitterAug", "ContrastJitterAug",
     "SaturationJitterAug", "HueJitterAug", "LightingAug", "RandomGrayAug",
     "RandomOrderAug", "ColorJitterAug",
@@ -154,6 +154,37 @@ class RandomCropAug(Augmenter):
 
     def __call__(self, src):
         out, _ = random_crop(src, self.size)
+        return np.asarray(out)
+
+
+class RandomSizedCropAug(Augmenter):
+    """Inception-style random area/aspect crop resized to ``size``
+    (reference RandomSizedCropAug: area in [0.08, 1], aspect in
+    [3/4, 4/3], 10 attempts then center-crop fallback)."""
+
+    def __init__(self, size, area=(0.08, 1.0), ratio=(3.0 / 4.0, 4.0 / 3.0),
+                 interp=2):
+        super().__init__(size=size, area=area, ratio=ratio)
+        self.size = size
+        self.area = area if isinstance(area, (tuple, list)) else (area, 1.0)
+        self.ratio = ratio
+
+    def __call__(self, src):
+        img = np.asarray(src)
+        h, w = img.shape[:2]
+        src_area = h * w
+        for _ in range(10):
+            target_area = np.random.uniform(*self.area) * src_area
+            log_ratio = (np.log(self.ratio[0]), np.log(self.ratio[1]))
+            aspect = np.exp(np.random.uniform(*log_ratio))
+            new_w = int(round(np.sqrt(target_area * aspect)))
+            new_h = int(round(np.sqrt(target_area / aspect)))
+            if new_w <= w and new_h <= h and new_w > 0 and new_h > 0:
+                x0 = np.random.randint(0, w - new_w + 1)
+                y0 = np.random.randint(0, h - new_h + 1)
+                crop = img[y0:y0 + new_h, x0:x0 + new_w]
+                return _resize_np(crop, self.size[0], self.size[1])
+        out, _ = center_crop(src, self.size)
         return np.asarray(out)
 
 
@@ -302,6 +333,12 @@ class RandomOrderAug(Augmenter):
         super().__init__()
         self.ts = list(ts)
 
+    def dumps(self):
+        """Nest the children (reference RandomOrderAug.dumps)."""
+        import json
+        return json.dumps([type(self).__name__,
+                           [json.loads(t.dumps()) for t in self.ts]])
+
     def __call__(self, src):
         order = np.random.permutation(len(self.ts))
         for i in order:
@@ -339,7 +376,10 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
     if resize > 0:
         auglist.append(ResizeAug(resize, inter_method))
     crop_size = (data_shape[2], data_shape[1])
-    if rand_crop:
+    if rand_resize:
+        # reference: rand_resize implies random crop (area/aspect jitter)
+        auglist.append(RandomSizedCropAug(crop_size, interp=inter_method))
+    elif rand_crop:
         auglist.append(RandomCropAug(crop_size, inter_method))
     else:
         auglist.append(CenterCropAug(crop_size, inter_method))
@@ -500,9 +540,16 @@ class ImageRecordIterPy(ImageIter):
         if mean_r or mean_g or mean_b:
             mean = np.array([mean_r, mean_g, mean_b], np.float32)
             std = np.array([std_r or 1, std_g or 1, std_b or 1], np.float32)
-        aug = CreateAugmenter((data_shape[0], data_shape[1], data_shape[2]),
-                              resize=resize, rand_crop=rand_crop,
-                              rand_mirror=rand_mirror, mean=mean, std=std)
+        aug = CreateAugmenter(
+            (data_shape[0], data_shape[1], data_shape[2]),
+            resize=resize, rand_crop=rand_crop, rand_mirror=rand_mirror,
+            mean=mean, std=std,
+            # photometric kwargs forward too (reference ImageRecordIter
+            # max_random_* params; same silent-drop bug as ImageIter had)
+            **{k: v for k, v in kwargs.items()
+               if k in ("rand_resize", "brightness", "contrast",
+                        "saturation", "hue", "pca_noise", "rand_gray",
+                        "inter_method")})
         self._native = None  # before super().__init__ — it calls reset()
         super().__init__(batch_size, data_shape, label_width=label_width,
                          path_imgrec=path_imgrec, path_imgidx=path_imgidx,

@@ -154,10 +154,13 @@ def adaptive_avg_pooling_2d(data, output_size=(1, 1)):
     n, c, h, w = data.shape
 
     def masks(nbins, size):
-        i = jnp.arange(nbins, dtype=jnp.float32)[:, None]
-        s = jnp.arange(size, dtype=jnp.float32)[None, :]
-        lo = jnp.floor(i * size / nbins)
-        hi = jnp.ceil((i + 1) * size / nbins)
+        # INTEGER bin boundaries: float floor/ceil of i*size/nbins is
+        # not exact on TPU f32 (ceil(4.0000005) = 5 pulls a stray row
+        # into the bin); integer floor/ceil division is exact
+        i = jnp.arange(nbins, dtype=jnp.int32)[:, None]
+        s = jnp.arange(size, dtype=jnp.int32)[None, :]
+        lo = (i * size) // nbins
+        hi = ((i + 1) * size + nbins - 1) // nbins
         m = ((s >= lo) & (s < hi)).astype(jnp.float32)
         return m / jnp.maximum(m.sum(axis=1, keepdims=True), 1.0)
 

@@ -1,4 +1,4 @@
-"""Detection augmenter tests (python/mxnet/image/detection.py scope)."""
+"""Detection augmenter + image-pipeline augmenter tests (python/mxnet/image scope: detection.py DetAugmenters, ImageDetIter, and the classification photometric chain)."""
 import numpy as np
 import pytest
 
@@ -282,3 +282,38 @@ def test_photometric_kwargs_reach_image_iter(tmp_path):
     assert isinstance(cj, image.ColorJitterAug)
     assert isinstance(cj, image.RandomOrderAug)
     assert len(cj.ts) == 3
+
+
+def test_rand_resize_and_dumps_nesting(tmp_path):
+    """Review regressions: rand_resize builds a real RandomSizedCropAug
+    (both iterators), ImageRecordIterPy forwards photometric kwargs,
+    RandomOrderAug.dumps() nests children."""
+    import json
+    np.random.seed(4)
+    img = np.random.RandomState(0).randint(0, 255, (40, 60, 3), np.uint8)
+    aug = image.RandomSizedCropAug((24, 24))
+    out = aug(img)
+    assert out.shape == (24, 24, 3)
+    chain = image.CreateAugmenter((3, 24, 24), rand_resize=True)
+    assert any(isinstance(a, image.RandomSizedCropAug) for a in chain)
+    # nested dumps
+    ro = image.RandomOrderAug([image.BrightnessJitterAug(0.2),
+                               image.HueJitterAug(0.1)])
+    name, kids = json.loads(ro.dumps())
+    assert name == "RandomOrderAug" and len(kids) == 2
+    assert kids[0][0] == "BrightnessJitterAug"
+    # record-iter forwards photometric kwargs
+    from PIL import Image
+    import io as _io
+    rec_path, idx_path = str(tmp_path / "r.rec"), str(tmp_path / "r.idx")
+    rec = mx.recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    buf = _io.BytesIO()
+    Image.fromarray(img).save(buf, format="JPEG")
+    rec.write_idx(0, mx.recordio.pack(
+        mx.recordio.IRHeader(0, 0.0, 0, 0), buf.getvalue()))
+    rec.close()
+    it = image.ImageRecordIterPy(path_imgrec=rec_path, path_imgidx=idx_path,
+                                 data_shape=(3, 24, 24), batch_size=1,
+                                 brightness=0.3, rand_gray=0.1)
+    names = [type(a).__name__ for a in it.auglist]
+    assert "ColorJitterAug" in names and "RandomGrayAug" in names

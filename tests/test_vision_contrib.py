@@ -13,11 +13,19 @@ import pytest
 
 import mxnet_tpu as mx
 from mxnet_tpu import nd
-from mxnet_tpu.test_utils import assert_almost_equal
+from mxnet_tpu.test_utils import assert_almost_equal, device_tols
 
 torch = pytest.importorskip("torch")
 
 RS = onp.random.RandomState(11)
+# f32 contractions ride bf16 MXU passes on the real chip — goldens use
+# the device tolerance table THERE, but keep the tight 1e-5 baseline on
+# CPU (round-6 review: widening the CPU bar would hide regressions)
+from mxnet_tpu.test_utils import _on_tpu
+if _on_tpu():
+    RTOL_G, ATOL_G = device_tols("float32")
+else:
+    RTOL_G, ATOL_G = 1e-5, 1e-5
 
 
 def _nd(x, dtype="float32"):
@@ -30,7 +38,7 @@ def test_adaptive_avg_pooling_vs_torch():
         got = nd.contrib.AdaptiveAvgPooling2D(_nd(x), output_size=out_size)
         want = torch.nn.functional.adaptive_avg_pool2d(
             torch.from_numpy(x), out_size).numpy()
-        assert_almost_equal(got.asnumpy(), want, rtol=1e-5, atol=1e-5)
+        assert_almost_equal(got.asnumpy(), want, rtol=RTOL_G, atol=ATOL_G)
 
 
 def test_adaptive_avg_pooling_grad():
@@ -52,7 +60,7 @@ def test_bilinear_resize_vs_torch():
         want = torch.nn.functional.interpolate(
             torch.from_numpy(x), size=(oh, ow), mode="bilinear",
             align_corners=True).numpy()
-        assert_almost_equal(got.asnumpy(), want, rtol=1e-5, atol=1e-5)
+        assert_almost_equal(got.asnumpy(), want, rtol=RTOL_G, atol=ATOL_G)
     # scale mode
     got = nd.contrib.BilinearResize2D(_nd(x), scale_height=2.0,
                                       scale_width=2.0)
