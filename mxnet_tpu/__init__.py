@@ -97,28 +97,16 @@ from . import numpy_extension  # noqa: F401
 # deep-numpy hybrid-forward convention: np-style blocks write
 # F.np.dot(...) / F.npx.relu(...) — install the namespaces on the nd
 # module handed to hybrid_forward (classic F.<op> names untouched).
-# The legacy Symbol graph path gets a proxy raising a CLEAR error:
-# np-style blocks are supported eager + hybridized (the compiled
-# path), not through mx.sym graph building.
+# The Symbol path gets the SYMBOLIC np/npx namespaces (op-backed
+# subset; Python-composed functions raise pointing at hybridize).
 ndarray.np = np
 ndarray.npx = npx
 
+from .symbol import numpy as _sym_np  # noqa: E402
+from .symbol import numpy_extension as _sym_npx  # noqa: E402
 
-class _SymbolNpProxy:
-    def __init__(self, name):
-        self._name = name
-
-    def __getattr__(self, attr):
-        raise NotImplementedError(
-            f"F.{self._name}.{attr}: the deep-numpy namespaces are not "
-            f"available on the legacy Symbol path — np-style hybrid "
-            f"blocks run eagerly and hybridized (jit-compiled); use "
-            f"classic F.<op> names for Symbol graph building/export")
-
-
-symbol.np = _SymbolNpProxy("np")
-symbol.npx = _SymbolNpProxy("npx")
-del _SymbolNpProxy
+symbol.np = _sym_np
+symbol.npx = _sym_npx
 from . import visualization
 from . import visualization as viz
 

@@ -1,0 +1,91 @@
+"""``mx.sym.npx`` — symbolic numpy-extension namespace.
+
+Mirrors the eager ``mx.npx`` nn-op surface for graph building
+(reference python/mxnet/symbol/numpy_extension/): each function lowers
+to the same registry op under NumPy-style lowercase names."""
+from __future__ import annotations
+
+import sys as _sys
+
+from ..ndarray.register import get_op
+from .symbol import Symbol, _make_node
+
+__all__ = []
+
+_NPX_OPS = {
+    "relu": "relu", "sigmoid": "sigmoid", "log_sigmoid": "log_sigmoid",
+    "softmax": "softmax", "log_softmax": "log_softmax",
+    "softmin": "softmin", "activation": "Activation",
+    "leaky_relu": "LeakyReLU", "gelu": "gelu", "erf": "erf",
+    "erfinv": "erfinv", "gamma": "gamma", "gammaln": "gammaln",
+    "digamma": "digamma", "smooth_l1": "smooth_l1",
+    "batch_dot": "batch_dot", "fully_connected": "FullyConnected",
+    "convolution": "Convolution", "deconvolution": "Deconvolution",
+    "pooling": "Pooling", "dropout": "Dropout", "embedding": "Embedding",
+    "batch_norm": "BatchNorm", "layer_norm": "LayerNorm",
+    "group_norm": "GroupNorm", "instance_norm": "InstanceNorm",
+    "l2_normalization": "L2Normalization", "rnn": "RNN",
+    "roi_pooling": "ROIPooling", "ctc_loss": "ctc_loss",
+    "one_hot": "one_hot", "pick": "pick", "topk": "topk",
+    "gather_nd": "gather_nd", "scatter_nd": "scatter_nd",
+    "arange_like": "arange_like", "broadcast_like": "broadcast_like",
+    "sequence_mask": "SequenceMask", "reshape": "reshape",
+    "reshape_like": "reshape_like",
+    "multibox_prior": "_contrib_MultiBoxPrior",
+    "multibox_target": "_contrib_MultiBoxTarget",
+    "multibox_detection": "_contrib_MultiBoxDetection",
+    "box_nms": "_contrib_box_nms", "box_iou": "_contrib_box_iou",
+}
+
+_mod = _sys.modules[__name__]
+
+
+def _make(fname, opname):
+    def f(*args, name=None, **params):
+        bad = [a for a in args
+               if not (isinstance(a, Symbol) or a is None)]
+        if bad:
+            raise TypeError(
+                f"sym.npx.{fname}: positional argument of type "
+                f"{type(bad[0]).__name__} is not a tensor input — pass "
+                f"op parameters as keywords")
+        inputs = list(args)
+        # trailing optional inputs (e.g. bias=None) drop like eager
+        while inputs and inputs[-1] is None:
+            inputs.pop()
+        if any(i is None for i in inputs):
+            raise TypeError(
+                f"sym.npx.{fname}: only TRAILING tensor inputs may be None")
+        return _make_node(get_op(opname), inputs, params, name=name)
+
+    f.__name__ = fname
+    f.__doc__ = f"Symbolic npx.{fname}: registry op {opname}."
+    return f
+
+
+for _f, _o in _NPX_OPS.items():
+    setattr(_mod, _f, _make(_f, _o))
+    __all__.append(_f)
+
+
+def reshape(data, newshape=None, reverse=False, name=None, **params):
+    """Symbolic npx.reshape: same signature as the eager one
+    (newshape maps to the op's ``shape`` param; special codes apply)."""
+    if newshape is None:
+        newshape = params.pop("shape", None)
+    if newshape is None:
+        raise TypeError("sym.npx.reshape requires newshape")
+    return _make_node(get_op("reshape"), [data],
+                      {"shape": tuple(newshape), "reverse": reverse},
+                      name=name)
+
+
+__all__.append("reshape")
+
+
+def __getattr__(attr):
+    if attr.startswith("__"):
+        raise AttributeError(attr)
+    raise NotImplementedError(
+        f"sym.npx.{attr} has no symbolic lowering — hybridize the "
+        f"block instead (the compiled path supports all of mx.npx)")

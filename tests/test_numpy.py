@@ -659,14 +659,50 @@ def test_np_style_hybrid_block():
         npx.reset_np()
 
 
-def test_np_symbol_path_clear_error():
-    """F.np on the legacy Symbol path raises a NAMED error, not a
-    bare AttributeError (review regression)."""
+def test_np_symbolic_namespace():
+    """mx.sym.np / mx.sym.npx: the op-backed symbolic numpy subset
+    builds and EXECUTES graphs matching numpy goldens; Python-composed
+    functions raise a named error pointing at hybridize."""
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    out = mx.sym.np.einsum("ij,kj->ik", mx.sym.np.tanh(a), b)
+    e = out.bind(mx.current_context(), {"a": mx.nd.array(_X),
+                                        "b": mx.nd.array(_Y)})
+    got = e.forward()[0].asnumpy()
+    _chk(got, onp.einsum("ij,kj->ik", onp.tanh(_X), _Y))
+    # scalar lifting through _constant
+    out2 = mx.sym.np.add(a, 2.5)
+    e2 = out2.bind(mx.current_context(), {"a": mx.nd.array(_X)})
+    _chk(e2.forward()[0], _X + 2.5)
+    # reductions + manipulation + linalg
+    out3 = mx.sym.np.sum(mx.sym.np.tril(a), axis=1)
+    e3 = out3.bind(mx.current_context(), {"a": mx.nd.array(_X)})
+    _chk(e3.forward()[0], onp.tril(_X).sum(1))
+    sq = mx.sym.Variable("sq")
+    out4 = mx.sym.np.linalg.cholesky(sq)
+    e4 = out4.bind(mx.current_context(), {"sq": mx.nd.array(_SQ)})
+    _chk(e4.forward()[0], onp.linalg.cholesky(_SQ), rtol=1e-3, atol=1e-3)
+    # npx symbolic
+    out5 = mx.sym.npx.relu(a)
+    e5 = out5.bind(mx.current_context(), {"a": mx.nd.array(_X)})
+    _chk(e5.forward()[0], onp.maximum(_X, 0))
+    # np-style hybrid block now ALSO works on the Symbol path
+    class NpBlock(mx.gluon.nn.HybridBlock):
+        def hybrid_forward(self, F, x):
+            return F.npx.relu(F.np.multiply(x, 2.0))
+
+    blk = NpBlock()
+    blk.initialize()
+    sym_out = blk(mx.sym.Variable("x"))
+    ee = sym_out.bind(mx.current_context(), {"x": mx.nd.array(_X)})
+    _chk(ee.forward()[0], onp.maximum(_X * 2.0, 0))
+    # composed functions raise with a pointer at hybridize
     import pytest as _pytest
-    with _pytest.raises(NotImplementedError, match="Symbol"):
-        mx.sym.np.dot
-    with _pytest.raises(NotImplementedError, match="Symbol"):
-        mx.sym.npx.relu
+    with _pytest.raises(NotImplementedError, match="hybridize"):
+        mx.sym.np.meshgrid(a, b)
+    # non-liftable input type raises a named TypeError
+    with _pytest.raises(TypeError, match="Symbol or python scalar"):
+        mx.sym.np.add(a, onp.ones(3))
 
 
 def test_np_pickle_roundtrip():
@@ -698,3 +734,42 @@ def test_np_mode_dataloader_and_metric():
         assert m.get()[1] == 1.0
     finally:
         npx.reset_np()
+
+
+def test_np_symbolic_review_regressions():
+    """Round-6 review: int scalars stay integer through _lift, npx
+    symbolic reshape matches the eager signature, concatenate
+    axis=None flattens, unknown names raise the named error, and
+    under-supplied ops fail at build time."""
+    import pytest as _pytest
+    a = mx.sym.Variable("a")
+    ia = mx.nd.array(_I8)
+    # int scalar keeps integer dtype (shift works; no float promotion)
+    out = mx.sym.np.left_shift(a, 2)
+    e = out.bind(mx.current_context(), {"a": ia})
+    assert (e.forward()[0].asnumpy() == onp.left_shift(_I8, 2)).all()
+    out2 = mx.sym.np.add(a, 2)
+    e2 = out2.bind(mx.current_context(), {"a": ia})
+    assert "int" in str(e2.forward()[0].dtype)
+    # npx.reshape positional newshape, special codes
+    r = mx.sym.npx.reshape(a, (-1, -2))
+    er = r.bind(mx.current_context(), {"a": mx.nd.array(_X)})
+    assert er.forward()[0].shape == _X.shape
+    # concatenate axis=None flattens like numpy
+    b = mx.sym.Variable("b")
+    c = mx.sym.np.concatenate([a, b], axis=None)
+    ec = c.bind(mx.current_context(), {"a": mx.nd.array(_X),
+                                       "b": mx.nd.array(_Y)})
+    assert ec.forward()[0].shape == (_X.size + _Y.size,)
+    # unknown eager-only names raise the NAMED error
+    with _pytest.raises(NotImplementedError, match="hybridize"):
+        mx.sym.np.zeros((3,))
+    with _pytest.raises(NotImplementedError, match="hybridize"):
+        mx.sym.npx.save("f", {})
+    # under-supplied binary fails AT BUILD with a clear message
+    with _pytest.raises(TypeError, match="tensor argument"):
+        mx.sym.np.dot(a)
+    # interleaved param named clearly in npx
+    w = mx.sym.Variable("w")
+    with _pytest.raises(TypeError, match="keywords"):
+        mx.sym.npx.fully_connected(a, 128, w)
