@@ -1029,3 +1029,70 @@ def finfo(dtype):
 
 def iinfo(dtype):
     return onp.iinfo(onp.dtype(dtype_np(dtype)))
+
+
+# ---------------------------------------------------------------------------
+# tail: index helpers, window functions, remaining creation fns
+# ---------------------------------------------------------------------------
+def argwhere(a):
+    return _np_invoke("_npi_argwhere", [_proc(a)])
+
+
+def dsplit(ary, indices_or_sections):
+    a = _proc(ary)
+    if a.ndim < 3:
+        raise ValueError("dsplit only works on arrays of 3 or more "
+                         "dimensions")
+    return _split_impl(a, indices_or_sections, 2, even_required=True)
+
+
+def tri(N, M=None, k=0, dtype="float32", ctx=None):
+    ctx = ctx or current_context()
+    return ndarray(jnp.tri(N, M, k, dtype=dtype_np(dtype)), ctx)
+
+
+def vander(x, N=None, increasing=False):
+    a = asarray(x)
+    if a.ndim != 1:
+        raise ValueError("x must be a one-dimensional array or sequence")
+    n = int(a.size) if N is None else int(N)
+    powers = arange(n) if increasing else arange(n - 1, -1, -1)
+    # a[:, None] ** powers — composed from registry ops (differentiable)
+    return power(expand_dims(a, 1), powers.reshape(1, -1))
+
+
+def hanning(M, ctx=None):
+    ctx = ctx or current_context()
+    return ndarray(jnp.hanning(int(M)).astype(jnp.float32), ctx)
+
+
+def hamming(M, ctx=None):
+    ctx = ctx or current_context()
+    return ndarray(jnp.hamming(int(M)).astype(jnp.float32), ctx)
+
+
+def blackman(M, ctx=None):
+    ctx = ctx or current_context()
+    return ndarray(jnp.blackman(int(M)).astype(jnp.float32), ctx)
+
+
+def indices(dimensions, dtype="int32", ctx=None):
+    ctx = ctx or current_context()
+    return ndarray(jnp.indices(tuple(dimensions),
+                               dtype=dtype_np(dtype)), ctx)
+
+
+def tril_indices(n, k=0, m=None, ctx=None):
+    ctx = ctx or current_context()
+    r, c = jnp.tril_indices(n, k, m)
+    return ndarray(r, ctx), ndarray(c, ctx)
+
+
+def triu_indices(n, k=0, m=None, ctx=None):
+    ctx = ctx or current_context()
+    r, c = jnp.triu_indices(n, k, m)
+    return ndarray(r, ctx), ndarray(c, ctx)
+
+
+__all__ += ["argwhere", "dsplit", "tri", "vander", "hanning", "hamming",
+            "blackman", "indices", "tril_indices", "triu_indices"]

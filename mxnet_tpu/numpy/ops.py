@@ -605,3 +605,8 @@ def _npi_broadcast_to(a, shape=()):
     # numpy broadcast_to prepends axes; the classic broadcast_to op
     # keeps MXNet's same-rank/0-keeps-dim contract
     return jnp.broadcast_to(a, tuple(shape))
+
+
+@register_op("_npi_argwhere", differentiable=False)
+def _npi_argwhere(a):
+    return jnp.argwhere(a)

@@ -189,6 +189,22 @@ def einsum(subscripts, *operands, name=None, **params):
 
 _install("einsum", einsum)
 
+# dynamic-output-shape ops: single-op but value-dependent result
+# shapes, which the jitted symbolic executor cannot bind — excluded
+# with an accurate message (eager mx.np supports them)
+def _dynamic_shape(fname):
+    def f(*args, **kwargs):
+        raise NotImplementedError(
+            f"sym.np.{fname}: output shape depends on VALUES "
+            f"(dynamic), which symbolic graph execution cannot bind — "
+            f"use eager mx.np.{fname}")
+    f.__name__ = fname
+    return f
+
+
+for _f in ("argwhere",):
+    _install(_f, _dynamic_shape(_f))
+
 # Python-composed eager functions: clear error, not AttributeError
 for _f in ("split", "array_split", "hsplit", "vsplit", "meshgrid",
            "nonzero", "flatnonzero", "unique", "histogram", "bincount",

@@ -773,3 +773,49 @@ def test_np_symbolic_review_regressions():
     w = mx.sym.Variable("w")
     with _pytest.raises(TypeError, match="keywords"):
         mx.sym.npx.fully_connected(a, 128, w)
+
+
+def test_np_tail_functions():
+    """argwhere / dsplit / tri / vander / windows / indices /
+    tril_indices — the remaining creation+index tail."""
+    x = onp.array([[0, 1], [2, 0]], "f")
+    _chk(np.argwhere(np.array(x)), onp.argwhere(x))
+    t3 = _a(2, 4, 6)
+    for got, want in zip(np.dsplit(np.array(t3), 3), onp.dsplit(t3, 3)):
+        _chk(got, want)
+    with pytest.raises(ValueError):
+        np.dsplit(np.array(_X), 2)
+    _chk(np.tri(3, 5, 1), onp.tri(3, 5, 1, dtype="f"))
+    v = onp.array([1.0, 2.0, 3.0], "f")
+    _chk(np.vander(np.array(v)), onp.vander(v))
+    _chk(np.vander(np.array(v), 4, increasing=True),
+         onp.vander(v, 4, increasing=True))
+    for fn, ofn in ((np.hanning, onp.hanning), (np.hamming, onp.hamming),
+                    (np.blackman, onp.blackman)):
+        _chk(fn(8), ofn(8).astype("f"))
+    _chk(np.indices((2, 3)), onp.indices((2, 3)))
+    r, c = np.tril_indices(4, 1)
+    wr, wc = onp.tril_indices(4, 1)
+    assert (r.asnumpy() == wr).all() and (c.asnumpy() == wc).all()
+    r2, c2 = np.triu_indices(3)
+    wr2, wc2 = onp.triu_indices(3)
+    assert (r2.asnumpy() == wr2).all() and (c2.asnumpy() == wc2).all()
+    # vander differentiates (composed from power/expand_dims)
+    a = np.array(v)
+    a.attach_grad()
+    with mx.autograd.record():
+        out = np.vander(a, 3).sum()
+    out.backward()
+    # d/dx sum(x^2 + x + 1) = 2x + 1
+    assert_almost_equal(a.grad.asnumpy(), 2 * v + 1, rtol=1e-5, atol=1e-5)
+    # index-helper outputs index straight back into arrays
+    m = np.array(_a(4, 4))
+    low = m[np.tril_indices(4)]
+    assert low.shape == (10,)
+
+
+def test_np_vander_validation_and_sym_argwhere():
+    with pytest.raises(ValueError, match="one-dimensional"):
+        np.vander(np.array(_X))
+    with pytest.raises(NotImplementedError, match="dynamic"):
+        mx.sym.np.argwhere(mx.sym.Variable("a"))
