@@ -306,7 +306,11 @@ def main():
                     np.random.RandomState(i).rand(8, 3, IMAGE, IMAGE)
                     .astype(np.float32), ctx=ctx, dtype=DTYPE)]
                     for i in range(4)]
-                quantize_net(net, calib_data=calib, ctx=ctx)
+                # BENCH_S8_IF=1: chain conv->relu->conv interfaces in
+                # s8 (requantize epilogue) instead of bf16
+                quantize_net(net, calib_data=calib, ctx=ctx,
+                             s8_interfaces=os.environ.get(
+                                 "BENCH_S8_IF") == "1")
                 net(warm)  # re-trace materializes int8 weights
         fn, params = functionalize(net, training=False, ctx=ctx)
         if CHAIN > 1:

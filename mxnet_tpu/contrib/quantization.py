@@ -183,29 +183,62 @@ class QuantizedConv2D(_QuantizedBase):
         ctx = ctx or current_context()
         self._kwargs = dict(float_layer._kwargs)
         self._act = float_layer.act
+        # s8-interface chaining (quantize_net(s8_interfaces=True) second
+        # pass): _out_req holds the NEXT chained conv's act_amax
+        # Parameter — the requantize epilogue target; _prequantized
+        # means the input arrives already s8 at our own act_amax scale
+        self._out_req = None
+        self._prequantized = False
+        # the float interface dtype of the original model (the layer
+        # input dtype is s8 when prequantized — can't cast output to it)
+        self._float_dtype = str(float_layer.weight.dtype)
         self._quantize_weight(float_layer, ctx, act_range, fold_bn=fold_bn,
                               channelwise=True)
 
     def forward(self, x):
+        import jax.numpy as jnp
         from ..ndarray.register import get_op, invoke
-        from ..ndarray.op_impl_quant import quantize_act
+        from ..ndarray.op_impl_quant import quantize_act, _amax_scale
         from ..ndarray.ndarray import _wrap
-        q, s = quantize_act(x._data, self.act_amax.data(x.ctx)._data)
+        if self._prequantized and str(x.dtype) == "int8":
+            # producer already requantized into OUR calibrated scale
+            q = x._data
+            s = _amax_scale(self.act_amax.data(x.ctx)._data.reshape(())
+                            ).reshape(1)
+        else:
+            q, s = quantize_act(x._data, self.act_amax.data(x.ctx)._data)
         bias = self.bias.data(x.ctx) if self.bias is not None else None
         kw = {k: v for k, v in self._kwargs.items()
               if k in ("kernel", "stride", "dilate", "pad", "num_filter",
                        "num_group")}
-        out = invoke(get_op("quantized_conv"),
-                     [_wrap(q, x.ctx), self.weight_q.data(x.ctx),
-                      _wrap(s, x.ctx), self.weight_scale.data(x.ctx), bias],
-                     {**kw, "no_bias": bias is None})
-        out = out.astype(x.dtype)  # keep bf16 interfaces bf16
+        inputs = [_wrap(q, x.ctx), self.weight_q.data(x.ctx),
+                  _wrap(s, x.ctx), self.weight_scale.data(x.ctx), bias]
+        no_bias = bias is None
+        if self._out_req is not None:
+            if bias is None:
+                # placeholder: invoke only drops TRAILING None inputs
+                inputs[4] = _wrap(jnp.zeros((1,), jnp.float32), x.ctx)
+            inputs.append(self._out_req.data(x.ctx))
+        out = invoke(get_op("quantized_conv"), inputs,
+                     {**kw, "no_bias": no_bias})
+        if self._out_req is not None:
+            # s8 out rides to the chained consumer (relu/Identity
+            # between us operate on s8 unchanged). An inline act here
+            # would run on raw s8 CODES (wrong for anything nonlinear
+            # beyond relu) — the chain pass only links act-free convs.
+            assert self._act is None, \
+                "s8-interface chain must not carry an inline activation"
+            return out
+        # keep bf16 interfaces bf16; a prequantized input is s8, so the
+        # model's float dtype is the cast target then
+        tgt = self._float_dtype if str(x.dtype) == "int8" else x.dtype
+        out = out.astype(tgt)
         return self._act(out) if self._act is not None else out
 
 
 def quantize_net(net, quantized_dtype="int8", calib_data=None,
                  calib_mode="naive", num_calib_examples=32, ctx=None,
-                 exclude_layers=(), **kwargs):
+                 exclude_layers=(), s8_interfaces=False, **kwargs):
     """Rewrite ``net`` so Dense/Conv2D children execute in int8.
 
     With ``calib_data``: per-layer INPUT ranges are collected first
@@ -220,6 +253,12 @@ def quantize_net(net, quantized_dtype="int8", calib_data=None,
     newly quantized nets."""
     if quantized_dtype != "int8":
         raise MXNetError(f"only int8 is supported, got {quantized_dtype}")
+    if s8_interfaces and calib_data is None:
+        # validate BEFORE the destructive in-place rewrite — raising
+        # after it would leave the caller's net half-quantized
+        raise MXNetError(
+            "s8_interfaces=True needs calibrated (static) activation "
+            "ranges — pass calib_data")
     # hybridized nets would run calibration hooks (which read concrete
     # values) inside a trace, and the cached compiled graph would keep
     # executing the FLOAT layers after the rewrite — deactivate and
@@ -277,9 +316,51 @@ def quantize_net(net, quantized_dtype="int8", calib_data=None,
                     object.__setattr__(block, attr, qlayer)
 
     rewrite(net)
+
+    if s8_interfaces:
+        _chain_s8_interfaces(net)
     net._quantized_dtype = quantized_dtype
     net._quant_ranges = ranges
     return net
+
+
+def _chain_s8_interfaces(net):
+    """Second rewrite pass: within each (Hybrid)Sequential, when a
+    QuantizedConv2D reaches the NEXT QuantizedConv2D through only
+    Identity / relu-Activation children, requantize the producer's
+    output straight into the consumer's calibrated input scale — the
+    tensor between them stays s8 end-to-end (half the bf16 HBM bytes;
+    the relu between them is exact on s8: requant-then-relu ==
+    relu-then-requant for a symmetric scale). Residual-add boundaries
+    (non-Sequential dataflow) stay bf16 — correctness first."""
+
+    def passthrough(child):
+        if type(child) is _nn.Identity:
+            return True
+        return (type(child) is _nn.Activation
+                and getattr(child, "_act_type", None) == "relu")
+
+    def walk(block):
+        if isinstance(block, (_nn.Sequential, _nn.HybridSequential)):
+            items = [c for _, c in block._children.items()]
+            for i, child in enumerate(items):
+                if not isinstance(child, QuantizedConv2D):
+                    continue
+                if child._act is not None:
+                    continue  # inline act would run pre-requant
+                j = i + 1
+                while j < len(items) and passthrough(items[j]):
+                    j += 1
+                if j < len(items) and isinstance(items[j], QuantizedConv2D):
+                    consumer = items[j]
+                    amax = float(consumer.act_amax.data().asnumpy()[0])
+                    if amax > 0:  # static calibrated range only
+                        child._out_req = consumer.act_amax
+                        consumer._prequantized = True
+        for _, c in block._children.items():
+            walk(c)
+
+    walk(net)
 
 
 def quantize_model(sym, arg_params, aux_params, data_names=("data",),

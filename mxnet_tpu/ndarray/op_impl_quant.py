@@ -65,10 +65,17 @@ def quantized_fully_connected(data, weight, x_scale, w_scale, bias=None,
 
 
 @register_op("quantized_conv", differentiable=False)
-def quantized_conv(data, weight, x_scale, w_scale, bias=None, kernel=None,
-                   stride=None, dilate=None, pad=None, num_filter=None,
-                   num_group=1, no_bias=False, layout=None):
-    """int8 NCHW conv: s8 operands, s32 accumulation (MXU int8 path)."""
+def quantized_conv(data, weight, x_scale, w_scale, bias=None, out_amax=None,
+                   kernel=None, stride=None, dilate=None, pad=None,
+                   num_filter=None, num_group=1, no_bias=False, layout=None):
+    """int8 NCHW conv: s8 operands, s32 accumulation (MXU int8 path).
+
+    ``out_amax`` (optional 6th tensor input, a (1,) f32 calibrated
+    range) switches on the REQUANTIZE epilogue: the f32 result is
+    rescaled by out_amax/127, rounded and clamped back to s8 — the
+    tensor between chained int8 layers then stays s8 end-to-end
+    (half the HBM bytes of bf16; reference mkldnn int8 fuses
+    requantize into the conv the same way)."""
     nd_ = len(kernel) if kernel is not None else weight.ndim - 2
     stride = tuple(stride) if stride else (1,) * nd_
     dilate = tuple(dilate) if dilate else (1,) * nd_
@@ -94,6 +101,10 @@ def quantized_conv(data, weight, x_scale, w_scale, bias=None, kernel=None,
     out = acc.astype(jnp.float32) * x_scale.reshape(()) * ws
     if bias is not None and not no_bias:
         out = out + bias.reshape((1, -1) + (1,) * nd_)
+    if out_amax is not None:
+        s_out = _amax_scale(out_amax.reshape(()))
+        return jnp.clip(jnp.round(out / s_out), -_QMAX, _QMAX
+                        ).astype(jnp.int8)
     return out
 
 

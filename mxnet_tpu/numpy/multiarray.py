@@ -1056,9 +1056,15 @@ def vander(x, N=None, increasing=False):
     if a.ndim != 1:
         raise ValueError("x must be a one-dimensional array or sequence")
     n = int(a.size) if N is None else int(N)
-    powers = arange(n) if increasing else arange(n - 1, -1, -1)
-    # a[:, None] ** powers — composed from registry ops (differentiable)
-    return power(expand_dims(a, 1), powers.reshape(1, -1))
+    # cumulative multiplies (numpy uses multiply.accumulate): integer
+    # powers stay EXACT, unlike the exp/log pow lowering, and the
+    # construction stays differentiable through the registry ops
+    cols = [ones_like(a)]
+    for _ in range(1, n):
+        cols.append(multiply(cols[-1], a))
+    if not increasing:
+        cols = cols[::-1]
+    return stack(cols, axis=1)
 
 
 def hanning(M, ctx=None):

@@ -819,3 +819,8 @@ def test_np_vander_validation_and_sym_argwhere():
         np.vander(np.array(_X))
     with pytest.raises(NotImplementedError, match="dynamic"):
         mx.sym.np.argwhere(mx.sym.Variable("a"))
+
+
+def test_np_vander_exact_integer_powers():
+    v = np.vander(np.array([1.0, 2.0, 3.0]))
+    assert (v.asnumpy() == onp.vander(onp.array([1.0, 2.0, 3.0], "f"))).all()

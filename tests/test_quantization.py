@@ -186,3 +186,83 @@ def test_quantize_net_folds_batchnorm():
     # int8 tolerance: ~1% of dynamic range
     tol = 0.02 * max(1e-3, float(np.abs(ref).max()))
     np.testing.assert_allclose(got, ref, atol=tol, rtol=0.1)
+
+
+def test_s8_interfaces_chain():
+    """quantize_net(s8_interfaces=True): chained convs exchange s8
+    tensors (producer requantizes into the consumer's calibrated
+    scale); numerics match the bf16-interface int8 net closely and the
+    chain actually engages."""
+    import numpy as onp
+    from mxnet_tpu import nd
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.contrib.quantization import (quantize_net,
+                                                QuantizedConv2D)
+
+    rs = onp.random.RandomState(0)
+    x = nd.array(rs.rand(2, 3, 16, 16).astype("f"))
+
+    def build():
+        net = nn.HybridSequential()
+        net.add(nn.Conv2D(8, 3, padding=1, use_bias=False),
+                nn.BatchNorm(),
+                nn.Activation("relu"),
+                nn.Conv2D(8, 3, padding=1, use_bias=False),
+                nn.BatchNorm(),
+                nn.Activation("relu"),
+                nn.Conv2D(4, 1))
+        net.initialize(init=mx.initializer.Xavier())
+        net(x)  # finalize shapes
+        return net
+
+    onp.random.seed(0); mx.random.seed(0)
+    ref_net = build()
+    # global name counters differ per instance — copy params by ORDER
+    ref_params = [v.data() for _, v in ref_net.collect_params().items()]
+
+    def clone():
+        onp.random.seed(0); mx.random.seed(0)
+        n = build()
+        for (_, v), val in zip(n.collect_params().items(), ref_params):
+            v.set_data(val)
+        return n
+
+    float_out = ref_net(x).asnumpy()
+    q_plain = quantize_net(clone(), calib_data=[(x,)])
+    out_plain = q_plain(x).asnumpy()
+    q_s8 = quantize_net(clone(), calib_data=[(x,)], s8_interfaces=True)
+    # the chain engaged: first two convs requantize, followers consume
+    convs = [c for c in q_s8._children.values()
+             if isinstance(c, QuantizedConv2D)]
+    assert len(convs) == 3
+    assert convs[0]._out_req is not None and convs[1]._out_req is not None
+    assert convs[1]._prequantized and convs[2]._prequantized
+    assert convs[2]._out_req is None  # tail conv emits float
+    out_s8 = q_s8(x).asnumpy()
+    # both int8 variants agree closely (same scales; only the
+    # intermediate rounding point differs) and track the float net
+    assert onp.abs(out_s8 - out_plain).max() < 0.12
+    rel = onp.abs(out_s8 - float_out).mean() / (onp.abs(float_out).mean() + 1e-6)
+    assert rel < 0.1, rel
+    # hybridize works with s8 interfaces
+    q_s8.hybridize()
+    out_h = q_s8(x).asnumpy()
+    assert onp.allclose(out_h, out_s8, atol=1e-5)
+    # without calibration the mode refuses (dynamic ranges can't chain)
+    with pytest.raises(Exception):
+        quantize_net(clone(), s8_interfaces=True)
+
+
+def test_s8_interfaces_validates_before_rewrite():
+    """Review regression: the calib_data check fires BEFORE the
+    destructive rewrite — the net stays float on failure."""
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.contrib.quantization import quantize_net
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(4, 3, padding=1))
+    net.initialize()
+    net(mx.nd.zeros((1, 2, 8, 8)))
+    with pytest.raises(Exception, match="calib_data"):
+        quantize_net(net, s8_interfaces=True)
+    # net unchanged: still a float Conv2D
+    assert type(list(net._children.values())[0]) is nn.Conv2D
