@@ -76,10 +76,20 @@ def bipartite_matching(dist, is_ascend=False, threshold=None, topk=-1):
     (reference bounding_box.cc BipartiteMatching): repeatedly take the
     globally best (row, col) pair, mark both used. Returns
     (row_match, col_match): for each row the matched col (or -1), and
-    for each col the matched row (or -1)."""
+    for each col the matched row (or -1). Batched input (..., N, M) is
+    matched independently per leading index (gluoncv matchers rely on
+    this reference behavior)."""
     d = dist
-    if d.ndim != 2:
-        raise ValueError("bipartite_matching expects a 2-D dist matrix")
+    if d.ndim < 2:
+        raise ValueError("bipartite_matching expects a >=2-D dist matrix")
+    if d.ndim > 2:
+        lead = d.shape[:-2]
+        flat = d.reshape((-1,) + d.shape[-2:])
+        rows, cols = jax.vmap(
+            lambda x: bipartite_matching(x, is_ascend=is_ascend,
+                                         threshold=threshold, topk=topk))(flat)
+        return (rows.reshape(lead + rows.shape[-1:]),
+                cols.reshape(lead + cols.shape[-1:]))
     n, m = d.shape
     k = min(n, m) if topk is None or topk < 0 else min(topk, min(n, m))
     big = jnp.asarray(jnp.inf, d.dtype)
@@ -197,11 +207,17 @@ def multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
     step_x = 1.0 / w if steps[1] < 0 else steps[1]
     cy = (jnp.arange(h, dtype=jnp.float32) + offsets[0]) * step_y
     cx = (jnp.arange(w, dtype=jnp.float32) + offsets[1]) * step_x
+    # reference multibox_prior.cc scales the half-WIDTH by the feature
+    # map aspect (in_height/in_width) so a `size` means the same image
+    # fraction on both axes of a non-square map; half-height is unscaled
+    aspect = float(h) / float(w)
     half = []
     for k, s in enumerate(sizes):
-        half.append((s * (ratios[0] ** 0.5) / 2.0, s / (ratios[0] ** 0.5) / 2.0))
+        half.append((s * aspect * (ratios[0] ** 0.5) / 2.0,
+                     s / (ratios[0] ** 0.5) / 2.0))
     for r in ratios[1:]:
-        half.append((sizes[0] * (r ** 0.5) / 2.0, sizes[0] / (r ** 0.5) / 2.0))
+        half.append((sizes[0] * aspect * (r ** 0.5) / 2.0,
+                     sizes[0] / (r ** 0.5) / 2.0))
     hw = jnp.asarray([p[0] for p in half], jnp.float32)  # (A,)
     hh = jnp.asarray([p[1] for p in half], jnp.float32)
     gy, gx = jnp.meshgrid(cy, cx, indexing="ij")  # (H, W)

@@ -256,3 +256,47 @@ def test_np_hstack_scalars():
     assert out.asnumpy().tolist() == [1, 2]
     cs = mx.np.column_stack((1.0, 2.0))
     assert cs.shape == (1, 2)
+
+
+def test_multibox_prior_nonsquare_aspect():
+    """Reference multibox_prior scales half-WIDTH by in_h/in_w so `size`
+    is the same image fraction on both axes of a non-square map
+    (advisor r4 medium: square maps hid the missing factor)."""
+    feat = nd.zeros((1, 3, 2, 4))  # h=2, w=4 -> aspect 0.5
+    a = nd.contrib.MultiBoxPrior(feat, sizes=(0.5,),
+                                 ratios=(1.0,)).asnumpy().reshape(2, 4, 1, 4)
+    # first cell: center (cx, cy) = (0.125, 0.25); half-width
+    # 0.5 * (2/4) / 2 = 0.125, half-height 0.25
+    assert a[0, 0, 0] == pytest.approx([0.0, 0.0, 0.25, 0.5], abs=1e-6)
+    wid = a[0, 0, 0, 2] - a[0, 0, 0, 0]
+    hei = a[0, 0, 0, 3] - a[0, 0, 0, 1]
+    assert wid == pytest.approx(0.25, abs=1e-6)
+    assert hei == pytest.approx(0.5, abs=1e-6)
+    # ratio anchors get the same aspect correction
+    a2 = nd.contrib.MultiBoxPrior(feat, sizes=(0.5,), ratios=(1.0, 4.0))
+    a2 = a2.asnumpy().reshape(2, 4, 2, 4)
+    w2 = a2[0, 0, 1, 2] - a2[0, 0, 1, 0]
+    h2 = a2[0, 0, 1, 3] - a2[0, 0, 1, 1]
+    # sqrt(4)=2: width 2x the corrected base, height half the base
+    assert w2 == pytest.approx(0.5, abs=1e-6)
+    assert h2 == pytest.approx(0.25, abs=1e-6)
+
+
+def test_bipartite_matching_batched():
+    """(B, N, M) input matches each batch row independently (gluoncv
+    matcher contract; advisor r4)."""
+    d0 = onp.array([[0.9, 0.1], [0.8, 0.7], [0.2, 0.3]], "f")
+    d1 = onp.array([[0.1, 0.9], [0.7, 0.8], [0.3, 0.2]], "f")
+    rows, cols = nd.contrib.bipartite_matching(_nd(onp.stack([d0, d1])))
+    assert rows.shape == (2, 3) and cols.shape == (2, 2)
+    assert rows.asnumpy()[0].tolist() == [0.0, 1.0, -1.0]
+    assert rows.asnumpy()[1].tolist() == [1.0, 0.0, -1.0]
+    assert cols.asnumpy()[1].tolist() == [1.0, 0.0]
+    # matches the per-slice 2-D results exactly
+    r0, c0 = nd.contrib.bipartite_matching(_nd(d0))
+    assert rows.asnumpy()[0].tolist() == r0.asnumpy().tolist()
+    # 4-D leading dims reshape through
+    d4 = onp.stack([onp.stack([d0, d1]), onp.stack([d1, d0])])
+    rows4, cols4 = nd.contrib.bipartite_matching(_nd(d4))
+    assert rows4.shape == (2, 2, 3) and cols4.shape == (2, 2, 2)
+    assert rows4.asnumpy()[0, 1].tolist() == rows.asnumpy()[1].tolist()
