@@ -27,11 +27,25 @@ __all__ = ["CalibrationCollector", "calib_graph", "quantize_model",
 
 
 class CalibrationCollector:
-    """Collects per-layer min/max over calibration batches
-    (reference _LayerOutputMinMaxCollector)."""
+    """Collects per-layer activation statistics over calibration batches
+    (reference _LayerOutputMinMaxCollector / _LayerHistogramCollector).
 
-    def __init__(self):
+    ``mode="naive"``: running min/max. ``mode="entropy"``: additionally
+    keeps the observed values so :meth:`ranges` can run the KL-optimal
+    threshold search (reference _get_optimal_thresholds /
+    src/operator/quantization/calibrate.cc) — the symmetric range that
+    minimizes the KL divergence between the clipped distribution and
+    its 255-level quantization, which ignores rare outliers that would
+    otherwise stretch the int8 grid."""
+
+    def __init__(self, mode="naive", num_bins=8001):
+        if mode not in ("naive", "entropy"):
+            raise MXNetError(f"unknown calib_mode {mode!r} "
+                             "(expected 'naive' or 'entropy')")
+        self.mode = mode
+        self.num_bins = num_bins
         self.min_max = {}
+        self._hists = {}   # name -> _RangeHistogram (entropy mode)
 
     def collect(self, name, arr):
         a = arr.asnumpy() if hasattr(arr, "asnumpy") else np.asarray(arr)
@@ -40,17 +54,154 @@ class CalibrationCollector:
             plo, phi = self.min_max[name]
             lo, hi = min(lo, plo), max(hi, phi)
         self.min_max[name] = (lo, hi)
+        if self.mode == "entropy":
+            h = self._hists.get(name)
+            if h is None:
+                h = self._hists[name] = _RangeHistogram(self.num_bins)
+            h.add(np.asarray(a, np.float32).ravel())
+
+    def ranges(self):
+        """Per-layer (lo, hi) to quantize against."""
+        if self.mode == "naive":
+            return self.min_max
+        out = {}
+        for name, h in self._hists.items():
+            th = _optimal_threshold_hist(h.hist, h.edges())
+            out[name] = (-th, th)
+        return out
 
 
-def calib_graph(net, calib_data, num_batches=10, inputs=False):
+class _RangeHistogram:
+    """Fixed-bin symmetric histogram whose range grows with the data:
+    memory per layer is one (num_bins,) float array regardless of how
+    many calibration batches run (the reference's histogram collector
+    does the same; storing raw activations was O(total activations))."""
+
+    def __init__(self, num_bins=8001):
+        self.num_bins = num_bins
+        self.amax = 0.0
+        self.hist = np.zeros(num_bins, np.float64)
+
+    def edges(self):
+        return np.linspace(-self.amax, self.amax, self.num_bins + 1)
+
+    def add(self, values):
+        amax = float(np.abs(values).max()) if values.size else 0.0
+        if amax > self.amax:
+            if self.hist.any():
+                # re-bin the existing mass into the wider range by its
+                # old bin centers (bounded coarsening, standard practice)
+                centers = 0.5 * (self.edges()[:-1] + self.edges()[1:])
+                old = self.hist
+                self.amax = amax
+                self.hist = np.histogram(
+                    centers, bins=self.num_bins,
+                    range=(-amax, amax), weights=old)[0].astype(np.float64)
+            else:
+                self.amax = amax
+        if self.amax == 0.0:
+            return
+        self.hist += np.histogram(values, bins=self.num_bins,
+                                  range=(-self.amax, self.amax))[0]
+
+
+def _smooth(p, eps=1e-4):
+    """Move eps mass onto empty bins so KL(p||q) stays finite; returns
+    None when the distribution has no support at all."""
+    zeros = p == 0
+    n_nonzero = p.size - int(zeros.sum())
+    if n_nonzero == 0:
+        return None
+    off = eps * float(zeros.sum()) / n_nonzero
+    out = p.astype(np.float64).copy()
+    out[zeros] = eps
+    out[~zeros] -= off
+    if (out[~zeros] <= 0).any():
+        return None
+    return out
+
+
+def _kl(p, q):
+    p = p / p.sum()
+    q = q / q.sum()
+    mask = p > 0
+    return float((p[mask] * np.log(p[mask] / q[mask])).sum())
+
+
+def _optimal_threshold(values, num_bins=8001, num_quantized_bins=255):
+    """KL-optimal threshold from raw values (tests / one-shot use;
+    the collector path feeds :func:`_optimal_threshold_hist` from its
+    memory-bounded histogram)."""
+    amax = float(np.abs(values).max()) if values.size else 0.0
+    if amax == 0.0:
+        return 0.0
+    hist, edges = np.histogram(values, bins=num_bins, range=(-amax, amax))
+    return _optimal_threshold_hist(hist.astype(np.float64), edges,
+                                   num_quantized_bins)
+
+
+def _optimal_threshold_hist(hist, edges, num_quantized_bins=255):
+    """KL-divergence-optimal symmetric clipping threshold (the TensorRT
+    calibration recipe the reference implements in calibrate.cc): over
+    a symmetric histogram, for each candidate half-width ``i`` bins,
+    compare the clipped distribution P (outliers folded into the edge
+    bins) against Q = P re-quantized to 255 levels; return the
+    threshold with minimal KL(P||Q)."""
+    num_bins = hist.shape[0]
+    amax = float(edges[-1])
+    if amax == 0.0 or not hist.any():
+        return 0.0
+    zero = num_bins // 2
+    half_q = num_quantized_bins // 2
+    best_th, best_kl = amax, np.inf
+    for i in range(half_q + 1, zero + 1):
+        lo, hi = zero - i, zero + i + 1
+        p = hist[lo:hi].copy()
+        p[0] += hist[:lo].sum()
+        p[-1] += hist[hi:].sum()
+        support = hist[lo:hi] != 0  # clipped-view support, pre-fold
+        # quantize the sliced histogram into 255 equal-width groups:
+        # each group's mass spreads uniformly over its occupied bins
+        n = hi - lo
+        merged = n // num_quantized_bins
+        main = hist[lo:lo + merged * num_quantized_bins].reshape(
+            num_quantized_bins, merged)
+        gmass = main.sum(axis=1)
+        gmass[-1] += hist[lo + merged * num_quantized_bins:hi].sum()
+        occ = (main != 0).sum(axis=1).astype(np.float64)
+        tail_occ = (hist[lo + merged * num_quantized_bins:hi] != 0).sum()
+        occ[-1] += tail_occ
+        per_bin = np.divide(gmass, occ, out=np.zeros_like(gmass),
+                            where=occ > 0)
+        q = np.repeat(per_bin, merged)
+        q = np.concatenate([q, np.full(n - q.size, per_bin[-1])])
+        q[~support] = 0.0
+        ps, qs = _smooth(p), _smooth(q)
+        if ps is None or qs is None:
+            continue
+        kl = _kl(ps, qs)
+        if kl < best_kl:
+            best_kl, best_th = kl, float(edges[hi])
+    return best_th
+
+
+def calib_graph(net, calib_data, num_batches=10, inputs=False,
+                mode="naive"):
     """Run calibration batches through a Block, hooking layer outputs
     (or inputs with ``inputs=True`` — what the int8 layers consume)."""
-    collector = CalibrationCollector()
+    collector = CalibrationCollector(mode=mode)
     handles = []
 
     def walk(block):
         for name, child in block._children.items():
-            if inputs:
+            # entropy mode accumulates an 8001-bin histogram per hooked
+            # block — hook LEAVES only (the rewrite consumes leaf-layer
+            # ranges; container hooks would histogram every tensor once
+            # per nesting level for nothing)
+            hook_this = mode != "entropy" or not child._children
+            if not hook_this:
+                pass
+            elif inputs:
                 def make_pre(n):
                     def hook(blk, ins):
                         collector.collect(n, ins[0])
@@ -74,7 +225,7 @@ def calib_graph(net, calib_data, num_batches=10, inputs=False):
             break
     for h in handles:
         h.detach()
-    return collector.min_max
+    return collector.ranges()
 
 
 from ..gluon.block import HybridBlock  # noqa: E402
@@ -242,9 +393,11 @@ def quantize_net(net, quantized_dtype="int8", calib_data=None,
     """Rewrite ``net`` so Dense/Conv2D children execute in int8.
 
     With ``calib_data``: per-layer INPUT ranges are collected first
-    (static activation scales). Without: dynamic per-batch ranges.
-    Returns the same net object (rewritten in place), reference-API
-    compatible.
+    (static activation scales) — ``calib_mode="naive"`` uses running
+    min/max, ``"entropy"`` the KL-optimal clipping threshold (reference
+    _get_optimal_thresholds), which ignores rare outliers. Without
+    calib_data: dynamic per-batch ranges. Returns the same net object
+    (rewritten in place), reference-API compatible.
 
     Conv->BatchNorm pairs inside (Hybrid)Sequential containers are
     folded into the int8 conv (BN dropped); conv weight scales are
@@ -270,7 +423,7 @@ def quantize_net(net, quantized_dtype="int8", calib_data=None,
     if calib_data is not None:
         ranges = calib_graph(net, calib_data,
                              num_batches=max(1, num_calib_examples // 32),
-                             inputs=True)
+                             inputs=True, mode=calib_mode)
 
     def rewrite(block):
         items = list(block._children.items())
@@ -340,6 +493,19 @@ def _chain_s8_interfaces(net):
         return (type(child) is _nn.Activation
                 and getattr(child, "_act_type", None) == "relu")
 
+    # chaining mutates the conv INSTANCE (_out_req/_prequantized), so a
+    # conv shared by a second dataflow path would return s8 there too —
+    # count every block's occurrences across the whole tree and leave
+    # any shared instance unchained
+    counts = {}
+
+    def count(block):
+        for _, c in block._children.items():
+            counts[id(c)] = counts.get(id(c), 0) + 1
+            count(c)
+
+    count(net)
+
     def walk(block):
         if isinstance(block, (_nn.Sequential, _nn.HybridSequential)):
             items = [c for _, c in block._children.items()]
@@ -348,11 +514,15 @@ def _chain_s8_interfaces(net):
                     continue
                 if child._act is not None:
                     continue  # inline act would run pre-requant
+                if counts.get(id(child), 0) > 1:
+                    continue  # shared producer: another path needs bf16
                 j = i + 1
                 while j < len(items) and passthrough(items[j]):
                     j += 1
                 if j < len(items) and isinstance(items[j], QuantizedConv2D):
                     consumer = items[j]
+                    if counts.get(id(consumer), 0) > 1:
+                        continue  # shared consumer: other path feeds bf16
                     amax = float(consumer.act_amax.data().asnumpy()[0])
                     if amax > 0:  # static calibrated range only
                         child._out_req = consumer.act_amax
