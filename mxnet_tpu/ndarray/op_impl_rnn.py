@@ -162,7 +162,9 @@ def _run_layer(x, w_i2h, w_h2h, b_i2h, b_h2h, h0, c0, mode, reverse=False):
     return out, h_last, c_last
 
 
-@register_op("RNN", wrap=False)
+@register_op("RNN", wrap=False,
+             infer_num_outputs=lambda params:
+             3 if str(params.get("mode", "lstm")) == "lstm" else 2)
 def rnn(data, parameters, state, state_cell=None, sequence_length=None,
         state_size=0, num_layers=1, bidirectional=False, mode="lstm",
         p=0.0, state_outputs=False, projection_size=None,

@@ -215,13 +215,12 @@ for _f in ("split", "array_split", "hsplit", "vsplit", "meshgrid",
 
 
 def __getattr__(attr):
-    """Unknown names raise the NAMED pointer-at-hybridize error, not a
-    bare AttributeError (eager mx.np has many functions with no
-    single-op symbolic lowering — creation fns, composed helpers).
-    Dunder probes keep AttributeError semantics (hasattr/inspect)."""
-    if attr.startswith("__"):
-        raise AttributeError(attr)
-    raise NotImplementedError(
+    """Unknown names raise AttributeError carrying the pointer-at-
+    hybridize message (eager mx.np has many functions with no single-op
+    symbolic lowering — creation fns, composed helpers). AttributeError
+    — not NotImplementedError — so hasattr()/getattr(..., default)
+    introspection keeps working."""
+    raise AttributeError(
         f"sym.np.{attr} has no symbolic lowering — hybridize the block "
         f"instead (the compiled path supports all of mx.np), or use "
         f"mx.sym.zeros/ones/arange for symbolic creation")

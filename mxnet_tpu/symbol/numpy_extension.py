@@ -84,8 +84,8 @@ __all__.append("reshape")
 
 
 def __getattr__(attr):
-    if attr.startswith("__"):
-        raise AttributeError(attr)
-    raise NotImplementedError(
+    # AttributeError (not NotImplementedError) keeps hasattr/getattr
+    # introspection semantics while preserving the pointer message
+    raise AttributeError(
         f"sym.npx.{attr} has no symbolic lowering — hybridize the "
         f"block instead (the compiled path supports all of mx.npx)")

@@ -20,6 +20,23 @@ from ..context import current_context
 from ..name import NameManager
 from ..attribute import AttrScope
 
+_TRAINING_AWARE = {}
+_POSITIONAL_NAMES = {}
+
+
+def _accepts_training(op):
+    """True when the op impl takes a ``_training`` kwarg (Dropout/RNN —
+    the stateful ops the reference gates on Imperative::is_training)."""
+    hit = _TRAINING_AWARE.get(op.name)
+    if hit is None:
+        import inspect
+        try:
+            hit = "_training" in inspect.signature(op.fn).parameters
+        except (TypeError, ValueError):
+            hit = False
+        _TRAINING_AWARE[op.name] = hit
+    return hit
+
 __all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json"]
 
 
@@ -275,6 +292,15 @@ class Symbol:
                       if v is not None}
             from ..ndarray.register import _note_invocation
             _note_invocation(op)
+            # stateful ops (Dropout/RNN) gate on the _training kwarg;
+            # the eager wrappers inject it at invoke time (ndarray/
+            # __init__.py) but this raw-fn walk bypasses them — without
+            # the injection Dropout's default _training=True ran dropout
+            # in predict-mode executors (caught by the ONNX inception
+            # round-trip)
+            if "_training" not in params and _accepts_training(op):
+                from .. import autograd as _ag
+                params["_training"] = _ag.is_training()
             out = op.fn(*flat, **params)
             vis = op.num_visible_outputs
             if vis is not None and isinstance(out, (tuple, list)):
@@ -739,8 +765,44 @@ def _populate_symbol_ops(module):
     def make(op):
         static_input_names = _OP_INPUT_NAMES.get(op.name)
 
+        def positional_names():
+            # op-fn parameter names in declaration order, for folding
+            # scalar positional args (sym.clip(x, 0, 6)) into attrs —
+            # raw scalars in _inputs would break every graph walker
+            names = _POSITIONAL_NAMES.get(op.name)
+            if names is None:
+                import inspect
+                try:
+                    names = []
+                    for p in inspect.signature(op.fn).parameters.values():
+                        if p.kind not in (p.POSITIONAL_ONLY,
+                                          p.POSITIONAL_OR_KEYWORD):
+                            break  # *args/keyword-only: unmappable
+                        names.append(p.name)
+                except (TypeError, ValueError):
+                    names = []
+                _POSITIONAL_NAMES[op.name] = names
+            return names
+
         def sym_fn(*args, **kwargs):
             name = kwargs.pop("name", None)
+            if any(not isinstance(a, (Symbol, type(None))) for a in args):
+                pos = positional_names()
+                folded = []
+                extra = {}
+                for i, a in enumerate(args):
+                    if isinstance(a, (Symbol, type(None))):
+                        folded.append(a)
+                    elif i < len(pos):
+                        extra[pos[i]] = a
+                    else:
+                        raise MXNetError(
+                            f"sym.{op.name}: positional argument {i} "
+                            f"({a!r}) is neither a Symbol nor mappable "
+                            "to a keyword parameter")
+                args = tuple(folded)
+                extra.update(kwargs)
+                kwargs = extra
             input_names = static_input_names
             if input_names is None and \
                     getattr(op, "infer_input_names", None) is not None:

@@ -761,11 +761,14 @@ def test_np_symbolic_review_regressions():
     ec = c.bind(mx.current_context(), {"a": mx.nd.array(_X),
                                        "b": mx.nd.array(_Y)})
     assert ec.forward()[0].shape == (_X.size + _Y.size,)
-    # unknown eager-only names raise the NAMED error
-    with _pytest.raises(NotImplementedError, match="hybridize"):
+    # unknown eager-only names raise the NAMED error — as
+    # AttributeError so hasattr/getattr introspection still works
+    with _pytest.raises(AttributeError, match="hybridize"):
         mx.sym.np.zeros((3,))
-    with _pytest.raises(NotImplementedError, match="hybridize"):
+    with _pytest.raises(AttributeError, match="hybridize"):
         mx.sym.npx.save("f", {})
+    assert not hasattr(mx.sym.np, "zeros")
+    assert getattr(mx.sym.npx, "save", None) is None
     # under-supplied binary fails AT BUILD with a clear message
     with _pytest.raises(TypeError, match="tensor argument"):
         mx.sym.np.dot(a)
