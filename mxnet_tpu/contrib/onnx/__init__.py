@@ -90,6 +90,9 @@ def _attr(name, value):
         if value and isinstance(value[0], float):
             a.type = pb.AttributeProto.FLOATS
             a.floats.extend(value)
+        elif value and isinstance(value[0], str):
+            a.type = pb.AttributeProto.STRINGS
+            a.strings.extend(v.encode() for v in value)
         else:
             a.type = pb.AttributeProto.INTS
             a.ints.extend(int(v) for v in value)
@@ -135,9 +138,141 @@ def _tup(v, n=None):
     return t
 
 
-def _export_node(ex, op_name, attrs, ins, out_name=None):
-    """Map one mxnet op application to ONNX node(s); returns output name."""
+# ---------------------------------------------------------------------------
+# fused RNN op <-> ONNX LSTM/GRU/RNN (reference mx2onnx _op_translations
+# RNN coverage). Gate-order maps between the cuDNN-canonical packed
+# vector (op_impl_rnn.py: LSTM [i,f,g,o], GRU [r,z,n]) and the ONNX
+# layouts (LSTM [i,o,f,c], GRU [z,r,h], W/R/B stacked per direction).
+_RNN_GATES = {"lstm": 4, "gru": 3, "rnn_relu": 1, "rnn_tanh": 1}
+_RNN_ONNX_OP = {"lstm": "LSTM", "gru": "GRU",
+                "rnn_relu": "RNN", "rnn_tanh": "RNN"}
+# ours->onnx block permutation (onnx = ours[perm])
+_RNN_PERM = {"lstm": (0, 3, 1, 2), "gru": (1, 0, 2),
+             "rnn_relu": (0,), "rnn_tanh": (0,)}
+# onnx->ours (inverse permutation)
+_RNN_INV = {"lstm": (0, 2, 3, 1), "gru": (1, 0, 2),
+            "rnn_relu": (0,), "rnn_tanh": (0,)}
+
+
+def _gate_perm(mat, mode, perm_table):
+    """Permute the H-row gate blocks of a (gates*H, X) matrix or
+    (gates*H,) bias vector."""
+    gates = _RNN_GATES[mode]
+    perm = list(perm_table[mode])
+    blocks = mat.reshape((gates, mat.shape[0] // gates) + mat.shape[1:])
+    return blocks[perm].reshape(mat.shape)
+
+
+def _rnn_unpack(packed, mode, H, L, D):
+    """Split the cuDNN-canonical flat vector into per-layer/direction
+    (w_i2h, w_h2h, b_i2h, b_h2h) numpy arrays (layout per
+    op_impl_rnn._unpack_params; input size inferred from total length)."""
+    gates = _RNN_GATES[mode]
+    rest_w = (L - 1) * D * gates * H * (D * H + H)
+    total_b = L * D * gates * H * 2
+    first_w = packed.size - rest_w - total_b
+    isz0 = first_w // (D * gates * H) - H
+    if isz0 <= 0 or D * gates * H * (isz0 + H) != first_w:
+        raise MXNetError(
+            f"packed RNN parameter vector of size {packed.size} does not "
+            f"match mode={mode} H={H} L={L} D={D}")
+    ws = []
+    idx = 0
+    for layer in range(L):
+        isz = isz0 if layer == 0 else D * H
+        per = []
+        for _ in range(D):
+            w_i2h = packed[idx:idx + gates * H * isz].reshape(gates * H, isz)
+            idx += gates * H * isz
+            w_h2h = packed[idx:idx + gates * H * H].reshape(gates * H, H)
+            idx += gates * H * H
+            per.append([w_i2h, w_h2h])
+        ws.append(per)
+    for layer in range(L):
+        for d in range(D):
+            b_i2h = packed[idx:idx + gates * H]
+            idx += gates * H
+            b_h2h = packed[idx:idx + gates * H]
+            idx += gates * H
+            ws[layer][d].extend([b_i2h, b_h2h])
+    return ws
+
+
+def _export_rnn(ex, a, ins, params_lookup):
+    """Emit ONNX LSTM/GRU/RNN node(s) for one fused-RNN application;
+    returns [output, h_out(, c_out)] names."""
+    mode = str(a.get("mode", "lstm"))
+    if mode not in _RNN_GATES:
+        raise MXNetError(f"RNN mode {mode!r} not exportable")
+    H = int(a["state_size"])
+    L = int(a.get("num_layers", 1))
+    D = 2 if str(a.get("bidirectional", False)) in ("True", "1", "true") \
+        else 1
+    packed = params_lookup(ins[1])
+    if packed is None:
+        raise MXNetError(
+            "RNN export needs the packed parameter vector as a constant "
+            f"initializer; {ins[1]!r} is a free graph input")
+    layers = _rnn_unpack(np.asarray(packed, np.float32).ravel(),
+                         mode, H, L, D)
+    lstm = mode == "lstm"
+    onnx_op = _RNN_ONNX_OP[mode]
+
+    def state_for(layer, name):
+        if L == 1:
+            return name  # already (D, N, H)
+        return ex.node("Slice",
+                       [name, ex.const_i64([layer * D]),
+                        ex.const_i64([(layer + 1) * D]), ex.const_i64([0])])
+
+    x = ins[0]
+    hs, cs = [], []
+    for layer in range(L):
+        W = np.stack([_gate_perm(d[0], mode, _RNN_PERM)
+                      for d in layers[layer]])
+        R = np.stack([_gate_perm(d[1], mode, _RNN_PERM)
+                      for d in layers[layer]])
+        B = np.stack([np.concatenate([_gate_perm(d[2], mode, _RNN_PERM),
+                                      _gate_perm(d[3], mode, _RNN_PERM)])
+                      for d in layers[layer]])
+        wn, rn, bn = (ex.uniq(f"rnn_{t}{layer}") for t in ("W", "R", "B"))
+        for nm, arr in ((wn, W), (rn, R), (bn, B)):
+            ex.g.initializer.append(_np_tensor(nm, arr))
+        node_ins = [x, wn, rn, bn, "", state_for(layer, ins[2])]
+        if lstm:
+            node_ins.append(state_for(layer, ins[3]))
+        outs = [ex.uniq("rnn_Y"), ex.uniq("rnn_Yh")]
+        if lstm:
+            outs.append(ex.uniq("rnn_Yc"))
+        kw = {"hidden_size": H,
+              "direction": "bidirectional" if D == 2 else "forward"}
+        if mode == "gru":
+            # cuDNN computes n = tanh(Wx + r*(Rh + bR))
+            kw["linear_before_reset"] = 1
+        if onnx_op == "RNN":
+            kw["activations"] = ["Relu" if mode == "rnn_relu"
+                                 else "Tanh"] * D
+        ex.node(onnx_op, node_ins, outs, **kw)
+        # Y (T, D, N, H) -> (T, N, D*H) for the next layer / output
+        tr = ex.node("Transpose", [outs[0]], perm=(0, 2, 1, 3))
+        x = ex.node("Reshape", [tr, ex.const_i64((0, 0, D * H))])
+        hs.append(outs[1])
+        if lstm:
+            cs.append(outs[2])
+    h = hs[0] if L == 1 else ex.node("Concat", hs, axis=0)
+    if lstm:
+        c = cs[0] if L == 1 else ex.node("Concat", cs, axis=0)
+        return [x, h, c]
+    return [x, h]
+
+
+def _export_node(ex, op_name, attrs, ins, out_name=None,
+                 params_lookup=None):
+    """Map one mxnet op application to ONNX node(s); returns the output
+    name (or a LIST of names for multi-output ops like RNN)."""
     a = {k: v for k, v in attrs.items() if v is not None}
+    if op_name == "RNN":
+        return _export_rnn(ex, a, ins, params_lookup)
     if op_name in _UNARY_EXPORT:
         return ex.node(_UNARY_EXPORT[op_name], ins, [out_name] if out_name else None)
     if op_name in _BINARY_EXPORT:
@@ -189,6 +324,11 @@ def _export_node(ex, op_name, attrs, ins, out_name=None):
         kw = dict(kernel_shape=k,
                   strides=_tup(a.get("stride")) or (1,) * len(k),
                   pads=pads + pads)
+        # pooling_convention="full" is mxnet's ceil_mode (gluon
+        # MaxPool2D(ceil_mode=True)); dropping it silently shifted
+        # squeezenet's pool shapes by one
+        if str(a.get("pooling_convention", "valid")) == "full":
+            kw["ceil_mode"] = 1
         if ptype == "max":
             return ex.node("MaxPool", ins, [out_name] if out_name else None, **kw)
         kw["count_include_pad"] = int(str(a.get("count_include_pad", True))
@@ -220,12 +360,16 @@ def _export_node(ex, op_name, attrs, ins, out_name=None):
         return ex.node("Gather", [ins[1], ins[0]],
                        [out_name] if out_name else None, axis=0)
     if op_name == "clip":
+        # bounds arrive as attrs (a_min/a_max kwargs) or as scalar
+        # positional inputs (sym.clip(x, 0, 6))
+        lo = a.get("a_min", ins[1] if len(ins) > 1 else 0.0)
+        hi = a.get("a_max", ins[2] if len(ins) > 2 else 0.0)
         ex_lo = ex.uniq("clip_min")
         ex_hi = ex.uniq("clip_max")
         ex.g.initializer.append(_np_tensor(
-            ex_lo, np.asarray(float(a.get("a_min", 0.0)), np.float32)))
+            ex_lo, np.asarray(float(lo), np.float32)))
         ex.g.initializer.append(_np_tensor(
-            ex_hi, np.asarray(float(a.get("a_max", 0.0)), np.float32)))
+            ex_hi, np.asarray(float(hi), np.float32)))
         return ex.node("Clip", [ins[0], ex_lo, ex_hi],
                        [out_name] if out_name else None)
     raise MXNetError(f"op {op_name!r} has no ONNX export mapping")
@@ -258,9 +402,20 @@ def export_model(sym, params, input_shapes=None, input_types=None,
     shapes = dict(input_shapes or {})
     names: dict = {}
 
+    def params_lookup(name):
+        arr = params.get(name)
+        return arr.asnumpy() if arr is not None else None
+
+    def first(v):
+        # a multi-output node used directly as an input means output 0
+        return v[0] if isinstance(v, list) else v
+
     def emit(node):
         if node._base is not None:
-            return emit(node._base)  # single-output subset
+            outs = emit(node._base)
+            if isinstance(outs, list):
+                return outs[node._output_index or 0]
+            return outs  # single-output subset
         if id(node) in names:
             return names[id(node)]
         if node._op is None:
@@ -272,16 +427,20 @@ def export_model(sym, params, input_shapes=None, input_types=None,
                 for d in shapes.get(node._name, ()):
                     vi.type.tensor_type.shape.dim.add().dim_value = int(d)
             return node._name
-        ins = [emit(i) for i in node._inputs]
+        # scalar positional args (sym.clip(x, 0, 6)) ride through as
+        # python values for the op branch to fold into attributes
+        ins = [first(emit(i)) if isinstance(i, Symbol) else i
+               for i in node._inputs]
         attrs = {k: v for k, v in node._attrs.items() if not k.startswith("__")}
         out = _export_node(ex, node._op.name, attrs, ins,
-                           out_name=node._name + "_out" if node._name else None)
+                           out_name=node._name + "_out" if node._name else None,
+                           params_lookup=params_lookup)
         names[id(node)] = out
         return out
 
     outputs = sym._inputs if sym._is_group() else [sym]
     for o in outputs:
-        out_name = emit(o)
+        out_name = first(emit(o))
         vi = g.output.add()
         vi.name = out_name
         vi.type.tensor_type.elem_type = pb.TensorProto.FLOAT
@@ -327,6 +486,90 @@ def _get_attrs(n):
             out[a.name] = tuple(a.ints)
         elif a.type == pb.AttributeProto.FLOATS:
             out[a.name] = tuple(a.floats)
+        elif a.type == pb.AttributeProto.STRINGS:
+            out[a.name] = tuple(s.decode() for s in a.strings)
+    return out
+
+
+def _import_rnn(symmod, nd, n, a, ins, inits, env, arg_params, sym_of):
+    """ONNX LSTM/GRU/RNN node → fused sym.RNN; returns
+    {onnx_output_name: Symbol} for the outputs the node declares."""
+    t = n.op_type
+    H = int(a["hidden_size"])
+    direction = a.get("direction", "forward")
+    if direction == "reverse":
+        raise MXNetError(f"{t}: direction='reverse' is not supported "
+                         "(wrap in bidirectional or flip the sequence)")
+    D = 2 if direction == "bidirectional" else 1
+    if t == "LSTM":
+        mode = "lstm"
+    elif t == "GRU":
+        mode = "gru"
+        if not int(a.get("linear_before_reset", 0)):
+            raise MXNetError(
+                "GRU with linear_before_reset=0 differs from the "
+                "cuDNN-canonical cell this framework computes")
+    else:
+        acts = tuple(s.lower() for s in a.get("activations", ("tanh",) * D))
+        if any(s != acts[0] for s in acts) or acts[0] not in ("tanh", "relu"):
+            raise MXNetError(f"RNN activations {acts} not supported")
+        mode = f"rnn_{acts[0]}"
+    gates = _RNN_GATES[mode]
+    W = inits.get(ins[1])
+    R = inits.get(ins[2])
+    if W is None or R is None:
+        raise MXNetError(f"{t}: W/R must be constant initializers")
+    B = inits.get(ins[3]) if len(ins) > 3 and ins[3] else \
+        np.zeros((D, 2 * gates * H), np.float32)
+    if B is None:
+        raise MXNetError(f"{t}: B must be a constant initializer")
+    ws, bs = [], []
+    for d in range(D):
+        ws.append(_gate_perm(np.asarray(W[d], np.float32),
+                             mode, _RNN_INV).ravel())
+        ws.append(_gate_perm(np.asarray(R[d], np.float32),
+                             mode, _RNN_INV).ravel())
+        bs.append(_gate_perm(np.asarray(B[d][:gates * H], np.float32),
+                             mode, _RNN_INV))
+        bs.append(_gate_perm(np.asarray(B[d][gates * H:], np.float32),
+                             mode, _RNN_INV))
+    packed = np.concatenate(ws + bs)
+    pname = (n.name or f"{t.lower()}_{n.output[0]}") + "_parameters"
+    env[pname] = ("var", symmod.var(pname))
+    arg_params[pname] = nd.array(packed)
+    # W/R/B are consumed into the packed vector — they must not linger
+    # as free parameters the caller would have to feed
+    for consumed in ins[1:4]:
+        arg_params.pop(consumed, None)
+    if len(ins) > 4 and ins[4]:
+        raise MXNetError(
+            f"{t}: sequence_lens input is not supported — the fused RNN "
+            "would run the full recurrence over padding and silently "
+            "diverge from the ONNX-spec masked result")
+    if len(ins) > 5 and ins[5]:
+        init_h = sym_of(ins[5])
+    else:
+        raise MXNetError(
+            f"{t}: initial_h input is required (implicit zero states "
+            "need a static batch size this importer does not carry)")
+    args = [sym_of(ins[0]), env[pname][1], init_h]
+    if mode == "lstm":
+        if len(ins) > 6 and ins[6]:
+            args.append(sym_of(ins[6]))
+        else:
+            raise MXNetError("LSTM: initial_c input is required")
+    r = symmod.RNN(*args, state_size=H, num_layers=1,
+                   bidirectional=D == 2, mode=mode, state_outputs=True)
+    # our output (T, N, D*H) -> ONNX Y (T, D, N, H)
+    y = symmod.transpose(symmod.reshape(r[0], shape=(0, 0, D, H)),
+                         axes=(0, 2, 1, 3))
+    out = {n.output[0]: y} if n.output[0] else {}
+    if len(n.output) > 1 and n.output[1]:
+        out[n.output[1]] = r[1]
+    if mode == "lstm" and len(n.output) > 2 and n.output[2]:
+        out[n.output[2]] = r[2]
+    if not out:
+        out = {"_unused": y}
     return out
 
 
@@ -370,7 +613,25 @@ def import_model(onnx_file_path):
         a = _get_attrs(n)
         t = n.op_type
         ins = list(n.input)
-        if t in _UNARY_IMPORT:
+        multi = None  # multi-output nodes set {output_name: sym}
+        if t in ("LSTM", "GRU", "RNN"):
+            multi = _import_rnn(symmod, nd, n, a, ins, inits, env,
+                                arg_params, sym_of)
+            res = next(iter(multi.values()))
+        elif t == "Slice":
+            starts = np.asarray(val(ins[1])).ravel()
+            ends = np.asarray(val(ins[2])).ravel()
+            axes = (np.asarray(val(ins[3])).ravel() if len(ins) > 3
+                    else np.arange(starts.size))
+            if len(ins) > 4:
+                steps = np.asarray(val(ins[4])).ravel()
+                if (steps != 1).any():
+                    raise MXNetError("Slice with steps != 1 not supported")
+            res = sym_of(ins[0])
+            for ax, b, e in zip(axes, starts, ends):
+                res = symmod.slice_axis(res, axis=int(ax), begin=int(b),
+                                        end=int(e))
+        elif t in _UNARY_IMPORT:
             res = getattr(symmod, "flatten" if t == "Flatten" else _UNARY_IMPORT[t])(sym_of(ins[0])) \
                 if t != "Flatten" else symmod.Flatten(sym_of(ins[0]))
         elif t in _BINARY_IMPORT:
@@ -422,6 +683,8 @@ def import_model(onnx_file_path):
                 sym_of(ins[0]), kernel=k,
                 pool_type="max" if t == "MaxPool" else "avg",
                 stride=tuple(a.get("strides", (1,) * len(k))), pad=pads,
+                pooling_convention=("full" if int(a.get("ceil_mode", 0))
+                                    else "valid"),
                 # ONNX spec default: EXCLUDE padding from the average
                 count_include_pad=bool(a.get("count_include_pad", 0)))
         elif t in ("GlobalMaxPool", "GlobalAveragePool"):
@@ -464,7 +727,11 @@ def import_model(onnx_file_path):
             res = symmod.Activation(sym_of(ins[0]), act_type="softrelu")
         else:
             raise MXNetError(f"ONNX op {t!r} has no import mapping")
-        env[n.output[0]] = ("var", res)
+        if multi is not None:
+            for out_name, s in multi.items():
+                env[out_name] = ("var", s)
+        else:
+            env[n.output[0]] = ("var", res)
 
     outputs = [sym_of(vi.name) for vi in g.output]
     out_sym = outputs[0] if len(outputs) == 1 else symmod.Group(outputs)
