@@ -1,0 +1,207 @@
+"""Per-fusion HBM-roofline profile of a bench config (VERDICT r4 #2).
+
+Captures an xprof device trace of the jitted train step (the same step
+``bench.py`` times), parses ``hlo_stats``, and emits:
+
+- the top fusions by device time with their true-HBM bandwidth
+  (``hbm_bw`` column — NOT ``measured_memory_bw``, which mixes
+  CMEM/VMEM and reads above peak), each as a fraction of the chip's
+  peak HBM bandwidth;
+- aggregate true HBM bytes/step — the honest ``hbm_frac`` numerator
+  (XLA cost-analysis ``bytes accessed`` over-counts fused re-reads and
+  read >1.0 on the ResNet train config, BENCH_r04);
+- backward-pass shares by role (wgrad/dgrad/bn-vjp/optimizer), keyed
+  off HLO op-name metadata.
+
+Usage (on the TPU host, repo root):
+    python tools/xprof_roofline.py [--model resnet50] [--steps 5]
+    python tools/xprof_roofline.py --inspect   # dump available columns
+
+The tool reuses bench.py's model builders so the profiled program IS
+the benchmarked program (chain=1: per-step attribution needs step
+boundaries, and the scan body executes the same kernels).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _capture(step, args_, steps, trace_dir):
+    import jax
+
+    # one warm call compiles + pages weights
+    out = step(*args_)
+    jax.block_until_ready(out)
+    with jax.profiler.trace(trace_dir):
+        for _ in range(steps):
+            out = step(*out[:2], *args_[2:])
+        jax.block_until_ready(out)
+    return out
+
+
+def _tool_data(trace_dir, tool="hlo_stats"):
+    """Parse the raw xspace files into the named xprof tool's table."""
+    import glob
+
+    from xprof.convert.raw_to_tool_data import xspace_to_tool_data
+
+    paths = glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"),
+                      recursive=True)
+    if not paths:
+        raise RuntimeError(f"no xplane.pb under {trace_dir}")
+    data, _ = xspace_to_tool_data(paths, tool, {})
+    if isinstance(data, bytes):
+        data = data.decode()
+    return data
+
+
+def _rows(csvish):
+    """hlo_stats arrives as CSV text; yield dict rows."""
+    import csv
+    import io
+
+    rd = csv.DictReader(io.StringIO(csvish))
+    for row in rd:
+        yield row
+
+
+def _f(row, *keys, default=0.0):
+    for k in keys:
+        if k in row and row[k] not in ("", None):
+            try:
+                return float(row[k])
+            except ValueError:
+                continue
+    return default
+
+
+def classify(name, program_id=""):
+    """Role of an HLO op from its name/metadata (heuristic, printed
+    alongside raw names so misclassification is visible)."""
+    n = name.lower()
+    if "transpose" in n and "conv" in n:
+        return "wgrad/dgrad-conv"
+    if "conv" in n:
+        return "conv"
+    if any(t in n for t in ("batch-norm", "batchnorm", "bn_")):
+        return "batchnorm"
+    if any(t in n for t in ("sgd", "momentum", "optimizer", "multi_sgd")):
+        return "optimizer"
+    if "all-reduce" in n:
+        return "collective"
+    if "fusion" in n:
+        return "fusion"
+    return "other"
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="resnet50")
+    ap.add_argument("--steps", type=int, default=5)
+    ap.add_argument("--top", type=int, default=20)
+    ap.add_argument("--inspect", action="store_true",
+                    help="dump the hlo_stats columns and exit")
+    ap.add_argument("--trace-dir", default=None)
+    opts = ap.parse_args()
+
+    os.environ.setdefault("BENCH_CHAIN", "1")
+    import bench  # noqa: E402  (repo-root script; reuses its builders)
+    import jax
+
+    trace_dir = opts.trace_dir or tempfile.mkdtemp(prefix="xprof_")
+
+    if opts.model == "resnet50":
+        import jax.numpy as jnp
+        import numpy as np
+
+        import mxnet_tpu as mx
+        from mxnet_tpu.gluon.block import functionalize
+        from mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
+
+        bench._setup_cache()
+        ctx = mx.current_context()
+        net = resnet50_v1(classes=1000)
+        net.initialize(init=mx.initializer.Xavier(), ctx=ctx)
+        net.cast("bfloat16")
+        warm = mx.nd.zeros((2, 3, 224, 224), ctx=ctx, dtype="bfloat16")
+        with mx.autograd.predict_mode():
+            net(warm)
+        fn, params = functionalize(net, training=True, ctx=ctx)
+
+        def loss_fn(p, rng, x, y):
+            logits = fn(p, rng, x)
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            return -jnp.take_along_axis(logp, y[:, None], axis=-1).mean()
+
+        step = bench._make_momentum_sgd(loss_fn, 0.1)
+        moms = bench._zeros_moms(params)
+        rng = jax.random.PRNGKey(0)
+        b = int(os.environ.get("BENCH_BATCH", "128"))
+        x = jnp.asarray(np.random.RandomState(0).rand(b, 3, 224, 224),
+                        jnp.bfloat16)
+        y = jnp.asarray(np.random.RandomState(1).randint(0, 1000, b),
+                        jnp.int32)
+        _capture(step, (params, moms, rng, x, y), opts.steps, trace_dir)
+    else:
+        raise SystemExit(f"unknown --model {opts.model}")
+
+    data = _tool_data(trace_dir)
+    rows = list(_rows(data))
+    if opts.inspect:
+        print(json.dumps({"columns": list(rows[0].keys()) if rows else [],
+                          "n_rows": len(rows)}, indent=2))
+        return
+
+    peak_gbps = bench._peak_hbm_gbps()
+    peak_tf = bench._peak_tflops()
+    total_us = sum(_f(r, "Total Duration (us)", "total_time_us",
+                      "Avg. duration (us)") for r in rows)
+    recs = []
+    hbm_bytes = 0.0
+    for r in rows:
+        us = _f(r, "Total Duration (us)", "total_time_us")
+        bw = _f(r, "hbm_bw", "HBM Bandwidth (GB/s)", "hbm_bw (GB/s)")
+        name = (r.get("HLO Op Name") or r.get("hlo_op_name")
+                or r.get("HLO Op") or "?")
+        cat = (r.get("Op Category") or r.get("category") or "")
+        bound = (r.get("Bound by") or r.get("bound_by") or "")
+        hbm_bytes += bw * 1e9 * us * 1e-6
+        recs.append({"name": name[:80], "cat": cat, "us": us,
+                     "hbm_gbps": bw,
+                     "roofline_frac": round(bw / peak_gbps, 3)
+                     if peak_gbps else 0.0,
+                     "bound_by": bound,
+                     "role": classify(name)})
+    recs.sort(key=lambda r: -r["us"])
+    per_step_bytes = hbm_bytes / max(opts.steps, 1)
+    role_us = {}
+    for r in recs:
+        role_us[r["role"]] = role_us.get(r["role"], 0.0) + r["us"]
+    out = {
+        "model": opts.model,
+        "steps": opts.steps,
+        "total_device_us": round(total_us, 1),
+        "per_step_ms": round(total_us / 1000.0 / max(opts.steps, 1), 3),
+        "true_hbm_bytes_per_step": round(per_step_bytes),
+        "true_hbm_gbps": round(per_step_bytes /
+                               (total_us * 1e-6 / max(opts.steps, 1)) / 1e9,
+                               1) if total_us else 0.0,
+        "peak_hbm_gbps": peak_gbps,
+        "peak_tflops": peak_tf,
+        "role_shares": {k: round(v / total_us, 4) if total_us else 0.0
+                        for k, v in sorted(role_us.items(),
+                                           key=lambda kv: -kv[1])},
+        "top_fusions": recs[:opts.top],
+        "trace_dir": trace_dir,
+    }
+    print(json.dumps(out, indent=2))
+
+
+if __name__ == "__main__":
+    main()
