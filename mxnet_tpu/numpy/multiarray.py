@@ -1088,6 +1088,92 @@ def indices(dimensions, dtype="int32", ctx=None):
                                dtype=dtype_np(dtype)), ctx)
 
 
+def row_stack(arrays):
+    return vstack(arrays)
+
+
+def rollaxis(a, axis, start=0):
+    x = _proc(a)
+    return ndarray(jnp.rollaxis(x._data, int(axis), int(start)), x.ctx)
+
+
+def delete(arr, obj, axis=None):
+    x = _proc(arr)
+    if isinstance(obj, NDArray):
+        obj = onp.asarray(obj.asnumpy())
+    return ndarray(jnp.delete(x._data, obj, axis=axis), x.ctx)
+
+
+def insert(arr, obj, values, axis=None):
+    x = _proc(arr)
+    v = _proc(values)
+    vdata = v._data if isinstance(v, NDArray) else v
+    if isinstance(obj, NDArray):
+        obj = onp.asarray(obj.asnumpy())
+    return ndarray(jnp.insert(x._data, obj, vdata, axis=axis), x.ctx)
+
+
+def diag_indices_from(arr):
+    x = _proc(arr)
+    if x.ndim < 2:
+        raise ValueError("input array must be at least 2-d")
+    if len(set(x.shape)) != 1:
+        raise ValueError("All dimensions of input must be of equal length")
+    idx = jnp.arange(x.shape[0])
+    return tuple(ndarray(idx, x.ctx) for _ in range(x.ndim))
+
+
+def unravel_index(indices, shape):
+    i = _proc(indices)
+    raw = i._data if isinstance(i, NDArray) else onp.asarray(i)
+    ctx = i.ctx if isinstance(i, NDArray) else current_context()
+    return tuple(ndarray(c, ctx) for c in
+                 jnp.unravel_index(raw, tuple(int(s) for s in shape)))
+
+
+def _copy_out(res, out):
+    if out is None:
+        return res
+    out[:] = res
+    return out
+
+
+def isposinf(x, out=None):
+    a = _proc(x)
+    return _copy_out(logical_and(isinf(a), greater(a, 0.0)), out)
+
+
+def isneginf(x, out=None):
+    a = _proc(x)
+    return _copy_out(logical_and(isinf(a), less(a, 0.0)), out)
+
+
+def float_power(x1, x2):
+    # numpy semantics: promote to the widest float BEFORE the power —
+    # stays on registry ops so gradients flow. Python scalars promote
+    # too (2**-1 on raw ints raises in jax).
+    a, b = _proc(x1), _proc(x2)
+    a = a.astype("float64") if isinstance(a, NDArray) else float(a)
+    b = b.astype("float64") if isinstance(b, NDArray) else float(b)
+    if not isinstance(a, NDArray) and not isinstance(b, NDArray):
+        a = array(a, dtype="float64")
+    return power(a, b)
+
+
+def polyval(p, x):
+    # Horner's scheme over registry ops: differentiable in both p and x
+    c = _proc(p)
+    v = _proc(x)
+    if not isinstance(c, NDArray) or c.ndim != 1:
+        raise ValueError("p must be a 1-D array of coefficients")
+    if int(c.size) == 0:
+        return zeros_like(v)  # numpy: empty coefficients -> 0
+    out = zeros_like(v) + c[0]
+    for i in range(1, int(c.size)):
+        out = add(multiply(out, v), c[i])
+    return out
+
+
 def tril_indices(n, k=0, m=None, ctx=None):
     ctx = ctx or current_context()
     r, c = jnp.tril_indices(n, k, m)
@@ -1101,4 +1187,7 @@ def triu_indices(n, k=0, m=None, ctx=None):
 
 
 __all__ += ["argwhere", "dsplit", "tri", "vander", "hanning", "hamming",
-            "blackman", "indices", "tril_indices", "triu_indices"]
+            "blackman", "indices", "tril_indices", "triu_indices",
+            "row_stack", "rollaxis", "delete", "insert",
+            "diag_indices_from", "unravel_index", "isposinf", "isneginf",
+            "float_power", "polyval"]

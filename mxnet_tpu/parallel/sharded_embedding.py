@@ -75,21 +75,31 @@ def _local_lookup(table_l, ids_l, axis_name):
     return out
 
 
-def make_sharded_embedding_fn(mesh, axis_name="ep"):
+def make_sharded_embedding_fn(mesh, axis_name="ep", batch_axis=None):
     """Build ``lookup(table, ids) -> (batch, E)`` where the table is
-    row-sharded and the batch is sharded over ``axis_name``.
+    row-sharded over ``axis_name`` and the batch is sharded over
+    ``batch_axis`` (defaults to ``axis_name`` — the pure-EP layout).
+
+    Passing a distinct ``batch_axis`` composes EP with data
+    parallelism on one mesh: ids shard over (batch_axis, axis_name)
+    jointly — every device owns a distinct slice of the batch — and
+    the all_to_all exchange rides the table axis within each dp row
+    (the DLRM dp x ep layout; splitting the dp-shard across tp peers
+    also divides the exchange work instead of duplicating it).
 
     Differentiable: grad w.r.t. the table stays sharded (scatter-add on
     the owning shard via the transposed exchange). ids length must be
-    divisible by the axis size.
+    divisible by the product of the named axis sizes.
     """
+    id_spec = (P((batch_axis, axis_name)) if batch_axis
+               and batch_axis != axis_name else P(axis_name))
 
     def lookup(table, ids):
         return shard_map(
             lambda t, i: _local_lookup(t, i.reshape(-1), axis_name),
             mesh=mesh,
-            in_specs=(P(axis_name, None), P(axis_name)),
-            out_specs=P(axis_name),
+            in_specs=(P(axis_name, None), id_spec),
+            out_specs=id_spec,
         )(table, ids)
 
     return lookup

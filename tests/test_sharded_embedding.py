@@ -105,3 +105,34 @@ def test_training_step_converges(mesh):
         params, loss = step(params, jnp.asarray(ids_np, jnp.int32), y)
         losses.append(float(loss))
     assert losses[-1] < 0.3 * losses[0], (losses[0], losses[-1])
+
+
+def test_sharded_embedding_dp_tp_composition():
+    """batch_axis != table axis: ids shard over (dp, tp) jointly, the
+    exchange rides tp within each dp row (the DLRM dp x ep layout);
+    fwd + grad match the unsharded table (VERDICT r4 #7 groundwork)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as onp
+    from jax.sharding import Mesh
+
+    from mxnet_tpu.parallel.sharded_embedding import (
+        make_sharded_embedding_fn)
+
+    devs = jax.devices()
+    if len(devs) < 4:
+        import pytest
+        pytest.skip("needs 4 virtual devices")
+    mesh = Mesh(onp.array(devs[:4]).reshape(2, 2), ("dp", "tp"))
+    lookup = make_sharded_embedding_fn(mesh, "tp", batch_axis="dp")
+    rs = onp.random.RandomState(0)
+    table = jnp.asarray(rs.randn(12, 6), jnp.float32)
+    ids = jnp.asarray(rs.randint(0, 12, 8), jnp.int32)
+    w = jnp.asarray(rs.randn(8, 6), jnp.float32)
+    out = jax.jit(lookup)(table, ids)
+    assert onp.allclose(onp.asarray(out), onp.asarray(table)[onp.asarray(ids)],
+                        atol=1e-6)
+    g = jax.jit(jax.grad(lambda t: (lookup(t, ids) * w).sum()))(table)
+    gref = onp.zeros((12, 6), onp.float32)
+    onp.add.at(gref, onp.asarray(ids), onp.asarray(w))
+    assert onp.allclose(onp.asarray(g), gref, atol=1e-5)

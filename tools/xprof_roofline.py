@@ -61,42 +61,49 @@ def _tool_data(trace_dir, tool="hlo_stats"):
     return data
 
 
-def _rows(csvish):
-    """hlo_stats arrives as CSV text; yield dict rows."""
-    import csv
-    import io
-
-    rd = csv.DictReader(io.StringIO(csvish))
-    for row in rd:
-        yield row
+def _rows(data):
+    """hlo_stats arrives as a Google-DataTable JSON blob
+    ({"cols": [...], "rows": [{"c": [{"v": ...}]}]}); yield dict rows
+    keyed by column id."""
+    obj = json.loads(data)
+    if isinstance(obj, list):  # framework_op_stats wraps in a list
+        obj = obj[0]
+    ids = [c["id"] for c in obj["cols"]]
+    for r in obj.get("rows", []):
+        yield {k: (c or {}).get("v") for k, c in zip(ids, r["c"])}
 
 
 def _f(row, *keys, default=0.0):
     for k in keys:
-        if k in row and row[k] not in ("", None):
+        v = row.get(k)
+        if v not in ("", None):
             try:
-                return float(row[k])
-            except ValueError:
+                return float(v)
+            except (TypeError, ValueError):
                 continue
     return default
 
 
-def classify(name, program_id=""):
-    """Role of an HLO op from its name/metadata (heuristic, printed
-    alongside raw names so misclassification is visible)."""
+def classify(name):
+    """Role of an HLO op from its tf_op_name metadata (the jax op path;
+    backward ops run under transpose(jvp(...))). Heuristic — raw names
+    print alongside so misclassification is visible."""
     n = name.lower()
-    if "transpose" in n and "conv" in n:
-        return "wgrad/dgrad-conv"
+    bwd = "transpose(" in n or "/vjp" in n
     if "conv" in n:
-        return "conv"
-    if any(t in n for t in ("batch-norm", "batchnorm", "bn_")):
-        return "batchnorm"
-    if any(t in n for t in ("sgd", "momentum", "optimizer", "multi_sgd")):
-        return "optimizer"
-    if "all-reduce" in n:
+        return "conv-bwd (wgrad/dgrad)" if bwd else "conv-fwd"
+    if "dot_general" in n or "einsum" in n:
+        return "matmul-bwd" if bwd else "matmul-fwd"
+    if "batch_norm" in n or "bn_" in n or "normalize" in n:
+        return "batchnorm-bwd" if bwd else "batchnorm-fwd"
+    if any(t in n for t in ("sgd", "momentum", "mul", "sub", "add_any")) \
+            and "while" not in n:
+        return "optimizer/elementwise"
+    if any(t in n for t in ("all-reduce", "all-gather", "all-to-all",
+                            "reduce-scatter", "collective")):
         return "collective"
-    if "fusion" in n:
-        return "fusion"
+    if "softmax" in n or "log_softmax" in n:
+        return "loss"
     return "other"
 
 
@@ -110,7 +117,9 @@ def main():
     ap.add_argument("--trace-dir", default=None)
     opts = ap.parse_args()
 
-    os.environ.setdefault("BENCH_CHAIN", "1")
+    # force chain=1: per-step attribution divides by step count only,
+    # so an inherited BENCH_CHAIN would inflate every number CHAIN-fold
+    os.environ["BENCH_CHAIN"] = "1"
     import bench  # noqa: E402  (repo-root script; reuses its builders)
     import jax
 
@@ -160,24 +169,28 @@ def main():
 
     peak_gbps = bench._peak_hbm_gbps()
     peak_tf = bench._peak_tflops()
-    total_us = sum(_f(r, "Total Duration (us)", "total_time_us",
-                      "Avg. duration (us)") for r in rows)
+    total_us = sum(_f(r, "total_self_time") for r in rows)
     recs = []
     hbm_bytes = 0.0
     for r in rows:
-        us = _f(r, "Total Duration (us)", "total_time_us")
-        bw = _f(r, "hbm_bw", "HBM Bandwidth (GB/s)", "hbm_bw (GB/s)")
-        name = (r.get("HLO Op Name") or r.get("hlo_op_name")
-                or r.get("HLO Op") or "?")
-        cat = (r.get("Op Category") or r.get("category") or "")
-        bound = (r.get("Bound by") or r.get("bound_by") or "")
+        us = _f(r, "total_self_time")
+        bw = _f(r, "hbm_bw")
+        name = r.get("hlo_op_name") or "?"
+        tf_name = r.get("tf_op_name") or ""
+        cat = r.get("category") or ""
+        bound = r.get("bound_by") or ""
+        flop_rate = _f(r, "model_flop_rate")  # GFLOP/s
         hbm_bytes += bw * 1e9 * us * 1e-6
-        recs.append({"name": name[:80], "cat": cat, "us": us,
-                     "hbm_gbps": bw,
-                     "roofline_frac": round(bw / peak_gbps, 3)
+        recs.append({"name": name[:60], "tf_op": tf_name[:80],
+                     "cat": cat, "us": round(us, 1),
+                     "hbm_gbps": round(bw, 1),
+                     "hbm_roofline_frac": round(bw / peak_gbps, 3)
                      if peak_gbps else 0.0,
+                     "tflops": round(flop_rate / 1e3, 1),
+                     "flops_roofline_frac": round(
+                         flop_rate / 1e3 / peak_tf, 3) if peak_tf else 0.0,
                      "bound_by": bound,
-                     "role": classify(name)})
+                     "role": classify(tf_name or name)})
     recs.sort(key=lambda r: -r["us"])
     per_step_bytes = hbm_bytes / max(opts.steps, 1)
     role_us = {}
