@@ -345,15 +345,75 @@ class _MultiProcessIter:
 class DevicePrefetcher:
     """Wraps a batch iterable; keeps ``depth`` batches already
     device_put to ``ctx`` so the accelerator never waits on H2D
-    (reference PrefetcherIter + pin_memory role; PJRT transfers are
-    async so 'prefetch' is simply converting early)."""
+    (reference PrefetcherIter + pin_memory role).
 
-    def __init__(self, it, ctx=None, depth=2):
+    ``threaded=True`` (default) runs source-pull + device_put on a
+    dedicated thread, so decode waits and H2D RPCs overlap the
+    consumer's step dispatches (double-buffering; the consumer only
+    blocks when the queue is empty). ``threaded=False`` keeps the
+    simple synchronous fill."""
+
+    def __init__(self, it, ctx=None, depth=2, threaded=True):
         from ...context import current_context
         self._src = iter(it)
         self._ctx = ctx or current_context()
         self._depth = max(1, depth)
         self._queue = deque()
+        self._threaded = bool(threaded)
+        self._worker = None
+        if self._threaded:
+            import queue as _q
+            import threading as _t
+            self._q = _q.Queue(maxsize=self._depth)
+            self._done = object()
+            self._stop = False
+
+            def put(item):
+                # bounded put that gives up when the consumer closes —
+                # a plain q.put would pin this thread (and depth device
+                # batches) forever if iteration stops early
+                while not self._stop:
+                    try:
+                        self._q.put(item, timeout=0.1)
+                        return True
+                    except _q.Full:
+                        continue
+                return False
+
+            def pump():
+                try:
+                    for batch in self._src:
+                        if not put(self._to_device(batch)):
+                            return
+                except BaseException as e:  # surfaced on the consumer
+                    put(e)
+                # ALWAYS terminate the stream: without the sentinel a
+                # consumer that survives the raised error deadlocks on
+                # the next get()
+                put(self._done)
+
+            self._worker = _t.Thread(target=pump, daemon=True)
+            self._worker.start()
+
+    def close(self):
+        """Stop the pump thread and release queued device batches
+        (safe to call repeatedly; no-op for the synchronous mode)."""
+        if self._worker is None:
+            return
+        self._stop = True
+        try:
+            while True:
+                self._q.get_nowait()
+        except Exception:
+            pass
+        self._worker.join(timeout=2.0)
+        self._worker = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
     def _to_device(self, batch):
         if isinstance(batch, NDArray):
@@ -375,6 +435,15 @@ class DevicePrefetcher:
         return self
 
     def __next__(self):
+        if self._threaded:
+            if self._worker is None and self._q.empty():
+                raise StopIteration  # closed
+            item = self._q.get()
+            if item is self._done:
+                raise StopIteration
+            if isinstance(item, BaseException):
+                raise item
+            return item
         self._fill()
         if not self._queue:
             raise StopIteration

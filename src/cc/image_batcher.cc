@@ -27,6 +27,10 @@
 #include <deque>
 #include <fstream>
 #include <mutex>
+#include <pthread.h>
+#include <sched.h>
+
+#include <cstdlib>
 #include <random>
 #include <string>
 #include <thread>
@@ -185,6 +189,20 @@ struct ImgBatcher {
     emit_batch_id = 0;
     for (int i = 0; i < num_threads; ++i)
       workers.emplace_back([this] { WorkerLoop(); });
+    // MXTPU_DECODE_RT=1: move decode threads to SCHED_RR so they
+    // preempt a spin-waiting accelerator client on shared cores (the
+    // axon tunnel busy-polls while device work is in flight, starving
+    // ordinary CFS threads to ~46% of a core). Decode threads block on
+    // the prefetch bound regularly, so the client still gets cycles.
+    // Needs CAP_SYS_NICE; failures are silently ignored (EPERM in
+    // unprivileged containers).
+    const char* rt = std::getenv("MXTPU_DECODE_RT");
+    if (rt && rt[0] == '1') {
+      sched_param sp{};
+      sp.sched_priority = 1;
+      for (auto& t : workers)
+        pthread_setschedparam(t.native_handle(), SCHED_RR, &sp);
+    }
   }
 
   size_t NextReadyId() { return emit_batch_id + ready.size(); }

@@ -423,3 +423,32 @@ def test_kvstore_sparse_push_pull_roundtrip():
     kv.row_sparse_pull("w", out=rsp, row_ids=mx.nd.array([1, 2]))
     np.testing.assert_allclose(rsp.data.asnumpy(),
                                exp[[1, 2]])
+
+
+def test_device_prefetcher_threaded_lifecycle():
+    """Threaded DevicePrefetcher (r5): worker exceptions surface once
+    then the stream TERMINATES (no deadlock on the next get), and
+    close() releases the pump thread after an early break."""
+    import numpy as onp
+
+    from mxnet_tpu.gluon.data import DevicePrefetcher
+
+    def bad():
+        yield onp.ones((2, 2), onp.float32)
+        raise RuntimeError("boom")
+
+    it = iter(DevicePrefetcher(bad(), depth=2))
+    assert next(it).shape == (2, 2)
+    with pytest.raises(RuntimeError, match="boom"):
+        next(it)
+    with pytest.raises(StopIteration):
+        next(it)
+
+    p = DevicePrefetcher(iter([onp.ones((2, 2), onp.float32)] * 10),
+                         depth=2)
+    assert next(iter(p)).shape == (2, 2)
+    p.close()
+    assert p._worker is None
+    # synchronous mode unchanged
+    s = DevicePrefetcher([onp.zeros((1,), onp.float32)], threaded=False)
+    assert [b.shape for b in s] == [(1,)]
