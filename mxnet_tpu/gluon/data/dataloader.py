@@ -367,6 +367,7 @@ class DevicePrefetcher:
             self._q = _q.Queue(maxsize=self._depth)
             self._done = object()
             self._stop = False
+            self._exhausted = False
 
             def put(item):
                 # bounded put that gives up when the consumer closes —
@@ -436,10 +437,12 @@ class DevicePrefetcher:
 
     def __next__(self):
         if self._threaded:
-            if self._worker is None and self._q.empty():
-                raise StopIteration  # closed
+            if self._exhausted or (self._worker is None
+                                   and self._q.empty()):
+                raise StopIteration  # repeatable: pump is gone
             item = self._q.get()
             if item is self._done:
+                self._exhausted = True
                 raise StopIteration
             if isinstance(item, BaseException):
                 raise item

@@ -48,7 +48,9 @@ def create(name="local") -> "KVStore":
     if name in ("local", "local_update_cpu", "local_allreduce_cpu",
                 "device", "local_allreduce_device", "nccl"):
         return KVStore(name)
-    if name in ("dist_sync", "dist_async", "dist_device_sync", "dist_sync_device", "dist"):
+    if name == "dist_async":
+        return AsyncDistKVStore()
+    if name in ("dist_sync", "dist_device_sync", "dist_sync_device", "dist"):
         return DistKVStore(name)
     if name == "horovod":
         return HorovodKVStore()
@@ -349,6 +351,300 @@ class DistKVStore(KVStore):
         if self.num_workers > 1:
             from jax.experimental import multihost_utils
             multihost_utils.sync_global_devices("mxnet_tpu_kvstore_barrier")
+
+
+class _ParameterServer:
+    """Host-side parameter server (the ps-lite server role) for
+    ``dist_async``: runs as a daemon thread in worker 0's process,
+    speaking length-prefixed pickles over TCP. State and updates live
+    in a plain local :class:`KVStore` on host-CPU NDArrays — exactly
+    the reference's CPU server-side update path
+    (src/kvstore/kvstore_dist_server.h); workers push gradients and
+    pull weights with NO inter-worker synchronization, so updates
+    apply in arrival order (stale gradients by design — the dist_async
+    contract)."""
+
+    def __init__(self, host, port, num_workers):
+        import socket
+        import threading
+
+        self._store = KVStore("local")
+        self._lock = threading.Lock()
+        self._opt_payload = None
+        self._num_workers = num_workers
+        self._barrier_count = 0
+        self._barrier_cv = threading.Condition()
+        self._barrier_gen = 0
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind((host, port))
+        srv.listen(num_workers + 2)
+        self._srv = srv
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+
+    def _accept_loop(self):
+        import threading
+        while True:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn):
+        try:
+            while True:
+                msg = _recv_msg(conn)
+                if msg is None:
+                    return
+                op, key, payload = msg
+                try:
+                    _send_msg(conn, ("ok", self._handle(op, key, payload)))
+                except (ConnectionError, EOFError, OSError):
+                    raise
+                except Exception as e:  # reply, don't kill the server
+                    import traceback
+                    _send_msg(conn, ("err", f"{e!r}\n"
+                                     f"{traceback.format_exc(limit=5)}"))
+        except (ConnectionError, EOFError, OSError):
+            return
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _handle(self, op, key, payload):
+        from .context import cpu as _cpu
+        from . import ndarray as _ndmod
+        if op == "init":
+            with self._lock:
+                if key not in self._store._store:
+                    self._store.init(key, _ndmod.array(payload, ctx=_cpu(0)))
+            return None
+        if op == "push":
+            with self._lock:
+                self._store.push(key, _ndmod.array(payload, ctx=_cpu(0)))
+            return None
+        if op == "pull":
+            with self._lock:
+                return self._store._get(key).asnumpy()
+        if op == "setopt":
+            import pickle
+            with self._lock:
+                # replace on a genuinely different optimizer (resets
+                # updater state, as setting a new optimizer should);
+                # byte-equal re-sends from other workers are idempotent
+                if payload != self._opt_payload:
+                    self._opt_payload = payload
+                    self._store.set_optimizer(pickle.loads(payload))
+            return None
+        if op == "optattr":
+            # per-step optimizer attribute sync (rescale_grad changes on
+            # every Trainer.step; the pickled optimizer would go stale)
+            name, value = payload
+            with self._lock:
+                if self._store._optimizer is not None:
+                    setattr(self._store._optimizer, name, value)
+            return None
+        if op == "barrier":
+            with self._barrier_cv:
+                gen = self._barrier_gen
+                self._barrier_count += 1
+                if self._barrier_count >= self._num_workers:
+                    self._barrier_count = 0
+                    self._barrier_gen += 1
+                    self._barrier_cv.notify_all()
+                elif not self._barrier_cv.wait_for(
+                        lambda: self._barrier_gen != gen, timeout=300.0):
+                    # a silent 'ok' after timeout would let the caller
+                    # proceed on orderings the barrier was guarding
+                    self._barrier_count -= 1
+                    raise MXNetError(
+                        "dist_async barrier timed out after 300 s "
+                        "(a worker is stuck or gone)")
+            return None
+        raise MXNetError(f"unknown op {op!r}")
+
+
+def _send_msg(sock, obj):
+    import pickle
+    import struct
+    data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(struct.pack("<Q", len(data)) + data)
+
+
+def _recv_msg(sock):
+    import pickle
+    import struct
+    hdr = b""
+    while len(hdr) < 8:
+        chunk = sock.recv(8 - len(hdr))
+        if not chunk:
+            return None
+        hdr += chunk
+    (n,) = struct.unpack("<Q", hdr)
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            return None
+        buf += chunk
+    return pickle.loads(bytes(buf))
+
+
+class AsyncDistKVStore(KVStore):
+    """``dist_async``: true asynchronous multi-process training
+    (reference dist_async semantics, src/kvstore/kvstore_dist.h with
+    server-side updates): worker 0's process hosts a TCP parameter
+    server; every worker pushes gradients (applied on arrival — no
+    gradient aggregation barrier, no lockstep between workers) and
+    pulls the latest weights. Progress is per-worker; staleness is the
+    accepted trade, exactly as in the reference. jax.distributed is
+    NOT required — the PS channel is plain host TCP (DCN), keeping the
+    accelerators free for compute."""
+
+    def __init__(self):
+        super().__init__("dist_async")
+        import socket
+        import time as _time
+        self._rank = int(os.environ.get("MXNET_TPU_PROC_ID")
+                         or os.environ.get("DMLC_WORKER_ID") or 0)
+        self._n = int(os.environ.get("MXNET_TPU_NUM_PROCS")
+                      or os.environ.get("DMLC_NUM_WORKER") or 1)
+        host = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
+        # the jax.distributed coordinator (dist_sync) owns ROOT_PORT;
+        # the async server claims a fixed offset above it
+        port = int(os.environ.get("DMLC_PS_ROOT_PORT", "9000")) + 1717
+        self._server = None
+        if self._rank == 0 and self._n > 1:
+            self._server = _ParameterServer("0.0.0.0", port, self._n)
+        import threading
+        self._rpc_lock = threading.Lock()
+        self._sent_rescale = None
+        self._sock = None
+        if self._n > 1:
+            deadline = _time.monotonic() + 60.0
+            last = None
+            while _time.monotonic() < deadline:
+                try:
+                    s = socket.create_connection((host, port), timeout=5.0)
+                    s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                    s.settimeout(None)  # barriers block far past the
+                    # connect timeout; blocking mode for the RPC stream
+                    self._sock = s
+                    break
+                except OSError as e:
+                    last = e
+                    _time.sleep(0.2)
+            if self._sock is None:
+                raise MXNetError(
+                    f"dist_async worker {self._rank} could not reach the "
+                    f"parameter server at {host}:{port}: {last!r}")
+
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def num_workers(self):
+        return self._n
+
+    def _rpc(self, op, key, payload=None):
+        with self._rpc_lock:
+            _send_msg(self._sock, (op, key, payload))
+            reply = _recv_msg(self._sock)
+        if reply is None:
+            raise MXNetError(
+                "dist_async parameter server connection lost (worker 0's "
+                f"process gone?) during {op!r}")
+        status, out = reply
+        if status != "ok":
+            raise MXNetError(f"dist_async server error: {out}")
+        return out
+
+    def init(self, key, value):
+        if self._n <= 1:
+            return super().init(key, value)
+        keys, values = _normalize(key, value)
+        for k, v in zip(keys, values):
+            vs = v if isinstance(v, (list, tuple)) else [v]
+            self._rpc("init", k, vs[0].asnumpy())
+            # local replica for pulls into stored dtype/shape checks
+            self._store[k] = vs[0].copy()
+
+    def push(self, key, value, priority=0):
+        if self._n <= 1:
+            return super().push(key, value, priority)
+        # the server applies updates with ITS optimizer copy — mirror
+        # the attributes Trainer mutates per step before the gradients
+        # they govern arrive
+        opt = self._optimizer
+        if opt is not None:
+            rescale = getattr(opt, "rescale_grad", None)
+            if rescale is not None and rescale != self._sent_rescale:
+                self._rpc("optattr", None, ("rescale_grad", rescale))
+                self._sent_rescale = rescale
+        keys, values = _normalize(key, value)
+        for k, v in zip(keys, values):
+            merged = self._reduce(v if isinstance(v, (list, tuple))
+                                  else [v], key=k)
+            self._rpc("push", k, merged.asnumpy())
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        if self._n <= 1:
+            return super().pull(key, out, priority, ignore_sparse)
+        from . import ndarray as _ndmod
+        keys, outs = _normalize(key, out)
+        for k, o in zip(keys, outs):
+            arr = self._rpc("pull", k)
+            for dst in (o if isinstance(o, (list, tuple)) else [o]):
+                _ndmod.array(arr, ctx=dst.ctx,
+                             dtype=str(dst.dtype)).copyto(dst)
+
+    def pushpull(self, key, value, out=None, priority=0):
+        self.push(key, value, priority)
+        self.pull(key, out if out is not None else value, priority)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        if self._n > 1:
+            # the base implementation reads the LOCAL replica — refresh
+            # it from the server first or sparse pulls would return
+            # frozen init-time weights forever
+            from . import ndarray as _ndmod
+            keys, _ = _normalize(key, out)
+            for k in keys:
+                arr = self._rpc("pull", k)
+                stored = self._store.get(k)
+                if stored is None:
+                    self._store[k] = _ndmod.array(arr)
+                else:
+                    _ndmod.array(arr, ctx=stored.ctx,
+                                 dtype=str(stored.dtype)).copyto(stored)
+        return super().row_sparse_pull(key, out, priority, row_ids)
+
+    def set_optimizer(self, optimizer):
+        if self._n <= 1:
+            return super().set_optimizer(optimizer)
+        import pickle
+        # param_dict holds device-backed Parameter objects — strip it
+        # for the wire (the reference sends the optimizer string to
+        # servers the same way; per-param lr/wd multipliers don't ride)
+        saved = getattr(optimizer, "param_dict", None)
+        try:
+            if saved is not None:
+                optimizer.param_dict = {}
+            payload = pickle.dumps(optimizer)
+        finally:
+            if saved is not None:
+                optimizer.param_dict = saved
+        self._rpc("setopt", None, payload)
+        self._optimizer = optimizer  # tracked for per-step attr sync
+
+    def barrier(self):
+        if self._n > 1:
+            self._rpc("barrier", None)
 
 
 class HorovodKVStore(DistKVStore):

@@ -1,0 +1,99 @@
+"""Worker body for the dist_async test (reference
+tests/nightly/dist_async_kvstore.py role): launched via tools/launch.py
+with 2 processes. Asserts the TRUE-async parameter-server contract:
+
+- rank/num_workers reflect the launch WITHOUT jax.distributed;
+- init broadcasts; push applies the update server-side on arrival
+  (set_optimizer runs ON the server), pull returns the latest weights;
+- workers are NOT in lockstep: worker 1 deliberately pushes twice as
+  many updates and both are visible to worker 0 without any barrier
+  between steps;
+- a Gluon Trainer with update_on_kvstore trains end-to-end and the
+  loss drops on every worker.
+"""
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import mxnet_tpu as mx
+from mxnet_tpu import kvstore, nd
+
+
+def main():
+    kv = kvstore.create("dist_async")
+    rank, nw = kv.rank, kv.num_workers
+    assert kv.type == "dist_async"
+    assert nw == 2, f"expected 2 workers, got {nw}"
+
+    # --- raw PS contract: assign semantics without an optimizer
+    if rank == 0:
+        kv.init("w", nd.array(np.full((4,), 1.0, np.float32)))
+    kv.barrier()
+    if rank == 1:
+        kv.init("w", nd.array(np.zeros((4,), np.float32)))  # no-op: taken
+    out = nd.zeros((4,))
+    kv.pull("w", out=out)
+    assert np.allclose(out.asnumpy(), 1.0), out.asnumpy()
+    kv.barrier()  # keep the NEXT phase's pushes out of this check
+
+    # without an updater a push ASSIGNS (local-store parity)
+    kv.push("w", nd.array(np.full((4,), float(2 + rank), np.float32)))
+    kv.barrier()
+    kv.pull("w", out=out)
+    assert float(out.asnumpy()[0]) in (2.0, 3.0)  # arrival order wins
+
+    # --- server-side optimizer: updates apply per push, NO lockstep
+    from mxnet_tpu import optimizer as opt
+    kv.set_optimizer(opt.SGD(learning_rate=0.5))
+    kv.barrier()
+    if rank == 0:
+        kv.init("u", nd.array(np.zeros((2,), np.float32)))
+    kv.barrier()
+    npush = 2 if rank == 1 else 1
+    for _ in range(npush):
+        kv.push("u", nd.array(np.full((2,), 1.0, np.float32)))
+    kv.barrier()
+    kv.pull("u", out=(u := nd.zeros((2,))))
+    # 3 pushes of grad=1 at lr 0.5 -> w = -1.5 regardless of which
+    # worker sent them (asynchronous arrival, shared server state)
+    assert np.allclose(u.asnumpy(), -1.5), u.asnumpy()
+
+    # --- end-to-end: Trainer with update_on_kvstore (server-side SGD)
+    from mxnet_tpu import autograd, gluon
+
+    rs = np.random.RandomState(42)  # same data both workers
+    X = rs.rand(64, 8).astype(np.float32)
+    W = rs.rand(8, 1).astype(np.float32)
+    Y = X @ W
+    net = gluon.nn.Dense(1, in_units=8)
+    net.initialize(init=mx.initializer.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05}, kvstore=kv)
+    assert trainer._update_on_kvstore is not False
+    loss_fn = gluon.loss.L2Loss()
+    x, y = nd.array(X), nd.array(Y)
+    first = last = None
+    for _ in range(30):
+        with autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        trainer.step(64)
+        last = float(loss.mean().asnumpy())
+        if first is None:
+            first = last
+    assert last < first * 0.5, (first, last)
+    kv.barrier()
+    print(f"ASYNC_WORKER_{rank}_OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()
