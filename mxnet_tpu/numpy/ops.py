@@ -610,3 +610,83 @@ def _npi_broadcast_to(a, shape=()):
 @register_op("_npi_argwhere", differentiable=False)
 def _npi_argwhere(a):
     return jnp.argwhere(a)
+
+
+# ----------------------------------------------------------------------
+# composed-function ops (round 5): the eager frontend builds these in
+# Python; registering jnp-backed single ops gives `mx.sym.np` a static
+# graph lowering too (upstream symbol/numpy has backend ops for the
+# same reason). Multi-output counts are parameter-inferable, so the
+# symbolic layer exposes real output selectors.
+# ----------------------------------------------------------------------
+@register_op("_npi_vstack")
+def _npi_vstack(*arrays):
+    return jnp.vstack(arrays)
+
+
+@register_op("_npi_hstack")
+def _npi_hstack(*arrays):
+    return jnp.hstack(arrays)
+
+
+@register_op("_npi_dstack")
+def _npi_dstack(*arrays):
+    return jnp.dstack(arrays)
+
+
+@register_op("_npi_column_stack")
+def _npi_column_stack(*arrays):
+    return jnp.column_stack(arrays)
+
+
+def _split_count(params):
+    ios = params.get("indices_or_sections", 1)
+    if isinstance(ios, (list, tuple)):
+        return len(ios) + 1
+    return int(ios)
+
+
+@register_op("_npi_split_np", wrap=False, infer_num_outputs=_split_count)
+def _npi_split_np(x, indices_or_sections=1, axis=0):
+    ios = indices_or_sections
+    return tuple(jnp.split(x, ios if isinstance(ios, int) else list(ios),
+                           axis=int(axis)))
+
+
+@register_op("_npi_array_split", wrap=False, infer_num_outputs=_split_count)
+def _npi_array_split(x, indices_or_sections=1, axis=0):
+    ios = indices_or_sections
+    return tuple(jnp.array_split(
+        x, ios if isinstance(ios, int) else list(ios), axis=int(axis)))
+
+
+@register_op("_npi_meshgrid", wrap=False,
+             infer_num_outputs=lambda p: int(p.get("num_outputs", 1)))
+def _npi_meshgrid(*arrays, indexing="xy", num_outputs=None):
+    return tuple(jnp.meshgrid(*arrays, indexing=indexing))
+
+
+@register_op("_npi_broadcast_arrays", wrap=False,
+             infer_num_outputs=lambda p: int(p.get("num_outputs", 1)))
+def _npi_broadcast_arrays(*arrays, num_outputs=None):
+    return tuple(jnp.broadcast_arrays(*arrays))
+
+
+@register_op("_npi_atleast_1d")
+def _npi_atleast_1d(a):
+    return jnp.atleast_1d(a)
+
+
+@register_op("_npi_atleast_2d")
+def _npi_atleast_2d(a):
+    return jnp.atleast_2d(a)
+
+
+@register_op("_npi_atleast_3d")
+def _npi_atleast_3d(a):
+    return jnp.atleast_3d(a)
+
+
+@register_op("_npi_around")
+def _npi_around(a, decimals=0):
+    return jnp.round(a, int(decimals))

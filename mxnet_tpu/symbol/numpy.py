@@ -5,11 +5,13 @@ NumPy-style graph building over the same registry ops the eager
 ``mx.np`` frontend dispatches. Coverage contract: every mx.np function
 that lowers to ONE registry op is available symbolically (unaries,
 binaries with python-scalar lifting via the ``_constant`` op,
-reductions, single-op manipulation, contractions, np.linalg);
-functions the eager frontend composes in Python (split/meshgrid/
-nonzero/unique/histogram/stack-helpers) raise NotImplementedError
-with a pointer to hybridize — the compiled path supports all of
-mx.np via tracing.
+reductions, single-op manipulation, contractions, np.linalg), and the
+STATICALLY-shaped compositions (split family, meshgrid, the stack
+helpers, atleast_*, broadcast_arrays, interp, around, average,
+quantile/percentile) lower to dedicated registry ops with real
+multi-output selectors. Only the value-dependent-shape functions
+(nonzero/unique/histogram/bincount/argwhere) raise, with a pointer to
+eager mx.np.
 """
 from __future__ import annotations
 
@@ -205,13 +207,94 @@ def _dynamic_shape(fname):
 for _f in ("argwhere",):
     _install(_f, _dynamic_shape(_f))
 
-# Python-composed eager functions: clear error, not AttributeError
-for _f in ("split", "array_split", "hsplit", "vsplit", "meshgrid",
-           "nonzero", "flatnonzero", "unique", "histogram", "bincount",
-           "vstack", "hstack", "dstack", "column_stack", "atleast_1d",
-           "atleast_2d", "atleast_3d", "broadcast_arrays", "interp",
-           "around", "average", "quantile", "percentile"):
+# truly dynamic compositions (output shape depends on VALUES): clear
+# error, not AttributeError
+for _f in ("nonzero", "flatnonzero", "unique", "histogram", "bincount"):
     _install(_f, _not_composable(_f))
+
+
+# statically-shaped compositions lower to dedicated registry ops
+# (numpy/ops.py round-5 tail) — real symbolic output selectors for the
+# multi-output ones (split/meshgrid/broadcast_arrays)
+def _seq_fn(fname, opname):
+    def f(seq, name=None):
+        return _make_node(get_op(opname),
+                          [_lift(s, fname) for s in seq], {}, name=name)
+    f.__name__ = fname
+    return f
+
+
+for _f in ("vstack", "hstack", "dstack", "column_stack"):
+    _install(_f, _seq_fn(_f, f"_npi_{_f}"))
+
+
+def _split_fn(fname, axis_fixed=None):
+    op = "_npi_array_split" if fname == "array_split" else "_npi_split_np"
+
+    def f(ary, indices_or_sections, axis=0, name=None):
+        if axis_fixed is not None and axis != 0:
+            # numpy's vsplit/hsplit/dsplit take NO axis argument —
+            # silently splitting on the fixed axis anyway would discard
+            # the caller's intent
+            raise TypeError(f"sym.np.{fname} does not accept axis "
+                            f"(it always splits axis {axis_fixed})")
+        ios = (tuple(int(i) for i in indices_or_sections)
+               if isinstance(indices_or_sections, (list, tuple))
+               else int(indices_or_sections))
+        return _make_node(get_op(op), [_lift(ary, fname)],
+                          {"indices_or_sections": ios,
+                           "axis": axis_fixed if axis_fixed is not None
+                           else axis}, name=name)
+    f.__name__ = fname
+    if axis_fixed is not None and axis_fixed > 0:
+        f.__doc__ = (f"Symbolic numpy.{fname}; assumes input rank > "
+                     f"{axis_fixed} (symbols carry no rank).")
+    return f
+
+
+_install("split", _split_fn("split"))
+_install("array_split", _split_fn("array_split"))
+_install("vsplit", _split_fn("vsplit", axis_fixed=0))
+_install("hsplit", _split_fn("hsplit", axis_fixed=1))
+_install("dsplit", _split_fn("dsplit", axis_fixed=2))
+
+
+def meshgrid(*xi, indexing="xy", name=None):
+    return _make_node(get_op("_npi_meshgrid"),
+                      [_lift(x, "meshgrid") for x in xi],
+                      {"indexing": indexing, "num_outputs": len(xi)},
+                      name=name)
+
+
+_install("meshgrid", meshgrid)
+
+
+def broadcast_arrays(*args, name=None):
+    return _make_node(get_op("_npi_broadcast_arrays"),
+                      [_lift(a, "broadcast_arrays") for a in args],
+                      {"num_outputs": len(args)}, name=name)
+
+
+_install("broadcast_arrays", broadcast_arrays)
+
+for _f in ("atleast_1d", "atleast_2d", "atleast_3d"):
+    _install(_f, _sfn(_f, f"_npi_{_f}", 1))
+_install("interp", _sfn("interp", "_npi_interp", 3, ("left", "right")))
+_install("around", _sfn("around", "_npi_around", 1, ("decimals",)))
+_install("quantile", _sfn("quantile", "_npi_quantile", 1, ("q", "axis")))
+_install("percentile", _sfn("percentile", "_npi_percentile", 1,
+                            ("q", "axis")))
+
+
+def average(a, axis=None, weights=None, name=None):
+    inputs = [_lift(a, "average")]
+    if weights is not None:
+        inputs.append(_lift(weights, "average"))
+    return _make_node(get_op("_npi_average"), inputs, {"axis": axis},
+                      name=name)
+
+
+_install("average", average)
 
 
 def __getattr__(attr):

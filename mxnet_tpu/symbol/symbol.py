@@ -656,8 +656,13 @@ def _make_node(op, inputs, params, name=None):
             pass
     if getattr(op, "infer_num_outputs", None) is not None:
         # param-dependent arity (mx.operator Custom: output count comes
-        # from the registered CustomOpProp's list_outputs())
-        nout = int(op.infer_num_outputs(params))
+        # from the registered CustomOpProp's list_outputs()). Params may
+        # arrive JSON-stringified (load_json) — parse before counting,
+        # or split((1,3)) graphs crash on reload (int('(1, 3)')).
+        from ..ndarray.register import _parse_param
+        nout = int(op.infer_num_outputs(
+            {k: _parse_param(v) for k, v in params.items()
+             if v is not None}))
     return Symbol(op=op, inputs=inputs, attrs=merged, name=name,
                   num_outputs=nout)
 
@@ -698,22 +703,27 @@ def load_json(json_str):
     data = json.loads(json_str)
     nodes = data["nodes"]
     built: list[Symbol] = []
+
+    def pick(src, out_idx):
+        # output 0 of a MULTI-output node still needs a selector —
+        # returning the bare node would splat every output (caught by
+        # the sym.np.split json round-trip)
+        if src.num_outputs > 1:
+            return src[out_idx]
+        return src if out_idx == 0 else src[out_idx]
+
     for n in nodes:
         if n["op"] == "null":
             built.append(Variable(n["name"], attr=n.get("attrs", {})))
         else:
             ins = []
             for nid, out_idx, _ in n["inputs"]:
-                src = built[nid]
-                ins.append(src if out_idx == 0 else src[out_idx])
+                ins.append(pick(built[nid], out_idx))
             attrs = n.get("attrs", n.get("param", {}))
             sym = _make_node(get_op(n["op"]), ins, dict(attrs), name=n["name"])
             built.append(sym)
     heads = data.get("heads", [[len(built) - 1, 0, 0]])
-    outs = []
-    for nid, out_idx, _ in heads:
-        src = built[nid]
-        outs.append(src if out_idx == 0 else src[out_idx])
+    outs = [pick(built[nid], out_idx) for nid, out_idx, _ in heads]
     return outs[0] if len(outs) == 1 else Group(outs)
 
 
