@@ -694,7 +694,12 @@ def functionalize(block: Block, training: bool = False, ctx=None):
                 for p in params:
                     arr = param_arrays[p.name]
                     p._data = {c: _wrap(arr, c) for c in p._data}
-                out = block(*[_wrap(a, ctx) for a in in_arrays])
+                # None inputs pass through untouched: optional
+                # positional slots (e.g. BERTModel's mask between
+                # valid_length and segment_ids) stay skippable from the
+                # functional caller
+                out = block(*[_wrap(a, ctx) if a is not None else None
+                              for a in in_arrays])
         finally:
             for p, d in saved:
                 p._data = d

@@ -1,4 +1,7 @@
 from .dataset import Dataset, SimpleDataset, ArrayDataset, RecordFileDataset
 from .sampler import Sampler, SequentialSampler, RandomSampler, BatchSampler
 from .dataloader import DataLoader, DevicePrefetcher
+# sequence packing batchify (variable-length corpora -> fixed packed
+# rows for the segment-aware flash-attention path; worker-safe numpy)
+from ...io.packing import PackedBatchify
 from . import vision

@@ -38,21 +38,43 @@ class BERTEmbeddings(HybridBlock):
             self.ln = LayerNorm(epsilon=layer_norm_eps, prefix="ln_")
             self.dropout = Dropout(dropout) if dropout else None
 
-    def hybrid_forward(self, F, inputs, token_types):
-        # positions 0..S-1 derived from the input itself (jit-static).
-        # Embedding's take() clips out-of-range ids, which would silently
-        # alias every position past max_length — reject instead.
+    def hybrid_forward(self, F, inputs, token_types, positions=None):
+        # positions 0..S-1 derived from the input itself (jit-static)
+        # unless the caller supplies explicit per-token positions — the
+        # packed path does: each packed sequence's positions restart at
+        # 0 (io/packing.py), not at its row offset. Embedding's take()
+        # clips out-of-range ids, which would silently alias every
+        # position past max_length — reject instead.
         try:
             seq_len = inputs.shape[1]
         except Exception:
             seq_len = None
-        if seq_len is not None and seq_len > self._max_length:
+        if positions is None and seq_len is not None \
+                and seq_len > self._max_length:
             raise ValueError(
                 f"sequence length {seq_len} exceeds max_length "
                 f"{self._max_length} of the position table")
-        pos = F.arange_like(inputs, axis=1)
         x = self.word_embed(inputs) + self.token_type_embed(token_types)
-        x = x + F.expand_dims(self.position_embed(pos), 0)
+        if positions is None:
+            pos = F.arange_like(inputs, axis=1)
+            x = x + F.expand_dims(self.position_embed(pos), 0)
+        else:
+            # caller contract: every position id < max_length (packers
+            # bound ids by each SAMPLE's length, so keep packed sample
+            # lengths <= max_length even when rows are longer).
+            # Concrete (eager) positions are validated here; traced
+            # values cannot be (take() would clip silently — the same
+            # aliasing the seq_len guard above rejects).
+            try:
+                pmax = int(positions.asnumpy().max())
+            except Exception:
+                pmax = None
+            if pmax is not None and pmax >= self._max_length:
+                raise ValueError(
+                    f"position id {pmax} exceeds the position table "
+                    f"(max_length {self._max_length}); packed samples "
+                    "must each be at most max_length tokens")
+            x = x + self.position_embed(positions)
         x = self.ln(x)
         if self.dropout is not None:
             x = self.dropout(x)
@@ -85,14 +107,22 @@ class BERTModel(HybridBlock):
                            if use_pooler else None)
 
     def hybrid_forward(self, F, inputs, token_types, valid_length=None,
-                       mask=None):
+                       mask=None, segment_ids=None, positions=None):
         """``valid_length`` (B,) per-example token counts — third
         positional input, matching the GluonNLP BERTModel signature
         (inputs, token_types, valid_length); rides the flash kernel's
         native per-row kv-length path. ``mask`` stays the general
-        additive escape hatch (composed attention)."""
-        x = self.embeddings(inputs, token_types)
-        seq = self.encoder(x, mask, valid_length)
+        additive escape hatch (composed attention).
+
+        Packed batches (io/packing.py) pass ``segment_ids`` (B, S) —
+        attention goes block-diagonal per packed sequence — and
+        ``positions`` (B, S), the per-segment position ids (each
+        sequence's positional embedding restarts at 0). With packing
+        the pooled output is meaningless (row slot 0 is only the FIRST
+        packed sequence's [CLS]); slice per-segment outputs with the
+        packer's placements instead."""
+        x = self.embeddings(inputs, token_types, positions)
+        seq = self.encoder(x, mask, valid_length, segment_ids)
         if self.pooler is None:
             return seq
         pooled = self.pooler(F.slice_axis(seq, axis=1, begin=0, end=1)

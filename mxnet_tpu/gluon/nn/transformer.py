@@ -62,7 +62,8 @@ class MultiHeadAttention(HybridBlock):
         x = F.reshape(x, shape=(0, 0, self._heads, -1))
         return F.transpose(x, axes=(0, 2, 1, 3))
 
-    def hybrid_forward(self, F, x, mask=None, valid_length=None):
+    def hybrid_forward(self, F, x, mask=None, valid_length=None,
+                       segment_ids=None):
         from ... import autograd as _autograd
 
         c = self._units
@@ -74,19 +75,29 @@ class MultiHeadAttention(HybridBlock):
         k = self._split_heads(F, k)
         v = self._split_heads(F, v)
 
+        # packed rows (io/packing.py): segment_ids (B, S) make attention
+        # block-diagonal per sequence. The flash path needs the row's
+        # used length too — derive it when the caller didn't pass one
+        # (packers lay segments contiguously, so count-of-nonzero IS it)
+        if segment_ids is not None and valid_length is None:
+            valid_length = F.segment_valid_len(segment_ids)
+
         # the flash kernel has no attention-prob dropout; honour a
         # configured attention_dropout by taking the composed path while
         # training (trace-time decision — training mode is static).
-        # valid_length (B,) padding stays ON the flash path — the kernel
-        # masks per-example lengths natively; only arbitrary additive
-        # masks force the composed path.
+        # valid_length (B,) padding and segment_ids packing stay ON the
+        # flash path — the kernel masks both natively; only arbitrary
+        # additive masks force the composed path.
         need_drop = bool(self._attn_drop) and _autograd.is_training()
         if mask is None and not need_drop:
-            if valid_length is None:
-                out = F.flash_attention(q, k, v, causal=self._causal)
-            else:
+            if segment_ids is not None:
+                out = F.flash_attention(q, k, v, valid_length, segment_ids,
+                                        causal=self._causal)
+            elif valid_length is not None:
                 out = F.flash_attention(q, k, v, valid_length,
                                         causal=self._causal)
+            else:
+                out = F.flash_attention(q, k, v, causal=self._causal)
         else:
             # composed batch_dot+softmax path (reference-era attention);
             # mask is additive, broadcastable to (B, 1|H, S, S)
@@ -96,6 +107,8 @@ class MultiHeadAttention(HybridBlock):
                 scores = F.broadcast_add(scores, mask)
             if valid_length is not None:
                 scores = F.attention_length_mask(scores, valid_length)
+            if segment_ids is not None:
+                scores = F.attention_segment_mask(scores, segment_ids)
             if self._causal:
                 scores = F.causal_mask_scores(scores)
             probs = F.softmax(scores, axis=-1)
@@ -104,6 +117,9 @@ class MultiHeadAttention(HybridBlock):
                 # the composed path matches the flash kernel's l==0
                 # zeros for empty (valid_len == 0) examples
                 probs = F.attention_zero_empty_rows(probs, valid_length)
+            if segment_ids is not None:
+                # same guard for packed PADDING rows (segment id 0)
+                probs = F.attention_zero_pad_rows(probs, segment_ids)
             if self.dropout is not None:
                 probs = self.dropout(probs)
             out = F.batch_dot_attention_apply(probs, v)
@@ -163,15 +179,17 @@ class TransformerEncoderCell(HybridBlock):
             self.ffn_ln = LayerNorm(epsilon=layer_norm_eps, prefix="ffn_ln_")
             self.dropout = Dropout(dropout) if dropout else None
 
-    def hybrid_forward(self, F, x, mask=None, valid_length=None):
+    def hybrid_forward(self, F, x, mask=None, valid_length=None,
+                       segment_ids=None):
         if self._pre_norm:
-            h = self.attention(self.attn_ln(x), mask, valid_length)
+            h = self.attention(self.attn_ln(x), mask, valid_length,
+                               segment_ids)
             if self.dropout is not None:
                 h = self.dropout(h)
             x = x + h
             h = self.ffn(self.ffn_ln(x))
             return x + h
-        h = self.attention(x, mask, valid_length)
+        h = self.attention(x, mask, valid_length, segment_ids)
         if self.dropout is not None:
             h = self.dropout(h)
         x = self.attn_ln(x + h)
@@ -205,9 +223,10 @@ class TransformerEncoder(HybridBlock):
             self.final_ln = (LayerNorm(epsilon=layer_norm_eps, prefix="final_ln_")
                              if pre_norm else None)
 
-    def hybrid_forward(self, F, x, mask=None, valid_length=None):
+    def hybrid_forward(self, F, x, mask=None, valid_length=None,
+                       segment_ids=None):
         for cell in self.cells:
-            x = cell(x, mask, valid_length)
+            x = cell(x, mask, valid_length, segment_ids)
         if self.final_ln is not None:
             x = self.final_ln(x)
         return x

@@ -2,3 +2,7 @@ from .io import (
     DataDesc, DataBatch, DataIter, NDArrayIter, ResizeIter, PrefetchingIter,
     CSVIter, LibSVMIter, MNISTIter, ImageRecordIter,
 )
+from .packing import (
+    PackedBatch, PackedBatchify, PackedSeqIter, pack_sequences,
+    unpack_sequences, packing_efficiency,
+)

@@ -1,0 +1,257 @@
+"""Sequence packing for variable-length token batches.
+
+The padded BERT leg burns ~26% of every step attending over and
+backpropagating through padding (BENCH_r05: valid_frac 0.74 at seq512).
+Packing recovers it: multiple variable-length sequences share one fixed
+(batch, seq_len) row, and the flash-attention kernel's ``segment_ids``
+path (ops/pallas/flash_attention.py) keeps attention block-diagonal so
+sequences never see each other — the T5/MaxText-style TPU fix, and the
+TPU-native continuation of the reference's bucketing heritage
+(BucketingModule binned lengths into a few compiled shapes; packing
+bins them into ONE shape with near-zero waste).
+
+Layout contract (shared with the kernel and the gluon/bench consumers):
+
+- ``data``        (R, L): tokens, first-fit-packed, padded with
+                  ``pad_value``;
+- ``segment_ids`` (R, L) int32: 1..n per row in placement order, 0 on
+                  padding — contiguous, monotonically non-decreasing
+                  within a row (what makes the kernel's min/max
+                  block-skip tight);
+- ``positions``   (R, L) int32: PER-SEGMENT 0-based positions (each
+                  sequence's positional embedding restarts at 0), 0 on
+                  padding;
+- ``valid_length``(R,) int32: used slots per row (segments are packed
+                  from position 0, so this is also the kv length the
+                  kernel masks with).
+
+Loss masks derive as ``segment_ids > 0``.
+
+Positions are bounded by each SAMPLE's length, not the row length —
+so a model with a finite position table (BERT ``max_length``) can pack
+into rows LONGER than the table as long as every individual sample
+stays within it (the bench packs 512-max samples into 2048-slot rows
+against a 512-entry table).
+
+``pack_sequences`` is greedy first-fit in arrival order — the online
+algorithm a streaming corpus reader can run (rows stay open until the
+stream ends). For a bench-style fixed row budget, pack a modest
+oversample and keep the fullest rows (bench.py does this; first-fit's
+open tail rows are the only low-occupancy ones).
+"""
+from __future__ import annotations
+
+from collections import namedtuple
+
+import numpy as np
+
+__all__ = ["PackedBatch", "Placement", "pack_sequences", "unpack_sequences",
+           "packing_efficiency", "PackedBatchify", "PackedSeqIter"]
+
+
+PackedBatch = namedtuple(
+    "PackedBatch",
+    ["data", "segment_ids", "positions", "valid_length", "placements",
+     "extras"])
+
+# where sample i landed: data[row, offset:offset+length] (segment_ids
+# there are == segment; kept per-sample so unpack is exact)
+Placement = namedtuple("Placement", ["row", "offset", "length", "segment"])
+
+
+def pack_sequences(sequences, seq_len, extras=None, pad_value=0,
+                   dtype=None, max_rows=None):
+    """Greedy first-fit packing of 1-D samples into (R, seq_len) rows.
+
+    Parameters
+    ----------
+    sequences : list of 1-D arrays (the token samples), each with
+        0 < len <= seq_len.
+    extras : optional list of lists of 1-D arrays, each parallel to
+        ``sequences`` (labels, weights, ...) and length-equal per
+        sample; packed into identical layouts.
+    max_rows : refuse placements that would open row max_rows+1 —
+        samples that no open row can hold raise (the bench packs with
+        an unbounded row count and selects rows afterwards).
+
+    Returns a :class:`PackedBatch`; ``extras`` in the result is a list
+    of (R, seq_len) arrays parallel to the input extras.
+    """
+    seqs = [np.asarray(s).reshape(-1) for s in sequences]
+    extras = [list(map(np.asarray, ex)) for ex in (extras or [])]
+    for ex in extras:
+        if len(ex) != len(seqs):
+            raise ValueError("extras must parallel sequences")
+    if dtype is None:
+        dtype = seqs[0].dtype if seqs else np.int32
+
+    used = []          # per open row: slots consumed
+    counts = []        # per open row: number of segments placed
+    placements = []
+    for idx, s in enumerate(seqs):
+        n = len(s)
+        if not 0 < n <= seq_len:
+            raise ValueError(
+                f"sample {idx} has length {n}, outside (0, {seq_len}]")
+        if extras:
+            for ex in extras:
+                if len(ex[idx]) != n:
+                    raise ValueError(
+                        f"extra for sample {idx} has length "
+                        f"{len(ex[idx])} != {n}")
+        for r in range(len(used)):      # first fit
+            if used[r] + n <= seq_len:
+                break
+        else:
+            r = len(used)
+            if max_rows is not None and r >= max_rows:
+                raise ValueError(
+                    f"sample {idx} (len {n}) does not fit in any of the "
+                    f"{max_rows} allowed rows")
+            used.append(0)
+            counts.append(0)
+        placements.append(Placement(r, used[r], n, counts[r] + 1))
+        used[r] += n
+        counts[r] += 1
+
+    rows = len(used)
+    data = np.full((rows, seq_len), pad_value, dtype=dtype)
+    seg = np.zeros((rows, seq_len), np.int32)
+    pos = np.zeros((rows, seq_len), np.int32)
+    packed_extras = [
+        np.zeros((rows, seq_len), ex[0].dtype if ex else np.int32)
+        for ex in extras]
+    for s, pl, i in zip(seqs, placements, range(len(seqs))):
+        sl = slice(pl.offset, pl.offset + pl.length)
+        data[pl.row, sl] = s
+        seg[pl.row, sl] = pl.segment
+        pos[pl.row, sl] = np.arange(pl.length)
+        for ex, out in zip(extras, packed_extras):
+            out[pl.row, sl] = ex[i]
+    valid = np.asarray(used, np.int32)
+    return PackedBatch(data, seg, pos, valid, placements, packed_extras)
+
+
+def unpack_sequences(packed, placements=None):
+    """Restore the original sample list from a packed array.
+
+    ``packed`` is a PackedBatch (its own placements are used) or a bare
+    (R, L[, ...]) array with ``placements`` given — the latter unpacks
+    any array sharing the packed layout (model outputs: per-token
+    logits/hidden states slice the same way)."""
+    if placements is None:
+        placements = packed.placements
+        packed = packed.data
+    return [np.asarray(packed)[p.row, p.offset:p.offset + p.length]
+            for p in placements]
+
+
+def packing_efficiency(batch):
+    """Fraction of slots holding real tokens (PackedBatch or a
+    segment_ids array)."""
+    seg = batch.segment_ids if isinstance(batch, PackedBatch) else batch
+    seg = np.asarray(seg)
+    return float((seg > 0).sum()) / seg.size
+
+
+class PackedBatchify:
+    """``DataLoader(..., batchify_fn=PackedBatchify(seq_len))``: pack
+    the sampled variable-length sequences into fixed rows.
+
+    Samples are 1-D token arrays, or (tokens, label_arrays...) tuples
+    with per-token labels packed into the same layout. Returns
+    ``(data, segment_ids, positions, valid_length[, labels...])`` as
+    numpy — worker-process safe (never touches device arrays; the
+    parent wraps, matching default_mp_batchify_fn's contract)."""
+
+    def __init__(self, seq_len, pad_value=0):
+        self._seq_len = seq_len
+        self._pad = pad_value
+
+    def __call__(self, samples):
+        if isinstance(samples[0], tuple):
+            cols = list(zip(*samples))
+            seqs, label_cols = cols[0], cols[1:]
+        else:
+            seqs, label_cols = samples, ()
+        batch = pack_sequences(seqs, self._seq_len,
+                               extras=[list(c) for c in label_cols],
+                               pad_value=self._pad)
+        return (batch.data, batch.segment_ids, batch.positions,
+                batch.valid_length, *batch.extras)
+
+
+class PackedSeqIter:
+    """DataIter over packed rows (the Module-path consumer).
+
+    Packs the whole sample list up front (first-fit, arrival order) and
+    yields DataBatch(data=[tokens, segment_ids, positions, valid_length],
+    label=[packed labels...]) of ``batch_size`` rows. The final partial
+    row-batch pads with empty rows and reports ``pad`` (NDArrayIter's
+    last-batch convention).
+    """
+
+    def __init__(self, sequences, seq_len, batch_size, labels=None,
+                 pad_value=0, data_name="data", label_name="softmax_label"):
+        from . import io as _io
+
+        self._io = _io
+        batch = pack_sequences(
+            sequences, seq_len,
+            extras=[labels] if labels is not None else None,
+            pad_value=pad_value)
+        self.packed = batch
+        self.batch_size = batch_size
+        self._seq_len = seq_len
+        arrays = [batch.data, batch.segment_ids, batch.positions,
+                  batch.valid_length]
+        self._data_names = [data_name, "segment_ids", "positions",
+                            "valid_length"]
+        self._arrays = arrays
+        self._labels = list(batch.extras)
+        self._label_names = [label_name] if self._labels else []
+        self._rows = batch.data.shape[0]
+        self._cursor = 0
+
+    @property
+    def provide_data(self):
+        return [self._io.DataDesc(n, (self.batch_size,) + a.shape[1:],
+                                  a.dtype)
+                for n, a in zip(self._data_names, self._arrays)]
+
+    @property
+    def provide_label(self):
+        return [self._io.DataDesc(n, (self.batch_size,) + a.shape[1:],
+                                  a.dtype)
+                for n, a in zip(self._label_names, self._labels)]
+
+    def reset(self):
+        self._cursor = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return self.next()
+
+    def next(self):
+        from .. import ndarray as nd
+
+        if self._cursor >= self._rows:
+            raise StopIteration
+        lo = self._cursor
+        hi = min(lo + self.batch_size, self._rows)
+        self._cursor = hi
+        pad = self.batch_size - (hi - lo)
+
+        def take(a):
+            out = a[lo:hi]
+            if pad:
+                out = np.concatenate(
+                    [out, np.zeros((pad,) + a.shape[1:], a.dtype)])
+            return nd.array(out, dtype=str(out.dtype))
+
+        return self._io.DataBatch(
+            data=[take(a) for a in self._arrays],
+            label=[take(a) for a in self._labels],
+            pad=pad)

@@ -522,7 +522,7 @@ class AsyncDistKVStore(KVStore):
             self._server = _ParameterServer("0.0.0.0", port, self._n)
         import threading
         self._rpc_lock = threading.Lock()
-        self._sent_rescale = None
+        self._sent_optattrs = {}
         self._sock = None
         if self._n > 1:
             deadline = _time.monotonic() + 60.0
@@ -574,18 +574,30 @@ class AsyncDistKVStore(KVStore):
             # local replica for pulls into stored dtype/shape checks
             self._store[k] = vs[0].copy()
 
+    def _sync_optattrs(self):
+        """Mirror scalar optimizer attributes the worker mutates after
+        set_optimizer through the optattr RPC, so the server's copy
+        applies the CURRENT values: rescale_grad changes on every
+        Trainer.step, lr/wd via Trainer.set_learning_rate /
+        setattr(trainer.optimizer, 'wd', ...) — without this the
+        server would keep applying the pickled-at-setopt values
+        forever."""
+        opt = self._optimizer
+        if opt is None:
+            return
+        for name in ("rescale_grad", "lr", "wd"):
+            val = getattr(opt, name, None)
+            if val is not None and val != self._sent_optattrs.get(name):
+                self._rpc("optattr", None, (name, val))
+                self._sent_optattrs[name] = val
+
     def push(self, key, value, priority=0):
         if self._n <= 1:
             return super().push(key, value, priority)
         # the server applies updates with ITS optimizer copy — mirror
         # the attributes Trainer mutates per step before the gradients
         # they govern arrive
-        opt = self._optimizer
-        if opt is not None:
-            rescale = getattr(opt, "rescale_grad", None)
-            if rescale is not None and rescale != self._sent_rescale:
-                self._rpc("optattr", None, ("rescale_grad", rescale))
-                self._sent_rescale = rescale
+        self._sync_optattrs()
         keys, values = _normalize(key, value)
         for k, v in zip(keys, values):
             merged = self._reduce(v if isinstance(v, (list, tuple))
@@ -641,6 +653,7 @@ class AsyncDistKVStore(KVStore):
                 optimizer.param_dict = saved
         self._rpc("setopt", None, payload)
         self._optimizer = optimizer  # tracked for per-step attr sync
+        self._sent_optattrs = {}     # new server copy: resend attrs
 
     def barrier(self):
         if self._n > 1:

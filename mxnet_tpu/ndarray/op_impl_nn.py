@@ -433,6 +433,39 @@ def attention_zero_empty_rows(probs, valid_len):
     return probs * ok[:, None, None, None].astype(probs.dtype)
 
 
+@register_op("attention_segment_mask")
+def attention_segment_mask(scores, segment_ids):
+    """Mask cross-segment score pairs with -1e30 (additive-mask form of
+    the packed block-diagonal attention, for the composed path; scores
+    (B, H|1, Sq, Sk), segment_ids (B, S) with Sq == Sk == S). Tokens
+    attend only same-segment tokens — padding slots (id 0) are their own
+    'segment', so mask them via attention_length_mask / loss masking."""
+    seg = segment_ids.astype(jnp.int32)
+    m = seg[:, None, :, None] == seg[:, None, None, :]
+    return jnp.where(m, scores, jnp.asarray(-1e30, scores.dtype))
+
+
+@register_op("attention_zero_pad_rows")
+def attention_zero_pad_rows(probs, segment_ids):
+    """Zero attention probs of PADDING query rows (segment id 0) in a
+    packed batch: every real key is cross-segment for them, so their
+    all-masked scores softmax to uniform on the composed path — the
+    flash kernel emits exact zeros there (l==0 guard) and the composed
+    path must agree."""
+    ok = segment_ids.astype(jnp.int32) > 0
+    return probs * ok[:, None, :, None].astype(probs.dtype)
+
+
+@register_op("segment_valid_len", differentiable=False)
+def segment_valid_len(segment_ids):
+    """(B,) count of non-padding (id > 0) slots per packed row — the
+    kv_lens companion a packed batch needs on the flash path (packers
+    lay segments contiguously from position 0, so the count IS the used
+    length)."""
+    return jnp.sum((segment_ids.astype(jnp.int32) > 0)
+                   .astype(jnp.int32), axis=-1)
+
+
 @register_op("causal_mask_scores")
 def causal_mask_scores(scores):
     """End-aligned causal mask over the last two axes of (…,Sq,Sk)."""
@@ -447,20 +480,26 @@ def causal_mask_scores(scores):
 # Exposed as mx.nd.flash_attention.
 # ----------------------------------------------------------------------
 @register_op("flash_attention")
-def flash_attention_op(query, key, value, valid_len=None, causal=False,
-                       sm_scale=None):
+def flash_attention_op(query, key, value, valid_len=None, segment_ids=None,
+                       causal=False, sm_scale=None):
     """softmax(Q K^T * scale) V over (B, H, S, D) inputs.
 
     Pallas flash kernel on TPU (O(S) memory); jnp fallback elsewhere.
     ``valid_len`` (B,) int masks keys at/after each example's length
     (padded batches) — the kernel handles it natively (per-example
     length in SMEM, fully-masked tiles skipped; see
-    ops/pallas/flash_attention.py).
+    ops/pallas/flash_attention.py). ``segment_ids`` (B, S) int makes
+    attention block-diagonal over packed sequences (sequence packing,
+    io/packing.py; requires Sq == Skv): tokens attend only tokens with
+    the same segment id, cross-block tiles with disjoint id ranges are
+    skipped whole.
     """
     from ..ops import pallas as _pallas
 
     if valid_len is not None:
         valid_len = valid_len.astype(jnp.int32).reshape(-1)
+    if segment_ids is not None:
+        segment_ids = segment_ids.astype(jnp.int32)
     if (_pallas.pallas_ok_for(query)
             and query.dtype in (jnp.float32, jnp.bfloat16, jnp.float16)
             and query.ndim == 4):
@@ -469,7 +508,8 @@ def flash_attention_op(query, key, value, valid_len=None, causal=False,
         # fallback below
         q_off = key.shape[2] - query.shape[2] if causal else 0
         return _pallas.flash_attention(query, key, value, sm_scale,
-                                       bool(causal), q_off, None, valid_len)
+                                       bool(causal), q_off, None, valid_len,
+                                       segment_ids)
     d = query.shape[-1]
     scale = sm_scale if sm_scale is not None else 1.0 / (d ** 0.5)
     s = jnp.einsum("bhqd,bhkd->bhqk",
@@ -480,6 +520,9 @@ def flash_attention_op(query, key, value, valid_len=None, causal=False,
     if valid_len is not None:
         mask = jnp.arange(sk)[None, None, None, :] \
             < valid_len[:, None, None, None]
+    if segment_ids is not None:
+        sm = segment_ids[:, None, :, None] == segment_ids[:, None, None, :]
+        mask = sm if mask is None else jnp.logical_and(mask, sm)
     if causal:
         cm = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
         mask = cm if mask is None else jnp.logical_and(mask, cm)

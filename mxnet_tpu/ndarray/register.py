@@ -111,8 +111,12 @@ def register_op(name=None, *, differentiable=True, aliases=(),
                 infer_num_outputs=infer_num_outputs,
                 infer_input_names=infer_input_names)
         _OPS[op_name] = op
+        # re-registration may change the impl signature — drop the
+        # cached positional-name tuple call_op_fn binds with
+        _POS_PARAM_NAMES.pop(op_name, None)
         for a in aliases:
             _OPS[a] = op
+            _POS_PARAM_NAMES.pop(a, None)
         OP_REGISTRY.register(op_name)(op)
         fn._op = op
         fn._expose = wrap
@@ -333,6 +337,50 @@ def invoke(op: Op, inputs, params=None, out=None, ctx: Context | None = None,
     return results
 
 
+# op name -> leading positional parameter names of its impl (cached;
+# stops at *args / keyword-only, same rule as the symbol builder's
+# scalar folding)
+_POS_PARAM_NAMES: dict[str, tuple] = {}
+
+
+def _positional_names(op):
+    names = _POS_PARAM_NAMES.get(op.name)
+    if names is None:
+        import inspect
+        try:
+            names = []
+            for p in inspect.signature(op.fn).parameters.values():
+                if p.kind not in (p.POSITIONAL_ONLY,
+                                  p.POSITIONAL_OR_KEYWORD):
+                    break
+                names.append(p.name)
+            names = tuple(names)
+        except (TypeError, ValueError):
+            names = ()
+        _POS_PARAM_NAMES[op.name] = names
+    return names
+
+
+def call_op_fn(op, arrays, params):
+    """``op.fn(*arrays, **params)`` with signature-aware rebinding.
+
+    The symbol builder folds scalar positionals into attrs by their
+    ORIGINAL argument index (sym.op(x, 2.0, y) -> inputs [x, y], attr
+    {<param1>: 2.0}). Calling the impl with the tensors positional
+    would then bind y into the scalar's slot and collide ("multiple
+    values for <param1>"). When an attr names one of the leading slots
+    the tensors would occupy, walk the signature's positional names and
+    the tensors together, skipping names the attrs own — reproducing
+    the user's original argument order."""
+    if params:
+        names = _positional_names(op)
+        if names and any(n in params for n in names[:len(arrays)]):
+            free = [n for n in names if n not in params]
+            if len(arrays) <= len(free):  # every tensor has a named slot
+                return op.fn(**dict(zip(free, arrays)), **params)
+    return op.fn(*arrays, **params)
+
+
 def _call_positional(op, params, nargs, *arrays):
     """Closure helper so jax.vjp sees only tensor positionals. The AMP
     cast hook applies HERE — inside the differentiated function — so
@@ -340,7 +388,7 @@ def _call_positional(op, params, nargs, *arrays):
     producer's output dtype."""
     if _DISPATCH_CAST_HOOK is not None:
         arrays = _DISPATCH_CAST_HOOK(op, arrays)
-    return op.fn(*arrays, **params)
+    return call_op_fn(op, arrays, params)
 
 
 def _make_ns_function(op: Op, fname: str):

@@ -6,6 +6,11 @@ import os
 
 import jax
 
+try:  # newer jax exports the x64 context manager at top level
+    _enable_x64 = jax.enable_x64
+except AttributeError:  # older jax: experimental namespace
+    from jax.experimental import enable_x64 as _enable_x64
+
 _TRUE = ("1", "true", "yes", "on")
 
 
@@ -79,7 +84,7 @@ def x32(fn):
 
     @functools.wraps(fn)
     def wrapper(*args, **kwargs):
-        with jax.enable_x64(False):
+        with _enable_x64(False):
             return fn(*args, **kwargs)
 
     return wrapper

@@ -31,6 +31,26 @@ identically, so gradients match the composed masked softmax exactly.
 Arbitrary ADDITIVE masks (relative-position biases etc.) are not
 expressible as lengths — the op layer falls back to the jnp composed
 path for those.
+
+SEQUENCE PACKING is handled natively too: ``segment_ids`` (B, S) int32
+gives each token's segment (sequence) id within its packed row
+(io/packing.py emits them; 0 marks padding slots). Attention is
+block-diagonal — a (q, k) pair contributes only when the two tokens
+share a segment id — so multiple short sequences ride one row with
+exactly zero cross-sequence attention, forward and backward. The ids
+ride in VMEM in the lane/sublane-broadcast layout Mosaic compares
+cheaply (q ids replicated across 128 lanes, kv ids across 8 sublanes —
+the jax.experimental flash reference's SegmentIds idiom), and a
+per-block id-range summary (min/max per q/k tile) rides in SMEM so a
+(q-block, kv-block) pair whose id ranges are disjoint is SKIPPED
+whole (no MXU work) — sound for arbitrary ids since disjoint ranges
+cannot share a value, and tight when the packer lays segments out
+contiguously (monotonic ids). Combine with ``kv_lens`` (the packed
+row's used length) so tail padding is masked and padding rows emit
+exact zeros through the l==0 guard; packed outputs and gradients then
+match each sequence run unpacked, bit-for-bit in block-free cases and
+within fp tolerance otherwise. Packing requires Sq == Skv (self
+attention; the KV-cache decode path has no packed analog here).
 """
 from __future__ import annotations
 
@@ -38,6 +58,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
@@ -45,6 +66,16 @@ from jax.experimental.pallas import tpu as pltpu
 from ._util import resolve_interpret, x32
 
 _NEG_INF = -1e30
+# segment-id VMEM layout (the jax flash reference's SegmentIds idiom):
+# q ids broadcast across the 128 lanes, kv ids across 8 sublanes, so the
+# (block_q, block_k) equality mask is a repeat + a sublane-broadcast
+# compare — both native Mosaic moves, no transposes
+_SEG_LANES = 128
+_SEG_SUBLANES = 8
+# tile-padding sentinels: q pad rows and kv pad cols must never match
+# each other (or any real id ≥ 0), so they get DISTINCT negatives
+_SEG_PAD_Q = -2
+_SEG_PAD_KV = -3
 
 
 def _dot_precision(dtype):
@@ -57,22 +88,48 @@ def _dot_precision(dtype):
             else lax.Precision.DEFAULT)
 
 
+def _segment_mask(qseg_ref, kseg_ref, block_k):
+    """(block_q, block_k) same-segment mask from the broadcast-layout id
+    tiles: q ids (block_q, 128) repeated across lane groups, kv ids one
+    sublane row (1, block_k) broadcast down the sublanes."""
+    qs = qseg_ref[0]
+    if block_k > _SEG_LANES:
+        qs = pltpu.repeat(qs, block_k // _SEG_LANES, axis=1)
+    elif block_k < _SEG_LANES:  # never hit: block_k is a 128-multiple
+        qs = qs[:, :block_k]
+    return qs == kseg_ref[0][:1, :]
+
+
+def _seg_range(qrng_ref, krng_ref, i, j, n_heads):
+    """The (i, j) pair's segment-id range summaries (4 SMEM scalars) —
+    None refs mean no segment masking."""
+    if qrng_ref is None:
+        return None
+    b = pl.program_id(0) // np.int32(n_heads)
+    return (qrng_ref[0, b, i], qrng_ref[1, b, i],
+            krng_ref[0, b, j], krng_ref[1, b, j])
+
+
 def _pair_mask(i, j, causal, q_offset, kv_len, block_q, block_k,
-               kvl=None):
+               kvl=None, smask=None):
     """Validity mask for the (i, j) score block, or None when every
     position is statically visible (no kv padding, not causal, no
-    per-example length) — the common dense shape skips the iota/where
-    entirely. ``kvl`` is the traced per-example valid kv length (SMEM
-    scalar); it subsumes the static tail-pad mask since kvl <= kv_len."""
-    mask = None
+    per-example length, no segments) — the common dense shape skips the
+    iota/where entirely. ``kvl`` is the traced per-example valid kv
+    length (SMEM scalar); it subsumes the static tail-pad mask since
+    kvl <= kv_len. ``smask`` is the precomputed (block_q, block_k)
+    same-segment mask (packing)."""
+    mask = smask
     if kvl is not None:
         col = j * block_k + lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
-        mask = col < kvl
+        lm = col < kvl
+        mask = lm if mask is None else jnp.logical_and(mask, lm)
     elif kv_len % block_k != 0:  # padded tail block exists
         col = j * block_k + lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
-        mask = col < kv_len
+        lm = col < kv_len
+        mask = lm if mask is None else jnp.logical_and(mask, lm)
     if causal:
         col = j * block_k + lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
@@ -83,21 +140,33 @@ def _pair_mask(i, j, causal, q_offset, kv_len, block_q, block_k,
     return mask
 
 
-def _block_visible(i, j, causal, q_offset, block_q, block_k, kvl):
-    """Whether the (i, j) tile has ANY live score: causal skip plus the
+def _block_visible(i, j, causal, q_offset, block_q, block_k, kvl,
+                   segrng=None):
+    """Whether the (i, j) tile has ANY live score: causal skip, the
     per-example length skip (tiles starting at/after kvl are dead —
-    the variable-length fast path's whole-tile saving)."""
+    the variable-length fast path's whole-tile saving), and the packed
+    segment-range skip (disjoint id ranges cannot share a segment, so
+    cross-sequence tiles cost no MXU work)."""
     q_last = (i + 1) * block_q - 1 + q_offset
     vis = jnp.logical_or(not causal, j * block_k <= q_last)
     if kvl is not None:
         vis = jnp.logical_and(vis, j * block_k < kvl)
+    if segrng is not None:
+        qmin, qmax, kmin, kmax = segrng
+        vis = jnp.logical_and(vis, jnp.logical_and(qmin <= kmax,
+                                                   kmin <= qmax))
     return vis
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, kvl_ref, o_ref, lse_ref,
-                acc_sc, m_sc, l_sc, *,
+def _fwd_kernel(q_ref, k_ref, v_ref, kvl_ref, *rest,
                 sm_scale, causal, q_offset, kv_len, block_q, block_k,
-                precision, dynamic_kv):
+                precision, dynamic_kv, dynamic_seg, n_heads):
+    if dynamic_seg:
+        (qseg_ref, kseg_ref, qrng_ref, krng_ref,
+         o_ref, lse_ref, acc_sc, m_sc, l_sc) = rest
+    else:
+        qseg_ref = kseg_ref = qrng_ref = krng_ref = None
+        o_ref, lse_ref, acc_sc, m_sc, l_sc = rest
     i, j = pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
     kvl = kvl_ref[pl.program_id(0)] if dynamic_kv else None
@@ -108,8 +177,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, kvl_ref, o_ref, lse_ref,
         m_sc[:] = jnp.full_like(m_sc, _NEG_INF)
         l_sc[:] = jnp.zeros_like(l_sc)
 
-    # skip: causal invisibility or a tile past the example's kv length
-    visible = _block_visible(i, j, causal, q_offset, block_q, block_k, kvl)
+    # skip: causal invisibility, a tile past the example's kv length,
+    # or a packed tile whose segment-id ranges are disjoint
+    visible = _block_visible(i, j, causal, q_offset, block_q, block_k, kvl,
+                             _seg_range(qrng_ref, krng_ref, i, j, n_heads))
 
     @pl.when(visible)
     def _():
@@ -121,10 +192,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, kvl_ref, o_ref, lse_ref,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32, precision=precision)
 
+        smask = _segment_mask(qseg_ref, kseg_ref, block_k) \
+            if dynamic_seg else None
         mask = _pair_mask(i, j, causal, q_offset, kv_len, block_q, block_k,
-                          kvl)
+                          kvl, smask)
         if mask is not None:
-            s = jnp.where(mask, s, _NEG_INF)
+            s = jnp.where(mask, s, np.float32(_NEG_INF))
 
         m_prev = m_sc[:]
         m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
@@ -132,9 +205,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, kvl_ref, o_ref, lse_ref,
         # rows with no visible key yet keep m_cur at the -1e30 sentinel;
         # exp(s - m_cur) would be exp(0)=1 there, polluting l/acc with an
         # average of V. Force p (and alpha) to 0 until a real score lands.
-        seen = m_cur > _NEG_INF / 2
-        alpha = jnp.where(seen, alpha, 0.0)
-        p = jnp.where(seen, jnp.exp(s - m_cur), 0.0)
+        seen = m_cur > np.float32(_NEG_INF / 2)
+        alpha = jnp.where(seen, alpha, np.float32(0.0))
+        p = jnp.where(seen, jnp.exp(s - m_cur), np.float32(0.0))
         l_sc[:] = l_sc[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
         v = v_ref[0].astype(jnp.float32)
         acc_sc[:] = acc_sc[:] * alpha + jax.lax.dot_general(
@@ -145,16 +218,22 @@ def _fwd_kernel(q_ref, k_ref, v_ref, kvl_ref, o_ref, lse_ref,
     @pl.when(j == nk - 1)
     def _():
         l = l_sc[:]
-        l_safe = jnp.where(l == 0.0, 1.0, l)
+        l_safe = jnp.where(l == np.float32(0.0), np.float32(1.0), l)
         o_ref[0] = (acc_sc[:] / l_safe).astype(o_ref.dtype)
-        lse = jnp.where(l == 0.0, _NEG_INF, m_sc[:] + jnp.log(l_safe))
+        lse = jnp.where(l == np.float32(0.0), np.float32(_NEG_INF),
+                        m_sc[:] + jnp.log(l_safe))
         lse_ref[0] = lse
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   kvl_ref, dq_ref, dq_sc, *,
+                   kvl_ref, *rest,
                    sm_scale, causal, q_offset, kv_len, block_q, block_k,
-                   precision, dynamic_kv):
+                   precision, dynamic_kv, dynamic_seg, n_heads):
+    if dynamic_seg:
+        qseg_ref, kseg_ref, qrng_ref, krng_ref, dq_ref, dq_sc = rest
+    else:
+        qseg_ref = kseg_ref = qrng_ref = krng_ref = None
+        dq_ref, dq_sc = rest
     i, j = pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
     kvl = kvl_ref[pl.program_id(0)] if dynamic_kv else None
@@ -163,7 +242,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _():
         dq_sc[:] = jnp.zeros_like(dq_sc)
 
-    visible = _block_visible(i, j, causal, q_offset, block_q, block_k, kvl)
+    visible = _block_visible(i, j, causal, q_offset, block_q, block_k, kvl,
+                             _seg_range(qrng_ref, krng_ref, i, j, n_heads))
 
     @pl.when(visible)
     def _():
@@ -177,10 +257,12 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32, precision=precision)
+        smask = _segment_mask(qseg_ref, kseg_ref, block_k) \
+            if dynamic_seg else None
         mask = _pair_mask(i, j, causal, q_offset, kv_len, block_q, block_k,
-                          kvl)
+                          kvl, smask)
         p = jnp.exp(s - lse) if mask is None \
-            else jnp.where(mask, jnp.exp(s - lse), 0.0)
+            else jnp.where(mask, jnp.exp(s - lse), np.float32(0.0))
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32, precision=precision)
@@ -193,14 +275,20 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _():
         # dq is wrt the ORIGINAL q: rescale once on the small (bq, d)
         # block (q was pre-scaled; ds here is wrt unscaled scores)
-        dq_ref[0] = (dq_sc[:] * sm_scale).astype(dq_ref.dtype)
+        dq_ref[0] = (dq_sc[:] * np.float32(sm_scale)).astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    kvl_ref, dk_ref, dv_ref, dk_sc, dv_sc, *,
+                    kvl_ref, *rest,
                     sm_scale, causal, q_offset, kv_len, block_q, block_k,
-                    precision, dynamic_kv):
+                    precision, dynamic_kv, dynamic_seg, n_heads):
     # grid: (BH, nk, nq) — q is the inner (sequential) axis
+    if dynamic_seg:
+        (qseg_ref, kseg_ref, qrng_ref, krng_ref,
+         dk_ref, dv_ref, dk_sc, dv_sc) = rest
+    else:
+        qseg_ref = kseg_ref = qrng_ref = krng_ref = None
+        dk_ref, dv_ref, dk_sc, dv_sc = rest
     j, i = pl.program_id(1), pl.program_id(2)
     nq = pl.num_programs(2)
     kvl = kvl_ref[pl.program_id(0)] if dynamic_kv else None
@@ -210,7 +298,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_sc[:] = jnp.zeros_like(dk_sc)
         dv_sc[:] = jnp.zeros_like(dv_sc)
 
-    visible = _block_visible(i, j, causal, q_offset, block_q, block_k, kvl)
+    visible = _block_visible(i, j, causal, q_offset, block_q, block_k, kvl,
+                             _seg_range(qrng_ref, krng_ref, i, j, n_heads))
 
     @pl.when(visible)
     def _():
@@ -224,10 +313,12 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32, precision=precision)
+        smask = _segment_mask(qseg_ref, kseg_ref, block_k) \
+            if dynamic_seg else None
         mask = _pair_mask(i, j, causal, q_offset, kv_len, block_q, block_k,
-                          kvl)
+                          kvl, smask)
         p = jnp.exp(s - lse) if mask is None \
-            else jnp.where(mask, jnp.exp(s - lse), 0.0)
+            else jnp.where(mask, jnp.exp(s - lse), np.float32(0.0))
 
         dv_sc[:] = dv_sc[:] + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
@@ -247,9 +338,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                      kvl_ref, dq_ref, dk_ref, dv_ref, dk_sc, dv_sc, *,
+                      kvl_ref, *rest,
                       sm_scale, causal, q_offset, kv_len, block_q, block_k,
-                      precision, dynamic_kv):
+                      precision, dynamic_kv, dynamic_seg, n_heads):
     """One-pass backward: dq, dk, dv from a SINGLE traversal of the
     (q block, k block) grid — the score matrix s and dp are computed
     once per pair instead of once in a dq kernel and again in a dkv
@@ -262,6 +353,12 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     prefetch pipeline) and the per-k-block partials are summed by XLA
     outside the kernel.
     """
+    if dynamic_seg:
+        (qseg_ref, kseg_ref, qrng_ref, krng_ref,
+         dq_ref, dk_ref, dv_ref, dk_sc, dv_sc) = rest
+    else:
+        qseg_ref = kseg_ref = qrng_ref = krng_ref = None
+        dq_ref, dk_ref, dv_ref, dk_sc, dv_sc = rest
     j, i = pl.program_id(1), pl.program_id(2)
     nq = pl.num_programs(2)
     kvl = kvl_ref[pl.program_id(0)] if dynamic_kv else None
@@ -271,7 +368,8 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_sc[:] = jnp.zeros_like(dk_sc)
         dv_sc[:] = jnp.zeros_like(dv_sc)
 
-    visible = _block_visible(i, j, causal, q_offset, block_q, block_k, kvl)
+    visible = _block_visible(i, j, causal, q_offset, block_q, block_k, kvl,
+                             _seg_range(qrng_ref, krng_ref, i, j, n_heads))
 
     @pl.when(visible)
     def _():
@@ -285,10 +383,12 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32, precision=precision)
+        smask = _segment_mask(qseg_ref, kseg_ref, block_k) \
+            if dynamic_seg else None
         mask = _pair_mask(i, j, causal, q_offset, kv_len, block_q, block_k,
-                          kvl)
+                          kvl, smask)
         p = jnp.exp(s - lse) if mask is None \
-            else jnp.where(mask, jnp.exp(s - lse), 0.0)
+            else jnp.where(mask, jnp.exp(s - lse), np.float32(0.0))
 
         dv_sc[:] = dv_sc[:] + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
@@ -303,7 +403,7 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_ref[0, 0] = (jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
-            precision=precision) * sm_scale).astype(dq_ref.dtype)
+            precision=precision) * np.float32(sm_scale)).astype(dq_ref.dtype)
 
     @pl.when(jnp.logical_not(visible))
     def _():
@@ -320,6 +420,15 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _pad_len(s, block):
     return ((s + block - 1) // block) * block
+
+
+def _pad0(x, pad):
+    """jnp.pad with a fill constant pinned to x's dtype: a bare python
+    0 is weakly typed, and mixing the x32 trace region with an x64
+    caller jit makes two differently-typed lowerings of jnp.pad's
+    private helper collide on some jax versions (symbolic-executor
+    graphs trace these pads under x64)."""
+    return jnp.pad(x, pad, constant_values=np.zeros((), x.dtype))
 
 
 def _pick_blocks(sq, skv):
@@ -348,11 +457,65 @@ def _expand_kv_lens(kv_lens, b, h):
         kv_lens.astype(jnp.int32).reshape(b, 1), (b, h)).reshape(b * h)
 
 
+def _prep_segments(segment_ids, b, sq, skv, sq_p, skv_p, block_q, block_k):
+    """Host-side packed-attention operands from (B, S) segment ids:
+
+    - qseg (B, sq_p, 128): ids broadcast across lanes (q side);
+    - kseg (B, 8, skv_p): ids broadcast across sublanes (kv side);
+    - qrng (2, B, nq) / krng (2, B, nk): per-tile id min/max (SMEM)
+      driving the whole-block disjoint-range skip.
+
+    Tile padding uses distinct negative sentinels per side so padded q
+    rows can never match padded kv cols. Arrays are per-BATCH (not
+    per-head); kernels index them with program_id(0) // n_heads."""
+    seg = segment_ids.astype(jnp.int32)
+    qseg = seg if sq_p == sq else jnp.pad(
+        seg, ((0, 0), (0, sq_p - sq)),
+        constant_values=np.int32(_SEG_PAD_Q))
+    kseg = seg if skv_p == skv else jnp.pad(
+        seg, ((0, 0), (0, skv_p - skv)),
+        constant_values=np.int32(_SEG_PAD_KV))
+    nq, nk = sq_p // block_q, skv_p // block_k
+    qt = qseg.reshape(b, nq, block_q)
+    kt = kseg.reshape(b, nk, block_k)
+    qrng = jnp.stack([qt.min(-1), qt.max(-1)])
+    krng = jnp.stack([kt.min(-1), kt.max(-1)])
+    qseg = lax.broadcast_in_dim(qseg, (b, sq_p, _SEG_LANES), (0, 1))
+    kseg = lax.broadcast_in_dim(kseg, (b, _SEG_SUBLANES, skv_p), (0, 2))
+    return qseg, kseg, qrng, krng
+
+
+def _seg_specs(block_q, block_k, n_heads, transposed_grid):
+    """BlockSpecs for the four segment operands. ``transposed_grid``:
+    the dkv/fused backward runs (BH, nk, nq), the fwd/dq grids run
+    (BH, nq, nk) — the index maps pick the right program axes."""
+    h32 = np.int32(n_heads)  # i32 divisor: index maps must stay i32
+    if transposed_grid:
+        qmap = lambda b_, j, i: (b_ // h32, i, 0)  # noqa: E731
+        kmap = lambda b_, j, i: (b_ // h32, 0, j)  # noqa: E731
+    else:
+        qmap = lambda b_, i, j: (b_ // h32, i, 0)  # noqa: E731
+        kmap = lambda b_, i, j: (b_ // h32, 0, j)  # noqa: E731
+    return [
+        pl.BlockSpec((1, block_q, _SEG_LANES), qmap,
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, _SEG_SUBLANES, block_k), kmap,
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec(memory_space=pltpu.SMEM),
+        pl.BlockSpec(memory_space=pltpu.SMEM),
+    ]
+
+
 @x32
 def _flash_fwd(q, k, v, sm_scale, causal, q_offset, interpret,
-               block_q=None, block_k=None, kv_lens=None):
+               block_q=None, block_k=None, kv_lens=None,
+               segment_ids=None):
     b, h, sq, d = q.shape
     skv = k.shape[2]
+    if segment_ids is not None and sq != skv:
+        raise ValueError(
+            f"segment_ids (packing) requires self-attention shapes, got "
+            f"sq={sq} != skv={skv}")
     bq0, bk0 = _pick_blocks(sq, skv)
     block_q = block_q or bq0
     block_k = block_k or bk0
@@ -364,32 +527,40 @@ def _flash_fwd(q, k, v, sm_scale, causal, q_offset, interpret,
     kf = k.reshape(b * h, skv, d)
     vf = v.reshape(b * h, skv, d)
     if sq_p != sq:
-        qf = jnp.pad(qf, ((0, 0), (0, sq_p - sq), (0, 0)))
+        qf = _pad0(qf, ((0, 0), (0, sq_p - sq), (0, 0)))
     if skv_p != skv:
-        kf = jnp.pad(kf, ((0, 0), (0, skv_p - skv), (0, 0)))
-        vf = jnp.pad(vf, ((0, 0), (0, skv_p - skv), (0, 0)))
+        kf = _pad0(kf, ((0, 0), (0, skv_p - skv), (0, 0)))
+        vf = _pad0(vf, ((0, 0), (0, skv_p - skv), (0, 0)))
 
     bh = b * h
     dynamic_kv = kv_lens is not None
+    dynamic_seg = segment_ids is not None
     kvlf = _expand_kv_lens(kv_lens, b, h) if dynamic_kv \
         else jnp.full((bh,), skv, jnp.int32)
     nq, nk = sq_p // block_q, skv_p // block_k
     kern = functools.partial(
         _fwd_kernel, sm_scale=sm_scale, causal=causal,
         q_offset=q_offset, kv_len=skv, block_q=block_q, block_k=block_k,
-        precision=_dot_precision(q.dtype), dynamic_kv=dynamic_kv)
+        precision=_dot_precision(q.dtype), dynamic_kv=dynamic_kv,
+        dynamic_seg=dynamic_seg, n_heads=h)
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b_, i, j: (b_, i, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_k, d), lambda b_, i, j: (b_, j, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_k, d), lambda b_, i, j: (b_, j, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec(memory_space=pltpu.SMEM),
+    ]
+    operands = [qf, kf, vf, kvlf]
+    if dynamic_seg:
+        in_specs += _seg_specs(block_q, block_k, h, transposed_grid=False)
+        operands += list(_prep_segments(segment_ids, b, sq, skv,
+                                        sq_p, skv_p, block_q, block_k))
     o, lse = pl.pallas_call(
         kern,
         grid=(bh, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b_, i, j: (b_, i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), lambda b_, i, j: (b_, j, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), lambda b_, i, j: (b_, j, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b_, i, j: (b_, i, 0),
                          memory_space=pltpu.VMEM),
@@ -406,7 +577,7 @@ def _flash_fwd(q, k, v, sm_scale, causal, q_offset, interpret,
             pltpu.VMEM((block_q, 1), jnp.float32),
         ],
         interpret=interpret,
-    )(qf, kf, vf, kvlf)
+    )(*operands)
     o = o[:, :sq].reshape(b, h, sq, d)
     lse = lse[:, :sq, 0].reshape(b, h, sq)
     return o, lse
@@ -414,7 +585,8 @@ def _flash_fwd(q, k, v, sm_scale, causal, q_offset, interpret,
 
 @x32
 def _flash_bwd(q, k, v, o, lse, do, sm_scale, causal, q_offset, interpret,
-               block_q=None, block_k=None, dlse=None, kv_lens=None):
+               block_q=None, block_k=None, dlse=None, kv_lens=None,
+               segment_ids=None):
     import os
 
     b, h, sq, d = q.shape
@@ -427,6 +599,9 @@ def _flash_bwd(q, k, v, o, lse, do, sm_scale, causal, q_offset, interpret,
     dynamic_kv = kv_lens is not None
     kvlf = _expand_kv_lens(kv_lens, b, h) if dynamic_kv \
         else jnp.full((bh,), skv, jnp.int32)
+    seg_ops = None if segment_ids is None else list(
+        _prep_segments(segment_ids, b, sq, skv, sq_p, skv_p,
+                       block_q, block_k))
 
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1).reshape(bh, sq, 1)
@@ -443,36 +618,37 @@ def _flash_bwd(q, k, v, o, lse, do, sm_scale, causal, q_offset, interpret,
     lsef = lse.reshape(bh, sq, 1)
     if sq_p != sq:
         pad = ((0, 0), (0, sq_p - sq), (0, 0))
-        qf, dof = jnp.pad(qf, pad), jnp.pad(dof, pad)
+        qf, dof = _pad0(qf, pad), _pad0(dof, pad)
         # padded q rows: lse=-inf would give exp(s - -inf)=inf; use +inf
         # so p=exp(-inf)=0 for those rows
         lsef = jnp.pad(lsef, ((0, 0), (0, sq_p - sq), (0, 0)),
-                       constant_values=jnp.inf)
-        delta = jnp.pad(delta, ((0, 0), (0, sq_p - sq), (0, 0)))
+                       constant_values=np.float32(np.inf))
+        delta = _pad0(delta, ((0, 0), (0, sq_p - sq), (0, 0)))
     if skv_p != skv:
         pad = ((0, 0), (0, skv_p - skv), (0, 0))
-        kf, vf = jnp.pad(kf, pad), jnp.pad(vf, pad)
+        kf, vf = _pad0(kf, pad), _pad0(vf, pad)
 
     nq, nk = sq_p // block_q, skv_p // block_k
     common = dict(sm_scale=sm_scale, causal=causal, q_offset=q_offset,
                   kv_len=skv, block_q=block_q, block_k=block_k,
-                  precision=_dot_precision(q.dtype), dynamic_kv=dynamic_kv)
+                  precision=_dot_precision(q.dtype), dynamic_kv=dynamic_kv,
+                  dynamic_seg=seg_ops is not None, n_heads=h)
 
     # the fused pass writes nk f32 dq-partial copies to HBM; past nk=2
     # that memory/write cliff outweighs the recompute saving, so long
     # multi-k-block rows (S > 2*block_k cap) take the split path whose
     # dq accumulates in VMEM scratch
     if nk <= 2 and os.environ.get("MXNET_TPU_FLASH_SPLIT_BWD", "0") != "1":
-        return _flash_bwd_fused(qf, kf, vf, dof, lsef, delta, kvlf,
+        return _flash_bwd_fused(qf, kf, vf, dof, lsef, delta, kvlf, seg_ops,
                                 (b, h, sq, skv, d), nq, nk, common,
                                 interpret, k.dtype, v.dtype, q.dtype)
-    return _flash_bwd_split(qf, kf, vf, dof, lsef, delta, kvlf,
+    return _flash_bwd_split(qf, kf, vf, dof, lsef, delta, kvlf, seg_ops,
                             (b, h, sq, skv, d), nq, nk, common,
                             interpret, k.dtype, v.dtype, q.dtype)
 
 
-def _flash_bwd_fused(qf, kf, vf, dof, lsef, delta, kvlf, dims, nq, nk,
-                     common, interpret, k_dtype, v_dtype, q_dtype):
+def _flash_bwd_fused(qf, kf, vf, dof, lsef, delta, kvlf, seg_ops, dims,
+                     nq, nk, common, interpret, k_dtype, v_dtype, q_dtype):
     """Single-pass dq/dk/dv (default; MXNET_TPU_FLASH_SPLIT_BWD=1
     selects the two-kernel path for A/B and as a fallback)."""
     b, h, sq, skv, d = dims
@@ -480,24 +656,29 @@ def _flash_bwd_fused(qf, kf, vf, dof, lsef, delta, kvlf, dims, nq, nk,
     block_q, block_k = common["block_q"], common["block_k"]
     sq_p, skv_p = nq * block_q, nk * block_k
 
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b_, j, i: (b_, i, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_k, d), lambda b_, j, i: (b_, j, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_k, d), lambda b_, j, i: (b_, j, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_q, d), lambda b_, j, i: (b_, i, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_q, 1), lambda b_, j, i: (b_, i, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_q, 1), lambda b_, j, i: (b_, i, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec(memory_space=pltpu.SMEM),
+    ]
+    operands = [qf, kf, vf, dof, lsef, delta, kvlf]
+    if seg_ops is not None:
+        in_specs += _seg_specs(block_q, block_k, h, transposed_grid=True)
+        operands += seg_ops
     dq_part, dk, dv = pl.pallas_call(
         functools.partial(_bwd_fused_kernel, **common),
         grid=(bh, nk, nq),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b_, j, i: (b_, i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), lambda b_, j, i: (b_, j, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), lambda b_, j, i: (b_, j, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q, d), lambda b_, j, i: (b_, i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q, 1), lambda b_, j, i: (b_, i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q, 1), lambda b_, j, i: (b_, i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, 1, block_q, d),
                          lambda b_, j, i: (b_, j, i, 0),
@@ -519,7 +700,7 @@ def _flash_bwd_fused(qf, kf, vf, dof, lsef, delta, kvlf, dims, nq, nk,
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
         interpret=interpret,
-    )(qf, kf, vf, dof, lsef, delta, kvlf)
+    )(*operands)
 
     dq = dq_part.sum(axis=1).astype(q_dtype) if nk > 1 \
         else dq_part[:, 0].astype(q_dtype)
@@ -529,56 +710,64 @@ def _flash_bwd_fused(qf, kf, vf, dof, lsef, delta, kvlf, dims, nq, nk,
     return dq, dk, dv
 
 
-def _flash_bwd_split(qf, kf, vf, dof, lsef, delta, kvlf, dims, nq, nk,
-                     common, interpret, k_dtype, v_dtype, q_dtype):
+def _flash_bwd_split(qf, kf, vf, dof, lsef, delta, kvlf, seg_ops, dims,
+                     nq, nk, common, interpret, k_dtype, v_dtype, q_dtype):
     b, h, sq, skv, d = dims
     bh = b * h
     block_q, block_k = common["block_q"], common["block_k"]
     sq_p, skv_p = nq * block_q, nk * block_k
 
+    dq_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b_, i, j: (b_, i, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_k, d), lambda b_, i, j: (b_, j, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_k, d), lambda b_, i, j: (b_, j, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_q, d), lambda b_, i, j: (b_, i, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_q, 1), lambda b_, i, j: (b_, i, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_q, 1), lambda b_, i, j: (b_, i, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec(memory_space=pltpu.SMEM),
+    ]
+    operands = [qf, kf, vf, dof, lsef, delta, kvlf]
+    if seg_ops is not None:
+        dq_specs += _seg_specs(block_q, block_k, h, transposed_grid=False)
+        operands += seg_ops
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, **common),
         grid=(bh, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b_, i, j: (b_, i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), lambda b_, i, j: (b_, j, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), lambda b_, i, j: (b_, j, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q, d), lambda b_, i, j: (b_, i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q, 1), lambda b_, i, j: (b_, i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q, 1), lambda b_, i, j: (b_, i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-        ],
+        in_specs=dq_specs,
         out_specs=pl.BlockSpec((1, block_q, d), lambda b_, i, j: (b_, i, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((bh, sq_p, d), q_dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
-    )(qf, kf, vf, dof, lsef, delta, kvlf)
+    )(*operands)
 
+    dkv_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b_, j, i: (b_, i, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_k, d), lambda b_, j, i: (b_, j, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_k, d), lambda b_, j, i: (b_, j, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_q, d), lambda b_, j, i: (b_, i, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_q, 1), lambda b_, j, i: (b_, i, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_q, 1), lambda b_, j, i: (b_, i, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec(memory_space=pltpu.SMEM),
+    ]
+    if seg_ops is not None:
+        dkv_specs += _seg_specs(block_q, block_k, h, transposed_grid=True)
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, **common),
         grid=(bh, nk, nq),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b_, j, i: (b_, i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), lambda b_, j, i: (b_, j, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), lambda b_, j, i: (b_, j, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q, d), lambda b_, j, i: (b_, i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q, 1), lambda b_, j, i: (b_, i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q, 1), lambda b_, j, i: (b_, i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-        ],
+        in_specs=dkv_specs,
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b_, j, i: (b_, j, 0),
                          memory_space=pltpu.VMEM),
@@ -594,7 +783,7 @@ def _flash_bwd_split(qf, kf, vf, dof, lsef, delta, kvlf, dims, nq, nk,
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
         interpret=interpret,
-    )(qf, kf, vf, dof, lsef, delta, kvlf)
+    )(*operands)
 
     dq = dq[:, :sq].reshape(b, h, sq, d)
     dk = dk[:, :skv].reshape(b, h, skv, d)
@@ -602,18 +791,19 @@ def _flash_bwd_split(qf, kf, vf, dof, lsef, delta, kvlf, dims, nq, nk,
     return dq, dk, dv
 
 
-def _kv_lens_ct(kv_lens):
-    """Cotangent for the integer kv_lens argument: None when absent,
-    float0 zeros when present (custom_vjp contract for int primals)."""
-    if kv_lens is None:
+def _int_ct(x):
+    """Cotangent for an integer tensor argument (kv_lens, segment_ids):
+    None when absent, float0 zeros when present (custom_vjp contract
+    for int primals)."""
+    if x is None:
         return None
-    import numpy as np
-    return np.zeros(kv_lens.shape, jax.dtypes.float0)
+    return np.zeros(x.shape, jax.dtypes.float0)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def flash_attention_with_lse(q, k, v, sm_scale=None, causal=False,
-                             q_offset=0, interpret=None, kv_lens=None):
+                             q_offset=0, interpret=None, kv_lens=None,
+                             segment_ids=None):
     """Flash attention returning (out, lse) — DIFFERENTIABLE in both
     outputs (the lse cotangent folds into the backward's delta term).
 
@@ -621,31 +811,36 @@ def flash_attention_with_lse(q, k, v, sm_scale=None, causal=False,
     schemes need; ring_attention folds per-chunk (out, lse) pairs with
     the log-sum-exp combiner and lets gradients flow through both.
     ``kv_lens`` (B,) int32 masks keys at/after each example's length.
+    ``segment_ids`` (B, S) int32 restricts attention to same-segment
+    pairs (sequence packing; see the module docstring).
     """
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
     return _flash_fwd(q, k, v, sm_scale, bool(causal), int(q_offset),
-                      resolve_interpret(interpret), kv_lens=kv_lens)
+                      resolve_interpret(interpret), kv_lens=kv_lens,
+                      segment_ids=segment_ids)
 
 
 def _flash_lse_vjp_fwd(q, k, v, sm_scale, causal, q_offset, interpret,
-                       kv_lens=None):
+                       kv_lens=None, segment_ids=None):
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
     o, lse = _flash_fwd(q, k, v, sm_scale, bool(causal), int(q_offset),
-                        resolve_interpret(interpret), kv_lens=kv_lens)
-    return (o, lse), (q, k, v, o, lse, kv_lens)
+                        resolve_interpret(interpret), kv_lens=kv_lens,
+                        segment_ids=segment_ids)
+    return (o, lse), (q, k, v, o, lse, kv_lens, segment_ids)
 
 
 def _flash_lse_vjp_bwd(sm_scale, causal, q_offset, interpret, res, cts):
-    q, k, v, o, lse, kv_lens = res
+    q, k, v, o, lse, kv_lens, segment_ids = res
     do, dlse = cts
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
     dq, dk, dv = _flash_bwd(q, k, v, o, lse, do, sm_scale, bool(causal),
                             int(q_offset), resolve_interpret(interpret),
-                            dlse=dlse, kv_lens=kv_lens)
-    return dq, dk, dv, _kv_lens_ct(kv_lens)
+                            dlse=dlse, kv_lens=kv_lens,
+                            segment_ids=segment_ids)
+    return dq, dk, dv, _int_ct(kv_lens), _int_ct(segment_ids)
 
 
 flash_attention_with_lse.defvjp(_flash_lse_vjp_fwd, _flash_lse_vjp_bwd)
@@ -653,34 +848,38 @@ flash_attention_with_lse.defvjp(_flash_lse_vjp_fwd, _flash_lse_vjp_bwd)
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def flash_attention(q, k, v, sm_scale=None, causal=False, q_offset=0,
-                    interpret=None, kv_lens=None):
-    """softmax(q k^T * scale [+causal/length mask]) v, blockwise in
-    VMEM. ``kv_lens`` (B,) int32 masks keys at/after each example's
-    valid length (variable-length batches, e.g. BERT padding)."""
+                    interpret=None, kv_lens=None, segment_ids=None):
+    """softmax(q k^T * scale [+causal/length/segment mask]) v,
+    blockwise in VMEM. ``kv_lens`` (B,) int32 masks keys at/after each
+    example's valid length (variable-length batches, e.g. BERT
+    padding); ``segment_ids`` (B, S) int32 makes attention
+    block-diagonal over packed sequences (see module docstring)."""
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
     o, _ = _flash_fwd(q, k, v, sm_scale, bool(causal), int(q_offset),
-                      resolve_interpret(interpret), kv_lens=kv_lens)
+                      resolve_interpret(interpret), kv_lens=kv_lens,
+                      segment_ids=segment_ids)
     return o
 
 
 def _flash_vjp_fwd(q, k, v, sm_scale, causal, q_offset, interpret,
-                   kv_lens=None):
+                   kv_lens=None, segment_ids=None):
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
     o, lse = _flash_fwd(q, k, v, sm_scale, bool(causal), int(q_offset),
-                        resolve_interpret(interpret), kv_lens=kv_lens)
-    return o, (q, k, v, o, lse, kv_lens)
+                        resolve_interpret(interpret), kv_lens=kv_lens,
+                        segment_ids=segment_ids)
+    return o, (q, k, v, o, lse, kv_lens, segment_ids)
 
 
 def _flash_vjp_bwd(sm_scale, causal, q_offset, interpret, res, do):
-    q, k, v, o, lse, kv_lens = res
+    q, k, v, o, lse, kv_lens, segment_ids = res
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
     dq, dk, dv = _flash_bwd(q, k, v, o, lse, do, sm_scale, bool(causal),
                             int(q_offset), resolve_interpret(interpret),
-                            kv_lens=kv_lens)
-    return dq, dk, dv, _kv_lens_ct(kv_lens)
+                            kv_lens=kv_lens, segment_ids=segment_ids)
+    return dq, dk, dv, _int_ct(kv_lens), _int_ct(segment_ids)
 
 
 flash_attention.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)

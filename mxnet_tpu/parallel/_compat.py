@@ -1,0 +1,25 @@
+"""jax API compatibility for the parallel layer.
+
+``shard_map`` moved from ``jax.experimental.shard_map`` to the
+top-level ``jax`` namespace, and its replication-check kwarg renamed
+``check_rep`` -> ``check_vma`` along the way. The kernels and sharding
+wrappers target the new spelling; this shim keeps the package importable
+(and the CPU-mesh test suite runnable) on older installed jax.
+"""
+from __future__ import annotations
+
+try:  # jax >= 0.6: top-level function, check_vma kwarg
+    from jax import shard_map as _shard_map
+    _CHECK_KWARG = "check_vma"
+except ImportError:  # older jax: experimental module, check_rep kwarg
+    from jax.experimental.shard_map import shard_map as _shard_map
+    _CHECK_KWARG = "check_rep"
+
+__all__ = ["shard_map"]
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None, **kwargs):
+    if check_vma is not None:
+        kwargs[_CHECK_KWARG] = check_vma
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **kwargs)

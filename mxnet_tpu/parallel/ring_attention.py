@@ -196,7 +196,7 @@ def ulysses_attention(q, k, v, axis_name, causal=False, sm_scale=None):
 
 
 def _seq_sharded_wrapper(fn, mesh, axis_name, **kw):
-    from jax import shard_map
+    from ._compat import shard_map
 
     spec = P(None, None, axis_name, None)
     wrapped = shard_map(

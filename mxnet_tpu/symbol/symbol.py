@@ -301,7 +301,10 @@ class Symbol:
             if "_training" not in params and _accepts_training(op):
                 from .. import autograd as _ag
                 params["_training"] = _ag.is_training()
-            out = op.fn(*flat, **params)
+            # signature-aware binding: folded scalars that precede a
+            # later Symbol arg (op(x, 2.0, y)) must not collide with the
+            # positional tensors at call time
+            out = _reg.call_op_fn(op, flat, params)
             vis = op.num_visible_outputs
             if vis is not None and isinstance(out, (tuple, list)):
                 out = list(out[:vis])
@@ -374,11 +377,12 @@ class Symbol:
                 params = {k: _parse_attr(v) for k, v in node._attrs.items()
                           if not k.startswith("__")}
                 try:
+                    from ..ndarray.register import call_op_fn
                     structs = [jax.ShapeDtypeStruct(s, np.float32)
                                for s in in_shapes]
                     out = jax.eval_shape(
-                        lambda *xs: _sym_note(node._op, node._op.fn(
-                            *xs, **params)), *structs)
+                        lambda *xs: _sym_note(node._op, call_op_fn(
+                            node._op, xs, params)), *structs)
                 except Exception:
                     continue
                 if not isinstance(out, (tuple, list)):

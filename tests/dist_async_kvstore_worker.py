@@ -67,6 +67,19 @@ def main():
     # worker sent them (asynchronous arrival, shared server state)
     assert np.allclose(u.asnumpy(), -1.5), u.asnumpy()
 
+    # --- lr changes AFTER set_optimizer reach the server optimizer
+    # (Trainer.set_learning_rate mutates the worker's copy; push must
+    # mirror it through the optattr path like rescale_grad)
+    kv.barrier()
+    kv._optimizer.set_learning_rate(0.25)
+    if rank == 0:
+        kv.push("u", nd.array(np.full((2,), 1.0, np.float32)))
+    kv.barrier()
+    kv.pull("u", out=u)
+    # one more grad=1 push at the NEW lr: -1.5 - 0.25 = -1.75
+    assert np.allclose(u.asnumpy(), -1.75), u.asnumpy()
+    kv._optimizer.set_learning_rate(0.5)  # restore for the Trainer leg
+
     # --- end-to-end: Trainer with update_on_kvstore (server-side SGD)
     from mxnet_tpu import autograd, gluon
 

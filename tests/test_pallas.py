@@ -446,6 +446,158 @@ def test_flash_attention_variable_length(causal, split_bwd, monkeypatch):
             assert np.all(arr[b_, :, int(kv_lens[b_]):] == 0.0)
 
 
+def _attn_seg_ref(q, k, v, seg, kv_lens=None, causal=False):
+    """Composed masked softmax with block-diagonal segment isolation —
+    the golden the packed kernel must match exactly."""
+    d = q.shape[-1]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) / np.sqrt(d)
+    mask = seg[:, None, :, None] == seg[:, None, None, :]
+    if kv_lens is not None:
+        mask = jnp.logical_and(
+            mask, jnp.arange(k.shape[2])[None, None, None, :]
+            < kv_lens[:, None, None, None])
+    if causal:
+        cm = jnp.tril(jnp.ones((s.shape[-2], s.shape[-1]), bool))
+        mask = jnp.logical_and(mask, cm)
+    s = jnp.where(mask, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    p = jnp.where(jnp.broadcast_to(mask, s.shape).any(-1, keepdims=True),
+                  p, 0.0)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+
+
+def _packed_case(rng, B, H, S, D):
+    q = jnp.asarray(rng.randn(B, H, S, D).astype(np.float32)) * 0.3
+    k = jnp.asarray(rng.randn(B, H, S, D).astype(np.float32)) * 0.3
+    v = jnp.asarray(rng.randn(B, H, S, D).astype(np.float32))
+    seg = np.zeros((B, S), np.int32)
+    # row 0: three segments + padding; row 1: one long segment + padding
+    b0 = [0, S // 3, S // 2, int(S * 0.9)]
+    seg[0, b0[0]:b0[1]] = 1
+    seg[0, b0[1]:b0[2]] = 2
+    seg[0, b0[2]:b0[3]] = 3
+    seg[1, :int(S * 0.8)] = 1
+    lens = jnp.asarray([int(S * 0.9), int(S * 0.8)], jnp.int32)
+    return q, k, v, jnp.asarray(seg), lens
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("split_bwd", [False, True])
+def test_flash_attention_segment_isolation(causal, split_bwd, monkeypatch):
+    """Sequence packing: per-token segment_ids make attention exactly
+    block-diagonal — forward and all three gradients match the composed
+    masked softmax on BOTH backward paths, including causal mode and
+    padding slots (id 0) that must emit exact zeros."""
+    if split_bwd:
+        monkeypatch.setenv("MXNET_TPU_FLASH_SPLIT_BWD", "1")
+    rng = np.random.RandomState(11)
+    B, H, S, D = 2, 2, 40, 16
+    q, k, v, seg, lens = _packed_case(rng, B, H, S, D)
+
+    o = flash_attention(q, k, v, None, causal, 0, True, lens, seg)
+    ref = _attn_seg_ref(q, k, v, seg, lens, causal)
+    np.testing.assert_allclose(np.asarray(o), np.asarray(ref),
+                               rtol=RTOL, atol=ATOL)
+    # padding rows (segment 0 past each row's used length) -> exact 0
+    pad = np.asarray(seg) == 0
+    assert np.all(np.asarray(o)[pad[:, None, :].repeat(H, 1)] == 0.0)
+
+    # loss masks padding (the packed-training contract)
+    w = jnp.asarray(rng.randn(B, H, S, D).astype(np.float32)) \
+        * (np.asarray(seg)[:, None, :, None] > 0)
+    gf = jax.grad(lambda q, k, v: (flash_attention(
+        q, k, v, None, causal, 0, True, lens, seg) * w).sum(),
+        argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda q, k, v: (_attn_seg_ref(
+        q, k, v, seg, lens, causal) * w).sum(), argnums=(0, 1, 2))(q, k, v)
+    for a, c in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(c),
+                                   rtol=RTOL, atol=ATOL)
+    # padding slots get identically-zero dk/dv
+    for g in gf[1:]:
+        assert np.all(np.asarray(g)[pad[:, None, :, None]
+                                    .repeat(H, 1).repeat(D, 3)] == 0.0)
+
+
+def test_flash_attention_segment_multiblock(monkeypatch):
+    """Multi-tile packed grid (forced 64x128 tiles over S=512): the
+    SMEM segment-range whole-block skip and the lane-broadcast equality
+    mask must agree with the composed reference across tile boundaries;
+    128<block_k exercises the pltpu.repeat id layout."""
+    monkeypatch.setenv("MXNET_TPU_FLASH_BLOCK_Q", "64")
+    monkeypatch.setenv("MXNET_TPU_FLASH_BLOCK_K", "128")
+    rng = np.random.RandomState(12)
+    B, H, S, D = 2, 2, 512, 32
+    q, k, v, seg, lens = _packed_case(rng, B, H, S, D)
+    for causal in (False, True):
+        o = flash_attention(q, k, v, None, causal, 0, True, lens, seg)
+        ref = _attn_seg_ref(q, k, v, seg, lens, causal)
+        np.testing.assert_allclose(np.asarray(o), np.asarray(ref),
+                                   rtol=RTOL, atol=ATOL)
+    w = jnp.asarray(rng.randn(B, H, S, D).astype(np.float32)) \
+        * (np.asarray(seg)[:, None, :, None] > 0)
+    gf = jax.grad(lambda q, k, v: (flash_attention(
+        q, k, v, None, False, 0, True, lens, seg) * w).sum(),
+        argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda q, k, v: (_attn_seg_ref(
+        q, k, v, seg, lens, False) * w).sum(), argnums=(0, 1, 2))(q, k, v)
+    for a, c in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(c),
+                                   rtol=RTOL, atol=ATOL)
+    # the repeat branch (block_k > 128) on the same case
+    monkeypatch.setenv("MXNET_TPU_FLASH_BLOCK_K", "256")
+    o = flash_attention(q, k, v, None, False, 0, True, lens, seg)
+    np.testing.assert_allclose(
+        np.asarray(o), np.asarray(_attn_seg_ref(q, k, v, seg, lens, False)),
+        rtol=RTOL, atol=ATOL)
+
+
+def test_flash_attention_segments_reject_cross_attention():
+    """segment_ids with Sq != Skv (KV-cache decode) has no packed
+    meaning — the kernel refuses instead of mis-masking."""
+    rng = np.random.RandomState(13)
+    q = jnp.asarray(rng.randn(1, 1, 8, 8).astype(np.float32))
+    k = jnp.asarray(rng.randn(1, 1, 16, 8).astype(np.float32))
+    seg = jnp.ones((1, 16), jnp.int32)
+    with pytest.raises(ValueError):
+        flash_attention(q, k, k, None, False, 0, True, None, seg)
+
+
+def test_flash_attention_op_segment_dispatch(monkeypatch):
+    """mx.nd.flash_attention(q, k, v, valid_len, segment_ids) routes
+    the ids to the kernel AND the jnp fallback identically, and the
+    packed output for each segment matches that segment run alone."""
+    monkeypatch.setenv("MXNET_TPU_PALLAS_INTERPRET", "1")
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+
+    rng = np.random.RandomState(14)
+    B, H, S, D = 1, 2, 32, 8
+    q = mx.nd.array(rng.randn(B, H, S, D).astype(np.float32) * 0.3)
+    k = mx.nd.array(rng.randn(B, H, S, D).astype(np.float32) * 0.3)
+    v = mx.nd.array(rng.randn(B, H, S, D).astype(np.float32))
+    seg_np = np.zeros((B, S), np.int32)
+    seg_np[0, :12] = 1
+    seg_np[0, 12:26] = 2
+    seg = mx.nd.array(seg_np, dtype="int32")
+    vl = mx.nd.array(np.array([26], np.float32))
+
+    out_kernel = nd.flash_attention(q, k, v, vl, seg)
+    monkeypatch.setenv("MXNET_TPU_DISABLE_PALLAS", "1")
+    out_jnp = nd.flash_attention(q, k, v, vl, seg)
+    monkeypatch.delenv("MXNET_TPU_DISABLE_PALLAS")
+    np.testing.assert_allclose(out_kernel.asnumpy(), out_jnp.asnumpy(),
+                               rtol=RTOL, atol=ATOL)
+
+    # each packed segment == the same tokens run alone (unpacked golden)
+    for lo, hi in ((0, 12), (12, 26)):
+        alone = nd.flash_attention(
+            q[:, :, lo:hi], k[:, :, lo:hi], v[:, :, lo:hi])
+        np.testing.assert_allclose(out_kernel.asnumpy()[:, :, lo:hi],
+                                   alone.asnumpy(), rtol=RTOL, atol=ATOL)
+
+
 def test_flash_attention_op_valid_len_dispatch(monkeypatch):
     """mx.nd.flash_attention(q, k, v, valid_len) routes the length to
     the kernel AND the jnp fallback identically."""

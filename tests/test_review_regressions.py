@@ -85,3 +85,31 @@ def test_top_level_namespace_aliases():
     assert mx.init is mx.initializer
     assert mx.sym is mx.symbol
     assert mx.viz is mx.visualization
+
+
+def test_symbol_scalar_before_later_symbol_arg():
+    """ADVICE r5: sym.op(x, 2.0, y) — a scalar folded from a position
+    BEFORE a later Symbol arg must bind around the scalar's signature
+    slot at executor time, not collide with it ("multiple values")."""
+    import mxnet_tpu.symbol as sym
+    from mxnet_tpu.ndarray.register import register_op
+    import mxnet_tpu.symbol.symbol as symmod
+
+    if not hasattr(sym, "_test_scalar_mid"):
+        @register_op("_test_scalar_mid")
+        def _test_scalar_mid(x, a, y, b=1.0):
+            return a * x + b * y
+        symmod._populate_symbol_ops(sym)
+
+    x = nd.array(np.full((2, 3), 2.0, np.float32))
+    y = nd.array(np.full((2, 3), 10.0, np.float32))
+    s = sym._test_scalar_mid(sym.Variable("x"), 3.0, sym.Variable("y"),
+                             b=0.5)
+    ex = s.bind(mx.cpu(), {"x": x, "y": y})
+    out = ex.forward()[0].asnumpy()
+    assert np.allclose(out, 11.0), out  # 3*2 + 0.5*10
+    args, outs, _ = s.infer_shape(x=(2, 3), y=(2, 3))
+    assert outs == [(2, 3)]
+    # eager trailing-scalar folding unchanged
+    got = nd.clip(nd.array(np.array([-2.0, 0.5, 9.0])), 0.0, 1.0)
+    assert np.allclose(got.asnumpy(), [0.0, 0.5, 1.0])

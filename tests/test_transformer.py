@@ -157,3 +157,102 @@ def test_bert_padding_mask_isolates_padding():
     # the two maskings agree on valid positions
     np.testing.assert_allclose(v1.asnumpy()[:, :12], s1.asnumpy()[:, :12],
                                rtol=1e-5, atol=1e-6)
+
+
+def test_mha_segment_flash_vs_composed(monkeypatch):
+    """Packed MultiHeadAttention: the flash path (kernel segment mask)
+    and the composed path (attention_segment_mask +
+    attention_zero_pad_rows) agree on outputs AND input grads,
+    including exact zeros on padding rows."""
+    monkeypatch.setenv("MXNET_TPU_PALLAS_INTERPRET", "1")
+    rng = np.random.RandomState(21)
+    B, S, C, Hd = 2, 24, 32, 4
+    mx.random.seed(5)
+    attn = nn.MultiHeadAttention(C, Hd)
+    attn.initialize(init=mx.initializer.Xavier())
+    x = mx.nd.array(rng.randn(B, S, C).astype(np.float32))
+    seg_np = np.zeros((B, S), np.int32)
+    seg_np[0, :10] = 1
+    seg_np[0, 10:20] = 2
+    seg_np[1, :16] = 1
+    seg = mx.nd.array(seg_np, dtype="int32")
+    wmask = mx.nd.array((seg_np > 0).astype(np.float32)[:, :, None])
+
+    x.attach_grad()
+    with autograd.record():
+        out_flash = attn(x, None, None, seg)  # valid_length derived
+        (out_flash * wmask).sum().backward()
+    g_flash = x.grad.asnumpy().copy()
+
+    # zero additive mask forces the composed path, same math
+    zero_mask = mx.nd.zeros((B, 1, S, S))
+    x2 = mx.nd.array(x.asnumpy())
+    x2.attach_grad()
+    with autograd.record():
+        out_comp = attn(x2, zero_mask, None, seg)
+        (out_comp * wmask).sum().backward()
+
+    np.testing.assert_allclose(out_flash.asnumpy(), out_comp.asnumpy(),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(g_flash, x2.grad.asnumpy(),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_bert_packed_matches_unpacked_fwd_and_grads(monkeypatch):
+    """THE packing acceptance golden: a packed BERT batch (segment_ids
+    + per-segment positions + valid_length) reproduces, per sequence,
+    the outputs AND parameter gradients of the same sequences run
+    unpacked — the flash path's cross-sequence attention is exactly
+    zero and padding contributes nothing to the masked loss."""
+    from mxnet_tpu.gluon.model_zoo.bert import BERTModel
+    from mxnet_tpu.io.packing import pack_sequences, unpack_sequences
+
+    monkeypatch.setenv("MXNET_TPU_PALLAS_INTERPRET", "1")
+    rs = np.random.RandomState(22)
+    vocab, units, L = 120, 32, 40
+    mx.random.seed(6)
+    net = BERTModel(vocab_size=vocab, units=units, hidden_size=64,
+                    num_layers=2, num_heads=4, max_length=L, dropout=0.0,
+                    attention_dropout=0.0, use_pooler=False)
+    net.initialize(init=mx.initializer.Normal(0.02))
+
+    seqs = [rs.randint(1, vocab, n).astype(np.int32)
+            for n in (18, 13, 7, 26)]
+    packed = pack_sequences(seqs, L)
+    R = packed.data.shape[0]
+    ids = mx.nd.array(packed.data, dtype="int32")
+    tt = mx.nd.zeros((R, L), dtype="int32")
+    seg = mx.nd.array(packed.segment_ids, dtype="int32")
+    pos = mx.nd.array(packed.positions, dtype="int32")
+    vl = mx.nd.array(packed.valid_length, dtype="int32")
+    lmask = mx.nd.array((packed.segment_ids > 0).astype(np.float32))
+
+    params = list(net.collect_params().values())
+    with autograd.record():
+        seq_out = net(ids, tt, vl, None, seg, pos)
+        loss_p = (seq_out.square() * lmask.expand_dims(-1)).sum()
+    loss_p.backward()
+    packed_out = seq_out.asnumpy()
+    packed_grads = {p.name: p.grad().asnumpy().copy() for p in params
+                    if p.grad_req != "null"}
+
+    # reference: every sequence alone; grads accumulate across runs
+    per_seq = unpack_sequences(packed_out, packed.placements)
+    ref_grads = None
+    for s, got in zip(seqs, per_seq):
+        one = mx.nd.array(s[None, :], dtype="int32")
+        with autograd.record():
+            ref = net(one, mx.nd.zeros((1, len(s)), dtype="int32"))
+            loss_u = ref.square().sum()
+        loss_u.backward()
+        np.testing.assert_allclose(got, ref.asnumpy()[0],
+                                   rtol=2e-5, atol=2e-5)
+        g = {p.name: p.grad().asnumpy().copy() for p in params
+             if p.grad_req != "null"}
+        ref_grads = g if ref_grads is None else \
+            {k: ref_grads[k] + g[k] for k in g}
+
+    for name, gp in packed_grads.items():
+        np.testing.assert_allclose(
+            gp, ref_grads[name], rtol=2e-4, atol=2e-4,
+            err_msg=f"param grad mismatch: {name}")
