@@ -781,6 +781,190 @@ def main_bert():
             seqlen=seqlen, dtype=DTYPE, chain=CHAIN, **extras)
 
 
+def main_causal_lm():
+    """Packed CAUSAL LM training step, tokens/sec/chip (ROADMAP
+    follow-up: the causal segment kernel path was tested but never
+    benchmarked). GPT-small-shaped trunk at the bert_base budget
+    (L=12, H=768, A=12 over a 30522 vocab), always packed: the same
+    U[S/2, S] length mix as the packed BERT leg, first-fit into
+    BENCH_PACK_ROWLEN-slot rows, per-segment causal attention via the
+    flash kernel's segment_ids + causal path, next-token labels
+    shifted within each segment."""
+    import jax
+    import jax.numpy as jnp
+
+    _setup_cache()
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.block import functionalize
+    from mxnet_tpu.io.packing import pack_sequences, packing_efficiency
+
+    batch = int(os.environ.get("BENCH_BATCH", "64"))
+    seqlen = int(os.environ.get("BENCH_SEQLEN", "512"))
+    vocab = int(os.environ.get("BENCH_VOCAB", "30522"))
+    units = int(os.environ.get("BENCH_LM_UNITS", "768"))
+    layers = int(os.environ.get("BENCH_LM_LAYERS", "12"))
+    heads = int(os.environ.get("BENCH_LM_HEADS", "12"))
+    ctx = mx.current_context()
+
+    class PackedCausalLM(mx.gluon.HybridBlock):
+        """embed + per-segment positions -> causal encoder -> vocab."""
+
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.embed = mx.gluon.nn.Embedding(vocab, units)
+                self.pos_embed = mx.gluon.nn.Embedding(seqlen, units)
+                self.encoder = mx.gluon.nn.TransformerEncoder(
+                    layers, units, 4 * units, heads, dropout=0.0,
+                    attention_dropout=0.0, activation="gelu",
+                    causal=True)
+                self.decoder = mx.gluon.nn.Dense(vocab, flatten=False)
+
+        def hybrid_forward(self, F, ids, positions, valid_length,
+                           segment_ids):
+            x = self.embed(ids) + self.pos_embed(positions)
+            h = self.encoder(x, None, valid_length, segment_ids)
+            return self.decoder(h)
+
+    net = PackedCausalLM()
+    net.initialize(init=mx.initializer.Normal(0.02), ctx=ctx)
+    if DTYPE != "float32":
+        net.cast(DTYPE)
+    warm = mx.nd.zeros((2, seqlen), ctx=ctx, dtype="int32")
+    with mx.autograd.predict_mode():
+        net(warm, warm, mx.nd.array([seqlen, seqlen], ctx=ctx,
+                                    dtype="int32"), warm)
+    fn, params = functionalize(net, training=True, ctx=ctx)
+
+    rng = jax.random.PRNGKey(0)
+    npr = np.random.RandomState(0)
+    row_len = int(os.environ.get("BENCH_PACK_ROWLEN", str(4 * seqlen)))
+    rows = max(1, batch * seqlen // row_len)
+    # same oversample-and-keep-fullest selection as the packed BERT leg
+    n_pool = 4 * rows * row_len // (3 * seqlen // 4)
+    lens_pool = npr.randint(seqlen // 2, seqlen + 1, n_pool)
+    seq_pool = [npr.randint(0, vocab, n).astype(np.int32)
+                for n in lens_pool]
+    # next-token labels INSIDE each segment (the last position predicts
+    # a fresh random token — same flops, honest LM shape)
+    lab_pool = [np.concatenate([s[1:], npr.randint(0, vocab, 1)
+                                .astype(np.int32)]) for s in seq_pool]
+    pb = pack_sequences(seq_pool, row_len, extras=[lab_pool])
+    order = np.argsort(-pb.valid_length)[:rows]
+    ids = jnp.asarray(pb.data[order], jnp.int32)
+    segs = jnp.asarray(pb.segment_ids[order], jnp.int32)
+    pos = jnp.asarray(pb.positions[order], jnp.int32)
+    lens = jnp.asarray(pb.valid_length[order], jnp.int32)
+    labels = jnp.asarray(pb.extras[0][order], jnp.int32)
+    pack_eff = packing_efficiency(pb.segment_ids[order])
+
+    def xent(flat, labels_flat):
+        from mxnet_tpu.ops import pallas as _pallas
+        if _pallas.pallas_enabled():
+            return _pallas.softmax_xent_fused(flat, labels_flat)
+        logp = jax.nn.log_softmax(flat.astype(jnp.float32), axis=-1)
+        return -jnp.take_along_axis(
+            logp, labels_flat[:, None], axis=-1)[:, 0]
+
+    def loss_fn(params, rng, ids, pos, lens, segs, labels):
+        logits = fn(params, rng, ids, pos, lens, segs)
+        loss = xent(logits.reshape(-1, vocab), labels.reshape(-1))
+        w = (segs > 0).astype(jnp.float32).reshape(-1)
+        return (loss.astype(jnp.float32) * w).sum() / w.sum()
+
+    step = _make_momentum_sgd(loss_fn, 1e-3)
+    moms = _zeros_moms(params)
+    args = (ids, pos, lens, segs, labels)
+
+    flops, nbytes = _step_cost(step, params, moms, rng, *args)
+    dt = _time_steps(step, params, moms, rng, *args,
+                     flops_per_step=flops * CHAIN,
+                     bytes_per_step=nbytes * CHAIN)
+
+    slots = rows * row_len
+    slots_per_sec = slots * STEPS * CHAIN / dt
+    _report("causal_lm_train_tokens_per_sec_per_chip", slots_per_sec,
+            "tokens/sec/chip", 0.0,
+            flops_per_step=flops, sec_per_step=dt / STEPS / CHAIN,
+            bytes_per_step=nbytes, batch=rows, seqlen=seqlen, dtype=DTYPE,
+            chain=CHAIN, packed=True, causal=True, row_len=row_len,
+            rows=rows, packing_efficiency=round(pack_eff, 4),
+            valid_tokens_per_sec=round(slots_per_sec * pack_eff, 2))
+
+
+def main_serving():
+    """Closed-loop packed continuous-batching serving bench
+    (mxnet_tpu/serving): synthetic variable-length traffic from
+    BENCH_SERVE_CLIENTS closed-loop clients against a BERT
+    encoder/embedder, reporting requests/sec, client-observed
+    p50/p95/p99 latency, valid_tokens_per_sec, and the engine's batch
+    packing_efficiency. The engine pre-compiles its whole shape
+    universe (warmup) so the measured window is steady-state serving,
+    not tracing."""
+    _setup_cache()
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo.bert import BERTModel, bert_serving_entry
+    from mxnet_tpu.serving import ServingEngine
+
+    tools_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "tools")
+    if tools_dir not in sys.path:
+        sys.path.insert(0, tools_dir)
+    from serve_loadgen import run_load
+
+    seqlen = int(os.environ.get("BENCH_SEQLEN", "512"))
+    vocab = int(os.environ.get("BENCH_VOCAB", "30522"))
+    units = int(os.environ.get("BENCH_SERVE_UNITS", "768"))
+    layers = int(os.environ.get("BENCH_SERVE_LAYERS", "12"))
+    heads = int(os.environ.get("BENCH_SERVE_HEADS", "12"))
+    clients = int(os.environ.get("BENCH_SERVE_CLIENTS", "16"))
+    reqs = int(os.environ.get("BENCH_SERVE_REQS", "16"))
+    max_rows = int(os.environ.get("BENCH_SERVE_ROWS", "8"))
+    buckets = tuple(int(b) for b in os.environ.get(
+        "BENCH_SERVE_BUCKETS", f"{max(1, seqlen // 4)},{seqlen}")
+        .split(","))
+    ctx = mx.current_context()
+
+    net = BERTModel(vocab_size=vocab, units=units, hidden_size=4 * units,
+                    num_layers=layers, num_heads=heads, max_length=seqlen,
+                    dropout=0.0, attention_dropout=0.0, use_pooler=False)
+    net.initialize(init=mx.initializer.Normal(0.02), ctx=ctx)
+    if DTYPE != "float32":
+        net.cast(DTYPE)
+
+    engine = ServingEngine(bert_serving_entry(net), ctx=ctx,
+                           bucket_lens=buckets, max_rows=max_rows,
+                           max_queue_depth=max(64, 8 * clients),
+                           pool="mean")
+    with engine:
+        engine.warmup()
+        # one throwaway closed-loop pass: page caches, thread spin-up
+        run_load(engine, n_clients=min(4, clients), requests_per_client=2,
+                 min_len=max(4, seqlen // 8), max_len=seqlen, vocab=vocab)
+        # fresh stats: the reported packing/latency numbers must cover
+        # ONLY the measured window, not the throwaway traffic
+        engine.reset_stats()
+        report = run_load(engine, n_clients=clients,
+                          requests_per_client=reqs,
+                          min_len=max(4, seqlen // 8), max_len=seqlen,
+                          vocab=vocab)
+    snap = report.pop("engine")
+    assert report["completed"] == clients * reqs, report
+    _report("bert_serving_requests_per_sec_per_chip",
+            report["requests_per_sec"], "requests/sec/chip", 0.0,
+            seqlen=seqlen, batch=max_rows, clients=clients,
+            requests=report["completed"], dtype=DTYPE,
+            p50_ms=report["p50_ms"], p95_ms=report["p95_ms"],
+            p99_ms=report["p99_ms"],
+            valid_tokens_per_sec=report["valid_tokens_per_sec"],
+            packing_efficiency=snap["packing_efficiency"],
+            serve_buckets=list(buckets),
+            compute_p50_ms=snap["latency"]["compute"].get("p50_ms"),
+            queue_p50_ms=snap["latency"]["queue"].get("p50_ms"))
+
+
 def main_lstm():
     """LSTM LM training step, tokens/sec/chip (BASELINE #4).
 
@@ -969,6 +1153,14 @@ _SUITE = (
      {"BENCH_SEQLEN": "512", "BENCH_BATCH": "64", "BENCH_PACKED": "1",
       "BENCH_WINDOWS": "1", "MXNET_TPU_FLASH_BLOCK_Q": "256",
       "MXNET_TPU_FLASH_BLOCK_K": "256"}),
+    # packed CAUSAL LM (ROADMAP follow-up): the kernel's causal segment
+    # path under a real training step; same tiling/length mix as the
+    # packed BERT leg so the two numbers compare directly
+    ("lm_seq512_packed_causal", "causal_lm",
+     {"BENCH_SEQLEN": "512", "BENCH_BATCH": "64", "BENCH_WINDOWS": "1",
+      "MXNET_TPU_FLASH_BLOCK_Q": "256", "MXNET_TPU_FLASH_BLOCK_K": "256"}),
+    # closed-loop packed continuous-batching serving (mxnet_tpu/serving)
+    ("bert_serving", "serving", {"BENCH_WINDOWS": "1"}),
     # seq2048 BEFORE seq1024 (it was the r5 rc=124 casualty) and with a
     # shorter chain/step budget: chain=4 compiles a 4-step scan instead
     # of 10 — the 420 s per-config cap was lost to trace+compile time,
@@ -992,7 +1184,8 @@ _SUITE = (
 # tail must hold the WHOLE suite in one line)
 _SUMMARY_KEYS = ("metric", "value", "unit", "mfu", "hbm_frac", "hbm_est",
                  "valid_frac", "valid_tokens_per_sec", "packing_efficiency",
-                 "seqlen", "batch", "failed")
+                 "seqlen", "batch", "failed", "causal", "clients",
+                 "p50_ms", "p99_ms")
 
 
 def _compact(rec):
@@ -1118,6 +1311,10 @@ def _dispatch():
         main_suite()
     elif _model == "bert":
         main_bert()
+    elif _model == "causal_lm":
+        main_causal_lm()
+    elif _model == "serving":
+        main_serving()
     elif _model == "lstm":
         main_lstm()
     elif _model == "widedeep":

@@ -2,6 +2,6 @@ from . import vision
 from .vision import get_model
 from . import bert
 from .bert import (BERTModel, BERTMLMHead, BERTNSPHead, bert_base,
-                   bert_large, get_bert)
+                   bert_large, bert_serving_entry, get_bert)
 from . import wide_deep as wide_deep_zoo
 from .wide_deep import WideDeep, wide_deep

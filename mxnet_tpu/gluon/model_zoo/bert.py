@@ -17,7 +17,7 @@ from ..nn import Dense, Dropout, Embedding, LayerNorm, TransformerEncoder
 from ..nn.basic_layers import Activation
 
 __all__ = ["BERTModel", "BERTMLMHead", "BERTNSPHead", "bert_base", "bert_large",
-           "get_bert"]
+           "get_bert", "bert_serving_entry"]
 
 
 class BERTEmbeddings(HybridBlock):
@@ -183,3 +183,31 @@ def bert_base(**kwargs):
 
 def bert_large(**kwargs):
     return get_bert("bert_large", **kwargs)
+
+
+def bert_serving_entry(model, head=None, hybridize=True):
+    """Adapt a (initialized) BERT trunk to the ``ServingEngine`` model
+    contract: ``entry(ids, token_types, valid_length, segment_ids,
+    positions) -> (B, S, U)`` per-token outputs on packed rows.
+
+    The packed pooled output is meaningless (row slot 0 is only the
+    first packed sequence's [CLS]) so only the sequence output rides;
+    the engine slices per-request outputs by placement and pools
+    per SEGMENT (``pool="cls"/"mean"``) — the packed-correct analog of
+    the pooler. ``head`` (e.g. a scorer Dense/BERTMLMHead) applies to
+    the sequence output inside the same traced graph. ``hybridize``
+    activates the CachedOp so each (rows, row_len) shape bucket
+    compiles once and is cached — the serving fast path.
+    """
+    if hybridize:
+        model.hybridize()
+        if head is not None:
+            head.hybridize()
+
+    def entry(ids, token_types, valid_length, segment_ids, positions):
+        out = model(ids, token_types, valid_length, None, segment_ids,
+                    positions)
+        seq = out[0] if isinstance(out, (list, tuple)) else out
+        return head(seq) if head is not None else seq
+
+    return entry

@@ -46,7 +46,8 @@ from collections import namedtuple
 import numpy as np
 
 __all__ = ["PackedBatch", "Placement", "pack_sequences", "unpack_sequences",
-           "packing_efficiency", "PackedBatchify", "PackedSeqIter"]
+           "packing_efficiency", "PackedBatchify", "PackedSeqIter",
+           "StreamingPacker", "stream_pack"]
 
 
 PackedBatch = namedtuple(
@@ -255,3 +256,134 @@ class PackedSeqIter:
             data=[take(a) for a in self._arrays],
             label=[take(a) for a in self._labels],
             pad=pad)
+
+
+class StreamingPacker:
+    """Online first-fit packer over a BOUNDED set of open rows.
+
+    ``pack_sequences`` needs the whole sample list up front; a corpus
+    reader (or a serving batcher) sees samples one at a time and cannot
+    hold an unbounded open-row set. This packer keeps at most
+    ``open_rows`` rows open: a sample first-fits into an open row, and
+    when none fits and the buffer is full, the FULLEST open row is
+    closed and emitted — the bounded-buffer variant of the same greedy
+    algorithm (what the module docstring calls "the online algorithm a
+    streaming corpus reader can run", now actually runnable on an
+    endless stream).
+
+    ``add`` returns the list of rows the call closed (usually empty);
+    ``flush`` closes and returns everything still open. Each emitted
+    row is a 1-row :class:`PackedBatch` sharing the layout contract
+    above; ``placements`` are in the order the samples were added to
+    that row.
+    """
+
+    def __init__(self, seq_len, open_rows=8, pad_value=0, dtype=None):
+        if open_rows < 1:
+            raise ValueError("open_rows must be >= 1")
+        self._seq_len = seq_len
+        self._open_rows = open_rows
+        self._pad = pad_value
+        self._dtype = dtype
+        self._open = []   # list of dicts: used, samples=[(seq, extras)]
+
+    @property
+    def open_rows(self):
+        """(used_slots, n_samples) per currently-open row."""
+        return [(row["used"], len(row["samples"])) for row in self._open]
+
+    def _emit(self, row):
+        seqs = [s for s, _ in row["samples"]]
+        n_extras = len(row["samples"][0][1])
+        extras = [[ex[e] for _, ex in row["samples"]]
+                  for e in range(n_extras)] or None
+        # the samples fit one row by construction, so offline first-fit
+        # over just them reproduces the exact single-row layout
+        return pack_sequences(seqs, self._seq_len, extras=extras,
+                              pad_value=self._pad, dtype=self._dtype,
+                              max_rows=1)
+
+    def add(self, seq, extras=()):
+        """Place one sample; returns the rows this call closed."""
+        seq = np.asarray(seq).reshape(-1)
+        n = len(seq)
+        if not 0 < n <= self._seq_len:
+            raise ValueError(
+                f"sample has length {n}, outside (0, {self._seq_len}]")
+        extras = tuple(np.asarray(e) for e in extras)
+        for e in extras:
+            if len(e) != n:
+                raise ValueError(
+                    f"extra has length {len(e)} != sample length {n}")
+        if self._open and len(extras) != len(self._open[0]["samples"][0][1]):
+            raise ValueError("extras arity changed mid-stream")
+        closed = []
+        for row in self._open:                      # first fit
+            if row["used"] + n <= self._seq_len:
+                row["used"] += n
+                row["samples"].append((seq, extras))
+                return closed
+        if len(self._open) >= self._open_rows:
+            # no open row fits: close the fullest (it has the least
+            # headroom left — the row least likely to ever fit again)
+            fullest = max(range(len(self._open)),
+                          key=lambda i: self._open[i]["used"])
+            closed.append(self._emit(self._open.pop(fullest)))
+        self._open.append({"used": n, "samples": [(seq, extras)]})
+        return closed
+
+    def flush(self):
+        """Close every open row (stream end); returns them in the
+        order they were opened."""
+        out = [self._emit(row) for row in self._open]
+        self._open = []
+        return out
+
+
+def stream_pack(samples, seq_len, batch_rows=None, open_rows=8,
+                pad_value=0, dtype=None):
+    """Generator: first-fit-pack a sample stream on the fly.
+
+    ``samples`` yields 1-D token arrays or (tokens, extra, ...) tuples
+    (per-token labels/weights, as in :class:`PackedBatchify`). Rows are
+    packed through a :class:`StreamingPacker` with a bounded
+    ``open_rows`` buffer; with ``batch_rows=None`` each completed row
+    is yielded as a 1-row :class:`PackedBatch`, otherwise rows are
+    accumulated and yielded as (batch_rows, seq_len) batches (the final
+    flush may yield a short batch). This is the epoch feeder the
+    offline ``pack_sequences`` could not be: memory is bounded by
+    ``open_rows + batch_rows`` rows regardless of corpus size."""
+    packer = StreamingPacker(seq_len, open_rows=open_rows,
+                             pad_value=pad_value, dtype=dtype)
+    pending = []
+    for sample in samples:
+        if isinstance(sample, tuple):
+            seq, extras = sample[0], tuple(sample[1:])
+        else:
+            seq, extras = sample, ()
+        pending.extend(packer.add(seq, extras))
+        yield from _drain(pending, batch_rows, done=False)
+    pending.extend(packer.flush())
+    yield from _drain(pending, batch_rows, done=True)
+
+
+def _drain(pending, batch_rows, done):
+    """Yield ready batches out of ``pending`` single-row packs."""
+    if batch_rows is None:
+        while pending:
+            yield pending.pop(0)
+        return
+    while len(pending) >= batch_rows or (done and pending):
+        rows = [pending.pop(0) for _ in range(min(batch_rows, len(pending)))]
+        placements = []
+        for r, row in enumerate(rows):
+            placements.extend(Placement(r, p.offset, p.length, p.segment)
+                              for p in row.placements)
+        yield PackedBatch(
+            np.concatenate([r.data for r in rows]),
+            np.concatenate([r.segment_ids for r in rows]),
+            np.concatenate([r.positions for r in rows]),
+            np.concatenate([r.valid_length for r in rows]),
+            placements,
+            [np.concatenate([r.extras[e] for r in rows])
+             for e in range(len(rows[0].extras))])

@@ -28,7 +28,7 @@ XLA collectives. Semantics preserved:
 from __future__ import annotations
 
 import os
-import pickle
+import struct
 from typing import Optional
 
 import jax
@@ -356,7 +356,10 @@ class DistKVStore(KVStore):
 class _ParameterServer:
     """Host-side parameter server (the ps-lite server role) for
     ``dist_async``: runs as a daemon thread in worker 0's process,
-    speaking length-prefixed pickles over TCP. State and updates live
+    speaking length-prefixed TYPED frames over TCP (``_wire_encode`` —
+    plain data + raw ndarray bytes, nothing executable; and the socket
+    binds the launcher-announced interface, not 0.0.0.0). State and
+    updates live
     in a plain local :class:`KVStore` on host-CPU NDArrays — exactly
     the reference's CPU server-side update path
     (src/kvstore/kvstore_dist_server.h); workers push gradients and
@@ -377,7 +380,18 @@ class _ParameterServer:
         self._barrier_gen = 0
         srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        srv.bind((host, port))
+        try:
+            srv.bind((host, port))
+        except OSError:
+            # the launcher-announced address may be a NAT/bridged
+            # front address not assigned to any local interface
+            # (containerized deployments); availability beats the
+            # narrower bind there — fall back loudly to all interfaces
+            import sys
+            print(f"mxnet_tpu dist_async server: cannot bind "
+                  f"{host}:{port} locally; falling back to 0.0.0.0",
+                  file=sys.stderr)
+            srv.bind(("0.0.0.0", port))
         srv.listen(num_workers + 2)
         self._srv = srv
         threading.Thread(target=self._accept_loop, daemon=True).start()
@@ -409,6 +423,13 @@ class _ParameterServer:
                                      f"{traceback.format_exc(limit=5)}"))
         except (ConnectionError, EOFError, OSError):
             return
+        except (ValueError, MXNetError) as e:
+            # malformed/refused wire frame: drop THIS client, keep
+            # serving the rest (and leave a trace for the operator)
+            import sys
+            print(f"mxnet_tpu dist_async server: dropping connection on "
+                  f"bad frame: {e}", file=sys.stderr)
+            return
         finally:
             try:
                 conn.close()
@@ -431,14 +452,22 @@ class _ParameterServer:
             with self._lock:
                 return self._store._get(key).asnumpy()
         if op == "setopt":
-            import pickle
             with self._lock:
                 # replace on a genuinely different optimizer (resets
                 # updater state, as setting a new optimizer should);
-                # byte-equal re-sends from other workers are idempotent
+                # equal re-sends from other workers are idempotent
                 if payload != self._opt_payload:
+                    from . import optimizer as _optmod
+                    name, attrs, sched_spec = payload
+                    opt = _optmod.create(name)
+                    for k, v in attrs.items():
+                        setattr(opt, k, dict(v) if isinstance(v, dict)
+                                else v)
+                    if sched_spec is not None:
+                        opt.lr_scheduler = _rebuild_wire_scheduler(
+                            sched_spec)
                     self._opt_payload = payload
-                    self._store.set_optimizer(pickle.loads(payload))
+                    self._store.set_optimizer(opt)
             return None
         if op == "optattr":
             # per-step optimizer attribute sync (rescale_grad changes on
@@ -468,16 +497,151 @@ class _ParameterServer:
         raise MXNetError(f"unknown op {op!r}")
 
 
+# -- dist_async wire codec ------------------------------------------------
+# Typed, NON-EXECUTABLE frame encoding. The first cut of this wire
+# spoke length-prefixed pickled objects — i.e. any peer that could
+# reach the port could run arbitrary code in the server process
+# (unpickling attacker-controlled socket bytes is code execution).
+# This codec replaces it: a tagged tree of plain data
+# (None/bool/int/float/str/bytes/dict/tuple) plus ndarrays as a
+# struct header (dtype, shape) + raw buffer bytes. Decoding can only
+# ever build data, never import or call anything.
+#
+#   N none | T true | F false | i int64 | f float64
+#   s utf-8 str | b bytes        (u32 length prefix)
+#   a ndarray: u8 dtype-str-len + dtype.str + u8 ndim + u64*ndim + raw
+#   l tuple:  u32 count + items
+#   d dict:   u32 count + key/value item pairs
+_WIRE_MAX_DEPTH = 16
+_WIRE_MAX_FRAME = 1 << 33          # 8 GiB: no 'length bomb' allocations
+
+
+def _enc(obj, out, depth=0):
+    if depth > _WIRE_MAX_DEPTH:
+        raise ValueError("wire object nests too deep")
+    if obj is None:
+        out.append(b"N")
+    elif obj is True:
+        out.append(b"T")
+    elif obj is False:
+        out.append(b"F")
+    elif isinstance(obj, (int, np.integer)):
+        out.append(b"i" + struct.pack("<q", int(obj)))
+    elif isinstance(obj, (float, np.floating)):
+        out.append(b"f" + struct.pack("<d", float(obj)))
+    elif isinstance(obj, str):
+        raw = obj.encode("utf-8")
+        out.append(b"s" + struct.pack("<I", len(raw)) + raw)
+    elif isinstance(obj, (bytes, bytearray)):
+        out.append(b"b" + struct.pack("<I", len(obj)) + bytes(obj))
+    elif isinstance(obj, np.ndarray):
+        if obj.dtype.hasobject:
+            raise ValueError("object arrays are not wire-encodable")
+        dt = obj.dtype.str.encode("ascii")
+        out.append(b"a" + struct.pack("<B", len(dt)) + dt
+                   + struct.pack("<B", obj.ndim)
+                   + struct.pack(f"<{obj.ndim}Q", *obj.shape))
+        out.append(np.ascontiguousarray(obj).tobytes())
+    elif isinstance(obj, (list, tuple)):
+        out.append(b"l" + struct.pack("<I", len(obj)))
+        for item in obj:
+            _enc(item, out, depth + 1)
+    elif isinstance(obj, dict):
+        out.append(b"d" + struct.pack("<I", len(obj)))
+        for k, v in obj.items():
+            _enc(k, out, depth + 1)
+            _enc(v, out, depth + 1)
+    else:
+        raise ValueError(
+            f"type {type(obj).__name__} is not wire-encodable (only "
+            "plain data rides the dist_async wire)")
+    return out
+
+
+def _dec(buf, pos, depth=0):
+    if depth > _WIRE_MAX_DEPTH:
+        raise ValueError("wire object nests too deep")
+    tag = buf[pos:pos + 1]
+    pos += 1
+    if tag == b"N":
+        return None, pos
+    if tag == b"T":
+        return True, pos
+    if tag == b"F":
+        return False, pos
+    if tag == b"i":
+        return struct.unpack_from("<q", buf, pos)[0], pos + 8
+    if tag == b"f":
+        return struct.unpack_from("<d", buf, pos)[0], pos + 8
+    if tag in (b"s", b"b"):
+        (n,) = struct.unpack_from("<I", buf, pos)
+        pos += 4
+        raw = bytes(buf[pos:pos + n])
+        if len(raw) != n:
+            raise ValueError("truncated wire frame")
+        return (raw.decode("utf-8") if tag == b"s" else raw), pos + n
+    if tag == b"a":
+        (dl,) = struct.unpack_from("<B", buf, pos)
+        pos += 1
+        dt = np.dtype(bytes(buf[pos:pos + dl]).decode("ascii"))
+        pos += dl
+        if dt.hasobject:
+            raise ValueError("object arrays are not wire-decodable")
+        (ndim,) = struct.unpack_from("<B", buf, pos)
+        pos += 1
+        shape = struct.unpack_from(f"<{ndim}Q", buf, pos)
+        pos += 8 * ndim
+        count = int(np.prod(shape, dtype=np.int64)) if ndim else 1
+        nbytes = count * dt.itemsize
+        if nbytes > _WIRE_MAX_FRAME or pos + nbytes > len(buf):
+            raise ValueError("truncated/oversized ndarray frame")
+        arr = np.frombuffer(buf, dt, count=count, offset=pos).reshape(shape)
+        return arr.copy(), pos + nbytes   # copy: own the memory
+    if tag == b"l":
+        (n,) = struct.unpack_from("<I", buf, pos)
+        pos += 4
+        items = []
+        for _ in range(n):
+            item, pos = _dec(buf, pos, depth + 1)
+            items.append(item)
+        return tuple(items), pos
+    if tag == b"d":
+        (n,) = struct.unpack_from("<I", buf, pos)
+        pos += 4
+        out = {}
+        for _ in range(n):
+            k, pos = _dec(buf, pos, depth + 1)
+            v, pos = _dec(buf, pos, depth + 1)
+            out[k] = v
+        return out, pos
+    raise ValueError(f"unknown wire tag {bytes(tag)!r} — refusing frame")
+
+
+def _wire_encode(obj) -> bytes:
+    return b"".join(_enc(obj, []))
+
+
+def _wire_decode(data) -> object:
+    try:
+        obj, pos = _dec(memoryview(data), 0)
+    except ValueError:
+        raise
+    except (struct.error, TypeError, UnicodeDecodeError, IndexError,
+            OverflowError, MemoryError) as e:
+        # every malformed-frame failure surfaces as ValueError so the
+        # server's bad-frame handling has ONE refusal path
+        raise ValueError(f"malformed wire frame: {e!r}") from e
+    if pos != len(data):
+        raise ValueError("trailing bytes in wire frame")
+    return obj
+
+
 def _send_msg(sock, obj):
-    import pickle
-    import struct
-    data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    data = _wire_encode(obj)
     sock.sendall(struct.pack("<Q", len(data)) + data)
 
 
 def _recv_msg(sock):
-    import pickle
-    import struct
     hdr = b""
     while len(hdr) < 8:
         chunk = sock.recv(8 - len(hdr))
@@ -485,13 +649,69 @@ def _recv_msg(sock):
             return None
         hdr += chunk
     (n,) = struct.unpack("<Q", hdr)
+    if n > _WIRE_MAX_FRAME:
+        raise MXNetError(f"wire frame of {n} bytes exceeds the cap")
     buf = bytearray()
     while len(buf) < n:
         chunk = sock.recv(min(1 << 20, n - len(buf)))
         if not chunk:
             return None
         buf += chunk
-    return pickle.loads(bytes(buf))
+    return _wire_decode(bytes(buf))
+
+
+def _optimizer_wire_spec(optimizer):
+    """(registry name, scalar attr table, scheduler spec) — what
+    set_optimizer sends instead of a pickled object. The server
+    rebuilds via ``optimizer.create(name)`` and overwrites every
+    scalar (and dict-of-scalar: lr_mult/wd_mult/idx2name) attribute,
+    so tuned hyperparameters survive the wire. The lr_scheduler rides
+    the same way — (class name in mxnet_tpu.lr_scheduler, scalar/list
+    attr table) — because server-side updates must follow the SCHEDULED
+    lr as the server's num_update advances (the pickled path did; a
+    spec that dropped it would silently train at the base lr forever).
+    Device-backed state (param_dict) and anything else callable does
+    not ride — same trade the reference made sending the optimizer
+    STRING to ps-lite servers."""
+    def scalar(v):
+        return v is None or isinstance(v, (bool, int, float, str))
+
+    def listy(v):
+        return (isinstance(v, (list, tuple))
+                and all(scalar(x) for x in v))
+
+    attrs = {}
+    for k, v in vars(optimizer).items():
+        if k in ("param_dict", "lr_scheduler", "sym"):
+            continue
+        if scalar(v):
+            attrs[k] = v
+        elif isinstance(v, dict) and all(
+                scalar(kk) and scalar(vv) for kk, vv in v.items()):
+            attrs[k] = v
+    sched = getattr(optimizer, "lr_scheduler", None)
+    sched_spec = None
+    if sched is not None:
+        sattrs = {k: (list(v) if listy(v) and not scalar(v) else v)
+                  for k, v in vars(sched).items()
+                  if scalar(v) or listy(v)}
+        sched_spec = (type(sched).__name__, sattrs)
+    return (type(optimizer).__name__.lower(), attrs, sched_spec)
+
+
+def _rebuild_wire_scheduler(sched_spec):
+    """Server side: rebuild the lr scheduler from its typed spec.
+    Only classes defined in mxnet_tpu.lr_scheduler are eligible —
+    the name is a lookup in ONE trusted module, never an import."""
+    from . import lr_scheduler as _lrs
+    cls_name, sattrs = sched_spec
+    cls = getattr(_lrs, cls_name, None)
+    if not (isinstance(cls, type) and issubclass(cls, _lrs.LRScheduler)):
+        raise MXNetError(f"unknown lr scheduler {cls_name!r} on the wire")
+    sched = cls.__new__(cls)    # attr bag; __call__ reads attrs only
+    for k, v in sattrs.items():
+        setattr(sched, k, list(v) if isinstance(v, tuple) else v)
+    return sched
 
 
 class AsyncDistKVStore(KVStore):
@@ -519,7 +739,10 @@ class AsyncDistKVStore(KVStore):
         port = int(os.environ.get("DMLC_PS_ROOT_PORT", "9000")) + 1717
         self._server = None
         if self._rank == 0 and self._n > 1:
-            self._server = _ParameterServer("0.0.0.0", port, self._n)
+            # bind the launcher-announced interface (the address every
+            # worker dials), NOT 0.0.0.0 — the parameter store should
+            # not listen on interfaces the job never asked for
+            self._server = _ParameterServer(host, port, self._n)
         import threading
         self._rpc_lock = threading.Lock()
         self._sent_optattrs = {}
@@ -639,19 +862,10 @@ class AsyncDistKVStore(KVStore):
     def set_optimizer(self, optimizer):
         if self._n <= 1:
             return super().set_optimizer(optimizer)
-        import pickle
-        # param_dict holds device-backed Parameter objects — strip it
-        # for the wire (the reference sends the optimizer string to
-        # servers the same way; per-param lr/wd multipliers don't ride)
-        saved = getattr(optimizer, "param_dict", None)
-        try:
-            if saved is not None:
-                optimizer.param_dict = {}
-            payload = pickle.dumps(optimizer)
-        finally:
-            if saved is not None:
-                optimizer.param_dict = saved
-        self._rpc("setopt", None, payload)
+        # typed (name, scalar-attr-table) spec — nothing executable
+        # crosses the wire; device-backed param_dict never rides (the
+        # reference sends the optimizer string to servers the same way)
+        self._rpc("setopt", None, _optimizer_wire_spec(optimizer))
         self._optimizer = optimizer  # tracked for per-step attr sync
         self._sent_optattrs = {}     # new server copy: resend attrs
 

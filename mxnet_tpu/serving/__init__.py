@@ -1,0 +1,41 @@
+"""Packed continuous-batching inference serving (`mxnet_tpu.serving`).
+
+An in-process model server for encoder-style models: a bounded
+request queue with admission control, a continuous batcher that
+first-fit-packs variable-length requests into a small closed set of
+fixed packed-row shapes (io/packing.py + the flash kernel's
+``segment_ids`` path — no request pays padding it didn't bring), one
+worker thread running the hybridized forward, and an observability
+surface (latency percentiles, queue depth, packing efficiency).
+
+Reference lineage: MXNet Model Server's queue → batcher → backend
+worker, rebuilt around iteration-level (Orca-style) scheduling and
+shape-bucketed compiled executors (the BucketingModule heritage).
+
+Quickstart::
+
+    from mxnet_tpu.gluon.model_zoo import bert_base
+    from mxnet_tpu.gluon.model_zoo.bert import bert_serving_entry
+    from mxnet_tpu.serving import ServingEngine
+
+    net = bert_base()
+    net.initialize(...)
+    engine = ServingEngine(bert_serving_entry(net), pool="mean",
+                           bucket_lens=(64, 256, 512), max_rows=8)
+    with engine:                       # start; stop(drain=True) on exit
+        fut = engine.submit(token_ids, deadline_ms=200)
+        embedding = fut.result()
+        print(engine.snapshot()["latency"]["total"])
+"""
+from .queue import (ServingError, QueueFullError, DeadlineExceededError,
+                    RequestTooLongError, EngineStoppedError,
+                    InferenceFuture, Request, RequestQueue)
+from .batcher import ContinuousBatcher, PackedPlan
+from .metrics import LatencySummary, ServingStats
+from .engine import ServingEngine
+
+__all__ = ["ServingEngine", "ContinuousBatcher", "PackedPlan",
+           "RequestQueue", "Request", "InferenceFuture", "LatencySummary",
+           "ServingStats", "ServingError", "QueueFullError",
+           "DeadlineExceededError", "RequestTooLongError",
+           "EngineStoppedError"]

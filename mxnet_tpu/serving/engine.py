@@ -1,0 +1,328 @@
+"""In-process continuous-batching model server for encoder models.
+
+``ServingEngine`` owns three pieces: a bounded :class:`RequestQueue`
+(admission control), a :class:`ContinuousBatcher` (first-fit packing
+into a closed set of shapes), and one worker thread running the
+model's hybridized/CachedOp forward per packed batch — the in-process
+analog of MXNet Model Server's queue → batcher → backend-worker
+pipeline, with iteration-level (Orca-style) scheduling: every batch is
+re-formed from whatever is queued the moment the previous batch
+finishes, so a long request never convoys short ones behind it.
+
+The model contract is one callable::
+
+    model(ids, token_types, valid_length, segment_ids, positions)
+      -> (B, S, U) NDArray            # or a tuple whose [0] is that
+
+with every input an int32 NDArray in the io/packing.py layout
+(``gluon.model_zoo.bert.bert_serving_entry`` adapts a BERTModel).
+Because inputs arrive in a small closed shape set, the CachedOp
+compile cache holds one executable per (rows, row_len) bucket and
+steady-state serving never re-traces.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from .. import autograd, profiler
+from .. import ndarray as nd
+from ..context import current_context
+from .batcher import ContinuousBatcher
+from .metrics import ServingStats
+from .queue import (DeadlineExceededError, EngineStoppedError, Request,
+                    RequestQueue, RequestTooLongError, ServingError)
+
+__all__ = ["ServingEngine"]
+
+
+def _slice_tokens(seq_slice, request):
+    """Default postprocess: the request's per-token outputs."""
+    return seq_slice
+
+
+def _mean_pool(seq_slice, request):
+    return seq_slice.mean(axis=0)
+
+
+def _cls_pool(seq_slice, request):
+    return seq_slice[0]
+
+
+_POOLERS = {"tokens": _slice_tokens, "mean": _mean_pool, "cls": _cls_pool}
+
+
+class ServingEngine:
+    """Continuous-batching server around one encoder forward.
+
+    Parameters
+    ----------
+    model : callable
+        The packed forward (see module docstring).
+    bucket_lens : row-length buckets (ascending); a request longer
+        than the last one is rejected at submit.
+    max_rows : packed rows per dispatched batch (row counts are
+        quantized to powers of two up to this).
+    max_queue_depth : admission bound; a full queue sheds with
+        :class:`QueueFullError`.
+    default_deadline_ms : deadline applied to requests that don't
+        bring their own (None = no deadline).
+    batch_wait_ms : linger after the first drained request to let a
+        batch fill (0 = pure continuous batching; the queue already
+        self-clocks under load because requests pile up while the
+        previous batch computes).
+    pool : per-request output view — "tokens" (len, U), "mean" (U,),
+        "cls" (U,), or a callable ``(seq_slice, request) -> result``.
+    """
+
+    def __init__(self, model, ctx=None, bucket_lens=(64, 256, 1024),
+                 max_rows=8, max_queue_depth=256, default_deadline_ms=None,
+                 batch_wait_ms=0.0, max_batch_requests=None, pool="tokens",
+                 pad_value=0, stats_window=4096):
+        self._model = model
+        self._ctx = ctx if ctx is not None else current_context()
+        self._batcher = ContinuousBatcher(bucket_lens=bucket_lens,
+                                          max_rows=max_rows,
+                                          pad_value=pad_value)
+        self._queue = RequestQueue(max_queue_depth)
+        self._default_deadline_ms = default_deadline_ms
+        self._batch_wait_s = batch_wait_ms / 1e3
+        # a packed batch holds at most rows*row_len/1 requests; the
+        # drain cap just bounds per-iteration work
+        self._max_batch_requests = (max_batch_requests
+                                    or max_rows * self._batcher.max_len)
+        self._pool = _POOLERS[pool] if isinstance(pool, str) else pool
+        self.stats = ServingStats(stats_window)
+        self.stats.set_queue_depth_fn(lambda: len(self._queue))
+        self._seen_shapes = set()
+        self._worker = None
+        self._abort = False
+        self._started = False
+        self._lock = threading.Lock()
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        with self._lock:
+            if self._started:
+                return self
+            if self._queue.closed:
+                raise EngineStoppedError("engine cannot be restarted")
+            self._started = True
+            self._worker = threading.Thread(target=self._run,
+                                            name="mxnet_tpu_serving",
+                                            daemon=True)
+            self._worker.start()
+        return self
+
+    def stop(self, drain=True, timeout=None):
+        """Shut down. ``drain=True`` finishes every queued/in-flight
+        request first; ``drain=False`` fails them with
+        :class:`EngineStoppedError` (counted ``cancelled``)."""
+        with self._lock:
+            self._queue.close()
+            if not drain:
+                self._abort = True
+            worker = self._worker
+        if worker is not None:
+            worker.join(timeout)
+            if worker.is_alive():
+                raise ServingError("serving worker did not stop in time")
+        # requests still queued after the worker exited (stop before
+        # start, or abort path raced new submits) fail loudly
+        for r in self._queue.drain_all():
+            self.stats.bump("cancelled")
+            r.future.set_exception(
+                EngineStoppedError("engine stopped before request ran"))
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop(drain=True)
+        return False
+
+    @property
+    def running(self):
+        with self._lock:
+            return (self._started and self._worker is not None
+                    and self._worker.is_alive())
+
+    # -- client surface ----------------------------------------------------
+    def submit(self, tokens, token_types=None, deadline_ms=None):
+        """Enqueue one request; returns an :class:`InferenceFuture`.
+        Raises the admission errors directly (queue full, too long,
+        stopped) so callers can tell shedding from failure."""
+        self.stats.bump("submitted")
+        if not self._started or self._queue.closed:
+            self.stats.bump("rejected_stopped")
+            raise EngineStoppedError("serving engine is not running")
+        if deadline_ms is None:
+            deadline_ms = self._default_deadline_ms
+        req = Request(tokens, token_types, deadline_ms)
+        if len(req) > self._batcher.max_len:
+            self.stats.bump("rejected_too_long")
+            raise RequestTooLongError(
+                f"request of {len(req)} tokens exceeds the largest row "
+                f"bucket ({self._batcher.max_len})")
+        try:
+            self._queue.put(req)
+        except ServingError as e:
+            self.stats.bump("rejected_queue_full"
+                            if not self._queue.closed else "rejected_stopped")
+            raise e
+        return req.future
+
+    def infer(self, tokens, token_types=None, deadline_ms=None,
+              timeout=None):
+        """Synchronous convenience: submit + wait."""
+        return self.submit(tokens, token_types, deadline_ms).result(timeout)
+
+    def warmup(self, shapes=None):
+        """Compile ahead of traffic: run one dummy forward per
+        (rows, row_len) shape the batcher can emit (or the given
+        subset). Serving latency then never pays a trace+compile.
+
+        Call BEFORE submitting traffic (right after ``start``): the
+        dummy forwards run on the caller's thread, and tracing the
+        same block from two threads at once (warmup racing a live
+        batch) is not supported by the CachedOp build path."""
+        for rows, row_len in (shapes or self._batcher.shape_universe()):
+            self._forward_shape(rows, row_len)
+        return self
+
+    def reset_stats(self):
+        """Swap in a fresh ServingStats (compile cache untouched):
+        separates a warmup/throwaway traffic window from the measured
+        one — lifetime-cumulative stats would otherwise fold both."""
+        window = self.stats.queue_ms._window.maxlen
+        self.stats = ServingStats(window)
+        self.stats.set_queue_depth_fn(lambda: len(self._queue))
+        return self
+
+    def snapshot(self):
+        """Stats dict: counters, queue depth, latency percentiles,
+        packing efficiency (see metrics.ServingStats)."""
+        out = self.stats.snapshot()
+        out["running"] = self.running
+        out["bucket_lens"] = list(self._batcher.bucket_lens)
+        out["max_rows"] = self._batcher.max_rows
+        return out
+
+    # -- worker ------------------------------------------------------------
+    def _run(self):
+        carry = []
+        while True:
+            if self._abort:
+                self._fail(carry, EngineStoppedError(
+                    "engine stopped before request ran"), "cancelled")
+                carry = []
+                return
+            drained = self._queue.poll(
+                self._max_batch_requests - len(carry),
+                timeout=0.0 if carry else 0.05)
+            if drained and self._batch_wait_s > 0 \
+                    and len(carry) + len(drained) < self._max_batch_requests:
+                time.sleep(self._batch_wait_s)   # linger for the batch
+                drained += self._queue.poll(
+                    self._max_batch_requests - len(carry) - len(drained))
+            reqs = carry + drained
+            carry = []
+            if not reqs:
+                if self._queue.closed and not len(self._queue):
+                    return                       # clean drain complete
+                continue
+            now = time.monotonic()
+            live = []
+            for r in reqs:
+                if r.expired(now):
+                    self.stats.bump("expired")
+                    r.future.set_exception(DeadlineExceededError(
+                        f"request {r.id} deadline exceeded before "
+                        "dispatch"))
+                else:
+                    live.append(r)
+            if not live:
+                continue
+            try:
+                t0 = time.perf_counter()
+                with profiler.Scope("serving/pack"):
+                    plan, carry = self._batcher.plan(live)
+                self.stats.pack_ms.observe((time.perf_counter() - t0) * 1e3)
+            except Exception as e:  # packing failure: fail this drain
+                self._fail(live, e, "failed")
+                carry = []
+                continue
+            try:
+                self._dispatch(plan)
+            except Exception as e:  # model failure: fail ONLY the
+                # dispatched batch's unfulfilled requests and keep
+                # serving — carry was never in this batch and gets its
+                # try next iteration (one poison batch must not take
+                # the engine or innocent leftovers down)
+                self._fail([r for r, _ in plan.entries
+                            if not r.future.done()], e, "failed")
+
+    def _fail(self, requests, exc, counter):
+        for r in requests:
+            self.stats.bump(counter)
+            r.future.set_exception(exc)
+
+    def _dispatch(self, plan):
+        shape = (plan.rows, plan.row_len)
+        t0 = time.perf_counter()
+        seq = self._forward(plan)
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        if shape in self._seen_shapes:
+            self.stats.compute_ms.observe(dt_ms)
+        else:
+            # first visit pays trace+compile; report it as compile
+            # latency, not as a (wildly misleading) compute sample
+            self._seen_shapes.add(shape)
+            self.stats.bump("compiles")
+            self.stats.compile_ms.observe(dt_ms)
+        self.stats.observe_batch(plan.rows, plan.row_len,
+                                 plan.valid_tokens, len(plan.entries),
+                                 plan.row_len)
+        now = time.monotonic()
+        for req, pl in plan.entries:
+            try:
+                out = self._pool(
+                    seq[pl.row, pl.offset:pl.offset + pl.length], req)
+            except Exception as e:  # a bad pool callable fails ITS
+                # request, not the rest of the batch
+                self.stats.bump("failed")
+                req.future.set_exception(e)
+                continue
+            req.t_done = now
+            self.stats.queue_ms.observe((req.t_drain - req.t_submit) * 1e3)
+            self.stats.total_ms.observe((now - req.t_submit) * 1e3)
+            self.stats.bump("completed")
+            req.future.set_result(out)
+
+    def _forward(self, plan):
+        ids = nd.array(plan.data, dtype="int32", ctx=self._ctx)
+        tt = nd.array(plan.token_types, dtype="int32", ctx=self._ctx)
+        vl = nd.array(plan.valid_length, dtype="int32", ctx=self._ctx)
+        seg = nd.array(plan.segment_ids, dtype="int32", ctx=self._ctx)
+        pos = nd.array(plan.positions, dtype="int32", ctx=self._ctx)
+        with autograd.predict_mode():
+            with profiler.Scope("serving/forward"):
+                out = self._model(ids, tt, vl, seg, pos)
+        if isinstance(out, (list, tuple)):
+            out = out[0]
+        return out.asnumpy()   # host sync: per-request slicing follows
+
+    def _forward_shape(self, rows, row_len):
+        """One dummy forward at (rows, row_len) — warmup helper."""
+        from .batcher import PackedPlan
+
+        data = np.zeros((rows, row_len), np.int32)
+        seg = np.zeros((rows, row_len), np.int32)
+        seg[:, 0] = 1
+        plan = PackedPlan(data, np.zeros_like(data), seg,
+                          np.zeros_like(data), np.ones(rows, np.int32),
+                          entries=[], pad_rows=rows)
+        self._seen_shapes.add((rows, row_len))
+        self._forward(plan)

@@ -1,0 +1,162 @@
+"""Serving observability: latency summaries, counters, packing stats.
+
+The reference lineage (MXNet Model Server) exported per-request
+latency/queue metrics over its management API; here the same surface
+is an in-process stats dict (``ServingStats.snapshot``) plus
+``profiler.py`` scopes around the hot stages, so an xprof/Chrome trace
+of a serving run shows queue/pack/compute spans next to the device
+timeline.
+
+Everything is thread-safe: client threads observe submit/reject
+counters while the single worker thread observes batch/compute stats.
+"""
+from __future__ import annotations
+
+import threading
+from collections import deque
+
+__all__ = ["LatencySummary", "ServingStats", "nearest_rank"]
+
+
+def nearest_rank(sorted_xs, p):
+    """Nearest-rank percentile of an ascending-sorted sample (None on
+    empty) — THE percentile convention for every serving metric
+    (engine-side summaries and the loadgen's client-observed numbers
+    share it so the two can be compared directly)."""
+    if not sorted_xs:
+        return None
+    rank = max(0, min(len(sorted_xs) - 1,
+                      int(round(p / 100.0 * len(sorted_xs))) - 1))
+    return sorted_xs[rank]
+
+
+class LatencySummary:
+    """Bounded-window latency aggregator (milliseconds).
+
+    Keeps a ring of the most recent ``capacity`` observations for
+    percentiles (a serving process runs forever; unbounded sample
+    lists would not) plus running count/sum/max over the full
+    lifetime. p50/p95/p99 therefore describe the recent window, count
+    and mean the whole run — the usual server-metrics convention.
+    """
+
+    def __init__(self, capacity=4096):
+        self._window = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self._count = 0
+        self._total = 0.0
+        self._max = 0.0
+
+    def observe(self, ms):
+        with self._lock:
+            self._window.append(float(ms))
+            self._count += 1
+            self._total += ms
+            if ms > self._max:
+                self._max = ms
+
+    @property
+    def count(self):
+        return self._count
+
+    def percentile(self, p):
+        """Nearest-rank percentile over the recent window (None when
+        nothing was observed)."""
+        with self._lock:
+            xs = sorted(self._window)
+        return nearest_rank(xs, p)
+
+    def snapshot(self):
+        with self._lock:
+            xs = sorted(self._window)
+            count, total, mx = self._count, self._total, self._max
+        if not xs:
+            return {"count": 0}
+        return {"count": count,
+                "mean_ms": round(total / count, 3),
+                "p50_ms": round(nearest_rank(xs, 50), 3),
+                "p95_ms": round(nearest_rank(xs, 95), 3),
+                "p99_ms": round(nearest_rank(xs, 99), 3),
+                "max_ms": round(mx, 3)}
+
+
+class ServingStats:
+    """Counter/gauge/latency bundle for one :class:`ServingEngine`.
+
+    Counters follow the admission-control outcomes one-to-one so a
+    dashboard can account for every submitted request:
+    ``submitted == completed + failed + rejected_* + expired +
+    cancelled + in flight``.
+    """
+
+    COUNTERS = ("submitted", "completed", "failed", "rejected_queue_full",
+                "rejected_too_long", "rejected_stopped", "expired",
+                "cancelled", "batches", "compiles")
+
+    def __init__(self, window=4096):
+        self._lock = threading.Lock()
+        self._c = {name: 0 for name in self.COUNTERS}
+        # dispatched slot accounting for the aggregate packing number
+        self._slots = 0
+        self._valid_tokens = 0
+        self.queue_ms = LatencySummary(window)
+        self.pack_ms = LatencySummary(window)
+        self.compute_ms = LatencySummary(window)
+        self.compile_ms = LatencySummary(window)
+        self.total_ms = LatencySummary(window)
+        self.batch_requests = LatencySummary(window)   # requests/batch
+        self._queue_depth_fn = None
+        self._last_batch = None
+
+    def bump(self, name, n=1):
+        with self._lock:
+            self._c[name] += n
+
+    def count(self, name):
+        with self._lock:
+            return self._c[name]
+
+    def set_queue_depth_fn(self, fn):
+        self._queue_depth_fn = fn
+
+    def observe_batch(self, rows, row_len, valid_tokens, n_requests,
+                      bucket_len):
+        with self._lock:
+            self._c["batches"] += 1
+            self._slots += rows * row_len
+            self._valid_tokens += valid_tokens
+            self._last_batch = {
+                "rows": rows, "row_len": row_len, "requests": n_requests,
+                "bucket_len": bucket_len,
+                "packing_efficiency":
+                    round(valid_tokens / float(rows * row_len), 4)}
+        self.batch_requests.observe(n_requests)
+
+    def packing_efficiency(self):
+        """Aggregate fraction of dispatched slots holding real tokens
+        (dummy pad rows from row-count quantization included — the
+        honest number the chip actually paid for)."""
+        with self._lock:
+            if not self._slots:
+                return None
+            return self._valid_tokens / float(self._slots)
+
+    def snapshot(self):
+        with self._lock:
+            counters = dict(self._c)
+            slots, valid = self._slots, self._valid_tokens
+            last = dict(self._last_batch) if self._last_batch else None
+        out = {"counters": counters,
+               "queue_depth": (self._queue_depth_fn()
+                               if self._queue_depth_fn else None),
+               "latency": {"queue": self.queue_ms.snapshot(),
+                           "pack": self.pack_ms.snapshot(),
+                           "compute": self.compute_ms.snapshot(),
+                           "compile": self.compile_ms.snapshot(),
+                           "total": self.total_ms.snapshot()},
+               "dispatched_slots": slots,
+               "valid_tokens": valid,
+               "packing_efficiency":
+                   round(valid / float(slots), 4) if slots else None,
+               "last_batch": last}
+        return out

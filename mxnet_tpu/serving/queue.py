@@ -1,0 +1,204 @@
+"""Request queue with admission control for the serving engine.
+
+Admission is where a server earns the right to stay up under heavy
+traffic: the queue is BOUNDED (a full queue raises
+:class:`QueueFullError` to the caller — backpressure, never unbounded
+growth), every request can carry a deadline (expired requests are
+rejected with :class:`DeadlineExceededError`, a DISTINCT error, not a
+silent drop), and close() fails fast instead of accepting work that
+will never run. The reference lineage is MXNet Model Server's bounded
+job queue in front of its backend workers.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+from ..base import MXNetError
+
+__all__ = ["ServingError", "QueueFullError", "DeadlineExceededError",
+           "RequestTooLongError", "EngineStoppedError", "InferenceFuture",
+           "Request", "RequestQueue"]
+
+
+class ServingError(MXNetError):
+    """Base class for serving-layer failures."""
+
+
+class QueueFullError(ServingError):
+    """Admission refused: the request queue is at max depth
+    (backpressure — retry later or shed upstream)."""
+
+
+class DeadlineExceededError(ServingError):
+    """The request's deadline passed before compute finished."""
+
+
+class RequestTooLongError(ServingError):
+    """The request does not fit the largest configured row bucket."""
+
+
+class EngineStoppedError(ServingError):
+    """The engine is stopped (or stopping) and admits no new work."""
+
+
+class InferenceFuture:
+    """Single-assignment result slot handed back by ``submit``.
+
+    Minimal on purpose (stdlib ``concurrent.futures.Future`` drags in
+    executor/cancel semantics the engine doesn't have): ``result``
+    blocks until the worker fulfils it, re-raising the request's
+    failure (deadline, shutdown, model error) in the CALLER's thread.
+    """
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._value = None
+        self._exc = None
+
+    def done(self):
+        return self._event.is_set()
+
+    def set_result(self, value):
+        # first write wins: a batch-failure sweep arriving after a
+        # request was already fulfilled must not clobber its result
+        if self._event.is_set():
+            return
+        self._value = value
+        self._event.set()
+
+    def set_exception(self, exc):
+        if self._event.is_set():
+            return
+        self._exc = exc
+        self._event.set()
+
+    def exception(self, timeout=None):
+        if not self._event.wait(timeout):
+            raise TimeoutError("inference result not ready")
+        return self._exc
+
+    def result(self, timeout=None):
+        if not self._event.wait(timeout):
+            raise TimeoutError("inference result not ready")
+        if self._exc is not None:
+            raise self._exc
+        return self._value
+
+
+_req_ids = itertools.count()
+
+
+class Request:
+    """One queued inference request and its timing breadcrumbs."""
+
+    __slots__ = ("id", "tokens", "token_types", "deadline", "future",
+                 "t_submit", "t_drain", "t_dispatch", "t_done")
+
+    def __init__(self, tokens, token_types=None, deadline_ms=None):
+        self.id = next(_req_ids)
+        self.tokens = np.asarray(tokens, np.int32).reshape(-1)
+        if self.tokens.size == 0:
+            raise ValueError("empty request")
+        if token_types is not None:
+            token_types = np.asarray(token_types, np.int32).reshape(-1)
+            if token_types.shape != self.tokens.shape:
+                raise ValueError(
+                    f"token_types length {token_types.size} != tokens "
+                    f"length {self.tokens.size}")
+        self.token_types = token_types
+        self.t_submit = time.monotonic()
+        self.deadline = (self.t_submit + deadline_ms / 1e3
+                         if deadline_ms is not None else None)
+        self.future = InferenceFuture()
+        self.t_drain = self.t_dispatch = self.t_done = None
+
+    def __len__(self):
+        return int(self.tokens.size)
+
+    def expired(self, now=None):
+        return (self.deadline is not None
+                and (now if now is not None else time.monotonic())
+                > self.deadline)
+
+
+class RequestQueue:
+    """Thread-safe bounded FIFO the continuous batcher drains.
+
+    ``put`` never blocks and never grows past ``max_depth`` — the
+    caller eats :class:`QueueFullError` (that IS the flow control).
+    ``poll`` is the iteration-level drain: wait up to ``timeout`` for
+    the queue to become non-empty, then take everything available (up
+    to ``max_items``) WITHOUT waiting for stragglers — the Orca-style
+    continuous-batching discipline (batch what is there, never hold a
+    batch open for latecomers).
+    """
+
+    def __init__(self, max_depth=256):
+        if max_depth < 1:
+            raise ValueError("max_depth must be >= 1")
+        self._max_depth = max_depth
+        self._dq = deque()
+        self._lock = threading.Lock()
+        self._not_empty = threading.Condition(self._lock)
+        self._closed = False
+
+    def __len__(self):
+        with self._lock:
+            return len(self._dq)
+
+    @property
+    def max_depth(self):
+        return self._max_depth
+
+    @property
+    def closed(self):
+        with self._lock:
+            return self._closed
+
+    def put(self, request):
+        with self._lock:
+            if self._closed:
+                raise EngineStoppedError(
+                    "serving engine is stopped; request refused")
+            if len(self._dq) >= self._max_depth:
+                raise QueueFullError(
+                    f"request queue full (depth {self._max_depth}); "
+                    "backpressure — retry later")
+            self._dq.append(request)
+            self._not_empty.notify()
+
+    def poll(self, max_items, timeout=0.0):
+        """Drain up to ``max_items`` requests; block up to ``timeout``
+        seconds only while the queue is empty."""
+        deadline = time.monotonic() + timeout
+        with self._not_empty:
+            while not self._dq and not self._closed:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not self._not_empty.wait(remaining):
+                    break
+            out = []
+            while self._dq and len(out) < max_items:
+                out.append(self._dq.popleft())
+            now = time.monotonic()
+            for r in out:
+                r.t_drain = now
+            return out
+
+    def close(self):
+        """Refuse new work; queued requests stay drainable (the engine
+        decides whether to run or fail them)."""
+        with self._lock:
+            self._closed = True
+            self._not_empty.notify_all()
+
+    def drain_all(self):
+        """Take every queued request (shutdown path)."""
+        with self._lock:
+            out = list(self._dq)
+            self._dq.clear()
+            return out

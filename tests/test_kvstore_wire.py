@@ -1,0 +1,196 @@
+"""dist_async wire hardening: the parameter-server channel must never
+evaluate executable encodings from the socket (ADVICE: pickle.loads on
+network bytes = remote code execution in worker 0's process).
+
+The 2-worker end-to-end contract lives in test_dist_kvstore.py; this
+file owns the codec itself and the server's behavior on hostile bytes.
+"""
+import inspect
+import os
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu.kvstore as kvmod
+from mxnet_tpu.kvstore import (_ParameterServer, _optimizer_wire_spec,
+                               _recv_msg, _send_msg, _wire_decode,
+                               _wire_encode)
+
+
+def test_no_pickle_on_the_wire():
+    """The acceptance criterion, asserted directly: nothing in
+    kvstore.py calls pickle at all (the wire codec is typed; optimizer
+    state io lives in the updater, off-socket)."""
+    src = inspect.getsource(kvmod)
+    for needle in ("pickle.loads", "pickle.load(", "pickle.dumps",
+                   "import pickle"):
+        assert needle not in src, f"kvstore.py still uses {needle}"
+
+
+def test_wire_codec_roundtrip():
+    msgs = [
+        None, True, False, 0, -(2 ** 40), 1.5, "héllo", b"\x00\xff",
+        ("init", "w", np.full((4,), 1.0, np.float32)),
+        ("ok", None),
+        ("optattr", None, ("lr", 0.5)),
+        ("setopt", None, ("sgd", {"lr": 0.5, "lr_mult": {}, "n": 3})),
+        {"a": 1, 2: "b", "nested": {"x": None}},
+        np.array(3.5),                       # 0-d
+        np.arange(24).reshape(2, 3, 4).astype(np.int64),
+        np.random.RandomState(0).rand(17, 5).astype(np.float16),
+    ]
+    for m in msgs:
+        got = _wire_decode(_wire_encode(m))
+        _assert_wire_equal(got, m)
+    # non-contiguous arrays encode their logical content
+    arr = np.arange(20).reshape(4, 5)[:, ::2]
+    assert np.array_equal(_wire_decode(_wire_encode(arr)), arr)
+
+
+def _assert_wire_equal(got, want):
+    if isinstance(want, np.ndarray):
+        assert isinstance(got, np.ndarray)
+        assert got.dtype == want.dtype and np.array_equal(got, want)
+    elif isinstance(want, (list, tuple)):
+        assert isinstance(got, tuple) and len(got) == len(want)
+        for g, w in zip(got, want):
+            _assert_wire_equal(g, w)
+    elif isinstance(want, dict):
+        assert set(got) == set(want)
+        for k in want:
+            _assert_wire_equal(got[k], want[k])
+    else:
+        assert got == want and type(got) is type(want)
+
+
+def test_wire_rejects_executable_and_garbage_frames(tmp_path):
+    import pickle
+    sentinel = tmp_path / "pwned"
+
+    class Evil:
+        def __reduce__(self):
+            return (os.system, (f"touch {sentinel}",))
+
+    for bad in (pickle.dumps(Evil()), b"\x80\x04K*.", b"zjunk",
+                b"a\x02<8junk"):
+        with pytest.raises(ValueError):
+            _wire_decode(bad)
+    assert not sentinel.exists(), "decoding executed code!"
+    # trailing bytes after a valid object are refused too (no smuggling)
+    with pytest.raises(ValueError):
+        _wire_decode(_wire_encode("ok") + b"N")
+    # non-data objects refuse to encode rather than falling back
+    with pytest.raises(ValueError):
+        _wire_encode(lambda: None)
+
+
+def test_optimizer_wire_spec_rebuilds_scalars():
+    from mxnet_tpu import optimizer as opt
+
+    sgd = opt.SGD(learning_rate=0.25, momentum=0.9, wd=1e-4,
+                  rescale_grad=1.0 / 64)
+    sgd.lr_mult = {"dense0_weight": 2.0}
+    name, attrs, sched_spec = _wire_decode(
+        _wire_encode(_optimizer_wire_spec(sgd)))
+    assert sched_spec is None           # no scheduler set on this one
+    rebuilt = opt.create(name)
+    for k, v in attrs.items():
+        setattr(rebuilt, k, dict(v) if isinstance(v, dict) else v)
+    assert isinstance(rebuilt, opt.SGD)
+    assert rebuilt.lr == 0.25 and rebuilt.momentum == 0.9
+    assert rebuilt.wd == 1e-4 and rebuilt.rescale_grad == 1.0 / 64
+    assert rebuilt.lr_mult == {"dense0_weight": 2.0}
+    # nothing device-backed or callable rode the wire
+    assert "param_dict" not in attrs and "lr_scheduler" not in attrs
+
+
+def test_optimizer_wire_spec_carries_lr_scheduler():
+    """The scheduled lr must survive the typed wire: server-side
+    updates follow lr_scheduler(num_update), so dropping the scheduler
+    would silently train at the base lr forever."""
+    from mxnet_tpu import lr_scheduler, optimizer as opt
+    from mxnet_tpu.kvstore import _rebuild_wire_scheduler
+
+    sched = lr_scheduler.FactorScheduler(step=10, factor=0.5)
+    sgd = opt.SGD(learning_rate=0.5, lr_scheduler=sched)
+    payload = _wire_decode(_wire_encode(_optimizer_wire_spec(sgd)))
+    name, attrs, sspec = payload
+    assert sspec[0] == "FactorScheduler"
+    rebuilt = _rebuild_wire_scheduler(sspec)
+    ref = lr_scheduler.FactorScheduler(step=10, factor=0.5, base_lr=0.5)
+    for n in (0, 5, 15, 25, 40):            # both stateful; same walk
+        assert rebuilt(n) == ref(n), n
+    # list-valued scheduler attrs (MultiFactorScheduler.step) ride too
+    msched = lr_scheduler.MultiFactorScheduler(step=[5, 15], factor=0.1)
+    adam = opt.Adam(lr_scheduler=msched)
+    _, _, mspec = _wire_decode(_wire_encode(_optimizer_wire_spec(adam)))
+    mre = _rebuild_wire_scheduler(mspec)
+    mref = lr_scheduler.MultiFactorScheduler(step=[5, 15], factor=0.1,
+                                             base_lr=adam.lr)
+    for n in (0, 7, 20):
+        assert mre(n) == mref(n), n
+    # only classes from mxnet_tpu.lr_scheduler rebuild — never imports
+    from mxnet_tpu.base import MXNetError
+    with pytest.raises(MXNetError):
+        _rebuild_wire_scheduler(("os", {}))
+
+
+def test_server_setopt_applies_typed_spec_with_scheduler():
+    from mxnet_tpu import lr_scheduler, optimizer as opt
+
+    srv = _ParameterServer("127.0.0.1", 0, num_workers=1)
+    try:
+        sgd = opt.SGD(learning_rate=0.25, momentum=0.9,
+                      lr_scheduler=lr_scheduler.FactorScheduler(
+                          step=100, factor=0.5))
+        srv._handle("setopt", None, _optimizer_wire_spec(sgd))
+        rebuilt = srv._store._optimizer
+        assert isinstance(rebuilt, opt.SGD)
+        assert rebuilt.momentum == 0.9 and rebuilt.lr == 0.25
+        assert isinstance(rebuilt.lr_scheduler,
+                          lr_scheduler.FactorScheduler)
+        assert rebuilt.lr_scheduler.step == 100
+    finally:
+        srv._srv.close()
+
+
+@pytest.mark.timeout(60)
+def test_server_survives_hostile_frame_and_binds_loopback():
+    """Socket-level: a raw pickle frame must not execute anything and
+    must not take the server down for well-behaved clients."""
+    import pickle
+
+    srv = _ParameterServer("127.0.0.1", 0, num_workers=1)
+    host, port = srv._srv.getsockname()[:2]
+    assert host == "127.0.0.1"  # launcher-announced interface, not 0.0.0.0
+    try:
+        hits = []
+
+        class Evil:
+            def __reduce__(self):
+                return (hits.append, ("executed",))
+
+        evil = pickle.dumps(Evil())
+        s = socket.create_connection(("127.0.0.1", port), timeout=5)
+        s.sendall(struct.pack("<Q", len(evil)) + evil)
+        # server drops the connection instead of replying
+        s.settimeout(5)
+        assert s.recv(1) == b""
+        s.close()
+        assert hits == [], "hostile frame executed code"
+
+        # a fresh well-formed client still gets served
+        s2 = socket.create_connection(("127.0.0.1", port), timeout=5)
+        _send_msg(s2, ("init", "k", np.full((3,), 2.0, np.float32)))
+        status, _ = _recv_msg(s2)
+        assert status == "ok"
+        _send_msg(s2, ("pull", "k", None))
+        status, arr = _recv_msg(s2)
+        assert status == "ok" and np.allclose(arr, 2.0)
+        s2.close()
+    finally:
+        srv._srv.close()

@@ -1,0 +1,472 @@
+"""Serving subsystem (mxnet_tpu/serving): queue admission control,
+continuous packing batcher, engine correctness under concurrency, and
+clean shutdown. Marker-clean — this IS the tier-1 CPU serving smoke.
+
+The acceptance golden (closed-loop, >= 8 concurrent clients, every
+response bit-matched against a solo forward within fp tolerance, zero
+lost responses, distinct errors for deadline/shedding) lives in
+``test_concurrent_clients_parity_and_stats``.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.serving import (ContinuousBatcher, DeadlineExceededError,
+                               EngineStoppedError, LatencySummary,
+                               QueueFullError, Request, RequestQueue,
+                               RequestTooLongError, ServingEngine)
+from mxnet_tpu.serving.queue import InferenceFuture
+
+
+class StubModel:
+    """Contract-shaped stand-in: out[b, s, 0] == ids[b, s], so a
+    correctly-unpacked response equals the request's own tokens —
+    any placement/slicing bug shows up as a value mismatch."""
+
+    def __init__(self, delay=0.0):
+        self.delay = delay
+        self.started = threading.Event()
+        self.shapes = []
+
+    def __call__(self, ids, token_types, valid_length, segment_ids,
+                 positions):
+        self.started.set()
+        if self.delay:
+            time.sleep(self.delay)
+        self.shapes.append(tuple(ids.shape))
+        return nd.array(ids.asnumpy().astype(np.float32)[..., None])
+
+
+def _tiny_bert():
+    from mxnet_tpu.gluon.model_zoo.bert import BERTModel
+
+    mx.random.seed(11)
+    net = BERTModel(vocab_size=64, units=16, hidden_size=32, num_layers=1,
+                    num_heads=2, max_length=16, dropout=0.0,
+                    attention_dropout=0.0, use_pooler=False)
+    net.initialize(init=mx.initializer.Normal(0.02))
+    return net
+
+
+# ---------------------------------------------------------------------------
+# queue / future / metrics units
+# ---------------------------------------------------------------------------
+
+def test_request_queue_admission_and_poll():
+    q = RequestQueue(max_depth=2)
+    r1, r2 = Request([1, 2]), Request([3])
+    q.put(r1)
+    q.put(r2)
+    with pytest.raises(QueueFullError):
+        q.put(Request([4]))
+    # poll drains what's there without waiting for more
+    t0 = time.monotonic()
+    got = q.poll(max_items=8, timeout=5.0)
+    assert [g.id for g in got] == [r1.id, r2.id]
+    assert time.monotonic() - t0 < 1.0
+    assert all(g.t_drain is not None for g in got)
+    # empty queue: poll waits at most timeout
+    assert q.poll(4, timeout=0.05) == []
+    q.close()
+    with pytest.raises(EngineStoppedError):
+        q.put(Request([5]))
+
+
+def test_request_deadline_and_validation():
+    r = Request([1, 2, 3], deadline_ms=1.0)
+    time.sleep(0.01)
+    assert r.expired()
+    assert not Request([1]).expired()
+    with pytest.raises(ValueError):
+        Request([])
+    with pytest.raises(ValueError):
+        Request([1, 2], token_types=[0])
+
+
+def test_future_result_and_exception():
+    f = InferenceFuture()
+    with pytest.raises(TimeoutError):
+        f.result(timeout=0.01)
+    f.set_result(42)
+    assert f.done() and f.result() == 42 and f.exception() is None
+    g = InferenceFuture()
+    g.set_exception(DeadlineExceededError("late"))
+    with pytest.raises(DeadlineExceededError):
+        g.result()
+
+
+def test_latency_summary_percentiles():
+    s = LatencySummary(capacity=100)
+    for v in range(1, 101):
+        s.observe(float(v))
+    snap = s.snapshot()
+    assert snap["count"] == 100
+    assert snap["p50_ms"] == 50.0
+    assert snap["p99_ms"] == 99.0
+    assert snap["max_ms"] == 100.0
+    assert LatencySummary().snapshot() == {"count": 0}
+
+
+# ---------------------------------------------------------------------------
+# batcher units
+# ---------------------------------------------------------------------------
+
+def test_batcher_buckets_quantization_and_leftovers():
+    b = ContinuousBatcher(bucket_lens=(8, 16), max_rows=4)
+    # bucket: longest request picks the row length
+    plan, left = b.plan([Request([1] * 3), Request([2] * 10)])
+    assert plan.row_len == 16 and not left
+    # row count quantizes to powers of two with 1-token dummy rows
+    plan, _ = b.plan([Request([1] * 7), Request([2] * 7), Request([3] * 7)])
+    assert plan.rows == 4 and plan.pad_rows == 1
+    assert plan.valid_length[-1] == 1 and plan.segment_ids[-1, 0] == 1
+    assert plan.valid_tokens == 21
+    # overflow: requests beyond max_rows rows come back as leftovers
+    reqs = [Request([9] * 8) for _ in range(6)]
+    plan, left = b.plan(reqs)
+    assert len(plan.entries) == 4 and len(left) == 2
+    assert [r.id for r in left] == [reqs[4].id, reqs[5].id]
+    # the compile budget is closed and small
+    assert set(plan.data.shape for plan in [plan]) <= set(b.shape_universe())
+    assert len(b.shape_universe()) == 6  # {1,2,4} rows x {8,16} lens
+
+
+def test_batcher_packs_multiple_requests_per_row():
+    b = ContinuousBatcher(bucket_lens=(16,), max_rows=2)
+    reqs = [Request(np.arange(1, n + 1)) for n in (6, 5, 4, 9)]
+    plan, left = b.plan(reqs)
+    assert not left
+    assert plan.rows == 2
+    # every request's tokens are where its placement says
+    for req, pl in plan.entries:
+        got = plan.data[pl.row, pl.offset:pl.offset + pl.length]
+        assert np.array_equal(got, req.tokens)
+        seg = plan.segment_ids[pl.row, pl.offset:pl.offset + pl.length]
+        assert (seg == pl.segment).all()
+        pos = plan.positions[pl.row, pl.offset:pl.offset + pl.length]
+        assert np.array_equal(pos, np.arange(pl.length))
+    assert plan.packing_efficiency == 24 / 32.0
+
+
+# ---------------------------------------------------------------------------
+# engine behavior (stub model: no compiles, pure threading semantics)
+# ---------------------------------------------------------------------------
+
+def test_engine_roundtrip_and_placement_mapping():
+    stub = StubModel()
+    eng = ServingEngine(stub, bucket_lens=(16,), max_rows=2,
+                        max_queue_depth=32)
+    rs = np.random.RandomState(3)
+    with eng:
+        toks = [rs.randint(1, 60, n).astype(np.int32)
+                for n in (3, 7, 12, 5, 9, 4)]
+        outs = [eng.submit(t).result(timeout=30) for t in toks]
+    for t, o in zip(toks, outs):
+        assert o.shape == (len(t), 1)
+        assert np.array_equal(o[:, 0].astype(np.int32), t)
+    snap = eng.snapshot()
+    assert snap["counters"]["completed"] == len(toks)
+    assert snap["counters"]["submitted"] == len(toks)
+    # every dispatched shape came from the batcher's closed universe
+    universe = set(ContinuousBatcher((16,), 2).shape_universe())
+    assert set(stub.shapes) <= universe
+
+
+def test_engine_deadline_expiry_is_distinct_error():
+    stub = StubModel(delay=0.3)
+    eng = ServingEngine(stub, bucket_lens=(16,), max_rows=1,
+                        max_queue_depth=8)
+    with eng:
+        f1 = eng.submit([1, 2, 3])          # occupies the worker
+        assert stub.started.wait(10)
+        f2 = eng.submit([4, 5], deadline_ms=10)  # expires in queue
+        assert f1.result(timeout=30).shape == (3, 1)
+        with pytest.raises(DeadlineExceededError):
+            f2.result(timeout=30)
+    assert eng.stats.count("expired") == 1
+    assert eng.stats.count("completed") == 1
+
+
+def test_engine_queue_full_sheds_with_backpressure():
+    stub = StubModel(delay=0.4)
+    eng = ServingEngine(stub, bucket_lens=(16,), max_rows=1,
+                        max_queue_depth=2)
+    with eng:
+        first = eng.submit([1])             # drained into the worker
+        assert stub.started.wait(10)
+        ok = [eng.submit([2]), eng.submit([3])]   # fill the queue
+        with pytest.raises(QueueFullError):
+            eng.submit([4])
+        assert eng.stats.count("rejected_queue_full") == 1
+        for f in [first] + ok:
+            f.result(timeout=30)            # nothing below the limit lost
+
+
+def test_engine_rejects_oversize_requests():
+    eng = ServingEngine(StubModel(), bucket_lens=(8, 16), max_rows=2)
+    with eng:
+        with pytest.raises(RequestTooLongError):
+            eng.submit(list(range(17)))
+    assert eng.stats.count("rejected_too_long") == 1
+
+
+def test_engine_clean_shutdown_drains_in_flight():
+    stub = StubModel(delay=0.05)
+    eng = ServingEngine(stub, bucket_lens=(16,), max_rows=1,
+                        max_queue_depth=64)
+    eng.start()
+    futs = [eng.submit([i + 1]) for i in range(10)]
+    eng.stop(drain=True, timeout=60)        # returns only when drained
+    for i, f in enumerate(futs):
+        assert f.result(timeout=0.1)[0, 0] == i + 1
+    assert eng.stats.count("completed") == 10
+    assert not eng.running
+    with pytest.raises(EngineStoppedError):
+        eng.submit([1])
+
+
+def test_engine_abort_fails_pending_loudly():
+    stub = StubModel(delay=0.3)
+    eng = ServingEngine(stub, bucket_lens=(16,), max_rows=1,
+                        max_queue_depth=8)
+    eng.start()
+    f1 = eng.submit([1, 2])
+    assert stub.started.wait(10)
+    pending = [eng.submit([3]), eng.submit([4])]
+    eng.stop(drain=False, timeout=60)
+    assert f1.result(timeout=30).shape == (2, 1)  # in-flight finishes
+    for f in pending:
+        with pytest.raises(EngineStoppedError):
+            f.result(timeout=5)
+    assert eng.stats.count("cancelled") == 2
+
+
+def test_engine_survives_model_failure():
+    calls = {"n": 0}
+
+    class Flaky(StubModel):
+        def __call__(self, *args):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("boom")
+            return super().__call__(*args)
+
+    eng = ServingEngine(Flaky(), bucket_lens=(16,), max_rows=1)
+    with eng:
+        bad = eng.submit([1, 2, 3])
+        with pytest.raises(RuntimeError):
+            bad.result(timeout=30)
+        ok = eng.submit([4, 5]).result(timeout=30)
+        assert ok.shape == (2, 1)
+    assert eng.stats.count("failed") == 1
+    assert eng.stats.count("completed") == 1
+
+
+def test_engine_model_failure_spares_carry():
+    """A poison BATCH fails only its own requests: leftovers carried
+    to the next iteration (never dispatched in the failed batch) must
+    still be served."""
+    calls = {"n": 0}
+
+    class Flaky(StubModel):
+        def __call__(self, *args):
+            calls["n"] += 1
+            if calls["n"] == 2:
+                raise RuntimeError("boom")
+            return super().__call__(*args)
+
+    stub = Flaky(delay=0.2)
+    eng = ServingEngine(stub, bucket_lens=(16,), max_rows=1,
+                        max_queue_depth=8)
+    with eng:
+        f1 = eng.submit([1] * 10)
+        assert stub.started.wait(10)
+        f2 = eng.submit([2] * 10)       # 10+10 > 16: r3 becomes carry
+        f3 = eng.submit([3] * 10)
+        assert f1.result(timeout=30).shape == (10, 1)
+        with pytest.raises(RuntimeError):
+            f2.result(timeout=30)
+        assert f3.result(timeout=30)[0, 0] == 3.0
+    assert eng.stats.count("failed") == 1
+    assert eng.stats.count("completed") == 2
+
+
+def test_future_first_write_wins():
+    f = InferenceFuture()
+    f.set_result(7)
+    f.set_exception(RuntimeError("late sweep"))
+    assert f.result() == 7              # the sweep must not clobber it
+
+
+def test_engine_reset_stats_separates_windows():
+    eng = ServingEngine(StubModel(), bucket_lens=(16,), max_rows=1)
+    with eng:
+        eng.infer([1, 2], timeout=30)
+        assert eng.stats.count("completed") == 1
+        eng.reset_stats()
+        assert eng.stats.count("completed") == 0
+        eng.infer([3], timeout=30)
+        assert eng.stats.count("completed") == 1
+        assert eng.snapshot()["queue_depth"] == 0
+
+
+# ---------------------------------------------------------------------------
+# the acceptance golden: real model, 8 concurrent clients, solo parity
+# ---------------------------------------------------------------------------
+
+def test_concurrent_clients_parity_and_stats():
+    from mxnet_tpu.gluon.model_zoo.bert import bert_serving_entry
+
+    net = _tiny_bert()
+    eng = ServingEngine(bert_serving_entry(net), bucket_lens=(16,),
+                        max_rows=4, max_queue_depth=128)
+    rs = np.random.RandomState(7)
+    lens = [3, 5, 8, 11, 13, 15]            # few distinct solo shapes
+    n_clients, per_client = 8, 4
+    results = {}
+    errors = []
+
+    def client(cid):
+        rc = np.random.RandomState(100 + cid)
+        try:
+            for j in range(per_client):
+                toks = rc.randint(1, 60, lens[(cid + j) % len(lens)]) \
+                    .astype(np.int32)
+                out = eng.infer(toks, timeout=300)
+                results[(cid, j)] = (toks, out)
+        except Exception as e:  # surfaced below — a lost response fails
+            errors.append((cid, e))
+
+    with eng:
+        threads = [threading.Thread(target=client, args=(c,))
+                   for c in range(n_clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(600)
+    assert not errors, errors
+    assert len(results) == n_clients * per_client   # zero lost responses
+
+    # per-request parity vs the same tokens run SOLO through the model
+    solo_cache = {}
+    for (cid, j), (toks, out) in sorted(results.items()):
+        key = toks.tobytes()
+        if key not in solo_cache:
+            one = nd.array(toks[None, :], dtype="int32")
+            tt = nd.zeros((1, len(toks)), dtype="int32")
+            with mx.autograd.predict_mode():
+                solo_cache[key] = net(one, tt).asnumpy()[0]
+        np.testing.assert_allclose(out, solo_cache[key], rtol=2e-4,
+                                   atol=2e-4,
+                                   err_msg=f"client {cid} req {j}")
+
+    snap = eng.snapshot()
+    c = snap["counters"]
+    assert c["completed"] == n_clients * per_client
+    assert c["submitted"] == c["completed"]  # nothing shed in this run
+    lat = snap["latency"]["total"]
+    assert lat["count"] == c["completed"]
+    assert 0 < lat["p50_ms"] <= lat["p99_ms"] <= lat["max_ms"]
+    assert snap["packing_efficiency"] is not None
+    assert snap["queue_depth"] == 0
+    assert c["batches"] >= 1 and c["compiles"] >= 1
+
+
+def test_loaded_traffic_packs_densely():
+    """The packing acceptance number: under sustained load (the queue
+    holds work while a batch computes — the continuous-batching steady
+    state) the synthetic variable-length mix packs > 0.8 of dispatched
+    slots with real tokens."""
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    from serve_loadgen import run_load
+
+    stub = StubModel(delay=0.02)   # compute window lets the queue fill
+    eng = ServingEngine(stub, bucket_lens=(64,), max_rows=4,
+                        max_queue_depth=256)
+    with eng:
+        report = run_load(eng, n_clients=12, requests_per_client=8,
+                          min_len=16, max_len=64, vocab=60)
+    assert report["completed"] == 96
+    assert report["errors"] == 0 and report["shed"] == 0
+    snap = report["engine"]
+    assert snap["packing_efficiency"] > 0.8, snap
+    lat = report["p50_ms"], report["p99_ms"]
+    assert 0 < lat[0] <= lat[1]
+    assert snap["latency"]["queue"]["count"] == 96
+
+
+@pytest.mark.slow
+def test_bench_serving_leg_smoke():
+    """bench.py BENCH_MODEL=serving end-to-end at toy size: emits the
+    serving metric line with latency percentiles and packing stats."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ, BENCH_MODEL="serving", BENCH_SEQLEN="32",
+               BENCH_VOCAB="200", BENCH_SERVE_UNITS="32",
+               BENCH_SERVE_LAYERS="1", BENCH_SERVE_HEADS="2",
+               BENCH_SERVE_CLIENTS="8", BENCH_SERVE_REQS="4",
+               BENCH_SERVE_ROWS="4", BENCH_SERVE_BUCKETS="8,32",
+               JAX_PLATFORMS="cpu")
+    bench = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "bench.py")
+    r = subprocess.run([sys.executable, bench], env=env,
+                       capture_output=True, text=True, timeout=560)
+    assert r.returncode == 0, r.stderr[-2000:]
+    rec = json.loads([ln for ln in r.stdout.splitlines()
+                      if ln.startswith('{"metric"')][-1])
+    assert rec["metric"] == "bert_serving_requests_per_sec_per_chip"
+    assert rec["value"] > 0
+    assert rec["requests"] == 32          # zero lost under the limit
+    assert rec["p50_ms"] > 0 and rec["p99_ms"] >= rec["p50_ms"]
+    assert 0 < rec["packing_efficiency"] <= 1.0
+
+
+@pytest.mark.slow
+def test_bench_packed_causal_leg_smoke():
+    """bench.py BENCH_MODEL=causal_lm (the packed CAUSAL ROADMAP
+    follow-up) runs end-to-end at toy size."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ, BENCH_MODEL="causal_lm", BENCH_STEPS="2",
+               BENCH_CHAIN="1", BENCH_WINDOWS="1", BENCH_BATCH="2",
+               BENCH_SEQLEN="32", BENCH_PACK_ROWLEN="64",
+               BENCH_VOCAB="200", BENCH_LM_UNITS="32",
+               BENCH_LM_LAYERS="1", BENCH_LM_HEADS="2",
+               JAX_PLATFORMS="cpu")
+    bench = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "bench.py")
+    r = subprocess.run([sys.executable, bench], env=env,
+                       capture_output=True, text=True, timeout=560)
+    assert r.returncode == 0, r.stderr[-2000:]
+    rec = json.loads([ln for ln in r.stdout.splitlines()
+                      if ln.startswith('{"metric"')][-1])
+    assert rec["metric"] == "causal_lm_train_tokens_per_sec_per_chip"
+    assert rec["causal"] is True and rec["packed"] is True
+    assert rec["packing_efficiency"] >= 0.9
+    assert rec["valid_tokens_per_sec"] > 0
+
+
+def test_engine_pool_modes():
+    stub = StubModel()
+    outs = {}
+    for pool in ("tokens", "mean", "cls"):
+        eng = ServingEngine(stub, bucket_lens=(16,), max_rows=1, pool=pool)
+        with eng:
+            outs[pool] = eng.infer([2, 4, 6], timeout=30)
+    assert outs["tokens"].shape == (3, 1)
+    assert outs["mean"].shape == (1,) and outs["mean"][0] == 4.0
+    assert outs["cls"].shape == (1,) and outs["cls"][0] == 2.0

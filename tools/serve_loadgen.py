@@ -1,0 +1,146 @@
+"""Closed-loop synthetic traffic generator for mxnet_tpu.serving.
+
+Shared by the bench serving leg (bench.py BENCH_MODEL=serving imports
+``run_load``) and usable by hand against any engine::
+
+    python tools/serve_loadgen.py --clients 8 --requests 16
+
+(standalone mode builds a small CPU BERT, serves it, prints the JSON
+report). Closed loop: each client thread submits its next request only
+after the previous response lands — the standard serving-bench shape
+(latency is client-observed, throughput is total completed / wall).
+"""
+from __future__ import annotations
+
+import json
+import time
+
+
+def run_load(engine, n_clients=8, requests_per_client=16,
+             min_len=16, max_len=512, vocab=30522, deadline_ms=None,
+             result_timeout_s=600.0, seed=0):
+    """Drive ``engine`` with n_clients closed-loop threads.
+
+    Returns a stats dict: client-observed latency percentiles,
+    completed/shed/expired counts, requests_per_sec and
+    valid_tokens_per_sec over the loaded wall-clock window, plus the
+    engine's own snapshot (queue depth, packing efficiency,
+    compile/compute split).
+    """
+    import threading
+
+    import numpy as np
+
+    from mxnet_tpu.serving import (DeadlineExceededError, QueueFullError)
+
+    latencies = []          # (client, ms) — list.append is atomic
+    outcomes = {"ok": 0, "expired": 0, "shed": 0, "error": 0}
+    valid_tokens = [0]
+    lock = threading.Lock()
+
+    def client(cid):
+        rs = np.random.RandomState(seed + cid)
+        for _ in range(requests_per_client):
+            n = int(rs.randint(min_len, max_len + 1))
+            toks = rs.randint(1, vocab, n).astype(np.int32)
+            t0 = time.perf_counter()
+            try:
+                engine.infer(toks, deadline_ms=deadline_ms,
+                             timeout=result_timeout_s)
+            except DeadlineExceededError:
+                with lock:
+                    outcomes["expired"] += 1
+                continue
+            except QueueFullError:
+                with lock:
+                    outcomes["shed"] += 1
+                time.sleep(0.005)       # polite backoff, stay closed-loop
+                continue
+            except Exception:
+                with lock:
+                    outcomes["error"] += 1
+                continue
+            ms = (time.perf_counter() - t0) * 1e3
+            with lock:
+                outcomes["ok"] += 1
+                valid_tokens[0] += n
+                latencies.append(ms)
+
+    threads = [threading.Thread(target=client, args=(c,))
+               for c in range(n_clients)]
+    t_start = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t_start
+
+    from mxnet_tpu.serving.metrics import nearest_rank
+
+    xs = sorted(latencies)
+
+    def pct(p):
+        v = nearest_rank(xs, p)
+        return None if v is None else round(v, 3)
+
+    return {"clients": n_clients,
+            "requests_per_client": requests_per_client,
+            "wall_s": round(wall, 3),
+            "completed": outcomes["ok"],
+            "expired": outcomes["expired"],
+            "shed": outcomes["shed"],
+            "errors": outcomes["error"],
+            "requests_per_sec": round(outcomes["ok"] / wall, 2) if wall else 0,
+            "valid_tokens_per_sec":
+                round(valid_tokens[0] / wall, 2) if wall else 0,
+            "p50_ms": pct(50), "p95_ms": pct(95), "p99_ms": pct(99),
+            "engine": engine.snapshot()}
+
+
+def _main():
+    import argparse
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--clients", type=int, default=8)
+    ap.add_argument("--requests", type=int, default=16)
+    ap.add_argument("--min-len", type=int, default=8)
+    ap.add_argument("--max-len", type=int, default=48)
+    ap.add_argument("--buckets", default="16,64",
+                    help="comma-separated row-length buckets")
+    ap.add_argument("--max-rows", type=int, default=4)
+    ap.add_argument("--deadline-ms", type=float, default=None)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--units", type=int, default=64)
+    ap.add_argument("--heads", type=int, default=4)
+    ap.add_argument("--vocab", type=int, default=1000)
+    ap.add_argument("--pool", default="mean")
+    args = ap.parse_args()
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo.bert import BERTModel, bert_serving_entry
+    from mxnet_tpu.serving import ServingEngine
+
+    buckets = tuple(int(b) for b in args.buckets.split(","))
+    net = BERTModel(vocab_size=args.vocab, units=args.units,
+                    hidden_size=4 * args.units, num_layers=args.layers,
+                    num_heads=args.heads, max_length=args.max_len,
+                    dropout=0.0, attention_dropout=0.0, use_pooler=False)
+    net.initialize(init=mx.initializer.Normal(0.02))
+    engine = ServingEngine(bert_serving_entry(net), bucket_lens=buckets,
+                           max_rows=args.max_rows, pool=args.pool)
+    with engine:
+        engine.warmup()
+        report = run_load(engine, n_clients=args.clients,
+                          requests_per_client=args.requests,
+                          min_len=args.min_len, max_len=args.max_len,
+                          vocab=args.vocab, deadline_ms=args.deadline_ms)
+    print(json.dumps(report, indent=2))
+
+
+if __name__ == "__main__":
+    _main()
