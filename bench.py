@@ -144,6 +144,16 @@ def _report(metric, value, unit, vs_baseline, flops_per_step=0.0,
         if "hbm_gbs_xprof" in rec:
             rec["hbm_gbs"] = rec["hbm_gbs_xprof"]
         rec["hbm_est"] = False
+    if "telemetry" not in rec:
+        # every leg's record carries its process's telemetry state
+        # (nonzero counters + histogram counts); the suite summary
+        # forwards it so one bench_suite_summary line shows what each
+        # leg actually exercised
+        try:
+            from mxnet_tpu.telemetry import REGISTRY
+            rec["telemetry"] = REGISTRY.snapshot_compact()
+        except Exception:
+            pass
     print(json.dumps(rec))
     sys.stdout.flush()
 
@@ -939,6 +949,9 @@ def main_serving():
                            max_queue_depth=max(64, 8 * clients),
                            pool="mean")
     with engine:
+        # scrape-side observability rides the measured run: the loadgen
+        # cross-checks /metrics counter deltas against its own books
+        metrics_url = engine.expose().url("/metrics")
         engine.warmup()
         # one throwaway closed-loop pass: page caches, thread spin-up
         run_load(engine, n_clients=min(4, clients), requests_per_client=2,
@@ -949,9 +962,11 @@ def main_serving():
         report = run_load(engine, n_clients=clients,
                           requests_per_client=reqs,
                           min_len=max(4, seqlen // 8), max_len=seqlen,
-                          vocab=vocab)
+                          vocab=vocab, metrics_url=metrics_url)
     snap = report.pop("engine")
     assert report["completed"] == clients * reqs, report
+    server = report.get("server", {})
+    assert server.get("reconciled", True), server
     _report("bert_serving_requests_per_sec_per_chip",
             report["requests_per_sec"], "requests/sec/chip", 0.0,
             seqlen=seqlen, batch=max_rows, clients=clients,
@@ -962,7 +977,9 @@ def main_serving():
             packing_efficiency=snap["packing_efficiency"],
             serve_buckets=list(buckets),
             compute_p50_ms=snap["latency"]["compute"].get("p50_ms"),
-            queue_p50_ms=snap["latency"]["queue"].get("p50_ms"))
+            queue_p50_ms=snap["latency"]["queue"].get("p50_ms"),
+            telemetry_reconciled=server.get("reconciled"),
+            server_p50_ms_est=server.get("latency", {}).get("p50_ms_est"))
 
 
 def main_lstm():
@@ -1185,7 +1202,7 @@ _SUITE = (
 _SUMMARY_KEYS = ("metric", "value", "unit", "mfu", "hbm_frac", "hbm_est",
                  "valid_frac", "valid_tokens_per_sec", "packing_efficiency",
                  "seqlen", "batch", "failed", "causal", "clients",
-                 "p50_ms", "p99_ms")
+                 "p50_ms", "p99_ms", "telemetry_reconciled", "telemetry")
 
 
 def _compact(rec):

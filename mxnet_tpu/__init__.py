@@ -72,6 +72,7 @@ from . import parallel
 from . import test_utils
 from . import runtime
 from . import checkpoint
+from . import telemetry
 from . import serving
 from .util import is_np_array
 

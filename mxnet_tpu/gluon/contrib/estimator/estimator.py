@@ -52,9 +52,13 @@ class Estimator:
 
     def fit(self, train_data, val_data=None, epochs=1, event_handlers=None,
             batches=None):
+        import time
+
+        from ....module.base_module import _fit_telemetry
         autograd = self._autograd
         handlers = event_handlers or []
         handlers.append(LoggingHandler())
+        step_ms, samples_per_sec = _fit_telemetry("gluon_fit")
         for epoch in range(epochs):
             for m in self.train_metrics:
                 m.reset()
@@ -63,11 +67,16 @@ class Estimator:
                 data, label = batch[0], batch[1]
                 data = data.as_in_context(self.context[0])
                 label = label.as_in_context(self.context[0])
+                t0 = time.perf_counter()
                 with autograd.record():
                     pred = self.net(data)
                     loss = self.loss(pred, label)
                 loss.backward()
                 self.trainer.step(data.shape[0])
+                dt = time.perf_counter() - t0
+                step_ms.observe(dt * 1e3)
+                if dt > 0:
+                    samples_per_sec.set(data.shape[0] / dt)
                 for m in self.train_metrics:
                     m.update([label], [pred])
                 nbatch += 1

@@ -38,8 +38,27 @@ from .base import MXNetError
 from .ndarray import NDArray
 from .ndarray.ndarray import _wrap
 from .parallel import comm as _allreduce
+from .telemetry import events as _events
+from .telemetry.registry import REGISTRY as _REGISTRY
+from .telemetry.trace import (current_trace_id as _current_trace_id,
+                              new_trace_id as _new_trace_id)
 
 __all__ = ["KVStore", "create"]
+
+
+def _wire_metrics(side):
+    """Registry families for the dist_async RPC channel, one set per
+    side ('client' = worker RPCs, 'server' = the parameter server).
+    Created lazily on first dist use — a local kvstore never touches
+    them."""
+    lat = _REGISTRY.histogram(
+        f"mxnet_tpu_kvstore_{side}_rpc_ms",
+        f"dist_async {side}-observed RPC latency by op", ("op",))
+    byt = _REGISTRY.counter(
+        f"mxnet_tpu_kvstore_{side}_bytes_total",
+        f"dist_async {side} wire bytes by op and direction",
+        ("op", "direction"))
+    return lat, byt
 
 
 def create(name="local") -> "KVStore":
@@ -234,6 +253,31 @@ class KVStore:
         with open(fname, "rb") as f:
             self._updater.set_states(f.read())
 
+    # -- telemetry ---------------------------------------------------------
+    def expose(self, port=0, host="127.0.0.1"):
+        """Start a telemetry exposition server for this store's
+        process: Prometheus ``/metrics`` off the process registry
+        (dist_async RPC latency/bytes land there on both ends),
+        ``/healthz`` from :meth:`_healthz`, and ``/stats`` with store
+        identity + key count. ``port=0`` picks a free port."""
+        from .telemetry.expo import TelemetryServer
+
+        if getattr(self, "_expo", None) is None:
+            def stats():
+                return {"type": self.type, "rank": self.rank,
+                        "num_workers": self.num_workers,
+                        "keys": len(self._store)}
+
+            self._expo = TelemetryServer(healthz_fn=self._healthz,
+                                         stats_fn=stats,
+                                         port=port, host=host)
+            _events.emit("telemetry_expose", component="kvstore",
+                         port=self._expo.port, host=self._expo.host)
+        return self._expo
+
+    def _healthz(self):
+        return True, {"type": self.type, "rank": self.rank}
+
     # -- internals ---------------------------------------------------------
     def _get(self, k):
         if k not in self._store:
@@ -407,26 +451,51 @@ class _ParameterServer:
                              daemon=True).start()
 
     def _serve(self, conn):
+        import time as _time
+        lat, byt = _wire_metrics("server")
         try:
             while True:
-                msg = _recv_msg(conn)
-                if msg is None:
+                sized = _recv_msg_sized(conn)
+                if sized is None:
                     return
-                op, key, payload = msg
+                msg, nbytes_in = sized
+                if not isinstance(msg, tuple) or len(msg) not in (3, 4):
+                    raise ValueError(
+                        "RPC frame must be (op, key, payload[, trace_id])"
+                        f", got {type(msg).__name__}")
+                op, key, payload = msg[:3]
+                # trace id rides the frame (4th field) so this handle
+                # correlates with the worker-side rpc event on one push
+                tid = msg[3] if len(msg) == 4 else None
+                t0 = _time.perf_counter()
                 try:
-                    _send_msg(conn, ("ok", self._handle(op, key, payload)))
+                    reply = ("ok", self._handle(op, key, payload))
                 except (ConnectionError, EOFError, OSError):
                     raise
                 except Exception as e:  # reply, don't kill the server
                     import traceback
-                    _send_msg(conn, ("err", f"{e!r}\n"
-                                     f"{traceback.format_exc(limit=5)}"))
+                    reply = ("err", f"{e!r}\n"
+                             f"{traceback.format_exc(limit=5)}")
+                nbytes_out = _send_msg(conn, reply)
+                ms = (_time.perf_counter() - t0) * 1e3
+                opname = op if isinstance(op, str) else "?"
+                lat.labels(op=opname).observe(ms)
+                byt.labels(op=opname, direction="in").inc(nbytes_in)
+                byt.labels(op=opname, direction="out").inc(nbytes_out)
+                _events.emit("kvstore_server_handle", op=opname, key=key,
+                             ms=round(ms, 3), bytes_in=nbytes_in,
+                             bytes_out=nbytes_out, ok=reply[0] == "ok",
+                             trace_id=tid)
         except (ConnectionError, EOFError, OSError):
             return
         except (ValueError, MXNetError) as e:
             # malformed/refused wire frame: drop THIS client, keep
             # serving the rest (and leave a trace for the operator)
             import sys
+            _REGISTRY.counter(
+                "mxnet_tpu_kvstore_wire_refusals_total",
+                "dist_async frames refused by the typed codec").inc()
+            _events.emit("wire_frame_refused", error=str(e))
             print(f"mxnet_tpu dist_async server: dropping connection on "
                   f"bad frame: {e}", file=sys.stderr)
             return
@@ -468,6 +537,8 @@ class _ParameterServer:
                             sched_spec)
                     self._opt_payload = payload
                     self._store.set_optimizer(opt)
+                    _events.emit("kvstore_optimizer_update", kind="setopt",
+                                 optimizer=name)
             return None
         if op == "optattr":
             # per-step optimizer attribute sync (rescale_grad changes on
@@ -476,6 +547,8 @@ class _ParameterServer:
             with self._lock:
                 if self._store._optimizer is not None:
                     setattr(self._store._optimizer, name, value)
+            _events.emit("kvstore_optimizer_update", kind="optattr",
+                         attr=name, value=value)
             return None
         if op == "barrier":
             with self._barrier_cv:
@@ -637,11 +710,15 @@ def _wire_decode(data) -> object:
 
 
 def _send_msg(sock, obj):
+    """Encode + length-prefix + send; returns the frame's byte size so
+    callers can account wire traffic without re-encoding."""
     data = _wire_encode(obj)
     sock.sendall(struct.pack("<Q", len(data)) + data)
+    return len(data)
 
 
-def _recv_msg(sock):
+def _recv_msg_sized(sock):
+    """(decoded object, frame bytes) — None on a cleanly closed peer."""
     hdr = b""
     while len(hdr) < 8:
         chunk = sock.recv(8 - len(hdr))
@@ -657,7 +734,12 @@ def _recv_msg(sock):
         if not chunk:
             return None
         buf += chunk
-    return _wire_decode(bytes(buf))
+    return _wire_decode(bytes(buf)), n
+
+
+def _recv_msg(sock):
+    sized = _recv_msg_sized(sock)
+    return sized[0] if sized is not None else None
 
 
 def _optimizer_wire_spec(optimizer):
@@ -745,6 +827,7 @@ class AsyncDistKVStore(KVStore):
             self._server = _ParameterServer(host, port, self._n)
         import threading
         self._rpc_lock = threading.Lock()
+        self._wire_metrics = _wire_metrics("client")
         self._sent_optattrs = {}
         self._sock = None
         if self._n > 1:
@@ -774,14 +857,58 @@ class AsyncDistKVStore(KVStore):
     def num_workers(self):
         return self._n
 
+    def _healthz(self):
+        """dist_async liveness: this worker still holds its server
+        connection; on rank 0, the parameter server socket is open."""
+        detail = {"type": self.type, "rank": self._rank,
+                  "workers": self._n}
+        ok = self._n <= 1 or self._sock is not None
+        if self._server is not None:
+            srv_up = self._server._srv.fileno() != -1
+            detail["server_listening"] = srv_up
+            ok = ok and srv_up
+        return ok, detail
+
     def _rpc(self, op, key, payload=None):
+        import time as _time
+        # the active trace id (a serving request, a Trainer step's
+        # scope) rides the frame; an RPC outside any context mints its
+        # own so worker- and server-side logs still correlate
+        tid = _current_trace_id() or _new_trace_id("kv")
+        t0 = _time.perf_counter()
         with self._rpc_lock:
-            _send_msg(self._sock, (op, key, payload))
-            reply = _recv_msg(self._sock)
-        if reply is None:
+            # read + check the socket INSIDE the lock: a concurrent
+            # RPC that lost the connection nulls it, and a waiter must
+            # see MXNetError, not _send_msg(None) blowing up
+            sock = self._sock
+            if sock is None:
+                raise MXNetError(
+                    "dist_async parameter server connection is down "
+                    f"(lost on an earlier RPC); cannot send {op!r}")
+            try:
+                nbytes_out = _send_msg(sock, (op, key, payload, tid))
+                sized = _recv_msg_sized(sock)
+            except OSError:
+                self._sock = None       # /healthz must see the loss
+                raise
+            if sized is None:
+                # half-closed peer: mark the connection dead so
+                # liveness probes (and later RPCs) report it instead
+                # of a live sock
+                self._sock = None
+        if sized is None:
             raise MXNetError(
                 "dist_async parameter server connection lost (worker 0's "
                 f"process gone?) during {op!r}")
+        reply, nbytes_in = sized
+        ms = (_time.perf_counter() - t0) * 1e3
+        lat, byt = self._wire_metrics
+        lat.labels(op=op).observe(ms)
+        byt.labels(op=op, direction="out").inc(nbytes_out)
+        byt.labels(op=op, direction="in").inc(nbytes_in)
+        _events.emit("kvstore_rpc", op=op, key=key, ms=round(ms, 3),
+                     bytes_out=nbytes_out, bytes_in=nbytes_in,
+                     rank=self._rank, trace_id=tid)
         status, out = reply
         if status != "ok":
             raise MXNetError(f"dist_async server error: {out}")

@@ -8,8 +8,23 @@ import time
 from ..base import MXNetError
 from .. import metric as _metric
 from ..model import BatchEndParam
+from ..telemetry import events as _events
+from ..telemetry.registry import REGISTRY as _REGISTRY
 
 __all__ = ["BaseModule"]
+
+
+def _fit_telemetry(loop):
+    """(step-time histogram child, samples/sec gauge child) for a fit
+    loop — one family shared by module and gluon loops, labeled by
+    which loop fed it."""
+    hist = _REGISTRY.histogram(
+        "mxnet_tpu_train_step_ms",
+        "host wall per train step (fwd+bwd+update dispatch)", ("loop",))
+    gauge = _REGISTRY.gauge(
+        "mxnet_tpu_train_samples_per_sec",
+        "most recent train-loop throughput", ("loop",))
+    return hist.labels(loop=loop), gauge.labels(loop=loop)
 
 
 class BaseModule:
@@ -133,16 +148,30 @@ class BaseModule:
         if not isinstance(eval_metric, _metric.EvalMetric):
             eval_metric = _metric.create(eval_metric)
 
+        step_ms, samples_per_sec = _fit_telemetry("module_fit")
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
             eval_metric.reset()
             nbatch = 0
+            nsample = 0
             train_data.reset()
             for data_batch in train_data:
                 if monitor is not None:
                     monitor.tic()
+                t0 = time.perf_counter()
                 self.forward_backward(data_batch)
                 self.update()
+                # host wall of the dispatch; under async execution the
+                # device backpressure folds in over steady-state steps
+                dt = time.perf_counter() - t0
+                step_ms.observe(dt * 1e3)
+                try:
+                    bsz = data_batch.data[0].shape[0]
+                except (AttributeError, IndexError, TypeError):
+                    bsz = 0
+                if bsz and dt > 0:
+                    samples_per_sec.set(bsz / dt)
+                    nsample += bsz
                 self.update_metric(eval_metric, data_batch.label)
                 if monitor is not None:
                     monitor.toc_print()
@@ -157,6 +186,9 @@ class BaseModule:
             for name, val in eval_metric.get_name_value():
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
             self.logger.info("Epoch[%d] Time cost=%.3f", epoch, time.time() - tic)
+            _events.emit("fit_epoch", loop="module_fit", epoch=epoch,
+                         batches=nbatch, samples=nsample,
+                         seconds=round(time.time() - tic, 3))
 
             arg_p, aux_p = self.get_params()
             self.set_params(arg_p, aux_p, allow_missing=False, force_init=True,

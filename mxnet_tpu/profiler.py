@@ -16,9 +16,12 @@ import time
 
 import jax
 
+from .telemetry.trace import current_trace_id as _current_trace_id
+
 __all__ = ["set_config", "set_state", "state", "dump", "dumps", "pause",
            "resume", "Task", "Frame", "Event", "Counter", "Marker",
-           "profiler_set_config", "profiler_set_state", "Scope"]
+           "profiler_set_config", "profiler_set_state", "Scope",
+           "export_metrics"]
 
 _CONFIG = {
     "filename": "profile.json",
@@ -45,8 +48,17 @@ profiler_set_config = set_config
 
 def set_state(state_name="stop", profile_process="worker"):
     if state_name == "run":
+        if _STATE["running"]:
+            # idempotent: re-entering 'run' while running must neither
+            # re-enter jax.profiler.start_trace (it raises on a second
+            # start) nor clobber the session's peak_memory_bytes
+            return
         _STATE["running"] = True
         _STATE.pop("peak_memory_bytes", None)  # fresh session, fresh peak
+        if _STATE.get("jax_trace"):
+            # 'run' after pause(): the device trace is still active —
+            # re-entering start_trace would raise and orphan it
+            return
         if os.environ.get("MXNET_PROFILER_AUTOSTART") != "0" and _CONFIG.get("xprof_dir"):
             try:
                 jax.profiler.start_trace(_CONFIG["xprof_dir"])
@@ -54,6 +66,8 @@ def set_state(state_name="stop", profile_process="worker"):
             except Exception:
                 _STATE["jax_trace"] = False
     elif state_name == "stop":
+        if not _STATE["running"] and not _STATE.get("jax_trace"):
+            return                             # idempotent no-op
         _STATE["running"] = False
         if _STATE.get("jax_trace"):
             try:
@@ -101,18 +115,23 @@ def _device_bytes_in_use():
         return None
 
 
-def record_op(name, begin_us, end_us, category="operator"):
-    """Called from the dispatch layer (ThreadedEngine ProfileOperator analog)."""
+def record_op(name, begin_us, end_us, category="operator", args=None):
+    """Called from the dispatch layer (ThreadedEngine ProfileOperator
+    analog). ``args`` lands in the Chrome-trace event's ``args`` dict —
+    `Scope` stamps the active telemetry trace id through it so one
+    request is findable in the device trace."""
     if not _STATE["running"]:
         return
     with _LOCK:
         ev = {"name": name, "cat": category, "ph": "X",
               "ts": begin_us, "dur": end_us - begin_us,
               "pid": os.getpid(), "tid": threading.get_ident()}
+        if args:
+            ev["args"] = dict(args)
         if _CONFIG["profile_memory"]:
             mem = _device_bytes_in_use()
             if mem is not None:
-                ev["args"] = {"bytes_in_use": mem}
+                ev.setdefault("args", {})["bytes_in_use"] = mem
                 peak = _STATE.get("peak_memory_bytes", 0)
                 _STATE["peak_memory_bytes"] = max(peak, mem)
         _EVENTS.append(ev)
@@ -147,6 +166,30 @@ def dumps(reset=False, format="table"):
         return "\n".join(lines)
 
 
+def export_metrics(registry=None):
+    """Publish the aggregate per-op stats (``aggregate_stats=True``
+    sessions) onto a telemetry registry as gauges —
+    ``mxnet_tpu_profiler_op_calls{op=...}`` /
+    ``..._op_total_ms{op=...}`` / ``..._op_max_ms{op=...}`` — so a
+    /metrics scrape sees the same table ``dumps()`` prints. Returns
+    the number of ops exported."""
+    from .telemetry.registry import REGISTRY
+    reg = registry if registry is not None else REGISTRY
+    calls = reg.gauge("mxnet_tpu_profiler_op_calls",
+                      "profiled calls per op", ("op",))
+    total = reg.gauge("mxnet_tpu_profiler_op_total_ms",
+                      "profiled wall ms per op", ("op",))
+    mx_ms = reg.gauge("mxnet_tpu_profiler_op_max_ms",
+                      "profiled max wall ms per op", ("op",))
+    with _LOCK:
+        agg = {name: tuple(v) for name, v in _AGGREGATE.items()}
+    for name, (cnt, tot, _mn, mx) in agg.items():
+        calls.labels(op=name).set(cnt)
+        total.labels(op=name).set(round(tot, 3))
+        mx_ms.labels(op=name).set(round(mx, 3))
+    return len(agg)
+
+
 def pause(profile_process="worker"):
     _STATE["running"] = False
 
@@ -164,16 +207,18 @@ class _Named:
 
 
 class Task(_Named):
-    def __init__(self, domain=None, name="task"):
+    def __init__(self, domain=None, name="task", args=None):
         super().__init__(name)
         self._start = None
+        self._args = args
 
     def start(self):
         self._start = time.perf_counter_ns() // 1000
 
     def stop(self):
         if self._start is not None:
-            record_op(self.name, self._start, time.perf_counter_ns() // 1000, "task")
+            record_op(self.name, self._start, time.perf_counter_ns() // 1000,
+                      "task", args=self._args)
             self._start = None
 
 
@@ -210,19 +255,39 @@ class Marker(_Named):
 
 
 class Scope:
-    """with profiler.Scope('fwd'): ... — custom range."""
+    """with profiler.Scope('fwd'): ... — custom range.
+
+    Stamps the active telemetry trace id (serving request ids minted at
+    ``ServingEngine.submit``) into both the Chrome-trace event ``args``
+    and the xprof TraceAnnotation metadata, so one request correlates
+    across the wall-clock and device timelines. Degrades to
+    wall-clock-only when ``jax.profiler.TraceAnnotation`` raises (a
+    broken device-trace backend must not take the serving worker down,
+    and the started wall-clock Task must still be closed)."""
 
     def __init__(self, name="scope"):
         self.name = name
 
     def __enter__(self):
-        self._t = Task(name=self.name)
+        tid = _current_trace_id()
+        self._t = Task(name=self.name,
+                       args={"trace_id": tid} if tid else None)
         self._t.start()
-        self._jax_ctx = jax.profiler.TraceAnnotation(self.name)
-        self._jax_ctx.__enter__()
+        self._jax_ctx = None
+        try:
+            ctx = (jax.profiler.TraceAnnotation(self.name, trace_id=tid)
+                   if tid else jax.profiler.TraceAnnotation(self.name))
+            ctx.__enter__()
+            self._jax_ctx = ctx
+        except Exception:
+            pass                      # wall-clock-only scope
         return self
 
     def __exit__(self, *exc):
-        self._jax_ctx.__exit__(*exc)
+        if self._jax_ctx is not None:
+            try:
+                self._jax_ctx.__exit__(*exc)
+            except Exception:
+                pass
         self._t.stop()
         return False

@@ -30,12 +30,28 @@ import numpy as np
 from .. import autograd, profiler
 from .. import ndarray as nd
 from ..context import current_context
+from ..telemetry import events as _events
+from ..telemetry.registry import REGISTRY as _REGISTRY
+from ..telemetry.trace import trace_context as _trace_context
 from .batcher import ContinuousBatcher
 from .metrics import ServingStats
 from .queue import (DeadlineExceededError, EngineStoppedError, Request,
                     RequestQueue, RequestTooLongError, ServingError)
 
 __all__ = ["ServingEngine"]
+
+
+def _join_trace_ids(requests, cap=16):
+    """One contextvar value for a whole batch: the member requests'
+    trace ids, comma-joined (capped — a 128-request batch must not
+    grow a kilobyte span annotation). None when the batch is empty
+    (warmup dummy forwards)."""
+    ids = [r.trace_id for r in requests]
+    if not ids:
+        return None
+    if len(ids) > cap:
+        ids = ids[:cap] + [f"+{len(ids) - cap}more"]
+    return ",".join(ids)
 
 
 def _slice_tokens(seq_slice, request):
@@ -96,8 +112,13 @@ class ServingEngine:
         self._pool = _POOLERS[pool] if isinstance(pool, str) else pool
         self.stats = ServingStats(stats_window)
         self.stats.set_queue_depth_fn(lambda: len(self._queue))
+        self._compile_cache = _REGISTRY.counter(
+            "mxnet_tpu_serving_compile_cache_total",
+            "per-shape CachedOp executable cache outcomes at dispatch",
+            ("result",))
         self._seen_shapes = set()
         self._worker = None
+        self._expo = None
         self._abort = False
         self._started = False
         self._lock = threading.Lock()
@@ -114,27 +135,46 @@ class ServingEngine:
                                             name="mxnet_tpu_serving",
                                             daemon=True)
             self._worker.start()
+        _events.emit("engine_start",
+                     bucket_lens=list(self._batcher.bucket_lens),
+                     max_rows=self._batcher.max_rows)
         return self
 
     def stop(self, drain=True, timeout=None):
         """Shut down. ``drain=True`` finishes every queued/in-flight
         request first; ``drain=False`` fails them with
         :class:`EngineStoppedError` (counted ``cancelled``)."""
+        _events.emit("engine_abort" if not drain else "engine_stop",
+                     drain=drain)
         with self._lock:
             self._queue.close()
             if not drain:
                 self._abort = True
             worker = self._worker
+        timed_out = False
         if worker is not None:
             worker.join(timeout)
-            if worker.is_alive():
-                raise ServingError("serving worker did not stop in time")
+            timed_out = worker.is_alive()
         # requests still queued after the worker exited (stop before
-        # start, or abort path raced new submits) fail loudly
+        # start, abort racing new submits, or a HUNG worker — a stuck
+        # forward will never serve them) fail loudly; the exposition
+        # server closes either way so the port never leaks
         for r in self._queue.drain_all():
             self.stats.bump("cancelled")
             r.future.set_exception(
                 EngineStoppedError("engine stopped before request ran"))
+        # release the registry's queue-depth closure (it would pin this
+        # engine — params, compile caches — for the process lifetime
+        # and report a dead queue as live) and the exposition server;
+        # swap under the lock so a racing expose() can't leak one. The
+        # queue was just drained, so a constant 0 stays truthful.
+        self.stats.set_queue_depth_fn(lambda: 0)
+        with self._lock:
+            expo, self._expo = self._expo, None
+        if expo is not None:
+            expo.close()
+        if timed_out:
+            raise ServingError("serving worker did not stop in time")
 
     def __enter__(self):
         return self.start()
@@ -154,23 +194,33 @@ class ServingEngine:
         """Enqueue one request; returns an :class:`InferenceFuture`.
         Raises the admission errors directly (queue full, too long,
         stopped) so callers can tell shedding from failure."""
+        if deadline_ms is None:
+            deadline_ms = self._default_deadline_ms
+        # validate FIRST: a malformed request (empty tokens, mismatched
+        # token_types) raises to the caller without touching any
+        # counter, so submitted always equals the sum of the outcome
+        # counters (the invariant the loadgen cross-check reconciles)
+        req = Request(tokens, token_types, deadline_ms)
         self.stats.bump("submitted")
         if not self._started or self._queue.closed:
             self.stats.bump("rejected_stopped")
             raise EngineStoppedError("serving engine is not running")
-        if deadline_ms is None:
-            deadline_ms = self._default_deadline_ms
-        req = Request(tokens, token_types, deadline_ms)
         if len(req) > self._batcher.max_len:
             self.stats.bump("rejected_too_long")
+            _events.emit("request_shed", reason="too_long",
+                         trace_id=req.trace_id, tokens=len(req))
             raise RequestTooLongError(
                 f"request of {len(req)} tokens exceeds the largest row "
                 f"bucket ({self._batcher.max_len})")
         try:
             self._queue.put(req)
         except ServingError as e:
+            full = not self._queue.closed
             self.stats.bump("rejected_queue_full"
-                            if not self._queue.closed else "rejected_stopped")
+                            if full else "rejected_stopped")
+            _events.emit("request_shed",
+                         reason="queue_full" if full else "stopped",
+                         trace_id=req.trace_id, tokens=len(req))
             raise e
         return req.future
 
@@ -195,11 +245,47 @@ class ServingEngine:
     def reset_stats(self):
         """Swap in a fresh ServingStats (compile cache untouched):
         separates a warmup/throwaway traffic window from the measured
-        one — lifetime-cumulative stats would otherwise fold both."""
-        window = self.stats.queue_ms._window.maxlen
-        self.stats = ServingStats(window)
+        one — lifetime-cumulative stats would otherwise fold both.
+        The process-wide telemetry registry keeps counting (Prometheus
+        counters never reset); scrapers diff between scrapes."""
+        self.stats = ServingStats(self.stats.window)
         self.stats.set_queue_depth_fn(lambda: len(self._queue))
         return self
+
+    def expose(self, port=0, host="127.0.0.1"):
+        """Start (or return the running) telemetry exposition server
+        for this engine: Prometheus ``/metrics`` off the process
+        registry, ``/healthz`` liveness (worker thread alive, queue
+        open), and ``/stats`` serving this engine's ``snapshot()``
+        JSON. ``port=0`` picks a free port (read ``.port`` back).
+        Closed automatically by :meth:`stop`."""
+        from ..telemetry.expo import TelemetryServer
+
+        with self._lock:
+            if self._queue.closed:
+                # stop() already ran (or is draining): a fresh server
+                # here would have no one to close it
+                raise EngineStoppedError(
+                    "cannot expose telemetry on a stopped engine")
+            if self._expo is not None:
+                return self._expo
+
+            def healthz():
+                alive = (self._worker is not None
+                         and self._worker.is_alive())
+                closed = self._queue.closed
+                return (alive and not closed,
+                        {"worker_alive": alive, "queue_closed": closed,
+                         "queue_depth": len(self._queue)})
+
+            srv = TelemetryServer(healthz_fn=healthz,
+                                  stats_fn=self.snapshot,
+                                  port=port, host=host)
+            self._expo = srv
+        # emit/return through the local: a stop() racing in right here
+        # may already have swapped self._expo away (and closed it)
+        _events.emit("telemetry_expose", port=srv.port, host=srv.host)
+        return srv
 
     def snapshot(self):
         """Stats dict: counters, queue depth, latency percentiles,
@@ -238,6 +324,9 @@ class ServingEngine:
             for r in reqs:
                 if r.expired(now):
                     self.stats.bump("expired")
+                    _events.emit("request_expired", trace_id=r.trace_id,
+                                 waited_ms=round((now - r.t_submit) * 1e3,
+                                                 3))
                     r.future.set_exception(DeadlineExceededError(
                         f"request {r.id} deadline exceeded before "
                         "dispatch"))
@@ -247,8 +336,9 @@ class ServingEngine:
                 continue
             try:
                 t0 = time.perf_counter()
-                with profiler.Scope("serving/pack"):
-                    plan, carry = self._batcher.plan(live)
+                with _trace_context(_join_trace_ids(live)):
+                    with profiler.Scope("serving/pack"):
+                        plan, carry = self._batcher.plan(live)
                 self.stats.pack_ms.observe((time.perf_counter() - t0) * 1e3)
             except Exception as e:  # packing failure: fail this drain
                 self._fail(live, e, "failed")
@@ -271,10 +361,15 @@ class ServingEngine:
 
     def _dispatch(self, plan):
         shape = (plan.rows, plan.row_len)
+        hit = shape in self._seen_shapes
+        self._compile_cache.labels(result="hit" if hit else "miss").inc()
+        if not hit:
+            _events.emit("compile_begin", rows=plan.rows,
+                         row_len=plan.row_len)
         t0 = time.perf_counter()
         seq = self._forward(plan)
         dt_ms = (time.perf_counter() - t0) * 1e3
-        if shape in self._seen_shapes:
+        if hit:
             self.stats.compute_ms.observe(dt_ms)
         else:
             # first visit pays trace+compile; report it as compile
@@ -282,9 +377,17 @@ class ServingEngine:
             self._seen_shapes.add(shape)
             self.stats.bump("compiles")
             self.stats.compile_ms.observe(dt_ms)
+            _events.emit("compile_end", rows=plan.rows,
+                         row_len=plan.row_len, ms=round(dt_ms, 3))
         self.stats.observe_batch(plan.rows, plan.row_len,
                                  plan.valid_tokens, len(plan.entries),
                                  plan.row_len)
+        # one line per batch (not per request): every served request's
+        # trace id is findable in the event log without per-request spam
+        _events.emit("batch_dispatch", rows=plan.rows,
+                     row_len=plan.row_len, requests=len(plan.entries),
+                     valid_tokens=plan.valid_tokens, ms=round(dt_ms, 3),
+                     trace_ids=[r.trace_id for r, _ in plan.entries])
         now = time.monotonic()
         for req, pl in plan.entries:
             try:
@@ -307,9 +410,12 @@ class ServingEngine:
         vl = nd.array(plan.valid_length, dtype="int32", ctx=self._ctx)
         seg = nd.array(plan.segment_ids, dtype="int32", ctx=self._ctx)
         pos = nd.array(plan.positions, dtype="int32", ctx=self._ctx)
-        with autograd.predict_mode():
-            with profiler.Scope("serving/forward"):
-                out = self._model(ids, tt, vl, seg, pos)
+        # the batch adopts its requests' trace ids so the forward span
+        # in the Chrome trace / xprof names every request it served
+        with _trace_context(_join_trace_ids(r for r, _ in plan.entries)):
+            with autograd.predict_mode():
+                with profiler.Scope("serving/forward"):
+                    out = self._model(ids, tt, vl, seg, pos)
         if isinstance(out, (list, tuple)):
             out = out[0]
         return out.asnumpy()   # host sync: per-request slicing follows

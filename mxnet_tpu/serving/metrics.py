@@ -7,6 +7,16 @@ is an in-process stats dict (``ServingStats.snapshot``) plus
 of a serving run shows queue/pack/compute spans next to the device
 timeline.
 
+Since the telemetry subsystem landed, every ``ServingStats`` also
+BRIDGES onto the process-wide :data:`mxnet_tpu.telemetry.REGISTRY`:
+counters feed ``mxnet_tpu_serving_requests_total{event=...}``, each
+latency summary co-observes a ``mxnet_tpu_serving_latency_ms{stage=..}``
+histogram, queue depth is a pull gauge, and per-bucket batch traffic
+lands in ``mxnet_tpu_serving_batch_{tokens,slots}_total{bucket=...}``.
+Registry counters are process-cumulative by Prometheus contract:
+``ServingEngine.reset_stats`` swaps the WINDOW (this object) while the
+registry keeps counting — scrapers diff between scrapes.
+
 Everything is thread-safe: client threads observe submit/reject
 counters while the single worker thread observes batch/compute stats.
 """
@@ -15,7 +25,12 @@ from __future__ import annotations
 import threading
 from collections import deque
 
+from ..telemetry.registry import REGISTRY
+
 __all__ = ["LatencySummary", "ServingStats", "nearest_rank"]
+
+# batch-size histogram boundaries (requests per dispatched batch)
+_BATCH_REQ_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0)
 
 
 def nearest_rank(sorted_xs, p):
@@ -38,14 +53,18 @@ class LatencySummary:
     lists would not) plus running count/sum/max over the full
     lifetime. p50/p95/p99 therefore describe the recent window, count
     and mean the whole run — the usual server-metrics convention.
+
+    ``hist`` (optional) is a telemetry histogram child co-observed on
+    every sample, so the same numbers are scrapeable at /metrics.
     """
 
-    def __init__(self, capacity=4096):
+    def __init__(self, capacity=4096, hist=None):
         self._window = deque(maxlen=capacity)
         self._lock = threading.Lock()
         self._count = 0
         self._total = 0.0
         self._max = 0.0
+        self._hist = hist
 
     def observe(self, ms):
         with self._lock:
@@ -54,6 +73,8 @@ class LatencySummary:
             self._total += ms
             if ms > self._max:
                 self._max = ms
+        if self._hist is not None:
+            self._hist.observe(ms)
 
     @property
     def count(self):
@@ -93,24 +114,58 @@ class ServingStats:
                 "rejected_too_long", "rejected_stopped", "expired",
                 "cancelled", "batches", "compiles")
 
-    def __init__(self, window=4096):
+    def __init__(self, window=4096, registry=None):
+        reg = registry if registry is not None else REGISTRY
+        self.window = window          # public: reset_stats reads this
         self._lock = threading.Lock()
         self._c = {name: 0 for name in self.COUNTERS}
         # dispatched slot accounting for the aggregate packing number
         self._slots = 0
         self._valid_tokens = 0
-        self.queue_ms = LatencySummary(window)
-        self.pack_ms = LatencySummary(window)
-        self.compute_ms = LatencySummary(window)
-        self.compile_ms = LatencySummary(window)
-        self.total_ms = LatencySummary(window)
-        self.batch_requests = LatencySummary(window)   # requests/batch
+        # registry bridge: children resolved ONCE here so the hot path
+        # pays a dict lookup + locked add, never family bookkeeping
+        req_total = reg.counter(
+            "mxnet_tpu_serving_requests_total",
+            "serving requests by admission/completion outcome", ("event",))
+        self._reg_c = {name: req_total.labels(event=name)
+                       for name in self.COUNTERS
+                       if name not in ("batches", "compiles")}
+        # not request outcomes — their own families keep the
+        # requests_total label space reconcilable request-for-request
+        self._reg_c["batches"] = reg.counter(
+            "mxnet_tpu_serving_batches_total", "dispatched packed batches")
+        self._reg_c["compiles"] = reg.counter(
+            "mxnet_tpu_serving_compiles_total",
+            "first-visit shape trace+compiles")
+        lat = reg.histogram("mxnet_tpu_serving_latency_ms",
+                            "serving latency by pipeline stage", ("stage",))
+        self.queue_ms = LatencySummary(window, lat.labels(stage="queue"))
+        self.pack_ms = LatencySummary(window, lat.labels(stage="pack"))
+        self.compute_ms = LatencySummary(window,
+                                         lat.labels(stage="compute"))
+        self.compile_ms = LatencySummary(window,
+                                         lat.labels(stage="compile"))
+        self.total_ms = LatencySummary(window, lat.labels(stage="total"))
+        self.batch_requests = LatencySummary(
+            window, reg.histogram("mxnet_tpu_serving_batch_requests",
+                                  "requests per dispatched batch",
+                                  buckets=_BATCH_REQ_BUCKETS))
+        self._reg_batch_tokens = reg.counter(
+            "mxnet_tpu_serving_batch_tokens_total",
+            "valid tokens dispatched, by row-length bucket", ("bucket",))
+        self._reg_batch_slots = reg.counter(
+            "mxnet_tpu_serving_batch_slots_total",
+            "padded slots dispatched, by row-length bucket", ("bucket",))
+        self._reg_queue_depth = reg.gauge(
+            "mxnet_tpu_serving_queue_depth",
+            "requests waiting in the admission queue")
         self._queue_depth_fn = None
         self._last_batch = None
 
     def bump(self, name, n=1):
         with self._lock:
             self._c[name] += n
+        self._reg_c[name].inc(n)
 
     def count(self, name):
         with self._lock:
@@ -118,6 +173,8 @@ class ServingStats:
 
     def set_queue_depth_fn(self, fn):
         self._queue_depth_fn = fn
+        # pull gauge: evaluated at scrape time, zero hot-path cost
+        self._reg_queue_depth.set_function(fn)
 
     def observe_batch(self, rows, row_len, valid_tokens, n_requests,
                       bucket_len):
@@ -130,6 +187,9 @@ class ServingStats:
                 "bucket_len": bucket_len,
                 "packing_efficiency":
                     round(valid_tokens / float(rows * row_len), 4)}
+        self._reg_c["batches"].inc()
+        self._reg_batch_tokens.labels(bucket=bucket_len).inc(valid_tokens)
+        self._reg_batch_slots.labels(bucket=bucket_len).inc(rows * row_len)
         self.batch_requests.observe(n_requests)
 
     def packing_efficiency(self):

@@ -19,6 +19,7 @@ from collections import deque
 import numpy as np
 
 from ..base import MXNetError
+from ..telemetry.trace import new_trace_id
 
 __all__ = ["ServingError", "QueueFullError", "DeadlineExceededError",
            "RequestTooLongError", "EngineStoppedError", "InferenceFuture",
@@ -94,13 +95,21 @@ _req_ids = itertools.count()
 
 
 class Request:
-    """One queued inference request and its timing breadcrumbs."""
+    """One queued inference request and its timing breadcrumbs.
 
-    __slots__ = ("id", "tokens", "token_types", "deadline", "future",
-                 "t_submit", "t_drain", "t_dispatch", "t_done")
+    ``trace_id`` is the request's cross-layer identity: minted here (at
+    submit time), it follows the request through queue→batcher→dispatch
+    via the telemetry contextvar, gets stamped into profiler
+    Chrome-trace/xprof spans, and names the request in the structured
+    event log — ``id`` stays the cheap in-process ordinal.
+    """
+
+    __slots__ = ("id", "trace_id", "tokens", "token_types", "deadline",
+                 "future", "t_submit", "t_drain", "t_dispatch", "t_done")
 
     def __init__(self, tokens, token_types=None, deadline_ms=None):
         self.id = next(_req_ids)
+        self.trace_id = new_trace_id("req")
         self.tokens = np.asarray(tokens, np.int32).reshape(-1)
         if self.tokens.size == 0:
             raise ValueError("empty request")
@@ -115,6 +124,9 @@ class Request:
         self.deadline = (self.t_submit + deadline_ms / 1e3
                          if deadline_ms is not None else None)
         self.future = InferenceFuture()
+        # clients hold only the future; mirror the id there so caller
+        # logs can name the request the server's telemetry names
+        self.future.trace_id = self.trace_id
         self.t_drain = self.t_dispatch = self.t_done = None
 
     def __len__(self):

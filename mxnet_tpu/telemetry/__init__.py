@@ -1,0 +1,45 @@
+"""Framework-wide telemetry (`mxnet_tpu.telemetry`).
+
+The first CROSS-PROCESS observability layer of the stack (reference
+lineage: MXNet Model Server's management-API metrics + MXNet
+src/profiler/'s chrome://tracing feed, which this repo's in-process
+``ServingStats``/``profiler.py`` reproduce — scrapeable from outside
+the process starting here). Four pieces:
+
+- :mod:`.registry` — process-wide thread-safe Counter/Gauge/Histogram
+  families with label sets (module-level :data:`REGISTRY` default);
+- :mod:`.expo` — stdlib-http background server: Prometheus
+  ``/metrics``, ``/healthz`` liveness, ``/stats`` JSON;
+- :mod:`.events` — structured JSONL run-event log (wall + monotonic
+  stamps, pid, event type, trace id), env-configured via
+  ``MXNET_TPU_EVENT_LOG``;
+- :mod:`.trace` — trace-id propagation: minted at
+  ``ServingEngine.submit``, rides a contextvar into profiler spans,
+  and crosses the dist_async wire so both processes' event logs
+  correlate on the same push.
+
+Quickstart::
+
+    from mxnet_tpu import telemetry
+
+    srv = engine.expose(port=9100)        # ServingEngine exposition
+    # curl :9100/metrics | :9100/healthz | :9100/stats
+
+    telemetry.events.configure("run-events.jsonl")
+    c = telemetry.REGISTRY.counter("my_total", "things", ("kind",))
+    c.labels(kind="good").inc()
+"""
+from . import events, expo, trace
+from .events import EventLog
+from .expo import (TelemetryServer, histogram_quantile,
+                   parse_prometheus_text, start_server)
+from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
+                       REGISTRY, DEFAULT_MS_BUCKETS)
+from .trace import (current_trace_id, new_trace_id, set_trace_id,
+                    trace_context)
+
+__all__ = ["REGISTRY", "MetricsRegistry", "Counter", "Gauge", "Histogram",
+           "DEFAULT_MS_BUCKETS", "TelemetryServer", "start_server",
+           "parse_prometheus_text", "histogram_quantile", "EventLog",
+           "events", "expo", "trace", "new_trace_id", "current_trace_id",
+           "set_trace_id", "trace_context"]

@@ -1,0 +1,140 @@
+"""Structured JSONL run-event log.
+
+One line per lifecycle event — engine start/stop/abort, per-shape
+compile begin/end, request shed/expiry, wire-frame refusal, kvstore
+optimizer updates — so a run leaves a machine-readable record next to
+the human stderr stream. Every record carries::
+
+    {"ts": <wall unix s>, "mono": <monotonic s>, "pid": <pid>,
+     "event": <type>, "trace_id": <active trace id or null>, ...fields}
+
+Wall time orders events across machines; the monotonic stamp orders
+them exactly within a process (wall clocks step, monotonic doesn't).
+
+Cost discipline: when no log is configured, :func:`emit` is one global
+read + None check — the instrumented hot paths pay nothing (guarded by
+the disabled-path microbenchmark in tests/test_telemetry.py).
+
+Configuration: :func:`configure` in code, or the
+``MXNET_TPU_EVENT_LOG`` env var (read once, on first emit). If the
+value names a DIRECTORY, each process writes its own
+``events-<pid>.jsonl`` inside it — exactly what a multi-process
+dist_async launch needs (one env var in the launcher, one log per
+process, no interleaved writes).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+from .trace import current_trace_id
+
+__all__ = ["EventLog", "configure", "emit", "get_log", "read_events"]
+
+
+class EventLog:
+    """Append-only JSONL writer (thread-safe, line-buffered: every
+    event is durable on its own ``write`` — a crashed process keeps
+    its log up to the last event)."""
+
+    def __init__(self, path, component=None):
+        self.path = str(path)
+        self.component = component
+        self._lock = threading.Lock()
+        self._f = open(self.path, "a", buffering=1)
+
+    def emit(self, event, **fields):
+        rec = {"ts": round(time.time(), 6),
+               "mono": round(time.monotonic(), 6),
+               "pid": os.getpid(),
+               "event": event,
+               "trace_id": fields.pop("trace_id", None)
+               or current_trace_id()}
+        if self.component:
+            rec["component"] = self.component
+        rec.update(fields)
+        line = json.dumps(rec, default=str)
+        with self._lock:
+            try:
+                self._f.write(line + "\n")
+            except (ValueError, OSError):
+                # a concurrent configure()/close() or a full disk must
+                # never take an instrumented hot path down — telemetry
+                # loses one line, the serving batch survives
+                pass
+
+    def close(self):
+        with self._lock:
+            try:
+                self._f.close()
+            except OSError:
+                pass
+
+
+_global = None
+_env_checked = False
+_lock = threading.Lock()
+
+
+def _resolve_path(value):
+    if os.path.isdir(value):
+        return os.path.join(value, f"events-{os.getpid()}.jsonl")
+    return value
+
+
+def configure(path=None, component=None):
+    """Install (or with ``path=None`` remove) the process event log.
+    Returns the :class:`EventLog` (or None)."""
+    global _global, _env_checked
+    with _lock:
+        if _global is not None:
+            _global.close()
+        _global = (EventLog(_resolve_path(path), component)
+                   if path is not None else None)
+        _env_checked = True          # explicit config outranks the env
+    return _global
+
+
+def get_log():
+    """The active process log, auto-configuring from
+    ``MXNET_TPU_EVENT_LOG`` on first call. None when logging is off."""
+    global _global, _env_checked
+    if _global is None and not _env_checked:
+        with _lock:
+            if _global is None and not _env_checked:
+                env = os.environ.get("MXNET_TPU_EVENT_LOG")
+                if env:
+                    try:
+                        _global = EventLog(_resolve_path(env))
+                    except OSError:
+                        _global = None
+                _env_checked = True
+    return _global
+
+
+def emit(event, **fields):
+    """Emit to the process log; a no-op (one None check after the
+    first call) when no log is configured."""
+    log = get_log()
+    if log is not None:
+        log.emit(event, **fields)
+
+
+def read_events(path, event=None):
+    """Parse an events JSONL file (tolerating a torn final line from a
+    live writer); optionally filter by event type."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if event is None or rec.get("event") == event:
+                out.append(rec)
+    return out

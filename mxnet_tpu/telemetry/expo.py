@@ -1,0 +1,255 @@
+"""Telemetry exposition: a background-thread HTTP server.
+
+Serves three endpoints off a stdlib ``ThreadingHTTPServer`` (no new
+dependencies, daemon threads — never blocks process exit):
+
+- ``/metrics``  — Prometheus text format 0.0.4 from a
+  :class:`~.registry.MetricsRegistry` (default: the process registry);
+- ``/healthz``  — liveness: 200 + JSON when the attached health check
+  passes (serving worker alive, queue open), 503 when it fails, 200
+  ``{"ok": true}`` when nothing registered a check (process is up);
+- ``/stats``    — the attached component's JSON stats dict (a
+  ``ServingEngine.snapshot()`` made scrapeable), falling back to the
+  registry snapshot.
+
+Attach points: ``ServingEngine.expose(port)`` and
+``kvstore.expose_telemetry(kv, port)`` construct one of these; scripts
+can also run ``start_server(port)`` for bare registry exposition.
+
+Also here: :func:`parse_prometheus_text`, the scrape-side parser the
+loadgen cross-check and ``tools/telemetry_dump.py`` share.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from .registry import REGISTRY
+
+__all__ = ["TelemetryServer", "start_server", "parse_prometheus_text",
+           "parse_labels", "histogram_quantile"]
+
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class TelemetryServer:
+    """Background /metrics + /healthz + /stats server.
+
+    Parameters
+    ----------
+    registry : MetricsRegistry, default the process-wide one.
+    healthz_fn : ``() -> (bool, dict)`` liveness check; None = always
+        healthy (the process answered, that IS liveness).
+    stats_fn : ``() -> dict`` for /stats; None = registry snapshot.
+    port : 0 picks a free port (read it back from ``.port``).
+    host : bind interface; loopback by default — exposing metrics on
+        all interfaces is an operator decision, not a default.
+    """
+
+    def __init__(self, registry=None, healthz_fn=None, stats_fn=None,
+                 port=0, host="127.0.0.1"):
+        self.registry = registry if registry is not None else REGISTRY
+        self.healthz_fn = healthz_fn
+        self.stats_fn = stats_fn
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):      # scrapes must not spam stderr
+                pass
+
+            def do_GET(self):
+                try:
+                    server._route(self)
+                except (BrokenPipeError, ConnectionResetError):
+                    pass                    # scraper went away mid-reply
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="mxnet_tpu_telemetry",
+                                        daemon=True)
+        self._thread.start()
+
+    @property
+    def port(self):
+        return self._httpd.server_address[1]
+
+    @property
+    def host(self):
+        return self._httpd.server_address[0]
+
+    def url(self, path="/metrics"):
+        return f"http://{self.host}:{self.port}{path}"
+
+    def _route(self, handler):
+        path = handler.path.split("?", 1)[0]
+        if path == "/metrics":
+            body = self.registry.render_prometheus().encode()
+            self._reply(handler, 200, PROMETHEUS_CONTENT_TYPE, body)
+        elif path == "/healthz":
+            ok, detail = True, {}
+            if self.healthz_fn is not None:
+                try:
+                    ok, detail = self.healthz_fn()
+                except Exception as e:
+                    ok, detail = False, {"error": repr(e)}
+            body = json.dumps({"ok": bool(ok), **detail}).encode()
+            self._reply(handler, 200 if ok else 503, "application/json",
+                        body)
+        elif path == "/stats":
+            try:
+                stats = (self.stats_fn() if self.stats_fn is not None
+                         else self.registry.snapshot())
+                body = json.dumps(stats, default=str).encode()
+            except Exception as e:
+                self._reply(handler, 500, "application/json",
+                            json.dumps({"error": repr(e)}).encode())
+                return
+            self._reply(handler, 200, "application/json", body)
+        else:
+            self._reply(handler, 404, "text/plain",
+                        b"try /metrics, /healthz or /stats\n")
+
+    @staticmethod
+    def _reply(handler, code, ctype, body):
+        handler.send_response(code)
+        handler.send_header("Content-Type", ctype)
+        handler.send_header("Content-Length", str(len(body)))
+        handler.end_headers()
+        handler.wfile.write(body)
+
+    def close(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5.0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def start_server(port=0, host="127.0.0.1", registry=None, healthz_fn=None,
+                 stats_fn=None):
+    """Convenience: start and return a :class:`TelemetryServer`."""
+    return TelemetryServer(registry=registry, healthz_fn=healthz_fn,
+                           stats_fn=stats_fn, port=port, host=host)
+
+
+def parse_prometheus_text(text):
+    """Parse exposition text into ``{name{labels}: float}`` (labels
+    part verbatim, ``""`` for none). Inverse enough of
+    ``MetricsRegistry.render_prometheus`` for scrape cross-checks —
+    handles escaped quotes in label values, skips comments."""
+    out = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        # split at the last space OUTSIDE a quoted label value
+        in_quote = False
+        split_at = -1
+        prev = ""
+        for i, ch in enumerate(line):
+            if ch == '"' and prev != "\\":
+                in_quote = not in_quote
+            elif ch == " " and not in_quote:
+                split_at = i
+            prev = ch if not (ch == "\\" and prev == "\\") else ""
+        if split_at < 0:
+            continue
+        key, val = line[:split_at], line[split_at + 1:].strip()
+        try:
+            out[key] = float(val)
+        except ValueError:
+            continue
+    return out
+
+
+def parse_labels(key):
+    """``name{a="x",b="y"}`` → ``(name, {"a": "x", "b": "y"})``
+    (unescaping the spec's three label-value escapes)."""
+    if "{" not in key:
+        return key, {}
+    name, _, rest = key.partition("{")
+    rest = rest.rstrip("}")
+    labels = {}
+    # split on commas outside quotes
+    parts, buf, in_quote, prev = [], "", False, ""
+    for ch in rest:
+        if ch == '"' and prev != "\\":
+            in_quote = not in_quote
+        if ch == "," and not in_quote:
+            parts.append(buf)
+            buf = ""
+        else:
+            buf += ch
+        prev = ch if not (ch == "\\" and prev == "\\") else ""
+    if buf:
+        parts.append(buf)
+    for p in parts:
+        k, _, v = p.partition("=")
+        labels[k.strip()] = _unescape(v.strip().strip('"'))
+    return name, labels
+
+
+def _unescape(v):
+    """Left-to-right unescape of the spec's three label-value escapes
+    (a replace() chain would corrupt values mixing backslashes with
+    'n' or quotes — '\\\\n' must decode to backslash+'n', not
+    backslash+newline)."""
+    out, i, n = [], 0, len(v)
+    while i < n:
+        ch = v[i]
+        if ch == "\\" and i + 1 < n:
+            nxt = v[i + 1]
+            if nxt == "n":
+                out.append("\n")
+                i += 2
+                continue
+            if nxt in ('"', "\\"):
+                out.append(nxt)
+                i += 2
+                continue
+        out.append(ch)
+        i += 1
+    return "".join(out)
+
+
+def histogram_quantile(parsed, name, q, match=None):
+    """PromQL-style ``histogram_quantile`` over a parsed scrape:
+    linear interpolation inside the bucket where the q-th sample
+    falls. ``match`` filters by label subset (e.g. {"stage": "total"}).
+    Returns None when the histogram has no samples. An estimate, not a
+    sample percentile — good for cross-checking magnitudes, not for
+    goldens."""
+    match = match or {}
+    buckets = []
+    for key, val in parsed.items():
+        n, labels = parse_labels(key)
+        if n != f"{name}_bucket" or "le" not in labels:
+            continue
+        if any(labels.get(k) != str(v) for k, v in match.items()):
+            continue
+        le = labels["le"]
+        buckets.append((float("inf") if le == "+Inf" else float(le), val))
+    if not buckets:
+        return None
+    buckets.sort()
+    total = buckets[-1][1]
+    if total <= 0:
+        return None
+    rank = q / 100.0 * total
+    lo_bound, lo_count = 0.0, 0.0
+    for bound, cum in buckets:
+        if cum >= rank:
+            if bound == float("inf"):
+                return lo_bound       # open-ended top bucket: its floor
+            span = cum - lo_count
+            frac = (rank - lo_count) / span if span else 1.0
+            return lo_bound + (bound - lo_bound) * frac
+        lo_bound, lo_count = bound, cum
+    return buckets[-1][0]

@@ -1,0 +1,49 @@
+"""Worker body for the cross-process trace-correlation test: 2-process
+dist_async launch where every worker writes a structured event log
+(MXNET_TPU_EVENT_LOG points at a shared directory, one
+events-<pid>.jsonl per process). Worker 1 pushes under an explicit
+trace context; the test asserts the SAME trace id shows up in worker
+1's client-side `kvstore_rpc` event and in worker 0's server-side
+`kvstore_server_handle` event — the id crossed the wire inside the
+typed frame.
+"""
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mxnet_tpu import kvstore, nd
+from mxnet_tpu.telemetry import trace_context
+
+
+def main():
+    kv = kvstore.create("dist_async")
+    rank = kv.rank
+    assert kv.num_workers == 2, kv.num_workers
+
+    if rank == 0:
+        kv.init("w", nd.array(np.zeros((4,), np.float32)))
+    kv.barrier()
+
+    if rank == 1:
+        with trace_context("trace-golden-push"):
+            kv.push("w", nd.array(np.full((4,), 2.0, np.float32)))
+    kv.barrier()
+
+    out = nd.zeros((4,))
+    kv.pull("w", out=out)
+    assert np.allclose(out.asnumpy(), 2.0), out.asnumpy()
+    kv.barrier()
+    print(f"TRACE_WORKER_{rank}_OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -46,6 +46,77 @@ def test_profiler_scopes():
     profiler.set_state("stop")
 
 
+def test_profiler_set_state_idempotent():
+    """Repeated run/stop calls are no-ops in the current state: a
+    second 'run' must not re-enter jax.profiler.start_trace or clobber
+    the session's peak_memory_bytes."""
+    profiler.set_config(profile_memory=True)
+    profiler.set_state("run")
+    try:
+        nd.ones((64, 64)).wait_to_read()
+        (nd.ones((64, 64)) * 2).wait_to_read()
+        peak = profiler.peak_memory_bytes()
+        assert peak is not None and peak > 0
+        profiler.set_state("run")        # no-op, peak survives
+        assert profiler.peak_memory_bytes() == peak
+        assert profiler.state() == "run"
+    finally:
+        profiler.set_state("stop")
+        profiler.set_config(profile_memory=False)
+    profiler.set_state("stop")           # second stop: silent no-op
+    assert profiler.state() == "stop"
+
+
+def test_profiler_scope_degrades_without_device_trace(monkeypatch):
+    """A raising TraceAnnotation must not crash the scope: it degrades
+    to wall-clock-only and still records its Task on exit."""
+    import jax
+
+    class Boom:
+        def __init__(self, *a, **k):
+            raise RuntimeError("no device tracer")
+
+    monkeypatch.setattr(jax.profiler, "TraceAnnotation", Boom)
+    profiler.set_state("run")
+    try:
+        with profiler.Scope("degraded/scope"):
+            nd.ones((2, 2)).asnumpy()
+    finally:
+        profiler.set_state("stop")
+    from mxnet_tpu.profiler import _EVENTS
+    assert any(e.get("name") == "degraded/scope" for e in _EVENTS)
+
+
+def test_profiler_scope_stamps_trace_id():
+    from mxnet_tpu.telemetry import trace_context
+
+    profiler.set_state("run")
+    try:
+        with trace_context("scope-tid-1"):
+            with profiler.Scope("traced/scope"):
+                nd.ones((2, 2)).asnumpy()
+    finally:
+        profiler.set_state("stop")
+    from mxnet_tpu.profiler import _EVENTS
+    ev = [e for e in _EVENTS if e.get("name") == "traced/scope"]
+    assert ev and ev[-1]["args"]["trace_id"] == "scope-tid-1"
+
+
+def test_profiler_export_metrics():
+    from mxnet_tpu.telemetry import MetricsRegistry
+
+    profiler.set_config(aggregate_stats=True)
+    profiler.set_state("run")
+    nd.exp(nd.ones((4, 4))).asnumpy()
+    profiler.set_state("stop")
+    reg = MetricsRegistry()
+    n = profiler.export_metrics(reg)
+    assert n >= 1
+    calls = reg.get("mxnet_tpu_profiler_op_calls")
+    assert calls is not None
+    assert any(v >= 1 for v in calls.snapshot().values())
+
+
 def test_monitor_collects_stats():
     from mxnet_tpu.monitor import Monitor
     x, _ = np.random.randn(16, 4).astype(np.float32), None

@@ -1,0 +1,495 @@
+"""Telemetry subsystem tests (mxnet_tpu/telemetry): registry
+concurrency exactness, Prometheus text-format golden, live
+ServingEngine /metrics + /healthz + /stats scrape, loadgen
+server/client reconciliation, trace-id correlation across the serving
+event log + Chrome trace and across the dist_async wire (two REAL
+processes), and the disabled-path cost guard the acceptance criteria
+require.
+"""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, profiler
+from mxnet_tpu.serving import ServingEngine, ServingStats
+from mxnet_tpu.telemetry import (MetricsRegistry, REGISTRY,
+                                 histogram_quantile, parse_prometheus_text,
+                                 TelemetryServer, events, trace_context)
+from mxnet_tpu.telemetry.expo import parse_labels
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, r.read().decode()
+
+
+class StubModel:
+    def __call__(self, ids, token_types, valid_length, segment_ids,
+                 positions):
+        return nd.array(ids.asnumpy().astype(np.float32)[..., None])
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+def test_registry_concurrent_totals_exact():
+    """The concurrency contract: N threads bumping/observing in
+    parallel lose NOTHING — totals are exact, not approximate."""
+    reg = MetricsRegistry()
+    c = reg.counter("t_total", "x", ("worker",))
+    h = reg.histogram("t_ms", "x", buckets=(1.0, 10.0, 100.0))
+    g = reg.gauge("t_depth")
+    n_threads, per_thread = 8, 5000
+
+    def work(i):
+        child = c.labels(worker=i % 2)
+        for j in range(per_thread):
+            child.inc()
+            h.observe(float(j % 200))
+            g.inc()
+
+    threads = [threading.Thread(target=work, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    total = n_threads * per_thread
+    assert c.labels(worker=0).value + c.labels(worker=1).value == total
+    assert h.count == total
+    # +Inf bucket of the rendered histogram equals the exact count too
+    parsed = parse_prometheus_text(reg.render_prometheus())
+    assert parsed['t_ms_bucket{le="+Inf"}'] == total
+    assert g.value == total
+    # histogram sum is the exact arithmetic series sum
+    assert h.sum == n_threads * sum(float(j % 200)
+                                    for j in range(per_thread))
+
+
+def test_registry_idempotent_and_conflicts():
+    reg = MetricsRegistry()
+    a = reg.counter("same_total", "x", ("k",))
+    assert reg.counter("same_total", "x", ("k",)) is a
+    with pytest.raises(ValueError):
+        reg.gauge("same_total")                  # kind conflict
+    with pytest.raises(ValueError):
+        reg.counter("same_total", "x", ("other",))   # label conflict
+    with pytest.raises(ValueError):
+        a.labels(k="v").inc(-1)                  # counters only go up
+    with pytest.raises(ValueError):
+        a.inc()                                  # labeled family needs labels
+    h = reg.histogram("same_ms", "x", buckets=(1.0, 2.0))
+    assert reg.histogram("same_ms", "x", buckets=(1.0, 2.0)) is h
+    assert reg.histogram("same_ms", "x") is h    # None = no opinion
+    with pytest.raises(ValueError):
+        reg.histogram("same_ms", "x", buckets=(5.0,))  # bucket conflict
+
+
+def test_prometheus_text_golden():
+    """Exact text-format golden: escaping, deterministic ordering,
+    histogram bucket CUMULATIVITY and +Inf == _count."""
+    reg = MetricsRegistry()
+    c = reg.counter("req_total", "requests served", ("path", "code"))
+    c.labels(path='/a"b\\c\nd', code=200).inc(3)
+    c.labels(path="/plain", code=500).inc()
+    g = reg.gauge("depth", "queue depth")
+    g.set(7)
+    h = reg.histogram("lat_ms", "latency", buckets=(1.0, 5.0, 25.0))
+    # binary-exact values: the _sum golden must not chase float repr
+    for v in (0.5, 0.75, 3.0, 30.0, 100.0):
+        h.observe(v)
+    golden = "\n".join([
+        '# HELP depth queue depth',
+        '# TYPE depth gauge',
+        'depth 7',
+        '# HELP lat_ms latency',
+        '# TYPE lat_ms histogram',
+        'lat_ms_bucket{le="1"} 2',
+        'lat_ms_bucket{le="5"} 3',
+        'lat_ms_bucket{le="25"} 3',
+        'lat_ms_bucket{le="+Inf"} 5',
+        'lat_ms_sum 134.25',
+        'lat_ms_count 5',
+        '# HELP req_total requests served',
+        '# TYPE req_total counter',
+        'req_total{path="/a\\"b\\\\c\\nd",code="200"} 3',
+        'req_total{path="/plain",code="500"} 1',
+    ]) + "\n"
+    assert reg.render_prometheus() == golden
+    # the scrape parser inverts the renderer
+    parsed = parse_prometheus_text(golden)
+    assert parsed['req_total{path="/a\\"b\\\\c\\nd",code="200"}'] == 3.0
+    assert parsed['lat_ms_bucket{le="+Inf"}'] == 5.0
+    name, labels = parse_labels('req_total{path="/a\\"b\\\\c\\nd",code="200"}')
+    assert name == "req_total" and labels["path"] == '/a"b\\c\nd'
+    # backslash-then-'n' must survive the round trip (NOT a newline)
+    from mxnet_tpu.telemetry.registry import escape_label_value
+    tricky = 'C:\\new"\\\\q'
+    _, rt = parse_labels('m{v="' + escape_label_value(tricky) + '"}')
+    assert rt["v"] == tricky
+    # quantile estimate lands inside the right bucket
+    p50 = histogram_quantile(parsed, "lat_ms", 50)
+    assert 0.0 < p50 <= 5.0
+
+
+def test_snapshot_and_compact():
+    reg = MetricsRegistry()
+    reg.counter("a_total").inc(2)
+    reg.counter("zero_total")                 # no samples: not compacted
+    reg.histogram("h_ms", buckets=(1.0,)).observe(0.5)
+    snap = reg.snapshot()
+    assert snap["a_total"]["kind"] == "counter"
+    compact = reg.snapshot_compact()
+    assert compact["a_total"] == {"": 2.0}
+    assert "zero_total" not in compact
+    assert compact["h_ms"] == {"": 1}
+
+
+# ---------------------------------------------------------------------------
+# events + trace
+# ---------------------------------------------------------------------------
+
+def test_event_log_records_and_trace_context(tmp_path):
+    path = str(tmp_path / "ev.jsonl")
+    log = events.EventLog(path, component="test")
+    with trace_context("tid-123"):
+        log.emit("thing_happened", n=3)
+    log.emit("other")
+    log.close()
+    recs = events.read_events(path)
+    assert len(recs) == 2
+    assert recs[0]["event"] == "thing_happened"
+    assert recs[0]["trace_id"] == "tid-123" and recs[0]["n"] == 3
+    assert recs[0]["component"] == "test"
+    assert recs[1]["trace_id"] is None
+    assert recs[0]["pid"] == os.getpid()
+    assert isinstance(recs[0]["ts"], float) and recs[0]["mono"] > 0
+    assert events.read_events(path, event="other") == [recs[1]]
+
+
+def test_event_log_directory_mode(tmp_path):
+    """MXNET_TPU_EVENT_LOG pointing at a DIRECTORY gives each process
+    its own events-<pid>.jsonl — the multi-process launch contract."""
+    from mxnet_tpu.telemetry.events import _resolve_path
+    p = _resolve_path(str(tmp_path))
+    assert p == os.path.join(str(tmp_path), f"events-{os.getpid()}.jsonl")
+    assert _resolve_path("/x/y.jsonl") == "/x/y.jsonl"
+
+
+def test_trace_ids_unique_and_scoped():
+    from mxnet_tpu.telemetry import current_trace_id, new_trace_id
+    ids = {new_trace_id() for _ in range(1000)}
+    assert len(ids) == 1000
+    assert current_trace_id() is None
+    with trace_context("outer"):
+        assert current_trace_id() == "outer"
+        with trace_context("inner"):
+            assert current_trace_id() == "inner"
+        assert current_trace_id() == "outer"
+    assert current_trace_id() is None
+
+
+# ---------------------------------------------------------------------------
+# exposition server
+# ---------------------------------------------------------------------------
+
+def test_expo_endpoints_and_health_transitions():
+    reg = MetricsRegistry()
+    reg.counter("up_total").inc(5)
+    health = {"ok": True}
+    srv = TelemetryServer(registry=reg,
+                          healthz_fn=lambda: (health["ok"],
+                                              {"note": "unit"}),
+                          stats_fn=lambda: {"x": 1}, port=0)
+    try:
+        code, body = _get(srv.url("/metrics"))
+        assert code == 200 and parse_prometheus_text(body)["up_total"] == 5
+        code, body = _get(srv.url("/healthz"))
+        assert code == 200 and json.loads(body)["ok"] is True
+        code, body = _get(srv.url("/stats"))
+        assert code == 200 and json.loads(body) == {"x": 1}
+        health["ok"] = False
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(srv.url("/healthz"))
+        assert ei.value.code == 503
+        with pytest.raises(urllib.error.HTTPError) as e2:
+            _get(srv.url("/nope"))
+        assert e2.value.code == 404
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# serving end to end: scrape a live engine, reconcile with the loadgen
+# ---------------------------------------------------------------------------
+
+def test_live_engine_scrape_and_loadgen_reconciliation():
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    from serve_loadgen import run_load
+
+    eng = ServingEngine(StubModel(), bucket_lens=(64,), max_rows=4,
+                        max_queue_depth=256)
+    with eng:
+        srv = eng.expose()
+        code, body = _get(srv.url("/healthz"))
+        assert code == 200 and json.loads(body)["worker_alive"] is True
+        report = run_load(eng, n_clients=6, requests_per_client=8,
+                          min_len=8, max_len=48, vocab=60,
+                          metrics_url=srv.url("/metrics"))
+        # /stats serves the engine snapshot dict, scrapeable
+        code, body = _get(srv.url("/stats"))
+        stats = json.loads(body)
+        assert stats["counters"]["completed"] == 48
+        assert stats["running"] is True
+    assert report["completed"] == 48 and report["errors"] == 0
+    server = report["server"]
+    assert server["reconciled"] is True, server["mismatches"]
+    assert server["requests_total_delta"]["completed"] == 48
+    assert server["requests_total_delta"]["submitted"] == 48
+    assert server["latency"]["p50_ms_est"] is not None
+    # engine.stop() closed the exposition server with it
+    with pytest.raises(Exception):
+        _get(srv.url("/healthz"), timeout=2)
+
+
+def test_request_trace_id_in_event_log_and_chrome_trace(tmp_path):
+    """The acceptance wiring: one request's trace id (minted at
+    submit) is findable in BOTH the structured event log and the
+    Chrome-trace events the profiler dumps."""
+    events.configure(str(tmp_path / "serve.jsonl"))
+    profiler.set_state("run")
+    try:
+        eng = ServingEngine(StubModel(), bucket_lens=(16,), max_rows=2)
+        with eng:
+            fut = eng.submit([1, 2, 3])
+            fut.result(timeout=30)
+            tid = fut.trace_id
+        assert tid and tid.startswith("req")
+    finally:
+        profiler.set_state("stop")
+        log_path = events.get_log().path
+        events.configure(None)
+    recs = events.read_events(log_path)
+    by_event = {r["event"] for r in recs}
+    assert {"engine_start", "engine_stop", "compile_begin", "compile_end",
+            "batch_dispatch"} <= by_event, by_event
+    dispatched = [r for r in recs if r["event"] == "batch_dispatch"]
+    assert any(tid in r["trace_ids"] for r in dispatched)
+    # and the same id rode the contextvar into the profiler span args
+    from mxnet_tpu.profiler import _EVENTS
+    spans = [e for e in _EVENTS
+             if e.get("name") == "serving/forward" and "args" in e]
+    assert any(tid in e["args"].get("trace_id", "") for e in spans), \
+        [e.get("args") for e in spans]
+
+
+def test_shed_and_expiry_events(tmp_path):
+    events.configure(str(tmp_path / "shed.jsonl"))
+    try:
+        eng = ServingEngine(StubModel(), bucket_lens=(8,), max_rows=1)
+        with eng:
+            with pytest.raises(Exception):
+                eng.submit(list(range(9)))       # too long -> shed event
+        log_path = events.get_log().path
+    finally:
+        events.configure(None)
+    shed = events.read_events(log_path, event="request_shed")
+    assert shed and shed[0]["reason"] == "too_long"
+    assert shed[0]["trace_id"].startswith("req")
+
+
+# ---------------------------------------------------------------------------
+# serving stats bridge details
+# ---------------------------------------------------------------------------
+
+def test_submit_validation_does_not_skew_submitted_counter():
+    """An invalid request raises to the caller BEFORE any counter
+    moves, preserving submitted == sum(outcomes) — the invariant the
+    loadgen cross-check reconciles."""
+    eng = ServingEngine(StubModel(), bucket_lens=(16,), max_rows=1)
+    with eng:
+        before = eng.stats.count("submitted")
+        with pytest.raises(ValueError):
+            eng.submit([])                       # empty request
+        with pytest.raises(ValueError):
+            eng.submit([1, 2], token_types=[0])  # length mismatch
+        assert eng.stats.count("submitted") == before
+
+
+def test_serving_stats_window_public_and_reset_preserves():
+    assert ServingStats(128).window == 128
+    eng = ServingEngine(StubModel(), bucket_lens=(16,), max_rows=1,
+                        stats_window=77)
+    assert eng.stats.window == 77
+    eng.reset_stats()
+    assert eng.stats.window == 77
+
+
+def test_compile_cache_and_bucket_counters():
+    reg_hits = REGISTRY.counter("mxnet_tpu_serving_compile_cache_total",
+                                "", ("result",))
+    h0 = reg_hits.labels(result="hit").value
+    m0 = reg_hits.labels(result="miss").value
+    eng = ServingEngine(StubModel(), bucket_lens=(16,), max_rows=1)
+    with eng:
+        eng.infer([1, 2], timeout=30)
+        eng.infer([3, 4], timeout=30)
+        eng.infer([5], timeout=30)
+    assert reg_hits.labels(result="miss").value - m0 >= 1
+    assert reg_hits.labels(result="hit").value - h0 >= 1
+    tokens = REGISTRY.counter("mxnet_tpu_serving_batch_tokens_total",
+                              "", ("bucket",))
+    assert tokens.labels(bucket=16).value > 0
+
+
+# ---------------------------------------------------------------------------
+# kvstore wire: trace id across a real socket + server-side metrics
+# ---------------------------------------------------------------------------
+
+def test_param_server_handles_traced_frames_and_logs(tmp_path):
+    import socket
+
+    from mxnet_tpu.kvstore import _ParameterServer, _recv_msg, _send_msg
+
+    events.configure(str(tmp_path / "srv.jsonl"))
+    try:
+        srv = _ParameterServer("127.0.0.1", 0, num_workers=1)
+        try:
+            port = srv._srv.getsockname()[1]
+            s = socket.create_connection(("127.0.0.1", port), timeout=5)
+            # legacy 3-tuple still served (no trace field)
+            _send_msg(s, ("init", "k", np.full((3,), 2.0, np.float32)))
+            assert _recv_msg(s)[0] == "ok"
+            # 4-tuple: trace id rides the frame
+            _send_msg(s, ("pull", "k", None, "wire-tid-7"))
+            status, arr = _recv_msg(s)
+            assert status == "ok" and np.allclose(arr, 2.0)
+            s.close()
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                handled = events.read_events(
+                    events.get_log().path, event="kvstore_server_handle")
+                if len(handled) >= 2:
+                    break
+                time.sleep(0.05)
+        finally:
+            srv._srv.close()
+    finally:
+        log_path = events.get_log().path
+        events.configure(None)
+    handled = events.read_events(log_path, event="kvstore_server_handle")
+    by_op = {r["op"]: r for r in handled}
+    assert by_op["init"]["trace_id"] is None
+    assert by_op["pull"]["trace_id"] == "wire-tid-7"
+    assert by_op["pull"]["bytes_out"] > 0 and by_op["pull"]["ms"] >= 0
+    # server-side registry families saw the traffic
+    lat = REGISTRY.get("mxnet_tpu_kvstore_server_rpc_ms")
+    assert lat is not None and lat.labels(op="pull").count >= 1
+
+
+@pytest.mark.timeout(600)
+def test_dist_async_trace_id_crosses_processes(tmp_path):
+    """Two REAL processes: the same trace id shows up in the pushing
+    worker's client event log and the server-side log in worker 0's
+    process — the id crossed the wire inside the typed frame."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = ROOT
+    env["MXNET_TPU_EVENT_LOG"] = str(tmp_path)
+    port = 9161 + (os.getpid() % 400)
+    cmd = [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+           "-n", "2", "--launcher", "local", "--port", str(port),
+           sys.executable, os.path.join(ROOT, "tests",
+                                        "dist_async_trace_worker.py")]
+    res = subprocess.run(cmd, env=env, cwd=ROOT, capture_output=True,
+                         text=True, timeout=540)
+    out = res.stdout + res.stderr
+    assert res.returncode == 0, out[-4000:]
+    assert "TRACE_WORKER_0_OK" in out and "TRACE_WORKER_1_OK" in out, \
+        out[-4000:]
+    logs = sorted(tmp_path.glob("events-*.jsonl"))
+    assert len(logs) == 2, logs
+    rpc, handled = [], []
+    for p in logs:
+        rpc += events.read_events(str(p), event="kvstore_rpc")
+        handled += events.read_events(str(p), event="kvstore_server_handle")
+    pushes_sent = [r for r in rpc if r["op"] == "push"
+                   and r["trace_id"] == "trace-golden-push"]
+    pushes_served = [r for r in handled if r["op"] == "push"
+                     and r["trace_id"] == "trace-golden-push"]
+    assert pushes_sent, "client-side push event lost"
+    assert pushes_served, "server-side push event lost"
+    # the two records came from DIFFERENT processes
+    assert pushes_sent[0]["pid"] != pushes_served[0]["pid"]
+    # and byte accounting matches across the wire for that frame
+    assert pushes_sent[0]["bytes_out"] == pushes_served[0]["bytes_in"]
+
+
+# ---------------------------------------------------------------------------
+# telemetry_dump tool
+# ---------------------------------------------------------------------------
+
+def test_telemetry_dump_renders_sources(tmp_path, capsys):
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    import telemetry_dump
+
+    reg = MetricsRegistry()
+    reg.counter("d_total", "x").inc(4)
+    h = reg.histogram("d_ms", "x", buckets=(1.0, 10.0))
+    h.observe(0.5)
+    h.observe(5.0)
+    telemetry_dump.dump_metrics(reg.render_prometheus())
+    out = capsys.readouterr().out
+    assert "d_total" in out and "d_ms" in out and "4" in out
+
+    path = str(tmp_path / "ev.jsonl")
+    log = events.EventLog(path)
+    with trace_context("tid-dump"):
+        log.emit("request_shed", reason="queue_full")
+    log.close()
+    telemetry_dump.dump_events(path)
+    out = capsys.readouterr().out
+    assert "request_shed" in out and "tid-dump" in out
+
+
+# ---------------------------------------------------------------------------
+# the disabled-path cost guard (acceptance criterion)
+# ---------------------------------------------------------------------------
+
+def test_disabled_paths_stay_cheap():
+    """With no exporter attached and no event log configured the
+    instrumented hot paths cost microseconds: stats.bump (serving
+    dispatch) and events.emit (everywhere) must stay far below any
+    measurable effect on a model step. Budgets are ~50x the observed
+    cost so the guard catches regressions (an accidental flush, a
+    render on the hot path), not scheduler noise."""
+    assert events.get_log() is None     # precondition: nothing attached
+    stats = ServingStats(256)
+    n = 20000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        stats.bump("submitted")
+    per_bump = (time.perf_counter() - t0) / n
+    t0 = time.perf_counter()
+    for _ in range(n):
+        stats.total_ms.observe(1.0)
+    per_obs = (time.perf_counter() - t0) / n
+    t0 = time.perf_counter()
+    for _ in range(n):
+        events.emit("noop")
+    per_emit = (time.perf_counter() - t0) / n
+    assert per_bump < 100e-6, f"bump {per_bump * 1e6:.1f}us"
+    assert per_obs < 100e-6, f"observe {per_obs * 1e6:.1f}us"
+    assert per_emit < 20e-6, f"emit {per_emit * 1e6:.1f}us"

@@ -16,9 +16,50 @@ import json
 import time
 
 
+def scrape_metrics(url, timeout=10.0):
+    """GET a /metrics endpoint and parse it into {series: value}."""
+    import urllib.request
+
+    from mxnet_tpu.telemetry import parse_prometheus_text
+
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return parse_prometheus_text(r.read().decode())
+
+
+_SERVER_EVENTS = ("submitted", "completed", "rejected_queue_full",
+                  "rejected_too_long", "rejected_stopped", "expired",
+                  "cancelled", "failed")
+
+
+def _requests_total_delta(before, after):
+    out = {}
+    for ev in _SERVER_EVENTS:
+        key = f'mxnet_tpu_serving_requests_total{{event="{ev}"}}'
+        out[ev] = int(after.get(key, 0.0) - before.get(key, 0.0))
+    return out
+
+
+def cross_check(outcomes, attempts, delta):
+    """Reconcile client-side accounting against the server-observed
+    /metrics deltas — every submit must land in exactly one counter on
+    both sides. Returns (reconciled, mismatches)."""
+    checks = {
+        "submitted": (attempts, delta["submitted"]),
+        "completed": (outcomes["ok"], delta["completed"]),
+        "shed": (outcomes["shed"], delta["rejected_queue_full"]),
+        "expired": (outcomes["expired"], delta["expired"]),
+        "errors": (outcomes["error"],
+                   delta["failed"] + delta["rejected_too_long"]
+                   + delta["rejected_stopped"] + delta["cancelled"]),
+    }
+    mismatches = [f"{name}: client={c} server={s}"
+                  for name, (c, s) in checks.items() if c != s]
+    return not mismatches, mismatches
+
+
 def run_load(engine, n_clients=8, requests_per_client=16,
              min_len=16, max_len=512, vocab=30522, deadline_ms=None,
-             result_timeout_s=600.0, seed=0):
+             result_timeout_s=600.0, seed=0, metrics_url=None):
     """Drive ``engine`` with n_clients closed-loop threads.
 
     Returns a stats dict: client-observed latency percentiles,
@@ -26,12 +67,24 @@ def run_load(engine, n_clients=8, requests_per_client=16,
     valid_tokens_per_sec over the loaded wall-clock window, plus the
     engine's own snapshot (queue depth, packing efficiency,
     compile/compute split).
+
+    With ``metrics_url`` (a ``/metrics`` endpoint, e.g. from
+    ``engine.expose()``), the loadgen also scrapes BEFORE and AFTER
+    the run and cross-checks the server-observed counter deltas
+    against its own client-side accounting (registry counters are
+    process-cumulative, so deltas are the honest comparison). The
+    report then carries a ``server`` section: per-outcome deltas,
+    ``reconciled`` (True when both sides agree request-for-request),
+    and histogram-estimated server-side total-latency percentiles
+    next to the client-observed ones.
     """
     import threading
 
     import numpy as np
 
     from mxnet_tpu.serving import (DeadlineExceededError, QueueFullError)
+
+    before = scrape_metrics(metrics_url) if metrics_url else None
 
     latencies = []          # (client, ms) — list.append is atomic
     outcomes = {"ok": 0, "expired": 0, "shed": 0, "error": 0}
@@ -83,18 +136,39 @@ def run_load(engine, n_clients=8, requests_per_client=16,
         v = nearest_rank(xs, p)
         return None if v is None else round(v, 3)
 
-    return {"clients": n_clients,
-            "requests_per_client": requests_per_client,
-            "wall_s": round(wall, 3),
-            "completed": outcomes["ok"],
-            "expired": outcomes["expired"],
-            "shed": outcomes["shed"],
-            "errors": outcomes["error"],
-            "requests_per_sec": round(outcomes["ok"] / wall, 2) if wall else 0,
-            "valid_tokens_per_sec":
-                round(valid_tokens[0] / wall, 2) if wall else 0,
-            "p50_ms": pct(50), "p95_ms": pct(95), "p99_ms": pct(99),
-            "engine": engine.snapshot()}
+    report = {"clients": n_clients,
+              "requests_per_client": requests_per_client,
+              "wall_s": round(wall, 3),
+              "completed": outcomes["ok"],
+              "expired": outcomes["expired"],
+              "shed": outcomes["shed"],
+              "errors": outcomes["error"],
+              "requests_per_sec":
+                  round(outcomes["ok"] / wall, 2) if wall else 0,
+              "valid_tokens_per_sec":
+                  round(valid_tokens[0] / wall, 2) if wall else 0,
+              "p50_ms": pct(50), "p95_ms": pct(95), "p99_ms": pct(99),
+              "engine": engine.snapshot()}
+    if metrics_url:
+        from mxnet_tpu.telemetry import histogram_quantile
+
+        after = scrape_metrics(metrics_url)
+        delta = _requests_total_delta(before, after)
+        reconciled, mismatches = cross_check(
+            outcomes, n_clients * requests_per_client, delta)
+        # quantiles over the DELTA of the bucket counts: the estimate
+        # covers this load window only, not warmup traffic
+        window = {k: v - before.get(k, 0.0) for k, v in after.items()}
+        est = {f"p{q}_ms_est": (round(v, 3) if v is not None else None)
+               for q in (50, 99)
+               for v in [histogram_quantile(
+                   window, "mxnet_tpu_serving_latency_ms", q,
+                   match={"stage": "total"})]}
+        report["server"] = {"requests_total_delta": delta,
+                            "reconciled": reconciled,
+                            "mismatches": mismatches,
+                            "latency": est}
+    return report
 
 
 def _main():
@@ -119,6 +193,14 @@ def _main():
     ap.add_argument("--heads", type=int, default=4)
     ap.add_argument("--vocab", type=int, default=1000)
     ap.add_argument("--pool", default="mean")
+    ap.add_argument("--expose-port", type=int, default=0,
+                    help="telemetry exposition port (0 = auto); the "
+                    "loadgen scrapes it and cross-checks server vs "
+                    "client accounting")
+    ap.add_argument("--no-expose", action="store_true",
+                    help="skip exposition + scrape cross-check")
+    ap.add_argument("--event-log", default=None,
+                    help="write the structured JSONL run-event log here")
     args = ap.parse_args()
 
     import mxnet_tpu as mx
@@ -131,16 +213,34 @@ def _main():
                     num_heads=args.heads, max_length=args.max_len,
                     dropout=0.0, attention_dropout=0.0, use_pooler=False)
     net.initialize(init=mx.initializer.Normal(0.02))
+    if args.event_log:
+        from mxnet_tpu.telemetry import events
+        events.configure(args.event_log, component="serve_loadgen")
+
     engine = ServingEngine(bert_serving_entry(net), bucket_lens=buckets,
                            max_rows=args.max_rows, pool=args.pool)
     with engine:
+        metrics_url = None
+        if not args.no_expose:
+            srv = engine.expose(port=args.expose_port)
+            metrics_url = srv.url("/metrics")
+            print(f"# telemetry: {srv.url('/metrics')} "
+                  f"{srv.url('/healthz')} {srv.url('/stats')}",
+                  file=sys.stderr)
         engine.warmup()
         report = run_load(engine, n_clients=args.clients,
                           requests_per_client=args.requests,
                           min_len=args.min_len, max_len=args.max_len,
-                          vocab=args.vocab, deadline_ms=args.deadline_ms)
+                          vocab=args.vocab, deadline_ms=args.deadline_ms,
+                          metrics_url=metrics_url)
     print(json.dumps(report, indent=2))
+    if not args.no_expose and not report["server"]["reconciled"]:
+        print("# WARNING: server/client accounting mismatch: "
+              + "; ".join(report["server"]["mismatches"]),
+              file=sys.stderr)
+        return 1
+    return 0
 
 
 if __name__ == "__main__":
-    _main()
+    raise SystemExit(_main())

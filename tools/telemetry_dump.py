@@ -1,0 +1,154 @@
+"""One-screen health summary from any mxnet_tpu telemetry source.
+
+Point it at a live exposition endpoint or an event-log file::
+
+    python tools/telemetry_dump.py http://127.0.0.1:9100/metrics
+    python tools/telemetry_dump.py http://127.0.0.1:9100/stats
+    python tools/telemetry_dump.py run-events.jsonl
+
+/metrics prints nonzero counters, gauges, and per-histogram
+count/mean/p50/p99 estimates (PromQL-style bucket interpolation);
+/stats pretty-prints the JSON; an events file prints counts by event
+type, the trace-id population, and the most recent events. The
+`--healthz` flag probes the sibling /healthz first and sets the exit
+code from it (scriptable liveness checks).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _fetch(url, timeout=10.0):
+    import urllib.request
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.read().decode()
+
+
+def dump_metrics(text, out=sys.stdout):
+    from mxnet_tpu.telemetry import histogram_quantile
+    from mxnet_tpu.telemetry.expo import parse_labels, \
+        parse_prometheus_text
+
+    parsed = parse_prometheus_text(text)
+    kinds = {}          # family name -> kind, from the TYPE comments
+    for line in text.splitlines():
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(None, 3)
+            kinds[name] = kind
+
+    plain, hist_names = [], []
+    for key, val in sorted(parsed.items()):
+        name, labels = parse_labels(key)
+        base = name.rsplit("_bucket", 1)[0].rsplit("_sum", 1)[0] \
+            .rsplit("_count", 1)[0]
+        if kinds.get(base) == "histogram":
+            if base not in hist_names:
+                hist_names.append(base)
+            continue
+        if val:
+            plain.append((key, val))
+
+    if plain:
+        print("-- counters / gauges " + "-" * 38, file=out)
+        for key, val in plain:
+            print(f"  {key:<60} {val:g}", file=out)
+    if hist_names:
+        print("-- histograms (count / mean / ~p50 / ~p99 ms) " + "-" * 13,
+              file=out)
+    for base in hist_names:
+        series = {}     # label-subset string -> (count, sum)
+        for key, val in parsed.items():
+            name, labels = parse_labels(key)
+            if name not in (f"{base}_count", f"{base}_sum"):
+                continue
+            tag = ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+            cnt, tot = series.get(tag, (0.0, 0.0))
+            series[tag] = ((val, tot) if name.endswith("_count")
+                           else (cnt, val))
+        for tag, (cnt, tot) in sorted(series.items()):
+            if not cnt:
+                continue
+            match = dict(p.split("=", 1) for p in tag.split(",") if p)
+            p50 = histogram_quantile(parsed, base, 50, match=match)
+            p99 = histogram_quantile(parsed, base, 99, match=match)
+            label = f"{base}{{{tag}}}" if tag else base
+            print(f"  {label:<52} {int(cnt):>7} {tot / cnt:>9.2f} "
+                  f"{(p50 if p50 is not None else float('nan')):>9.2f} "
+                  f"{(p99 if p99 is not None else float('nan')):>9.2f}",
+                  file=out)
+    if not plain and not hist_names:
+        print("(no samples)", file=out)
+
+
+def dump_events(path, out=sys.stdout, tail=8):
+    from mxnet_tpu.telemetry.events import read_events
+
+    events = read_events(path)
+    if not events:
+        print("(no events)", file=out)
+        return
+    by_type = {}
+    traces = set()
+    for e in events:
+        by_type[e.get("event", "?")] = by_type.get(e.get("event", "?"), 0) + 1
+        tid = e.get("trace_id")
+        if tid:
+            traces.update(str(tid).split(","))
+        for t in e.get("trace_ids") or []:
+            traces.add(str(t))
+    span_s = events[-1].get("mono", 0) - events[0].get("mono", 0)
+    pids = sorted({e.get("pid") for e in events})
+    print(f"-- {len(events)} events over {span_s:.1f}s, pids {pids}, "
+          f"{len(traces)} trace ids " + "-" * 10, file=out)
+    for name, n in sorted(by_type.items(), key=lambda kv: -kv[1]):
+        print(f"  {name:<32} {n}", file=out)
+    print(f"-- last {min(tail, len(events))} " + "-" * 48, file=out)
+    for e in events[-tail:]:
+        extra = {k: v for k, v in e.items()
+                 if k not in ("ts", "mono", "pid", "event", "trace_id")}
+        tid = e.get("trace_id")
+        print(f"  {e.get('event', '?'):<20} "
+              f"{('trace=' + str(tid)[:28]) if tid else '':<36} {extra}",
+              file=out)
+
+
+def main(argv=None):
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("source", help="/metrics URL, /stats URL, or an "
+                    "events JSONL path")
+    ap.add_argument("--healthz", action="store_true",
+                    help="also probe the endpoint's /healthz; exit "
+                    "nonzero when unhealthy")
+    args = ap.parse_args(argv)
+
+    src = args.source
+    rc = 0
+    if src.startswith("http://") or src.startswith("https://"):
+        if args.healthz:
+            base = src.rsplit("/", 1)[0]
+            try:
+                hz = json.loads(_fetch(base + "/healthz"))
+                ok = hz.pop("ok", False)
+            except Exception as e:
+                ok, hz = False, {"error": repr(e)}
+            print(f"healthz: {'OK' if ok else 'UNHEALTHY'} {hz}")
+            rc = 0 if ok else 2
+        body = _fetch(src)
+        if src.rstrip("/").endswith("/stats"):
+            print(json.dumps(json.loads(body), indent=2))
+        else:
+            dump_metrics(body)
+    else:
+        dump_events(src)
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
