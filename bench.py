@@ -154,6 +154,19 @@ def _report(metric, value, unit, vs_baseline, flops_per_step=0.0,
             rec["telemetry"] = REGISTRY.snapshot_compact()
         except Exception:
             pass
+    if "slowest_traces" not in rec:
+        # the tail-sampled span ring's slowest retained traces: when a
+        # leg ran slower than expected, these name the exact requests/
+        # epochs to open with telemetry_dump.py --trace <id>
+        try:
+            from mxnet_tpu.telemetry import spans as _spans
+            slowest = _spans.slowest_traces(3)
+            if slowest:
+                rec["slowest_traces"] = [
+                    {"trace_id": t, "root": r, "ms": d}
+                    for t, r, d in slowest]
+        except Exception:
+            pass
     print(json.dumps(rec))
     sys.stdout.flush()
 
@@ -1202,7 +1215,8 @@ _SUITE = (
 _SUMMARY_KEYS = ("metric", "value", "unit", "mfu", "hbm_frac", "hbm_est",
                  "valid_frac", "valid_tokens_per_sec", "packing_efficiency",
                  "seqlen", "batch", "failed", "causal", "clients",
-                 "p50_ms", "p99_ms", "telemetry_reconciled", "telemetry")
+                 "p50_ms", "p99_ms", "telemetry_reconciled", "telemetry",
+                 "slowest_traces")
 
 
 def _compact(rec):

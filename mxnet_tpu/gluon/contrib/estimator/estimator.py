@@ -55,6 +55,7 @@ class Estimator:
         import time
 
         from ....module.base_module import _fit_telemetry
+        from ....telemetry import spans as _spans
         autograd = self._autograd
         handlers = event_handlers or []
         handlers.append(LoggingHandler())
@@ -63,25 +64,31 @@ class Estimator:
             for m in self.train_metrics:
                 m.reset()
             nbatch = 0
-            for batch in train_data:
-                data, label = batch[0], batch[1]
-                data = data.as_in_context(self.context[0])
-                label = label.as_in_context(self.context[0])
-                t0 = time.perf_counter()
-                with autograd.record():
-                    pred = self.net(data)
-                    loss = self.loss(pred, label)
-                loss.backward()
-                self.trainer.step(data.shape[0])
-                dt = time.perf_counter() - t0
-                step_ms.observe(dt * 1e3)
-                if dt > 0:
-                    samples_per_sec.set(data.shape[0] / dt)
-                for m in self.train_metrics:
-                    m.update([label], [pred])
-                nbatch += 1
-                if batches is not None and nbatch >= batches:
-                    break
+            # per-epoch span (tail-sampled local root) with per-step
+            # children — the same tree shape as Module.fit
+            with _spans.span("fit/epoch", loop="gluon_fit",
+                             epoch=epoch) as ep_span:
+                for batch in train_data:
+                    data, label = batch[0], batch[1]
+                    data = data.as_in_context(self.context[0])
+                    label = label.as_in_context(self.context[0])
+                    t0 = time.perf_counter()
+                    with _spans.span("fit/step", step=nbatch):
+                        with autograd.record():
+                            pred = self.net(data)
+                            loss = self.loss(pred, label)
+                        loss.backward()
+                        self.trainer.step(data.shape[0])
+                    dt = time.perf_counter() - t0
+                    step_ms.observe(dt * 1e3)
+                    if dt > 0:
+                        samples_per_sec.set(data.shape[0] / dt)
+                    for m in self.train_metrics:
+                        m.update([label], [pred])
+                    nbatch += 1
+                    if batches is not None and nbatch >= batches:
+                        break
+                ep_span.set_attr(batches=nbatch)
             for h in handlers:
                 if isinstance(h, LoggingHandler):
                     h.epoch_end(self, epoch)

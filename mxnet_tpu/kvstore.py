@@ -39,6 +39,8 @@ from .ndarray import NDArray
 from .ndarray.ndarray import _wrap
 from .parallel import comm as _allreduce
 from .telemetry import events as _events
+from .telemetry import recorder as _recorder
+from .telemetry import spans as _spans
 from .telemetry.registry import REGISTRY as _REGISTRY
 from .telemetry.trace import (current_trace_id as _current_trace_id,
                               new_trace_id as _new_trace_id)
@@ -414,6 +416,7 @@ class _ParameterServer:
     def __init__(self, host, port, num_workers):
         import socket
         import threading
+        import time as _time
 
         self._store = KVStore("local")
         self._lock = threading.Lock()
@@ -422,6 +425,19 @@ class _ParameterServer:
         self._barrier_count = 0
         self._barrier_cv = threading.Condition()
         self._barrier_gen = 0
+        # watchdog surface: per-connection in-flight handles (thread
+        # ident -> (op, started)) and a last-served heartbeat so a
+        # handle wedged in an optimizer update is detectable; own lock
+        # because handler threads mutate it while the watchdog reads
+        self._inflight = {}
+        self._inflight_lock = threading.Lock()
+        self._last_handle = _time.monotonic()
+        _REGISTRY.gauge(
+            "mxnet_tpu_kvstore_server_last_handle_age_s",
+            "seconds since the parameter server last served an RPC"
+        ).set_function(lambda: _time.monotonic() - self._last_handle)
+        _recorder.install()
+        _recorder.register_probe("kvstore_server", self._watchdog_probe)
         srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         try:
@@ -450,7 +466,22 @@ class _ParameterServer:
             threading.Thread(target=self._serve, args=(conn,),
                              daemon=True).start()
 
+    def _watchdog_probe(self):
+        """Anomaly when any in-flight handle has been running past the
+        stall threshold (an optimizer update or store op wedged)."""
+        import time as _time
+        now = _time.monotonic()
+        stall = _recorder.stall_seconds()
+        with self._inflight_lock:
+            inflight = list(self._inflight.values())
+        for op, started in inflight:
+            if now - started > stall:
+                return {"kind": "kvstore_server_stall", "op": op,
+                        "seconds_in_flight": round(now - started, 3)}
+        return None
+
     def _serve(self, conn):
+        import threading
         import time as _time
         lat, byt = _wire_metrics("server")
         try:
@@ -459,33 +490,63 @@ class _ParameterServer:
                 if sized is None:
                     return
                 msg, nbytes_in = sized
-                if not isinstance(msg, tuple) or len(msg) not in (3, 4):
+                if not isinstance(msg, tuple) or len(msg) not in (3, 4, 5):
                     raise ValueError(
-                        "RPC frame must be (op, key, payload[, trace_id])"
-                        f", got {type(msg).__name__}")
+                        "RPC frame must be (op, key, payload[, trace_id"
+                        f"[, span_id]]), got {type(msg).__name__}")
                 op, key, payload = msg[:3]
                 # trace id rides the frame (4th field) so this handle
-                # correlates with the worker-side rpc event on one push
-                tid = msg[3] if len(msg) == 4 else None
-                t0 = _time.perf_counter()
-                try:
-                    reply = ("ok", self._handle(op, key, payload))
-                except (ConnectionError, EOFError, OSError):
-                    raise
-                except Exception as e:  # reply, don't kill the server
-                    import traceback
-                    reply = ("err", f"{e!r}\n"
-                             f"{traceback.format_exc(limit=5)}")
-                nbytes_out = _send_msg(conn, reply)
-                ms = (_time.perf_counter() - t0) * 1e3
+                # correlates with the worker-side rpc event on one
+                # push; the 5th field (new) is the worker's RPC span
+                # id, which this handle span parents under — a
+                # cross-process span tree on one trace
+                tid = msg[3] if len(msg) >= 4 else None
+                remote_span = msg[4] if len(msg) >= 5 else None
                 opname = op if isinstance(op, str) else "?"
+                t0 = _time.perf_counter()
+                handle_span = _spans.start_span(
+                    f"kvstore/server/{opname}", trace_id=tid,
+                    parent_id=remote_span, local_root=True,
+                    attrs={"op": opname, "key": key,
+                           "bytes_in": nbytes_in})
+                me = threading.get_ident()
+                with self._inflight_lock:
+                    self._inflight[me] = (opname, _time.monotonic())
+                try:
+                    with _spans.use_span(handle_span):
+                        try:
+                            reply = ("ok", self._handle(op, key, payload))
+                        except (ConnectionError, EOFError, OSError):
+                            raise
+                        except Exception as e:  # reply, don't kill the
+                            import traceback    # server
+                            reply = ("err", f"{e!r}\n"
+                                     f"{traceback.format_exc(limit=5)}")
+                    nbytes_out = _send_msg(conn, reply)
+                    handle_span.end(status="ok" if reply[0] == "ok"
+                                    else "error",
+                                    error=None if reply[0] == "ok"
+                                    else str(reply[1])[:200])
+                finally:
+                    with self._inflight_lock:
+                        self._inflight.pop(me, None)
+                    self._last_handle = _time.monotonic()
+                    # end() is idempotent (first end wins): on success
+                    # the real status was already recorded above and
+                    # this is a no-op; it only closes the span when
+                    # handle/send blew up, so a dropped connection
+                    # can't pin the trace's active buffer with an open
+                    # local root forever
+                    handle_span.end(error="connection lost mid-handle")
+                ms = (_time.perf_counter() - t0) * 1e3
                 lat.labels(op=opname).observe(ms)
                 byt.labels(op=opname, direction="in").inc(nbytes_in)
                 byt.labels(op=opname, direction="out").inc(nbytes_out)
                 _events.emit("kvstore_server_handle", op=opname, key=key,
                              ms=round(ms, 3), bytes_in=nbytes_in,
                              bytes_out=nbytes_out, ok=reply[0] == "ok",
-                             trace_id=tid)
+                             trace_id=tid, span_id=handle_span.span_id,
+                             parent_span_id=remote_span)
         except (ConnectionError, EOFError, OSError):
             return
         except (ValueError, MXNetError) as e:
@@ -515,7 +576,14 @@ class _ParameterServer:
             return None
         if op == "push":
             with self._lock:
-                self._store.push(key, _ndmod.array(payload, ctx=_cpu(0)))
+                # the server-side optimizer update is the dist_async
+                # hot path; its span parents under this handle (which
+                # parents under the worker's RPC span across the wire)
+                with _spans.span("kvstore/server/optimizer_update",
+                                 key=key,
+                                 updater=self._store._updater is not None):
+                    self._store.push(key,
+                                     _ndmod.array(payload, ctx=_cpu(0)))
             return None
         if op == "pull":
             with self._lock:
@@ -830,7 +898,11 @@ class AsyncDistKVStore(KVStore):
         self._wire_metrics = _wire_metrics("client")
         self._sent_optattrs = {}
         self._sock = None
+        self._rpc_inflight = None      # (op, monotonic started) or None
         if self._n > 1:
+            _recorder.install()
+            _recorder.register_probe(f"kvstore_worker_{self._rank}",
+                                     self._rpc_watchdog_probe)
             deadline = _time.monotonic() + 60.0
             last = None
             while _time.monotonic() < deadline:
@@ -869,50 +941,77 @@ class AsyncDistKVStore(KVStore):
             ok = ok and srv_up
         return ok, detail
 
+    def _rpc_watchdog_probe(self):
+        """Anomaly when one RPC has been in flight past the stall
+        threshold — the server stopped answering (stale heartbeat from
+        this worker's point of view)."""
+        import time as _time
+        inflight = self._rpc_inflight
+        if inflight is None:
+            return None
+        op, started = inflight
+        waited = _time.monotonic() - started
+        if waited > _recorder.stall_seconds():
+            return {"kind": "kvstore_rpc_stall", "op": op,
+                    "rank": self._rank,
+                    "seconds_in_flight": round(waited, 3)}
+        return None
+
     def _rpc(self, op, key, payload=None):
         import time as _time
         # the active trace id (a serving request, a Trainer step's
         # scope) rides the frame; an RPC outside any context mints its
-        # own so worker- and server-side logs still correlate
-        tid = _current_trace_id() or _new_trace_id("kv")
-        t0 = _time.perf_counter()
-        with self._rpc_lock:
-            # read + check the socket INSIDE the lock: a concurrent
-            # RPC that lost the connection nulls it, and a waiter must
-            # see MXNetError, not _send_msg(None) blowing up
-            sock = self._sock
-            if sock is None:
-                raise MXNetError(
-                    "dist_async parameter server connection is down "
-                    f"(lost on an earlier RPC); cannot send {op!r}")
-            try:
-                nbytes_out = _send_msg(sock, (op, key, payload, tid))
-                sized = _recv_msg_sized(sock)
-            except OSError:
-                self._sock = None       # /healthz must see the loss
-                raise
+        # own so worker- and server-side logs still correlate. The RPC
+        # span's id rides as the 5th frame field — the server's handle
+        # span parents under it, one tree across two processes.
+        with _spans.span(f"kvstore/rpc/{op}", op=op, key=key,
+                         rank=self._rank) as sp:
+            tid = _current_trace_id() or sp.trace_id \
+                or _new_trace_id("kv")
+            t0 = _time.perf_counter()
+            with self._rpc_lock:
+                # read + check the socket INSIDE the lock: a concurrent
+                # RPC that lost the connection nulls it, and a waiter
+                # must see MXNetError, not _send_msg(None) blowing up
+                sock = self._sock
+                if sock is None:
+                    raise MXNetError(
+                        "dist_async parameter server connection is down "
+                        f"(lost on an earlier RPC); cannot send {op!r}")
+                self._rpc_inflight = (op, _time.monotonic())
+                try:
+                    nbytes_out = _send_msg(
+                        sock, (op, key, payload, tid, sp.span_id))
+                    sized = _recv_msg_sized(sock)
+                except OSError:
+                    self._sock = None   # /healthz must see the loss
+                    raise
+                finally:
+                    self._rpc_inflight = None
+                if sized is None:
+                    # half-closed peer: mark the connection dead so
+                    # liveness probes (and later RPCs) report it
+                    # instead of a live sock
+                    self._sock = None
             if sized is None:
-                # half-closed peer: mark the connection dead so
-                # liveness probes (and later RPCs) report it instead
-                # of a live sock
-                self._sock = None
-        if sized is None:
-            raise MXNetError(
-                "dist_async parameter server connection lost (worker 0's "
-                f"process gone?) during {op!r}")
-        reply, nbytes_in = sized
-        ms = (_time.perf_counter() - t0) * 1e3
-        lat, byt = self._wire_metrics
-        lat.labels(op=op).observe(ms)
-        byt.labels(op=op, direction="out").inc(nbytes_out)
-        byt.labels(op=op, direction="in").inc(nbytes_in)
-        _events.emit("kvstore_rpc", op=op, key=key, ms=round(ms, 3),
-                     bytes_out=nbytes_out, bytes_in=nbytes_in,
-                     rank=self._rank, trace_id=tid)
-        status, out = reply
-        if status != "ok":
-            raise MXNetError(f"dist_async server error: {out}")
-        return out
+                raise MXNetError(
+                    "dist_async parameter server connection lost "
+                    f"(worker 0's process gone?) during {op!r}")
+            reply, nbytes_in = sized
+            ms = (_time.perf_counter() - t0) * 1e3
+            sp.set_attr(bytes_out=nbytes_out, bytes_in=nbytes_in)
+            lat, byt = self._wire_metrics
+            lat.labels(op=op).observe(ms)
+            byt.labels(op=op, direction="out").inc(nbytes_out)
+            byt.labels(op=op, direction="in").inc(nbytes_in)
+            _events.emit("kvstore_rpc", op=op, key=key, ms=round(ms, 3),
+                         bytes_out=nbytes_out, bytes_in=nbytes_in,
+                         rank=self._rank, trace_id=tid,
+                         span_id=sp.span_id)
+            status, out = reply
+            if status != "ok":
+                raise MXNetError(f"dist_async server error: {out}")
+            return out
 
     def init(self, key, value):
         if self._n <= 1:

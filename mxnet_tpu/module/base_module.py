@@ -9,6 +9,7 @@ from ..base import MXNetError
 from .. import metric as _metric
 from ..model import BatchEndParam
 from ..telemetry import events as _events
+from ..telemetry import spans as _spans
 from ..telemetry.registry import REGISTRY as _REGISTRY
 
 __all__ = ["BaseModule"]
@@ -155,33 +156,42 @@ class BaseModule:
             nbatch = 0
             nsample = 0
             train_data.reset()
-            for data_batch in train_data:
-                if monitor is not None:
-                    monitor.tic()
-                t0 = time.perf_counter()
-                self.forward_backward(data_batch)
-                self.update()
-                # host wall of the dispatch; under async execution the
-                # device backpressure folds in over steady-state steps
-                dt = time.perf_counter() - t0
-                step_ms.observe(dt * 1e3)
-                try:
-                    bsz = data_batch.data[0].shape[0]
-                except (AttributeError, IndexError, TypeError):
-                    bsz = 0
-                if bsz and dt > 0:
-                    samples_per_sec.set(bsz / dt)
-                    nsample += bsz
-                self.update_metric(eval_metric, data_batch.label)
-                if monitor is not None:
-                    monitor.toc_print()
-                if batch_end_callback is not None:
-                    param = BatchEndParam(epoch=epoch, nbatch=nbatch,
-                                          eval_metric=eval_metric,
-                                          locals=locals())
-                    for cb in _as_list(batch_end_callback):
-                        cb(param)
-                nbatch += 1
+            # the epoch span is a tail-sampled local root (epochs are
+            # slow, so they're kept); per-step child spans decompose
+            # where the epoch went, capped by the recorder's
+            # max-spans-per-trace bound
+            with _spans.span("fit/epoch", loop="module_fit",
+                             epoch=epoch) as _ep:
+                for data_batch in train_data:
+                    if monitor is not None:
+                        monitor.tic()
+                    t0 = time.perf_counter()
+                    with _spans.span("fit/step", step=nbatch):
+                        self.forward_backward(data_batch)
+                        self.update()
+                    # host wall of the dispatch; under async execution
+                    # the device backpressure folds in over
+                    # steady-state steps
+                    dt = time.perf_counter() - t0
+                    step_ms.observe(dt * 1e3)
+                    try:
+                        bsz = data_batch.data[0].shape[0]
+                    except (AttributeError, IndexError, TypeError):
+                        bsz = 0
+                    if bsz and dt > 0:
+                        samples_per_sec.set(bsz / dt)
+                        nsample += bsz
+                    self.update_metric(eval_metric, data_batch.label)
+                    if monitor is not None:
+                        monitor.toc_print()
+                    if batch_end_callback is not None:
+                        param = BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                              eval_metric=eval_metric,
+                                              locals=locals())
+                        for cb in _as_list(batch_end_callback):
+                            cb(param)
+                    nbatch += 1
+                _ep.set_attr(batches=nbatch, samples=nsample)
 
             for name, val in eval_metric.get_name_value():
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)

@@ -145,9 +145,17 @@ def record_op(name, begin_us, end_us, category="operator", args=None):
 
 
 def dump(finished=True, profile_process="worker"):
-    """Write Chrome trace-event JSON to the configured filename."""
+    """Write Chrome trace-event JSON to the configured filename.
+
+    The telemetry span ring (kept tail-sampled traces + in-flight
+    spans) merges into the same stream — span and op events share one
+    perf_counter microsecond axis, so chrome://tracing shows a slow
+    request's queue/pack/forward spans next to the op timeline."""
+    from .telemetry import spans as _spans
+    span_events = _spans.export_chrome_events()
     with _LOCK:
-        payload = {"traceEvents": list(_EVENTS), "displayTimeUnit": "ms"}
+        payload = {"traceEvents": list(_EVENTS) + span_events,
+                   "displayTimeUnit": "ms"}
         with open(_CONFIG["filename"], "w") as f:
             json.dump(payload, f)
         if finished:

@@ -31,6 +31,8 @@ from .. import autograd, profiler
 from .. import ndarray as nd
 from ..context import current_context
 from ..telemetry import events as _events
+from ..telemetry import recorder as _recorder
+from ..telemetry import spans as _spans
 from ..telemetry.registry import REGISTRY as _REGISTRY
 from ..telemetry.trace import trace_context as _trace_context
 from .batcher import ContinuousBatcher
@@ -122,6 +124,12 @@ class ServingEngine:
         self._abort = False
         self._started = False
         self._lock = threading.Lock()
+        # watchdog surface: the worker loop beats every iteration, so
+        # a beat that stops while running means a wedged forward (or a
+        # deadlocked drain) — exactly what the stall probe reports
+        self._beat = time.monotonic()
+        self._last_dispatch = self._beat
+        self._probe_name = f"serving_engine_{id(self):x}"
 
     # -- lifecycle ---------------------------------------------------------
     def start(self):
@@ -131,10 +139,16 @@ class ServingEngine:
             if self._queue.closed:
                 raise EngineStoppedError("engine cannot be restarted")
             self._started = True
+            self._beat = time.monotonic()
+            self._last_dispatch = self._beat
             self._worker = threading.Thread(target=self._run,
                                             name="mxnet_tpu_serving",
                                             daemon=True)
             self._worker.start()
+        # a serving process should be able to explain its own death:
+        # flight-recorder crash hooks + the stall watchdog ride along
+        _recorder.install()
+        _recorder.register_probe(self._probe_name, self._watchdog_probe)
         _events.emit("engine_start",
                      bucket_lens=list(self._batcher.bucket_lens),
                      max_rows=self._batcher.max_rows)
@@ -146,6 +160,7 @@ class ServingEngine:
         :class:`EngineStoppedError` (counted ``cancelled``)."""
         _events.emit("engine_abort" if not drain else "engine_stop",
                      drain=drain)
+        _recorder.unregister_probe(self._probe_name)
         with self._lock:
             self._queue.close()
             if not drain:
@@ -161,6 +176,7 @@ class ServingEngine:
         # server closes either way so the port never leaks
         for r in self._queue.drain_all():
             self.stats.bump("cancelled")
+            r.span.end(error="cancelled: engine stopped")
             r.future.set_exception(
                 EngineStoppedError("engine stopped before request ran"))
         # release the registry's queue-depth closure (it would pin this
@@ -204,11 +220,14 @@ class ServingEngine:
         self.stats.bump("submitted")
         if not self._started or self._queue.closed:
             self.stats.bump("rejected_stopped")
+            req.span.end(error="rejected: engine not running")
             raise EngineStoppedError("serving engine is not running")
         if len(req) > self._batcher.max_len:
             self.stats.bump("rejected_too_long")
             _events.emit("request_shed", reason="too_long",
                          trace_id=req.trace_id, tokens=len(req))
+            req.span.set_attr(shed="too_long").force_keep() \
+               .end(error="shed: too_long")
             raise RequestTooLongError(
                 f"request of {len(req)} tokens exceeds the largest row "
                 f"bucket ({self._batcher.max_len})")
@@ -216,11 +235,15 @@ class ServingEngine:
             self._queue.put(req)
         except ServingError as e:
             full = not self._queue.closed
+            reason = "queue_full" if full else "stopped"
             self.stats.bump("rejected_queue_full"
                             if full else "rejected_stopped")
-            _events.emit("request_shed",
-                         reason="queue_full" if full else "stopped",
+            _events.emit("request_shed", reason=reason,
                          trace_id=req.trace_id, tokens=len(req))
+            # shed traces are tail-sampling KEEPs by contract: the
+            # operator debugging overload wants exactly these
+            req.span.set_attr(shed=reason).force_keep() \
+               .end(error=f"shed: {reason}")
             raise e
         return req.future
 
@@ -296,10 +319,34 @@ class ServingEngine:
         out["max_rows"] = self._batcher.max_rows
         return out
 
+    # -- watchdog ----------------------------------------------------------
+    def _watchdog_probe(self):
+        """None while healthy; an anomaly dict when the worker loop
+        stopped beating (wedged forward) or the queue sits saturated
+        with no dispatch progressing."""
+        if not self.running:
+            return None
+        now = time.monotonic()
+        stall = _recorder.stall_seconds()
+        since_beat = now - self._beat
+        if since_beat > stall:
+            return {"kind": "serving_worker_stall",
+                    "seconds_since_beat": round(since_beat, 3),
+                    "queue_depth": len(self._queue)}
+        depth = len(self._queue)
+        if (depth >= self._queue.max_depth
+                and now - self._last_dispatch > stall):
+            return {"kind": "serving_queue_saturated",
+                    "queue_depth": depth,
+                    "seconds_since_dispatch": round(
+                        now - self._last_dispatch, 3)}
+        return None
+
     # -- worker ------------------------------------------------------------
     def _run(self):
         carry = []
         while True:
+            self._beat = time.monotonic()
             if self._abort:
                 self._fail(carry, EngineStoppedError(
                     "engine stopped before request ran"), "cancelled")
@@ -327,6 +374,8 @@ class ServingEngine:
                     _events.emit("request_expired", trace_id=r.trace_id,
                                  waited_ms=round((now - r.t_submit) * 1e3,
                                                  3))
+                    self._queue_span(r)
+                    r.span.end(error="deadline exceeded before dispatch")
                     r.future.set_exception(DeadlineExceededError(
                         f"request {r.id} deadline exceeded before "
                         "dispatch"))
@@ -339,13 +388,14 @@ class ServingEngine:
                 with _trace_context(_join_trace_ids(live)):
                     with profiler.Scope("serving/pack"):
                         plan, carry = self._batcher.plan(live)
-                self.stats.pack_ms.observe((time.perf_counter() - t0) * 1e3)
+                pack_t1 = time.perf_counter()
+                self.stats.pack_ms.observe((pack_t1 - t0) * 1e3)
             except Exception as e:  # packing failure: fail this drain
                 self._fail(live, e, "failed")
                 carry = []
                 continue
             try:
-                self._dispatch(plan)
+                self._dispatch(plan, pack_interval=(t0, pack_t1))
             except Exception as e:  # model failure: fail ONLY the
                 # dispatched batch's unfulfilled requests and keep
                 # serving — carry was never in this batch and gets its
@@ -357,9 +407,19 @@ class ServingEngine:
     def _fail(self, requests, exc, counter):
         for r in requests:
             self.stats.bump(counter)
+            r.span.end(error=repr(exc))
             r.future.set_exception(exc)
 
-    def _dispatch(self, plan):
+    @staticmethod
+    def _queue_span(req):
+        """Synthesized queue-wait child span (submit → drain)."""
+        if req.t_drain is not None and req.span.span_id is not None:
+            _spans.record_span("serving/queue", req.trace_id,
+                               parent_id=req.span.span_id,
+                               mono_start=req.t_submit,
+                               mono_end=req.t_drain)
+
+    def _dispatch(self, plan, pack_interval=None):
         shape = (plan.rows, plan.row_len)
         hit = shape in self._seen_shapes
         self._compile_cache.labels(result="hit" if hit else "miss").inc()
@@ -368,7 +428,8 @@ class ServingEngine:
                          row_len=plan.row_len)
         t0 = time.perf_counter()
         seq = self._forward(plan)
-        dt_ms = (time.perf_counter() - t0) * 1e3
+        t1 = time.perf_counter()
+        dt_ms = (t1 - t0) * 1e3
         if hit:
             self.stats.compute_ms.observe(dt_ms)
         else:
@@ -388,20 +449,48 @@ class ServingEngine:
                      row_len=plan.row_len, requests=len(plan.entries),
                      valid_tokens=plan.valid_tokens, ms=round(dt_ms, 3),
                      trace_ids=[r.trace_id for r, _ in plan.entries])
+        self._last_dispatch = time.monotonic()
         now = time.monotonic()
+        # per-request span trees: batch stages (pack, compile/forward)
+        # time ONCE, but every member request's tree shows them — the
+        # acceptance shape submit → queue → pack → compile/forward →
+        # complete under one trace id
+        fwd_name = "serving/forward" if hit else "serving/compile"
+        fwd_attrs = {"rows": plan.rows, "row_len": plan.row_len,
+                     "requests": len(plan.entries), "compiled": not hit}
         for req, pl in plan.entries:
+            record_spans = req.span.span_id is not None
+            if record_spans:
+                self._queue_span(req)
+                if pack_interval is not None:
+                    _spans.record_span(
+                        "serving/pack", req.trace_id,
+                        parent_id=req.span.span_id,
+                        start_us=int(pack_interval[0] * 1e6),
+                        end_us=int(pack_interval[1] * 1e6))
+                _spans.record_span(fwd_name, req.trace_id,
+                                   parent_id=req.span.span_id,
+                                   start_us=int(t0 * 1e6),
+                                   end_us=int(t1 * 1e6),
+                                   attrs=fwd_attrs)
             try:
                 out = self._pool(
                     seq[pl.row, pl.offset:pl.offset + pl.length], req)
             except Exception as e:  # a bad pool callable fails ITS
                 # request, not the rest of the batch
                 self.stats.bump("failed")
+                req.span.end(error=repr(e))
                 req.future.set_exception(e)
                 continue
             req.t_done = now
             self.stats.queue_ms.observe((req.t_drain - req.t_submit) * 1e3)
             self.stats.total_ms.observe((now - req.t_submit) * 1e3)
             self.stats.bump("completed")
+            if record_spans:
+                _spans.record_span("serving/complete", req.trace_id,
+                                   parent_id=req.span.span_id,
+                                   start_us=int(t1 * 1e6))
+            req.span.end()
             req.future.set_result(out)
 
     def _forward(self, plan):

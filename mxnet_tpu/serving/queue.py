@@ -19,6 +19,7 @@ from collections import deque
 import numpy as np
 
 from ..base import MXNetError
+from ..telemetry import spans as _spans
 from ..telemetry.trace import new_trace_id
 
 __all__ = ["ServingError", "QueueFullError", "DeadlineExceededError",
@@ -102,10 +103,16 @@ class Request:
     via the telemetry contextvar, gets stamped into profiler
     Chrome-trace/xprof spans, and names the request in the structured
     event log — ``id`` stays the cheap in-process ordinal.
+
+    ``span`` is the request's ROOT span (``serving/request``): started
+    here, ended by the engine at complete/fail/shed — its duration is
+    the tail-sampling input, so only slow/errored/shed requests retain
+    their full queue→pack→forward span trees.
     """
 
-    __slots__ = ("id", "trace_id", "tokens", "token_types", "deadline",
-                 "future", "t_submit", "t_drain", "t_dispatch", "t_done")
+    __slots__ = ("id", "trace_id", "span", "tokens", "token_types",
+                 "deadline", "future", "t_submit", "t_drain",
+                 "t_dispatch", "t_done")
 
     def __init__(self, tokens, token_types=None, deadline_ms=None):
         self.id = next(_req_ids)
@@ -121,6 +128,9 @@ class Request:
                     f"length {self.tokens.size}")
         self.token_types = token_types
         self.t_submit = time.monotonic()
+        self.span = _spans.start_span(
+            "serving/request", trace_id=self.trace_id,
+            attrs={"tokens": int(self.tokens.size)}, local_root=True)
         self.deadline = (self.t_submit + deadline_ms / 1e3
                          if deadline_ms is not None else None)
         self.future = InferenceFuture()

@@ -16,30 +16,47 @@ the process starting here). Four pieces:
 - :mod:`.trace` — trace-id propagation: minted at
   ``ServingEngine.submit``, rides a contextvar into profiler spans,
   and crosses the dist_async wire so both processes' event logs
-  correlate on the same push.
+  correlate on the same push;
+- :mod:`.spans` — hierarchical spans over those trace ids (Dapper
+  lineage): a bounded ring of tail-sampled traces (slow/errored/shed
+  kept in full, the rest counted and dropped), served at ``/traces``
+  + ``/traces/<id>`` and merged into ``profiler.dump()``'s
+  Chrome-trace stream;
+- :mod:`.recorder` — flight recorder + stall watchdog: recent-event
+  ring, post-mortem bundles (spans + registry snapshot + all-thread
+  stacks) on watchdog trip / crash / SIGUSR2.
 
 Quickstart::
 
     from mxnet_tpu import telemetry
 
     srv = engine.expose(port=9100)        # ServingEngine exposition
-    # curl :9100/metrics | :9100/healthz | :9100/stats
+    # curl :9100/metrics | :9100/healthz | :9100/stats | :9100/traces
 
     telemetry.events.configure("run-events.jsonl")
     c = telemetry.REGISTRY.counter("my_total", "things", ("kind",))
     c.labels(kind="good").inc()
+
+    with telemetry.span("my/stage", shard=3):   # nested spans
+        ...
 """
-from . import events, expo, trace
+from . import events, expo, recorder, spans, trace
 from .events import EventLog
 from .expo import (TelemetryServer, histogram_quantile,
                    parse_prometheus_text, start_server)
 from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
                        REGISTRY, DEFAULT_MS_BUCKETS)
+from .spans import (Span, current_span, current_span_id, get_trace,
+                    record_span, span, start_span, traces_summary,
+                    use_span)
 from .trace import (current_trace_id, new_trace_id, set_trace_id,
                     trace_context)
 
 __all__ = ["REGISTRY", "MetricsRegistry", "Counter", "Gauge", "Histogram",
            "DEFAULT_MS_BUCKETS", "TelemetryServer", "start_server",
            "parse_prometheus_text", "histogram_quantile", "EventLog",
-           "events", "expo", "trace", "new_trace_id", "current_trace_id",
-           "set_trace_id", "trace_context"]
+           "events", "expo", "trace", "spans", "recorder",
+           "new_trace_id", "current_trace_id", "set_trace_id",
+           "trace_context", "Span", "span", "start_span", "record_span",
+           "use_span", "current_span", "current_span_id",
+           "traces_summary", "get_trace"]

@@ -10,7 +10,12 @@ dependencies, daemon threads — never blocks process exit):
   ``{"ok": true}`` when nothing registered a check (process is up);
 - ``/stats``    — the attached component's JSON stats dict (a
   ``ServingEngine.snapshot()`` made scrapeable), falling back to the
-  registry snapshot.
+  registry snapshot;
+- ``/traces``   — tail-sampled trace summaries from the process span
+  ring (:mod:`.spans`), slowest first, plus drop accounting;
+- ``/traces/<id>`` — one trace's full span list (kept ring first,
+  then in-flight partials), 404 when the id was dropped or never
+  seen.
 
 Attach points: ``ServingEngine.expose(port)`` and
 ``kvstore.expose_telemetry(kv, port)`` construct one of these; scripts
@@ -107,9 +112,27 @@ class TelemetryServer:
                             json.dumps({"error": repr(e)}).encode())
                 return
             self._reply(handler, 200, "application/json", body)
+        elif path == "/traces" or path.startswith("/traces/"):
+            from urllib.parse import unquote
+
+            from . import spans as _spans
+            if path == "/traces" or path == "/traces/":
+                body = json.dumps(_spans.traces_summary(),
+                                  default=str).encode()
+                self._reply(handler, 200, "application/json", body)
+                return
+            tid = unquote(path[len("/traces/"):])
+            trace = _spans.get_trace(tid)
+            if trace is None:
+                self._reply(handler, 404, "application/json",
+                            json.dumps({"error": "unknown trace",
+                                        "trace_id": tid}).encode())
+                return
+            self._reply(handler, 200, "application/json",
+                        json.dumps(trace, default=str).encode())
         else:
             self._reply(handler, 404, "text/plain",
-                        b"try /metrics, /healthz or /stats\n")
+                        b"try /metrics, /healthz, /stats or /traces\n")
 
     @staticmethod
     def _reply(handler, code, ctype, body):

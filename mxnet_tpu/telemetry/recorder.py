@@ -1,0 +1,303 @@
+"""Flight recorder + stall watchdog: crash-time self-diagnosis.
+
+When a serving worker loop wedges mid-forward, a dist_async server
+hangs in an optimizer update, or the process dies on an unhandled
+exception, stderr alone says nothing about WHERE the time went. This
+module keeps a bounded in-memory ring of recent run events (a tap on
+:mod:`.events`), and on demand — watchdog trip, unhandled crash,
+``SIGUSR2`` — dumps a post-mortem bundle for offline triage::
+
+    <MXNET_TPU_FLIGHT_DIR or ./mxnet_tpu_flight>/<utc>-<pid>-<reason>/
+        meta.json      reason, pid, argv, wall/mono stamps
+        spans.json     kept + in-flight traces from the span ring
+        events.jsonl   recent structured events (newest last)
+        metrics.json   full registry snapshot
+        threads.txt    stack trace of every live thread
+
+The WATCHDOG is one daemon thread polling registered probes (a probe
+returns None when healthy, or an anomaly dict). Subsystems register
+their own: the serving engine reports a stalled worker loop and a
+saturated-queue-with-no-dispatch; the dist_async worker reports an RPC
+stuck in flight; the parameter server reports a stalled handle. A trip
+emits a ``watchdog_anomaly`` event, bumps
+``mxnet_tpu_watchdog_anomalies_total{kind=...}``, and dumps a bundle
+(rate-limited per reason so a persistent stall can't fill the disk).
+
+Env knobs: ``MXNET_TPU_FLIGHT_DIR`` (bundle root),
+``MXNET_TPU_WATCHDOG=0`` (disable the thread),
+``MXNET_TPU_WATCHDOG_INTERVAL_S`` (poll period, default 5),
+``MXNET_TPU_WATCHDOG_STALL_S`` (stall threshold probes share,
+default 30).
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import sys
+import threading
+import time
+import traceback
+from collections import deque
+
+from . import events as _events
+from . import spans as _spans
+from .registry import REGISTRY
+
+__all__ = ["FlightRecorder", "RECORDER", "install", "dump",
+           "register_probe", "unregister_probe", "configure",
+           "stall_seconds", "watchdog"]
+
+_dump_seq = itertools.count()
+
+_config = {
+    "interval_s": float(os.environ.get("MXNET_TPU_WATCHDOG_INTERVAL_S", 5.0)),
+    "stall_s": float(os.environ.get("MXNET_TPU_WATCHDOG_STALL_S", 30.0)),
+    "min_dump_interval_s": 60.0,
+    "recent_events": 512,
+}
+
+
+def stall_seconds():
+    """The shared stall threshold watchdog probes compare against."""
+    return _config["stall_s"]
+
+
+def _thread_stacks():
+    """Every live thread's current stack, formatted for threads.txt."""
+    frames = sys._current_frames()
+    lines = []
+    for t in threading.enumerate():
+        lines.append(f"--- thread {t.name} (ident={t.ident}, "
+                     f"daemon={t.daemon}, alive={t.is_alive()}) ---")
+        frame = frames.get(t.ident)
+        if frame is None:
+            lines.append("  <no frame>")
+        else:
+            lines.extend(l.rstrip("\n")
+                         for l in traceback.format_stack(frame))
+        lines.append("")
+    return "\n".join(lines)
+
+
+class FlightRecorder:
+    """Recent-history ring + post-mortem bundle writer."""
+
+    def __init__(self, out_dir=None):
+        self._out_dir = out_dir
+        self._recent = deque(maxlen=_config["recent_events"])
+        self._lock = threading.Lock()
+        self._last_dump = {}            # reason -> monotonic stamp
+        self._installed = False
+        self._prev_excepthook = None
+        self._prev_threading_hook = None
+
+    @property
+    def out_dir(self):
+        return (self._out_dir
+                or os.environ.get("MXNET_TPU_FLIGHT_DIR")
+                or os.path.join(os.getcwd(), "mxnet_tpu_flight"))
+
+    # -- event tap ---------------------------------------------------------
+    def _tap(self, rec):
+        self._recent.append(rec)        # deque.append is atomic
+
+    def recent_events(self):
+        return list(self._recent)
+
+    # -- install -----------------------------------------------------------
+    def install(self, sigusr2=True, excepthook=True):
+        """Attach the event tap + crash hooks (idempotent). SIGUSR2
+        installation silently degrades off the main thread / platforms
+        without the signal."""
+        with self._lock:
+            if self._installed:
+                return self
+            self._installed = True
+        _events.add_tap(self._tap)
+        if excepthook:
+            self._prev_excepthook = sys.excepthook
+
+            def _hook(exc_type, exc, tb):
+                try:
+                    self.dump("crash", extra={
+                        "exception": "".join(traceback.format_exception(
+                            exc_type, exc, tb))[-8000:]})
+                except Exception:
+                    pass
+                (self._prev_excepthook or sys.__excepthook__)(
+                    exc_type, exc, tb)
+
+            sys.excepthook = _hook
+            self._prev_threading_hook = threading.excepthook
+
+            def _thook(args):
+                try:
+                    self.dump("thread_crash", extra={
+                        "thread": getattr(args.thread, "name", "?"),
+                        "exception": "".join(traceback.format_exception(
+                            args.exc_type, args.exc_value,
+                            args.exc_traceback))[-8000:]})
+                except Exception:
+                    pass
+                if self._prev_threading_hook is not None:
+                    self._prev_threading_hook(args)
+
+            threading.excepthook = _thook
+        if sigusr2:
+            try:
+                import signal
+                signal.signal(signal.SIGUSR2,
+                              lambda signo, frame:
+                              self.dump("sigusr2", min_interval_s=0.0))
+            except (ValueError, OSError, AttributeError):
+                pass        # not main thread / no SIGUSR2 here
+        return self
+
+    # -- bundle ------------------------------------------------------------
+    def dump(self, reason, extra=None, min_interval_s=None):
+        """Write one post-mortem bundle; returns its directory, or
+        None when rate-limited for this reason. Never raises — a
+        diagnosis path must not add a second failure."""
+        if min_interval_s is None:
+            min_interval_s = _config["min_dump_interval_s"]
+        now = time.monotonic()
+        with self._lock:
+            last = self._last_dump.get(reason)
+            if last is not None and now - last < min_interval_s:
+                return None
+            self._last_dump[reason] = now
+        try:
+            stamp = time.strftime("%Y%m%dT%H%M%S", time.gmtime())
+            # per-process sequence keeps names unique across dumps in
+            # the same second (rate-limit 0 in tests), so the atomic
+            # rename below never collides with an existing bundle
+            path = os.path.join(
+                self.out_dir,
+                f"{stamp}-{os.getpid()}-{next(_dump_seq)}-{reason}")
+            # write into a hidden temp dir, rename when complete: a
+            # bundle directory that is VISIBLE is always whole (triage
+            # tooling — and the tests — never see half a dump)
+            tmp = path + ".tmp"
+            os.makedirs(tmp, exist_ok=True)
+            meta = {"reason": reason, "ts": round(time.time(), 6),
+                    "mono": round(time.monotonic(), 6),
+                    "pid": os.getpid(), "argv": sys.argv,
+                    "python": sys.version.split()[0]}
+            if extra:
+                meta.update(extra)
+            with open(os.path.join(tmp, "meta.json"), "w") as f:
+                json.dump(meta, f, indent=2, default=str)
+            with open(os.path.join(tmp, "spans.json"), "w") as f:
+                json.dump(_spans.RECORDER.dump_state(), f, default=str)
+            with open(os.path.join(tmp, "events.jsonl"), "w") as f:
+                for rec in self.recent_events():
+                    f.write(json.dumps(rec, default=str) + "\n")
+            with open(os.path.join(tmp, "metrics.json"), "w") as f:
+                json.dump(REGISTRY.snapshot(), f, default=str)
+            with open(os.path.join(tmp, "threads.txt"), "w") as f:
+                f.write(_thread_stacks())
+            os.rename(tmp, path)
+            _events.emit("flight_recorder_dump", reason=reason, path=path)
+            print(f"mxnet_tpu flight recorder: wrote {path} "
+                  f"(reason: {reason})", file=sys.stderr)
+            return path
+        except Exception:
+            return None
+
+
+class Watchdog:
+    """One daemon thread polling probes; trips emit + dump."""
+
+    def __init__(self):
+        self._probes = {}
+        self._lock = threading.Lock()
+        self._thread = None
+        self._stop = threading.Event()
+        self._c_anomalies = REGISTRY.counter(
+            "mxnet_tpu_watchdog_anomalies_total",
+            "watchdog-detected stalls/anomalies by kind", ("kind",))
+
+    def register(self, name, probe):
+        """Register ``probe: () -> None | dict`` and make sure the
+        watchdog thread runs (unless MXNET_TPU_WATCHDOG=0)."""
+        with self._lock:
+            self._probes[name] = probe
+            if (self._thread is None
+                    and os.environ.get("MXNET_TPU_WATCHDOG") != "0"):
+                self._stop.clear()
+                self._thread = threading.Thread(
+                    target=self._run, name="mxnet_tpu_watchdog",
+                    daemon=True)
+                self._thread.start()
+
+    def unregister(self, name):
+        with self._lock:
+            self._probes.pop(name, None)
+
+    def _run(self):
+        while not self._stop.wait(_config["interval_s"]):
+            with self._lock:
+                probes = list(self._probes.items())
+            for name, probe in probes:
+                try:
+                    anomaly = probe()
+                except Exception as e:   # a broken probe is itself news
+                    anomaly = {"kind": "probe_error", "error": repr(e)}
+                if not anomaly:
+                    continue
+                kind = anomaly.get("kind", name)
+                self._c_anomalies.labels(kind=kind).inc()
+                _events.emit("watchdog_anomaly", probe=name, **anomaly)
+                RECORDER.dump(f"watchdog_{kind}")
+
+    def stop(self):
+        """Tests only: halt the poll thread."""
+        with self._lock:
+            self._stop.set()
+            t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5.0)
+
+
+#: process-wide flight recorder / watchdog singletons
+RECORDER = FlightRecorder()
+_WATCHDOG = Watchdog()
+
+
+def watchdog():
+    return _WATCHDOG
+
+
+def install(sigusr2=True, excepthook=True):
+    return RECORDER.install(sigusr2=sigusr2, excepthook=excepthook)
+
+
+def dump(reason, extra=None, min_interval_s=0.0):
+    return RECORDER.dump(reason, extra=extra,
+                         min_interval_s=min_interval_s)
+
+
+def register_probe(name, probe):
+    _WATCHDOG.register(name, probe)
+
+
+def unregister_probe(name):
+    _WATCHDOG.unregister(name)
+
+
+def configure(interval_s=None, stall_s=None, min_dump_interval_s=None,
+              recent_events=None):
+    """Runtime tuning (tests shrink the intervals to force fast
+    trips). Only the arguments given change."""
+    if interval_s is not None:
+        _config["interval_s"] = float(interval_s)
+    if stall_s is not None:
+        _config["stall_s"] = float(stall_s)
+    if min_dump_interval_s is not None:
+        _config["min_dump_interval_s"] = float(min_dump_interval_s)
+    if recent_events is not None:
+        _config["recent_events"] = int(recent_events)
+        RECORDER._recent = deque(RECORDER._recent,
+                                 maxlen=_config["recent_events"])
+    return dict(_config)

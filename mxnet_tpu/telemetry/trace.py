@@ -21,7 +21,7 @@ import os
 import threading
 
 __all__ = ["new_trace_id", "current_trace_id", "set_trace_id",
-           "trace_context"]
+           "reset_trace_id", "trace_context"]
 
 _trace_id = contextvars.ContextVar("mxnet_tpu_trace_id", default=None)
 _counter = itertools.count()
@@ -53,6 +53,12 @@ def current_trace_id():
 def set_trace_id(trace_id):
     """Set the active id; returns a token for ``_trace_id.reset``."""
     return _trace_id.set(trace_id)
+
+
+def reset_trace_id(token):
+    """Undo a :func:`set_trace_id` (spans.py uses the pair to scope a
+    minted trace id to one local-root span)."""
+    _trace_id.reset(token)
 
 
 @contextlib.contextmanager

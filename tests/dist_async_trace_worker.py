@@ -6,11 +6,20 @@ trace context; the test asserts the SAME trace id shows up in worker
 1's client-side `kvstore_rpc` event and in worker 0's server-side
 `kvstore_server_handle` event — the id crossed the wire inside the
 typed frame.
+
+Span parenting crosses too (the 5th frame field): worker 1 prints the
+span id of its client-side `kvstore/rpc/push` span
+(``SPAN_RPC=<id>``); worker 0 finds the server-side
+`kvstore/server/push` span for the same trace in ITS span ring and
+prints that span's parent (``SPAN_HANDLE_PARENT=<id>``). The test
+asserts the two ids are equal — one span tree across two processes.
 """
 import os
 import sys
+import time
 
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+os.environ["MXNET_TPU_TRACE_SLOW_MS"] = "0"   # keep every trace
 
 import jax
 
@@ -21,7 +30,19 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from mxnet_tpu import kvstore, nd
-from mxnet_tpu.telemetry import trace_context
+from mxnet_tpu.telemetry import spans, trace_context
+
+
+def _find_span(trace_id, name, timeout_s=30.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        trace = spans.get_trace(trace_id)
+        if trace:
+            for s in trace["spans"]:
+                if s["name"] == name:
+                    return s
+        time.sleep(0.1)
+    return None
 
 
 def main():
@@ -36,7 +57,18 @@ def main():
     if rank == 1:
         with trace_context("trace-golden-push"):
             kv.push("w", nd.array(np.full((4,), 2.0, np.float32)))
+        rpc_span = _find_span("trace-golden-push", "kvstore/rpc/push")
+        assert rpc_span is not None, "client rpc span not recorded"
+        print(f"SPAN_RPC={rpc_span['span_id']}", flush=True)
     kv.barrier()
+
+    if rank == 0:
+        handle = _find_span("trace-golden-push", "kvstore/server/push")
+        assert handle is not None, "server handle span not recorded"
+        print(f"SPAN_HANDLE_PARENT={handle['parent_id']}", flush=True)
+        opt = _find_span("trace-golden-push",
+                         "kvstore/server/optimizer_update")
+        assert opt is not None and opt["parent_id"] == handle["span_id"]
 
     out = nd.zeros((4,))
     kv.pull("w", out=out)

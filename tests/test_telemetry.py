@@ -403,7 +403,10 @@ def test_param_server_handles_traced_frames_and_logs(tmp_path):
 def test_dist_async_trace_id_crosses_processes(tmp_path):
     """Two REAL processes: the same trace id shows up in the pushing
     worker's client event log and the server-side log in worker 0's
-    process — the id crossed the wire inside the typed frame."""
+    process — the id crossed the wire inside the typed frame. Span
+    parenting crosses too (ISSUE 4): worker 1 prints its client RPC
+    span id, worker 0 prints the parent of its server handle span,
+    and the two must be EQUAL — one span tree over two processes."""
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)
     env["PYTHONPATH"] = ROOT
@@ -435,6 +438,19 @@ def test_dist_async_trace_id_crosses_processes(tmp_path):
     assert pushes_sent[0]["pid"] != pushes_served[0]["pid"]
     # and byte accounting matches across the wire for that frame
     assert pushes_sent[0]["bytes_out"] == pushes_served[0]["bytes_in"]
+    # span parenting crossed the wire: client rpc span id == server
+    # handle span's parent (each printed from its own process's ring)
+    rpc_id = handle_parent = None
+    for line in out.splitlines():
+        if line.startswith("SPAN_RPC="):
+            rpc_id = line.split("=", 1)[1].strip()
+        if line.startswith("SPAN_HANDLE_PARENT="):
+            handle_parent = line.split("=", 1)[1].strip()
+    assert rpc_id and handle_parent, out[-4000:]
+    assert rpc_id == handle_parent
+    # the span ids also landed in the structured event logs
+    assert pushes_sent[0].get("span_id") == rpc_id
+    assert pushes_served[0].get("parent_span_id") == rpc_id
 
 
 # ---------------------------------------------------------------------------

@@ -86,7 +86,7 @@ def run_load(engine, n_clients=8, requests_per_client=16,
 
     before = scrape_metrics(metrics_url) if metrics_url else None
 
-    latencies = []          # (client, ms) — list.append is atomic
+    latencies = []          # (ms, trace_id) — list.append is atomic
     outcomes = {"ok": 0, "expired": 0, "shed": 0, "error": 0}
     valid_tokens = [0]
     lock = threading.Lock()
@@ -98,8 +98,12 @@ def run_load(engine, n_clients=8, requests_per_client=16,
             toks = rs.randint(1, vocab, n).astype(np.int32)
             t0 = time.perf_counter()
             try:
-                engine.infer(toks, deadline_ms=deadline_ms,
-                             timeout=result_timeout_s)
+                # submit + result (not infer) so every generated
+                # request is TAGGED with its server-side trace id —
+                # the report's slowest_traces hand the operator ids to
+                # paste straight into `telemetry_dump.py --trace <id>`
+                fut = engine.submit(toks, deadline_ms=deadline_ms)
+                fut.result(timeout=result_timeout_s)
             except DeadlineExceededError:
                 with lock:
                     outcomes["expired"] += 1
@@ -117,7 +121,7 @@ def run_load(engine, n_clients=8, requests_per_client=16,
             with lock:
                 outcomes["ok"] += 1
                 valid_tokens[0] += n
-                latencies.append(ms)
+                latencies.append((ms, fut.trace_id))
 
     threads = [threading.Thread(target=client, args=(c,))
                for c in range(n_clients)]
@@ -130,11 +134,13 @@ def run_load(engine, n_clients=8, requests_per_client=16,
 
     from mxnet_tpu.serving.metrics import nearest_rank
 
-    xs = sorted(latencies)
+    xs = sorted(ms for ms, _ in latencies)
 
     def pct(p):
         v = nearest_rank(xs, p)
         return None if v is None else round(v, 3)
+
+    slowest = sorted(latencies, key=lambda x: -x[0])[:5]
 
     report = {"clients": n_clients,
               "requests_per_client": requests_per_client,
@@ -148,6 +154,8 @@ def run_load(engine, n_clients=8, requests_per_client=16,
               "valid_tokens_per_sec":
                   round(valid_tokens[0] / wall, 2) if wall else 0,
               "p50_ms": pct(50), "p95_ms": pct(95), "p99_ms": pct(99),
+              "slowest_traces": [{"trace_id": tid, "ms": round(ms, 3)}
+                                 for ms, tid in slowest],
               "engine": engine.snapshot()}
     if metrics_url:
         from mxnet_tpu.telemetry import histogram_quantile
@@ -234,6 +242,13 @@ def _main():
                           vocab=args.vocab, deadline_ms=args.deadline_ms,
                           metrics_url=metrics_url)
     print(json.dumps(report, indent=2))
+    if report.get("slowest_traces"):
+        print("# slowest traces (span trees, while the ring holds "
+              "them: python tools/telemetry_dump.py --trace <id> "
+              "<base-url>):", file=sys.stderr)
+        for rec in report["slowest_traces"]:
+            print(f"#   {rec['ms']:>10.2f} ms  {rec['trace_id']}",
+                  file=sys.stderr)
     if not args.no_expose and not report["server"]["reconciled"]:
         print("# WARNING: server/client accounting mismatch: "
               + "; ".join(report["server"]["mismatches"]),

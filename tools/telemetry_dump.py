@@ -5,6 +5,8 @@ Point it at a live exposition endpoint or an event-log file::
     python tools/telemetry_dump.py http://127.0.0.1:9100/metrics
     python tools/telemetry_dump.py http://127.0.0.1:9100/stats
     python tools/telemetry_dump.py run-events.jsonl
+    python tools/telemetry_dump.py --traces http://127.0.0.1:9100
+    python tools/telemetry_dump.py --trace req3f2a-1c-0 http://127.0.0.1:9100
 
 /metrics prints nonzero counters, gauges, and per-histogram
 count/mean/p50/p99 estimates (PromQL-style bucket interpolation);
@@ -12,6 +14,12 @@ count/mean/p50/p99 estimates (PromQL-style bucket interpolation);
 type, the trace-id population, and the most recent events. The
 `--healthz` flag probes the sibling /healthz first and sets the exit
 code from it (scriptable liveness checks).
+
+`--traces` tables the tail-sampled trace ring (slowest first — these
+are exactly the slow/errored/shed requests worth opening); `--trace
+<id>` renders one trace's span tree, indented by parentage, with each
+span's wall time and SELF time (duration minus direct children) so
+the stage that actually ate the request is visible at a glance.
 """
 from __future__ import annotations
 
@@ -29,7 +37,10 @@ def _fetch(url, timeout=10.0):
         return r.read().decode()
 
 
-def dump_metrics(text, out=sys.stdout):
+def dump_metrics(text, out=None):
+    # stdout resolved at CALL time (a def-time default would pin the
+    # importing test harness's capture object)
+    out = out if out is not None else sys.stdout
     from mxnet_tpu.telemetry import histogram_quantile
     from mxnet_tpu.telemetry.expo import parse_labels, \
         parse_prometheus_text
@@ -85,7 +96,8 @@ def dump_metrics(text, out=sys.stdout):
         print("(no samples)", file=out)
 
 
-def dump_events(path, out=sys.stdout, tail=8):
+def dump_events(path, out=None, tail=8):
+    out = out if out is not None else sys.stdout
     from mxnet_tpu.telemetry.events import read_events
 
     events = read_events(path)
@@ -117,22 +129,103 @@ def dump_events(path, out=sys.stdout, tail=8):
               file=out)
 
 
+def _base_url(src):
+    """Normalize a source URL to the server base (strip a known
+    endpoint path so any of /metrics | /stats | the bare base work)."""
+    src = src.rstrip("/")
+    for suffix in ("/metrics", "/stats", "/healthz", "/traces"):
+        if src.endswith(suffix):
+            return src[: -len(suffix)]
+    return src
+
+
+def dump_traces(summary, out=None, top=10):
+    """Table the /traces summary (slowest kept traces first)."""
+    out = out if out is not None else sys.stdout
+    kept = summary.get("kept", [])
+    print(f"-- {len(kept)} kept traces (slow_ms={summary.get('slow_ms')}, "
+          f"dropped={summary.get('dropped_traces')}, "
+          f"active={summary.get('active_traces')}) " + "-" * 10, file=out)
+    if not kept:
+        print("(none kept — nothing slow/errored/shed yet)", file=out)
+        return
+    print(f"  {'trace_id':<32} {'root':<24} {'ms':>10} {'spans':>6} "
+          f"{'status':<7} reason", file=out)
+    for rec in kept[:top]:
+        print(f"  {rec['trace_id']:<32} {rec['root'] or '?':<24} "
+              f"{rec['duration_ms']:>10.2f} {rec['spans']:>6} "
+              f"{rec['status']:<7} {rec.get('keep_reason', '')}", file=out)
+
+
+def dump_trace_tree(trace, out=None):
+    """Indented span-tree render with per-span self-time."""
+    out = out if out is not None else sys.stdout
+    spans = sorted(trace.get("spans", []),
+                   key=lambda s: (s.get("ts_us") or 0))
+    if not spans:
+        print("(trace has no spans)", file=out)
+        return
+    ids = {s["span_id"] for s in spans}
+    children = {}
+    roots = []
+    for s in spans:
+        if s.get("parent_id") in ids:
+            children.setdefault(s["parent_id"], []).append(s)
+        else:
+            roots.append(s)          # local root (parent may be remote)
+    print(f"-- trace {trace['trace_id']}"
+          + (" (partial)" if trace.get("partial") else "")
+          + f": {len(spans)} spans, status {trace.get('status', '?')} "
+          + "-" * 10, file=out)
+    print(f"  {'span':<52} {'ms':>10} {'self ms':>10}  notes", file=out)
+
+    def render(s, depth):
+        dur = (s.get("dur_us") or 0) / 1e3
+        kids = children.get(s["span_id"], [])
+        self_ms = dur - sum((k.get("dur_us") or 0) / 1e3 for k in kids)
+        label = "  " * depth + s["name"]
+        notes = []
+        if s.get("status") != "ok":
+            notes.append(f"ERROR: {s.get('error', '?')}")
+        if s.get("parent_id") and s["parent_id"] not in ids:
+            notes.append(f"remote parent {s['parent_id']}")
+        attrs = s.get("attrs") or {}
+        if attrs:
+            notes.append(",".join(f"{k}={v}" for k, v in attrs.items()))
+        print(f"  {label:<52} {dur:>10.2f} {max(self_ms, 0.0):>10.2f}  "
+              f"{' '.join(notes)}", file=out)
+        for k in kids:
+            render(k, depth + 1)
+
+    for r in roots:
+        render(r, 0)
+
+
 def main(argv=None):
     import argparse
 
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("source", help="/metrics URL, /stats URL, or an "
-                    "events JSONL path")
+    ap.add_argument("source", help="/metrics URL, /stats URL, server "
+                    "base URL (with --traces/--trace), or an events "
+                    "JSONL path")
     ap.add_argument("--healthz", action="store_true",
                     help="also probe the endpoint's /healthz; exit "
                     "nonzero when unhealthy")
+    ap.add_argument("--traces", action="store_true",
+                    help="table the tail-sampled trace ring "
+                    "(slowest first) from the server's /traces")
+    ap.add_argument("--trace", default=None, metavar="ID",
+                    help="render one trace's span tree from "
+                    "/traces/<ID>")
+    ap.add_argument("--top", type=int, default=10,
+                    help="rows in the --traces table")
     args = ap.parse_args(argv)
 
     src = args.source
     rc = 0
     if src.startswith("http://") or src.startswith("https://"):
+        base = _base_url(src)
         if args.healthz:
-            base = src.rsplit("/", 1)[0]
             try:
                 hz = json.loads(_fetch(base + "/healthz"))
                 ok = hz.pop("ok", False)
@@ -140,11 +233,26 @@ def main(argv=None):
                 ok, hz = False, {"error": repr(e)}
             print(f"healthz: {'OK' if ok else 'UNHEALTHY'} {hz}")
             rc = 0 if ok else 2
-        body = _fetch(src)
-        if src.rstrip("/").endswith("/stats"):
-            print(json.dumps(json.loads(body), indent=2))
+        if args.trace:
+            import urllib.error
+            from urllib.parse import quote
+            try:
+                trace = json.loads(_fetch(
+                    base + "/traces/" + quote(args.trace, safe="")))
+            except urllib.error.HTTPError as e:
+                print(f"trace {args.trace!r}: HTTP {e.code} (dropped "
+                      "by tail sampling, or never seen)")
+                return 3
+            dump_trace_tree(trace)
+        elif args.traces:
+            dump_traces(json.loads(_fetch(base + "/traces")),
+                        top=args.top)
         else:
-            dump_metrics(body)
+            body = _fetch(src)
+            if src.rstrip("/").endswith("/stats"):
+                print(json.dumps(json.loads(body), indent=2))
+            else:
+                dump_metrics(body)
     else:
         dump_events(src)
     return rc
