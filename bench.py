@@ -995,6 +995,97 @@ def main_serving():
             server_p50_ms_est=server.get("latency", {}).get("p50_ms_est"))
 
 
+def main_serving_router():
+    """Multi-engine router serving bench: BENCH_ROUTER_ENGINES
+    (default 2) in-process engines behind a ServingRouter, the same
+    closed-loop traffic as the single-engine leg driven at the ROUTER.
+    Reports router req/s, per-engine request share (least-outstanding
+    should keep it near-even), failover count (0 in the happy path —
+    nonzero means an engine died mid-bench), and the loadgen's
+    reconciliation of the router's AGGREGATED /metrics against client
+    accounting. Defaults are smaller than the single-engine leg: the
+    number under test is the router plane, not one more BERT forward."""
+    _setup_cache()
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo.bert import BERTModel, bert_serving_entry
+    from mxnet_tpu.serving import ServingEngine, ServingRouter
+
+    tools_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "tools")
+    if tools_dir not in sys.path:
+        sys.path.insert(0, tools_dir)
+    from serve_loadgen import run_load
+
+    n_engines = int(os.environ.get("BENCH_ROUTER_ENGINES", "2"))
+    seqlen = int(os.environ.get("BENCH_SEQLEN", "256"))
+    vocab = int(os.environ.get("BENCH_VOCAB", "30522"))
+    units = int(os.environ.get("BENCH_SERVE_UNITS", "256"))
+    layers = int(os.environ.get("BENCH_SERVE_LAYERS", "4"))
+    heads = int(os.environ.get("BENCH_SERVE_HEADS", "8"))
+    clients = int(os.environ.get("BENCH_SERVE_CLIENTS", "16"))
+    reqs = int(os.environ.get("BENCH_SERVE_REQS", "16"))
+    max_rows = int(os.environ.get("BENCH_SERVE_ROWS", "8"))
+    buckets = tuple(int(b) for b in os.environ.get(
+        "BENCH_SERVE_BUCKETS", f"{max(1, seqlen // 4)},{seqlen}")
+        .split(","))
+    ctx = mx.current_context()
+
+    def make_engine(i):
+        net = BERTModel(vocab_size=vocab, units=units,
+                        hidden_size=4 * units, num_layers=layers,
+                        num_heads=heads, max_length=seqlen, dropout=0.0,
+                        attention_dropout=0.0, use_pooler=False)
+        net.initialize(init=mx.initializer.Normal(0.02), ctx=ctx)
+        if DTYPE != "float32":
+            net.cast(DTYPE)
+        return ServingEngine(bert_serving_entry(net), ctx=ctx,
+                             bucket_lens=buckets, max_rows=max_rows,
+                             max_queue_depth=max(64, 8 * clients),
+                             pool="mean", engine_id=f"e{i}")
+
+    import contextlib
+    with contextlib.ExitStack() as stack:
+        engines = [stack.enter_context(make_engine(i))
+                   for i in range(n_engines)]
+        router = stack.enter_context(ServingRouter(engines=engines))
+        metrics_url = router.expose().url("/metrics")
+        for eng in engines:
+            eng.warmup()
+        run_load(router, n_clients=min(4, clients),
+                 requests_per_client=2, min_len=max(4, seqlen // 8),
+                 max_len=seqlen, vocab=vocab)
+        for eng in engines:
+            eng.reset_stats()
+        report = run_load(router, n_clients=clients,
+                          requests_per_client=reqs,
+                          min_len=max(4, seqlen // 8), max_len=seqlen,
+                          vocab=vocab, metrics_url=metrics_url)
+    report.pop("engine")       # the router metric line stands alone;
+    # a failed assert below must not dump the whole fleet snapshot
+    assert report["completed"] == clients * reqs, report
+    server = report.get("server", {})
+    assert server.get("reconciled", True), server
+    # per-engine share from the /metrics DELTA (window-exact; the
+    # router's dispatched counts also cover the warmup pass)
+    per_engine = (server.get("per_engine_completed")
+                  or report["per_engine"])
+    total = max(1, sum(per_engine.values()))
+    _report("bert_serving_router_requests_per_sec",
+            report["requests_per_sec"], "requests/sec", 0.0,
+            seqlen=seqlen, clients=clients, engines=n_engines,
+            requests=report["completed"], dtype=DTYPE,
+            p50_ms=report["p50_ms"], p95_ms=report["p95_ms"],
+            p99_ms=report["p99_ms"],
+            valid_tokens_per_sec=report["valid_tokens_per_sec"],
+            per_engine={eid: round(n / total, 3)
+                        for eid, n in sorted(per_engine.items())},
+            failover=report["failovers"],
+            engines_up=report["engines_up"],
+            telemetry_reconciled=server.get("reconciled"),
+            server_p50_ms_est=server.get("latency", {}).get("p50_ms_est"))
+
+
 def main_lstm():
     """LSTM LM training step, tokens/sec/chip (BASELINE #4).
 
@@ -1191,6 +1282,9 @@ _SUITE = (
       "MXNET_TPU_FLASH_BLOCK_Q": "256", "MXNET_TPU_FLASH_BLOCK_K": "256"}),
     # closed-loop packed continuous-batching serving (mxnet_tpu/serving)
     ("bert_serving", "serving", {"BENCH_WINDOWS": "1"}),
+    # 2 engines behind the front-door router: req/s, per-engine share,
+    # failover count, aggregated-/metrics reconciliation
+    ("bert_serving_router", "serving_router", {"BENCH_WINDOWS": "1"}),
     # seq2048 BEFORE seq1024 (it was the r5 rc=124 casualty) and with a
     # shorter chain/step budget: chain=4 compiles a 4-step scan instead
     # of 10 — the 420 s per-config cap was lost to trace+compile time,
@@ -1216,7 +1310,7 @@ _SUMMARY_KEYS = ("metric", "value", "unit", "mfu", "hbm_frac", "hbm_est",
                  "valid_frac", "valid_tokens_per_sec", "packing_efficiency",
                  "seqlen", "batch", "failed", "causal", "clients",
                  "p50_ms", "p99_ms", "telemetry_reconciled", "telemetry",
-                 "slowest_traces")
+                 "slowest_traces", "per_engine", "failover", "engines_up")
 
 
 def _compact(rec):
@@ -1346,6 +1440,8 @@ def _dispatch():
         main_causal_lm()
     elif _model == "serving":
         main_serving()
+    elif _model == "serving_router":
+        main_serving_router()
     elif _model == "lstm":
         main_lstm()
     elif _model == "widedeep":

@@ -12,6 +12,12 @@ Reference lineage: MXNet Model Server's queue → batcher → backend
 worker, rebuilt around iteration-level (Orca-style) scheduling and
 shape-bucketed compiled executors (the BucketingModule heritage).
 
+Scale-out: :class:`~.router.ServingRouter` fronts N engines
+(in-process handles or remote ``expose()`` endpoints) with
+least-outstanding routing, failover requeue, engine-labeled metric
+aggregation, cross-engine trace merging, and a per-engine health
+scoreboard — see ``router.py``.
+
 Quickstart::
 
     from mxnet_tpu.gluon.model_zoo import bert_base
@@ -33,9 +39,12 @@ from .queue import (ServingError, QueueFullError, DeadlineExceededError,
 from .batcher import ContinuousBatcher, PackedPlan
 from .metrics import LatencySummary, ServingStats
 from .engine import ServingEngine
+from .router import (ServingRouter, NoEngineAvailableError,
+                     RemoteEngineError)
 
-__all__ = ["ServingEngine", "ContinuousBatcher", "PackedPlan",
-           "RequestQueue", "Request", "InferenceFuture", "LatencySummary",
-           "ServingStats", "ServingError", "QueueFullError",
-           "DeadlineExceededError", "RequestTooLongError",
-           "EngineStoppedError"]
+__all__ = ["ServingEngine", "ServingRouter", "ContinuousBatcher",
+           "PackedPlan", "RequestQueue", "Request", "InferenceFuture",
+           "LatencySummary", "ServingStats", "ServingError",
+           "QueueFullError", "DeadlineExceededError",
+           "RequestTooLongError", "EngineStoppedError",
+           "NoEngineAvailableError", "RemoteEngineError"]

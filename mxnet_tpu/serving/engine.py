@@ -22,6 +22,8 @@ steady-state serving never re-traces.
 """
 from __future__ import annotations
 
+import itertools
+import os
 import threading
 import time
 
@@ -41,6 +43,17 @@ from .queue import (DeadlineExceededError, EngineStoppedError, Request,
                     RequestQueue, RequestTooLongError, ServingError)
 
 __all__ = ["ServingEngine"]
+
+_engine_seq = itertools.count()
+
+# HTTP status for each admission/serving failure the /submit dispatch
+# endpoint can report (the router maps error_type back to the class)
+_SUBMIT_ERROR_STATUS = {
+    "QueueFullError": 429,
+    "RequestTooLongError": 413,
+    "DeadlineExceededError": 504,
+    "EngineStoppedError": 503,
+}
 
 
 def _join_trace_ids(requests, cap=16):
@@ -93,13 +106,20 @@ class ServingEngine:
         previous batch computes).
     pool : per-request output view — "tokens" (len, U), "mean" (U,),
         "cls" (U,), or a callable ``(seq_slice, request) -> result``.
+    engine_id : label value for this engine's serving metric families
+        (and the ``engine`` attr on its spans). Defaults to a
+        process-unique id; give stable names ("chip0") when a router
+        fronts several engines so dashboards and the fleet scoreboard
+        agree on who is who.
     """
 
     def __init__(self, model, ctx=None, bucket_lens=(64, 256, 1024),
                  max_rows=8, max_queue_depth=256, default_deadline_ms=None,
                  batch_wait_ms=0.0, max_batch_requests=None, pool="tokens",
-                 pad_value=0, stats_window=4096):
+                 pad_value=0, stats_window=4096, engine_id=None):
         self._model = model
+        self.engine_id = str(engine_id) if engine_id is not None \
+            else f"e{os.getpid():x}-{next(_engine_seq)}"
         self._ctx = ctx if ctx is not None else current_context()
         self._batcher = ContinuousBatcher(bucket_lens=bucket_lens,
                                           max_rows=max_rows,
@@ -112,12 +132,15 @@ class ServingEngine:
         self._max_batch_requests = (max_batch_requests
                                     or max_rows * self._batcher.max_len)
         self._pool = _POOLERS[pool] if isinstance(pool, str) else pool
-        self.stats = ServingStats(stats_window)
+        self.stats = ServingStats(stats_window, engine_id=self.engine_id)
         self.stats.set_queue_depth_fn(lambda: len(self._queue))
-        self._compile_cache = _REGISTRY.counter(
+        cc = _REGISTRY.counter(
             "mxnet_tpu_serving_compile_cache_total",
             "per-shape CachedOp executable cache outcomes at dispatch",
-            ("result",))
+            ("engine_id", "result"))
+        self._compile_cache = {
+            True: cc.labels(engine_id=self.engine_id, result="hit"),
+            False: cc.labels(engine_id=self.engine_id, result="miss")}
         self._seen_shapes = set()
         self._worker = None
         self._expo = None
@@ -149,7 +172,7 @@ class ServingEngine:
         # flight-recorder crash hooks + the stall watchdog ride along
         _recorder.install()
         _recorder.register_probe(self._probe_name, self._watchdog_probe)
-        _events.emit("engine_start",
+        _events.emit("engine_start", engine_id=self.engine_id,
                      bucket_lens=list(self._batcher.bucket_lens),
                      max_rows=self._batcher.max_rows)
         return self
@@ -159,7 +182,7 @@ class ServingEngine:
         request first; ``drain=False`` fails them with
         :class:`EngineStoppedError` (counted ``cancelled``)."""
         _events.emit("engine_abort" if not drain else "engine_stop",
-                     drain=drain)
+                     engine_id=self.engine_id, drain=drain)
         _recorder.unregister_probe(self._probe_name)
         with self._lock:
             self._queue.close()
@@ -206,17 +229,25 @@ class ServingEngine:
                     and self._worker.is_alive())
 
     # -- client surface ----------------------------------------------------
-    def submit(self, tokens, token_types=None, deadline_ms=None):
+    def submit(self, tokens, token_types=None, deadline_ms=None,
+               trace_id=None, parent_span_id=None):
         """Enqueue one request; returns an :class:`InferenceFuture`.
         Raises the admission errors directly (queue full, too long,
-        stopped) so callers can tell shedding from failure."""
+        stopped) so callers can tell shedding from failure.
+
+        ``trace_id``/``parent_span_id`` adopt an upstream trace (the
+        router's dispatch, or a remote ``/submit`` payload): the
+        request joins that trace and its ``serving/request`` span
+        parents under the given — possibly remote — span id."""
         if deadline_ms is None:
             deadline_ms = self._default_deadline_ms
         # validate FIRST: a malformed request (empty tokens, mismatched
         # token_types) raises to the caller without touching any
         # counter, so submitted always equals the sum of the outcome
         # counters (the invariant the loadgen cross-check reconciles)
-        req = Request(tokens, token_types, deadline_ms)
+        req = Request(tokens, token_types, deadline_ms,
+                      trace_id=trace_id, parent_span_id=parent_span_id)
+        req.span.set_attr(engine=self.engine_id)
         self.stats.bump("submitted")
         if not self._started or self._queue.closed:
             self.stats.bump("rejected_stopped")
@@ -225,6 +256,7 @@ class ServingEngine:
         if len(req) > self._batcher.max_len:
             self.stats.bump("rejected_too_long")
             _events.emit("request_shed", reason="too_long",
+                         engine_id=self.engine_id,
                          trace_id=req.trace_id, tokens=len(req))
             req.span.set_attr(shed="too_long").force_keep() \
                .end(error="shed: too_long")
@@ -239,6 +271,7 @@ class ServingEngine:
             self.stats.bump("rejected_queue_full"
                             if full else "rejected_stopped")
             _events.emit("request_shed", reason=reason,
+                         engine_id=self.engine_id,
                          trace_id=req.trace_id, tokens=len(req))
             # shed traces are tail-sampling KEEPs by contract: the
             # operator debugging overload wants exactly these
@@ -271,7 +304,8 @@ class ServingEngine:
         one — lifetime-cumulative stats would otherwise fold both.
         The process-wide telemetry registry keeps counting (Prometheus
         counters never reset); scrapers diff between scrapes."""
-        self.stats = ServingStats(self.stats.window)
+        self.stats = ServingStats(self.stats.window,
+                                  engine_id=self.engine_id)
         self.stats.set_queue_depth_fn(lambda: len(self._queue))
         return self
 
@@ -279,9 +313,13 @@ class ServingEngine:
         """Start (or return the running) telemetry exposition server
         for this engine: Prometheus ``/metrics`` off the process
         registry, ``/healthz`` liveness (worker thread alive, queue
-        open), and ``/stats`` serving this engine's ``snapshot()``
-        JSON. ``port=0`` picks a free port (read ``.port`` back).
-        Closed automatically by :meth:`stop`."""
+        open, seconds since the worker loop's last beat), ``/stats``
+        serving this engine's ``snapshot()`` JSON, and ``POST
+        /submit`` — the remote dispatch endpoint a
+        :class:`~.router.ServingRouter` in another process drives
+        (JSON request in, JSON result out, long-polled until the
+        forward completes). ``port=0`` picks a free port (read
+        ``.port`` back). Closed automatically by :meth:`stop`."""
         from ..telemetry.expo import TelemetryServer
 
         with self._lock:
@@ -298,26 +336,65 @@ class ServingEngine:
                          and self._worker.is_alive())
                 closed = self._queue.closed
                 return (alive and not closed,
-                        {"worker_alive": alive, "queue_closed": closed,
-                         "queue_depth": len(self._queue)})
+                        {"engine_id": self.engine_id,
+                         "worker_alive": alive, "queue_closed": closed,
+                         "queue_depth": len(self._queue),
+                         "seconds_since_beat":
+                             round(time.monotonic() - self._beat, 3)})
 
             srv = TelemetryServer(healthz_fn=healthz,
                                   stats_fn=self.snapshot,
+                                  submit_fn=self._remote_submit,
                                   port=port, host=host)
             self._expo = srv
         # emit/return through the local: a stop() racing in right here
         # may already have swapped self._expo away (and closed it)
-        _events.emit("telemetry_expose", port=srv.port, host=srv.host)
+        _events.emit("telemetry_expose", engine_id=self.engine_id,
+                     port=srv.port, host=srv.host)
         return srv
 
     def snapshot(self):
         """Stats dict: counters, queue depth, latency percentiles,
-        packing efficiency (see metrics.ServingStats)."""
+        packing efficiency (see metrics.ServingStats).
+        ``seconds_since_beat`` is the worker loop's heartbeat age —
+        the router's health poll reads it to tell a WEDGED engine
+        (alive thread, stuck forward) from a healthy one."""
         out = self.stats.snapshot()
         out["running"] = self.running
         out["bucket_lens"] = list(self._batcher.bucket_lens)
         out["max_rows"] = self._batcher.max_rows
+        out["seconds_since_beat"] = round(time.monotonic() - self._beat, 3)
         return out
+
+    def _remote_submit(self, payload):
+        """``POST /submit`` handler (runs on an exposition-server
+        thread): submit + block for the result, JSON-serializable
+        either way. Returns ``(http_status, body_dict)`` — admission
+        errors carry their class name in ``error_type`` so the remote
+        router re-raises the same serving taxonomy."""
+        try:
+            fut = self.submit(payload["tokens"],
+                              payload.get("token_types"),
+                              deadline_ms=payload.get("deadline_ms"),
+                              trace_id=payload.get("trace_id"),
+                              parent_span_id=payload.get("span_id"))
+        except (ServingError, ValueError, KeyError, TypeError) as e:
+            name = type(e).__name__
+            return (_SUBMIT_ERROR_STATUS.get(name, 400),
+                    {"ok": False, "error_type": name, "error": str(e),
+                     "engine_id": self.engine_id})
+        timeout_s = payload.get("timeout_s") or 600.0
+        try:
+            out = fut.result(timeout=float(timeout_s))
+        except Exception as e:
+            name = type(e).__name__
+            return (_SUBMIT_ERROR_STATUS.get(name, 500),
+                    {"ok": False, "error_type": name, "error": str(e),
+                     "trace_id": fut.trace_id,
+                     "engine_id": self.engine_id})
+        return 200, {"ok": True, "result": np.asarray(out).tolist(),
+                     "trace_id": fut.trace_id,
+                     "engine_id": self.engine_id}
 
     # -- watchdog ----------------------------------------------------------
     def _watchdog_probe(self):
@@ -410,22 +487,22 @@ class ServingEngine:
             r.span.end(error=repr(exc))
             r.future.set_exception(exc)
 
-    @staticmethod
-    def _queue_span(req):
+    def _queue_span(self, req):
         """Synthesized queue-wait child span (submit → drain)."""
         if req.t_drain is not None and req.span.span_id is not None:
             _spans.record_span("serving/queue", req.trace_id,
                                parent_id=req.span.span_id,
                                mono_start=req.t_submit,
-                               mono_end=req.t_drain)
+                               mono_end=req.t_drain,
+                               attrs={"engine": self.engine_id})
 
     def _dispatch(self, plan, pack_interval=None):
         shape = (plan.rows, plan.row_len)
         hit = shape in self._seen_shapes
-        self._compile_cache.labels(result="hit" if hit else "miss").inc()
+        self._compile_cache[hit].inc()
         if not hit:
-            _events.emit("compile_begin", rows=plan.rows,
-                         row_len=plan.row_len)
+            _events.emit("compile_begin", engine_id=self.engine_id,
+                         rows=plan.rows, row_len=plan.row_len)
         t0 = time.perf_counter()
         seq = self._forward(plan)
         t1 = time.perf_counter()
@@ -438,14 +515,16 @@ class ServingEngine:
             self._seen_shapes.add(shape)
             self.stats.bump("compiles")
             self.stats.compile_ms.observe(dt_ms)
-            _events.emit("compile_end", rows=plan.rows,
-                         row_len=plan.row_len, ms=round(dt_ms, 3))
+            _events.emit("compile_end", engine_id=self.engine_id,
+                         rows=plan.rows, row_len=plan.row_len,
+                         ms=round(dt_ms, 3))
         self.stats.observe_batch(plan.rows, plan.row_len,
                                  plan.valid_tokens, len(plan.entries),
                                  plan.row_len)
         # one line per batch (not per request): every served request's
         # trace id is findable in the event log without per-request spam
-        _events.emit("batch_dispatch", rows=plan.rows,
+        _events.emit("batch_dispatch", engine_id=self.engine_id,
+                     rows=plan.rows,
                      row_len=plan.row_len, requests=len(plan.entries),
                      valid_tokens=plan.valid_tokens, ms=round(dt_ms, 3),
                      trace_ids=[r.trace_id for r, _ in plan.entries])
@@ -457,7 +536,8 @@ class ServingEngine:
         # complete under one trace id
         fwd_name = "serving/forward" if hit else "serving/compile"
         fwd_attrs = {"rows": plan.rows, "row_len": plan.row_len,
-                     "requests": len(plan.entries), "compiled": not hit}
+                     "requests": len(plan.entries), "compiled": not hit,
+                     "engine": self.engine_id}
         for req, pl in plan.entries:
             record_spans = req.span.span_id is not None
             if record_spans:
@@ -467,7 +547,8 @@ class ServingEngine:
                         "serving/pack", req.trace_id,
                         parent_id=req.span.span_id,
                         start_us=int(pack_interval[0] * 1e6),
-                        end_us=int(pack_interval[1] * 1e6))
+                        end_us=int(pack_interval[1] * 1e6),
+                        attrs={"engine": self.engine_id})
                 _spans.record_span(fwd_name, req.trace_id,
                                    parent_id=req.span.span_id,
                                    start_us=int(t0 * 1e6),
@@ -489,7 +570,8 @@ class ServingEngine:
             if record_spans:
                 _spans.record_span("serving/complete", req.trace_id,
                                    parent_id=req.span.span_id,
-                                   start_us=int(t1 * 1e6))
+                                   start_us=int(t1 * 1e6),
+                                   attrs={"engine": self.engine_id})
             req.span.end()
             req.future.set_result(out)
 

@@ -9,13 +9,19 @@ timeline.
 
 Since the telemetry subsystem landed, every ``ServingStats`` also
 BRIDGES onto the process-wide :data:`mxnet_tpu.telemetry.REGISTRY`:
-counters feed ``mxnet_tpu_serving_requests_total{event=...}``, each
-latency summary co-observes a ``mxnet_tpu_serving_latency_ms{stage=..}``
-histogram, queue depth is a pull gauge, and per-bucket batch traffic
-lands in ``mxnet_tpu_serving_batch_{tokens,slots}_total{bucket=...}``.
-Registry counters are process-cumulative by Prometheus contract:
-``ServingEngine.reset_stats`` swaps the WINDOW (this object) while the
-registry keeps counting — scrapers diff between scrapes.
+counters feed ``mxnet_tpu_serving_requests_total{engine_id=..,event=..}``,
+each latency summary co-observes a
+``mxnet_tpu_serving_latency_ms{engine_id=..,stage=..}`` histogram,
+queue depth is a pull gauge, and per-bucket batch traffic lands in
+``mxnet_tpu_serving_batch_{tokens,slots}_total{engine_id=..,bucket=..}``.
+Every serving family carries an ``engine_id`` label (the ROADMAP
+"per-chip router metrics" item): N engines in one process — or N
+engine processes scrape-merged at a :class:`~.router.ServingRouter` —
+keep disjoint counter children instead of double-counting one
+unlabeled set. Registry counters are process-cumulative by Prometheus
+contract: ``ServingEngine.reset_stats`` swaps the WINDOW (this
+object) while the registry keeps counting — scrapers diff between
+scrapes.
 
 Everything is thread-safe: client threads observe submit/reject
 counters while the single worker thread observes batch/compute stats.
@@ -114,9 +120,11 @@ class ServingStats:
                 "rejected_too_long", "rejected_stopped", "expired",
                 "cancelled", "batches", "compiles")
 
-    def __init__(self, window=4096, registry=None):
+    def __init__(self, window=4096, registry=None, engine_id="default"):
         reg = registry if registry is not None else REGISTRY
         self.window = window          # public: reset_stats reads this
+        self.engine_id = str(engine_id)
+        eid = self.engine_id
         self._lock = threading.Lock()
         self._c = {name: 0 for name in self.COUNTERS}
         # dispatched slot accounting for the aggregate packing number
@@ -126,39 +134,52 @@ class ServingStats:
         # pays a dict lookup + locked add, never family bookkeeping
         req_total = reg.counter(
             "mxnet_tpu_serving_requests_total",
-            "serving requests by admission/completion outcome", ("event",))
-        self._reg_c = {name: req_total.labels(event=name)
+            "serving requests by admission/completion outcome, per engine",
+            ("engine_id", "event"))
+        self._reg_c = {name: req_total.labels(engine_id=eid, event=name)
                        for name in self.COUNTERS
                        if name not in ("batches", "compiles")}
         # not request outcomes — their own families keep the
         # requests_total label space reconcilable request-for-request
         self._reg_c["batches"] = reg.counter(
-            "mxnet_tpu_serving_batches_total", "dispatched packed batches")
+            "mxnet_tpu_serving_batches_total",
+            "dispatched packed batches, per engine",
+            ("engine_id",)).labels(engine_id=eid)
         self._reg_c["compiles"] = reg.counter(
             "mxnet_tpu_serving_compiles_total",
-            "first-visit shape trace+compiles")
+            "first-visit shape trace+compiles, per engine",
+            ("engine_id",)).labels(engine_id=eid)
         lat = reg.histogram("mxnet_tpu_serving_latency_ms",
-                            "serving latency by pipeline stage", ("stage",))
-        self.queue_ms = LatencySummary(window, lat.labels(stage="queue"))
-        self.pack_ms = LatencySummary(window, lat.labels(stage="pack"))
-        self.compute_ms = LatencySummary(window,
-                                         lat.labels(stage="compute"))
-        self.compile_ms = LatencySummary(window,
-                                         lat.labels(stage="compile"))
-        self.total_ms = LatencySummary(window, lat.labels(stage="total"))
+                            "serving latency by pipeline stage, per engine",
+                            ("engine_id", "stage"))
+        self.queue_ms = LatencySummary(
+            window, lat.labels(engine_id=eid, stage="queue"))
+        self.pack_ms = LatencySummary(
+            window, lat.labels(engine_id=eid, stage="pack"))
+        self.compute_ms = LatencySummary(
+            window, lat.labels(engine_id=eid, stage="compute"))
+        self.compile_ms = LatencySummary(
+            window, lat.labels(engine_id=eid, stage="compile"))
+        self.total_ms = LatencySummary(
+            window, lat.labels(engine_id=eid, stage="total"))
         self.batch_requests = LatencySummary(
             window, reg.histogram("mxnet_tpu_serving_batch_requests",
                                   "requests per dispatched batch",
-                                  buckets=_BATCH_REQ_BUCKETS))
+                                  ("engine_id",),
+                                  buckets=_BATCH_REQ_BUCKETS)
+            .labels(engine_id=eid))
         self._reg_batch_tokens = reg.counter(
             "mxnet_tpu_serving_batch_tokens_total",
-            "valid tokens dispatched, by row-length bucket", ("bucket",))
+            "valid tokens dispatched, by row-length bucket",
+            ("engine_id", "bucket"))
         self._reg_batch_slots = reg.counter(
             "mxnet_tpu_serving_batch_slots_total",
-            "padded slots dispatched, by row-length bucket", ("bucket",))
+            "padded slots dispatched, by row-length bucket",
+            ("engine_id", "bucket"))
         self._reg_queue_depth = reg.gauge(
             "mxnet_tpu_serving_queue_depth",
-            "requests waiting in the admission queue")
+            "requests waiting in the admission queue, per engine",
+            ("engine_id",)).labels(engine_id=eid)
         self._queue_depth_fn = None
         self._last_batch = None
 
@@ -188,8 +209,10 @@ class ServingStats:
                 "packing_efficiency":
                     round(valid_tokens / float(rows * row_len), 4)}
         self._reg_c["batches"].inc()
-        self._reg_batch_tokens.labels(bucket=bucket_len).inc(valid_tokens)
-        self._reg_batch_slots.labels(bucket=bucket_len).inc(rows * row_len)
+        self._reg_batch_tokens.labels(
+            engine_id=self.engine_id, bucket=bucket_len).inc(valid_tokens)
+        self._reg_batch_slots.labels(
+            engine_id=self.engine_id, bucket=bucket_len).inc(rows * row_len)
         self.batch_requests.observe(n_requests)
 
     def packing_efficiency(self):
@@ -206,7 +229,8 @@ class ServingStats:
             counters = dict(self._c)
             slots, valid = self._slots, self._valid_tokens
             last = dict(self._last_batch) if self._last_batch else None
-        out = {"counters": counters,
+        out = {"engine_id": self.engine_id,
+               "counters": counters,
                "queue_depth": (self._queue_depth_fn()
                                if self._queue_depth_fn else None),
                "latency": {"queue": self.queue_ms.snapshot(),

@@ -24,7 +24,7 @@ from ..telemetry.trace import new_trace_id
 
 __all__ = ["ServingError", "QueueFullError", "DeadlineExceededError",
            "RequestTooLongError", "EngineStoppedError", "InferenceFuture",
-           "Request", "RequestQueue"]
+           "Request", "RequestQueue", "validate_tokens"]
 
 
 class ServingError(MXNetError):
@@ -61,23 +61,46 @@ class InferenceFuture:
         self._event = threading.Event()
         self._value = None
         self._exc = None
+        self._lock = threading.Lock()
+        self._callbacks = []
 
     def done(self):
         return self._event.is_set()
 
-    def set_result(self, value):
+    def _finish(self, value, exc):
         # first write wins: a batch-failure sweep arriving after a
         # request was already fulfilled must not clobber its result
-        if self._event.is_set():
-            return
-        self._value = value
-        self._event.set()
+        with self._lock:
+            if self._event.is_set():
+                return
+            self._value = value
+            self._exc = exc
+            self._event.set()
+            callbacks, self._callbacks = self._callbacks, []
+        for cb in callbacks:    # outside the lock: a callback may block
+            try:
+                cb(self)
+            except Exception:
+                pass            # a broken observer must not lose the result
+
+    def set_result(self, value):
+        self._finish(value, None)
 
     def set_exception(self, exc):
-        if self._event.is_set():
-            return
-        self._exc = exc
-        self._event.set()
+        self._finish(None, exc)
+
+    def add_done_callback(self, fn):
+        """Call ``fn(self)`` once the future resolves (immediately when
+        it already has) — the router's completion hook; exceptions from
+        ``fn`` are swallowed."""
+        with self._lock:
+            if not self._event.is_set():
+                self._callbacks.append(fn)
+                return
+        try:
+            fn(self)
+        except Exception:
+            pass
 
     def exception(self, timeout=None):
         if not self._event.wait(timeout):
@@ -95,6 +118,22 @@ class InferenceFuture:
 _req_ids = itertools.count()
 
 
+def validate_tokens(tokens, token_types):
+    """Shared admission validation (engine Request AND router
+    RouterRequest): int32-flatten tokens, reject empty, shape-match
+    token_types. Returns the normalized ``(tokens, token_types)``."""
+    tokens = np.asarray(tokens, np.int32).reshape(-1)
+    if tokens.size == 0:
+        raise ValueError("empty request")
+    if token_types is not None:
+        token_types = np.asarray(token_types, np.int32).reshape(-1)
+        if token_types.shape != tokens.shape:
+            raise ValueError(
+                f"token_types length {token_types.size} != tokens "
+                f"length {tokens.size}")
+    return tokens, token_types
+
+
 class Request:
     """One queued inference request and its timing breadcrumbs.
 
@@ -108,28 +147,29 @@ class Request:
     here, ended by the engine at complete/fail/shed — its duration is
     the tail-sampling input, so only slow/errored/shed requests retain
     their full queue→pack→forward span trees.
+
+    A fronting :class:`~.router.ServingRouter` passes its own
+    ``trace_id`` and root-span id down so the engine-side tree parents
+    under the router's ``router/request`` span — the same frame-carried
+    ``(trace_id, span_id)`` crossing the dist_async wire uses (the
+    parent may live in ANOTHER process; ``local_root=True`` keeps the
+    engine's tail-sampling decision local either way).
     """
 
     __slots__ = ("id", "trace_id", "span", "tokens", "token_types",
                  "deadline", "future", "t_submit", "t_drain",
                  "t_dispatch", "t_done")
 
-    def __init__(self, tokens, token_types=None, deadline_ms=None):
+    def __init__(self, tokens, token_types=None, deadline_ms=None,
+                 trace_id=None, parent_span_id=None):
         self.id = next(_req_ids)
-        self.trace_id = new_trace_id("req")
-        self.tokens = np.asarray(tokens, np.int32).reshape(-1)
-        if self.tokens.size == 0:
-            raise ValueError("empty request")
-        if token_types is not None:
-            token_types = np.asarray(token_types, np.int32).reshape(-1)
-            if token_types.shape != self.tokens.shape:
-                raise ValueError(
-                    f"token_types length {token_types.size} != tokens "
-                    f"length {self.tokens.size}")
-        self.token_types = token_types
+        self.trace_id = trace_id or new_trace_id("req")
+        self.tokens, self.token_types = validate_tokens(tokens,
+                                                        token_types)
         self.t_submit = time.monotonic()
         self.span = _spans.start_span(
             "serving/request", trace_id=self.trace_id,
+            parent_id=parent_span_id,
             attrs={"tokens": int(self.tokens.size)}, local_root=True)
         self.deadline = (self.t_submit + deadline_ms / 1e3
                          if deadline_ms is not None else None)

@@ -1,0 +1,917 @@
+"""Multi-engine serving front door with a fleet-wide observability plane.
+
+``ServingRouter`` fronts N :class:`~.engine.ServingEngine` seats — the
+"one engine per chip, one front door" scale-out shape — and routes
+each request to the routable engine with the fewest router-observed
+outstanding requests (least-outstanding, the standard L7 balancing
+policy for long-tailed request costs). Seats come in two kinds:
+
+- **in-process** engines, registered by handle (``add_engine(id,
+  engine)``) and dispatched via ``engine.submit`` directly;
+- **remote** engines, registered by the base URL of their
+  ``engine.expose()`` endpoint and dispatched via its ``POST /submit``
+  long-poll, with per-engine health/stats/metrics/traces scraped off
+  the same endpoint.
+
+The observability plane is the point:
+
+1. **Engine-labeled metrics** — every serving family carries an
+   ``engine_id`` label (see :mod:`.metrics`); the router's own
+   ``/metrics`` serves an AGGREGATED exposition: the local process
+   registry unioned with every remote engine's scrape
+   (:func:`~mxnet_tpu.telemetry.expo.merge_prometheus_texts`), so one
+   Prometheus target sees the whole fleet.
+2. **Cross-engine trace aggregation** — ``submit`` opens a
+   ``router/request`` root span and propagates ``(trace_id,
+   span_id)`` to the chosen engine (directly in-process, as dispatch
+   payload fields for remote seats — the same frame-carried crossing
+   the dist_async wire uses), so the engine-side
+   ``serving/request → queue → pack → forward → complete`` tree
+   parents under the router root across processes. The router's
+   ``/traces`` and ``/traces/<id>`` merge the per-engine tail-sampled
+   rings into one fleet view / one span tree, each span tagged with
+   the engine that served it.
+3. **Per-engine health scoreboard** — a poll thread folds engine
+   heartbeats (``running``/``/healthz``, queue depth, worker-beat
+   age, p95, qps) into per-engine gauges and a scoreboard dict; a
+   stalled or unreachable engine is marked unroutable (new traffic
+   avoids it; its failed dispatches re-queue to siblings), every
+   transition emits a ``router_engine_state`` event, and a watchdog
+   probe plus a ``router_scoreboard.json`` flight-recorder bundle
+   section make a wedged engine self-diagnosing.
+
+Failover: a dispatch that dies of an ENGINE-SHAPED failure (engine
+stopped, queue full, remote transport error) re-queues the request at
+the front of the line for a sibling — requests are only lost to
+explicit sheds (:class:`NoEngineAvailableError` when every candidate
+is down/tried) or their own deadlines, never silently. Model errors
+and deadline misses propagate to the caller untouched: retrying a
+deterministic failure on every engine would just multiply it.
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+from collections import OrderedDict, deque
+
+import numpy as np
+
+from ..telemetry import events as _events
+from ..telemetry import recorder as _recorder
+from ..telemetry import spans as _spans
+from ..telemetry.registry import REGISTRY as _REGISTRY
+from ..telemetry.trace import new_trace_id
+from .engine import ServingEngine
+from .metrics import LatencySummary
+from .queue import (DeadlineExceededError, EngineStoppedError,
+                    InferenceFuture, QueueFullError, ServingError,
+                    validate_tokens)
+
+__all__ = ["ServingRouter", "NoEngineAvailableError", "RemoteEngineError"]
+
+_router_seq = itertools.count()
+
+
+class NoEngineAvailableError(ServingError):
+    """Shed: no routable engine (fleet down, or failover exhausted
+    every candidate for this request)."""
+
+
+class RemoteEngineError(ServingError):
+    """A remote engine endpoint failed at the transport level
+    (unreachable, timeout, non-JSON reply)."""
+
+
+# engine-shaped failures: the request did not fail, the ENGINE did —
+# eligible for failover to a sibling
+_FAILOVER_ERRORS = (EngineStoppedError, QueueFullError, RemoteEngineError)
+
+# remote /submit error_type -> local exception class (anything unknown
+# lands on ServingError so callers still catch the serving taxonomy)
+_ERROR_CLASSES = {
+    "QueueFullError": QueueFullError,
+    "DeadlineExceededError": DeadlineExceededError,
+    "EngineStoppedError": EngineStoppedError,
+}
+
+
+class RouterRequest:
+    """One admitted request and its router-side breadcrumbs: the
+    minted trace id, the ``router/request`` root span every engine-side
+    span ultimately parents under, the engines already tried (failover
+    must not ping-pong), and the absolute deadline (failover burns
+    wall-clock; the remaining budget shrinks with each attempt)."""
+
+    __slots__ = ("tokens", "token_types", "deadline", "future",
+                 "trace_id", "span", "t_submit", "tried", "engine_id",
+                 "requeues")
+
+    def __init__(self, tokens, token_types=None, deadline_ms=None):
+        self.tokens, self.token_types = validate_tokens(tokens,
+                                                        token_types)
+        self.trace_id = new_trace_id("req")
+        self.t_submit = time.monotonic()
+        self.deadline = (self.t_submit + deadline_ms / 1e3
+                         if deadline_ms is not None else None)
+        self.span = _spans.start_span(
+            "router/request", trace_id=self.trace_id,
+            attrs={"tokens": int(self.tokens.size)}, local_root=True)
+        self.future = InferenceFuture()
+        self.future.trace_id = self.trace_id
+        self.tried = set()
+        self.engine_id = None
+        self.requeues = 0
+
+    def remaining_ms(self, now=None):
+        if self.deadline is None:
+            return None
+        return (self.deadline - (now if now is not None
+                                 else time.monotonic())) * 1e3
+
+    def expired(self, now=None):
+        return (self.deadline is not None
+                and (now if now is not None else time.monotonic())
+                > self.deadline)
+
+
+class _Seat:
+    """One engine behind the router: routing state + scoreboard row."""
+
+    kind = "?"
+
+    def __init__(self, engine_id):
+        self.engine_id = str(engine_id)
+        self.outstanding = 0        # router-observed in flight
+        self.dispatched = 0
+        self.up = True              # optimistic until the first poll
+        self.routable = True
+        self.consecutive_failures = 0
+        self.last_change = time.time()
+        self.queue_depth = None
+        self.p95_ms = None
+        self.qps = 0.0
+        self.last_error = None
+        self.last_picked = 0        # round-robin tie-break stamp
+        self._prev_completed = None
+        self._prev_poll = None
+
+    def row(self):
+        return {"kind": self.kind, "up": self.up,
+                "routable": self.routable,
+                "outstanding": self.outstanding,
+                "dispatched": self.dispatched,
+                "queue_depth": self.queue_depth,
+                "p95_ms": self.p95_ms, "qps": self.qps,
+                "consecutive_failures": self.consecutive_failures,
+                "last_change": round(self.last_change, 3),
+                "last_error": self.last_error}
+
+
+class _LocalSeat(_Seat):
+    kind = "local"
+
+    def __init__(self, engine_id, engine):
+        super().__init__(engine_id)
+        self._engine = engine
+
+    def dispatch(self, req, timeout_s, done):
+        fut = self._engine.submit(req.tokens, req.token_types,
+                                  deadline_ms=req.remaining_ms(),
+                                  trace_id=req.trace_id,
+                                  parent_span_id=req.span.span_id)
+
+        def _cb(f):
+            exc = f.exception(timeout=0)
+            done(self, req, exc,
+                 None if exc is not None else f.result(timeout=0))
+
+        fut.add_done_callback(_cb)
+
+    def health(self):
+        snap = self._engine.snapshot()
+        return bool(snap.get("running")), snap
+
+
+class _RemoteSeat(_Seat):
+    kind = "remote"
+
+    def __init__(self, engine_id, base_url, http_timeout_s=5.0):
+        super().__init__(engine_id)
+        self.base_url = base_url.rstrip("/")
+        self._timeout = http_timeout_s
+
+    def _get(self, path, timeout=None):
+        with urllib.request.urlopen(
+                self.base_url + path,
+                timeout=timeout if timeout is not None
+                else self._timeout) as r:
+            return r.read().decode()
+
+    def dispatch(self, req, timeout_s, done):
+        payload = {"tokens": req.tokens.tolist(),
+                   "token_types": (req.token_types.tolist()
+                                   if req.token_types is not None
+                                   else None),
+                   "deadline_ms": req.remaining_ms(),
+                   "trace_id": req.trace_id,
+                   "span_id": req.span.span_id,
+                   "timeout_s": timeout_s}
+
+        # the /submit long-poll blocks for the whole request; a waiter
+        # thread per in-flight remote dispatch keeps the router's
+        # dispatch loop free (in-process seats resolve via callbacks)
+        def _run():
+            exc = value = None
+            body = None
+            try:
+                http_req = urllib.request.Request(
+                    self.base_url + "/submit",
+                    data=json.dumps(payload).encode(),
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(
+                        http_req, timeout=timeout_s + self._timeout) as r:
+                    body = json.loads(r.read().decode())
+            except urllib.error.HTTPError as e:
+                try:
+                    body = json.loads(e.read().decode())
+                except Exception:
+                    exc = RemoteEngineError(
+                        f"engine {self.engine_id}: HTTP {e.code}")
+            except Exception as e:
+                exc = RemoteEngineError(
+                    f"engine {self.engine_id} unreachable: {e!r}")
+            if exc is None:
+                if body.get("ok"):
+                    value = np.asarray(body["result"], np.float32)
+                else:
+                    cls = _ERROR_CLASSES.get(body.get("error_type"),
+                                             ServingError)
+                    exc = cls(body.get("error")
+                              or f"engine {self.engine_id} error")
+            done(self, req, exc, value)
+
+        threading.Thread(
+            target=_run, daemon=True,
+            name=f"mxnet_tpu_router_rpc_{self.engine_id}").start()
+
+    def health(self):
+        try:
+            hz = json.loads(self._get("/healthz"))
+            ok = bool(hz.get("ok"))
+        except urllib.error.HTTPError as e:
+            try:
+                hz = json.loads(e.read().decode())
+            except Exception:
+                hz = {"error": f"HTTP {e.code}"}
+            ok = False
+        except Exception as e:
+            return False, {"error": repr(e)}
+        snap = {}
+        if ok:
+            try:
+                snap = json.loads(self._get("/stats"))
+            except Exception as e:
+                return False, {"error": repr(e)}
+        snap.setdefault("queue_depth", hz.get("queue_depth"))
+        snap.setdefault("seconds_since_beat", hz.get("seconds_since_beat"))
+        return ok, snap
+
+    def metrics_text(self):
+        return self._get("/metrics")
+
+    def traces_summary(self):
+        try:
+            return json.loads(self._get("/traces"))
+        except Exception:
+            return None
+
+    def get_trace(self, trace_id):
+        from urllib.parse import quote
+        try:
+            return json.loads(
+                self._get("/traces/" + quote(trace_id, safe="")))
+        except Exception:
+            return None
+
+
+class ServingRouter:
+    """Least-outstanding front door over N serving engines.
+
+    Parameters
+    ----------
+    engines : optional initial fleet — a ``{engine_id: target}`` dict
+        or an iterable of :class:`ServingEngine` (their own
+        ``engine_id`` names the seat); a ``target`` is an engine
+        handle (in-process) or an ``http://host:port`` exposition base
+        URL (remote).
+    max_queue_depth : router admission bound (like the engine's —
+        backpressure, never unbounded growth).
+    poll_interval_s : health-scoreboard poll period.
+    health_fail_after : consecutive failed polls before an engine is
+        marked down (dispatch-observed stop/transport errors mark it
+        down immediately).
+    dispatch_timeout_s : per-attempt cap a remote long-poll waits for
+        one engine before the transport gives up.
+    """
+
+    COUNTERS = ("submitted", "completed", "failed", "expired",
+                "cancelled", "requeued", "shed_queue_full",
+                "shed_no_engine", "rejected_stopped")
+
+    def __init__(self, engines=None, max_queue_depth=1024,
+                 poll_interval_s=1.0, health_fail_after=1,
+                 default_deadline_ms=None, dispatch_timeout_s=600.0,
+                 router_id=None):
+        self.router_id = (str(router_id) if router_id is not None
+                          else f"router-{os.getpid():x}-"
+                               f"{next(_router_seq)}")
+        self._seats = OrderedDict()
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._queue = deque()
+        self._max_queue_depth = int(max_queue_depth)
+        self._poll_interval_s = float(poll_interval_s)
+        self._fail_after = max(1, int(health_fail_after))
+        self._default_deadline_ms = default_deadline_ms
+        self._dispatch_timeout_s = float(dispatch_timeout_s)
+        self._pending = 0           # admitted, not yet resolved
+        self._closed = False
+        self._abort = False
+        self._started = False
+        self._dispatcher = None
+        self._poller = None
+        self._stop_evt = threading.Event()
+        self._expo = None
+        self._probe_name = f"serving_router_{id(self):x}"
+        self._pick_seq = itertools.count(1)
+        # trace -> engines that served it (bounded): lets the merged
+        # /traces summary attribute LOCAL-engine traces too (remote
+        # attribution comes from which ring a span was scraped off)
+        self._trace_engines = OrderedDict()
+        self._trace_engines_cap = 1024
+
+        self._c = {name: 0 for name in self.COUNTERS}
+        req_total = _REGISTRY.counter(
+            "mxnet_tpu_router_requests_total",
+            "router requests by admission/completion outcome", ("event",))
+        self._reg_c = {name: req_total.labels(event=name)
+                       for name in self.COUNTERS}
+        self._c_dispatch = _REGISTRY.counter(
+            "mxnet_tpu_router_dispatch_total",
+            "requests dispatched, per engine", ("engine_id",))
+        self._c_failover = _REGISTRY.counter(
+            "mxnet_tpu_router_failover_total",
+            "failover requeues, per FAILED engine", ("engine_id",))
+        self._g_up = _REGISTRY.gauge(
+            "mxnet_tpu_router_engine_up",
+            "1 when the engine is routable, else 0", ("engine_id",))
+        self._g_queue_depth = _REGISTRY.gauge(
+            "mxnet_tpu_router_engine_queue_depth",
+            "engine-reported admission-queue depth at last poll",
+            ("engine_id",))
+        self._g_inflight = _REGISTRY.gauge(
+            "mxnet_tpu_router_engine_inflight",
+            "router-observed in-flight requests, per engine",
+            ("engine_id",))
+        self._g_fleet = _REGISTRY.gauge(
+            "mxnet_tpu_router_engines_up", "routable engines")
+        self._c_scrape_err = _REGISTRY.counter(
+            "mxnet_tpu_router_scrape_errors_total",
+            "remote-engine scrape failures at the aggregated /metrics",
+            ("engine_id",))
+        self.total_ms = LatencySummary(
+            4096, _REGISTRY.histogram(
+                "mxnet_tpu_router_latency_ms",
+                "router-observed end-to-end latency", ("stage",))
+            .labels(stage="total"))
+
+        if engines:
+            items = (engines.items() if isinstance(engines, dict)
+                     else ((getattr(e, "engine_id", None), e)
+                           for e in engines))
+            for eid, target in items:
+                self.add_engine(eid, target)
+
+    # -- fleet membership --------------------------------------------------
+    def add_engine(self, engine_id, target):
+        """Register one engine seat: an in-process
+        :class:`ServingEngine` handle, or the base URL string of a
+        remote engine's ``expose()`` endpoint."""
+        if isinstance(target, str):
+            seat = _RemoteSeat(engine_id or target, target)
+        elif isinstance(target, ServingEngine) or hasattr(target, "submit"):
+            seat = _LocalSeat(
+                engine_id if engine_id is not None
+                else getattr(target, "engine_id", None), target)
+        else:
+            raise TypeError(f"engine target {target!r} is neither a "
+                            "ServingEngine nor an exposition URL")
+        with self._lock:
+            if seat.engine_id in self._seats:
+                raise ValueError(
+                    f"engine id {seat.engine_id!r} already registered")
+            self._seats[seat.engine_id] = seat
+            self._g_up.labels(engine_id=seat.engine_id).set(1)
+            self._g_inflight.labels(engine_id=seat.engine_id) \
+                .set_function(lambda s=seat: s.outstanding)
+        _events.emit("router_engine_added", router_id=self.router_id,
+                     engine_id=seat.engine_id, kind=seat.kind)
+        return self
+
+    def engine_ids(self):
+        with self._lock:
+            return list(self._seats)
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        with self._lock:
+            if self._started:
+                return self
+            if self._closed:
+                raise EngineStoppedError("router cannot be restarted")
+            if not self._seats:
+                raise ValueError("router has no engines; add_engine first")
+            self._started = True
+            self._stop_evt.clear()
+            self._dispatcher = threading.Thread(
+                target=self._run_dispatch, daemon=True,
+                name="mxnet_tpu_router_dispatch")
+            self._poller = threading.Thread(
+                target=self._run_poll, daemon=True,
+                name="mxnet_tpu_router_health")
+        # the router is a serving front door: it explains its own
+        # death the same way an engine does (probe + bundle section),
+        # and its bundle carries the FLEET scoreboard
+        _recorder.install()
+        _recorder.register_probe(self._probe_name, self._watchdog_probe)
+        _recorder.add_bundle_section("router_scoreboard", self.snapshot)
+        self._poll_once()           # scoreboard fresh before traffic
+        self._dispatcher.start()
+        self._poller.start()
+        _events.emit("router_start", router_id=self.router_id,
+                     engines=self.engine_ids())
+        return self
+
+    def stop(self, drain=True, timeout=None):
+        """Shut the router down (engines are NOT stopped — the router
+        fronts them, it doesn't own them). ``drain=True`` waits for
+        every admitted request to resolve; ``drain=False`` fails
+        undispatched requests with :class:`EngineStoppedError`."""
+        _events.emit("router_stop", router_id=self.router_id, drain=drain)
+        with self._cond:
+            already = self._closed
+            self._closed = True
+            if not drain:
+                self._abort = True
+            stranded = []
+            if not drain:
+                stranded = list(self._queue)
+                self._queue.clear()
+            self._cond.notify_all()
+        for req in stranded:
+            self._finish(req, EngineStoppedError(
+                "router stopped before request was dispatched"),
+                "cancelled")
+        deadline = (time.monotonic() + timeout
+                    if timeout is not None else None)
+        timed_out = False
+        if drain:
+            with self._cond:
+                while self._pending > 0:
+                    remaining = (None if deadline is None
+                                 else deadline - time.monotonic())
+                    if remaining is not None and remaining <= 0:
+                        timed_out = True
+                        break
+                    self._cond.wait(0.2 if remaining is None
+                                    else min(0.2, remaining))
+        self._stop_evt.set()
+        for t in (self._dispatcher, self._poller):
+            if t is not None:
+                t.join(timeout=5.0)
+        if not already:
+            _recorder.unregister_probe(self._probe_name)
+            _recorder.remove_bundle_section("router_scoreboard")
+        with self._lock:
+            expo, self._expo = self._expo, None
+        if expo is not None:
+            expo.close()
+        if timed_out:
+            raise ServingError("router did not drain in time")
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop(drain=True)
+        return False
+
+    @property
+    def running(self):
+        with self._lock:
+            return (self._started and not self._closed
+                    and self._dispatcher is not None
+                    and self._dispatcher.is_alive())
+
+    # -- client surface ----------------------------------------------------
+    def submit(self, tokens, token_types=None, deadline_ms=None):
+        """Admit one request; returns an :class:`InferenceFuture`
+        whose ``trace_id`` names the request fleet-wide. Sheds loudly:
+        :class:`QueueFullError` (router queue at bound),
+        :class:`NoEngineAvailableError` (no routable engine),
+        :class:`EngineStoppedError` (router not running)."""
+        if deadline_ms is None:
+            deadline_ms = self._default_deadline_ms
+        # validate FIRST (same invariant as the engine: submitted ==
+        # sum of outcome counters, malformed requests touch nothing)
+        req = RouterRequest(tokens, token_types, deadline_ms)
+        self._bump("submitted")
+        # decide under the lock, account/raise OUTSIDE it (self._cond
+        # shares self._lock, which _bump needs — non-reentrant)
+        refusal = None
+        with self._cond:
+            if not self._started or self._closed:
+                refusal = "stopped"
+            elif not any(s.routable for s in self._seats.values()):
+                refusal = "no_engine"
+            elif len(self._queue) >= self._max_queue_depth:
+                refusal = "queue_full"
+            else:
+                self._queue.append(req)
+                self._pending += 1
+                self._cond.notify()
+        if refusal is None:
+            return req.future
+        if refusal == "stopped":
+            self._bump("rejected_stopped")
+            req.span.end(error="rejected: router not running")
+            raise EngineStoppedError("serving router is not running")
+        _events.emit("router_shed", reason=refusal,
+                     router_id=self.router_id, trace_id=req.trace_id)
+        # shed traces are tail-sampling KEEPs by contract, same as the
+        # engine's: the operator debugging overload wants exactly these
+        req.span.set_attr(shed=refusal).force_keep() \
+           .end(error=f"shed: {refusal}")
+        if refusal == "no_engine":
+            self._bump("shed_no_engine")
+            raise NoEngineAvailableError("no routable engine (fleet down)")
+        self._bump("shed_queue_full")
+        raise QueueFullError(
+            f"router queue full (depth {self._max_queue_depth})")
+
+    def infer(self, tokens, token_types=None, deadline_ms=None,
+              timeout=None):
+        return self.submit(tokens, token_types, deadline_ms).result(timeout)
+
+    # -- dispatch ----------------------------------------------------------
+    def _run_dispatch(self):
+        while True:
+            with self._cond:
+                while not self._queue and not self._exit_locked():
+                    self._cond.wait(0.2)
+                if not self._queue:
+                    if self._exit_locked():
+                        return
+                    continue
+                req = self._queue.popleft()
+                seat = None
+                if not req.expired():
+                    seat = self._pick_locked(req.tried)
+                    if seat is not None:
+                        seat.outstanding += 1
+                        seat.dispatched += 1
+            if seat is None:
+                if req.expired():
+                    self._finish(req, DeadlineExceededError(
+                        f"request {req.trace_id} deadline exceeded "
+                        "before dispatch"), "expired")
+                else:
+                    # failover exhausted or fleet down: an explicit
+                    # shed, never a silent drop
+                    self._bump_shed_no_engine(req)
+                continue
+            # a deadline that lapsed since the in-lock check still
+            # dispatches: the engine re-checks at drain, and the
+            # picked seat's outstanding count must balance its _on_done
+            req.engine_id = seat.engine_id
+            self._c_dispatch.labels(engine_id=seat.engine_id).inc()
+            self._note_trace_engine(req.trace_id, seat.engine_id)
+            try:
+                seat.dispatch(req, self._dispatch_timeout_s,
+                              self._on_done)
+            except Exception as e:  # sync admission failure (queue
+                # full, stopped) funnels through the same completion
+                # path so failover/accounting stay uniform
+                self._on_done(seat, req, e, None)
+
+    def _exit_locked(self):
+        return self._closed and (self._abort or self._pending == 0)
+
+    def _pick_locked(self, exclude):
+        # least outstanding; ties break round-robin (least recently
+        # picked) so an idle fleet doesn't hot-spot the first seat
+        best = None
+        for seat in self._seats.values():
+            if not seat.routable or seat.engine_id in exclude:
+                continue
+            if best is None or (seat.outstanding, seat.last_picked) \
+                    < (best.outstanding, best.last_picked):
+                best = seat
+        if best is not None:
+            best.last_picked = next(self._pick_seq)
+        return best
+
+    def _bump_shed_no_engine(self, req):
+        self._bump("shed_no_engine")
+        _events.emit("router_shed", reason="no_engine",
+                     router_id=self.router_id, trace_id=req.trace_id,
+                     tried=sorted(req.tried))
+        req.span.set_attr(shed="no_engine")
+        self._finish(req, NoEngineAvailableError(
+            "no routable engine"
+            + (f" (tried {sorted(req.tried)})" if req.tried else "")),
+            None, force_keep=True)
+
+    def _on_done(self, seat, req, exc, value):
+        with self._lock:
+            seat.outstanding = max(0, seat.outstanding - 1)
+        if exc is None:
+            self._bump("completed")
+            self.total_ms.observe((time.monotonic() - req.t_submit) * 1e3)
+            req.span.set_attr(engine=req.engine_id,
+                              requeues=req.requeues).end()
+            req.future.set_result(value)
+            self._resolve()
+            return
+        if isinstance(exc, _FAILOVER_ERRORS) and not req.expired():
+            # the ENGINE failed, not the request: unroutable-on-death
+            # + re-queue at the front for a sibling. The queue insert
+            # and the abort check share one critical section — an
+            # abort stop() racing in here must not strand the request
+            # in a queue whose dispatcher already exited.
+            if isinstance(exc, (EngineStoppedError, RemoteEngineError)):
+                self._mark(seat, up=False,
+                           reason=f"dispatch: {type(exc).__name__}")
+                seat.last_error = repr(exc)
+            with self._cond:
+                requeued = not self._abort
+                if requeued:
+                    # tried must grow BEFORE the dispatcher can re-pop
+                    # the request, or it may re-pick this same seat
+                    req.requeues += 1
+                    req.tried.add(seat.engine_id)
+                    self._queue.appendleft(req)
+                    self._cond.notify()
+            if requeued:
+                self._bump("requeued")
+                self._c_failover.labels(engine_id=seat.engine_id).inc()
+                _events.emit("router_failover", router_id=self.router_id,
+                             trace_id=req.trace_id,
+                             from_engine=seat.engine_id,
+                             error=repr(exc), requeues=req.requeues)
+                return
+        if isinstance(exc, DeadlineExceededError):
+            counter = "expired"
+        elif isinstance(exc, EngineStoppedError):
+            counter = "cancelled"
+        else:
+            counter = "failed"
+        self._finish(req, exc, counter)
+
+    def _finish(self, req, exc, counter, force_keep=False):
+        if counter is not None:
+            self._bump(counter)
+        if force_keep:
+            req.span.force_keep()
+        req.span.end(error=repr(exc))
+        req.future.set_exception(exc)
+        self._resolve()
+
+    def _resolve(self):
+        with self._cond:
+            self._pending -= 1
+            self._cond.notify_all()
+
+    def _bump(self, name, n=1):
+        with self._lock:
+            self._c[name] += n
+        self._reg_c[name].inc(n)
+
+    def count(self, name):
+        with self._lock:
+            return self._c[name]
+
+    def _note_trace_engine(self, trace_id, engine_id):
+        with self._lock:
+            ids = self._trace_engines.setdefault(trace_id, [])
+            if engine_id not in ids:
+                ids.append(engine_id)
+            self._trace_engines.move_to_end(trace_id)
+            while len(self._trace_engines) > self._trace_engines_cap:
+                self._trace_engines.popitem(last=False)
+
+    # -- health scoreboard -------------------------------------------------
+    def _run_poll(self):
+        while not self._stop_evt.wait(self._poll_interval_s):
+            try:
+                self._poll_once()
+            except Exception:
+                pass            # a poll failure must not kill routing
+
+    def _poll_once(self):
+        now = time.monotonic()
+        with self._lock:
+            seats = list(self._seats.values())
+        up_count = 0
+        for seat in seats:
+            try:
+                ok, snap = seat.health()
+            except Exception as e:
+                ok, snap = False, {"error": repr(e)}
+            beat_age = snap.get("seconds_since_beat")
+            if ok and beat_age is not None \
+                    and beat_age > _recorder.stall_seconds() \
+                    and (snap.get("queue_depth") or 0) > 0:
+                # alive but WEDGED: the worker loop stopped beating
+                # with work queued — unroutable, same as unreachable
+                ok = False
+                snap = dict(snap, error=f"stalled: worker beat "
+                            f"{beat_age:.1f}s old with queued work")
+            if ok:
+                seat.consecutive_failures = 0
+                seat.queue_depth = snap.get("queue_depth")
+                lat = (snap.get("latency") or {}).get("total") or {}
+                seat.p95_ms = lat.get("p95_ms")
+                completed = (snap.get("counters") or {}).get("completed")
+                if (completed is not None
+                        and seat._prev_completed is not None
+                        and seat._prev_poll is not None
+                        and now > seat._prev_poll):
+                    seat.qps = max(0.0, round(
+                        (completed - seat._prev_completed)
+                        / (now - seat._prev_poll), 2))
+                seat._prev_completed = completed
+                seat._prev_poll = now
+                self._mark(seat, up=True)
+            else:
+                seat.consecutive_failures += 1
+                seat.last_error = snap.get("error") or "health check failed"
+                if seat.consecutive_failures >= self._fail_after:
+                    self._mark(seat, up=False, reason=seat.last_error)
+            self._g_queue_depth.labels(engine_id=seat.engine_id) \
+                .set(seat.queue_depth or 0)
+            if seat.routable:
+                up_count += 1
+        self._g_fleet.set(up_count)
+
+    def _mark(self, seat, up, reason=None):
+        if seat.routable == up and seat.up == up:
+            return
+        seat.up = up
+        seat.routable = up
+        seat.last_change = time.time()
+        self._g_up.labels(engine_id=seat.engine_id).set(1 if up else 0)
+        _events.emit("router_engine_state", router_id=self.router_id,
+                     engine_id=seat.engine_id,
+                     state="up" if up else "down", reason=reason)
+        if up:
+            seat.consecutive_failures = 0
+            seat.last_error = None
+
+    def _watchdog_probe(self):
+        """None while the whole fleet is routable; an anomaly dict
+        (which the flight bundle's router_scoreboard.json expands on)
+        when any engine is down."""
+        if not self.running:
+            return None
+        with self._lock:
+            down = [s.engine_id for s in self._seats.values()
+                    if not s.routable]
+            total = len(self._seats)
+        if not down:
+            return None
+        kind = ("router_all_engines_down" if len(down) == total
+                else "router_engine_down")
+        return {"kind": kind, "engines_down": down,
+                "engines_total": total}
+
+    def scoreboard(self):
+        """Per-engine health rows (the /stats ``engines`` section and
+        the flight bundle's fleet view)."""
+        with self._lock:
+            return {sid: seat.row() for sid, seat in self._seats.items()}
+
+    def snapshot(self):
+        board = self.scoreboard()
+        with self._lock:
+            counters = dict(self._c)
+            queue_depth = len(self._queue)
+            pending = self._pending
+        return {"router_id": self.router_id,
+                "running": self.running,
+                "counters": counters,
+                "queue_depth": queue_depth,
+                "pending": pending,
+                "engines": board,
+                "engines_up": sum(1 for r in board.values()
+                                  if r["routable"]),
+                "engines_total": len(board),
+                "latency": {"total": self.total_ms.snapshot()}}
+
+    # -- aggregated observability plane ------------------------------------
+    def _remote_seats(self, engine_filter=None):
+        """Remote seats worth scraping: unroutable seats are SKIPPED —
+        a dead endpoint would stall the aggregated reply by a full
+        http timeout per scrape (past Prometheus's own scrape budget)
+        while contributing nothing."""
+        with self._lock:
+            return [s for s in self._seats.values()
+                    if isinstance(s, _RemoteSeat) and s.routable
+                    and (engine_filter is None
+                         or s.engine_id in engine_filter)]
+
+    def metrics_text(self):
+        """The fleet exposition: this process's registry (router
+        families + every LOCAL engine's labeled families) scrape-merged
+        with each routable remote engine's ``/metrics``."""
+        from ..telemetry.expo import merge_prometheus_texts
+
+        texts = [_REGISTRY.render_prometheus()]
+        for seat in self._remote_seats():
+            try:
+                texts.append(seat.metrics_text())
+            except Exception:
+                self._c_scrape_err.labels(engine_id=seat.engine_id).inc()
+        return merge_prometheus_texts(texts)
+
+    def traces_summary(self):
+        """Fleet /traces: the local span ring (router + in-process
+        engines) merged with every routable remote engine's
+        tail-sampled ring, each kept trace annotated with the engines
+        that served it."""
+        parts = [(None, _spans.traces_summary())]
+        for seat in self._remote_seats():
+            parts.append((seat.engine_id, seat.traces_summary()))
+        merged = _spans.merge_trace_summaries(parts)
+        with self._lock:
+            known = dict(self._trace_engines)
+        for rec in merged["kept"]:
+            for eid in known.get(rec["trace_id"], ()):
+                if eid not in rec["engines"]:
+                    rec["engines"].append(eid)
+        return merged
+
+    def get_trace(self, trace_id):
+        """Fleet /traces/<id>: one merged span tree across every ring
+        that kept the trace — engine-side spans parent under the
+        ``router/request`` root via the propagated span id. When the
+        router dispatched the trace itself it queries only the engines
+        that served it; unknown ids fan out to every routable remote
+        (the trace may predate this router or be engine-local)."""
+        with self._lock:
+            known = self._trace_engines.get(trace_id)
+        parts = [(None, _spans.get_trace(trace_id))]
+        for seat in self._remote_seats(engine_filter=set(known)
+                                       if known else None):
+            parts.append((seat.engine_id, seat.get_trace(trace_id)))
+        return _spans.merge_trace_records(parts)
+
+    def _healthz(self):
+        board = self.scoreboard()
+        up = sum(1 for r in board.values() if r["routable"])
+        with self._lock:
+            queue_depth = len(self._queue)
+        return (self.running and up > 0,
+                {"router_id": self.router_id, "engines_up": up,
+                 "engines_total": len(board),
+                 "queue_depth": queue_depth})
+
+    def expose(self, port=0, host="127.0.0.1"):
+        """Start (or return) the router's exposition server: the
+        AGGREGATED ``/metrics``, fleet ``/healthz`` (ok while ≥1
+        engine is routable), ``/stats`` (scoreboard + counters), and
+        the merged ``/traces`` + ``/traces/<id>``. Closed by
+        :meth:`stop`."""
+        from ..telemetry.expo import TelemetryServer
+
+        with self._lock:
+            if self._closed:
+                raise EngineStoppedError(
+                    "cannot expose telemetry on a stopped router")
+            if self._expo is not None:
+                return self._expo
+            srv = TelemetryServer(healthz_fn=self._healthz,
+                                  stats_fn=self.snapshot,
+                                  metrics_fn=self.metrics_text,
+                                  traces_fn=self.traces_summary,
+                                  trace_fn=self.get_trace,
+                                  port=port, host=host)
+            self._expo = srv
+        _events.emit("telemetry_expose", router_id=self.router_id,
+                     port=srv.port, host=srv.host)
+        return srv

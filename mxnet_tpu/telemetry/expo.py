@@ -15,14 +15,26 @@ dependencies, daemon threads — never blocks process exit):
   ring (:mod:`.spans`), slowest first, plus drop accounting;
 - ``/traces/<id>`` — one trace's full span list (kept ring first,
   then in-flight partials), 404 when the id was dropped or never
-  seen.
+  seen;
+- ``POST /submit`` — optional dispatch endpoint (only when a
+  ``submit_fn`` is attached): JSON body in, ``(status, JSON)`` out —
+  how a :class:`~mxnet_tpu.serving.router.ServingRouter` drives a
+  remote engine.
 
-Attach points: ``ServingEngine.expose(port)`` and
-``kvstore.expose_telemetry(kv, port)`` construct one of these; scripts
-can also run ``start_server(port)`` for bare registry exposition.
+A server constructed with ``metrics_fn``/``traces_fn``/``trace_fn``
+overrides serves those endpoints from the callables instead of the
+process registry/span ring — the router's AGGREGATED fleet view is
+exactly such a server.
+
+Attach points: ``ServingEngine.expose(port)``,
+``ServingRouter.expose(port)`` and ``kvstore.expose_telemetry(kv,
+port)`` construct one of these; scripts can also run
+``start_server(port)`` for bare registry exposition.
 
 Also here: :func:`parse_prometheus_text`, the scrape-side parser the
-loadgen cross-check and ``tools/telemetry_dump.py`` share.
+loadgen cross-check and ``tools/telemetry_dump.py`` share, and
+:func:`merge_prometheus_texts`, the scrape-merge the router's
+aggregated ``/metrics`` is built on.
 """
 from __future__ import annotations
 
@@ -30,10 +42,11 @@ import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from .registry import REGISTRY
+from .registry import REGISTRY, _fmt
 
 __all__ = ["TelemetryServer", "start_server", "parse_prometheus_text",
-           "parse_labels", "histogram_quantile"]
+           "parse_labels", "histogram_quantile",
+           "merge_prometheus_texts"]
 
 PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
@@ -47,16 +60,29 @@ class TelemetryServer:
     healthz_fn : ``() -> (bool, dict)`` liveness check; None = always
         healthy (the process answered, that IS liveness).
     stats_fn : ``() -> dict`` for /stats; None = registry snapshot.
+    metrics_fn : ``() -> str`` overriding /metrics (the router serves
+        its aggregated fleet exposition this way); None = render the
+        registry.
+    traces_fn / trace_fn : ``() -> dict`` / ``(trace_id) -> dict|None``
+        overriding /traces and /traces/<id>; None = the process span
+        ring.
+    submit_fn : ``(payload_dict) -> (status, body_dict)`` enabling
+        ``POST /submit`` (remote engine dispatch); None = 404.
     port : 0 picks a free port (read it back from ``.port``).
     host : bind interface; loopback by default — exposing metrics on
         all interfaces is an operator decision, not a default.
     """
 
     def __init__(self, registry=None, healthz_fn=None, stats_fn=None,
-                 port=0, host="127.0.0.1"):
+                 metrics_fn=None, traces_fn=None, trace_fn=None,
+                 submit_fn=None, port=0, host="127.0.0.1"):
         self.registry = registry if registry is not None else REGISTRY
         self.healthz_fn = healthz_fn
         self.stats_fn = stats_fn
+        self.metrics_fn = metrics_fn
+        self.traces_fn = traces_fn
+        self.trace_fn = trace_fn
+        self.submit_fn = submit_fn
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -68,6 +94,12 @@ class TelemetryServer:
                     server._route(self)
                 except (BrokenPipeError, ConnectionResetError):
                     pass                    # scraper went away mid-reply
+
+            def do_POST(self):
+                try:
+                    server._route_post(self)
+                except (BrokenPipeError, ConnectionResetError):
+                    pass                    # client went away mid-reply
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self._httpd.daemon_threads = True
@@ -90,8 +122,15 @@ class TelemetryServer:
     def _route(self, handler):
         path = handler.path.split("?", 1)[0]
         if path == "/metrics":
-            body = self.registry.render_prometheus().encode()
-            self._reply(handler, 200, PROMETHEUS_CONTENT_TYPE, body)
+            try:
+                text = (self.metrics_fn() if self.metrics_fn is not None
+                        else self.registry.render_prometheus())
+            except Exception as e:
+                self._reply(handler, 500, "text/plain",
+                            f"# metrics error: {e!r}\n".encode())
+                return
+            self._reply(handler, 200, PROMETHEUS_CONTENT_TYPE,
+                        text.encode())
         elif path == "/healthz":
             ok, detail = True, {}
             if self.healthz_fn is not None:
@@ -117,12 +156,14 @@ class TelemetryServer:
 
             from . import spans as _spans
             if path == "/traces" or path == "/traces/":
-                body = json.dumps(_spans.traces_summary(),
-                                  default=str).encode()
+                summary = (self.traces_fn() if self.traces_fn is not None
+                           else _spans.traces_summary())
+                body = json.dumps(summary, default=str).encode()
                 self._reply(handler, 200, "application/json", body)
                 return
             tid = unquote(path[len("/traces/"):])
-            trace = _spans.get_trace(tid)
+            trace = (self.trace_fn(tid) if self.trace_fn is not None
+                     else _spans.get_trace(tid))
             if trace is None:
                 self._reply(handler, 404, "application/json",
                             json.dumps({"error": "unknown trace",
@@ -133,6 +174,31 @@ class TelemetryServer:
         else:
             self._reply(handler, 404, "text/plain",
                         b"try /metrics, /healthz, /stats or /traces\n")
+
+    def _route_post(self, handler):
+        path = handler.path.split("?", 1)[0]
+        if path != "/submit" or self.submit_fn is None:
+            self._reply(handler, 404, "application/json",
+                        json.dumps({"ok": False,
+                                    "error": "no submit endpoint"})
+                        .encode())
+            return
+        try:
+            length = int(handler.headers.get("Content-Length", 0))
+            payload = json.loads(handler.rfile.read(length).decode())
+        except Exception as e:
+            self._reply(handler, 400, "application/json",
+                        json.dumps({"ok": False, "error_type": "BadRequest",
+                                    "error": repr(e)}).encode())
+            return
+        try:
+            code, body = self.submit_fn(payload)
+        except Exception as e:   # the handler must answer, not hang up
+            code, body = 500, {"ok": False,
+                               "error_type": type(e).__name__,
+                               "error": str(e)}
+        self._reply(handler, code, "application/json",
+                    json.dumps(body, default=str).encode())
 
     @staticmethod
     def _reply(handler, code, ctype, body):
@@ -162,6 +228,31 @@ def start_server(port=0, host="127.0.0.1", registry=None, healthz_fn=None,
                            stats_fn=stats_fn, port=port, host=host)
 
 
+def _parse_sample_line(line):
+    """One exposition sample line → ``(key, float)`` or None (comment,
+    blank, malformed). Splits at the last space OUTSIDE a quoted label
+    value."""
+    line = line.strip()
+    if not line or line.startswith("#"):
+        return None
+    in_quote = False
+    split_at = -1
+    prev = ""
+    for i, ch in enumerate(line):
+        if ch == '"' and prev != "\\":
+            in_quote = not in_quote
+        elif ch == " " and not in_quote:
+            split_at = i
+        prev = ch if not (ch == "\\" and prev == "\\") else ""
+    if split_at < 0:
+        return None
+    key, val = line[:split_at], line[split_at + 1:].strip()
+    try:
+        return key, float(val)
+    except ValueError:
+        return None
+
+
 def parse_prometheus_text(text):
     """Parse exposition text into ``{name{labels}: float}`` (labels
     part verbatim, ``""`` for none). Inverse enough of
@@ -169,27 +260,62 @@ def parse_prometheus_text(text):
     handles escaped quotes in label values, skips comments."""
     out = {}
     for line in text.splitlines():
-        line = line.strip()
-        if not line or line.startswith("#"):
-            continue
-        # split at the last space OUTSIDE a quoted label value
-        in_quote = False
-        split_at = -1
-        prev = ""
-        for i, ch in enumerate(line):
-            if ch == '"' and prev != "\\":
-                in_quote = not in_quote
-            elif ch == " " and not in_quote:
-                split_at = i
-            prev = ch if not (ch == "\\" and prev == "\\") else ""
-        if split_at < 0:
-            continue
-        key, val = line[:split_at], line[split_at + 1:].strip()
-        try:
-            out[key] = float(val)
-        except ValueError:
-            continue
+        parsed = _parse_sample_line(line)
+        if parsed is not None:
+            out[parsed[0]] = parsed[1]
     return out
+
+
+def merge_prometheus_texts(texts):
+    """Merge several exposition texts into one (the router's
+    aggregated ``/metrics``): families are unioned (first HELP/TYPE
+    seen wins), and samples with the IDENTICAL series key are SUMMED —
+    engine-labeled serving families stay disjoint per engine, while
+    process-level families (trace counters, watchdog totals) fold into
+    fleet totals. Histogram buckets sum correctly because every
+    input's buckets are already cumulative. Output is deterministic:
+    families sorted by name, samples sorted by key."""
+    helps, types = {}, {}
+    samples = {}
+    for text in texts:
+        for line in text.splitlines():
+            line = line.strip()
+            if line.startswith("# HELP "):
+                parts = line.split(None, 3)
+                if len(parts) >= 3:
+                    helps.setdefault(parts[2],
+                                     parts[3] if len(parts) > 3 else "")
+                continue
+            if line.startswith("# TYPE "):
+                parts = line.split(None, 3)
+                if len(parts) >= 4:
+                    types.setdefault(parts[2], parts[3])
+                continue
+            parsed = _parse_sample_line(line)
+            if parsed is not None:
+                samples[parsed[0]] = samples.get(parsed[0], 0.0) + parsed[1]
+
+    def family_of(key):
+        name = key.split("{", 1)[0]
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix):
+                base = name[: -len(suffix)]
+                if types.get(base) == "histogram":
+                    return base
+        return name
+
+    by_family = {}
+    for key in samples:
+        by_family.setdefault(family_of(key), []).append(key)
+    out = []
+    for fam in sorted(set(by_family) | set(types)):
+        if fam in helps and helps[fam]:
+            out.append(f"# HELP {fam} {helps[fam]}")
+        if fam in types:
+            out.append(f"# TYPE {fam} {types[fam]}")
+        for key in sorted(by_family.get(fam, ())):
+            out.append(f"{key} {_fmt(samples[key])}")
+    return "\n".join(out) + "\n"
 
 
 def parse_labels(key):

@@ -13,6 +13,9 @@ module keeps a bounded in-memory ring of recent run events (a tap on
         events.jsonl   recent structured events (newest last)
         metrics.json   full registry snapshot
         threads.txt    stack trace of every live thread
+        <extra>.json   one per registered bundle section
+                       (add_bundle_section — e.g. the serving
+                       router's router_scoreboard.json fleet view)
 
 The WATCHDOG is one daemon thread polling registered probes (a probe
 returns None when healthy, or an anomaly dict). Subsystems register
@@ -46,7 +49,8 @@ from .registry import REGISTRY
 
 __all__ = ["FlightRecorder", "RECORDER", "install", "dump",
            "register_probe", "unregister_probe", "configure",
-           "stall_seconds", "watchdog"]
+           "stall_seconds", "watchdog", "add_bundle_section",
+           "remove_bundle_section"]
 
 _dump_seq = itertools.count()
 
@@ -91,6 +95,7 @@ class FlightRecorder:
         self._installed = False
         self._prev_excepthook = None
         self._prev_threading_hook = None
+        self._sections = {}             # name -> () -> JSON-able dict
 
     @property
     def out_dir(self):
@@ -104,6 +109,20 @@ class FlightRecorder:
 
     def recent_events(self):
         return list(self._recent)
+
+    # -- extra bundle sections ---------------------------------------------
+    def add_section(self, name, fn):
+        """Register ``fn: () -> JSON-able`` written as ``<name>.json``
+        into every future bundle — subsystems contribute their own
+        post-mortem state (the serving router registers its fleet
+        scoreboard here, so a wedged-engine trip explains the whole
+        fleet, not just this process)."""
+        with self._lock:
+            self._sections[str(name)] = fn
+
+    def remove_section(self, name):
+        with self._lock:
+            self._sections.pop(str(name), None)
 
     # -- install -----------------------------------------------------------
     def install(self, sigusr2=True, excepthook=True):
@@ -197,6 +216,16 @@ class FlightRecorder:
                 json.dump(REGISTRY.snapshot(), f, default=str)
             with open(os.path.join(tmp, "threads.txt"), "w") as f:
                 f.write(_thread_stacks())
+            with self._lock:
+                sections = list(self._sections.items())
+            for name, fn in sections:
+                try:        # a broken section must not lose the bundle
+                    data = fn()
+                    with open(os.path.join(tmp, f"{name}.json"),
+                              "w") as f:
+                        json.dump(data, f, indent=2, default=str)
+                except Exception:
+                    pass
             os.rename(tmp, path)
             _events.emit("flight_recorder_dump", reason=reason, path=path)
             print(f"mxnet_tpu flight recorder: wrote {path} "
@@ -284,6 +313,14 @@ def register_probe(name, probe):
 
 def unregister_probe(name):
     _WATCHDOG.unregister(name)
+
+
+def add_bundle_section(name, fn):
+    RECORDER.add_section(name, fn)
+
+
+def remove_bundle_section(name):
+    RECORDER.remove_section(name)
 
 
 def configure(interval_s=None, stall_s=None, min_dump_interval_s=None,

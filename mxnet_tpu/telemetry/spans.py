@@ -44,7 +44,8 @@ from .trace import (current_trace_id, new_trace_id, reset_trace_id,
 __all__ = ["Span", "SpanRecorder", "RECORDER", "span", "start_span",
            "record_span", "use_span", "current_span", "current_span_id",
            "configure", "enabled", "traces_summary", "get_trace",
-           "slowest_traces", "export_chrome_events", "reset"]
+           "slowest_traces", "export_chrome_events", "reset",
+           "merge_trace_records", "merge_trace_summaries"]
 
 _current_span = contextvars.ContextVar("mxnet_tpu_span", default=None)
 _counter = itertools.count()
@@ -503,6 +504,110 @@ def record_span(name, trace_id, parent_id=None, start_us=None, end_us=None,
               attrs=attrs, ts_us=start_us, wall=wall)
     sp.end(status=status, error=error, end_us=end_us)
     return sp
+
+
+# -- cross-ring merge (the router's fleet-wide /traces view) --------------
+def merge_trace_records(parts):
+    """Merge per-ring ``/traces/<id>`` records for ONE trace into a
+    single span tree — the router's cross-engine trace aggregation.
+
+    ``parts`` is ``[(tag, record_or_None), ...]``: each record is a
+    :meth:`SpanRecorder.get`-shaped dict from one span ring (the
+    router's own process ring, then each REMOTE engine's, scraped over
+    its ``/traces/<id>`` endpoint). A non-None ``tag`` (the engine id)
+    is stamped into each span's ``attrs.engine`` when the span doesn't
+    already carry one, so the merged tree names the engine that served
+    every span. Spans are deduped by span id (a request that visited
+    the same ring twice must not double-render), statuses/durations
+    combine pessimistically, and the record's ``engines`` lists every
+    engine that contributed a span. Returns None when no part had the
+    trace."""
+    spans_out, seen = [], set()
+    merged = None
+    engines = set()
+    for tag, rec in parts:
+        if not rec:
+            continue
+        if merged is None:
+            merged = {"trace_id": rec.get("trace_id"), "status": "ok",
+                      "duration_ms": 0.0, "dropped_spans": 0,
+                      "sources": 0}
+        merged["sources"] += 1
+        for s in rec.get("spans", ()):
+            sid = s.get("span_id")
+            if sid in seen:
+                continue
+            seen.add(sid)
+            s = dict(s)
+            attrs = dict(s.get("attrs") or {})
+            if tag and "engine" not in attrs:
+                attrs["engine"] = tag
+                s["attrs"] = attrs
+            if attrs.get("engine"):
+                engines.add(str(attrs["engine"]))
+            spans_out.append(s)
+        if rec.get("status") == "error":
+            merged["status"] = "error"
+        merged["duration_ms"] = max(merged["duration_ms"],
+                                    rec.get("duration_ms") or 0.0)
+        merged["dropped_spans"] += rec.get("dropped_spans", 0) or 0
+        if rec.get("partial"):
+            merged["partial"] = True
+        if rec.get("keep_reason") and "keep_reason" not in merged:
+            merged["keep_reason"] = rec["keep_reason"]
+    if merged is None:
+        return None
+    # NB: ts_us axes differ across processes (per-process perf_counter)
+    # — the sort gives stable output, parentage is what merges exactly
+    spans_out.sort(key=lambda s: (s.get("ts_us") or 0))
+    ids = {s.get("span_id") for s in spans_out}
+    roots = [s for s in spans_out if s.get("parent_id") not in ids]
+    merged["root"] = roots[0]["name"] if roots else None
+    merged["spans"] = spans_out
+    merged["engines"] = sorted(engines)
+    return merged
+
+
+def merge_trace_summaries(parts):
+    """Merge per-ring ``/traces`` summaries into one fleet summary:
+    kept records union by trace id (a cross-engine trace appears once,
+    with every contributing engine listed), drop/active counts sum.
+    ``parts`` is ``[(tag, summary_or_None), ...]`` like
+    :func:`merge_trace_records`."""
+    by_tid = OrderedDict()
+    out = {"slow_ms": None, "max_traces": None, "dropped_traces": 0,
+           "active_traces": 0, "sources": 0}
+    for tag, summary in parts:
+        if not summary:
+            continue
+        out["sources"] += 1
+        if out["slow_ms"] is None:
+            out["slow_ms"] = summary.get("slow_ms")
+            out["max_traces"] = summary.get("max_traces")
+        out["dropped_traces"] += summary.get("dropped_traces", 0) or 0
+        out["active_traces"] += summary.get("active_traces", 0) or 0
+        for kept in summary.get("kept", ()):
+            rec = by_tid.get(kept["trace_id"])
+            if rec is None:
+                rec = dict(kept)
+                rec["engines"] = []
+                by_tid[kept["trace_id"]] = rec
+            else:
+                rec["spans"] = (rec.get("spans") or 0) \
+                    + (kept.get("spans") or 0)
+                rec["duration_ms"] = max(rec.get("duration_ms") or 0.0,
+                                         kept.get("duration_ms") or 0.0)
+                if kept.get("status") == "error":
+                    rec["status"] = "error"
+                # the front door's root names the trace in a fleet view
+                if kept.get("root") == "router/request":
+                    rec["root"] = kept["root"]
+            if tag and tag not in rec["engines"]:
+                rec["engines"].append(tag)
+    kept = sorted(by_tid.values(),
+                  key=lambda r: -(r.get("duration_ms") or 0.0))
+    out["kept"] = kept
+    return out
 
 
 # -- module-level read helpers (the expo server + tools consume these) ----

@@ -1,0 +1,45 @@
+"""Subprocess helper for the router cross-process goldens: one
+ServingEngine (trivial identity model) exposing the full telemetry
+endpoint set — /metrics, /healthz, /stats, /traces and POST /submit —
+on a free port.
+
+Prints ``PORT <n>`` on stdout once serving, then runs until stdin
+closes (the parent test owns the lifetime). Spans keep EVERYTHING
+(slow_ms=0) so the parent's /traces/<id> scrape always finds the
+request tree regardless of how fast the stub forward ran.
+
+Usage: python serving_router_engine_worker.py <engine_id>
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("MXNET_TPU_WATCHDOG", "0")
+
+import numpy as np  # noqa: E402
+
+from mxnet_tpu import nd  # noqa: E402
+from mxnet_tpu.serving import ServingEngine  # noqa: E402
+from mxnet_tpu.telemetry import spans  # noqa: E402
+
+
+def model(ids, token_types, valid_length, segment_ids, positions):
+    """out[b, s, 0] == ids[b, s]: the parent checks placement."""
+    return nd.array(ids.asnumpy().astype(np.float32)[..., None])
+
+
+def main():
+    engine_id = sys.argv[1] if len(sys.argv) > 1 else "worker"
+    spans.configure(slow_ms=0.0)
+    eng = ServingEngine(model, bucket_lens=(32,), max_rows=2,
+                        engine_id=engine_id)
+    with eng:
+        srv = eng.expose(port=0)
+        print(f"PORT {srv.port}", flush=True)
+        sys.stdin.read()        # parent closes stdin to stop us
+
+
+if __name__ == "__main__":
+    main()

@@ -17,8 +17,9 @@ import mxnet_tpu as mx
 from mxnet_tpu import nd
 from mxnet_tpu.serving import (ContinuousBatcher, DeadlineExceededError,
                                EngineStoppedError, LatencySummary,
-                               QueueFullError, Request, RequestQueue,
-                               RequestTooLongError, ServingEngine)
+                               NoEngineAvailableError, QueueFullError,
+                               Request, RequestQueue, RequestTooLongError,
+                               ServingEngine, ServingRouter)
 from mxnet_tpu.serving.queue import InferenceFuture
 
 
@@ -433,6 +434,39 @@ def test_bench_serving_leg_smoke():
 
 
 @pytest.mark.slow
+def test_bench_serving_router_leg_smoke():
+    """bench.py BENCH_MODEL=serving_router end-to-end at toy size:
+    2 engines behind the router, per-engine share + failover count in
+    the metric line, aggregated-/metrics reconciliation asserted."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ, BENCH_MODEL="serving_router",
+               BENCH_SEQLEN="32", BENCH_VOCAB="200",
+               BENCH_SERVE_UNITS="32", BENCH_SERVE_LAYERS="1",
+               BENCH_SERVE_HEADS="2", BENCH_SERVE_CLIENTS="6",
+               BENCH_SERVE_REQS="4", BENCH_SERVE_ROWS="2",
+               BENCH_SERVE_BUCKETS="8,32", JAX_PLATFORMS="cpu")
+    bench = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "bench.py")
+    r = subprocess.run([sys.executable, bench], env=env,
+                       capture_output=True, text=True, timeout=560)
+    assert r.returncode == 0, r.stderr[-2000:]
+    rec = json.loads([ln for ln in r.stdout.splitlines()
+                      if ln.startswith('{"metric"')][-1])
+    assert rec["metric"] == "bert_serving_router_requests_per_sec"
+    assert rec["value"] > 0
+    assert rec["requests"] == 24
+    assert rec["engines"] == 2 and rec["engines_up"] == 2
+    assert set(rec["per_engine"]) == {"e0", "e1"}
+    assert abs(sum(rec["per_engine"].values()) - 1.0) < 0.01
+    assert rec["failover"] == 0
+    assert rec["telemetry_reconciled"] is True
+
+
+@pytest.mark.slow
 def test_bench_packed_causal_leg_smoke():
     """bench.py BENCH_MODEL=causal_lm (the packed CAUSAL ROADMAP
     follow-up) runs end-to-end at toy size."""
@@ -458,6 +492,218 @@ def test_bench_packed_causal_leg_smoke():
     assert rec["causal"] is True and rec["packed"] is True
     assert rec["packing_efficiency"] >= 0.9
     assert rec["valid_tokens_per_sec"] > 0
+
+
+# ---------------------------------------------------------------------------
+# engine-labeled metric families (ROADMAP per-chip router metrics)
+# ---------------------------------------------------------------------------
+
+def test_engine_metric_families_disjoint_per_engine():
+    """REGRESSION for the shared-family collision: two engines in one
+    process used to double-count one unlabeled family set; with
+    engine_id labels each engine's counters stay disjoint and each
+    equals that engine's own window counts exactly."""
+    from mxnet_tpu.telemetry import REGISTRY
+
+    a = ServingEngine(StubModel(), bucket_lens=(16,), max_rows=1,
+                      engine_id="disjoint-a")
+    b = ServingEngine(StubModel(), bucket_lens=(16,), max_rows=1,
+                      engine_id="disjoint-b")
+    with a, b:
+        for _ in range(3):
+            a.infer([1, 2], timeout=30)
+        for _ in range(5):
+            b.infer([3], timeout=30)
+    req_total = REGISTRY.counter("mxnet_tpu_serving_requests_total", "",
+                                 ("engine_id", "event"))
+    for eng, n in ((a, 3), (b, 5)):
+        for event in ("submitted", "completed"):
+            child = req_total.labels(engine_id=eng.engine_id, event=event)
+            assert child.value == n, (eng.engine_id, event, child.value)
+    lat = REGISTRY.get("mxnet_tpu_serving_latency_ms")
+    assert lat.labels(engine_id="disjoint-a", stage="total").count == 3
+    assert lat.labels(engine_id="disjoint-b", stage="total").count == 5
+    # the rendered exposition carries both engines' labeled children
+    text = REGISTRY.render_prometheus()
+    assert ('mxnet_tpu_serving_requests_total{engine_id="disjoint-a",'
+            'event="completed"} 3') in text
+    assert ('mxnet_tpu_serving_requests_total{engine_id="disjoint-b",'
+            'event="completed"} 5') in text
+
+
+# ---------------------------------------------------------------------------
+# multi-engine router: routing, failover, shed, scoreboard
+# ---------------------------------------------------------------------------
+
+def _stub_engine(engine_id, delay=0.0, **kw):
+    kw.setdefault("bucket_lens", (16,))
+    kw.setdefault("max_rows", 2)
+    return ServingEngine(StubModel(delay=delay), engine_id=engine_id, **kw)
+
+
+def test_router_roundtrip_distribution_and_snapshot():
+    a = _stub_engine("rt-a")
+    b = _stub_engine("rt-b")
+    router = ServingRouter(engines=[a, b], poll_interval_s=0.2)
+    rs = np.random.RandomState(5)
+    with a, b, router:
+        toks = [rs.randint(1, 60, n).astype(np.int32)
+                for n in (3, 7, 5, 9, 2, 6, 4, 8)]
+        outs = [router.submit(t).result(timeout=30) for t in toks]
+        for t, o in zip(toks, outs):
+            assert np.array_equal(o[:, 0].astype(np.int32), t)
+        snap = router.snapshot()
+    c = snap["counters"]
+    assert c["completed"] == len(toks) == c["submitted"]
+    dispatched = {eid: row["dispatched"]
+                  for eid, row in snap["engines"].items()}
+    assert sum(dispatched.values()) == len(toks)
+    # least-outstanding over sequential submits: both engines serve
+    assert all(n > 0 for n in dispatched.values()), dispatched
+    assert snap["engines_up"] == 2
+    assert snap["latency"]["total"]["count"] == len(toks)
+
+
+def test_router_failover_requeues_to_sibling():
+    """An engine dying mid-load (stop drain=False) fails its
+    admitted-but-undispatched requests with EngineStoppedError; the
+    router re-queues them to the sibling — zero client-visible
+    failures, failover counted per failed engine."""
+    from mxnet_tpu.telemetry import REGISTRY
+
+    live = _stub_engine("fo-live", max_rows=1)
+    dying = _stub_engine("fo-dying", max_rows=1)
+    live.start()
+    dying.start()
+    # poll slow enough that DISPATCH discovers the death, not the poll
+    router = ServingRouter(engines=[live, dying], poll_interval_s=30.0)
+    router.start()
+    try:
+        dying.stop(drain=False)
+        futs = [router.submit([7, 8]) for _ in range(8)]
+        outs = [f.result(timeout=30) for f in futs]
+        assert all(o[0, 0] == 7.0 for o in outs)      # nothing lost
+        snap = router.snapshot()
+        assert snap["counters"]["completed"] == 8
+        assert snap["counters"]["requeued"] >= 1
+        assert snap["engines"]["fo-dying"]["routable"] is False
+        fo = REGISTRY.counter("mxnet_tpu_router_failover_total", "",
+                              ("engine_id",))
+        assert fo.labels(engine_id="fo-dying").value >= 1
+    finally:
+        router.stop()
+        live.stop()
+
+
+def test_router_sheds_when_all_engines_down():
+    """Fleet down => submit sheds with a DISTINCT error (and the shed
+    trace is force-kept, same contract as engine sheds)."""
+    from mxnet_tpu.telemetry import spans
+
+    eng = _stub_engine("down-1")
+    eng.start()
+    router = ServingRouter(engines=[eng], poll_interval_s=0.1,
+                           health_fail_after=1)
+    router.start()
+    try:
+        assert router.infer([1, 2], timeout=30)[0, 0] == 1.0
+        eng.stop(drain=True)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if not router.snapshot()["engines"]["down-1"]["routable"]:
+                break
+            time.sleep(0.05)
+        snap = router.snapshot()
+        assert snap["engines_up"] == 0, snap["engines"]
+        with pytest.raises(NoEngineAvailableError):
+            router.submit([3, 4])
+        assert router.count("shed_no_engine") == 1
+        kept = spans.traces_summary()["kept"]
+        shed = [k for k in kept if k["root"] == "router/request"
+                and k["status"] == "error"]
+        assert shed, kept
+    finally:
+        router.stop()
+
+
+def test_router_engine_overflow_fails_over_then_sheds():
+    """A saturated engine (its own queue at bound) is an ENGINE
+    failure from the router's view: the request retries a sibling;
+    with no sibling left it sheds LOUDLY — and a stopped router
+    refuses new work with a distinct error."""
+    slow = ServingEngine(StubModel(delay=0.3), bucket_lens=(16,),
+                         max_rows=1, max_queue_depth=1,
+                         engine_id="ovf-slow")
+    roomy = _stub_engine("ovf-roomy", max_rows=1)
+    router = ServingRouter(engines=[slow, roomy], poll_interval_s=30.0)
+    with slow, roomy, router:
+        # saturate: one in flight + one queued at the slow engine, the
+        # rest overflow — every overflow must land on the sibling
+        futs = [router.submit([9, 9]) for _ in range(10)]
+        outs = [f.result(timeout=60) for f in futs]
+        assert all(o[0, 0] == 9.0 for o in outs)       # nothing lost
+        snap = router.snapshot()
+        assert snap["counters"]["completed"] == 10
+
+    # single saturated engine, no sibling: the shed is explicit
+    slow2 = ServingEngine(StubModel(delay=0.3), bucket_lens=(16,),
+                          max_rows=1, max_queue_depth=1,
+                          engine_id="ovf-solo")
+    router2 = ServingRouter(engines=[slow2], poll_interval_s=30.0)
+    with slow2, router2:
+        futs, shed = [], 0
+        for _ in range(8):
+            futs.append(router2.submit([3]))
+        for f in futs:
+            try:
+                f.result(timeout=60)
+            except NoEngineAvailableError:
+                shed += 1
+        assert shed >= 1                 # overflow shed, not silent
+        assert shed == router2.count("shed_no_engine")
+        assert router2.count("completed") == len(futs) - shed
+    with pytest.raises(EngineStoppedError):
+        router2.submit([5])
+    assert router2.count("rejected_stopped") == 1
+
+
+def test_router_scoreboard_events_and_recovery(tmp_path):
+    """up→down→up transitions emit router_engine_state events and the
+    scoreboard gauges follow."""
+    from mxnet_tpu.telemetry import REGISTRY, events
+
+    events.configure(str(tmp_path / "router.jsonl"))
+    try:
+        eng = _stub_engine("sb-1")
+        eng.start()
+        srv = eng.expose()
+        router = ServingRouter(poll_interval_s=0.1, health_fail_after=1)
+        # remote seat against the engine's own exposition endpoint
+        router.add_engine("sb-remote", f"http://127.0.0.1:{srv.port}")
+        router.start()
+        try:
+            out = router.infer([5, 6], timeout=30)
+            assert out.shape == (2, 1)
+            eng.stop(drain=True)         # endpoint goes away
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                row = router.snapshot()["engines"]["sb-remote"]
+                if not row["routable"]:
+                    break
+                time.sleep(0.05)
+            assert not router.snapshot()["engines"]["sb-remote"][
+                "routable"]
+            up = REGISTRY.gauge("mxnet_tpu_router_engine_up", "",
+                                ("engine_id",))
+            assert up.labels(engine_id="sb-remote").value == 0
+        finally:
+            router.stop()
+        log_path = events.get_log().path
+    finally:
+        events.configure(None)
+    states = events.read_events(log_path, event="router_engine_state")
+    assert any(e["engine_id"] == "sb-remote" and e["state"] == "down"
+               for e in states), states
 
 
 def test_engine_pool_modes():

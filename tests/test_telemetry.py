@@ -339,19 +339,21 @@ def test_serving_stats_window_public_and_reset_preserves():
 
 def test_compile_cache_and_bucket_counters():
     reg_hits = REGISTRY.counter("mxnet_tpu_serving_compile_cache_total",
-                                "", ("result",))
-    h0 = reg_hits.labels(result="hit").value
-    m0 = reg_hits.labels(result="miss").value
+                                "", ("engine_id", "result"))
     eng = ServingEngine(StubModel(), bucket_lens=(16,), max_rows=1)
+    eid = eng.engine_id
+    # engine_id labels (ROADMAP per-chip metrics): a FRESH engine's
+    # children start at zero — no cross-engine accumulation to diff
+    assert reg_hits.labels(engine_id=eid, result="hit").value == 0
     with eng:
         eng.infer([1, 2], timeout=30)
         eng.infer([3, 4], timeout=30)
         eng.infer([5], timeout=30)
-    assert reg_hits.labels(result="miss").value - m0 >= 1
-    assert reg_hits.labels(result="hit").value - h0 >= 1
+    assert reg_hits.labels(engine_id=eid, result="miss").value >= 1
+    assert reg_hits.labels(engine_id=eid, result="hit").value >= 1
     tokens = REGISTRY.counter("mxnet_tpu_serving_batch_tokens_total",
-                              "", ("bucket",))
-    assert tokens.labels(bucket=16).value > 0
+                              "", ("engine_id", "bucket"))
+    assert tokens.labels(engine_id=eid, bucket=16).value > 0
 
 
 # ---------------------------------------------------------------------------

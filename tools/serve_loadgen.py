@@ -9,6 +9,12 @@ Shared by the bench serving leg (bench.py BENCH_MODEL=serving imports
 report). Closed loop: each client thread submits its next request only
 after the previous response lands — the standard serving-bench shape
 (latency is client-observed, throughput is total completed / wall).
+
+``--router N`` fronts N engines with a ``ServingRouter`` and drives
+the ROUTER: the report gains the per-engine request distribution, and
+the scrape cross-check reconciles the router's AGGREGATED ``/metrics``
+delta (router counter family + engine-labeled serving families summed
+across engines) against client-side accounting.
 """
 from __future__ import annotations
 
@@ -30,13 +36,50 @@ _SERVER_EVENTS = ("submitted", "completed", "rejected_queue_full",
                   "rejected_too_long", "rejected_stopped", "expired",
                   "cancelled", "failed")
 
+_ROUTER_EVENTS = ("submitted", "completed", "failed", "expired",
+                  "cancelled", "requeued", "shed_queue_full",
+                  "shed_no_engine", "rejected_stopped")
 
-def _requests_total_delta(before, after):
+
+def _sum_by_event(parsed, family):
+    """Sum a scraped counter family by its ``event`` label across all
+    other labels — with engine_id-labeled serving families (and a
+    router aggregating N engines) the reconciliation is against the
+    FLEET total, not one child."""
+    from mxnet_tpu.telemetry.expo import parse_labels
+
     out = {}
-    for ev in _SERVER_EVENTS:
-        key = f'mxnet_tpu_serving_requests_total{{event="{ev}"}}'
-        out[ev] = int(after.get(key, 0.0) - before.get(key, 0.0))
+    for key, val in parsed.items():
+        name, labels = parse_labels(key)
+        if name != family or "event" not in labels:
+            continue
+        out[labels["event"]] = out.get(labels["event"], 0.0) + val
     return out
+
+
+def _requests_total_delta(before, after,
+                          family="mxnet_tpu_serving_requests_total",
+                          events=_SERVER_EVENTS):
+    b = _sum_by_event(before, family)
+    a = _sum_by_event(after, family)
+    return {ev: int(a.get(ev, 0.0) - b.get(ev, 0.0)) for ev in events}
+
+
+def _per_engine_completed_delta(before, after):
+    """Completed-request delta per engine_id — the distribution the
+    router report prints next to the router's own dispatch counts."""
+    from mxnet_tpu.telemetry.expo import parse_labels
+
+    out = {}
+    for parsed, sign in ((before, -1), (after, 1)):
+        for key, val in parsed.items():
+            name, labels = parse_labels(key)
+            if name != "mxnet_tpu_serving_requests_total" \
+                    or labels.get("event") != "completed":
+                continue
+            eid = labels.get("engine_id", "?")
+            out[eid] = out.get(eid, 0.0) + sign * val
+    return {eid: int(v) for eid, v in out.items() if v}
 
 
 def cross_check(outcomes, attempts, delta):
@@ -57,10 +100,32 @@ def cross_check(outcomes, attempts, delta):
     return not mismatches, mismatches
 
 
+def cross_check_router(outcomes, attempts, delta):
+    """The router-mode reconciliation: client accounting vs the
+    ROUTER's counter family (engine-side counters can't balance the
+    books — a router-shed request never reaches an engine, a
+    failed-over one reaches two). ``requeued`` is informational: a
+    requeue is not a client-visible outcome."""
+    checks = {
+        "submitted": (attempts, delta["submitted"]),
+        "completed": (outcomes["ok"], delta["completed"]),
+        "shed": (outcomes["shed"],
+                 delta["shed_queue_full"] + delta["shed_no_engine"]),
+        "expired": (outcomes["expired"], delta["expired"]),
+        "errors": (outcomes["error"],
+                   delta["failed"] + delta["rejected_stopped"]
+                   + delta["cancelled"]),
+    }
+    mismatches = [f"{name}: client={c} server={s}"
+                  for name, (c, s) in checks.items() if c != s]
+    return not mismatches, mismatches
+
+
 def run_load(engine, n_clients=8, requests_per_client=16,
              min_len=16, max_len=512, vocab=30522, deadline_ms=None,
              result_timeout_s=600.0, seed=0, metrics_url=None):
-    """Drive ``engine`` with n_clients closed-loop threads.
+    """Drive ``engine`` — a ServingEngine OR a ServingRouter (same
+    submit surface) — with n_clients closed-loop threads.
 
     Returns a stats dict: client-observed latency percentiles,
     completed/shed/expired counts, requests_per_sec and
@@ -82,7 +147,12 @@ def run_load(engine, n_clients=8, requests_per_client=16,
 
     import numpy as np
 
-    from mxnet_tpu.serving import (DeadlineExceededError, QueueFullError)
+    from mxnet_tpu.serving import (DeadlineExceededError,
+                                   NoEngineAvailableError, QueueFullError)
+
+    # a router reports against its OWN counter family and adds the
+    # per-engine request distribution to the report
+    is_router = hasattr(engine, "scoreboard")
 
     before = scrape_metrics(metrics_url) if metrics_url else None
 
@@ -108,7 +178,7 @@ def run_load(engine, n_clients=8, requests_per_client=16,
                 with lock:
                     outcomes["expired"] += 1
                 continue
-            except QueueFullError:
+            except (QueueFullError, NoEngineAvailableError):
                 with lock:
                     outcomes["shed"] += 1
                 time.sleep(0.005)       # polite backoff, stay closed-loop
@@ -157,25 +227,46 @@ def run_load(engine, n_clients=8, requests_per_client=16,
               "slowest_traces": [{"trace_id": tid, "ms": round(ms, 3)}
                                  for ms, tid in slowest],
               "engine": engine.snapshot()}
+    if is_router:
+        snap = report["engine"]
+        report["per_engine"] = {eid: row["dispatched"]
+                                for eid, row in snap["engines"].items()}
+        report["failovers"] = snap["counters"].get("requeued", 0)
+        report["engines_up"] = snap.get("engines_up")
     if metrics_url:
         from mxnet_tpu.telemetry import histogram_quantile
 
         after = scrape_metrics(metrics_url)
-        delta = _requests_total_delta(before, after)
-        reconciled, mismatches = cross_check(
-            outcomes, n_clients * requests_per_client, delta)
+        attempts = n_clients * requests_per_client
+        if is_router:
+            delta = _requests_total_delta(
+                before, after, family="mxnet_tpu_router_requests_total",
+                events=_ROUTER_EVENTS)
+            reconciled, mismatches = cross_check_router(
+                outcomes, attempts, delta)
+        else:
+            delta = _requests_total_delta(before, after)
+            reconciled, mismatches = cross_check(
+                outcomes, attempts, delta)
         # quantiles over the DELTA of the bucket counts: the estimate
         # covers this load window only, not warmup traffic
         window = {k: v - before.get(k, 0.0) for k, v in after.items()}
+        lat_family = ("mxnet_tpu_router_latency_ms" if is_router
+                      else "mxnet_tpu_serving_latency_ms")
         est = {f"p{q}_ms_est": (round(v, 3) if v is not None else None)
                for q in (50, 99)
                for v in [histogram_quantile(
-                   window, "mxnet_tpu_serving_latency_ms", q,
-                   match={"stage": "total"})]}
+                   window, lat_family, q, match={"stage": "total"})]}
         report["server"] = {"requests_total_delta": delta,
                             "reconciled": reconciled,
                             "mismatches": mismatches,
                             "latency": est}
+        if is_router:
+            # aggregated /metrics carries every engine's labeled
+            # families: the per-engine share as PROMETHEUS sees it,
+            # next to the router's own dispatch accounting
+            report["server"]["per_engine_completed"] = \
+                _per_engine_completed_delta(before, after)
     return report
 
 
@@ -209,39 +300,67 @@ def _main():
                     help="skip exposition + scrape cross-check")
     ap.add_argument("--event-log", default=None,
                     help="write the structured JSONL run-event log here")
+    ap.add_argument("--router", type=int, default=0, metavar="N",
+                    help="front N in-process engines with a "
+                    "ServingRouter and drive the ROUTER endpoint: the "
+                    "report adds the per-engine request distribution "
+                    "and the cross-check reconciles the router's "
+                    "aggregated /metrics delta against client-side "
+                    "accounting")
     args = ap.parse_args()
+
+    import contextlib
 
     import mxnet_tpu as mx
     from mxnet_tpu.gluon.model_zoo.bert import BERTModel, bert_serving_entry
-    from mxnet_tpu.serving import ServingEngine
+    from mxnet_tpu.serving import ServingEngine, ServingRouter
 
     buckets = tuple(int(b) for b in args.buckets.split(","))
-    net = BERTModel(vocab_size=args.vocab, units=args.units,
-                    hidden_size=4 * args.units, num_layers=args.layers,
-                    num_heads=args.heads, max_length=args.max_len,
-                    dropout=0.0, attention_dropout=0.0, use_pooler=False)
-    net.initialize(init=mx.initializer.Normal(0.02))
     if args.event_log:
         from mxnet_tpu.telemetry import events
         events.configure(args.event_log, component="serve_loadgen")
 
-    engine = ServingEngine(bert_serving_entry(net), bucket_lens=buckets,
-                           max_rows=args.max_rows, pool=args.pool)
-    with engine:
+    def make_engine(engine_id=None):
+        net = BERTModel(vocab_size=args.vocab, units=args.units,
+                        hidden_size=4 * args.units,
+                        num_layers=args.layers, num_heads=args.heads,
+                        max_length=args.max_len, dropout=0.0,
+                        attention_dropout=0.0, use_pooler=False)
+        net.initialize(init=mx.initializer.Normal(0.02))
+        return ServingEngine(bert_serving_entry(net), bucket_lens=buckets,
+                             max_rows=args.max_rows, pool=args.pool,
+                             engine_id=engine_id)
+
+    with contextlib.ExitStack() as stack:
+        if args.router > 0:
+            engines = [stack.enter_context(make_engine(f"e{i}"))
+                       for i in range(args.router)]
+            target = stack.enter_context(ServingRouter(engines=engines))
+        else:
+            engines = [stack.enter_context(make_engine())]
+            target = engines[0]
         metrics_url = None
         if not args.no_expose:
-            srv = engine.expose(port=args.expose_port)
+            srv = target.expose(port=args.expose_port)
             metrics_url = srv.url("/metrics")
             print(f"# telemetry: {srv.url('/metrics')} "
                   f"{srv.url('/healthz')} {srv.url('/stats')}",
                   file=sys.stderr)
-        engine.warmup()
-        report = run_load(engine, n_clients=args.clients,
+        for eng in engines:
+            eng.warmup()
+        report = run_load(target, n_clients=args.clients,
                           requests_per_client=args.requests,
                           min_len=args.min_len, max_len=args.max_len,
                           vocab=args.vocab, deadline_ms=args.deadline_ms,
                           metrics_url=metrics_url)
     print(json.dumps(report, indent=2))
+    if report.get("per_engine"):
+        total = max(1, sum(report["per_engine"].values()))
+        print("# per-engine distribution: "
+              + " ".join(f"{eid}={n} ({n / total:.0%})"
+                         for eid, n in sorted(
+                             report["per_engine"].items())),
+              file=sys.stderr)
     if report.get("slowest_traces"):
         print("# slowest traces (span trees, while the ring holds "
               "them: python tools/telemetry_dump.py --trace <id> "

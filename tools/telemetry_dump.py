@@ -20,6 +20,13 @@ are exactly the slow/errored/shed requests worth opening); `--trace
 <id>` renders one trace's span tree, indented by parentage, with each
 span's wall time and SELF time (duration minus direct children) so
 the stage that actually ate the request is visible at a glance.
+
+`--fleet` points at a ServingRouter endpoint and prints the one-screen
+fleet view: the per-engine scoreboard (up/routable, outstanding,
+queue depth, qps, p95), the router's outcome counters, and the
+slowest cross-engine traces with the engines that served each::
+
+    python tools/telemetry_dump.py --fleet http://127.0.0.1:9200
 """
 from __future__ import annotations
 
@@ -157,6 +164,51 @@ def dump_traces(summary, out=None, top=10):
               f"{rec['status']:<7} {rec.get('keep_reason', '')}", file=out)
 
 
+def dump_fleet(base, out=None, top=5):
+    """One-screen fleet view from a router endpoint: scoreboard +
+    counters + slowest cross-engine traces (with serving engines)."""
+    out = out if out is not None else sys.stdout
+    stats = json.loads(_fetch(base + "/stats"))
+    engines = stats.get("engines", {})
+    up = stats.get("engines_up", 0)
+    print(f"-- fleet {stats.get('router_id', '?')}: {up}/"
+          f"{stats.get('engines_total', len(engines))} engines up, "
+          f"router queue {stats.get('queue_depth')}, pending "
+          f"{stats.get('pending')} " + "-" * 10, file=out)
+    print(f"  {'engine':<16} {'kind':<7} {'up':<5} {'outst':>6} "
+          f"{'queue':>6} {'qps':>8} {'p95 ms':>9} {'dispatched':>11} "
+          f"last_error", file=out)
+    for eid, row in sorted(engines.items()):
+        p95 = row.get("p95_ms")
+        print(f"  {eid:<16} {row.get('kind', '?'):<7} "
+              f"{str(bool(row.get('routable'))):<5} "
+              f"{row.get('outstanding', 0):>6} "
+              f"{row.get('queue_depth') if row.get('queue_depth') is not None else '-':>6} "
+              f"{row.get('qps', 0):>8} "
+              f"{(f'{p95:.1f}' if p95 is not None else '-'):>9} "
+              f"{row.get('dispatched', 0):>11} "
+              f"{row.get('last_error') or ''}", file=out)
+    counters = stats.get("counters", {})
+    nonzero = {k: v for k, v in counters.items() if v}
+    print(f"  router counters: {nonzero or counters}", file=out)
+    try:
+        traces = json.loads(_fetch(base + "/traces"))
+    except Exception as e:
+        print(f"  (traces unavailable: {e!r})", file=out)
+        return
+    kept = traces.get("kept", [])
+    print(f"-- slowest of {len(kept)} kept traces "
+          f"(dropped={traces.get('dropped_traces')}) " + "-" * 14,
+          file=out)
+    if not kept:
+        print("  (none kept — nothing slow/errored/shed yet)", file=out)
+    for rec in kept[:top]:
+        engines_str = ",".join(rec.get("engines") or []) or "?"
+        print(f"  {rec['trace_id']:<32} {rec.get('root') or '?':<18} "
+              f"{rec['duration_ms']:>10.2f} ms  {rec.get('status'):<7} "
+              f"engines={engines_str}", file=out)
+
+
 def dump_trace_tree(trace, out=None):
     """Indented span-tree render with per-span self-time."""
     out = out if out is not None else sys.stdout
@@ -214,6 +266,10 @@ def main(argv=None):
     ap.add_argument("--traces", action="store_true",
                     help="table the tail-sampled trace ring "
                     "(slowest first) from the server's /traces")
+    ap.add_argument("--fleet", action="store_true",
+                    help="one-screen fleet view from a ServingRouter "
+                    "endpoint: per-engine scoreboard + slowest "
+                    "cross-engine traces")
     ap.add_argument("--trace", default=None, metavar="ID",
                     help="render one trace's span tree from "
                     "/traces/<ID>")
@@ -233,7 +289,9 @@ def main(argv=None):
                 ok, hz = False, {"error": repr(e)}
             print(f"healthz: {'OK' if ok else 'UNHEALTHY'} {hz}")
             rc = 0 if ok else 2
-        if args.trace:
+        if args.fleet:
+            dump_fleet(base, top=args.top)
+        elif args.trace:
             import urllib.error
             from urllib.parse import quote
             try:
