@@ -64,9 +64,11 @@ def _peak_tflops():
     (override with MXNET_TPU_PEAK_TFLOPS). Sources: public TPU specs."""
     import jax
 
-    env = os.environ.get("MXNET_TPU_PEAK_TFLOPS")
+    from mxnet_tpu import envvars
+
+    env = envvars.get("MXNET_TPU_PEAK_TFLOPS")
     if env:
-        return float(env)
+        return env
     kind = jax.devices()[0].device_kind.lower()
     for tag, peak in (("v6e", 918.0), ("v6", 918.0), ("v5p", 459.0),
                       ("v5e", 197.0), ("v5 lite", 197.0), ("v4", 275.0),
@@ -81,9 +83,11 @@ def _peak_hbm_gbps():
     MXNET_TPU_PEAK_HBM_GBPS). Sources: public TPU specs."""
     import jax
 
-    env = os.environ.get("MXNET_TPU_PEAK_HBM_GBPS")
+    from mxnet_tpu import envvars
+
+    env = envvars.get("MXNET_TPU_PEAK_HBM_GBPS")
     if env:
-        return float(env)
+        return env
     kind = jax.devices()[0].device_kind.lower()
     for tag, peak in (("v6e", 1640.0), ("v6", 1640.0), ("v5p", 2765.0),
                       ("v5e", 819.0), ("v5 lite", 819.0), ("v4", 1228.0),
@@ -299,6 +303,7 @@ def main():
     _setup_cache()
 
     import mxnet_tpu as mx
+    from mxnet_tpu import envvars
     from mxnet_tpu.gluon.block import functionalize
     from mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
 
@@ -430,7 +435,7 @@ def main():
             "images/sec/chip", imgs_per_sec / BASELINE_IMGS_PER_SEC,
             flops_per_step=flops, sec_per_step=dt / STEPS / CHAIN,
             bytes_per_step=nbytes, batch=BATCH, dtype=DTYPE,
-            conv_nhwc=os.environ.get("MXNET_TPU_CONV_NHWC", "0") == "1",
+            conv_nhwc=envvars.get("MXNET_TPU_CONV_NHWC"),
             s2d_stem=s2d, remat_stages=list(remat), chain=CHAIN, **extras)
 
 

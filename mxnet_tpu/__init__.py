@@ -27,7 +27,9 @@ __version__ = "0.1.0"
 # Override with MXNET_TPU_MATMUL_PRECISION=default for max f32 speed.
 import jax as _jax
 
-_prec = _os.environ.get("MXNET_TPU_MATMUL_PRECISION", "high")
+from . import envvars
+
+_prec = envvars.get("MXNET_TPU_MATMUL_PRECISION")
 try:
     _jax.config.update("jax_default_matmul_precision", _prec)
 except Exception:

@@ -1,0 +1,273 @@
+"""Typed registry of every ``MXNET_TPU_*`` environment variable.
+
+The reference documented its ~80 ``MXNET_*`` knobs in one hand-written
+faq page (``docs/faq/env_var.md``) and read them ad hoc all over the C++
+tree; the TPU backend grew the same scatter (31 ``MXNET_TPU_*`` reads
+across kernels, dist, serving and telemetry) until this module. Now:
+
+- every variable is DECLARED here once — name, type, default, doc,
+  subsystem scope — and READ here only: :func:`get` returns the parsed,
+  typed value (or the declared default), :func:`get_raw` the raw string.
+  ``tools/mxlint``'s ``env-raw-read`` pass forbids raw ``os.environ``
+  access to ``MXNET_TPU_*`` names anywhere else in ``mxnet_tpu/``,
+  ``tools/`` and ``bench.py``, and its ``env-unregistered`` check
+  rejects :func:`get` calls for names not declared here;
+- the README "Configuration reference" table is GENERATED from this
+  registry (``python -m tools.mxlint --write-envdoc``) and the mxlint
+  gate fails when a registered variable is missing from it — the docs
+  cannot go stale silently.
+
+This module must stay stdlib-only and import nothing from the package:
+``mxnet_tpu/__init__.py`` reads ``MXNET_TPU_MATMUL_PRECISION`` through
+it before jax is even configured.
+
+Parsing conventions: ``bool`` treats ``"" / 0 / false / no / off``
+(case-insensitive) as False and anything else as True; ``int`` and
+``float`` fall back to the declared default on an empty value. A
+variable with default ``None`` reads as ``None`` when unset — call
+sites own their fallback chain (e.g. the ``DMLC_*`` compat names).
+"""
+from __future__ import annotations
+
+import os
+from collections import OrderedDict
+
+__all__ = ["EnvVar", "ENVVARS", "register", "get", "get_raw", "is_set",
+           "all_vars", "markdown_table"]
+
+_FALSY = ("", "0", "false", "no", "off")
+
+
+class EnvVar:
+    """One declared variable: its name, value type (``bool``/``int``/
+    ``float``/``str``/``path``), default, one-line doc, and subsystem
+    scope (groups the generated reference table)."""
+
+    __slots__ = ("name", "vtype", "default", "doc", "scope")
+
+    def __init__(self, name, vtype, default, doc, scope):
+        if not name.startswith("MXNET_TPU_"):
+            raise ValueError(f"{name!r} is not an MXNET_TPU_* variable")
+        if vtype not in ("bool", "int", "float", "str", "path"):
+            raise ValueError(f"unknown env var type {vtype!r}")
+        self.name = name
+        self.vtype = vtype
+        self.default = default
+        self.doc = doc
+        self.scope = scope
+
+    def parse(self, raw):
+        """Raw string → typed value (the declared default when the
+        value is empty or unparsable — a typo'd knob must degrade to
+        documented behavior, not crash process startup)."""
+        if raw is None:
+            return self.default
+        raw = raw.strip()
+        if self.vtype == "bool":
+            return raw.lower() not in _FALSY
+        if raw == "":
+            return self.default
+        try:
+            if self.vtype == "int":
+                return int(raw, 0)
+            if self.vtype == "float":
+                return float(raw)
+        except ValueError:
+            return self.default
+        return raw      # str / path
+
+    def describe_default(self):
+        if self.default is None:
+            return "unset"
+        if self.vtype == "bool":
+            return "on" if self.default else "off"
+        return str(self.default)
+
+
+#: declaration order is documentation order (grouped by scope)
+ENVVARS: "OrderedDict[str, EnvVar]" = OrderedDict()
+
+
+def register(name, vtype, default, doc, scope="runtime"):
+    if name in ENVVARS:
+        raise ValueError(f"env var {name} registered twice")
+    var = EnvVar(name, vtype, default, doc, scope)
+    ENVVARS[name] = var
+    return var
+
+
+def get(name):
+    """The typed value of a registered variable (its default when
+    unset). Raises ``KeyError`` for undeclared names — registering here
+    IS the act of creating a configuration knob."""
+    return ENVVARS[name].parse(os.environ.get(name))
+
+
+def get_raw(name):
+    """The raw string (None when unset) of a registered variable — for
+    fallback chains that must distinguish unset from falsy values."""
+    ENVVARS[name]            # undeclared names fail just like get()
+    return os.environ.get(name)
+
+
+def is_set(name):
+    ENVVARS[name]
+    return name in os.environ
+
+
+def all_vars():
+    return list(ENVVARS.values())
+
+
+# ---------------------------------------------------------------------------
+# the registry — one entry per variable, grouped by subsystem
+# ---------------------------------------------------------------------------
+
+# -- core runtime -----------------------------------------------------------
+register("MXNET_TPU_SYMBOLIC_JIT", "bool", True,
+         "compiled symbolic executor for Module/simple_bind; ``0`` falls "
+         "back to the eager per-op DAG walk (bug-bisection ladder)",
+         scope="runtime")
+register("MXNET_TPU_MATMUL_PRECISION", "str", "high",
+         "f32 matmul precision: ``high`` = multi-pass bf16 (~f32 "
+         "accuracy), ``default`` = fastest single-pass bf16",
+         scope="runtime")
+register("MXNET_TPU_CONV_NHWC", "bool", False,
+         "execute 2-D convs internally in NHWC (bench knob; measured "
+         "±0 — XLA's layout assignment is already optimal)",
+         scope="runtime")
+register("MXNET_TPU_EMB_GRAD", "str", "plain",
+         "embedding-backward lowering: ``plain`` take-VJP scatter, "
+         "``sorted`` sort+segment-sum, ``bf16`` bf16-accumulated "
+         "scatter (A/B knob; both alternatives measured slower on v5e)",
+         scope="runtime")
+register("MXNET_TPU_MODEL_STORE", "path", None,
+         "model-zoo download/cache root (falls back to "
+         "``$MXNET_HOME/models``, then ``~/.mxnet/models``)",
+         scope="runtime")
+
+# -- Pallas kernels ---------------------------------------------------------
+register("MXNET_TPU_PALLAS_INTERPRET", "bool", False,
+         "run Pallas kernels in interpret mode (off-TPU kernel testing)",
+         scope="kernels")
+register("MXNET_TPU_DISABLE_PALLAS", "bool", False,
+         "force the plain jnp/XLA lowering for every fused-kernel op",
+         scope="kernels")
+register("MXNET_TPU_FLASH_BLOCK_Q", "int", 512,
+         "flash-attention query-tile cap (v5e-measured optimum 512)",
+         scope="kernels")
+register("MXNET_TPU_FLASH_BLOCK_K", "int", 2048,
+         "flash-attention kv-tile cap (effective tile is "
+         "``min(seq, cap)``)", scope="kernels")
+register("MXNET_TPU_FLASH_SPLIT_BWD", "bool", False,
+         "use the two-kernel flash-attention backward instead of the "
+         "fused one-pass kernel (A/B + fallback)", scope="kernels")
+register("MXNET_TPU_FUSED_LSTM", "bool", False,
+         "opt-in whole-sequence Pallas LSTM kernel (XLA's scan measured "
+         "faster at WikiText-2 shapes; see BASELINE.md)", scope="kernels")
+register("MXNET_TPU_XENT_BLOCK_N", "int", 128,
+         "fused softmax-CE kernel row-tile cap", scope="kernels")
+register("MXNET_TPU_XENT_BLOCK_V", "int", 2048,
+         "fused softmax-CE kernel vocab-tile cap", scope="kernels")
+
+# -- distributed ------------------------------------------------------------
+register("MXNET_TPU_COORDINATOR", "str", None,
+         "jax.distributed coordinator ``host:port`` (set by "
+         "``tools/launch.py``; ``DMLC_PS_ROOT_URI``/``_PORT`` accepted "
+         "for script compat)", scope="dist")
+register("MXNET_TPU_NUM_PROCS", "int", None,
+         "world size for multi-process rendezvous (``DMLC_NUM_WORKER`` "
+         "compat fallback)", scope="dist")
+register("MXNET_TPU_PROC_ID", "int", None,
+         "this process's rank (``DMLC_WORKER_ID`` compat fallback)",
+         scope="dist")
+register("MXNET_TPU_LOCAL_RANK", "int", 0,
+         "rank within this host (set per worker by ``tools/launch.py``; "
+         "horovod-shim ``local_rank``)", scope="dist")
+
+# -- telemetry: events / spans ----------------------------------------------
+register("MXNET_TPU_EVENT_LOG", "path", None,
+         "structured JSONL run-event log path (a directory gets one "
+         "``events-<pid>.jsonl`` per process)", scope="telemetry")
+register("MXNET_TPU_EVENT_LOG_MAX_MB", "float", None,
+         "rotate the event log at this size (MB); unset = no rotation",
+         scope="telemetry")
+register("MXNET_TPU_EVENT_LOG_KEEP", "int", 3,
+         "rotated event-log files kept (``read_events`` reads across "
+         "rotations)", scope="telemetry")
+register("MXNET_TPU_SPANS", "bool", True,
+         "span recording (tail-sampled request tracing); ``0`` disables "
+         "— the ring is bounded either way", scope="telemetry")
+register("MXNET_TPU_TRACE_SLOW_MS", "float", 250.0,
+         "tail-sampling keep threshold: traces whose local root ran "
+         "longer are kept in full", scope="telemetry")
+register("MXNET_TPU_TRACE_BUFFER", "int", 64,
+         "kept-trace ring size", scope="telemetry")
+register("MXNET_TPU_TRACE_MAX_SPANS", "int", 256,
+         "per-trace span cap (a leaked trace cannot grow the process)",
+         scope="telemetry")
+register("MXNET_TPU_TRACE_MAX_ACTIVE", "int", 256,
+         "in-flight (not yet sampled) trace buffer cap",
+         scope="telemetry")
+
+# -- telemetry: flight recorder / watchdog ----------------------------------
+register("MXNET_TPU_FLIGHT_DIR", "path", None,
+         "flight-recorder bundle directory (default "
+         "``./mxnet_tpu_flight``)", scope="telemetry")
+register("MXNET_TPU_WATCHDOG", "bool", True,
+         "the stall-watchdog daemon thread; ``0`` disables",
+         scope="telemetry")
+register("MXNET_TPU_WATCHDOG_INTERVAL_S", "float", 5.0,
+         "watchdog probe poll period (seconds)", scope="telemetry")
+register("MXNET_TPU_WATCHDOG_STALL_S", "float", 30.0,
+         "shared stall threshold watchdog probes compare against "
+         "(seconds)", scope="telemetry")
+
+# -- bench ------------------------------------------------------------------
+register("MXNET_TPU_PEAK_TFLOPS", "float", None,
+         "override the per-chip peak dense bf16 TFLOP/s used for "
+         "bench.py MFU (unset = inferred from device kind)",
+         scope="bench")
+register("MXNET_TPU_PEAK_HBM_GBPS", "float", None,
+         "override the per-chip peak HBM bandwidth GB/s used for "
+         "bench.py roofline fields", scope="bench")
+
+# -- tests / dev harness ----------------------------------------------------
+register("MXNET_TPU_TEST_REAL_DEVICE", "bool", False,
+         "run the test suite against the real backend instead of the "
+         "virtual 8-device CPU mesh", scope="tests")
+register("MXNET_TPU_NIGHTLY", "bool", False,
+         "enable the large-tensor nightly test tier (>2^31-element "
+         "allocations)", scope="tests")
+register("MXNET_TPU_DRYRUN_REAL", "bool", False,
+         "``dryrun_multichip`` uses real devices instead of a forced "
+         "CPU mesh", scope="tests")
+
+
+_SCOPE_TITLES = OrderedDict([
+    ("runtime", "Core runtime"),
+    ("kernels", "Pallas kernels"),
+    ("dist", "Distributed"),
+    ("telemetry", "Telemetry / observability"),
+    ("bench", "Benchmarks"),
+    ("tests", "Tests / dev harness"),
+])
+
+
+def markdown_table():
+    """The generated README "Configuration reference" body: one table
+    per scope, every registered variable present exactly once."""
+    lines = []
+    for scope, title in _SCOPE_TITLES.items():
+        rows = [v for v in ENVVARS.values() if v.scope == scope]
+        if not rows:
+            continue
+        lines.append(f"**{title}**")
+        lines.append("")
+        lines.append("| Variable | Type | Default | Effect |")
+        lines.append("|---|---|---|---|")
+        for v in rows:
+            lines.append(f"| `{v.name}` | {v.vtype} | "
+                         f"`{v.describe_default()}` | {v.doc} |")
+        lines.append("")
+    return "\n".join(lines).rstrip() + "\n"

@@ -12,8 +12,8 @@ XLA's fusion + buffer planner replace nnvm PlanMemory; set
 """
 from __future__ import annotations
 
-import os
 
+from . import envvars
 from .base import MXNetError
 from .context import current_context
 
@@ -47,7 +47,7 @@ class Executor:
         self.outputs = []
         self._monitor_callback = None
         self._recording = False
-        self._jit = os.environ.get("MXNET_TPU_SYMBOLIC_JIT", "1") == "1"
+        self._jit = envvars.get("MXNET_TPU_SYMBOLIC_JIT")
         # (shape/dtype/training key) -> Op wrapping the jitted graph fn;
         # shared across reshape()-derived executors (BucketingModule: one
         # compiled computation per bucket, nothing re-allocated)

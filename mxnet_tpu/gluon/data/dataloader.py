@@ -25,6 +25,7 @@ import numpy as np
 
 from ...base import MXNetError
 from ...ndarray import NDArray, array
+from ...telemetry import events as _telemetry_events
 from .sampler import SequentialSampler, RandomSampler, BatchSampler
 
 __all__ = ["DataLoader", "DevicePrefetcher", "default_batchify_fn",
@@ -332,8 +333,11 @@ class _MultiProcessIter:
             res = self._pending.popleft()
             try:
                 _discard_shm(res.get(timeout=self._loader._timeout))
-            except Exception:
-                pass
+            except Exception as e:
+                # keep draining (every leaked result pins /dev/shm),
+                # but a discard that itself fails is worth a trace
+                _telemetry_events.emit("dataloader_discard_error",
+                                       error=repr(e))
 
     def __del__(self):
         try:

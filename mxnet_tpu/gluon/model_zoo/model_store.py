@@ -28,7 +28,8 @@ _INDEX = "model_index.json"
 
 
 def _default_root():
-    env = os.environ.get("MXNET_TPU_MODEL_STORE")
+    from ... import envvars
+    env = envvars.get("MXNET_TPU_MODEL_STORE")
     if env:
         return env
     home = os.environ.get("MXNET_HOME")

@@ -266,7 +266,9 @@ class PrefetchingIter(DataIter):
         self._stop = False
         self._exhausted = False
         self._cv = threading.Condition(self._lock)
-        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread = threading.Thread(target=self._worker,
+                                        name="mxnet_tpu_io_prefetch",
+                                        daemon=True)
         self._thread.start()
 
     @property

@@ -34,6 +34,7 @@ from typing import Optional
 import jax
 import numpy as np
 
+from . import envvars
 from .base import MXNetError
 from .ndarray import NDArray
 from .ndarray.ndarray import _wrap
@@ -454,7 +455,9 @@ class _ParameterServer:
             srv.bind(("0.0.0.0", port))
         srv.listen(num_workers + 2)
         self._srv = srv
-        threading.Thread(target=self._accept_loop, daemon=True).start()
+        threading.Thread(target=self._accept_loop,
+                         name="mxnet_tpu_kvstore_accept",
+                         daemon=True).start()
 
     def _accept_loop(self):
         import threading
@@ -464,6 +467,7 @@ class _ParameterServer:
             except OSError:
                 return
             threading.Thread(target=self._serve, args=(conn,),
+                             name=f"mxnet_tpu_kvstore_serve_fd{conn.fileno()}",
                              daemon=True).start()
 
     def _watchdog_probe(self):
@@ -879,9 +883,9 @@ class AsyncDistKVStore(KVStore):
         super().__init__("dist_async")
         import socket
         import time as _time
-        self._rank = int(os.environ.get("MXNET_TPU_PROC_ID")
+        self._rank = int(envvars.get_raw("MXNET_TPU_PROC_ID")
                          or os.environ.get("DMLC_WORKER_ID") or 0)
-        self._n = int(os.environ.get("MXNET_TPU_NUM_PROCS")
+        self._n = int(envvars.get_raw("MXNET_TPU_NUM_PROCS")
                       or os.environ.get("DMLC_NUM_WORKER") or 1)
         host = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
         # the jax.distributed coordinator (dist_sync) owns ROOT_PORT;
@@ -980,9 +984,14 @@ class AsyncDistKVStore(KVStore):
                         f"(lost on an earlier RPC); cannot send {op!r}")
                 self._rpc_inflight = (op, _time.monotonic())
                 try:
+                    # _rpc_lock IS the socket mutex: request/reply pairs
+                    # from concurrent pushers must not interleave on one
+                    # TCP stream, so holding it across the round trip is
+                    # the design, not an accident
+                    # mxlint: disable=lock-blocking-call
                     nbytes_out = _send_msg(
                         sock, (op, key, payload, tid, sp.span_id))
-                    sized = _recv_msg_sized(sock)
+                    sized = _recv_msg_sized(sock)  # mxlint: disable=lock-blocking-call
                 except OSError:
                     self._sock = None   # /healthz must see the loss
                     raise
@@ -1118,7 +1127,7 @@ class HorovodKVStore(DistKVStore):
     def local_rank(self):
         # set per worker by tools/launch.py (rank within this host);
         # single-process or unlaunched runs are local rank 0
-        return int(os.environ.get("MXNET_TPU_LOCAL_RANK", "0"))
+        return envvars.get("MXNET_TPU_LOCAL_RANK")
 
     def push(self, key, value, priority=0):
         raise MXNetError("push is not supported by horovod kvstore; "
@@ -1205,9 +1214,9 @@ def _maybe_init_distributed() -> bool:
     initialize the local XLA backend, after which the multi-process
     rendezvous is impossible (initialize() must precede any backend
     use)."""
-    coord = os.environ.get("MXNET_TPU_COORDINATOR")
-    n = os.environ.get("MXNET_TPU_NUM_PROCS") or os.environ.get("DMLC_NUM_WORKER")
-    pid = os.environ.get("MXNET_TPU_PROC_ID") or os.environ.get("DMLC_WORKER_ID")
+    coord = envvars.get("MXNET_TPU_COORDINATOR")
+    n = envvars.get_raw("MXNET_TPU_NUM_PROCS") or os.environ.get("DMLC_NUM_WORKER")
+    pid = envvars.get_raw("MXNET_TPU_PROC_ID") or os.environ.get("DMLC_WORKER_ID")
     if not coord and os.environ.get("DMLC_PS_ROOT_URI"):
         coord = (os.environ["DMLC_PS_ROOT_URI"] + ":"
                  + os.environ.get("DMLC_PS_ROOT_PORT", "9000"))

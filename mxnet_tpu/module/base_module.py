@@ -151,7 +151,7 @@ class BaseModule:
 
         step_ms, samples_per_sec = _fit_telemetry("module_fit")
         for epoch in range(begin_epoch, num_epoch):
-            tic = time.time()
+            tic = time.perf_counter()
             eval_metric.reset()
             nbatch = 0
             nsample = 0
@@ -195,10 +195,11 @@ class BaseModule:
 
             for name, val in eval_metric.get_name_value():
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
-            self.logger.info("Epoch[%d] Time cost=%.3f", epoch, time.time() - tic)
+            self.logger.info("Epoch[%d] Time cost=%.3f", epoch,
+                             time.perf_counter() - tic)
             _events.emit("fit_epoch", loop="module_fit", epoch=epoch,
                          batches=nbatch, samples=nsample,
-                         seconds=round(time.time() - tic, 3))
+                         seconds=round(time.perf_counter() - tic, 3))
 
             arg_p, aux_p = self.get_params()
             self.set_params(arg_p, aux_p, allow_missing=False, force_init=True,

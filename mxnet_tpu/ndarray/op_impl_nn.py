@@ -14,7 +14,6 @@ through every op (the AMP/fp16 analog).
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
@@ -64,7 +63,8 @@ _CONV_DN = {1: ("NCW", "OIW", "NCW"), 2: ("NCHW", "OIHW", "NCHW"),
 
 
 def _conv_nhwc():
-    return os.environ.get("MXNET_TPU_CONV_NHWC", "0") == "1"
+    from .. import envvars
+    return envvars.get("MXNET_TPU_CONV_NHWC")
 
 
 @register_op("Convolution")
@@ -791,14 +791,14 @@ _take_rows_bf16_grad.defvjp(_take_rows_fwd, _take_rows_bf16_bwd)
 def embedding(data, weight, input_dim=None, output_dim=None, dtype="float32",
               sparse_grad=False):
     idx = data.astype(jnp.int32)
-    import os as _os
     # MXNET_TPU_EMB_GRAD=sorted: sort+segment-sum table gradient
     # (kvstore unique-rowid merge in-graph). A/B on v5e (W&D b8192,
     # chain=10): 428.9k vs 618.1k ex/s — the 213k-row sort+permute
     # costs MORE than scatter collision serialization saves, so the
     # default stays the plain take VJP; the option remains for
     # narrow-table/high-collision workloads.
-    mode = _os.environ.get("MXNET_TPU_EMB_GRAD", "plain")
+    from .. import envvars as _envvars
+    mode = _envvars.get("MXNET_TPU_EMB_GRAD")
     if mode == "sorted":
         return _take_rows_sorted_grad(weight, idx)
     if mode == "bf16":

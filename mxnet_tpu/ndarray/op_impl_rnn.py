@@ -119,9 +119,8 @@ def _run_layer(x, w_i2h, w_h2h, b_i2h, b_h2h, h0, c0, mode, reverse=False):
         c_last = None
     elif mode == "lstm":
         from ..ops.pallas._util import pallas_ok_for
-        import os as _os
-        if pallas_ok_for(x) and _os.environ.get(
-                "MXNET_TPU_FUSED_LSTM", "0") == "1":
+        from .. import envvars as _envvars
+        if pallas_ok_for(x) and _envvars.get("MXNET_TPU_FUSED_LSTM"):
             # OPT-IN fused whole-sequence kernel (weight-stationary
             # recurrent matmul + gates in VMEM, one kernel for the
             # T-step loop — the cudnn_rnn-inl.h analog). Measured on

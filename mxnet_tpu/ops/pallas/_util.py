@@ -2,26 +2,24 @@
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
+
+from ... import envvars
 
 try:  # newer jax exports the x64 context manager at top level
     _enable_x64 = jax.enable_x64
 except AttributeError:  # older jax: experimental namespace
     from jax.experimental import enable_x64 as _enable_x64
 
-_TRUE = ("1", "true", "yes", "on")
-
-
 def interpret_mode() -> bool:
     """Run pallas_call in interpreter mode (CPU testing of kernels)."""
-    return os.environ.get("MXNET_TPU_PALLAS_INTERPRET", "").lower() in _TRUE
+    return envvars.get("MXNET_TPU_PALLAS_INTERPRET")
 
 
 def pallas_enabled() -> bool:
     """Should ops dispatch to the Pallas kernel path?"""
-    if os.environ.get("MXNET_TPU_DISABLE_PALLAS", "").lower() in _TRUE:
+    if envvars.get("MXNET_TPU_DISABLE_PALLAS"):
         return False
     if interpret_mode():
         return True

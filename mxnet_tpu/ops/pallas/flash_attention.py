@@ -440,9 +440,9 @@ def _pick_blocks(sq, skv):
     # the whole row. VMEM: the f32 score block is bq*bk*4 = 4 MB at
     # 512x2048 (d<=128 keeps operand blocks ~1 MB), inside the ~16 MB
     # budget. Override per run with MXNET_TPU_FLASH_BLOCK_Q/K.
-    import os
-    bq_cap = int(os.environ.get("MXNET_TPU_FLASH_BLOCK_Q", "512"))
-    bk_cap = int(os.environ.get("MXNET_TPU_FLASH_BLOCK_K", "2048"))
+    from ... import envvars
+    bq_cap = envvars.get("MXNET_TPU_FLASH_BLOCK_Q")
+    bk_cap = envvars.get("MXNET_TPU_FLASH_BLOCK_K")
     bq = min(bq_cap, _pad_len(sq, 8))
     bk = min(bk_cap, _pad_len(skv, 128))
     return bq, bk
@@ -587,7 +587,7 @@ def _flash_fwd(q, k, v, sm_scale, causal, q_offset, interpret,
 def _flash_bwd(q, k, v, o, lse, do, sm_scale, causal, q_offset, interpret,
                block_q=None, block_k=None, dlse=None, kv_lens=None,
                segment_ids=None):
-    import os
+    from ... import envvars as _envvars
 
     b, h, sq, d = q.shape
     skv = k.shape[2]
@@ -638,7 +638,7 @@ def _flash_bwd(q, k, v, o, lse, do, sm_scale, causal, q_offset, interpret,
     # that memory/write cliff outweighs the recompute saving, so long
     # multi-k-block rows (S > 2*block_k cap) take the split path whose
     # dq accumulates in VMEM scratch
-    if nk <= 2 and os.environ.get("MXNET_TPU_FLASH_SPLIT_BWD", "0") != "1":
+    if nk <= 2 and not _envvars.get("MXNET_TPU_FLASH_SPLIT_BWD"):
         return _flash_bwd_fused(qf, kf, vf, dof, lsef, delta, kvlf, seg_ops,
                                 (b, h, sq, skv, d), nq, nk, common,
                                 interpret, k.dtype, v.dtype, q.dtype)

@@ -70,11 +70,9 @@ def _pad_to(n, m):
 
 
 def _blocks(n, v):
-    import os
-    bn = min(int(os.environ.get("MXNET_TPU_XENT_BLOCK_N", "128")),
-             _pad_to(n, 8))
-    bv = min(int(os.environ.get("MXNET_TPU_XENT_BLOCK_V", "2048")),
-             _pad_to(v, 128))
+    from ... import envvars
+    bn = min(envvars.get("MXNET_TPU_XENT_BLOCK_N"), _pad_to(n, 8))
+    bv = min(envvars.get("MXNET_TPU_XENT_BLOCK_V"), _pad_to(v, 128))
     return bn, bv
 
 

@@ -19,6 +19,7 @@ from collections import deque
 import numpy as np
 
 from ..base import MXNetError
+from ..telemetry import events as _events
 from ..telemetry import spans as _spans
 from ..telemetry.trace import new_trace_id
 
@@ -69,7 +70,13 @@ class InferenceFuture:
 
     def _finish(self, value, exc):
         # first write wins: a batch-failure sweep arriving after a
-        # request was already fulfilled must not clobber its result
+        # request was already fulfilled must not clobber its result.
+        # Callbacks are SNAPSHOT under the lock and invoked OUTSIDE it:
+        # a done-callback may block, take other locks, or reentrantly
+        # submit/resolve — under the future's lock any of those
+        # deadlocks the completing thread (the engine worker) against
+        # every other waiter. tools/mxlint's lock-callback rule pins
+        # this shape.
         with self._lock:
             if self._event.is_set():
                 return
@@ -77,11 +84,18 @@ class InferenceFuture:
             self._exc = exc
             self._event.set()
             callbacks, self._callbacks = self._callbacks, []
-        for cb in callbacks:    # outside the lock: a callback may block
-            try:
-                cb(self)
-            except Exception:
-                pass            # a broken observer must not lose the result
+        for cb in callbacks:
+            self._run_callback(cb)
+
+    def _run_callback(self, cb):
+        try:
+            cb(self)
+        except Exception as e:
+            # a broken observer must not lose the result — but it must
+            # not vanish either (thread-hygiene contract)
+            _events.emit("future_callback_error",
+                         trace_id=getattr(self, "trace_id", None),
+                         error=repr(e))
 
     def set_result(self, value):
         self._finish(value, None)
@@ -91,16 +105,14 @@ class InferenceFuture:
 
     def add_done_callback(self, fn):
         """Call ``fn(self)`` once the future resolves (immediately when
-        it already has) — the router's completion hook; exceptions from
-        ``fn`` are swallowed."""
+        it already has) — the router's completion hook. ``fn`` runs
+        OUTSIDE the future's lock (it may reenter submit); exceptions
+        are swallowed after leaving a ``future_callback_error`` event."""
         with self._lock:
             if not self._event.is_set():
                 self._callbacks.append(fn)
                 return
-        try:
-            fn(self)
-        except Exception:
-            pass
+        self._run_callback(fn)
 
     def exception(self, timeout=None):
         if not self._event.wait(timeout):

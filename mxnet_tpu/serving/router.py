@@ -720,8 +720,11 @@ class ServingRouter:
         while not self._stop_evt.wait(self._poll_interval_s):
             try:
                 self._poll_once()
-            except Exception:
-                pass            # a poll failure must not kill routing
+            except Exception as e:
+                # a poll failure must not kill routing, but a silent
+                # one hides a scoreboard gone stale — leave a trace
+                _events.emit("router_poll_error",
+                             router_id=self.router_id, error=repr(e))
 
     def _poll_once(self):
         now = time.monotonic()

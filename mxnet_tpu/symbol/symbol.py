@@ -383,7 +383,10 @@ class Symbol:
                     out = jax.eval_shape(
                         lambda *xs: _sym_note(node._op, call_op_fn(
                             node._op, xs, params)), *structs)
-                except Exception:
+                # not a worker loop: this fixpoint PROBES eval_shape per
+                # node, and "this node won't infer yet" is the expected
+                # negative — skip and let iteration retry
+                except Exception:  # mxlint: disable=silent-except
                     continue
                 if not isinstance(out, (tuple, list)):
                     out = [out]

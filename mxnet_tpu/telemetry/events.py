@@ -44,6 +44,7 @@ import os
 import threading
 import time
 
+from .. import envvars
 from .trace import current_trace_id
 
 __all__ = ["EventLog", "configure", "emit", "get_log", "read_events",
@@ -75,12 +76,11 @@ class EventLog:
         self.path = str(path)
         self.component = component
         if max_bytes is None:
-            mb = os.environ.get("MXNET_TPU_EVENT_LOG_MAX_MB")
-            max_bytes = int(float(mb) * 1024 * 1024) if mb else None
+            mb = envvars.get("MXNET_TPU_EVENT_LOG_MAX_MB")
+            max_bytes = int(mb * 1024 * 1024) if mb else None
         self.max_bytes = max_bytes
         self.keep = (int(keep) if keep is not None
-                     else int(os.environ.get("MXNET_TPU_EVENT_LOG_KEEP",
-                                             3)))
+                     else envvars.get("MXNET_TPU_EVENT_LOG_KEEP"))
         self._lock = threading.Lock()
         self._f = open(self.path, "a", buffering=1)
         try:
@@ -190,7 +190,7 @@ def get_log():
     if _global is None and not _env_checked:
         with _lock:
             if _global is None and not _env_checked:
-                env = os.environ.get("MXNET_TPU_EVENT_LOG")
+                env = envvars.get("MXNET_TPU_EVENT_LOG")
                 if env:
                     try:
                         _global = EventLog(_resolve_path(env))

@@ -43,6 +43,7 @@ import time
 import traceback
 from collections import deque
 
+from .. import envvars
 from . import events as _events
 from . import spans as _spans
 from .registry import REGISTRY
@@ -55,8 +56,8 @@ __all__ = ["FlightRecorder", "RECORDER", "install", "dump",
 _dump_seq = itertools.count()
 
 _config = {
-    "interval_s": float(os.environ.get("MXNET_TPU_WATCHDOG_INTERVAL_S", 5.0)),
-    "stall_s": float(os.environ.get("MXNET_TPU_WATCHDOG_STALL_S", 30.0)),
+    "interval_s": envvars.get("MXNET_TPU_WATCHDOG_INTERVAL_S"),
+    "stall_s": envvars.get("MXNET_TPU_WATCHDOG_STALL_S"),
     "min_dump_interval_s": 60.0,
     "recent_events": 512,
 }
@@ -68,10 +69,16 @@ def stall_seconds():
 
 
 def _thread_stacks():
-    """Every live thread's current stack, formatted for threads.txt."""
+    """Every live thread's current stack, formatted for threads.txt.
+    Threads are listed by NAME (mxlint's thread-hygiene pass makes
+    every framework thread carry one — ``mxnet_tpu_<subsystem>_<role>``)
+    so a bundle attributes each stack to its subsystem at a glance."""
     frames = sys._current_frames()
-    lines = []
-    for t in threading.enumerate():
+    threads = sorted(threading.enumerate(), key=lambda t: t.name)
+    lines = [f"# {len(threads)} live threads "
+             f"({sum(1 for t in threads if t.daemon)} daemon), "
+             f"sorted by name", ""]
+    for t in threads:
         lines.append(f"--- thread {t.name} (ident={t.ident}, "
                      f"daemon={t.daemon}, alive={t.is_alive()}) ---")
         frame = frames.get(t.ident)
@@ -100,7 +107,7 @@ class FlightRecorder:
     @property
     def out_dir(self):
         return (self._out_dir
-                or os.environ.get("MXNET_TPU_FLIGHT_DIR")
+                or envvars.get("MXNET_TPU_FLIGHT_DIR")
                 or os.path.join(os.getcwd(), "mxnet_tpu_flight"))
 
     # -- event tap ---------------------------------------------------------
@@ -253,7 +260,7 @@ class Watchdog:
         with self._lock:
             self._probes[name] = probe
             if (self._thread is None
-                    and os.environ.get("MXNET_TPU_WATCHDOG") != "0"):
+                    and envvars.get("MXNET_TPU_WATCHDOG")):
                 self._stop.clear()
                 self._thread = threading.Thread(
                     target=self._run, name="mxnet_tpu_watchdog",

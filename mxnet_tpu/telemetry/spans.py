@@ -37,6 +37,7 @@ import threading
 import time
 from collections import OrderedDict
 
+from .. import envvars
 from .registry import REGISTRY
 from .trace import (current_trace_id, new_trace_id, reset_trace_id,
                     set_trace_id)
@@ -194,15 +195,14 @@ class SpanRecorder:
 
     def __init__(self, max_traces=None, slow_ms=None, max_spans=None,
                  max_active=None, registry=None):
-        env = os.environ.get
         self.max_traces = int(max_traces
-                              or env("MXNET_TPU_TRACE_BUFFER", 64))
+                              or envvars.get("MXNET_TPU_TRACE_BUFFER"))
         self.slow_ms = float(slow_ms if slow_ms is not None
-                             else env("MXNET_TPU_TRACE_SLOW_MS", 250.0))
+                             else envvars.get("MXNET_TPU_TRACE_SLOW_MS"))
         self.max_spans = int(max_spans
-                             or env("MXNET_TPU_TRACE_MAX_SPANS", 256))
+                             or envvars.get("MXNET_TPU_TRACE_MAX_SPANS"))
         self.max_active = int(max_active
-                              or env("MXNET_TPU_TRACE_MAX_ACTIVE", 256))
+                              or envvars.get("MXNET_TPU_TRACE_MAX_ACTIVE"))
         self._lock = threading.Lock()
         self._active = OrderedDict()   # trace_id -> buf dict
         self._kept = OrderedDict()     # trace_id -> kept-trace dict
@@ -365,7 +365,7 @@ class SpanRecorder:
 #: process-wide recorder every instrumented layer records into
 RECORDER = SpanRecorder()
 
-_enabled = os.environ.get("MXNET_TPU_SPANS", "1") != "0"
+_enabled = envvars.get("MXNET_TPU_SPANS")
 
 
 def enabled():
@@ -499,7 +499,10 @@ def record_span(name, trace_id, parent_id=None, start_us=None, end_us=None,
     if end_us is None:
         end_us = (mono_to_us(mono_end) if mono_end is not None
                   else _now_us())
-    wall = time.time() - (_now_us() - start_us) / 1e6
+    # deriving the wall STAMP of a past mono point (not a duration):
+    # wall-now minus the mono offset since start is the only way to
+    # wall-stamp a span recorded after the fact
+    wall = time.time() - (_now_us() - start_us) / 1e6  # mxlint: disable=wall-clock-delta
     sp = Span(name, trace_id, parent_id=parent_id, local_root=False,
               attrs=attrs, ts_us=start_us, wall=wall)
     sp.end(status=status, error=error, end_us=end_us)

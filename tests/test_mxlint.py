@@ -1,0 +1,261 @@
+"""mxlint: per-rule fixture goldens + the tier-1 repo gate.
+
+Two halves:
+
+1. FIXTURES — every pass has a fixture under ``tools/mxlint/fixtures/``
+   with positive, inline-suppressed and clean snippets; the goldens
+   here pin the exact rule multiset (and spot-check anchor lines) so a
+   pass that goes blind or trigger-happy fails loudly.
+2. THE GATE — the real passes run over the acceptance scope
+   (``mxnet_tpu/``, ``tools/``, ``bench.py``) and must report ZERO
+   unbaselined findings with an EMPTY committed baseline; the README
+   configuration reference must be regeneration-stable against
+   ``mxnet_tpu/envvars.py``; the Grafana dashboard families must all
+   exist. This is the CI contract from ISSUE 6.
+
+No jax / device work anywhere here — the linter is pure stdlib AST.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+from tools.mxlint import core  # noqa: E402
+from tools.mxlint.passes import all_passes  # noqa: E402
+from tools.mxlint.passes.env_registry import (  # noqa: E402
+    load_envvar_registry)
+from tools.mxlint.passes.telemetry_consistency import (  # noqa: E402
+    TelemetryConsistencyPass)
+
+FIXTURES = os.path.join(ROOT, "tools", "mxlint", "fixtures")
+
+
+def _lint_fixture(fname, relpath=None):
+    with open(os.path.join(FIXTURES, fname), encoding="utf-8") as fh:
+        source = fh.read()
+    project = core.Project(root=ROOT)
+    project.lint_source(source, relpath or f"fixtures/{fname}")
+    project.finalize()
+    return project, source
+
+
+def _rules(project):
+    return sorted(f.rule for f in project.findings)
+
+
+def _line_mentions_rule(source, finding):
+    """The fixture convention: every positive finding's anchor line
+    carries a comment naming its rule (or the line right after, for
+    findings anchored on multi-line statements)."""
+    lines = source.splitlines()
+    window = " ".join(lines[finding.line - 1:finding.line + 1])
+    return finding.rule in window
+
+
+# ---------------------------------------------------------------------------
+# fixture goldens, one per pass
+# ---------------------------------------------------------------------------
+
+def test_fixture_lock_order():
+    project, source = _lint_fixture("lock_order_fixture.py")
+    assert _rules(project) == [
+        "lock-blocking-call",       # time.sleep under lock
+        "lock-blocking-call",       # urlopen under lock
+        "lock-blocking-call",       # foreign Event.wait under lock
+        "lock-blocking-call",       # thread join under lock
+        "lock-callback",            # cb() under lock
+        "lock-nested",              # via same-class method call
+        "lock-nested",              # direct re-acquire
+        "lock-order",               # the ABBA pair
+    ]
+    for f in project.findings:
+        if f.rule in ("lock-blocking-call", "lock-callback"):
+            assert _line_mentions_rule(source, f), f
+    # the suppressed time.sleep was seen but silenced inline
+    assert [f.rule for f in project.suppressed] == ["lock-blocking-call"]
+
+
+def test_fixture_thread_hygiene():
+    project, source = _lint_fixture("thread_hygiene_fixture.py")
+    assert _rules(project) == [
+        "silent-except",
+        "thread-daemon",            # unnamed_and_implicit
+        "thread-daemon",            # named_but_undecided
+        "thread-unjoined",
+        "thread-unnamed",
+    ]
+    assert sorted(f.rule for f in project.suppressed) == [
+        "thread-daemon", "thread-unnamed"]
+    silent = [f for f in project.findings if f.rule == "silent-except"]
+    assert _line_mentions_rule(source, silent[0])
+
+
+def test_fixture_telemetry_consistency():
+    project, source = _lint_fixture("telemetry_fixture.py")
+    assert _rules(project) == [
+        "metric-engine-label",
+        "metric-labels",
+        "span-leak",
+    ]
+    leak = [f for f in project.findings if f.rule == "span-leak"]
+    assert _line_mentions_rule(source, leak[0])
+
+
+def test_fixture_env_registry():
+    project, source = _lint_fixture("env_registry_fixture.py")
+    assert _rules(project) == [
+        "env-raw-read",             # os.environ.get
+        "env-raw-read",             # os.environ[...]
+        "env-raw-read",             # os.getenv
+        "env-raw-read",             # aliased env = os.environ.get
+        "env-unregistered",
+    ]
+    assert [f.rule for f in project.suppressed] == ["env-raw-read"]
+    unreg = [f for f in project.findings if f.rule == "env-unregistered"]
+    assert "MXNET_TPU_NOT_A_REAL_KNOB" in unreg[0].message
+
+
+def test_fixture_wire_safety():
+    # the pass is scoped to the wire path: linted under a PRETEND
+    # serving relpath it fires, under the fixture's real path it doesn't
+    project, source = _lint_fixture("wire_safety_fixture.py",
+                                    relpath="mxnet_tpu/serving/_fx.py")
+    assert _rules(project) == [
+        "wire-unsafe",              # import pickle
+        "wire-unsafe",              # pickle.loads
+        "wire-unsafe",              # eval
+        "wire-unsafe",              # yaml.load
+    ]
+    assert [f.rule for f in project.suppressed] == ["wire-unsafe"]
+    unscoped, _ = _lint_fixture("wire_safety_fixture.py")
+    assert "wire-unsafe" not in _rules(unscoped)
+
+
+def test_fixture_clock_discipline():
+    project, source = _lint_fixture("clocks_fixture.py")
+    assert _rules(project) == [
+        "wall-clock-delta",         # direct time.time() - t0
+        "wall-clock-delta",         # tainted local
+        "wall-clock-delta",         # tainted self attr
+    ]
+    assert [f.rule for f in project.suppressed] == ["wall-clock-delta"]
+    for f in project.findings:
+        assert _line_mentions_rule(source, f), f
+
+
+def test_suppression_mechanics():
+    project = core.Project(root=ROOT)
+    project.lint_source(
+        "import time\n"
+        "# mxlint: disable-file=thread-unnamed\n"
+        "import threading\n"
+        "def f(t0):\n"
+        "    # mxlint: disable=wall-clock-delta\n"
+        "    d = time.time() - t0\n"
+        "    t = threading.Thread(target=print, daemon=True)\n"
+        "    return d, t\n",
+        "fixtures/_inline.py")
+    project.finalize()
+    assert _rules(project) == []            # both silenced
+    assert sorted(f.rule for f in project.suppressed) == [
+        "thread-unnamed", "wall-clock-delta"]
+
+
+def test_dashboard_cross_check_fires_when_family_missing():
+    # a full-scan project that declared NO families must flag every
+    # family the committed Grafana dashboard queries
+    p = TelemetryConsistencyPass()
+    project = core.Project(root=ROOT, passes=[p])
+    project.lint_source("x = 1\n", "fixtures/_empty.py")
+    project.full_scan = True
+    findings = project.finalize()
+    dash = [f for f in findings if f.rule == "dashboard-family"]
+    assert dash, "dashboard cross-check never fired"
+    assert any("mxnet_tpu_serving_requests_total" in f.message
+               for f in dash)
+
+
+# ---------------------------------------------------------------------------
+# the env registry itself
+# ---------------------------------------------------------------------------
+
+def test_envvar_registry_typing(monkeypatch):
+    mod = load_envvar_registry(ROOT)
+    monkeypatch.delenv("MXNET_TPU_SPANS", raising=False)
+    assert mod.get("MXNET_TPU_SPANS") is True
+    monkeypatch.setenv("MXNET_TPU_SPANS", "0")
+    assert mod.get("MXNET_TPU_SPANS") is False
+    monkeypatch.setenv("MXNET_TPU_TRACE_BUFFER", "128")
+    assert mod.get("MXNET_TPU_TRACE_BUFFER") == 128
+    monkeypatch.setenv("MXNET_TPU_TRACE_BUFFER", "not-an-int")
+    assert mod.get("MXNET_TPU_TRACE_BUFFER") == 64      # typo -> default
+    monkeypatch.setenv("MXNET_TPU_WATCHDOG_STALL_S", "2.5")
+    assert mod.get("MXNET_TPU_WATCHDOG_STALL_S") == 2.5
+    assert mod.get("MXNET_TPU_PEAK_TFLOPS") is None
+    with pytest.raises(KeyError):
+        mod.get("MXNET_TPU_NOT_A_REAL_KNOB")
+    assert mod.get_raw("MXNET_TPU_SPANS") == "0"
+    # every declared name is a real MXNET_TPU_* name with a doc
+    for var in mod.all_vars():
+        assert var.name.startswith("MXNET_TPU_")
+        assert var.doc
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 gate
+# ---------------------------------------------------------------------------
+
+def test_repo_gate_zero_unbaselined_findings():
+    project = core.run(root=ROOT)
+    baseline = core.load_baseline(ROOT)
+    new = [f for f in project.findings if f.key() not in baseline]
+    assert not new, (
+        "unbaselined mxlint findings (fix them or inline-suppress "
+        "with justification):\n" + "\n".join(map(repr, new)))
+
+
+def test_baseline_is_empty():
+    """The acceptance bar: the committed baseline carries ZERO debt —
+    in particular nothing from the lock-order, wire-safety or
+    telemetry-consistency passes may ever be baselined away."""
+    with open(core.baseline_path(ROOT), encoding="utf-8") as fh:
+        data = json.load(fh)
+    assert data["findings"] == []
+
+
+def test_envdoc_is_regeneration_stable():
+    """README's generated configuration reference matches the registry
+    exactly (i.e. --write-envdoc would be a no-op)."""
+    from tools.mxlint.__main__ import ENVDOC_BEGIN, ENVDOC_END
+    mod = load_envvar_registry(ROOT)
+    with open(os.path.join(ROOT, "README.md"), encoding="utf-8") as fh:
+        text = fh.read()
+    assert ENVDOC_BEGIN in text and ENVDOC_END in text
+    body = text.split(ENVDOC_BEGIN, 1)[1].split(ENVDOC_END, 1)[0]
+    assert body.strip() == mod.markdown_table().strip()
+    for var in mod.ENVVARS.values():
+        assert f"`{var.name}`" in body, f"{var.name} missing from table"
+
+
+def test_cli_smoke_exits_zero():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.mxlint", "-q"],
+        cwd=ROOT, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "unbaselined" in proc.stdout
+
+
+def test_cli_list_rules():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.mxlint", "--list-rules"],
+        cwd=ROOT, capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0
+    for rule in ("lock-blocking-call", "thread-unnamed", "metric-labels",
+                 "env-raw-read", "wire-unsafe", "wall-clock-delta"):
+        assert rule in proc.stdout

@@ -303,6 +303,70 @@ def test_future_first_write_wins():
     assert f.result() == 7              # the sweep must not clobber it
 
 
+def test_future_callbacks_run_outside_lock_and_report_errors():
+    """Done-callbacks are snapshot under the future's lock and invoked
+    OUTSIDE it (the mxlint lock-callback contract): a callback that
+    reenters the future — or raises — must neither deadlock nor lose
+    the result, and a raising observer leaves a
+    ``future_callback_error`` event."""
+    from mxnet_tpu.telemetry import events as _events
+
+    records = []
+    _events.add_tap(records.append)
+    try:
+        f = InferenceFuture()
+        f.trace_id = "req-reentrant"
+        seen = []
+
+        def reentrant(fut):
+            # reentry: registering ANOTHER callback from inside a
+            # callback takes the future's lock again — deadlocks if
+            # callbacks ran under it
+            fut.add_done_callback(lambda g: seen.append(g.result()))
+
+        def broken(fut):
+            raise RuntimeError("broken observer")
+
+        f.add_done_callback(reentrant)
+        f.add_done_callback(broken)
+        f.set_result(41)
+        assert f.result(timeout=1) == 41
+        assert seen == [41]
+        errs = [r for r in records if r["event"] == "future_callback_error"]
+        assert errs and "broken observer" in errs[0]["error"]
+        assert errs[0]["trace_id"] == "req-reentrant"
+    finally:
+        _events.remove_tap(records.append)
+
+
+def test_reentrant_done_callback_cannot_deadlock_submit():
+    """ISSUE-6 satellite regression: a done-callback that REENTERS
+    ``engine.submit`` runs on the engine worker thread the moment it
+    fulfils the future — if shed/expiry/completion notifications ran
+    under the queue lock, this would deadlock the worker against its
+    own admission path. Must complete well inside the timeout."""
+    eng = ServingEngine(StubModel(), bucket_lens=(8,), max_rows=2)
+    with eng:
+        chained = []
+        done = threading.Event()
+
+        def resubmit(fut):
+            # executes on the worker thread, mid-completion sweep
+            chained.append(eng.submit([7, 8, 9]))
+            done.set()
+
+        first = eng.submit([1, 2, 3, 4])
+        first.add_done_callback(resubmit)
+        np.testing.assert_allclose(
+            np.asarray(first.result(timeout=30)).reshape(-1)[:4],
+            [1, 2, 3, 4])
+        assert done.wait(30)
+        np.testing.assert_allclose(
+            np.asarray(chained[0].result(timeout=30)).reshape(-1)[:3],
+            [7, 8, 9])
+    assert eng.stats.count("completed") == 2
+
+
 def test_engine_reset_stats_separates_windows():
     eng = ServingEngine(StubModel(), bucket_lens=(16,), max_rows=1)
     with eng:

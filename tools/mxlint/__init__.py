@@ -1,0 +1,67 @@
+"""mxlint — repo-native static analysis for the threaded serving /
+telemetry / dist stack.
+
+Generic linters know Python; they don't know THIS codebase's contracts:
+that a ``with self._lock:`` body must never long-poll a socket, that
+every ``mxnet_tpu_serving_*`` metric family carries an ``engine_id``
+label (the ISSUE-5 fleet contract the Grafana dashboard keys on), that
+``serving/`` and the dist wire admit nothing executable, or that the 31
+``MXNET_TPU_*`` env knobs are read through ``mxnet_tpu/envvars.py`` and
+nowhere else. mxlint encodes those contracts as AST passes — the
+ThreadSanitizer-happens-before / Dapper-schema-consistency discipline
+applied statically to our own idioms — and tier-1 runs it as a
+zero-unbaselined-findings gate (``tests/test_mxlint.py``), following
+the ``tools/np_surface_audit.py`` precedent of committed-artifact
+audits that cannot go stale silently.
+
+Passes (one module each under :mod:`tools.mxlint.passes`):
+
+==========================  ================================================
+``lock-order``              per-class lock acquisition graph: inconsistent
+                            A→B/B→A order, non-reentrant re-acquisition
+                            (incl. one level of same-class method calls),
+                            blocking calls (socket/urlopen/sleep/join/
+                            future-wait) and user callbacks invoked under
+                            a held lock
+``thread-hygiene``          every ``threading.Thread`` named + explicitly
+                            daemon'd (so flight-recorder thread dumps are
+                            attributable); non-daemon threads must be
+                            joined; worker loops must not swallow broad
+                            exceptions silently
+``telemetry-consistency``   one label set per metric family across all
+                            call sites, ``engine_id`` on every serving
+                            family, span open/close pairing, and the
+                            Grafana dashboard's PromQL families
+                            cross-checked against families the code
+                            actually declares
+``env-registry``            raw ``os.environ`` access to ``MXNET_TPU_*``
+                            forbidden outside ``mxnet_tpu/envvars.py``;
+                            ``envvars.get`` names must be registered;
+                            registered names must appear in the README
+                            reference table
+``wire-safety``             ``pickle``/``eval``/``exec``/``yaml.load``
+                            forbidden in ``serving/``, ``kvstore.py`` and
+                            ``telemetry/`` (locks in the ISSUE-2 typed
+                            non-executable codec hardening)
+``clock-discipline``        durations must come from a monotonic clock —
+                            ``time.time()`` arithmetic is flagged (wall
+                            clock is for event stamps only)
+==========================  ================================================
+
+Suppressions: ``# mxlint: disable=<rule>[,<rule>]`` on the offending
+line (or alone on the line above) suppresses those rules there;
+``# mxlint: disable-file=<rule>`` anywhere suppresses the rule for the
+whole file. ``tools/mxlint/baseline.json`` lists findings accepted as
+pre-existing debt — it is COMMITTED EMPTY and the gate keeps it that
+way for the lock-order, wire-safety and telemetry-consistency passes.
+
+Run: ``python -m tools.mxlint`` (non-zero exit on unbaselined
+findings); ``--write-baseline`` to accept current findings;
+``--write-envdoc`` to regenerate the README configuration reference
+from the env registry.
+"""
+from .core import (Finding, LintPass, Project, iter_python_files,
+                   lint_file, load_baseline, run)
+
+__all__ = ["Finding", "LintPass", "Project", "iter_python_files",
+           "lint_file", "load_baseline", "run"]

@@ -1,0 +1,110 @@
+"""mxlint CLI.
+
+::
+
+    python -m tools.mxlint                  # lint the acceptance scope
+    python -m tools.mxlint mxnet_tpu/serving
+    python -m tools.mxlint --list-rules
+    python -m tools.mxlint --write-baseline # accept current findings
+    python -m tools.mxlint --write-envdoc   # regenerate README env table
+
+Exit codes: 0 clean (or fully baselined), 1 unbaselined findings,
+2 usage error. The tier-1 gate (``tests/test_mxlint.py``) runs the
+default scope and asserts exit 0 with an EMPTY baseline.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+# runnable both as ``python -m tools.mxlint`` from the repo root and as
+# a checkout-relative script
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+from tools.mxlint import core  # noqa: E402
+from tools.mxlint import passes as pass_registry  # noqa: E402
+from tools.mxlint.passes.env_registry import load_envvar_registry  # noqa: E402
+
+ENVDOC_BEGIN = "<!-- mxlint:envdoc:begin (generated; edit " \
+               "mxnet_tpu/envvars.py, then python -m tools.mxlint " \
+               "--write-envdoc) -->"
+ENVDOC_END = "<!-- mxlint:envdoc:end -->"
+
+
+def write_envdoc(root):
+    """Regenerate the README "Configuration reference" between the
+    envdoc markers from the typed registry."""
+    mod = load_envvar_registry(root)
+    readme = os.path.join(root, "README.md")
+    with open(readme, encoding="utf-8") as fh:
+        text = fh.read()
+    if ENVDOC_BEGIN not in text or ENVDOC_END not in text:
+        print(f"mxlint: README.md lacks the envdoc markers "
+              f"({ENVDOC_BEGIN!r} ... {ENVDOC_END!r})", file=sys.stderr)
+        return 2
+    head, rest = text.split(ENVDOC_BEGIN, 1)
+    _, tail = rest.split(ENVDOC_END, 1)
+    body = mod.markdown_table()
+    out = head + ENVDOC_BEGIN + "\n\n" + body + "\n" + ENVDOC_END + tail
+    if out != text:
+        with open(readme, "w", encoding="utf-8") as fh:
+            fh.write(out)
+        print(f"mxlint: wrote configuration reference "
+              f"({len(mod.ENVVARS)} variables) into README.md")
+    else:
+        print("mxlint: README configuration reference already current")
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="mxlint", description=__doc__)
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: the acceptance "
+                         "scope: mxnet_tpu/ tools/ bench.py)")
+    ap.add_argument("--root", default=None,
+                    help="repo root (default: auto-detected)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="accept current findings into baseline.json")
+    ap.add_argument("--write-envdoc", action="store_true",
+                    help="regenerate the README configuration "
+                         "reference from mxnet_tpu/envvars.py")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("-q", "--quiet", action="store_true")
+    args = ap.parse_args(argv)
+    root = os.path.abspath(args.root) if args.root else core.repo_root()
+
+    if args.list_rules:
+        for cls in pass_registry.PASS_CLASSES:
+            print(f"{cls.name}:")
+            for rule in cls.rules:
+                print(f"  {rule}")
+        return 0
+    if args.write_envdoc:
+        return write_envdoc(root)
+
+    project = core.run(root=root, paths=args.paths or None)
+    baseline = core.load_baseline(root)
+    new = [f for f in project.findings if f.key() not in baseline]
+    stale = baseline - {f.key() for f in project.findings}
+
+    if args.write_baseline:
+        core.save_baseline(project, root)
+        print(f"mxlint: baselined {len(project.findings)} findings")
+        return 0
+
+    if not args.quiet:
+        for f in new:
+            print(f)
+    n_files = len(project.contexts)
+    print(f"mxlint: {n_files} files, {len(new)} unbaselined findings "
+          f"({len(project.findings) - len(new)} baselined, "
+          f"{len(project.suppressed)} inline-suppressed"
+          + (f", {len(stale)} stale baseline entries" if stale else "")
+          + ")")
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

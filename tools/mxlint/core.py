@@ -1,0 +1,245 @@
+"""mxlint framework: findings, suppressions, baseline, pass pipeline.
+
+One :class:`Project` per run. Every file is parsed ONCE; each
+registered pass visits the tree and appends :class:`Finding`\\ s; passes
+that need cross-file state (label-set consistency, dashboard
+cross-check, env-registry membership) accumulate it on themselves
+during the per-file phase and emit project findings in ``finalize``.
+"""
+from __future__ import annotations
+
+import ast
+import io
+import json
+import os
+import re
+import tokenize
+
+__all__ = ["Finding", "FileContext", "LintPass", "Project",
+           "iter_python_files", "lint_file", "load_baseline", "run",
+           "DEFAULT_PATHS", "repo_root"]
+
+#: the acceptance scope: the package, the tools, and the bench driver
+DEFAULT_PATHS = ("mxnet_tpu", "tools", "bench.py")
+
+#: directories never scanned (fixtures hold INTENTIONAL violations)
+_SKIP_PARTS = ("__pycache__", "fixtures", ".jax_cache", "dashboards")
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*mxlint:\s*disable(?P<file>-file)?\s*=\s*"
+    r"(?P<rules>[a-z0-9_,\-\s]+)")
+
+
+def repo_root():
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+
+class Finding:
+    """One diagnostic: rule id, repo-relative path, position, message."""
+
+    __slots__ = ("rule", "path", "line", "col", "message")
+
+    def __init__(self, rule, path, line, col, message):
+        self.rule = rule
+        self.path = path
+        self.line = int(line)
+        self.col = int(col)
+        self.message = message
+
+    def key(self):
+        """Baseline identity. Line numbers are EXCLUDED so unrelated
+        edits above a baselined finding don't un-baseline it; the
+        message carries enough context to stay unique in practice."""
+        return f"{self.path}::{self.rule}::{self.message}"
+
+    def sort_key(self):
+        return (self.path, self.line, self.col, self.rule)
+
+    def __repr__(self):
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"[{self.rule}] {self.message}")
+
+
+class FileContext:
+    """One parsed file + its suppression map."""
+
+    def __init__(self, path, relpath, source, tree):
+        self.path = path
+        self.relpath = relpath
+        self.source = source
+        self.tree = tree
+        self.line_suppress = {}     # line -> set(rules)
+        self.file_suppress = set()  # rules suppressed file-wide
+        self._scan_suppressions()
+
+    def _scan_suppressions(self):
+        lines = self.source.splitlines()
+        try:
+            tokens = tokenize.generate_tokens(
+                io.StringIO(self.source).readline)
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = _SUPPRESS_RE.search(tok.string)
+                if not m:
+                    continue
+                rules = {r.strip() for r in m.group("rules").split(",")
+                         if r.strip()}
+                if m.group("file"):
+                    self.file_suppress |= rules
+                    continue
+                line = tok.start[0]
+                self.line_suppress.setdefault(line, set()).update(rules)
+                # a comment ALONE on its line covers the next line (the
+                # statement it annotates)
+                prefix = lines[line - 1][:tok.start[1]]
+                if not prefix.strip():
+                    self.line_suppress.setdefault(line + 1,
+                                                  set()).update(rules)
+        except (tokenize.TokenError, IndentationError):
+            pass
+
+    def suppressed(self, finding):
+        if finding.rule in self.file_suppress or "all" in self.file_suppress:
+            return True
+        rules = self.line_suppress.get(finding.line, ())
+        return finding.rule in rules or "all" in rules
+
+    def finding(self, rule, node, message):
+        return Finding(rule, self.relpath, getattr(node, "lineno", 1),
+                       getattr(node, "col_offset", 0), message)
+
+
+class LintPass:
+    """Base pass: subclass, set ``name``/``rules``, implement
+    ``check(ctx) -> list[Finding]``; optionally ``applies(relpath)``
+    to scope the pass and ``finalize(project) -> list[Finding]`` for
+    cross-file checks."""
+
+    name = "base"
+    rules = ()
+
+    def applies(self, relpath):
+        return True
+
+    def check(self, ctx):
+        return []
+
+    def finalize(self, project):
+        return []
+
+
+class Project:
+    """One lint run: root, pass instances, findings, counts."""
+
+    def __init__(self, root=None, passes=None):
+        from . import passes as _passes
+        self.root = os.path.abspath(root or repo_root())
+        self.passes = passes if passes is not None else _passes.all_passes()
+        self.findings = []          # unsuppressed findings
+        self.suppressed = []        # findings silenced inline
+        self.contexts = []
+        self.full_scan = False      # True when the default scope ran
+
+    # -- scanning ----------------------------------------------------------
+    def lint_source(self, source, relpath):
+        """Lint one in-memory source blob (the fixture-test entry)."""
+        try:
+            tree = ast.parse(source, filename=relpath)
+        except SyntaxError as e:
+            f = Finding("syntax-error", relpath, e.lineno or 1, 0,
+                        f"file does not parse: {e.msg}")
+            self.findings.append(f)
+            return [f]
+        ctx = FileContext(os.path.join(self.root, relpath), relpath,
+                          source, tree)
+        self.contexts.append(ctx)
+        out = []
+        for p in self.passes:
+            if not p.applies(relpath):
+                continue
+            for f in p.check(ctx):
+                (self.suppressed if ctx.suppressed(f)
+                 else self.findings).append(f)
+                out.append(f)
+        return out
+
+    def lint_path(self, path):
+        relpath = os.path.relpath(os.path.abspath(path), self.root)
+        with open(path, encoding="utf-8") as fh:
+            source = fh.read()
+        return self.lint_source(source, relpath.replace(os.sep, "/"))
+
+    def finalize(self):
+        ctx_by_path = {c.relpath: c for c in self.contexts}
+        for p in self.passes:
+            for f in p.finalize(self):
+                ctx = ctx_by_path.get(f.path)
+                if ctx is not None and ctx.suppressed(f):
+                    self.suppressed.append(f)
+                else:
+                    self.findings.append(f)
+        self.findings.sort(key=Finding.sort_key)
+        return self.findings
+
+
+def iter_python_files(root, paths=DEFAULT_PATHS):
+    for rel in paths:
+        top = os.path.join(root, rel)
+        if os.path.isfile(top):
+            if top.endswith(".py"):
+                yield top
+            continue
+        for dirpath, dirnames, filenames in os.walk(top):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d not in _SKIP_PARTS)
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    yield os.path.join(dirpath, fn)
+
+
+def run(root=None, paths=None, passes=None):
+    """Lint ``paths`` (default: the acceptance scope) under ``root``.
+    Returns the finalized :class:`Project`."""
+    project = Project(root=root, passes=passes)
+    if paths is None:
+        paths = DEFAULT_PATHS
+        project.full_scan = True
+    for path in iter_python_files(project.root, paths):
+        project.lint_path(path)
+    project.finalize()
+    return project
+
+
+def lint_file(path, root=None, passes=None):
+    """Lint ONE file (fixture tests); returns (project, findings)."""
+    project = Project(root=root, passes=passes)
+    project.lint_path(path)
+    project.finalize()
+    return project
+
+
+# -- baseline ---------------------------------------------------------------
+
+def baseline_path(root=None):
+    return os.path.join(root or repo_root(), "tools", "mxlint",
+                        "baseline.json")
+
+
+def load_baseline(root=None):
+    try:
+        with open(baseline_path(root), encoding="utf-8") as fh:
+            data = json.load(fh)
+    except (OSError, ValueError):
+        return set()
+    return set(data.get("findings", []))
+
+
+def save_baseline(project, root=None):
+    data = {"comment": "accepted pre-existing mxlint findings; keep "
+                       "EMPTY — fix or inline-suppress instead",
+            "findings": sorted(f.key() for f in project.findings)}
+    with open(baseline_path(root), "w", encoding="utf-8") as fh:
+        json.dump(data, fh, indent=2)
+        fh.write("\n")

@@ -1,0 +1,41 @@
+"""clock-discipline pass fixture (parsed, never imported)."""
+import time
+
+
+def direct_sub(t0):
+    return time.time() - t0                 # wall-clock-delta
+
+
+def tainted_local():
+    t0 = time.time()
+    work = 1
+    return time.monotonic() - t0 + work     # wall-clock-delta (t0)
+
+
+class TaintedAttr:
+    def __init__(self):
+        self.tic = time.time()
+
+    def elapsed(self):
+        return time.monotonic() - self.tic  # wall-clock-delta (self.tic)
+
+
+class CleanAttr:
+    def __init__(self):
+        self.tic = time.perf_counter()
+
+    def elapsed(self):
+        return time.perf_counter() - self.tic       # clean
+
+
+def stamp_only():
+    return {"ts": time.time()}              # clean: an event stamp
+
+
+def monotonic_duration():
+    t0 = time.perf_counter()
+    return time.perf_counter() - t0         # clean
+
+
+def suppressed(t0):
+    return time.time() - t0  # mxlint: disable=wall-clock-delta

@@ -1,0 +1,106 @@
+"""lock-order pass fixture: positives, a suppressed case, clean idioms.
+
+NEVER imported — parsed by tests/test_mxlint.py; line numbers are
+asserted as goldens, so edits here must update the test table.
+"""
+import threading
+import time
+import urllib.request
+
+
+class AbbaPair:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def one(self):
+        with self._a:
+            with self._b:           # edge a -> b
+                pass
+
+    def two(self):
+        with self._b:
+            with self._a:           # edge b -> a: ABBA -> lock-order
+                pass
+
+
+class NestedSame:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+
+    def bad(self):
+        with self._cond:
+            self._helper()          # lock-nested (via method call)
+
+    def _helper(self):
+        with self._lock:            # same group as _cond
+            pass
+
+    def bad_direct(self):
+        with self._lock:
+            with self._lock:        # lock-nested (direct)
+                pass
+
+
+class ReentrantOk:
+    def __init__(self):
+        self._rlock = threading.RLock()
+
+    def fine(self):
+        with self._rlock:
+            with self._rlock:       # clean: RLock is reentrant
+                pass
+
+
+class BlockingUnderLock:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition()
+        self._done_evt = threading.Event()
+
+    def sleepy(self):
+        with self._lock:
+            time.sleep(1.0)         # lock-blocking-call
+
+    def netty(self, url):
+        with self._lock:
+            return urllib.request.urlopen(url)      # lock-blocking-call
+
+    def waity(self):
+        with self._lock:
+            self._done_evt.wait()   # lock-blocking-call (foreign wait)
+
+    def joiny(self, worker):
+        with self._lock:
+            worker.join()           # lock-blocking-call
+
+    def cv_idiom(self):
+        with self._cond:
+            self._cond.wait(0.1)    # clean: waiting on the HELD cond
+
+    def suppressed(self):
+        with self._lock:
+            time.sleep(0.0)  # mxlint: disable=lock-blocking-call
+
+    def outside(self):
+        with self._lock:
+            snapshot = 1
+        time.sleep(snapshot)        # clean: lock released first
+
+
+class CallbackUnderLock:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._callbacks = []
+
+    def bad(self):
+        with self._lock:
+            for cb in self._callbacks:
+                cb(self)            # lock-callback
+
+    def good(self):
+        with self._lock:
+            callbacks, self._callbacks = self._callbacks, []
+        for cb in callbacks:
+            cb(self)                # clean: invoked outside the lock

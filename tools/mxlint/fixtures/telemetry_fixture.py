@@ -1,0 +1,39 @@
+"""telemetry-consistency pass fixture (parsed, never imported)."""
+REGISTRY = None
+_spans = None
+
+
+def declare_ok(reg):
+    reg.counter("mxnet_tpu_fixture_total", "doc", ("op",))
+    reg.counter("mxnet_tpu_fixture_total", "doc", ("op",))     # same: ok
+
+
+def declare_drift(reg):
+    reg.counter("mxnet_tpu_fixture_drift_total", "doc", ("op",))
+    reg.counter("mxnet_tpu_fixture_drift_total", "doc",
+                ("op", "rank"))             # metric-labels (finalize)
+
+
+def serving_without_engine_id(reg):
+    reg.histogram("mxnet_tpu_serving_fixture_ms", "doc",
+                  ("stage",))               # metric-engine-label
+
+
+def serving_with_engine_id(reg):
+    reg.histogram("mxnet_tpu_serving_fixture2_ms", "doc",
+                  ("engine_id", "stage"))   # clean
+
+
+def span_leak():
+    sp = _spans.start_span("fixture/leak")  # span-leak: never ended
+    return 1 + (0 if sp is None else 0)
+
+
+def span_paired():
+    sp = _spans.start_span("fixture/ok")
+    sp.end()                                # clean
+
+
+def span_escapes():
+    sp = _spans.start_span("fixture/escapes")
+    return sp                               # clean: caller owns it

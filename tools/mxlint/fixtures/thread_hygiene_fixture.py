@@ -1,0 +1,50 @@
+"""thread-hygiene pass fixture (parsed, never imported)."""
+import threading
+
+
+def unnamed_and_implicit():
+    t = threading.Thread(target=print)      # thread-unnamed + thread-daemon
+    return t
+
+
+def named_but_undecided():
+    return threading.Thread(target=print, name="x")     # thread-daemon
+
+
+def nondaemon_never_joined():
+    t = threading.Thread(target=print, name="x",
+                         daemon=False)      # thread-unjoined (nobody
+    t.start()                               # ever joins it in this file)
+
+
+def clean_daemon():
+    return threading.Thread(target=print, name="mxnet_tpu_fixture_ok",
+                            daemon=True)
+
+
+def suppressed():
+    return threading.Thread(target=print)  # mxlint: disable=thread-unnamed,thread-daemon
+
+
+def silent_worker_loop(q):
+    while True:
+        try:
+            q.popleft()
+        except Exception:                   # silent-except
+            pass
+
+
+def loud_worker_loop(q, emit):
+    while True:
+        try:
+            q.popleft()
+        except Exception as e:              # clean: leaves a trace
+            emit("worker_error", error=repr(e))
+
+
+def narrow_is_fine(q):
+    while True:
+        try:
+            q.popleft()
+        except IndexError:                  # clean: narrow except
+            pass

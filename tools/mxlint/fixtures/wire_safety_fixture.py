@@ -1,0 +1,31 @@
+"""wire-safety pass fixture — the test lints it under a PRETEND
+serving-path relpath (the pass is scoped to serving/kvstore/telemetry).
+Parsed, never imported."""
+import json
+
+import pickle                               # wire-unsafe
+import yaml                                 # (import yaml itself is fine)
+
+
+def unpickle(frame):
+    return pickle.loads(frame)              # wire-unsafe
+
+
+def evaluate(frame):
+    return eval(frame)                      # wire-unsafe
+
+
+def yaml_load(frame):
+    return yaml.load(frame)                 # wire-unsafe
+
+
+def yaml_safe(frame):
+    return yaml.safe_load(frame)            # clean
+
+
+def typed_codec(frame):
+    return json.loads(frame)                # clean
+
+
+def suppressed(frame):
+    return pickle.loads(frame)  # mxlint: disable=wire-unsafe

@@ -1,0 +1,20 @@
+"""mxlint pass registry: one module per pass, instantiated per run
+(passes carry per-run cross-file state)."""
+from __future__ import annotations
+
+from .clocks import ClockDisciplinePass
+from .env_registry import EnvRegistryPass
+from .lock_order import LockOrderPass
+from .telemetry_consistency import TelemetryConsistencyPass
+from .thread_hygiene import ThreadHygienePass
+from .wire_safety import WireSafetyPass
+
+__all__ = ["all_passes", "PASS_CLASSES"]
+
+PASS_CLASSES = (LockOrderPass, ThreadHygienePass,
+                TelemetryConsistencyPass, EnvRegistryPass,
+                WireSafetyPass, ClockDisciplinePass)
+
+
+def all_passes():
+    return [cls() for cls in PASS_CLASSES]

@@ -1,0 +1,53 @@
+"""Shared AST helpers for mxlint passes."""
+from __future__ import annotations
+
+import ast
+
+__all__ = ["dotted_name", "terminal_attr", "str_const", "call_kwargs",
+           "walk_shallow"]
+
+
+def dotted_name(node):
+    """``a.b.c`` for Name/Attribute chains, None for anything else."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def terminal_attr(node):
+    """The last attribute segment of a call target ('get' for
+    ``os.environ.get``, 'sleep' for ``time.sleep``), or the bare name."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def str_const(node):
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def call_kwargs(call):
+    return {kw.arg: kw.value for kw in call.keywords if kw.arg}
+
+
+def walk_shallow(node):
+    """Like ast.walk but does NOT descend into nested function/class
+    definitions — the bodies of inner defs/lambdas run later, outside
+    the enclosing statement's dynamic context (e.g. a lock region)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        child = stack.pop()
+        yield child
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda, ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(child))

@@ -1,0 +1,112 @@
+"""Clock discipline: durations must come from a monotonic clock.
+
+``time.time()`` is wall clock — NTP steps it backwards and smears it;
+a duration computed from it can go negative or silently stretch, and
+those numbers feed latency histograms, watchdog stall thresholds and
+QPS math. The contract: ``time.monotonic()`` / ``time.perf_counter()``
+for anything subtracted, ``time.time()`` only for event STAMPS
+(log/meta fields that name a moment).
+
+- ``wall-clock-delta`` — a subtraction whose operand is
+  ``time.time()`` directly, a local assigned from it in the same
+  function, or a ``self.<attr>`` assigned from it anywhere in the
+  class.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import LintPass
+from ._util import dotted_name
+
+_WALL = {"time.time", "_time.time"}
+
+
+def _is_wall_call(node):
+    return (isinstance(node, ast.Call)
+            and (dotted_name(node.func) or "") in _WALL)
+
+
+class ClockDisciplinePass(LintPass):
+    name = "clock-discipline"
+    rules = ("wall-clock-delta",)
+
+    def check(self, ctx):
+        out = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                out.extend(self._check_scope(ctx, node,
+                                             self._class_taint(node)))
+        out.extend(self._check_scope(ctx, ctx.tree, set(),
+                                     toplevel_only=True))
+        return out
+
+    def _class_taint(self, cls):
+        """self attrs assigned time.time() and NEVER a monotonic
+        source (a reassignment from perf_counter clears suspicion)."""
+        wall, clean = set(), set()
+        for node in ast.walk(cls):
+            if not isinstance(node, ast.Assign):
+                continue
+            for t in node.targets:
+                if not (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"):
+                    continue
+                if _is_wall_call(node.value):
+                    wall.add(t.attr)
+                else:
+                    clean.add(t.attr)
+        return wall - clean
+
+    def _check_scope(self, ctx, scope, attr_taint, toplevel_only=False):
+        out = []
+        funcs = []
+        for node in ast.walk(scope):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                funcs.append(node)
+        if toplevel_only:
+            # module-level statements only (functions are walked via
+            # their classes or as standalone funcs below)
+            funcs = [n for n in ast.iter_child_nodes(scope)
+                     if isinstance(n, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef))]
+        for fn in funcs:
+            out.extend(self._check_function(ctx, fn, attr_taint))
+        return out
+
+    def _check_function(self, ctx, fn, attr_taint):
+        tainted = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and _is_wall_call(node.value):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        tainted.add(t.id)
+        out = []
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.BinOp)
+                    and isinstance(node.op, ast.Sub)):
+                continue
+            for side in (node.left, node.right):
+                reason = self._wall_operand(side, tainted, attr_taint)
+                if reason:
+                    out.append(ctx.finding(
+                        "wall-clock-delta", node,
+                        f"duration computed from wall clock "
+                        f"({reason}) — use time.monotonic() or "
+                        f"time.perf_counter(); wall clock is for "
+                        f"event stamps only"))
+                    break
+        return out
+
+    def _wall_operand(self, node, tainted, attr_taint):
+        if _is_wall_call(node):
+            return "time.time() in a subtraction"
+        if isinstance(node, ast.Name) and node.id in tainted:
+            return f"{node.id} was assigned time.time()"
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"
+                and node.attr in attr_taint):
+            return f"self.{node.attr} is assigned time.time()"
+        return None

@@ -1,0 +1,146 @@
+"""Env-var registry enforcement.
+
+- ``env-raw-read``     — raw ``os.environ`` / ``os.getenv`` access to an
+  ``MXNET_TPU_*`` name anywhere but ``mxnet_tpu/envvars.py``: the typed
+  registry is the only sanctioned reader (one declaration per knob —
+  name, type, default, doc — and a generated README table that cannot
+  go stale). Simple aliases (``env = os.environ.get``) are followed;
+- ``env-unregistered`` — ``envvars.get/get_raw/is_set`` called with a
+  name the registry does not declare: registering IS the act of
+  creating a configuration knob;
+- ``env-undocumented`` — a registered variable missing from the README
+  "Configuration reference" table (regenerate with
+  ``python -m tools.mxlint --write-envdoc``).
+
+Writes (``os.environ[...] = x``, launcher child-env dicts) are allowed:
+the registry governs how the process READS its own configuration.
+"""
+from __future__ import annotations
+
+import ast
+import importlib.util
+import os
+
+from ..core import Finding, LintPass
+from ._util import dotted_name, str_const
+
+_ENV_READ_FUNCS = {"os.environ.get", "environ.get", "os.getenv",
+                   "getenv", "_os.environ.get", "_os.getenv"}
+_ENVVARS_FUNCS = {"get", "get_raw", "is_set"}
+
+
+def load_envvar_registry(root):
+    """The declared-name set, loaded WITHOUT importing the mxnet_tpu
+    package (the package import drags in jax; the linter must run in
+    milliseconds). envvars.py is stdlib-only by contract."""
+    path = os.path.join(root, "mxnet_tpu", "envvars.py")
+    spec = importlib.util.spec_from_file_location("_mxlint_envvars", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class EnvRegistryPass(LintPass):
+    name = "env-registry"
+    rules = ("env-raw-read", "env-unregistered", "env-undocumented")
+
+    def __init__(self):
+        self.envvar_calls = []      # (name literal, relpath, line)
+
+    def applies(self, relpath):
+        return relpath != "mxnet_tpu/envvars.py"
+
+    def check(self, ctx):
+        out = []
+        aliases = self._env_read_aliases(ctx.tree)
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Subscript):
+                out.extend(self._check_subscript(ctx, node))
+            elif isinstance(node, ast.Call):
+                out.extend(self._check_call(ctx, node, aliases))
+        return out
+
+    def _env_read_aliases(self, tree):
+        """Names bound to os.environ.get / os.getenv anywhere in the
+        module (the ``env = os.environ.get`` idiom)."""
+        aliases = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                if (dotted_name(node.value) or "") in _ENV_READ_FUNCS:
+                    aliases.add(node.targets[0].id)
+        return aliases
+
+    def _check_subscript(self, ctx, node):
+        if not isinstance(node.ctx, ast.Load):
+            return []
+        dname = dotted_name(node.value) or ""
+        if not dname.endswith("environ"):
+            return []
+        key = str_const(node.slice)
+        if key and key.startswith("MXNET_TPU_"):
+            return [ctx.finding(
+                "env-raw-read", node,
+                f"raw os.environ[{key!r}] read — go through "
+                f"mxnet_tpu.envvars.get({key!r})")]
+        return []
+
+    def _check_call(self, ctx, call, aliases):
+        if not call.args:
+            return []
+        key = str_const(call.args[0])
+        if not key or not key.startswith("MXNET_TPU_"):
+            return []
+        dname = dotted_name(call.func) or ""
+        term = dname.split(".")[-1]
+        is_env_read = (dname in _ENV_READ_FUNCS
+                       or (isinstance(call.func, ast.Name)
+                           and call.func.id in aliases))
+        if is_env_read:
+            return [ctx.finding(
+                "env-raw-read", call,
+                f"raw environment read of {key} — go through "
+                f"mxnet_tpu.envvars.get({key!r})")]
+        if term in _ENVVARS_FUNCS and "envvars" in dname:
+            self.envvar_calls.append((key, ctx.relpath, call.lineno))
+        return []
+
+    def finalize(self, project):
+        try:
+            mod = load_envvar_registry(project.root)
+        except (OSError, SyntaxError) as e:
+            if not project.full_scan:
+                return []
+            return [Finding("env-unregistered", "mxnet_tpu/envvars.py",
+                            1, 0, f"cannot load env registry: {e!r}")]
+        registered = set(mod.ENVVARS)
+        out = []
+        for key, rel, line in self.envvar_calls:
+            if key not in registered:
+                out.append(Finding(
+                    "env-unregistered", rel, line, 0,
+                    f"envvars.get({key!r}): name not declared in "
+                    f"mxnet_tpu/envvars.py — register it (name, type, "
+                    f"default, doc)"))
+        if project.full_scan:
+            out.extend(self._check_readme(project, mod))
+        return out
+
+    def _check_readme(self, project, mod):
+        readme = os.path.join(project.root, "README.md")
+        try:
+            with open(readme, encoding="utf-8") as fh:
+                text = fh.read()
+        except OSError:
+            return [Finding("env-undocumented", "README.md", 1, 0,
+                            "README.md missing — cannot verify the "
+                            "configuration reference")]
+        out = []
+        for var in mod.ENVVARS.values():
+            if f"`{var.name}`" not in text:
+                out.append(Finding(
+                    "env-undocumented", "README.md", 1, 0,
+                    f"{var.name} is registered but missing from the "
+                    f"README configuration reference — run "
+                    f"python -m tools.mxlint --write-envdoc"))
+        return out

@@ -1,0 +1,277 @@
+"""Lock-order / deadlock analyzer.
+
+Per class, builds the ``with self.<lock>:`` acquisition structure:
+
+- ``lock-order``          — a lock pair acquired in BOTH orders
+  somewhere in one class (the classic ABBA deadlock shape), including
+  through one level of same-class method calls;
+- ``lock-nested``         — re-acquiring a non-reentrant lock (or its
+  Condition alias) already held, directly or through a same-class
+  method call (``submit`` holding ``self._cond`` calling ``self._bump``
+  which takes the aliased ``self._lock``);
+- ``lock-blocking-call``  — a blocking call under a held lock: sleeps,
+  socket ops, ``urlopen``, queue gets, thread/future waits and joins.
+  ``cond.wait()`` while holding *that* condition is the CV idiom and is
+  allowed;
+- ``lock-callback``       — a user/stored callback invoked under a held
+  lock (done-callbacks, hooks): a reentrant callback deadlocks, a slow
+  one convoys every other thread. Snapshot under the lock, invoke
+  outside.
+
+Lock attributes are discovered from ``self.X = threading.Lock() /
+RLock() / Condition(...)`` assignments; ``Condition(self.Y)`` aliases X
+and Y into one group (they share one mutex). Attributes merely NAMED
+like locks (``*lock*``, ``*cond*``, ``*cv``, ``*mutex*``) count too, so
+a lock constructed elsewhere still participates. Nested function /
+lambda / class bodies are skipped — they execute later, outside the
+lexical lock region.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from ..core import LintPass
+from ._util import dotted_name, terminal_attr
+
+_LOCKISH_NAME = re.compile(r"(lock|cond|mutex|cv$|not_empty|not_full)")
+_CALLBACK_NAME = re.compile(
+    r"^_?(cb|fn|func|callback|hook|done|done_cb|on_done|notify_fn)$")
+_SOCKET_BLOCKING = {"accept", "recv", "recvfrom", "recv_into", "connect",
+                    "sendall", "urlopen", "getresponse"}
+_SENDRECV_HELPER = re.compile(r"^_?(send_msg|recv_msg\w*)$")
+_SKIP_SCOPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                ast.ClassDef)
+
+
+class _Lock:
+    __slots__ = ("names", "reentrant")
+
+    def __init__(self, name):
+        self.names = {name}
+        self.reentrant = False
+
+    def label(self):
+        return "self." + sorted(self.names)[0]
+
+
+class LockOrderPass(LintPass):
+    name = "lock-order"
+    rules = ("lock-order", "lock-nested", "lock-blocking-call",
+             "lock-callback")
+
+    def check(self, ctx):
+        out = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                out.extend(self._check_class(ctx, node))
+        return out
+
+    # -- lock discovery ----------------------------------------------------
+    def _discover_locks(self, cls):
+        groups = {}
+
+        def group_for(name):
+            if name not in groups:
+                groups[name] = _Lock(name)
+            return groups[name]
+
+        for node in ast.walk(cls):
+            if not isinstance(node, ast.Assign) \
+                    or not isinstance(node.value, ast.Call):
+                continue
+            ctor = terminal_attr(node.value.func)
+            if ctor not in ("Lock", "RLock", "Condition", "Semaphore",
+                            "BoundedSemaphore"):
+                continue
+            for t in node.targets:
+                if not (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"):
+                    continue
+                g = group_for(t.attr)
+                if ctor == "RLock":
+                    g.reentrant = True
+                if ctor == "Condition" and node.value.args:
+                    inner = node.value.args[0]
+                    if (isinstance(inner, ast.Attribute)
+                            and isinstance(inner.value, ast.Name)
+                            and inner.value.id == "self"):
+                        other = group_for(inner.attr)
+                        other.names |= g.names
+                        other.reentrant |= g.reentrant
+                        for n in g.names:
+                            groups[n] = other
+        return groups
+
+    def _lock_attr(self, expr, groups):
+        if (isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self"):
+            if expr.attr in groups:
+                return groups[expr.attr]
+            if _LOCKISH_NAME.search(expr.attr):
+                groups[expr.attr] = _Lock(expr.attr)
+                return groups[expr.attr]
+        return None
+
+    # -- per-class analysis ------------------------------------------------
+    def _check_class(self, ctx, cls):
+        groups = self._discover_locks(cls)
+        methods = {n.name: n for n in cls.body
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))}
+        acquired = {}      # method name -> set of group ids
+        events = []        # (held list, node)
+        for name, fn in methods.items():
+            acq = set()
+            for stmt in fn.body:
+                self._walk(stmt, groups, [], acq, events)
+            acquired[name] = acq
+
+        by_id = {}
+        for g in groups.values():
+            by_id[id(g)] = g
+
+        out = []
+        edges = {}         # (gid_a, gid_b) -> node (first witness)
+        for held, node in events:
+            out.extend(self._check_node(ctx, node, held, groups,
+                                        acquired, by_id, edges))
+        seen = set()
+        for (ia, ib), node in edges.items():
+            if (ib, ia) in edges and (ib, ia) not in seen:
+                seen.add((ia, ib))
+                out.append(ctx.finding(
+                    "lock-order", node,
+                    f"class {cls.name}: locks {by_id[ia].label()} and "
+                    f"{by_id[ib].label()} are acquired in both orders "
+                    f"(ABBA deadlock shape); pick one order"))
+        return out
+
+    def _walk(self, node, groups, held, acquired, events):
+        if isinstance(node, _SKIP_SCOPES):
+            return
+        if held:
+            events.append((list(held), node))
+        if isinstance(node, ast.With):
+            pushed = []
+            for item in node.items:
+                self._walk(item.context_expr, groups, held, acquired,
+                           events)
+                g = self._lock_attr(item.context_expr, groups)
+                if g is not None:
+                    acquired.add(id(g))
+                    pushed.append(g)
+                    held.append(g)
+            for b in node.body:
+                self._walk(b, groups, held, acquired, events)
+            del held[len(held) - len(pushed):]
+            return
+        for child in ast.iter_child_nodes(node):
+            self._walk(child, groups, held, acquired, events)
+
+    # -- per-node checks under a held lock ---------------------------------
+    def _check_node(self, ctx, node, held, groups, acquired, by_id,
+                    edges):
+        out = []
+        held_ids = {id(g) for g in held}
+        top = held[-1]
+        if isinstance(node, ast.With):
+            for item in node.items:
+                g = self._lock_attr(item.context_expr, groups)
+                if g is None:
+                    continue
+                if id(g) in held_ids:
+                    if not g.reentrant:
+                        out.append(ctx.finding(
+                            "lock-nested", node,
+                            f"re-acquiring non-reentrant lock "
+                            f"{g.label()} already held (self-deadlock)"))
+                else:
+                    by_id[id(g)] = g
+                    for h in held:
+                        by_id[id(h)] = h
+                        edges.setdefault((id(h), id(g)), node)
+            return out
+        if not isinstance(node, ast.Call):
+            return out
+
+        func = node.func
+        dname = dotted_name(func) or ""
+        term = terminal_attr(func) or ""
+
+        # same-class method call: one interprocedural level
+        if (isinstance(func, ast.Attribute)
+                and isinstance(func.value, ast.Name)
+                and func.value.id == "self" and func.attr in acquired):
+            for gid in acquired[func.attr]:
+                if gid in held_ids:
+                    g = next(g for g in held if id(g) == gid)
+                    if not g.reentrant:
+                        out.append(ctx.finding(
+                            "lock-nested", node,
+                            f"self.{func.attr}() acquires {g.label()} "
+                            f"which the caller already holds "
+                            f"(self-deadlock through a method call)"))
+                else:
+                    for h in held:
+                        edges.setdefault((id(h), gid), node)
+            return out
+
+        blocking = self._blocking_reason(node, dname, term, held, groups)
+        if blocking:
+            out.append(ctx.finding(
+                "lock-blocking-call", node,
+                f"{blocking} while holding {top.label()} — move the "
+                f"blocking call outside the lock (snapshot under lock, "
+                f"act outside)"))
+            return out
+
+        cb = self._callback_reason(func)
+        if cb:
+            out.append(ctx.finding(
+                "lock-callback", node,
+                f"{cb} invoked while holding {top.label()} — a "
+                f"reentrant or slow callback deadlocks/convoys every "
+                f"other thread; snapshot under the lock, invoke "
+                f"outside"))
+        return out
+
+    def _blocking_reason(self, call, dname, term, held, groups):
+        base = call.func.value if isinstance(call.func,
+                                             ast.Attribute) else None
+        base_term = terminal_attr(base) if base is not None else None
+        if term == "sleep" and (base_term or "").lstrip("_") == "time":
+            return "time.sleep()"
+        if term in _SOCKET_BLOCKING:
+            return f"blocking I/O call .{term}()"
+        if _SENDRECV_HELPER.match(term or ""):
+            return f"blocking wire call {term}()"
+        if term == "get" and base_term and re.search(
+                r"(^|_)(q|dq|queue)$", base_term):
+            return f"queue get on .{base_term}"
+        if term in ("wait", "wait_for"):
+            g = self._lock_attr(base, groups) if base is not None else None
+            if g is not None and any(g is h for h in held):
+                return None            # the CV idiom
+            return f".{term}() wait"
+        if term == "result":
+            return "future .result() wait"
+        if term == "join":
+            if isinstance(base, ast.Constant):        # ", ".join(...)
+                return None
+            if base_term in ("path", "os"):           # os.path.join
+                return None
+            if len(call.args) > 1:                    # separator joins
+                return None
+            return ".join() wait"
+        return None
+
+    def _callback_reason(self, func):
+        if isinstance(func, ast.Name) and _CALLBACK_NAME.match(func.id):
+            return f"callback {func.id}()"
+        if isinstance(func, ast.Attribute) \
+                and _CALLBACK_NAME.match(func.attr):
+            return f"callback .{func.attr}()"
+        return None

@@ -1,0 +1,223 @@
+"""Telemetry consistency.
+
+- ``metric-labels``       — a metric family must declare ONE label set
+  across every call site: Prometheus rejects (and Grafana silently
+  mis-joins) a family whose children disagree on label names. Checked
+  cross-file in ``finalize``;
+- ``metric-engine-label`` — every ``mxnet_tpu_serving_*`` family must
+  carry the ``engine_id`` label (the ISSUE-5 fleet contract: N engines
+  in one process — or N engine processes scrape-merged at the router —
+  must count disjointly);
+- ``span-leak``           — a span assigned to a LOCAL variable from
+  ``start_span(...)`` must be ``.end()``-ed in the same function: an
+  un-ended local root pins its trace in the active buffer forever.
+  Spans stored on ``self`` / returned / yielded escape the function
+  and are exempt;
+- ``dashboard-family``    — every metric family a
+  ``tools/dashboards/*.json`` PromQL expr references must be declared
+  somewhere in the scanned code (``_bucket``/``_sum``/``_count``
+  histogram suffixes stripped). A dashboard panel watching a family
+  that doesn't exist renders an empty graph in the exact incident it
+  was built for. Families declared via f-strings match as patterns.
+"""
+from __future__ import annotations
+
+import ast
+import glob
+import json
+import os
+import re
+
+from ..core import Finding, LintPass
+from ._util import str_const, terminal_attr
+
+_REGISTRY_RECEIVERS = {"REGISTRY", "_REGISTRY", "registry", "reg"}
+_FAMILY_CTORS = {"counter", "gauge", "histogram"}
+_PROM_NAME = re.compile(r"mxnet_tpu_[a-z0-9_]+")
+
+
+class TelemetryConsistencyPass(LintPass):
+    name = "telemetry-consistency"
+    rules = ("metric-labels", "metric-engine-label", "span-leak",
+             "dashboard-family")
+
+    def __init__(self):
+        # family -> list of (labels tuple | None, relpath, line)
+        self.declared = {}
+        self.patterns = []          # (regex, relpath, line) f-string fams
+
+    def check(self, ctx):
+        out = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                out.extend(self._check_family_decl(ctx, node))
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.extend(self._check_span_pairing(ctx, node))
+        return out
+
+    # -- metric family declarations ----------------------------------------
+    def _check_family_decl(self, ctx, call):
+        func = call.func
+        if not (isinstance(func, ast.Attribute)
+                and func.attr in _FAMILY_CTORS
+                and terminal_attr(func.value) in _REGISTRY_RECEIVERS):
+            return []
+        name_arg = call.args[0] if call.args else None
+        labels = self._labels_arg(call)
+        name = str_const(name_arg)
+        if name is None:
+            pattern = self._fstring_pattern(name_arg)
+            if pattern is not None:
+                self.patterns.append((pattern, ctx.relpath, call.lineno))
+            return []
+        self.declared.setdefault(name, []).append(
+            (labels, ctx.relpath, call.lineno))
+        if (name.startswith("mxnet_tpu_serving_")
+                and (labels is None or "engine_id" not in labels)):
+            return [ctx.finding(
+                "metric-engine-label", call,
+                f"serving family {name} must carry the engine_id label "
+                f"(fleet contract: engines count disjointly)")]
+        return []
+
+    def _labels_arg(self, call):
+        node = None
+        if len(call.args) >= 3:
+            node = call.args[2]
+        else:
+            for kw in call.keywords:
+                if kw.arg == "labels":
+                    node = kw.value
+        if node is None:
+            return ()
+        if isinstance(node, (ast.Tuple, ast.List)):
+            vals = [str_const(e) for e in node.elts]
+            if all(v is not None for v in vals):
+                return tuple(vals)
+        return None                 # dynamic: unknown
+
+    def _fstring_pattern(self, node):
+        if not isinstance(node, ast.JoinedStr):
+            return None
+        parts = []
+        for v in node.values:
+            if isinstance(v, ast.Constant):
+                parts.append(re.escape(str(v.value)))
+            else:
+                parts.append(r"[a-z0-9_]+")
+        pattern = "".join(parts)
+        if not pattern.startswith("mxnet_tpu_"):
+            return None
+        return re.compile(pattern + "$")
+
+    # -- span pairing ------------------------------------------------------
+    def _check_span_pairing(self, ctx, fn):
+        opened = {}                 # var name -> node
+        escaped = set()
+        ended = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.value, ast.Call) \
+                    and terminal_attr(node.value.func) == "start_span":
+                t = node.targets[0]
+                if isinstance(t, ast.Name):
+                    opened[t.id] = node
+                # self.x = start_span(...) escapes by construction
+            elif isinstance(node, ast.Call):
+                term = terminal_attr(node.func)
+                if term == "end" and isinstance(node.func, ast.Attribute) \
+                        and isinstance(node.func.value, ast.Name):
+                    ended.add(node.func.value.id)
+                else:
+                    for arg in list(node.args) + \
+                            [kw.value for kw in node.keywords]:
+                        if isinstance(arg, ast.Name):
+                            escaped.add(arg.id)
+            elif isinstance(node, (ast.Return, ast.Yield, ast.YieldFrom)) \
+                    and isinstance(getattr(node, "value", None), ast.Name):
+                escaped.add(node.value.id)
+            elif isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Name):
+                escaped.add(node.value.id)      # stored somewhere else
+            elif isinstance(node, ast.With):
+                for item in node.items:
+                    if isinstance(item.context_expr, ast.Call) and \
+                            terminal_attr(item.context_expr.func) \
+                            == "use_span":
+                        pass        # context use doesn't close it
+        out = []
+        for var, node in opened.items():
+            if var not in ended and var not in escaped:
+                out.append(ctx.finding(
+                    "span-leak", node,
+                    f"span {var!r} from start_span() is never .end()-ed "
+                    f"in this function and never escapes it — an open "
+                    f"local root pins its trace's active buffer"))
+        return out
+
+    # -- dashboard cross-check ---------------------------------------------
+    def finalize(self, project):
+        out = self._check_label_consistency()
+        if project.full_scan:
+            dash_dir = os.path.join(project.root, "tools", "dashboards")
+            for path in sorted(glob.glob(os.path.join(dash_dir,
+                                                      "*.json"))):
+                out.extend(self._check_dashboard(project, path))
+        return out
+
+    def _check_label_consistency(self):
+        out = []
+        for name, decls in sorted(self.declared.items()):
+            known = [(lab, rel, line) for lab, rel, line in decls
+                     if lab is not None]
+            if len({lab for lab, _, _ in known}) > 1:
+                first = known[0]
+                for lab, rel, line in known[1:]:
+                    if lab != first[0]:
+                        out.append(Finding(
+                            "metric-labels", rel, line, 0,
+                            f"family {name} declared with labels "
+                            f"{lab!r} here but {first[0]!r} at "
+                            f"{first[1]}:{first[2]} — one label set "
+                            f"per family"))
+        return out
+
+    def _check_dashboard(self, project, path):
+        try:
+            with open(path, encoding="utf-8") as fh:
+                data = json.load(fh)
+        except (OSError, ValueError) as e:
+            rel = os.path.relpath(path, project.root).replace(os.sep, "/")
+            return [Finding("dashboard-family", rel, 1, 0,
+                            f"dashboard does not parse: {e}")]
+        exprs = []
+        self._collect_exprs(data, exprs)
+        rel = os.path.relpath(path, project.root).replace(os.sep, "/")
+        out = []
+        seen = set()
+        for expr in exprs:
+            for name in _PROM_NAME.findall(expr):
+                base = re.sub(r"_(bucket|sum|count)$", "", name)
+                if base in seen:
+                    continue
+                seen.add(base)
+                if base in self.declared:
+                    continue
+                if any(p.match(base) for p, _, _ in self.patterns):
+                    continue
+                out.append(Finding(
+                    "dashboard-family", rel, 1, 0,
+                    f"dashboard queries family {base} but no scanned "
+                    f"code declares it — the panel would render empty"))
+        return out
+
+    def _collect_exprs(self, obj, out):
+        if isinstance(obj, dict):
+            for k, v in obj.items():
+                if k == "expr" and isinstance(v, str):
+                    out.append(v)
+                else:
+                    self._collect_exprs(v, out)
+        elif isinstance(obj, list):
+            for v in obj:
+                self._collect_exprs(v, out)

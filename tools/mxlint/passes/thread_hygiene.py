@@ -1,0 +1,115 @@
+"""Thread hygiene.
+
+- ``thread-unnamed``       — every ``threading.Thread(...)`` must pass
+  ``name=``: the flight recorder dumps all-thread stacks on a watchdog
+  trip, and a bundle full of ``Thread-12`` is unattributable;
+- ``thread-daemon``        — ``daemon=`` must be explicit: whether a
+  thread may outlive (block) process exit is a design decision, not an
+  inherited accident;
+- ``thread-unjoined``      — a ``daemon=False`` thread must have a
+  visible ``.join(`` somewhere in the same file (joined-or-registered:
+  a non-daemon thread nobody joins wedges interpreter shutdown);
+- ``silent-except``        — a bare/overbroad except handler inside a
+  ``while`` loop whose body is only ``pass``/``continue``: a worker
+  loop that swallows everything hides the failure the watchdog and
+  event log exist to surface. Emit an event or bump a metric before
+  swallowing.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import LintPass
+from ._util import call_kwargs, dotted_name, terminal_attr
+
+
+class ThreadHygienePass(LintPass):
+    name = "thread-hygiene"
+    rules = ("thread-unnamed", "thread-daemon", "thread-unjoined",
+             "silent-except")
+
+    def check(self, ctx):
+        out = []
+        has_join = self._has_thread_join(ctx.tree)
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                out.extend(self._check_thread(ctx, node, has_join))
+            elif isinstance(node, ast.While):
+                out.extend(self._check_loop_handlers(ctx, node))
+        return out
+
+    def _has_thread_join(self, tree):
+        """A thread-shaped ``.join(`` call anywhere in the file:
+        attribute call named join on a NON-string-constant, non-path
+        receiver, with at most a timeout argument — `", ".join(xs)` and
+        ``os.path.join(a, b)`` must not satisfy the joined-or-daemon
+        obligation."""
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "join"):
+                continue
+            base = node.func.value
+            if isinstance(base, ast.Constant):
+                continue
+            if (terminal_attr(base) or "") in ("path", "os", "sep"):
+                continue
+            if len(node.args) > 1:
+                continue
+            return True
+        return False
+
+    def _check_thread(self, ctx, call, has_join):
+        dname = dotted_name(call.func) or ""
+        if not (dname.endswith("threading.Thread")
+                or dname == "Thread"):
+            return []
+        kwargs = call_kwargs(call)
+        if any(kw.arg is None for kw in call.keywords):
+            return []           # **kwargs splat: can't see inside
+        out = []
+        if "name" not in kwargs:
+            out.append(ctx.finding(
+                "thread-unnamed", call,
+                "threading.Thread without name=: name every thread "
+                "(mxnet_tpu_<subsystem>_<role>) so flight-recorder "
+                "stack dumps are attributable"))
+        if "daemon" not in kwargs:
+            out.append(ctx.finding(
+                "thread-daemon", call,
+                "threading.Thread without explicit daemon=: decide "
+                "whether this thread may block process exit"))
+        else:
+            d = kwargs["daemon"]
+            explicit_false = (isinstance(d, ast.Constant)
+                              and d.value is False)
+            if explicit_false and not has_join:
+                out.append(ctx.finding(
+                    "thread-unjoined", call,
+                    "daemon=False thread with no .join( in this file: "
+                    "join it or make it a daemon"))
+        return out
+
+    def _check_loop_handlers(self, ctx, loop):
+        out = []
+        for node in ast.walk(loop):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not self._overbroad(node.type):
+                continue
+            if all(isinstance(stmt, (ast.Pass, ast.Continue))
+                   for stmt in node.body):
+                caught = ("bare except" if node.type is None else
+                          f"except {terminal_attr(node.type)}")
+                out.append(ctx.finding(
+                    "silent-except", node,
+                    f"{caught} in a worker loop swallows the failure "
+                    f"silently — emit a run event or bump a metric "
+                    f"before continuing"))
+        return out
+
+    def _overbroad(self, type_node):
+        if type_node is None:
+            return True
+        name = terminal_attr(type_node)
+        return name in ("Exception", "BaseException")

@@ -1,0 +1,77 @@
+"""Wire safety: nothing executable crosses a trust boundary.
+
+The ISSUE-2 hardening replaced pickled dist_async frames with a typed
+non-executable codec; the serving ``/submit`` endpoint and the
+telemetry plane parse JSON only. This pass LOCKS that in for
+``mxnet_tpu/serving/``, ``mxnet_tpu/kvstore.py`` and
+``mxnet_tpu/telemetry/``:
+
+- ``wire-unsafe`` — importing or calling ``pickle``/``cPickle``/
+  ``dill``/``shelve``/``marshal``, calling ``eval``/``exec``/
+  ``compile``, or ``yaml.load``/``yaml.unsafe_load``. One pickled frame
+  from a hostile peer is arbitrary code execution in the serving
+  process.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import LintPass
+from ._util import dotted_name
+
+_BANNED_MODULES = {"pickle", "cPickle", "dill", "shelve", "marshal"}
+_BANNED_CALLS = {"eval", "exec", "compile"}
+_SCOPED = ("mxnet_tpu/serving/", "mxnet_tpu/kvstore.py",
+           "mxnet_tpu/telemetry/")
+
+
+class WireSafetyPass(LintPass):
+    name = "wire-safety"
+    rules = ("wire-unsafe",)
+
+    def applies(self, relpath):
+        return any(relpath == s or relpath.startswith(s) for s in _SCOPED)
+
+    def check(self, ctx):
+        out = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    root = alias.name.split(".")[0]
+                    if root in _BANNED_MODULES:
+                        out.append(ctx.finding(
+                            "wire-unsafe", node,
+                            f"import {alias.name}: executable "
+                            f"deserialization is banned on the wire "
+                            f"path — use the typed codec / JSON"))
+            elif isinstance(node, ast.ImportFrom):
+                root = (node.module or "").split(".")[0]
+                if root in _BANNED_MODULES:
+                    out.append(ctx.finding(
+                        "wire-unsafe", node,
+                        f"from {node.module} import ...: executable "
+                        f"deserialization is banned on the wire path"))
+            elif isinstance(node, ast.Call):
+                out.extend(self._check_call(ctx, node))
+        return out
+
+    def _check_call(self, ctx, call):
+        dname = dotted_name(call.func) or ""
+        if isinstance(call.func, ast.Name) \
+                and call.func.id in _BANNED_CALLS:
+            return [ctx.finding(
+                "wire-unsafe", call,
+                f"{call.func.id}() on the wire path: nothing "
+                f"executable may come off a frame")]
+        root = dname.split(".")[0]
+        if root in _BANNED_MODULES:
+            return [ctx.finding(
+                "wire-unsafe", call,
+                f"{dname}() on the wire path: executable "
+                f"deserialization is banned — use the typed codec")]
+        if dname in ("yaml.load", "yaml.unsafe_load", "yaml.full_load"):
+            return [ctx.finding(
+                "wire-unsafe", call,
+                f"{dname}() constructs arbitrary objects — "
+                f"yaml.safe_load or JSON only on the wire path")]
+        return []

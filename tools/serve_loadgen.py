@@ -193,7 +193,8 @@ def run_load(engine, n_clients=8, requests_per_client=16,
                 valid_tokens[0] += n
                 latencies.append((ms, fut.trace_id))
 
-    threads = [threading.Thread(target=client, args=(c,))
+    threads = [threading.Thread(target=client, args=(c,),
+                                name=f"loadgen_client_{c}", daemon=True)
                for c in range(n_clients)]
     t_start = time.perf_counter()
     for t in threads:
