@@ -146,6 +146,27 @@ register("MXNET_TPU_MODEL_STORE", "path", None,
          "``$MXNET_HOME/models``, then ``~/.mxnet/models``)",
          scope="runtime")
 
+# -- persistent compilation cache -------------------------------------------
+register("MXNET_TPU_COMPILE_CACHE", "bool", True,
+         "persistent on-disk XLA compilation cache, configured at "
+         "CachedOp trace / executor bind time; ``0`` disables — every "
+         "process then recompiles every shape from scratch",
+         scope="compile_cache")
+register("MXNET_TPU_COMPILE_CACHE_DIR", "path", None,
+         "persistent compile-cache directory (default "
+         "``~/.cache/mxnet_tpu/compile_cache``); share it across "
+         "engine processes so restarts reuse each other's executables",
+         scope="compile_cache")
+register("MXNET_TPU_COMPILE_CACHE_MIN_S", "float", 1.0,
+         "only compiles slower than this many seconds are persisted "
+         "(``0`` persists everything — tests use it to force "
+         "cross-process hits)", scope="compile_cache")
+register("MXNET_TPU_WARMUP_MANIFEST", "path", None,
+         "warmup-manifest path: the serving router persists the "
+         "fleet-union visited-shape manifest here, and a restarting "
+         "engine replays it via ``warmup(manifest=...)`` before "
+         "admitting traffic", scope="compile_cache")
+
 # -- Pallas kernels ---------------------------------------------------------
 register("MXNET_TPU_PALLAS_INTERPRET", "bool", False,
          "run Pallas kernels in interpret mode (off-TPU kernel testing)",
@@ -222,6 +243,11 @@ register("MXNET_TPU_WATCHDOG_INTERVAL_S", "float", 5.0,
 register("MXNET_TPU_WATCHDOG_STALL_S", "float", 30.0,
          "shared stall threshold watchdog probes compare against "
          "(seconds)", scope="telemetry")
+register("MXNET_TPU_WATCHDOG_COMPILE_GRACE_S", "float", 300.0,
+         "extra stall allowance while a serving engine has a "
+         "first-visit trace+compile window open — first-visit "
+         "compiles must not trip flight-recorder bundles",
+         scope="telemetry")
 
 # -- bench ------------------------------------------------------------------
 register("MXNET_TPU_PEAK_TFLOPS", "float", None,
@@ -246,6 +272,7 @@ register("MXNET_TPU_DRYRUN_REAL", "bool", False,
 
 _SCOPE_TITLES = OrderedDict([
     ("runtime", "Core runtime"),
+    ("compile_cache", "Persistent compilation cache"),
     ("kernels", "Pallas kernels"),
     ("dist", "Distributed"),
     ("telemetry", "Telemetry / observability"),

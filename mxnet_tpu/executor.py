@@ -68,6 +68,10 @@ class Executor:
         op = self._graph_cache.get(key)
         if op is not None:
             return op
+        # binding compiles: persist the executable across processes
+        # (same whole-graph key → disk hit instead of a re-trace+build)
+        from . import compile_cache
+        compile_cache.ensure()
         sym = self._symbol
         nm = tuple(names)
 

@@ -549,6 +549,11 @@ class HybridBlock(Block):
 
     def _build_cached_op(self, args, inputs, params, ctx, training):
         """Trace hybrid_forward into a jitted function (CachedOp ctor)."""
+        # trace time is compile time: make sure the persistent
+        # compilation cache is pointed at disk BEFORE the first jit,
+        # so this executable outlives the process (warm restarts)
+        from .. import compile_cache
+        compile_cache.ensure()
         block = self
         n_in = len(inputs)
         arg_template = list(args)

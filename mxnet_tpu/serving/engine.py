@@ -29,7 +29,7 @@ import time
 
 import numpy as np
 
-from .. import autograd, profiler
+from .. import autograd, compile_cache, envvars, profiler
 from .. import ndarray as nd
 from ..context import current_context
 from ..telemetry import events as _events
@@ -136,12 +136,23 @@ class ServingEngine:
         self.stats.set_queue_depth_fn(lambda: len(self._queue))
         cc = _REGISTRY.counter(
             "mxnet_tpu_serving_compile_cache_total",
-            "per-shape CachedOp executable cache outcomes at dispatch",
+            "per-shape executable cache outcomes at dispatch: "
+            "memory_hit (in-process), persistent_hit (on-disk cache "
+            "served the compile), miss (fresh backend compile)",
             ("engine_id", "result"))
         self._compile_cache = {
-            True: cc.labels(engine_id=self.engine_id, result="hit"),
-            False: cc.labels(engine_id=self.engine_id, result="miss")}
+            r: cc.labels(engine_id=self.engine_id, result=r)
+            for r in ("memory_hit", "persistent_hit", "miss")}
+        self._cc_counts = {r: 0 for r in self._compile_cache}
         self._seen_shapes = set()
+        # guards _seen_shapes + the compile-cache tallies: the worker
+        # dispatches while warmup()/warmup_manifest() run on caller
+        # threads and the router's poll thread reads the manifest
+        self._shapes_lock = threading.Lock()
+        # monotonic stamp while a first-visit trace+compile is in
+        # flight — the watchdog widens its stall threshold over this
+        # window so legitimate compiles never trip a flight bundle
+        self._compiling_since = None
         self._worker = None
         self._expo = None
         self._abort = False
@@ -168,6 +179,9 @@ class ServingEngine:
                                             name="mxnet_tpu_serving",
                                             daemon=True)
             self._worker.start()
+        # serving compiles should outlive this process: point the
+        # persistent compilation cache at disk before the first trace
+        compile_cache.ensure()
         # a serving process should be able to explain its own death:
         # flight-recorder crash hooks + the stall watchdog ride along
         _recorder.install()
@@ -285,18 +299,48 @@ class ServingEngine:
         """Synchronous convenience: submit + wait."""
         return self.submit(tokens, token_types, deadline_ms).result(timeout)
 
-    def warmup(self, shapes=None):
+    def warmup(self, shapes=None, manifest=None):
         """Compile ahead of traffic: run one dummy forward per
         (rows, row_len) shape the batcher can emit (or the given
         subset). Serving latency then never pays a trace+compile.
+
+        ``manifest`` (a dict from :func:`~mxnet_tpu.compile_cache.
+        load_manifest` / a router's ``/warmup``, or a path to one)
+        replays exactly the fleet's VISITED buckets instead of the
+        whole universe — the warm-restart path: with the persistent
+        compilation cache primed, each replay is a disk fetch, and
+        the first real request after a rolling restart runs warm.
+        Manifest shapes outside this batcher's universe are skipped
+        (a config drift degrades coverage, never crashes startup).
 
         Call BEFORE submitting traffic (right after ``start``): the
         dummy forwards run on the caller's thread, and tracing the
         same block from two threads at once (warmup racing a live
         batch) is not supported by the CachedOp build path."""
-        for rows, row_len in (shapes or self._batcher.shape_universe()):
+        if manifest is not None:
+            if isinstance(manifest, (str, os.PathLike)):
+                manifest = compile_cache.load_manifest(manifest)
+            universe = set(self._batcher.shape_universe())
+            want = compile_cache.manifest_shapes(manifest)
+            shapes = [s for s in want if s in universe]
+            _events.emit("warmup_replay", engine_id=self.engine_id,
+                         shapes=len(shapes),
+                         skipped_incompatible=len(want) - len(shapes))
+        if shapes is None:
+            shapes = self._batcher.shape_universe()
+        for rows, row_len in shapes:
             self._forward_shape(rows, row_len)
         return self
+
+    def warmup_manifest(self):
+        """This engine's visited-shape warmup manifest (exported at
+        ``/warmup`` by :meth:`expose`; the fronting router unions the
+        fleet's and persists it for restarts)."""
+        with self._shapes_lock:
+            shapes = sorted(self._seen_shapes)
+        return compile_cache.new_manifest(
+            self.engine_id, self._batcher.bucket_lens,
+            self._batcher.max_rows, shapes)
 
     def reset_stats(self):
         """Swap in a fresh ServingStats (compile cache untouched):
@@ -335,16 +379,19 @@ class ServingEngine:
                 alive = (self._worker is not None
                          and self._worker.is_alive())
                 closed = self._queue.closed
+                compiling = self._compiling_since
                 return (alive and not closed,
                         {"engine_id": self.engine_id,
                          "worker_alive": alive, "queue_closed": closed,
                          "queue_depth": len(self._queue),
+                         "compiling": compiling is not None,
                          "seconds_since_beat":
                              round(time.monotonic() - self._beat, 3)})
 
             srv = TelemetryServer(healthz_fn=healthz,
                                   stats_fn=self.snapshot,
                                   submit_fn=self._remote_submit,
+                                  warmup_fn=self.warmup_manifest,
                                   port=port, host=host)
             self._expo = srv
         # emit/return through the local: a stop() racing in right here
@@ -364,6 +411,10 @@ class ServingEngine:
         out["bucket_lens"] = list(self._batcher.bucket_lens)
         out["max_rows"] = self._batcher.max_rows
         out["seconds_since_beat"] = round(time.monotonic() - self._beat, 3)
+        with self._shapes_lock:
+            out["compile_cache"] = dict(self._cc_counts)
+            out["manifest_shapes"] = len(self._seen_shapes)
+        out["compiling"] = self._compiling_since is not None
         return out
 
     def _remote_submit(self, payload):
@@ -405,6 +456,13 @@ class ServingEngine:
             return None
         now = time.monotonic()
         stall = _recorder.stall_seconds()
+        if self._compiling_since is not None:
+            # a first-visit trace+compile window is open: widen the
+            # threshold (ROADMAP carried follow-up) — tens-of-seconds
+            # compiles are progress, not a stall, and must not burn
+            # flight-recorder bundles; a compile outliving even the
+            # grace still trips
+            stall += envvars.get("MXNET_TPU_WATCHDOG_COMPILE_GRACE_S")
         since_beat = now - self._beat
         if since_beat > stall:
             return {"kind": "serving_worker_stall",
@@ -496,28 +554,63 @@ class ServingEngine:
                                mono_end=req.t_drain,
                                attrs={"engine": self.engine_id})
 
+    def _bump_cc(self, result):
+        with self._shapes_lock:
+            self._cc_counts[result] += 1
+        self._compile_cache[result].inc()
+
+    def _compile_forward(self, plan):
+        """First-visit forward: open the compile window (watchdog
+        grace) and classify the outcome against the jax cache events
+        — a disk-served compile (persistent_hit: trace + cache fetch)
+        vs a fresh backend build (miss). The event tally is process-
+        global, so a CONCURRENT compile on another engine can only
+        downgrade a true persistent_hit to miss (its miss events leak
+        into this window), never invent one — the warm-restart signal
+        stays conservative. Returns (seq, result, t0, t1)."""
+        cc_before = compile_cache.events_snapshot()
+        self._compiling_since = time.monotonic()
+        t0 = time.perf_counter()
+        try:
+            seq = self._forward(plan)
+        finally:
+            # refresh the heartbeat IN the same step that closes the
+            # window: a probe (or the router's wedge check) must never
+            # see the compile flag already cleared while the beat is
+            # still as old as the whole compile
+            self._beat = time.monotonic()
+            self._compiling_since = None
+        t1 = time.perf_counter()
+        result = compile_cache.classify(
+            cc_before, compile_cache.events_snapshot())
+        self._bump_cc(result)
+        return seq, result, t0, t1
+
     def _dispatch(self, plan, pack_interval=None):
         shape = (plan.rows, plan.row_len)
-        hit = shape in self._seen_shapes
-        self._compile_cache[hit].inc()
-        if not hit:
-            _events.emit("compile_begin", engine_id=self.engine_id,
-                         rows=plan.rows, row_len=plan.row_len)
-        t0 = time.perf_counter()
-        seq = self._forward(plan)
-        t1 = time.perf_counter()
-        dt_ms = (t1 - t0) * 1e3
+        with self._shapes_lock:
+            hit = shape in self._seen_shapes
         if hit:
+            self._bump_cc("memory_hit")
+            t0 = time.perf_counter()
+            seq = self._forward(plan)
+            t1 = time.perf_counter()
+            dt_ms = (t1 - t0) * 1e3
             self.stats.compute_ms.observe(dt_ms)
         else:
+            _events.emit("compile_begin", engine_id=self.engine_id,
+                         rows=plan.rows, row_len=plan.row_len)
+            seq, result, t0, t1 = self._compile_forward(plan)
+            dt_ms = (t1 - t0) * 1e3
             # first visit pays trace+compile; report it as compile
             # latency, not as a (wildly misleading) compute sample
-            self._seen_shapes.add(shape)
+            with self._shapes_lock:
+                self._seen_shapes.add(shape)
             self.stats.bump("compiles")
             self.stats.compile_ms.observe(dt_ms)
             _events.emit("compile_end", engine_id=self.engine_id,
                          rows=plan.rows, row_len=plan.row_len,
-                         ms=round(dt_ms, 3))
+                         result=result, ms=round(dt_ms, 3))
         self.stats.observe_batch(plan.rows, plan.row_len,
                                  plan.valid_tokens, len(plan.entries),
                                  plan.row_len)
@@ -592,7 +685,10 @@ class ServingEngine:
         return out.asnumpy()   # host sync: per-request slicing follows
 
     def _forward_shape(self, rows, row_len):
-        """One dummy forward at (rows, row_len) — warmup helper."""
+        """One dummy forward at (rows, row_len) — warmup helper.
+        Counts in the compile-cache split like a live dispatch (a
+        manifest replay against a primed persistent cache records
+        ``persistent_hit``s — the warm-restart acceptance signal)."""
         from .batcher import PackedPlan
 
         data = np.zeros((rows, row_len), np.int32)
@@ -601,5 +697,16 @@ class ServingEngine:
         plan = PackedPlan(data, np.zeros_like(data), seg,
                           np.zeros_like(data), np.ones(rows, np.int32),
                           entries=[], pad_rows=rows)
-        self._seen_shapes.add((rows, row_len))
-        self._forward(plan)
+        with self._shapes_lock:
+            seen = (rows, row_len) in self._seen_shapes
+        if seen:
+            self._forward(plan)
+            self._bump_cc("memory_hit")
+        else:
+            self._compile_forward(plan)
+            # mark seen only AFTER the forward succeeded: a failed
+            # warmup replay must leave the shape cold so the first
+            # live dispatch still gets the compile path (grace window
+            # + compile_ms accounting), not a phantom memory_hit
+            with self._shapes_lock:
+                self._seen_shapes.add((rows, row_len))

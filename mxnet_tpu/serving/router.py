@@ -40,6 +40,17 @@ The observability plane is the point:
    probe plus a ``router_scoreboard.json`` flight-recorder bundle
    section make a wedged engine self-diagnosing.
 
+4. **Warm restarts** — the poll thread collects each engine's
+   visited-shape **warmup manifest** (``/warmup`` /
+   ``warmup_manifest()``), keeps the fleet union, and persists it at
+   ``MXNET_TPU_WARMUP_MANIFEST`` whenever it grows; a replacement
+   engine started with ``warmup(manifest=router.warmup_manifest())``
+   (plus the persistent compilation cache,
+   :mod:`mxnet_tpu.compile_cache`) replays the fleet's working set
+   before ``add_engine`` admits it traffic — rolling restarts serve
+   their first real request warm. ``remove_engine`` completes the
+   drill.
+
 Failover: a dispatch that dies of an ENGINE-SHAPED failure (engine
 stopped, queue full, remote transport error) re-queues the request at
 the front of the line for a sibling — requests are only lost to
@@ -61,6 +72,7 @@ from collections import OrderedDict, deque
 
 import numpy as np
 
+from .. import compile_cache, envvars
 from ..telemetry import events as _events
 from ..telemetry import recorder as _recorder
 from ..telemetry import spans as _spans
@@ -159,6 +171,7 @@ class _Seat:
         self.last_picked = 0        # round-robin tie-break stamp
         self._prev_completed = None
         self._prev_poll = None
+        self._manifest_count = None  # visited shapes at last collect
 
     def row(self):
         return {"kind": self.kind, "up": self.up,
@@ -167,9 +180,13 @@ class _Seat:
                 "dispatched": self.dispatched,
                 "queue_depth": self.queue_depth,
                 "p95_ms": self.p95_ms, "qps": self.qps,
+                "manifest_shapes": self._manifest_count,
                 "consecutive_failures": self.consecutive_failures,
                 "last_change": round(self.last_change, 3),
                 "last_error": self.last_error}
+
+    def warmup_manifest(self):
+        return None
 
 
 class _LocalSeat(_Seat):
@@ -195,6 +212,12 @@ class _LocalSeat(_Seat):
     def health(self):
         snap = self._engine.snapshot()
         return bool(snap.get("running")), snap
+
+    def warmup_manifest(self):
+        try:
+            return self._engine.warmup_manifest()
+        except Exception:
+            return None
 
 
 class _RemoteSeat(_Seat):
@@ -298,6 +321,12 @@ class _RemoteSeat(_Seat):
         except Exception:
             return None
 
+    def warmup_manifest(self):
+        try:
+            return json.loads(self._get("/warmup"))
+        except Exception:
+            return None
+
 
 class ServingRouter:
     """Least-outstanding front door over N serving engines.
@@ -384,6 +413,14 @@ class ServingRouter:
             "mxnet_tpu_router_scrape_errors_total",
             "remote-engine scrape failures at the aggregated /metrics",
             ("engine_id",))
+        # fleet-union warmup manifest: the poll thread folds every
+        # live engine's visited-shape manifest in here and persists
+        # the union at MXNET_TPU_WARMUP_MANIFEST so a restarting
+        # engine can replay the fleet's working set (warm restart)
+        self._fleet_manifest = None
+        self._g_manifest = _REGISTRY.gauge(
+            "mxnet_tpu_router_warmup_manifest_shapes",
+            "shape buckets in the fleet-union warmup manifest")
         self.total_ms = LatencySummary(
             4096, _REGISTRY.histogram(
                 "mxnet_tpu_router_latency_ms",
@@ -421,6 +458,23 @@ class ServingRouter:
                 .set_function(lambda s=seat: s.outstanding)
         _events.emit("router_engine_added", router_id=self.router_id,
                      engine_id=seat.engine_id, kind=seat.kind)
+        return self
+
+    def remove_engine(self, engine_id):
+        """Deregister one seat (the rolling-restart drill: remove the
+        dead engine, then ``add_engine`` its warmed replacement under
+        the same id). In-flight dispatches to it resolve through the
+        normal failover path; new traffic stops immediately."""
+        engine_id = str(engine_id)
+        with self._lock:
+            seat = self._seats.pop(engine_id, None)
+            if seat is None:
+                raise KeyError(f"engine id {engine_id!r} not registered")
+            self._g_up.labels(engine_id=engine_id).set(0)
+            self._g_inflight.labels(engine_id=engine_id).set(0)
+            self._g_queue_depth.labels(engine_id=engine_id).set(0)
+        _events.emit("router_engine_removed", router_id=self.router_id,
+                     engine_id=engine_id, kind=seat.kind)
         return self
 
     def engine_ids(self):
@@ -737,14 +791,41 @@ class ServingRouter:
             except Exception as e:
                 ok, snap = False, {"error": repr(e)}
             beat_age = snap.get("seconds_since_beat")
-            if ok and beat_age is not None \
-                    and beat_age > _recorder.stall_seconds() \
+            allowed = _recorder.stall_seconds()
+            if snap.get("compiling"):
+                # an open first-visit compile window widens the
+                # allowance by the SAME finite grace as the engine's
+                # own watchdog — tens-of-seconds compiles are
+                # progress, but a compile outliving even the grace is
+                # a wedge and must not stay routable forever
+                allowed += envvars.get(
+                    "MXNET_TPU_WATCHDOG_COMPILE_GRACE_S")
+            if ok and beat_age is not None and beat_age > allowed \
                     and (snap.get("queue_depth") or 0) > 0:
                 # alive but WEDGED: the worker loop stopped beating
                 # with work queued — unroutable, same as unreachable
                 ok = False
                 snap = dict(snap, error=f"stalled: worker beat "
                             f"{beat_age:.1f}s old with queued work")
+            if ok:
+                mcount = snap.get("manifest_shapes")
+                if mcount is not None \
+                        and mcount != seat._manifest_count:
+                    # visited-shape set changed since the last collect:
+                    # pull the engine's manifest and fold it into the
+                    # fleet union (persisted for warm restarts). A
+                    # failing collect must not abort the poll round —
+                    # the remaining seats still need health updates.
+                    try:
+                        m = seat.warmup_manifest()
+                        if m is not None:
+                            seat._manifest_count = mcount
+                            self._fold_manifest(m)
+                    except Exception as e:
+                        _events.emit("router_manifest_error",
+                                     router_id=self.router_id,
+                                     engine_id=seat.engine_id,
+                                     error=repr(e))
             if ok:
                 seat.consecutive_failures = 0
                 seat.queue_depth = snap.get("queue_depth")
@@ -771,6 +852,47 @@ class ServingRouter:
             if seat.routable:
                 up_count += 1
         self._g_fleet.set(up_count)
+
+    def _fold_manifest(self, manifest):
+        """Union one engine's manifest into the fleet manifest; when
+        the union GROWS, persist it (MXNET_TPU_WARMUP_MANIFEST) so a
+        restarting engine finds the fleet's whole working set on disk
+        even after every live engine is gone. The in-memory union is
+        seeded from the persisted file, and an empty shape set is
+        never written: a freshly restarted fleet reporting zero
+        visited shapes must not clobber the previous run's manifest
+        (which is exactly what the next warm restart needs)."""
+        with self._lock:
+            need_seed = self._fleet_manifest is None
+        seed = compile_cache.load_manifest() if need_seed else None
+        with self._lock:
+            prev = self._fleet_manifest
+            if prev is None:
+                prev = seed
+            merged = compile_cache.merge_manifests([prev, manifest])
+            if merged is None:
+                return
+            grew = (prev is None
+                    or len(merged["shapes"]) > len(prev["shapes"])
+                    or set(merged["engines"]) != set(prev["engines"]))
+            self._fleet_manifest = merged
+        self._g_manifest.set(len(merged["shapes"]))
+        if grew and merged["shapes"]:
+            path = compile_cache.save_manifest(merged)
+            _events.emit("router_warmup_manifest",
+                         router_id=self.router_id,
+                         shapes=len(merged["shapes"]),
+                         engines=merged["engines"], path=path)
+
+    def warmup_manifest(self):
+        """The fleet-union warmup manifest (``/warmup`` on the
+        router's exposition server; falls back to the persisted file
+        when no engine has reported yet — e.g. right after a full
+        fleet restart)."""
+        with self._lock:
+            if self._fleet_manifest is not None:
+                return dict(self._fleet_manifest)
+        return compile_cache.load_manifest()
 
     def _mark(self, seat, up, reason=None):
         if seat.routable == up and seat.up == up:
@@ -815,11 +937,14 @@ class ServingRouter:
             counters = dict(self._c)
             queue_depth = len(self._queue)
             pending = self._pending
+            manifest_shapes = (len(self._fleet_manifest["shapes"])
+                               if self._fleet_manifest else 0)
         return {"router_id": self.router_id,
                 "running": self.running,
                 "counters": counters,
                 "queue_depth": queue_depth,
                 "pending": pending,
+                "manifest_shapes": manifest_shapes,
                 "engines": board,
                 "engines_up": sum(1 for r in board.values()
                                   if r["routable"]),
@@ -913,6 +1038,7 @@ class ServingRouter:
                                   metrics_fn=self.metrics_text,
                                   traces_fn=self.traces_summary,
                                   trace_fn=self.get_trace,
+                                  warmup_fn=self.warmup_manifest,
                                   port=port, host=host)
             self._expo = srv
         _events.emit("telemetry_expose", router_id=self.router_id,

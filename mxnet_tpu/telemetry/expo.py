@@ -19,7 +19,11 @@ dependencies, daemon threads — never blocks process exit):
 - ``POST /submit`` — optional dispatch endpoint (only when a
   ``submit_fn`` is attached): JSON body in, ``(status, JSON)`` out —
   how a :class:`~mxnet_tpu.serving.router.ServingRouter` drives a
-  remote engine.
+  remote engine;
+- ``/warmup`` — optional warmup-manifest endpoint (only when a
+  ``warmup_fn`` is attached): the engine's visited-shape manifest /
+  the router's fleet union, JSON — what a rolling restart replays
+  before admitting traffic.
 
 A server constructed with ``metrics_fn``/``traces_fn``/``trace_fn``
 overrides serves those endpoints from the callables instead of the
@@ -68,6 +72,8 @@ class TelemetryServer:
         ring.
     submit_fn : ``(payload_dict) -> (status, body_dict)`` enabling
         ``POST /submit`` (remote engine dispatch); None = 404.
+    warmup_fn : ``() -> dict | None`` enabling ``/warmup`` (the
+        warmup manifest a restarting engine replays); None = 404.
     port : 0 picks a free port (read it back from ``.port``).
     host : bind interface; loopback by default — exposing metrics on
         all interfaces is an operator decision, not a default.
@@ -75,7 +81,8 @@ class TelemetryServer:
 
     def __init__(self, registry=None, healthz_fn=None, stats_fn=None,
                  metrics_fn=None, traces_fn=None, trace_fn=None,
-                 submit_fn=None, port=0, host="127.0.0.1"):
+                 submit_fn=None, warmup_fn=None, port=0,
+                 host="127.0.0.1"):
         self.registry = registry if registry is not None else REGISTRY
         self.healthz_fn = healthz_fn
         self.stats_fn = stats_fn
@@ -83,6 +90,7 @@ class TelemetryServer:
         self.traces_fn = traces_fn
         self.trace_fn = trace_fn
         self.submit_fn = submit_fn
+        self.warmup_fn = warmup_fn
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -171,9 +179,24 @@ class TelemetryServer:
                 return
             self._reply(handler, 200, "application/json",
                         json.dumps(trace, default=str).encode())
+        elif path == "/warmup":
+            if self.warmup_fn is None:
+                self._reply(handler, 404, "application/json",
+                            json.dumps({"error": "no warmup manifest"})
+                            .encode())
+                return
+            try:
+                manifest = self.warmup_fn()
+            except Exception as e:
+                self._reply(handler, 500, "application/json",
+                            json.dumps({"error": repr(e)}).encode())
+                return
+            self._reply(handler, 200, "application/json",
+                        json.dumps(manifest, default=str).encode())
         else:
             self._reply(handler, 404, "text/plain",
-                        b"try /metrics, /healthz, /stats or /traces\n")
+                        b"try /metrics, /healthz, /stats, /traces "
+                        b"or /warmup\n")
 
     def _route_post(self, handler):
         path = handler.path.split("?", 1)[0]

@@ -1,0 +1,41 @@
+"""Subprocess body for the cross-process persistent-cache golden
+(tests/test_compile_cache.py): build the SAME tiny model the same way,
+serve one request per bucket through a ServingEngine, and print the
+engine's compile-cache split as JSON. Run twice against one
+MXNET_TPU_COMPILE_CACHE_DIR: the first process records ``miss`` (fresh
+backend compiles), the second records ``persistent_hit`` for the same
+(model, bucket) — the executable came off disk, proving the cache key
+is stable across process lifetimes."""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main():
+    import mxnet_tpu as mx
+    from mxnet_tpu import compile_cache
+    from mxnet_tpu.gluon.model_zoo.bert import BERTModel, bert_serving_entry
+    from mxnet_tpu.serving import ServingEngine
+
+    mx.random.seed(7)
+    net = BERTModel(vocab_size=64, units=16, hidden_size=32, num_layers=1,
+                    num_heads=2, max_length=16, dropout=0.0,
+                    attention_dropout=0.0, use_pooler=False)
+    net.initialize(init=mx.initializer.Normal(0.02))
+    eng = ServingEngine(bert_serving_entry(net), bucket_lens=(8,),
+                        max_rows=1, pool="mean", engine_id="golden")
+    with eng:
+        eng.infer([1, 2, 3, 4, 5], timeout=120)
+        snap = eng.snapshot()
+    print(json.dumps({"compile_cache": snap["compile_cache"],
+                      "manifest_shapes": snap["manifest_shapes"],
+                      "jax_events": compile_cache.events_snapshot(),
+                      "state": compile_cache.state()}))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -344,13 +344,17 @@ def test_compile_cache_and_bucket_counters():
     eid = eng.engine_id
     # engine_id labels (ROADMAP per-chip metrics): a FRESH engine's
     # children start at zero — no cross-engine accumulation to diff
-    assert reg_hits.labels(engine_id=eid, result="hit").value == 0
+    assert reg_hits.labels(engine_id=eid, result="memory_hit").value == 0
     with eng:
         eng.infer([1, 2], timeout=30)
         eng.infer([3, 4], timeout=30)
         eng.infer([5], timeout=30)
-    assert reg_hits.labels(engine_id=eid, result="miss").value >= 1
-    assert reg_hits.labels(engine_id=eid, result="hit").value >= 1
+    # first visit is a compile (miss, or persistent_hit when the
+    # on-disk cache already held it); repeats are memory_hits
+    assert (reg_hits.labels(engine_id=eid, result="miss").value
+            + reg_hits.labels(engine_id=eid,
+                              result="persistent_hit").value) >= 1
+    assert reg_hits.labels(engine_id=eid, result="memory_hit").value >= 1
     tokens = REGISTRY.counter("mxnet_tpu_serving_batch_tokens_total",
                               "", ("engine_id", "bucket"))
     assert tokens.labels(engine_id=eid, bucket=16).value > 0

@@ -121,6 +121,106 @@ def cross_check_router(outcomes, attempts, delta):
     return not mismatches, mismatches
 
 
+def _watch_restarts(router, stop_evt, restarts, poll_s=0.05):
+    """Scoreboard watcher for router-driven runs: an engine seat that
+    goes unroutable/disappears and comes back (or a replacement seat
+    appearing mid-run — the rolling-restart drill) is recorded with
+    its downtime and its time-to-first-token after restart (first
+    completed request on that engine after it reappeared; falls back
+    to first dispatched for engines whose counters this process can't
+    see). Appends dicts to ``restarts`` and returns when stopped."""
+    try:
+        from mxnet_tpu.telemetry.registry import REGISTRY
+        fam = REGISTRY.counter(
+            "mxnet_tpu_serving_requests_total",
+            "serving requests by admission/completion outcome, "
+            "per engine", ("engine_id", "event"))
+
+        def completed(eid):
+            return fam.labels(engine_id=eid, event="completed").value
+    except Exception:         # remote-only fleet: dispatched fallback
+        def completed(eid):
+            return None
+
+    seen = {}          # eid -> {"routable", "down_at", "dispatched"}
+    open_restarts = {}  # eid -> record still waiting for first token
+    first = True
+    while True:
+        stopped = stop_evt.wait(0.0 if first else poll_s)
+        now = time.perf_counter()
+        board = router.scoreboard()
+        for eid, row in board.items():
+            st = seen.get(eid)
+            restarted = False
+            if st is None:
+                # a seat appearing AFTER the initial snapshot is a
+                # restarted/replacement engine
+                restarted = not first
+                seen[eid] = st = {"routable": bool(row["routable"]),
+                                  "down_at": None,
+                                  "dispatched": row.get("dispatched", 0)}
+            elif row.get("dispatched", 0) < st["dispatched"]:
+                # dispatch count went BACKWARDS: a replacement seat
+                # took this id between two polls (remove+add faster
+                # than the poll period)
+                restarted = True
+                st["routable"] = bool(row["routable"])
+            elif bool(row["routable"]) != st["routable"]:
+                st["routable"] = bool(row["routable"])
+                if not st["routable"]:
+                    st["down_at"] = now
+                else:
+                    restarted = True
+            st["dispatched"] = row.get("dispatched", 0)
+            if restarted:
+                rec = {"engine_id": eid,
+                       "downtime_s": (round(now - st["down_at"], 3)
+                                      if st.get("down_at") else None),
+                       "ttft_ms": None,
+                       "_t0": now,
+                       "_completed0": completed(eid),
+                       "_dispatched0": row.get("dispatched", 0)}
+                st["down_at"] = None
+                open_restarts[eid] = rec
+                restarts.append(rec)
+        for eid in [e for e in seen if e not in board]:
+            st = seen[eid]
+            if st["down_at"] is None:       # removed seat == down
+                st["down_at"] = now
+            st["routable"] = False
+        for eid, rec in list(open_restarts.items()):
+            row = board.get(eid)
+            if row is None:
+                continue
+            done_now = completed(eid)
+            if row.get("kind") == "remote":
+                # remote seats' counters live in another process (the
+                # local registry child stays 0 forever): the router's
+                # dispatched count is the only observable signal —
+                # ttft is then first-dispatch, slightly optimistic
+                served = (row.get("dispatched", 0)
+                          > rec["_dispatched0"])
+            else:
+                # local seats: first COMPLETION only. Dispatched moves
+                # the moment the router hands the request over — long
+                # before a cold engine finishes its first-visit
+                # compile, which is exactly the latency to measure.
+                served = (done_now is not None
+                          and rec["_completed0"] is not None
+                          and done_now > rec["_completed0"])
+            if served:
+                rec["ttft_ms"] = round(
+                    (time.perf_counter() - rec["_t0"]) * 1e3, 3)
+                del open_restarts[eid]
+        first = False
+        if stopped:
+            for rec in restarts:
+                rec.pop("_t0", None)
+                rec.pop("_completed0", None)
+                rec.pop("_dispatched0", None)
+            return
+
+
 def run_load(engine, n_clients=8, requests_per_client=16,
              min_len=16, max_len=512, vocab=30522, deadline_ms=None,
              result_timeout_s=600.0, seed=0, metrics_url=None):
@@ -196,12 +296,30 @@ def run_load(engine, n_clients=8, requests_per_client=16,
     threads = [threading.Thread(target=client, args=(c,),
                                 name=f"loadgen_client_{c}", daemon=True)
                for c in range(n_clients)]
+    restarts = []
+    watcher = stop_watch = None
+    if is_router:
+        # restart observer: if an engine dies and comes back mid-run
+        # (rolling restart / failover drill), the report carries its
+        # downtime and post-restart time-to-first-token
+        stop_watch = threading.Event()
+        watcher = threading.Thread(
+            target=_watch_restarts, args=(engine, stop_watch, restarts),
+            name="loadgen_restart_watch", daemon=True)
+        watcher.start()
     t_start = time.perf_counter()
     for t in threads:
         t.start()
     for t in threads:
         t.join()
     wall = time.perf_counter() - t_start
+    if watcher is not None:
+        stop_watch.set()
+        watcher.join(timeout=5.0)
+        # publish COPIES without the watcher's private keys: if the
+        # join timed out the thread may still be mutating the records
+        restarts = [{k: v for k, v in rec.items()
+                     if not k.startswith("_")} for rec in restarts]
 
     from mxnet_tpu.serving.metrics import nearest_rank
 
@@ -234,6 +352,7 @@ def run_load(engine, n_clients=8, requests_per_client=16,
                                 for eid, row in snap["engines"].items()}
         report["failovers"] = snap["counters"].get("requeued", 0)
         report["engines_up"] = snap.get("engines_up")
+        report["restarts"] = restarts
     if metrics_url:
         from mxnet_tpu.telemetry import histogram_quantile
 
@@ -361,6 +480,13 @@ def _main():
               + " ".join(f"{eid}={n} ({n / total:.0%})"
                          for eid, n in sorted(
                              report["per_engine"].items())),
+              file=sys.stderr)
+    for rec in report.get("restarts") or ():
+        ttft = rec.get("ttft_ms")
+        print(f"# engine restart observed: {rec['engine_id']} "
+              f"downtime={rec.get('downtime_s')}s "
+              f"time-to-first-token="
+              f"{f'{ttft:.1f} ms' if ttft is not None else 'n/a'}",
               file=sys.stderr)
     if report.get("slowest_traces"):
         print("# slowest traces (span trees, while the ring holds "

@@ -164,6 +164,25 @@ def dump_traces(summary, out=None, top=10):
               f"{rec['status']:<7} {rec.get('keep_reason', '')}", file=out)
 
 
+def compile_cache_split(metrics_text):
+    """Per-engine memory_hit / persistent_hit / miss compile-cache
+    counts from an exposition scrape (plus the process-wide jax
+    persistent-cache event counters under the ``(jax)`` key)."""
+    from mxnet_tpu.telemetry.expo import parse_labels, \
+        parse_prometheus_text
+
+    out = {}
+    for key, val in parse_prometheus_text(metrics_text).items():
+        name, labels = parse_labels(key)
+        if name == "mxnet_tpu_serving_compile_cache_total":
+            eid = labels.get("engine_id", "?")
+            out.setdefault(eid, {})[labels.get("result", "?")] = val
+        elif name == "mxnet_tpu_compile_cache_persistent_total":
+            out.setdefault("(jax)", {})[
+                f"persistent_{labels.get('result', '?')}"] = val
+    return out
+
+
 def dump_fleet(base, out=None, top=5):
     """One-screen fleet view from a router endpoint: scoreboard +
     counters + slowest cross-engine traces (with serving engines)."""
@@ -177,9 +196,10 @@ def dump_fleet(base, out=None, top=5):
           f"{stats.get('pending')} " + "-" * 10, file=out)
     print(f"  {'engine':<16} {'kind':<7} {'up':<5} {'outst':>6} "
           f"{'queue':>6} {'qps':>8} {'p95 ms':>9} {'dispatched':>11} "
-          f"last_error", file=out)
+          f"{'shapes':>7} last_error", file=out)
     for eid, row in sorted(engines.items()):
         p95 = row.get("p95_ms")
+        shapes = row.get("manifest_shapes")
         print(f"  {eid:<16} {row.get('kind', '?'):<7} "
               f"{str(bool(row.get('routable'))):<5} "
               f"{row.get('outstanding', 0):>6} "
@@ -187,10 +207,21 @@ def dump_fleet(base, out=None, top=5):
               f"{row.get('qps', 0):>8} "
               f"{(f'{p95:.1f}' if p95 is not None else '-'):>9} "
               f"{row.get('dispatched', 0):>11} "
+              f"{shapes if shapes is not None else '-':>7} "
               f"{row.get('last_error') or ''}", file=out)
     counters = stats.get("counters", {})
     nonzero = {k: v for k, v in counters.items() if v}
     print(f"  router counters: {nonzero or counters}", file=out)
+    print(f"  fleet warmup manifest: "
+          f"{stats.get('manifest_shapes', 0)} shape buckets", file=out)
+    try:
+        cc = compile_cache_split(_fetch(base + "/metrics"))
+    except Exception:
+        cc = {}
+    for eid, split in sorted(cc.items()):
+        print("  compile-cache "
+              + f"{eid}: " + " ".join(f"{k}={int(v)}" for k, v in
+                                      sorted(split.items())), file=out)
     try:
         traces = json.loads(_fetch(base + "/traces"))
     except Exception as e:
