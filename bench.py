@@ -191,6 +191,27 @@ def _report(metric, value, unit, vs_baseline, flops_per_step=0.0,
                     for t, r, d in slowest]
         except Exception:
             pass
+    if "resources" not in rec:
+        # per-leg resource footprint: RSS/device-memory watermarks
+        # (each leg is its own process, so the peak IS the leg's) —
+        # a memory regression shows in bench_suite_summary, not in an
+        # OOM three legs later
+        try:
+            from mxnet_tpu.telemetry import resources as _resources
+            rec["resources"] = _resources.compact()
+        except Exception:
+            pass
+    if "profile_top" not in rec:
+        # where the leg's HOST time went, from the always-on sampling
+        # profiler (empty when MXNET_TPU_PROF=0)
+        try:
+            from mxnet_tpu.telemetry import profiling as _profiling
+            if _profiling.PROFILER.running:
+                rec["profile_top"] = [
+                    f"{t['frame']} {t['self_frac'] * 100:.0f}%"
+                    for t in _profiling.top_self(3)]
+        except Exception:
+            pass
     print(json.dumps(rec))
     sys.stdout.flush()
 
@@ -1010,6 +1031,7 @@ def main_serving():
     assert report["completed"] == clients * reqs, report
     server = report.get("server", {})
     assert server.get("reconciled", True), server
+    cost = report.get("cost", {})
     _report("bert_serving_requests_per_sec_per_chip",
             report["requests_per_sec"], "requests/sec/chip", 0.0,
             seqlen=seqlen, batch=max_rows, clients=clients,
@@ -1022,6 +1044,8 @@ def main_serving():
             compute_p50_ms=snap["latency"]["compute"].get("p50_ms"),
             queue_p50_ms=snap["latency"]["queue"].get("p50_ms"),
             telemetry_reconciled=server.get("reconciled"),
+            cost_reconciled=cost.get("reconciled"),
+            device_s_per_1k_tokens=cost.get("device_s_per_1k_tokens"),
             server_p50_ms_est=server.get("latency", {}).get("p50_ms_est"))
 
 
@@ -1137,6 +1161,9 @@ def main_serving_router():
                         for eid, n in sorted(per_engine.items())},
             failover=report["failovers"],
             engines_up=report["engines_up"],
+            cost_reconciled=report.get("cost", {}).get("reconciled"),
+            device_s_per_1k_tokens=report.get("cost", {})
+            .get("device_s_per_1k_tokens"),
             telemetry_reconciled=server.get("reconciled"),
             server_p50_ms_est=server.get("latency", {}).get("p50_ms_est"))
 
@@ -1496,7 +1523,9 @@ _SUMMARY_KEYS = ("metric", "value", "unit", "mfu", "hbm_frac", "hbm_est",
                  "seqlen", "batch", "failed", "causal", "clients",
                  "p50_ms", "p99_ms", "telemetry_reconciled", "telemetry",
                  "slowest_traces", "per_engine", "failover", "engines_up",
-                 "ttft_cold_ms", "ttft_warm_ms", "lost")
+                 "ttft_cold_ms", "ttft_warm_ms", "lost", "resources",
+                 "profile_top", "cost_reconciled",
+                 "device_s_per_1k_tokens")
 
 
 def _compact(rec):
@@ -1618,6 +1647,16 @@ def main_suite():
 
 def _dispatch():
     _model = os.environ.get("BENCH_MODEL")
+    if _model is not None:
+        # every measured leg runs under the always-on sampling
+        # profiler + resource sweep (MXNET_TPU_PROF=0 opts out): the
+        # per-leg record then carries RSS/device-mem watermarks and
+        # the top host-time frames
+        try:
+            from mxnet_tpu.telemetry import profiling as _profiling
+            _profiling.ensure_started()
+        except Exception:
+            pass
     if _model is None:
         main_suite()
     elif _model == "bert":

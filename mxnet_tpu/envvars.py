@@ -231,6 +231,30 @@ register("MXNET_TPU_TRACE_MAX_ACTIVE", "int", 256,
          "in-flight (not yet sampled) trace buffer cap",
          scope="telemetry")
 
+# -- telemetry: continuous profiler / resource accounting -------------------
+register("MXNET_TPU_PROF", "bool", True,
+         "always-on continuous sampling profiler daemon (Google-Wide-"
+         "Profiling style): started by serving engines/routers and "
+         "bench legs, samples every thread's Python stack into bounded "
+         "folded-stack counts served at ``/profile``; ``0`` disables",
+         scope="telemetry")
+register("MXNET_TPU_PROF_HZ", "float", 19.0,
+         "continuous-profiler sampling rate (Hz); the odd default "
+         "avoids phase-locking with 1 s/100 ms periodic work",
+         scope="telemetry")
+register("MXNET_TPU_PROF_MAX_STACKS", "int", 2048,
+         "distinct (thread, folded-stack) entries kept by the "
+         "continuous profiler; overflow folds into a per-thread "
+         "``(stack-table-full)`` bucket so totals stay honest",
+         scope="telemetry")
+register("MXNET_TPU_PROF_MAX_DEPTH", "int", 48,
+         "frames kept per sampled stack (deepest callees win)",
+         scope="telemetry")
+register("MXNET_TPU_PROF_RESOURCE_S", "float", 1.0,
+         "period of the resource-gauge sweep (host RSS/fds/threads + "
+         "device memory) the profiler daemon runs between stack "
+         "samples", scope="telemetry")
+
 # -- telemetry: flight recorder / watchdog ----------------------------------
 register("MXNET_TPU_FLIGHT_DIR", "path", None,
          "flight-recorder bundle directory (default "

@@ -33,12 +33,13 @@ from .. import autograd, compile_cache, envvars, profiler
 from .. import ndarray as nd
 from ..context import current_context
 from ..telemetry import events as _events
+from ..telemetry import profiling as _profiling
 from ..telemetry import recorder as _recorder
 from ..telemetry import spans as _spans
 from ..telemetry.registry import REGISTRY as _REGISTRY
 from ..telemetry.trace import trace_context as _trace_context
 from .batcher import ContinuousBatcher
-from .metrics import ServingStats
+from .metrics import CostLedger, ServingStats
 from .queue import (DeadlineExceededError, EngineStoppedError, Request,
                     RequestQueue, RequestTooLongError, ServingError)
 
@@ -134,6 +135,11 @@ class ServingEngine:
         self._pool = _POOLERS[pool] if isinstance(pool, str) else pool
         self.stats = ServingStats(stats_window, engine_id=self.engine_id)
         self.stats.set_queue_depth_fn(lambda: len(self._queue))
+        # per-bucket cost ledger: device/compile seconds + requests +
+        # tokens, cumulative for the process lifetime (reset_stats
+        # swaps the stats WINDOW, never the ledger — /costs scrapers
+        # diff, same contract as registry counters)
+        self.costs = CostLedger(self.engine_id)
         cc = _REGISTRY.counter(
             "mxnet_tpu_serving_compile_cache_total",
             "per-shape executable cache outcomes at dispatch: "
@@ -186,6 +192,9 @@ class ServingEngine:
         # flight-recorder crash hooks + the stall watchdog ride along
         _recorder.install()
         _recorder.register_probe(self._probe_name, self._watchdog_probe)
+        # ... and where its host time goes while alive: the always-on
+        # sampling profiler + resource sweep (MXNET_TPU_PROF=0 opts out)
+        _profiling.ensure_started()
         _events.emit("engine_start", engine_id=self.engine_id,
                      bucket_lens=list(self._batcher.bucket_lens),
                      max_rows=self._batcher.max_rows)
@@ -358,7 +367,9 @@ class ServingEngine:
         for this engine: Prometheus ``/metrics`` off the process
         registry, ``/healthz`` liveness (worker thread alive, queue
         open, seconds since the worker loop's last beat), ``/stats``
-        serving this engine's ``snapshot()`` JSON, and ``POST
+        serving this engine's ``snapshot()`` JSON, ``/costs`` (the
+        per-bucket cost ledger), ``/profile`` (the process continuous
+        profiler's collapsed stacks), and ``POST
         /submit`` — the remote dispatch endpoint a
         :class:`~.router.ServingRouter` in another process drives
         (JSON request in, JSON result out, long-polled until the
@@ -392,6 +403,7 @@ class ServingEngine:
                                   stats_fn=self.snapshot,
                                   submit_fn=self._remote_submit,
                                   warmup_fn=self.warmup_manifest,
+                                  costs_fn=self.cost_table,
                                   port=port, host=host)
             self._expo = srv
         # emit/return through the local: a stop() racing in right here
@@ -415,7 +427,17 @@ class ServingEngine:
             out["compile_cache"] = dict(self._cc_counts)
             out["manifest_shapes"] = len(self._seen_shapes)
         out["compiling"] = self._compiling_since is not None
+        out["costs"] = self.costs.totals()
         return out
+
+    def cost_table(self):
+        """The ``/costs`` body: this engine's per-bucket cost ledger
+        (device/compile seconds, requests, valid tokens, derived
+        per-request and per-1k-token rates) plus the cross-bucket
+        totals. A fronting router merges these into the fleet table."""
+        return {"engine_id": self.engine_id,
+                "buckets": self.costs.table(),
+                "totals": self.costs.totals()}
 
     def _remote_submit(self, payload):
         """``POST /submit`` handler (runs on an exposition-server
@@ -445,7 +467,11 @@ class ServingEngine:
                      "engine_id": self.engine_id})
         return 200, {"ok": True, "result": np.asarray(out).tolist(),
                      "trace_id": fut.trace_id,
-                     "engine_id": self.engine_id}
+                     "engine_id": self.engine_id,
+                     # amortized cost attribution crosses the wire so
+                     # a remote router's caller sees the same bill an
+                     # in-process caller would
+                     "cost": getattr(fut, "cost", None)}
 
     # -- watchdog ----------------------------------------------------------
     def _watchdog_probe(self):
@@ -611,6 +637,9 @@ class ServingEngine:
             _events.emit("compile_end", engine_id=self.engine_id,
                          rows=plan.rows, row_len=plan.row_len,
                          result=result, ms=round(dt_ms, 3))
+        dt_s = t1 - t0
+        self.costs.observe_batch(plan.row_len, dt_s, len(plan.entries),
+                                 plan.valid_tokens, compiled=not hit)
         self.stats.observe_batch(plan.rows, plan.row_len,
                                  plan.valid_tokens, len(plan.entries),
                                  plan.row_len)
@@ -632,6 +661,20 @@ class ServingEngine:
                      "requests": len(plan.entries), "compiled": not hit,
                      "engine": self.engine_id}
         for req, pl in plan.entries:
+            # amortized cost attribution: the batch's forward wall,
+            # split by token share, rides the future so callers (and
+            # the router/loadgen cross-checks) see what THIS request
+            # cost the device. Shares sum to the batch time exactly —
+            # the ledger-exactness contract. Written before pool/
+            # result so even a failing postprocess keeps its bill.
+            share = (pl.length / plan.valid_tokens
+                     if plan.valid_tokens else 0.0)
+            req.future.cost = {"engine_id": self.engine_id,
+                               "bucket": plan.row_len,
+                               "device_s": dt_s * share,
+                               "compiled": not hit,
+                               "tokens": pl.length,
+                               "batch_requests": len(plan.entries)}
             record_spans = req.span.span_id is not None
             if record_spans:
                 self._queue_span(req)
@@ -700,10 +743,14 @@ class ServingEngine:
         with self._shapes_lock:
             seen = (rows, row_len) in self._seen_shapes
         if seen:
+            t0 = time.perf_counter()
             self._forward(plan)
+            self.costs.observe_warmup(row_len, time.perf_counter() - t0,
+                                      compiled=False)
             self._bump_cc("memory_hit")
         else:
-            self._compile_forward(plan)
+            _seq, _result, t0, t1 = self._compile_forward(plan)
+            self.costs.observe_warmup(row_len, t1 - t0, compiled=True)
             # mark seen only AFTER the forward succeeded: a failed
             # warmup replay must leave the shape cold so the first
             # live dispatch still gets the compile path (grace window

@@ -33,7 +33,8 @@ from collections import deque
 
 from ..telemetry.registry import REGISTRY
 
-__all__ = ["LatencySummary", "ServingStats", "nearest_rank"]
+__all__ = ["LatencySummary", "ServingStats", "CostLedger",
+           "nearest_rank", "merge_cost_buckets"]
 
 # batch-size histogram boundaries (requests per dispatched batch)
 _BATCH_REQ_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0)
@@ -105,6 +106,140 @@ class LatencySummary:
                 "p95_ms": round(nearest_rank(xs, 95), 3),
                 "p99_ms": round(nearest_rank(xs, 99), 3),
                 "max_ms": round(mx, 3)}
+
+
+class CostLedger:
+    """Per-bucket resource/cost accounting for one engine — what a
+    request actually COSTS, not just how fast it was.
+
+    Every dispatched batch lands in the row-length bucket it ran at,
+    split by where the wall time went:
+
+    - ``device_s``   — forward wall seconds of memory-hit batches (the
+      steady-state serving cost);
+    - ``compile_s``  — first-visit trace+compile wall seconds (live
+      batches AND warmup replays — the amortizable startup cost);
+    - ``warmup_s``   — memory-hit warmup forwards (dummy traffic; kept
+      apart so device_s reconciles against real requests exactly);
+    - ``request_s``  — the amortizable slice: seconds of batches that
+      carried real requests (device or compile). The engine writes
+      each member request's token-weighted share onto its
+      ``InferenceFuture.cost``, so ``sum(per-request device_s) ==
+      request_s`` by construction — the exactness contract
+      tests/test_profiling.py pins and ``serve_loadgen`` cross-checks;
+    - ``requests`` / ``valid_tokens`` / ``batches`` — the divisor side.
+
+    The same numbers feed the ``mxnet_tpu_serving_cost_*`` registry
+    families (engine-labeled, per the fleet contract) so Prometheus
+    rates give fleet cost-per-1k-tokens live. The ledger is
+    process-cumulative like registry counters: ``reset_stats`` swaps
+    the stats WINDOW, never the ledger — scrapers diff ``/costs``
+    between scrapes.
+    """
+
+    FIELDS = ("device_s", "compile_s", "warmup_s", "request_s")
+
+    def __init__(self, engine_id, registry=None):
+        reg = registry if registry is not None else REGISTRY
+        self.engine_id = str(engine_id)
+        self._lock = threading.Lock()
+        self._buckets = {}      # bucket_len -> row dict
+        self._sec = reg.counter(
+            "mxnet_tpu_serving_cost_seconds_total",
+            "accumulated serving wall seconds by row-length bucket and "
+            "kind (device = memory-hit batch forward, compile = "
+            "first-visit trace+compile, warmup = dummy warmup forward)",
+            ("engine_id", "bucket", "kind"))
+        self._req = reg.counter(
+            "mxnet_tpu_serving_cost_requests_total",
+            "requests whose device time was amortized into the cost "
+            "ledger, by row-length bucket",
+            ("engine_id", "bucket"))
+        self._tok = reg.counter(
+            "mxnet_tpu_serving_cost_tokens_total",
+            "valid tokens cost-accounted, by row-length bucket",
+            ("engine_id", "bucket"))
+
+    def _row(self, bucket_len):
+        row = self._buckets.get(bucket_len)
+        if row is None:
+            row = self._buckets.setdefault(
+                bucket_len, {f: 0.0 for f in self.FIELDS}
+                | {"requests": 0, "valid_tokens": 0, "batches": 0})
+        return row
+
+    def observe_batch(self, bucket_len, seconds, requests, valid_tokens,
+                      compiled):
+        """One LIVE dispatched batch: ``seconds`` is the batch's
+        forward wall (including the compile on first visit)."""
+        kind = "compile" if compiled else "device"
+        with self._lock:
+            row = self._row(bucket_len)
+            row["compile_s" if compiled else "device_s"] += seconds
+            if requests:
+                row["request_s"] += seconds
+            row["requests"] += requests
+            row["valid_tokens"] += valid_tokens
+            row["batches"] += 1
+        self._sec.labels(engine_id=self.engine_id, bucket=bucket_len,
+                         kind=kind).inc(seconds)
+        if requests:
+            self._req.labels(engine_id=self.engine_id,
+                             bucket=bucket_len).inc(requests)
+        if valid_tokens:
+            self._tok.labels(engine_id=self.engine_id,
+                             bucket=bucket_len).inc(valid_tokens)
+
+    def observe_warmup(self, bucket_len, seconds, compiled):
+        """A dummy warmup forward (no requests): compile seconds count
+        with the compiles, memory-hit replays stay in warmup_s."""
+        kind = "compile" if compiled else "warmup"
+        with self._lock:
+            row = self._row(bucket_len)
+            row["compile_s" if compiled else "warmup_s"] += seconds
+            row["batches"] += 1
+        self._sec.labels(engine_id=self.engine_id, bucket=bucket_len,
+                         kind=kind).inc(seconds)
+
+    @staticmethod
+    def _derive(row):
+        out = dict(row)
+        for f in CostLedger.FIELDS:
+            out[f] = round(out[f], 6)
+        if out["requests"]:
+            out["device_ms_per_request"] = round(
+                out["request_s"] * 1e3 / out["requests"], 3)
+        if out["valid_tokens"]:
+            out["device_s_per_1k_tokens"] = round(
+                out["request_s"] * 1e3 / out["valid_tokens"], 6)
+        return out
+
+    def table(self):
+        """``{bucket_len(str): row}`` with derived per-request /
+        per-1k-token rates — the ``/costs`` body."""
+        with self._lock:
+            rows = {str(b): dict(r)
+                    for b, r in sorted(self._buckets.items())}
+        return {b: self._derive(r) for b, r in rows.items()}
+
+    def totals(self):
+        """One row summed across buckets (the /stats `costs` line)."""
+        with self._lock:
+            rows = [dict(r) for r in self._buckets.values()]
+        return self._derive(merge_cost_buckets(rows))
+
+
+def merge_cost_buckets(rows):
+    """Sum cost-ledger rows field-by-field (a router folding N
+    engines' buckets, or totals across buckets)."""
+    out = {f: 0.0 for f in CostLedger.FIELDS} \
+        | {"requests": 0, "valid_tokens": 0, "batches": 0}
+    for row in rows:
+        for f in CostLedger.FIELDS:
+            out[f] += row.get(f, 0.0) or 0.0
+        for f in ("requests", "valid_tokens", "batches"):
+            out[f] += int(row.get(f, 0) or 0)
+    return out
 
 
 class ServingStats:

@@ -56,6 +56,13 @@ class InferenceFuture:
     executor/cancel semantics the engine doesn't have): ``result``
     blocks until the worker fulfils it, re-raising the request's
     failure (deadline, shutdown, model error) in the CALLER's thread.
+
+    ``cost`` is the request's amortized bill, written by the engine at
+    dispatch (and forwarded by the router across processes): a dict of
+    ``engine_id``, row-length ``bucket``, token-share ``device_s`` of
+    the batch forward, ``compiled`` (first-visit batch), ``tokens``
+    and ``batch_requests`` — None until dispatched (sheds and
+    pre-dispatch expiries never ran, so they cost nothing).
     """
 
     def __init__(self):
@@ -64,6 +71,7 @@ class InferenceFuture:
         self._exc = None
         self._lock = threading.Lock()
         self._callbacks = []
+        self.cost = None
 
     def done(self):
         return self._event.is_set()

@@ -51,6 +51,13 @@ The observability plane is the point:
    their first real request warm. ``remove_engine`` completes the
    drill.
 
+5. **Fleet cost accounting** — ``/costs`` merges every engine's
+   per-bucket cost ledger (device/compile seconds, requests, valid
+   tokens; :class:`~.metrics.CostLedger`) into one fleet table with
+   per-request / per-1k-token rates, and completed requests carry
+   their engine-computed amortized ``future.cost`` through the router
+   untouched.
+
 Failover: a dispatch that dies of an ENGINE-SHAPED failure (engine
 stopped, queue full, remote transport error) re-queues the request at
 the front of the line for a sibling — requests are only lost to
@@ -74,12 +81,13 @@ import numpy as np
 
 from .. import compile_cache, envvars
 from ..telemetry import events as _events
+from ..telemetry import profiling as _profiling
 from ..telemetry import recorder as _recorder
 from ..telemetry import spans as _spans
 from ..telemetry.registry import REGISTRY as _REGISTRY
 from ..telemetry.trace import new_trace_id
 from .engine import ServingEngine
-from .metrics import LatencySummary
+from .metrics import LatencySummary, merge_cost_buckets
 from .queue import (DeadlineExceededError, EngineStoppedError,
                     InferenceFuture, QueueFullError, ServingError,
                     validate_tokens)
@@ -173,6 +181,9 @@ class _Seat:
         self._prev_poll = None
         self._manifest_count = None  # visited shapes at last collect
 
+    def cost_table(self):
+        return None
+
     def row(self):
         return {"kind": self.kind, "up": self.up,
                 "routable": self.routable,
@@ -205,7 +216,8 @@ class _LocalSeat(_Seat):
         def _cb(f):
             exc = f.exception(timeout=0)
             done(self, req, exc,
-                 None if exc is not None else f.result(timeout=0))
+                 None if exc is not None else f.result(timeout=0),
+                 cost=f.cost)
 
         fut.add_done_callback(_cb)
 
@@ -219,6 +231,12 @@ class _LocalSeat(_Seat):
         except Exception:
             return None
 
+    def cost_table(self):
+        try:
+            return self._engine.cost_table()
+        except Exception:
+            return None
+
 
 class _RemoteSeat(_Seat):
     kind = "remote"
@@ -227,6 +245,7 @@ class _RemoteSeat(_Seat):
         super().__init__(engine_id)
         self.base_url = base_url.rstrip("/")
         self._timeout = http_timeout_s
+        self._last_costs = None     # last fetched /costs (see cost_table)
 
     def _get(self, path, timeout=None):
         with urllib.request.urlopen(
@@ -249,7 +268,7 @@ class _RemoteSeat(_Seat):
         # thread per in-flight remote dispatch keeps the router's
         # dispatch loop free (in-process seats resolve via callbacks)
         def _run():
-            exc = value = None
+            exc = value = cost = None
             body = None
             try:
                 http_req = urllib.request.Request(
@@ -271,12 +290,13 @@ class _RemoteSeat(_Seat):
             if exc is None:
                 if body.get("ok"):
                     value = np.asarray(body["result"], np.float32)
+                    cost = body.get("cost")
                 else:
                     cls = _ERROR_CLASSES.get(body.get("error_type"),
                                              ServingError)
                     exc = cls(body.get("error")
                               or f"engine {self.engine_id} error")
-            done(self, req, exc, value)
+            done(self, req, exc, value, cost=cost)
 
         threading.Thread(
             target=_run, daemon=True,
@@ -327,6 +347,16 @@ class _RemoteSeat(_Seat):
         except Exception:
             return None
 
+    def cost_table(self):
+        # books are cumulative: a seat that stops answering (died,
+        # restarting) must not DROP its billed history from the fleet
+        # table, so the last fetched ledger stands in for it
+        try:
+            self._last_costs = json.loads(self._get("/costs"))
+        except Exception:
+            return self._last_costs
+        return self._last_costs
+
 
 class ServingRouter:
     """Least-outstanding front door over N serving engines.
@@ -360,6 +390,10 @@ class ServingRouter:
                           else f"router-{os.getpid():x}-"
                                f"{next(_router_seq)}")
         self._seats = OrderedDict()
+        # cost ledgers of seats removed by remove_engine: the fleet
+        # /costs books are cumulative, so a rolling-restart drill must
+        # not drop the dead engine's billed requests from the table
+        self._retired_costs = OrderedDict()
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._queue = deque()
@@ -473,6 +507,13 @@ class ServingRouter:
             self._g_up.labels(engine_id=engine_id).set(0)
             self._g_inflight.labels(engine_id=engine_id).set(0)
             self._g_queue_depth.labels(engine_id=engine_id).set(0)
+        # snapshot the departing seat's cumulative cost ledger OUTSIDE
+        # the lock (remote seats scrape /costs) so the fleet books keep
+        # every request it ever billed
+        table = seat.cost_table()
+        if table is not None:
+            with self._lock:
+                self._retired_costs[engine_id] = table
         _events.emit("router_engine_removed", router_id=self.router_id,
                      engine_id=engine_id, kind=seat.kind)
         return self
@@ -504,6 +545,7 @@ class ServingRouter:
         _recorder.install()
         _recorder.register_probe(self._probe_name, self._watchdog_probe)
         _recorder.add_bundle_section("router_scoreboard", self.snapshot)
+        _profiling.ensure_started()
         self._poll_once()           # scoreboard fresh before traffic
         self._dispatcher.start()
         self._poller.start()
@@ -691,7 +733,7 @@ class ServingRouter:
             + (f" (tried {sorted(req.tried)})" if req.tried else "")),
             None, force_keep=True)
 
-    def _on_done(self, seat, req, exc, value):
+    def _on_done(self, seat, req, exc, value, cost=None):
         with self._lock:
             seat.outstanding = max(0, seat.outstanding - 1)
         if exc is None:
@@ -699,6 +741,11 @@ class ServingRouter:
             self.total_ms.observe((time.monotonic() - req.t_submit) * 1e3)
             req.span.set_attr(engine=req.engine_id,
                               requeues=req.requeues).end()
+            if cost is not None:
+                # the engine's amortized bill rides through to the
+                # router's caller (remote seats carry it in the
+                # /submit body) so cost attribution survives fronting
+                req.future.cost = cost
             req.future.set_result(value)
             self._resolve()
             return
@@ -1009,6 +1056,47 @@ class ServingRouter:
             parts.append((seat.engine_id, seat.get_trace(trace_id)))
         return _spans.merge_trace_records(parts)
 
+    def cost_table(self):
+        """The fleet ``/costs`` body: every routable engine's
+        per-bucket cost ledger (local seats read the handle, remote
+        seats scrape their ``/costs``), merged into one fleet table —
+        per-bucket sums across engines plus fleet totals with the
+        derived cost-per-request / cost-per-1k-tokens rates. The books
+        are cumulative, so they must survive seats dying: every seat
+        is asked regardless of routability (a stopped LOCAL engine's
+        ledger still reads; remote seats fall back to their last
+        fetched table) and ``remove_engine`` retires a seat's final
+        ledger into the merge. Only a seat that never produced a table
+        contributes nothing — named in ``missing`` rather than
+        stalling the reply."""
+        from .metrics import CostLedger
+
+        engines = {}
+        missing = []
+        with self._lock:
+            seats = list(self._seats.values())
+            retired = dict(self._retired_costs)
+        for seat in seats:
+            table = seat.cost_table()
+            if table is None:
+                missing.append(seat.engine_id)
+                continue
+            engines[seat.engine_id] = table
+        fleet_buckets = {}
+        for table in list(engines.values()) + list(retired.values()):
+            for blen, row in (table.get("buckets") or {}).items():
+                fleet_buckets.setdefault(str(blen), []).append(row)
+        fleet = {b: CostLedger._derive(merge_cost_buckets(rows))
+                 for b, rows in sorted(fleet_buckets.items(),
+                                       key=lambda kv: int(kv[0]))}
+        totals = CostLedger._derive(
+            merge_cost_buckets(list(fleet.values())))
+        out = {"router_id": self.router_id, "engines": engines,
+               "fleet": fleet, "totals": totals, "missing": missing}
+        if retired:
+            out["retired"] = retired
+        return out
+
     def _healthz(self):
         board = self.scoreboard()
         up = sum(1 for r in board.values() if r["routable"])
@@ -1022,9 +1110,9 @@ class ServingRouter:
     def expose(self, port=0, host="127.0.0.1"):
         """Start (or return) the router's exposition server: the
         AGGREGATED ``/metrics``, fleet ``/healthz`` (ok while ≥1
-        engine is routable), ``/stats`` (scoreboard + counters), and
-        the merged ``/traces`` + ``/traces/<id>``. Closed by
-        :meth:`stop`."""
+        engine is routable), ``/stats`` (scoreboard + counters), the
+        merged ``/traces`` + ``/traces/<id>``, and the fleet ``/costs``
+        cost table. Closed by :meth:`stop`."""
         from ..telemetry.expo import TelemetryServer
 
         with self._lock:
@@ -1039,6 +1127,7 @@ class ServingRouter:
                                   traces_fn=self.traces_summary,
                                   trace_fn=self.get_trace,
                                   warmup_fn=self.warmup_manifest,
+                                  costs_fn=self.cost_table,
                                   port=port, host=host)
             self._expo = srv
         _events.emit("telemetry_expose", router_id=self.router_id,

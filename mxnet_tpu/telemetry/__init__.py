@@ -24,7 +24,13 @@ the process starting here). Four pieces:
   Chrome-trace stream;
 - :mod:`.recorder` — flight recorder + stall watchdog: recent-event
   ring, post-mortem bundles (spans + registry snapshot + all-thread
-  stacks) on watchdog trip / crash / SIGUSR2.
+  stacks) on watchdog trip / crash / SIGUSR2;
+- :mod:`.profiling` — always-on continuous sampling profiler
+  (GWP lineage): one daemon folding every thread's stack into
+  bounded collapsed-stack counts at ``MXNET_TPU_PROF_HZ``, served at
+  ``/profile`` and dumped as ``profile.txt`` in flight bundles;
+- :mod:`.resources` — host RSS/fd/thread + device-memory gauges and
+  process-lifetime watermarks, swept by the profiler daemon.
 
 Quickstart::
 
@@ -40,7 +46,7 @@ Quickstart::
     with telemetry.span("my/stage", shard=3):   # nested spans
         ...
 """
-from . import events, expo, recorder, spans, trace
+from . import events, expo, profiling, recorder, resources, spans, trace
 from .events import EventLog
 from .expo import (TelemetryServer, histogram_quantile,
                    parse_prometheus_text, start_server)
@@ -55,7 +61,8 @@ from .trace import (current_trace_id, new_trace_id, set_trace_id,
 __all__ = ["REGISTRY", "MetricsRegistry", "Counter", "Gauge", "Histogram",
            "DEFAULT_MS_BUCKETS", "TelemetryServer", "start_server",
            "parse_prometheus_text", "histogram_quantile", "EventLog",
-           "events", "expo", "trace", "spans", "recorder",
+           "events", "expo", "trace", "spans", "recorder", "profiling",
+           "resources",
            "new_trace_id", "current_trace_id", "set_trace_id",
            "trace_context", "Span", "span", "start_span", "record_span",
            "use_span", "current_span", "current_span_id",

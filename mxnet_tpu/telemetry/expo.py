@@ -23,7 +23,13 @@ dependencies, daemon threads — never blocks process exit):
 - ``/warmup`` — optional warmup-manifest endpoint (only when a
   ``warmup_fn`` is attached): the engine's visited-shape manifest /
   the router's fleet union, JSON — what a rolling restart replays
-  before admitting traffic.
+  before admitting traffic;
+- ``/profile`` — the process continuous profiler's (:mod:`.profiling`)
+  folded-stack dump as flamegraph-ready collapsed text;
+  ``?format=json`` returns the top-self-time JSON summary instead;
+- ``/costs`` — optional per-bucket cost ledger (only when a
+  ``costs_fn`` is attached): the engine's device/compile-seconds +
+  request/token table, or the router's fleet-merged cost table.
 
 A server constructed with ``metrics_fn``/``traces_fn``/``trace_fn``
 overrides serves those endpoints from the callables instead of the
@@ -74,6 +80,12 @@ class TelemetryServer:
         ``POST /submit`` (remote engine dispatch); None = 404.
     warmup_fn : ``() -> dict | None`` enabling ``/warmup`` (the
         warmup manifest a restarting engine replays); None = 404.
+    costs_fn : ``() -> dict`` enabling ``/costs`` (the serving cost
+        ledger: per-bucket device/compile seconds + requests/tokens,
+        or the router's fleet merge); None = 404.
+    profile_fn : ``() -> str | dict`` overriding ``/profile``; None =
+        the process continuous profiler (:mod:`.profiling`) — a str
+        serves as collapsed text, a dict as JSON.
     port : 0 picks a free port (read it back from ``.port``).
     host : bind interface; loopback by default — exposing metrics on
         all interfaces is an operator decision, not a default.
@@ -81,8 +93,8 @@ class TelemetryServer:
 
     def __init__(self, registry=None, healthz_fn=None, stats_fn=None,
                  metrics_fn=None, traces_fn=None, trace_fn=None,
-                 submit_fn=None, warmup_fn=None, port=0,
-                 host="127.0.0.1"):
+                 submit_fn=None, warmup_fn=None, costs_fn=None,
+                 profile_fn=None, port=0, host="127.0.0.1"):
         self.registry = registry if registry is not None else REGISTRY
         self.healthz_fn = healthz_fn
         self.stats_fn = stats_fn
@@ -91,6 +103,8 @@ class TelemetryServer:
         self.trace_fn = trace_fn
         self.submit_fn = submit_fn
         self.warmup_fn = warmup_fn
+        self.costs_fn = costs_fn
+        self.profile_fn = profile_fn
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -128,7 +142,7 @@ class TelemetryServer:
         return f"http://{self.host}:{self.port}{path}"
 
     def _route(self, handler):
-        path = handler.path.split("?", 1)[0]
+        path, _, query = handler.path.partition("?")
         if path == "/metrics":
             try:
                 text = (self.metrics_fn() if self.metrics_fn is not None
@@ -193,10 +207,46 @@ class TelemetryServer:
                 return
             self._reply(handler, 200, "application/json",
                         json.dumps(manifest, default=str).encode())
+        elif path == "/profile":
+            from urllib.parse import parse_qs
+            params = parse_qs(query)
+            want_json = params.get("format", [""])[0] == "json"
+            try:
+                if self.profile_fn is not None:
+                    payload = self.profile_fn()
+                else:
+                    from . import profiling as _profiling
+                    payload = (_profiling.profile_snapshot(
+                        int(params.get("top", ["20"])[0]))
+                        if want_json else _profiling.collapsed_text())
+            except Exception as e:
+                self._reply(handler, 500, "application/json",
+                            json.dumps({"error": repr(e)}).encode())
+                return
+            if isinstance(payload, str):
+                self._reply(handler, 200, "text/plain; charset=utf-8",
+                            payload.encode())
+            else:
+                self._reply(handler, 200, "application/json",
+                            json.dumps(payload, default=str).encode())
+        elif path == "/costs":
+            if self.costs_fn is None:
+                self._reply(handler, 404, "application/json",
+                            json.dumps({"error": "no cost ledger"})
+                            .encode())
+                return
+            try:
+                costs = self.costs_fn()
+            except Exception as e:
+                self._reply(handler, 500, "application/json",
+                            json.dumps({"error": repr(e)}).encode())
+                return
+            self._reply(handler, 200, "application/json",
+                        json.dumps(costs, default=str).encode())
         else:
             self._reply(handler, 404, "text/plain",
-                        b"try /metrics, /healthz, /stats, /traces "
-                        b"or /warmup\n")
+                        b"try /metrics, /healthz, /stats, /traces, "
+                        b"/profile, /costs or /warmup\n")
 
     def _route_post(self, handler):
         path = handler.path.split("?", 1)[0]

@@ -1,0 +1,366 @@
+"""Always-on continuous sampling profiler (Google-Wide-Profiling style).
+
+The existing profiler (:mod:`mxnet_tpu.profiler`) is a *session* tool:
+you turn it on, run a workload, dump a Chrome trace. A production
+serving fleet needs the complementary *always-on* layer — "where is
+host time going RIGHT NOW" — cheap enough to never turn off. This
+module is that layer, stdlib-only:
+
+- one daemon thread wakes at ``MXNET_TPU_PROF_HZ`` (default ~19 Hz —
+  deliberately off any round period) and snapshots every thread's
+  Python stack via ``sys._current_frames()``;
+- samples aggregate into **bounded folded-stack counts** keyed by
+  ``(thread name, (frame, frame, ...))`` — the Brendan-Gregg collapsed
+  format, flamegraph-ready as text. Frames fold by ``function (file)``
+  (no line numbers) so the table stays small and stable; the table is
+  capped at ``MXNET_TPU_PROF_MAX_STACKS`` entries with overflow folded
+  into a per-thread ``(stack-table-full)`` bucket, so a pathological
+  workload can grow the *counts*, never the *process*;
+- the same daemon runs the resource sweep (:mod:`.resources` — host
+  RSS/fds/threads + device memory gauges and watermarks) every
+  ``MXNET_TPU_PROF_RESOURCE_S`` seconds, and refreshes the
+  ``mxnet_tpu_prof_top_self_frac{frame=...}`` gauge family (the
+  Grafana top-functions table) every couple of seconds.
+
+Consumption: ``GET /profile`` on every exposition server (collapsed
+text; ``?format=json`` for the top-self-time summary),
+``tools/telemetry_dump.py --profile``, a ``profile.txt`` section in
+every flight-recorder bundle, and ``profile_top`` in per-leg bench
+records.
+
+Cost: one stack walk per live thread per tick — tens of microseconds
+at the default rate, invisible next to a model forward. With
+``MXNET_TPU_PROF=0`` the daemon never starts and
+:func:`ensure_started` is a single env-registry read.
+"""
+from __future__ import annotations
+
+import atexit
+import os
+import sys
+import threading
+import time
+
+from .. import envvars
+from . import events as _events
+from . import resources as _resources
+from .registry import REGISTRY
+
+__all__ = ["ContinuousProfiler", "PROFILER", "ensure_started",
+           "collapsed_text", "top_self", "profile_snapshot"]
+
+_TOP_GAUGE_N = 15         # frames exported to the Grafana table
+_EXPORT_EVERY_S = 2.0     # top-frame gauge refresh period
+
+
+class ContinuousProfiler:
+    """Sampling daemon + bounded folded-stack aggregation."""
+
+    def __init__(self, hz=None, max_stacks=None, max_depth=None,
+                 registry=None):
+        reg = registry if registry is not None else REGISTRY
+        self.hz = float(hz if hz is not None
+                        else envvars.get("MXNET_TPU_PROF_HZ"))
+        self.max_stacks = int(max_stacks if max_stacks is not None
+                              else envvars.get("MXNET_TPU_PROF_MAX_STACKS"))
+        self.max_depth = int(max_depth if max_depth is not None
+                             else envvars.get("MXNET_TPU_PROF_MAX_DEPTH"))
+        self.resource_s = envvars.get("MXNET_TPU_PROF_RESOURCE_S")
+        self._lock = threading.Lock()
+        self._counts = {}       # (thread_name, stack tuple) -> samples
+        self._samples = 0       # sampler wakeups
+        self._errors = 0
+        self._thread = None
+        self._stop = threading.Event()
+        self._started_mono = None
+        self._exported = set()  # frames currently on the top gauge
+        self._last_leaf = {}    # leaf-self counts at the last export
+        self._last_total = 0    # total samples at the last export
+        self._c_samples = reg.counter(
+            "mxnet_tpu_prof_samples_total",
+            "continuous-profiler sampler wakeups")
+        self._g_stacks = reg.gauge(
+            "mxnet_tpu_prof_distinct_stacks",
+            "distinct (thread, folded stack) entries held")
+        self._c_overflow = reg.counter(
+            "mxnet_tpu_prof_overflow_total",
+            "samples folded into (stack-table-full) because the "
+            "bounded stack table was full")
+        self._g_top = reg.gauge(
+            "mxnet_tpu_prof_top_self_frac",
+            "fraction of RECENT thread-samples (since the previous "
+            "~2 s export) whose LEAF frame is this one — the 'where "
+            "is host time going right now' signal (top-N only; "
+            "dropped frames reset to 0)", ("frame",))
+
+    # -- lifecycle ---------------------------------------------------------
+    def configure(self, hz=None, max_stacks=None, max_depth=None,
+                  resource_s=None):
+        """Runtime tuning (tests raise hz to converge fast). Takes
+        effect on the next sampler wakeup."""
+        if hz is not None:
+            self.hz = float(hz)
+        if max_stacks is not None:
+            self.max_stacks = int(max_stacks)
+        if max_depth is not None:
+            self.max_depth = int(max_depth)
+        if resource_s is not None:
+            self.resource_s = float(resource_s)
+        return self
+
+    @property
+    def running(self):
+        t = self._thread
+        return t is not None and t.is_alive()
+
+    def start(self):
+        """Start the daemon (idempotent) and register the
+        flight-recorder ``profile.txt`` bundle section. An atexit hook
+        stops the sampler BEFORE interpreter teardown: the resource
+        sweep calls into jax, and a daemon thread inside the PJRT
+        client while it is being destroyed aborts the process
+        ("terminate called without an active exception")."""
+        with self._lock:
+            spawned = not (self._thread is not None
+                           and self._thread.is_alive())
+            if spawned:
+                self._stop.clear()
+                self._started_mono = time.monotonic()
+                self._thread = threading.Thread(
+                    target=self._run, name="mxnet_tpu_prof", daemon=True)
+                self._thread.start()
+        with _atexit_lock:
+            _live.add(self)
+        _register_atexit_stop()
+        # (re-)register on EVERY start: the section name is shared
+        # process-wide, and another instance's stop() may have taken
+        # it — an already-running profiler must still heal it
+        from . import recorder as _recorder
+        _recorder.add_bundle_section("profile.txt", self.collapsed_text)
+        if spawned:
+            _events.emit("prof_start", hz=self.hz,
+                         max_stacks=self.max_stacks)
+        return self
+
+    def stop(self):
+        """Tests only: halt the sampler (counts are kept)."""
+        with self._lock:
+            self._stop.set()
+            t, self._thread = self._thread, None
+        with _atexit_lock:
+            _live.discard(self)
+        if t is not None:
+            t.join(timeout=5.0)
+        from . import recorder as _recorder
+        # only drop the bundle section when it is OURS — a short-lived
+        # instance stopping must not strip the process profiler's —
+        # and heal it back to the still-running process profiler if
+        # this instance had taken the shared name over
+        if _recorder.RECORDER.get_section("profile.txt") \
+                == self.collapsed_text:
+            _recorder.remove_bundle_section("profile.txt")
+            if self is not PROFILER and PROFILER.running:
+                _recorder.add_bundle_section("profile.txt",
+                                             PROFILER.collapsed_text)
+
+    def clear(self):
+        """Drop accumulated counts (test isolation / fresh window)."""
+        with self._lock:
+            self._counts.clear()
+            self._samples = 0
+        self._last_leaf = {}
+        self._last_total = 0
+
+    # -- sampling ----------------------------------------------------------
+    def _run(self):
+        last_resource = last_export = 0.0
+        while not self._stop.wait(1.0 / max(self.hz, 0.1)):
+            try:
+                self._sample_once()
+            except Exception as e:
+                # a broken sampler must not die silently NOR spam: the
+                # first few failures leave a trace, the rest count
+                self._errors += 1
+                if self._errors <= 3:
+                    _events.emit("prof_sample_error", error=repr(e))
+            now = time.monotonic()
+            if now - last_resource >= self.resource_s:
+                last_resource = now
+                try:
+                    _resources.sample()
+                except Exception as e:
+                    self._errors += 1
+                    if self._errors <= 3:
+                        _events.emit("prof_resource_error", error=repr(e))
+            if now - last_export >= _EXPORT_EVERY_S:
+                last_export = now
+                try:
+                    self._export_top()
+                except Exception as e:
+                    self._errors += 1
+                    if self._errors <= 3:
+                        _events.emit("prof_export_error", error=repr(e))
+
+    def _sample_once(self):
+        frames = sys._current_frames()
+        names = {}
+        for t in threading.enumerate():
+            if t.ident is not None:
+                names[t.ident] = t.name
+        own = threading.get_ident()
+        walked = []
+        for ident, frame in frames.items():
+            if ident == own:
+                continue            # never profile the profiler
+            stack = []
+            f = frame
+            while f is not None and len(stack) < self.max_depth:
+                code = f.f_code
+                stack.append(f"{code.co_name} "
+                             f"({os.path.basename(code.co_filename)})")
+                f = f.f_back
+            stack.reverse()         # root first, leaf last (collapsed)
+            walked.append((names.get(ident, f"thread-{ident}"),
+                           tuple(stack)))
+        overflow = 0
+        with self._lock:
+            self._samples += 1
+            for tname, stack in walked:
+                key = (tname, stack)
+                cur = self._counts.get(key)
+                if cur is None and len(self._counts) >= self.max_stacks:
+                    overflow += 1
+                    key = (tname, ("(stack-table-full)",))
+                    cur = self._counts.get(key)
+                self._counts[key] = (cur or 0) + 1
+            n_stacks = len(self._counts)
+        self._c_samples.inc()
+        self._g_stacks.set(n_stacks)
+        if overflow:
+            self._c_overflow.inc(overflow)
+
+    def _export_top(self):
+        """Refresh the top-frames gauge from the RECENT window — the
+        delta of leaf-self counts since the previous export — so a
+        long-lived process's 'right now' signal tracks the current
+        hot path instead of converging to the lifetime average (the
+        cumulative view stays available at /profile)."""
+        counts, _ = self._snapshot_counts()
+        leaf, total = {}, 0
+        for (tname, stack), c in counts.items():
+            total += c
+            lf = stack[-1] if stack else "(no stack)"
+            leaf[lf] = leaf.get(lf, 0) + c
+        win = {f: c - self._last_leaf.get(f, 0) for f, c in leaf.items()}
+        win_total = total - self._last_total
+        self._last_leaf, self._last_total = leaf, total
+        if win_total <= 0:
+            return              # idle window (or counts cleared)
+        top = sorted(win.items(), key=lambda kv: -kv[1])[:_TOP_GAUGE_N]
+        seen = set()
+        for frame, c in top:
+            if c <= 0:
+                continue
+            seen.add(frame)
+            self._g_top.labels(frame=frame).set(round(c / win_total, 4))
+        for frame in self._exported - seen:
+            self._g_top.labels(frame=frame).set(0.0)
+        self._exported = seen
+
+    # -- read side ---------------------------------------------------------
+    def _snapshot_counts(self):
+        with self._lock:
+            return dict(self._counts), self._samples
+
+    def collapsed_text(self):
+        """The folded-stack dump: ``thread;frame;...;leaf count`` lines,
+        hottest stack first — paste into any flamegraph renderer."""
+        counts, samples = self._snapshot_counts()
+        lines = [f"# mxnet_tpu continuous profile: {samples} samples "
+                 f"@ {self.hz:g} Hz, {len(counts)} stacks, "
+                 f"pid {os.getpid()}"]
+        for (tname, stack), n in sorted(counts.items(),
+                                        key=lambda kv: -kv[1]):
+            lines.append(";".join((tname,) + stack) + f" {n}")
+        return "\n".join(lines) + "\n"
+
+    def top_self(self, n=20):
+        """Top frames by SELF samples (the leaf of each sampled stack
+        — where the interpreter actually was), with the thread-sample
+        fraction each represents."""
+        counts, _ = self._snapshot_counts()
+        self_counts, total = {}, 0
+        for (tname, stack), c in counts.items():
+            total += c
+            leaf = stack[-1] if stack else "(no stack)"
+            self_counts[leaf] = self_counts.get(leaf, 0) + c
+        out = []
+        for frame, c in sorted(self_counts.items(),
+                               key=lambda kv: -kv[1])[:n]:
+            out.append({"frame": frame, "self": c,
+                        "self_frac": round(c / total, 4) if total else 0.0})
+        return out
+
+    def snapshot(self, top=20):
+        """The ``/profile?format=json`` payload."""
+        counts, samples = self._snapshot_counts()
+        up = (time.monotonic() - self._started_mono
+              if self._started_mono is not None else None)
+        return {"running": self.running, "hz": self.hz,
+                "samples": samples,
+                "uptime_s": round(up, 3) if up is not None else None,
+                "threads": threading.active_count(),
+                "distinct_stacks": len(counts),
+                "errors": self._errors,
+                "top_self": self.top_self(top)}
+
+
+#: process-wide profiler the serving stack / bench start on demand
+PROFILER = ContinuousProfiler()
+
+_atexit_lock = threading.Lock()
+_atexit_registered = False
+_live = set()   # every started profiler instance, not just PROFILER:
+                # a custom instance left running into interpreter
+                # teardown aborts the process the same way
+
+
+def _register_atexit_stop():
+    global _atexit_registered
+    with _atexit_lock:
+        if _atexit_registered:
+            return
+        _atexit_registered = True
+
+    def _stop_at_exit():
+        with _atexit_lock:
+            running = list(_live)
+        for prof in running:
+            try:
+                prof.stop()
+            except Exception:
+                pass        # exiting anyway; never mask the real exit
+
+    atexit.register(_stop_at_exit)
+
+
+def ensure_started():
+    """Start the process profiler unless ``MXNET_TPU_PROF=0``
+    (idempotent; this is the 'always-on' hook every serving
+    engine/router and bench leg calls at start). Returns the profiler
+    or None when disabled."""
+    if not envvars.get("MXNET_TPU_PROF"):
+        return None
+    return PROFILER.start()
+
+
+def collapsed_text():
+    return PROFILER.collapsed_text()
+
+
+def top_self(n=20):
+    return PROFILER.top_self(n)
+
+
+def profile_snapshot(top=20):
+    return PROFILER.snapshot(top)

@@ -15,7 +15,10 @@ module keeps a bounded in-memory ring of recent run events (a tap on
         threads.txt    stack trace of every live thread
         <extra>.json   one per registered bundle section
                        (add_bundle_section — e.g. the serving
-                       router's router_scoreboard.json fleet view)
+                       router's router_scoreboard.json fleet view);
+                       a section whose name carries an extension
+                       (e.g. the continuous profiler's profile.txt)
+                       is written verbatim when its fn returns text
 
 The WATCHDOG is one daemon thread polling registered probes (a probe
 returns None when healthy, or an anomaly dict). Subsystems register
@@ -123,13 +126,23 @@ class FlightRecorder:
         into every future bundle — subsystems contribute their own
         post-mortem state (the serving router registers its fleet
         scoreboard here, so a wedged-engine trip explains the whole
-        fleet, not just this process)."""
+        fleet, not just this process). A name that already carries an
+        extension ("profile.txt") is used verbatim, and a section fn
+        returning a string is written as raw text — the continuous
+        profiler's collapsed-stack dump rides bundles this way."""
         with self._lock:
             self._sections[str(name)] = fn
 
     def remove_section(self, name):
         with self._lock:
             self._sections.pop(str(name), None)
+
+    def get_section(self, name):
+        """The registered section fn (or None) — lets an owner verify
+        a shared section name is still ITS registration before
+        removing it."""
+        with self._lock:
+            return self._sections.get(str(name))
 
     # -- install -----------------------------------------------------------
     def install(self, sigusr2=True, excepthook=True):
@@ -228,9 +241,12 @@ class FlightRecorder:
             for name, fn in sections:
                 try:        # a broken section must not lose the bundle
                     data = fn()
-                    with open(os.path.join(tmp, f"{name}.json"),
-                              "w") as f:
-                        json.dump(data, f, indent=2, default=str)
+                    fname = name if "." in name else f"{name}.json"
+                    with open(os.path.join(tmp, fname), "w") as f:
+                        if isinstance(data, str):
+                            f.write(data)
+                        else:
+                            json.dump(data, f, indent=2, default=str)
                 except Exception:
                     pass
             os.rename(tmp, path)

@@ -510,6 +510,62 @@ def record_span(name, trace_id, parent_id=None, start_us=None, end_us=None,
 
 
 # -- cross-ring merge (the router's fleet-wide /traces view) --------------
+def _reanchor_spans(spans_out):
+    """Re-anchor cross-PROCESS spans onto one time axis (the carried
+    ROADMAP 'remote trace axes' follow-up).
+
+    Each process records ``ts_us`` on its own perf_counter axis —
+    exact within the process, meaningless across processes. Every span
+    also carries a ``wall`` stamp. Per process group we estimate that
+    process's axis offset as the median of ``wall*1e6 - ts_us`` over
+    its spans, then shift every foreign group's spans onto the
+    REFERENCE axis (the group owning the trace's root span — the
+    router's, for router-front traces) by the offset difference.
+    Groups key on ``(source ring, pid)``, not pid alone: two remote
+    engines that are each pid 1 inside their own container must not
+    pool their unrelated perf_counter axes (nor silently share the
+    reference axis). A ring only ever holds spans recorded in its own
+    process, so the source disambiguates pid collisions; the same
+    process split across keys just computes the same offset twice.
+    Intra-process timing stays perf_counter-exact (one rigid shift per
+    group); cross-process alignment is as good as the hosts' wall
+    clocks — sub-millisecond on one machine, which is what makes the
+    merged tree render on one monotonic axis with no negative gaps.
+    Returns the pids shifted (empty when everything already shared the
+    reference axis)."""
+    import statistics
+
+    groups = {}
+    for s in spans_out:
+        if s.get("ts_us") is None or s.get("wall") is None:
+            continue
+        groups.setdefault((s.get("_src"), s.get("pid")), []).append(s)
+    if len(groups) <= 1:
+        return []
+    ids = {s.get("span_id") for s in spans_out}
+    roots = [s for s in spans_out
+             if s.get("parent_id") not in ids and s.get("wall") is not None]
+    if roots:
+        root = min(roots, key=lambda s: s["wall"])
+        ref = (root.get("_src"), root.get("pid"))
+    else:
+        ref = min(groups, key=str)
+    if ref not in groups:
+        ref = min(groups, key=str)
+    offsets = {key: statistics.median(s["wall"] * 1e6 - s["ts_us"]
+                                      for s in group)
+               for key, group in groups.items()}
+    shifted = set()
+    for key, group in groups.items():
+        if key == ref:
+            continue
+        shift = int(offsets[key] - offsets[ref])
+        for s in group:
+            s["ts_us"] += shift
+        shifted.add(key[1])
+    return sorted(shifted, key=str)
+
+
 def merge_trace_records(parts):
     """Merge per-ring ``/traces/<id>`` records for ONE trace into a
     single span tree — the router's cross-engine trace aggregation.
@@ -528,7 +584,7 @@ def merge_trace_records(parts):
     spans_out, seen = [], set()
     merged = None
     engines = set()
-    for tag, rec in parts:
+    for src_idx, (tag, rec) in enumerate(parts):
         if not rec:
             continue
         if merged is None:
@@ -542,6 +598,7 @@ def merge_trace_records(parts):
                 continue
             seen.add(sid)
             s = dict(s)
+            s["_src"] = src_idx     # re-anchor group key; stripped below
             attrs = dict(s.get("attrs") or {})
             if tag and "engine" not in attrs:
                 attrs["engine"] = tag
@@ -560,8 +617,14 @@ def merge_trace_records(parts):
             merged["keep_reason"] = rec["keep_reason"]
     if merged is None:
         return None
-    # NB: ts_us axes differ across processes (per-process perf_counter)
-    # — the sort gives stable output, parentage is what merges exactly
+    # per-process perf_counter axes are re-anchored onto the ROOT
+    # process's axis via wall stamps, so a merged cross-process tree
+    # (and telemetry_dump's render of it) reads on ONE monotonic axis
+    reanchored = _reanchor_spans(spans_out)
+    if reanchored:
+        merged["reanchored_pids"] = reanchored
+    for s in spans_out:
+        s.pop("_src", None)
     spans_out.sort(key=lambda s: (s.get("ts_us") or 0))
     ids = {s.get("span_id") for s in spans_out}
     roots = [s for s in spans_out if s.get("parent_id") not in ids]

@@ -357,6 +357,15 @@ def test_restart_drill_zero_loss_and_warm_replay(tmp_path, monkeypatch):
         try:
             while router.count("completed") < total // 6:
                 time.sleep(0.01)
+            # kill only while the victim actually has work IN FLIGHT:
+            # the drill must always exercise the failover-requeue path
+            # (a lucky kill between dispatches would count 0 failovers)
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                row = router.scoreboard().get("rd-e1") or {}
+                if row.get("outstanding", 0) > 0:
+                    break
+                time.sleep(0.002)
             e1.stop(drain=False)
             router.remove_engine("rd-e1")
             stub = StubModel(delay=0.02)

@@ -827,3 +827,97 @@ def test_disabled_span_paths_stay_cheap():
         assert per_record < 20e-6, f"record {per_record * 1e6:.1f}us"
     finally:
         spans.configure(enabled=saved)
+
+
+# ---------------------------------------------------------------------------
+# cross-process merge re-anchors remote axes (ISSUE 8 satellite golden)
+# ---------------------------------------------------------------------------
+
+def test_merge_reanchors_remote_spans_onto_router_axis():
+    """Two rings from different PROCESSES carry wildly different
+    perf_counter axes; after merge, every engine-side span lands
+    INSIDE the router root's interval on ONE monotonic axis (no
+    negative gaps) because wall stamps re-anchor the foreign pid's
+    spans. Intra-process offsets stay exact."""
+    wall0 = 1700000000.0
+    # router process (pid 100): its perf_counter axis happens to start
+    # near 50s. Root runs 0..100ms wall.
+    root = {"trace_id": "t-x", "span_id": "r1", "parent_id": None,
+            "name": "router/request", "pid": 100,
+            "ts_us": 50_000_000, "dur_us": 100_000, "wall": wall0}
+    # engine process (pid 200): an axis offset ~9000s away. Its
+    # serving/request started 10ms after the root (per wall) and its
+    # forward child 5ms after that.
+    eng_root = {"trace_id": "t-x", "span_id": "e1", "parent_id": "r1",
+                "name": "serving/request", "pid": 200,
+                "ts_us": 9_000_000_000, "dur_us": 80_000,
+                "wall": wall0 + 0.010}
+    eng_fwd = {"trace_id": "t-x", "span_id": "e2", "parent_id": "e1",
+               "name": "serving/forward", "pid": 200,
+               "ts_us": 9_000_005_000, "dur_us": 60_000,
+               "wall": wall0 + 0.015}
+    merged = spans.merge_trace_records([
+        (None, {"trace_id": "t-x", "spans": [root]}),
+        ("e0", {"trace_id": "t-x", "spans": [eng_root, eng_fwd]})])
+    assert merged["reanchored_pids"] == [200]
+    by_id = {s["span_id"]: s for s in merged["spans"]}
+    r, e1, e2 = by_id["r1"], by_id["e1"], by_id["e2"]
+    # the router's own axis is untouched
+    assert r["ts_us"] == 50_000_000
+    # engine spans landed INSIDE the root interval: monotonic axis,
+    # no negative gap at the process crossing
+    assert r["ts_us"] <= e1["ts_us"] <= r["ts_us"] + r["dur_us"]
+    assert e1["ts_us"] <= e2["ts_us"] <= e1["ts_us"] + e1["dur_us"]
+    # intra-process delta survives EXACTLY (one rigid shift per pid)
+    assert e2["ts_us"] - e1["ts_us"] == 5_000
+    # wall-anchored placement is accurate to the wall precision
+    assert abs(e1["ts_us"] - (r["ts_us"] + 10_000)) <= 2_000
+    # merged output is sorted on the single re-anchored axis
+    ts = [s["ts_us"] for s in merged["spans"]]
+    assert ts == sorted(ts)
+
+
+def test_merge_reanchors_colliding_pids_per_source():
+    """Two containerized remote engines that are EACH pid 1 carry
+    unrelated perf_counter axes: grouping must key on (source ring,
+    pid), not pid alone, or the median pools both axes (and an engine
+    sharing the reference pid would never shift at all)."""
+    wall0 = 1700000000.0
+    root = {"trace_id": "t-z", "span_id": "r1", "parent_id": None,
+            "name": "router/request", "pid": 1,
+            "ts_us": 50_000_000, "dur_us": 100_000, "wall": wall0}
+    eng_a = {"trace_id": "t-z", "span_id": "a1", "parent_id": "r1",
+             "name": "serving/request", "pid": 1,
+             "ts_us": 9_000_000_000, "dur_us": 40_000,
+             "wall": wall0 + 0.010}
+    eng_b = {"trace_id": "t-z", "span_id": "b1", "parent_id": "r1",
+             "name": "serving/request", "pid": 1,
+             "ts_us": 123_000, "dur_us": 40_000,
+             "wall": wall0 + 0.050}
+    merged = spans.merge_trace_records([
+        (None, {"trace_id": "t-z", "spans": [root]}),
+        ("eA", {"trace_id": "t-z", "spans": [eng_a]}),
+        ("eB", {"trace_id": "t-z", "spans": [eng_b]})])
+    assert merged["reanchored_pids"] == [1]
+    by_id = {s["span_id"]: s for s in merged["spans"]}
+    r = by_id["r1"]
+    assert r["ts_us"] == 50_000_000      # reference axis untouched
+    # BOTH colliding-pid engines land inside the root interval at
+    # their own wall offsets — one rigid shift per (source, pid)
+    assert abs(by_id["a1"]["ts_us"] - (r["ts_us"] + 10_000)) <= 2_000
+    assert abs(by_id["b1"]["ts_us"] - (r["ts_us"] + 50_000)) <= 2_000
+    # the transient grouping key never leaks into the merged output
+    assert all("_src" not in s for s in merged["spans"])
+
+
+def test_merge_single_process_axes_untouched():
+    a = {"trace_id": "t-y", "span_id": "a", "parent_id": None,
+         "name": "root", "pid": 1, "ts_us": 1000, "dur_us": 10,
+         "wall": 5.0}
+    b = {"trace_id": "t-y", "span_id": "b", "parent_id": "a",
+         "name": "child", "pid": 1, "ts_us": 1002, "dur_us": 5,
+         "wall": 5.000002}
+    merged = spans.merge_trace_records([(None, {"trace_id": "t-y",
+                                                "spans": [a, b]})])
+    assert "reanchored_pids" not in merged
+    assert [s["ts_us"] for s in merged["spans"]] == [1000, 1002]

@@ -100,6 +100,78 @@ def cross_check(outcomes, attempts, delta):
     return not mismatches, mismatches
 
 
+def _fetch_costs(metrics_url, timeout=10.0):
+    """GET the sibling /costs of a /metrics URL; returns the
+    cross-bucket totals row (router bodies carry a fleet ``totals``,
+    engines their own) or None when the endpoint is absent."""
+    import urllib.request
+
+    base = metrics_url.rsplit("/metrics", 1)[0]
+    try:
+        with urllib.request.urlopen(base + "/costs", timeout=timeout) as r:
+            body = json.loads(r.read().decode())
+    except Exception:
+        return None
+    return body.get("totals")
+
+
+def cross_check_costs(client_cost, before, after, slack=0,
+                      lost_ledgers=False):
+    """Reconcile client-side cost accounting (summed per-request
+    ``future.cost`` bills) against the server cost-ledger DELTA:
+    requests and tokens must match exactly, and the client's summed
+    amortized device seconds must equal the ledger's ``request_s``
+    (batch-time conservation) within 5%.
+
+    ``slack`` is the number of requests the SERVER may legitimately
+    have billed beyond the client's books: a dispatched request whose
+    reply was lost and failed over is billed on two engines but
+    completes once client-side, and a post-dispatch failure is billed
+    but lands in the client's error column. With slack > 0 the
+    requests/tokens/device_s checks become ``ledger >= client`` (with
+    requests bounded by client + slack) instead of exact — a healthy
+    run with failovers must not report a mismatch.
+
+    ``lost_ledgers=True`` waives the LOWER bounds too: when an engine
+    process died mid-run the router's fleet table may be missing that
+    seat's final window (remote seats fall back to their last fetched
+    ledger), so the server side can legitimately under-read — only
+    over-billing beyond slack stays a mismatch. Returns
+    (reconciled, mismatches, delta)."""
+    if before is None or after is None:
+        return None, ["/costs endpoint unavailable"], None
+    delta = {k: after.get(k, 0) - before.get(k, 0)
+             for k in ("request_s", "requests", "valid_tokens")}
+    mismatches = []
+    req_lo = 0 if lost_ledgers else client_cost["requests"]
+    req_hi = client_cost["requests"] + max(int(slack), 0)
+    if not req_lo <= delta["requests"] <= req_hi:
+        mismatches.append(f"requests: client={client_cost['requests']} "
+                          f"ledger={delta['requests']}"
+                          + (f" (slack {slack})" if slack else ""))
+    if lost_ledgers:
+        tokens_ok = True
+    elif slack:
+        tokens_ok = client_cost["tokens"] <= delta["valid_tokens"]
+    else:
+        tokens_ok = client_cost["tokens"] == delta["valid_tokens"]
+    if not tokens_ok:
+        mismatches.append(f"tokens: client={client_cost['tokens']} "
+                          f"ledger={delta['valid_tokens']}")
+    ledger_s = delta["request_s"]
+    client_s = client_cost["device_s"]
+    if lost_ledgers:
+        device_ok = True
+    elif slack:
+        device_ok = client_s <= ledger_s * 1.05
+    else:
+        device_ok = abs(client_s - ledger_s) <= 0.05 * max(ledger_s, 1e-9)
+    if not device_ok:
+        mismatches.append(f"device_s: client={client_s:.6f} "
+                          f"ledger={ledger_s:.6f}")
+    return not mismatches, mismatches, delta
+
+
 def cross_check_router(outcomes, attempts, delta):
     """The router-mode reconciliation: client accounting vs the
     ROUTER's counter family (engine-side counters can't balance the
@@ -241,7 +313,10 @@ def run_load(engine, n_clients=8, requests_per_client=16,
     report then carries a ``server`` section: per-outcome deltas,
     ``reconciled`` (True when both sides agree request-for-request),
     and histogram-estimated server-side total-latency percentiles
-    next to the client-observed ones.
+    next to the client-observed ones. A ``cost`` section reconciles
+    the client-summed per-request amortized bills (``future.cost``)
+    against the server's ``/costs`` ledger delta — requests and
+    tokens exactly, device seconds within 5%.
     """
     import threading
 
@@ -255,10 +330,15 @@ def run_load(engine, n_clients=8, requests_per_client=16,
     is_router = hasattr(engine, "scoreboard")
 
     before = scrape_metrics(metrics_url) if metrics_url else None
+    costs_before = _fetch_costs(metrics_url) if metrics_url else None
 
     latencies = []          # (ms, trace_id) — list.append is atomic
     outcomes = {"ok": 0, "expired": 0, "shed": 0, "error": 0}
     valid_tokens = [0]
+    # client-side cost books: summed per-request amortized bills off
+    # future.cost — reconciled against the server's /costs delta
+    client_cost = {"device_s": 0.0, "requests": 0, "tokens": 0,
+                   "compiled": 0, "missing": 0}
     lock = threading.Lock()
 
     def client(cid):
@@ -288,10 +368,19 @@ def run_load(engine, n_clients=8, requests_per_client=16,
                     outcomes["error"] += 1
                 continue
             ms = (time.perf_counter() - t0) * 1e3
+            cost = getattr(fut, "cost", None)
             with lock:
                 outcomes["ok"] += 1
                 valid_tokens[0] += n
                 latencies.append((ms, fut.trace_id))
+                if cost:
+                    client_cost["device_s"] += cost.get("device_s", 0.0)
+                    client_cost["requests"] += 1
+                    client_cost["tokens"] += cost.get("tokens", 0)
+                    if cost.get("compiled"):
+                        client_cost["compiled"] += 1
+                else:
+                    client_cost["missing"] += 1
 
     threads = [threading.Thread(target=client, args=(c,),
                                 name=f"loadgen_client_{c}", daemon=True)
@@ -387,6 +476,30 @@ def run_load(engine, n_clients=8, requests_per_client=16,
             # next to the router's own dispatch accounting
             report["server"]["per_engine_completed"] = \
                 _per_engine_completed_delta(before, after)
+        # cost cross-check: client-summed amortized bills vs the
+        # server cost-ledger delta over the measured window
+        costs_after = _fetch_costs(metrics_url)
+        # failed-over and post-dispatch-failed requests are billed in
+        # the ledger but not in the client's ok-books — that many
+        # extra server-side requests is healthy, not a mismatch
+        cost_slack = outcomes["error"] + report.get("failovers", 0)
+        cost_ok, cost_mismatches, cost_delta = cross_check_costs(
+            client_cost, costs_before, costs_after, slack=cost_slack,
+            lost_ledgers=bool(report.get("restarts")))
+        report["cost"] = {
+            "client_device_s": round(client_cost["device_s"], 6),
+            "client_requests": client_cost["requests"],
+            "client_tokens": client_cost["tokens"],
+            "compiled_requests": client_cost["compiled"],
+            "missing_bills": client_cost["missing"],
+            "ledger_delta": cost_delta,
+            "reconciled": cost_ok,
+            "mismatches": cost_mismatches}
+        if cost_delta and report["completed"] and wall:
+            tokens = cost_delta["valid_tokens"]
+            if tokens:
+                report["cost"]["device_s_per_1k_tokens"] = round(
+                    cost_delta["request_s"] * 1e3 / tokens, 6)
     return report
 
 
@@ -495,12 +608,30 @@ def _main():
         for rec in report["slowest_traces"]:
             print(f"#   {rec['ms']:>10.2f} ms  {rec['trace_id']}",
                   file=sys.stderr)
+    cost = report.get("cost")
+    if cost:
+        delta = cost.get("ledger_delta") or {}
+        per_1k = cost.get("device_s_per_1k_tokens")
+        print("# cost cross-check: client device_s="
+              f"{cost['client_device_s']:.4f} ledger request_s="
+              f"{(delta.get('request_s') or 0):.4f} requests="
+              f"{cost['client_requests']}/{delta.get('requests')} "
+              f"tokens={cost['client_tokens']}/"
+              f"{delta.get('valid_tokens')}"
+              + (f" device_s_per_1k_tokens={per_1k}"
+                 if per_1k is not None else "")
+              + f" reconciled={cost['reconciled']}", file=sys.stderr)
+    rc = 0
     if not args.no_expose and not report["server"]["reconciled"]:
         print("# WARNING: server/client accounting mismatch: "
               + "; ".join(report["server"]["mismatches"]),
               file=sys.stderr)
-        return 1
-    return 0
+        rc = 1
+    if cost and cost["reconciled"] is False:
+        print("# WARNING: cost-ledger mismatch: "
+              + "; ".join(cost["mismatches"]), file=sys.stderr)
+        rc = 1
+    return rc
 
 
 if __name__ == "__main__":

@@ -27,6 +27,12 @@ queue depth, qps, p95), the router's outcome counters, and the
 slowest cross-engine traces with the engines that served each::
 
     python tools/telemetry_dump.py --fleet http://127.0.0.1:9200
+
+`--profile` fetches the continuous profiler's `/profile` summary and
+tables the top frames by self time (where host CPU goes right now);
+`--costs` fetches the `/costs` cost ledger (an engine's, or a
+router's fleet merge) and tables per-bucket device/compile seconds,
+requests, tokens, and the derived per-request / per-1k-token rates.
 """
 from __future__ import annotations
 
@@ -140,7 +146,8 @@ def _base_url(src):
     """Normalize a source URL to the server base (strip a known
     endpoint path so any of /metrics | /stats | the bare base work)."""
     src = src.rstrip("/")
-    for suffix in ("/metrics", "/stats", "/healthz", "/traces"):
+    for suffix in ("/metrics", "/stats", "/healthz", "/traces",
+                   "/profile", "/costs"):
         if src.endswith(suffix):
             return src[: -len(suffix)]
     return src
@@ -240,6 +247,72 @@ def dump_fleet(base, out=None, top=5):
               f"engines={engines_str}", file=out)
 
 
+def dump_profile(snap, out=None, top=10):
+    """Table the /profile?format=json summary: top frames by self
+    samples — the one-screen 'where is host time going' answer."""
+    out = out if out is not None else sys.stdout
+    print(f"-- continuous profile: {snap.get('samples', 0)} samples @ "
+          f"{snap.get('hz')} Hz, {snap.get('threads')} threads, "
+          f"{snap.get('distinct_stacks')} stacks "
+          + ("(running) " if snap.get("running") else "(stopped) ")
+          + "-" * 8, file=out)
+    frames = snap.get("top_self") or []
+    if not frames:
+        print("(no samples yet — is MXNET_TPU_PROF enabled and the "
+              "daemon started?)", file=out)
+        return
+    print(f"  {'self%':>7} {'samples':>8}  frame", file=out)
+    for rec in frames[:top]:
+        print(f"  {rec['self_frac'] * 100:>6.1f}% {rec['self']:>8}  "
+              f"{rec['frame']}", file=out)
+
+
+def _cost_rows(buckets, out, indent="  "):
+    print(f"{indent}{'bucket':>7} {'device s':>10} {'compile s':>10} "
+          f"{'requests':>9} {'tokens':>10} {'ms/req':>8} {'s/1k tok':>9}",
+          file=out)
+    for blen, row in sorted(buckets.items(), key=lambda kv: int(kv[0])):
+        mspr = row.get("device_ms_per_request")
+        sptk = row.get("device_s_per_1k_tokens")
+        print(f"{indent}{blen:>7} {row.get('device_s', 0):>10.3f} "
+              f"{row.get('compile_s', 0):>10.3f} "
+              f"{row.get('requests', 0):>9} "
+              f"{row.get('valid_tokens', 0):>10} "
+              f"{(f'{mspr:.2f}' if mspr is not None else '-'):>8} "
+              f"{(f'{sptk:.4f}' if sptk is not None else '-'):>9}",
+          file=out)
+
+
+def dump_costs(data, out=None):
+    """Table a /costs body — one engine's ledger, or a router's fleet
+    merge (per-engine sections + the fleet table)."""
+    out = out if out is not None else sys.stdout
+    if "engines" in data:           # router fleet table
+        print(f"-- fleet costs {data.get('router_id', '?')}: "
+              f"{len(data.get('engines', {}))} engines "
+              + (f"(missing: {data['missing']}) " if data.get("missing")
+                 else "") + "-" * 10, file=out)
+        for eid, table in sorted(data.get("engines", {}).items()):
+            print(f"  engine {eid}:", file=out)
+            _cost_rows(table.get("buckets") or {}, out, indent="    ")
+        print("  fleet (all engines):", file=out)
+        _cost_rows(data.get("fleet") or {}, out, indent="    ")
+        totals = data.get("totals") or {}
+    else:                           # single engine
+        print(f"-- costs, engine {data.get('engine_id', '?')} "
+              + "-" * 30, file=out)
+        _cost_rows(data.get("buckets") or {}, out)
+        totals = data.get("totals") or {}
+    if totals:
+        print(f"  totals: device={totals.get('device_s', 0):.3f}s "
+              f"compile={totals.get('compile_s', 0):.3f}s "
+              f"requests={totals.get('requests', 0)} "
+              f"tokens={totals.get('valid_tokens', 0)}"
+              + (f" s/1k_tok={totals['device_s_per_1k_tokens']:.4f}"
+                 if totals.get("device_s_per_1k_tokens") is not None
+                 else ""), file=out)
+
+
 def dump_trace_tree(trace, out=None):
     """Indented span-tree render with per-span self-time."""
     out = out if out is not None else sys.stdout
@@ -304,8 +377,14 @@ def main(argv=None):
     ap.add_argument("--trace", default=None, metavar="ID",
                     help="render one trace's span tree from "
                     "/traces/<ID>")
+    ap.add_argument("--profile", action="store_true",
+                    help="table the continuous profiler's top "
+                    "self-time frames from the server's /profile")
+    ap.add_argument("--costs", action="store_true",
+                    help="table the per-bucket cost ledger from the "
+                    "server's /costs (engine or router fleet merge)")
     ap.add_argument("--top", type=int, default=10,
-                    help="rows in the --traces table")
+                    help="rows in the --traces/--profile tables")
     args = ap.parse_args(argv)
 
     src = args.source
@@ -320,8 +399,22 @@ def main(argv=None):
                 ok, hz = False, {"error": repr(e)}
             print(f"healthz: {'OK' if ok else 'UNHEALTHY'} {hz}")
             rc = 0 if ok else 2
+        # --fleet / --profile / --costs compose: any combination
+        # prints each requested table once
+        shown = False
         if args.fleet:
             dump_fleet(base, top=args.top)
+            shown = True
+        if args.profile:
+            dump_profile(json.loads(_fetch(
+                base + f"/profile?format=json&top={args.top}")),
+                top=args.top)
+            shown = True
+        if args.costs:
+            dump_costs(json.loads(_fetch(base + "/costs")))
+            shown = True
+        if shown:
+            pass
         elif args.trace:
             import urllib.error
             from urllib.parse import quote

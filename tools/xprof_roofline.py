@@ -49,7 +49,13 @@ def _tool_data(trace_dir, tool="hlo_stats"):
     """Parse the raw xspace files into the named xprof tool's table."""
     import glob
 
-    from xprof.convert.raw_to_tool_data import xspace_to_tool_data
+    try:
+        from xprof.convert.raw_to_tool_data import xspace_to_tool_data
+    except ImportError as e:
+        raise RuntimeError(
+            "xprof is unavailable (off-device host?): hlo_stats parsing "
+            f"— and --trace-id filtering over it — needs it ({e})") \
+            from e
 
     paths = glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"),
                       recursive=True)
@@ -59,6 +65,28 @@ def _tool_data(trace_dir, tool="hlo_stats"):
     if isinstance(data, bytes):
         data = data.decode()
     return data
+
+
+def filter_rows_by_trace(rows, trace_id):
+    """Keep hlo_stats rows whose metadata mentions ``trace_id``.
+
+    ``profiler.Scope`` stamps the active telemetry trace id into its
+    ``jax.profiler.TraceAnnotation``, so on-device the id surfaces in
+    the op-name/metadata strings xprof reports; this filter narrows the
+    roofline to the ops that ran under ONE traced request. Degrades
+    gracefully: when nothing matches (CPU run, annotation not
+    propagated by this backend, wrong id) the FULL row set is returned
+    with ``matched=False`` so the tool still reports — an operator
+    gets the whole-step roofline plus an honest flag instead of an
+    empty table. Returns ``(rows, matched)``."""
+    if not trace_id:
+        return rows, True
+    hits = [r for r in rows
+            if any(isinstance(v, str) and trace_id in v
+                   for v in r.values())]
+    if hits:
+        return hits, True
+    return rows, False
 
 
 def _rows(data):
@@ -115,6 +143,12 @@ def main():
     ap.add_argument("--inspect", action="store_true",
                     help="dump the hlo_stats columns and exit")
     ap.add_argument("--trace-dir", default=None)
+    ap.add_argument("--trace-id", default=None,
+                    help="narrow the roofline to HLO ops whose xprof "
+                    "metadata carries this telemetry trace id "
+                    "(profiler.Scope TraceAnnotation stamp); falls "
+                    "back to the full table with trace_id_matched="
+                    "false when nothing matches (e.g. off-device)")
     opts = ap.parse_args()
 
     # force chain=1: per-step attribution divides by step count only,
@@ -166,6 +200,13 @@ def main():
         print(json.dumps({"columns": list(rows[0].keys()) if rows else [],
                           "n_rows": len(rows)}, indent=2))
         return
+    trace_matched = True
+    if opts.trace_id:
+        rows, trace_matched = filter_rows_by_trace(rows, opts.trace_id)
+        if not trace_matched:
+            print(f"# trace id {opts.trace_id!r} matched no hlo_stats "
+                  "rows; reporting the UNFILTERED table "
+                  "(trace_id_matched: false)", file=sys.stderr)
 
     peak_gbps = bench._peak_hbm_gbps()
     peak_tf = bench._peak_tflops()
@@ -199,6 +240,8 @@ def main():
     out = {
         "model": opts.model,
         "steps": opts.steps,
+        "trace_id": opts.trace_id,
+        "trace_id_matched": trace_matched,
         "total_device_us": round(total_us, 1),
         "per_step_ms": round(total_us / 1000.0 / max(opts.steps, 1), 3),
         "true_hbm_bytes_per_step": round(per_step_bytes),
