@@ -1167,6 +1167,88 @@ def main_serving_router():
             telemetry_reconciled=server.get("reconciled"),
             server_p50_ms_est=server.get("latency", {}).get("p50_ms_est"))
 
+    # -- wire-vs-JSON A/B: the same fleet REMOTE-fronted --------------------
+    # The in-process run above measures the router plane; this phase
+    # measures the DISPATCH TRANSPORT. The engines expose() and the
+    # router fronts them by URL, once over the binary wire (persistent
+    # multiplexed connections, raw typed ndarrays) and once pinned to
+    # the HTTP/JSON long-poll — same engines, same traffic, so the
+    # delta is pure serialization+transport. The wire must win on both
+    # serialized bytes/request and dispatch-overhead p50.
+    from mxnet_tpu.serving.metrics import (wire_bytes_counter,
+                                           wire_fallback_counter)
+
+    byt = wire_bytes_counter()
+    fall = wire_fallback_counter()
+
+    def _bytes(transport):
+        return sum(byt.labels(side="router", transport=transport,
+                              direction=d).value for d in ("in", "out"))
+
+    def _fallbacks():
+        return sum(fall.labels(engine_id=f"e{i}").value
+                   for i in range(n_engines))
+
+    ab = {}
+    with contextlib.ExitStack() as stack:
+        engines = [stack.enter_context(make_engine(i))
+                   for i in range(n_engines)]
+        urls = []
+        for eng in engines:
+            srv = eng.expose(port=0)
+            urls.append(f"http://{srv.host}:{srv.port}")
+            eng.warmup()
+        for transport, wire_flag in (("wire", True), ("json", False)):
+            router = ServingRouter(
+                {f"e{i}": url for i, url in enumerate(urls)},
+                wire=wire_flag, poll_interval_s=0.2)
+            with router:
+                if wire_flag:
+                    deadline = time.perf_counter() + 15.0
+                    while time.perf_counter() < deadline and not all(
+                            row.get("transport") == "wire"
+                            for row in router.scoreboard().values()):
+                        time.sleep(0.1)
+                    assert all(row.get("transport") == "wire"
+                               for row in router.scoreboard().values()), \
+                        router.scoreboard()
+                b0, f0 = _bytes(transport), _fallbacks()
+                rep = run_load(router, n_clients=clients,
+                               requests_per_client=reqs,
+                               min_len=max(4, seqlen // 8),
+                               max_len=seqlen, vocab=vocab)
+                nbytes = _bytes(transport) - b0
+                assert rep["completed"] == clients * reqs, rep
+                over = router.snapshot()["dispatch_overhead"] \
+                    .get(transport) or {}
+                ab[transport] = {
+                    "requests_per_sec": rep["requests_per_sec"],
+                    "p50_ms": rep["p50_ms"], "p99_ms": rep["p99_ms"],
+                    "bytes_per_request": round(
+                        nbytes / max(1, rep["completed"]), 1),
+                    "dispatch_overhead_p50_ms": over.get("p50_ms"),
+                    "dispatch_overhead_p99_ms": over.get("p99_ms"),
+                    # nonzero on the wire run = it limped through HTTP
+                    "fallbacks": (int(_fallbacks() - f0)
+                                  if wire_flag else None)}
+    wire_ab, json_ab = ab["wire"], ab["json"]
+    # the acceptance bar: binary framing beats decimal-text JSON on
+    # the serialized payload AND on what the transport costs on top
+    # of the engine wall
+    assert wire_ab["bytes_per_request"] < json_ab["bytes_per_request"], ab
+    assert (wire_ab["dispatch_overhead_p50_ms"]
+            < json_ab["dispatch_overhead_p50_ms"]), ab
+    _report("bert_serving_router_wire_requests_per_sec",
+            wire_ab["requests_per_sec"], "requests/sec", 0.0,
+            seqlen=seqlen, clients=clients, engines=n_engines,
+            dtype=DTYPE, transport="wire", wire=wire_ab, json=json_ab,
+            bytes_per_request_ratio=round(
+                wire_ab["bytes_per_request"]
+                / max(1e-9, json_ab["bytes_per_request"]), 4),
+            dispatch_overhead_p50_speedup=round(
+                json_ab["dispatch_overhead_p50_ms"]
+                / max(1e-9, wire_ab["dispatch_overhead_p50_ms"]), 2))
+
 
 def main_serving_restart():
     """Rolling-restart serving drill (the warm-restart acceptance

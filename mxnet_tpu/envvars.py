@@ -206,6 +206,37 @@ register("MXNET_TPU_LOCAL_RANK", "int", 0,
          "rank within this host (set per worker by ``tools/launch.py``; "
          "horovod-shim ``local_rank``)", scope="dist")
 
+# -- serving dispatch wire --------------------------------------------------
+register("MXNET_TPU_WIRE", "bool", True,
+         "binary dispatch wire: ``ServingEngine.expose()`` starts the "
+         "typed-frame dispatch listener next to the HTTP server, and a "
+         "``ServingRouter`` upgrades remote seats that advertise a "
+         "``wire_port`` to persistent multiplexed connections; ``0`` "
+         "keeps dispatch on the HTTP/JSON long-poll only", scope="wire")
+register("MXNET_TPU_WIRE_PORT", "int", 0,
+         "engine dispatch-listener port (``0`` picks a free port; the "
+         "bound port is advertised at ``/healthz`` as ``wire_port``). "
+         "A taken configured port falls back to ephemeral with a "
+         "``wire_port_fallback`` event", scope="wire")
+register("MXNET_TPU_WIRE_CONNS", "int", 2,
+         "persistent multiplexed wire connections a router keeps per "
+         "wire-capable engine (one reader thread each demuxes replies "
+         "by correlation id)", scope="wire")
+register("MXNET_TPU_WIRE_TIMEOUT_S", "float", 5.0,
+         "wire connect/handshake timeout and the grace added on top "
+         "of the dispatch timeout before an unanswered in-flight "
+         "request is failed over", scope="wire")
+register("MXNET_TPU_WIRE_MAX_FRAME_MB", "int", 256,
+         "dispatch-wire frame size cap in MiB (length-bomb guard; a "
+         "larger prefix refuses the connection before allocating — "
+         "the dist_async channel keeps its own 8 GiB cap)",
+         scope="wire")
+register("MXNET_TPU_WIRE_HTTP_POOL", "int", 8,
+         "bounded waiter threads per remote seat for the HTTP/JSON "
+         "fallback dispatch path (the legacy thread-per-in-flight-"
+         "request shape could thread-bomb under load spikes)",
+         scope="wire")
+
 # -- telemetry: events / spans ----------------------------------------------
 register("MXNET_TPU_EVENT_LOG", "path", None,
          "structured JSONL run-event log path (a directory gets one "
@@ -299,6 +330,7 @@ _SCOPE_TITLES = OrderedDict([
     ("compile_cache", "Persistent compilation cache"),
     ("kernels", "Pallas kernels"),
     ("dist", "Distributed"),
+    ("wire", "Serving dispatch wire"),
     ("telemetry", "Telemetry / observability"),
     ("bench", "Benchmarks"),
     ("tests", "Tests / dev harness"),

@@ -28,11 +28,9 @@ XLA collectives. Semantics preserved:
 from __future__ import annotations
 
 import os
-import struct
 from typing import Optional
 
 import jax
-import numpy as np
 
 from . import envvars
 from .base import MXNetError
@@ -643,170 +641,41 @@ class _ParameterServer:
 
 
 # -- dist_async wire codec ------------------------------------------------
-# Typed, NON-EXECUTABLE frame encoding. The first cut of this wire
-# spoke length-prefixed pickled objects — i.e. any peer that could
-# reach the port could run arbitrary code in the server process
-# (unpickling attacker-controlled socket bytes is code execution).
-# This codec replaces it: a tagged tree of plain data
-# (None/bool/int/float/str/bytes/dict/tuple) plus ndarrays as a
-# struct header (dtype, shape) + raw buffer bytes. Decoding can only
-# ever build data, never import or call anything.
-#
-#   N none | T true | F false | i int64 | f float64
-#   s utf-8 str | b bytes        (u32 length prefix)
-#   a ndarray: u8 dtype-str-len + dtype.str + u8 ndim + u64*ndim + raw
-#   l tuple:  u32 count + items
-#   d dict:   u32 count + key/value item pairs
-_WIRE_MAX_DEPTH = 16
+# The typed, NON-EXECUTABLE frame codec was born here (replacing the
+# pickled frames whose decode was remote code execution) and now lives
+# in mxnet_tpu/serving/wire.py, shared with the serving dispatch wire.
+# These thin wrappers keep kvstore's historical names — tests and the
+# 2-process workers import them from here — and pin the dist_async
+# channel's own frame cap. The import is lazy on purpose: kvstore
+# loads BEFORE the serving package during `import mxnet_tpu`, and at
+# RPC time everything is initialized.
 _WIRE_MAX_FRAME = 1 << 33          # 8 GiB: no 'length bomb' allocations
 
 
-def _enc(obj, out, depth=0):
-    if depth > _WIRE_MAX_DEPTH:
-        raise ValueError("wire object nests too deep")
-    if obj is None:
-        out.append(b"N")
-    elif obj is True:
-        out.append(b"T")
-    elif obj is False:
-        out.append(b"F")
-    elif isinstance(obj, (int, np.integer)):
-        out.append(b"i" + struct.pack("<q", int(obj)))
-    elif isinstance(obj, (float, np.floating)):
-        out.append(b"f" + struct.pack("<d", float(obj)))
-    elif isinstance(obj, str):
-        raw = obj.encode("utf-8")
-        out.append(b"s" + struct.pack("<I", len(raw)) + raw)
-    elif isinstance(obj, (bytes, bytearray)):
-        out.append(b"b" + struct.pack("<I", len(obj)) + bytes(obj))
-    elif isinstance(obj, np.ndarray):
-        if obj.dtype.hasobject:
-            raise ValueError("object arrays are not wire-encodable")
-        dt = obj.dtype.str.encode("ascii")
-        out.append(b"a" + struct.pack("<B", len(dt)) + dt
-                   + struct.pack("<B", obj.ndim)
-                   + struct.pack(f"<{obj.ndim}Q", *obj.shape))
-        out.append(np.ascontiguousarray(obj).tobytes())
-    elif isinstance(obj, (list, tuple)):
-        out.append(b"l" + struct.pack("<I", len(obj)))
-        for item in obj:
-            _enc(item, out, depth + 1)
-    elif isinstance(obj, dict):
-        out.append(b"d" + struct.pack("<I", len(obj)))
-        for k, v in obj.items():
-            _enc(k, out, depth + 1)
-            _enc(v, out, depth + 1)
-    else:
-        raise ValueError(
-            f"type {type(obj).__name__} is not wire-encodable (only "
-            "plain data rides the dist_async wire)")
-    return out
-
-
-def _dec(buf, pos, depth=0):
-    if depth > _WIRE_MAX_DEPTH:
-        raise ValueError("wire object nests too deep")
-    tag = buf[pos:pos + 1]
-    pos += 1
-    if tag == b"N":
-        return None, pos
-    if tag == b"T":
-        return True, pos
-    if tag == b"F":
-        return False, pos
-    if tag == b"i":
-        return struct.unpack_from("<q", buf, pos)[0], pos + 8
-    if tag == b"f":
-        return struct.unpack_from("<d", buf, pos)[0], pos + 8
-    if tag in (b"s", b"b"):
-        (n,) = struct.unpack_from("<I", buf, pos)
-        pos += 4
-        raw = bytes(buf[pos:pos + n])
-        if len(raw) != n:
-            raise ValueError("truncated wire frame")
-        return (raw.decode("utf-8") if tag == b"s" else raw), pos + n
-    if tag == b"a":
-        (dl,) = struct.unpack_from("<B", buf, pos)
-        pos += 1
-        dt = np.dtype(bytes(buf[pos:pos + dl]).decode("ascii"))
-        pos += dl
-        if dt.hasobject:
-            raise ValueError("object arrays are not wire-decodable")
-        (ndim,) = struct.unpack_from("<B", buf, pos)
-        pos += 1
-        shape = struct.unpack_from(f"<{ndim}Q", buf, pos)
-        pos += 8 * ndim
-        count = int(np.prod(shape, dtype=np.int64)) if ndim else 1
-        nbytes = count * dt.itemsize
-        if nbytes > _WIRE_MAX_FRAME or pos + nbytes > len(buf):
-            raise ValueError("truncated/oversized ndarray frame")
-        arr = np.frombuffer(buf, dt, count=count, offset=pos).reshape(shape)
-        return arr.copy(), pos + nbytes   # copy: own the memory
-    if tag == b"l":
-        (n,) = struct.unpack_from("<I", buf, pos)
-        pos += 4
-        items = []
-        for _ in range(n):
-            item, pos = _dec(buf, pos, depth + 1)
-            items.append(item)
-        return tuple(items), pos
-    if tag == b"d":
-        (n,) = struct.unpack_from("<I", buf, pos)
-        pos += 4
-        out = {}
-        for _ in range(n):
-            k, pos = _dec(buf, pos, depth + 1)
-            v, pos = _dec(buf, pos, depth + 1)
-            out[k] = v
-        return out, pos
-    raise ValueError(f"unknown wire tag {bytes(tag)!r} — refusing frame")
+def _wire_mod():
+    from .serving import wire
+    return wire
 
 
 def _wire_encode(obj) -> bytes:
-    return b"".join(_enc(obj, []))
+    return _wire_mod().wire_encode(obj)
 
 
 def _wire_decode(data) -> object:
-    try:
-        obj, pos = _dec(memoryview(data), 0)
-    except ValueError:
-        raise
-    except (struct.error, TypeError, UnicodeDecodeError, IndexError,
-            OverflowError, MemoryError) as e:
-        # every malformed-frame failure surfaces as ValueError so the
-        # server's bad-frame handling has ONE refusal path
-        raise ValueError(f"malformed wire frame: {e!r}") from e
-    if pos != len(data):
-        raise ValueError("trailing bytes in wire frame")
-    return obj
+    return _wire_mod().wire_decode(data)
 
 
 def _send_msg(sock, obj):
     """Encode + length-prefix + send; returns the frame's byte size so
     callers can account wire traffic without re-encoding."""
-    data = _wire_encode(obj)
-    sock.sendall(struct.pack("<Q", len(data)) + data)
-    return len(data)
+    return _wire_mod().send_frame(sock, obj, max_frame=_WIRE_MAX_FRAME)
 
 
 def _recv_msg_sized(sock):
-    """(decoded object, frame bytes) — None on a cleanly closed peer."""
-    hdr = b""
-    while len(hdr) < 8:
-        chunk = sock.recv(8 - len(hdr))
-        if not chunk:
-            return None
-        hdr += chunk
-    (n,) = struct.unpack("<Q", hdr)
-    if n > _WIRE_MAX_FRAME:
-        raise MXNetError(f"wire frame of {n} bytes exceeds the cap")
-    buf = bytearray()
-    while len(buf) < n:
-        chunk = sock.recv(min(1 << 20, n - len(buf)))
-        if not chunk:
-            return None
-        buf += chunk
-    return _wire_decode(bytes(buf)), n
+    """(decoded object, frame bytes) — None on a cleanly closed peer.
+    An over-cap length prefix raises FrameTooLargeError (an MXNetError
+    AND a ValueError, matching both historical refusal paths)."""
+    return _wire_mod().recv_frame(sock, max_frame=_WIRE_MAX_FRAME)
 
 
 def _recv_msg(sock):

@@ -53,6 +53,10 @@ _SUBMIT_ERROR_STATUS = {
     "QueueFullError": 429,
     "RequestTooLongError": 413,
     "DeadlineExceededError": 504,
+    # the handler's own fut.result(timeout) expiring is request-scoped
+    # like a deadline: 504 tells a failover client NOT to replay it as
+    # new work while this process may still be executing it
+    "TimeoutError": 504,
     "EngineStoppedError": 503,
 }
 
@@ -161,6 +165,7 @@ class ServingEngine:
         self._compiling_since = None
         self._worker = None
         self._expo = None
+        self._wire = None           # binary dispatch listener (expose)
         self._abort = False
         self._started = False
         self._lock = threading.Lock()
@@ -233,6 +238,9 @@ class ServingEngine:
         self.stats.set_queue_depth_fn(lambda: 0)
         with self._lock:
             expo, self._expo = self._expo, None
+            wire, self._wire = self._wire, None
+        if wire is not None:
+            wire.close()
         if expo is not None:
             expo.close()
         if timed_out:
@@ -374,7 +382,13 @@ class ServingEngine:
         :class:`~.router.ServingRouter` in another process drives
         (JSON request in, JSON result out, long-polled until the
         forward completes). ``port=0`` picks a free port (read
-        ``.port`` back). Closed automatically by :meth:`stop`."""
+        ``.port`` back). Closed automatically by :meth:`stop`.
+
+        Unless ``MXNET_TPU_WIRE=0``, a binary dispatch listener
+        (:class:`~.wire.WireListener`) starts alongside and its port
+        is advertised in ``/healthz`` — wire-capable routers upgrade
+        their dispatch transport off that; JSON-only peers keep using
+        ``POST /submit``."""
         from ..telemetry.expo import TelemetryServer
 
         with self._lock:
@@ -391,11 +405,14 @@ class ServingEngine:
                          and self._worker.is_alive())
                 closed = self._queue.closed
                 compiling = self._compiling_since
+                wire = self._wire
                 return (alive and not closed,
                         {"engine_id": self.engine_id,
                          "worker_alive": alive, "queue_closed": closed,
                          "queue_depth": len(self._queue),
                          "compiling": compiling is not None,
+                         "wire_port": (wire.port if wire is not None
+                                       else None),
                          "seconds_since_beat":
                              round(time.monotonic() - self._beat, 3)})
 
@@ -406,6 +423,19 @@ class ServingEngine:
                                   costs_fn=self.cost_table,
                                   port=port, host=host)
             self._expo = srv
+            # the binary dispatch listener rides along with the HTTP
+            # server (MXNET_TPU_WIRE=0 opts out): /healthz advertises
+            # its port so a fronting router upgrades its transport —
+            # a bind failure degrades to HTTP dispatch, never to a
+            # dead engine
+            if envvars.get("MXNET_TPU_WIRE") and self._wire is None:
+                from .wire import WireListener
+                try:
+                    self._wire = WireListener(self, host=host)
+                except OSError as e:
+                    _events.emit("wire_listen_error",
+                                 engine_id=self.engine_id,
+                                 error=repr(e))
         # emit/return through the local: a stop() racing in right here
         # may already have swapped self._expo away (and closed it)
         _events.emit("telemetry_expose", engine_id=self.engine_id,
@@ -444,7 +474,11 @@ class ServingEngine:
         thread): submit + block for the result, JSON-serializable
         either way. Returns ``(http_status, body_dict)`` — admission
         errors carry their class name in ``error_type`` so the remote
-        router re-raises the same serving taxonomy."""
+        router re-raises the same serving taxonomy. ``engine_ms`` (the
+        engine-observed submit→result wall) rides back so the router
+        can split its dispatch round trip into engine time vs
+        transport overhead — the wire-vs-JSON comparison axis."""
+        t0 = time.perf_counter()
         try:
             fut = self.submit(payload["tokens"],
                               payload.get("token_types"),
@@ -468,6 +502,8 @@ class ServingEngine:
         return 200, {"ok": True, "result": np.asarray(out).tolist(),
                      "trace_id": fut.trace_id,
                      "engine_id": self.engine_id,
+                     "engine_ms": round(
+                         (time.perf_counter() - t0) * 1e3, 3),
                      # amortized cost attribution crosses the wire so
                      # a remote router's caller sees the same bill an
                      # in-process caller would

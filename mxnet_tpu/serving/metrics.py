@@ -34,10 +34,102 @@ from collections import deque
 from ..telemetry.registry import REGISTRY
 
 __all__ = ["LatencySummary", "ServingStats", "CostLedger",
-           "nearest_rank", "merge_cost_buckets"]
+           "DispatchOverhead", "nearest_rank", "merge_cost_buckets",
+           "wire_frames_counter", "wire_bytes_counter",
+           "wire_connections_gauge", "wire_refusals_counter",
+           "wire_fallback_counter"]
 
 # batch-size histogram boundaries (requests per dispatched batch)
 _BATCH_REQ_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0)
+
+# dispatch-overhead boundaries (ms): the binary wire's round trip minus
+# engine time is sub-millisecond on loopback — the default ms buckets
+# would fold every sample into the first bucket
+_WIRE_OVERHEAD_BUCKETS = (0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+                          25.0, 50.0, 100.0, 250.0, 1000.0)
+
+
+# -- dispatch-wire metric families ------------------------------------------
+# Declared HERE once (one label set per family — the mxlint
+# telemetry-consistency contract) and shared by serving/wire.py (both
+# sides of the binary transport) and serving/router.py (the HTTP/JSON
+# fallback path's byte/fallback accounting).
+
+def wire_frames_counter(registry=None):
+    reg = registry if registry is not None else REGISTRY
+    return reg.counter(
+        "mxnet_tpu_wire_frames_total",
+        "dispatch-wire frames by side (router/engine), direction and "
+        "frame type", ("side", "direction", "frame"))
+
+
+def wire_bytes_counter(registry=None):
+    reg = registry if registry is not None else REGISTRY
+    return reg.counter(
+        "mxnet_tpu_wire_bytes_total",
+        "serialized dispatch payload bytes by side, transport "
+        "(wire = binary frames, json = the HTTP fallback bodies) and "
+        "direction", ("side", "transport", "direction"))
+
+
+def wire_connections_gauge(registry=None):
+    reg = registry if registry is not None else REGISTRY
+    return reg.gauge(
+        "mxnet_tpu_wire_connections",
+        "live persistent dispatch-wire connections, per side",
+        ("side",))
+
+
+def wire_refusals_counter(registry=None):
+    reg = registry if registry is not None else REGISTRY
+    return reg.counter(
+        "mxnet_tpu_wire_refusals_total",
+        "hostile/malformed dispatch-wire frames refused (the frame or "
+        "connection errored; the process never does)", ("side",))
+
+
+def wire_fallback_counter(registry=None):
+    reg = registry if registry is not None else REGISTRY
+    return reg.counter(
+        "mxnet_tpu_wire_fallback_total",
+        "remote dispatches a wire-capable router sent over the "
+        "HTTP/JSON path instead (peer advertises no wire port, or its "
+        "wire connections are down), per engine", ("engine_id",))
+
+
+class DispatchOverhead:
+    """Router-observed remote dispatch overhead by transport: the full
+    dispatch round trip MINUS the engine-observed serving wall
+    (``engine_ms`` in the reply) — i.e. what serialization, transport
+    and demux cost on top of the model. This is THE wire-vs-JSON
+    comparison number; each sample co-observes a registry histogram
+    (fine sub-ms buckets) and a per-transport :class:`LatencySummary`
+    for exact window percentiles in the router snapshot."""
+
+    def __init__(self, registry=None):
+        reg = registry if registry is not None else REGISTRY
+        self._hist = reg.histogram(
+            "mxnet_tpu_wire_dispatch_overhead_ms",
+            "remote dispatch round trip minus engine-observed serving "
+            "wall, by transport", ("transport",),
+            buckets=_WIRE_OVERHEAD_BUCKETS)
+        self._summaries = {}
+        self._lock = threading.Lock()
+
+    def observe(self, transport, ms):
+        transport = str(transport)
+        summary = self._summaries.get(transport)
+        if summary is None:
+            with self._lock:
+                summary = self._summaries.setdefault(
+                    transport, LatencySummary(
+                        4096, self._hist.labels(transport=transport)))
+        summary.observe(max(0.0, float(ms)))
+
+    def snapshot(self):
+        with self._lock:
+            items = list(self._summaries.items())
+        return {t: s.snapshot() for t, s in items}
 
 
 def nearest_rank(sorted_xs, p):

@@ -9,9 +9,17 @@ policy for long-tailed request costs). Seats come in two kinds:
 - **in-process** engines, registered by handle (``add_engine(id,
   engine)``) and dispatched via ``engine.submit`` directly;
 - **remote** engines, registered by the base URL of their
-  ``engine.expose()`` endpoint and dispatched via its ``POST /submit``
-  long-poll, with per-engine health/stats/metrics/traces scraped off
-  the same endpoint.
+  ``engine.expose()`` endpoint, with per-engine health/stats/metrics/
+  traces scraped off that endpoint. Dispatch prefers the BINARY WIRE
+  (:mod:`.wire`): when the engine's ``/healthz`` advertises a
+  ``wire_port``, the seat keeps a small pool of persistent
+  multiplexed connections whose single reader thread per connection
+  demuxes replies by correlation id — zero connections, threads or
+  ``tokens.tolist()`` round-trips per request. A peer with no wire
+  port (an old engine, or ``MXNET_TPU_WIRE=0``) — or a seat whose
+  wire connections are momentarily down — falls back to the
+  ``POST /submit`` HTTP/JSON long-poll, now driven by a BOUNDED
+  per-seat waiter pool instead of a thread per in-flight request.
 
 The observability plane is the point:
 
@@ -76,6 +84,7 @@ import time
 import urllib.error
 import urllib.request
 from collections import OrderedDict, deque
+from urllib.parse import urlsplit
 
 import numpy as np
 
@@ -86,11 +95,14 @@ from ..telemetry import recorder as _recorder
 from ..telemetry import spans as _spans
 from ..telemetry.registry import REGISTRY as _REGISTRY
 from ..telemetry.trace import new_trace_id
-from .engine import ServingEngine
-from .metrics import LatencySummary, merge_cost_buckets
+from .engine import _SUBMIT_ERROR_STATUS, ServingEngine
+from .metrics import (DispatchOverhead, LatencySummary,
+                      merge_cost_buckets, wire_bytes_counter,
+                      wire_fallback_counter)
 from .queue import (DeadlineExceededError, EngineStoppedError,
                     InferenceFuture, QueueFullError, ServingError,
                     validate_tokens)
+from .wire import WireClient, WireError
 
 __all__ = ["ServingRouter", "NoEngineAvailableError", "RemoteEngineError"]
 
@@ -159,6 +171,68 @@ class RouterRequest:
                 > self.deadline)
 
 
+class _FallbackPool:
+    """Bounded waiter pool for the HTTP/JSON fallback dispatch path.
+
+    The legacy shape spawned one unbounded daemon thread per in-flight
+    remote request — a load spike against a slow engine thread-bombed
+    the router. Jobs queue here instead; at most
+    ``MXNET_TPU_WIRE_HTTP_POOL`` waiters per seat run them, spawned
+    lazily only when every existing waiter is busy. ``close()`` lets
+    the waiters drain what's queued and exit."""
+
+    def __init__(self, name, size):
+        self._name = str(name)
+        self._size = max(1, int(size))
+        self._dq = deque()
+        self._cv = threading.Condition()
+        self._threads = 0
+        self._idle = 0
+        self._closed = False
+        self._seq = itertools.count()
+
+    def submit(self, fn):
+        """Queue one job; False when the pool is closed (the seat is
+        being torn down — the caller resolves the request itself)."""
+        with self._cv:
+            if self._closed:
+                return False
+            self._dq.append(fn)
+            if self._idle == 0 and self._threads < self._size:
+                self._threads += 1
+                threading.Thread(
+                    target=self._run, daemon=True,
+                    name=f"mxnet_tpu_router_http_{self._name}"
+                         f"_{next(self._seq)}").start()
+            else:
+                self._cv.notify()
+        return True
+
+    def close(self):
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+
+    def _run(self):
+        while True:
+            with self._cv:
+                while not self._dq and not self._closed:
+                    self._idle += 1
+                    self._cv.wait(0.5)
+                    self._idle -= 1
+                if not self._dq:
+                    self._threads -= 1
+                    return          # closed and drained
+                fn = self._dq.popleft()
+            try:
+                fn()
+            except Exception as e:
+                # a job resolves its own request via done(); an escape
+                # here is a bug worth a trace, never a dead waiter pool
+                _events.emit("router_http_pool_error",
+                             pool=self._name, error=repr(e))
+
+
 class _Seat:
     """One engine behind the router: routing state + scoreboard row."""
 
@@ -198,6 +272,13 @@ class _Seat:
 
     def warmup_manifest(self):
         return None
+
+    def maintain(self):
+        """Poll-thread housekeeping (wire connection upkeep)."""
+
+    def close(self):
+        """Release seat-owned transport resources (router stop /
+        ``remove_engine``)."""
 
 
 class _LocalSeat(_Seat):
@@ -241,11 +322,29 @@ class _LocalSeat(_Seat):
 class _RemoteSeat(_Seat):
     kind = "remote"
 
-    def __init__(self, engine_id, base_url, http_timeout_s=5.0):
+    def __init__(self, engine_id, base_url, http_timeout_s=5.0,
+                 overhead=None, wire_enabled=None, client_id=None):
         super().__init__(engine_id)
         self.base_url = base_url.rstrip("/")
         self._timeout = http_timeout_s
         self._last_costs = None     # last fetched /costs (see cost_table)
+        self._overhead = overhead   # router-shared DispatchOverhead
+        self._wire_enabled = (bool(wire_enabled) if wire_enabled
+                              is not None
+                              else bool(envvars.get("MXNET_TPU_WIRE")))
+        self._client_id = str(client_id or f"router-{os.getpid():x}")
+        self._wire = None           # WireClient once a port is known
+        self._wire_peer = None      # engine id the pool was built for
+        self._advertised = (None, None)   # (wire_port, engine_id) @ poll
+        self._pool = _FallbackPool(
+            self.engine_id, envvars.get("MXNET_TPU_WIRE_HTTP_POOL"))
+        byt = wire_bytes_counter()
+        self._b_out_json = byt.labels(side="router", transport="json",
+                                      direction="out")
+        self._b_in_json = byt.labels(side="router", transport="json",
+                                     direction="in")
+        self._c_fallback = wire_fallback_counter() \
+            .labels(engine_id=self.engine_id)
 
     def _get(self, path, timeout=None):
         with urllib.request.urlopen(
@@ -254,7 +353,105 @@ class _RemoteSeat(_Seat):
                 else self._timeout) as r:
             return r.read().decode()
 
+    def row(self):
+        out = super().row()
+        wire = self._wire
+        out["transport"] = ("wire" if wire is not None
+                            and wire.has_live() else "json")
+        out["wire_port"] = self._advertised[0]
+        return out
+
+    # -- binary wire path ---------------------------------------------------
+    def maintain(self):
+        """Poll-thread housekeeping for the wire transport: (re)open
+        persistent connections toward the advertised dispatch port,
+        time out unanswered in-flight requests. All blocking connect/
+        handshake work lives HERE — the dispatch path only ever queues
+        frames on already-live connections."""
+        if not self._wire_enabled:
+            return
+        port, peer_eid = self._advertised
+        wire = self._wire
+        if wire is not None and (
+                port is None or wire.port != int(port)
+                or (peer_eid is not None
+                    and self._wire_peer not in (None, peer_eid))):
+            # peer downgraded (restarted with MXNET_TPU_WIRE=0), came
+            # back on a different port, or a REPLACEMENT engine took
+            # the same port under a new id (the old client would pin
+            # a stale expect and refuse it forever): rebuild the pool
+            self._wire = None
+            wire.close()
+            wire = None
+        if port is None:
+            return
+        if wire is None:
+            host = urlsplit(self.base_url).hostname or "127.0.0.1"
+            wire = WireClient(host, int(port),
+                              client_id=self._client_id,
+                              expect_engine_id=peer_eid)
+            self._wire = wire
+            self._wire_peer = peer_eid
+        wire.ensure()
+        wire.sweep()
+
+    def _dispatch_wire(self, wire, req, timeout_s, done):
+        # raw typed ndarrays — no tolist()/JSON round trip; trace and
+        # span ids ride the frame so the engine-side span tree parents
+        # under the router root exactly as it did over HTTP
+        payload = {"tokens": req.tokens,
+                   "token_types": req.token_types,
+                   "deadline_ms": req.remaining_ms(),
+                   "trace_id": req.trace_id,
+                   "span_id": req.span.span_id}
+        t0 = time.perf_counter()
+
+        def _on_wire(exc, body):
+            rt_ms = (time.perf_counter() - t0) * 1e3
+            if exc is not None:
+                # connection died or reply timed out: engine-shaped —
+                # the router's failover requeues the request
+                done(self, req, RemoteEngineError(
+                    f"engine {self.engine_id} wire dispatch failed: "
+                    f"{exc}"), None)
+                return
+            err_type = body.get("error_type")
+            if err_type is None:
+                engine_ms = body.get("engine_ms")
+                if self._overhead is not None and engine_ms is not None:
+                    self._overhead.observe("wire",
+                                           rt_ms - float(engine_ms))
+                done(self, req, None, np.asarray(body.get("result")),
+                     cost=body.get("cost"))
+                return
+            if err_type == "WireError":
+                # protocol-level refusal from the listener (bad frame
+                # shape we somehow sent): transport-shaped
+                exc2 = RemoteEngineError(
+                    body.get("error")
+                    or f"engine {self.engine_id} wire error")
+            else:
+                cls = _ERROR_CLASSES.get(err_type, ServingError)
+                exc2 = cls(body.get("error")
+                           or f"engine {self.engine_id} error")
+            done(self, req, exc2, None)
+
+        wire.dispatch(payload, _on_wire, timeout_s)
+
+    # -- dispatch (wire preferred, bounded HTTP/JSON fallback) --------------
     def dispatch(self, req, timeout_s, done):
+        wire = self._wire
+        if wire is not None:
+            try:
+                self._dispatch_wire(wire, req, timeout_s, done)
+                return
+            except WireError:
+                pass    # no live connection right now: HTTP still works
+        if self._wire_enabled:
+            # a wire-capable router dispatching over HTTP: the peer has
+            # no wire port, or its connections are down — visible so an
+            # operator can tell "fast path" from "limping"
+            self._c_fallback.inc()
         payload = {"tokens": req.tokens.tolist(),
                    "token_types": (req.token_types.tolist()
                                    if req.token_types is not None
@@ -263,21 +460,26 @@ class _RemoteSeat(_Seat):
                    "trace_id": req.trace_id,
                    "span_id": req.span.span_id,
                    "timeout_s": timeout_s}
+        t0 = time.perf_counter()
 
-        # the /submit long-poll blocks for the whole request; a waiter
-        # thread per in-flight remote dispatch keeps the router's
-        # dispatch loop free (in-process seats resolve via callbacks)
+        # the /submit long-poll blocks for the whole request; a BOUNDED
+        # waiter pool keeps the router's dispatch loop free without the
+        # legacy thread-per-in-flight-request bomb (in-process seats
+        # resolve via callbacks)
         def _run():
             exc = value = cost = None
             body = None
             try:
+                data = json.dumps(payload).encode()
+                self._b_out_json.inc(len(data))
                 http_req = urllib.request.Request(
-                    self.base_url + "/submit",
-                    data=json.dumps(payload).encode(),
+                    self.base_url + "/submit", data=data,
                     headers={"Content-Type": "application/json"})
                 with urllib.request.urlopen(
                         http_req, timeout=timeout_s + self._timeout) as r:
-                    body = json.loads(r.read().decode())
+                    raw = r.read()
+                    self._b_in_json.inc(len(raw))
+                    body = json.loads(raw.decode())
             except urllib.error.HTTPError as e:
                 try:
                     body = json.loads(e.read().decode())
@@ -291,6 +493,12 @@ class _RemoteSeat(_Seat):
                 if body.get("ok"):
                     value = np.asarray(body["result"], np.float32)
                     cost = body.get("cost")
+                    engine_ms = body.get("engine_ms")
+                    if self._overhead is not None \
+                            and engine_ms is not None:
+                        self._overhead.observe(
+                            "json", (time.perf_counter() - t0) * 1e3
+                            - float(engine_ms))
                 else:
                     cls = _ERROR_CLASSES.get(body.get("error_type"),
                                              ServingError)
@@ -298,14 +506,25 @@ class _RemoteSeat(_Seat):
                               or f"engine {self.engine_id} error")
             done(self, req, exc, value, cost=cost)
 
-        threading.Thread(
-            target=_run, daemon=True,
-            name=f"mxnet_tpu_router_rpc_{self.engine_id}").start()
+        if not self._pool.submit(_run):
+            done(self, req, RemoteEngineError(
+                f"engine {self.engine_id} seat is closed"), None)
+
+    def close(self):
+        wire, self._wire = self._wire, None
+        if wire is not None:
+            wire.close()
+        self._pool.close()
 
     def health(self):
         try:
             hz = json.loads(self._get("/healthz"))
             ok = bool(hz.get("ok"))
+            # the advertised dispatch port (and the engine's REAL id —
+            # the seat may be registered under an operator alias) feed
+            # maintain()'s connection upkeep on this same poll thread
+            self._advertised = (hz.get("wire_port"),
+                                hz.get("engine_id"))
         except urllib.error.HTTPError as e:
             try:
                 hz = json.loads(e.read().decode())
@@ -385,10 +604,18 @@ class ServingRouter:
     def __init__(self, engines=None, max_queue_depth=1024,
                  poll_interval_s=1.0, health_fail_after=1,
                  default_deadline_ms=None, dispatch_timeout_s=600.0,
-                 router_id=None):
+                 router_id=None, wire=None):
         self.router_id = (str(router_id) if router_id is not None
                           else f"router-{os.getpid():x}-"
                                f"{next(_router_seq)}")
+        # wire=None follows MXNET_TPU_WIRE; False pins every remote
+        # seat to the HTTP/JSON path (the bench A/B and the fallback
+        # regression test need a JSON-only router on demand)
+        self._wire_flag = (bool(wire) if wire is not None
+                           else bool(envvars.get("MXNET_TPU_WIRE")))
+        # router-observed remote dispatch overhead (round trip minus
+        # engine-observed wall) by transport — THE wire-vs-JSON number
+        self.dispatch_overhead = DispatchOverhead()
         self._seats = OrderedDict()
         # cost ledgers of seats removed by remove_engine: the fleet
         # /costs books are cumulative, so a rolling-restart drill must
@@ -474,7 +701,10 @@ class ServingRouter:
         :class:`ServingEngine` handle, or the base URL string of a
         remote engine's ``expose()`` endpoint."""
         if isinstance(target, str):
-            seat = _RemoteSeat(engine_id or target, target)
+            seat = _RemoteSeat(engine_id or target, target,
+                               overhead=self.dispatch_overhead,
+                               wire_enabled=self._wire_flag,
+                               client_id=self.router_id)
         elif isinstance(target, ServingEngine) or hasattr(target, "submit"):
             seat = _LocalSeat(
                 engine_id if engine_id is not None
@@ -514,6 +744,10 @@ class ServingRouter:
         if table is not None:
             with self._lock:
                 self._retired_costs[engine_id] = table
+        # then drop its transport: closing the wire pool fails its
+        # in-flight dispatches with WireError → failover requeues them
+        # to siblings (the rolling-restart drill's zero-loss contract)
+        seat.close()
         _events.emit("router_engine_removed", router_id=self.router_id,
                      engine_id=engine_id, kind=seat.kind)
         return self
@@ -595,8 +829,13 @@ class ServingRouter:
             _recorder.remove_bundle_section("router_scoreboard")
         with self._lock:
             expo, self._expo = self._expo, None
+            seats = list(self._seats.values())
         if expo is not None:
             expo.close()
+        # transports are router-owned even though the engines aren't:
+        # drop the persistent wire pools and HTTP waiter pools
+        for seat in seats:
+            seat.close()
         if timed_out:
             raise ServingError("router did not drain in time")
 
@@ -898,6 +1137,15 @@ class ServingRouter:
                 .set(seat.queue_depth or 0)
             if seat.routable:
                 up_count += 1
+            try:
+                # wire upkeep rides the same poll cadence: blocking
+                # connect/handshake + in-flight timeout sweep happen
+                # HERE so the dispatch path never blocks on either
+                seat.maintain()
+            except Exception as e:
+                _events.emit("router_wire_maintain_error",
+                             router_id=self.router_id,
+                             engine_id=seat.engine_id, error=repr(e))
         self._g_fleet.set(up_count)
 
     def _fold_manifest(self, manifest):
@@ -996,7 +1244,8 @@ class ServingRouter:
                 "engines_up": sum(1 for r in board.values()
                                   if r["routable"]),
                 "engines_total": len(board),
-                "latency": {"total": self.total_ms.snapshot()}}
+                "latency": {"total": self.total_ms.snapshot()},
+                "dispatch_overhead": self.dispatch_overhead.snapshot()}
 
     # -- aggregated observability plane ------------------------------------
     def _remote_seats(self, engine_filter=None):
@@ -1097,6 +1346,43 @@ class ServingRouter:
             out["retired"] = retired
         return out
 
+    def _remote_submit(self, payload):
+        """``POST /submit`` handler (exposition-server thread): admit
+        + block for the result, JSON either way — the surface a
+        CLIENT-SIDE failover target (``serve_loadgen --router-url
+        r1,r2``) drives, mirroring the engine's own handler. Refusals
+        carry their class name in ``error_type``; a fleet-down shed
+        answers 503 so a dumb load balancer (or the loadgen's url
+        list) knows to try the next router."""
+        t0 = time.perf_counter()
+        try:
+            fut = self.submit(payload["tokens"],
+                              payload.get("token_types"),
+                              deadline_ms=payload.get("deadline_ms"))
+        except (ServingError, ValueError, KeyError, TypeError) as e:
+            name = type(e).__name__
+            status = {"NoEngineAvailableError": 503}.get(
+                name, _SUBMIT_ERROR_STATUS.get(name, 400))
+            return (status, {"ok": False, "error_type": name,
+                             "error": str(e),
+                             "router_id": self.router_id})
+        timeout_s = payload.get("timeout_s") or self._dispatch_timeout_s
+        try:
+            out = fut.result(timeout=float(timeout_s))
+        except Exception as e:
+            name = type(e).__name__
+            status = {"NoEngineAvailableError": 503}.get(
+                name, _SUBMIT_ERROR_STATUS.get(name, 500))
+            return (status, {"ok": False, "error_type": name,
+                             "error": str(e), "trace_id": fut.trace_id,
+                             "router_id": self.router_id})
+        return 200, {"ok": True, "result": np.asarray(out).tolist(),
+                     "trace_id": fut.trace_id,
+                     "router_id": self.router_id,
+                     "router_ms": round(
+                         (time.perf_counter() - t0) * 1e3, 3),
+                     "cost": getattr(fut, "cost", None)}
+
     def _healthz(self):
         board = self.scoreboard()
         up = sum(1 for r in board.values() if r["routable"])
@@ -1111,8 +1397,10 @@ class ServingRouter:
         """Start (or return) the router's exposition server: the
         AGGREGATED ``/metrics``, fleet ``/healthz`` (ok while ≥1
         engine is routable), ``/stats`` (scoreboard + counters), the
-        merged ``/traces`` + ``/traces/<id>``, and the fleet ``/costs``
-        cost table. Closed by :meth:`stop`."""
+        merged ``/traces`` + ``/traces/<id>``, the fleet ``/costs``
+        cost table, and ``POST /submit`` so clients (e.g.
+        ``serve_loadgen --router-url``) can drive this router from
+        another process. Closed by :meth:`stop`."""
         from ..telemetry.expo import TelemetryServer
 
         with self._lock:
@@ -1128,6 +1416,7 @@ class ServingRouter:
                                   trace_fn=self.get_trace,
                                   warmup_fn=self.warmup_manifest,
                                   costs_fn=self.cost_table,
+                                  submit_fn=self._remote_submit,
                                   port=port, host=host)
             self._expo = srv
         _events.emit("telemetry_expose", router_id=self.router_id,

@@ -518,9 +518,10 @@ def test_bench_serving_router_leg_smoke():
     r = subprocess.run([sys.executable, bench], env=env,
                        capture_output=True, text=True, timeout=560)
     assert r.returncode == 0, r.stderr[-2000:]
-    rec = json.loads([ln for ln in r.stdout.splitlines()
-                      if ln.startswith('{"metric"')][-1])
-    assert rec["metric"] == "bert_serving_router_requests_per_sec"
+    recs = {rec["metric"]: rec for rec in
+            (json.loads(ln) for ln in r.stdout.splitlines()
+             if ln.startswith('{"metric"'))}
+    rec = recs["bert_serving_router_requests_per_sec"]
     assert rec["value"] > 0
     assert rec["requests"] == 24
     assert rec["engines"] == 2 and rec["engines_up"] == 2
@@ -528,6 +529,16 @@ def test_bench_serving_router_leg_smoke():
     assert abs(sum(rec["per_engine"].values()) - 1.0) < 0.01
     assert rec["failover"] == 0
     assert rec["telemetry_reconciled"] is True
+    # the wire-vs-JSON A/B record from the same leg: binary framing
+    # must beat JSON on serialized bytes and dispatch overhead
+    wab = recs["bert_serving_router_wire_requests_per_sec"]
+    assert wab["value"] > 0 and wab["transport"] == "wire"
+    assert wab["wire"]["bytes_per_request"] \
+        < wab["json"]["bytes_per_request"]
+    assert wab["wire"]["dispatch_overhead_p50_ms"] \
+        < wab["json"]["dispatch_overhead_p50_ms"]
+    assert wab["bytes_per_request_ratio"] < 1.0
+    assert wab["wire"]["fallbacks"] == 0
 
 
 @pytest.mark.slow

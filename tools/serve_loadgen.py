@@ -15,10 +15,18 @@ the ROUTER: the report gains the per-engine request distribution, and
 the scrape cross-check reconciles the router's AGGREGATED ``/metrics``
 delta (router counter family + engine-labeled serving families summed
 across engines) against client-side accounting.
+
+``--router-url http://r1:8080,http://r2:8080`` drives ALREADY-RUNNING
+router endpoints instead of building anything locally, with
+CLIENT-SIDE FAILOVER: a router that refuses the connection or answers
+5xx sends the request to the next URL in the list (sticky — later
+requests start from the last router that answered), so a router
+restart mid-run costs retries, not failed requests.
 """
 from __future__ import annotations
 
 import json
+import threading
 import time
 
 
@@ -191,6 +199,169 @@ def cross_check_router(outcomes, attempts, delta):
     mismatches = [f"{name}: client={c} server={s}"
                   for name, (c, s) in checks.items() if c != s]
     return not mismatches, mismatches
+
+
+class RouterClient:
+    """Client-side target over one-or-more REMOTE ServingRouter
+    endpoints (``--router-url url1,url2``): the ``submit`` surface
+    ``run_load`` expects, spoken over each router's ``POST /submit``
+    long-poll, with client-side failover. A router that refuses the
+    connection or answers 5xx advances the request to the NEXT url;
+    the first router that answers becomes sticky-preferred so a
+    healthy fleet pays zero extra probes. Only when every router in
+    the list refuses does the request fail (as
+    ``NoEngineAvailableError`` — the client's shed column).
+    ``failovers`` counts the client-observed advances."""
+
+    class _Future:
+        """Lazy long-poll: the POST runs inside ``result()`` on the
+        calling client thread (closed-loop — exactly where the legacy
+        blocking wait lived)."""
+
+        def __init__(self, client, payload):
+            self._client = client
+            self._payload = payload
+            self.trace_id = None
+            self.cost = None
+
+        def result(self, timeout=None):
+            return self._client._request(self, timeout)
+
+    def __init__(self, urls, timeout_s=600.0):
+        urls = [u.strip().rstrip("/") for u in urls if u.strip()]
+        if not urls:
+            raise ValueError("no router URLs given")
+        self.urls = urls
+        self._timeout = float(timeout_s)
+        self._preferred = 0
+        self._lock = threading.Lock()
+        self.failovers = 0
+        self._last_board = {}
+
+    def _order(self):
+        with self._lock:
+            start = self._preferred
+        return [(start + i) % len(self.urls)
+                for i in range(len(self.urls))]
+
+    def submit(self, tokens, token_types=None, deadline_ms=None):
+        import numpy as np
+        payload = {"tokens": np.asarray(tokens).tolist(),
+                   "token_types": (np.asarray(token_types).tolist()
+                                   if token_types is not None else None),
+                   "deadline_ms": deadline_ms}
+        return self._Future(self, payload)
+
+    def _request(self, fut, timeout):
+        import urllib.error
+        import urllib.request
+
+        from mxnet_tpu.serving import NoEngineAvailableError, ServingError
+
+        data = json.dumps(fut._payload).encode()
+        last_err = None
+        last_body = None
+        for i in self._order():
+            try:
+                req = urllib.request.Request(
+                    self.urls[i] + "/submit", data=data,
+                    headers={"Content-Type": "application/json"})
+                resp = urllib.request.urlopen(
+                    req, timeout=timeout if timeout is not None
+                    else self._timeout)
+            except urllib.error.HTTPError as e:
+                try:
+                    body = json.loads(e.read().decode())
+                except Exception:
+                    body = None
+                if e.code >= 500 and e.code != 504:
+                    # the ROUTER is sick (stopped, whole fleet down,
+                    # proxy error) — the next url may front healthy
+                    # engines. 504 is the REQUEST's own deadline OR the
+                    # router's dispatch timeout on it: either way it is
+                    # request-scoped and must not be retried somewhere
+                    # else as new work.
+                    last_err = f"{self.urls[i]}: HTTP {e.code}"
+                    last_body = body
+                    with self._lock:
+                        self.failovers += 1
+                    continue
+                if body is None:
+                    raise ServingError(
+                        f"{self.urls[i]}: HTTP {e.code}") from e
+            except Exception as e:
+                # the long-poll reply comes as one blob, so urlopen
+                # returning means the router ANSWERED; timing out here
+                # means it accepted the request and is still executing
+                # it — replaying on the next url would duplicate work
+                # (and double-bill the cost books). Only failures that
+                # mean the request reached no live router (connect
+                # refused / reset / dns / connect-phase timeout, which
+                # urllib wraps in URLError) advance down the url list;
+                # a BARE socket.timeout is the read phase.
+                if isinstance(e, TimeoutError):
+                    raise ServingError(
+                        f"{self.urls[i]}: timed out mid-request "
+                        "(not failing over: the router may still be "
+                        "executing it)") from e
+                last_err = f"{self.urls[i]}: {e!r}"
+                with self._lock:
+                    self.failovers += 1
+                continue
+            else:
+                try:
+                    with resp:
+                        body = json.loads(resp.read().decode())
+                except Exception as e:
+                    # post-accept failure (truncated/garbled reply):
+                    # the router took the work — not retriable either
+                    raise ServingError(
+                        f"{self.urls[i]}: bad reply: {e!r}") from e
+            with self._lock:
+                self._preferred = i
+            return self._deliver(fut, body)
+        # every url refused: the last router-shaped error body (e.g.
+        # a single router answering "fleet down") still maps onto the
+        # serving taxonomy; with nothing parseable it's a client shed
+        if last_body is not None:
+            return self._deliver(fut, last_body)
+        raise NoEngineAvailableError(
+            f"every router url refused (last: {last_err})")
+
+    def _deliver(self, fut, body):
+        import numpy as np
+
+        from mxnet_tpu.serving import NoEngineAvailableError, ServingError
+        from mxnet_tpu.serving.router import _ERROR_CLASSES
+
+        fut.trace_id = body.get("trace_id")
+        if body.get("ok"):
+            fut.cost = body.get("cost")
+            return np.asarray(body["result"], np.float32)
+        cls = _ERROR_CLASSES.get(body.get("error_type"), ServingError)
+        if body.get("error_type") == "NoEngineAvailableError":
+            cls = NoEngineAvailableError
+        raise cls(body.get("error") or "router error")
+
+    # run_load's router-mode surface (scoreboard marks router-ness;
+    # snapshot feeds the report) — scraped off the preferred /stats
+    def snapshot(self):
+        import urllib.request
+        for i in self._order():
+            try:
+                with urllib.request.urlopen(
+                        self.urls[i] + "/stats", timeout=10.0) as r:
+                    snap = json.loads(r.read().decode())
+                with self._lock:
+                    self._last_board = snap.get("engines") or {}
+                return snap
+            except Exception:
+                continue
+        return {"engines": dict(self._last_board), "counters": {}}
+
+    def scoreboard(self):
+        snap = self.snapshot()
+        return snap.get("engines") or {}
 
 
 def _watch_restarts(router, stop_evt, restarts, poll_s=0.05):
@@ -540,6 +711,12 @@ def _main():
                     "and the cross-check reconciles the router's "
                     "aggregated /metrics delta against client-side "
                     "accounting")
+    ap.add_argument("--router-url", default=None, metavar="URL[,URL...]",
+                    help="drive ALREADY-RUNNING router endpoint(s) "
+                    "instead of building engines locally; a comma-"
+                    "separated list gets client-side failover (a "
+                    "router that refuses the connection or answers "
+                    "5xx advances the request to the next url)")
     args = ap.parse_args()
 
     import contextlib
@@ -565,15 +742,25 @@ def _main():
                              engine_id=engine_id)
 
     with contextlib.ExitStack() as stack:
-        if args.router > 0:
+        metrics_url = None
+        if args.router_url:
+            urls = args.router_url.split(",")
+            target = RouterClient(urls)
+            engines = []
+            # the scrape cross-check needs ONE set of books: with a
+            # single router its aggregated /metrics reconciles; with
+            # a failover list the traffic may split across routers'
+            # registries, so the delta would be an honest mismatch
+            if len(urls) == 1 and not args.no_expose:
+                metrics_url = urls[0].strip().rstrip("/") + "/metrics"
+        elif args.router > 0:
             engines = [stack.enter_context(make_engine(f"e{i}"))
                        for i in range(args.router)]
             target = stack.enter_context(ServingRouter(engines=engines))
         else:
             engines = [stack.enter_context(make_engine())]
             target = engines[0]
-        metrics_url = None
-        if not args.no_expose:
+        if not args.router_url and not args.no_expose:
             srv = target.expose(port=args.expose_port)
             metrics_url = srv.url("/metrics")
             print(f"# telemetry: {srv.url('/metrics')} "
@@ -586,6 +773,8 @@ def _main():
                           min_len=args.min_len, max_len=args.max_len,
                           vocab=args.vocab, deadline_ms=args.deadline_ms,
                           metrics_url=metrics_url)
+        if args.router_url:
+            report["client_failovers"] = target.failovers
     print(json.dumps(report, indent=2))
     if report.get("per_engine"):
         total = max(1, sum(report["per_engine"].values()))
@@ -622,7 +811,10 @@ def _main():
                  if per_1k is not None else "")
               + f" reconciled={cost['reconciled']}", file=sys.stderr)
     rc = 0
-    if not args.no_expose and not report["server"]["reconciled"]:
+    # a multi-URL --router-url list skips the scrape cross-check (no
+    # single set of books), so there may be no server section at all
+    if "server" in report and not args.no_expose \
+            and not report["server"]["reconciled"]:
         print("# WARNING: server/client accounting mismatch: "
               + "; ".join(report["server"]["mismatches"]),
               file=sys.stderr)
