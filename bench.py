@@ -1046,7 +1046,19 @@ def main_serving():
             telemetry_reconciled=server.get("reconciled"),
             cost_reconciled=cost.get("reconciled"),
             device_s_per_1k_tokens=cost.get("device_s_per_1k_tokens"),
+            slo_compliance=_slo_compliance(report),
             server_p50_ms_est=server.get("latency", {}).get("p50_ms_est"))
+
+
+def _slo_compliance(report):
+    """Error-budget remaining per declared objective off the loadgen's
+    ``/slo`` fetch — the serving legs' one-line SLO answer (None when
+    MXNET_TPU_SLO=0, or the engine predates the SLO engine)."""
+    slo = report.get("slo")
+    if not slo:
+        return None
+    return {name: row.get("error_budget_remaining")
+            for name, row in sorted(slo.items())}
 
 
 def _router_fleet_setup(clients_default, reqs_default):
@@ -1164,6 +1176,7 @@ def main_serving_router():
             cost_reconciled=report.get("cost", {}).get("reconciled"),
             device_s_per_1k_tokens=report.get("cost", {})
             .get("device_s_per_1k_tokens"),
+            slo_compliance=_slo_compliance(report),
             telemetry_reconciled=server.get("reconciled"),
             server_p50_ms_est=server.get("latency", {}).get("p50_ms_est"))
 
@@ -1368,6 +1381,7 @@ def main_serving_restart():
             restarts=restarts, failover=report["failovers"],
             lost=total - report["completed"],
             p50_ms=report["p50_ms"], p99_ms=report["p99_ms"],
+            slo_compliance=_slo_compliance(report),
             telemetry_reconciled=server.get("reconciled"))
 
 
@@ -1607,7 +1621,7 @@ _SUMMARY_KEYS = ("metric", "value", "unit", "mfu", "hbm_frac", "hbm_est",
                  "slowest_traces", "per_engine", "failover", "engines_up",
                  "ttft_cold_ms", "ttft_warm_ms", "lost", "resources",
                  "profile_top", "cost_reconciled",
-                 "device_s_per_1k_tokens")
+                 "device_s_per_1k_tokens", "slo_compliance")
 
 
 def _compact(rec):

@@ -304,6 +304,55 @@ register("MXNET_TPU_WATCHDOG_COMPILE_GRACE_S", "float", 300.0,
          "compiles must not trip flight-recorder bundles",
          scope="telemetry")
 
+# -- SLOs / alerting --------------------------------------------------------
+register("MXNET_TPU_SLO", "bool", True,
+         "in-process SLO engine: serving engines/routers register "
+         "their default objectives (latency quantile, availability, "
+         "cost budget, engine-up fraction) and the alert daemon "
+         "evaluates multi-window burn-rate / threshold / absence "
+         "rules against them; ``0`` disables evaluation, exemplar "
+         "recording and the ``/alerts``+``/slo`` endpoints",
+         scope="slo")
+register("MXNET_TPU_SLO_EVAL_S", "float", 5.0,
+         "alert-daemon evaluation period (seconds)", scope="slo")
+register("MXNET_TPU_SLO_WINDOW_SCALE", "float", 1.0,
+         "multiplier on every SLO window (burn-rate long/short "
+         "windows, pending durations, error-budget window) — drills "
+         "and tests shrink hours to seconds with one knob",
+         scope="slo")
+register("MXNET_TPU_SLO_BUDGET_S", "float", 2592000.0,
+         "error-budget accounting window in seconds (default 30 "
+         "days; clipped to process uptime)", scope="slo")
+register("MXNET_TPU_SLO_LATENCY_MS", "float", 1000.0,
+         "default serving latency objective: requests must complete "
+         "under this many milliseconds (snapped up to the nearest "
+         "histogram bucket boundary)", scope="slo")
+register("MXNET_TPU_SLO_LATENCY_TARGET", "float", 0.99,
+         "fraction of requests that must meet the latency objective "
+         "(the quantile, as a ratio target)", scope="slo")
+register("MXNET_TPU_SLO_AVAILABILITY_TARGET", "float", 0.999,
+         "availability objective: fraction of requests that must "
+         "complete (not shed, not errored, not expired)", scope="slo")
+register("MXNET_TPU_SLO_COST_S_PER_1K", "float", None,
+         "cost objective: device seconds per 1k valid tokens budget "
+         "(unset = cost objective off; set it from a measured "
+         "baseline)", scope="slo")
+register("MXNET_TPU_SLO_ENGINE_UP_FRACTION", "float", 0.5,
+         "router fleet objective: alert when fewer than this "
+         "fraction of registered engines is routable", scope="slo")
+register("MXNET_TPU_SLO_EXEMPLARS", "bool", True,
+         "record (latency bucket, trace_id) exemplar pairs on the "
+         "serving/router total-latency histograms, rendered "
+         "OpenMetrics-style in the text exposition and surfaced on "
+         "``/alerts``; ``0`` skips the per-request exemplar write",
+         scope="slo")
+register("MXNET_TPU_ALERT_RESOLVED_KEEP_S", "float", 300.0,
+         "how long a resolved alert stays listed on ``/alerts`` "
+         "before decaying to inactive", scope="slo")
+register("MXNET_TPU_ALERT_HISTORY", "int", 128,
+         "alert state-transition history ring size (served on "
+         "``/alerts``, carried into flight bundles)", scope="slo")
+
 # -- bench ------------------------------------------------------------------
 register("MXNET_TPU_PEAK_TFLOPS", "float", None,
          "override the per-chip peak dense bf16 TFLOP/s used for "
@@ -332,6 +381,7 @@ _SCOPE_TITLES = OrderedDict([
     ("dist", "Distributed"),
     ("wire", "Serving dispatch wire"),
     ("telemetry", "Telemetry / observability"),
+    ("slo", "SLOs & alerting"),
     ("bench", "Benchmarks"),
     ("tests", "Tests / dev harness"),
 ])

@@ -39,7 +39,8 @@ from ..telemetry import spans as _spans
 from ..telemetry.registry import REGISTRY as _REGISTRY
 from ..telemetry.trace import trace_context as _trace_context
 from .batcher import ContinuousBatcher
-from .metrics import CostLedger, ServingStats
+from .metrics import (CostLedger, ServingStats, exemplar_gate,
+                      slow_exemplar)
 from .queue import (DeadlineExceededError, EngineStoppedError, Request,
                     RequestQueue, RequestTooLongError, ServingError)
 
@@ -163,6 +164,13 @@ class ServingEngine:
         # flight — the watchdog widens its stall threshold over this
         # window so legitimate compiles never trip a flight bundle
         self._compiling_since = None
+        # SLO engine (MXNET_TPU_SLO): declarative objectives over this
+        # engine's metric families + the alert daemon judging them —
+        # built in start(), exposed at /slo + /alerts
+        self._slo = None
+        # exemplar gate, resolved once; the exemplar↔retrievable-trace
+        # contract lives in metrics.slow_exemplar (shared with router)
+        self._exemplars = exemplar_gate()
         self._worker = None
         self._expo = None
         self._wire = None           # binary dispatch listener (expose)
@@ -200,6 +208,20 @@ class ServingEngine:
         # ... and where its host time goes while alive: the always-on
         # sampling profiler + resource sweep (MXNET_TPU_PROF=0 opts out)
         _profiling.ensure_started()
+        # ... and judge its own health: the SLO engine declares the
+        # default serving objectives (latency quantile, availability,
+        # optional cost budget) and the alert daemon walks the SRE
+        # multi-window burn-rate rules over them (MXNET_TPU_SLO=0
+        # opts out of evaluation, exemplars and the endpoints)
+        if envvars.get("MXNET_TPU_SLO"):
+            from ..telemetry.alerts import (AlertDaemon, default_burn_rules,
+                                            default_serving_objectives)
+            from ..telemetry.slo import SloEvaluator
+            evaluator = SloEvaluator(self.engine_id)
+            names = default_serving_objectives(evaluator, self.engine_id)
+            self._slo = AlertDaemon(evaluator)
+            default_burn_rules(self._slo, names)
+            self._slo.start()
         _events.emit("engine_start", engine_id=self.engine_id,
                      bucket_lens=list(self._batcher.bucket_lens),
                      max_rows=self._batcher.max_rows)
@@ -212,6 +234,8 @@ class ServingEngine:
         _events.emit("engine_abort" if not drain else "engine_stop",
                      engine_id=self.engine_id, drain=drain)
         _recorder.unregister_probe(self._probe_name)
+        if self._slo is not None:
+            self._slo.stop()
         with self._lock:
             self._queue.close()
             if not drain:
@@ -377,7 +401,9 @@ class ServingEngine:
         open, seconds since the worker loop's last beat), ``/stats``
         serving this engine's ``snapshot()`` JSON, ``/costs`` (the
         per-bucket cost ledger), ``/profile`` (the process continuous
-        profiler's collapsed stacks), and ``POST
+        profiler's collapsed stacks), ``/slo`` + ``/alerts`` (the SLO
+        engine's objective table and alert-rule state, present unless
+        ``MXNET_TPU_SLO=0``), and ``POST
         /submit`` — the remote dispatch endpoint a
         :class:`~.router.ServingRouter` in another process drives
         (JSON request in, JSON result out, long-polled until the
@@ -421,6 +447,12 @@ class ServingEngine:
                                   submit_fn=self._remote_submit,
                                   warmup_fn=self.warmup_manifest,
                                   costs_fn=self.cost_table,
+                                  slo_fn=(self.slo_snapshot
+                                          if self._slo is not None
+                                          else None),
+                                  alerts_fn=(self.alerts_snapshot
+                                             if self._slo is not None
+                                             else None),
                                   port=port, host=host)
             self._expo = srv
             # the binary dispatch listener rides along with the HTTP
@@ -459,6 +491,32 @@ class ServingEngine:
         out["compiling"] = self._compiling_since is not None
         out["costs"] = self.costs.totals()
         return out
+
+    @property
+    def alerts(self):
+        """The engine's :class:`~mxnet_tpu.telemetry.alerts.
+        AlertDaemon` (None when ``MXNET_TPU_SLO=0`` or before
+        ``start``) — tests and drills drive ``evaluate_once`` /
+        declare extra rules through it."""
+        return self._slo
+
+    def slo_snapshot(self):
+        """The ``/slo`` body: per declared objective the SLI (or
+        windowed value), burn rates over the canonical windows, and
+        error budget remaining over the budget window."""
+        if self._slo is None:
+            return {"owner": self.engine_id, "enabled": False,
+                    "objectives": {}}
+        return self._slo.evaluator.snapshot()
+
+    def alerts_snapshot(self):
+        """The ``/alerts`` body: every rule's state-machine position,
+        evidence (burn history, latency exemplars) and the recent
+        transition log."""
+        if self._slo is None:
+            return {"owner": self.engine_id, "enabled": False,
+                    "rules": []}
+        return self._slo.snapshot()
 
     def cost_table(self):
         """The ``/costs`` body: this engine's per-bucket cost ledger
@@ -737,7 +795,12 @@ class ServingEngine:
                 continue
             req.t_done = now
             self.stats.queue_ms.observe((req.t_drain - req.t_submit) * 1e3)
-            self.stats.total_ms.observe((now - req.t_submit) * 1e3)
+            total_ms = (now - req.t_submit) * 1e3
+            # OpenMetrics exemplar: links a firing latency alert
+            # straight to a RETRIEVABLE trace at /traces/<id>
+            self.stats.total_ms.observe(
+                total_ms, exemplar=slow_exemplar(
+                    req.trace_id, total_ms, self._exemplars))
             self.stats.bump("completed")
             if record_spans:
                 _spans.record_span("serving/complete", req.trace_id,

@@ -31,13 +31,36 @@ from __future__ import annotations
 import threading
 from collections import deque
 
+from .. import envvars
+from ..telemetry import spans as _spans
 from ..telemetry.registry import REGISTRY
 
 __all__ = ["LatencySummary", "ServingStats", "CostLedger",
            "DispatchOverhead", "nearest_rank", "merge_cost_buckets",
+           "exemplar_gate", "slow_exemplar",
            "wire_frames_counter", "wire_bytes_counter",
            "wire_connections_gauge", "wire_refusals_counter",
            "wire_fallback_counter"]
+
+
+def exemplar_gate():
+    """Resolve the latency-exemplar recording gate once per owner
+    (engine/router construction): exemplars only make sense when the
+    SLO engine runs AND spans are enabled — an exemplar whose trace
+    tail sampling can never keep would be a dead link."""
+    return bool(envvars.get("MXNET_TPU_SLO")
+                and envvars.get("MXNET_TPU_SLO_EXEMPLARS")
+                and _spans.enabled())
+
+
+def slow_exemplar(trace_id, total_ms, gated):
+    """The exemplar to attach to a total-latency observation: the
+    request's trace id when the gate is open and the request is slow
+    enough that tail sampling KEEPS its trace (same threshold), else
+    None. The one place the exemplar↔retrievable-trace contract
+    lives — engine and router both call it."""
+    return (trace_id if gated and total_ms >= _spans.RECORDER.slow_ms
+            else None)
 
 # batch-size histogram boundaries (requests per dispatched batch)
 _BATCH_REQ_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0)
@@ -155,6 +178,9 @@ class LatencySummary:
 
     ``hist`` (optional) is a telemetry histogram child co-observed on
     every sample, so the same numbers are scrapeable at /metrics.
+    ``exemplar`` (a trace id) rides through to the histogram as an
+    OpenMetrics exemplar — the machine link from a latency bucket back
+    to a retrievable trace at ``/traces/<id>``.
     """
 
     def __init__(self, capacity=4096, hist=None):
@@ -165,7 +191,7 @@ class LatencySummary:
         self._max = 0.0
         self._hist = hist
 
-    def observe(self, ms):
+    def observe(self, ms, exemplar=None):
         with self._lock:
             self._window.append(float(ms))
             self._count += 1
@@ -173,7 +199,7 @@ class LatencySummary:
             if ms > self._max:
                 self._max = ms
         if self._hist is not None:
-            self._hist.observe(ms)
+            self._hist.observe(ms, exemplar=exemplar)
 
     @property
     def count(self):

@@ -66,6 +66,19 @@ The observability plane is the point:
    their engine-computed amortized ``future.cost`` through the router
    untouched.
 
+6. **Fleet objectives** — the router runs its own SLO engine
+   (:mod:`mxnet_tpu.telemetry.slo` / :mod:`~mxnet_tpu.telemetry.
+   alerts`, gate ``MXNET_TPU_SLO``): availability ACROSS failover
+   (router outcome counters — a failed-over request that completed on
+   a sibling burns no budget), the fleet latency quantile over the
+   router-observed end-to-end histogram (with trace-id exemplars on
+   slow requests), and the routable-engine fraction off the
+   scoreboard. ``/slo`` and ``/alerts`` serve the fleet view: the
+   router's own objectives plus every seat's seat-level snapshot
+   (local handles read directly, remote seats are scraped), so one
+   endpoint answers both "is the fleet healthy" and "which engine is
+   burning its budget".
+
 Failover: a dispatch that dies of an ENGINE-SHAPED failure (engine
 stopped, queue full, remote transport error) re-queues the request at
 the front of the line for a sibling — requests are only lost to
@@ -96,9 +109,9 @@ from ..telemetry import spans as _spans
 from ..telemetry.registry import REGISTRY as _REGISTRY
 from ..telemetry.trace import new_trace_id
 from .engine import _SUBMIT_ERROR_STATUS, ServingEngine
-from .metrics import (DispatchOverhead, LatencySummary,
-                      merge_cost_buckets, wire_bytes_counter,
-                      wire_fallback_counter)
+from .metrics import (DispatchOverhead, LatencySummary, exemplar_gate,
+                      merge_cost_buckets, slow_exemplar,
+                      wire_bytes_counter, wire_fallback_counter)
 from .queue import (DeadlineExceededError, EngineStoppedError,
                     InferenceFuture, QueueFullError, ServingError,
                     validate_tokens)
@@ -273,6 +286,14 @@ class _Seat:
     def warmup_manifest(self):
         return None
 
+    def slo_snapshot(self):
+        """This seat's /slo body (None when the engine has no SLO
+        evaluator — MXNET_TPU_SLO=0, or an old peer)."""
+        return None
+
+    def alerts_snapshot(self):
+        return None
+
     def maintain(self):
         """Poll-thread housekeeping (wire connection upkeep)."""
 
@@ -315,6 +336,22 @@ class _LocalSeat(_Seat):
     def cost_table(self):
         try:
             return self._engine.cost_table()
+        except Exception:
+            return None
+
+    def slo_snapshot(self):
+        try:
+            if self._engine.alerts is None:
+                return None
+            return self._engine.slo_snapshot()
+        except Exception:
+            return None
+
+    def alerts_snapshot(self):
+        try:
+            if self._engine.alerts is None:
+                return None
+            return self._engine.alerts_snapshot()
         except Exception:
             return None
 
@@ -576,6 +613,22 @@ class _RemoteSeat(_Seat):
             return self._last_costs
         return self._last_costs
 
+    def slo_snapshot(self):
+        # a 404 body ({"error": "no SLO evaluator"}) parses but is not
+        # a snapshot: only objective-bearing replies count
+        try:
+            snap = json.loads(self._get("/slo"))
+        except Exception:
+            return None
+        return snap if "objectives" in snap else None
+
+    def alerts_snapshot(self):
+        try:
+            snap = json.loads(self._get("/alerts"))
+        except Exception:
+            return None
+        return snap if "rules" in snap else None
+
 
 class ServingRouter:
     """Least-outstanding front door over N serving engines.
@@ -638,6 +691,11 @@ class ServingRouter:
         self._stop_evt = threading.Event()
         self._expo = None
         self._probe_name = f"serving_router_{id(self):x}"
+        # fleet SLO engine (MXNET_TPU_SLO): built in start(), serves
+        # /slo + /alerts; exemplar gate shared with the engine via
+        # metrics.exemplar_gate/slow_exemplar
+        self._slo = None
+        self._exemplars = exemplar_gate()
         self._pick_seq = itertools.count(1)
         # trace -> engines that served it (bounded): lets the merged
         # /traces summary attribute LOCAL-engine traces too (remote
@@ -780,6 +838,18 @@ class ServingRouter:
         _recorder.register_probe(self._probe_name, self._watchdog_probe)
         _recorder.add_bundle_section("router_scoreboard", self.snapshot)
         _profiling.ensure_started()
+        # fleet objectives: availability across failover, fleet
+        # latency quantile, routable-engine fraction — judged by the
+        # same burn-rate machinery every engine runs on itself
+        if envvars.get("MXNET_TPU_SLO"):
+            from ..telemetry.alerts import (AlertDaemon, default_burn_rules,
+                                            default_router_objectives)
+            from ..telemetry.slo import SloEvaluator
+            evaluator = SloEvaluator(self.router_id)
+            names = default_router_objectives(evaluator, self)
+            self._slo = AlertDaemon(evaluator)
+            default_burn_rules(self._slo, names)
+            self._slo.start()
         self._poll_once()           # scoreboard fresh before traffic
         self._dispatcher.start()
         self._poller.start()
@@ -827,6 +897,8 @@ class ServingRouter:
         if not already:
             _recorder.unregister_probe(self._probe_name)
             _recorder.remove_bundle_section("router_scoreboard")
+            if self._slo is not None:
+                self._slo.stop()
         with self._lock:
             expo, self._expo = self._expo, None
             seats = list(self._seats.values())
@@ -977,7 +1049,12 @@ class ServingRouter:
             seat.outstanding = max(0, seat.outstanding - 1)
         if exc is None:
             self._bump("completed")
-            self.total_ms.observe((time.monotonic() - req.t_submit) * 1e3)
+            total_ms = (time.monotonic() - req.t_submit) * 1e3
+            # exemplar on the fleet latency histogram: links a firing
+            # fleet_latency alert to a retrievable cross-engine trace
+            self.total_ms.observe(
+                total_ms, exemplar=slow_exemplar(
+                    req.trace_id, total_ms, self._exemplars))
             req.span.set_attr(engine=req.engine_id,
                               requeues=req.requeues).end()
             if cost is not None:
@@ -1346,6 +1423,66 @@ class ServingRouter:
             out["retired"] = retired
         return out
 
+    @property
+    def alerts(self):
+        """The router's fleet :class:`~mxnet_tpu.telemetry.alerts.
+        AlertDaemon` (None when ``MXNET_TPU_SLO=0`` or before
+        ``start``) — drills drive ``evaluate_once`` / add rules
+        through it."""
+        return self._slo
+
+    def slo_snapshot(self):
+        """The fleet ``/slo`` body: the router's own objectives
+        (availability across failover, fleet latency, engines-up
+        fraction) plus every seat's seat-level SLO snapshot under
+        ``engines`` (local handles read directly, remote seats
+        scraped; seats without an evaluator are listed in
+        ``missing``)."""
+        if self._slo is None:
+            out = {"owner": self.router_id, "enabled": False,
+                   "objectives": {}}
+        else:
+            out = self._slo.evaluator.snapshot()
+        with self._lock:
+            seats = list(self._seats.values())
+        engines, missing = {}, []
+        for seat in seats:
+            snap = seat.slo_snapshot()
+            if snap is None:
+                missing.append(seat.engine_id)
+            else:
+                engines[seat.engine_id] = snap
+        out["engines"] = engines
+        if missing:
+            out["missing"] = missing
+        return out
+
+    def alerts_snapshot(self):
+        """The fleet ``/alerts`` body: the router's own rule table
+        plus every seat's, with fleet-wide firing/pending totals on
+        top — one endpoint answers "what is burning, and WHERE"."""
+        if self._slo is None:
+            out = {"owner": self.router_id, "enabled": False,
+                   "rules": [], "firing": 0, "pending": 0}
+        else:
+            out = self._slo.snapshot()
+        with self._lock:
+            seats = list(self._seats.values())
+        engines = {}
+        firing = out.get("firing", 0)
+        pending = out.get("pending", 0)
+        for seat in seats:
+            snap = seat.alerts_snapshot()
+            if snap is None:
+                continue
+            engines[seat.engine_id] = snap
+            firing += snap.get("firing", 0)
+            pending += snap.get("pending", 0)
+        out["engines"] = engines
+        out["fleet_firing"] = firing
+        out["fleet_pending"] = pending
+        return out
+
     def _remote_submit(self, payload):
         """``POST /submit`` handler (exposition-server thread): admit
         + block for the result, JSON either way — the surface a
@@ -1398,9 +1535,10 @@ class ServingRouter:
         AGGREGATED ``/metrics``, fleet ``/healthz`` (ok while ≥1
         engine is routable), ``/stats`` (scoreboard + counters), the
         merged ``/traces`` + ``/traces/<id>``, the fleet ``/costs``
-        cost table, and ``POST /submit`` so clients (e.g.
-        ``serve_loadgen --router-url``) can drive this router from
-        another process. Closed by :meth:`stop`."""
+        cost table, ``/slo`` + ``/alerts`` (fleet objectives + every
+        seat's seat-level view), and ``POST /submit`` so clients
+        (e.g. ``serve_loadgen --router-url``) can drive this router
+        from another process. Closed by :meth:`stop`."""
         from ..telemetry.expo import TelemetryServer
 
         with self._lock:
@@ -1417,6 +1555,8 @@ class ServingRouter:
                                   warmup_fn=self.warmup_manifest,
                                   costs_fn=self.cost_table,
                                   submit_fn=self._remote_submit,
+                                  slo_fn=self.slo_snapshot,
+                                  alerts_fn=self.alerts_snapshot,
                                   port=port, host=host)
             self._expo = srv
         _events.emit("telemetry_expose", router_id=self.router_id,

@@ -30,7 +30,14 @@ the process starting here). Four pieces:
   bounded collapsed-stack counts at ``MXNET_TPU_PROF_HZ``, served at
   ``/profile`` and dumped as ``profile.txt`` in flight bundles;
 - :mod:`.resources` — host RSS/fd/thread + device-memory gauges and
-  process-lifetime watermarks, swept by the profiler daemon.
+  process-lifetime watermarks, swept by the profiler daemon;
+- :mod:`.slo` + :mod:`.alerts` — the judging layer: a declarative SLO
+  registry (latency quantiles, availability, cost budgets, gauge
+  bounds) evaluated by an in-process alert daemon — SRE-workbook
+  multi-window multi-burn-rate rules, threshold and absence rules,
+  pending→firing→resolved state machine, ``/slo`` + ``/alerts``
+  endpoints, and OpenMetrics histogram exemplars linking a firing
+  latency alert to retrievable traces at ``/traces/<id>``.
 
 Quickstart::
 
@@ -46,9 +53,10 @@ Quickstart::
     with telemetry.span("my/stage", shard=3):   # nested spans
         ...
 """
-from . import events, expo, profiling, recorder, resources, spans, trace
+from . import (alerts, events, expo, profiling, recorder, resources,
+               slo, spans, trace)
 from .events import EventLog
-from .expo import (TelemetryServer, histogram_quantile,
+from .expo import (TelemetryServer, histogram_quantile, parse_exemplar,
                    parse_prometheus_text, start_server)
 from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
                        REGISTRY, DEFAULT_MS_BUCKETS)
@@ -60,9 +68,10 @@ from .trace import (current_trace_id, new_trace_id, set_trace_id,
 
 __all__ = ["REGISTRY", "MetricsRegistry", "Counter", "Gauge", "Histogram",
            "DEFAULT_MS_BUCKETS", "TelemetryServer", "start_server",
-           "parse_prometheus_text", "histogram_quantile", "EventLog",
+           "parse_prometheus_text", "parse_exemplar",
+           "histogram_quantile", "EventLog",
            "events", "expo", "trace", "spans", "recorder", "profiling",
-           "resources",
+           "resources", "slo", "alerts",
            "new_trace_id", "current_trace_id", "set_trace_id",
            "trace_context", "Span", "span", "start_span", "record_span",
            "use_span", "current_span", "current_span_id",

@@ -1,0 +1,605 @@
+"""Alert rules over declared SLOs: the judging half of the SLO engine.
+
+:mod:`.slo` computes (SLIs, burn rates, budgets); this module decides
+and escalates. An :class:`AlertDaemon` owns one
+:class:`~.slo.SloEvaluator` and a set of declared rules, ticks them on
+a background thread every ``MXNET_TPU_SLO_EVAL_S`` seconds, and walks
+each rule through the classic state machine::
+
+    inactive → pending (condition true, waiting out ``for_s``)
+             → firing  (condition held)
+             → resolved (condition cleared; listed for
+                         ``MXNET_TPU_ALERT_RESOLVED_KEEP_S``, then
+                         back to inactive)
+
+Rule kinds:
+
+- :class:`BurnRateRule` — the SRE-workbook multi-window multi-burn-rate
+  shape: fire only when the error budget burns faster than ``factor``×
+  sustainable over BOTH a long window (enough evidence) and a short
+  window (still happening right now). The default pairs are the
+  workbook's: **page** = 1h long / 5m short at 14.4× (2% of a 30-day
+  budget in one hour), **ticket** = 6h long / 30m short at 6× (5% in
+  six hours).
+- :class:`ThresholdRule` — a threshold objective (cost budget, gauge
+  bound) violated over a window: its ``burn_rate`` (violation
+  multiple) exceeds ``factor`` (default 1.0 = at the bound).
+- :class:`AbsenceRule` — a metric family (or labeled slice) that
+  stopped moving: no increase over the window, or the family was
+  never created at all. Heartbeats and scrape targets alert this way.
+
+Every transition emits an ``alert_state`` run event and bumps
+``mxnet_tpu_alerts_transitions_total{alert,to}``;
+``mxnet_tpu_alerts_state{alert,severity}`` tracks the live position
+(0 inactive/resolved, 1 pending, 2 firing) and
+``mxnet_tpu_alerts_firing{owner,severity}`` counts what's burning. A rule
+entering **firing** at ``page`` severity dumps a flight-recorder
+bundle whose meta carries the alert payload — burn-rate history and,
+for latency objectives, the OpenMetrics exemplars whose trace ids
+resolve at ``/traces/<id>``. The daemon also registers an
+``alerts_<owner>`` bundle section, so a watchdog- or crash-triggered
+bundle explains the alert state too (and the recorder's shared-window
+dedupe means a watchdog trip and a page firing together produce ONE
+bundle tagged with both causes).
+
+``/alerts`` on the owner's exposition server serves
+:meth:`AlertDaemon.snapshot`.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict, deque
+
+from .. import envvars
+from . import events as _events
+from . import recorder as _recorder
+from .registry import REGISTRY
+from .slo import LatencySLO, ThresholdSLO, _match_labels
+
+__all__ = ["AlertRule", "BurnRateRule", "ThresholdRule", "AbsenceRule",
+           "AlertDaemon", "default_serving_objectives",
+           "default_router_objectives", "default_burn_rules",
+           "PAGE", "TICKET"]
+
+PAGE = "page"
+TICKET = "ticket"
+
+#: SRE-workbook multi-window pairs: (long, short, factor, for_s)
+_PAGE_WINDOWS = ("1h", "5m", 14.4, 60.0)
+_TICKET_WINDOWS = ("6h", "30m", 6.0, 300.0)
+
+_STATE_VALUE = {"inactive": 0, "resolved": 0, "pending": 1, "firing": 2}
+
+
+class AlertRule:
+    """One declared rule: a name, a severity, and a condition over an
+    evaluator. ``for_s`` is the pending dwell (scaled by the
+    evaluator's window scale, like every other SLO duration) before a
+    true condition escalates to firing."""
+
+    kind = "rule"
+
+    def __init__(self, name, severity=TICKET, for_s=0.0):
+        if severity not in (PAGE, TICKET):
+            raise ValueError(f"severity must be page/ticket, "
+                             f"got {severity!r}")
+        self.name = str(name)
+        self.severity = severity
+        self.for_s = float(for_s)
+
+    def sample(self, evaluator, now):
+        """Per-tick raw sampling hook (absence rules record their
+        series here); default rules read what the evaluator sampled."""
+
+    def condition(self, evaluator, now):
+        """``(active, detail)`` — active None means "not enough data"
+        (treated as not active: an idle or freshly started process
+        must not page on ignorance)."""
+        raise NotImplementedError
+
+    def slo_name(self):
+        return None
+
+    def describe(self):
+        return {"alert": self.name, "kind": self.kind,
+                "severity": self.severity, "for_s": self.for_s}
+
+
+class BurnRateRule(AlertRule):
+    """Multi-window multi-burn-rate over one declared SLO: fires when
+    the error budget burns faster than ``factor``× sustainable over
+    BOTH windows. Windows are labels into the evaluator's canonical
+    set (``"5m"``/``"30m"``/``"1h"``/``"6h"``) or raw seconds."""
+
+    kind = "burn_rate"
+
+    def __init__(self, name, slo, long_window="1h", short_window="5m",
+                 factor=14.4, severity=PAGE, for_s=60.0):
+        super().__init__(name, severity, for_s)
+        self.slo = str(slo)
+        self.long_window = long_window
+        self.short_window = short_window
+        self.factor = float(factor)
+
+    def slo_name(self):
+        return self.slo
+
+    def condition(self, evaluator, now):
+        slo = evaluator.get(self.slo)
+        if slo is None:
+            return None, {"error": f"unknown SLO {self.slo!r}"}
+        long_s = evaluator.window_s(self.long_window)
+        short_s = evaluator.window_s(self.short_window)
+        b_long = slo.burn_rate(evaluator.store, long_s, now)
+        b_short = slo.burn_rate(evaluator.store, short_s, now)
+        detail = {"burn_long": (round(b_long, 4)
+                                if b_long is not None else None),
+                  "burn_short": (round(b_short, 4)
+                                 if b_short is not None else None),
+                  "factor": self.factor,
+                  "long_window": self.long_window,
+                  "short_window": self.short_window}
+        if b_long is None or b_short is None:
+            return None, detail
+        return (b_long > self.factor and b_short > self.factor), detail
+
+    def describe(self):
+        return dict(super().describe(), slo=self.slo,
+                    long_window=str(self.long_window),
+                    short_window=str(self.short_window),
+                    factor=self.factor)
+
+
+class ThresholdRule(AlertRule):
+    """A threshold objective violated over a window: the SLO's
+    violation multiple (``burn_rate``: value/bound, or bound/value for
+    lower-is-bad) exceeds ``factor``."""
+
+    kind = "threshold"
+
+    def __init__(self, name, slo, window="30m", factor=1.0,
+                 severity=TICKET, for_s=300.0):
+        super().__init__(name, severity, for_s)
+        self.slo = str(slo)
+        self.window = window
+        self.factor = float(factor)
+
+    def slo_name(self):
+        return self.slo
+
+    def condition(self, evaluator, now):
+        slo = evaluator.get(self.slo)
+        if slo is None:
+            return None, {"error": f"unknown SLO {self.slo!r}"}
+        w = evaluator.window_s(self.window)
+        burn = slo.burn_rate(evaluator.store, w, now)
+        value = (slo.value(evaluator.store, w, now)
+                 if isinstance(slo, ThresholdSLO) else None)
+        detail = {"burn": round(burn, 4) if burn is not None else None,
+                  "value": (round(value, 6) if value is not None
+                            else None),
+                  "bound": slo.target, "factor": self.factor,
+                  "window": str(self.window)}
+        if burn is None:
+            return None, detail
+        return burn > self.factor, detail
+
+    def describe(self):
+        return dict(super().describe(), slo=self.slo,
+                    window=str(self.window), factor=self.factor)
+
+
+class AbsenceRule(AlertRule):
+    """A cumulative family (or labeled slice of one) that stopped
+    moving — no increase over the window — or that was never created
+    at all. The daemon samples the matched sum every tick into the
+    evaluator's store under a private key, so the delta math is the
+    same partial-coverage-honest machinery the SLOs use."""
+
+    kind = "absence"
+
+    def __init__(self, name, family, window="5m", match=None,
+                 severity=TICKET, for_s=0.0, registry=None):
+        super().__init__(name, severity, for_s)
+        self.family = str(family)
+        self.window = window
+        self.match = dict(match or {})
+        self.registry = registry if registry is not None else REGISTRY
+
+    def _key(self):
+        return f"__absence__:{self.name}"
+
+    def sample(self, evaluator, now):
+        fam = self.registry.get(self.family)
+        if fam is None:
+            return
+        total = 0.0
+        for values, child in fam._sorted_children():
+            if not _match_labels(fam.labelnames, values, self.match):
+                continue
+            total += (child.count if hasattr(child, "cumulative")
+                      else child.value)
+        evaluator.store.record(self._key(), now, total)
+
+    def condition(self, evaluator, now):
+        fam = self.registry.get(self.family)
+        detail = {"family": self.family, "match": self.match,
+                  "window": str(self.window)}
+        if fam is None:
+            # never created: absent by definition (a renamed family
+            # upstream fails mxlint, but a dead subsystem lands here)
+            return True, dict(detail, absent="family")
+        d = evaluator.store.delta(
+            self._key(), evaluator.window_s(self.window), now)
+        if d is None:
+            return None, detail
+        detail["delta"] = round(d[0], 6)
+        return d[0] <= 0, detail
+
+    def describe(self):
+        return dict(super().describe(), family=self.family,
+                    match=self.match, window=str(self.window))
+
+
+class _AlertStatus:
+    """Runtime position of one rule in the state machine."""
+
+    __slots__ = ("rule", "state", "since_mono", "since_wall",
+                 "fired_at", "resolved_at", "detail", "history")
+
+    def __init__(self, rule, history):
+        self.rule = rule
+        self.state = "inactive"
+        self.since_mono = time.monotonic()
+        self.since_wall = time.time()
+        self.fired_at = None
+        self.resolved_at = None
+        self.detail = {}
+        self.history = deque(maxlen=history)   # (wall_ts, detail)
+
+
+class AlertDaemon:
+    """Background evaluation loop: tick the evaluator, step every
+    rule's state machine, publish gauges/events, escalate pages.
+
+    ``on_page`` overrides the page escalation (default: a
+    flight-recorder bundle via :func:`~.recorder.dump` whose meta
+    carries the alert payload). The daemon can also be driven manually
+    with :meth:`evaluate_once` (tests, or an owner that already has a
+    poll loop).
+    """
+
+    def __init__(self, evaluator, eval_s=None, resolved_keep_s=None,
+                 history=None, registry=None, on_page=None):
+        self.evaluator = evaluator
+        self.owner_id = evaluator.owner_id
+        reg = registry if registry is not None else REGISTRY
+        self.eval_s = (float(eval_s) if eval_s is not None
+                       else envvars.get("MXNET_TPU_SLO_EVAL_S"))
+        scale = evaluator.scale
+        self.resolved_keep_s = (
+            float(resolved_keep_s) if resolved_keep_s is not None
+            else envvars.get("MXNET_TPU_ALERT_RESOLVED_KEEP_S") * scale)
+        self._history_len = (int(history) if history is not None
+                             else envvars.get("MXNET_TPU_ALERT_HISTORY"))
+        self._on_page = on_page
+        self._rules = OrderedDict()     # name -> _AlertStatus
+        self._transitions = deque(maxlen=self._history_len)
+        self._lock = threading.Lock()
+        self._thread = None
+        self._stop = threading.Event()
+        self._section = f"alerts_{self.owner_id}"
+        self._g_state = reg.gauge(
+            "mxnet_tpu_alerts_state",
+            "alert state-machine position (0 inactive/resolved, "
+            "1 pending, 2 firing)", ("alert", "severity"))
+        # owner-labeled: a router and its engines run N+1 daemons in
+        # ONE process registry — absolute sets on a severity-only
+        # family would clobber each other (sum by severity in PromQL)
+        self._g_firing = reg.gauge(
+            "mxnet_tpu_alerts_firing",
+            "alerts currently firing, by owner and severity",
+            ("owner", "severity"))
+        self._c_transitions = reg.counter(
+            "mxnet_tpu_alerts_transitions_total",
+            "alert state transitions, by alert and destination state",
+            ("alert", "to"))
+
+    # -- rule set ----------------------------------------------------------
+    def add_rule(self, rule):
+        with self._lock:
+            if rule.name in self._rules:
+                raise ValueError(f"alert {rule.name!r} already declared")
+            self._rules[rule.name] = _AlertStatus(rule,
+                                                  self._history_len)
+        self._g_state.labels(alert=self._label(rule),
+                             severity=rule.severity).set(0)
+        return rule
+
+    def _label(self, rule):
+        return f"{self.owner_id}:{rule.name}"
+
+    def get(self, name):
+        with self._lock:
+            st = self._rules.get(name)
+            return st.rule if st is not None else None
+
+    def state(self, name):
+        with self._lock:
+            st = self._rules.get(name)
+            return st.state if st is not None else None
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        with self._lock:
+            if self._thread is not None:
+                return self
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, daemon=True,
+                name=f"mxnet_tpu_alerts_{self.owner_id}")
+            self._thread.start()
+        # every flight bundle from this process now explains the alert
+        # state too (watchdog trips and page firings share bundles via
+        # the recorder's dedupe window)
+        _recorder.add_bundle_section(self._section, self.snapshot)
+        return self
+
+    def stop(self):
+        with self._lock:
+            self._stop.set()
+            t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5.0)
+        _recorder.remove_bundle_section(self._section)
+
+    def _run(self):
+        while not self._stop.wait(self.eval_s):
+            try:
+                self.evaluate_once()
+            except Exception as e:
+                # one broken evaluation must not kill alerting
+                _events.emit("alert_eval_error", owner=self.owner_id,
+                             error=repr(e))
+
+    # -- evaluation --------------------------------------------------------
+    def evaluate_once(self, now=None):
+        """One tick: sample, evaluate, step state machines. Returns
+        ``{alert: state}``."""
+        now = self.evaluator.tick(now)
+        with self._lock:
+            statuses = list(self._rules.values())
+        wall = time.time()
+        firing = {PAGE: 0, TICKET: 0}
+        out = {}
+        for st in statuses:
+            st.rule.sample(self.evaluator, now)
+            active, detail = st.rule.condition(self.evaluator, now)
+            # under the lock: a concurrent /alerts scrape (or bundle
+            # write) iterates the history deque
+            with self._lock:
+                st.detail = detail
+                st.history.append((round(wall, 3), detail))
+            self._step(st, bool(active) if active is not None else False,
+                       now)
+            if st.state == "firing":
+                firing[st.rule.severity] += 1
+            out[st.rule.name] = st.state
+        for sev, n in firing.items():
+            self._g_firing.labels(owner=self.owner_id,
+                                  severity=sev).set(n)
+        return out
+
+    def _step(self, st, active, now):
+        rule = st.rule
+        for_s = rule.for_s * self.evaluator.scale
+        new = st.state
+        if st.state in ("inactive", "resolved"):
+            if active:
+                new = "pending" if for_s > 0 else "firing"
+            elif (st.state == "resolved"
+                    and now - st.since_mono > self.resolved_keep_s):
+                new = "inactive"
+        elif st.state == "pending":
+            if not active:
+                new = "inactive"
+            elif now - st.since_mono >= for_s:
+                new = "firing"
+        elif st.state == "firing":
+            if not active:
+                new = "resolved"
+        if new == st.state:
+            return
+        prev, st.state = st.state, new
+        st.since_mono = now
+        st.since_wall = time.time()
+        if new == "firing":
+            st.fired_at = st.since_wall
+        if new == "resolved":
+            st.resolved_at = st.since_wall
+        self._g_state.labels(alert=self._label(rule),
+                             severity=rule.severity) \
+            .set(_STATE_VALUE[new])
+        self._c_transitions.labels(alert=self._label(rule),
+                                   to=new).inc()
+        rec = {"alert": rule.name, "owner": self.owner_id,
+               "severity": rule.severity, "from": prev, "to": new,
+               "ts": round(st.since_wall, 3), "detail": st.detail}
+        with self._lock:
+            self._transitions.append(rec)
+        _events.emit("alert_state", **rec)
+        if new == "firing" and rule.severity == PAGE:
+            self._page(st)
+
+    def _page(self, st):
+        payload = self._alert_payload(st)
+        if self._on_page is not None:
+            try:
+                self._on_page(payload)
+            except Exception as e:
+                _events.emit("alert_page_error", owner=self.owner_id,
+                             alert=st.rule.name, error=repr(e))
+            return
+        # default escalation: a flight bundle carrying the alert, its
+        # burn-rate history and the exemplar evidence. The recorder's
+        # shared dedupe window folds this with a concurrent watchdog
+        # trip into ONE bundle tagged with both causes.
+        _recorder.RECORDER.dump(f"alert_{st.rule.name}",
+                                extra={"alert": payload})
+
+    # -- surfaces ----------------------------------------------------------
+    def _alert_payload(self, st, history=32):
+        rule = st.rule
+        with self._lock:
+            state = (st.state, round(st.since_wall, 3), st.fired_at,
+                     st.resolved_at, st.detail,
+                     list(st.history)[-int(history):])
+        out = dict(rule.describe(), owner=self.owner_id,
+                   state=state[0], since=state[1],
+                   fired_at=state[2], resolved_at=state[3],
+                   detail=state[4], burn_history=state[5])
+        name = rule.slo_name()
+        slo = self.evaluator.get(name) if name else None
+        if slo is not None:
+            row = self.evaluator.evaluate(slo)
+            out["error_budget_remaining"] = row.get(
+                "error_budget_remaining")
+            out["slo_target"] = slo.target
+            if isinstance(slo, LatencySLO):
+                exemplars = slo.exemplars()
+                # the alert surface promises RETRIEVABLE evidence:
+                # drop exemplars whose trace the bounded tail-sampling
+                # ring has already evicted (keep the raw list only
+                # when nothing survives — a value-only hint still
+                # beats none)
+                try:
+                    from . import spans as _spans
+                    live = [e for e in exemplars
+                            if _spans.get_trace(e["trace_id"])
+                            is not None]
+                except Exception:
+                    live = []
+                out["exemplars"] = live or exemplars
+        return out
+
+    def snapshot(self):
+        """The ``/alerts`` body (also the bundle section): every
+        rule's position, evidence and history, firing/pending counts,
+        and the recent transition log."""
+        with self._lock:
+            statuses = list(self._rules.values())
+            transitions = list(self._transitions)
+        rules = [self._alert_payload(st, history=8) for st in statuses]
+        return {"owner": self.owner_id,
+                "eval_s": self.eval_s,
+                "window_scale": self.evaluator.scale,
+                "firing": sum(1 for r in rules
+                              if r["state"] == "firing"),
+                "pending": sum(1 for r in rules
+                               if r["state"] == "pending"),
+                "rules": rules,
+                "transitions": transitions[-32:]}
+
+
+# -- default objective/rule sets --------------------------------------------
+
+def default_serving_objectives(evaluator, engine_id):
+    """The default engine objective set (ISSUE defaults, knob-tuned):
+    latency quantile, availability, and — when a budget is declared —
+    cost per 1k tokens. Returns the added SLO names."""
+    from .slo import AvailabilitySLO, CostSLO
+
+    names = []
+    evaluator.add(LatencySLO(
+        "serving_latency",
+        threshold_ms=envvars.get("MXNET_TPU_SLO_LATENCY_MS"),
+        target=envvars.get("MXNET_TPU_SLO_LATENCY_TARGET"),
+        match={"engine_id": engine_id, "stage": "total"},
+        description="requests completing under the latency bound"))
+    names.append("serving_latency")
+    evaluator.add(AvailabilitySLO(
+        "serving_availability",
+        target=envvars.get("MXNET_TPU_SLO_AVAILABILITY_TARGET"),
+        match={"engine_id": engine_id},
+        description="requests completed (not shed/errored/expired)"))
+    names.append("serving_availability")
+    budget = envvars.get("MXNET_TPU_SLO_COST_S_PER_1K")
+    if budget is not None:
+        evaluator.add(CostSLO(
+            "serving_cost", budget, match={"engine_id": engine_id},
+            description="device seconds per 1k valid tokens"))
+        names.append("serving_cost")
+    return names
+
+
+def default_router_objectives(evaluator, router):
+    """The default fleet objective set: availability across failover
+    (router outcomes), fleet latency quantile, and the routable-engine
+    fraction."""
+    from .slo import AvailabilitySLO, GaugeSLO
+
+    names = []
+    evaluator.add(LatencySLO(
+        "fleet_latency",
+        threshold_ms=envvars.get("MXNET_TPU_SLO_LATENCY_MS"),
+        target=envvars.get("MXNET_TPU_SLO_LATENCY_TARGET"),
+        family="mxnet_tpu_router_latency_ms",
+        match={"stage": "total"},
+        description="router-observed end-to-end latency objective"))
+    names.append("fleet_latency")
+    evaluator.add(AvailabilitySLO(
+        "fleet_availability",
+        target=envvars.get("MXNET_TPU_SLO_AVAILABILITY_TARGET"),
+        family="mxnet_tpu_router_requests_total",
+        good_events=("completed",),
+        bad_events=("failed", "expired", "shed_queue_full",
+                    "shed_no_engine", "rejected_stopped", "cancelled"),
+        description="fleet availability across failover: requests "
+                    "completed vs shed/failed/expired"))
+    names.append("fleet_availability")
+
+    def up_fraction():
+        board = router.scoreboard()
+        if not board:
+            return 0.0
+        return (sum(1 for r in board.values() if r["routable"])
+                / float(len(board)))
+
+    evaluator.add(GaugeSLO(
+        "fleet_engines_up",
+        target=envvars.get("MXNET_TPU_SLO_ENGINE_UP_FRACTION"),
+        op="ge", value_fn=up_fraction,
+        description="fraction of registered engines routable"))
+    names.append("fleet_engines_up")
+    return names
+
+
+def default_burn_rules(daemon, slo_names):
+    """The SRE-workbook rule pair per ratio objective (fast 5m/1h page
+    + slow 30m/6h ticket); threshold objectives get a ticket threshold
+    rule. Returns the added rule names."""
+    from .slo import RatioSLO
+
+    added = []
+    for name in slo_names:
+        slo = daemon.evaluator.get(name)
+        if slo is None:
+            continue
+        if isinstance(slo, RatioSLO):
+            lw, sw, factor, for_s = _PAGE_WINDOWS
+            daemon.add_rule(BurnRateRule(
+                f"{name}_fast_burn", name, long_window=lw,
+                short_window=sw, factor=factor, severity=PAGE,
+                for_s=for_s))
+            lw, sw, factor, for_s = _TICKET_WINDOWS
+            daemon.add_rule(BurnRateRule(
+                f"{name}_slow_burn", name, long_window=lw,
+                short_window=sw, factor=factor, severity=TICKET,
+                for_s=for_s))
+            added += [f"{name}_fast_burn", f"{name}_slow_burn"]
+        else:
+            daemon.add_rule(ThresholdRule(
+                f"{name}_over_budget", name, severity=TICKET))
+            added.append(f"{name}_over_budget")
+    return added

@@ -29,7 +29,15 @@ dependencies, daemon threads — never blocks process exit):
   ``?format=json`` returns the top-self-time JSON summary instead;
 - ``/costs`` — optional per-bucket cost ledger (only when a
   ``costs_fn`` is attached): the engine's device/compile-seconds +
-  request/token table, or the router's fleet-merged cost table.
+  request/token table, or the router's fleet-merged cost table;
+- ``/slo`` — optional SLO evaluator snapshot (only when an ``slo_fn``
+  is attached): per objective the SLI/value, burn rates per canonical
+  window and error budget remaining — the router serves the
+  fleet-aggregated view;
+- ``/alerts`` — optional alert-daemon state (only when an
+  ``alerts_fn`` is attached): every rule's pending/firing/resolved
+  position, burn-rate history, latency exemplars (trace ids
+  retrievable at ``/traces/<id>``) and recent transitions.
 
 A server constructed with ``metrics_fn``/``traces_fn``/``trace_fn``
 overrides serves those endpoints from the callables instead of the
@@ -55,7 +63,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from .registry import REGISTRY, _fmt
 
 __all__ = ["TelemetryServer", "start_server", "parse_prometheus_text",
-           "parse_labels", "histogram_quantile",
+           "parse_labels", "parse_exemplar", "histogram_quantile",
            "merge_prometheus_texts"]
 
 PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
@@ -83,6 +91,13 @@ class TelemetryServer:
     costs_fn : ``() -> dict`` enabling ``/costs`` (the serving cost
         ledger: per-bucket device/compile seconds + requests/tokens,
         or the router's fleet merge); None = 404.
+    slo_fn : ``() -> dict`` enabling ``/slo`` (the owner's SLO
+        evaluator snapshot: per objective the SLI/value, burn rates
+        per window, error budget remaining — or the router's fleet
+        aggregation); None = 404.
+    alerts_fn : ``() -> dict`` enabling ``/alerts`` (the alert
+        daemon's rule table: state machine position per rule, burn
+        history, exemplars, recent transitions); None = 404.
     profile_fn : ``() -> str | dict`` overriding ``/profile``; None =
         the process continuous profiler (:mod:`.profiling`) — a str
         serves as collapsed text, a dict as JSON.
@@ -94,7 +109,8 @@ class TelemetryServer:
     def __init__(self, registry=None, healthz_fn=None, stats_fn=None,
                  metrics_fn=None, traces_fn=None, trace_fn=None,
                  submit_fn=None, warmup_fn=None, costs_fn=None,
-                 profile_fn=None, port=0, host="127.0.0.1"):
+                 profile_fn=None, slo_fn=None, alerts_fn=None,
+                 port=0, host="127.0.0.1"):
         self.registry = registry if registry is not None else REGISTRY
         self.healthz_fn = healthz_fn
         self.stats_fn = stats_fn
@@ -105,6 +121,8 @@ class TelemetryServer:
         self.warmup_fn = warmup_fn
         self.costs_fn = costs_fn
         self.profile_fn = profile_fn
+        self.slo_fn = slo_fn
+        self.alerts_fn = alerts_fn
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -230,23 +248,31 @@ class TelemetryServer:
                 self._reply(handler, 200, "application/json",
                             json.dumps(payload, default=str).encode())
         elif path == "/costs":
-            if self.costs_fn is None:
-                self._reply(handler, 404, "application/json",
-                            json.dumps({"error": "no cost ledger"})
-                            .encode())
-                return
-            try:
-                costs = self.costs_fn()
-            except Exception as e:
-                self._reply(handler, 500, "application/json",
-                            json.dumps({"error": repr(e)}).encode())
-                return
-            self._reply(handler, 200, "application/json",
-                        json.dumps(costs, default=str).encode())
+            self._json_fn(handler, self.costs_fn, "no cost ledger")
+        elif path == "/slo":
+            self._json_fn(handler, self.slo_fn, "no SLO evaluator")
+        elif path == "/alerts":
+            self._json_fn(handler, self.alerts_fn, "no alert daemon")
         else:
             self._reply(handler, 404, "text/plain",
                         b"try /metrics, /healthz, /stats, /traces, "
-                        b"/profile, /costs or /warmup\n")
+                        b"/profile, /costs, /slo, /alerts or /warmup\n")
+
+    def _json_fn(self, handler, fn, missing):
+        """Serve an optional JSON endpoint off a callable: 404 when
+        nothing is attached, 500 (never a hang-up) when it raises."""
+        if fn is None:
+            self._reply(handler, 404, "application/json",
+                        json.dumps({"error": missing}).encode())
+            return
+        try:
+            body = fn()
+        except Exception as e:
+            self._reply(handler, 500, "application/json",
+                        json.dumps({"error": repr(e)}).encode())
+            return
+        self._reply(handler, 200, "application/json",
+                    json.dumps(body, default=str).encode())
 
     def _route_post(self, handler):
         path = handler.path.split("?", 1)[0]
@@ -301,13 +327,34 @@ def start_server(port=0, host="127.0.0.1", registry=None, healthz_fn=None,
                            stats_fn=stats_fn, port=port, host=host)
 
 
+def _split_exemplar(line):
+    """Split an exposition line at the OpenMetrics exemplar marker
+    (`` # `` outside quoted label values) → ``(sample_part,
+    exemplar_part_or_None)``. A parser that treats the whole line as
+    one sample drops every exemplar-bearing series — the bug that made
+    scrape-merge corrupt exemplar expositions."""
+    in_quote = False
+    prev = ""
+    for i, ch in enumerate(line):
+        if ch == '"' and prev != "\\":
+            in_quote = not in_quote
+        elif (ch == "#" and not in_quote and i > 0
+                and line[i - 1] == " "):
+            return line[:i - 1].rstrip(), line[i + 1:].strip()
+        prev = ch if not (ch == "\\" and prev == "\\") else ""
+    return line, None
+
+
 def _parse_sample_line(line):
-    """One exposition sample line → ``(key, float)`` or None (comment,
-    blank, malformed). Splits at the last space OUTSIDE a quoted label
-    value."""
+    """One exposition sample line → ``(key, float, exemplar_raw)`` or
+    None (comment, blank, malformed). Splits the value at the last
+    space OUTSIDE a quoted label value; an OpenMetrics exemplar
+    (``... # {trace_id="..."} v ts``) is split off first and returned
+    verbatim so round-trips keep it."""
     line = line.strip()
     if not line or line.startswith("#"):
         return None
+    line, exemplar = _split_exemplar(line)
     in_quote = False
     split_at = -1
     prev = ""
@@ -321,21 +368,67 @@ def _parse_sample_line(line):
         return None
     key, val = line[:split_at], line[split_at + 1:].strip()
     try:
-        return key, float(val)
+        return key, float(val), exemplar
     except ValueError:
         return None
 
 
-def parse_prometheus_text(text):
+def parse_exemplar(raw):
+    """An exemplar's raw text (``{trace_id="..."} 93.1 1690.5``) →
+    ``{"labels": {...}, "trace_id": ..., "value": float, "ts":
+    float|None}`` (None when malformed)."""
+    if not raw:
+        return None
+    raw = raw.strip()
+    if not raw.startswith("{"):
+        return None
+    depth_end = -1
+    in_quote = False
+    prev = ""
+    for i, ch in enumerate(raw):
+        if ch == '"' and prev != "\\":
+            in_quote = not in_quote
+        elif ch == "}" and not in_quote:
+            depth_end = i
+            break
+        prev = ch if not (ch == "\\" and prev == "\\") else ""
+    if depth_end < 0:
+        return None
+    _, labels = parse_labels("x" + raw[:depth_end + 1])
+    rest = raw[depth_end + 1:].split()
+    try:
+        value = float(rest[0]) if rest else None
+    except ValueError:
+        return None
+    ts = None
+    if len(rest) > 1:
+        try:
+            ts = float(rest[1])
+        except ValueError:
+            ts = None
+    if value is None:
+        return None
+    return {"labels": labels, "trace_id": labels.get("trace_id"),
+            "value": value, "ts": ts}
+
+
+def parse_prometheus_text(text, exemplars=None):
     """Parse exposition text into ``{name{labels}: float}`` (labels
     part verbatim, ``""`` for none). Inverse enough of
     ``MetricsRegistry.render_prometheus`` for scrape cross-checks —
-    handles escaped quotes in label values, skips comments."""
+    handles escaped quotes in label values, skips comments, and keeps
+    the sample when an OpenMetrics exemplar trails it. Pass a dict as
+    ``exemplars`` to collect ``{series_key: parsed_exemplar}`` for the
+    series that carry one."""
     out = {}
     for line in text.splitlines():
         parsed = _parse_sample_line(line)
         if parsed is not None:
             out[parsed[0]] = parsed[1]
+            if exemplars is not None and parsed[2] is not None:
+                ex = parse_exemplar(parsed[2])
+                if ex is not None:
+                    exemplars[parsed[0]] = ex
     return out
 
 
@@ -346,10 +439,14 @@ def merge_prometheus_texts(texts):
     engine-labeled serving families stay disjoint per engine, while
     process-level families (trace counters, watchdog totals) fold into
     fleet totals. Histogram buckets sum correctly because every
-    input's buckets are already cumulative. Output is deterministic:
-    families sorted by name, samples sorted by key."""
+    input's buckets are already cumulative. OpenMetrics exemplars
+    round-trip: per series key the largest-valued exemplar survives
+    the merge (the fleet scrape keeps the worst retrievable trace per
+    bucket, matching the registry's per-child rule). Output is
+    deterministic: families sorted by name, samples sorted by key."""
     helps, types = {}, {}
     samples = {}
+    exemplars = {}          # series key -> (value, raw_text)
     for text in texts:
         for line in text.splitlines():
             line = line.strip()
@@ -367,6 +464,12 @@ def merge_prometheus_texts(texts):
             parsed = _parse_sample_line(line)
             if parsed is not None:
                 samples[parsed[0]] = samples.get(parsed[0], 0.0) + parsed[1]
+                if parsed[2] is not None:
+                    ex = parse_exemplar(parsed[2])
+                    prev = exemplars.get(parsed[0])
+                    if ex is not None and (prev is None
+                                           or ex["value"] >= prev[0]):
+                        exemplars[parsed[0]] = (ex["value"], parsed[2])
 
     def family_of(key):
         name = key.split("{", 1)[0]
@@ -387,7 +490,10 @@ def merge_prometheus_texts(texts):
         if fam in types:
             out.append(f"# TYPE {fam} {types[fam]}")
         for key in sorted(by_family.get(fam, ())):
-            out.append(f"{key} {_fmt(samples[key])}")
+            line = f"{key} {_fmt(samples[key])}"
+            if key in exemplars:
+                line += f" # {exemplars[key][1]}"
+            out.append(line)
     return "\n".join(out) + "\n"
 
 

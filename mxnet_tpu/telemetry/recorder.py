@@ -102,6 +102,10 @@ class FlightRecorder:
         self._recent = deque(maxlen=_config["recent_events"])
         self._lock = threading.Lock()
         self._last_dump = {}            # reason -> monotonic stamp
+        self._last_bundle = None        # (monotonic, path) of newest
+        # serializes bundle writes + amends; REENTRANT because a
+        # SIGUSR2 handler may fire on the main thread mid-dump
+        self._write_lock = threading.RLock()
         self._installed = False
         self._prev_excepthook = None
         self._prev_threading_hook = None
@@ -197,15 +201,62 @@ class FlightRecorder:
     def dump(self, reason, extra=None, min_interval_s=None):
         """Write one post-mortem bundle; returns its directory, or
         None when rate-limited for this reason. Never raises — a
-        diagnosis path must not add a second failure."""
+        diagnosis path must not add a second failure.
+
+        Bundles DEDUPE across reasons within the rate-limit window: a
+        watchdog trip and a page-alert firing seconds apart describe
+        the same incident, so the second trigger AMENDS the existing
+        bundle's meta (``causes`` grows, extras merge) instead of
+        racing to write a near-identical sibling. An explicit
+        ``min_interval_s=0`` (SIGUSR2, tests) always writes fresh."""
         if min_interval_s is None:
             min_interval_s = _config["min_dump_interval_s"]
-        now = time.monotonic()
-        with self._lock:
-            last = self._last_dump.get(reason)
-            if last is not None and now - last < min_interval_s:
-                return None
-            self._last_dump[reason] = now
+        with self._write_lock:
+            now = time.monotonic()
+            with self._lock:
+                last = self._last_dump.get(reason)
+                if last is not None and now - last < min_interval_s:
+                    return None
+                self._last_dump[reason] = now
+                lb = self._last_bundle
+            if (min_interval_s > 0 and lb is not None
+                    and now - lb[0] < min_interval_s):
+                amended = self._amend(lb[1], reason, extra)
+                if amended is not None:
+                    return amended
+            path = self._write_bundle(reason, extra)
+            if path is not None:
+                with self._lock:
+                    self._last_bundle = (now, path)
+            return path
+
+    def _amend(self, path, reason, extra):
+        """Tag an existing bundle with an additional cause; None when
+        the bundle is gone — the caller writes a fresh one instead.
+        The new trigger's extras land NAMESPACED under ``amendments``
+        (keyed by reason) — a flat merge would overwrite the first
+        trigger's payload under the same key (two page alerts both
+        carry ``alert``)."""
+        try:
+            meta_path = os.path.join(path, "meta.json")
+            with open(meta_path) as f:
+                meta = json.load(f)
+            causes = meta.setdefault("causes", [meta.get("reason")])
+            causes.append(reason)
+            if extra:
+                meta.setdefault("amendments", []).append(
+                    dict(extra, reason=reason))
+            tmp = meta_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(meta, f, indent=2, default=str)
+            os.replace(tmp, meta_path)
+            _events.emit("flight_recorder_amend", reason=reason,
+                         path=path, causes=causes)
+            return path
+        except Exception:
+            return None
+
+    def _write_bundle(self, reason, extra):
         try:
             stamp = time.strftime("%Y%m%dT%H%M%S", time.gmtime())
             # per-process sequence keeps names unique across dumps in
@@ -219,7 +270,8 @@ class FlightRecorder:
             # tooling — and the tests — never see half a dump)
             tmp = path + ".tmp"
             os.makedirs(tmp, exist_ok=True)
-            meta = {"reason": reason, "ts": round(time.time(), 6),
+            meta = {"reason": reason, "causes": [reason],
+                    "ts": round(time.time(), 6),
                     "mono": round(time.monotonic(), 6),
                     "pid": os.getpid(), "argv": sys.argv,
                     "python": sys.version.split()[0]}

@@ -18,13 +18,31 @@ Everything is thread-safe; children are created on first touch and
 live for the process lifetime (Prometheus counters are cumulative by
 contract — `serving.ServingStats` windows reset, registry counters
 never do; scrapers diff).
+
+Histograms can carry OpenMetrics-style EXEMPLARS: ``observe(v,
+exemplar=trace_id)`` remembers the most recent (and, per bucket, the
+slowest-seen) ``(value, trace_id, wall_ts)`` triple for the bucket the
+observation landed in, rendered as ``# {trace_id="..."} value ts``
+after the ``_bucket`` sample line. That is the machine-readable link
+from a latency histogram back to a retrievable trace in
+``/traces/<id>`` — the SLO engine's alert surface reads them off
+:meth:`Histogram._Child.exemplars`.
 """
 from __future__ import annotations
 
 import threading
+import time
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
-           "DEFAULT_MS_BUCKETS", "escape_label_value"]
+           "DEFAULT_MS_BUCKETS", "escape_label_value",
+           "EXEMPLAR_MAX_AGE_S"]
+
+#: a bucket's reigning exemplar decays after this many seconds: the
+#: slowest-ever observation would otherwise pin a trace id whose trace
+#: the bounded tail-sampling ring evicted long ago — a dead link. Past
+#: this age ANY new exemplar-bearing observation takes the slot, so
+#: exposition exemplars always point near the present.
+EXEMPLAR_MAX_AGE_S = 30.0
 
 # latency bucket boundaries in milliseconds: sub-ms dispatch overhead
 # through multi-second compiles on one axis
@@ -207,7 +225,8 @@ class Histogram(_Family):
     kind = "histogram"
 
     class _Child:
-        __slots__ = ("_bounds", "_counts", "_sum", "_count", "_lock")
+        __slots__ = ("_bounds", "_counts", "_sum", "_count", "_lock",
+                     "_exemplars")
 
         def __init__(self, bounds):
             self._bounds = bounds
@@ -215,8 +234,9 @@ class Histogram(_Family):
             self._sum = 0.0
             self._count = 0
             self._lock = threading.Lock()
+            self._exemplars = None      # allocated on first exemplar
 
-        def observe(self, v):
+        def observe(self, v, exemplar=None):
             v = float(v)
             i = 0
             bounds = self._bounds
@@ -228,6 +248,37 @@ class Histogram(_Family):
                 self._counts[i] += 1
                 self._sum += v
                 self._count += 1
+                if exemplar is not None:
+                    if self._exemplars is None:
+                        self._exemplars = [None] * (len(bounds) + 1)
+                    prev = self._exemplars[i]
+                    # per bucket, the SLOWEST RECENT observation wins:
+                    # a firing latency alert wants the worst retrievable
+                    # trace in that bucket, not whichever came last —
+                    # but a stale champion decays (EXEMPLAR_MAX_AGE_S,
+                    # measured on the monotonic clock; the wall ts is
+                    # exposition-only) so the id still resolves in the
+                    # bounded trace ring
+                    mono = time.monotonic()
+                    if (prev is None or v >= prev["value"]
+                            or mono - prev["mono"] > EXEMPLAR_MAX_AGE_S):
+                        self._exemplars[i] = {
+                            "trace_id": str(exemplar), "value": v,
+                            "ts": round(time.time(), 3), "mono": mono}
+
+        def exemplars(self):
+            """``{bucket_bound_or_inf: {trace_id, value, ts}}`` for
+            buckets that have one (empty when none were recorded)."""
+            with self._lock:
+                ex = list(self._exemplars) if self._exemplars else []
+            out = {}
+            for i, e in enumerate(ex):
+                if e is not None:
+                    bound = (self._bounds[i] if i < len(self._bounds)
+                             else float("inf"))
+                    out[bound] = {k: v for k, v in e.items()
+                                  if k != "mono"}
+            return out
 
         @property
         def count(self):
@@ -256,8 +307,8 @@ class Histogram(_Family):
     def _make_child(self):
         return Histogram._Child(self.buckets)
 
-    def observe(self, v):
-        self._default_child().observe(v)
+    def observe(self, v, exemplar=None):
+        self._default_child().observe(v, exemplar=exemplar)
 
     @property
     def count(self):
@@ -267,20 +318,29 @@ class Histogram(_Family):
     def sum(self):
         return self._default_child().sum
 
+    def exemplars(self):
+        return self._default_child().exemplars()
+
     def render(self, out):
         for values, child in self._sorted_children():
             cum = child.cumulative()
-            for bound, acc in zip(self.buckets, cum):
-                lv = values + (_fmt(bound),)
+            exemplars = child.exemplars()
+            for bound, acc in zip(self.buckets + (float("inf"),),
+                                  cum):
+                lv = values + (("+Inf" if bound == float("inf")
+                                else _fmt(bound)),)
                 pairs = ",".join(
                     f'{n}="{escape_label_value(v)}"'
                     for n, v in zip(self.labelnames + ("le",), lv))
-                out.append(f"{self.name}_bucket{{{pairs}}} {acc}")
-            pairs = ",".join(
-                f'{n}="{escape_label_value(v)}"'
-                for n, v in zip(self.labelnames + ("le",),
-                                values + ("+Inf",)))
-            out.append(f"{self.name}_bucket{{{pairs}}} {cum[-1]}")
+                line = f"{self.name}_bucket{{{pairs}}} {acc}"
+                ex = exemplars.get(bound)
+                if ex is not None:
+                    # OpenMetrics exemplar syntax on the bucket line:
+                    # the trace id a scraper can resolve at /traces/<id>
+                    line += (f' # {{trace_id="'
+                             f'{escape_label_value(ex["trace_id"])}"}} '
+                             f'{_fmt(ex["value"])} {_fmt(ex["ts"])}')
+                out.append(line)
             ls = self._label_str(values)
             out.append(f"{self.name}_sum{ls} {_fmt(child.sum)}")
             out.append(f"{self.name}_count{ls} {child.count}")

@@ -1,0 +1,603 @@
+"""Declarative SLO registry: objectives, windowed SLIs, burn rates.
+
+The fleet can be *measured* (metrics registry, spans, profiler, cost
+ledger) but nothing here could *judge* it: this module turns raw
+telemetry into objectives. An :class:`SloEvaluator` owns a set of
+declared SLOs, samples their underlying (cumulative) metric series
+into a bounded :class:`SampleStore` on every evaluation tick, and
+answers the SRE-workbook questions about each objective:
+
+- **SLI over a window** — the good/total ratio over the trailing
+  window (partial coverage uses whatever history exists, so a freshly
+  started process answers honestly rather than not at all);
+- **burn rate** — ``(1 - SLI) / (1 - target)``: 1.0 means the error
+  budget burns exactly at the sustainable rate, N means the budget
+  burns N× too fast;
+- **error budget remaining** — over the budget window
+  (``MXNET_TPU_SLO_BUDGET_S``, clipped to uptime):
+  ``1 - (1 - SLI) / (1 - target)`` — negative means the budget is
+  blown.
+
+Two objective shapes:
+
+- **ratio** SLOs (:class:`LatencySLO`, :class:`AvailabilitySLO`) read
+  good/total cumulative counters off the process registry — latency
+  "good" is the histogram's cumulative count at the bucket boundary
+  the threshold snaps up to (so the SLI is exact, not interpolated);
+- **threshold** SLOs (:class:`CostSLO`, :class:`GaugeSLO`) compare a
+  windowed value (a delta ratio, or an instantaneous gauge) against a
+  bound; their "burn rate" is ``value/bound`` (or ``bound/value`` for
+  lower-is-bad objectives) so the same alerting machinery applies.
+
+Alert rules over these objectives — multi-window multi-burn-rate,
+threshold, absence — live in :mod:`.alerts`; this module stays
+policy-free (it computes, rules decide).
+
+Every window is multiplied by ``MXNET_TPU_SLO_WINDOW_SCALE`` so a
+drill can shrink hours to seconds with one knob.
+"""
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+from collections import OrderedDict
+
+from .. import envvars
+from .registry import REGISTRY
+
+__all__ = ["SampleStore", "SLO", "RatioSLO", "LatencySLO",
+           "AvailabilitySLO", "ThresholdSLO", "CostSLO", "GaugeSLO",
+           "SloEvaluator", "BURN_WINDOWS", "window_scale"]
+
+#: canonical burn-rate windows (seconds, before scaling) — the SRE
+#: workbook's multi-window pairs read these by label
+BURN_WINDOWS = OrderedDict((("5m", 300.0), ("30m", 1800.0),
+                            ("1h", 3600.0), ("6h", 21600.0)))
+
+
+def window_scale():
+    """The global window multiplier (``MXNET_TPU_SLO_WINDOW_SCALE``,
+    floored at a microsecond so a zero knob can't divide the world)."""
+    return max(1e-6, float(envvars.get("MXNET_TPU_SLO_WINDOW_SCALE")))
+
+
+class SampleStore:
+    """Bounded time series of cumulative samples, one sorted list per
+    key.
+
+    The registry's counters are process-cumulative; windowed rates
+    need history. The evaluator records ``(t, value)`` on every tick;
+    :meth:`delta` bisects for the latest sample at or before
+    ``now - window`` (falling back to the oldest — partial coverage
+    beats no answer). Samples older than ``max_age_s`` are pruned on
+    write, and a series exceeding ``max_samples`` COARSENS its older
+    half (every other sample dropped) — windowed deltas only need
+    anchors, not full resolution, so a month-long budget window costs
+    kilobytes per series, not the raw 5-second-tick history.
+    """
+
+    def __init__(self, max_age_s, max_samples=4096):
+        self.max_age_s = float(max_age_s)
+        self.max_samples = max(8, int(max_samples))
+        self._series = {}
+        self._lock = threading.Lock()
+
+    def record(self, key, t, value):
+        t = float(t)
+        with self._lock:
+            arr = self._series.get(key)
+            if arr is None:
+                arr = self._series.setdefault(key, [])
+            arr.append((t, float(value)))
+            horizon = t - self.max_age_s
+            if len(arr) > 2 and arr[1][0] < horizon:
+                # keep ONE sample older than the horizon so a
+                # full-width window still has an anchor to diff against
+                idx = bisect.bisect_left(arr, (horizon, -1e308)) - 1
+                if idx > 0:
+                    del arr[:idx]
+            if len(arr) > self.max_samples:
+                half = len(arr) // 2
+                arr[:half] = arr[0:half:2]
+
+    def delta(self, key, window_s, now=None):
+        """``(delta, span_s)`` of the newest sample vs the anchor at
+        ``now - window_s`` (oldest sample when coverage is partial);
+        None with fewer than two samples."""
+        with self._lock:
+            arr = self._series.get(key)
+            if arr is None or len(arr) < 2:
+                return None
+            latest_t, latest_v = arr[-1]
+            cut = (now if now is not None else latest_t) - float(window_s)
+            i = bisect.bisect_right(arr, (cut, 1e308)) - 1
+            anchor_t, anchor_v = arr[max(0, i)]
+        span = latest_t - anchor_t
+        if span <= 0:
+            return None
+        return latest_v - anchor_v, span
+
+    def latest(self, key):
+        with self._lock:
+            arr = self._series.get(key)
+            return arr[-1][1] if arr else None
+
+    def keys(self):
+        with self._lock:
+            return list(self._series)
+
+
+def _match_labels(labelnames, values, match):
+    if not match:
+        return True
+    labels = dict(zip(labelnames, values))
+    return all(labels.get(k) == str(v) for k, v in match.items())
+
+
+class SLO:
+    """One declared objective: a name, a target, and the recipe for
+    reading its raw series off a :class:`~.registry.MetricsRegistry`.
+    Subclasses implement :meth:`sample` (cumulative values recorded
+    each tick) plus the kind-specific evaluation below."""
+
+    kind = "ratio"
+
+    def __init__(self, name, target, description="", registry=None):
+        self.name = str(name)
+        self.target = float(target)
+        self.description = description
+        self.registry = registry if registry is not None else REGISTRY
+
+    def sample(self):
+        """``{series_suffix: cumulative_value}`` to record this tick."""
+        raise NotImplementedError
+
+    def describe(self):
+        return {"kind": self.kind, "target": self.target,
+                "description": self.description}
+
+
+class RatioSLO(SLO):
+    """good/total objective. Subclasses implement :meth:`good_total`
+    returning the two CUMULATIVE series."""
+
+    kind = "ratio"
+
+    def good_total(self):
+        raise NotImplementedError
+
+    def sample(self):
+        good, total = self.good_total()
+        return {"good": good, "total": total}
+
+    def sli(self, store, window_s, now=None):
+        """Good fraction over the window (None without enough data or
+        with zero traffic in the window — no traffic is not an SLI of
+        1.0, it's the absence of one)."""
+        g = store.delta(f"{self.name}:good", window_s, now)
+        t = store.delta(f"{self.name}:total", window_s, now)
+        if g is None or t is None or t[0] <= 0:
+            return None
+        return max(0.0, min(1.0, g[0] / t[0]))
+
+    def burn_rate(self, store, window_s, now=None):
+        """Error-budget burn multiple over the window (None when the
+        SLI is unknown). A target of 1.0 makes any error an infinite
+        burn — capped at 1e9 to stay JSON-able."""
+        sli = self.sli(store, window_s, now)
+        if sli is None:
+            return None
+        budget = 1.0 - self.target
+        if budget <= 0:
+            return 0.0 if sli >= 1.0 else 1e9
+        return (1.0 - sli) / budget
+
+
+class LatencySLO(RatioSLO):
+    """Latency-quantile objective over a registry histogram family:
+    ``target`` of requests must land at or under ``threshold_ms``
+    (snapped UP to the nearest bucket boundary so good counts are
+    exact cumulative-bucket reads, not interpolations).
+
+    ``match`` filters children by label subset — per engine
+    (``{"engine_id": ..., "stage": "total"}``), per serving bucket, or
+    any other labeled slice the family carries.
+    """
+
+    def __init__(self, name, threshold_ms, target=0.99,
+                 family="mxnet_tpu_serving_latency_ms", match=None,
+                 description="", registry=None):
+        super().__init__(name, target, description, registry)
+        self.family = str(family)
+        self.match = dict(match or {})
+        self.threshold_ms = float(threshold_ms)
+
+    def effective_bound(self):
+        """The bucket boundary the threshold snapped up to (None when
+        the family doesn't exist yet or the threshold exceeds every
+        finite bucket — good then means "finished at all")."""
+        fam = self.registry.get(self.family)
+        if fam is None or not hasattr(fam, "buckets"):
+            return None
+        for b in fam.buckets:
+            if b >= self.threshold_ms:
+                return b
+        return None
+
+    def good_total(self):
+        fam = self.registry.get(self.family)
+        if fam is None or not hasattr(fam, "buckets"):
+            return 0.0, 0.0
+        idx = None
+        for i, b in enumerate(fam.buckets):
+            if b >= self.threshold_ms:
+                idx = i
+                break
+        good = total = 0.0
+        for values, child in fam._sorted_children():
+            if not _match_labels(fam.labelnames, values, self.match):
+                continue
+            cum = child.cumulative()
+            good += cum[idx] if idx is not None else cum[-1]
+            total += child.count
+        return good, total
+
+    def exemplars(self, max_items=8):
+        """The retrievable evidence for a violated latency objective:
+        OpenMetrics exemplars recorded in buckets ABOVE the effective
+        bound (i.e. requests that missed the objective), slowest
+        first. Each carries the trace id a scraper resolves at
+        ``/traces/<id>`` — exactly what a firing burn-rate alert
+        links to."""
+        fam = self.registry.get(self.family)
+        if fam is None or not hasattr(fam, "buckets"):
+            return []
+        bound = self.effective_bound()
+        out = []
+        for values, child in fam._sorted_children():
+            if not _match_labels(fam.labelnames, values, self.match):
+                continue
+            for b, ex in child.exemplars().items():
+                if bound is not None and b <= bound:
+                    continue        # met the objective: not evidence
+                out.append({"trace_id": ex["trace_id"],
+                            "value_ms": round(ex["value"], 3),
+                            "bucket_le": ("+Inf" if b == float("inf")
+                                          else b),
+                            "ts": ex["ts"]})
+        out.sort(key=lambda e: -e["value_ms"])
+        return out[:int(max_items)]
+
+    def describe(self):
+        return dict(super().describe(), family=self.family,
+                    match=self.match, threshold_ms=self.threshold_ms,
+                    effective_threshold_ms=self.effective_bound())
+
+
+class AvailabilitySLO(RatioSLO):
+    """Availability objective over an outcome-labeled counter family:
+    good = the ``good_events`` children, total = good + the
+    ``bad_events`` children (sheds and errors burn budget; outcomes
+    not named — e.g. in-flight bookkeeping — count for neither side).
+    """
+
+    def __init__(self, name, target=0.999,
+                 family="mxnet_tpu_serving_requests_total", match=None,
+                 good_events=("completed",),
+                 bad_events=("failed", "expired", "rejected_queue_full",
+                             "rejected_too_long", "rejected_stopped",
+                             "cancelled"),
+                 event_label="event", description="", registry=None):
+        super().__init__(name, target, description, registry)
+        self.family = str(family)
+        self.match = dict(match or {})
+        self.good_events = tuple(good_events)
+        self.bad_events = tuple(bad_events)
+        self.event_label = event_label
+
+    def good_total(self):
+        fam = self.registry.get(self.family)
+        if fam is None:
+            return 0.0, 0.0
+        good = bad = 0.0
+        for values, child in fam._sorted_children():
+            if not _match_labels(fam.labelnames, values, self.match):
+                continue
+            event = dict(zip(fam.labelnames, values)).get(self.event_label)
+            if event in self.good_events:
+                good += child.value
+            elif event in self.bad_events:
+                bad += child.value
+        return good, good + bad
+
+    def describe(self):
+        return dict(super().describe(), family=self.family,
+                    match=self.match, good_events=list(self.good_events),
+                    bad_events=list(self.bad_events))
+
+
+class ThresholdSLO(SLO):
+    """Bound-comparison objective: a windowed value must stay at-or-
+    under (``op="le"``) or at-or-over (``op="ge"``) ``target``.
+    Subclasses implement :meth:`value`. ``burn_rate`` is the violation
+    multiple (1.0 = exactly at the bound) so threshold objectives plug
+    into the same alert rules as ratio ones."""
+
+    kind = "threshold"
+
+    def __init__(self, name, target, op="le", description="",
+                 registry=None):
+        if op not in ("le", "ge"):
+            raise ValueError(f"threshold op must be le/ge, got {op!r}")
+        super().__init__(name, target, description, registry)
+        self.op = op
+
+    def value(self, store, window_s, now=None):
+        raise NotImplementedError
+
+    def ok(self, value):
+        if value is None:
+            return None
+        return value <= self.target if self.op == "le" \
+            else value >= self.target
+
+    def burn_rate(self, store, window_s, now=None):
+        v = self.value(store, window_s, now)
+        if v is None:
+            return None
+        if self.op == "le":
+            return v / self.target if self.target > 0 else 1e9
+        return self.target / v if v > 0 else 1e9
+
+    def budget_remaining(self, value):
+        """Headroom to the bound as a fraction of the bound (negative
+        = violating) — the threshold analog of error budget."""
+        if value is None or self.target == 0:
+            return None
+        if self.op == "le":
+            return (self.target - value) / self.target
+        return (value - self.target) / self.target
+
+    def describe(self):
+        return dict(super().describe(), op=self.op)
+
+
+class CostSLO(ThresholdSLO):
+    """Cost budget: device seconds per 1k valid tokens over the
+    window, read as the delta ratio of two cumulative counter
+    families (the serving cost ledger's)."""
+
+    def __init__(self, name, budget_s_per_1k,
+                 seconds_family="mxnet_tpu_serving_cost_seconds_total",
+                 tokens_family="mxnet_tpu_serving_cost_tokens_total",
+                 match=None, kinds=("device",), kind_label="kind",
+                 description="", registry=None):
+        super().__init__(name, budget_s_per_1k, op="le",
+                         description=description, registry=registry)
+        self.seconds_family = str(seconds_family)
+        self.tokens_family = str(tokens_family)
+        self.match = dict(match or {})
+        self.kinds = tuple(kinds)
+        self.kind_label = kind_label
+
+    def _sum(self, family, want_kinds):
+        fam = self.registry.get(family)
+        if fam is None:
+            return 0.0
+        out = 0.0
+        for values, child in fam._sorted_children():
+            if not _match_labels(fam.labelnames, values, self.match):
+                continue
+            if want_kinds:
+                kind = dict(zip(fam.labelnames, values)) \
+                    .get(self.kind_label)
+                if kind not in self.kinds:
+                    continue
+            out += child.value
+        return out
+
+    def sample(self):
+        return {"seconds": self._sum(self.seconds_family, True),
+                "tokens": self._sum(self.tokens_family, False)}
+
+    def value(self, store, window_s, now=None):
+        s = store.delta(f"{self.name}:seconds", window_s, now)
+        t = store.delta(f"{self.name}:tokens", window_s, now)
+        if s is None or t is None or t[0] <= 0:
+            return None
+        return s[0] * 1e3 / t[0]
+
+    def describe(self):
+        return dict(super().describe(), family=self.seconds_family,
+                    tokens_family=self.tokens_family, match=self.match,
+                    kinds=list(self.kinds),
+                    budget_s_per_1k_tokens=self.target)
+
+
+class GaugeSLO(ThresholdSLO):
+    """Instantaneous-value objective: a callable (or a gauge family
+    sum) compared against the bound — e.g. the router's routable-
+    engine fraction. Windowless: the latest sampled value decides."""
+
+    def __init__(self, name, target, op="ge", value_fn=None, family=None,
+                 match=None, description="", registry=None):
+        super().__init__(name, target, op=op, description=description,
+                         registry=registry)
+        if value_fn is None and family is None:
+            raise ValueError("GaugeSLO needs value_fn or family")
+        self.value_fn = value_fn
+        self.family = str(family) if family is not None else None
+        self.match = dict(match or {})
+
+    def _read(self):
+        if self.value_fn is not None:
+            try:
+                return float(self.value_fn())
+            except Exception:
+                return float("nan")
+        fam = self.registry.get(self.family)
+        if fam is None:
+            return float("nan")
+        return sum(child.value
+                   for values, child in fam._sorted_children()
+                   if _match_labels(fam.labelnames, values, self.match))
+
+    def sample(self):
+        return {"value": self._read()}
+
+    def value(self, store, window_s, now=None):
+        v = store.latest(f"{self.name}:value")
+        if v is None or v != v:        # never sampled, or NaN read
+            return None
+        return v
+
+
+class SloEvaluator:
+    """The per-owner (engine / router) objective set + sample store.
+
+    ``tick()`` samples every objective's cumulative series;
+    ``snapshot()`` answers the ``/slo`` endpoint: per objective the
+    SLI (or value), burn rates over the canonical windows, and error
+    budget remaining over the budget window — and mirrors them onto
+    the ``mxnet_tpu_slo_*`` gauge families so Grafana plots budgets
+    and burns without scraping JSON.
+    """
+
+    def __init__(self, owner_id, registry=None, budget_s=None,
+                 scale=None):
+        self.owner_id = str(owner_id)
+        reg = registry if registry is not None else REGISTRY
+        self._scale = float(scale) if scale is not None else window_scale()
+        self.budget_s = (float(budget_s) if budget_s is not None
+                         else envvars.get("MXNET_TPU_SLO_BUDGET_S")
+                         * self._scale)
+        self.windows = OrderedDict(
+            (label, s * self._scale) for label, s in BURN_WINDOWS.items())
+        self.store = SampleStore(max_age_s=max(
+            self.budget_s, max(self.windows.values())) * 1.25)
+        self.objectives = OrderedDict()
+        self._start_mono = time.monotonic()
+        self._lock = threading.Lock()
+        self._g_target = reg.gauge(
+            "mxnet_tpu_slo_objective",
+            "declared SLO target (ratio objectives) or bound "
+            "(threshold objectives)", ("slo",))
+        self._g_budget = reg.gauge(
+            "mxnet_tpu_slo_error_budget_remaining",
+            "error budget remaining over the budget window (1 = "
+            "untouched, 0 = spent, negative = blown)", ("slo",))
+        self._g_burn = reg.gauge(
+            "mxnet_tpu_slo_burn_rate",
+            "error-budget burn-rate multiple per trailing window "
+            "(1 = sustainable)", ("slo", "window"))
+
+    @property
+    def scale(self):
+        """The window multiplier every duration here was scaled by
+        (drills shrink hours to seconds through it)."""
+        return self._scale
+
+    def window_s(self, w):
+        """Resolve a window spec — a canonical label (``"5m"``…) or
+        raw pre-scale seconds — into scaled seconds."""
+        if isinstance(w, str):
+            return self.windows[w]
+        return float(w) * self._scale
+
+    def _label(self, slo):
+        return f"{self.owner_id}:{slo.name}"
+
+    def add(self, slo):
+        with self._lock:
+            if slo.name in self.objectives:
+                raise ValueError(f"SLO {slo.name!r} already declared")
+            self.objectives[slo.name] = slo
+        self._g_target.labels(slo=self._label(slo)).set(slo.target)
+        return slo
+
+    def get(self, name):
+        with self._lock:
+            return self.objectives.get(name)
+
+    def tick(self, now=None):
+        """Sample every objective's series into the store."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            objectives = list(self.objectives.values())
+        for slo in objectives:
+            try:
+                samples = slo.sample()
+            except Exception:
+                continue        # one broken reader must not stop the rest
+            for suffix, value in samples.items():
+                self.store.record(f"{slo.name}:{suffix}", now, value)
+        return now
+
+    def burn(self, name, window_s, now=None):
+        slo = self.get(name)
+        if slo is None:
+            return None
+        return slo.burn_rate(self.store, window_s, now)
+
+    def _budget_window(self, now):
+        return min(self.budget_s, max(1e-9, now - self._start_mono))
+
+    def evaluate(self, slo, now=None):
+        """One objective's full answer (the /slo row)."""
+        now = time.monotonic() if now is None else now
+        budget_w = self._budget_window(now)
+        out = {"objective": slo.name, **slo.describe(),
+               "budget_window_s": round(budget_w, 3)}
+        burns = {}
+        for label, w in self.windows.items():
+            b = slo.burn_rate(self.store, w, now)
+            burns[label] = round(b, 4) if b is not None else None
+        out["burn_rates"] = burns
+        if slo.kind == "ratio":
+            sli = slo.sli(self.store, budget_w, now)
+            out["sli"] = round(sli, 6) if sli is not None else None
+            budget = 1.0 - slo.target
+            if sli is None:
+                eb = None
+            elif budget <= 0:
+                eb = 1.0 if sli >= 1.0 else 0.0
+            else:
+                eb = 1.0 - (1.0 - sli) / budget
+            out["error_budget_remaining"] = (round(eb, 6)
+                                             if eb is not None else None)
+            out["met"] = sli is None or sli >= slo.target
+        else:
+            value = slo.value(self.store, budget_w, now)
+            out["value"] = (round(value, 6) if value is not None
+                            else None)
+            eb = slo.budget_remaining(value)
+            out["error_budget_remaining"] = (round(eb, 6)
+                                             if eb is not None else None)
+            ok = slo.ok(value)
+            out["met"] = True if ok is None else bool(ok)
+        label = self._label(slo)
+        if out["error_budget_remaining"] is not None:
+            self._g_budget.labels(slo=label) \
+                .set(out["error_budget_remaining"])
+        for wlabel, b in burns.items():
+            if b is not None:
+                self._g_burn.labels(slo=label, window=wlabel).set(b)
+        return out
+
+    def snapshot(self, now=None, tick=True):
+        """The ``/slo`` body. ``tick=True`` samples first, so a
+        scrape right after startup still has something to diff."""
+        now = time.monotonic() if now is None else now
+        if tick:
+            self.tick(now)
+        with self._lock:
+            objectives = list(self.objectives.values())
+        return {"owner": self.owner_id,
+                "budget_s": self.budget_s,
+                "window_scale": self._scale,
+                "windows_s": {k: round(v, 3)
+                              for k, v in self.windows.items()},
+                "uptime_s": round(now - self._start_mono, 3),
+                "objectives": {slo.name: self.evaluate(slo, now)
+                               for slo in objectives}}

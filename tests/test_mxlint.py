@@ -167,6 +167,29 @@ def test_suppression_mechanics():
         "thread-unnamed", "wall-clock-delta"]
 
 
+def test_alert_rule_family_cross_check():
+    # the SLO/alert layer's family references resolve like dashboard
+    # queries: a rule over a renamed family must fail lint. Checked in
+    # finalize under full_scan (declarations span the whole repo scan).
+    p = TelemetryConsistencyPass()
+    project = core.Project(root=ROOT, passes=[p])
+    with open(os.path.join(FIXTURES, "telemetry_fixture.py"),
+              encoding="utf-8") as fh:
+        source = fh.read()
+    project.lint_source(source, "fixtures/telemetry_fixture.py")
+    project.full_scan = True
+    findings = [f for f in project.finalize()
+                if f.rule == "alert-rule-family"]
+    fams = sorted(f.message.split("family ")[1].split()[0]
+                  for f in findings)
+    # the kwarg ref AND the signature default fire; the rule over the
+    # fixture-declared family does not
+    assert fams == ["mxnet_tpu_fixture_default_gone_ms",
+                    "mxnet_tpu_fixture_gone_total"], findings
+    for f in findings:
+        assert _line_mentions_rule(source, f), f
+
+
 def test_dashboard_cross_check_fires_when_family_missing():
     # a full-scan project that declared NO families must flag every
     # family the committed Grafana dashboard queries

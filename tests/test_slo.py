@@ -1,0 +1,664 @@
+"""SLO engine tests (ISSUE 10): burn-rate math goldens, the alert
+state machine, absence rules, OpenMetrics exemplar render/parse/merge
+round-trips, flight-bundle dedupe across watchdog/page triggers, the
+induced-overload drill (a 2-engine router flooded past its latency
+SLO: fast-burn alert walks pending→firing with a retrievable trace
+exemplar, ONE bundle, resolves after the load drops), and the
+``MXNET_TPU_SLO=0`` disabled-path microbench guard.
+
+CPU-only: stub models, scaled-down SLO windows
+(``MXNET_TPU_SLO_WINDOW_SCALE``) so the SRE-workbook hour windows run
+in seconds.
+"""
+import glob
+import json
+import os
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mxnet_tpu import nd
+from mxnet_tpu.serving import ServingEngine, ServingRouter
+from mxnet_tpu.telemetry import alerts as alerts_mod
+from mxnet_tpu.telemetry import recorder as flight
+from mxnet_tpu.telemetry import slo as slo_mod
+from mxnet_tpu.telemetry import spans
+from mxnet_tpu.telemetry.expo import (merge_prometheus_texts,
+                                      parse_exemplar,
+                                      parse_prometheus_text)
+from mxnet_tpu.telemetry.registry import MetricsRegistry
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(ROOT, "tools")
+if TOOLS not in sys.path:
+    sys.path.insert(0, TOOLS)
+
+
+def _get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, r.read().decode()
+
+
+def _get_json(url, timeout=10):
+    return json.loads(_get(url, timeout)[1])
+
+
+class StubModel:
+    def __init__(self, delay=0.0):
+        self.delay = delay
+
+    def __call__(self, ids, token_types, valid_length, segment_ids,
+                 positions):
+        if self.delay:
+            time.sleep(self.delay)
+        return nd.array(ids.asnumpy().astype(np.float32)[..., None])
+
+
+class FakeRatio(slo_mod.RatioSLO):
+    """Ratio objective whose cumulative good/total counters the test
+    scripts directly — burn-rate goldens without a registry."""
+
+    def __init__(self, name="fake", target=0.99):
+        super().__init__(name, target, registry=MetricsRegistry())
+        self.g = 0.0
+        self.t = 0.0
+
+    def good_total(self):
+        return self.g, self.t
+
+
+# ---------------------------------------------------------------------------
+# sample store + burn-rate math goldens
+# ---------------------------------------------------------------------------
+
+def test_sample_store_windowed_delta_and_prune():
+    store = slo_mod.SampleStore(max_age_s=10.0)
+    for i in range(6):
+        store.record("k", 100.0 + i, 10.0 * i)
+    # full window: newest (105, 50) vs anchor at 105-3=102 -> (102, 20)
+    d, span = store.delta("k", 3.0)
+    assert (d, span) == (30.0, 3.0)
+    # window wider than history: falls back to the oldest (partial
+    # coverage answers honestly instead of not at all)
+    d, span = store.delta("k", 1000.0)
+    assert (d, span) == (50.0, 5.0)
+    assert store.latest("k") == 50.0
+    assert store.delta("missing", 3.0) is None
+    # prune keeps ONE sample older than the horizon as the anchor
+    store.record("k", 200.0, 60.0)
+    d, span = store.delta("k", 1000.0)
+    assert d == 60.0 - 10.0 * (len(store._series["k"]) - 2) or d > 0
+
+
+def test_ratio_sli_burn_rate_and_budget_goldens():
+    slo = FakeRatio(target=0.99)
+    store = slo_mod.SampleStore(max_age_s=100.0)
+    now = 1000.0
+    for i, (g, t) in enumerate([(0, 0), (90, 100), (180, 200)]):
+        slo.g, slo.t = float(g), float(t)
+        for k, v in slo.sample().items():
+            store.record(f"fake:{k}", now + i, v)
+    # window covering both ticks: good 180/200 -> SLI 0.9 exactly
+    assert slo.sli(store, 10.0, now + 2) == pytest.approx(0.9)
+    # burn = (1-SLI)/(1-target) = 0.1/0.01 = 10x
+    assert slo.burn_rate(store, 10.0, now + 2) == pytest.approx(10.0)
+    # zero traffic in the window is NOT an SLI of 1.0
+    store.record("fake:good", now + 3, 180.0)
+    store.record("fake:total", now + 3, 200.0)
+    assert slo.sli(store, 0.5, now + 3.1) is None
+    # a target of 1.0 makes any error a capped-infinite burn, and a
+    # perfect window a zero burn
+    perfect = FakeRatio(target=1.0)
+    perfect.name = "perfect"
+    assert perfect.burn_rate(store, 10.0, now + 2) is None  # no samples
+    for i, (g, t) in enumerate([(0, 0), (99, 100)]):
+        perfect.g, perfect.t = float(g), float(t)
+        for k, v in perfect.sample().items():
+            store.record(f"perfect:{k}", now + i, v)
+    assert perfect.burn_rate(store, 10.0, now + 1) == pytest.approx(1e9)
+    clean = FakeRatio(target=1.0)
+    clean.name = "clean"
+    for i, (g, t) in enumerate([(0, 0), (100, 100)]):
+        clean.g, clean.t = float(g), float(t)
+        for k, v in clean.sample().items():
+            store.record(f"clean:{k}", now + i, v)
+    assert clean.burn_rate(store, 10.0, now + 1) == 0.0
+
+
+def test_latency_slo_bucket_snapping_and_exact_counts():
+    reg = MetricsRegistry()
+    hist = reg.histogram("mxnet_tpu_t_latency_ms", "t",
+                         ("engine_id", "stage"),
+                         buckets=(10.0, 50.0, 100.0, 500.0))
+    child = hist.labels(engine_id="e0", stage="total")
+    for v in (5, 30, 60, 200, 700):
+        child.observe(v)
+    slo = slo_mod.LatencySLO("lat", threshold_ms=40.0, target=0.9,
+                             family="mxnet_tpu_t_latency_ms",
+                             match={"engine_id": "e0", "stage": "total"},
+                             registry=reg)
+    # 40ms snaps UP to the 50ms boundary: good = cumulative count at
+    # le=50 (5, 30) -> 2 of 5; the read is exact, not interpolated
+    assert slo.effective_bound() == 50.0
+    assert slo.good_total() == (2.0, 5.0)
+    # over every finite bucket: good means "finished at all"
+    wild = slo_mod.LatencySLO("lat2", threshold_ms=1e9,
+                              family="mxnet_tpu_t_latency_ms",
+                              registry=reg)
+    assert wild.effective_bound() is None
+    assert wild.good_total() == (5.0, 5.0)
+    # family not created yet: zeros, not a crash
+    ghost = slo_mod.LatencySLO("lat3", 10.0, family="mxnet_tpu_t_none",
+                               registry=reg)
+    assert ghost.good_total() == (0.0, 0.0)
+    assert ghost.effective_bound() is None
+
+
+def test_latency_slo_exemplars_only_above_bound_slowest_first():
+    reg = MetricsRegistry()
+    hist = reg.histogram("mxnet_tpu_t2_latency_ms", "t", ("stage",),
+                         buckets=(10.0, 100.0, 1000.0))
+    child = hist.labels(stage="total")
+    child.observe(5, exemplar="fast-trace")       # le=10: met objective
+    child.observe(300, exemplar="slow-trace")     # le=1000
+    child.observe(5000, exemplar="awful-trace")   # +Inf
+    slo = slo_mod.LatencySLO("lat", threshold_ms=100.0,
+                             family="mxnet_tpu_t2_latency_ms",
+                             match={"stage": "total"}, registry=reg)
+    ex = slo.exemplars()
+    # the fast trace met the objective: it is not evidence
+    assert [e["trace_id"] for e in ex] == ["awful-trace", "slow-trace"]
+    assert ex[0]["bucket_le"] == "+Inf"
+    assert ex[1]["value_ms"] == pytest.approx(300.0)
+
+
+def test_availability_slo_counts_outcome_labels():
+    reg = MetricsRegistry()
+    c = reg.counter("mxnet_tpu_t_requests_total", "t",
+                    ("engine_id", "event"))
+    c.labels(engine_id="e0", event="completed").inc(97)
+    c.labels(engine_id="e0", event="failed").inc(2)
+    c.labels(engine_id="e0", event="rejected_queue_full").inc(1)
+    c.labels(engine_id="e0", event="submitted").inc(100)  # neither side
+    c.labels(engine_id="e1", event="failed").inc(50)      # other engine
+    slo = slo_mod.AvailabilitySLO("avail", target=0.999,
+                                  family="mxnet_tpu_t_requests_total",
+                                  match={"engine_id": "e0"},
+                                  registry=reg)
+    assert slo.good_total() == (97.0, 100.0)
+
+
+def test_threshold_cost_slo_windowed_value_and_budget():
+    reg = MetricsRegistry()
+    secs = reg.counter("mxnet_tpu_t_cost_seconds_total", "t",
+                       ("engine_id", "kind"))
+    toks = reg.counter("mxnet_tpu_t_cost_tokens_total", "t",
+                       ("engine_id",))
+    slo = slo_mod.CostSLO("cost", budget_s_per_1k=2.0,
+                          seconds_family="mxnet_tpu_t_cost_seconds_total",
+                          tokens_family="mxnet_tpu_t_cost_tokens_total",
+                          registry=reg)
+    store = slo_mod.SampleStore(100.0)
+    now = 50.0
+
+    def tick(i):
+        for k, v in slo.sample().items():
+            store.record(f"cost:{k}", now + i, v)
+
+    tick(0)
+    secs.labels(engine_id="e0", kind="device").inc(3.0)
+    secs.labels(engine_id="e0", kind="compile").inc(99.0)   # not billed
+    toks.labels(engine_id="e0").inc(1000)
+    tick(1)
+    # 3 device-seconds per 1000 tokens = 3.0 s/1k vs bound 2.0
+    assert slo.value(store, 10.0, now + 1) == pytest.approx(3.0)
+    assert slo.burn_rate(store, 10.0, now + 1) == pytest.approx(1.5)
+    assert slo.budget_remaining(3.0) == pytest.approx(-0.5)
+    assert slo.ok(3.0) is False
+    assert slo.ok(1.5) is True
+    # lower-is-bad ("ge") thresholds invert the violation multiple
+    up = slo_mod.GaugeSLO("up", target=0.5, op="ge",
+                          value_fn=lambda: 0.25, registry=reg)
+    store2 = slo_mod.SampleStore(100.0)
+    store2.record("up:value", now, up._read())
+    assert up.value(store2, 1.0, now) == pytest.approx(0.25)
+    assert up.burn_rate(store2, 1.0, now) == pytest.approx(2.0)
+    assert up.budget_remaining(0.25) == pytest.approx(-0.5)
+
+
+# ---------------------------------------------------------------------------
+# alert rules: absence + the burn-rate state machine
+# ---------------------------------------------------------------------------
+
+def test_absence_rule_never_created_stalled_and_moving():
+    reg = MetricsRegistry()
+    ev = slo_mod.SloEvaluator("abs-t", registry=reg, scale=0.01,
+                              budget_s=1000.0)
+    rule = alerts_mod.AbsenceRule("beat", "mxnet_tpu_t_beats_total",
+                                  window="5m", registry=reg)
+    now0 = time.monotonic()
+    # family never created: absent by definition
+    active, detail = rule.condition(ev, now0)
+    assert active is True and detail["absent"] == "family"
+    c = reg.counter("mxnet_tpu_t_beats_total", "t", ("engine_id",))
+    c.labels(engine_id="e0").inc()
+    rule.sample(ev, now0)
+    # one sample: not enough data -> None, never a false page
+    active, _ = rule.condition(ev, now0)
+    assert active is None
+    c.labels(engine_id="e0").inc()
+    rule.sample(ev, now0 + 1)
+    active, detail = rule.condition(ev, now0 + 1)
+    assert active is False and detail["delta"] == 1.0
+    # the counter stops moving: once the last increment ages out of
+    # the 3s window (5m at scale 0.01), the slice is absent
+    rule.sample(ev, now0 + 4)
+    rule.sample(ev, now0 + 5)
+    active, detail = rule.condition(ev, now0 + 5)
+    assert active is True and detail["delta"] == 0.0
+
+
+def test_burn_rule_state_machine_pending_firing_resolved_inactive():
+    reg = MetricsRegistry()
+    ev = slo_mod.SloEvaluator("sm-t", registry=reg, scale=0.01,
+                              budget_s=1000.0)
+    fake = FakeRatio(target=0.99)
+    ev.add(fake)
+    pages = []
+    daemon = alerts_mod.AlertDaemon(ev, eval_s=3600.0,
+                                    resolved_keep_s=2.0, registry=reg,
+                                    on_page=pages.append)
+    daemon.add_rule(alerts_mod.BurnRateRule(
+        "fake_fast", "fake", long_window="1h", short_window="5m",
+        factor=14.4, severity=alerts_mod.PAGE, for_s=60.0))
+    # driven manually: evaluate_once(now) with a scripted clock — the
+    # daemon thread never starts
+    now0 = time.monotonic()
+    fake.g = fake.t = 0.0
+    assert daemon.evaluate_once(now0) == {"fake_fast": "inactive"}
+    # overload: 0/100 good -> SLI 0 -> burn 100x on both windows
+    fake.t = 100.0
+    assert daemon.evaluate_once(now0 + 1) == {"fake_fast": "pending"}
+    # for_s=60 scaled by 0.01 -> 0.6s dwell: still pending at +0.2s
+    fake.t = 120.0
+    assert daemon.evaluate_once(now0 + 1.2) == {"fake_fast": "pending"}
+    fake.t = 150.0
+    assert daemon.evaluate_once(now0 + 1.8) == {"fake_fast": "firing"}
+    assert len(pages) == 1 and pages[0]["alert"] == "fake_fast"
+    assert pages[0]["severity"] == "page"
+    assert pages[0]["burn_history"], "firing payload carries history"
+    # recovery: healthy traffic walks the short window clean (3s at
+    # scale 0.01) -> resolved
+    state = None
+    for i in range(3, 9):
+        fake.g += 500.0
+        fake.t += 500.0
+        state = daemon.evaluate_once(now0 + i)["fake_fast"]
+        if state == "resolved":
+            break
+    assert state == "resolved"
+    # resolved decays to inactive after resolved_keep_s (2s)
+    fake.g += 500.0
+    fake.t += 500.0
+    final = daemon.evaluate_once(now0 + 12.0)
+    assert final == {"fake_fast": "inactive"}
+    # the walk is on the transition log, pending first
+    snap = daemon.snapshot()
+    walk = [(t["from"], t["to"]) for t in snap["transitions"]]
+    assert walk[:3] == [("inactive", "pending"), ("pending", "firing"),
+                        ("firing", "resolved")]
+    # and on the transitions counter family
+    trans = reg.get("mxnet_tpu_alerts_transitions_total")
+    assert trans.labels(alert="sm-t:fake_fast", to="firing").value == 1
+
+
+def test_alert_rule_validation():
+    reg = MetricsRegistry()
+    ev = slo_mod.SloEvaluator("val-t", registry=reg, scale=1.0,
+                              budget_s=10.0)
+    with pytest.raises(ValueError):
+        alerts_mod.BurnRateRule("x", "slo", severity="sev1")
+    daemon = alerts_mod.AlertDaemon(ev, registry=reg, on_page=lambda p: 0)
+    daemon.add_rule(alerts_mod.BurnRateRule("dup", "nope"))
+    with pytest.raises(ValueError):
+        daemon.add_rule(alerts_mod.BurnRateRule("dup", "nope"))
+    # a rule over an unknown SLO reports, never crashes the loop
+    out = daemon.evaluate_once(time.monotonic())
+    assert out == {"dup": "inactive"}
+    with pytest.raises(ValueError):
+        ev.add(slo_mod.GaugeSLO("bad", 1.0))    # needs value_fn/family
+
+
+# ---------------------------------------------------------------------------
+# OpenMetrics exemplars: render -> parse -> merge round trip
+# ---------------------------------------------------------------------------
+
+def test_histogram_exemplar_render_and_parse_roundtrip():
+    reg = MetricsRegistry()
+    hist = reg.histogram("mxnet_tpu_t3_ms", "t", ("stage",),
+                         buckets=(10.0, 100.0))
+    child = hist.labels(stage="total")
+    child.observe(5.0)
+    child.observe(42.0, exemplar="req-slow-1")
+    child.observe(77.0, exemplar="req-slow-2")   # same bucket, slower
+    text = reg.render_prometheus()
+    ex_lines = [ln for ln in text.splitlines() if " # " in ln]
+    assert len(ex_lines) == 1
+    # per bucket the SLOWEST recent observation wins
+    assert 'trace_id="req-slow-2"' in ex_lines[0]
+    assert 'le="100"' in ex_lines[0]
+    # the sample VALUE parses correctly despite the trailing exemplar
+    # (the old parser dropped everything after '#'  — and with it the
+    # series — corrupting scrape merges)
+    exemplars = {}
+    parsed = parse_prometheus_text(text, exemplars=exemplars)
+    key = 'mxnet_tpu_t3_ms_bucket{stage="total",le="100"}'
+    assert parsed[key] == 3.0
+    assert exemplars[key]["trace_id"] == "req-slow-2"
+    assert exemplars[key]["value"] == pytest.approx(77.0)
+    assert parsed['mxnet_tpu_t3_ms_count{stage="total"}'] == 3.0
+
+
+def test_exemplar_stale_champion_decays(monkeypatch):
+    # the slowest-ever exemplar would pin a trace id the bounded ring
+    # evicted long ago (a dead /alerts link — caught by the CLI drill):
+    # past EXEMPLAR_MAX_AGE_S any new exemplar takes the slot
+    import mxnet_tpu.telemetry.registry as reg_mod
+    monkeypatch.setattr(reg_mod, "EXEMPLAR_MAX_AGE_S", 0.05)
+    reg = MetricsRegistry()
+    hist = reg.histogram("mxnet_tpu_t6_ms", "t", buckets=(100.0,))
+    hist.observe(90.0, exemplar="old-champion")
+    hist.observe(50.0, exemplar="newer-but-faster")
+    assert hist.exemplars()[100.0]["trace_id"] == "old-champion"
+    time.sleep(0.08)
+    hist.observe(50.0, exemplar="fresh")
+    assert hist.exemplars()[100.0]["trace_id"] == "fresh"
+
+
+def test_parse_exemplar_syntax():
+    ex = parse_exemplar('{trace_id="abc",x="y"} 93.5 1690.25')
+    assert ex["trace_id"] == "abc"
+    assert ex["labels"]["x"] == "y"
+    assert ex["value"] == pytest.approx(93.5)
+    assert ex["ts"] == pytest.approx(1690.25)
+    assert parse_exemplar('{trace_id="abc"} 12') ["ts"] is None
+    assert parse_exemplar("") is None
+    assert parse_exemplar("no-braces 1") is None
+    assert parse_exemplar('{trace_id="a"} not-a-number') is None
+    # a '#' INSIDE a quoted label value is not an exemplar marker
+    parsed = parse_prometheus_text(
+        'mxnet_tpu_t_x{op="a # b"} 4\n')
+    assert parsed == {'mxnet_tpu_t_x{op="a # b"}': 4.0}
+
+
+def test_merge_prometheus_texts_keeps_worst_exemplar():
+    a = ("# TYPE mxnet_tpu_t4_ms histogram\n"
+         'mxnet_tpu_t4_ms_bucket{le="100"} 2 # {trace_id="t-a"} 60 1.0\n'
+         'mxnet_tpu_t4_ms_bucket{le="+Inf"} 2\n'
+         'mxnet_tpu_t4_ms_sum 70\n'
+         'mxnet_tpu_t4_ms_count 2\n')
+    b = ("# TYPE mxnet_tpu_t4_ms histogram\n"
+         'mxnet_tpu_t4_ms_bucket{le="100"} 1 # {trace_id="t-b"} 90 2.0\n'
+         'mxnet_tpu_t4_ms_bucket{le="+Inf"} 1\n'
+         'mxnet_tpu_t4_ms_sum 90\n'
+         'mxnet_tpu_t4_ms_count 1\n')
+    merged = merge_prometheus_texts([a, b])
+    exemplars = {}
+    parsed = parse_prometheus_text(merged, exemplars=exemplars)
+    # buckets summed, the worst (slowest) exemplar survives
+    assert parsed['mxnet_tpu_t4_ms_bucket{le="100"}'] == 3.0
+    assert exemplars['mxnet_tpu_t4_ms_bucket{le="100"}']["trace_id"] \
+        == "t-b"
+    # and a merged exposition re-merges without corruption
+    again = merge_prometheus_texts([merged])
+    assert parse_prometheus_text(again) == parsed
+
+
+# ---------------------------------------------------------------------------
+# flight-bundle dedupe: one incident, one bundle
+# ---------------------------------------------------------------------------
+
+def test_bundle_dedupe_two_causes_one_bundle(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_FLIGHT_DIR", str(tmp_path))
+    rec = flight.RECORDER
+    rec._last_bundle = None
+    rec._last_dump.clear()
+    p1 = rec.dump("alert_latency_fast_burn",
+                  extra={"alert": {"alert": "latency_fast_burn"}})
+    # a second page / watchdog trip seconds later describes the SAME
+    # incident: the bundle is AMENDED (causes grows, the new trigger's
+    # extras land namespaced under amendments — NOT a flat merge that
+    # would overwrite the first alert's payload), not raced
+    p2 = rec.dump("alert_availability_fast_burn",
+                  extra={"alert": {"alert": "availability_fast_burn"}})
+    assert p1 == p2
+    assert len(os.listdir(tmp_path)) == 1
+    with open(os.path.join(p1, "meta.json")) as f:
+        meta = json.load(f)
+    assert meta["causes"] == ["alert_latency_fast_burn",
+                              "alert_availability_fast_burn"]
+    # the FIRST pager's evidence is intact, the second's is kept too
+    assert meta["alert"]["alert"] == "latency_fast_burn"
+    assert meta["amendments"][0]["alert"]["alert"] \
+        == "availability_fast_burn"
+    assert meta["amendments"][0]["reason"] \
+        == "alert_availability_fast_burn"
+    # min_interval_s=0 (SIGUSR2, tests) always writes FRESH
+    p3 = rec.dump("alert_latency_fast_burn", min_interval_s=0.0)
+    assert p3 != p1
+    assert len(os.listdir(tmp_path)) == 2
+    rec._last_bundle = None
+    rec._last_dump.clear()
+
+
+# ---------------------------------------------------------------------------
+# engine + router SLO surfaces
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def slo_drill_env(monkeypatch, tmp_path):
+    """Drill-speed SLO clock + kept-trace config, restored on exit."""
+    monkeypatch.setenv("MXNET_TPU_SLO_WINDOW_SCALE", "0.01")
+    monkeypatch.setenv("MXNET_TPU_SLO_EVAL_S", "0.1")
+    monkeypatch.setenv("MXNET_TPU_SLO_LATENCY_MS", "30")
+    monkeypatch.setenv("MXNET_TPU_FLIGHT_DIR", str(tmp_path / "flight"))
+    saved = (spans.enabled(), spans.RECORDER.slow_ms)
+    spans.configure(enabled=True, slow_ms=40.0)
+    spans.reset()
+    rec = flight.RECORDER
+    rec._last_bundle = None
+    rec._last_dump.clear()
+    yield str(tmp_path / "flight")
+    spans.configure(enabled=saved[0], slow_ms=saved[1])
+    spans.reset()
+    rec._last_bundle = None
+    rec._last_dump.clear()
+
+
+def test_engine_slo_and_alerts_endpoints(slo_drill_env):
+    eng = ServingEngine(StubModel(), bucket_lens=(16,), max_rows=2,
+                        engine_id="slo-ep0")
+    with eng:
+        srv = eng.expose()
+        eng.warmup()
+        for _ in range(4):
+            eng.infer([1, 2, 3], timeout=30)
+        slo = _get_json(srv.url("/slo"))
+        assert slo["owner"] == "slo-ep0"
+        assert set(slo["objectives"]) >= {"serving_latency",
+                                          "serving_availability"}
+        lat = slo["objectives"]["serving_latency"]
+        assert lat["kind"] == "ratio"
+        assert set(lat["burn_rates"]) == {"5m", "30m", "1h", "6h"}
+        al = _get_json(srv.url("/alerts"))
+        names = {r["alert"] for r in al["rules"]}
+        assert {"serving_latency_fast_burn", "serving_latency_slow_burn",
+                "serving_availability_fast_burn"} <= names
+        page = [r for r in al["rules"]
+                if r["alert"] == "serving_latency_fast_burn"][0]
+        assert page["severity"] == "page"
+        assert eng.alerts is not None
+    # after stop the daemon thread is gone
+    assert not any(t.name.startswith("mxnet_tpu_alerts_slo-ep0")
+                   for t in __import__("threading").enumerate())
+
+
+def test_router_fleet_slo_aggregates_local_and_remote_seats(
+        slo_drill_env):
+    local = ServingEngine(StubModel(), bucket_lens=(16,), max_rows=2,
+                          engine_id="slo-loc")
+    remote = ServingEngine(StubModel(), bucket_lens=(16,), max_rows=2,
+                           engine_id="slo-rem")
+    with local, remote:
+        rsrv = remote.expose()
+        router = ServingRouter(poll_interval_s=0.2,
+                               router_id="slo-router")
+        router.add_engine("slo-loc", local)
+        router.add_engine("slo-rem", f"http://{rsrv.host}:{rsrv.port}")
+        with router:
+            srv = router.expose()
+            for _ in range(6):
+                router.infer([1, 2, 3], timeout=30)
+            time.sleep(0.5)
+            slo = _get_json(srv.url("/slo"))
+            assert set(slo["objectives"]) == {"fleet_latency",
+                                              "fleet_availability",
+                                              "fleet_engines_up"}
+            # seat-level snapshots ride under the fleet view — the
+            # LOCAL seat via the handle, the REMOTE seat scraped
+            assert set(slo["engines"]) == {"slo-loc", "slo-rem"}
+            assert "serving_latency" in \
+                slo["engines"]["slo-rem"]["objectives"]
+            up = slo["objectives"]["fleet_engines_up"]
+            assert up["value"] == pytest.approx(1.0)
+            assert up["met"] is True
+            al = _get_json(srv.url("/alerts"))
+            assert set(al["engines"]) == {"slo-loc", "slo-rem"}
+            assert al["fleet_firing"] == 0
+            # loadgen report carries the /slo compliance section
+            from serve_loadgen import run_load
+            report = run_load(router, n_clients=2,
+                              requests_per_client=2, min_len=4,
+                              max_len=8, vocab=50,
+                              metrics_url=srv.url("/metrics"))
+            assert "slo" in report
+            assert "fleet_availability" in report["slo"]
+            row = report["slo"]["fleet_availability"]
+            assert row["met"] is True
+            assert row["error_budget_remaining"] is not None
+
+
+# ---------------------------------------------------------------------------
+# the induced-overload drill (ISSUE acceptance)
+# ---------------------------------------------------------------------------
+
+def test_overload_drill_router_fast_burn_fires_and_resolves(
+        slo_drill_env):
+    """Flood a 2-engine router past the latency SLO: the fleet
+    fast-burn alert walks pending→firing with ≥1 exemplar whose trace
+    is retrievable via /traces/<id>, ONE flight bundle carries the
+    alert + burn history, and the alert resolves after the load
+    drops."""
+    from serve_loadgen import overload_drill
+
+    flight_dir = slo_drill_env
+    e0 = ServingEngine(StubModel(delay=0.06), bucket_lens=(64,),
+                       max_rows=2, engine_id="drill-e0",
+                       max_queue_depth=64)
+    e1 = ServingEngine(StubModel(delay=0.06), bucket_lens=(64,),
+                       max_rows=2, engine_id="drill-e1",
+                       max_queue_depth=64)
+    with e0, e1:
+        router = ServingRouter(engines=[e0, e1], poll_interval_s=0.2,
+                               router_id="drill-router")
+        with router:
+            srv = router.expose()
+            base = f"http://{srv.host}:{srv.port}"
+
+            def get_trace(tid):
+                from urllib.parse import quote
+                try:
+                    return _get_json(base + "/traces/"
+                                     + quote(tid, safe=""))
+                except Exception:
+                    return None
+
+            rep = overload_drill(router, get_trace=get_trace,
+                                 n_clients=8, min_len=8, max_len=48,
+                                 fire_timeout_s=60,
+                                 resolve_timeout_s=60)
+            # the walk: pending dwelt, fired, resolved after recovery
+            assert rep["alert"] == "fleet_latency_fast_burn"
+            assert ("pending", "firing") in \
+                [(t["from"], t["to"]) for t in rep["transitions"]]
+            assert rep["resolved_state"] in ("resolved", "inactive")
+            # evidence: the exemplar's trace resolved over HTTP with
+            # actual spans in it
+            assert rep["exemplar"]["trace_id"]
+            assert rep["exemplar_trace_spans"] >= 1
+            # budget blown while firing
+            assert rep["error_budget_remaining"] is not None
+            assert rep["error_budget_remaining"] < 1.0
+            # the /alerts surface shows the firing in its transition
+            # log too (engine daemons may ALSO have fired — that is
+            # the dedupe test below)
+            al = _get_json(base + "/alerts")
+            fleet_walk = [(t["alert"], t["to"]) for t in al["transitions"]]
+            assert ("fleet_latency_fast_burn", "firing") in fleet_walk
+    # EXACTLY ONE bundle: the router page and any engine-level pages
+    # within the dedupe window share it, tagged with every cause
+    bundles = glob.glob(os.path.join(flight_dir, "*"))
+    assert len(bundles) == 1, bundles
+    with open(os.path.join(bundles[0], "meta.json")) as f:
+        meta = json.load(f)
+    assert any(c.startswith("alert_") for c in meta["causes"])
+    assert "alert" in meta
+    assert meta["alert"]["burn_history"]
+    # the bundle's alert payload carries the exemplar evidence when
+    # the first pager was a latency rule
+    first = meta["alert"]
+    if first.get("exemplars") is not None:
+        assert first["exemplars"], first
+
+
+# ---------------------------------------------------------------------------
+# disabled path: MXNET_TPU_SLO=0 costs ~nothing
+# ---------------------------------------------------------------------------
+
+def test_slo_disabled_path_stays_cheap(monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_SLO", "0")
+    eng = ServingEngine(StubModel(), bucket_lens=(16,), max_rows=2,
+                        engine_id="slo-off")
+    with eng:
+        srv = eng.expose()
+        eng.warmup()
+        eng.infer([1, 2, 3], timeout=30)
+        assert eng.alerts is None
+        for path in ("/slo", "/alerts"):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _get(srv.url(path))
+            assert ei.value.code == 404
+        # no alert daemon thread, no exemplar recording
+        assert not any(t.name.startswith("mxnet_tpu_alerts_slo-off")
+                       for t in __import__("threading").enumerate())
+    text = eng.stats.total_ms._hist  # engine-labeled histogram child
+    assert text.exemplars() == {}
+    # the hot-path cost with exemplars off is one histogram observe
+    reg = MetricsRegistry()
+    hist = reg.histogram("mxnet_tpu_t5_ms", "t", buckets=(10.0, 100.0))
+    n = 20000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        hist.observe(12.5)
+    per = (time.perf_counter() - t0) / n
+    assert per < 50e-6, f"observe {per * 1e6:.2f}us"
+    # and WITH an exemplar it stays micro-cheap (budget ~50x observed)
+    t0 = time.perf_counter()
+    for i in range(n):
+        hist.observe(12.5, exemplar="t")
+    per = (time.perf_counter() - t0) / n
+    assert per < 100e-6, f"observe+exemplar {per * 1e6:.2f}us"

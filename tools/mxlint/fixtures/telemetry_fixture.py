@@ -37,3 +37,19 @@ def span_paired():
 def span_escapes():
     sp = _spans.start_span("fixture/escapes")
     return sp                               # clean: caller owns it
+
+
+def rule_over_declared_family(LatencySLO):
+    return LatencySLO("fx", 100,
+                      family="mxnet_tpu_fixture_total")       # clean
+
+
+def rule_over_renamed_family(AbsenceRule):
+    return AbsenceRule(
+        "fx", family="mxnet_tpu_fixture_gone_total")  # alert-rule-family
+
+
+def rule_default_family(threshold_ms,
+                        family="mxnet_tpu_fixture_default_gone_ms"):
+    # alert-rule-family fires on the signature default (line above)
+    return threshold_ms, family

@@ -18,7 +18,15 @@
   somewhere in the scanned code (``_bucket``/``_sum``/``_count``
   histogram suffixes stripped). A dashboard panel watching a family
   that doesn't exist renders an empty graph in the exact incident it
-  was built for. Families declared via f-strings match as patterns.
+  was built for. Families declared via f-strings match as patterns;
+- ``alert-rule-family``   — every metric family an SLO objective or
+  alert rule reads (``family=`` / ``seconds_family=`` /
+  ``tokens_family=`` arguments and signature defaults of the
+  ``*SLO`` / ``AbsenceRule`` constructors) must be declared somewhere
+  in the scanned code — the same machinery as the dashboard check. A
+  rule over a renamed family would evaluate over nothing and the
+  alert it guards would never fire, which is strictly worse than no
+  alert: it reads as green.
 """
 from __future__ import annotations
 
@@ -34,25 +42,37 @@ from ._util import str_const, terminal_attr
 _REGISTRY_RECEIVERS = {"REGISTRY", "_REGISTRY", "registry", "reg"}
 _FAMILY_CTORS = {"counter", "gauge", "histogram"}
 _PROM_NAME = re.compile(r"mxnet_tpu_[a-z0-9_]+")
+#: constructors whose family-reading arguments the alert-rule
+#: cross-check tracks (the SLO/alerting layer of telemetry/slo.py +
+#: telemetry/alerts.py, by conventional class name)
+_SLO_CTORS = {"LatencySLO", "AvailabilitySLO", "CostSLO", "GaugeSLO",
+              "RatioSLO", "ThresholdSLO", "AbsenceRule"}
+
+
+def _is_family_arg(name):
+    return name == "family" or (name or "").endswith("_family")
 
 
 class TelemetryConsistencyPass(LintPass):
     name = "telemetry-consistency"
     rules = ("metric-labels", "metric-engine-label", "span-leak",
-             "dashboard-family")
+             "dashboard-family", "alert-rule-family")
 
     def __init__(self):
         # family -> list of (labels tuple | None, relpath, line)
         self.declared = {}
         self.patterns = []          # (regex, relpath, line) f-string fams
+        self.rule_refs = []         # (family, relpath, line) SLO/alert refs
 
     def check(self, ctx):
         out = []
         for node in ast.walk(ctx.tree):
             if isinstance(node, ast.Call):
                 out.extend(self._check_family_decl(ctx, node))
+                self._collect_rule_ref(ctx, node)
             elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 out.extend(self._check_span_pairing(ctx, node))
+                self._collect_sig_family_defaults(ctx, node)
         return out
 
     # -- metric family declarations ----------------------------------------
@@ -95,6 +115,37 @@ class TelemetryConsistencyPass(LintPass):
             if all(v is not None for v in vals):
                 return tuple(vals)
         return None                 # dynamic: unknown
+
+    # -- SLO / alert-rule family references ----------------------------------
+    def _collect_rule_ref(self, ctx, call):
+        """``LatencySLO(..., family="mxnet_tpu_x")`` and friends: the
+        family the rule will read, resolved against declarations in
+        ``finalize`` (same machinery as the dashboard cross-check)."""
+        if terminal_attr(call.func) not in _SLO_CTORS:
+            return
+        for kw in call.keywords:
+            if not _is_family_arg(kw.arg):
+                continue
+            fam = str_const(kw.value)
+            if fam is not None and fam.startswith("mxnet_tpu_"):
+                self.rule_refs.append((fam, ctx.relpath, kw.value.lineno))
+
+    def _collect_sig_family_defaults(self, ctx, fn):
+        """``def __init__(..., family="mxnet_tpu_x")``: the DEFAULT
+        objective set lives in signature defaults (slo.py/alerts.py),
+        so a renamed family must fail lint there too, not only at
+        explicit call sites."""
+        args = fn.args
+        pairs = list(zip(args.args[len(args.args) - len(args.defaults):],
+                         args.defaults))
+        pairs += [(a, d) for a, d in zip(args.kwonlyargs, args.kw_defaults)
+                  if d is not None]
+        for arg, default in pairs:
+            if not _is_family_arg(arg.arg):
+                continue
+            fam = str_const(default)
+            if fam is not None and fam.startswith("mxnet_tpu_"):
+                self.rule_refs.append((fam, ctx.relpath, default.lineno))
 
     def _fstring_pattern(self, node):
         if not isinstance(node, ast.JoinedStr):
@@ -159,10 +210,27 @@ class TelemetryConsistencyPass(LintPass):
     def finalize(self, project):
         out = self._check_label_consistency()
         if project.full_scan:
+            out.extend(self._check_rule_refs())
             dash_dir = os.path.join(project.root, "tools", "dashboards")
             for path in sorted(glob.glob(os.path.join(dash_dir,
                                                       "*.json"))):
                 out.extend(self._check_dashboard(project, path))
+        return out
+
+    def _check_rule_refs(self):
+        out = []
+        for fam, rel, line in self.rule_refs:
+            base = re.sub(r"_(bucket|sum|count)$", "", fam)
+            if base in self.declared:
+                continue
+            if any(p.match(base) for p, _, _ in self.patterns):
+                continue
+            out.append(Finding(
+                "alert-rule-family", rel, line, 0,
+                f"SLO/alert rule reads family {fam} but no scanned "
+                f"code declares it — the rule would evaluate over "
+                f"nothing and its alert could never fire (renamed "
+                f"family?)"))
         return out
 
     def _check_label_consistency(self):

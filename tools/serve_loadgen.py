@@ -123,6 +123,36 @@ def _fetch_costs(metrics_url, timeout=10.0):
     return body.get("totals")
 
 
+def _fetch_slo(metrics_url, timeout=10.0):
+    """GET the sibling /slo of a /metrics URL; returns the per-
+    objective compliance map — error-budget remaining, burn rates and
+    ``met`` — or None when no SLO evaluator is attached
+    (``MXNET_TPU_SLO=0``, or a pre-SLO engine)."""
+    import urllib.request
+
+    base = metrics_url.rsplit("/metrics", 1)[0]
+    try:
+        with urllib.request.urlopen(base + "/slo", timeout=timeout) as r:
+            body = json.loads(r.read().decode())
+    except Exception:
+        return None
+    objectives = body.get("objectives")
+    if not objectives:
+        return None
+    out = {}
+    for name, row in objectives.items():
+        out[name] = {
+            "met": row.get("met"),
+            "error_budget_remaining": row.get("error_budget_remaining"),
+            "burn_rates": row.get("burn_rates"),
+        }
+        if "sli" in row:
+            out[name]["sli"] = row["sli"]
+        if "value" in row:
+            out[name]["value"] = row["value"]
+    return out
+
+
 def cross_check_costs(client_cost, before, after, slack=0,
                       lost_ledgers=False):
     """Reconcile client-side cost accounting (summed per-request
@@ -671,7 +701,168 @@ def run_load(engine, n_clients=8, requests_per_client=16,
             if tokens:
                 report["cost"]["device_s_per_1k_tokens"] = round(
                     cost_delta["request_s"] * 1e3 / tokens, 6)
+        # SLO compliance after the measured window: error-budget
+        # remaining + burn rates per declared objective (the bench's
+        # serving legs forward this as `slo_compliance`)
+        slo = _fetch_slo(metrics_url)
+        if slo is not None:
+            report["slo"] = slo
     return report
+
+
+def overload_drill(target, alerts_fn=None, get_trace=None, alert=None,
+                   n_clients=8, min_len=16, max_len=64, vocab=1000,
+                   deadline_ms=None, fire_timeout_s=60.0,
+                   resolve_timeout_s=120.0, poll_s=0.05, seed=0):
+    """Induced-overload drill: flood ``target`` (a ServingEngine or
+    ServingRouter — same submit surface) with closed-loop traffic
+    until the named fast-burn alert FIRES, then stop the load and wait
+    for it to RESOLVE. Asserts the full SLO-engine contract:
+
+    - the alert walks the state machine ``pending → firing`` (read
+      off the /alerts transition log, so a short pending dwell can't
+      be missed between polls);
+    - the firing payload carries ≥1 OpenMetrics exemplar whose trace
+      id resolves to a retrievable trace (``get_trace``), i.e. the
+      alert links to evidence, not just a number;
+    - after the load stops, the alert leaves ``firing`` (resolved).
+
+    ``alerts_fn``/``get_trace`` default to the target's own in-process
+    surfaces; pass URL-backed callables to drill a remote fleet. The
+    caller is expected to have tuned the SLO knobs for drill time
+    scales (``MXNET_TPU_SLO_WINDOW_SCALE``, ``MXNET_TPU_SLO_EVAL_S``,
+    ``MXNET_TPU_SLO_LATENCY_MS``) BEFORE starting the engines.
+
+    Returns a report dict (states seen, the firing payload, the
+    retrieved exemplar trace, wall timings). Raises AssertionError on
+    any violated contract.
+    """
+    import numpy as np
+
+    is_router = hasattr(target, "scoreboard")
+    if alert is None:
+        alert = ("fleet_latency_fast_burn" if is_router
+                 else "serving_latency_fast_burn")
+    if alerts_fn is None:
+        if not hasattr(target, "alerts_snapshot"):
+            raise ValueError(
+                "overload_drill over a remote target needs an "
+                "alerts_fn (an /alerts fetcher)")
+        alerts_fn = target.alerts_snapshot
+    if get_trace is None:
+        if hasattr(target, "get_trace"):
+            get_trace = target.get_trace
+        else:
+            from mxnet_tpu.telemetry import spans as _spans
+            get_trace = _spans.get_trace
+
+    def rule_row(body):
+        for row in body.get("rules", ()):
+            if row.get("alert") == alert:
+                return row
+        raise AssertionError(
+            f"alert {alert!r} not declared; have "
+            f"{[r.get('alert') for r in body.get('rules', ())]}")
+
+    stop = threading.Event()
+    flood_errors = []
+
+    def flooder(cid):
+        rs = np.random.RandomState(seed + cid)
+        while not stop.is_set():
+            n = int(rs.randint(min_len, max_len + 1))
+            toks = rs.randint(1, vocab, n).astype(np.int32)
+            try:
+                # submit+result, not infer: RouterClient (a remote
+                # drill target) only speaks the submit surface
+                target.submit(toks, deadline_ms=deadline_ms) \
+                    .result(timeout=fire_timeout_s)
+            except Exception as e:
+                # sheds/expiries ARE the overload working; only record
+                # for the report, never abort the flood
+                flood_errors.append(type(e).__name__)
+                time.sleep(0.002)
+
+    threads = [threading.Thread(target=flooder, args=(c,), daemon=True,
+                                name=f"overload_drill_{c}")
+               for c in range(n_clients)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    states_seen = []
+    fired = None
+    try:
+        deadline = time.monotonic() + fire_timeout_s
+        while time.monotonic() < deadline:
+            body = alerts_fn()
+            row = rule_row(body)
+            if not states_seen or states_seen[-1] != row["state"]:
+                states_seen.append(row["state"])
+            if row["state"] == "firing":
+                fired = dict(row)
+                fired["transitions"] = [
+                    t for t in body.get("transitions", ())
+                    if t.get("alert") == alert]
+                break
+            time.sleep(poll_s)
+        assert fired is not None, (
+            f"alert {alert!r} never fired within {fire_timeout_s}s "
+            f"(states seen: {states_seen}; is the latency SLO tuned "
+            f"below the flooded latency and the window scale small?)")
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10.0)
+    t_fired = time.perf_counter() - t0
+
+    # the pending dwell may be shorter than a poll period: the
+    # transition LOG is the authoritative walk record
+    walked = [(t.get("from"), t.get("to")) for t in fired["transitions"]]
+    assert ("inactive", "pending") in walked or "pending" in states_seen, (
+        f"alert {alert!r} never dwelt pending: {walked}")
+    assert ("pending", "firing") in walked, (
+        f"alert {alert!r} fired without walking pending→firing: {walked}")
+
+    exemplars = fired.get("exemplars") or []
+    assert exemplars, (
+        f"firing {alert!r} carries no exemplars — no retrievable "
+        f"evidence (are exemplars enabled and requests slow enough "
+        f"for tail sampling?)")
+    trace = None
+    exemplar = None
+    for ex in exemplars:
+        trace = get_trace(ex["trace_id"])
+        if trace is not None and trace.get("spans"):
+            exemplar = ex
+            break
+    assert exemplar is not None, (
+        f"none of the {len(exemplars)} exemplar trace ids resolved to "
+        f"a kept trace (exemplars: {exemplars})")
+
+    # recovery: with the load gone the alert must leave firing
+    deadline = time.monotonic() + resolve_timeout_s
+    resolved = False
+    while time.monotonic() < deadline:
+        row = rule_row(alerts_fn())
+        if states_seen[-1] != row["state"]:
+            states_seen.append(row["state"])
+        if row["state"] not in ("firing",):
+            resolved = row["state"]
+            break
+        time.sleep(poll_s)
+    assert resolved, (f"alert {alert!r} still firing "
+                      f"{resolve_timeout_s}s after the load stopped")
+    return {"alert": alert,
+            "states": states_seen,
+            "fired_after_s": round(t_fired, 3),
+            "resolved_state": resolved,
+            "resolved_after_s": round(time.perf_counter() - t0, 3),
+            "exemplar": exemplar,
+            "exemplar_trace_spans": len(trace.get("spans", ())),
+            "error_budget_remaining":
+                fired.get("error_budget_remaining"),
+            "flood_errors": len(flood_errors),
+            "transitions": fired["transitions"]}
 
 
 def _main():
@@ -717,6 +908,16 @@ def _main():
                     "separated list gets client-side failover (a "
                     "router that refuses the connection or answers "
                     "5xx advances the request to the next url)")
+    ap.add_argument("--drill-overload", nargs="?", const="auto",
+                    default=None, metavar="ALERT",
+                    help="instead of the measured run, flood the "
+                    "target past its latency SLO and assert the "
+                    "fast-burn ALERT (default: the target's "
+                    "*_latency_fast_burn) walks pending→firing with "
+                    "a retrievable trace exemplar, then resolves "
+                    "after the load stops. Tune the drill clock "
+                    "first, e.g. MXNET_TPU_SLO_WINDOW_SCALE=0.01 "
+                    "MXNET_TPU_SLO_EVAL_S=0.2 MXNET_TPU_SLO_LATENCY_MS=20")
     args = ap.parse_args()
 
     import contextlib
@@ -768,6 +969,46 @@ def _main():
                   file=sys.stderr)
         for eng in engines:
             eng.warmup()
+        if args.drill_overload:
+            alerts_fn = get_trace = None
+            if metrics_url:
+                import urllib.request
+                from urllib.parse import quote
+                base = metrics_url.rsplit("/metrics", 1)[0]
+
+                def alerts_fn():
+                    with urllib.request.urlopen(base + "/alerts",
+                                                timeout=10.0) as r:
+                        return json.loads(r.read().decode())
+
+                def get_trace(tid):
+                    try:
+                        with urllib.request.urlopen(
+                                base + "/traces/" + quote(tid, safe=""),
+                                timeout=10.0) as r:
+                            return json.loads(r.read().decode())
+                    except Exception:
+                        return None
+
+            drill_alert = (None if args.drill_overload == "auto"
+                           else args.drill_overload)
+            if drill_alert is None and args.router_url:
+                # a RouterClient target has no scoreboard attr for the
+                # auto-pick, but the peer IS a router
+                drill_alert = "fleet_latency_fast_burn"
+            drill = overload_drill(
+                target, alerts_fn=alerts_fn, get_trace=get_trace,
+                alert=drill_alert,
+                n_clients=args.clients, min_len=args.min_len,
+                max_len=args.max_len, vocab=args.vocab,
+                deadline_ms=args.deadline_ms)
+            print(json.dumps(drill, indent=2))
+            print(f"# drill OK: {drill['alert']} walked "
+                  f"{'→'.join(drill['states'])}; exemplar trace "
+                  f"{drill['exemplar']['trace_id']} retrieved "
+                  f"({drill['exemplar_trace_spans']} spans)",
+                  file=sys.stderr)
+            return 0
         report = run_load(target, n_clients=args.clients,
                           requests_per_client=args.requests,
                           min_len=args.min_len, max_len=args.max_len,

@@ -33,6 +33,14 @@ tables the top frames by self time (where host CPU goes right now);
 `--costs` fetches the `/costs` cost ledger (an engine's, or a
 router's fleet merge) and tables per-bucket device/compile seconds,
 requests, tokens, and the derived per-request / per-1k-token rates.
+
+`--alerts` fetches the SLO engine's `/alerts` (an engine's, or a
+router's fleet view with every seat's section) and prints the
+one-screen rule table — firing/pending first, with the error-budget-
+remaining column, the observed burn rates against each rule's factor,
+and the exemplar trace ids a firing latency alert links to (paste
+into `--trace <id>`). The exit code goes nonzero while anything is
+firing, so the drill scripts can gate on it.
 """
 from __future__ import annotations
 
@@ -147,7 +155,7 @@ def _base_url(src):
     endpoint path so any of /metrics | /stats | the bare base work)."""
     src = src.rstrip("/")
     for suffix in ("/metrics", "/stats", "/healthz", "/traces",
-                   "/profile", "/costs"):
+                   "/profile", "/costs", "/slo", "/alerts"):
         if src.endswith(suffix):
             return src[: -len(suffix)]
     return src
@@ -313,6 +321,74 @@ def dump_costs(data, out=None):
                  else ""), file=out)
 
 
+_ALERT_ORDER = {"firing": 0, "pending": 1, "resolved": 2, "inactive": 3}
+
+
+def _alert_rows(rules, out, indent="  "):
+    print(f"{indent}{'alert':<38} {'sev':<6} {'state':<9} "
+          f"{'budget':>8} {'burn (long/short vs ×)':>23}  evidence",
+          file=out)
+    for r in sorted(rules, key=lambda r: (
+            _ALERT_ORDER.get(r.get("state"), 9), r.get("alert", ""))):
+        d = r.get("detail") or {}
+        if "burn_long" in d or "burn_short" in d:
+            burn = (f"{_n(d.get('burn_long'))}/"
+                    f"{_n(d.get('burn_short'))} vs {_n(d.get('factor'))}")
+        elif "burn" in d:
+            burn = f"{_n(d.get('burn'))} vs {_n(d.get('factor'))}"
+        elif "delta" in d or "absent" in d:
+            burn = ("absent" if d.get("absent")
+                    else f"delta {_n(d.get('delta'))}")
+        else:
+            burn = "-"
+        eb = r.get("error_budget_remaining")
+        notes = []
+        exemplars = r.get("exemplars") or []
+        if exemplars and r.get("state") in ("pending", "firing"):
+            notes.append("traces: " + ",".join(
+                e["trace_id"] for e in exemplars[:2]))
+        print(f"{indent}{r.get('alert', '?'):<38} "
+              f"{r.get('severity', '?'):<6} {r.get('state', '?'):<9} "
+              f"{(f'{eb:.3f}' if eb is not None else '-'):>8} "
+              f"{burn:>23}  {' '.join(notes)}", file=out)
+
+
+def _n(v):
+    return f"{v:g}" if isinstance(v, (int, float)) else "-"
+
+
+def dump_alerts(data, out=None):
+    """One-screen /alerts table — an engine's rule set, or a router's
+    fleet view (own rules + every seat's). Returns the number of
+    FIRING alerts so the CLI can turn it into an exit code."""
+    out = out if out is not None else sys.stdout
+    engines = data.get("engines")
+    firing = data.get("fleet_firing", data.get("firing", 0))
+    pending = data.get("fleet_pending", data.get("pending", 0))
+    print(f"-- alerts, owner {data.get('owner', '?')}: "
+          f"{firing} firing, {pending} pending "
+          f"(window scale {data.get('window_scale', 1)}) "
+          + "-" * 10, file=out)
+    if not data.get("rules") and not engines:
+        print("  (no rules declared — MXNET_TPU_SLO=0, or the owner "
+              "never started)", file=out)
+        return 0
+    if data.get("rules"):
+        _alert_rows(data["rules"], out)
+    for eid, section in sorted((engines or {}).items()):
+        print(f"  engine {eid}: {section.get('firing', 0)} firing, "
+              f"{section.get('pending', 0)} pending", file=out)
+        if section.get("rules"):
+            _alert_rows(section["rules"], out, indent="    ")
+    recent = [t for t in data.get("transitions", ())][-5:]
+    if recent:
+        print("  recent transitions:", file=out)
+        for t in recent:
+            print(f"    {t.get('alert'):<38} {t.get('from')}→{t.get('to')} "
+                  f"@ {t.get('ts')}", file=out)
+    return firing
+
+
 def dump_trace_tree(trace, out=None):
     """Indented span-tree render with per-span self-time."""
     out = out if out is not None else sys.stdout
@@ -383,6 +459,10 @@ def main(argv=None):
     ap.add_argument("--costs", action="store_true",
                     help="table the per-bucket cost ledger from the "
                     "server's /costs (engine or router fleet merge)")
+    ap.add_argument("--alerts", action="store_true",
+                    help="table the SLO engine's /alerts rule state "
+                    "(firing/pending first, error-budget column); "
+                    "exit 4 while anything is firing")
     ap.add_argument("--top", type=int, default=10,
                     help="rows in the --traces/--profile tables")
     args = ap.parse_args(argv)
@@ -412,6 +492,11 @@ def main(argv=None):
             shown = True
         if args.costs:
             dump_costs(json.loads(_fetch(base + "/costs")))
+            shown = True
+        if args.alerts:
+            firing = dump_alerts(json.loads(_fetch(base + "/alerts")))
+            if firing:
+                rc = max(rc, 4)
             shown = True
         if shown:
             pass
