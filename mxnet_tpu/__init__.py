@@ -29,6 +29,15 @@ import jax as _jax
 
 from . import envvars
 
+# Runtime concurrency sanitizer: must patch the threading factories
+# BEFORE any mxnet_tpu module creates locks or threads, so every
+# primitive the package mints is instrumented. Gated — the disabled
+# path patches nothing.
+if envvars.get("MXNET_TPU_SANITIZE"):
+    from . import _sanitize as _sanitize_mod
+
+    _sanitize_mod.install()
+
 _prec = envvars.get("MXNET_TPU_MATMUL_PRECISION")
 try:
     _jax.config.update("jax_default_matmul_precision", _prec)

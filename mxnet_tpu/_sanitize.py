@@ -1,0 +1,665 @@
+"""mxsan — runtime concurrency sanitizer (``MXNET_TPU_SANITIZE=1``).
+
+The static half (``tools/mxlint`` lock-order + lock-graph passes)
+PROVES the declared lock graph acyclic; this module VERIFIES the proof
+on every instrumented run, the way the paper's ``ThreadedEngine``
+checks its dependency discipline at runtime rather than trusting the
+scheduler. Under the gate, :func:`install` patches
+``threading.Lock`` / ``threading.RLock`` / ``threading.Condition``
+with wrappers that
+
+- record per-thread acquisition stacks and maintain the OBSERVED
+  lock-order graph keyed by lock *creation site* (two engines' locks
+  born on the same line are one node — instance-insensitive, exactly
+  like the static pass), reporting a cycle the moment an edge closes
+  one (``order-cycle``): a potential deadlock is flagged even when the
+  interleaving that would hang never happens in this run;
+- time every hold and report holds longer than
+  ``MXNET_TPU_SANITIZE_HOLD_MS`` *while another thread was waiting*
+  (``long-hold``) — the contended-convoy shape, not mere slowness;
+- track thread lifecycles (``Thread.start``/``join`` are wrapped) and
+  report at teardown (``thread-leak``) non-daemon threads still alive
+  past the session, and (``thread-unjoined``) non-daemon non-test
+  threads that died without ever being joined.
+
+The DISABLED path is free: nothing is patched unless
+:func:`install` runs, and ``mxnet_tpu/__init__`` only calls it under
+the env gate — ``threading.Lock`` stays the raw ``_thread``
+factory (identity-asserted by the microbench guard in
+``tests/test_sanitize.py``).
+
+Findings are suppressed by a ``# mxsan: allow=<rule>`` comment on the
+lock's creation line, the acquisition line, or the thread's start
+line (``allow=all`` works too), and otherwise gated against the
+committed ``tests/mxsan_baseline.json`` by the pytest plugin in
+``tests/conftest.py`` — same contract as mxlint's baseline: the file
+is committed EMPTY and the sanitized leg fails on any unbaselined
+finding.
+
+Only locks *created by repo code* are instrumented (the creation
+frame must live under the repo root): stdlib/third-party internals —
+every ``threading.Event``'s private lock, jax's pools — keep raw
+primitives, which both bounds the graph and avoids false cycles
+through shared stdlib creation sites.
+"""
+from __future__ import annotations
+
+import json
+import linecache
+import os
+import re
+import sys
+import threading
+import time
+import weakref
+
+import _thread
+
+from . import envvars
+
+__all__ = ["Sanitizer", "Finding", "install", "uninstall", "active",
+           "load_baseline", "unbaselined", "report", "RULES"]
+
+RULES = ("order-cycle", "long-hold", "thread-leak", "thread-unjoined")
+
+_RAW_LOCK = _thread.allocate_lock
+_RAW_RLOCK = _thread.RLock
+_RAW_CONDITION = threading.Condition
+_RAW_THREAD_START = threading.Thread.start
+_RAW_THREAD_JOIN = threading.Thread.join
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_OWN_FILE = os.path.abspath(__file__)
+_ALLOW_RE = re.compile(r"#\s*mxsan:\s*allow=([\w,\-]+)")
+_DIGITS_RE = re.compile(r"\d+")
+
+
+def _caller_site():
+    """(abs filename, lineno) of the nearest frame outside this
+    module."""
+    f = sys._getframe(1)
+    while f is not None and f.f_code.co_filename == __file__:
+        f = f.f_back
+    if f is None:
+        return "<unknown>", 0
+    return f.f_code.co_filename, f.f_lineno
+
+
+def _in_repo(filename):
+    return filename.startswith(_REPO_ROOT + os.sep)
+
+
+class _Site:
+    """A lock/thread creation site: the order-graph node identity."""
+
+    __slots__ = ("path", "rel", "line", "__weakref__")
+
+    def __init__(self, path, line):
+        self.path = path
+        try:
+            rel = os.path.relpath(path, _REPO_ROOT)
+        except ValueError:
+            rel = path
+        self.rel = rel.replace(os.sep, "/")
+        self.line = line
+
+    def label(self):
+        snippet = linecache.getline(self.path, self.line).strip()
+        snippet = snippet.split("#")[0].strip()
+        loc = f"{self.rel}:{self.line}"
+        return f"{loc} ({snippet})" if snippet else loc
+
+    def key(self):
+        return f"{self.rel}:{self.line}"
+
+
+class Finding:
+    """One sanitizer finding (mxlint-shaped: rule + message + a stable
+    key for the committed baseline)."""
+
+    __slots__ = ("rule", "message", "sites", "meta")
+
+    def __init__(self, rule, message, sites, meta=None):
+        self.rule = rule
+        self.message = message
+        self.sites = tuple(sites)
+        self.meta = meta or {}
+
+    def key(self):
+        return "|".join([self.rule] + sorted(s.key() for s in self.sites))
+
+    def __repr__(self):
+        return f"<mxsan {self.rule} {self.key()}>"
+
+
+def _allowed(rule, sites, extra_lines=()):
+    """True when any involved source line carries
+    ``# mxsan: allow=<rule>`` (or ``allow=all``)."""
+    lines = [(s.path, s.line) for s in sites]
+    lines.extend(extra_lines)
+    for path, line in lines:
+        m = _ALLOW_RE.search(linecache.getline(path, line))
+        if not m:
+            continue
+        allowed = {r.strip() for r in m.group(1).split(",")}
+        if rule in allowed or "all" in allowed:
+            return True
+    return False
+
+
+class _HeldEntry:
+    """One live hold. Keeps a reference to the ACQUIRING thread's held
+    list so a cross-thread release (a Lock used as a semaphore) can
+    still retire the entry instead of leaving a stale hold that would
+    fabricate edges forever."""
+
+    __slots__ = ("lock", "site", "acq_path", "acq_line", "held_list")
+
+    def __init__(self, lock, site, acq_path, acq_line, held_list):
+        self.lock = lock
+        self.site = site
+        self.acq_path = acq_path
+        self.acq_line = acq_line
+        self.held_list = held_list
+
+
+class _SanLock:
+    """Instrumented non-reentrant lock (drop-in for
+    ``threading.Lock()``)."""
+
+    _reentrant = False
+
+    __slots__ = ("_san", "_site", "_raw", "_owner", "_acq_mono",
+                 "_acq_path", "_acq_line", "_waiters", "_contended",
+                 "_entry", "__weakref__")
+
+    def __init__(self, san, site):
+        self._san = san
+        self._site = site
+        self._raw = _RAW_LOCK()
+        self._owner = None
+        self._acq_mono = 0.0
+        self._acq_path = ""
+        self._acq_line = 0
+        self._waiters = 0
+        self._contended = False
+        self._entry = None
+
+    def acquire(self, blocking=True, timeout=-1):
+        got = self._raw.acquire(False)
+        if not got:
+            self._contended = True
+            if not blocking:
+                return False
+            self._waiters += 1
+            try:
+                got = self._raw.acquire(True, timeout)
+            finally:
+                self._waiters -= 1
+            if not got:
+                return False
+        self._san._acquired(self)
+        return True
+
+    def release(self):
+        self._san._releasing(self)
+        self._owner = None
+        self._raw.release()
+
+    def locked(self):
+        return self._raw.locked()
+
+    def _is_owned(self):
+        return self._owner == threading.get_ident()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return f"<mxsan Lock @ {self._site.key()}>"
+
+
+class _SanRLock:
+    """Instrumented reentrant lock (drop-in for
+    ``threading.RLock()``, Condition-protocol complete)."""
+
+    _reentrant = True
+
+    __slots__ = ("_san", "_site", "_raw", "_owner", "_count",
+                 "_acq_mono", "_acq_path", "_acq_line", "_waiters",
+                 "_contended", "_entry", "__weakref__")
+
+    def __init__(self, san, site):
+        self._san = san
+        self._site = site
+        self._raw = _RAW_LOCK()
+        self._owner = None
+        self._count = 0
+        self._acq_mono = 0.0
+        self._acq_path = ""
+        self._acq_line = 0
+        self._waiters = 0
+        self._contended = False
+        self._entry = None
+
+    def acquire(self, blocking=True, timeout=-1):
+        me = threading.get_ident()
+        if self._owner == me:
+            self._count += 1
+            return True
+        got = self._raw.acquire(False)
+        if not got:
+            self._contended = True
+            if not blocking:
+                return False
+            self._waiters += 1
+            try:
+                got = self._raw.acquire(True, timeout)
+            finally:
+                self._waiters -= 1
+            if not got:
+                return False
+        self._owner = me
+        self._count = 1
+        self._san._acquired(self)
+        return True
+
+    def release(self):
+        if self._owner != threading.get_ident():
+            raise RuntimeError("cannot release un-acquired lock")
+        self._count -= 1
+        if self._count:
+            return
+        self._san._releasing(self)
+        self._owner = None
+        self._raw.release()
+
+    def locked(self):
+        return self._raw.locked()
+
+    def _is_owned(self):
+        return self._owner == threading.get_ident()
+
+    # Condition protocol: wait() parks OUTSIDE the hold — the saved
+    # recursion count is restored (and the hold re-timed, the edges
+    # re-checked) on wakeup.
+    def _release_save(self):
+        count = self._count
+        self._san._releasing(self)
+        self._count = 0
+        self._owner = None
+        self._raw.release()
+        return count
+
+    def _acquire_restore(self, count):
+        got = self._raw.acquire(False)
+        if not got:
+            self._contended = True
+            self._waiters += 1
+            try:
+                self._raw.acquire()
+            finally:
+                self._waiters -= 1
+        self._owner = threading.get_ident()
+        self._count = count
+        self._san._acquired(self)
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return f"<mxsan RLock @ {self._site.key()}>"
+
+
+class Sanitizer:
+    """The engine: observed order graph + hold timing + thread
+    lifecycle, findings deduped by :meth:`Finding.key`.
+
+    One process-global instance backs the patched ``threading``
+    factories (:func:`install`); tests build private instances whose
+    :meth:`lock`/:meth:`rlock`/:meth:`condition` wrap raw primitives
+    directly, so goldens never pollute the session gate."""
+
+    def __init__(self, hold_ms=None):
+        if hold_ms is None:
+            hold_ms = envvars.get("MXNET_TPU_SANITIZE_HOLD_MS")
+        self.hold_ms = hold_ms
+        self.findings = []
+        self.suppressed = []
+        self._keys = set()
+        self._state_lock = _RAW_LOCK()
+        self._edges = {}            # (src, dst) -> witness str
+        self._adj = {}              # src -> set(dst)
+        self._cycles_seen = set()   # frozenset(sites)
+        self._sites = {}            # (path, line) -> _Site
+        self._tls = threading.local()
+        self._threads = weakref.WeakKeyDictionary()  # Thread -> _Site
+        self._joined = weakref.WeakSet()
+        self._preexisting = weakref.WeakSet()
+        for t in threading.enumerate():
+            self._preexisting.add(t)
+
+    # -- explicit constructors (tests, non-patched embedding) ----------
+    def lock(self):
+        path, line = _caller_site()
+        return _SanLock(self, self._site(path, line))
+
+    def rlock(self):
+        path, line = _caller_site()
+        return _SanRLock(self, self._site(path, line))
+
+    def condition(self, lock=None):
+        if lock is None:
+            path, line = _caller_site()
+            lock = _SanRLock(self, self._site(path, line))
+        return _RAW_CONDITION(lock)
+
+    def _site(self, path, line):
+        key = (path, line)
+        s = self._sites.get(key)
+        if s is None:
+            # setdefault is atomic under the GIL: a racing creator
+            # loses its throwaway _Site and both threads share ONE
+            # node (edges key on site identity)
+            s = self._sites.setdefault(key, _Site(path, line))
+        return s
+
+    # -- acquisition tracking ------------------------------------------
+    def _held(self):
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = self._tls.held = []
+        return held
+
+    def _acquired(self, lk):
+        acq_path, acq_line = _caller_site()
+        lk._owner = threading.get_ident()
+        lk._acq_mono = time.monotonic()
+        lk._acq_path = acq_path
+        lk._acq_line = acq_line
+        lk._contended = False
+        held = self._held()
+        for e in tuple(held):
+            if e.site is not lk._site:
+                self._edge(e, lk, acq_path, acq_line)
+        entry = _HeldEntry(lk, lk._site, acq_path, acq_line, held)
+        lk._entry = entry
+        held.append(entry)
+
+    def _releasing(self, lk):
+        entry = lk._entry
+        lk._entry = None
+        if entry is not None:
+            try:
+                entry.held_list.remove(entry)
+            except ValueError:
+                pass
+        dur_ms = (time.monotonic() - lk._acq_mono) * 1000.0
+        if dur_ms <= self.hold_ms:
+            return
+        if not (lk._waiters > 0 or lk._contended):
+            return
+        site = lk._site
+        acq = (lk._acq_path, lk._acq_line)
+        f = Finding(
+            "long-hold",
+            f"{site.label()} held {dur_ms:.0f} ms with waiter(s) "
+            f"blocked (acquired at "
+            f"{self._site(*acq).key()}, threshold "
+            f"{self.hold_ms:.0f} ms) — every thread queued on this "
+            f"lock convoys behind the hold; shrink the critical "
+            f"section (snapshot under the lock, work outside)",
+            [site, self._site(*acq)])
+        self._report(f, extra_lines=[acq])
+
+    def _edge(self, held_entry, lk, acq_path, acq_line):
+        src, dst = held_entry.site, lk._site
+        pair = (src, dst)
+        if pair in self._edges:
+            return
+        tname = threading.current_thread().name
+        holder_at = self._site(held_entry.acq_path,
+                               held_entry.acq_line).key()
+        witness = (f"thread {tname!r} acquired {dst.label()} at "
+                   f"{self._site(acq_path, acq_line).key()} while "
+                   f"holding {src.label()} (acquired at {holder_at})")
+        with self._state_lock:
+            if pair in self._edges:
+                return
+            self._edges[pair] = witness
+            self._adj.setdefault(src, set()).add(dst)
+            cycle = self._find_cycle(dst, src)
+        if cycle is not None:
+            self._report_cycle(cycle)
+
+    def _find_cycle(self, start, goal):
+        """DFS ``start`` → ``goal`` through the order graph (called
+        with the state lock held, right after adding goal→start): a
+        path back means the new edge closed a cycle. Returns the site
+        path [goal, start, ..., goal] or None."""
+        stack = [(start, [goal, start])]
+        seen = {start}
+        while stack:
+            node, path = stack.pop()
+            for nxt in self._adj.get(node, ()):
+                if nxt is goal:
+                    return path + [goal]
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append((nxt, path + [nxt]))
+        return None
+
+    def _report_cycle(self, cycle):
+        ring = frozenset(cycle)
+        with self._state_lock:
+            if ring in self._cycles_seen:
+                return
+            self._cycles_seen.add(ring)
+            legs = [self._edges.get((a, b), f"{a.key()} -> {b.key()}")
+                    for a, b in zip(cycle, cycle[1:])]
+        sites = sorted(set(cycle), key=lambda s: s.key())
+        f = Finding(
+            "order-cycle",
+            f"observed lock-order cycle across {len(sites)} locks "
+            f"({', '.join(s.key() for s in sites)}): "
+            f"{'; '.join(legs)} — threads taking these legs "
+            f"concurrently deadlock; impose one global order or "
+            f"snapshot-and-call-outside",
+            sites)
+        self._report(f)
+
+    def _report(self, finding, extra_lines=()):
+        with self._state_lock:
+            if finding.key() in self._keys:
+                return
+            self._keys.add(finding.key())
+        if _allowed(finding.rule, finding.sites, extra_lines):
+            self.suppressed.append(finding)
+        else:
+            self.findings.append(finding)
+
+    # -- thread lifecycle ----------------------------------------------
+    def track_thread(self, thread, site=None):
+        if site is None:
+            path, line = _caller_site()
+            site = self._site(path, line)
+        self._threads[thread] = site
+
+    def track_join(self, thread):
+        self._joined.add(thread)
+
+    def teardown_check(self):
+        """Run the end-of-session thread checks; returns the full
+        findings list (lock findings included)."""
+        main = threading.main_thread()
+        for t in threading.enumerate():
+            if t is main or t.daemon or not t.is_alive():
+                continue
+            if t in self._preexisting:
+                continue
+            site = self._threads.get(t)
+            where = site.label() if site else "start site unknown"
+            name = _DIGITS_RE.sub("N", t.name)
+            self._report_thread(Finding(
+                "thread-leak",
+                f"non-daemon thread {t.name!r} (started at {where}) "
+                f"still alive at teardown — it outlives the session "
+                f"and wedges interpreter shutdown; join it, stop its "
+                f"owner, or make it a daemon",
+                [site] if site else [],
+                meta={"thread": name}), name)
+        for t, site in list(self._threads.items()):
+            if t.is_alive() or t.daemon:
+                continue
+            if t in self._joined:
+                continue
+            if site.rel.startswith("tests/"):
+                continue        # short-lived test helpers may just end
+            name = _DIGITS_RE.sub("N", t.name)
+            self._report_thread(Finding(
+                "thread-unjoined",
+                f"non-daemon thread {t.name!r} (started at "
+                f"{site.label()}) died without ever being joined — "
+                f"its owner has no teardown ordering; join it where "
+                f"its work is consumed",
+                [site], meta={"thread": name}), name)
+        return list(self.findings)
+
+    def _report_thread(self, finding, name):
+        # thread findings key on (rule, sites, normalized name) so two
+        # pool workers ("x_0", "x_1") dedupe to one finding
+        key = finding.meta.get("key") or \
+            "|".join([finding.rule]
+                     + sorted(s.key() for s in finding.sites) + [name])
+        with self._state_lock:
+            if key in self._keys:
+                return
+            self._keys.add(key)
+        finding.meta["key"] = key
+        if finding.sites and _allowed(finding.rule, finding.sites):
+            self.suppressed.append(finding)
+        else:
+            self.findings.append(finding)
+
+
+# ---------------------------------------------------------------------------
+# global install: patch the threading factories
+# ---------------------------------------------------------------------------
+
+_ACTIVE = None
+_INSTALL_LOCK = _RAW_LOCK()
+
+
+def _patched_lock():
+    san = _ACTIVE
+    if san is not None:
+        path, line = _caller_site()
+        if _in_repo(path):
+            return _SanLock(san, san._site(path, line))
+    return _RAW_LOCK()
+
+
+def _patched_rlock():
+    san = _ACTIVE
+    if san is not None:
+        path, line = _caller_site()
+        if _in_repo(path):
+            return _SanRLock(san, san._site(path, line))
+    return _RAW_RLOCK()
+
+
+def _patched_condition(lock=None):
+    san = _ACTIVE
+    if san is not None and lock is None:
+        path, line = _caller_site()
+        if _in_repo(path):
+            lock = _SanRLock(san, san._site(path, line))
+    return _RAW_CONDITION(lock)
+
+
+def _patched_start(self):
+    san = _ACTIVE
+    if san is not None:
+        path, line = _caller_site()
+        san.track_thread(self, san._site(path, line))
+    return _RAW_THREAD_START(self)
+
+
+def _patched_join(self, timeout=None):
+    san = _ACTIVE
+    if san is not None:
+        san.track_join(self)
+    return _RAW_THREAD_JOIN(self, timeout)
+
+
+def install(hold_ms=None):
+    """Activate the global sanitizer and patch the ``threading``
+    factories. Idempotent; returns the active :class:`Sanitizer`."""
+    global _ACTIVE
+    with _INSTALL_LOCK:
+        if _ACTIVE is not None:
+            return _ACTIVE
+        san = Sanitizer(hold_ms=hold_ms)
+        _ACTIVE = san
+        threading.Lock = _patched_lock
+        threading.RLock = _patched_rlock
+        threading.Condition = _patched_condition
+        threading.Thread.start = _patched_start
+        threading.Thread.join = _patched_join
+        return san
+
+
+def uninstall():
+    """Restore the raw factories (tests). Locks created while active
+    keep working — their wrappers hold their own raw locks."""
+    global _ACTIVE
+    with _INSTALL_LOCK:
+        if _ACTIVE is None:
+            return
+        _ACTIVE = None
+        threading.Lock = _RAW_LOCK
+        threading.RLock = _RAW_RLOCK
+        threading.Condition = _RAW_CONDITION
+        threading.Thread.start = _RAW_THREAD_START
+        threading.Thread.join = _RAW_THREAD_JOIN
+
+
+def active():
+    return _ACTIVE
+
+
+# ---------------------------------------------------------------------------
+# baseline + reporting (the pytest plugin's surface)
+# ---------------------------------------------------------------------------
+
+def load_baseline(path):
+    """The committed baseline: a JSON list of finding keys (empty in a
+    healthy repo). Missing file == empty."""
+    try:
+        with open(path, encoding="utf-8") as fh:
+            return set(json.load(fh))
+    except (OSError, ValueError):
+        return set()
+
+
+def unbaselined(findings, baseline):
+    return [f for f in findings
+            if (f.meta.get("key") or f.key()) not in baseline]
+
+
+def report(findings):
+    lines = [f"mxsan: {len(findings)} unbaselined finding(s)"]
+    for f in findings:
+        lines.append(f"  [{f.rule}] {f.message}")
+        lines.append(f"    key: {f.meta.get('key') or f.key()}")
+    return "\n".join(lines)

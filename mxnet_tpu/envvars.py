@@ -353,6 +353,23 @@ register("MXNET_TPU_ALERT_HISTORY", "int", 128,
          "alert state-transition history ring size (served on "
          "``/alerts``, carried into flight bundles)", scope="slo")
 
+# -- concurrency sanitizer --------------------------------------------------
+register("MXNET_TPU_SANITIZE", "bool", False,
+         "runtime concurrency sanitizer: patches ``threading.Lock``/"
+         "``RLock``/``Condition`` (repo-created only) with wrappers "
+         "that maintain the observed lock-order graph (cycle = "
+         "potential deadlock, flagged even when the fatal "
+         "interleaving never fires), time contended holds, and track "
+         "thread lifecycles; the pytest plugin fails the session on "
+         "unbaselined findings (``tests/mxsan_baseline.json``, "
+         "``# mxsan: allow=<rule>`` suppressions). Off = nothing is "
+         "patched", scope="sanitize")
+register("MXNET_TPU_SANITIZE_HOLD_MS", "float", 100.0,
+         "sanitizer long-hold threshold: a lock held longer than this "
+         "many milliseconds WHILE another thread waits on it is "
+         "reported (``long-hold``) — the convoy shape, not mere "
+         "slowness", scope="sanitize")
+
 # -- bench ------------------------------------------------------------------
 register("MXNET_TPU_PEAK_TFLOPS", "float", None,
          "override the per-chip peak dense bf16 TFLOP/s used for "
@@ -382,6 +399,7 @@ _SCOPE_TITLES = OrderedDict([
     ("wire", "Serving dispatch wire"),
     ("telemetry", "Telemetry / observability"),
     ("slo", "SLOs & alerting"),
+    ("sanitize", "Concurrency sanitizer"),
     ("bench", "Benchmarks"),
     ("tests", "Tests / dev harness"),
 ])

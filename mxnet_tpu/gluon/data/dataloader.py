@@ -249,7 +249,9 @@ class _ThreadedIter:
 
     def __init__(self, loader: DataLoader):
         self._loader = loader
-        self._pool = _futures.ThreadPoolExecutor(max_workers=loader._num_workers)
+        self._pool = _futures.ThreadPoolExecutor(
+            max_workers=loader._num_workers,
+            thread_name_prefix="mxnet_tpu_dataloader_prefetch")
         self._batches = iter(loader._batch_sampler)
         self._pending = deque()
         for _ in range(loader._prefetch):

@@ -43,12 +43,54 @@ OP_COVERAGE_EXEMPT = {
 }
 
 
+# ---------------------------------------------------------------------------
+# mxsan: the runtime concurrency sanitizer plugin (ISSUE 11). Under
+# MXNET_TPU_SANITIZE=1 the whole suite runs with instrumented
+# Lock/RLock/Condition/Thread primitives; at session end, unbaselined
+# findings (vs the committed-EMPTY tests/mxsan_baseline.json, after
+# `# mxsan: allow=<rule>` inline suppressions) fail the run. The
+# raw-env read mirrors MXNET_TPU_TEST_REAL_DEVICE above: conftest must
+# not import mxnet_tpu before deciding how to configure it.
+# ---------------------------------------------------------------------------
+MXSAN_BASELINE = os.path.join(os.path.dirname(__file__),
+                              "mxsan_baseline.json")
+
+
+def pytest_configure(config):
+    if os.environ.get("MXNET_TPU_SANITIZE") == "1":
+        # importing the package installs the sanitizer (gated in
+        # mxnet_tpu/__init__) before any repo lock exists
+        import mxnet_tpu  # noqa: F401
+
+
+def _mxsan_gate(session):
+    import sys
+    mod = sys.modules.get("mxnet_tpu._sanitize")
+    san = mod.active() if mod else None
+    if san is None:
+        return
+    findings = san.teardown_check()
+    new = mod.unbaselined(findings, mod.load_baseline(MXSAN_BASELINE))
+    rep = session.config.pluginmanager.get_plugin("terminalreporter")
+    if not new:
+        if rep:
+            rep.write_line(
+                f"mxsan: 0 unbaselined findings "
+                f"({len(san.suppressed)} inline-suppressed)")
+        return
+    if rep:
+        for line in mod.report(new).splitlines():
+            rep.write_line("mxsan " + line, red=True)
+    session.exitstatus = 1
+
+
 def pytest_sessionstart(session):
     from mxnet_tpu.ndarray.register import record_invocations
     record_invocations(RECORDED_OPS)
 
 
 def pytest_sessionfinish(session, exitstatus):
+    _mxsan_gate(session)
     from mxnet_tpu.ndarray.register import record_invocations
     record_invocations(None)
     # only gate FULL runs (the driver's `pytest tests/`); -k / file

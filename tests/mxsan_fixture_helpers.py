@@ -1,0 +1,28 @@
+"""Seeded fixtures for mxsan suppression mechanics.
+
+The ``# mxsan: allow=<rule>`` comments below are load-bearing: the
+sanitizer reads the CREATION line of each lock (via linecache) when a
+finding lands on it, so these helpers must keep the comment on the
+``san.lock()`` call line.
+"""
+
+
+def make_allowed_hold_lock(san):
+    """A lock whose long-hold findings are inline-suppressed."""
+    return san.lock()  # mxsan: allow=long-hold
+
+
+def make_allowed_cycle_locks(san):
+    """A lock pair whose order-cycle findings are inline-suppressed
+    (the allow on ONE participant suppresses the cycle — same contract
+    as mxlint's line-anchored disables)."""
+    a = san.lock()  # mxsan: allow=order-cycle
+    b = san.lock()
+    return a, b
+
+
+def make_plain_locks(san):
+    """The unsuppressed control pair."""
+    a = san.lock()
+    b = san.lock()
+    return a, b

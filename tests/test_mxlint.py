@@ -84,16 +84,22 @@ def test_fixture_lock_order():
 def test_fixture_thread_hygiene():
     project, source = _lint_fixture("thread_hygiene_fixture.py")
     assert _rules(project) == [
+        "executor-unnamed",         # ThreadPoolExecutor, no prefix
         "silent-except",
+        "socketserver-daemon",      # UndecidedServer class
+        "socketserver-daemon",      # bare ThreadingHTTPServer(...)
         "thread-daemon",            # unnamed_and_implicit
         "thread-daemon",            # named_but_undecided
         "thread-unjoined",
         "thread-unnamed",
     ]
     assert sorted(f.rule for f in project.suppressed) == [
-        "thread-daemon", "thread-unnamed"]
+        "executor-unnamed", "thread-daemon", "thread-unnamed"]
     silent = [f for f in project.findings if f.rule == "silent-except"]
     assert _line_mentions_rule(source, silent[0])
+    for f in project.findings:
+        if f.rule in ("executor-unnamed", "socketserver-daemon"):
+            assert _line_mentions_rule(source, f), f
 
 
 def test_fixture_telemetry_consistency():
@@ -135,6 +141,145 @@ def test_fixture_wire_safety():
     assert [f.rule for f in project.suppressed] == ["wire-unsafe"]
     unscoped, _ = _lint_fixture("wire_safety_fixture.py")
     assert "wire-unsafe" not in _rules(unscoped)
+
+
+def test_wire_safety_covers_loadgen_and_dump_tools():
+    # ISSUE 11 satellite: the two tools that parse wire payloads off
+    # live fleets are in scope now — the same fixture fires under
+    # their relpaths
+    for relpath in ("tools/serve_loadgen.py", "tools/telemetry_dump.py"):
+        project, _ = _lint_fixture("wire_safety_fixture.py",
+                                   relpath=relpath)
+        assert "wire-unsafe" in _rules(project), relpath
+
+
+def _lint_lock_graph_pair():
+    project = core.Project(root=ROOT)
+    # the whole-program pass only reports on full scans (a partial
+    # graph would mis-resolve); the fixture pair stands in for one
+    project.full_scan = True
+    for fname in ("lock_graph_fixture_b.py", "lock_graph_fixture_a.py"):
+        with open(os.path.join(FIXTURES, fname), encoding="utf-8") as fh:
+            project.lint_source(fh.read(), f"fixtures/{fname}")
+    project.finalize()
+    return project
+
+
+def test_fixture_lock_graph_cycle_via_callback():
+    """The tentpole golden: router holds its lock entering the engine;
+    the engine completes futures under ITS lock, firing the router's
+    done-callback — a cycle NEITHER per-class pass can see. The
+    finding must carry the full witness path."""
+    project = _lint_lock_graph_pair()
+    cycles = [f for f in project.findings if f.rule == "lock-graph-cycle"]
+    assert len(cycles) == 1, project.findings
+    msg = cycles[0].message
+    # both legs of the witness, with the method chain spelled out
+    assert "FixtureRouter._lock" in msg and "FixtureEngine._elock" in msg
+    assert "FixtureRouter.submit" in msg          # leg 1: router->engine
+    assert "FixtureEngine.submit" in msg          # leg 2: engine->callback
+    assert "FixtureRouter._on_done" in msg        # the re-entry
+    # the negative control participates in no cycle
+    assert "CleanRouter" not in msg and "CleanEngine" not in msg
+
+
+def test_fixture_lock_graph_blocking_escalation():
+    project = _lint_lock_graph_pair()
+    blocking = [f for f in project.findings
+                if f.rule == "lock-graph-blocking"]
+    assert len(blocking) == 1, project.findings
+    assert "time.sleep()" in blocking[0].message
+    assert "FixtureEngine.flush" in blocking[0].message
+    # flush_quietly's identical shape was inline-suppressed
+    assert "lock-graph-blocking" in [f.rule for f in project.suppressed]
+
+
+def test_lock_graph_negative_control_alone_is_clean():
+    """The clean pair linted WITHOUT the seeded classes: zero lock-graph
+    findings (guards against the pass going trigger-happy on the
+    snapshot-outside idiom itself)."""
+    import re as _re
+    project = core.Project(root=ROOT)
+    project.full_scan = True
+    for fname in ("lock_graph_fixture_b.py", "lock_graph_fixture_a.py"):
+        with open(os.path.join(FIXTURES, fname), encoding="utf-8") as fh:
+            src = fh.read()
+        # keep only the Clean* halves of each fixture
+        kept = _re.split(r"(?m)^class ", src)
+        body = kept[0] + "".join("class " + part for part in kept[1:]
+                                 if part.startswith("Clean")
+                                 or part.startswith("FixtureFuture"))
+        project.lint_source(body, f"fixtures/_clean_{fname}")
+    project.finalize()
+    assert [f for f in project.findings
+            if f.rule.startswith("lock-graph")] == []
+
+
+def test_lock_graph_blocking_survives_call_graph_cycle():
+    """Review regression: mutually-recursive helpers must not freeze
+    an incomplete transitive summary — the blocking call reachable
+    only through the A<->B call cycle is still reported, regardless of
+    method visit order."""
+    src = '''
+import threading
+import time
+
+
+class Pump:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def run(self):
+        with self._lock:
+            self.step_a()
+
+    def step_a(self):
+        self.step_b()
+
+    def step_b(self):
+        self.step_a()          # the cycle
+        time.sleep(0.5)        # reachable only through it
+'''
+    project = core.Project(root=ROOT)
+    project.full_scan = True
+    project.lint_source(src, "fixtures/_cycle_pump.py")
+    project.finalize()
+    blocking = [f for f in project.findings
+                if f.rule == "lock-graph-blocking"]
+    assert len(blocking) == 1, project.findings
+    assert "time.sleep()" in blocking[0].message
+
+
+def test_lock_graph_silent_on_partial_scans():
+    """Whole-program findings need the whole program: the same seeded
+    pair linted WITHOUT full_scan (the --changed-only / explicit-path
+    shape) reports nothing, so a pre-commit subset can never flag a
+    finding the full CI graph disclaims."""
+    project = core.Project(root=ROOT)
+    for fname in ("lock_graph_fixture_b.py", "lock_graph_fixture_a.py"):
+        with open(os.path.join(FIXTURES, fname), encoding="utf-8") as fh:
+            project.lint_source(fh.read(), f"fixtures/{fname}")
+    project.finalize()
+    assert [f for f in project.findings
+            if f.rule.startswith("lock-graph")] == []
+
+
+def test_executor_positional_prefix_satisfies_rule():
+    src = ("from concurrent.futures import ThreadPoolExecutor\n"
+           "pool = ThreadPoolExecutor(4, 'mxnet_tpu_pool')\n")
+    project = core.Project(root=ROOT)
+    project.lint_source(src, "fixtures/_positional_prefix.py")
+    project.finalize()
+    assert "executor-unnamed" not in _rules(project)
+
+
+def test_cli_write_baseline_rejects_changed_only():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.mxlint", "--changed-only",
+         "--write-baseline"],
+        cwd=ROOT, capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 2
+    assert "truncate" in proc.stderr
 
 
 def test_fixture_clock_discipline():
@@ -266,6 +411,52 @@ def test_envdoc_is_regeneration_stable():
         assert f"`{var.name}`" in body, f"{var.name} missing from table"
 
 
+def test_ast_cache_shared_across_runs():
+    """ISSUE 11 satellite: one parse per (file, mtime, size) per
+    process — the repo gate, the alert cross-check and every fixture
+    test share contexts instead of re-parsing the scope."""
+    p = os.path.join(ROOT, "tools", "mxlint", "core.py")
+    c1 = core.cached_context(p, "tools/mxlint/core.py")
+    c2 = core.cached_context(p, "tools/mxlint/core.py")
+    assert c1 is c2
+    assert c1.tree is c2.tree
+    # the shared preorder node list is computed once too
+    assert c1.nodes is c2.nodes
+    # a run() consumes the cached context rather than re-parsing
+    project = core.run(root=ROOT, paths=("tools/mxlint/core.py",))
+    assert any(ctx is c1 for ctx in project.contexts)
+
+
+def test_warm_cache_parallel_jobs_matches_serial():
+    from tools.mxlint.core import _CTX_CACHE
+    paths = ("tools/mxlint",)
+    serial = core.run(root=ROOT, paths=paths)
+    serial_keys = sorted(f.key() for f in serial.findings)
+    _CTX_CACHE.clear()
+    n = core.warm_cache(ROOT, paths, jobs=2)
+    assert n >= 5
+    warm = core.run(root=ROOT, paths=paths)
+    assert sorted(f.key() for f in warm.findings) == serial_keys
+
+
+def test_changed_files_scope_filtered():
+    rels = core.changed_files(ROOT)
+    for rel in rels:
+        assert rel.endswith(".py"), rel
+        assert rel == "bench.py" or rel.split("/")[0] in (
+            "mxnet_tpu", "tools"), rel
+        assert "fixtures" not in rel.split("/"), rel
+
+
+def test_cli_changed_only_exits_zero():
+    # the repo gate holds zero unbaselined findings on the FULL scope,
+    # so any changed-only subset must be clean too
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.mxlint", "--changed-only", "-q"],
+        cwd=ROOT, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
 def test_cli_smoke_exits_zero():
     proc = subprocess.run(
         [sys.executable, "-m", "tools.mxlint", "-q"],
@@ -280,5 +471,7 @@ def test_cli_list_rules():
         cwd=ROOT, capture_output=True, text=True, timeout=60)
     assert proc.returncode == 0
     for rule in ("lock-blocking-call", "thread-unnamed", "metric-labels",
-                 "env-raw-read", "wire-unsafe", "wall-clock-delta"):
+                 "env-raw-read", "wire-unsafe", "wall-clock-delta",
+                 "lock-graph-cycle", "lock-graph-blocking",
+                 "executor-unnamed", "socketserver-daemon"):
         assert rule in proc.stdout

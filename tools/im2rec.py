@@ -104,7 +104,9 @@ def make_record(args):
     rec = recordio.MXIndexedRecordIO(args.prefix + ".idx",
                                      args.prefix + ".rec", "w")
     n = 0
-    with futures.ThreadPoolExecutor(max_workers=args.num_thread) as pool:
+    with futures.ThreadPoolExecutor(
+            max_workers=args.num_thread,
+            thread_name_prefix="mxnet_tpu_im2rec") as pool:
         for idx, payload in pool.map(load, items):
             rec.write_idx(idx, payload)
             n += 1

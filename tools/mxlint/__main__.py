@@ -4,6 +4,8 @@
 
     python -m tools.mxlint                  # lint the acceptance scope
     python -m tools.mxlint mxnet_tpu/serving
+    python -m tools.mxlint --changed-only   # git-diff-scoped (pre-commit)
+    python -m tools.mxlint --jobs 4         # parallel parse/tokenize
     python -m tools.mxlint --list-rules
     python -m tools.mxlint --write-baseline # accept current findings
     python -m tools.mxlint --write-envdoc   # regenerate README env table
@@ -11,6 +13,12 @@
 Exit codes: 0 clean (or fully baselined), 1 unbaselined findings,
 2 usage error. The tier-1 gate (``tests/test_mxlint.py``) runs the
 default scope and asserts exit 0 with an EMPTY baseline.
+
+``--changed-only`` lints only files modified vs HEAD (plus untracked)
+so the pre-commit path is sub-second on a small diff; whole-repo
+ABSENCE checks (dashboard families, README env rows, the repo-wide
+lock graph) need the full scan and are skipped — CI still runs the
+default scope.
 """
 from __future__ import annotations
 
@@ -70,6 +78,12 @@ def main(argv=None):
     ap.add_argument("--write-envdoc", action="store_true",
                     help="regenerate the README configuration "
                          "reference from mxnet_tpu/envvars.py")
+    ap.add_argument("--changed-only", action="store_true",
+                    help="lint only files changed vs git HEAD (plus "
+                         "untracked); skips whole-repo cross-checks")
+    ap.add_argument("--jobs", type=int, default=1, metavar="N",
+                    help="parse/tokenize files with N worker processes "
+                         "(pass checks stay serial)")
     ap.add_argument("--list-rules", action="store_true")
     ap.add_argument("-q", "--quiet", action="store_true")
     args = ap.parse_args(argv)
@@ -84,7 +98,26 @@ def main(argv=None):
     if args.write_envdoc:
         return write_envdoc(root)
 
-    project = core.run(root=root, paths=args.paths or None)
+    paths = args.paths or None
+    if args.changed_only:
+        if paths:
+            print("mxlint: --changed-only and explicit paths are "
+                  "mutually exclusive", file=sys.stderr)
+            return 2
+        if args.write_baseline:
+            print("mxlint: --write-baseline needs the full scan — "
+                  "a --changed-only subset would truncate the "
+                  "committed baseline to the diff's findings",
+                  file=sys.stderr)
+            return 2
+        paths = core.changed_files(root)
+        if not paths:
+            print("mxlint: 0 changed files in scope")
+            return 0
+    if args.jobs > 1:
+        core.warm_cache(root, paths or core.DEFAULT_PATHS,
+                        jobs=args.jobs)
+    project = core.run(root=root, paths=paths)
     baseline = core.load_baseline(root)
     new = [f for f in project.findings if f.key() not in baseline]
     stale = baseline - {f.key() for f in project.findings}

@@ -1,0 +1,64 @@
+"""lock-graph fixture, side A: the router half (parsed, never
+imported — always linted into ONE project together with
+``lock_graph_fixture_b.py``, the engine half).
+
+``FixtureRouter`` + ``FixtureEngine`` seed the canonical cross-object
+deadlock: the router holds its lock while entering the engine (edge
+Router._lock -> Engine._elock) and the engine completes futures under
+its own lock, firing the router's registered done-callback which
+re-enters the router (edge Engine._elock -> Router._lock). Neither
+class is ABBA within itself — only the whole-program graph sees the
+cycle. ``CleanRouter`` is the negative control: same wiring, but it
+calls the engine and registers the callback OUTSIDE its lock.
+"""
+import threading
+
+from lock_graph_fixture_b import CleanEngine, FixtureEngine
+
+
+class FixtureRouter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._engine = FixtureEngine()
+        self._inflight = 0
+
+    def submit(self, req):
+        with self._lock:
+            self._inflight += 1
+            fut = self._engine.submit(req)      # lock-graph-cycle leg 1
+            fut.add_done_callback(self._on_done)
+        return fut
+
+    def _on_done(self, fut):
+        with self._lock:
+            self._inflight -= 1
+
+    def flush_all(self):
+        with self._lock:
+            self._engine.flush()                # lock-graph-blocking
+
+    def flush_quietly(self):
+        with self._lock:
+            # justified: fixture-only — proves inline suppression works
+            # mxlint: disable=lock-graph-blocking
+            self._engine.flush()
+
+
+class CleanRouter:
+    """Decide under the lock, act outside: no cross-object edges."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._engine = CleanEngine()
+        self._inflight = 0
+
+    def submit(self, req):
+        with self._lock:
+            self._inflight += 1
+        fut = self._engine.submit(req)
+        fut.add_done_callback(self._done_quietly)
+        return fut
+
+    def _done_quietly(self, fut):
+        with self._lock:
+            self._inflight -= 1

@@ -1,0 +1,54 @@
+"""lock-graph fixture, side B: the engine half (see fixture A).
+
+``FixtureEngine`` completes futures while still holding its lock —
+the registered done-callbacks run synchronously in the completing
+thread, so every lock the callbacks take is acquired UNDER
+``_elock``. ``CleanEngine`` snapshots under the lock and completes
+outside it (the queue.py idiom), so the done pool contributes no
+edge from its lock.
+"""
+import threading
+
+
+class FixtureFuture:
+    def __init__(self):
+        self._cbs = []
+        self._value = None
+
+    def add_done_callback(self, fn):
+        self._cbs.append(fn)
+
+    def set_result(self, value):
+        self._value = value
+        for cb in self._cbs:
+            cb(self)
+
+
+class FixtureEngine:
+    def __init__(self):
+        self._elock = threading.Lock()
+        self._done = 0
+
+    def submit(self, req):
+        fut = FixtureFuture()
+        with self._elock:
+            self._done += 1
+            fut.set_result(req)                 # lock-graph-cycle leg 2
+        return fut
+
+    def flush(self):
+        import time
+        time.sleep(0.05)                        # reached under A's lock
+
+
+class CleanEngine:
+    def __init__(self):
+        self._elock = threading.Lock()
+        self._done = 0
+
+    def submit(self, req):
+        fut = FixtureFuture()
+        with self._elock:
+            self._done += 1
+        fut.set_result(req)                     # outside the lock
+        return fut

@@ -1,5 +1,8 @@
 """thread-hygiene pass fixture (parsed, never imported)."""
+import socketserver
 import threading
+from concurrent.futures import ThreadPoolExecutor
+from http.server import ThreadingHTTPServer
 
 
 def unnamed_and_implicit():
@@ -24,6 +27,33 @@ def clean_daemon():
 
 def suppressed():
     return threading.Thread(target=print)  # mxlint: disable=thread-unnamed,thread-daemon
+
+
+def anonymous_executor():
+    return ThreadPoolExecutor(max_workers=4)    # executor-unnamed
+
+
+def named_executor():
+    return ThreadPoolExecutor(
+        max_workers=4, thread_name_prefix="mxnet_tpu_fixture_pool")
+
+
+def suppressed_executor():
+    return ThreadPoolExecutor(max_workers=1)  # mxlint: disable=executor-unnamed
+
+
+class UndecidedServer(socketserver.ThreadingMixIn,   # socketserver-daemon
+                      socketserver.TCPServer):
+    pass
+
+
+class DecidedServer(socketserver.ThreadingMixIn, socketserver.TCPServer):
+    daemon_threads = True                       # clean: explicit
+
+
+def bare_threading_server(handler):
+    return ThreadingHTTPServer(("", 0), handler)    # socketserver-daemon
+    # (this file never assigns .daemon_threads on an instance)
 
 
 def silent_worker_loop(q):

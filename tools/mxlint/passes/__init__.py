@@ -4,6 +4,7 @@ from __future__ import annotations
 
 from .clocks import ClockDisciplinePass
 from .env_registry import EnvRegistryPass
+from .lock_graph import LockGraphPass
 from .lock_order import LockOrderPass
 from .telemetry_consistency import TelemetryConsistencyPass
 from .thread_hygiene import ThreadHygienePass
@@ -11,7 +12,7 @@ from .wire_safety import WireSafetyPass
 
 __all__ = ["all_passes", "PASS_CLASSES"]
 
-PASS_CLASSES = (LockOrderPass, ThreadHygienePass,
+PASS_CLASSES = (LockOrderPass, LockGraphPass, ThreadHygienePass,
                 TelemetryConsistencyPass, EnvRegistryPass,
                 WireSafetyPass, ClockDisciplinePass)
 

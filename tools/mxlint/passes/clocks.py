@@ -33,7 +33,7 @@ class ClockDisciplinePass(LintPass):
 
     def check(self, ctx):
         out = []
-        for node in ast.walk(ctx.tree):
+        for node in ctx.nodes:
             if isinstance(node, ast.ClassDef):
                 out.extend(self._check_scope(ctx, node,
                                              self._class_taint(node)))

@@ -53,7 +53,7 @@ class EnvRegistryPass(LintPass):
     def check(self, ctx):
         out = []
         aliases = self._env_read_aliases(ctx.tree)
-        for node in ast.walk(ctx.tree):
+        for node in ctx.nodes:
             if isinstance(node, ast.Subscript):
                 out.extend(self._check_subscript(ctx, node))
             elif isinstance(node, ast.Call):

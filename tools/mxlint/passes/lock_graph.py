@@ -1,0 +1,865 @@
+"""Whole-program lock-graph analysis (cross-object deadlock shapes).
+
+The per-class ``lock-order`` pass proves each class ABBA-free, but the
+fleet deadlocks the repo actually invites are CROSS-object: the router
+holding a seat lock while calling ``engine.submit`` (which takes engine
+locks), a future done-callback fired by the engine worker re-entering
+the router, the alert daemon dumping flight bundles under recorder
+state. This pass builds ONE acquisition graph for everything scanned:
+
+- ``lock-graph-cycle``    — a cycle in the global lock-acquisition
+  graph spanning more than one class/module (single-class ABBA stays
+  ``lock-order``'s report). The finding carries the full witness path:
+  every edge names the method chain that acquires lock B while lock A
+  is held (``ServingRouter._lock -> [submit -> ServingEngine.submit]
+  -> ServingEngine._lock -> [done-callback ...] -> ...``).
+- ``lock-graph-blocking`` — a blocking call (sleep, socket I/O, queue
+  get, thread/future wait) reached INTERPROCEDURALLY while a lock is
+  held: method A holds a lock and calls B (possibly on another object,
+  possibly several hops deep) which blocks. Direct blocking under a
+  lock is ``lock-blocking-call``; this rule is the escalation across
+  call/object boundaries that the per-class pass cannot see.
+
+How identities resolve:
+
+- Lock nodes are ``(owner, attribute)`` where the owner is a class
+  (``self.X = threading.Lock()/RLock()/Condition()`` discovery, with
+  ``Condition(self.Y)`` aliasing) or a MODULE (``_LOCK =
+  threading.Lock()`` at module scope).
+- Object types come from constructor sites (``self.X = Cls(...)``,
+  ``var = Cls(...)``) and from ``__init__``/method parameter
+  annotations (``def f(self, engine: ServingEngine)`` followed by
+  ``self._e = engine``). Class names resolve through each file's
+  imports first, then by unique global name.
+- Calls followed: ``self.m()``, ``self.attr.m()`` / ``var.m()`` on a
+  typed receiver, ``Cls(...)`` constructors, same-module and imported
+  module-level functions (``_recorder.install()``).
+- Callback edges: callables registered via ``add_done_callback`` /
+  ``register_probe`` pool globally; any dynamic callback-shaped
+  invocation (``cb()``, ``probe()``), and any
+  ``set_result``/``set_exception``/``add_done_callback`` call (the
+  future runs its snapshot of callbacks synchronously in the CALLING
+  thread, so the caller's held locks are held across them), links the
+  held locks to every pooled callback's transitive acquisitions.
+
+Limitations (documented, deliberate): no inheritance walking, no
+instance sensitivity (two engines share one node per lock attribute —
+right for order graphs), single-owner cycles left to ``lock-order``,
+one representative cycle per strongly-connected component.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from ..core import LintPass
+from ._util import dotted_name, terminal_attr
+
+_LOCK_CTORS = ("Lock", "RLock", "Condition", "Semaphore",
+               "BoundedSemaphore")
+_LOCKISH_NAME = re.compile(r"(lock|cond|mutex|cv$|not_empty|not_full)")
+_CALLBACK_NAME = re.compile(
+    r"^_?(cb|fn|func|callback|hook|done|done_cb|on_done|notify_fn|"
+    r"probe)$")
+_FUTURE_FANOUT = {"set_result", "set_exception", "add_done_callback"}
+_REGISTER_DONE = {"add_done_callback"}
+_REGISTER_PROBE = {"register_probe"}
+_SOCKET_BLOCKING = {"accept", "recv", "recvfrom", "recv_into", "connect",
+                    "sendall", "urlopen", "getresponse"}
+_SENDRECV_HELPER = re.compile(r"^_?(send_msg|recv_msg\w*)$")
+_MAX_WITNESS_HOPS = 8
+
+
+class _Group:
+    """One lock identity: a set of aliased attribute/global names on
+    one owner (class or module)."""
+
+    __slots__ = ("names", "reentrant", "owner")
+
+    def __init__(self, name, owner):
+        self.names = {name}
+        self.reentrant = False
+        self.owner = owner          # _Owner
+
+    def label(self):
+        return f"{self.owner.display}.{sorted(self.names)[0]}"
+
+
+class _Meth:
+    """One analyzed callable: a method, a module function, or a nested
+    def/lambda (analyzed with EMPTY held set — it runs later)."""
+
+    __slots__ = ("owner", "name", "qual", "relpath", "events", "lineno")
+
+    def __init__(self, owner, name, relpath, lineno):
+        self.owner = owner
+        self.name = name
+        self.qual = f"{owner.display}.{name}"
+        self.relpath = relpath
+        self.lineno = lineno
+        self.events = []    # ("acq",h,g,ln) ("call",h,spec,ln)
+        #                     ("block",h,reason,ln) ("cb",h,pool,ln)
+
+
+class _Owner:
+    """A class or a module: lock groups + methods + attribute types."""
+
+    __slots__ = ("kind", "key", "display", "relpath", "groups",
+                 "attr_types", "methods")
+
+    def __init__(self, kind, key, display, relpath):
+        self.kind = kind            # "class" | "module"
+        self.key = key
+        self.display = display
+        self.relpath = relpath
+        self.groups = {}            # name -> _Group
+        self.attr_types = {}        # attr -> dotted type name string
+        self.methods = {}           # name -> _Meth
+
+    def group_for(self, name):
+        if name not in self.groups:
+            self.groups[name] = _Group(name, self)
+        return self.groups[name]
+
+
+class _FileInfo:
+    __slots__ = ("relpath", "module_imports", "from_imports", "owners")
+
+    def __init__(self, relpath):
+        self.relpath = relpath
+        self.module_imports = {}    # alias -> dotted module
+        self.from_imports = {}      # name -> (dotted module, orig name)
+        self.owners = []
+
+
+class LockGraphPass(LintPass):
+    name = "lock-graph"
+    rules = ("lock-graph-cycle", "lock-graph-blocking")
+
+    def __init__(self):
+        self.files = {}             # relpath -> _FileInfo
+        self.registered = {"done": [], "probe": []}   # pooled _Meth
+
+    # ------------------------------------------------------------------
+    # per-file phase: collect owners, methods, events
+    # ------------------------------------------------------------------
+    def check(self, ctx):
+        fi = _FileInfo(ctx.relpath)
+        self.files[ctx.relpath] = fi
+        self._collect_imports(ctx.tree, fi)
+        modname = ctx.relpath[:-3].replace("/", ".")
+        mod = _Owner("module", ctx.relpath,
+                     modname.rsplit(".", 1)[-1], ctx.relpath)
+        fi.owners.append(mod)
+        self._discover_module_locks(ctx.tree, mod)
+        for node in ctx.tree.body:
+            if isinstance(node, ast.ClassDef):
+                cls = _Owner("class", f"{ctx.relpath}::{node.name}",
+                             node.name, ctx.relpath)
+                fi.owners.append(cls)
+                self._discover_class_locks(node, cls)
+                self._discover_attr_types(node, cls)
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        self._analyze_function(item, cls, fi)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._analyze_function(node, mod, fi)
+        return []                   # everything reports in finalize
+
+    def _collect_imports(self, tree, fi):
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    fi.module_imports[alias.asname
+                                      or alias.name.split(".")[0]] = \
+                        alias.name
+            elif isinstance(node, ast.ImportFrom):
+                mod = "." * node.level + (node.module or "")
+                for alias in node.names:
+                    fi.from_imports[alias.asname or alias.name] = \
+                        (mod, alias.name)
+
+    def _discover_module_locks(self, tree, mod):
+        for node in tree.body:
+            if not (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)):
+                continue
+            ctor = terminal_attr(node.value.func)
+            if ctor not in _LOCK_CTORS:
+                continue
+            for t in node.targets:
+                if not isinstance(t, ast.Name):
+                    continue
+                g = mod.group_for(t.id)
+                if ctor == "RLock":
+                    g.reentrant = True
+                if ctor == "Condition" and node.value.args:
+                    inner = node.value.args[0]
+                    if isinstance(inner, ast.Name):
+                        self._alias(mod, inner.id, g)
+
+    def _discover_class_locks(self, cls_node, cls):
+        for node in ast.walk(cls_node):
+            if not (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)):
+                continue
+            ctor = terminal_attr(node.value.func)
+            if ctor not in _LOCK_CTORS:
+                continue
+            for t in node.targets:
+                if not (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"):
+                    continue
+                g = cls.group_for(t.attr)
+                if ctor == "RLock":
+                    g.reentrant = True
+                if ctor == "Condition" and node.value.args:
+                    inner = node.value.args[0]
+                    if (isinstance(inner, ast.Attribute)
+                            and isinstance(inner.value, ast.Name)
+                            and inner.value.id == "self"):
+                        self._alias(cls, inner.attr, g)
+
+    def _alias(self, owner, other_name, g):
+        other = owner.group_for(other_name)
+        if other is g:
+            return
+        other.names |= g.names
+        other.reentrant |= g.reentrant
+        for n in g.names:
+            owner.groups[n] = other
+
+    def _discover_attr_types(self, cls_node, cls):
+        for fn in cls_node.body:
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            ann = self._param_annotations(fn)
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Assign):
+                    continue
+                tname = self._ctor_type(node.value)
+                if tname is None and isinstance(node.value, ast.Name):
+                    tname = ann.get(node.value.id)
+                if tname is None:
+                    continue
+                for t in node.targets:
+                    if (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"):
+                        cls.attr_types.setdefault(t.attr, tname)
+
+    def _param_annotations(self, fn):
+        out = {}
+        for arg in (fn.args.posonlyargs + fn.args.args
+                    + fn.args.kwonlyargs):
+            tname = self._annotation_name(arg.annotation)
+            if tname:
+                out[arg.arg] = tname
+        return out
+
+    def _annotation_name(self, ann):
+        if ann is None:
+            return None
+        if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+            return ann.value
+        return dotted_name(ann)
+
+    def _ctor_type(self, value):
+        """``Cls(...)`` / ``mod.Cls(...)`` when the terminal name looks
+        like a class (capitalized and not a lock constructor)."""
+        if not isinstance(value, ast.Call):
+            return None
+        dname = dotted_name(value.func)
+        term = terminal_attr(value.func) or ""
+        if dname and term[:1].isupper() and term not in _LOCK_CTORS:
+            return dname
+        return None
+
+    # ------------------------------------------------------------------
+    # method body walk
+    # ------------------------------------------------------------------
+    def _analyze_function(self, fn, owner, fi, prefix=""):
+        name = prefix + fn.name
+        meth = _Meth(owner, name, fi.relpath, fn.lineno)
+        owner.methods[name] = meth
+        local_types = self._param_annotations(fn)
+        self._walk(fn.body, owner, fi, meth, [], local_types,
+                   prefix=name + ".")
+        return meth
+
+    def _walk(self, body, owner, fi, meth, held, local_types, prefix):
+        for node in body:
+            self._walk_node(node, owner, fi, meth, held, local_types,
+                            prefix)
+
+    def _walk_node(self, node, owner, fi, meth, held, local_types,
+                   prefix):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested def: runs later, outside the current lock region —
+            # analyzed as its own callable (callback registrations can
+            # point at it)
+            self._analyze_function(node, owner, fi, prefix=prefix)
+            return
+        if isinstance(node, ast.Lambda):
+            sub = _Meth(owner, f"{prefix}<lambda@{node.lineno}>",
+                        fi.relpath, node.lineno)
+            owner.methods[sub.name] = sub
+            self._walk_node(node.body, owner, fi, sub, [], {},
+                            prefix=sub.name + ".")
+            return
+        if isinstance(node, ast.ClassDef):
+            return
+        if isinstance(node, ast.Assign):
+            tname = self._ctor_type(node.value)
+            if tname:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        local_types[t.id] = tname
+        if isinstance(node, ast.With):
+            pushed = []
+            for item in node.items:
+                self._walk_node(item.context_expr, owner, fi, meth,
+                                held, local_types, prefix)
+                g = self._lock_expr(item.context_expr, owner)
+                if g is not None:
+                    meth.events.append(("acq", tuple(held), g,
+                                        node.lineno))
+                    pushed.append(g)
+                    held.append(g)
+            self._walk(node.body, owner, fi, meth, held, local_types,
+                       prefix)
+            del held[len(held) - len(pushed):]
+            return
+        if isinstance(node, ast.Call):
+            self._record_call(node, owner, fi, meth, held, local_types,
+                              prefix)
+        for child in ast.iter_child_nodes(node):
+            self._walk_node(child, owner, fi, meth, held, local_types,
+                            prefix)
+
+    def _lock_expr(self, expr, owner):
+        """The lock group a ``with`` context expr acquires, if any."""
+        if (isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self" and owner.kind == "class"):
+            if expr.attr in owner.groups:
+                return owner.groups[expr.attr]
+            if _LOCKISH_NAME.search(expr.attr):
+                return owner.group_for(expr.attr)
+        if isinstance(expr, ast.Name):
+            # module-scope locks participate by DECLARED name only
+            fi = self.files.get(owner.relpath)
+            if fi is not None:
+                mod = fi.owners[0]
+                if expr.id in mod.groups:
+                    return mod.groups[expr.id]
+        return None
+
+    def _record_call(self, call, owner, fi, meth, held, local_types,
+                     prefix):
+        func = call.func
+        term = terminal_attr(func) or ""
+        ln = call.lineno
+        h = tuple(held)
+
+        # callback registration: pool the registered callable globally
+        if term in _REGISTER_DONE | _REGISTER_PROBE:
+            pool = "done" if term in _REGISTER_DONE else "probe"
+            for arg in call.args:
+                spec = self._callable_ref(arg, owner, fi, prefix)
+                if spec is not None:
+                    self.registered[pool].append(spec)
+        # the future fan-out: set_result/set_exception/add_done_callback
+        # run the registered callbacks synchronously in THIS thread
+        if term in _FUTURE_FANOUT:
+            meth.events.append(("cb", h, "done", ln))
+            if term != "add_done_callback":
+                return
+        if term in _REGISTER_DONE | _REGISTER_PROBE:
+            return
+
+        # dynamic callback-shaped invocation: cb() / probe() / fn()
+        cbname = None
+        if isinstance(func, ast.Name):
+            cbname = func.id
+        elif isinstance(func, ast.Attribute) \
+                and isinstance(func.value, ast.Name) \
+                and func.value.id == "self":
+            cbname = func.attr
+        if cbname and _CALLBACK_NAME.match(cbname) \
+                and cbname not in owner.methods \
+                and prefix + cbname not in owner.methods:
+            pool = "probe" if "probe" in cbname else "done"
+            meth.events.append(("cb", h, pool, ln))
+            return
+
+        blocking = self._blocking_reason(call, term, held, owner)
+        if blocking:
+            meth.events.append(("block", h, blocking, ln))
+            return
+
+        spec = self._call_spec(func, owner, local_types, prefix)
+        if spec is not None:
+            meth.events.append(("call", h, spec, ln))
+
+    def _callable_ref(self, arg, owner, fi, prefix):
+        """A registration argument as an unresolved callable spec."""
+        if (isinstance(arg, ast.Attribute)
+                and isinstance(arg.value, ast.Name)
+                and arg.value.id == "self"):
+            return ("self", arg.attr)
+        if isinstance(arg, ast.Name):
+            return ("scoped", prefix + arg.id, arg.id, owner.key)
+        if isinstance(arg, ast.Lambda):
+            return ("scoped", f"{prefix}<lambda@{arg.lineno}>", None,
+                    owner.key)
+        return None
+
+    def _call_spec(self, func, owner, local_types, prefix):
+        if isinstance(func, ast.Name):
+            # nested def first, then module-level function / import
+            return ("name", prefix + func.id, func.id)
+        if not isinstance(func, ast.Attribute):
+            return None
+        base = func.value
+        if isinstance(base, ast.Name):
+            if base.id == "self":
+                return ("self", func.attr)
+            if base.id in local_types:
+                return ("type", local_types[base.id], func.attr)
+            return ("modattr", base.id, func.attr)
+        if (isinstance(base, ast.Attribute)
+                and isinstance(base.value, ast.Name)
+                and base.value.id == "self"):
+            return ("selfattr", base.attr, func.attr)
+        return None
+
+    def _blocking_reason(self, call, term, held, owner):
+        base = call.func.value if isinstance(call.func,
+                                             ast.Attribute) else None
+        base_term = terminal_attr(base) if base is not None else None
+        if term == "sleep" and (base_term or "").lstrip("_") == "time":
+            return "time.sleep()"
+        if term in _SOCKET_BLOCKING:
+            return f"blocking I/O call .{term}()"
+        if _SENDRECV_HELPER.match(term or ""):
+            return f"blocking wire call {term}()"
+        if term == "get" and base_term and re.search(
+                r"(^|_)(q|dq|queue)$", base_term):
+            return f"queue get on .{base_term}"
+        if term in ("wait", "wait_for"):
+            g = self._lock_expr(base, owner) if base is not None else None
+            if g is not None and any(g is hg for hg in held):
+                return None        # the CV idiom
+            return f".{term}() wait"
+        if term == "result":
+            if self._zero_timeout(call):
+                return None        # .result(timeout=0) never blocks
+            return "future .result() wait"
+        if term == "join":
+            if isinstance(base, ast.Constant):
+                return None
+            if base_term in ("path", "os", "sep"):
+                return None
+            if len(call.args) > 1:
+                return None
+            return ".join() wait"
+        return None
+
+    def _zero_timeout(self, call):
+        args = list(call.args) + [kw.value for kw in call.keywords
+                                  if kw.arg == "timeout"]
+        return any(isinstance(a, ast.Constant) and a.value == 0
+                   for a in args)
+
+    # ------------------------------------------------------------------
+    # whole-program phase
+    # ------------------------------------------------------------------
+    def finalize(self, project):
+        if not project.full_scan:
+            # a --changed-only / explicit-path subset sees a PARTIAL
+            # program: _resolve_class's unique-global-name fallback
+            # could resolve calls the full scan rejects (a repo-wide
+            # ambiguous name looks unique in the subset), flagging
+            # findings CI's full graph disclaims — whole-program
+            # checks need the whole program
+            return []
+        classes = {}                # name -> [owner]
+        by_key = {}
+        for fi in self.files.values():
+            for o in fi.owners:
+                by_key[o.key] = o
+                if o.kind == "class":
+                    classes.setdefault(o.display, []).append(o)
+        self._classes = classes
+        self._by_key = by_key
+        self._pools = {p: self._resolve_pool(p)
+                       for p in ("done", "probe")}
+        self._acq_memo = {}
+        self._blk_memo = {}
+
+        findings = []
+        edges = {}       # (id(gA), id(gB)) -> (gA, gB, witness, rel, ln)
+        blocked = set()  # dedupe (lock label, reason, entry)
+        for fi in sorted(self.files.values(), key=lambda f: f.relpath):
+            for o in fi.owners:
+                for m in o.methods.values():
+                    self._edges_for(m, edges, findings, blocked)
+        findings.extend(self._cycles(edges))
+        return findings
+
+    def _resolve_pool(self, pool):
+        out = []
+        for spec in self.registered[pool]:
+            if spec[0] == "self":
+                # bound method: every class declaring it (receiver type
+                # is rarely recoverable at the registration site)
+                for infos in self._classes.values():
+                    for cls in infos:
+                        m = cls.methods.get(spec[1])
+                        if m is not None:
+                            out.append(m)
+            else:   # ("scoped", qualified, bare, owner_key)
+                o = self._by_key.get(spec[3])
+                if o is None:
+                    continue
+                m = o.methods.get(spec[1]) or (
+                    o.methods.get(spec[2]) if spec[2] else None)
+                if m is not None:
+                    out.append(m)
+        return sorted(set(out), key=lambda m: m.qual)
+
+    def _resolve_class(self, relpath, tname):
+        """Resolve a (possibly dotted) type name seen in ``relpath``."""
+        if tname is None:
+            return None
+        fi = self.files.get(relpath)
+        parts = tname.split(".")
+        leaf = parts[-1]
+        if fi is not None:
+            if len(parts) == 1 and leaf in fi.from_imports:
+                modrel = self._module_relpath(
+                    fi.from_imports[leaf][0], relpath)
+                name = fi.from_imports[leaf][1]
+                if modrel:
+                    key = f"{modrel}::{name}"
+                    if key in self._by_key:
+                        return self._by_key[key]
+                leaf = name
+            key = f"{relpath}::{leaf}"
+            if key in self._by_key:
+                return self._by_key[key]
+        infos = self._classes.get(leaf, [])
+        if len(infos) == 1:
+            return infos[0]
+        return None
+
+    def _module_relpath(self, dotted, from_relpath):
+        """Map a dotted (possibly relative) module name onto a scanned
+        file's relpath."""
+        if dotted.startswith("."):
+            level = len(dotted) - len(dotted.lstrip("."))
+            pkg = from_relpath.rsplit("/", 1)[0].split("/")
+            pkg = pkg[:len(pkg) - (level - 1)] if level > 1 else pkg
+            tail = dotted.lstrip(".")
+            parts = pkg + (tail.split(".") if tail else [])
+        else:
+            parts = dotted.split(".")
+        base = "/".join(parts)
+        for cand in (base + ".py", base + "/__init__.py"):
+            if cand in self.files:
+                return cand
+        return None
+
+    def _resolve_call(self, meth, spec):
+        """A call spec -> list of target _Meth."""
+        kind = spec[0]
+        owner = meth.owner
+        if kind == "self":
+            m = owner.methods.get(spec[1])
+            return [m] if m else []
+        if kind == "name":
+            qualified, bare = spec[1], spec[2]
+            m = owner.methods.get(qualified)
+            if m is not None:
+                return [m]
+            fi = self.files.get(meth.relpath)
+            mod = fi.owners[0] if fi else None
+            if mod is not None and bare in mod.methods:
+                return [mod.methods[bare]]
+            if fi is not None and bare in fi.from_imports:
+                dmod, orig = fi.from_imports[bare]
+                modrel = self._module_relpath(dmod, meth.relpath)
+                if modrel:
+                    tgt = self.files[modrel].owners[0].methods.get(orig)
+                    if tgt is not None:
+                        return [tgt]
+                # imported CLASS constructor
+                cls = self._resolve_class(meth.relpath, bare)
+                if cls is not None:
+                    m = cls.methods.get("__init__")
+                    return [m] if m else []
+            if bare and bare[:1].isupper():
+                cls = self._resolve_class(meth.relpath, bare)
+                if cls is not None:
+                    m = cls.methods.get("__init__")
+                    return [m] if m else []
+            return []
+        if kind == "selfattr":
+            if owner.kind != "class":
+                return []
+            tname = owner.attr_types.get(spec[1])
+            cls = self._resolve_class(meth.relpath, tname)
+            if cls is None:
+                return []
+            m = cls.methods.get(spec[2])
+            return [m] if m else []
+        if kind == "type":
+            cls = self._resolve_class(meth.relpath, spec[1])
+            if cls is None:
+                return []
+            m = cls.methods.get(spec[2])
+            return [m] if m else []
+        if kind == "modattr":
+            fi = self.files.get(meth.relpath)
+            if fi is None:
+                return []
+            alias, fname = spec[1], spec[2]
+            dmod = None
+            if alias in fi.module_imports:
+                dmod = fi.module_imports[alias]
+            elif alias in fi.from_imports:
+                # ``from ..telemetry import events as _events`` makes
+                # the ALIAS a module: rejoin (all-dots prefixes concat
+                # without a separator)
+                sub, orig = fi.from_imports[alias]
+                dmod = sub + orig if sub.endswith(".") or not sub \
+                    else sub + "." + orig
+            if dmod is None:
+                return []
+            modrel = self._module_relpath(dmod, meth.relpath)
+            if modrel is None:
+                return []
+            mod = self.files[modrel].owners[0]
+            m = mod.methods.get(fname)
+            if m is not None:
+                return [m]
+            cls = self._by_key.get(f"{modrel}::{fname}")
+            if cls is not None:
+                m = cls.methods.get("__init__")
+                return [m] if m else []
+            return []
+        return []
+
+    def _targets(self, meth, ev):
+        if ev[0] == "call":
+            return self._resolve_call(meth, ev[2])
+        if ev[0] == "cb":
+            return self._pools[ev[2]]
+        return []
+
+    def _transitive(self, meth, memo, pick, _stack=None):
+        """Transitive summary for ``meth``: key -> (witness path, value)
+        where ``pick(ev)`` yields (key, value) for direct events.
+
+        Call-graph cycles (A calls B calls A) are cut at the back
+        edge, and any summary computed THROUGH an in-progress node is
+        left unmemoized: caching it would freeze an incomplete view
+        and silently drop acquisitions/blocking calls reachable via
+        the cycle for every later caller. Cycle members get recomputed
+        per top-level query instead — each fresh query sees every
+        finished node's complete summary."""
+        if meth in memo:
+            return memo[meth]
+        if _stack is None:
+            _stack = set()
+        _stack.add(meth)
+        out = {}
+        tainted = False
+        for ev in meth.events:
+            direct = pick(ev)
+            if direct is not None:
+                key, ln = direct
+                out.setdefault(key, (f"{meth.qual} "
+                                     f"({meth.relpath}:{ln})",))
+                continue
+            if ev[0] in ("call", "cb"):
+                hop = f"{meth.qual} ({meth.relpath}:{ev[3]})"
+                for t in self._targets(meth, ev):
+                    if t in _stack:
+                        tainted = True      # back edge: cut here
+                        continue
+                    sub = self._transitive(t, memo, pick, _stack)
+                    if t not in memo:
+                        tainted = True      # t saw an in-progress node
+                    for key, path in sub.items():
+                        if len(path) >= _MAX_WITNESS_HOPS:
+                            continue
+                        out.setdefault(key, (hop,) + path)
+        _stack.discard(meth)
+        if not tainted:
+            memo[meth] = out
+        return out
+
+    def _acq(self, meth):
+        return self._transitive(
+            meth, self._acq_memo,
+            lambda ev: (ev[2], ev[3]) if ev[0] == "acq" else None)
+
+    def _blk(self, meth):
+        return self._transitive(
+            meth, self._blk_memo,
+            lambda ev: (ev[2], ev[3]) if ev[0] == "block" else None)
+
+    def _edges_for(self, meth, edges, findings, blocked):
+        from ..core import Finding
+        for ev in meth.events:
+            held = ev[1]
+            if not held:
+                continue
+            kind, ln = ev[0], ev[3]
+            if kind == "acq":
+                g = ev[2]
+                for hg in held:
+                    if hg is not g:
+                        edges.setdefault(
+                            (id(hg), id(g)),
+                            (hg, g, (f"{meth.qual} "
+                                     f"({meth.relpath}:{ln})",),
+                             meth.relpath, ln))
+                continue
+            if kind not in ("call", "cb"):
+                continue
+            targets = self._targets(meth, ev)
+            if not targets:
+                continue
+            hop = f"{meth.qual} ({meth.relpath}:{ln})"
+            for t in targets:
+                for g, path in sorted(self._acq(t).items(),
+                                      key=lambda kv: kv[0].label()):
+                    for hg in held:
+                        if hg is not g:
+                            edges.setdefault(
+                                (id(hg), id(g)),
+                                (hg, g, (hop,) + path,
+                                 meth.relpath, ln))
+                for reason, path in sorted(self._blk(t).items()):
+                    top = held[-1]
+                    key = (top.label(), reason, t.qual, meth.qual, ln)
+                    if key in blocked:
+                        continue
+                    blocked.add(key)
+                    findings.append(Finding(
+                        "lock-graph-blocking", meth.relpath, ln, 0,
+                        f"{top.label()} is held at {meth.qual} across "
+                        f"{' -> '.join((hop,) + path)} which does "
+                        f"{reason} — a slow peer convoys every thread "
+                        f"queued on {top.label()}; snapshot under the "
+                        f"lock, call outside"))
+
+    def _cycles(self, edges):
+        from ..core import Finding
+        adj = {}
+        for (ia, ib), (ga, gb, _w, _r, _l) in edges.items():
+            adj.setdefault(ia, {"g": ga, "out": set()})
+            adj.setdefault(ib, {"g": gb, "out": set()})
+            adj[ia]["out"].add(ib)
+
+        # Tarjan SCC, iterative
+        index = {}
+        low = {}
+        on = set()
+        stack = []
+        sccs = []
+        counter = [0]
+
+        def strongconnect(v0):
+            work = [(v0, iter(sorted(adj[v0]["out"])))]
+            index[v0] = low[v0] = counter[0]
+            counter[0] += 1
+            stack.append(v0)
+            on.add(v0)
+            while work:
+                v, it = work[-1]
+                advanced = False
+                for w in it:
+                    if w not in index:
+                        index[w] = low[w] = counter[0]
+                        counter[0] += 1
+                        stack.append(w)
+                        on.add(w)
+                        work.append((w, iter(sorted(adj[w]["out"]))))
+                        advanced = True
+                        break
+                    if w in on:
+                        low[v] = min(low[v], index[w])
+                if advanced:
+                    continue
+                work.pop()
+                if work:
+                    pv = work[-1][0]
+                    low[pv] = min(low[pv], low[v])
+                if low[v] == index[v]:
+                    comp = []
+                    while True:
+                        w = stack.pop()
+                        on.discard(w)
+                        comp.append(w)
+                        if w == v:
+                            break
+                    sccs.append(comp)
+
+        for v in sorted(adj):
+            if v not in index:
+                strongconnect(v)
+
+        out = []
+        for comp in sccs:
+            if len(comp) < 2:
+                continue
+            comp_set = set(comp)
+            labels = sorted(adj[v]["g"].label() for v in comp)
+            owners = {adj[v]["g"].owner.key for v in comp}
+            if len(owners) < 2:
+                continue        # single-owner ABBA is lock-order's
+            start = min(comp, key=lambda v: adj[v]["g"].label())
+            cycle = self._find_cycle(start, comp_set, adj)
+            if cycle is None:
+                continue
+            parts = []
+            for ia, ib in zip(cycle, cycle[1:]):
+                ga, gb, wit, _r, _l = edges[(ia, ib)]
+                parts.append(f"{ga.label()} -> "
+                             f"[{' -> '.join(wit)}] -> {gb.label()}")
+            _ga, _gb, _w, rel, ln = edges[(cycle[0], cycle[1])]
+            out.append(Finding(
+                "lock-graph-cycle", rel, ln, 0,
+                f"whole-program lock cycle across "
+                f"{len(owners)} objects ({', '.join(labels)}); "
+                f"witness: {'; '.join(parts)} — a thread in each leg "
+                f"deadlocks the fleet; break one edge (snapshot under "
+                f"the lock, call outside)"))
+        return out
+
+    def _find_cycle(self, start, comp, adj):
+        """A simple cycle through ``start`` inside one SCC (BFS so the
+        witness is the shortest such cycle)."""
+        from collections import deque
+        prev = {start: None}
+        dq = deque([start])
+        while dq:
+            v = dq.popleft()
+            for w in sorted(adj[v]["out"]):
+                if w == start:
+                    path = [w, v]
+                    while prev[v] is not None:
+                        v = prev[v]
+                        path.append(v)
+                    path.reverse()
+                    return path   # start ... v, start
+                if w in comp and w not in prev:
+                    prev[w] = v
+                    dq.append(w)
+        return None

@@ -62,7 +62,7 @@ class LockOrderPass(LintPass):
 
     def check(self, ctx):
         out = []
-        for node in ast.walk(ctx.tree):
+        for node in ctx.nodes:
             if isinstance(node, ast.ClassDef):
                 out.extend(self._check_class(ctx, node))
         return out

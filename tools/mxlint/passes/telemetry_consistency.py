@@ -66,7 +66,7 @@ class TelemetryConsistencyPass(LintPass):
 
     def check(self, ctx):
         out = []
-        for node in ast.walk(ctx.tree):
+        for node in ctx.nodes:
             if isinstance(node, ast.Call):
                 out.extend(self._check_family_decl(ctx, node))
                 self._collect_rule_ref(ctx, node)

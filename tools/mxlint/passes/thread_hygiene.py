@@ -14,6 +14,18 @@
   loop that swallows everything hides the failure the watchdog and
   event log exist to surface. Emit an event or bump a metric before
   swallowing.
+- ``executor-unnamed``     — ``ThreadPoolExecutor`` without
+  ``thread_name_prefix=``: executors mint threads too, and a flight
+  bundle full of ``ThreadPoolExecutor-0_3`` is exactly the anonymous
+  stack problem ``thread-unnamed`` exists to prevent;
+- ``socketserver-daemon``  — a class mixing in a ``socketserver``
+  threading server (``ThreadingMixIn`` / ``ThreadingTCPServer`` /
+  ``ThreadingHTTPServer`` / ``ThreadingUDPServer``) must set
+  ``daemon_threads`` explicitly in the class body, and a direct
+  ``Threading*Server(...)`` instantiation needs a visible
+  ``.daemon_threads =`` assignment in the same file — per-connection
+  handler threads otherwise inherit ``daemon_threads = False`` and
+  wedge interpreter shutdown, invisibly to the ``thread-daemon`` rule.
 """
 from __future__ import annotations
 
@@ -22,29 +34,39 @@ import ast
 from ..core import LintPass
 from ._util import call_kwargs, dotted_name, terminal_attr
 
+_THREADING_SERVERS = ("ThreadingMixIn", "ThreadingTCPServer",
+                      "ThreadingUDPServer", "ThreadingHTTPServer",
+                      "ThreadingUnixStreamServer")
+
 
 class ThreadHygienePass(LintPass):
     name = "thread-hygiene"
     rules = ("thread-unnamed", "thread-daemon", "thread-unjoined",
-             "silent-except")
+             "silent-except", "executor-unnamed", "socketserver-daemon")
 
     def check(self, ctx):
         out = []
-        has_join = self._has_thread_join(ctx.tree)
-        for node in ast.walk(ctx.tree):
+        has_join = self._has_thread_join(ctx.nodes)
+        sets_daemon_threads = self._sets_daemon_threads(ctx.nodes)
+        for node in ctx.nodes:
             if isinstance(node, ast.Call):
                 out.extend(self._check_thread(ctx, node, has_join))
+                out.extend(self._check_executor(ctx, node))
+                out.extend(self._check_server_call(
+                    ctx, node, sets_daemon_threads))
             elif isinstance(node, ast.While):
                 out.extend(self._check_loop_handlers(ctx, node))
+            elif isinstance(node, ast.ClassDef):
+                out.extend(self._check_server_class(ctx, node))
         return out
 
-    def _has_thread_join(self, tree):
+    def _has_thread_join(self, nodes):
         """A thread-shaped ``.join(`` call anywhere in the file:
         attribute call named join on a NON-string-constant, non-path
         receiver, with at most a timeout argument — `", ".join(xs)` and
         ``os.path.join(a, b)`` must not satisfy the joined-or-daemon
         obligation."""
-        for node in ast.walk(tree):
+        for node in nodes:
             if not (isinstance(node, ast.Call)
                     and isinstance(node.func, ast.Attribute)
                     and node.func.attr == "join"):
@@ -57,6 +79,15 @@ class ThreadHygienePass(LintPass):
             if len(node.args) > 1:
                 continue
             return True
+        return False
+
+    def _sets_daemon_threads(self, nodes):
+        for node in nodes:
+            if isinstance(node, ast.Assign) and any(
+                    isinstance(t, ast.Attribute)
+                    and t.attr == "daemon_threads"
+                    for t in node.targets):
+                return True
         return False
 
     def _check_thread(self, ctx, call, has_join):
@@ -89,6 +120,51 @@ class ThreadHygienePass(LintPass):
                     "daemon=False thread with no .join( in this file: "
                     "join it or make it a daemon"))
         return out
+
+    def _check_executor(self, ctx, call):
+        if (terminal_attr(call.func) or "") != "ThreadPoolExecutor":
+            return []
+        kwargs = call_kwargs(call)
+        if any(kw.arg is None for kw in call.keywords):
+            return []           # **kwargs splat: can't see inside
+        if "thread_name_prefix" in kwargs:
+            return []
+        if len(call.args) >= 2:
+            return []           # prefix passed positionally
+        return [ctx.finding(
+            "executor-unnamed", call,
+            "ThreadPoolExecutor without thread_name_prefix=: executor "
+            "threads show up in flight-recorder stack dumps too — name "
+            "them (mxnet_tpu_<subsystem>)")]
+
+    def _check_server_class(self, ctx, cls):
+        mixes = [terminal_attr(b) for b in cls.bases]
+        if not any(m in _THREADING_SERVERS for m in mixes):
+            return []
+        for node in cls.body:
+            if isinstance(node, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id == "daemon_threads"
+                    for t in node.targets):
+                return []
+        return [ctx.finding(
+            "socketserver-daemon", cls,
+            f"class {cls.name} mixes in a socketserver threading "
+            f"server without setting daemon_threads in the class body: "
+            f"per-connection threads inherit daemon_threads=False and "
+            f"wedge interpreter shutdown — decide explicitly")]
+
+    def _check_server_call(self, ctx, call, sets_daemon_threads):
+        term = terminal_attr(call.func) or ""
+        if term not in _THREADING_SERVERS or term == "ThreadingMixIn":
+            return []
+        if sets_daemon_threads:
+            return []
+        return [ctx.finding(
+            "socketserver-daemon", call,
+            f"{term}(...) instantiated but this file never assigns "
+            f".daemon_threads: per-connection threads inherit "
+            f"daemon_threads=False and wedge interpreter shutdown — "
+            f"set it explicitly on the instance (or subclass)")]
 
     def _check_loop_handlers(self, ctx, loop):
         out = []

@@ -3,8 +3,12 @@
 The ISSUE-2 hardening replaced pickled dist_async frames with a typed
 non-executable codec; the serving ``/submit`` endpoint and the
 telemetry plane parse JSON only. This pass LOCKS that in for
-``mxnet_tpu/serving/``, ``mxnet_tpu/kvstore.py`` and
-``mxnet_tpu/telemetry/``:
+``mxnet_tpu/serving/``, ``mxnet_tpu/kvstore.py``,
+``mxnet_tpu/telemetry/`` — and the two TOOLS that parse wire payloads
+off live fleets, ``tools/serve_loadgen.py`` (dispatch replies, scrape
+bodies) and ``tools/telemetry_dump.py`` (/metrics, /stats, event
+logs): a hostile fleet endpoint must not get code execution in an
+operator's shell either.
 
 - ``wire-unsafe`` — importing or calling ``pickle``/``cPickle``/
   ``dill``/``shelve``/``marshal``, calling ``eval``/``exec``/
@@ -22,7 +26,8 @@ from ._util import dotted_name
 _BANNED_MODULES = {"pickle", "cPickle", "dill", "shelve", "marshal"}
 _BANNED_CALLS = {"eval", "exec", "compile"}
 _SCOPED = ("mxnet_tpu/serving/", "mxnet_tpu/kvstore.py",
-           "mxnet_tpu/telemetry/")
+           "mxnet_tpu/telemetry/", "tools/serve_loadgen.py",
+           "tools/telemetry_dump.py")
 
 
 class WireSafetyPass(LintPass):
@@ -34,7 +39,7 @@ class WireSafetyPass(LintPass):
 
     def check(self, ctx):
         out = []
-        for node in ast.walk(ctx.tree):
+        for node in ctx.nodes:
             if isinstance(node, ast.Import):
                 for alias in node.names:
                     root = alias.name.split(".")[0]
