@@ -353,6 +353,68 @@ register("MXNET_TPU_ALERT_HISTORY", "int", 128,
          "alert state-transition history ring size (served on "
          "``/alerts``, carried into flight bundles)", scope="slo")
 
+# -- synthetic canaries -----------------------------------------------------
+register("MXNET_TPU_CANARY", "bool", True,
+         "black-box canary prober: a router-side daemon submits "
+         "synthetic golden requests to every seat from outside (over "
+         "the binary wire and the HTTP dispatch path, round-robined), "
+         "checks responses against the golden checksum, and feeds the "
+         "per-seat canary-absence page rule; ``0`` spawns no thread "
+         "and registers no ``mxnet_tpu_canary_*`` families",
+         scope="canary")
+register("MXNET_TPU_CANARY_INTERVAL_S", "float", 1.0,
+         "canary probe round period (seconds between rounds; every "
+         "seat is probed once per round)", scope="canary")
+register("MXNET_TPU_CANARY_TIMEOUT_S", "float", 10.0,
+         "per-probe completion timeout: a probe still unanswered after "
+         "this long counts ``timeout`` (a wedged seat answers nothing "
+         "— exactly what the absence rule pages on)", scope="canary")
+register("MXNET_TPU_CANARY_ABSENCE_S", "float", 300.0,
+         "canary-absence window in pre-scale seconds: no successful "
+         "canary against a seat for this long (scaled by "
+         "``MXNET_TPU_SLO_WINDOW_SCALE``) pages even when the seat "
+         "self-reports healthy", scope="canary")
+
+# -- alert egress -----------------------------------------------------------
+register("MXNET_TPU_ALERT_EGRESS", "bool", True,
+         "alert delivery out of the process: alert daemons attach the "
+         "process notifier (webhook/file/stdout sinks, retry + "
+         "dead-letter spool) when any sink is configured; ``0`` spawns "
+         "no thread and registers no ``mxnet_tpu_alert_egress_*`` "
+         "families", scope="egress")
+register("MXNET_TPU_ALERT_EGRESS_URL", "str", None,
+         "webhook sink: alert notifications POST here as JSON (unset "
+         "= no webhook sink)", scope="egress")
+register("MXNET_TPU_ALERT_EGRESS_FILE", "path", None,
+         "file sink: alert notifications append here as JSONL (tests "
+         "and air-gapped runs page into a file)", scope="egress")
+register("MXNET_TPU_ALERT_EGRESS_STDOUT", "bool", False,
+         "stdout sink: print alert notifications as JSON lines",
+         scope="egress")
+register("MXNET_TPU_ALERT_EGRESS_RETRIES", "int", 4,
+         "delivery attempts per sink before a notification goes to "
+         "the dead-letter spool (exponential backoff + jitter between "
+         "attempts)", scope="egress")
+register("MXNET_TPU_ALERT_EGRESS_BACKOFF_S", "float", 0.5,
+         "base delivery backoff in seconds (doubles per retry, plus "
+         "up to 50% jitter)", scope="egress")
+register("MXNET_TPU_ALERT_EGRESS_SPOOL", "path", None,
+         "dead-letter spool directory for undeliverable notifications "
+         "(default ``<MXNET_TPU_FLIGHT_DIR>/egress-spool``); replayed "
+         "on the next notifier start so a page survives process death",
+         scope="egress")
+register("MXNET_TPU_ALERT_EGRESS_SPOOL_MAX", "int", 256,
+         "dead-letter spool bound (files); past it the OLDEST spooled "
+         "notification is dropped to keep the newest pages",
+         scope="egress")
+
+# -- incident timeline ------------------------------------------------------
+register("MXNET_TPU_INCIDENT_GAP_S", "float", 120.0,
+         "incident correlation gap in pre-scale seconds (scaled by "
+         "``MXNET_TPU_SLO_WINDOW_SCALE``): signals this close fold "
+         "into one incident, and a quiet incident with nothing firing "
+         "and no seat down closes after it", scope="incidents")
+
 # -- concurrency sanitizer --------------------------------------------------
 register("MXNET_TPU_SANITIZE", "bool", False,
          "runtime concurrency sanitizer: patches ``threading.Lock``/"
@@ -399,6 +461,9 @@ _SCOPE_TITLES = OrderedDict([
     ("wire", "Serving dispatch wire"),
     ("telemetry", "Telemetry / observability"),
     ("slo", "SLOs & alerting"),
+    ("canary", "Synthetic canaries"),
+    ("egress", "Alert egress"),
+    ("incidents", "Incident timeline"),
     ("sanitize", "Concurrency sanitizer"),
     ("bench", "Benchmarks"),
     ("tests", "Tests / dev harness"),

@@ -33,6 +33,7 @@ from .. import autograd, compile_cache, envvars, profiler
 from .. import ndarray as nd
 from ..context import current_context
 from ..telemetry import events as _events
+from ..telemetry import incidents as _incidents
 from ..telemetry import profiling as _profiling
 from ..telemetry import recorder as _recorder
 from ..telemetry import spans as _spans
@@ -164,6 +165,15 @@ class ServingEngine:
         # flight — the watchdog widens its stall threshold over this
         # window so legitimate compiles never trip a flight bundle
         self._compiling_since = None
+        # serializes model forwards across threads: the worker
+        # dispatches live batches while warmup() replays shapes on the
+        # caller's thread (and black-box canaries make day-one traffic
+        # during warmup the NORMAL case, not a misuse) — the CachedOp
+        # build path must never trace one block from two threads at
+        # once (UnexpectedTracerError). Uncontended cost per batch is
+        # one lock op; a compile legitimately holds it for seconds
+        # while a waiter queues, hence the long-hold allowance.
+        self._forward_lock = threading.Lock()  # mxsan: allow=long-hold
         # SLO engine (MXNET_TPU_SLO): declarative objectives over this
         # engine's metric families + the alert daemon judging them —
         # built in start(), exposed at /slo + /alerts
@@ -205,6 +215,10 @@ class ServingEngine:
         # flight-recorder crash hooks + the stall watchdog ride along
         _recorder.install()
         _recorder.register_probe(self._probe_name, self._watchdog_probe)
+        # ... and narrate it: the incident tracker folds alert
+        # firings, watchdog trips and scoreboard transitions into the
+        # /incidents timeline (thread-free — an events tap)
+        _incidents.install()
         # ... and where its host time goes while alive: the always-on
         # sampling profiler + resource sweep (MXNET_TPU_PROF=0 opts out)
         _profiling.ensure_started()
@@ -818,10 +832,12 @@ class ServingEngine:
         pos = nd.array(plan.positions, dtype="int32", ctx=self._ctx)
         # the batch adopts its requests' trace ids so the forward span
         # in the Chrome trace / xprof names every request it served
-        with _trace_context(_join_trace_ids(r for r, _ in plan.entries)):
-            with autograd.predict_mode():
-                with profiler.Scope("serving/forward"):
-                    out = self._model(ids, tt, vl, seg, pos)
+        with self._forward_lock:
+            with _trace_context(
+                    _join_trace_ids(r for r, _ in plan.entries)):
+                with autograd.predict_mode():
+                    with profiler.Scope("serving/forward"):
+                        out = self._model(ids, tt, vl, seg, pos)
         if isinstance(out, (list, tuple)):
             out = out[0]
         return out.asnumpy()   # host sync: per-request slicing follows

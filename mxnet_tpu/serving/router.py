@@ -103,6 +103,7 @@ import numpy as np
 
 from .. import compile_cache, envvars
 from ..telemetry import events as _events
+from ..telemetry import incidents as _incidents
 from ..telemetry import profiling as _profiling
 from ..telemetry import recorder as _recorder
 from ..telemetry import spans as _spans
@@ -629,6 +630,13 @@ class _RemoteSeat(_Seat):
             return None
         return snap if "rules" in snap else None
 
+    def incidents_snapshot(self):
+        try:
+            snap = json.loads(self._get("/incidents"))
+        except Exception:
+            return None
+        return snap if "open" in snap else None
+
 
 class ServingRouter:
     """Least-outstanding front door over N serving engines.
@@ -695,6 +703,10 @@ class ServingRouter:
         # /slo + /alerts; exemplar gate shared with the engine via
         # metrics.exemplar_gate/slow_exemplar
         self._slo = None
+        # black-box canary prober (MXNET_TPU_CANARY): built in
+        # start(), probes every seat from outside over wire + HTTP and
+        # feeds the per-seat canary-absence page rules
+        self._canary = None
         self._exemplars = exemplar_gate()
         self._pick_seq = itertools.count(1)
         # trace -> engines that served it (bounded): lets the merged
@@ -838,6 +850,7 @@ class ServingRouter:
         _recorder.register_probe(self._probe_name, self._watchdog_probe)
         _recorder.add_bundle_section("router_scoreboard", self.snapshot)
         _profiling.ensure_started()
+        _incidents.install()
         # fleet objectives: availability across failover, fleet
         # latency quantile, routable-engine fraction — judged by the
         # same burn-rate machinery every engine runs on itself
@@ -850,6 +863,16 @@ class ServingRouter:
             self._slo = AlertDaemon(evaluator)
             default_burn_rules(self._slo, names)
             self._slo.start()
+        # black-box monitoring: the canary prober serves the product
+        # path from OUTSIDE each seat (wire + HTTP round-robined) and
+        # declares the per-seat canary-absence page rule on the fleet
+        # daemon — a wedged engine pages even with a green /healthz
+        if envvars.get("MXNET_TPU_CANARY"):
+            from ..telemetry.canary import CanaryProber
+            self._canary = CanaryProber(self._canary_targets,
+                                        owner_id=self.router_id,
+                                        alerts=self._slo)
+            self._canary.start()
         self._poll_once()           # scoreboard fresh before traffic
         self._dispatcher.start()
         self._poller.start()
@@ -897,6 +920,8 @@ class ServingRouter:
         if not already:
             _recorder.unregister_probe(self._probe_name)
             _recorder.remove_bundle_section("router_scoreboard")
+            if self._canary is not None:
+                self._canary.stop()
             if self._slo is not None:
                 self._slo.stop()
         with self._lock:
@@ -1483,6 +1508,48 @@ class ServingRouter:
         out["fleet_pending"] = pending
         return out
 
+    def incidents_snapshot(self):
+        """The fleet ``/incidents`` body: this process's incident
+        tracker (the router's own signals + every in-process seat's —
+        they share one tracker) merged with each routable remote
+        seat's ``/incidents``, deduped by incident id."""
+        parts = [(None, _incidents.snapshot())]
+        for seat in self._remote_seats():
+            parts.append((seat.engine_id, seat.incidents_snapshot()))
+        out = _incidents.merge_snapshots(parts)
+        out["router_id"] = self.router_id
+        return out
+
+    def _canary_targets(self):
+        """The canary prober's view of the fleet: every seat
+        (routable or NOT — black-box probing of a down seat is how
+        recovery is detected), remote seats by URL + advertised wire
+        port, in-process seats by handle."""
+        with self._lock:
+            seats = list(self._seats.values())
+        out = []
+        for seat in seats:
+            t = {"engine_id": seat.engine_id, "kind": seat.kind}
+            if isinstance(seat, _RemoteSeat):
+                t["url"] = seat.base_url
+                # advertised (port, REAL engine id) from the health
+                # poll: the prober's wire handshake pins the identity
+                # so a replacement engine on a recycled port is never
+                # probed (and TOFU-goldened) under the old seat's name
+                t["wire_port"] = seat._advertised[0]
+                t["wire_engine_id"] = seat._advertised[1]
+            else:
+                t["engine"] = seat._engine
+            out.append(t)
+        return out
+
+    @property
+    def canary(self):
+        """The router's :class:`~mxnet_tpu.telemetry.canary.
+        CanaryProber` (None when ``MXNET_TPU_CANARY=0`` or before
+        ``start``)."""
+        return self._canary
+
     def _remote_submit(self, payload):
         """``POST /submit`` handler (exposition-server thread): admit
         + block for the result, JSON either way — the surface a
@@ -1557,6 +1624,7 @@ class ServingRouter:
                                   submit_fn=self._remote_submit,
                                   slo_fn=self.slo_snapshot,
                                   alerts_fn=self.alerts_snapshot,
+                                  incidents_fn=self.incidents_snapshot,
                                   port=port, host=host)
             self._expo = srv
         _events.emit("telemetry_expose", router_id=self.router_id,

@@ -37,7 +37,19 @@ the process starting here). Four pieces:
   multi-window multi-burn-rate rules, threshold and absence rules,
   pending→firing→resolved state machine, ``/slo`` + ``/alerts``
   endpoints, and OpenMetrics histogram exemplars linking a firing
-  latency alert to retrievable traces at ``/traces/<id>``.
+  latency alert to retrievable traces at ``/traces/<id>``;
+- :mod:`.canary` — black-box synthetic monitoring: a router-side
+  prober submits golden requests to every seat from OUTSIDE (binary
+  wire + HTTP, round-robined), checks response checksums, and feeds
+  per-seat canary-absence page rules — a wedged engine pages even
+  while its own ``/healthz`` answers green;
+- :mod:`.egress` — alert delivery out of the process: webhook/file/
+  stdout sinks with retry + exponential backoff, fingerprint dedup
+  and a bounded on-disk dead-letter spool replayed on restart;
+- :mod:`.incidents` — the correlated incident timeline: alert
+  firings, watchdog trips, scoreboard transitions, restarts and
+  flight bundles fold into one incident object per outage, served at
+  ``/incidents`` and stamped (incident id) into flight bundles.
 
 Quickstart::
 
@@ -53,8 +65,8 @@ Quickstart::
     with telemetry.span("my/stage", shard=3):   # nested spans
         ...
 """
-from . import (alerts, events, expo, profiling, recorder, resources,
-               slo, spans, trace)
+from . import (alerts, canary, egress, events, expo, incidents,
+               profiling, recorder, resources, slo, spans, trace)
 from .events import EventLog
 from .expo import (TelemetryServer, histogram_quantile, parse_exemplar,
                    parse_prometheus_text, start_server)
@@ -71,7 +83,7 @@ __all__ = ["REGISTRY", "MetricsRegistry", "Counter", "Gauge", "Histogram",
            "parse_prometheus_text", "parse_exemplar",
            "histogram_quantile", "EventLog",
            "events", "expo", "trace", "spans", "recorder", "profiling",
-           "resources", "slo", "alerts",
+           "resources", "slo", "alerts", "canary", "egress", "incidents",
            "new_trace_id", "current_trace_id", "set_trace_id",
            "trace_context", "Span", "span", "start_span", "record_span",
            "use_span", "current_span", "current_span_id",

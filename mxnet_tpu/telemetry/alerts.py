@@ -230,12 +230,21 @@ class AbsenceRule(AlertRule):
             # never created: absent by definition (a renamed family
             # upstream fails mxlint, but a dead subsystem lands here)
             return True, dict(detail, absent="family")
-        d = evaluator.store.delta(
-            self._key(), evaluator.window_s(self.window), now)
+        w = evaluator.window_s(self.window)
+        d = evaluator.store.delta(self._key(), w, now)
         if d is None:
             return None, detail
-        detail["delta"] = round(d[0], 6)
-        return d[0] <= 0, detail
+        delta, span = d
+        detail["delta"] = round(delta, 6)
+        if span < 0.9 * w:
+            # "nothing moved over the window" is undecidable on
+            # history SHORTER than the window: the partial-coverage
+            # fallback that is honest for burn rates would page a
+            # freshly declared rule off one quiet second (the canary
+            # startup false-page) — not enough data, never a page
+            detail["span_s"] = round(span, 3)
+            return None, detail
+        return delta <= 0, detail
 
     def describe(self):
         return dict(super().describe(), family=self.family,
@@ -285,6 +294,7 @@ class AlertDaemon:
                              else envvars.get("MXNET_TPU_ALERT_HISTORY"))
         self._on_page = on_page
         self._rules = OrderedDict()     # name -> _AlertStatus
+        self._listeners = []            # fn(transition_record)
         self._transitions = deque(maxlen=self._history_len)
         self._lock = threading.Lock()
         self._thread = None
@@ -317,6 +327,61 @@ class AlertDaemon:
                              severity=rule.severity).set(0)
         return rule
 
+    def remove_rule(self, name):
+        """Retire one rule (the canary prober drops a removed seat's
+        absence rule this way — a seat that LEFT the fleet must not
+        page forever). A rule retired while PENDING/FIRING emits a
+        final ``resolved`` transition (tagged ``removed``) so the
+        incident tracker releases its firing hold and the egress
+        notifier delivers the clearing notification — silently
+        popping a firing page would leave the incident open and the
+        pager waiting forever. Its state gauge zeroes; history stays
+        in the transition log."""
+        with self._lock:
+            st = self._rules.pop(name, None)
+            listeners = list(self._listeners)
+        if st is None:
+            return False
+        rule = st.rule
+        self._g_state.labels(alert=self._label(rule),
+                             severity=rule.severity).set(0)
+        if st.state in ("pending", "firing"):
+            rec = {"alert": rule.name, "owner": self.owner_id,
+                   "severity": rule.severity, "from": st.state,
+                   "to": "resolved", "ts": round(time.time(), 3),
+                   "detail": dict(st.detail, removed=True)}
+            self._c_transitions.labels(alert=self._label(rule),
+                                       to="resolved").inc()
+            with self._lock:
+                self._transitions.append(rec)
+            _events.emit("alert_state", **rec)
+            for fn in listeners:
+                try:
+                    fn(dict(rec))
+                except Exception as e:
+                    _events.emit("alert_listener_error",
+                                 owner=self.owner_id, alert=rule.name,
+                                 error=repr(e))
+        return True
+
+    def add_listener(self, fn):
+        """Register ``fn(transition_record)`` called on EVERY state
+        transition (after the ``alert_state`` event and counters) —
+        the alert-egress notifier attaches here. Listener failures are
+        contained (an ``alert_listener_error`` event, never a dead
+        evaluation loop)."""
+        with self._lock:
+            if fn not in self._listeners:
+                self._listeners.append(fn)
+        return fn
+
+    def remove_listener(self, fn):
+        with self._lock:
+            try:
+                self._listeners.remove(fn)
+            except ValueError:
+                pass
+
     def _label(self, rule):
         return f"{self.owner_id}:{rule.name}"
 
@@ -344,6 +409,18 @@ class AlertDaemon:
         # state too (watchdog trips and page firings share bundles via
         # the recorder's dedupe window)
         _recorder.add_bundle_section(self._section, self.snapshot)
+        # alert egress: when the process notifier is configured
+        # (MXNET_TPU_ALERT_EGRESS + a sink), this daemon's transitions
+        # ride out through it — one delivery pipeline per process, the
+        # fingerprint dedup keeps N daemons from double-paging
+        try:
+            from . import egress as _egress
+            notifier = _egress.default_notifier()
+            if notifier is not None:
+                self.add_listener(notifier.notify)
+        except Exception as e:
+            _events.emit("alert_egress_error", owner=self.owner_id,
+                         error=repr(e))
         return self
 
     def stop(self):
@@ -428,7 +505,18 @@ class AlertDaemon:
                "ts": round(st.since_wall, 3), "detail": st.detail}
         with self._lock:
             self._transitions.append(rec)
+            listeners = list(self._listeners)
+        # the alert_state event goes FIRST (the incident tracker taps
+        # it and opens/updates the incident), THEN listeners — so the
+        # egress notifier finds the incident id already minted
         _events.emit("alert_state", **rec)
+        for fn in listeners:
+            try:
+                fn(dict(rec))
+            except Exception as e:
+                _events.emit("alert_listener_error",
+                             owner=self.owner_id, alert=rule.name,
+                             error=repr(e))
         if new == "firing" and rule.severity == PAGE:
             self._page(st)
 

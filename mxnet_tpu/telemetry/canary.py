@@ -1,0 +1,434 @@
+"""Black-box synthetic canary probing: don't trust the seat's word.
+
+Every health signal the fleet had so far is WHITE-BOX and
+self-reported: an engine whose worker loop wedges mid-forward still
+answers a green ``/healthz`` (the handler thread is fine, the worker
+thread is merely stuck), and the router scoreboard folds exactly those
+self-reports. A :class:`CanaryProber` closes that gap the way
+production fleets do — by serving the product path from outside:
+
+- a daemon on the ROUTER side submits one synthetic **golden request**
+  per seat per round, over the real dispatch transports — the binary
+  wire and the HTTP ``/submit`` path, round-robined per seat so each
+  transport stays continuously exercised (in-process seats without an
+  exposition endpoint are driven through ``engine.submit`` directly,
+  transport ``local``);
+- the response CONTENT is checked against a per-model **golden
+  checksum** (established on the first successful probe, or pinned via
+  ``golden=``): a seat that answers quickly but wrongly — stale
+  weights after a botched hot-swap, a corrupted cache — counts
+  ``checksum_mismatch``, not ``ok``;
+- outcomes and latency land in ``mxnet_tpu_canary_*`` families, every
+  one tagged ``traffic="synthetic"`` so loadgen's client-vs-ledger
+  cost reconciliation (and any dashboard) can exclude canary traffic;
+  the amortized bill a successful probe carries back feeds
+  ``mxnet_tpu_canary_billed_*`` — exactly what the reconciliation
+  subtracts;
+- the paging signal is an **absence rule** per seat on the owning
+  :class:`~.alerts.AlertDaemon`: *no successful canary against seat X
+  for* ``MXNET_TPU_CANARY_ABSENCE_S`` *scaled seconds* walks
+  pending→firing even while the seat self-reports healthy — the
+  lying-healthz page.
+
+``MXNET_TPU_CANARY=0`` disables the whole subsystem: the router never
+constructs a prober, no thread spawns, no family registers.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from urllib.parse import urlsplit
+
+import numpy as np
+
+from .. import envvars
+from . import events as _events
+from .alerts import PAGE, AbsenceRule
+from .registry import DEFAULT_MS_BUCKETS, REGISTRY
+from .trace import new_trace_id
+
+__all__ = ["CanaryProber", "golden_tokens", "response_checksum"]
+
+#: outcome label values (one counter child each, per seat/transport)
+OUTCOMES = ("ok", "checksum_mismatch", "timeout", "error")
+
+_TIMEOUT_ERRORS = ("DeadlineExceededError", "TimeoutError")
+
+
+def golden_tokens(n=16, vocab=1000):
+    """The deterministic synthetic request: small (one packed row,
+    negligible device time) and identical on every probe so the
+    response checksum is comparable across seats and rounds."""
+    return (np.arange(n, dtype=np.int32) % max(2, int(vocab) - 1)) + 1
+
+
+def response_checksum(result):
+    """Content checksum of a probe response. Rounded to 3 decimals
+    before hashing so benign float jitter across identical replicas
+    (bf16 reductions, fused vs unfused lowerings) doesn't page, while
+    wrong weights — which move outputs at the first decimal — do."""
+    arr = np.asarray(result, dtype=np.float32)
+    return hashlib.sha256(
+        np.round(arr, 3).tobytes() + str(arr.shape).encode()
+    ).hexdigest()[:16]
+
+
+class CanaryProber:
+    """Round-robin black-box prober over a fleet of seats.
+
+    Parameters
+    ----------
+    targets_fn : ``() -> [target, ...]`` re-read every round (seats
+        come and go). A target dict carries ``engine_id`` plus either
+        ``url`` (exposition base URL; ``wire_port`` when the seat
+        advertises one) or ``engine`` (in-process handle).
+    alerts : the owning :class:`~.alerts.AlertDaemon` (usually the
+        router's) — one canary-absence PAGE rule per seat is declared
+        on it, and removed when the seat leaves the fleet. None (e.g.
+        ``MXNET_TPU_SLO=0``) keeps probing + metrics without paging.
+    golden : pin ONE fleet-wide golden checksum (a fleet serving one
+        model — any seat answering differently is wrong). Default:
+        trust-on-first-use PER SEAT — each seat's first successful
+        probe pins its own golden (a ``canary_golden`` event records
+        it) and later drift on that seat counts
+        ``checksum_mismatch``; per-seat goldens also serve fleets
+        whose seats legitimately differ (A/B weights, the loadgen's
+        per-engine random inits).
+    """
+
+    def __init__(self, targets_fn, owner_id="canary", alerts=None,
+                 interval_s=None, timeout_s=None, absence_s=None,
+                 tokens=None, golden=None, registry=None):
+        reg = registry if registry is not None else REGISTRY
+        self._registry = reg
+        self._targets_fn = targets_fn
+        self.owner_id = str(owner_id)
+        self._alerts = alerts
+        self.interval_s = (float(interval_s) if interval_s is not None
+                           else envvars.get("MXNET_TPU_CANARY_INTERVAL_S"))
+        self.timeout_s = (float(timeout_s) if timeout_s is not None
+                          else envvars.get("MXNET_TPU_CANARY_TIMEOUT_S"))
+        self._absence_s = (float(absence_s) if absence_s is not None
+                           else envvars.get("MXNET_TPU_CANARY_ABSENCE_S"))
+        self._tokens = np.asarray(tokens, np.int32) \
+            if tokens is not None else golden_tokens()
+        self.golden = str(golden) if golden is not None else None
+        self._goldens = {}          # per-seat TOFU when not pinned
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = None
+        self._transport_rr = {}     # engine_id -> last transport used
+        self._wire = {}             # engine_id -> (port, WireClient)
+        self._rules = set()         # absence-rule names we declared
+        self.rounds = 0
+        self._c_req = reg.counter(
+            "mxnet_tpu_canary_requests_total",
+            "synthetic canary probes by seat, transport and outcome "
+            "(ok / checksum_mismatch / timeout / error); tagged "
+            "synthetic so cost reconciliation excludes them",
+            ("engine_id", "transport", "outcome", "traffic"))
+        self._h_lat = reg.histogram(
+            "mxnet_tpu_canary_latency_ms",
+            "canary probe round-trip latency by seat and transport",
+            ("engine_id", "transport", "traffic"),
+            buckets=DEFAULT_MS_BUCKETS)
+        self._c_billed_s = reg.counter(
+            "mxnet_tpu_canary_billed_seconds_total",
+            "amortized device seconds billed to canary probes (what "
+            "loadgen subtracts from the cost-ledger delta)",
+            ("engine_id", "traffic"))
+        self._c_billed_req = reg.counter(
+            "mxnet_tpu_canary_billed_requests_total",
+            "canary probes carrying an amortized cost bill",
+            ("engine_id", "traffic"))
+        self._c_billed_tok = reg.counter(
+            "mxnet_tpu_canary_billed_tokens_total",
+            "valid tokens billed to canary probes",
+            ("engine_id", "traffic"))
+        # the exemplar↔retrievable-trace contract is serving-owned;
+        # imported lazily here (telemetry must stay importable without
+        # serving) and resolved once per prober
+        try:
+            from ..serving.metrics import exemplar_gate, slow_exemplar
+            self._exemplars = exemplar_gate()
+            self._slow_exemplar = slow_exemplar
+        except Exception:
+            self._exemplars = False
+            self._slow_exemplar = None
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        with self._lock:
+            if self._thread is not None:
+                return self
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, daemon=True,
+                name=f"mxnet_tpu_canary_{self.owner_id}")
+            self._thread.start()
+        _events.emit("canary_start", owner=self.owner_id,
+                     interval_s=self.interval_s)
+        return self
+
+    def stop(self):
+        with self._lock:
+            self._stop.set()
+            t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5.0)
+        with self._lock:
+            wires = [entry[-1] for entry in self._wire.values()]
+            self._wire.clear()
+        for w in wires:
+            w.close()
+
+    def _run(self):
+        # the FIRST round runs immediately: a fresh fleet must get its
+        # first canary success on the books before the absence rule's
+        # pending dwell can elapse (at drill window scales the dwell is
+        # shorter than one probe interval)
+        while True:
+            try:
+                self.probe_all()
+            except Exception as e:
+                # one broken round must not kill black-box monitoring
+                _events.emit("canary_round_error", owner=self.owner_id,
+                             error=repr(e))
+            if self._stop.wait(self.interval_s):
+                return
+
+    # -- one round ---------------------------------------------------------
+    def probe_all(self):
+        """Probe every current seat once (round-robin transport per
+        seat); reconcile the absence-rule set with the live fleet.
+        Returns ``{engine_id: outcome}``."""
+        try:
+            targets = list(self._targets_fn() or ())
+        except Exception as e:
+            _events.emit("canary_targets_error", owner=self.owner_id,
+                         error=repr(e))
+            return {}
+        self._sync_rules(targets)
+        out = {}
+        for t in targets:
+            if self._stop.is_set():
+                break
+            eid = str(t.get("engine_id"))
+            transport = self._pick_transport(eid, t)
+            t0 = time.perf_counter()
+            outcome, cost, trace_id = self._probe(t, transport)
+            ms = (time.perf_counter() - t0) * 1e3
+            self._record(eid, transport, outcome, ms, cost, trace_id)
+            out[eid] = outcome
+        self.rounds += 1
+        return out
+
+    def _pick_transport(self, eid, target):
+        if "engine" in target:
+            return "local"
+        if not target.get("wire_port"):
+            return "http"
+        last = self._transport_rr.get(eid)
+        nxt = "http" if last == "wire" else "wire"
+        self._transport_rr[eid] = nxt
+        return nxt
+
+    def _record(self, eid, transport, outcome, ms, cost, trace_id):
+        tagged = {"engine_id": eid, "transport": transport,
+                  "traffic": "synthetic"}
+        self._c_req.labels(outcome=outcome, **tagged).inc()
+        if outcome in ("ok", "checksum_mismatch"):
+            exemplar = (self._slow_exemplar(trace_id, ms,
+                                            self._exemplars)
+                        if self._slow_exemplar is not None else None)
+            self._h_lat.labels(**tagged).observe(ms, exemplar=exemplar)
+        if cost:
+            bill = {"engine_id": eid, "traffic": "synthetic"}
+            self._c_billed_s.labels(**bill).inc(
+                max(0.0, float(cost.get("device_s") or 0.0)))
+            self._c_billed_req.labels(**bill).inc()
+            self._c_billed_tok.labels(**bill).inc(
+                int(cost.get("tokens") or 0))
+        if outcome != "ok":
+            _events.emit("canary_probe_failed", owner=self.owner_id,
+                         engine_id=eid, transport=transport,
+                         outcome=outcome, ms=round(ms, 3),
+                         trace_id=trace_id)
+
+    # -- absence rules ------------------------------------------------------
+    def _rule_name(self, eid):
+        return f"canary_absent_{eid}"
+
+    def _sync_rules(self, targets):
+        """One PAGE absence rule per live seat: 'no successful canary
+        against seat X over the (scaled) absence window'. Seats that
+        left the fleet drop their rule — a removed engine must not
+        page forever."""
+        if self._alerts is None:
+            return
+        live = {str(t.get("engine_id")) for t in targets}
+        for eid in live:
+            name = self._rule_name(eid)
+            if name in self._rules:
+                continue
+            try:
+                self._alerts.add_rule(AbsenceRule(
+                    name, "mxnet_tpu_canary_requests_total",
+                    window=self._absence_s,
+                    match={"engine_id": eid, "outcome": "ok",
+                           "traffic": "synthetic"},
+                    severity=PAGE, for_s=60.0,
+                    registry=self._registry))
+                self._rules.add(name)
+            except ValueError:
+                self._rules.add(name)   # declared by a prior prober
+        for eid in [r[len("canary_absent_"):] for r in self._rules]:
+            if eid not in live:
+                self._alerts.remove_rule(self._rule_name(eid))
+                self._rules.discard(self._rule_name(eid))
+
+    # -- probes -------------------------------------------------------------
+    def golden_for(self, engine_id):
+        """The golden checksum this seat is being judged against
+        (None before its first successful probe, unless pinned)."""
+        if self.golden is not None:
+            return self.golden
+        with self._lock:
+            return self._goldens.get(str(engine_id))
+
+    def _probe(self, target, transport):
+        """(outcome, cost_or_None, trace_id) for one probe."""
+        trace_id = new_trace_id("canary")
+        eid = str(target.get("engine_id"))
+        try:
+            if transport == "local":
+                result, cost = self._probe_local(target, trace_id)
+            elif transport == "wire":
+                result, cost = self._probe_wire(target, trace_id)
+            else:
+                result, cost = self._probe_http(target, trace_id)
+        except Exception as e:
+            name = type(e).__name__
+            outcome = ("timeout" if name in _TIMEOUT_ERRORS
+                       or "timed out" in str(e) else "error")
+            return outcome, None, trace_id
+        return self._check(eid, result), cost, trace_id
+
+    def _check(self, eid, result):
+        checksum = response_checksum(result)
+        if self.golden is not None:     # pinned fleet-wide golden
+            return ("ok" if checksum == self.golden
+                    else "checksum_mismatch")
+        with self._lock:
+            prev = self._goldens.get(eid)
+            if prev is None:
+                # trust on first use, PER SEAT: this seat's first
+                # healthy answer is its golden — recorded so an
+                # operator can pin it fleet-wide
+                self._goldens[eid] = checksum
+        if prev is None:
+            _events.emit("canary_golden", owner=self.owner_id,
+                         engine_id=eid, checksum=checksum)
+            return "ok"
+        return "ok" if checksum == prev else "checksum_mismatch"
+
+    def _probe_local(self, target, trace_id):
+        fut = target["engine"].submit(
+            self._tokens, deadline_ms=self.timeout_s * 1e3,
+            trace_id=trace_id)
+        result = fut.result(timeout=self.timeout_s)
+        return result, getattr(fut, "cost", None)
+
+    def _probe_http(self, target, trace_id):
+        payload = {"tokens": self._tokens.tolist(),
+                   "token_types": None,
+                   "deadline_ms": self.timeout_s * 1e3,
+                   "trace_id": trace_id,
+                   "timeout_s": self.timeout_s}
+        req = urllib.request.Request(
+            target["url"].rstrip("/") + "/submit",
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(
+                    req, timeout=self.timeout_s + 1.0) as r:
+                body = json.loads(r.read().decode())
+        except urllib.error.HTTPError as e:
+            try:
+                body = json.loads(e.read().decode())
+            except Exception:
+                raise OSError(f"HTTP {e.code}") from e
+        if not body.get("ok"):
+            if body.get("error_type") in _TIMEOUT_ERRORS:
+                raise TimeoutError(body.get("error") or "canary timeout")
+            raise OSError(body.get("error") or "canary dispatch error")
+        return (np.asarray(body["result"], np.float32),
+                body.get("cost"))
+
+    def _probe_wire(self, target, trace_id):
+        wc = self._wire_client(target)
+        payload = {"tokens": self._tokens,
+                   "token_types": None,
+                   "deadline_ms": self.timeout_s * 1e3,
+                   "trace_id": trace_id,
+                   "span_id": None}
+        box = {}
+        evt = threading.Event()
+
+        def _done(exc, body):
+            box["exc"], box["body"] = exc, body
+            evt.set()
+
+        wc.dispatch(payload, _done, self.timeout_s)
+        if not evt.wait(self.timeout_s + 1.0):
+            raise TimeoutError("canary wire probe timed out")
+        if box.get("exc") is not None:
+            raise box["exc"]
+        body = box["body"] or {}
+        if body.get("error_type") is not None:
+            if body["error_type"] in _TIMEOUT_ERRORS:
+                raise TimeoutError(body.get("error") or "canary timeout")
+            raise OSError(body.get("error") or "canary wire error")
+        return np.asarray(body.get("result")), body.get("cost")
+
+    def _wire_client(self, target):
+        """The prober's OWN persistent wire connection per seat —
+        probing over the router's dispatch pool would share its fate
+        (and its correlation slots); black-box means independent. The
+        handshake pins the seat's advertised engine identity (same
+        defense as the router's dispatch pool): a replacement engine
+        on a recycled port is refused, never probed — or trust-on-
+        first-use goldened — under the old seat's name."""
+        from ..serving.wire import WireClient, WireError
+
+        eid = str(target.get("engine_id"))
+        port = int(target["wire_port"])
+        peer = target.get("wire_engine_id")
+        peer = str(peer) if peer is not None else None
+        host = urlsplit(target["url"]).hostname or "127.0.0.1"
+        with self._lock:
+            known = self._wire.get(eid)
+        if known is not None and (known[0] != port
+                                  or (peer is not None
+                                      and known[1] not in (None, peer))):
+            known[2].close()
+            known = None
+        if known is None:
+            wc = WireClient(host, port, conns=1,
+                            client_id=f"canary-{self.owner_id}",
+                            expect_engine_id=peer,
+                            timeout_s=min(self.timeout_s, 5.0))
+            with self._lock:
+                self._wire[eid] = (port, peer, wc)
+            known = (port, peer, wc)
+        wc = known[2]
+        # blocking connect/handshake is fine HERE: the prober thread
+        # owns its own cadence (this is not a dispatch hot path)
+        if wc.ensure() == 0:
+            raise WireError(f"no canary wire connection to {host}:{port}")
+        wc.sweep()
+        return wc

@@ -1,0 +1,443 @@
+"""Alert egress: the delivery pipeline that takes a page OUT of the
+process.
+
+The SLO engine (:mod:`.slo` + :mod:`.alerts`) can judge the fleet and
+walk a rule to *firing*, but until now the page never left the
+process — an operator not already tailing ``/alerts`` learned nothing.
+An :class:`AlertNotifier` consumes :class:`~.alerts.AlertDaemon` state
+transitions (via ``AlertDaemon.add_listener``) and delivers them to
+configured sinks:
+
+- :class:`WebhookSink` — JSON POST to ``MXNET_TPU_ALERT_EGRESS_URL``
+  (a pager bridge, Alertmanager, a chat webhook);
+- :class:`FileSink` — JSONL append (tests, air-gapped runs);
+- :class:`StdoutSink` — JSON lines on stdout.
+
+Delivery discipline:
+
+- **filtering** — only the transitions worth a human's attention ride
+  out: by default ``firing`` and ``resolved`` of ``page``-severity
+  rules (everything else counts ``skipped``);
+- **fingerprinting + dedup** — each alert identity gets a stable
+  fingerprint (``sha1(owner:alert)``); one firing episode delivers ONE
+  page no matter how many times the daemon re-evaluates it, and the
+  matching ``resolved`` clears the episode so a later re-fire pages
+  again. The fingerprint rides the payload so a receiving pager can
+  correlate fire/resolve pairs, and ``incident_id`` (from
+  :mod:`.incidents`) ties the page to the correlated timeline;
+- **retry with exponential backoff + jitter** — a sink failure retries
+  ``MXNET_TPU_ALERT_EGRESS_RETRIES`` times, sleeping
+  ``backoff * 2^attempt`` plus up to 50% jitter (thundering-herd
+  hygiene when a whole fleet pages at once);
+- **bounded on-disk dead-letter spool** — a notification that exhausts
+  its retries is spooled to ``MXNET_TPU_ALERT_EGRESS_SPOOL`` (default
+  under the flight-recorder dir) and REPLAYED on the next notifier
+  start, so a page survives the death of the process that raised it;
+  delivery deletes the spool file, so a replay delivers exactly once.
+
+``mxnet_tpu_alert_egress_notifications_total{sink,result}`` accounts
+every notification (delivered / retried-then-delivered counts as
+delivered; failed / spooled / deduped / skipped / dropped), and
+``mxnet_tpu_alert_egress_spool`` gauges the dead-letter depth.
+
+``MXNET_TPU_ALERT_EGRESS=0`` — or no sink configured — means no
+notifier: no thread, no families, zero cost (the daemon's listener
+list stays empty).
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import random
+import sys
+import threading
+import time
+import urllib.request
+from collections import OrderedDict, deque
+
+from .. import envvars
+from . import events as _events
+from .registry import REGISTRY
+
+__all__ = ["Sink", "WebhookSink", "FileSink", "StdoutSink",
+           "AlertNotifier", "default_notifier", "reset_default"]
+
+
+class Sink:
+    """One delivery target. ``send`` raises on failure — the notifier
+    owns retries, backoff and the dead-letter spool."""
+
+    name = "?"
+
+    def send(self, payload):
+        raise NotImplementedError
+
+
+class WebhookSink(Sink):
+    """JSON POST to a webhook URL; any non-2xx (or transport error)
+    raises, i.e. retries."""
+
+    name = "webhook"
+
+    def __init__(self, url, timeout_s=5.0):
+        self.url = str(url)
+        self.timeout_s = float(timeout_s)
+
+    def send(self, payload):
+        data = json.dumps(payload, default=str).encode()
+        req = urllib.request.Request(
+            self.url, data=data,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+            if not 200 <= r.status < 300:
+                raise OSError(f"webhook answered HTTP {r.status}")
+
+
+class FileSink(Sink):
+    """JSONL append — one line per notification. Open-per-send keeps
+    the sink valid across log rotation and lets a send fail loudly
+    (unwritable path) instead of buffering into the void."""
+
+    name = "file"
+
+    def __init__(self, path):
+        self.path = str(path)
+
+    def send(self, payload):
+        with open(self.path, "a") as f:
+            f.write(json.dumps(payload, default=str) + "\n")
+
+
+class StdoutSink(Sink):
+    name = "stdout"
+
+    def send(self, payload):
+        sys.stdout.write(json.dumps(payload, default=str) + "\n")
+        sys.stdout.flush()
+
+
+def fingerprint(owner, alert):
+    """Stable identity of one alert rule across its whole lifecycle —
+    the key firing/resolved notifications correlate on."""
+    return hashlib.sha1(f"{owner}:{alert}".encode()).hexdigest()[:12]
+
+
+class AlertNotifier:
+    """Background delivery worker over a set of sinks.
+
+    ``notify(transition_record)`` is the producer surface (attach it
+    with ``daemon.add_listener(notifier.notify)``): filter → dedup →
+    enqueue; the worker thread delivers with per-sink retry/backoff
+    and spools exhausted notifications. ``sleep``/``rng`` are
+    injectable so the retry/backoff golden runs on a scripted clock;
+    :meth:`process_pending` drains the queue on the caller's thread
+    for thread-free tests.
+    """
+
+    def __init__(self, sinks=None, retries=None, backoff_s=None,
+                 spool_dir=None, spool_max=None,
+                 states=("firing", "resolved"), severities=("page",),
+                 registry=None, sleep=None, rng=None):
+        reg = registry if registry is not None else REGISTRY
+        self.sinks = list(sinks or [])
+        self.retries = (int(retries) if retries is not None
+                        else envvars.get("MXNET_TPU_ALERT_EGRESS_RETRIES"))
+        self.backoff_s = (float(backoff_s) if backoff_s is not None
+                          else envvars.get(
+                              "MXNET_TPU_ALERT_EGRESS_BACKOFF_S"))
+        self.spool_dir = spool_dir or self._default_spool()
+        self.spool_max = (int(spool_max) if spool_max is not None
+                          else envvars.get(
+                              "MXNET_TPU_ALERT_EGRESS_SPOOL_MAX"))
+        self.states = tuple(states)
+        self.severities = tuple(severities)
+        self._sleep = sleep if sleep is not None else time.sleep
+        self._rng = rng if rng is not None else random.Random()
+        self._dq = deque()
+        self._cv = threading.Condition()
+        self._idle = True
+        self._thread = None
+        self._closed = False
+        # fingerprint -> transition state already delivered this
+        # episode (bounded; resolved clears firing so a re-fire pages)
+        self._delivered = OrderedDict()
+        self._delivered_cap = 512
+        self._seq = 0
+        self._c_note = reg.counter(
+            "mxnet_tpu_alert_egress_notifications_total",
+            "alert notifications by sink and result (delivered / "
+            "failed / spooled / deduped / skipped / dropped)",
+            ("sink", "result"))
+        self._c_retries = reg.counter(
+            "mxnet_tpu_alert_egress_retries_total",
+            "delivery retries, per sink", ("sink",))
+        self._g_spool = reg.gauge(
+            "mxnet_tpu_alert_egress_spool",
+            "dead-letter spool depth (undelivered notification files)")
+        self._g_spool.set_function(self._spool_depth)
+
+    @staticmethod
+    def _default_spool():
+        explicit = envvars.get("MXNET_TPU_ALERT_EGRESS_SPOOL")
+        if explicit:
+            return explicit
+        flight = (envvars.get("MXNET_TPU_FLIGHT_DIR")
+                  or os.path.join(os.getcwd(), "mxnet_tpu_flight"))
+        return os.path.join(flight, "egress-spool")
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        """Start the delivery thread and enqueue the spool replay."""
+        with self._cv:
+            if self._thread is not None or self._closed:
+                return self
+            self._thread = threading.Thread(
+                target=self._run, daemon=True,
+                name="mxnet_tpu_alert_egress")
+            self._thread.start()
+        self.replay_spool()
+        return self
+
+    def stop(self, timeout=5.0):
+        with self._cv:
+            self._closed = True
+            t, self._thread = self._thread, None
+            self._cv.notify_all()
+        if t is not None:
+            t.join(timeout=timeout)
+
+    def flush(self, timeout=10.0):
+        """Block until the queue is drained and the worker idle (or
+        timeout). Returns True when fully drained."""
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while self._dq or not self._idle:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cv.wait(min(0.05, remaining))
+        return True
+
+    # -- producer ----------------------------------------------------------
+    def notify(self, rec):
+        """Consume one alert-daemon transition record. Filters to the
+        configured states/severities, dedupes per firing episode, and
+        enqueues the rest for delivery. Never raises (it runs on the
+        alert daemon's evaluation thread)."""
+        try:
+            to = rec.get("to")
+            if to not in self.states \
+                    or rec.get("severity") not in self.severities:
+                self._count("-", "skipped")
+                return None
+            fp = fingerprint(rec.get("owner"), rec.get("alert"))
+            key = f"{fp}:{to}"
+            with self._cv:
+                if key in self._delivered:
+                    dup = True
+                else:
+                    dup = False
+                    self._delivered[key] = True
+                    # the opposite transition opens a fresh episode: a
+                    # resolve clears the firing key so a later re-fire
+                    # pages again (and vice versa) — flapping pages per
+                    # episode, never per evaluation
+                    other = "resolved" if to == "firing" else "firing"
+                    self._delivered.pop(f"{fp}:{other}", None)
+                    while len(self._delivered) > self._delivered_cap:
+                        self._delivered.popitem(last=False)
+            if dup:
+                self._count("-", "deduped")
+                return None
+            note = dict(rec, fingerprint=fp, pid=os.getpid())
+            try:
+                from . import incidents as _incidents
+                iid = _incidents.id_for_alert(rec.get("owner"),
+                                              rec.get("alert"))
+                if iid is not None:
+                    note["incident_id"] = iid
+            except Exception:
+                pass
+            self._enqueue(note)
+            return note
+        except Exception as e:
+            _events.emit("alert_egress_error", error=repr(e))
+            return None
+
+    def _enqueue(self, note):
+        with self._cv:
+            if self._closed:
+                return
+            self._dq.append(note)
+            self._cv.notify()
+
+    # -- worker ------------------------------------------------------------
+    def _run(self):
+        while True:
+            with self._cv:
+                self._idle = True
+                self._cv.notify_all()
+                while not self._dq and not self._closed:
+                    self._cv.wait(0.5)
+                if self._closed and not self._dq:
+                    return
+                note = self._dq.popleft()
+                self._idle = False
+            self._deliver(note)
+
+    def process_pending(self):
+        """Deliver everything queued on the CALLER's thread (tests and
+        scripted-clock goldens — no worker thread required). Returns
+        the number of notifications processed."""
+        n = 0
+        while True:
+            with self._cv:
+                if not self._dq:
+                    return n
+                note = self._dq.popleft()
+            self._deliver(note)
+            n += 1
+
+    def _deliver(self, note):
+        # spool-replayed notes carry their target sink; live notes go
+        # to every configured sink independently
+        only = note.pop("_sink", None)
+        for sink in self.sinks:
+            if only is not None and sink.name != only:
+                continue
+            if self._deliver_to(sink, note):
+                self._count(sink.name, "delivered")
+            else:
+                self._count(sink.name, "failed")
+                self._spool(sink, note)
+
+    def _deliver_to(self, sink, note):
+        for attempt in range(self.retries + 1):
+            try:
+                sink.send(note)
+                return True
+            except Exception as e:
+                if attempt >= self.retries:
+                    _events.emit("alert_egress_failed", sink=sink.name,
+                                 alert=note.get("alert"), error=repr(e))
+                    return False
+                self._c_retries.labels(sink=sink.name).inc()
+                delay = self.backoff_s * (2 ** attempt)
+                delay += self._rng.uniform(0, delay * 0.5)
+                self._sleep(delay)
+        return False
+
+    # -- dead-letter spool --------------------------------------------------
+    def _spool_depth(self):
+        try:
+            return len([n for n in os.listdir(self.spool_dir)
+                        if n.endswith(".json")])
+        except OSError:
+            return 0
+
+    def _spool(self, sink, note):
+        """Persist one undeliverable notification (bounded: past
+        ``spool_max`` the OLDEST entry is dropped so the newest pages
+        survive). Never raises — the spool is the last resort, not a
+        new failure mode."""
+        try:
+            os.makedirs(self.spool_dir, exist_ok=True)
+            existing = sorted(n for n in os.listdir(self.spool_dir)
+                              if n.endswith(".json"))
+            while len(existing) >= max(1, self.spool_max):
+                victim = existing.pop(0)
+                try:
+                    os.remove(os.path.join(self.spool_dir, victim))
+                except OSError:
+                    pass
+                self._count(sink.name, "dropped")
+            with self._cv:
+                self._seq += 1
+                seq = self._seq
+            name = (f"{time.time():.3f}-{os.getpid()}-{seq}-"
+                    f"{sink.name}.json")
+            tmp = os.path.join(self.spool_dir, name + ".tmp")
+            with open(tmp, "w") as f:
+                json.dump(dict(note, _sink=sink.name), f, default=str)
+            os.replace(tmp, os.path.join(self.spool_dir, name))
+            self._count(sink.name, "spooled")
+            _events.emit("alert_egress_spooled", sink=sink.name,
+                         alert=note.get("alert"))
+        except Exception as e:
+            _events.emit("alert_egress_error", error=repr(e))
+
+    def replay_spool(self):
+        """Re-enqueue every spooled notification (oldest first) and
+        delete the files — a delivered replay therefore delivers
+        exactly once; a replay that fails again simply re-spools."""
+        try:
+            names = sorted(n for n in os.listdir(self.spool_dir)
+                           if n.endswith(".json"))
+        except OSError:
+            return 0
+        replayed = 0
+        for name in names:
+            path = os.path.join(self.spool_dir, name)
+            try:
+                with open(path) as f:
+                    note = json.load(f)
+                os.remove(path)
+            except (OSError, ValueError):
+                continue
+            note["replayed"] = True
+            self._enqueue(note)
+            replayed += 1
+        if replayed:
+            _events.emit("alert_egress_replay", count=replayed)
+        return replayed
+
+    def _count(self, sink, result):
+        self._c_note.labels(sink=sink, result=result).inc()
+
+
+# -- process singleton (env-configured) -------------------------------------
+
+_default = None
+_default_lock = threading.Lock()
+_default_built = False
+
+
+def default_notifier():
+    """The process-wide env-configured notifier, built (and started)
+    on first call — or None when ``MXNET_TPU_ALERT_EGRESS=0`` or no
+    sink is configured (then nothing is registered and no thread
+    runs). Every :class:`~.alerts.AlertDaemon` attaches this on
+    ``start()`` so one delivery pipeline serves all owners; the
+    fingerprint dedup keeps N daemons from double-paging."""
+    global _default, _default_built
+    with _default_lock:
+        if _default_built:
+            return _default
+        _default_built = True
+        if not envvars.get("MXNET_TPU_ALERT_EGRESS"):
+            return None
+        sinks = []
+        url = envvars.get("MXNET_TPU_ALERT_EGRESS_URL")
+        if url:
+            sinks.append(WebhookSink(url))
+        path = envvars.get("MXNET_TPU_ALERT_EGRESS_FILE")
+        if path:
+            sinks.append(FileSink(path))
+        if envvars.get("MXNET_TPU_ALERT_EGRESS_STDOUT"):
+            sinks.append(StdoutSink())
+        if not sinks:
+            return None
+        _default = AlertNotifier(sinks=sinks).start()
+        return _default
+
+
+def reset_default():
+    """Tests only: stop and forget the process notifier so the next
+    ``default_notifier()`` re-reads the environment."""
+    global _default, _default_built
+    with _default_lock:
+        n, _default = _default, None
+        _default_built = False
+    if n is not None:
+        n.stop()

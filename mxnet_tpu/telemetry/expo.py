@@ -37,7 +37,12 @@ dependencies, daemon threads — never blocks process exit):
 - ``/alerts`` — optional alert-daemon state (only when an
   ``alerts_fn`` is attached): every rule's pending/firing/resolved
   position, burn-rate history, latency exemplars (trace ids
-  retrievable at ``/traces/<id>``) and recent transitions.
+  retrievable at ``/traces/<id>``) and recent transitions;
+- ``/incidents`` — the correlated incident timeline
+  (:mod:`.incidents`): open incidents first, each folding the alert
+  firings, watchdog trips, scoreboard transitions, restarts and
+  flight bundles it correlates. Default: the process tracker; a
+  router attaches ``incidents_fn`` for the fleet merge.
 
 A server constructed with ``metrics_fn``/``traces_fn``/``trace_fn``
 overrides serves those endpoints from the callables instead of the
@@ -98,6 +103,9 @@ class TelemetryServer:
     alerts_fn : ``() -> dict`` enabling ``/alerts`` (the alert
         daemon's rule table: state machine position per rule, burn
         history, exemplars, recent transitions); None = 404.
+    incidents_fn : ``() -> dict`` overriding ``/incidents`` (the
+        router's fleet-merged incident timeline); None = the process
+        incident tracker.
     profile_fn : ``() -> str | dict`` overriding ``/profile``; None =
         the process continuous profiler (:mod:`.profiling`) — a str
         serves as collapsed text, a dict as JSON.
@@ -110,7 +118,7 @@ class TelemetryServer:
                  metrics_fn=None, traces_fn=None, trace_fn=None,
                  submit_fn=None, warmup_fn=None, costs_fn=None,
                  profile_fn=None, slo_fn=None, alerts_fn=None,
-                 port=0, host="127.0.0.1"):
+                 incidents_fn=None, port=0, host="127.0.0.1"):
         self.registry = registry if registry is not None else REGISTRY
         self.healthz_fn = healthz_fn
         self.stats_fn = stats_fn
@@ -123,6 +131,7 @@ class TelemetryServer:
         self.profile_fn = profile_fn
         self.slo_fn = slo_fn
         self.alerts_fn = alerts_fn
+        self.incidents_fn = incidents_fn
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -253,10 +262,19 @@ class TelemetryServer:
             self._json_fn(handler, self.slo_fn, "no SLO evaluator")
         elif path == "/alerts":
             self._json_fn(handler, self.alerts_fn, "no alert daemon")
+        elif path == "/incidents":
+            if self.incidents_fn is not None:
+                self._json_fn(handler, self.incidents_fn, "")
+                return
+            # default: the process incident tracker — every exposition
+            # server answers the on-call question, not just routers
+            from . import incidents as _incidents
+            self._json_fn(handler, _incidents.snapshot, "")
         else:
             self._reply(handler, 404, "text/plain",
                         b"try /metrics, /healthz, /stats, /traces, "
-                        b"/profile, /costs, /slo, /alerts or /warmup\n")
+                        b"/profile, /costs, /slo, /alerts, /incidents "
+                        b"or /warmup\n")
 
     def _json_fn(self, handler, fn, missing):
         """Serve an optional JSON endpoint off a callable: 404 when

@@ -1,0 +1,398 @@
+"""Correlated incident timeline: one object per outage, not N signals.
+
+When an engine wedges, the fleet emits a burst of disjoint telemetry:
+an alert walks pending→firing, the watchdog trips, the router
+scoreboard marks the seat down, a flight bundle lands on disk, a
+replacement seat warms up. Each is already observable on its own
+surface; this module folds them into correlated **incident** objects
+so ``/incidents`` answers the on-call question directly: *what is
+happening, since when, and what evidence do I have*.
+
+The :class:`IncidentTracker` is an event tap (no thread): it watches
+the structured run-event stream for SIGNAL events —
+
+- ``alert_state``          (the alert daemon's transitions; *firing*
+  opens an incident, *resolved* releases it),
+- ``watchdog_anomaly``     (stall/wedge trips — openers),
+- ``router_engine_state``  (scoreboard transitions; *down* opens and
+  holds the incident, *up* releases),
+- ``engine_start`` / ``warmup_replay`` / ``router_engine_added`` /
+  ``router_engine_removed`` (restart/recovery breadcrumbs — attach
+  to an open incident, never open one),
+- ``flight_recorder_dump`` / ``flight_recorder_amend`` (evidence:
+  the bundle path links into the incident, and — the other
+  direction — the recorder stamps the open incident's id into every
+  bundle's ``meta.json`` via :func:`~.recorder.set_meta_stamp`).
+
+An incident stays OPEN while any constituent alert is firing or any
+seat it saw go down has not come back; once everything released, it
+closes after a quiet ``MXNET_TPU_INCIDENT_GAP_S`` (scaled by
+``MXNET_TPU_SLO_WINDOW_SCALE`` like every other judging-layer
+duration). New signals inside the gap fold into the open incident —
+one wedge produces ONE incident carrying the alert, the trip, the
+scoreboard transition and the (single, deduped) bundle.
+
+Served at ``/incidents`` on every exposition server (the default
+route reads the process tracker; a router overrides with its fleet
+merge). ``mxnet_tpu_incidents_total`` counts openings,
+``mxnet_tpu_incidents_open`` gauges the live count.
+"""
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import time
+from collections import deque
+
+from .. import envvars
+from . import events as _events
+from . import recorder as _recorder
+from .registry import REGISTRY
+
+__all__ = ["Incident", "IncidentTracker", "TRACKER", "install",
+           "snapshot", "open_incidents", "id_for_alert",
+           "merge_snapshots"]
+
+_incident_seq = itertools.count(1)
+
+#: signal kinds that OPEN an incident (everything else only attaches)
+_OPENERS = ("alert", "watchdog", "scoreboard")
+
+#: run-event types the tap consumes (everything else returns in one
+#: frozenset lookup — the tap rides the hot emit path)
+_SIGNAL_EVENTS = frozenset((
+    "alert_state", "watchdog_anomaly", "router_engine_state",
+    "engine_start", "warmup_replay", "router_engine_added",
+    "router_engine_removed", "flight_recorder_dump",
+    "flight_recorder_amend"))
+
+
+class Incident:
+    """One correlated outage: signals, lifecycle, evidence."""
+
+    __slots__ = ("id", "opened_ts", "opened_mono", "closed_ts",
+                 "closed_mono", "last_signal_mono", "signals", "counts",
+                 "firing", "down_engines", "engines", "alerts",
+                 "bundles", "max_signals")
+
+    def __init__(self, max_signals=128):
+        self.id = f"inc-{os.getpid():x}-{next(_incident_seq)}"
+        self.opened_ts = time.time()
+        self.opened_mono = time.monotonic()
+        self.closed_ts = None
+        self.closed_mono = None
+        self.last_signal_mono = self.opened_mono
+        self.signals = deque(maxlen=max_signals)
+        self.counts = {}            # kind -> count (never truncated)
+        self.firing = set()         # (owner, alert) currently firing
+        self.down_engines = set()
+        self.engines = set()
+        self.alerts = set()         # every alert that ever fired here
+        self.bundles = []
+        self.max_signals = max_signals
+
+    @property
+    def open(self):
+        return self.closed_ts is None
+
+    def add(self, kind, summary, engine_id=None, alert=None,
+            bundle=None):
+        now = time.monotonic()
+        self.last_signal_mono = now
+        self.counts[kind] = self.counts.get(kind, 0) + 1
+        if engine_id:
+            self.engines.add(str(engine_id))
+        if alert:
+            self.alerts.add(str(alert))
+        if bundle and bundle not in self.bundles:
+            self.bundles.append(bundle)
+        self.signals.append({"kind": kind,
+                             "ts": round(time.time(), 3),
+                             "summary": summary})
+
+    def releasable(self):
+        """True when nothing holds the incident open anymore (only
+        the quiet gap remains)."""
+        return not self.firing and not self.down_engines
+
+    def row(self):
+        dur = ((self.closed_mono or time.monotonic())
+               - self.opened_mono)
+        return {"id": self.id,
+                "state": "open" if self.open else "closed",
+                "opened_ts": round(self.opened_ts, 3),
+                "closed_ts": (round(self.closed_ts, 3)
+                              if self.closed_ts else None),
+                "duration_s": round(dur, 3),
+                "counts": dict(self.counts),
+                "signals": list(self.signals),
+                "firing": sorted(f"{o}:{a}" for o, a in self.firing),
+                "down_engines": sorted(self.down_engines),
+                "engines": sorted(self.engines),
+                "alerts": sorted(self.alerts),
+                "bundles": list(self.bundles)}
+
+
+class IncidentTracker:
+    """Process-wide signal correlator (thread-free: driven entirely by
+    the events tap; closing is evaluated lazily on signal/snapshot)."""
+
+    def __init__(self, gap_s=None, keep_closed=32, registry=None):
+        self._gap_override = gap_s
+        self._lock = threading.Lock()
+        self._open = []             # usually 0 or 1
+        self._closed = deque(maxlen=keep_closed)
+        self._installed = False
+        self._total = 0
+        self._registry = registry if registry is not None else REGISTRY
+        self._c_total = None
+        self._g_open = None
+
+    @property
+    def gap_s(self):
+        if self._gap_override is not None:
+            return float(self._gap_override)
+        from .slo import window_scale
+        return (envvars.get("MXNET_TPU_INCIDENT_GAP_S")
+                * window_scale())
+
+    # -- install -----------------------------------------------------------
+    def install(self):
+        """Attach the events tap + the recorder meta stamp (idempotent;
+        called by engine/router ``start``). Registers the two incident
+        families on first install."""
+        reg = self._registry
+        with self._lock:
+            if self._installed:
+                return self
+            self._installed = True
+            self._c_total = reg.counter(
+                "mxnet_tpu_incidents_total", "incidents opened")
+            self._g_open = reg.gauge(
+                "mxnet_tpu_incidents_open", "incidents currently open")
+            self._g_open.set(len(self._open))
+        _events.add_tap(self._tap)
+        _recorder.set_meta_stamp(self._meta_stamp)
+        return self
+
+    def uninstall(self):
+        """Tests only: detach the tap/stamp (state is kept)."""
+        with self._lock:
+            self._installed = False
+        _events.remove_tap(self._tap)
+        _recorder.set_meta_stamp(None)
+
+    def _meta_stamp(self):
+        """The recorder hook: every flight bundle written while an
+        incident is open carries its id in ``meta.json``."""
+        with self._lock:
+            closed = self._sweep_locked(time.monotonic())
+            iid = self._open[-1].id if self._open else None
+        self._emit_closed(closed)
+        return {"incident_id": iid} if iid is not None else None
+
+    # -- the tap (hot path: one frozenset lookup for non-signals) ----------
+    def _tap(self, rec):
+        event = rec.get("event")
+        if event not in _SIGNAL_EVENTS:
+            return
+        try:
+            self._signal(event, rec)
+        except Exception:
+            pass                    # telemetry must not hurt the emitter
+
+    def _signal(self, event, rec):
+        kind, summary, opener = self._classify(event, rec)
+        if kind is None:
+            return
+        eid = rec.get("engine_id")
+        alert = rec.get("alert")
+        with self._lock:
+            now = time.monotonic()
+            closed = self._sweep_locked(now)
+            inc = self._open[-1] if self._open else None
+            if inc is None:
+                if not opener:
+                    return          # breadcrumbs never open incidents
+                inc = Incident()
+                self._open.append(inc)
+                self._total += 1
+                if self._c_total is not None:
+                    self._c_total.inc()
+                    self._g_open.set(len(self._open))
+                opened = True
+            else:
+                opened = False
+            inc.add(kind, summary, engine_id=eid, alert=alert,
+                    bundle=rec.get("path") if kind == "bundle" else None)
+            # holds/releases
+            if kind == "alert":
+                key = (rec.get("owner"), alert)
+                if rec.get("to") == "firing":
+                    inc.firing.add(key)
+                elif rec.get("to") in ("resolved", "inactive"):
+                    inc.firing.discard(key)
+            elif kind == "scoreboard":
+                if rec.get("state") == "down":
+                    inc.down_engines.add(str(eid))
+                else:
+                    inc.down_engines.discard(str(eid))
+            inc_id = inc.id
+        self._emit_closed(closed)
+        if opened:
+            _events.emit("incident_open", incident_id=inc_id,
+                         first_signal=kind)
+
+    def _classify(self, event, rec):
+        """(kind, summary, opens) for one signal event — None kind
+        drops it (e.g. a pending alert with no incident open)."""
+        if event == "alert_state":
+            to = rec.get("to")
+            if to not in ("pending", "firing", "resolved", "inactive"):
+                return None, None, False
+            return ("alert",
+                    {"alert": rec.get("alert"), "owner": rec.get("owner"),
+                     "severity": rec.get("severity"),
+                     "from": rec.get("from"), "to": to},
+                    to == "firing")
+        if event == "watchdog_anomaly":
+            return ("watchdog",
+                    {k: rec.get(k) for k in ("probe", "kind",
+                                             "seconds_since_beat",
+                                             "queue_depth")
+                     if rec.get(k) is not None}, True)
+        if event == "router_engine_state":
+            return ("scoreboard",
+                    {"engine_id": rec.get("engine_id"),
+                     "state": rec.get("state"),
+                     "reason": rec.get("reason")},
+                    rec.get("state") == "down")
+        if event in ("flight_recorder_dump", "flight_recorder_amend"):
+            return ("bundle", {"reason": rec.get("reason"),
+                               "path": rec.get("path")}, False)
+        # restart/recovery breadcrumbs
+        return ("restart", {"event": event,
+                            "engine_id": rec.get("engine_id")}, False)
+
+    def _sweep_locked(self, now):
+        """Close every open incident that released and has been quiet
+        past the gap. Returns the closed ids; the close events are
+        emitted OUTSIDE the lock by :meth:`_emit_closed` (an emit under
+        the tracker lock would re-enter the tap chain holding it)."""
+        gap = self.gap_s
+        still, closed = [], []
+        for inc in self._open:
+            if inc.releasable() and now - inc.last_signal_mono > gap:
+                inc.closed_ts = time.time()
+                inc.closed_mono = now
+                self._closed.append(inc)
+                closed.append(inc.id)
+            else:
+                still.append(inc)
+        if closed:
+            self._open = still
+            if self._g_open is not None:
+                self._g_open.set(len(self._open))
+        return closed
+
+    @staticmethod
+    def _emit_closed(closed_ids):
+        for iid in closed_ids:
+            _events.emit("incident_close", incident_id=iid)
+
+    # -- read surfaces -----------------------------------------------------
+    def open_incidents(self):
+        with self._lock:
+            closed = self._sweep_locked(time.monotonic())
+            rows = [inc.row() for inc in self._open]
+        self._emit_closed(closed)
+        return rows
+
+    def id_for_alert(self, owner, alert):
+        """The open incident that saw this alert (notification
+        enrichment: the page carries the incident id)."""
+        with self._lock:
+            closed = self._sweep_locked(time.monotonic())
+            out = None
+            for inc in reversed(self._open):
+                if str(alert) in inc.alerts:
+                    out = inc.id
+                    break
+            if out is None and self._open:
+                out = self._open[-1].id
+        self._emit_closed(closed)
+        return out
+
+    def snapshot(self):
+        """The ``/incidents`` body: open incidents first (newest
+        leading), then the recent closed ring."""
+        with self._lock:
+            swept = self._sweep_locked(time.monotonic())
+            opens = [inc.row() for inc in reversed(self._open)]
+            closed = [inc.row() for inc in reversed(self._closed)]
+            total = self._total
+        self._emit_closed(swept)
+        return {"open": opens, "recent": closed,
+                "total_opened": total,
+                "gap_s": round(self.gap_s, 3)}
+
+    def reset(self):
+        """Tests only: drop all incident state."""
+        with self._lock:
+            self._open = []
+            self._closed.clear()
+            self._total = 0
+            if self._g_open is not None:
+                self._g_open.set(0)
+
+
+#: the process tracker every exposition server's /incidents reads
+TRACKER = IncidentTracker()
+
+
+def install():
+    return TRACKER.install()
+
+
+def snapshot():
+    return TRACKER.snapshot()
+
+
+def open_incidents():
+    return TRACKER.open_incidents()
+
+
+def id_for_alert(owner, alert):
+    return TRACKER.id_for_alert(owner, alert)
+
+
+def merge_snapshots(parts):
+    """Fold N ``/incidents`` bodies (the router's own + every scraped
+    seat's) into one fleet view, deduped by incident id — in-process
+    seats share the router's tracker, so their incidents appear once.
+    ``parts`` is ``[(source_name_or_None, snapshot_or_None), ...]``."""
+    seen = set()
+    opens, recent = [], []
+    total = 0
+    sources = {}
+    for source, snap in parts:
+        name = source or "local"
+        if not snap or "open" not in snap:
+            if source is not None:
+                sources[name] = "missing"
+            continue
+        sources[name] = "ok"
+        total += snap.get("total_opened", 0)
+        for dst, key in ((opens, "open"), (recent, "recent")):
+            for row in snap.get(key, ()):
+                if row.get("id") in seen:
+                    continue
+                seen.add(row.get("id"))
+                if source is not None:
+                    row = dict(row, source=name)
+                dst.append(row)
+    opens.sort(key=lambda r: -(r.get("opened_ts") or 0))
+    recent.sort(key=lambda r: -(r.get("closed_ts") or 0))
+    return {"open": opens, "recent": recent, "total_opened": total,
+            "sources": sources}

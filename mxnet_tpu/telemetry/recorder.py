@@ -54,9 +54,36 @@ from .registry import REGISTRY
 __all__ = ["FlightRecorder", "RECORDER", "install", "dump",
            "register_probe", "unregister_probe", "configure",
            "stall_seconds", "watchdog", "add_bundle_section",
-           "remove_bundle_section"]
+           "remove_bundle_section", "set_meta_stamp"]
 
 _dump_seq = itertools.count()
+
+#: optional ``() -> dict | None`` merged into every bundle's meta.json
+#: at write AND amend time — the incident tracker stamps the open
+#: incident's id here so a bundle names the outage it belongs to
+_meta_stamp = None
+
+
+def set_meta_stamp(fn):
+    """Register (or with None remove) the bundle meta stamper."""
+    global _meta_stamp
+    _meta_stamp = fn
+
+
+def _stamp_meta(meta):
+    """Apply the registered stamp without clobbering existing keys (a
+    re-stamp at amend time keeps the id the bundle was born with)."""
+    fn = _meta_stamp
+    if fn is None:
+        return meta
+    try:
+        extra = fn()
+    except Exception:
+        return meta
+    if extra:
+        for k, v in extra.items():
+            meta.setdefault(k, v)
+    return meta
 
 _config = {
     "interval_s": envvars.get("MXNET_TPU_WATCHDOG_INTERVAL_S"),
@@ -246,6 +273,9 @@ class FlightRecorder:
             if extra:
                 meta.setdefault("amendments", []).append(
                     dict(extra, reason=reason))
+            # a bundle amended mid-incident gains the incident id even
+            # when the FIRST trigger predated the incident opening
+            _stamp_meta(meta)
             tmp = meta_path + ".tmp"
             with open(tmp, "w") as f:
                 json.dump(meta, f, indent=2, default=str)
@@ -277,6 +307,7 @@ class FlightRecorder:
                     "python": sys.version.split()[0]}
             if extra:
                 meta.update(extra)
+            _stamp_meta(meta)
             with open(os.path.join(tmp, "meta.json"), "w") as f:
                 json.dump(meta, f, indent=2, default=str)
             with open(os.path.join(tmp, "spans.json"), "w") as f:

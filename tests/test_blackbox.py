@@ -1,0 +1,779 @@
+"""Black-box fleet monitoring tests (ISSUE 13): synthetic canary
+probing, alert egress, and the correlated incident timeline.
+
+- CanaryProber goldens: golden-checksum trust-on-first-use, checksum
+  mismatch on changed weights, billed-cost accounting, absence-rule
+  lifecycle (declared per seat, removed with the seat);
+- AlertNotifier retry/backoff/dedup with a scripted clock — the
+  delivery-failure golden (N backoffs then dead-letter spool, spool
+  replay on restart delivers exactly once) and the fingerprint dedup
+  across the pending→firing→resolved walk;
+- exemplar-aware ``merge_prometheus_texts`` over the canary families;
+- incident tracker units (open/hold/release/close, bundle links,
+  fleet merge) and the ``telemetry_dump --incidents`` exit-5 contract;
+- THE end-to-end drill (ISSUE acceptance): 2 remote-seat router with
+  canaries on both transports, one engine's worker loop wedged — the
+  canary absence SLO walks pending→firing while the seat's /healthz
+  still answers, the file-sink notifier receives exactly ONE deduped
+  page carrying the incident id, ``/incidents`` shows one open
+  incident correlating alert + watchdog trip + scoreboard transition
+  over ONE amended flight bundle, and recovery resolves, notifies and
+  closes with zero lost non-synthetic requests;
+- disabled paths: ``MXNET_TPU_CANARY=0`` / ``MXNET_TPU_ALERT_EGRESS=0``
+  spawn no threads and register no families (subprocess-verified) and
+  the always-on incident tap stays microbench-cheap.
+"""
+import glob
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mxnet_tpu import nd
+from mxnet_tpu.serving import ServingEngine, ServingRouter
+from mxnet_tpu.telemetry import egress as egress_mod
+from mxnet_tpu.telemetry import incidents as incidents_mod
+from mxnet_tpu.telemetry import recorder as flight
+from mxnet_tpu.telemetry.canary import (CanaryProber, golden_tokens,
+                                        response_checksum)
+from mxnet_tpu.telemetry.egress import (AlertNotifier, FileSink,
+                                        fingerprint)
+from mxnet_tpu.telemetry.expo import (merge_prometheus_texts,
+                                      parse_prometheus_text)
+from mxnet_tpu.telemetry.registry import REGISTRY, MetricsRegistry
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(ROOT, "tools")
+if TOOLS not in sys.path:
+    sys.path.insert(0, TOOLS)
+
+
+def _get_json(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read().decode())
+
+
+class StubModel:
+    """Deterministic identity-ish model; ``scale`` changes the output
+    so a 'wrong weights' seat is one attribute away."""
+
+    def __init__(self, delay=0.0, scale=1.0):
+        self.delay = delay
+        self.scale = scale
+
+    def __call__(self, ids, token_types, valid_length, segment_ids,
+                 positions):
+        if self.delay:
+            time.sleep(self.delay)
+        return nd.array(
+            ids.asnumpy().astype(np.float32)[..., None] * self.scale)
+
+
+class WedgeModel(StubModel):
+    """Forward blocks while ``block`` is set — the wedged-worker-loop
+    shape: the thread is alive (healthz green) but nothing completes."""
+
+    def __init__(self):
+        super().__init__()
+        self.block = threading.Event()
+
+    def __call__(self, *args):
+        while self.block.is_set():
+            time.sleep(0.01)
+        return super().__call__(*args)
+
+
+class FailingSink(egress_mod.Sink):
+    name = "file"            # impersonates the file sink for replay
+
+    def __init__(self):
+        self.attempts = 0
+
+    def send(self, payload):
+        self.attempts += 1
+        raise OSError("pager endpoint down")
+
+
+class ListSink(egress_mod.Sink):
+    name = "list"
+
+    def __init__(self):
+        self.sent = []
+
+    def send(self, payload):
+        self.sent.append(dict(payload))
+
+
+def _transition(alert="lat_fast", owner="r0", to="firing",
+                frm="pending", severity="page"):
+    return {"alert": alert, "owner": owner, "severity": severity,
+            "from": frm, "to": to, "ts": round(time.time(), 3),
+            "detail": {"burn_long": 20.0}}
+
+
+# ---------------------------------------------------------------------------
+# alert egress: retry / backoff / dedup / spool goldens
+# ---------------------------------------------------------------------------
+
+def test_notifier_backoff_then_spool_then_replay_exactly_once(tmp_path):
+    """The delivery-failure golden: N retries with exponential backoff
+    + jitter, then the dead-letter spool; a restarted notifier replays
+    the spool and delivers exactly once."""
+    spool = str(tmp_path / "spool")
+    sleeps = []
+    failing = FailingSink()
+    n1 = AlertNotifier(sinks=[failing], retries=3, backoff_s=0.5,
+                       spool_dir=spool, registry=MetricsRegistry(),
+                       sleep=sleeps.append,
+                       rng=__import__("random").Random(0))
+    note = n1.notify(_transition())
+    assert note is not None and note["fingerprint"] \
+        == fingerprint("r0", "lat_fast")
+    assert n1.process_pending() == 1       # scripted clock: no thread
+    # 3 retries = 4 attempts, 3 backoff sleeps doubling from 0.5 with
+    # up to 50% jitter each
+    assert failing.attempts == 4
+    assert len(sleeps) == 3
+    for i, s in enumerate(sleeps):
+        base = 0.5 * (2 ** i)
+        assert base <= s <= base * 1.5, sleeps
+    spooled = [f for f in os.listdir(spool) if f.endswith(".json")]
+    assert len(spooled) == 1, spooled
+    body = json.load(open(os.path.join(spool, spooled[0])))
+    assert body["_sink"] == "file" and body["alert"] == "lat_fast"
+
+    # restart: a WORKING file sink under the same name replays the
+    # spooled page exactly once, then the spool is empty
+    out = tmp_path / "pages.jsonl"
+    n2 = AlertNotifier(sinks=[FileSink(str(out))], retries=0,
+                       spool_dir=spool, registry=MetricsRegistry(),
+                       sleep=sleeps.append)
+    assert n2.replay_spool() == 1
+    assert n2.process_pending() == 1
+    lines = [json.loads(l) for l in out.read_text().splitlines()]
+    assert len(lines) == 1
+    assert lines[0]["alert"] == "lat_fast" and lines[0]["replayed"]
+    assert not [f for f in os.listdir(spool) if f.endswith(".json")]
+    # nothing left to replay
+    assert n2.replay_spool() == 0
+
+
+def test_notifier_fingerprint_dedup_across_the_walk():
+    """One firing episode = one page; the matching resolved notifies
+    once and re-arms the fingerprint so a re-fire pages again. Pending
+    transitions and ticket severities never leave the process."""
+    sink = ListSink()
+    n = AlertNotifier(sinks=[sink], retries=0,
+                      registry=MetricsRegistry(), sleep=lambda s: None)
+    # pending filtered, ticket severity filtered
+    assert n.notify(_transition(to="pending", frm="inactive")) is None
+    assert n.notify(_transition(severity="ticket")) is None
+    # firing delivers once, the duplicate dedupes
+    assert n.notify(_transition()) is not None
+    assert n.notify(_transition()) is None
+    # resolved delivers, then the episode re-arms: fire again → page
+    assert n.notify(_transition(frm="firing", to="resolved")) is not None
+    assert n.notify(_transition()) is not None
+    n.process_pending()
+    walk = [(p["to"], p["fingerprint"]) for p in sink.sent]
+    fp = fingerprint("r0", "lat_fast")
+    assert walk == [("firing", fp), ("resolved", fp), ("firing", fp)]
+    # a DIFFERENT alert has a different fingerprint
+    assert fingerprint("r0", "avail_fast") != fp
+
+
+def test_notifier_spool_bound_drops_oldest(tmp_path):
+    spool = str(tmp_path / "spool")
+    n = AlertNotifier(sinks=[FailingSink()], retries=0, spool_max=2,
+                      spool_dir=spool, registry=MetricsRegistry(),
+                      sleep=lambda s: None)
+    for i in range(4):
+        n.notify(_transition(alert=f"a{i}"))
+        n.notify(_transition(alert=f"a{i}", frm="firing", to="resolved"))
+    n.process_pending()
+    names = sorted(f for f in os.listdir(spool) if f.endswith(".json"))
+    assert len(names) == 2, names
+    kept = {json.load(open(os.path.join(spool, f)))["alert"]
+            for f in names}
+    assert kept == {"a3"}, kept     # newest firing+resolved survive
+
+
+def test_default_notifier_env_gating(tmp_path, monkeypatch):
+    egress_mod.reset_default()
+    monkeypatch.setenv("MXNET_TPU_ALERT_EGRESS", "0")
+    monkeypatch.setenv("MXNET_TPU_ALERT_EGRESS_FILE",
+                       str(tmp_path / "p.jsonl"))
+    assert egress_mod.default_notifier() is None
+    egress_mod.reset_default()
+    monkeypatch.setenv("MXNET_TPU_ALERT_EGRESS", "1")
+    n = egress_mod.default_notifier()
+    try:
+        assert n is not None
+        assert [s.name for s in n.sinks] == ["file"]
+        # cached: same instance on re-ask
+        assert egress_mod.default_notifier() is n
+    finally:
+        egress_mod.reset_default()
+    # no sink configured → no notifier, no thread
+    monkeypatch.delenv("MXNET_TPU_ALERT_EGRESS_FILE")
+    assert egress_mod.default_notifier() is None
+    egress_mod.reset_default()
+
+
+# ---------------------------------------------------------------------------
+# canary prober units
+# ---------------------------------------------------------------------------
+
+def test_canary_local_probe_golden_and_mismatch():
+    eng = ServingEngine(StubModel(), bucket_lens=(32,), max_rows=2,
+                        engine_id="cn-e0")
+    reg = MetricsRegistry()
+    with eng:
+        eng.warmup()
+        prober = CanaryProber(
+            lambda: [{"engine_id": "cn-e0", "engine": eng}],
+            owner_id="cn-t", interval_s=60.0, timeout_s=10.0,
+            registry=reg)
+        out = prober.probe_all()
+        assert out == {"cn-e0": "ok"}
+        golden = prober.golden_for("cn-e0")
+        assert golden is not None and len(golden) == 16
+        # the golden is the CONTENT hash: identical output → identical
+        # checksum, any weight change → a different one
+        direct = eng.infer(golden_tokens(), timeout=30)
+        assert response_checksum(direct) == golden
+        # a second round against the same weights stays ok
+        assert prober.probe_all() == {"cn-e0": "ok"}
+        c = reg.get("mxnet_tpu_canary_requests_total")
+        assert c.labels(engine_id="cn-e0", transport="local",
+                        outcome="ok", traffic="synthetic").value == 2
+        # the probes were billed (and tagged for exclusion)
+        billed = reg.get("mxnet_tpu_canary_billed_requests_total")
+        assert billed.labels(engine_id="cn-e0",
+                             traffic="synthetic").value == 2
+        toks = reg.get("mxnet_tpu_canary_billed_tokens_total")
+        assert toks.labels(engine_id="cn-e0",
+                           traffic="synthetic").value \
+            == 2 * golden_tokens().size
+
+    # a seat serving the WRONG weights fails the PINNED fleet golden,
+    # not the transport: black-box catches what healthz never could
+    wrong = ServingEngine(StubModel(scale=2.0), bucket_lens=(32,),
+                          max_rows=2, engine_id="cn-e1")
+    with wrong:
+        wrong.warmup()
+        prober2 = CanaryProber(
+            lambda: [{"engine_id": "cn-e1", "engine": wrong}],
+            owner_id="cn-t2", interval_s=60.0, golden=golden,
+            registry=MetricsRegistry())
+        assert prober2.golden_for("cn-e1") == golden   # pinned
+        assert prober2.probe_all() == {"cn-e1": "checksum_mismatch"}
+    # per-seat trust-on-first-use: the same wrong-weights seat judged
+    # against ITSELF is healthy — until its own output drifts
+    drift = StubModel(scale=2.0)
+    eng3 = ServingEngine(drift, bucket_lens=(32,), max_rows=2,
+                         engine_id="cn-e2")
+    with eng3:
+        eng3.warmup()
+        prober3 = CanaryProber(
+            lambda: [{"engine_id": "cn-e2", "engine": eng3}],
+            owner_id="cn-t3", interval_s=60.0,
+            registry=MetricsRegistry())
+        assert prober3.probe_all() == {"cn-e2": "ok"}
+        drift.scale = 3.0          # a hot-swap gone wrong
+        assert prober3.probe_all() == {"cn-e2": "checksum_mismatch"}
+
+
+def test_canary_absence_rules_follow_the_fleet():
+    """One PAGE absence rule per live seat; a seat leaving the fleet
+    drops its rule (a removed engine must not page forever)."""
+    from mxnet_tpu.telemetry.alerts import AlertDaemon
+    from mxnet_tpu.telemetry.slo import SloEvaluator
+
+    reg = MetricsRegistry()
+    ev = SloEvaluator("cn-own", registry=reg, scale=0.01,
+                      budget_s=1000.0)
+    daemon = AlertDaemon(ev, registry=reg, on_page=lambda p: None)
+    eng = ServingEngine(StubModel(), bucket_lens=(32,), max_rows=2,
+                        engine_id="cn-a0")
+    targets = [{"engine_id": "cn-a0", "engine": eng}]
+    with eng:
+        eng.warmup()
+        prober = CanaryProber(lambda: targets, owner_id="cn-own",
+                              alerts=daemon, interval_s=60.0,
+                              registry=reg)
+        prober.probe_all()
+        rule = daemon.get("canary_absent_cn-a0")
+        assert rule is not None and rule.severity == "page"
+        assert rule.match == {"engine_id": "cn-a0", "outcome": "ok",
+                              "traffic": "synthetic"}
+        # a healthy seat evaluates inactive: successes keep landing
+        # BETWEEN ticks, so the windowed delta stays positive
+        daemon.evaluate_once()
+        prober.probe_all()
+        daemon.evaluate_once()
+        assert daemon.state("canary_absent_cn-a0") == "inactive"
+        # seat leaves the fleet → rule retired
+        targets.clear()
+        prober.probe_all()
+        assert daemon.get("canary_absent_cn-a0") is None
+
+
+def test_remove_rule_while_firing_emits_resolving_transition():
+    """Retiring a PENDING/FIRING rule (a seat removed mid-incident)
+    must emit a final resolved transition: the incident tracker's
+    firing hold releases and the notifier delivers the clearing page
+    — a silent pop would leave /incidents open forever."""
+    from mxnet_tpu.telemetry.alerts import AlertDaemon, AlertRule
+    from mxnet_tpu.telemetry.slo import SloEvaluator
+
+    class AlwaysFiring(AlertRule):
+        def condition(self, evaluator, now):
+            return True, {"forced": True}
+
+    reg = MetricsRegistry()
+    ev = SloEvaluator("rm-own", registry=reg, scale=1.0, budget_s=10.0)
+    daemon = AlertDaemon(ev, registry=reg, on_page=lambda p: None)
+    seen = []
+    daemon.add_listener(seen.append)
+    daemon.add_rule(AlwaysFiring("stuck", severity="page", for_s=0.0))
+    daemon.evaluate_once()
+    assert daemon.state("stuck") == "firing"
+    assert daemon.remove_rule("stuck") is True
+    assert daemon.get("stuck") is None
+    final = [r for r in seen if r["to"] == "resolved"]
+    assert len(final) == 1
+    assert final[0]["from"] == "firing"
+    assert final[0]["detail"]["removed"] is True
+    # the transition log carries the synthetic resolve too
+    walk = [(t["from"], t["to"])
+            for t in daemon.snapshot()["transitions"]]
+    assert walk[-1] == ("firing", "resolved")
+    # removing an INACTIVE rule stays silent (nothing to clear)
+    daemon.add_rule(AlwaysFiring("quiet", severity="page", for_s=1e9))
+    assert daemon.remove_rule("quiet") is True
+    assert [r for r in seen if r["alert"] == "quiet"] == []
+    assert daemon.remove_rule("ghost") is False
+
+
+def test_merge_prometheus_texts_canary_families_keep_exemplars():
+    """Two routers' canary expositions scrape-merge: buckets sum, and
+    per series the worst (slowest) exemplar survives — the fleet
+    exposition keeps the worst retrievable probe trace."""
+    series = ('mxnet_tpu_canary_latency_ms_bucket{engine_id="e0",'
+              'transport="wire",traffic="synthetic",le="100"}')
+    a = ("# TYPE mxnet_tpu_canary_latency_ms histogram\n"
+         f'{series} 3 # {{trace_id="canary-a"}} 40 1.0\n'
+         'mxnet_tpu_canary_latency_ms_sum{engine_id="e0",'
+         'transport="wire",traffic="synthetic"} 70\n'
+         'mxnet_tpu_canary_latency_ms_count{engine_id="e0",'
+         'transport="wire",traffic="synthetic"} 3\n'
+         "# TYPE mxnet_tpu_canary_requests_total counter\n"
+         'mxnet_tpu_canary_requests_total{engine_id="e0",'
+         'transport="wire",outcome="ok",traffic="synthetic"} 3\n')
+    b = ("# TYPE mxnet_tpu_canary_latency_ms histogram\n"
+         f'{series} 2 # {{trace_id="canary-b"}} 90 2.0\n'
+         "# TYPE mxnet_tpu_canary_requests_total counter\n"
+         'mxnet_tpu_canary_requests_total{engine_id="e0",'
+         'transport="wire",outcome="ok",traffic="synthetic"} 2\n')
+    merged = merge_prometheus_texts([a, b])
+    exemplars = {}
+    parsed = parse_prometheus_text(merged, exemplars=exemplars)
+    assert parsed[series] == 5.0
+    assert exemplars[series]["trace_id"] == "canary-b"
+    assert exemplars[series]["value"] == pytest.approx(90.0)
+    key = ('mxnet_tpu_canary_requests_total{engine_id="e0",'
+           'transport="wire",outcome="ok",traffic="synthetic"}')
+    assert parsed[key] == 5.0
+    # merged output re-merges without corruption
+    assert parse_prometheus_text(
+        merge_prometheus_texts([merged])) == parsed
+
+
+# ---------------------------------------------------------------------------
+# incident tracker units
+# ---------------------------------------------------------------------------
+
+def test_incident_open_hold_release_close():
+    tr = incidents_mod.IncidentTracker(gap_s=0.15,
+                                       registry=MetricsRegistry())
+    # breadcrumbs alone never open an incident
+    tr._signal("engine_start", {"event": "engine_start",
+                                "engine_id": "e0"})
+    assert tr.open_incidents() == []
+    # a firing alert opens; a scoreboard down holds
+    tr._signal("alert_state", _transition())
+    tr._signal("router_engine_state",
+               {"engine_id": "e0", "state": "down", "reason": "stall"})
+    tr._signal("watchdog_anomaly", {"probe": "p", "kind": "stall"})
+    tr._signal("flight_recorder_dump",
+               {"reason": "watchdog_stall", "path": "/tmp/b1"})
+    opens = tr.open_incidents()
+    assert len(opens) == 1
+    inc = opens[0]
+    assert inc["counts"] == {"alert": 1, "scoreboard": 1,
+                             "watchdog": 1, "bundle": 1}
+    assert inc["firing"] == ["r0:lat_fast"]
+    assert inc["down_engines"] == ["e0"]
+    assert inc["bundles"] == ["/tmp/b1"]
+    assert tr.id_for_alert("r0", "lat_fast") == inc["id"]
+    # released but not yet quiet: still open
+    tr._signal("alert_state", _transition(frm="firing", to="resolved"))
+    tr._signal("router_engine_state", {"engine_id": "e0", "state": "up"})
+    assert len(tr.open_incidents()) == 1
+    time.sleep(0.2)
+    assert tr.open_incidents() == []
+    snap = tr.snapshot()
+    assert snap["open"] == [] and len(snap["recent"]) == 1
+    assert snap["recent"][0]["state"] == "closed"
+    assert snap["recent"][0]["id"] == inc["id"]
+    assert snap["total_opened"] == 1
+    # post-close breadcrumbs do not resurrect it
+    tr._signal("warmup_replay", {"event": "warmup_replay",
+                                 "engine_id": "e0"})
+    assert tr.open_incidents() == []
+
+
+def test_incident_merge_snapshots_dedupes_by_id():
+    row = {"id": "inc-1", "opened_ts": 10.0, "state": "open"}
+    local = {"open": [row], "recent": [], "total_opened": 1}
+    remote = {"open": [dict(row)],
+              "recent": [{"id": "inc-0", "closed_ts": 5.0,
+                          "state": "closed"}],
+              "total_opened": 2}
+    merged = incidents_mod.merge_snapshots(
+        [(None, local), ("e1", remote), ("e2", None)])
+    assert [r["id"] for r in merged["open"]] == ["inc-1"]
+    assert [r["id"] for r in merged["recent"]] == ["inc-0"]
+    assert merged["recent"][0]["source"] == "e1"
+    assert merged["sources"] == {"local": "ok", "e1": "ok",
+                                 "e2": "missing"}
+
+
+def test_telemetry_dump_incidents_exit_codes(capsys):
+    import telemetry_dump
+    from mxnet_tpu.telemetry.expo import TelemetryServer
+
+    tr = incidents_mod.TRACKER
+    tr.reset()
+    installed = tr.install()        # idempotent; default route reads it
+    assert installed is tr
+    srv = TelemetryServer()
+    try:
+        url = srv.url("/incidents")
+        assert telemetry_dump.main(["--incidents", url]) == 0
+        out = capsys.readouterr().out
+        assert "0 open" in out
+        tr._signal("alert_state", _transition())
+        assert telemetry_dump.main(["--incidents", url]) == 5
+        out = capsys.readouterr().out
+        assert "1 open" in out and "lat_fast" in out
+    finally:
+        srv.close()
+        tr.reset()
+
+
+# ---------------------------------------------------------------------------
+# loadgen: synthetic canary traffic excluded from the cost books
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def drill_env(monkeypatch, tmp_path):
+    """Drill-speed knobs: scaled SLO clock, fast canary rounds, fast
+    watchdog, isolated flight dir; global state restored on exit."""
+    monkeypatch.setenv("MXNET_TPU_SLO_WINDOW_SCALE", "0.01")
+    monkeypatch.setenv("MXNET_TPU_SLO_EVAL_S", "0.1")
+    # recovery latencies are SECONDS; the latency objective must not
+    # page on them (this drill's page is the canary absence rule)
+    monkeypatch.setenv("MXNET_TPU_SLO_LATENCY_MS", "30000")
+    monkeypatch.setenv("MXNET_TPU_CANARY_INTERVAL_S", "0.1")
+    monkeypatch.setenv("MXNET_TPU_CANARY_TIMEOUT_S", "0.5")
+    monkeypatch.setenv("MXNET_TPU_FLIGHT_DIR", str(tmp_path / "flight"))
+    saved = flight.configure()
+    flight.configure(interval_s=0.2, stall_s=1.0, min_dump_interval_s=60)
+    rec = flight.RECORDER
+    rec._last_bundle = None
+    rec._last_dump.clear()
+    incidents_mod.TRACKER.reset()
+    yield str(tmp_path / "flight")
+    flight.configure(**{k: saved[k] for k in
+                        ("interval_s", "stall_s", "min_dump_interval_s")})
+    rec._last_bundle = None
+    rec._last_dump.clear()
+    incidents_mod.TRACKER.reset()
+
+
+def test_loadgen_excludes_canary_from_cost_books(drill_env):
+    """A router-side prober bills real device time into the ledger;
+    the loadgen cost cross-check must still reconcile (≤5% device_s)
+    by excluding the label-identified synthetic traffic, and the
+    report carries the canary section."""
+    from serve_loadgen import run_load
+
+    engines = [ServingEngine(StubModel(), bucket_lens=(32,), max_rows=2,
+                             engine_id=f"lg-e{i}") for i in range(2)]
+    for e in engines:
+        e.start()
+        e.warmup()
+    router = ServingRouter(engines=engines, poll_interval_s=0.2,
+                           router_id="lg-router").start()
+    try:
+        srv = router.expose()
+        # let at least one canary round land before the measured window
+        deadline = time.monotonic() + 10
+        c = REGISTRY.get("mxnet_tpu_canary_requests_total")
+        while time.monotonic() < deadline:
+            if all(c.labels(engine_id=f"lg-e{i}", transport="local",
+                            outcome="ok", traffic="synthetic").value > 0
+                   for i in range(2)):
+                break
+            time.sleep(0.05)
+        report = run_load(router, n_clients=4, requests_per_client=8,
+                          min_len=4, max_len=24, vocab=100,
+                          metrics_url=srv.url("/metrics"))
+        assert report["completed"] == 32
+        assert report["server"]["reconciled"], \
+            report["server"]["mismatches"]
+        cost = report["cost"]
+        assert cost["reconciled"] is True, cost["mismatches"]
+        canary = report.get("canary")
+        assert canary, report.keys()
+        assert canary["by_transport"].get("local", 0) > 0
+        assert canary["excluded"]["requests"] >= 1
+        assert canary["excluded"]["tokens"] \
+            >= canary["excluded"]["requests"] * golden_tokens().size
+        ok = sum(r.get("ok", 0) for r in canary["probes"].values())
+        assert ok >= canary["excluded"]["requests"] > 0
+    finally:
+        router.stop()
+        for e in engines:
+            e.stop()
+
+
+# ---------------------------------------------------------------------------
+# THE drill: wedged worker loop behind a 2-seat router (acceptance)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.timeout(300)
+def test_wedged_engine_blackbox_drill(drill_env, tmp_path):
+    flight_dir = drill_env
+    pages_path = str(tmp_path / "pages.jsonl")
+    m0, m1 = WedgeModel(), WedgeModel()
+    e0 = ServingEngine(m0, bucket_lens=(64,), max_rows=2,
+                       engine_id="bb-e0", max_queue_depth=64)
+    e1 = ServingEngine(m1, bucket_lens=(64,), max_rows=2,
+                       engine_id="bb-e1", max_queue_depth=64)
+    with e0, e1:
+        s0, s1 = e0.expose(), e1.expose()
+        e0.warmup()
+        e1.warmup()
+        router = ServingRouter(poll_interval_s=0.2,
+                               router_id="bb-router")
+        # remote seats: the canary probes them over BOTH transports
+        router.add_engine("bb-e0", f"http://{s0.host}:{s0.port}")
+        router.add_engine("bb-e1", f"http://{s1.host}:{s1.port}")
+        notifier = AlertNotifier(sinks=[FileSink(pages_path)],
+                                 registry=MetricsRegistry())
+        with router:
+            router.alerts.add_listener(notifier.notify)
+            notifier.start()
+            srv = router.expose()
+            base = f"http://{srv.host}:{srv.port}"
+
+            # phase 0: canaries green over wire AND http on both seats
+            c = REGISTRY.get("mxnet_tpu_canary_requests_total")
+
+            def ok_count(eid, tr):
+                return c.labels(engine_id=eid, transport=tr,
+                                outcome="ok",
+                                traffic="synthetic").value
+
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                if all(ok_count(eid, tr) > 0
+                       for eid in ("bb-e0", "bb-e1")
+                       for tr in ("wire", "http")):
+                    break
+                time.sleep(0.1)
+            assert all(ok_count(eid, tr) > 0
+                       for eid in ("bb-e0", "bb-e1")
+                       for tr in ("wire", "http")), \
+                "canaries never went green on both transports"
+            assert router.canary.golden_for("bb-e0") is not None
+
+            # non-synthetic traffic in flight across the whole drill
+            futs = [router.submit(np.arange(1, 9, dtype=np.int32))
+                    for _ in range(4)]
+
+            # phase 1: wedge e0's worker loop
+            m0.block.set()
+            hz = _get_json(f"http://{s0.host}:{s0.port}/healthz")
+            assert hz["ok"] and hz["worker_alive"], hz  # the lie
+
+            # phase 2: the absence rule walks pending→firing while
+            # /healthz still answers green
+            fired = None
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                al = _get_json(base + "/alerts")
+                rows = [r for r in al["rules"]
+                        if r["alert"] == "canary_absent_bb-e0"]
+                if rows and rows[0]["state"] == "firing":
+                    fired = rows[0]
+                    break
+                time.sleep(0.1)
+            assert fired is not None, "canary absence never fired"
+            assert fired["severity"] == "page"
+            hz = _get_json(f"http://{s0.host}:{s0.port}/healthz")
+            assert hz["ok"], "healthz should still be lying"
+            walked = [(t["from"], t["to"]) for t in al["transitions"]
+                      if t["alert"] == "canary_absent_bb-e0"]
+            assert ("inactive", "pending") in walked, walked
+            assert ("pending", "firing") in walked, walked
+
+            # phase 3: exactly ONE deduped page, carrying the incident
+            assert notifier.flush(15)
+            pages = [json.loads(l) for l in
+                     open(pages_path).read().splitlines()]
+            firing_pages = [p for p in pages if p["to"] == "firing"]
+            assert len(firing_pages) == 1, pages
+            page = firing_pages[0]
+            assert page["alert"] == "canary_absent_bb-e0"
+            assert page["severity"] == "page"
+            incident_id = page.get("incident_id")
+            assert incident_id, page
+
+            # phase 4: ONE open incident correlating alert + watchdog
+            # trip + scoreboard transition, linked to ONE bundle
+            inc = _get_json(base + "/incidents")
+            assert len(inc["open"]) == 1, inc
+            row = inc["open"][0]
+            assert row["id"] == incident_id
+            assert row["counts"].get("alert"), row["counts"]
+            assert row["counts"].get("watchdog"), row["counts"]
+            assert row["counts"].get("scoreboard"), row["counts"]
+            assert "canary_absent_bb-e0" in row["alerts"]
+            bundles = glob.glob(os.path.join(flight_dir, "2*"))
+            assert len(bundles) == 1, bundles   # amended, not raced
+            meta = json.load(open(os.path.join(bundles[0],
+                                               "meta.json")))
+            assert len(meta["causes"]) >= 2, meta["causes"]
+            assert any(cs.startswith("watchdog_")
+                       for cs in meta["causes"]), meta["causes"]
+            assert any(cs.startswith("alert_canary_absent")
+                       for cs in meta["causes"]), meta["causes"]
+            assert meta.get("incident_id") == incident_id
+            assert bundles[0] in row["bundles"], row["bundles"]
+
+            # phase 5: recovery — resolve, notify, close, zero loss
+            m0.block.clear()
+            resolved = False
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                al = _get_json(base + "/alerts")
+                row = [r for r in al["rules"]
+                       if r["alert"] == "canary_absent_bb-e0"][0]
+                if row["state"] in ("resolved", "inactive"):
+                    resolved = True
+                    break
+                time.sleep(0.1)
+            assert resolved, "absence alert never resolved"
+            assert notifier.flush(15)
+            pages = [json.loads(l) for l in
+                     open(pages_path).read().splitlines()]
+            assert any(p["to"] == "resolved"
+                       and p["alert"] == "canary_absent_bb-e0"
+                       for p in pages), pages
+
+            deadline = time.monotonic() + 45
+            closed = False
+            while time.monotonic() < deadline:
+                inc = _get_json(base + "/incidents")
+                if not inc["open"]:
+                    closed = True
+                    break
+                time.sleep(0.2)
+            assert closed, inc["open"]
+            assert any(r["id"] == incident_id for r in inc["recent"])
+
+            # zero lost non-synthetic requests
+            for f in futs:
+                assert f.result(timeout=60) is not None
+        notifier.stop()
+
+
+# ---------------------------------------------------------------------------
+# disabled paths: no threads, no families, microbench guard
+# ---------------------------------------------------------------------------
+
+_DISABLED_PROBE = r"""
+import json, sys, threading, time
+sys.path.insert(0, {root!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from mxnet_tpu import nd
+from mxnet_tpu.serving import ServingEngine, ServingRouter
+from mxnet_tpu.telemetry import events
+from mxnet_tpu.telemetry.registry import REGISTRY
+
+class Stub:
+    def __call__(self, ids, tt, vl, seg, pos):
+        return nd.array(ids.asnumpy().astype(np.float32)[..., None])
+
+eng = ServingEngine(Stub(), bucket_lens=(32,), max_rows=2,
+                    engine_id="off-e0").start()
+router = ServingRouter(engines=[eng], poll_interval_s=0.5).start()
+eng.warmup()
+fut = router.submit([1, 2, 3])
+fut.result(timeout=30)
+# microbench: the always-installed incident tap must keep emit cheap
+n = 20000
+t0 = time.perf_counter()
+for _ in range(n):
+    events.emit("bench_not_a_signal", x=1)
+per_us = (time.perf_counter() - t0) / n * 1e6
+out = {{
+    "canary_attr": router.canary is None,
+    "threads": sorted(t.name for t in threading.enumerate()
+                      if t.name.startswith(("mxnet_tpu_canary",
+                                            "mxnet_tpu_alert_egress"))),
+    "families": sorted(n for n in REGISTRY._metrics
+                       if n.startswith(("mxnet_tpu_canary_",
+                                        "mxnet_tpu_alert_egress_"))),
+    "emit_us": per_us,
+}}
+router.stop()
+eng.stop()
+print("RESULT " + json.dumps(out))
+"""
+
+
+@pytest.mark.timeout(300)
+def test_disabled_paths_no_threads_no_families():
+    """MXNET_TPU_CANARY=0 / MXNET_TPU_ALERT_EGRESS=0: a full
+    router+engine lifecycle spawns no canary/egress thread and
+    registers none of their families (subprocess: the process registry
+    must be born clean), and the always-on incident tap keeps
+    events.emit micro-cheap."""
+    env = dict(os.environ, MXNET_TPU_CANARY="0",
+               MXNET_TPU_ALERT_EGRESS="0",
+               MXNET_TPU_ALERT_EGRESS_FILE="/tmp/should_not_exist.jsonl",
+               JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-c", _DISABLED_PROBE.format(root=ROOT)],
+        capture_output=True, text=True, env=env, timeout=280)
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    line = [l for l in proc.stdout.splitlines()
+            if l.startswith("RESULT ")][-1]
+    out = json.loads(line[len("RESULT "):])
+    assert out["canary_attr"] is True
+    assert out["threads"] == [], out["threads"]
+    assert out["families"] == [], out["families"]
+    assert out["emit_us"] < 50.0, out["emit_us"]

@@ -252,13 +252,22 @@ def test_absence_rule_never_created_stalled_and_moving():
     assert active is None
     c.labels(engine_id="e0").inc()
     rule.sample(ev, now0 + 1)
+    # history SHORTER than the window: absence is undecidable — the
+    # partial-coverage fallback here false-paged freshly declared
+    # canary rules off one quiet second (ISSUE 13 fix)
     active, detail = rule.condition(ev, now0 + 1)
-    assert active is False and detail["delta"] == 1.0
+    assert active is None and detail["span_s"] == 1.0
+    c.labels(engine_id="e0").inc()
+    rule.sample(ev, now0 + 3)
+    # full-window history, counter moving: not absent
+    active, detail = rule.condition(ev, now0 + 3)
+    assert active is False and detail["delta"] == 2.0
     # the counter stops moving: once the last increment ages out of
     # the 3s window (5m at scale 0.01), the slice is absent
     rule.sample(ev, now0 + 4)
     rule.sample(ev, now0 + 5)
-    active, detail = rule.condition(ev, now0 + 5)
+    rule.sample(ev, now0 + 6)
+    active, detail = rule.condition(ev, now0 + 6)
     assert active is True and detail["delta"] == 0.0
 
 

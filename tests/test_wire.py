@@ -203,12 +203,16 @@ def test_wire_client_refuses_wrong_engine_and_non_wire_port():
 # end-to-end: router over 2 remote engines on the binary wire
 # ---------------------------------------------------------------------------
 
-def test_router_wire_parity_zero_threads_per_request():
+def test_router_wire_parity_zero_threads_per_request(monkeypatch):
     """The acceptance golden: 2 remote engines behind a wire router —
     results bit-match the request tokens under 8 concurrent clients,
     both engines serve, and the steady-state thread set does NOT grow
     with in-flight requests (the wire path spawns per CONNECTION, the
-    legacy path spawned per REQUEST)."""
+    legacy path spawned per REQUEST). The canary prober is pinned off:
+    its own per-seat wire connections come up asynchronously (once the
+    health poll advertises the port) and would shift the steady-state
+    thread snapshot this test pins."""
+    monkeypatch.setenv("MXNET_TPU_CANARY", "0")
     with _engine("w0") as e0, _engine("w1") as e1:
         u0, u1 = e0.expose(port=0), e1.expose(port=0)
         router = ServingRouter(poll_interval_s=0.1)
@@ -360,7 +364,9 @@ def test_wire_disabled_router_stays_on_json(monkeypatch):
 def test_fallback_pool_bounds_waiter_threads(monkeypatch):
     """8 concurrent HTTP dispatches against a slow engine run on at
     most MXNET_TPU_WIRE_HTTP_POOL waiter threads — the legacy shape
-    spawned 8."""
+    spawned 8. Canary pinned off: its probe would trip the model's
+    started-event before the 8 dispatches are even queued."""
+    monkeypatch.setenv("MXNET_TPU_CANARY", "0")
     monkeypatch.setenv("MXNET_TPU_WIRE_HTTP_POOL", "2")
     slow = SlowModel(0.2)
     with _engine("pool", m=slow, max_rows=2,

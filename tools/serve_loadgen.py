@@ -153,8 +153,56 @@ def _fetch_slo(metrics_url, timeout=10.0):
     return out
 
 
+def _canary_delta(before, after):
+    """Synthetic-canary deltas over the measured window, scraped off
+    the ``mxnet_tpu_canary_*`` families (tagged ``traffic="synthetic"``
+    for exactly this): per-seat probe counts by outcome, per-transport
+    counts, and the billed device_s/requests/tokens the cost
+    reconciliation must EXCLUDE — a background prober drives real
+    forwards through the engines, so its bills land in the server's
+    cost ledger but never in the loadgen's client books. Returns None
+    when no canary counter moved (prober off, or single-engine mode)."""
+    from mxnet_tpu.telemetry.expo import parse_labels
+
+    probes = {}
+    by_transport = {}
+    excluded = {"device_s": 0.0, "requests": 0, "tokens": 0}
+    moved = False
+    for parsed, sign in ((before or {}, -1), (after or {}, 1)):
+        for key, val in parsed.items():
+            name, labels = parse_labels(key)
+            if name == "mxnet_tpu_canary_requests_total":
+                eid = labels.get("engine_id", "?")
+                outcome = labels.get("outcome", "?")
+                row = probes.setdefault(eid, {})
+                row[outcome] = row.get(outcome, 0.0) + sign * val
+                tr = labels.get("transport", "?")
+                by_transport[tr] = by_transport.get(tr, 0.0) + sign * val
+            elif name == "mxnet_tpu_canary_billed_seconds_total":
+                excluded["device_s"] += sign * val
+            elif name == "mxnet_tpu_canary_billed_requests_total":
+                excluded["requests"] += sign * val
+            elif name == "mxnet_tpu_canary_billed_tokens_total":
+                excluded["tokens"] += sign * val
+            else:
+                continue
+            moved = True
+    probes = {eid: {o: int(n) for o, n in row.items() if n}
+              for eid, row in probes.items()}
+    probes = {eid: row for eid, row in probes.items() if row}
+    if not moved or (not probes
+                     and not any(excluded.values())):
+        return None
+    return {"probes": probes,
+            "by_transport": {t: int(n)
+                             for t, n in by_transport.items() if n},
+            "excluded": {"device_s": round(excluded["device_s"], 6),
+                         "requests": int(excluded["requests"]),
+                         "tokens": int(excluded["tokens"])}}
+
+
 def cross_check_costs(client_cost, before, after, slack=0,
-                      lost_ledgers=False):
+                      lost_ledgers=False, exclude=None):
     """Reconcile client-side cost accounting (summed per-request
     ``future.cost`` bills) against the server cost-ledger DELTA:
     requests and tokens must match exactly, and the client's summed
@@ -174,12 +222,22 @@ def cross_check_costs(client_cost, before, after, slack=0,
     process died mid-run the router's fleet table may be missing that
     seat's final window (remote seats fall back to their last fetched
     ledger), so the server side can legitimately under-read — only
-    over-billing beyond slack stays a mismatch. Returns
+    over-billing beyond slack stays a mismatch.
+
+    ``exclude`` (a ``_canary_delta``-shaped ``excluded`` dict) removes
+    label-identified SYNTHETIC traffic from the ledger delta before
+    comparing: canary probes are billed server-side but are not client
+    requests, and without the exclusion a background prober would skew
+    the ≤5% device_s reconciliation. Returns
     (reconciled, mismatches, delta)."""
     if before is None or after is None:
         return None, ["/costs endpoint unavailable"], None
     delta = {k: after.get(k, 0) - before.get(k, 0)
              for k in ("request_s", "requests", "valid_tokens")}
+    if exclude:
+        delta["request_s"] -= exclude.get("device_s", 0.0)
+        delta["requests"] -= exclude.get("requests", 0)
+        delta["valid_tokens"] -= exclude.get("tokens", 0)
     mismatches = []
     req_lo = 0 if lost_ledgers else client_cost["requests"]
     req_hi = client_cost["requests"] + max(int(slack), 0)
@@ -517,7 +575,12 @@ def run_load(engine, n_clients=8, requests_per_client=16,
     next to the client-observed ones. A ``cost`` section reconciles
     the client-summed per-request amortized bills (``future.cost``)
     against the server's ``/costs`` ledger delta — requests and
-    tokens exactly, device seconds within 5%.
+    tokens exactly, device seconds within 5% — with label-identified
+    SYNTHETIC canary traffic excluded from the ledger side (a
+    router-side prober's probes are billed server-side but are not
+    client requests); when a prober ran, a ``canary`` section reports
+    its per-seat outcome counts, transport split and the excluded
+    device_s/requests/tokens.
     """
     import threading
 
@@ -530,8 +593,14 @@ def run_load(engine, n_clients=8, requests_per_client=16,
     # per-engine request distribution to the report
     is_router = hasattr(engine, "scoreboard")
 
-    before = scrape_metrics(metrics_url) if metrics_url else None
+    # fetch order matters with a live canary prober: /costs BEFORE
+    # /metrics here, and /metrics before /costs at the end, so the
+    # ledger window CONTAINS the canary-counter window — a probe
+    # racing a scrape edge can then only leave an extra ledger-side
+    # request (covered by the upper slack), never an under-read that
+    # would push the delta below the exact lower bound
     costs_before = _fetch_costs(metrics_url) if metrics_url else None
+    before = scrape_metrics(metrics_url) if metrics_url else None
 
     latencies = []          # (ms, trace_id) — list.append is atomic
     outcomes = {"ok": 0, "expired": 0, "shed": 0, "error": 0}
@@ -680,13 +749,25 @@ def run_load(engine, n_clients=8, requests_per_client=16,
         # cost cross-check: client-summed amortized bills vs the
         # server cost-ledger delta over the measured window
         costs_after = _fetch_costs(metrics_url)
+        # synthetic canary traffic (a router-side background prober)
+        # is billed in the ledger but never in the client's books:
+        # exclude its label-identified deltas so the ≤5% device_s
+        # reconciliation holds with canaries running
+        canary = _canary_delta(before, after)
         # failed-over and post-dispatch-failed requests are billed in
         # the ledger but not in the client's ok-books — that many
-        # extra server-side requests is healthy, not a mismatch
-        cost_slack = outcomes["error"] + report.get("failovers", 0)
+        # extra server-side requests is healthy, not a mismatch; with
+        # a live prober, a probe billed inside the (wider) ledger
+        # window whose canary counters landed outside the metrics
+        # window adds ledger-side-only requests the same way
+        cost_slack = outcomes["error"] + report.get("failovers", 0) \
+            + (2 if canary else 0)
         cost_ok, cost_mismatches, cost_delta = cross_check_costs(
             client_cost, costs_before, costs_after, slack=cost_slack,
-            lost_ledgers=bool(report.get("restarts")))
+            lost_ledgers=bool(report.get("restarts")),
+            exclude=canary["excluded"] if canary else None)
+        if canary:
+            report["canary"] = canary
         report["cost"] = {
             "client_device_s": round(client_cost["device_s"], 6),
             "client_requests": client_cost["requests"],
@@ -865,6 +946,177 @@ def overload_drill(target, alerts_fn=None, get_trace=None, alert=None,
             "transitions": fired["transitions"]}
 
 
+class WedgeGate:
+    """Wraps a serving model callable with a blocking gate: while
+    ``block`` is set the forward spins — the worker THREAD stays
+    alive (self-reported health stays green) but nothing completes.
+    The ``--drill-wedge`` harness wedges exactly this way."""
+
+    def __init__(self, fn):
+        self.fn = fn
+        self.block = threading.Event()
+
+    def __call__(self, *args):
+        while self.block.is_set():
+            time.sleep(0.01)
+        return self.fn(*args)
+
+
+def wedge_drill(router, gates, victim, pages_path,
+                fire_timeout_s=90.0, resolve_timeout_s=90.0,
+                close_timeout_s=60.0, n_requests=4, poll_s=0.1):
+    """Black-box wedged-engine drill: block ``victim``'s forward (the
+    worker thread stays alive — its self-reported health stays green)
+    and assert the canary absence rule pages, the page leaves the
+    process through the file-sink notifier with the correlated
+    incident id, ``/incidents`` opens ONE incident, and recovery
+    resolves + closes it with zero lost real requests.
+
+    ``gates`` maps engine_id -> an object with a ``block``
+    ``threading.Event`` wrapped around the model forward (the loadgen
+    CLI builds these for ``--drill-wedge``). Tune the clocks first —
+    e.g. ``MXNET_TPU_SLO_WINDOW_SCALE=0.01 MXNET_TPU_SLO_EVAL_S=0.2
+    MXNET_TPU_CANARY_INTERVAL_S=0.2 MXNET_TPU_CANARY_TIMEOUT_S=1``.
+    Raises AssertionError on any violated contract; returns a report
+    dict."""
+    import numpy as np
+
+    from mxnet_tpu.telemetry.registry import REGISTRY
+
+    assert router.canary is not None, \
+        "wedge drill needs the canary prober (MXNET_TPU_CANARY=1)"
+    assert router.alerts is not None, \
+        "wedge drill needs the SLO engine (MXNET_TPU_SLO=1)"
+    alert = f"canary_absent_{victim}"
+    t0 = time.perf_counter()
+
+    # phase 0: canaries green on every seat
+    fam = REGISTRY.get("mxnet_tpu_canary_requests_total")
+
+    def ok_probes(eid):
+        total = 0.0
+        for values, child in fam._sorted_children():
+            labels = dict(zip(fam.labelnames, values))
+            if labels.get("engine_id") == eid \
+                    and labels.get("outcome") == "ok":
+                total += child.value
+        return total
+
+    deadline = time.monotonic() + fire_timeout_s
+    seats = router.engine_ids()
+    while time.monotonic() < deadline:
+        if fam is None:
+            fam = REGISTRY.get("mxnet_tpu_canary_requests_total")
+        elif all(ok_probes(eid) > 0 for eid in seats):
+            break
+        time.sleep(poll_s)
+    assert fam is not None and all(ok_probes(eid) > 0
+                                   for eid in seats), \
+        "canaries never went green on every seat"
+
+    # real (non-synthetic) traffic rides through the whole drill
+    futs = [router.submit(np.arange(1, 9, dtype=np.int32))
+            for _ in range(n_requests)]
+
+    # phase 1: wedge — then wait for the absence page
+    gates[victim].block.set()
+    fired = None
+    deadline = time.monotonic() + fire_timeout_s
+    while time.monotonic() < deadline:
+        body = router.alerts_snapshot()
+        rows = [r for r in body.get("rules", ())
+                if r.get("alert") == alert]
+        if rows and rows[0]["state"] == "firing":
+            fired = rows[0]
+            break
+        time.sleep(poll_s)
+    assert fired is not None, (
+        f"{alert} never fired within {fire_timeout_s}s (is the canary "
+        "interval/timeout tuned below the scaled absence window?)")
+    walked = [(t.get("from"), t.get("to"))
+              for t in body.get("transitions", ())
+              if t.get("alert") == alert]
+    assert ("pending", "firing") in walked, walked
+    t_fired = time.perf_counter() - t0
+
+    # phase 2: the page LEFT the process, exactly once, with the id
+    pages = []
+    deadline = time.monotonic() + 15.0
+    while time.monotonic() < deadline:
+        try:
+            with open(pages_path) as f:
+                pages = [json.loads(ln) for ln in f.read().splitlines()]
+        except OSError:
+            pages = []
+        if any(p.get("to") == "firing" and p.get("alert") == alert
+               for p in pages):
+            break
+        time.sleep(poll_s)
+    firing_pages = [p for p in pages
+                    if p.get("to") == "firing"
+                    and p.get("alert") == alert]
+    assert len(firing_pages) == 1, firing_pages or pages
+    incident_id = firing_pages[0].get("incident_id")
+    assert incident_id, firing_pages[0]
+    # ONLY the wedged seat pages: a healthy sibling firing here means
+    # either the serial prober starved it behind the victim's timeout
+    # or the absence rule judged a partial window (both fixed bugs)
+    others = [p for p in pages if p.get("to") == "firing"
+              and p.get("alert") != alert]
+    assert not others, others
+
+    inc = router.incidents_snapshot()
+    assert len(inc["open"]) == 1, inc["open"]
+    assert inc["open"][0]["id"] == incident_id
+
+    # phase 3: recovery — resolve, notify, close, zero loss
+    gates[victim].block.clear()
+    deadline = time.monotonic() + resolve_timeout_s
+    resolved = None
+    while time.monotonic() < deadline:
+        body = router.alerts_snapshot()
+        row = [r for r in body.get("rules", ())
+               if r.get("alert") == alert][0]
+        if row["state"] in ("resolved", "inactive"):
+            resolved = row["state"]
+            break
+        time.sleep(poll_s)
+    assert resolved, f"{alert} still firing after recovery"
+    deadline = time.monotonic() + close_timeout_s
+    closed = False
+    while time.monotonic() < deadline:
+        inc = router.incidents_snapshot()
+        if not inc["open"]:
+            closed = True
+            break
+        time.sleep(poll_s)
+    assert closed, "incident never closed after recovery"
+    for f in futs:
+        f.result(timeout=max(60.0, resolve_timeout_s))
+    deadline = time.monotonic() + 15.0
+    while time.monotonic() < deadline:
+        with open(pages_path) as f:
+            pages = [json.loads(ln) for ln in f.read().splitlines()]
+        if any(p.get("to") == "resolved" and p.get("alert") == alert
+               for p in pages):
+            break
+        time.sleep(poll_s)
+    assert any(p.get("to") == "resolved" and p.get("alert") == alert
+               for p in pages), pages
+    return {"alert": alert,
+            "victim": victim,
+            "incident_id": incident_id,
+            "fired_after_s": round(t_fired, 3),
+            "resolved_state": resolved,
+            "closed_after_s": round(time.perf_counter() - t0, 3),
+            "pages": [{k: p.get(k) for k in
+                       ("alert", "to", "incident_id", "fingerprint")}
+                      for p in pages],
+            "real_requests_completed": len(futs),
+            "recent_incident": inc["recent"][0] if inc.get("recent")
+            else None}
+
+
 def _main():
     import argparse
     import os
@@ -908,6 +1160,25 @@ def _main():
                     "separated list gets client-side failover (a "
                     "router that refuses the connection or answers "
                     "5xx advances the request to the next url)")
+    ap.add_argument("--drill-wedge", nargs="?", const="e0",
+                    default=None, metavar="ENGINE",
+                    help="black-box wedged-engine drill (needs "
+                    "--router N): block ENGINE's forward (its worker "
+                    "thread stays alive — self-reported health stays "
+                    "green) and assert the canary absence rule pages "
+                    "through the file-sink notifier with the "
+                    "correlated incident id, then recover, resolve "
+                    "and close with zero lost real requests. Tune "
+                    "the clocks first, e.g. "
+                    "MXNET_TPU_SLO_WINDOW_SCALE=0.01 "
+                    "MXNET_TPU_SLO_EVAL_S=0.2 "
+                    "MXNET_TPU_CANARY_INTERVAL_S=0.2 "
+                    "MXNET_TPU_CANARY_TIMEOUT_S=1 "
+                    "MXNET_TPU_WATCHDOG_INTERVAL_S=0.5 "
+                    "MXNET_TPU_WATCHDOG_STALL_S=2")
+    ap.add_argument("--pages", default=None, metavar="FILE",
+                    help="file-sink path for --drill-wedge page "
+                    "notifications (default: a temp file, printed)")
     ap.add_argument("--drill-overload", nargs="?", const="auto",
                     default=None, metavar="ALERT",
                     help="instead of the measured run, flood the "
@@ -931,6 +1202,8 @@ def _main():
         from mxnet_tpu.telemetry import events
         events.configure(args.event_log, component="serve_loadgen")
 
+    wedge_gates = {}
+
     def make_engine(engine_id=None):
         net = BERTModel(vocab_size=args.vocab, units=args.units,
                         hidden_size=4 * args.units,
@@ -938,7 +1211,10 @@ def _main():
                         max_length=args.max_len, dropout=0.0,
                         attention_dropout=0.0, use_pooler=False)
         net.initialize(init=mx.initializer.Normal(0.02))
-        return ServingEngine(bert_serving_entry(net), bucket_lens=buckets,
+        model = bert_serving_entry(net)
+        if args.drill_wedge is not None:
+            model = wedge_gates.setdefault(engine_id, WedgeGate(model))
+        return ServingEngine(model, bucket_lens=buckets,
                              max_rows=args.max_rows, pool=args.pool,
                              engine_id=engine_id)
 
@@ -957,18 +1233,60 @@ def _main():
         elif args.router > 0:
             engines = [stack.enter_context(make_engine(f"e{i}"))
                        for i in range(args.router)]
+            # warm BEFORE the router starts: its canary prober makes
+            # day-one synthetic traffic, and at drill window scales a
+            # cold fleet's first compiles outlast the absence window —
+            # a startup page the operator did not ask to drill
+            for eng in engines:
+                eng.warmup()
             target = stack.enter_context(ServingRouter(engines=engines))
         else:
             engines = [stack.enter_context(make_engine())]
             target = engines[0]
+            for eng in engines:
+                eng.warmup()
         if not args.router_url and not args.no_expose:
             srv = target.expose(port=args.expose_port)
             metrics_url = srv.url("/metrics")
             print(f"# telemetry: {srv.url('/metrics')} "
                   f"{srv.url('/healthz')} {srv.url('/stats')}",
                   file=sys.stderr)
-        for eng in engines:
-            eng.warmup()
+        if args.drill_wedge is not None:
+            if not args.router or args.router < 2:
+                ap.error("--drill-wedge needs --router N with N >= 2 "
+                         "(in-process engines the drill can gate)")
+            if args.drill_wedge not in wedge_gates:
+                ap.error(f"--drill-wedge {args.drill_wedge!r}: no such "
+                         f"engine (have {sorted(wedge_gates)})")
+            if target.alerts is None or target.canary is None:
+                ap.error("--drill-wedge needs the SLO engine and the "
+                         "canary prober (MXNET_TPU_SLO=1 and "
+                         "MXNET_TPU_CANARY=1)")
+            import tempfile
+
+            from mxnet_tpu.telemetry.egress import (AlertNotifier,
+                                                    FileSink)
+            pages_path = args.pages or os.path.join(
+                tempfile.mkdtemp(prefix="mxnet_tpu_drill_"),
+                "pages.jsonl")
+            print(f"# page notifications (file sink): {pages_path}",
+                  file=sys.stderr)
+            notifier = AlertNotifier(sinks=[FileSink(pages_path)])
+            target.alerts.add_listener(notifier.notify)
+            notifier.start()
+            try:
+                drill = wedge_drill(target, wedge_gates,
+                                    args.drill_wedge, pages_path)
+            finally:
+                notifier.stop()
+            print(json.dumps(drill, indent=2))
+            print(f"# wedge drill OK: {drill['alert']} paged "
+                  f"(incident {drill['incident_id']}), fired after "
+                  f"{drill['fired_after_s']}s, closed after "
+                  f"{drill['closed_after_s']}s, "
+                  f"{drill['real_requests_completed']} real requests "
+                  "completed, zero lost", file=sys.stderr)
+            return 0
         if args.drill_overload:
             alerts_fn = get_trace = None
             if metrics_url:
@@ -1051,6 +1369,19 @@ def _main():
               + (f" device_s_per_1k_tokens={per_1k}"
                  if per_1k is not None else "")
               + f" reconciled={cost['reconciled']}", file=sys.stderr)
+    can = report.get("canary")
+    if can:
+        total_probes = sum(sum(r.values())
+                           for r in can["probes"].values())
+        ok_probes = sum(r.get("ok", 0) for r in can["probes"].values())
+        exc = can["excluded"]
+        print(f"# canary (synthetic, excluded from cost books): "
+              f"{ok_probes}/{total_probes} ok, transports="
+              + ",".join(f"{t}={n}" for t, n in
+                         sorted(can["by_transport"].items()))
+              + f", excluded device_s={exc['device_s']:.4f} "
+              f"requests={exc['requests']} tokens={exc['tokens']}",
+              file=sys.stderr)
     rc = 0
     # a multi-URL --router-url list skips the scrape cross-check (no
     # single set of books), so there may be no server section at all

@@ -41,6 +41,13 @@ remaining column, the observed burn rates against each rule's factor,
 and the exemplar trace ids a firing latency alert links to (paste
 into `--trace <id>`). The exit code goes nonzero while anything is
 firing, so the drill scripts can gate on it.
+
+`--incidents` fetches the correlated incident timeline `/incidents`
+(a process's own, or a router's fleet merge) and tables OPEN
+incidents first: correlated signal counts (alerts / watchdog trips /
+scoreboard transitions / restarts), duration, the alerts and engines
+involved, and the linked flight-bundle path. Exit 5 while any
+incident is open — mirroring the `--alerts` exit-4 contract.
 """
 from __future__ import annotations
 
@@ -155,7 +162,8 @@ def _base_url(src):
     endpoint path so any of /metrics | /stats | the bare base work)."""
     src = src.rstrip("/")
     for suffix in ("/metrics", "/stats", "/healthz", "/traces",
-                   "/profile", "/costs", "/slo", "/alerts"):
+                   "/profile", "/costs", "/slo", "/alerts",
+                   "/incidents"):
         if src.endswith(suffix):
             return src[: -len(suffix)]
     return src
@@ -389,6 +397,49 @@ def dump_alerts(data, out=None):
     return firing
 
 
+def dump_incidents(data, out=None, top=10):
+    """One-screen /incidents table — open incidents first, then the
+    recent closed ring. Returns the number of OPEN incidents so the
+    CLI can turn it into an exit code (5 while anything is open)."""
+    out = out if out is not None else sys.stdout
+    opens = data.get("open") or []
+    recent = data.get("recent") or []
+    src = data.get("sources")
+    print(f"-- incidents: {len(opens)} open, {len(recent)} recent "
+          f"closed, {data.get('total_opened', 0)} total"
+          + (f" (sources: {src})" if src else "") + " " + "-" * 10,
+          file=out)
+    if not opens and not recent:
+        print("  (no incidents — nothing fired, tripped or went down)",
+              file=out)
+        return 0
+
+    def _row(inc):
+        counts = inc.get("counts") or {}
+        sig = " ".join(f"{k}={v}" for k, v in sorted(counts.items()))
+        print(f"  {inc.get('id', '?'):<22} {inc.get('state', '?'):<7} "
+              f"{inc.get('duration_s', 0):>9.1f}s  {sig}", file=out)
+        if inc.get("alerts"):
+            print(f"    alerts:  {', '.join(inc['alerts'])}", file=out)
+        if inc.get("engines"):
+            print(f"    engines: {', '.join(inc['engines'])}"
+                  + (f"  (down: {', '.join(inc['down_engines'])})"
+                     if inc.get("down_engines") else ""), file=out)
+        for b in inc.get("bundles") or []:
+            print(f"    bundle:  {b}", file=out)
+
+    if opens:
+        print(f"  {'incident':<22} {'state':<7} {'duration':>10}  "
+              f"signals", file=out)
+    for inc in opens[:top]:
+        _row(inc)
+    if recent:
+        print(f"-- recent closed " + "-" * 45, file=out)
+        for inc in recent[:top]:
+            _row(inc)
+    return len(opens)
+
+
 def dump_trace_tree(trace, out=None):
     """Indented span-tree render with per-span self-time."""
     out = out if out is not None else sys.stdout
@@ -463,6 +514,11 @@ def main(argv=None):
                     help="table the SLO engine's /alerts rule state "
                     "(firing/pending first, error-budget column); "
                     "exit 4 while anything is firing")
+    ap.add_argument("--incidents", action="store_true",
+                    help="table the correlated incident timeline from "
+                    "the server's /incidents (open first, with signal "
+                    "counts, duration and linked bundle paths); exit "
+                    "5 while an incident is open")
     ap.add_argument("--top", type=int, default=10,
                     help="rows in the --traces/--profile tables")
     args = ap.parse_args(argv)
@@ -497,6 +553,12 @@ def main(argv=None):
             firing = dump_alerts(json.loads(_fetch(base + "/alerts")))
             if firing:
                 rc = max(rc, 4)
+            shown = True
+        if args.incidents:
+            n_open = dump_incidents(
+                json.loads(_fetch(base + "/incidents")), top=args.top)
+            if n_open:
+                rc = max(rc, 5)
             shown = True
         if shown:
             pass
