@@ -1104,11 +1104,14 @@ def _router_fleet_setup(clients_default, reqs_default):
         net.initialize(init=mx.initializer.Normal(0.02), ctx=ctx)
         if DTYPE != "float32":
             net.cast(DTYPE)
+        # i is an index (classic legs) or a full engine-id string (the
+        # chaos drill's autoscaler spawns replacements by name)
+        eid = f"e{i}" if isinstance(i, int) else str(i)
         return ServingEngine(bert_serving_entry(net), ctx=ctx,
                              bucket_lens=cfg["buckets"],
                              max_rows=cfg["max_rows"],
                              max_queue_depth=max(64, 8 * cfg["clients"]),
-                             pool="mean", engine_id=f"e{i}")
+                             pool="mean", engine_id=eid)
 
     return cfg, make_engine
 
@@ -1385,6 +1388,50 @@ def main_serving_restart():
             telemetry_reconciled=server.get("reconciled"))
 
 
+def main_serving_chaos():
+    """Self-healing chaos drill leg (the ROADMAP 3a–c acceptance):
+    BENCH_ROUTER_ENGINES (min 3) BERT engines behind TWO active/active
+    routers under closed-loop load. The scripted faults and their
+    asserted recoveries: an induced hot-spot sheds routing weight off
+    the slow seat (per-seat share measurably moves), a seat kill is
+    replaced manifest-warm by the autoscaler (TTFT-probed before it
+    admits traffic), and a router kill hands every in-flight request
+    to the surviving peer (journal adoption + client cid resubmit).
+    Asserts SLO re-convergence, one correlated incident per induced
+    fault, and ZERO lost requests. The suite entry pins the
+    drill-speed judging clocks (window scale, eval period, latency
+    objective) in its env."""
+    _setup_cache()
+
+    cfg, make_engine = _router_fleet_setup(clients_default=6,
+                                           reqs_default=8)
+    from serve_loadgen import run_chaos_drill
+
+    n_engines = max(3, cfg["n_engines"])
+    hot_ms = float(os.environ.get("BENCH_CHAOS_HOT_MS", "1500"))
+    t0 = time.perf_counter()
+    report = run_chaos_drill(
+        make_engine, n_engines=n_engines, n_clients=cfg["clients"],
+        hot_ms=hot_ms, phase_timeout_s=180.0, vocab=cfg["vocab"],
+        min_len=max(4, cfg["seqlen"] // 8), max_len=cfg["seqlen"])
+    wall = time.perf_counter() - t0
+    assert report["lost"] == 0, report
+    ph = report["phases"]
+    _report("bert_serving_chaos_requests",
+            float(report["completed"]), "requests", 0.0,
+            seqlen=cfg["seqlen"], clients=cfg["clients"],
+            engines=n_engines, dtype=DTYPE,
+            lost=report["lost"],
+            weight_min=ph["hotspot"]["weight_min"],
+            hot_share=ph["hotspot"]["hot_share"],
+            ttft_warm_ms=ph["seat_kill"]["ttft_ms"],
+            manifest_shapes=ph["seat_kill"]["manifest_shapes"],
+            adopted=ph["router_kill"]["adopted"],
+            incidents=len(report["incidents"]),
+            client_failovers=report["client_failovers"],
+            drill_wall_s=round(wall, 1))
+
+
 def main_lstm():
     """LSTM LM training step, tokens/sec/chip (BASELINE #4).
 
@@ -1587,6 +1634,15 @@ _SUITE = (
     # rolling-restart drill: kill an engine mid-load, cold vs warm
     # (manifest-replay) time-to-first-token, zero-loss failover
     ("bert_serving_restart", "serving_restart", {"BENCH_WINDOWS": "1"}),
+    # self-healing chaos drill: hot-spot weight shed + seat-kill
+    # autoscaler replacement + two-router kill/adopt, zero lost
+    # requests; env pins the drill-speed judging clocks
+    ("bert_serving_chaos", "serving_chaos",
+     {"BENCH_WINDOWS": "1", "BENCH_SERVE_CLIENTS": "6",
+      "MXNET_TPU_SLO_WINDOW_SCALE": "0.01",
+      "MXNET_TPU_SLO_EVAL_S": "0.2",
+      "MXNET_TPU_SLO_LATENCY_MS": "700",
+      "MXNET_TPU_CANARY_INTERVAL_S": "0.5"}),
     # seq2048 BEFORE seq1024 (it was the r5 rc=124 casualty) and with a
     # shorter chain/step budget: chain=4 compiles a 4-step scan instead
     # of 10 — the 420 s per-config cap was lost to trace+compile time,
@@ -1621,7 +1677,9 @@ _SUMMARY_KEYS = ("metric", "value", "unit", "mfu", "hbm_frac", "hbm_est",
                  "slowest_traces", "per_engine", "failover", "engines_up",
                  "ttft_cold_ms", "ttft_warm_ms", "lost", "resources",
                  "profile_top", "cost_reconciled",
-                 "device_s_per_1k_tokens", "slo_compliance")
+                 "device_s_per_1k_tokens", "slo_compliance",
+                 "weight_min", "hot_share", "manifest_shapes",
+                 "adopted", "incidents")
 
 
 def _compact(rec):
@@ -1765,6 +1823,8 @@ def _dispatch():
         main_serving_router()
     elif _model == "serving_restart":
         main_serving_restart()
+    elif _model == "serving_chaos":
+        main_serving_chaos()
     elif _model == "lstm":
         main_lstm()
     elif _model == "widedeep":

@@ -375,6 +375,108 @@ register("MXNET_TPU_CANARY_ABSENCE_S", "float", 300.0,
          "``MXNET_TPU_SLO_WINDOW_SCALE``) pages even when the seat "
          "self-reports healthy", scope="canary")
 
+# -- SLO-aware routing ------------------------------------------------------
+register("MXNET_TPU_ROUTER_WEIGHTS", "bool", True,
+         "SLO-aware routing weights: the router's health poll folds "
+         "per-seat burn rate (``/slo``), windowed device-s/1k-tokens "
+         "drift and canary latency into a smoothed per-seat weight "
+         "the least-outstanding picker divides by — a seat burning "
+         "its error budget sheds traffic smoothly, with hysteresis; "
+         "``0`` pins every weight at 1.0 (classic least-outstanding)",
+         scope="routing")
+register("MXNET_TPU_ROUTER_WEIGHT_FLOOR", "float", 0.05,
+         "minimum routing weight for a degraded seat — a trickle of "
+         "traffic keeps flowing so recovery is observable (0.05 = "
+         "one twentieth of a full share)", scope="routing")
+register("MXNET_TPU_ROUTER_WEIGHT_GAIN", "float", 0.4,
+         "per-poll smoothing gain toward the weight target (1.0 = "
+         "jump immediately, small = glacial)", scope="routing")
+
+# -- router active/active HA ------------------------------------------------
+register("MXNET_TPU_ROUTER_HA", "bool", True,
+         "router active/active HA: with a peer configured, every "
+         "admitted request is journaled (correlation id + payload) "
+         "to the peer over the wire before dispatch, and a dead "
+         "router's survivor adopts the orphaned in-flight requests "
+         "front-of-queue; ``0`` disables journaling and the HA "
+         "listener entirely", scope="ha")
+register("MXNET_TPU_ROUTER_HA_PEER", "str", None,
+         "the PEER router's exposition base URL (e.g. "
+         "``http://host:9200``): liveness is polled off its "
+         "``/healthz`` (which advertises ``ha_port``) and the journal "
+         "link connects to that port", scope="ha")
+register("MXNET_TPU_ROUTER_HA_PORT", "int", 0,
+         "this router's HA journal-listener port (``0`` picks a free "
+         "port, advertised at ``/healthz`` as ``ha_port``); setting "
+         "it non-zero also starts the listener without a configured "
+         "outbound peer (asymmetric HA)", scope="ha")
+register("MXNET_TPU_ROUTER_HA_JOURNAL", "int", 4096,
+         "peer-journal capacity (in-flight requests held for the "
+         "peer); past it the OLDEST entry is dropped (counted "
+         "``journal_drop``)", scope="ha")
+register("MXNET_TPU_ROUTER_HA_ACK_S", "float", 1.0,
+         "bounded wait for the peer's journal ack before a request "
+         "becomes dispatchable (the durability cost of zero-loss); "
+         "an ack miss degrades that request to unjournaled",
+         scope="ha")
+
+# -- autoscaler -------------------------------------------------------------
+register("MXNET_TPU_AUTOSCALE", "bool", True,
+         "fleet autoscaler enable gate: a constructed "
+         "``FleetAutoscaler`` spawns/retires engine seats from "
+         "sustained burn rate + queue depth and replaces dead seats "
+         "with manifest-warmed engines; ``0`` makes ``start()`` a "
+         "no-op (no thread)", scope="autoscale")
+register("MXNET_TPU_AUTOSCALE_MIN", "int", 1,
+         "minimum seats the autoscaler keeps (scale-down floor)",
+         scope="autoscale")
+register("MXNET_TPU_AUTOSCALE_MAX", "int", 4,
+         "maximum seats the autoscaler grows to (scale-up ceiling)",
+         scope="autoscale")
+register("MXNET_TPU_AUTOSCALE_INTERVAL_S", "float", 1.0,
+         "autoscaler evaluation period (seconds)", scope="autoscale")
+register("MXNET_TPU_AUTOSCALE_BURN", "float", 6.0,
+         "fleet short-window burn-rate threshold that (sustained) "
+         "triggers a scale-up (6x = the SRE ticket factor)",
+         scope="autoscale")
+register("MXNET_TPU_AUTOSCALE_QUEUE", "int", 64,
+         "router queue depth that (sustained) triggers a scale-up",
+         scope="autoscale")
+register("MXNET_TPU_AUTOSCALE_HOLD_S", "float", 5.0,
+         "how long a scale-up signal must hold before acting (a "
+         "burst must not buy a seat)", scope="autoscale")
+register("MXNET_TPU_AUTOSCALE_COOLDOWN_S", "float", 30.0,
+         "minimum seconds between autoscaler actions (replacement of "
+         "a DEAD seat is exempt — availability does not wait out a "
+         "cooldown)", scope="autoscale")
+register("MXNET_TPU_AUTOSCALE_IDLE_S", "float", 120.0,
+         "how long the fleet must stay idle (empty queue, burn under "
+         "1x) before an autoscaler-added seat is retired",
+         scope="autoscale")
+register("MXNET_TPU_AUTOSCALE_REPLACE_S", "float", 3.0,
+         "how long a seat must stay unroutable before the autoscaler "
+         "replaces it (debounces a transient health blip)",
+         scope="autoscale")
+
+# -- chaos injection --------------------------------------------------------
+register("MXNET_TPU_CHAOS", "bool", False,
+         "deterministic fault-injection harness: engines/routers "
+         "register with the process chaos controller at start and "
+         "the scripted schedule (``MXNET_TPU_CHAOS_SCHEDULE``) "
+         "injects faults — slowed/wedged forwards, killed wire "
+         "connections, dropped/delayed dispatch frames, killed "
+         "engine/router processes; ``0`` (the default) patches "
+         "NOTHING and spawns no thread", scope="chaos")
+register("MXNET_TPU_CHAOS_SEED", "int", 0,
+         "chaos rng seed: the same seed + schedule replays an "
+         "identical fault sequence (the determinism contract)",
+         scope="chaos")
+register("MXNET_TPU_CHAOS_SCHEDULE", "str", None,
+         "the fault schedule: inline JSON (a list of "
+         "``{at, fault, target, ...}`` entries) or a path to a JSON "
+         "file; unset = an armed controller with no scripted faults "
+         "(drills drive it programmatically)", scope="chaos")
+
 # -- alert egress -----------------------------------------------------------
 register("MXNET_TPU_ALERT_EGRESS", "bool", True,
          "alert delivery out of the process: alert daemons attach the "
@@ -461,6 +563,10 @@ _SCOPE_TITLES = OrderedDict([
     ("wire", "Serving dispatch wire"),
     ("telemetry", "Telemetry / observability"),
     ("slo", "SLOs & alerting"),
+    ("routing", "SLO-aware routing"),
+    ("ha", "Router active/active HA"),
+    ("autoscale", "Autoscaler"),
+    ("chaos", "Chaos injection"),
     ("canary", "Synthetic canaries"),
     ("egress", "Alert egress"),
     ("incidents", "Incident timeline"),

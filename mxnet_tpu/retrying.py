@@ -1,0 +1,143 @@
+"""One retry/backoff policy for the whole repo.
+
+Three subsystems grew their own ad-hoc retry shapes — the alert-egress
+notifier's exponential backoff + jitter (`telemetry/egress.py`), the
+dispatch wire's reconnect loop (`serving/wire.py` WireClient), and the
+loadgen's client-side router failover (`tools/serve_loadgen.py`) —
+each with its own off-by-one attempt math and its own (or no) jitter.
+This module is the single policy object they all share:
+
+- :class:`RetryPolicy` — exponential backoff with proportional jitter
+  and an optional cap; ``retries`` RE-tries means ``retries + 1``
+  total attempts (the egress convention, kept). ``sleep``/``rng`` are
+  injectable so goldens run on a scripted clock with a seeded rng —
+  no real time passes in tests.
+- :class:`Reconnector` — the poll-driven shape: a caller that is
+  ticked periodically (a health poll, a maintenance loop) asks
+  :meth:`Reconnector.ready` whether enough backoff has elapsed to try
+  again, and reports :meth:`failed`/:meth:`succeeded`. Repeated
+  failures back off per the policy (so a dead peer is not hammered
+  every tick); one success resets.
+
+Stdlib-only on purpose: the wire layer imports this before any heavy
+dependency exists.
+"""
+from __future__ import annotations
+
+import random
+import time
+
+__all__ = ["RetryPolicy", "Reconnector"]
+
+
+class RetryPolicy:
+    """Exponential backoff + proportional jitter.
+
+    Parameters
+    ----------
+    retries : number of RE-tries after the first attempt
+        (``call`` makes at most ``retries + 1`` attempts).
+    backoff_s : base delay before the first retry.
+    multiplier : per-retry growth factor (2.0 = classic doubling).
+    jitter : proportional jitter — the delay for attempt ``i`` is
+        ``d + uniform(0, d * jitter)`` with ``d = backoff_s *
+        multiplier**i`` (capped at ``max_backoff_s``). 0 disables.
+    max_backoff_s : cap on the pre-jitter delay (None = uncapped).
+    sleep / rng : injectable for scripted-clock goldens (``sleep``
+        receives the computed delay; ``rng`` needs ``uniform``).
+    """
+
+    def __init__(self, retries=4, backoff_s=0.5, multiplier=2.0,
+                 jitter=0.5, max_backoff_s=None, sleep=None, rng=None):
+        self.retries = max(0, int(retries))
+        self.backoff_s = float(backoff_s)
+        self.multiplier = float(multiplier)
+        self.jitter = float(jitter)
+        self.max_backoff_s = (float(max_backoff_s)
+                              if max_backoff_s is not None else None)
+        self._sleep = sleep if sleep is not None else time.sleep
+        self._rng = rng if rng is not None else random.Random()
+
+    def delay(self, attempt):
+        """The backoff before retry number ``attempt`` (0-based):
+        ``backoff * multiplier**attempt`` capped, plus up to
+        ``jitter`` of itself."""
+        d = self.backoff_s * (self.multiplier ** max(0, int(attempt)))
+        if self.max_backoff_s is not None:
+            d = min(d, self.max_backoff_s)
+        if self.jitter > 0:
+            d += self._rng.uniform(0, d * self.jitter)
+        return d
+
+    def sleep_before_retry(self, attempt):
+        """Compute the delay for ``attempt`` and sleep it (via the
+        injected sleep). Returns the delay slept."""
+        d = self.delay(attempt)
+        self._sleep(d)
+        return d
+
+    def call(self, fn, retry_on=(Exception,), on_retry=None):
+        """Run ``fn()`` with up to ``retries`` retried attempts.
+        Between attempts sleeps the backoff; ``on_retry(attempt,
+        exc)`` (optional) observes each retry. The final failure
+        re-raises — the caller owns what exhaustion means (the egress
+        notifier spools, the loadgen sheds)."""
+        attempt = 0
+        while True:
+            try:
+                return fn()
+            except retry_on as e:
+                if attempt >= self.retries:
+                    raise
+                if on_retry is not None:
+                    on_retry(attempt, e)
+                self.sleep_before_retry(attempt)
+                attempt += 1
+
+
+class Reconnector:
+    """Backoff gate for poll-driven reconnect loops.
+
+    The caller ticks periodically (e.g. a router's 1 s health poll)
+    and asks :meth:`ready` whether a reconnect attempt is due; after
+    the attempt it reports :meth:`failed` or :meth:`succeeded`.
+    Consecutive failures push the next-allowed attempt out per the
+    policy's (jittered, capped) delays, so a dead peer costs one
+    connect syscall per backoff window instead of one per tick. One
+    success resets the ladder. ``clock`` is injectable (monotonic
+    seconds) for scripted tests.
+
+    Not thread-safe by design: each instance belongs to exactly one
+    maintenance loop (the wire client's poll-thread ``ensure``).
+    """
+
+    def __init__(self, policy=None, clock=None):
+        self.policy = policy if policy is not None else RetryPolicy(
+            retries=0, backoff_s=0.2, max_backoff_s=5.0)
+        self._clock = clock if clock is not None else time.monotonic
+        self._failures = 0
+        self._next_allowed = None     # None = try immediately
+
+    @property
+    def failures(self):
+        return self._failures
+
+    def ready(self, now=None):
+        """True when an attempt is due (first attempt is always
+        due)."""
+        if self._next_allowed is None:
+            return True
+        now = self._clock() if now is None else now
+        return now >= self._next_allowed
+
+    def failed(self, now=None):
+        """Record a failed attempt; schedules the next one."""
+        now = self._clock() if now is None else now
+        self._next_allowed = now + self.policy.delay(self._failures)
+        self._failures += 1
+
+    def succeeded(self):
+        """Reset the ladder: the next failure backs off from the
+        base delay again."""
+        self._failures = 0
+        self._next_allowed = None

@@ -41,8 +41,11 @@ from .metrics import LatencySummary, ServingStats
 from .engine import ServingEngine
 from .router import (ServingRouter, NoEngineAvailableError,
                      RemoteEngineError)
+from .autoscaler import FleetAutoscaler
+from .chaos import ChaosController
 
-__all__ = ["ServingEngine", "ServingRouter", "ContinuousBatcher",
+__all__ = ["ServingEngine", "ServingRouter", "FleetAutoscaler",
+           "ChaosController", "ContinuousBatcher",
            "PackedPlan", "RequestQueue", "Request", "InferenceFuture",
            "LatencySummary", "ServingStats", "ServingError",
            "QueueFullError", "DeadlineExceededError",

@@ -1,0 +1,347 @@
+"""Burn-rate + queue-depth autoscaling: the fleet buys its own seats.
+
+The telemetry can judge the fleet (SLO burn rates, queue depth,
+scoreboard) and the routing can shift load around a sick seat, but
+capacity itself was still an operator decision. A
+:class:`FleetAutoscaler` closes that loop over one or more fronting
+routers (active/active peers share one autoscaler so their seat sets
+stay identical) and an ``engine_factory``:
+
+- **scale up** when the fleet's short-window burn rate OR the router
+  queue depth holds above threshold for ``hold_s`` (a burst must not
+  buy a seat), up to ``max_seats`` and rate-limited by ``cooldown_s``;
+- **scale down** when an autoscaler-added seat has been idle (empty
+  queue, burn under sustainable) for ``idle_s``, down to
+  ``min_seats``;
+- **replace** a seat the scoreboard holds unroutable for
+  ``replace_s`` — the seat-kill drill's recovery path. Replacement is
+  exempt from the cooldown: availability does not wait out a timer.
+
+Every spawned seat admits traffic WARM: the factory's fresh engine is
+started, replays the router's fleet-union warmup manifest against the
+persistent compile cache (``warmup(manifest=...)``), and is
+TTFT-probed with one direct request before ``add_engine`` exposes it
+to traffic — the probe's wall time is the recorded
+``ttft_ms`` (warm ≈ milliseconds; a cold spawn pays its compiles
+here, never on a user request).
+
+``MXNET_TPU_AUTOSCALE=0`` makes ``start()`` a no-op (no thread);
+``evaluate_once`` stays drivable for scripted tests either way.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from .. import envvars
+from ..telemetry import events as _events
+from ..telemetry.registry import REGISTRY as _REGISTRY
+
+__all__ = ["FleetAutoscaler"]
+
+
+class FleetAutoscaler:
+    """Spawn/retire engine seats behind router(s) from fleet signals.
+
+    Parameters
+    ----------
+    routers : one ``ServingRouter`` or a list (active/active peers:
+        every membership action is applied to ALL of them, which IS
+        the seat-state sharing between peers fronting in-process
+        engines).
+    engine_factory : ``(engine_id) -> ServingEngine`` building a
+        FRESH engine (never started); the autoscaler owns start /
+        warmup / stop of the seats it creates.
+    probe_tokens : tokens for the admit-warm TTFT probe (default a
+        small arange request).
+    Remaining knobs default from the ``MXNET_TPU_AUTOSCALE*``
+    registry; ``clock`` is injectable for scripted tests.
+    """
+
+    def __init__(self, routers, engine_factory, min_seats=None,
+                 max_seats=None, interval_s=None, burn_threshold=None,
+                 queue_high=None, hold_s=None, cooldown_s=None,
+                 idle_s=None, replace_s=None, probe_tokens=None,
+                 clock=None, registry=None):
+        reg = registry if registry is not None else _REGISTRY
+        self.routers = list(routers) if isinstance(
+            routers, (list, tuple)) else [routers]
+        if not self.routers:
+            raise ValueError("autoscaler needs at least one router")
+        self._factory = engine_factory
+        self.min_seats = int(min_seats if min_seats is not None
+                             else envvars.get("MXNET_TPU_AUTOSCALE_MIN"))
+        self.max_seats = int(max_seats if max_seats is not None
+                             else envvars.get("MXNET_TPU_AUTOSCALE_MAX"))
+        self.interval_s = float(
+            interval_s if interval_s is not None
+            else envvars.get("MXNET_TPU_AUTOSCALE_INTERVAL_S"))
+        self.burn_threshold = float(
+            burn_threshold if burn_threshold is not None
+            else envvars.get("MXNET_TPU_AUTOSCALE_BURN"))
+        self.queue_high = int(
+            queue_high if queue_high is not None
+            else envvars.get("MXNET_TPU_AUTOSCALE_QUEUE"))
+        self.hold_s = float(hold_s if hold_s is not None
+                            else envvars.get("MXNET_TPU_AUTOSCALE_HOLD_S"))
+        self.cooldown_s = float(
+            cooldown_s if cooldown_s is not None
+            else envvars.get("MXNET_TPU_AUTOSCALE_COOLDOWN_S"))
+        self.idle_s = float(idle_s if idle_s is not None
+                            else envvars.get("MXNET_TPU_AUTOSCALE_IDLE_S"))
+        self.replace_s = float(
+            replace_s if replace_s is not None
+            else envvars.get("MXNET_TPU_AUTOSCALE_REPLACE_S"))
+        self._probe_tokens = (np.asarray(probe_tokens, np.int32)
+                              if probe_tokens is not None
+                              else np.arange(1, 9, dtype=np.int32))
+        self._clock = clock if clock is not None else time.monotonic
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = None
+        self._spawned = {}          # engine_id -> engine (we own stop)
+        self._auto_seats = []       # scale-up seat ids (LIFO retire)
+        self._seat_seq = 0
+        self._pressure_since = None
+        self._idle_since = None
+        self._down_since = {}       # engine_id -> first-seen-down t
+        self._last_action_t = None
+        self.actions = []           # action records (drill surface)
+        self._g_seats = reg.gauge(
+            "mxnet_tpu_autoscaler_seats",
+            "routable seats the autoscaler currently observes on its "
+            "primary router")
+        self._c_actions = reg.counter(
+            "mxnet_tpu_autoscaler_actions_total",
+            "autoscaler actions, by kind (scale_up / scale_down / "
+            "replace)", ("action",))
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self):
+        if not envvars.get("MXNET_TPU_AUTOSCALE"):
+            return self
+        with self._lock:
+            if self._thread is not None:
+                return self
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, daemon=True,
+                name="mxnet_tpu_autoscaler")
+            self._thread.start()
+        _events.emit("autoscale_start", min=self.min_seats,
+                     max=self.max_seats, burn=self.burn_threshold,
+                     queue=self.queue_high)
+        return self
+
+    def stop(self, stop_seats=False):
+        with self._lock:
+            self._stop.set()
+            t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=10.0)
+        if stop_seats:
+            with self._lock:
+                spawned = list(self._spawned.values())
+                self._spawned.clear()
+            for eng in spawned:
+                try:
+                    eng.stop(drain=False, timeout=10.0)
+                except Exception:
+                    pass
+
+    def _run(self):
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.evaluate_once()
+            except Exception as e:
+                # one broken evaluation must not kill autoscaling
+                _events.emit("autoscale_error", error=repr(e))
+
+    # -- signals ------------------------------------------------------------
+    def _primary(self):
+        """The first RUNNING router (falls back to the first): a dead
+        active/active primary must not freeze the autoscaler on its
+        last scoreboard — the survivor's live signals take over."""
+        for router in self.routers:
+            try:
+                if router.running:
+                    return router
+            except Exception:
+                continue
+        return self.routers[0]
+
+    def _signals(self):
+        """(burn, queue_depth, board) off the primary router: the max
+        short-window burn across its ratio objectives, the router
+        admission-queue depth, and the scoreboard."""
+        from ..telemetry.slo import max_short_burn
+
+        router = self._primary()
+        try:
+            slo = router.slo_snapshot()
+        except Exception:
+            slo = None
+        snap = router.snapshot()
+        return (max_short_burn(slo), snap.get("queue_depth") or 0,
+                snap["engines"])
+
+    # -- one tick -----------------------------------------------------------
+    def evaluate_once(self, now=None):
+        """One evaluation: replacement first (availability), then the
+        held scale-up/scale-down decisions. Returns the action taken
+        (an action record dict) or None."""
+        now = self._clock() if now is None else now
+        burn, queue_depth, board = self._signals()
+        routable = [eid for eid, row in board.items()
+                    if row.get("routable")]
+        self._g_seats.set(len(routable))
+
+        # -- replace dead seats (cooldown-exempt) ---------------------------
+        for eid, row in board.items():
+            if row.get("routable"):
+                self._down_since.pop(eid, None)
+                continue
+            first = self._down_since.setdefault(eid, now)
+            if now - first >= self.replace_s:
+                self._down_since.pop(eid, None)
+                return self._replace(eid, now)
+
+        # -- scale up -------------------------------------------------------
+        pressured = ((burn is not None and burn > self.burn_threshold)
+                     or queue_depth >= self.queue_high)
+        if pressured:
+            self._idle_since = None
+            if self._pressure_since is None:
+                self._pressure_since = now
+            held = now - self._pressure_since >= self.hold_s
+            if held and len(board) < self.max_seats \
+                    and self._cooled(now):
+                self._pressure_since = None
+                return self._scale_up(now, burn, queue_depth)
+            return None
+        self._pressure_since = None
+
+        # -- scale down -----------------------------------------------------
+        idle = (queue_depth == 0
+                and (burn is None or burn <= 1.0))
+        if not idle:
+            self._idle_since = None
+            return None
+        if self._idle_since is None:
+            self._idle_since = now
+        if (now - self._idle_since >= self.idle_s
+                and self._auto_seats
+                and len(routable) > self.min_seats
+                and self._cooled(now)):
+            self._idle_since = None
+            return self._scale_down(now)
+        return None
+
+    def _cooled(self, now):
+        return (self._last_action_t is None
+                or now - self._last_action_t >= self.cooldown_s)
+
+    # -- actions ------------------------------------------------------------
+    def _spawn_warm(self, engine_id):
+        """Build, start, manifest-warm and TTFT-probe one fresh seat
+        — everything BEFORE it can see a user request. Returns
+        (engine, ttft_ms, manifest_shapes). A failure anywhere stops
+        the half-built engine before re-raising — a failed spawn must
+        not leak a worker thread (and the caller retries on a later
+        tick)."""
+        engine = self._factory(engine_id)
+        try:
+            engine.start()
+            try:
+                manifest = self._primary().warmup_manifest()
+            except Exception:
+                manifest = None
+            shapes = 0
+            if manifest and manifest.get("shapes"):
+                shapes = len(manifest["shapes"])
+                engine.warmup(manifest=manifest)
+            t0 = time.perf_counter()
+            engine.submit(self._probe_tokens).result(timeout=600.0)
+            ttft_ms = round((time.perf_counter() - t0) * 1e3, 3)
+        except BaseException:
+            try:
+                engine.stop(drain=False, timeout=10.0)
+            except Exception:
+                pass
+            raise
+        return engine, ttft_ms, shapes
+
+    def _record(self, action, engine_id, now, **extra):
+        self._last_action_t = now
+        rec = dict(action=action, engine_id=engine_id, **extra)
+        self.actions.append(rec)
+        self._c_actions.labels(action=action).inc()
+        _events.emit("autoscale_action", **rec)
+        return rec
+
+    def _add_everywhere(self, engine_id, engine):
+        for router in self.routers:
+            router.add_engine(engine_id, engine)
+
+    def _remove_everywhere(self, engine_id):
+        for router in self.routers:
+            try:
+                router.remove_engine(engine_id)
+            except KeyError:
+                pass
+
+    def _scale_up(self, now, burn, queue_depth):
+        self._seat_seq += 1
+        engine_id = f"auto{self._seat_seq}"
+        engine, ttft_ms, shapes = self._spawn_warm(engine_id)
+        with self._lock:
+            self._spawned[engine_id] = engine
+            self._auto_seats.append(engine_id)
+        self._add_everywhere(engine_id, engine)
+        return self._record("scale_up", engine_id, now,
+                            ttft_ms=ttft_ms, manifest_shapes=shapes,
+                            burn=(round(burn, 3)
+                                  if burn is not None else None),
+                            queue_depth=queue_depth)
+
+    def _scale_down(self, now):
+        with self._lock:
+            engine_id = self._auto_seats.pop()
+            engine = self._spawned.pop(engine_id, None)
+        self._remove_everywhere(engine_id)
+        if engine is not None:
+            # drain=True: the seat finishes what it already accepted
+            try:
+                engine.stop(drain=True, timeout=60.0)
+            except Exception as e:
+                _events.emit("autoscale_error", engine_id=engine_id,
+                             error=repr(e))
+        return self._record("scale_down", engine_id, now)
+
+    def _replace(self, engine_id, now):
+        """A seat held unroutable past the debounce: admit a
+        manifest-warmed replacement under the SAME id (dashboards and
+        drills keep one name per chip). Spawn-THEN-remove: a failed
+        spawn leaves the dead seat on the boards, so the unroutable
+        debounce re-arms and replacement is retried on a later tick —
+        never a seat silently gone from the fleet."""
+        engine, ttft_ms, shapes = self._spawn_warm(engine_id)
+        # the old incarnation must STOP even when the caller built it
+        # (a wedged-but-alive engine left running would keep writing
+        # metric families under the id its replacement now owns) —
+        # grab the handle BEFORE removal drops the seat
+        dead = self._primary().engine_handle(engine_id)
+        self._remove_everywhere(engine_id)
+        with self._lock:
+            dead = self._spawned.pop(engine_id, None) or dead
+            self._spawned[engine_id] = engine
+        if dead is not None:
+            try:
+                dead.stop(drain=False, timeout=10.0)
+            except Exception:
+                pass
+        self._add_everywhere(engine_id, engine)
+        return self._record("replace", engine_id, now,
+                            ttft_ms=ttft_ms, manifest_shapes=shapes)

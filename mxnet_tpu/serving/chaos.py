@@ -1,0 +1,516 @@
+"""Deterministic fault injection: prove the fleet heals, on a script.
+
+Every self-healing mechanism the serving stack now has — failover
+requeue, SLO-aware routing weights, the autoscaler, router
+active/active adoption — is only trustworthy if it is EXERCISED, and
+production exercises it at the worst time. This module injects the
+faults on purpose, deterministically:
+
+- a :class:`ChaosController` owns a scripted **schedule** (a sorted
+  list of ``{at, fault, target, ...}`` entries), an injectable
+  **clock** and a seeded **rng** — the same
+  ``MXNET_TPU_CHAOS_SEED`` + schedule replays an IDENTICAL fault
+  sequence (the event-log golden in ``tests/test_chaos.py`` pins
+  this). Each applied fault gets its OWN rng stream derived from
+  ``(seed, fault sequence number)``, so a probabilistic fault's draw
+  pattern is deterministic per fault even when overlapping faults
+  draw concurrently from different threads;
+- faults act on live registered targets (engines/routers register at
+  ``start()`` when ``MXNET_TPU_CHAOS=1``), emitting ``chaos_*`` run
+  events so incidents and flight bundles can attribute an induced
+  fault as induced.
+
+Fault vocabulary (``fault`` key of a schedule entry):
+
+==============  ============================================================
+``hotspot``     slow ``target`` engine's forwards by ``ms`` for
+                ``duration_s`` (wraps the model callable; restores after)
+``wedge``       block ``target`` engine's forwards entirely for
+                ``duration_s`` (the worker thread stays alive — the
+                lying-healthz shape)
+``kill_wire``   abruptly close the target engine's accepted wire
+                connections (router side reconnects; in-flight work
+                fails over)
+``drop_frames`` drop inbound dispatch frames on the target engine's
+                wire listener with probability ``p`` for ``duration_s``
+``delay_frames`` delay inbound dispatch frames by ``ms`` for
+                ``duration_s``
+``kill_engine`` stop the target engine abruptly (``stop(drain=False)``)
+                — or ``SIGKILL`` when ``target`` is a pid
+``kill_router`` abrupt router death (``ServingRouter.die()``: nothing
+                drained, nothing resolved — the HA drill's trigger)
+==============  ============================================================
+
+Off is FREE: with ``MXNET_TPU_CHAOS=0`` (the default) nothing
+registers, no thread spawns, no metric family exists, and no model
+callable or wire path is wrapped — the disabled-path test asserts the
+identities, matching the mxsan pattern.
+"""
+from __future__ import annotations
+
+import json
+import os
+import random
+import signal
+import threading
+import time
+
+from .. import envvars
+from ..telemetry import events as _events
+from ..telemetry.registry import REGISTRY as _REGISTRY
+
+__all__ = ["ChaosController", "chaos_enabled", "controller",
+           "register_engine", "register_router", "reset",
+           "load_schedule", "FAULTS"]
+
+FAULTS = ("hotspot", "wedge", "kill_wire", "drop_frames",
+          "delay_frames", "kill_engine", "kill_router")
+
+
+def chaos_enabled():
+    return bool(envvars.get("MXNET_TPU_CHAOS"))
+
+
+def load_schedule(spec):
+    """Parse a schedule spec: a list (already parsed), inline JSON, or
+    a path to a JSON file. Returns a list of entry dicts."""
+    if spec is None:
+        return []
+    if isinstance(spec, (list, tuple)):
+        entries = list(spec)
+    else:
+        text = str(spec).strip()
+        if not text:
+            return []
+        if not text.startswith("["):
+            with open(text) as f:
+                text = f.read()
+        entries = json.loads(text)
+    out = []
+    for e in entries:
+        if not isinstance(e, dict) or "fault" not in e:
+            raise ValueError(f"bad chaos schedule entry: {e!r}")
+        if e["fault"] not in FAULTS:
+            raise ValueError(f"unknown chaos fault {e['fault']!r} "
+                             f"(have {FAULTS})")
+        out.append(dict(e))
+    out.sort(key=lambda e: float(e.get("at", 0.0)))
+    return out
+
+
+class _SlowModel:
+    """Hot-spot wrapper around an engine's model callable: every
+    forward pays an extra ``delay_s`` (rng-jittered ±20% so repeated
+    forwards don't phase-lock, drawn from the CONTROLLER's seeded rng
+    — deterministic under a pinned seed)."""
+
+    def __init__(self, fn, delay_s, rng, sleep):
+        self.fn = fn
+        self.delay_s = float(delay_s)
+        self._rng = rng
+        self._sleep = sleep
+
+    def __call__(self, *args):
+        self._sleep(self.delay_s * (0.8 + 0.4 * self._rng.random()))
+        return self.fn(*args)
+
+
+class _WedgedModel:
+    """Wedge wrapper: forwards spin while ``gate`` is set — the worker
+    THREAD stays alive (self-reported health stays green), nothing
+    completes. Exactly the lying-healthz shape the canary pages on."""
+
+    def __init__(self, fn, sleep):
+        self.fn = fn
+        self.gate = threading.Event()
+        self.gate.set()
+        self._sleep = sleep
+
+    def __call__(self, *args):
+        while self.gate.is_set():
+            self._sleep(0.01)
+        return self.fn(*args)
+
+
+class ChaosController:
+    """One scripted fault campaign over a set of registered targets.
+
+    Parameters
+    ----------
+    schedule : schedule spec (see :func:`load_schedule`); entries fire
+        at ``at`` seconds after :meth:`start` (or are driven manually
+        via :meth:`apply` — the scripted-clock test path).
+    seed : rng seed (default ``MXNET_TPU_CHAOS_SEED``) — the ONLY
+        randomness source for probabilistic faults.
+    clock / sleep : injectable monotonic clock and sleep so the
+        determinism golden runs without real time passing.
+    """
+
+    def __init__(self, schedule=None, seed=None, clock=None,
+                 sleep=None, registry=None):
+        reg = registry if registry is not None else _REGISTRY
+        if schedule is None:
+            schedule = envvars.get("MXNET_TPU_CHAOS_SCHEDULE")
+        self.schedule = load_schedule(schedule)
+        self.seed = (int(seed) if seed is not None
+                     else envvars.get("MXNET_TPU_CHAOS_SEED"))
+        self._rng = random.Random(self.seed)
+        self._fault_rng = self._rng     # re-derived per applied fault
+        self._clock = clock if clock is not None else time.monotonic
+        self._sleep = sleep if sleep is not None else time.sleep
+        self._engines = {}          # engine_id -> ServingEngine
+        self._routers = {}          # router_id -> ServingRouter
+        # engine_id -> STACK of (kind, wrapper, orig): overlapping
+        # faults on one engine nest, and each clear unlinks ITS
+        # wrapper (top via eng._model, inner via the outer's .fn)
+        self._wrapped = {}
+        # engine_id -> (fault_kind, hook): ONE frame fault at a time
+        # per engine (a newer one replaces the older; the older's
+        # scheduled clear then becomes a no-op instead of cancelling
+        # the newer fault)
+        self._frame_hooks = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = None
+        self._t0 = None
+        self._seq = 0
+        self._c_faults = reg.counter(
+            "mxnet_tpu_chaos_faults_total",
+            "chaos faults injected, by fault kind", ("fault",))
+        _events.emit("chaos_armed", seed=self.seed,
+                     schedule=len(self.schedule))
+
+    # -- target registry ----------------------------------------------------
+    def register_engine(self, engine):
+        with self._lock:
+            self._engines[str(engine.engine_id)] = engine
+        return self
+
+    def register_router(self, router):
+        with self._lock:
+            self._routers[str(router.router_id)] = router
+        return self
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self):
+        """Walk the schedule on a daemon thread against the (possibly
+        injected) clock. Idempotent."""
+        with self._lock:
+            if self._thread is not None:
+                return self
+            self._stop.clear()
+            self._t0 = self._clock()
+            self._thread = threading.Thread(
+                target=self._run, daemon=True, name="mxnet_tpu_chaos")
+            self._thread.start()
+        _events.emit("chaos_start", seed=self.seed,
+                     schedule=len(self.schedule))
+        return self
+
+    def stop(self, clear=True):
+        with self._lock:
+            self._stop.set()
+            t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5.0)
+        if clear:
+            self.clear_all()
+        _events.emit("chaos_stop", injected=self._seq)
+
+    def _run(self):
+        # the timeline holds injections AND their scheduled clears so
+        # both replay in one deterministic order
+        timeline = []
+        for i, e in enumerate(self.schedule):
+            at = float(e.get("at", 0.0))
+            timeline.append((at, 0, i, "apply", e))
+            dur = e.get("duration_s")
+            if dur is not None:
+                timeline.append((at + float(dur), 1, i, "clear", e))
+        timeline.sort(key=lambda x: (x[0], x[1], x[2]))
+        for at, _phase, _i, action, entry in timeline:
+            while not self._stop.is_set():
+                remaining = (self._t0 + at) - self._clock()
+                if remaining <= 0:
+                    break
+                self._stop.wait(min(0.05, max(0.001, remaining)))
+            if self._stop.is_set():
+                return
+            try:
+                if action == "apply":
+                    self.apply(entry)
+                else:
+                    self.clear(entry)
+            except Exception as e:
+                _events.emit("chaos_error", fault=entry.get("fault"),
+                             target=entry.get("target"), error=repr(e))
+
+    # -- fault application (also the scripted-clock test surface) -----------
+    def apply(self, entry):
+        """Inject one fault NOW (schedule thread, or a test driving a
+        scripted campaign). Emits ``chaos_fault``."""
+        fault = entry["fault"]
+        target = entry.get("target")
+        self._seq += 1
+        _events.emit("chaos_fault", seq=self._seq, fault=fault,
+                     target=target, at=entry.get("at"),
+                     duration_s=entry.get("duration_s"),
+                     ms=entry.get("ms"), p=entry.get("p"))
+        self._c_faults.labels(fault=fault).inc()
+        # per-fault rng stream: deterministic from (seed, seq) and
+        # private to this fault — overlapping faults drawing from
+        # different threads cannot perturb each other's sequences.
+        # (int seed: tuple seeding is hash-based and gone in py3.11)
+        self._fault_rng = random.Random(
+            (self.seed << 32) ^ (self._seq & 0xffffffff))
+        # the schedule walker clears with the SAME entry dict it
+        # applied: the tag lets a clear unlink exactly ITS wrapper
+        # even when two same-kind faults overlap on one engine
+        entry["_chaos_tag"] = self._seq
+        getattr(self, f"_apply_{fault}")(entry)
+
+    def clear(self, entry):
+        """Clear one duration fault (restore the wrapped/hooked
+        path). Emits ``chaos_fault_cleared``."""
+        fault = entry["fault"]
+        target = str(entry.get("target"))
+        if fault in ("hotspot", "wedge"):
+            self._unwrap(target, kind=fault,
+                         tag=entry.get("_chaos_tag"))
+        elif fault in ("drop_frames", "delay_frames"):
+            # identity-checked: only the fault whose hook is STILL
+            # installed may null it — a drop fault's scheduled clear
+            # must not cancel a delay fault armed after it
+            with self._lock:
+                rec = self._frame_hooks.get(target)
+                owns = rec is not None and rec[0] == fault
+                if owns:
+                    self._frame_hooks.pop(target, None)
+            if owns:
+                eng = self._engine(target)
+                if eng is not None and eng._wire is not None:
+                    eng._wire.chaos_rx = None
+        _events.emit("chaos_fault_cleared", fault=fault, target=target)
+
+    def clear_all(self):
+        with self._lock:
+            wrapped = list(self._wrapped)
+            engines = list(self._engines.values())
+        for eid in wrapped:
+            while True:
+                with self._lock:
+                    if not self._wrapped.get(eid):
+                        break
+                self._unwrap(eid)
+        with self._lock:
+            self._frame_hooks.clear()
+        for eng in engines:
+            if eng._wire is not None:
+                eng._wire.chaos_rx = None
+
+    # -- helpers ------------------------------------------------------------
+    def _engine(self, target):
+        with self._lock:
+            eng = self._engines.get(str(target))
+        if eng is None:
+            _events.emit("chaos_error", fault="?", target=target,
+                         error="no such registered engine")
+        return eng
+
+    def _wrap(self, eid, kind, wrapper, orig, tag=None):
+        with self._lock:
+            self._wrapped.setdefault(str(eid), []) \
+                .append((kind, wrapper, orig, tag))
+
+    def _unwrap(self, eid, kind=None, tag=None):
+        """Remove the wrapper tagged ``tag`` (falling back to the
+        newest of ``kind``, then the newest of any kind) from the
+        engine's wrap stack: the top unlinks via ``eng._model``, an
+        inner one by relinking the wrapper ABOVE it past it —
+        overlapping faults (even same-kind) clear independently and
+        ``clear_all`` always restores the original model."""
+        eid = str(eid)
+        eng = self._engine(eid)
+        relink = None
+        with self._lock:
+            stack = self._wrapped.get(eid) or []
+            idx = None
+            if tag is not None:
+                idx = next((i for i in range(len(stack) - 1, -1, -1)
+                            if stack[i][3] == tag), None)
+            if idx is None:
+                idx = next((i for i in range(len(stack) - 1, -1, -1)
+                            if kind is None or stack[i][0] == kind),
+                           None)
+            if idx is None:
+                return
+            k, wrapper, orig, _tag = stack.pop(idx)
+            if idx < len(stack):
+                # the wrapper above ours now wraps OUR orig — relink
+                # it AND rewrite its record (its stored orig must stop
+                # pointing at the wrapper we just removed)
+                above_k, above_w, _, above_tag = stack[idx]
+                stack[idx] = (above_k, above_w, orig, above_tag)
+                relink = above_w
+            if not stack:
+                self._wrapped.pop(eid, None)
+        if k == "wedge":
+            wrapper.gate.clear()    # release spinning forwards first
+        if eng is None:
+            return
+        if relink is not None:
+            relink.fn = orig
+        elif eng._model is wrapper:
+            eng._model = orig
+
+    # -- fault implementations ----------------------------------------------
+    def _apply_hotspot(self, entry):
+        eng = self._engine(entry.get("target"))
+        if eng is None:
+            return
+        delay_s = float(entry.get("ms", 50.0)) / 1e3
+        wrapper = _SlowModel(eng._model, delay_s, self._fault_rng,
+                             self._sleep)
+        self._wrap(eng.engine_id, "hotspot", wrapper, eng._model)
+        eng._model = wrapper
+
+    def _apply_wedge(self, entry):
+        eng = self._engine(entry.get("target"))
+        if eng is None:
+            return
+        wrapper = _WedgedModel(eng._model, self._sleep)
+        self._wrap(eng.engine_id, "wedge", wrapper, eng._model)
+        eng._model = wrapper
+
+    def _apply_kill_wire(self, entry):
+        target = str(entry.get("target"))
+        eng = None
+        with self._lock:
+            eng = self._engines.get(target)
+            routers = list(self._routers.values())
+        killed = 0
+        if eng is not None and eng._wire is not None:
+            killed += eng._wire.kill_connections()
+        else:
+            # a router target: tear down its dispatch pools
+            for r in routers:
+                if r.router_id == target:
+                    with r._lock:
+                        seats = list(r._seats.values())
+                    for seat in seats:
+                        wire = getattr(seat, "_wire", None)
+                        if wire is not None:
+                            killed += wire.kill_connections()
+        _events.emit("chaos_wire_killed", target=target,
+                     connections=killed)
+
+    def _frame_hook(self, mode, p, delay_s, rng=None):
+        rng = rng if rng is not None else self._rng
+        sleep = self._sleep
+
+        def hook(tag):
+            if tag != "SUBMIT":
+                return True         # only dispatch frames are game
+            if mode == "drop":
+                if rng.random() < p:
+                    _events.emit("chaos_frame_dropped", tag=tag)
+                    return False
+                return True
+            sleep(delay_s)
+            return True
+
+        return hook
+
+    def _arm_frame_fault(self, entry, kind, hook):
+        eng = self._engine(entry.get("target"))
+        if eng is None or eng._wire is None:
+            return
+        with self._lock:
+            self._frame_hooks[str(entry.get("target"))] = (kind, hook)
+        eng._wire.chaos_rx = hook
+
+    def _apply_drop_frames(self, entry):
+        self._arm_frame_fault(entry, "drop_frames", self._frame_hook(
+            "drop", float(entry.get("p", 0.5)), 0.0,
+            rng=self._fault_rng))
+
+    def _apply_delay_frames(self, entry):
+        self._arm_frame_fault(entry, "delay_frames", self._frame_hook(
+            "delay", 1.0, float(entry.get("ms", 20.0)) / 1e3,
+            rng=self._fault_rng))
+
+    def _apply_kill_engine(self, entry):
+        target = entry.get("target")
+        eng = None
+        with self._lock:
+            eng = self._engines.get(str(target))
+        if eng is None:
+            # pid target: the cross-process kill (the only fault that
+            # reaches outside this process)
+            try:
+                os.kill(int(target), signal.SIGKILL)
+                _events.emit("chaos_process_killed", pid=int(target))
+            except (ValueError, TypeError, OSError) as e:
+                _events.emit("chaos_error", fault="kill_engine",
+                             target=target, error=repr(e))
+            return
+        try:
+            eng.stop(drain=False, timeout=10.0)
+        except Exception as e:
+            _events.emit("chaos_error", fault="kill_engine",
+                         target=target, error=repr(e))
+
+    def _apply_kill_router(self, entry):
+        target = str(entry.get("target"))
+        with self._lock:
+            router = self._routers.get(target)
+        if router is None:
+            _events.emit("chaos_error", fault="kill_router",
+                         target=target, error="no such router")
+            return
+        router.die()
+
+
+# -- process singleton (env-gated) -------------------------------------------
+
+_controller = None
+_ctl_lock = threading.Lock()
+
+
+def controller():
+    """The process chaos controller — built from the environment on
+    first use, None when ``MXNET_TPU_CHAOS=0`` (nothing is built,
+    registered, patched or spawned)."""
+    global _controller
+    if not chaos_enabled():
+        return None
+    with _ctl_lock:
+        if _controller is None:
+            _controller = ChaosController()
+            if _controller.schedule:
+                _controller.start()
+        return _controller
+
+
+def register_engine(engine):
+    """Engine start() hook: one env check when chaos is off."""
+    ctl = controller()
+    if ctl is not None:
+        ctl.register_engine(engine)
+    return ctl
+
+
+def register_router(router):
+    ctl = controller()
+    if ctl is not None:
+        ctl.register_router(router)
+    return ctl
+
+
+def reset():
+    """Tests only: stop and forget the process controller."""
+    global _controller
+    with _ctl_lock:
+        ctl, _controller = _controller, None
+    if ctl is not None:
+        ctl.stop()

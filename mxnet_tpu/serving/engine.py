@@ -236,6 +236,12 @@ class ServingEngine:
             self._slo = AlertDaemon(evaluator)
             default_burn_rules(self._slo, names)
             self._slo.start()
+        # chaos harness (MXNET_TPU_CHAOS): register as a fault target.
+        # Off (the default) this is ONE env read — nothing is built,
+        # patched or spawned.
+        if envvars.get("MXNET_TPU_CHAOS"):
+            from .chaos import register_engine as _chaos_register
+            _chaos_register(self)
         _events.emit("engine_start", engine_id=self.engine_id,
                      bucket_lens=list(self._batcher.bucket_lens),
                      max_rows=self._batcher.max_rows)

@@ -102,6 +102,7 @@ from urllib.parse import urlsplit
 import numpy as np
 
 from .. import compile_cache, envvars
+from ..retrying import Reconnector
 from ..telemetry import events as _events
 from ..telemetry import incidents as _incidents
 from ..telemetry import profiling as _profiling
@@ -121,6 +122,16 @@ from .wire import WireClient, WireError
 __all__ = ["ServingRouter", "NoEngineAvailableError", "RemoteEngineError"]
 
 _router_seq = itertools.count()
+_seat_seq = itertools.count()
+
+# SLO-aware routing-weight hysteresis: a seat enters the DEGRADED
+# state (weight tracks its health target) when the target falls to
+# _W_ENTER, and returns to full weight only after _W_OK_POLLS
+# consecutive polls with the target back above _W_EXIT — weights shed
+# smoothly and never flap on a noisy boundary signal.
+_W_ENTER = 0.7
+_W_EXIT = 0.95
+_W_OK_POLLS = 3
 
 
 class NoEngineAvailableError(ServingError):
@@ -155,7 +166,7 @@ class RouterRequest:
 
     __slots__ = ("tokens", "token_types", "deadline", "future",
                  "trace_id", "span", "t_submit", "tried", "engine_id",
-                 "requeues")
+                 "requeues", "cid", "adopted")
 
     def __init__(self, tokens, token_types=None, deadline_ms=None):
         self.tokens, self.token_types = validate_tokens(tokens,
@@ -169,9 +180,16 @@ class RouterRequest:
             attrs={"tokens": int(self.tokens.size)}, local_root=True)
         self.future = InferenceFuture()
         self.future.trace_id = self.trace_id
+        # tried holds seat GENERATION tokens, not engine ids: a
+        # replacement seat registered under a reused id is a FRESH
+        # failover candidate, not forever poisoned by its predecessor
         self.tried = set()
         self.engine_id = None
         self.requeues = 0
+        # HA correlation id: client-provided (resubmit dedupe across
+        # routers) or minted from the trace id when journaling
+        self.cid = None
+        self.adopted = False
 
     def remaining_ms(self, now=None):
         if self.deadline is None:
@@ -254,10 +272,14 @@ class _Seat:
 
     def __init__(self, engine_id):
         self.engine_id = str(engine_id)
+        # generation token: unique per seat OBJECT, so failover
+        # bookkeeping survives a replacement under a reused id
+        self.token = f"{self.engine_id}#{next(_seat_seq)}"
         self.outstanding = 0        # router-observed in flight
         self.dispatched = 0
         self.up = True              # optimistic until the first poll
         self.routable = True
+        self.closed = False         # removed from the fleet
         self.consecutive_failures = 0
         self.last_change = time.time()
         self.queue_depth = None
@@ -268,6 +290,17 @@ class _Seat:
         self._prev_completed = None
         self._prev_poll = None
         self._manifest_count = None  # visited shapes at last collect
+        # SLO-aware routing weight: 1.0 = full share; a seat burning
+        # its error budget / drifting on cost / slow to canaries sheds
+        # weight smoothly (poll-thread owned, dispatcher read-only)
+        self.weight = 1.0
+        self.hys = "healthy"        # healthy | degraded (hysteresis)
+        self.ok_polls = 0
+        self.burn = None            # last short-window burn rate
+        self.cost_rate = None       # EMA windowed device_s/1k tokens
+        self._prev_cost = None      # (request_s, valid_tokens)
+        self._cost_age = 0          # polls since the EMA last updated
+        self._sig_tick = 0          # throttles remote /slo fetches
 
     def cost_table(self):
         return None
@@ -279,6 +312,11 @@ class _Seat:
                 "dispatched": self.dispatched,
                 "queue_depth": self.queue_depth,
                 "p95_ms": self.p95_ms, "qps": self.qps,
+                "weight": round(self.weight, 3),
+                "burn": (round(self.burn, 3)
+                         if self.burn is not None else None),
+                "cost_rate": (round(self.cost_rate, 6)
+                              if self.cost_rate is not None else None),
                 "manifest_shapes": self._manifest_count,
                 "consecutive_failures": self.consecutive_failures,
                 "last_change": round(self.last_change, 3),
@@ -300,7 +338,10 @@ class _Seat:
 
     def close(self):
         """Release seat-owned transport resources (router stop /
-        ``remove_engine``)."""
+        ``remove_engine``). Sets ``closed`` so a dispatch (or a poll
+        ``maintain``) racing the removal fails over instead of driving
+        a dead seat — subclasses must call ``super().close()``."""
+        self.closed = True
 
 
 class _LocalSeat(_Seat):
@@ -311,6 +352,12 @@ class _LocalSeat(_Seat):
         self._engine = engine
 
     def dispatch(self, req, timeout_s, done):
+        if self.closed:
+            # picked just as remove_engine() raced in: engine-shaped —
+            # the failover requeue hands the request to a sibling
+            done(self, req, EngineStoppedError(
+                f"engine {self.engine_id} seat was removed"), None)
+            return
         fut = self._engine.submit(req.tokens, req.token_types,
                                   deadline_ms=req.remaining_ms(),
                                   trace_id=req.trace_id,
@@ -406,7 +453,10 @@ class _RemoteSeat(_Seat):
         time out unanswered in-flight requests. All blocking connect/
         handshake work lives HERE — the dispatch path only ever queues
         frames on already-live connections."""
-        if not self._wire_enabled:
+        if not self._wire_enabled or self.closed:
+            # a poll racing remove_engine() must not resurrect the
+            # closed seat's wire pool (a pure leak: the seat can never
+            # be picked again)
             return
         port, peer_eid = self._advertised
         wire = self._wire
@@ -478,6 +528,12 @@ class _RemoteSeat(_Seat):
 
     # -- dispatch (wire preferred, bounded HTTP/JSON fallback) --------------
     def dispatch(self, req, timeout_s, done):
+        if self.closed:
+            # removal raced the pick: fail over immediately instead of
+            # paying an HTTP timeout against a seat already torn down
+            done(self, req, RemoteEngineError(
+                f"engine {self.engine_id} seat was removed"), None)
+            return
         wire = self._wire
         if wire is not None:
             try:
@@ -549,6 +605,7 @@ class _RemoteSeat(_Seat):
                 f"engine {self.engine_id} seat is closed"), None)
 
     def close(self):
+        super().close()
         wire, self._wire = self._wire, None
         if wire is not None:
             wire.close()
@@ -660,12 +717,12 @@ class ServingRouter:
 
     COUNTERS = ("submitted", "completed", "failed", "expired",
                 "cancelled", "requeued", "shed_queue_full",
-                "shed_no_engine", "rejected_stopped")
+                "shed_no_engine", "rejected_stopped", "adopted")
 
     def __init__(self, engines=None, max_queue_depth=1024,
                  poll_interval_s=1.0, health_fail_after=1,
                  default_deadline_ms=None, dispatch_timeout_s=600.0,
-                 router_id=None, wire=None):
+                 router_id=None, wire=None, peer=None):
         self.router_id = (str(router_id) if router_id is not None
                           else f"router-{os.getpid():x}-"
                                f"{next(_router_seq)}")
@@ -709,6 +766,59 @@ class ServingRouter:
         self._canary = None
         self._exemplars = exemplar_gate()
         self._pick_seq = itertools.count(1)
+        # SLO-aware routing weights (MXNET_TPU_ROUTER_WEIGHTS): the
+        # poll thread folds per-seat burn rate, windowed cost drift
+        # and canary latency into a smoothed weight the picker divides
+        # outstanding load by — off, every weight stays 1.0 and the
+        # pick order is exactly the classic least-outstanding
+        self._weights_on = bool(envvars.get("MXNET_TPU_ROUTER_WEIGHTS"))
+        self._w_floor = max(1e-3, float(
+            envvars.get("MXNET_TPU_ROUTER_WEIGHT_FLOOR")))
+        self._w_gain = min(1.0, max(0.01, float(
+            envvars.get("MXNET_TPU_ROUTER_WEIGHT_GAIN"))))
+        # a seat's burn signal costs a full SLO evaluation (an HTTP
+        # /slo GET for remote seats, an evaluator tick+evaluate for
+        # local handles): fetch it at most every ~2 s per seat
+        # (reusing the last value in between) so default-on weights
+        # don't multiply the poll thread's per-tick work
+        self._slo_every = max(1, int(round(2.0 / max(
+            0.05, float(poll_interval_s)))))
+        self._g_weight = _REGISTRY.gauge(
+            "mxnet_tpu_router_engine_weight",
+            "SLO-aware routing weight per seat (1 = full share; a "
+            "seat burning its error budget, drifting on cost or slow "
+            "to canaries sheds smoothly)", ("engine_id",))
+        # -- router active/active HA ------------------------------------
+        # each admitted SUBMIT is journaled (cid + payload) to the
+        # peer over the wire; when this router dies, the survivor
+        # adopts the orphaned in-flight requests front-of-queue and a
+        # client resubmitting the same cid attaches instead of
+        # duplicating work
+        self._peer_url = (str(peer).rstrip("/") if peer
+                          else envvars.get("MXNET_TPU_ROUTER_HA_PEER"))
+        if self._peer_url:
+            self._peer_url = self._peer_url.rstrip("/")
+        self._ha_on = bool(envvars.get("MXNET_TPU_ROUTER_HA"))
+        self._ha = None             # inbound journal listener
+        self._peer = None           # outbound WireClient to the peer
+        self._peer_rid = None
+        self._peer_ha_port = None
+        self._peer_alive = None     # None unknown / True / False dead
+        self._peer_fails = 0
+        # backoff gate for the peer /healthz dial: a blackholed peer
+        # must not cost the seat-health poll thread a full connect
+        # timeout on EVERY tick (same policy the wire reconnects use)
+        self._peer_recon = Reconnector()
+        self._journal = OrderedDict()    # peer's in-flight: cid->entry
+        self._journal_cap = int(envvars.get("MXNET_TPU_ROUTER_HA_JOURNAL"))
+        self._ha_ack_s = float(envvars.get("MXNET_TPU_ROUTER_HA_ACK_S"))
+        self._live_cids = OrderedDict()  # our in-flight cids -> future
+        self._adopted = OrderedDict()    # adopted orphans: cid->future
+        self._adopted_cap = 4096
+        self._c_ha = None
+        self._died = False
+        if self._ha_on and self._peer_url:
+            self._ha_setup()
         # trace -> engines that served it (bounded): lets the merged
         # /traces summary attribute LOCAL-engine traces too (remote
         # attribution comes from which ring a span was scraped off)
@@ -788,6 +898,7 @@ class ServingRouter:
                     f"engine id {seat.engine_id!r} already registered")
             self._seats[seat.engine_id] = seat
             self._g_up.labels(engine_id=seat.engine_id).set(1)
+            self._g_weight.labels(engine_id=seat.engine_id).set(1.0)
             self._g_inflight.labels(engine_id=seat.engine_id) \
                 .set_function(lambda s=seat: s.outstanding)
         _events.emit("router_engine_added", router_id=self.router_id,
@@ -804,7 +915,13 @@ class ServingRouter:
             seat = self._seats.pop(engine_id, None)
             if seat is None:
                 raise KeyError(f"engine id {engine_id!r} not registered")
+            # closed is visible to a dispatcher that picked this seat
+            # BEFORE the pop: its dispatch fails over immediately (and
+            # the poll thread's maintain() stops touching the seat)
+            # instead of erroring the request against a dead target
+            seat.closed = True
             self._g_up.labels(engine_id=engine_id).set(0)
+            self._g_weight.labels(engine_id=engine_id).set(0)
             self._g_inflight.labels(engine_id=engine_id).set(0)
             self._g_queue_depth.labels(engine_id=engine_id).set(0)
         # snapshot the departing seat's cumulative cost ledger OUTSIDE
@@ -820,11 +937,26 @@ class ServingRouter:
         seat.close()
         _events.emit("router_engine_removed", router_id=self.router_id,
                      engine_id=engine_id, kind=seat.kind)
+        # release any incident hold on this seat: a seat that LEFT the
+        # fleet must not pin an incident open forever (its replacement
+        # starts up without a down→up transition) — same contract as
+        # AlertDaemon.remove_rule's final resolved
+        _events.emit("router_engine_state", router_id=self.router_id,
+                     engine_id=engine_id, state="removed",
+                     reason="remove_engine")
         return self
 
     def engine_ids(self):
         with self._lock:
             return list(self._seats)
+
+    def engine_handle(self, engine_id):
+        """The in-process engine behind a seat (None for remote seats
+        or unknown ids) — the autoscaler uses it to stop a replaced
+        incarnation it didn't spawn itself."""
+        with self._lock:
+            seat = self._seats.get(str(engine_id))
+        return seat._engine if isinstance(seat, _LocalSeat) else None
 
     # -- lifecycle ---------------------------------------------------------
     def start(self):
@@ -873,6 +1005,11 @@ class ServingRouter:
                                         owner_id=self.router_id,
                                         alerts=self._slo)
             self._canary.start()
+        # chaos harness (MXNET_TPU_CHAOS): register as a fault target
+        # (kill_router / kill_wire) — one env read when off
+        if envvars.get("MXNET_TPU_CHAOS"):
+            from .chaos import register_router as _chaos_register
+            _chaos_register(self)
         self._poll_once()           # scoreboard fresh before traffic
         self._dispatcher.start()
         self._poller.start()
@@ -885,6 +1022,8 @@ class ServingRouter:
         fronts them, it doesn't own them). ``drain=True`` waits for
         every admitted request to resolve; ``drain=False`` fails
         undispatched requests with :class:`EngineStoppedError`."""
+        if self._died:
+            return      # die() already tore everything down abruptly
         _events.emit("router_stop", router_id=self.router_id, drain=drain)
         with self._cond:
             already = self._closed
@@ -926,9 +1065,15 @@ class ServingRouter:
                 self._slo.stop()
         with self._lock:
             expo, self._expo = self._expo, None
+            ha, self._ha = self._ha, None
+            peer, self._peer = self._peer, None
             seats = list(self._seats.values())
         if expo is not None:
             expo.close()
+        if ha is not None:
+            ha.close()
+        if peer is not None:
+            peer.close()
         # transports are router-owned even though the engines aren't:
         # drop the persistent wire pools and HTTP waiter pools
         for seat in seats:
@@ -951,34 +1096,69 @@ class ServingRouter:
                     and self._dispatcher.is_alive())
 
     # -- client surface ----------------------------------------------------
-    def submit(self, tokens, token_types=None, deadline_ms=None):
+    def submit(self, tokens, token_types=None, deadline_ms=None,
+               cid=None):
         """Admit one request; returns an :class:`InferenceFuture`
         whose ``trace_id`` names the request fleet-wide. Sheds loudly:
         :class:`QueueFullError` (router queue at bound),
         :class:`NoEngineAvailableError` (no routable engine),
-        :class:`EngineStoppedError` (router not running)."""
+        :class:`EngineStoppedError` (router not running).
+
+        ``cid`` is the HA correlation id: a client resubmitting the
+        same cid (after its first router died mid-request) ATTACHES to
+        the already-adopted/live request instead of duplicating work.
+        With an HA peer configured, every admitted request is
+        journaled (cid + payload) to the peer before it becomes
+        dispatchable, so a router death orphans nothing."""
         if deadline_ms is None:
             deadline_ms = self._default_deadline_ms
+        if cid is not None and self._c_ha is not None:
+            existing = self._ha_lookup(str(cid))
+            if existing is not None:
+                return existing
         # validate FIRST (same invariant as the engine: submitted ==
         # sum of outcome counters, malformed requests touch nothing)
         req = RouterRequest(tokens, token_types, deadline_ms)
         self._bump("submitted")
-        # decide under the lock, account/raise OUTSIDE it (self._cond
-        # shares self._lock, which _bump needs — non-reentrant)
-        refusal = None
-        with self._cond:
-            if not self._started or self._closed:
-                refusal = "stopped"
-            elif not any(s.routable for s in self._seats.values()):
-                refusal = "no_engine"
-            elif len(self._queue) >= self._max_queue_depth:
-                refusal = "queue_full"
-            else:
-                self._queue.append(req)
-                self._pending += 1
-                self._cond.notify()
+        # journal only requests that LOOK admittable: shedding must
+        # stay cheap under overload (no peer round trip per refusal).
+        # The authoritative admission check re-runs after journaling;
+        # if the queue drained in between (pre-check refused, final
+        # check would admit an UNJOURNALED request), go around once
+        # more so every admitted request really is journaled — the
+        # second lap journals unconditionally.
+        for lap in range(2):
+            if (self._c_ha is not None and req.cid is None
+                    and (lap > 0
+                         or self._refusal_peek() is None)):
+                req.cid = str(cid) if cid is not None else req.trace_id
+                # journal BEFORE the request can be dispatched: the
+                # ack wait (bounded) is the durability cost of the
+                # zero-loss contract; a missing/slow peer degrades to
+                # unjournaled
+                self._ha_journal(req)
+            # decide under the lock, account/raise OUTSIDE it
+            # (self._cond shares self._lock, which _bump needs —
+            # non-reentrant)
+            with self._cond:
+                refusal = self._refusal_locked()
+                if (refusal is None and self._c_ha is not None
+                        and req.cid is None):
+                    continue        # drained mid-flight: journal first
+                if refusal is None:
+                    self._queue.append(req)
+                    self._pending += 1
+                    if req.cid is not None:
+                        self._live_cids[req.cid] = req.future
+                        while len(self._live_cids) > self._adopted_cap:
+                            self._live_cids.popitem(last=False)
+                    self._cond.notify()
+            break
         if refusal is None:
             return req.future
+        # refused after journaling: release, or the peer would adopt
+        # (and execute) a request this router never accepted
+        self._ha_release(req)
         if refusal == "stopped":
             self._bump("rejected_stopped")
             req.span.end(error="rejected: router not running")
@@ -995,6 +1175,23 @@ class ServingRouter:
         self._bump("shed_queue_full")
         raise QueueFullError(
             f"router queue full (depth {self._max_queue_depth})")
+
+    def _refusal_locked(self):
+        """The admission decision (caller holds ``_lock``): None =
+        admittable, else the refusal reason."""
+        if not self._started or self._closed:
+            return "stopped"
+        if not any(s.routable for s in self._seats.values()):
+            return "no_engine"
+        if len(self._queue) >= self._max_queue_depth:
+            return "queue_full"
+        return None
+
+    def _refusal_peek(self):
+        """Advisory admission look (takes and releases the lock) —
+        the cheap pre-check that keeps sheds from paying peer I/O."""
+        with self._lock:
+            return self._refusal_locked()
 
     def infer(self, tokens, token_types=None, deadline_ms=None,
               timeout=None):
@@ -1045,15 +1242,21 @@ class ServingRouter:
         return self._closed and (self._abort or self._pending == 0)
 
     def _pick_locked(self, exclude):
-        # least outstanding; ties break round-robin (least recently
-        # picked) so an idle fleet doesn't hot-spot the first seat
-        best = None
+        # WEIGHTED least outstanding: score = (outstanding + 1) /
+        # weight, ties break round-robin (least recently picked). With
+        # every weight at 1.0 (weights off, or a healthy fleet) the
+        # order is exactly the classic least-outstanding; a seat shed
+        # to weight w gets ~w of a full share under load and only
+        # overflow traffic when idle.
+        best = best_score = None
         for seat in self._seats.values():
-            if not seat.routable or seat.engine_id in exclude:
+            if not seat.routable or seat.token in exclude:
                 continue
-            if best is None or (seat.outstanding, seat.last_picked) \
-                    < (best.outstanding, best.last_picked):
-                best = seat
+            score = ((seat.outstanding + 1.0)
+                     / max(seat.weight, self._w_floor))
+            if best is None or (score, seat.last_picked) \
+                    < (best_score, best.last_picked):
+                best, best_score = seat, score
         if best is not None:
             best.last_picked = next(self._pick_seq)
         return best
@@ -1088,6 +1291,7 @@ class ServingRouter:
                 # /submit body) so cost attribution survives fronting
                 req.future.cost = cost
             req.future.set_result(value)
+            self._ha_release(req)
             self._resolve()
             return
         if isinstance(exc, _FAILOVER_ERRORS) and not req.expired():
@@ -1096,7 +1300,10 @@ class ServingRouter:
             # and the abort check share one critical section — an
             # abort stop() racing in here must not strand the request
             # in a queue whose dispatcher already exited.
-            if isinstance(exc, (EngineStoppedError, RemoteEngineError)):
+            if isinstance(exc, (EngineStoppedError, RemoteEngineError)) \
+                    and not seat.closed:
+                # a REMOVED seat's failures must not touch the gauges
+                # of a replacement registered under the same id
                 self._mark(seat, up=False,
                            reason=f"dispatch: {type(exc).__name__}")
                 seat.last_error = repr(exc)
@@ -1105,8 +1312,10 @@ class ServingRouter:
                 if requeued:
                     # tried must grow BEFORE the dispatcher can re-pop
                     # the request, or it may re-pick this same seat
+                    # (generation tokens: a same-id REPLACEMENT seat
+                    # stays a fresh candidate)
                     req.requeues += 1
-                    req.tried.add(seat.engine_id)
+                    req.tried.add(seat.token)
                     self._queue.appendleft(req)
                     self._cond.notify()
             if requeued:
@@ -1132,6 +1341,7 @@ class ServingRouter:
             req.span.force_keep()
         req.span.end(error=repr(exc))
         req.future.set_exception(exc)
+        self._ha_release(req)
         self._resolve()
 
     def _resolve(self):
@@ -1173,6 +1383,7 @@ class ServingRouter:
         with self._lock:
             seats = list(self._seats.values())
         up_count = 0
+        signals = {}
         for seat in seats:
             try:
                 ok, snap = seat.health()
@@ -1230,6 +1441,8 @@ class ServingRouter:
                 seat._prev_completed = completed
                 seat._prev_poll = now
                 self._mark(seat, up=True)
+                if self._weights_on:
+                    signals[seat] = self._seat_signals(seat, snap)
             else:
                 seat.consecutive_failures += 1
                 seat.last_error = snap.get("error") or "health check failed"
@@ -1248,7 +1461,114 @@ class ServingRouter:
                 _events.emit("router_wire_maintain_error",
                              router_id=self.router_id,
                              engine_id=seat.engine_id, error=repr(e))
+        if self._weights_on:
+            self._update_weights(signals)
         self._g_fleet.set(up_count)
+        self._maintain_peer()
+
+    # -- SLO-aware routing weights (poll thread) ---------------------------
+    def _seat_signals(self, seat, snap):
+        """One seat's health signals for the weight fold: the max
+        short-window burn rate over its ratio objectives (``/slo``),
+        the poll-windowed device_s/1k-tokens EMA off the ``/stats``
+        cost totals, and the canary probe latency EMA. Poll thread
+        only."""
+        fetch = seat._sig_tick % self._slo_every == 0
+        seat._sig_tick += 1
+        if fetch:
+            from ..telemetry.slo import max_short_burn
+            try:
+                slo = seat.slo_snapshot()
+            except Exception:
+                slo = None
+            seat.burn = burn = max_short_burn(slo)
+        else:
+            burn = seat.burn        # throttled: reuse the last fetch
+        costs = snap.get("costs") or {}
+        cur = (costs.get("request_s"), costs.get("valid_tokens"))
+        prev = seat._prev_cost
+        seat._prev_cost = cur
+        if (prev is not None and None not in cur
+                and None not in prev and cur[1] - prev[1] > 0):
+            inst = (cur[0] - prev[0]) * 1e3 / (cur[1] - prev[1])
+            if inst >= 0:
+                seat.cost_rate = (inst if seat.cost_rate is None
+                                  else 0.5 * seat.cost_rate
+                                  + 0.5 * inst)
+                seat._cost_age = 0
+        else:
+            # no fresh tokens this poll: the EMA is aging. A shed
+            # seat stops receiving traffic, so a stale-high cost
+            # reading must EXPIRE or it would pin the penalty (and
+            # the floor weight) forever — no data is no signal,
+            # exactly like a burn rate over an empty window
+            seat._cost_age += 1
+        cost = seat.cost_rate if seat._cost_age <= 5 else None
+        canary = self._canary
+        lat = (canary.latency_ms(seat.engine_id)
+               if canary is not None else None)
+        return {"burn": burn, "cost": cost, "canary": lat}
+
+    def _update_weights(self, signals):
+        """Fold each healthy seat's signals into its routing weight.
+        Burn rate is judged absolutely (1x is sustainable, the page
+        factor 14.4x is a full shed); cost and canary latency are
+        judged RELATIVE to the median of the other seats (a uniform
+        slowdown is capacity, not a hot-spot)."""
+        def _others_median(key, me):
+            xs = sorted(v[key] for s, v in signals.items()
+                        if s is not me and v.get(key) is not None)
+            return xs[len(xs) // 2] if xs else None
+
+        for seat, v in signals.items():
+            penalty = 0.0
+            burn = v.get("burn")
+            if burn is not None and burn > 1.0:
+                penalty = max(penalty, min(1.0, (burn - 1.0) / 13.4))
+            for key in ("cost", "canary"):
+                mine = v.get(key)
+                ref = _others_median(key, seat)
+                if mine is None or ref is None or ref <= 0:
+                    continue
+                ratio = mine / ref
+                if ratio > 1.25:
+                    # 25% over the fleet is noise; 3x is a full shed
+                    penalty = max(penalty,
+                                  min(1.0, (ratio - 1.25) / 1.75))
+            self._step_weight(seat,
+                              max(self._w_floor, 1.0 - penalty))
+
+    def _step_weight(self, seat, target):
+        """One hysteresis + smoothing step: healthy seats pin 1.0;
+        a target at/below the enter bound flips the seat DEGRADED
+        (weight then tracks the target with gain alpha); recovery
+        needs the target back above the exit bound for
+        ``_W_OK_POLLS`` consecutive polls — no flapping on a noisy
+        boundary signal."""
+        prev_hys = seat.hys
+        if seat.hys == "healthy":
+            if target <= _W_ENTER:
+                seat.hys = "degraded"
+                seat.ok_polls = 0
+        elif target >= _W_EXIT:
+            seat.ok_polls += 1
+            if seat.ok_polls >= _W_OK_POLLS:
+                seat.hys = "healthy"
+        else:
+            seat.ok_polls = 0
+        if seat.hys == "degraded":
+            seat.weight += self._w_gain * (target - seat.weight)
+            seat.weight = max(self._w_floor, min(1.0, seat.weight))
+        else:
+            seat.weight = 1.0
+        self._g_weight.labels(engine_id=seat.engine_id) \
+            .set(round(seat.weight, 4))
+        if seat.hys != prev_hys:
+            _events.emit("router_engine_weight",
+                         router_id=self.router_id,
+                         engine_id=seat.engine_id, state=seat.hys,
+                         weight=round(seat.weight, 4),
+                         target=round(target, 4))
 
     def _fold_manifest(self, manifest):
         """Union one engine's manifest into the fleet manifest; when
@@ -1290,6 +1610,374 @@ class ServingRouter:
             if self._fleet_manifest is not None:
                 return dict(self._fleet_manifest)
         return compile_cache.load_manifest()
+
+    # -- router active/active HA -------------------------------------------
+    def set_peer(self, url):
+        """Configure (or repoint) the active/active peer AFTER
+        construction — the two-router bootstrap needs each other's
+        exposed URL, which only exists post-``expose()``. Starts the
+        HA journal listener immediately when this router is already
+        exposed. A no-op under ``MXNET_TPU_ROUTER_HA=0`` (the
+        disabled path registers no family and pays no per-request
+        cid cost)."""
+        if not self._ha_on:
+            return self
+        self._peer_url = str(url).rstrip("/")
+        self._ha_setup()
+        with self._lock:
+            expo = self._expo
+            if expo is not None:
+                self._ha_listen(expo.host)
+        return self
+
+    def _ha_listen(self, host):
+        """Start the HA journal listener (caller holds ``_lock``)."""
+        if self._ha is not None or not self._ha_on:
+            return
+        from .wire import WireListener
+        try:
+            self._ha = WireListener(
+                owner_id=self.router_id, handler=self._ha_handle,
+                host=host,
+                port=envvars.get("MXNET_TPU_ROUTER_HA_PORT"),
+                side="ha")
+            self._ha_setup()
+        except OSError as e:
+            _events.emit("router_ha_listen_error",
+                         router_id=self.router_id, error=repr(e))
+
+    def _ha_setup(self):
+        """Register the HA counter family (the activity gate: journal
+        and cid bookkeeping run only once this exists — HA off means
+        no family and zero per-request cost)."""
+        if self._c_ha is None:
+            self._c_ha = _REGISTRY.counter(
+                "mxnet_tpu_router_ha_total",
+                "router active/active HA events: journal sent/received"
+                "/released, ack misses, skipped (no peer link), orphan "
+                "adoptions, cid dedup hits, journal-cap drops",
+                ("event",))
+
+    def _ha_count(self, event):
+        if self._c_ha is not None:
+            self._c_ha.labels(event=event).inc()
+
+    def _ha_handle(self, payload):
+        """The inbound journal surface (wire-listener handler, runs on
+        the peer connection's reader thread — instant bookkeeping
+        only)."""
+        op = payload.get("op") if isinstance(payload, dict) else None
+        if op == "journal":
+            cid = str(payload.get("cid"))
+            entry = {"tokens": payload.get("tokens"),
+                     "token_types": payload.get("token_types"),
+                     "deadline_ms": payload.get("deadline_ms"),
+                     "router_id": payload.get("router_id"),
+                     "t": time.monotonic()}
+            dropped = 0
+            with self._lock:
+                self._journal[cid] = entry
+                self._journal.move_to_end(cid)
+                while len(self._journal) > self._journal_cap:
+                    self._journal.popitem(last=False)
+                    dropped += 1
+            self._ha_count("journal_rx")
+            for _ in range(dropped):
+                self._ha_count("journal_drop")
+            return {"ok": True}
+        if op == "release":
+            with self._lock:
+                self._journal.pop(str(payload.get("cid")), None)
+            self._ha_count("release")
+            return {"ok": True}
+        raise ValueError(f"unknown HA op {op!r}")
+
+    def _ha_lookup(self, cid):
+        """Resubmit dedupe: the future already serving this cid (live
+        or adopted), or None. A cid found in the PEER's journal means
+        the peer accepted it and died before answering — the client
+        re-drove it here, so the entry is consumed (counted an
+        adoption) and the resubmitted payload is executed once."""
+        with self._lock:
+            fut = self._live_cids.get(cid)
+            if fut is None:
+                fut = self._adopted.get(cid)
+            entry = None
+            if fut is None:
+                entry = self._journal.pop(cid, None)
+        if fut is not None:
+            self._ha_count("dedup")
+            _events.emit("router_ha_dedup", router_id=self.router_id,
+                         cid=cid)
+            return fut
+        if entry is not None:
+            self._ha_count("adopt")
+            _events.emit("router_ha_adopt", router_id=self.router_id,
+                         cid=cid, count=1, path="resubmit")
+        return None
+
+    def _ha_journal(self, req):
+        """Journal one admitted request to the peer and wait (bounded)
+        for the ack — the request must be durable on the peer BEFORE
+        it can be dispatched, or a death in between loses it. No live
+        peer link degrades to unjournaled (counted ``skip``) —
+        availability over durability."""
+        peer = self._peer
+        if peer is None or not peer.has_live():
+            if self._peer_url:
+                self._ha_count("skip")
+            return
+        acked = threading.Event()
+        box = {}
+
+        def _on_ack(exc, body):
+            # the reader delivers ERROR frames with exc=None and the
+            # error in the body: a peer that REFUSED the journal op
+            # must not count as durable
+            box["ok"] = (exc is None
+                         and not (body or {}).get("error_type"))
+            acked.set()
+
+        try:
+            peer.dispatch({"op": "journal", "cid": req.cid,
+                           "tokens": req.tokens,
+                           "token_types": req.token_types,
+                           "deadline_ms": req.remaining_ms(),
+                           "router_id": self.router_id},
+                          _on_ack, self._ha_ack_s)
+        except WireError:
+            self._ha_count("skip")
+            return
+        if acked.wait(self._ha_ack_s) and box.get("ok"):
+            self._ha_count("journal")
+        else:
+            self._ha_count("ack_miss")
+
+    def _ha_release(self, req):
+        """Tell the peer this cid resolved (fire-and-forget): its
+        journal entry must not outlive the request, or a later death
+        would re-execute completed work."""
+        cid = req.cid
+        if cid is None:
+            return
+        with self._lock:
+            self._live_cids.pop(cid, None)
+        peer = self._peer
+        if peer is None:
+            return
+        try:
+            peer.dispatch({"op": "release", "cid": cid},
+                          lambda exc, body: None, self._ha_ack_s)
+        except WireError:
+            pass
+
+    def _maintain_peer(self):
+        """Poll-thread peer upkeep: liveness (any HTTP answer from the
+        peer's /healthz means the PROCESS is alive — an unhealthy
+        fleet is not a dead router), journal-link connect/sweep, and
+        the death edge that triggers orphan adoption."""
+        if not (self._ha_on and self._peer_url):
+            return
+        if not self._peer_recon.ready():
+            return      # backing off a recently failed peer dial
+        alive, hz = True, {}
+        try:
+            # capped at the poll period: a slow-but-answering peer
+            # must not stretch every seat-health tick
+            with urllib.request.urlopen(
+                    self._peer_url + "/healthz",
+                    timeout=min(2.0, max(0.25,
+                                         self._poll_interval_s))) as r:
+                hz = json.loads(r.read().decode())
+        except urllib.error.HTTPError as e:
+            try:
+                hz = json.loads(e.read().decode())
+            except Exception:
+                hz = {}
+        except Exception:
+            alive = False
+        if alive:
+            self._peer_recon.succeeded()
+            self._peer_fails = 0
+            self._peer_ha_port = hz.get("ha_port") or self._peer_ha_port
+            rid = hz.get("router_id")
+            if rid is not None:
+                self._peer_rid = str(rid)
+            if self._peer_alive is False:
+                _events.emit("router_peer_state",
+                             router_id=self.router_id,
+                             peer=self._peer_rid or self._peer_url,
+                             state="up")
+            self._peer_alive = True
+            port = self._peer_ha_port
+            if port:
+                peer = self._peer
+                if peer is not None and peer.port != int(port):
+                    # peer restarted on a new HA port: rebuild
+                    self._peer = None
+                    peer.close()
+                    peer = None
+                if peer is None:
+                    host = (urlsplit(self._peer_url).hostname
+                            or "127.0.0.1")
+                    peer = WireClient(host, int(port), conns=1,
+                                      client_id=self.router_id,
+                                      expect_engine_id=self._peer_rid)
+                    self._peer = peer
+                    self._ha_setup()
+                peer.ensure()
+                peer.sweep()
+            return
+        self._peer_recon.failed()
+        self._peer_fails += 1
+        if self._peer_alive is True \
+                and self._peer_fails >= max(2, self._fail_after):
+            self._peer_alive = False
+            _events.emit("router_peer_state", router_id=self.router_id,
+                         peer=self._peer_rid or self._peer_url,
+                         state="down")
+            try:
+                self._adopt_orphans()
+            except Exception as e:
+                _events.emit("router_ha_adopt_error",
+                             router_id=self.router_id, error=repr(e))
+
+    def _adopt_orphans(self):
+        """The peer died: every cid it journaled and never released is
+        an in-flight request about to be lost — rebuild each as a
+        RouterRequest and requeue it FRONT of the line (it has been
+        waiting longest). A client resubmitting its cid attaches to
+        the adopted future; a client that never comes back still gets
+        the work completed (at-least-once). The cids are RESERVED in
+        ``_adopted`` in the same critical section that empties the
+        journal, so a resubmit racing this sweep attaches instead of
+        being admitted as duplicate new work."""
+        reserved = []               # (cid, entry, future)
+        with self._cond:
+            if self._closed:
+                return 0
+            entries = list(self._journal.items())
+            self._journal.clear()
+            for cid, e in entries:
+                if cid in self._live_cids or cid in self._adopted:
+                    continue
+                fut = InferenceFuture()
+                self._live_cids[cid] = fut
+                self._adopted[cid] = fut
+                reserved.append((cid, e, fut))
+            while len(self._adopted) > self._adopted_cap:
+                self._adopted.popitem(last=False)
+        adopt = []
+        for cid, e, fut in reserved:
+            deadline_ms = e.get("deadline_ms")
+            if deadline_ms is not None:
+                deadline_ms = (float(deadline_ms)
+                               - (time.monotonic() - e["t"]) * 1e3)
+                if deadline_ms <= 0:
+                    # dead on its own deadline either way — but the
+                    # reserved future must resolve for any attached
+                    # resubmitter
+                    fut.set_exception(DeadlineExceededError(
+                        f"adopted request {cid} expired before its "
+                        "peer's death was detected"))
+                    continue
+            try:
+                req = RouterRequest(e["tokens"], e.get("token_types"),
+                                    deadline_ms)
+            except Exception as exc:
+                fut.set_exception(ServingError(
+                    f"adopted journal entry {cid} unusable: {exc!r}"))
+                continue
+            # the RESERVED future is the request's identity (clients
+            # may already hold it via a resubmit attach)
+            req.future = fut
+            fut.trace_id = req.trace_id
+            req.cid = cid
+            req.adopted = True
+            req.span.set_attr(adopted=1)
+            adopt.append(req)
+        if not adopt:
+            _events.emit("router_peer_state", router_id=self.router_id,
+                         peer=self._peer_rid or self._peer_url,
+                         state="adopted")
+            return 0
+        with self._cond:
+            if self._closed:
+                for req in adopt:
+                    req.future.set_exception(EngineStoppedError(
+                        "router stopped during orphan adoption"))
+                return 0
+            for req in reversed(adopt):
+                self._queue.appendleft(req)
+            self._pending += len(adopt)
+            self._cond.notify_all()
+        for _ in adopt:
+            self._ha_count("adopt")
+        self._bump("adopted", len(adopt))
+        _events.emit("router_ha_adopt", router_id=self.router_id,
+                     peer=self._peer_rid or self._peer_url,
+                     count=len(adopt), path="peer_death")
+        # the peer's orphans are in OUR hands now: release the
+        # incident hold (the outage is handled, not ongoing)
+        _events.emit("router_peer_state", router_id=self.router_id,
+                     peer=self._peer_rid or self._peer_url,
+                     state="adopted")
+        return len(adopt)
+
+    def die(self):
+        """Simulate abrupt router death (the chaos drill's
+        ``kill_router`` fault and the HA tests' crash surface): stop
+        serving WITHOUT draining, resolving, or handing anything off —
+        in-flight work is orphaned exactly as a SIGKILL would leave
+        it. The peer's journal adoption (and clients' cid resubmits)
+        are the recovery path under test. After ``die()``, ``stop()``
+        is a no-op."""
+        _events.emit("router_die", router_id=self.router_id)
+        # sever the OUTWARD surfaces first — peer link, journal
+        # listener, exposition server — exactly what a SIGKILL cuts
+        # instantly. In-process work may still complete during the
+        # teardown window, but no release/journal/reply escapes it,
+        # so the peer's view matches a real crash.
+        with self._lock:
+            expo, self._expo = self._expo, None
+            ha, self._ha = self._ha, None
+            peer, self._peer = self._peer, None
+        if peer is not None:
+            peer.close()
+        if ha is not None:
+            ha.close()
+        if expo is not None:
+            expo.close()
+        with self._cond:
+            self._died = True
+            self._closed = True
+            self._abort = True
+            stranded = list(self._queue)
+            self._queue.clear()
+            self._cond.notify_all()
+        # a real SIGKILL severs every client connection instantly; the
+        # in-process simulation must match it — stranded futures fail
+        # NOW so a blocked /submit handler answers (503) and its
+        # client re-drives the cid at the survivor, instead of hanging
+        # out a long timeout on a half-dead router
+        for req in stranded:
+            req.span.end(error="router died")
+            req.future.set_exception(EngineStoppedError(
+                "router died with the request undispatched"))
+        self._stop_evt.set()
+        _recorder.unregister_probe(self._probe_name)
+        _recorder.remove_bundle_section("router_scoreboard")
+        if self._canary is not None:
+            self._canary.stop()
+        if self._slo is not None:
+            self._slo.stop()
+        with self._lock:
+            seats = list(self._seats.values())
+        for seat in seats:
+            seat.close()
+        for t in (self._dispatcher, self._poller):
+            if t is not None and t is not threading.current_thread():
+                t.join(timeout=5.0)
 
     def _mark(self, seat, up, reason=None):
         if seat.routable == up and seat.up == up:
@@ -1529,7 +2217,11 @@ class ServingRouter:
             seats = list(self._seats.values())
         out = []
         for seat in seats:
-            t = {"engine_id": seat.engine_id, "kind": seat.kind}
+            # the generation token lets the prober re-pin its TOFU
+            # golden when a REPLACEMENT seat reuses an id (new model,
+            # new golden — not a forever checksum_mismatch page)
+            t = {"engine_id": seat.engine_id, "kind": seat.kind,
+                 "token": seat.token}
             if isinstance(seat, _RemoteSeat):
                 t["url"] = seat.base_url
                 # advertised (port, REAL engine id) from the health
@@ -1562,7 +2254,8 @@ class ServingRouter:
         try:
             fut = self.submit(payload["tokens"],
                               payload.get("token_types"),
-                              deadline_ms=payload.get("deadline_ms"))
+                              deadline_ms=payload.get("deadline_ms"),
+                              cid=payload.get("cid"))
         except (ServingError, ValueError, KeyError, TypeError) as e:
             name = type(e).__name__
             status = {"NoEngineAvailableError": 503}.get(
@@ -1592,10 +2285,12 @@ class ServingRouter:
         up = sum(1 for r in board.values() if r["routable"])
         with self._lock:
             queue_depth = len(self._queue)
+            ha = self._ha
         return (self.running and up > 0,
                 {"router_id": self.router_id, "engines_up": up,
                  "engines_total": len(board),
-                 "queue_depth": queue_depth})
+                 "queue_depth": queue_depth,
+                 "ha_port": ha.port if ha is not None else None})
 
     def expose(self, port=0, host="127.0.0.1"):
         """Start (or return) the router's exposition server: the
@@ -1627,6 +2322,14 @@ class ServingRouter:
                                   incidents_fn=self.incidents_snapshot,
                                   port=port, host=host)
             self._expo = srv
+            # active/active HA journal listener: rides the exposition
+            # lifecycle like the engine's wire listener; the port is
+            # advertised in /healthz as ha_port so the PEER discovers
+            # it off its health poll — a bind failure degrades to
+            # unjournaled HA, never a dead router
+            if (self._peer_url
+                    or envvars.get("MXNET_TPU_ROUTER_HA_PORT")):
+                self._ha_listen(host)
         _events.emit("telemetry_expose", router_id=self.router_id,
                      port=srv.port, host=srv.host)
         return srv

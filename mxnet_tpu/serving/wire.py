@@ -64,6 +64,7 @@ import numpy as np
 
 from .. import envvars
 from ..base import MXNetError
+from ..retrying import Reconnector
 from ..telemetry import events as _events
 from . import metrics as _metrics
 
@@ -334,7 +335,10 @@ def _safe_callback(cb, *args):
 
 # -- engine side ------------------------------------------------------------
 class WireListener:
-    """Binary dispatch listener for one :class:`~.engine.ServingEngine`.
+    """Binary dispatch listener for one :class:`~.engine.ServingEngine`
+    — or, with ``handler=``, for any frame-served peer surface (the
+    router's active/active HA journal channel reuses exactly this
+    listener with a synchronous handler instead of an engine).
 
     Started by ``ServingEngine.expose()`` next to the HTTP exposition
     server (``MXNET_TPU_WIRE=0`` opts out); the port is advertised in
@@ -345,30 +349,47 @@ class WireListener:
     raw typed ndarray (no ``tolist()``) plus the request's amortized
     cost bill and the engine-observed wall (``engine_ms``, the router's
     dispatch-overhead baseline).
+
+    ``handler(payload_dict) -> body_dict`` (when given) serves each
+    SUBMIT frame synchronously on the connection's reader thread —
+    right for instant bookkeeping ops (the HA journal), wrong for
+    model forwards (which keep the engine's async future path). A
+    raising handler errors THE FRAME with the exception's class name,
+    never the connection.
     """
 
-    def __init__(self, engine, host="127.0.0.1", port=None,
-                 max_frame=None):
+    def __init__(self, engine=None, host="127.0.0.1", port=None,
+                 max_frame=None, owner_id=None, handler=None,
+                 side="engine"):
+        if engine is None and handler is None:
+            raise ValueError("WireListener needs an engine or a handler")
         self._engine = engine
+        self._handler = handler
+        self._owner_id = str(owner_id) if owner_id is not None \
+            else (engine.engine_id if engine is not None else "?")
+        self._side = str(side)
         self._max_frame = (int(max_frame) if max_frame is not None
                            else _max_frame_bytes())
-        eid = engine.engine_id
+        eid = self._owner_id
         frames = _metrics.wire_frames_counter()
         self._f_in = {}
         self._f_out = {}
         self._frames = frames
         byt = _metrics.wire_bytes_counter()
-        self._b_in = byt.labels(side="engine", transport="wire",
+        self._b_in = byt.labels(side=self._side, transport="wire",
                                 direction="in")
-        self._b_out = byt.labels(side="engine", transport="wire",
+        self._b_out = byt.labels(side=self._side, transport="wire",
                                  direction="out")
         self._conns_g = _metrics.wire_connections_gauge() \
-            .labels(side="engine")
+            .labels(side=self._side)
         self._refusals = _metrics.wire_refusals_counter() \
-            .labels(side="engine")
+            .labels(side=self._side)
         self._closed = False
         self._lock = threading.Lock()
         self._open = set()            # live connection sockets
+        # chaos receive hook (serving.chaos): None when chaos is off —
+        # nothing is patched, the per-frame cost is one attribute read
+        self.chaos_rx = None
         srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         want = int(port if port is not None
@@ -411,6 +432,20 @@ class WireListener:
             except OSError:
                 pass
 
+    def kill_connections(self):
+        """Abruptly close every ACCEPTED connection (the listener keeps
+        listening — peers reconnect). The chaos harness's
+        ``kill_wire`` fault; also a handy drill primitive. Returns the
+        number of connections killed."""
+        with self._lock:
+            conns = list(self._open)
+        for conn in conns:
+            try:
+                conn.close()
+            except OSError:
+                pass
+        return len(conns)
+
     def _count_in(self, tag, n):
         child = self._f_in.get(tag)
         if child is None:
@@ -448,7 +483,7 @@ class WireListener:
                 daemon=True).start()
 
     def _serve(self, conn, peer):
-        eid = self._engine.engine_id
+        eid = self._owner_id
         self._conns_g.inc()
         writer = _FrameWriter(
             conn, f"mxnet_tpu_wire_write_fd{conn.fileno()}",
@@ -466,6 +501,9 @@ class WireListener:
                 tag = frame[0]
                 self._count_in(tag if isinstance(tag, str) else "?",
                                nbytes)
+                rx = self.chaos_rx
+                if rx is not None and not rx(tag):
+                    continue        # chaos dropped the inbound frame
                 if tag == FRAME_PING:
                     writer.send((FRAME_PONG,) + tuple(frame[1:2]))
                 elif tag == FRAME_HELLO:
@@ -506,7 +544,7 @@ class WireListener:
         self._refusals.inc()
         writer.send((FRAME_ERROR, corr,
                      {"error_type": error_type, "error": message,
-                      "engine_id": self._engine.engine_id}))
+                      "engine_id": self._owner_id}))
 
     def _handle_submit(self, frame, writer):
         corr = frame[1] if len(frame) > 1 else None
@@ -520,6 +558,22 @@ class WireListener:
         if not isinstance(payload, dict):
             self._error_frame(writer, corr,
                               "SUBMIT payload must be a dict")
+            return
+        if self._handler is not None:
+            # synchronous peer-surface op (e.g. the router HA journal):
+            # instant bookkeeping, answered inline on the reader
+            # thread; a raising handler errors THE FRAME with the
+            # exception's class name, keeping the connection
+            try:
+                body = self._handler(payload)
+            except Exception as e:
+                writer.send((FRAME_ERROR, corr,
+                             {"error_type": type(e).__name__,
+                              "error": str(e),
+                              "engine_id": self._owner_id}))
+                return
+            writer.send((FRAME_RESULT, corr,
+                         dict(body or {}, engine_id=self._owner_id)))
             return
         t0 = time.perf_counter()
         try:
@@ -610,6 +664,11 @@ class WireClient:
         self._ping_seq = itertools.count(1)
         self._closed = False
         self._connect_failed = False  # edge-triggered event spam guard
+        # repo-wide reconnect policy (mxnet_tpu.retrying): consecutive
+        # failed connects back off 0.2 s doubling to a 5 s cap, so a
+        # dead peer costs one connect per backoff window, not one per
+        # poll tick; any success resets the ladder
+        self._recon = Reconnector()
         frames = _metrics.wire_frames_counter()
         self._frames = frames
         self._f_in = {}
@@ -645,7 +704,10 @@ class WireClient:
     # -- connection management (poll thread) -------------------------------
     def ensure(self):
         """(Re)connect any dead slot. Blocking (connect + handshake) —
-        call from the health-poll thread. Returns the live count."""
+        call from the health-poll thread. Returns the live count.
+        Consecutive failed connects are backoff-gated by the shared
+        :class:`~mxnet_tpu.retrying.Reconnector` policy — a dead peer
+        is not re-dialed on every poll tick."""
         live = 0
         for i in range(self._n):
             with self._lock:
@@ -655,9 +717,12 @@ class WireClient:
             if conn is not None and conn.alive:
                 live += 1
                 continue
+            if not self._recon.ready():
+                return live     # backing off a recent failed connect
             try:
                 fresh = self._connect()
             except (OSError, MXNetError, ValueError) as e:
+                self._recon.failed()
                 if not self._connect_failed:
                     self._connect_failed = True
                     _events.emit("wire_connect_error",
@@ -665,6 +730,7 @@ class WireClient:
                                  engine_id=self._expect, error=repr(e))
                 return live
             self._connect_failed = False
+            self._recon.succeeded()
             stale = None
             with self._lock:
                 if self._closed:
@@ -740,6 +806,19 @@ class WireClient:
             self._slots = [None] * self._n
         for conn in conns:
             self._teardown(conn)
+
+    def kill_connections(self):
+        """Abruptly tear down every live connection WITHOUT closing
+        the client (``ensure`` reconnects on the next tick) — the
+        chaos harness's router-side ``kill_wire`` fault. In-flight
+        requests fail with :class:`WireError`, i.e. the router's
+        failover requeues them. Returns the number killed."""
+        with self._lock:
+            conns = [c for c in self._slots if c is not None]
+            self._slots = [None] * self._n
+        for conn in conns:
+            self._teardown(conn)
+        return len(conns)
 
     def _teardown(self, conn, error=None):
         with conn.plock:

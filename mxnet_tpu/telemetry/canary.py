@@ -118,11 +118,17 @@ class CanaryProber:
             if tokens is not None else golden_tokens()
         self.golden = str(golden) if golden is not None else None
         self._goldens = {}          # per-seat TOFU when not pinned
+        self._gen = {}              # per-seat generation token: a
+        # REPLACEMENT seat under a reused id (remove_engine +
+        # add_engine, the autoscaler's replace) is a NEW model — its
+        # golden re-pins instead of paging checksum_mismatch forever
+        # against the dead incarnation's weights
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread = None
         self._transport_rr = {}     # engine_id -> last transport used
         self._wire = {}             # engine_id -> (port, WireClient)
+        self._lat_ema = {}          # engine_id -> ok-probe latency EMA
         self._rules = set()         # absence-rule names we declared
         self.rounds = 0
         self._c_req = reg.counter(
@@ -241,6 +247,13 @@ class CanaryProber:
         tagged = {"engine_id": eid, "transport": transport,
                   "traffic": "synthetic"}
         self._c_req.labels(outcome=outcome, **tagged).inc()
+        if outcome == "ok":
+            # per-seat latency EMA: the router's SLO-aware routing
+            # reads this as its black-box hot-spot signal
+            with self._lock:
+                prev = self._lat_ema.get(eid)
+                self._lat_ema[eid] = (ms if prev is None
+                                      else 0.5 * prev + 0.5 * ms)
         if outcome in ("ok", "checksum_mismatch"):
             exemplar = (self._slow_exemplar(trace_id, ms,
                                             self._exemplars)
@@ -291,6 +304,13 @@ class CanaryProber:
                 self._alerts.remove_rule(self._rule_name(eid))
                 self._rules.discard(self._rule_name(eid))
 
+    def latency_ms(self, engine_id):
+        """This seat's successful-probe latency EMA (None before its
+        first ok probe) — the black-box hot-spot signal the router's
+        SLO-aware routing weights fold in."""
+        with self._lock:
+            return self._lat_ema.get(str(engine_id))
+
     # -- probes -------------------------------------------------------------
     def golden_for(self, engine_id):
         """The golden checksum this seat is being judged against
@@ -316,20 +336,33 @@ class CanaryProber:
             outcome = ("timeout" if name in _TIMEOUT_ERRORS
                        or "timed out" in str(e) else "error")
             return outcome, None, trace_id
-        return self._check(eid, result), cost, trace_id
+        return (self._check(eid, result, token=target.get("token")),
+                cost, trace_id)
 
-    def _check(self, eid, result):
+    def _check(self, eid, result, token=None):
         checksum = response_checksum(result)
         if self.golden is not None:     # pinned fleet-wide golden
             return ("ok" if checksum == self.golden
                     else "checksum_mismatch")
+        regolden = False
         with self._lock:
+            if token is not None and self._gen.get(eid) != token:
+                # a new seat GENERATION under this id (replacement):
+                # the old incarnation's golden is void — re-TOFU.
+                # Same-generation weight drift still pages.
+                regolden = self._gen.get(eid) is not None
+                self._gen[eid] = token
+                self._goldens.pop(eid, None)
+                self._lat_ema.pop(eid, None)
             prev = self._goldens.get(eid)
             if prev is None:
                 # trust on first use, PER SEAT: this seat's first
                 # healthy answer is its golden — recorded so an
                 # operator can pin it fleet-wide
                 self._goldens[eid] = checksum
+        if regolden:
+            _events.emit("canary_regolden", owner=self.owner_id,
+                         engine_id=eid, token=str(token))
         if prev is None:
             _events.emit("canary_golden", owner=self.owner_id,
                          engine_id=eid, checksum=checksum)

@@ -57,6 +57,7 @@ import urllib.request
 from collections import OrderedDict, deque
 
 from .. import envvars
+from ..retrying import RetryPolicy
 from . import events as _events
 from .registry import REGISTRY
 
@@ -154,6 +155,14 @@ class AlertNotifier:
         self.severities = tuple(severities)
         self._sleep = sleep if sleep is not None else time.sleep
         self._rng = rng if rng is not None else random.Random()
+        # the one repo-wide retry shape (mxnet_tpu.retrying): doubling
+        # backoff from backoff_s with up to 50% proportional jitter,
+        # retries RE-tries = retries+1 attempts — injectable sleep/rng
+        # keep the scripted-clock goldens exact
+        self._policy = RetryPolicy(retries=self.retries,
+                                   backoff_s=self.backoff_s,
+                                   multiplier=2.0, jitter=0.5,
+                                   sleep=self._sleep, rng=self._rng)
         self._dq = deque()
         self._cv = threading.Condition()
         self._idle = True
@@ -313,20 +322,17 @@ class AlertNotifier:
                 self._spool(sink, note)
 
     def _deliver_to(self, sink, note):
-        for attempt in range(self.retries + 1):
-            try:
-                sink.send(note)
-                return True
-            except Exception as e:
-                if attempt >= self.retries:
-                    _events.emit("alert_egress_failed", sink=sink.name,
-                                 alert=note.get("alert"), error=repr(e))
-                    return False
-                self._c_retries.labels(sink=sink.name).inc()
-                delay = self.backoff_s * (2 ** attempt)
-                delay += self._rng.uniform(0, delay * 0.5)
-                self._sleep(delay)
-        return False
+        def _on_retry(_attempt, _exc):
+            self._c_retries.labels(sink=sink.name).inc()
+
+        try:
+            self._policy.call(lambda: sink.send(note),
+                              on_retry=_on_retry)
+            return True
+        except Exception as e:
+            _events.emit("alert_egress_failed", sink=sink.name,
+                         alert=note.get("alert"), error=repr(e))
+            return False
 
     # -- dead-letter spool --------------------------------------------------
     def _spool_depth(self):

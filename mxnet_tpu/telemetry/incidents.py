@@ -63,9 +63,9 @@ _OPENERS = ("alert", "watchdog", "scoreboard")
 #: frozenset lookup — the tap rides the hot emit path)
 _SIGNAL_EVENTS = frozenset((
     "alert_state", "watchdog_anomaly", "router_engine_state",
-    "engine_start", "warmup_replay", "router_engine_added",
-    "router_engine_removed", "flight_recorder_dump",
-    "flight_recorder_amend"))
+    "router_peer_state", "engine_start", "warmup_replay",
+    "router_engine_added", "router_engine_removed",
+    "flight_recorder_dump", "flight_recorder_amend"))
 
 
 class Incident:
@@ -238,6 +238,15 @@ class IncidentTracker:
                     inc.down_engines.add(str(eid))
                 else:
                     inc.down_engines.discard(str(eid))
+            elif kind == "peer":
+                # a dead peer ROUTER holds the incident open until the
+                # survivor either adopts its orphans ("adopted") or
+                # sees it return ("up") — handled beats ongoing
+                key = f"peer:{rec.get('peer')}"
+                if rec.get("state") == "down":
+                    inc.down_engines.add(key)
+                else:
+                    inc.down_engines.discard(key)
             inc_id = inc.id
         self._emit_closed(closed)
         if opened:
@@ -267,6 +276,12 @@ class IncidentTracker:
                     {"engine_id": rec.get("engine_id"),
                      "state": rec.get("state"),
                      "reason": rec.get("reason")},
+                    rec.get("state") == "down")
+        if event == "router_peer_state":
+            return ("peer",
+                    {"router_id": rec.get("router_id"),
+                     "peer": rec.get("peer"),
+                     "state": rec.get("state")},
                     rec.get("state") == "down")
         if event in ("flight_recorder_dump", "flight_recorder_amend"):
             return ("bundle", {"reason": rec.get("reason"),

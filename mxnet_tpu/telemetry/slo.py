@@ -48,7 +48,24 @@ from .registry import REGISTRY
 
 __all__ = ["SampleStore", "SLO", "RatioSLO", "LatencySLO",
            "AvailabilitySLO", "ThresholdSLO", "CostSLO", "GaugeSLO",
-           "SloEvaluator", "BURN_WINDOWS", "window_scale"]
+           "SloEvaluator", "BURN_WINDOWS", "window_scale",
+           "max_short_burn"]
+
+
+def max_short_burn(snapshot, window="5m"):
+    """The max burn rate over a ``/slo`` snapshot's RATIO objectives
+    at the given window label (None when none answer) — the one
+    "is this owner burning" scalar the router's routing weights and
+    the autoscaler both judge; one helper keeps them judging the
+    same signal by construction."""
+    burn = None
+    for row in ((snapshot or {}).get("objectives") or {}).values():
+        if row.get("kind") != "ratio":
+            continue
+        b = (row.get("burn_rates") or {}).get(window)
+        if b is not None and (burn is None or b > burn):
+            burn = b
+    return burn
 
 #: canonical burn-rate windows (seconds, before scaling) — the SRE
 #: workbook's multi-window pairs read these by label

@@ -1,0 +1,545 @@
+"""Self-healing fleet mechanics (ISSUE 14): the shared retry policy,
+the remove_engine mid-burst race fix, SLO-aware routing weights,
+router active/active HA (journal → adoption → cid dedupe), and the
+burn/queue autoscaler. The end-to-end chaos drill lives in
+tests/test_chaos.py; these are the per-mechanism contracts.
+"""
+import random
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx  # noqa: F401  (backend/env init)
+from mxnet_tpu import nd
+from mxnet_tpu.retrying import Reconnector, RetryPolicy
+from mxnet_tpu.serving import (FleetAutoscaler, ServingEngine,
+                               ServingRouter)
+
+
+class StubModel:
+    """out[b, s, 0] == ids[b, s] — responses bit-check against the
+    request's own tokens (same contract stub as test_serving)."""
+
+    def __init__(self, delay=0.0):
+        self.delay = delay
+
+    def __call__(self, ids, token_types, valid_length, segment_ids,
+                 positions):
+        if self.delay:
+            time.sleep(self.delay)
+        return nd.array(ids.asnumpy().astype(np.float32)[..., None])
+
+
+def _stub_engine(engine_id, delay=0.0, **kw):
+    kw.setdefault("bucket_lens", (16,))
+    kw.setdefault("max_rows", 2)
+    return ServingEngine(StubModel(delay=delay), engine_id=engine_id,
+                         **kw)
+
+
+def _wait(pred, timeout=30.0, what="condition", poll=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        v = pred()
+        if v:
+            return v
+        time.sleep(poll)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+# ---------------------------------------------------------------------------
+# retrying.py: the one repo-wide policy
+# ---------------------------------------------------------------------------
+
+def test_retry_policy_delay_golden_and_call():
+    """Doubling backoff + proportional jitter, retries+1 attempts,
+    final failure re-raises — the egress semantics, now shared."""
+    sleeps = []
+    policy = RetryPolicy(retries=3, backoff_s=0.5, jitter=0.5,
+                         sleep=sleeps.append, rng=random.Random(0))
+    attempts = []
+
+    def fail():
+        attempts.append(1)
+        raise OSError("down")
+
+    retried = []
+    with pytest.raises(OSError):
+        policy.call(fail, on_retry=lambda a, e: retried.append(a))
+    assert len(attempts) == 4 and retried == [0, 1, 2]
+    assert len(sleeps) == 3
+    for i, s in enumerate(sleeps):
+        base = 0.5 * (2 ** i)
+        assert base <= s <= base * 1.5, sleeps
+
+    # success on attempt 2 stops retrying; cap bounds the pre-jitter
+    seq = iter([OSError("x"), OSError("y"), "ok"])
+
+    def flaky():
+        v = next(seq)
+        if isinstance(v, Exception):
+            raise v
+        return v
+
+    assert policy.call(flaky) == "ok"
+    capped = RetryPolicy(retries=8, backoff_s=1.0, jitter=0.0,
+                         max_backoff_s=4.0, sleep=lambda s: None)
+    assert capped.delay(0) == 1.0
+    assert capped.delay(5) == 4.0       # capped, no jitter
+
+
+def test_reconnector_backoff_gates_poll_ticks():
+    """Consecutive failed connects push the next attempt out; success
+    resets the ladder — a dead peer costs one dial per window."""
+    clock = [0.0]
+    recon = Reconnector(RetryPolicy(retries=0, backoff_s=1.0,
+                                    jitter=0.0, max_backoff_s=8.0),
+                        clock=lambda: clock[0])
+    assert recon.ready()
+    recon.failed()
+    assert not recon.ready()            # 1.0 s backoff pending
+    clock[0] = 0.5
+    assert not recon.ready()
+    clock[0] = 1.0
+    assert recon.ready()
+    recon.failed()                      # second failure: 2.0 s
+    clock[0] = 2.5
+    assert not recon.ready()
+    clock[0] = 3.1
+    assert recon.ready()
+    recon.succeeded()
+    recon.failed()                      # ladder reset: base again
+    clock[0] = 4.2
+    assert recon.ready()
+
+
+# ---------------------------------------------------------------------------
+# remove_engine racing in-flight dispatches (the regression)
+# ---------------------------------------------------------------------------
+
+def test_remove_engine_mid_burst_zero_loss():
+    """Removing (and re-adding) a seat while a burst is in flight
+    must never error a request: dispatches racing the removal land in
+    the failover requeue and complete on a sibling or the
+    replacement."""
+    keep = _stub_engine("rm-keep", max_rows=1)
+    victims = [_stub_engine("rm-victim", delay=0.01, max_rows=1)
+               for _ in range(4)]
+    router = ServingRouter(engines={"rm-keep": keep,
+                                    "rm-victim": victims[0]},
+                           poll_interval_s=30.0)
+    keep.start()
+    for v in victims:
+        v.start()
+    router.start()
+    futs = []
+    stop = threading.Event()
+
+    def churn():
+        # remove + replace the victim seat under the same id, over
+        # and over, while the burst is dispatching
+        gen = 0
+        while not stop.is_set() and gen < len(victims) - 1:
+            time.sleep(0.03)
+            router.remove_engine("rm-victim")
+            gen += 1
+            router.add_engine("rm-victim", victims[gen])
+
+    t = threading.Thread(target=churn, daemon=True, name="rm_churn")
+    try:
+        t.start()
+        for i in range(120):
+            futs.append(router.submit([7, 8, 9]))
+            time.sleep(0.002)
+        outs = [f.result(timeout=60) for f in futs]
+        stop.set()
+        t.join(timeout=30)
+        for o in outs:
+            assert o[0, 0] == 7.0       # nothing lost, nothing wrong
+        assert router.count("completed") == len(futs)
+        assert router.count("failed") == 0
+        assert router.count("shed_no_engine") == 0
+    finally:
+        stop.set()
+        router.stop()
+        keep.stop()
+        for v in victims:
+            try:
+                v.stop(drain=False)
+            except Exception:
+                pass
+
+
+def test_replacement_seat_under_reused_id_is_fresh_candidate():
+    """req.tried pins seat GENERATION tokens, not ids: a request that
+    failed over from the old seat can still be served by its same-id
+    replacement (previously the id was poisoned forever)."""
+    a = _stub_engine("gen-a")
+    b = _stub_engine("gen-b")
+    router = ServingRouter(engines=[a, b], poll_interval_s=30.0)
+    with a, b, router:
+        with router._lock:
+            old = router._seats["gen-a"]
+        router.remove_engine("gen-a")
+        a2 = _stub_engine("gen-a")
+        a2.start()
+        try:
+            router.add_engine("gen-a", a2)
+            with router._lock:
+                new = router._seats["gen-a"]
+                assert new.token != old.token
+                # a request that already tried the OLD generation can
+                # still pick the replacement
+                picked = router._pick_locked({old.token,
+                                              router._seats["gen-b"].token})
+                assert picked is new
+        finally:
+            a2.stop()
+
+
+# ---------------------------------------------------------------------------
+# SLO-aware routing weights
+# ---------------------------------------------------------------------------
+
+def test_step_weight_hysteresis_and_floor():
+    """Shed is smooth (gain-tracked), entry needs the target at the
+    enter bound, recovery needs _W_OK_POLLS consecutive good polls —
+    and the weight never leaves [floor, 1]."""
+    from mxnet_tpu.serving import router as router_mod
+
+    eng = _stub_engine("w-hys")
+    r = ServingRouter(engines=[eng], poll_interval_s=30.0)
+    with r._lock:
+        seat = r._seats["w-hys"]
+    # healthy seats ignore mild targets (no flapping on noise)
+    r._step_weight(seat, 0.8)
+    assert seat.hys == "healthy" and seat.weight == 1.0
+    # a target at the enter bound degrades; weight tracks smoothly
+    r._step_weight(seat, 0.1)
+    assert seat.hys == "degraded"
+    w1 = seat.weight
+    assert w1 < 1.0
+    r._step_weight(seat, 0.1)
+    assert seat.weight < w1
+    for _ in range(40):
+        r._step_weight(seat, 0.05)
+    assert seat.weight >= r._w_floor
+    # recovery: needs _W_OK_POLLS consecutive good targets
+    r._step_weight(seat, 1.0)
+    assert seat.hys == "degraded"
+    r._step_weight(seat, 0.5)           # blip resets the exit count
+    r._step_weight(seat, 1.0)
+    r._step_weight(seat, 1.0)
+    assert seat.hys == "degraded"
+    r._step_weight(seat, 1.0)
+    assert seat.hys == "healthy" and seat.weight == 1.0
+    assert router_mod._W_OK_POLLS == 3
+
+
+def test_weighted_pick_prefers_healthy_seat():
+    """With one seat shed to the floor, the picker sends it only
+    overflow traffic — and with equal weights the order is exactly
+    the classic least-outstanding."""
+    a = _stub_engine("wp-a")
+    b = _stub_engine("wp-b")
+    r = ServingRouter(engines=[a, b], poll_interval_s=30.0)
+    with r._lock:
+        sa, sb = r._seats["wp-a"], r._seats["wp-b"]
+        sb.weight = 0.05
+        picks = []
+        for _ in range(6):
+            seat = r._pick_locked(set())
+            picks.append(seat.engine_id)
+            seat.outstanding += 1
+        # the degraded seat only gets picked once the healthy one is
+        # loaded: (o+1)/1 > 1/0.05 needs o >= 19 — never here
+        assert picks == ["wp-a"] * 6
+        sa.outstanding = sb.outstanding = 0
+        sb.weight = 1.0
+        picks = []
+        for _ in range(4):
+            seat = r._pick_locked(set())
+            picks.append(seat.engine_id)
+            seat.outstanding += 1
+        assert sorted(picks[:2]) == ["wp-a", "wp-b"]
+
+
+def test_router_sheds_weight_off_burning_seat(monkeypatch, tmp_path):
+    """Integration: a seat whose forwards slow past the latency SLO
+    burns its budget; the router's poll folds that burn into the
+    seat's weight (degraded, under the enter bound) and traffic share
+    moves to the healthy sibling. Clearing the slowdown recovers the
+    weight through the hysteresis exit."""
+    monkeypatch.setenv("MXNET_TPU_SLO_WINDOW_SCALE", "0.01")
+    monkeypatch.setenv("MXNET_TPU_SLO_EVAL_S", "0.1")
+    monkeypatch.setenv("MXNET_TPU_SLO_LATENCY_MS", "30")
+    monkeypatch.setenv("MXNET_TPU_CANARY", "0")
+    monkeypatch.setenv("MXNET_TPU_FLIGHT_DIR", str(tmp_path))
+    slow_model = StubModel()
+    slow = ServingEngine(slow_model, bucket_lens=(16,), max_rows=2,
+                         engine_id="burn-slow")
+    fast = _stub_engine("burn-fast")
+    router = ServingRouter(engines=[slow, fast], poll_interval_s=0.15)
+    stop = threading.Event()
+    errors = []
+
+    def load():
+        rs = np.random.RandomState(3)
+        while not stop.is_set():
+            toks = rs.randint(1, 60, 6).astype(np.int32)
+            try:
+                router.submit(toks).result(timeout=30)
+            except Exception as e:
+                errors.append(repr(e))
+
+    threads = [threading.Thread(target=load, daemon=True,
+                                name=f"burn_load_{i}")
+               for i in range(4)]
+    with slow, fast, router:
+        for t in threads:
+            t.start()
+        try:
+            _wait(lambda: router.count("completed") > 8, what="traffic")
+            slow_model.delay = 0.08     # the hot-spot
+            _wait(lambda: (router.scoreboard()["burn-slow"]["weight"]
+                           < 0.7), timeout=60,
+                  what="the burning seat to shed weight")
+            # measured share moves: the slow seat serves a fraction
+            b0 = {k: v["dispatched"]
+                  for k, v in router.scoreboard().items()}
+            time.sleep(1.2)
+            b1 = {k: v["dispatched"]
+                  for k, v in router.scoreboard().items()}
+            d_slow = b1["burn-slow"] - b0["burn-slow"]
+            d_fast = b1["burn-fast"] - b0["burn-fast"]
+            assert d_fast > 2 * max(1, d_slow), (d_slow, d_fast)
+            slow_model.delay = 0.0      # recovery
+            _wait(lambda: (router.scoreboard()["burn-slow"]["weight"]
+                           >= 0.95), timeout=60,
+                  what="the seat weight to recover")
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=30)
+    assert not errors, errors[:5]
+
+
+# ---------------------------------------------------------------------------
+# router active/active HA
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def ha_pair():
+    """Two peered routers over one 2-engine fleet, both exposed, HA
+    links up. Yields (r_keep, r_kill, engines, urls)."""
+    import contextlib
+
+    with contextlib.ExitStack() as stack:
+        engines = [_stub_engine("ha-e0", delay=0.05),
+                   _stub_engine("ha-e1", delay=0.05)]
+        for eng in engines:
+            eng.start()
+            stack.callback(lambda e=eng: e.stop(drain=False))
+        fleet = {e.engine_id: e for e in engines}
+        r_keep = ServingRouter(engines=dict(fleet),
+                               poll_interval_s=0.15,
+                               router_id="ha-keep")
+        r_kill = ServingRouter(engines=dict(fleet),
+                               poll_interval_s=0.15,
+                               router_id="ha-kill")
+        stack.callback(lambda: r_kill.stop(drain=False))
+        stack.callback(lambda: r_keep.stop(drain=False))
+        ks = r_keep.expose()
+        xs = r_kill.expose()
+        keep_url = f"http://{ks.host}:{ks.port}"
+        kill_url = f"http://{xs.host}:{xs.port}"
+        r_keep.set_peer(kill_url)
+        r_kill.set_peer(keep_url)
+        r_keep.start()
+        r_kill.start()
+        _wait(lambda: r_keep._peer_alive and r_kill._peer_alive,
+              what="peer liveness")
+        _wait(lambda: (r_keep._peer is not None
+                       and r_keep._peer.has_live()
+                       and r_kill._peer is not None
+                       and r_kill._peer.has_live()),
+              what="journal links")
+        yield r_keep, r_kill, engines, (keep_url, kill_url)
+
+
+def test_ha_journal_and_release(ha_pair):
+    """Every admitted submit is journaled to the peer before dispatch
+    and released on completion — the peer's journal never outlives a
+    resolved request."""
+    r_keep, r_kill, _engines, _urls = ha_pair
+    fut = r_kill.submit([1, 2, 3], cid="cid-journal-1")
+    # journaled on the peer (ack-before-enqueue: already visible)
+    with r_keep._lock:
+        assert "cid-journal-1" in r_keep._journal
+    assert fut.result(timeout=30)[0, 0] == 1.0
+    _wait(lambda: "cid-journal-1" not in r_keep._journal,
+          what="release to reach the peer")
+
+
+def test_ha_adoption_on_router_death_zero_loss(ha_pair):
+    """The crash contract: r_kill dies with requests in flight; the
+    survivor adopts every journaled orphan front-of-queue, completes
+    it, and a client resubmitting its cid gets the SAME result
+    without duplicate admission."""
+    r_keep, r_kill, _engines, _urls = ha_pair
+    cids = [f"cid-adopt-{i}" for i in range(6)]
+    for cid in cids:
+        r_kill.submit([4, 5, 6, 7], cid=cid)   # in flight (50 ms model)
+    r_kill.die()
+    # the survivor declares the peer dead off its health poll and
+    # adopts the orphans
+    _wait(lambda: r_keep.count("adopted") >= 1, timeout=30,
+          what="orphan adoption")
+    _wait(lambda: all(cid in r_keep._adopted for cid in cids),
+          timeout=30, what="every orphan adopted")
+    # adopted requests complete on the survivor
+    for cid in cids:
+        out = r_keep._adopted[cid].result(timeout=30)
+        assert out[0, 0] == 4.0
+    # client resubmit attaches (dedupe), not duplicate work
+    before = r_keep.count("submitted")
+    fut = r_keep.submit([4, 5, 6, 7], cid=cids[0])
+    assert fut.result(timeout=30)[0, 0] == 4.0
+    assert r_keep.count("submitted") == before     # attached, not new
+    # the incident hold released: peer down -> adopted
+    from mxnet_tpu.telemetry import incidents
+    snap = incidents.snapshot()
+    mine = [r for r in snap["open"] + snap["recent"]
+            if any(f"peer:" in d for d in r.get("down_engines", []))]
+    for r in mine:
+        assert not r["down_engines"], r
+
+
+def test_ha_resubmit_before_death_detection(ha_pair):
+    """A client whose router died can resubmit IMMEDIATELY (before
+    the survivor's health poll notices): the cid is found in the
+    peer journal and consumed as an adoption — exactly-once."""
+    r_keep, r_kill, _engines, _urls = ha_pair
+    fut0 = r_kill.submit([9, 9, 9], cid="cid-fast-resubmit")
+    del fut0
+    with r_keep._lock:
+        assert "cid-fast-resubmit" in r_keep._journal
+    # no die() yet — the resubmit itself consumes the journal entry
+    fut = r_keep.submit([9, 9, 9], cid="cid-fast-resubmit")
+    assert fut.result(timeout=30)[0, 0] == 9.0
+    with r_keep._lock:
+        assert "cid-fast-resubmit" not in r_keep._journal
+
+
+# ---------------------------------------------------------------------------
+# autoscaler
+# ---------------------------------------------------------------------------
+
+def test_autoscaler_scale_up_hold_cooldown_and_down():
+    """Scripted-clock ladder: pressure must HOLD before a seat is
+    bought, the cooldown rate-limits, idle retires only
+    autoscaler-added seats down to min_seats."""
+    eng = _stub_engine("as-base")
+    router = ServingRouter(engines=[eng], poll_interval_s=30.0)
+    clock = [0.0]
+    made = []
+
+    def factory(engine_id):
+        e = _stub_engine(engine_id)
+        made.append(e)
+        return e
+
+    scaler = FleetAutoscaler(router, factory, min_seats=1, max_seats=3,
+                             burn_threshold=6.0, queue_high=50,
+                             hold_s=5.0, cooldown_s=30.0, idle_s=60.0,
+                             replace_s=3.0, clock=lambda: clock[0])
+    sig = {"burn": None, "queue": 0}
+    scaler._signals = lambda: (sig["burn"], sig["queue"],
+                               router.snapshot()["engines"])
+    with eng, router:
+        try:
+            assert scaler.evaluate_once() is None       # quiet fleet
+            sig["burn"] = 20.0                          # pressure on
+            assert scaler.evaluate_once() is None       # not held yet
+            clock[0] = 4.0
+            assert scaler.evaluate_once() is None
+            clock[0] = 6.0
+            rec = scaler.evaluate_once()                # held: buy
+            assert rec and rec["action"] == "scale_up"
+            assert rec["ttft_ms"] is not None
+            assert "auto1" in router.engine_ids()
+            clock[0] = 10.0
+            assert scaler.evaluate_once() is None       # cooldown
+            clock[0] = 50.0                             # pressure held
+            rec = scaler.evaluate_once()                # through the
+            assert rec and rec["action"] == "scale_up"  # cooldown: buy
+            assert len(router.engine_ids()) == 3        # at max now
+            clock[0] = 85.0
+            assert scaler.evaluate_once() is None       # max respected
+            sig["burn"] = 0.5                           # idle
+            sig["queue"] = 0
+            clock[0] = 100.0
+            assert scaler.evaluate_once() is None       # idle not held
+            clock[0] = 161.0
+            rec = scaler.evaluate_once()
+            assert rec and rec["action"] == "scale_down"
+            assert rec["engine_id"] == "auto2"          # LIFO retire
+            clock[0] = 230.0
+            assert scaler.evaluate_once() is None       # idle restarts
+            clock[0] = 292.0
+            rec = scaler.evaluate_once()
+            assert rec and rec["action"] == "scale_down"
+            assert router.engine_ids() == ["as-base"]   # min respected
+            clock[0] = 360.0
+            assert scaler.evaluate_once() is None
+        finally:
+            scaler.stop(stop_seats=True)
+
+
+def test_autoscaler_replaces_dead_seat_warm():
+    """A seat held unroutable past the debounce is replaced under the
+    same id with a manifest-warmed, TTFT-probed engine — on EVERY
+    router it fronts (active/active seat-state sharing)."""
+    e0 = _stub_engine("rep-e0")
+    e1 = _stub_engine("rep-e1")
+    r1 = ServingRouter(engines=[e0, e1], poll_interval_s=0.1,
+                       router_id="rep-r1")
+    r2 = ServingRouter(engines=[e0, e1], poll_interval_s=0.1,
+                       router_id="rep-r2")
+    spawned = []
+
+    def factory(engine_id):
+        e = _stub_engine(engine_id)
+        spawned.append(e)
+        return e
+
+    scaler = FleetAutoscaler([r1, r2], factory, min_seats=2,
+                             max_seats=3, interval_s=0.1,
+                             replace_s=0.3, cooldown_s=0.5,
+                             hold_s=1.0)
+    with e0, e1, r1, r2:
+        for eng in (e0, e1):
+            eng.warmup()        # visited shapes -> fleet manifest
+        _wait(lambda: (r1.snapshot()["manifest_shapes"] or 0) > 0,
+              what="fleet manifest collected")
+        scaler.start()
+        try:
+            e0.stop(drain=False)
+            rec = _wait(lambda: next(
+                (a for a in scaler.actions
+                 if a["action"] == "replace"
+                 and a["engine_id"] == "rep-e0"), None),
+                timeout=60, what="replacement")
+            assert rec["ttft_ms"] is not None
+            assert rec["manifest_shapes"] >= 1      # admitted WARM
+            for r in (r1, r2):
+                _wait(lambda r=r: r.scoreboard()
+                      .get("rep-e0", {}).get("routable"), timeout=30,
+                      what=f"replacement routable on {r.router_id}")
+            # the replacement actually serves
+            out = r1.submit([5, 5]).result(timeout=30)
+            assert out[0, 0] == 5.0
+        finally:
+            scaler.stop(stop_seats=True)

@@ -25,7 +25,9 @@ restart mid-run costs retries, not failed requests.
 """
 from __future__ import annotations
 
+import itertools
 import json
+import os
 import threading
 import time
 
@@ -202,7 +204,8 @@ def _canary_delta(before, after):
 
 
 def cross_check_costs(client_cost, before, after, slack=0,
-                      lost_ledgers=False, exclude=None):
+                      lost_ledgers=False, exclude=None,
+                      counters=None):
     """Reconcile client-side cost accounting (summed per-request
     ``future.cost`` bills) against the server cost-ledger DELTA:
     requests and tokens must match exactly, and the client's summed
@@ -228,12 +231,36 @@ def cross_check_costs(client_cost, before, after, slack=0,
     label-identified SYNTHETIC traffic from the ledger delta before
     comparing: canary probes are billed server-side but are not client
     requests, and without the exclusion a background prober would skew
-    the ≤5% device_s reconciliation. Returns
+    the ≤5% device_s reconciliation.
+
+    ``counters`` (the before/after PARSED ``/metrics`` snapshots, when
+    given) overrides the ``requests``/``valid_tokens`` deltas with the
+    ``mxnet_tpu_serving_cost_{requests,tokens}_total`` family sums —
+    the same ATOMIC scrape the canary-billed exclusion comes from, so
+    the two windows cannot skew (the separate ``/costs`` fetch sits
+    OUTSIDE the metrics window by the scrape wall time itself, and
+    with a live prober that edge otherwise leaks probe rounds past
+    the slack). ``request_s`` stays ledger-sourced (it has no exact
+    family) under its looser ≤5% bound. Returns
     (reconciled, mismatches, delta)."""
     if before is None or after is None:
         return None, ["/costs endpoint unavailable"], None
     delta = {k: after.get(k, 0) - before.get(k, 0)
              for k in ("request_s", "requests", "valid_tokens")}
+    if counters is not None:
+        from mxnet_tpu.telemetry.expo import parse_labels
+
+        sums = {"requests": 0.0, "valid_tokens": 0.0}
+        fam_of = {"mxnet_tpu_serving_cost_requests_total": "requests",
+                  "mxnet_tpu_serving_cost_tokens_total": "valid_tokens"}
+        for parsed, sign in ((counters[0], -1), (counters[1], 1)):
+            for key, val in (parsed or {}).items():
+                name, _labels = parse_labels(key)
+                field = fam_of.get(name)
+                if field is not None:
+                    sums[field] += sign * val
+        delta["requests"] = int(round(sums["requests"]))
+        delta["valid_tokens"] = int(round(sums["valid_tokens"]))
     if exclude:
         delta["request_s"] -= exclude.get("device_s", 0.0)
         delta["requests"] -= exclude.get("requests", 0)
@@ -296,10 +323,19 @@ class RouterClient:
     long-poll, with client-side failover. A router that refuses the
     connection or answers 5xx advances the request to the NEXT url;
     the first router that answers becomes sticky-preferred so a
-    healthy fleet pays zero extra probes. Only when every router in
-    the list refuses does the request fail (as
-    ``NoEngineAvailableError`` — the client's shed column).
-    ``failovers`` counts the client-observed advances."""
+    healthy fleet pays zero extra probes. When every router in the
+    list refuses, the SWEEP retries per the shared
+    :class:`~mxnet_tpu.retrying.RetryPolicy` (bridging a router
+    restart / HA-adoption window) before failing as
+    ``NoEngineAvailableError`` — the client's shed column.
+    ``failovers`` counts the client-observed advances.
+
+    Every request carries a client-minted HA correlation id
+    (``cid``): active/active routers journal it to their peer, so a
+    request re-driven to the next url after its first router DIED
+    mid-flight attaches to the survivor's adopted copy instead of
+    executing twice. A mid-request TIMEOUT still never fails over
+    (the first router may be alive and still executing)."""
 
     class _Future:
         """Lazy long-poll: the POST runs inside ``result()`` on the
@@ -315,7 +351,9 @@ class RouterClient:
         def result(self, timeout=None):
             return self._client._request(self, timeout)
 
-    def __init__(self, urls, timeout_s=600.0):
+    def __init__(self, urls, timeout_s=600.0, retry=None):
+        from mxnet_tpu.retrying import RetryPolicy
+
         urls = [u.strip().rstrip("/") for u in urls if u.strip()]
         if not urls:
             raise ValueError("no router URLs given")
@@ -325,6 +363,10 @@ class RouterClient:
         self._lock = threading.Lock()
         self.failovers = 0
         self._last_board = {}
+        self._retry = retry if retry is not None else RetryPolicy(
+            retries=2, backoff_s=0.15, max_backoff_s=1.0)
+        self._cid_base = f"cli-{os.getpid():x}-{id(self) & 0xffffff:x}"
+        self._cid_seq = itertools.count(1)
 
     def _order(self):
         with self._lock:
@@ -337,15 +379,47 @@ class RouterClient:
         payload = {"tokens": np.asarray(tokens).tolist(),
                    "token_types": (np.asarray(token_types).tolist()
                                    if token_types is not None else None),
-                   "deadline_ms": deadline_ms}
+                   "deadline_ms": deadline_ms,
+                   "cid": f"{self._cid_base}-{next(self._cid_seq)}"}
         return self._Future(self, payload)
 
     def _request(self, fut, timeout):
+        from mxnet_tpu.serving import NoEngineAvailableError
+
+        attempt = 0
+        while True:
+            done, out, last_err, last_body = self._sweep(fut, timeout)
+            if done:
+                return out
+            if attempt >= self._retry.retries:
+                break
+            # every url refused: back off per the shared policy and
+            # re-sweep — a router restart (or the HA survivor still
+            # adopting) is a window, not a verdict
+            self._retry.sleep_before_retry(attempt)
+            attempt += 1
+        # the last router-shaped error body (e.g. a single router
+        # answering "fleet down") still maps onto the serving
+        # taxonomy; with nothing parseable it's a client shed
+        if last_body is not None:
+            return self._deliver(fut, last_body)
+        raise NoEngineAvailableError(
+            f"every router url refused (last: {last_err})")
+
+    def _sweep(self, fut, timeout):
+        """One pass down the url list. Returns ``(done, result,
+        last_err, last_body)`` — ``done=True`` means ``result`` is
+        the delivered answer (or a raised exception escaped)."""
         import urllib.error
         import urllib.request
 
-        from mxnet_tpu.serving import NoEngineAvailableError, ServingError
+        from mxnet_tpu.serving import ServingError
 
+        # the server-side wait must not outlive the client's own:
+        # a router holding a handler thread 600 s for a client that
+        # gave up at 60 is a slow leak
+        fut._payload["timeout_s"] = (timeout if timeout is not None
+                                     else self._timeout)
         data = json.dumps(fut._payload).encode()
         last_err = None
         last_body = None
@@ -381,12 +455,14 @@ class RouterClient:
                 # the long-poll reply comes as one blob, so urlopen
                 # returning means the router ANSWERED; timing out here
                 # means it accepted the request and is still executing
-                # it — replaying on the next url would duplicate work
-                # (and double-bill the cost books). Only failures that
-                # mean the request reached no live router (connect
-                # refused / reset / dns / connect-phase timeout, which
-                # urllib wraps in URLError) advance down the url list;
-                # a BARE socket.timeout is the read phase.
+                # it — the payload's cid would dedupe a replay against
+                # an HA PEER, but the same (live) router would treat
+                # it as new work, so a BARE timeout still never fails
+                # over. Connection DEATH (refused / reset / dns —
+                # urllib wraps them in URLError) advances down the
+                # list: either the request never arrived, or the
+                # router died with it and the survivor's journal
+                # adoption + cid dedupe make the replay exactly-once.
                 if isinstance(e, TimeoutError):
                     raise ServingError(
                         f"{self.urls[i]}: timed out mid-request "
@@ -407,14 +483,8 @@ class RouterClient:
                         f"{self.urls[i]}: bad reply: {e!r}") from e
             with self._lock:
                 self._preferred = i
-            return self._deliver(fut, body)
-        # every url refused: the last router-shaped error body (e.g.
-        # a single router answering "fleet down") still maps onto the
-        # serving taxonomy; with nothing parseable it's a client shed
-        if last_body is not None:
-            return self._deliver(fut, last_body)
-        raise NoEngineAvailableError(
-            f"every router url refused (last: {last_err})")
+            return True, self._deliver(fut, body), None, None
+        return False, None, last_err, last_body
 
     def _deliver(self, fut, body):
         import numpy as np
@@ -759,13 +829,17 @@ def run_load(engine, n_clients=8, requests_per_client=16,
         # extra server-side requests is healthy, not a mismatch; with
         # a live prober, a probe billed inside the (wider) ledger
         # window whose canary counters landed outside the metrics
-        # window adds ledger-side-only requests the same way
-        cost_slack = outcomes["error"] + report.get("failovers", 0) \
-            + (2 if canary else 0)
+        # window adds ledger-side-only requests the same way — up to
+        # one in-flight probe ROUND (= one probe per seat) per edge
+        cost_slack = outcomes["error"] + report.get("failovers", 0)
+        if canary:
+            seats = len(report.get("per_engine") or {}) or 1
+            cost_slack += 2 * seats
         cost_ok, cost_mismatches, cost_delta = cross_check_costs(
             client_cost, costs_before, costs_after, slack=cost_slack,
             lost_ledgers=bool(report.get("restarts")),
-            exclude=canary["excluded"] if canary else None)
+            exclude=canary["excluded"] if canary else None,
+            counters=(before, after))
         if canary:
             report["canary"] = canary
         report["cost"] = {
@@ -1117,6 +1191,314 @@ def wedge_drill(router, gates, victim, pages_path,
             else None}
 
 
+def _wait_for(pred, timeout_s, what, poll_s=0.05):
+    """Poll ``pred`` until truthy; its last value. AssertionError on
+    timeout — the drill's one blocking primitive."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        v = pred()
+        if v:
+            return v
+        time.sleep(poll_s)
+    raise AssertionError(f"timed out after {timeout_s}s waiting for "
+                         f"{what}")
+
+
+def chaos_drill(r_keep, r_kill, urls, ctl, autoscaler, hotspot,
+                victim, n_clients=6, hot_ms=80.0, min_len=8,
+                max_len=24, vocab=1000, phase_timeout_s=90.0,
+                settle_s=1.5, poll_s=0.05, seed=0):
+    """The ROADMAP self-healing drill: under closed-loop load through
+    TWO active/active routers, inject three scripted faults and assert
+    the fleet re-converges each time with ZERO lost requests and one
+    correlated incident per fault.
+
+    - **hot-spot**: slow ``hotspot``'s forwards by ``hot_ms`` — the
+      seat's latency SLO burns, its canary latency drifts, and the
+      routers shed routing weight off it (asserted: weight drops
+      under the degraded bound AND its measured per-seat dispatch
+      share falls under half a fair share); clearing the fault
+      recovers the weight through the hysteresis exit.
+    - **seat kill**: abort ``victim`` — the autoscaler replaces it
+      under the same id with a manifest-warmed engine (asserted: a
+      ``replace`` action carrying a TTFT probe, the seat routable
+      again on BOTH routers).
+    - **router kill**: ``r_kill`` (the clients' sticky-preferred
+      router) dies abruptly — its journaled in-flight requests are
+      handed to ``r_keep`` (adoption on resubmit and/or peer-death
+      sweep; asserted: the HA adopt counter moved) and every client
+      request still completes.
+
+    The caller owns construction (see :func:`run_chaos_drill`) and
+    must have tuned the judging clocks for drill time scales
+    (``MXNET_TPU_SLO_WINDOW_SCALE`` etc.). ``ctl`` is a
+    :class:`~mxnet_tpu.serving.chaos.ChaosController` with every
+    engine and both routers registered. Raises AssertionError on any
+    violated contract; returns the report dict."""
+    import numpy as np
+
+    from mxnet_tpu.telemetry import incidents as _incidents
+    from mxnet_tpu.telemetry.registry import REGISTRY
+
+    client = RouterClient(urls)     # urls[0] = r_kill: clients prefer
+    # the router that will die, so its death strands real in-flights
+    stop = threading.Event()
+    lock = threading.Lock()
+    counts = {"attempts": 0, "ok": 0}
+    errors = []
+
+    def flooder(cidx):
+        rs = np.random.RandomState(seed + cidx)
+        while not stop.is_set():
+            n = int(rs.randint(min_len, max_len + 1))
+            toks = rs.randint(1, vocab, n).astype(np.int32)
+            with lock:
+                counts["attempts"] += 1
+            try:
+                client.submit(toks).result(timeout=phase_timeout_s)
+            except Exception as e:
+                with lock:
+                    errors.append(f"{type(e).__name__}: {e}")
+                time.sleep(0.01)
+                continue
+            with lock:
+                counts["ok"] += 1
+
+    threads = [threading.Thread(target=flooder, args=(c,), daemon=True,
+                                name=f"chaos_drill_client_{c}")
+               for c in range(n_clients)]
+
+    def seat_row(router, eid):
+        return router.scoreboard().get(eid) or {}
+
+    def incident_ids():
+        snap = _incidents.snapshot()
+        return ({r["id"] for r in snap["open"]},
+                {r["id"] for r in snap["open"]}
+                | {r["id"] for r in snap["recent"]})
+
+    def share_window(router, window_s):
+        """Per-seat dispatch share over a measured window."""
+        b0 = {eid: r.get("dispatched", 0)
+              for eid, r in router.scoreboard().items()}
+        time.sleep(window_s)
+        b1 = {eid: r.get("dispatched", 0)
+              for eid, r in router.scoreboard().items()}
+        delta = {eid: b1.get(eid, 0) - b0.get(eid, 0) for eid in b1}
+        total = max(1, sum(delta.values()))
+        return {eid: d / total for eid, d in delta.items()}, total
+
+    def ha_count(event):
+        fam = REGISTRY.get("mxnet_tpu_router_ha_total")
+        if fam is None:
+            return 0.0
+        return fam.labels(event=event).value
+
+    def adopt_count():
+        return ha_count("adopt")
+
+    report = {"phases": {}, "incidents": []}
+    seen0 = incident_ids()[1]
+    for t in threads:
+        t.start()
+    try:
+        # steady state: traffic flowing AND journaled to the peer (the
+        # death edge only hands off what was journaled before it)
+        _wait_for(lambda: counts["ok"] >= n_clients * 2,
+                  phase_timeout_s, "steady-state traffic")
+        _wait_for(lambda: ha_count("journal") > 0, phase_timeout_s,
+                  "submits to journal to the HA peer")
+
+        def phase_incident(name):
+            """One NEW incident opened for this fault, then closed."""
+            fresh = _wait_for(
+                lambda: (incident_ids()[1] - seen0
+                         - set(report["incidents"])) or None,
+                phase_timeout_s, f"{name}: a correlated incident")
+            try:
+                _wait_for(lambda: not incident_ids()[0],
+                          phase_timeout_s,
+                          f"{name}: incident closed after recovery")
+            except AssertionError as e:
+                held = [{k: r.get(k) for k in
+                         ("id", "firing", "down_engines", "counts")}
+                        for r in _incidents.snapshot()["open"]]
+                raise AssertionError(f"{e}; still held open by: "
+                                     f"{held}") from None
+            new = sorted(fresh)
+            report["incidents"].extend(new)
+            return new
+
+        # ---- phase A: induced hot-spot sheds routing weight --------------
+        fair = 1.0 / max(1, len(r_kill.engine_ids()))
+        ctl.apply({"fault": "hotspot", "target": hotspot, "ms": hot_ms})
+        _wait_for(lambda: (seat_row(r_kill, hotspot).get("weight", 1.0)
+                           < 0.7), phase_timeout_s,
+                  f"hot seat {hotspot} to shed routing weight")
+        shares, n_window = share_window(r_kill, settle_s)
+        hot_share = shares.get(hotspot, 0.0)
+        weight_min = seat_row(r_kill, hotspot).get("weight")
+        assert hot_share < 0.5 * fair, (
+            f"hot-spot share did not move: {hotspot} still serves "
+            f"{hot_share:.0%} (fair {fair:.0%}) over {n_window} reqs")
+        ctl.clear({"fault": "hotspot", "target": hotspot})
+        _wait_for(lambda: (seat_row(r_kill, hotspot).get("weight", 0.0)
+                           >= 0.95), phase_timeout_s,
+                  f"{hotspot} weight to recover after the fault")
+        report["phases"]["hotspot"] = {
+            "target": hotspot, "weight_min": weight_min,
+            "fair_share": round(fair, 3),
+            "hot_share": round(hot_share, 3),
+            "window_requests": n_window,
+            "incident": phase_incident("hotspot")}
+
+        # ---- phase B: seat kill -> autoscaler replacement, warm ----------
+        n_actions = len(autoscaler.actions)
+        ctl.apply({"fault": "kill_engine", "target": victim})
+        rec = _wait_for(
+            lambda: next((a for a in autoscaler.actions[n_actions:]
+                          if a["action"] == "replace"
+                          and a["engine_id"] == victim), None),
+            phase_timeout_s, f"autoscaler to replace {victim}")
+        assert rec.get("ttft_ms") is not None, rec
+        assert rec.get("manifest_shapes", 0) >= 1, (
+            f"replacement admitted COLD (no manifest replay): {rec}")
+        for router in (r_keep, r_kill):
+            _wait_for(lambda r=router: seat_row(r, victim)
+                      .get("routable"), phase_timeout_s,
+                      f"replacement {victim} routable on "
+                      f"{router.router_id}")
+        report["phases"]["seat_kill"] = {
+            "victim": victim, "ttft_ms": rec["ttft_ms"],
+            "manifest_shapes": rec["manifest_shapes"],
+            "incident": phase_incident("seat_kill")}
+
+        # ---- phase C: router kill -> in-flight handoff -------------------
+        adopt0 = adopt_count()
+        ctl.apply({"fault": "kill_router", "target": r_kill.router_id})
+        _wait_for(lambda: adopt_count() > adopt0, phase_timeout_s,
+                  "the survivor to adopt orphaned in-flight requests")
+        # traffic must keep completing through the survivor
+        ok0 = counts["ok"]
+        _wait_for(lambda: counts["ok"] >= ok0 + n_clients,
+                  phase_timeout_s, "traffic to re-converge on the "
+                  "surviving router")
+        report["phases"]["router_kill"] = {
+            "killed": r_kill.router_id,
+            "adopted": int(adopt_count() - adopt0),
+            "client_failovers": client.failovers,
+            "incident": phase_incident("router_kill")}
+
+        # ---- re-convergence: SLO compliance, quiet alert table -----------
+        def quiet():
+            body = r_keep.alerts_snapshot()
+            return (body.get("fleet_firing", body.get("firing", 0)) == 0
+                    and not incident_ids()[0])
+        _wait_for(quiet, phase_timeout_s,
+                  "the fleet to re-converge to SLO compliance")
+    finally:
+        stop.set()
+        # past the per-request timeout: a stuck request must surface
+        # as ITS error (naming where it hung), never a silent count
+        for t in threads:
+            t.join(timeout=phase_timeout_s + 15.0)
+
+    # zero lost requests: every attempt completed (failover, adoption
+    # and cid dedupe mean no client-visible error anywhere in the run)
+    assert not errors, f"lost/errored requests: {errors[:8]}"
+    assert counts["ok"] == counts["attempts"], counts
+    # convergence detail: "met" judges the whole (scaled) budget
+    # window — which CONTAINS the induced faults by design — so the
+    # re-convergence signal is the short-window burn back under
+    # sustainable, plus the quiet alert table asserted above
+    slo = r_keep.slo_snapshot()
+    report["slo"] = {name: {"met": row.get("met"),
+                            "burn_5m":
+                                (row.get("burn_rates") or {}).get("5m"),
+                            "error_budget_remaining":
+                                row.get("error_budget_remaining")}
+                     for name, row in
+                     (slo.get("objectives") or {}).items()}
+    report["attempts"] = counts["attempts"]
+    report["completed"] = counts["ok"]
+    report["lost"] = counts["attempts"] - counts["ok"]
+    report["client_failovers"] = client.failovers
+    assert len(report["incidents"]) >= 3, report["incidents"]
+    return report
+
+
+def run_chaos_drill(make_engine, n_engines=3, n_clients=6,
+                    hot_ms=80.0, phase_timeout_s=90.0, vocab=1000,
+                    min_len=8, max_len=24):
+    """Build the two-router active/active chaos fleet and run
+    :func:`chaos_drill` over it: ``n_engines`` warmed engines fronted
+    by two peered routers (both exposed over HTTP), a
+    :class:`~mxnet_tpu.serving.FleetAutoscaler` spanning both (peers
+    share seat state through it), and a chaos controller with
+    everything registered. Used by ``--drill-chaos``, the
+    ``bert_serving_chaos`` bench leg and the tier-1 drill test."""
+    import contextlib
+
+    from mxnet_tpu.serving import FleetAutoscaler, ServingRouter
+    from mxnet_tpu.serving.chaos import ChaosController
+
+    if n_engines < 3:
+        raise ValueError("chaos drill needs >= 3 engines (hot-spot, "
+                         "kill victim, and a healthy witness)")
+    with contextlib.ExitStack() as stack:
+        engines = [make_engine(f"e{i}") for i in range(n_engines)]
+        for eng in engines:
+            eng.start()
+
+            def _safe_stop(e=eng):
+                try:
+                    e.stop(drain=False, timeout=10.0)
+                except Exception:
+                    pass
+            stack.callback(_safe_stop)
+            eng.warmup()
+        fleet = {eng.engine_id: eng for eng in engines}
+        r_keep = ServingRouter(engines=dict(fleet),
+                               poll_interval_s=0.2,
+                               router_id="r-keep")
+        r_kill = ServingRouter(engines=dict(fleet),
+                               poll_interval_s=0.2,
+                               router_id="r-kill")
+        stack.callback(lambda: r_kill.stop(drain=False))
+        stack.callback(lambda: r_keep.stop(drain=False))
+        keep_srv = r_keep.expose()
+        kill_srv = r_kill.expose()
+        keep_url = f"http://{keep_srv.host}:{keep_srv.port}"
+        kill_url = f"http://{kill_srv.host}:{kill_srv.port}"
+        r_keep.set_peer(kill_url)
+        r_kill.set_peer(keep_url)
+        r_keep.start()
+        r_kill.start()
+        ctl = ChaosController(schedule=None)
+        stack.callback(ctl.stop)
+        for eng in engines:
+            ctl.register_engine(eng)
+        ctl.register_router(r_keep)
+        ctl.register_router(r_kill)
+        autoscaler = FleetAutoscaler(
+            [r_keep, r_kill], make_engine, interval_s=0.25,
+            replace_s=0.5, cooldown_s=1.0, hold_s=1.0,
+            min_seats=n_engines, max_seats=n_engines + 1)
+        stack.callback(lambda: autoscaler.stop(stop_seats=True))
+        autoscaler.start()
+        # both routers must see the peer alive BEFORE any kill: the
+        # death EDGE (alive -> dead) is what triggers adoption
+        _wait_for(lambda: r_keep._peer_alive and r_kill._peer_alive,
+                  30.0, "the routers to see each other alive")
+        return chaos_drill(
+            r_keep, r_kill, [kill_url, keep_url], ctl, autoscaler,
+            hotspot=engines[1].engine_id,
+            victim=engines[0].engine_id,
+            n_clients=n_clients, hot_ms=hot_ms, vocab=vocab,
+            min_len=min_len, max_len=max_len,
+            phase_timeout_s=phase_timeout_s)
+
+
 def _main():
     import argparse
     import os
@@ -1179,6 +1561,20 @@ def _main():
     ap.add_argument("--pages", default=None, metavar="FILE",
                     help="file-sink path for --drill-wedge page "
                     "notifications (default: a temp file, printed)")
+    ap.add_argument("--drill-chaos", action="store_true",
+                    help="the self-healing chaos drill: 3+ engines "
+                    "behind TWO active/active routers under load; "
+                    "inject a hot-spot (routing weight must shed off "
+                    "the slow seat), a seat kill (the autoscaler must "
+                    "replace it manifest-warm) and a router kill "
+                    "(the survivor must adopt the in-flight "
+                    "requests) — asserts SLO re-convergence, one "
+                    "correlated incident per fault and ZERO lost "
+                    "requests. Tune the judging clocks first, e.g. "
+                    "MXNET_TPU_SLO_WINDOW_SCALE=0.01 "
+                    "MXNET_TPU_SLO_EVAL_S=0.2 "
+                    "MXNET_TPU_SLO_LATENCY_MS=40 "
+                    "MXNET_TPU_CANARY_INTERVAL_S=0.2")
     ap.add_argument("--drill-overload", nargs="?", const="auto",
                     default=None, metavar="ALERT",
                     help="instead of the measured run, flood the "
@@ -1217,6 +1613,41 @@ def _main():
         return ServingEngine(model, bucket_lens=buckets,
                              max_rows=args.max_rows, pool=args.pool,
                              engine_id=engine_id)
+
+    if args.drill_chaos:
+        from mxnet_tpu import envvars
+        if not envvars.get("MXNET_TPU_SLO"):
+            ap.error("--drill-chaos needs the SLO engine "
+                     "(MXNET_TPU_SLO=1)")
+        if not envvars.get("MXNET_TPU_ROUTER_HA"):
+            ap.error("--drill-chaos needs router HA "
+                     "(MXNET_TPU_ROUTER_HA=1)")
+        # the induced hot-spot must push the seat WELL past the
+        # configured latency objective, or only the relative signals
+        # shed weight and no page (= no incident) ever fires
+        hot_ms = max(80.0, 2.5 * float(
+            envvars.get("MXNET_TPU_SLO_LATENCY_MS")))
+        report = run_chaos_drill(
+            make_engine, n_engines=max(3, args.router or 3),
+            n_clients=args.clients, vocab=args.vocab, hot_ms=hot_ms,
+            min_len=args.min_len,
+            max_len=min(args.max_len, max(buckets)))
+        print(json.dumps(report, indent=2))
+        ph = report["phases"]
+        print("# chaos drill OK: hot-spot shed "
+              f"{ph['hotspot']['target']} to weight "
+              f"{ph['hotspot']['weight_min']} (share "
+              f"{ph['hotspot']['hot_share']:.0%} vs fair "
+              f"{ph['hotspot']['fair_share']:.0%}); "
+              f"seat {ph['seat_kill']['victim']} replaced warm "
+              f"(ttft {ph['seat_kill']['ttft_ms']} ms, "
+              f"{ph['seat_kill']['manifest_shapes']} shapes); "
+              f"router {ph['router_kill']['killed']} killed, "
+              f"{ph['router_kill']['adopted']} in-flight adopted; "
+              f"{len(report['incidents'])} incidents, "
+              f"{report['completed']}/{report['attempts']} "
+              "completed, zero lost", file=sys.stderr)
+        return 0
 
     with contextlib.ExitStack() as stack:
         metrics_url = None

@@ -217,14 +217,17 @@ def dump_fleet(base, out=None, top=5):
           f"{stats.get('engines_total', len(engines))} engines up, "
           f"router queue {stats.get('queue_depth')}, pending "
           f"{stats.get('pending')} " + "-" * 10, file=out)
-    print(f"  {'engine':<16} {'kind':<7} {'up':<5} {'outst':>6} "
+    print(f"  {'engine':<16} {'kind':<7} {'up':<5} {'wgt':>5} "
+          f"{'outst':>6} "
           f"{'queue':>6} {'qps':>8} {'p95 ms':>9} {'dispatched':>11} "
           f"{'shapes':>7} last_error", file=out)
     for eid, row in sorted(engines.items()):
         p95 = row.get("p95_ms")
         shapes = row.get("manifest_shapes")
+        weight = row.get("weight")
         print(f"  {eid:<16} {row.get('kind', '?'):<7} "
               f"{str(bool(row.get('routable'))):<5} "
+              f"{(f'{weight:.2f}' if weight is not None else '-'):>5} "
               f"{row.get('outstanding', 0):>6} "
               f"{row.get('queue_depth') if row.get('queue_depth') is not None else '-':>6} "
               f"{row.get('qps', 0):>8} "
