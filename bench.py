@@ -1266,6 +1266,192 @@ def main_serving_router():
                 / max(1e-9, wire_ab["dispatch_overhead_p50_ms"]), 2))
 
 
+def main_decode_serving():
+    """Autoregressive decode serving bench (`lm_decode_serving`): a
+    paged-KV causal LM behind the continuous-batching
+    ``DecodeEngine``, streamed tokens end to end.
+
+    Three phases in one leg:
+
+    1. **Headline (router-fronted):** BENCH_ROUTER_ENGINES decode
+       engines behind a ``ServingRouter``; closed-loop clients consume
+       token STREAMS. Reports generated tokens/s, client-observed TTFT
+       and inter-token p50/p99, peak KV-page occupancy, slot churn
+       (joins/leaves), and the server-side reconciliation (requests +
+       cost ledger with canary exclusion). Every stream is verified
+       byte-identical to its final result.
+    2. **Iteration-level vs STATIC batching A/B at equal rows:** the
+       same traffic against one engine scheduling Orca-style
+       (joins at any iteration boundary) vs classic cohort batching
+       (joins only into an empty batch). Iteration-level must WIN on
+       tokens/s — with varied generation lengths the static cohort
+       idles finished slots until its longest member drains.
+    3. **Wire-vs-JSON streamed dispatch A/B:** one engine
+       remote-fronted; the same streamed traffic once over partial
+       RESULT frames on the binary wire, once over chunked-JSON-lines
+       HTTP. The wire must win serialized bytes/request.
+    """
+    _setup_cache()
+
+    import contextlib
+
+    from mxnet_tpu.serving import (DecodeEngine, PagedCausalLM,
+                                   ServingRouter)
+
+    tools_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "tools")
+    if tools_dir not in sys.path:
+        sys.path.insert(0, tools_dir)
+    from serve_loadgen import run_decode_load
+
+    vocab = int(os.environ.get("BENCH_VOCAB", "2048"))
+    units = int(os.environ.get("BENCH_DECODE_UNITS", "128"))
+    layers = int(os.environ.get("BENCH_DECODE_LAYERS", "2"))
+    heads = int(os.environ.get("BENCH_DECODE_HEADS", "4"))
+    max_len = int(os.environ.get("BENCH_DECODE_MAXLEN", "256"))
+    max_new = int(os.environ.get("BENCH_DECODE_NEW", "24"))
+    rows = int(os.environ.get("BENCH_SERVE_ROWS", "8"))
+    clients = int(os.environ.get("BENCH_SERVE_CLIENTS", "8"))
+    reqs = int(os.environ.get("BENCH_SERVE_REQS", "4"))
+    n_engines = int(os.environ.get("BENCH_ROUTER_ENGINES", "2"))
+    buckets = (16, 64)
+
+    def make_engine(eid, iteration_level=True):
+        lm = PagedCausalLM(vocab=vocab, units=units, layers=layers,
+                           heads=heads, max_len=max_len, seed=0)
+        return DecodeEngine(lm, prefill_bucket_lens=buckets,
+                            max_rows=rows, max_new_tokens=max_new,
+                            iteration_level=iteration_level,
+                            engine_id=eid)
+
+    load_kw = dict(n_clients=clients, requests_per_client=reqs,
+                   min_prompt=8, max_prompt=max(buckets), vocab=vocab,
+                   min_new=max(2, max_new // 4), max_new=max_new)
+
+    # -- phase 1: headline, router-fronted streamed decode ------------------
+    with contextlib.ExitStack() as stack:
+        engines = [stack.enter_context(make_engine(f"e{i}"))
+                   for i in range(n_engines)]
+        for eng in engines:
+            eng.warmup()
+        router = stack.enter_context(ServingRouter(engines=engines))
+        metrics_url = router.expose().url("/metrics")
+        # one throwaway pass (page caches, thread spin-up), then a
+        # fresh measurement window
+        run_decode_load(router, n_clients=min(4, clients),
+                        requests_per_client=1, min_prompt=8,
+                        max_prompt=max(buckets), vocab=vocab,
+                        min_new=2, max_new=4)
+        for eng in engines:
+            eng.reset_stats()
+        report = run_decode_load(router, metrics_url=metrics_url,
+                                 watch_engines=engines, **load_kw)
+    assert report["completed"] == clients * reqs, report
+    assert report["stream_mismatches"] == 0, report
+    server = report.get("server", {})
+    assert server.get("reconciled", True), server
+
+    # -- phase 2: iteration-level vs static batching, equal rows ------------
+    ab = {}
+    for mode, iteration_level in (("iteration", True), ("static", False)):
+        with make_engine(f"ab_{mode}",
+                         iteration_level=iteration_level) as eng:
+            eng.warmup()
+            rep = run_decode_load(eng, watch_engines=[eng], **load_kw)
+        assert rep["completed"] == clients * reqs, (mode, rep)
+        assert rep["stream_mismatches"] == 0, (mode, rep)
+        ab[mode] = {"tokens_per_sec": rep["tokens_per_sec"],
+                    "ttft_p50_ms": rep["ttft_p50_ms"],
+                    "inter_token_p99_ms": rep["inter_token_p99_ms"],
+                    "kv_occupancy_peak": rep.get("kv_occupancy_peak"),
+                    "slot_utilization":
+                        rep["engine"]["decode"]["slot_utilization"]}
+    # the acceptance bar: joins at iteration boundaries keep slots
+    # busy; the static cohort idles finished rows until its longest
+    # member drains
+    assert (ab["iteration"]["tokens_per_sec"]
+            > ab["static"]["tokens_per_sec"]), ab
+
+    # -- phase 3: wire-vs-JSON streamed dispatch A/B ------------------------
+    from mxnet_tpu.serving.metrics import wire_bytes_counter
+
+    byt = wire_bytes_counter()
+
+    def _bytes(transport):
+        return sum(byt.labels(side="router", transport=transport,
+                              direction=d).value for d in ("in", "out"))
+
+    wire_ab = {}
+    with make_engine("w0") as eng:
+        srv = eng.expose(port=0)
+        url = f"http://{srv.host}:{srv.port}"
+        eng.warmup()
+        for transport, wire_flag in (("wire", True), ("json", False)):
+            router = ServingRouter({"w0": url}, wire=wire_flag,
+                                   poll_interval_s=0.2)
+            with router:
+                if wire_flag:
+                    deadline = time.perf_counter() + 15.0
+                    while time.perf_counter() < deadline and not all(
+                            row.get("transport") == "wire"
+                            for row in router.scoreboard().values()):
+                        time.sleep(0.1)
+                    assert all(row.get("transport") == "wire"
+                               for row in router.scoreboard().values()), \
+                        router.scoreboard()
+                b0 = _bytes(transport)
+                rep = run_decode_load(router, n_clients=min(4, clients),
+                                      requests_per_client=2,
+                                      min_prompt=8,
+                                      max_prompt=max(buckets),
+                                      vocab=vocab,
+                                      min_new=max(2, max_new // 4),
+                                      max_new=max_new)
+                nbytes = _bytes(transport) - b0
+                assert rep["completed"] == min(4, clients) * 2, rep
+                assert rep["stream_mismatches"] == 0, rep
+                over = router.snapshot()["dispatch_overhead"] \
+                    .get(transport) or {}
+                wire_ab[transport] = {
+                    "tokens_per_sec": rep["tokens_per_sec"],
+                    "inter_token_p99_ms": rep["inter_token_p99_ms"],
+                    "dispatch_overhead_p50_ms": over.get("p50_ms"),
+                    "bytes_per_request": round(
+                        nbytes / max(1, rep["completed"]), 1)}
+    # the decode-transport bar: what the transport costs ON TOP of the
+    # engine's generation wall. (Bytes are reported but not asserted:
+    # per-token payloads are tiny dicts either way — the binary wire's
+    # decode win is latency/overhead, unlike the encoder leg where raw
+    # ndarray framing also wins the byte count.)
+    assert (wire_ab["wire"]["dispatch_overhead_p50_ms"]
+            < wire_ab["json"]["dispatch_overhead_p50_ms"]), wire_ab
+
+    cost = report.get("cost", {})
+    _report("lm_decode_serving_tokens_per_sec",
+            report["tokens_per_sec"], "tokens/sec", 0.0,
+            clients=clients, engines=n_engines, batch=rows,
+            requests=report["completed"],
+            generated_tokens=report["generated_tokens"], dtype=DTYPE,
+            p50_ms=report["p50_ms"], p99_ms=report["p99_ms"],
+            ttft_p50_ms=report["ttft_p50_ms"],
+            ttft_p95_ms=report["ttft_p95_ms"],
+            inter_token_p50_ms=report["inter_token_p50_ms"],
+            inter_token_p99_ms=report["inter_token_p99_ms"],
+            kv_occupancy=report.get("kv_occupancy_peak"),
+            churn=report.get("churn"),
+            per_engine=report.get("per_engine"),
+            stream_mismatches=report["stream_mismatches"],
+            static_tokens_per_sec=ab["static"]["tokens_per_sec"],
+            iteration_speedup=round(
+                ab["iteration"]["tokens_per_sec"]
+                / max(1e-9, ab["static"]["tokens_per_sec"]), 3),
+            decode_ab=ab, wire=wire_ab["wire"], json=wire_ab["json"],
+            telemetry_reconciled=server.get("reconciled"),
+            cost_reconciled=cost.get("reconciled"),
+            device_s_per_1k_tokens=cost.get("device_s_per_1k_tokens"),
+            slo_compliance=_slo_compliance(report))
+
+
 def main_serving_restart():
     """Rolling-restart serving drill (the warm-restart acceptance
     leg): BENCH_ROUTER_ENGINES (default 2) engines behind a router
@@ -1631,6 +1817,11 @@ _SUITE = (
     # 2 engines behind the front-door router: req/s, per-engine share,
     # failover count, aggregated-/metrics reconciliation
     ("bert_serving_router", "serving_router", {"BENCH_WINDOWS": "1"}),
+    # autoregressive DECODE serving: paged-KV causal LM, iteration-
+    # level continuous batching, streamed tokens router-fronted —
+    # tokens/s + TTFT + inter-token p50/p99 + KV occupancy + churn,
+    # with the iteration-vs-static and wire-vs-JSON A/Bs inline
+    ("lm_decode_serving", "decode_serving", {"BENCH_WINDOWS": "1"}),
     # rolling-restart drill: kill an engine mid-load, cold vs warm
     # (manifest-replay) time-to-first-token, zero-loss failover
     ("bert_serving_restart", "serving_restart", {"BENCH_WINDOWS": "1"}),
@@ -1679,7 +1870,10 @@ _SUMMARY_KEYS = ("metric", "value", "unit", "mfu", "hbm_frac", "hbm_est",
                  "profile_top", "cost_reconciled",
                  "device_s_per_1k_tokens", "slo_compliance",
                  "weight_min", "hot_share", "manifest_shapes",
-                 "adopted", "incidents")
+                 "adopted", "incidents", "ttft_p50_ms",
+                 "inter_token_p50_ms", "inter_token_p99_ms",
+                 "kv_occupancy", "churn", "stream_mismatches",
+                 "static_tokens_per_sec", "iteration_speedup")
 
 
 def _compact(rec):
@@ -1817,6 +2011,8 @@ def _dispatch():
         main_bert()
     elif _model == "causal_lm":
         main_causal_lm()
+    elif _model == "decode_serving":
+        main_decode_serving()
     elif _model == "serving":
         main_serving()
     elif _model == "serving_router":

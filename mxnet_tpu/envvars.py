@@ -237,6 +237,40 @@ register("MXNET_TPU_WIRE_HTTP_POOL", "int", 8,
          "request shape could thread-bomb under load spikes)",
          scope="wire")
 
+# -- decode serving: paged KV cache + continuous decode batching ------------
+register("MXNET_TPU_KV_PAGE_SIZE", "int", 16,
+         "tokens per paged-KV-cache page (``serving/kvcache.py``): the "
+         "allocation granule of the decode engine's attention memory; "
+         "multiples of 8 keep the page a whole sublane tile on TPU",
+         scope="decode")
+register("MXNET_TPU_KV_PAGES", "int", 256,
+         "paged-KV-cache pool capacity in pages, preallocated per "
+         "layer at engine start (+1 internal scratch page); an "
+         "exhausted pool defers decode joins instead of failing them",
+         scope="decode")
+register("MXNET_TPU_DECODE_ROWS", "int", 8,
+         "decode-batch slot cap (``DecodeEngine`` default max "
+         "concurrent sequences; row counts quantize to powers of two "
+         "up to this, one compiled step per (rows, table-width) "
+         "bucket)", scope="decode")
+register("MXNET_TPU_DECODE_MAX_NEW_TOKENS", "int", 64,
+         "default generation cap for decode requests that bring no "
+         "``max_new_tokens`` of their own", scope="decode")
+register("MXNET_TPU_DECODE_DONATE", "bool", True,
+         "thread ``jax.jit(..., donate_argnums=...)`` through the "
+         "decode/prefill steps so the KV page pool updates in place "
+         "(no per-step cache-sized allocation); ``0`` copies — the "
+         "A/B knob for the donation win", scope="decode")
+register("MXNET_TPU_DECODE_PREFILLS_PER_ITER", "int", 1,
+         "prompt prefills admitted per decode-loop iteration: bounds "
+         "how long the running decode batch can stall behind prefill "
+         "work (the prefill/decode split-scheduling knob)",
+         scope="decode")
+register("MXNET_TPU_SLO_INTER_TOKEN_MS", "float", 250.0,
+         "decode inter-token latency bound for the default "
+         "``decode_inter_token`` LatencySLO (p-target reuses "
+         "``MXNET_TPU_SLO_LATENCY_TARGET``)", scope="slo")
+
 # -- telemetry: events / spans ----------------------------------------------
 register("MXNET_TPU_EVENT_LOG", "path", None,
          "structured JSONL run-event log path (a directory gets one "
@@ -561,6 +595,7 @@ _SCOPE_TITLES = OrderedDict([
     ("kernels", "Pallas kernels"),
     ("dist", "Distributed"),
     ("wire", "Serving dispatch wire"),
+    ("decode", "Decode serving (paged KV cache + continuous batching)"),
     ("telemetry", "Telemetry / observability"),
     ("slo", "SLOs & alerting"),
     ("routing", "SLO-aware routing"),

@@ -883,3 +883,181 @@ def _flash_vjp_bwd(sm_scale, causal, q_offset, interpret, res, do):
 
 
 flash_attention.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+# -- paged KV decode path ---------------------------------------------------
+# The packed path above requires Sq == Skv (self attention over one
+# packed row). Autoregressive DECODE is the opposite shape: one (or a
+# small chunk of) query token(s) per sequence against a long per-
+# sequence KV history that lives in a PAGED pool (serving/kvcache.py —
+# the vLLM layout: fixed-size pages, per-sequence page tables). This
+# kernel lifts the restriction for that case: K/V are read THROUGH the
+# page table — the table rides as a scalar-prefetch operand so each
+# (batch row, head, logical page) grid step DMAs exactly the physical
+# page it needs — with per-row ``kv_len`` masking and a whole-page
+# skip for table slots at/after each row's length. Forward-only by
+# design (decode is inference; the training path keeps the packed
+# kernel above).
+
+def _paged_fwd_kernel(tbl_ref, kvl_ref, q_ref, k_ref, v_ref, o_ref,
+                      acc_sc, m_sc, l_sc, *, sq, page_size, block_q,
+                      precision):
+    b, j = pl.program_id(0), pl.program_id(2)
+    npages = pl.num_programs(2)
+    kvl = kvl_ref[b]
+
+    @pl.when(j == 0)
+    def _():
+        acc_sc[:] = jnp.zeros_like(acc_sc)
+        m_sc[:] = jnp.full_like(m_sc, _NEG_INF)
+        l_sc[:] = jnp.zeros_like(l_sc)
+
+    # whole-page skip: a table slot at/after ceil(kvl / page_size) holds
+    # padding (or a recycled page) — no MXU work, no pollution
+    @pl.when(j * page_size < kvl)
+    def _():
+        q = q_ref[0, 0].astype(jnp.float32)          # (block_q, d)
+        k = k_ref[0, 0].astype(jnp.float32)          # (page_size, d)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=precision)
+        col = j * page_size + lax.broadcasted_iota(
+            jnp.int32, (block_q, page_size), 1)
+        row = lax.broadcasted_iota(jnp.int32, (block_q, page_size), 0)
+        # q chunk row i sits at global position kvl - sq + i (the chunk
+        # is the TAIL of the sequence, already written to the pages):
+        # causal decode masks cols past that position; col < kvl also
+        # bounds q pad rows (block_q >= sq) to written history only
+        mask = jnp.logical_and(col <= kvl - np.int32(sq) + row,
+                               col < kvl)
+        s = jnp.where(mask, s, np.float32(_NEG_INF))
+        m_prev = m_sc[:]
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        seen = m_cur > np.float32(_NEG_INF / 2)
+        alpha = jnp.where(seen, jnp.exp(m_prev - m_cur), np.float32(0.0))
+        p = jnp.where(seen, jnp.exp(s - m_cur), np.float32(0.0))
+        l_sc[:] = l_sc[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        v = v_ref[0, 0].astype(jnp.float32)
+        acc_sc[:] = acc_sc[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=precision)
+        m_sc[:] = m_cur
+
+    @pl.when(j == npages - 1)
+    def _():
+        l = l_sc[:]
+        l_safe = jnp.where(l == np.float32(0.0), np.float32(1.0), l)
+        o_ref[0, 0] = (acc_sc[:] / l_safe).astype(o_ref.dtype)
+
+
+@x32
+def paged_flash_attention(q, k_pages, v_pages, page_table, kv_lens,
+                          sm_scale=None, interpret=None):
+    """Decode-path flash attention over a paged KV pool.
+
+    Shapes::
+
+        q          (B, H, Sq, D)   the last Sq tokens of each sequence
+                                   (Sq=1 steady-state decode; small Sq
+                                   for chunked prefill)
+        k_pages    (P, H, page_size, D)   the pool (all sequences)
+        v_pages    (P, H, page_size, D)
+        page_table (B, NP) int32   per-row physical page ids, padded
+                                   with any in-range id past the row's
+                                   ceil(kv_len / page_size) pages
+        kv_lens    (B,) int32      per-row written history length,
+                                   INCLUDING the Sq query tokens
+
+    K/V are gathered through the page table inside the kernel (the
+    table is a scalar-prefetch operand driving the page DMA index
+    map); columns at/after each row's ``kv_len`` are masked and whole
+    dead pages are skipped. Causal within the chunk: q row ``i`` sees
+    positions ``<= kv_len - Sq + i``. Rows whose ``kv_len`` is 0 emit
+    exact zeros. Forward-only (inference); differentiation is
+    unsupported by design.
+    """
+    b, h, sq, d = q.shape
+    p_, hk, page_size, dk = k_pages.shape
+    if (hk, dk) != (h, d) or v_pages.shape != k_pages.shape:
+        raise ValueError(
+            f"page pool shape {k_pages.shape}/{v_pages.shape} does not "
+            f"match q heads/dim ({h}, {d})")
+    if page_table.ndim != 2 or page_table.shape[0] != b:
+        raise ValueError(
+            f"page_table must be (B={b}, NP), got {page_table.shape}")
+    if sm_scale is None:
+        sm_scale = 1.0 / (d ** 0.5)
+    npages = page_table.shape[1]
+    block_q = _pad_len(sq, 8)
+    qf = (q * sm_scale).astype(q.dtype)
+    if block_q != sq:
+        qf = _pad0(qf, ((0, 0), (0, 0), (0, block_q - sq), (0, 0)))
+    kern = functools.partial(
+        _paged_fwd_kernel, sq=sq, page_size=page_size, block_q=block_q,
+        precision=_dot_precision(q.dtype))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, h, npages),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda b_, h_, j, tbl, kvl: (b_, h_, 0, 0)),
+            pl.BlockSpec((1, 1, page_size, d),
+                         lambda b_, h_, j, tbl, kvl: (tbl[b_, j], h_,
+                                                      0, 0)),
+            pl.BlockSpec((1, 1, page_size, d),
+                         lambda b_, h_, j, tbl, kvl: (tbl[b_, j], h_,
+                                                      0, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, block_q, d),
+            lambda b_, h_, j, tbl, kvl: (b_, h_, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+        ])
+    out = pl.pallas_call(
+        kern, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, block_q, d), q.dtype),
+        interpret=resolve_interpret(interpret),
+    )(page_table.astype(jnp.int32), kv_lens.astype(jnp.int32),
+      qf, k_pages, v_pages)
+    return out[:, :, :sq]
+
+
+def paged_attention_reference(q, k_pages, v_pages, page_table, kv_lens,
+                              sm_scale=None):
+    """Dense jnp reference for :func:`paged_flash_attention` — the
+    golden the kernel tests compare against, and the CPU fallback the
+    decode model uses off-TPU. Gathers the table'd pages, masks
+    columns past each row's ``kv_len`` (causal within the Sq chunk)
+    and runs a plain max-subtracted softmax. Every row's computation
+    is independent of the others — the property the join/leave
+    solo-parity golden leans on."""
+    b, h, sq, d = q.shape
+    page_size = k_pages.shape[2]
+    npages = page_table.shape[1]
+    if sm_scale is None:
+        sm_scale = 1.0 / (d ** 0.5)
+    # (B, NP, H, page, D) -> (B, H, NP*page, D)
+    k = jnp.moveaxis(k_pages[page_table], 2, 1) \
+        .reshape(b, h, npages * page_size, d)
+    v = jnp.moveaxis(v_pages[page_table], 2, 1) \
+        .reshape(b, h, npages * page_size, d)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32) * sm_scale,
+                   k.astype(jnp.float32))
+    col = jnp.arange(npages * page_size, dtype=jnp.int32)
+    row = jnp.arange(sq, dtype=jnp.int32)
+    kvl = kv_lens.astype(jnp.int32)[:, None, None, None]
+    mask = jnp.logical_and(
+        col[None, None, None, :]
+        <= kvl - np.int32(sq) + row[None, None, :, None],
+        col[None, None, None, :] < kvl)
+    s = jnp.where(mask, s, np.float32(_NEG_INF))
+    m = jnp.max(s, axis=-1, keepdims=True)
+    seen = m > np.float32(_NEG_INF / 2)
+    p = jnp.where(seen, jnp.exp(s - m), np.float32(0.0))
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    l = jnp.where(l == 0.0, np.float32(1.0), l)
+    return (jnp.einsum("bhqk,bhkd->bhqd", p / l,
+                       v.astype(jnp.float32))).astype(q.dtype)

@@ -36,18 +36,23 @@ Quickstart::
 from .queue import (ServingError, QueueFullError, DeadlineExceededError,
                     RequestTooLongError, EngineStoppedError,
                     InferenceFuture, Request, RequestQueue)
-from .batcher import ContinuousBatcher, PackedPlan
-from .metrics import LatencySummary, ServingStats
+from .batcher import ContinuousBatcher, DecodeSlots, PackedPlan
+from .metrics import DecodeStats, LatencySummary, ServingStats
 from .engine import ServingEngine
+from .kvcache import KVPagesExhaustedError, PagedKVPool
+from .decode import DecodeEngine, DecodeRequest
+from .decode_model import PagedCausalLM
 from .router import (ServingRouter, NoEngineAvailableError,
                      RemoteEngineError)
 from .autoscaler import FleetAutoscaler
 from .chaos import ChaosController
 
-__all__ = ["ServingEngine", "ServingRouter", "FleetAutoscaler",
-           "ChaosController", "ContinuousBatcher",
-           "PackedPlan", "RequestQueue", "Request", "InferenceFuture",
-           "LatencySummary", "ServingStats", "ServingError",
-           "QueueFullError", "DeadlineExceededError",
+__all__ = ["ServingEngine", "DecodeEngine", "ServingRouter",
+           "FleetAutoscaler", "ChaosController", "ContinuousBatcher",
+           "DecodeSlots", "PackedPlan", "PagedKVPool", "PagedCausalLM",
+           "DecodeRequest", "KVPagesExhaustedError",
+           "RequestQueue", "Request", "InferenceFuture",
+           "LatencySummary", "ServingStats", "DecodeStats",
+           "ServingError", "QueueFullError", "DeadlineExceededError",
            "RequestTooLongError", "EngineStoppedError",
            "NoEngineAvailableError", "RemoteEngineError"]

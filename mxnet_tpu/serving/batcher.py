@@ -24,7 +24,7 @@ import numpy as np
 
 from ..io.packing import pack_sequences
 
-__all__ = ["PackedPlan", "ContinuousBatcher"]
+__all__ = ["PackedPlan", "ContinuousBatcher", "DecodeSlots"]
 
 
 class PackedPlan:
@@ -156,3 +156,54 @@ def _pad_rows(arr, pad_rows, fill):
         return arr
     return np.concatenate(
         [arr, np.full((pad_rows,) + arr.shape[1:], fill, arr.dtype)])
+
+
+def _pow2_up_to(cap):
+    out, v = [], 1
+    while v < cap:
+        out.append(v)
+        v *= 2
+    out.append(int(cap))
+    return sorted(set(out))
+
+
+class DecodeSlots:
+    """Closed (rows × table-width) bucket set for the decode batch.
+
+    The encoder batcher above quantizes (rows, row_len); the decode
+    loop's shape axes are the ROW COUNT of the iteration batch and the
+    WIDTH of the padded page-table operand (pages of the longest
+    member sequence). Both quantize to powers of two — rows capped at
+    ``max_rows`` (the slot budget), width at ``max_pages`` (the pages
+    a ``max_len`` sequence needs) — so the jitted decode step compiles
+    ``log2(max_rows) x log2(max_pages)`` executables, enumerable up
+    front for warmup, and a sequence crossing a page boundary reuses
+    the next bucket's executable instead of tracing a fresh one.
+    """
+
+    def __init__(self, max_rows=8, max_pages=8):
+        if max_rows < 1 or max_pages < 1:
+            raise ValueError(
+                f"bad decode slot geometry: rows {max_rows}, pages "
+                f"{max_pages}")
+        self.max_rows = int(max_rows)
+        self.max_pages = int(max_pages)
+        self._rows = _pow2_up_to(self.max_rows)
+        self._widths = _pow2_up_to(self.max_pages)
+
+    def bucket(self, n_rows, n_pages):
+        """The (rows, width) bucket holding an ``n_rows``-sequence
+        iteration whose longest member spans ``n_pages`` pages."""
+        if n_rows < 1 or n_rows > self.max_rows:
+            raise ValueError(f"{n_rows} rows outside 1..{self.max_rows}")
+        if n_pages < 1 or n_pages > self.max_pages:
+            raise ValueError(
+                f"{n_pages} pages outside 1..{self.max_pages}")
+        rows = next(r for r in self._rows if r >= n_rows)
+        width = next(w for w in self._widths if w >= n_pages)
+        return rows, width
+
+    def shape_universe(self):
+        """Every (rows, width) the decode loop can emit — the compile
+        budget, enumerable for warmup."""
+        return [(r, w) for r in self._rows for w in self._widths]

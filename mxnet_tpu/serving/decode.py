@@ -1,0 +1,972 @@
+"""Autoregressive decode serving: iteration-level continuous batching
+over a paged KV cache, with streamed tokens.
+
+The encoder :class:`~.engine.ServingEngine` re-forms a batch per
+REQUEST; a decode server must re-form it per TOKEN. The
+:class:`DecodeEngine` worker runs the Orca-style loop:
+
+1. **Join at any iteration boundary.** Queued prompts are admitted
+   between decode iterations — at most
+   ``MXNET_TPU_DECODE_PREFILLS_PER_ITER`` prefills per boundary, so a
+   long prompt can never stall the running decode batch for more than
+   one prefill (the prefill/decode split schedule). Admission reserves
+   each request's WORST-CASE page budget up front, so the decode loop
+   can never deadlock on an exhausted pool mid-generation — a join
+   that doesn't fit is deferred (front of queue), not failed.
+2. **One decode iteration** advances every live sequence by one token:
+   a single compiled step over the (rows × table-width) bucket
+   (``batcher.DecodeSlots``), each row reading its own KV history
+   through its page-table row (``ops.pallas.flash_attention.
+   paged_flash_attention``) and writing its new K/V slot in place
+   (donated buffers — ``decode_model.py``). Rows are numerically
+   independent, so joining/leaving neighbors never change a sequence's
+   tokens (the solo-parity golden).
+3. **Leave on EOS / max-tokens**, KV pages recycled the same
+   iteration; every generated token is pushed to the request's future
+   as a streamed part (``InferenceFuture.stream()``) the moment it
+   exists — inter-token latency is a first-class SLI
+   (``mxnet_tpu_serving_inter_token_latency_ms`` + the default
+   ``decode_inter_token`` LatencySLO).
+
+``iteration_level=False`` degrades the scheduler to classic STATIC
+batching (joins only when the batch has fully drained) — the bench
+leg's A/B baseline, kept deliberately so the win stays measurable.
+"""
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import time
+
+import numpy as np
+
+from .. import compile_cache, envvars
+from ..telemetry import events as _events
+from ..telemetry import incidents as _incidents
+from ..telemetry import profiling as _profiling
+from ..telemetry import recorder as _recorder
+from ..telemetry.registry import REGISTRY as _REGISTRY
+from .batcher import DecodeSlots
+from .engine import _SUBMIT_ERROR_STATUS
+from .kvcache import PagedKVPool
+from .metrics import (CostLedger, DecodeStats, ServingStats,
+                      exemplar_gate, slow_exemplar)
+from .queue import (DeadlineExceededError, EngineStoppedError, Request,
+                    RequestQueue, RequestTooLongError, ServingError)
+
+__all__ = ["DecodeEngine", "DecodeRequest"]
+
+_engine_seq = itertools.count()
+
+
+class DecodeRequest(Request):
+    """One generation request: the prompt plus decode bookkeeping —
+    generated tokens so far, the sequence's write position, and the
+    per-token timing stamps the inter-token SLI reads."""
+
+    __slots__ = ("max_new_tokens", "eos_id", "stream", "generated",
+                 "pos", "t_first", "t_last", "device_s", "prompt_len")
+
+    def __init__(self, tokens, max_new_tokens, eos_id=None, stream=False,
+                 deadline_ms=None, trace_id=None, parent_span_id=None):
+        super().__init__(tokens, None, deadline_ms, trace_id=trace_id,
+                         parent_span_id=parent_span_id)
+        self.prompt_len = int(self.tokens.size)
+        self.max_new_tokens = int(max_new_tokens)
+        if self.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        self.eos_id = int(eos_id) if eos_id is not None else None
+        self.stream = bool(stream)
+        self.generated = []
+        self.pos = self.prompt_len     # where the NEXT token's KV goes
+        self.t_first = self.t_last = None
+        self.device_s = 0.0            # amortized decode wall share
+
+
+class DecodeEngine:
+    """Continuous-batching decode server around one paged-KV LM.
+
+    Parameters
+    ----------
+    model : the decode contract (``decode_model.PagedCausalLM`` or
+        anything matching it): ``spec`` (KV geometry),
+        ``prefill(caches, ids, length, phys, off)`` and
+        ``decode_step(caches, ids, positions, tables)``.
+    prefill_bucket_lens : padded prompt-length buckets (ascending);
+        a longer prompt is rejected at submit.
+    max_rows : decode slot cap (default ``MXNET_TPU_DECODE_ROWS``).
+    page_size / n_pages : KV pool geometry (``MXNET_TPU_KV_PAGE*``).
+    max_new_tokens : default generation cap
+        (``MXNET_TPU_DECODE_MAX_NEW_TOKENS``).
+    eos_id : default end-of-sequence token id (None = generate to the
+        cap).
+    iteration_level : True (default) = Orca-style joins at iteration
+        boundaries; False = static cohort batching (the A/B baseline).
+    engine_id : metric/scoreboard label, as on ``ServingEngine``.
+    """
+
+    def __init__(self, model, prefill_bucket_lens=(16, 64, 256),
+                 max_rows=None, page_size=None, n_pages=None,
+                 max_queue_depth=256, default_deadline_ms=None,
+                 max_new_tokens=None, eos_id=None, iteration_level=True,
+                 stats_window=4096, engine_id=None,
+                 prefills_per_iter=None):
+        self._model = model
+        spec = dict(model.spec)
+        self.engine_id = str(engine_id) if engine_id is not None \
+            else f"d{os.getpid():x}-{next(_engine_seq)}"
+        self.max_len = int(spec["max_len"])
+        lens = sorted(set(int(b) for b in prefill_bucket_lens))
+        if not lens or lens[0] < 1:
+            raise ValueError(
+                f"bad prefill_bucket_lens {prefill_bucket_lens!r}")
+        self.prefill_bucket_lens = tuple(lens)
+        self._max_rows = int(max_rows if max_rows is not None
+                             else envvars.get("MXNET_TPU_DECODE_ROWS"))
+        self._default_max_new = int(
+            max_new_tokens if max_new_tokens is not None
+            else envvars.get("MXNET_TPU_DECODE_MAX_NEW_TOKENS"))
+        self._default_eos = eos_id
+        self._iteration_level = bool(iteration_level)
+        self._prefills_per_iter = max(1, int(
+            prefills_per_iter if prefills_per_iter is not None
+            else envvars.get("MXNET_TPU_DECODE_PREFILLS_PER_ITER")))
+        self._default_deadline_ms = default_deadline_ms
+        self.pool = PagedKVPool(
+            spec["n_layers"], spec["n_heads"], spec["head_dim"],
+            page_size=page_size, n_pages=n_pages,
+            engine_id=self.engine_id)
+        self._slots = DecodeSlots(
+            max_rows=self._max_rows,
+            max_pages=self.pool.pages_for(self.max_len))
+        self._queue = RequestQueue(max_queue_depth)
+        self._active = []              # worker-owned slot list
+        # static (cohort) mode only: the cohort's row count, pinned at
+        # admission — finished rows stay PADDED in the step until the
+        # whole cohort drains, the classic static-batching waste the
+        # iteration-level scheduler exists to eliminate (and the A/B
+        # measures against)
+        self._static_rows = 0
+        self._reserved = {}            # owner -> worst-case pages
+        self._reserved_pages = 0
+        self._defer_logged = False
+        self.stats = ServingStats(stats_window, engine_id=self.engine_id)
+        self.stats.set_queue_depth_fn(lambda: len(self._queue))
+        self.decode_stats = DecodeStats(self.engine_id,
+                                        window=stats_window)
+        self.decode_stats.set_split_fns(lambda: len(self._queue),
+                                        lambda: len(self._active))
+        self.costs = CostLedger(self.engine_id)
+        cc = _REGISTRY.counter(
+            "mxnet_tpu_serving_compile_cache_total",
+            "per-shape executable cache outcomes at dispatch: "
+            "memory_hit (in-process), persistent_hit (on-disk cache "
+            "served the compile), miss (fresh backend compile)",
+            ("engine_id", "result"))
+        self._compile_cache = {
+            r: cc.labels(engine_id=self.engine_id, result=r)
+            for r in ("memory_hit", "persistent_hit", "miss")}
+        self._cc_counts = {r: 0 for r in self._compile_cache}
+        self._seen_shapes = set()
+        self._shapes_lock = threading.Lock()
+        self._compiling_since = None
+        # one lock serializes model steps + pool swap: the worker loop,
+        # warmup on the caller's thread, and day-one canary traffic
+        # must never interleave a step with a cache swap (donated
+        # buffers die with the step). A compile legitimately holds it
+        # for seconds, hence the long-hold allowance.
+        self._forward_lock = threading.Lock()  # mxsan: allow=long-hold
+        self._exemplars = exemplar_gate()
+        self._slo = None
+        self._worker = None
+        self._expo = None
+        self._wire = None
+        self._abort = False
+        self._started = False
+        self._lock = threading.Lock()
+        self._beat = time.monotonic()
+        self._last_dispatch = self._beat
+        self._probe_name = f"decode_engine_{id(self):x}"
+        self._bundle_name = f"decode_scheduler_{self.engine_id}"
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        with self._lock:
+            if self._started:
+                return self
+            if self._queue.closed:
+                raise EngineStoppedError("engine cannot be restarted")
+            self._started = True
+            self._beat = time.monotonic()
+            self._last_dispatch = self._beat
+            self._worker = threading.Thread(target=self._run,
+                                            name="mxnet_tpu_decode",
+                                            daemon=True)
+            self._worker.start()
+        compile_cache.ensure()
+        _recorder.install()
+        _recorder.register_probe(self._probe_name, self._watchdog_probe)
+        # flight bundles carry the decode scheduler's state on any
+        # watchdog trip / crash: slot table, queue split, page
+        # occupancy — what the on-call needs to see a wedged loop
+        _recorder.add_bundle_section(self._bundle_name,
+                                     self.scheduler_state)
+        _incidents.install()
+        _profiling.ensure_started()
+        if envvars.get("MXNET_TPU_SLO"):
+            from ..telemetry.alerts import (AlertDaemon,
+                                            default_burn_rules,
+                                            default_decode_objectives)
+            from ..telemetry.slo import SloEvaluator
+            evaluator = SloEvaluator(self.engine_id)
+            names = default_decode_objectives(evaluator, self.engine_id)
+            self._slo = AlertDaemon(evaluator)
+            default_burn_rules(self._slo, names)
+            self._slo.start()
+        _events.emit("engine_start", engine_id=self.engine_id,
+                     decode=True,
+                     prefill_buckets=list(self.prefill_bucket_lens),
+                     max_rows=self._max_rows,
+                     kv_pages=self.pool.n_pages,
+                     page_size=self.pool.page_size,
+                     iteration_level=self._iteration_level)
+        return self
+
+    def stop(self, drain=True, timeout=None):
+        """Shut down. ``drain=True`` finishes every queued and
+        IN-FLIGHT generation first; ``drain=False`` fails them
+        (counted ``cancelled``) — partial token streams end with the
+        failure, exactly as ``stream()`` documents."""
+        _events.emit("engine_abort" if not drain else "engine_stop",
+                     engine_id=self.engine_id, drain=drain)
+        _recorder.unregister_probe(self._probe_name)
+        _recorder.remove_bundle_section(self._bundle_name)
+        if self._slo is not None:
+            self._slo.stop()
+        with self._lock:
+            self._queue.close()
+            if not drain:
+                self._abort = True
+            worker = self._worker
+        timed_out = False
+        if worker is not None:
+            worker.join(timeout)
+            timed_out = worker.is_alive()
+        for r in self._queue.drain_all():
+            self.stats.bump("cancelled")
+            r.span.end(error="cancelled: engine stopped")
+            r.future.set_exception(
+                EngineStoppedError("engine stopped before request ran"))
+        self.stats.set_queue_depth_fn(lambda: 0)
+        with self._lock:
+            expo, self._expo = self._expo, None
+            wire, self._wire = self._wire, None
+        if wire is not None:
+            wire.close()
+        if expo is not None:
+            expo.close()
+        if timed_out:
+            raise ServingError("decode worker did not stop in time")
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop(drain=True)
+        return False
+
+    @property
+    def running(self):
+        with self._lock:
+            return (self._started and self._worker is not None
+                    and self._worker.is_alive())
+
+    @property
+    def alerts(self):
+        return self._slo
+
+    # -- client surface ----------------------------------------------------
+    def submit(self, tokens, token_types=None, deadline_ms=None,
+               max_new_tokens=None, eos_id=None, stream=False,
+               trace_id=None, parent_span_id=None):
+        """Enqueue one generation request; returns a STREAMING
+        :class:`~.queue.InferenceFuture` — ``result()`` is the full
+        (max_new_tokens,) int32 token array, ``stream()`` yields each
+        token as it is generated. ``token_types`` is accepted for
+        submit-surface compatibility (canaries, generic loadgen) and
+        ignored — decode prompts are plain token ids."""
+        del token_types
+        if deadline_ms is None:
+            deadline_ms = self._default_deadline_ms
+        if max_new_tokens is None:
+            max_new_tokens = self._default_max_new
+        if eos_id is None:
+            eos_id = self._default_eos
+        req = DecodeRequest(tokens, max_new_tokens, eos_id=eos_id,
+                            stream=stream, deadline_ms=deadline_ms,
+                            trace_id=trace_id,
+                            parent_span_id=parent_span_id)
+        req.span.set_attr(engine=self.engine_id, decode=True)
+        self.stats.bump("submitted")
+        if not self._started or self._queue.closed:
+            self.stats.bump("rejected_stopped")
+            req.span.end(error="rejected: engine not running")
+            raise EngineStoppedError("decode engine is not running")
+        too_long = None
+        if req.prompt_len > self.prefill_bucket_lens[-1]:
+            too_long = (f"prompt of {req.prompt_len} tokens exceeds "
+                        f"the largest prefill bucket "
+                        f"({self.prefill_bucket_lens[-1]})")
+        elif req.prompt_len + req.max_new_tokens > self.max_len:
+            too_long = (f"prompt {req.prompt_len} + max_new_tokens "
+                        f"{req.max_new_tokens} exceeds the model's "
+                        f"max_len ({self.max_len})")
+        elif (self.pool.pages_for(req.prompt_len + req.max_new_tokens)
+                > self.pool.n_pages):
+            too_long = ("request's worst-case KV footprint exceeds "
+                        "the whole page pool")
+        if too_long is not None:
+            self.stats.bump("rejected_too_long")
+            _events.emit("request_shed", reason="too_long",
+                         engine_id=self.engine_id,
+                         trace_id=req.trace_id, tokens=req.prompt_len)
+            req.span.set_attr(shed="too_long").force_keep() \
+               .end(error="shed: too_long")
+            raise RequestTooLongError(too_long)
+        try:
+            self._queue.put(req)
+        except ServingError as e:
+            full = not self._queue.closed
+            reason = "queue_full" if full else "stopped"
+            self.stats.bump("rejected_queue_full"
+                            if full else "rejected_stopped")
+            _events.emit("request_shed", reason=reason,
+                         engine_id=self.engine_id,
+                         trace_id=req.trace_id, tokens=req.prompt_len)
+            req.span.set_attr(shed=reason).force_keep() \
+               .end(error=f"shed: {reason}")
+            raise e
+        return req.future
+
+    def infer(self, tokens, max_new_tokens=None, eos_id=None,
+              deadline_ms=None, timeout=None):
+        """Synchronous convenience: submit + wait for the full
+        generated sequence."""
+        return self.submit(tokens, deadline_ms=deadline_ms,
+                           max_new_tokens=max_new_tokens,
+                           eos_id=eos_id).result(timeout)
+
+    def submit_payload(self, payload):
+        """Dispatch-surface adapter (wire listener + HTTP ``/submit``):
+        one payload dict in, ``(future, streamed)`` out. The payload's
+        decode fields (``max_new_tokens``, ``eos_id``, ``stream``)
+        ride the same dict the encoder dispatch uses, so old routers
+        that know none of them still work."""
+        fut = self.submit(payload.get("tokens"),
+                          deadline_ms=payload.get("deadline_ms"),
+                          max_new_tokens=payload.get("max_new_tokens"),
+                          eos_id=payload.get("eos_id"),
+                          stream=bool(payload.get("stream")),
+                          trace_id=payload.get("trace_id"),
+                          parent_span_id=payload.get("span_id"))
+        return fut, bool(payload.get("stream"))
+
+    # -- warmup ------------------------------------------------------------
+    def warmup(self, shapes=None, manifest=None):
+        """Compile ahead of traffic: every (0, prefill_bucket) prompt
+        shape and every (rows, table_width) decode bucket (or the
+        given/manifest subset). Dummy forwards write only the pool's
+        scratch page. Call BEFORE traffic, like the encoder engine."""
+        if manifest is not None:
+            if isinstance(manifest, (str, os.PathLike)):
+                manifest = compile_cache.load_manifest(manifest)
+            universe = set(self._shape_universe())
+            want = compile_cache.manifest_shapes(manifest)
+            shapes = [s for s in want if s in universe]
+            _events.emit("warmup_replay", engine_id=self.engine_id,
+                         shapes=len(shapes),
+                         skipped_incompatible=len(want) - len(shapes))
+        if shapes is None:
+            shapes = self._shape_universe()
+        for shape in shapes:
+            if shape[0] == 0:
+                self._forward_prefill_shape(shape[1])
+            else:
+                self._forward_decode_shape(*shape)
+        return self
+
+    def _shape_universe(self):
+        """Manifest key space: prefill buckets as (0, padded_len),
+        decode buckets as (rows, table_width) — int pairs, so the
+        fleet manifest machinery (union/persist/replay) carries them
+        unchanged and encoder engines skip them as incompatible."""
+        return ([(0, b) for b in self.prefill_bucket_lens]
+                + list(self._slots.shape_universe()))
+
+    def warmup_manifest(self):
+        with self._shapes_lock:
+            shapes = sorted(self._seen_shapes)
+        return compile_cache.new_manifest(
+            self.engine_id, self.prefill_bucket_lens, self._max_rows,
+            shapes)
+
+    def reset_stats(self):
+        """Fresh measurement window (compile caches + ledger + pool
+        untouched) — the bench legs' warmup/measure split."""
+        self.stats = ServingStats(self.stats.window,
+                                  engine_id=self.engine_id)
+        self.stats.set_queue_depth_fn(lambda: len(self._queue))
+        self.decode_stats = DecodeStats(self.engine_id,
+                                        window=self.decode_stats.window)
+        self.decode_stats.set_split_fns(lambda: len(self._queue),
+                                        lambda: len(self._active))
+        return self
+
+    # -- observability surfaces --------------------------------------------
+    def snapshot(self):
+        out = self.stats.snapshot()
+        out["running"] = self.running
+        out["decode"] = self.decode_stats.snapshot()
+        out["kv"] = self.pool.occupancy()
+        out["prefill_buckets"] = list(self.prefill_bucket_lens)
+        out["max_rows"] = self._max_rows
+        out["iteration_level"] = self._iteration_level
+        out["active_slots"] = len(self._active)
+        out["seconds_since_beat"] = round(
+            time.monotonic() - self._beat, 3)
+        with self._shapes_lock:
+            out["compile_cache"] = dict(self._cc_counts)
+            out["manifest_shapes"] = len(self._seen_shapes)
+        out["compiling"] = self._compiling_since is not None
+        out["costs"] = self.costs.totals()
+        return out
+
+    def scheduler_state(self):
+        """The decode scheduler's live state — the flight-bundle
+        section a watchdog trip snapshots, and the `/stats` drill-down
+        for a wedged loop."""
+        active = [{"trace_id": r.trace_id, "prompt": r.prompt_len,
+                   "generated": len(r.generated), "pos": r.pos,
+                   "max_new_tokens": r.max_new_tokens,
+                   "pages": len(self.pool.table(r.id) or ())}
+                  for r in list(self._active)]
+        return {"engine_id": self.engine_id,
+                "iteration_level": self._iteration_level,
+                "active": active,
+                "prefill_queue_depth": len(self._queue),
+                "reserved_pages": self._reserved_pages,
+                "kv": self.pool.occupancy(),
+                "decode": self.decode_stats.snapshot()}
+
+    def slo_snapshot(self):
+        if self._slo is None:
+            return {"owner": self.engine_id, "enabled": False,
+                    "objectives": {}}
+        return self._slo.evaluator.snapshot()
+
+    def alerts_snapshot(self):
+        if self._slo is None:
+            return {"owner": self.engine_id, "enabled": False,
+                    "rules": []}
+        return self._slo.snapshot()
+
+    def cost_table(self):
+        """/costs body. Decode iterations land in NEGATED-rows buckets
+        (-1, -2, -4, ... — "a decode batch of N rows"; the sign keeps
+        them disjoint from prompt-length buckets for any config),
+        prefill forwards in their padded prompt-length buckets."""
+        return {"engine_id": self.engine_id,
+                "buckets": self.costs.table(),
+                "totals": self.costs.totals()}
+
+    def expose(self, port=0, host="127.0.0.1"):
+        """Telemetry + dispatch surface, mirroring
+        ``ServingEngine.expose``; ``POST /submit`` additionally
+        understands decode payload fields and — with ``"stream":
+        true`` — answers with chunked JSON lines, one per generated
+        token, final body last (the HTTP fallback for wire-less
+        peers). The binary wire listener streams partial RESULT
+        frames for the same requests (``MXNET_TPU_WIRE=0`` opts out)."""
+        from ..telemetry.expo import TelemetryServer
+
+        with self._lock:
+            if self._queue.closed:
+                raise EngineStoppedError(
+                    "cannot expose telemetry on a stopped engine")
+            if self._expo is not None:
+                return self._expo
+
+            def healthz():
+                alive = (self._worker is not None
+                         and self._worker.is_alive())
+                closed = self._queue.closed
+                wire = self._wire
+                return (alive and not closed,
+                        {"engine_id": self.engine_id, "decode": True,
+                         "worker_alive": alive, "queue_closed": closed,
+                         "queue_depth": len(self._queue),
+                         "active_slots": len(self._active),
+                         "kv_occupancy":
+                             self.pool.occupancy()["occupancy"],
+                         "compiling": self._compiling_since is not None,
+                         "wire_port": (wire.port if wire is not None
+                                       else None),
+                         "seconds_since_beat":
+                             round(time.monotonic() - self._beat, 3)})
+
+            srv = TelemetryServer(healthz_fn=healthz,
+                                  stats_fn=self.snapshot,
+                                  submit_fn=self._remote_submit,
+                                  warmup_fn=self.warmup_manifest,
+                                  costs_fn=self.cost_table,
+                                  slo_fn=(self.slo_snapshot
+                                          if self._slo is not None
+                                          else None),
+                                  alerts_fn=(self.alerts_snapshot
+                                             if self._slo is not None
+                                             else None),
+                                  port=port, host=host)
+            self._expo = srv
+            if envvars.get("MXNET_TPU_WIRE") and self._wire is None:
+                from .wire import WireListener
+                try:
+                    self._wire = WireListener(self, host=host)
+                except OSError as e:
+                    _events.emit("wire_listen_error",
+                                 engine_id=self.engine_id,
+                                 error=repr(e))
+        _events.emit("telemetry_expose", engine_id=self.engine_id,
+                     port=srv.port, host=srv.host)
+        return srv
+
+    def _remote_submit(self, payload):
+        """``POST /submit`` handler. Non-streamed: block, one JSON
+        body (the encoder contract, token array as the result).
+        Streamed (``"stream": true``): returns a part GENERATOR the
+        exposition server writes as chunked JSON lines — partial
+        tokens flow while the model generates, the final line carries
+        the authoritative full sequence."""
+        t0 = time.perf_counter()
+        try:
+            fut, streamed = self.submit_payload(payload)
+        except (ServingError, ValueError, KeyError, TypeError) as e:
+            name = type(e).__name__
+            return (_SUBMIT_ERROR_STATUS.get(name, 400),
+                    {"ok": False, "error_type": name, "error": str(e),
+                     "engine_id": self.engine_id})
+        timeout_s = float(payload.get("timeout_s") or 600.0)
+        if not streamed:
+            try:
+                out = fut.result(timeout=timeout_s)
+            except Exception as e:
+                name = type(e).__name__
+                return (_SUBMIT_ERROR_STATUS.get(name, 500),
+                        {"ok": False, "error_type": name,
+                         "error": str(e), "trace_id": fut.trace_id,
+                         "engine_id": self.engine_id})
+            # "decode": True marks the result as TOKEN IDS so an
+            # HTTP-fallback router restores int32 even when the
+            # request itself carried no decode params (engine-default
+            # max_new_tokens)
+            return 200, {"ok": True, "result": np.asarray(out).tolist(),
+                         "decode": True,
+                         "trace_id": fut.trace_id,
+                         "engine_id": self.engine_id,
+                         "engine_ms": round(
+                             (time.perf_counter() - t0) * 1e3, 3),
+                         "cost": getattr(fut, "cost", None)}
+
+        def parts():
+            n = 0
+            try:
+                for part in fut.stream(timeout=timeout_s):
+                    yield {"seq": n, "token": int(part["token"]),
+                           "final": False, "trace_id": fut.trace_id}
+                    n += 1
+                out = fut.result(timeout=0)
+            except Exception as e:
+                yield {"ok": False, "final": True,
+                       "error_type": type(e).__name__, "error": str(e),
+                       "trace_id": fut.trace_id,
+                       "engine_id": self.engine_id}
+                return
+            yield {"ok": True, "final": True, "seq": n,
+                   "result": np.asarray(out).tolist(),
+                   "trace_id": fut.trace_id,
+                   "engine_id": self.engine_id,
+                   "engine_ms": round(
+                       (time.perf_counter() - t0) * 1e3, 3),
+                   "cost": getattr(fut, "cost", None)}
+
+        return 200, parts()
+
+    # -- watchdog ----------------------------------------------------------
+    def _watchdog_probe(self):
+        if not self.running:
+            return None
+        now = time.monotonic()
+        stall = _recorder.stall_seconds()
+        if self._compiling_since is not None:
+            stall += envvars.get("MXNET_TPU_WATCHDOG_COMPILE_GRACE_S")
+        since_beat = now - self._beat
+        if since_beat > stall:
+            return {"kind": "decode_worker_stall",
+                    "seconds_since_beat": round(since_beat, 3),
+                    "active_slots": len(self._active),
+                    "queue_depth": len(self._queue)}
+        depth = len(self._queue)
+        if (depth >= self._queue.max_depth
+                and now - self._last_dispatch > stall):
+            return {"kind": "decode_queue_saturated",
+                    "queue_depth": depth,
+                    "seconds_since_dispatch": round(
+                        now - self._last_dispatch, 3)}
+        return None
+
+    # -- compile tracking --------------------------------------------------
+    def _bump_cc(self, result):
+        with self._shapes_lock:
+            self._cc_counts[result] += 1
+        self._compile_cache[result].inc()
+
+    def _step_compiled(self, shape_key, fn):
+        """Run one model step, classifying the executable-cache
+        outcome for ``shape_key`` exactly as the encoder engine does
+        (memory_hit / persistent_hit / miss, compile-grace window for
+        the watchdog). Returns (result, wall_s, first_visit)."""
+        with self._shapes_lock:
+            hit = shape_key in self._seen_shapes
+        if hit:
+            self._bump_cc("memory_hit")
+            t0 = time.perf_counter()
+            out = fn()
+            dt = time.perf_counter() - t0
+            return out, dt, False
+        _events.emit("compile_begin", engine_id=self.engine_id,
+                     shape=list(shape_key))
+        cc_before = compile_cache.events_snapshot()
+        self._compiling_since = time.monotonic()
+        t0 = time.perf_counter()
+        try:
+            out = fn()
+        finally:
+            self._beat = time.monotonic()
+            self._compiling_since = None
+        dt = time.perf_counter() - t0
+        result = compile_cache.classify(cc_before,
+                                        compile_cache.events_snapshot())
+        self._bump_cc(result)
+        with self._shapes_lock:
+            self._seen_shapes.add(shape_key)
+        self.stats.bump("compiles")
+        self.stats.compile_ms.observe(dt * 1e3)
+        _events.emit("compile_end", engine_id=self.engine_id,
+                     shape=list(shape_key), result=result,
+                     ms=round(dt * 1e3, 3))
+        return out, dt, True
+
+    # -- warmup forwards ---------------------------------------------------
+    def _forward_prefill_shape(self, bucket):
+        ids = np.zeros(bucket, np.int32)
+        phys = np.full(bucket, self.pool.scratch_page, np.int32)
+        off = (np.arange(bucket) % self.pool.page_size).astype(np.int32)
+
+        def run():
+            with self._forward_lock:
+                tok, caches = self._model.prefill(
+                    self.pool.caches, ids, bucket, phys, off)
+                self.pool.swap(caches)
+            return tok
+
+        _out, dt, compiled = self._step_compiled((0, bucket), run)
+        self.costs.observe_warmup(bucket, dt, compiled=compiled)
+
+    def _forward_decode_shape(self, rows, width):
+        ids = np.zeros(rows, np.int32)
+        positions = np.zeros(rows, np.int32)
+        tables = np.full((rows, width), self.pool.scratch_page,
+                         np.int32)
+
+        def run():
+            with self._forward_lock:
+                toks, caches = self._model.decode_step(
+                    self.pool.caches, ids, positions, tables)
+                self.pool.swap(caches)
+            return toks
+
+        _out, dt, compiled = self._step_compiled((rows, width), run)
+        self.costs.observe_warmup(-rows, dt, compiled=compiled)
+
+    # -- worker ------------------------------------------------------------
+    def _run(self):
+        while True:
+            self._beat = time.monotonic()
+            if self._abort:
+                self._fail_all(EngineStoppedError(
+                    "engine stopped before generation finished"))
+                return
+            self._admit()
+            if not self._active:
+                if self._queue.closed and not len(self._queue):
+                    return
+                continue
+            try:
+                self._iterate()
+            except Exception as e:
+                # a poison iteration fails ITS batch, never the engine:
+                # every active sequence is failed (their streams end
+                # with the error) and their pages recycle; queued
+                # requests get a fresh batch next loop
+                for req in self._active:
+                    self._leave(req, error=e)
+                self._active = []
+
+    def _fail_all(self, exc):
+        for req in self._active:
+            self._leave(req, error=exc, counter="cancelled")
+        self._active = []
+        for req in self._queue.drain_all():
+            self.stats.bump("cancelled")
+            req.span.end(error="cancelled: engine stopped")
+            req.future.set_exception(exc)
+
+    def _admit(self):
+        """Join queued prompts at this iteration boundary. Static mode
+        (``iteration_level=False``) admits only into an EMPTY batch
+        and pins the cohort's row count until it fully drains — the
+        classic cohort scheduler the A/B leg measures against."""
+        if not self._iteration_level and self._active:
+            return
+        if not self._active:
+            self._static_rows = 0
+        budget = (self._prefills_per_iter if self._active
+                  else self._max_rows)
+        admitted = 0
+        while len(self._active) < self._max_rows and admitted < budget:
+            # idle engines park on the queue poll; a running batch
+            # polls without waiting (the decode loop must not linger)
+            timeout = 0.05 if not self._active and not admitted else 0.0
+            reqs = self._queue.poll(1, timeout=timeout)
+            if not reqs:
+                break
+            req = reqs[0]
+            now = time.monotonic()
+            if req.expired(now):
+                self.stats.bump("expired")
+                _events.emit("request_expired", trace_id=req.trace_id,
+                             waited_ms=round(
+                                 (now - req.t_submit) * 1e3, 3))
+                req.span.end(error="deadline exceeded before prefill")
+                req.future.set_exception(DeadlineExceededError(
+                    f"request {req.id} deadline exceeded before "
+                    "prefill"))
+                continue
+            worst = self.pool.pages_for(req.prompt_len
+                                        + req.max_new_tokens)
+            if self._reserved_pages + worst > self.pool.n_pages:
+                # the pool cannot GUARANTEE this sequence's worst case:
+                # defer (front of line), never fail — pages recycle the
+                # moment any sequence leaves
+                self._queue.requeue(req)
+                if not self._defer_logged:
+                    self._defer_logged = True
+                    _events.emit("decode_defer",
+                                 engine_id=self.engine_id,
+                                 trace_id=req.trace_id,
+                                 need_pages=worst,
+                                 reserved=self._reserved_pages,
+                                 pool=self.pool.n_pages)
+                break
+            try:
+                self._prefill(req, worst)
+            except Exception as e:
+                self.pool.release(req.id)
+                self._unreserve(req)
+                self.stats.bump("failed")
+                req.span.end(error=repr(e))
+                req.future.set_exception(e)
+                continue
+            admitted += 1
+
+    def _unreserve(self, req):
+        worst = self._reserved.pop(req.id, 0)
+        self._reserved_pages -= worst
+
+    def _prefill(self, req, worst_pages):
+        """Run one prompt through the prefill step, emit the first
+        token, and either finish the request (max_new_tokens=1 / EOS
+        on token one) or JOIN it to the decode batch."""
+        self._reserved[req.id] = worst_pages
+        self._reserved_pages += worst_pages
+        bucket = next(b for b in self.prefill_bucket_lens
+                      if b >= req.prompt_len)
+        self.pool.ensure(req.id, req.prompt_len)
+        ids = np.zeros(bucket, np.int32)
+        ids[:req.prompt_len] = req.tokens
+        phys, off = self.pool.scatter_indices(req.id, req.prompt_len,
+                                              bucket)
+
+        def run():
+            with self._forward_lock:
+                tok, caches = self._model.prefill(
+                    self.pool.caches, ids, req.prompt_len, phys, off)
+                self.pool.swap(caches)
+            return int(tok)
+
+        tok, dt, compiled = self._step_compiled((0, bucket), run)
+        # prefill always carries exactly one live request, so its wall
+        # lands in request_s (observe_decode) — what keeps
+        # sum(per-request bills) == ledger request_s exact; the
+        # request is counted once, at leave — which IS now for a
+        # generation that ends on its first token (max_new_tokens=1,
+        # or EOS immediately). Tokens: the prompt PLUS the first
+        # generated token, matching the bills' unit token-for-token.
+        done_now = (req.max_new_tokens == 1
+                    or (req.eos_id is not None and tok == req.eos_id))
+        self.costs.observe_decode(bucket, dt,
+                                  tokens=req.prompt_len + 1,
+                                  completed=int(done_now),
+                                  compiled=compiled)
+        now = time.monotonic()
+        self._last_dispatch = now
+        req.t_first = req.t_last = now
+        req.device_s += dt
+        self.decode_stats.ttft_ms.observe((now - req.t_submit) * 1e3)
+        self.stats.queue_ms.observe((req.t_drain - req.t_submit) * 1e3)
+        self._emit_token(req, tok)
+        if self._done_after_token(req, tok):
+            self._leave(req, reason=self._leave_reason(req, tok),
+                        joined=False)
+            return
+        self._active.append(req)
+        self.decode_stats.observe_join()
+        _events.emit("decode_join", engine_id=self.engine_id,
+                     trace_id=req.trace_id, prompt=req.prompt_len,
+                     max_new_tokens=req.max_new_tokens,
+                     active=len(self._active))
+
+    def _emit_token(self, req, tok):
+        req.generated.append(tok)
+        self.decode_stats.observe_token()
+        req.future.push_part({"index": len(req.generated) - 1,
+                              "token": tok, "final": False})
+
+    @staticmethod
+    def _done_after_token(req, tok):
+        return (len(req.generated) >= req.max_new_tokens
+                or (req.eos_id is not None and tok == req.eos_id))
+
+    @staticmethod
+    def _leave_reason(req, tok):
+        if req.eos_id is not None and tok == req.eos_id:
+            return "eos"
+        return "max_tokens"
+
+    def _iterate(self):
+        """One decode iteration: every live sequence advances one
+        token through the bucketed paged step; EOS/max-token leavers
+        recycle their pages the same iteration."""
+        active = self._active
+        for req in active:
+            # guaranteed by the admission reservation: never raises
+            self.pool.ensure(req.id, req.pos + 1)
+        # ensure() just covered pos+1 for every row, so the page count
+        # is pure arithmetic — no pool lock or table copy per token
+        max_pages = max(self.pool.pages_for(req.pos + 1)
+                        for req in active)
+        n_rows = len(active)
+        if not self._iteration_level:
+            # classic static batching: the cohort's row count is
+            # pinned at admission; rows whose sequences finished keep
+            # burning padded slots until the LAST member drains
+            self._static_rows = max(self._static_rows, n_rows)
+            n_rows = self._static_rows
+        rows_b, width_b = self._slots.bucket(n_rows, max_pages)
+        ids = np.zeros(rows_b, np.int32)
+        positions = np.zeros(rows_b, np.int32)
+        for i, req in enumerate(active):
+            ids[i] = req.generated[-1]
+            positions[i] = req.pos
+        owners = [req.id for req in active] \
+            + ["__pad__"] * (rows_b - len(active))
+        tables = self.pool.padded_tables(owners, width_b)
+
+        def run():
+            with self._forward_lock:
+                toks, caches = self._model.decode_step(
+                    self.pool.caches, ids, positions, tables)
+                toks = np.asarray(toks)
+                self.pool.swap(caches)
+            return toks
+
+        toks, dt, compiled = self._step_compiled((rows_b, width_b), run)
+        now = time.monotonic()
+        self._beat = now
+        self._last_dispatch = now
+        n_active = len(active)
+        leavers = []
+        share = dt / n_active
+        completed = 0
+        for i, req in enumerate(active):
+            tok = int(toks[i])
+            self.decode_stats.inter_token_ms.observe(
+                (now - req.t_last) * 1e3)
+            req.t_last = now
+            req.pos += 1
+            req.device_s += share
+            self._emit_token(req, tok)
+            if self._done_after_token(req, tok):
+                leavers.append((req, self._leave_reason(req, tok)))
+                completed += 1
+        self.decode_stats.observe_iteration(rows_b, n_active)
+        self.stats.compute_ms.observe(dt * 1e3)
+        self.costs.observe_decode(-rows_b, dt, tokens=n_active,
+                                  completed=completed,
+                                  compiled=compiled)
+        if leavers:
+            left = {req.id for req, _ in leavers}
+            self._active = [r for r in active if r.id not in left]
+            for req, reason in leavers:
+                self._leave(req, reason=reason)
+
+    def _leave(self, req, reason=None, error=None, counter="failed",
+               joined=True):
+        """Retire one sequence: pages recycled immediately, stream
+        closed with the final result (or the failure)."""
+        freed = self.pool.release(req.id)
+        self._unreserve(req)
+        self._defer_logged = False
+        if joined:
+            self.decode_stats.observe_leave()
+        if error is not None:
+            self.stats.bump(counter)
+            req.span.end(error=repr(error))
+            req.future.set_exception(error)
+            return
+        now = time.monotonic()
+        req.t_done = now
+        out = np.asarray(req.generated, np.int32)
+        total_ms = (now - req.t_submit) * 1e3
+        self.stats.total_ms.observe(
+            total_ms, exemplar=slow_exemplar(req.trace_id, total_ms,
+                                             self._exemplars))
+        self.stats.bump("completed")
+        # "tokens" mirrors the ledger's accounting unit (prompt tokens
+        # prefilled + tokens generated) so client-summed bills
+        # reconcile against the /costs delta token-for-token
+        req.future.cost = {"engine_id": self.engine_id,
+                           "bucket": "decode",
+                           "device_s": req.device_s,
+                           "compiled": False,
+                           "tokens": req.prompt_len + len(req.generated),
+                           "generated_tokens": len(req.generated),
+                           "prompt_tokens": req.prompt_len,
+                           "batch_requests": 1}
+        _events.emit("decode_leave", engine_id=self.engine_id,
+                     trace_id=req.trace_id, reason=reason,
+                     tokens=len(req.generated), pages_freed=freed,
+                     active=len(self._active))
+        req.span.set_attr(tokens=len(req.generated), reason=reason)
+        req.span.end()
+        req.future.set_result(out)

@@ -1,0 +1,239 @@
+"""Reference decode model: a paged-KV causal LM the decode engine drives.
+
+The encoder serving path adapts gluon blocks (``bert_serving_entry``);
+autoregressive decode needs a model that THREADS THE KV CACHE through
+every step, which the encoder CachedOp contract has no slot for. This
+module provides the decode-side contract plus a self-contained
+GPT-style reference implementation (:class:`PagedCausalLM`) the decode
+engine, bench leg and tests drive:
+
+- ``prefill(caches, ids, length, phys, off)`` — one padded prompt row
+  in, the first generated token out; per-position K/V are scattered
+  into the paged pool THROUGH the precomputed page coordinates
+  (``serving/kvcache.py`` emits them; tail padding lands on the
+  scratch page).
+- ``decode_step(caches, ids, positions, tables)`` — one iteration of
+  the continuous decode batch: (R,) current tokens in, (R,) next
+  tokens out, each row reading its own history through its page-table
+  row (``ops.pallas.flash_attention.paged_flash_attention`` on TPU /
+  interpret, the dense reference off it) and writing its new K/V page
+  slot in place.
+
+Both are ``jax.jit`` steps with ``donate_argnums=(0,)`` on the cache
+pytree — the decode analog of the encoder path's per-shape CachedOp
+executables (one compile per (rows, table-width) bucket, cached by
+jax) — so the page pool updates IN PLACE: steady-state decode performs
+no per-step cache-sized allocation (``MXNET_TPU_DECODE_DONATE=0``
+disables donation for A/B; the resource-watermark test pins the
+default). Sampling is greedy argmax, deterministic by construction —
+what makes the solo-parity goldens byte-exact.
+"""
+from __future__ import annotations
+
+import warnings
+
+import numpy as np
+
+from .. import envvars
+
+__all__ = ["PagedCausalLM"]
+
+# XLA CPU cannot honor buffer donation (TPU/GPU can); jax warns once
+# per compile — expected off-chip, pure noise in CPU test logs
+warnings.filterwarnings(
+    "ignore", message="Some donated buffers were not usable")
+
+
+def _layer_norm(x, g, b, eps=1e-5):
+    import jax.numpy as jnp
+
+    m = jnp.mean(x, axis=-1, keepdims=True)
+    v = jnp.mean(jnp.square(x - m), axis=-1, keepdims=True)
+    return (x - m) / jnp.sqrt(v + eps) * g + b
+
+
+class PagedCausalLM:
+    """GPT-small-shaped causal LM with a paged decode path.
+
+    Weights are freshly initialized (seeded ``Normal(0.02)``) — the
+    serving plane under test is scheduling/transport/caching, not
+    model quality; greedy argmax over deterministic weights gives
+    byte-reproducible token sequences, which is exactly what the
+    parity goldens need.
+
+    Parameters mirror the bench legs: ``vocab``/``units``/``layers``/
+    ``heads`` plus ``max_len`` (position-table size — the admission
+    bound on prompt + generated length).
+    """
+
+    def __init__(self, vocab=256, units=64, layers=2, heads=4,
+                 max_len=1024, seed=0, dtype="float32", donate=None,
+                 interpret=None):
+        import jax
+        import jax.numpy as jnp
+
+        if units % heads:
+            raise ValueError(f"units {units} not divisible by heads "
+                             f"{heads}")
+        self.vocab = int(vocab)
+        self.units = int(units)
+        self.layers = int(layers)
+        self.heads = int(heads)
+        self.head_dim = self.units // self.heads
+        self.max_len = int(max_len)
+        self._interpret = interpret
+        donate = (envvars.get("MXNET_TPU_DECODE_DONATE")
+                  if donate is None else bool(donate))
+        self.donate = donate
+        rng = np.random.RandomState(seed)
+        dt = jnp.dtype(dtype)
+
+        def w(*shape):
+            return jnp.asarray(rng.normal(0.0, 0.02, shape), dt)
+
+        U, V, L = self.units, self.vocab, self.layers
+        p = {"embed": w(V, U), "pos": w(self.max_len, U),
+             "lnf_g": jnp.ones((U,), dt), "lnf_b": jnp.zeros((U,), dt),
+             "head": w(U, V)}
+        for i in range(L):
+            p[f"l{i}_ln1_g"] = jnp.ones((U,), dt)
+            p[f"l{i}_ln1_b"] = jnp.zeros((U,), dt)
+            p[f"l{i}_ln2_g"] = jnp.ones((U,), dt)
+            p[f"l{i}_ln2_b"] = jnp.zeros((U,), dt)
+            for n in ("wq", "wk", "wv", "wo"):
+                p[f"l{i}_{n}"] = w(U, U)
+            p[f"l{i}_w1"] = w(U, 4 * U)
+            p[f"l{i}_b1"] = jnp.zeros((4 * U,), dt)
+            p[f"l{i}_w2"] = w(4 * U, U)
+            p[f"l{i}_b2"] = jnp.zeros((U,), dt)
+        self.params = p
+        kw = {"donate_argnums": (0,)} if donate else {}
+        self._prefill = jax.jit(self._prefill_impl, **kw)
+        self._decode = jax.jit(self._decode_impl, **kw)
+
+    @property
+    def spec(self):
+        """The KV geometry the engine sizes its page pool from."""
+        return {"n_layers": self.layers, "n_heads": self.heads,
+                "head_dim": self.head_dim, "vocab": self.vocab,
+                "max_len": self.max_len}
+
+    # -- shared pieces ------------------------------------------------------
+    def _qkv(self, h, i):
+        """(..., U) -> three (..., H, D) projections."""
+        p = self.params
+        shape = h.shape[:-1] + (self.heads, self.head_dim)
+        return ((h @ p[f"l{i}_wq"]).reshape(shape),
+                (h @ p[f"l{i}_wk"]).reshape(shape),
+                (h @ p[f"l{i}_wv"]).reshape(shape))
+
+    def _mlp(self, x, i):
+        import jax
+
+        p = self.params
+        return jax.nn.gelu(
+            x @ p[f"l{i}_w1"] + p[f"l{i}_b1"]) @ p[f"l{i}_w2"] \
+            + p[f"l{i}_b2"]
+
+    def _ln(self, x, name):
+        return _layer_norm(x, self.params[f"{name}_g"],
+                           self.params[f"{name}_b"])
+
+    def _write(self, caches, i, phys, off, k, v):
+        """Scatter per-position K/V into layer ``i``'s page arrays.
+        ``phys``/``off`` are (T,) page coordinates, ``k``/``v``
+        (T, H, D)."""
+        kc, vc = caches[2 * i], caches[2 * i + 1]
+        kc = kc.at[phys, :, off, :].set(k)
+        vc = vc.at[phys, :, off, :].set(v)
+        return caches[:2 * i] + (kc, vc) + caches[2 * i + 2:]
+
+    # -- prefill ------------------------------------------------------------
+    def _prefill_impl(self, caches, ids, length, phys, off):
+        """One padded prompt row: ids (Lp,) int32, length scalar int32,
+        phys/off (Lp,) page coordinates. Returns (first generated
+        token (), updated caches). Dense causal self-attention (the
+        whole prompt is visible at once — the encoder-shaped phase);
+        K/V land in the pages for the decode steps to read back."""
+        import jax.numpy as jnp
+
+        p = self.params
+        lp = ids.shape[0]
+        positions = jnp.minimum(jnp.arange(lp, dtype=jnp.int32),
+                                np.int32(self.max_len - 1))
+        x = p["embed"][ids] + p["pos"][positions]
+        col = jnp.arange(lp, dtype=jnp.int32)[None, :]
+        row = jnp.arange(lp, dtype=jnp.int32)[:, None]
+        causal = col <= row
+        scale = np.float32(1.0 / np.sqrt(self.head_dim))
+        for i in range(self.layers):
+            h = self._ln(x, f"l{i}_ln1")
+            q, k, v = self._qkv(h, i)          # (Lp, H, D)
+            caches = self._write(caches, i, phys, off, k, v)
+            s = jnp.einsum("qhd,khd->hqk", q.astype(jnp.float32) * scale,
+                           k.astype(jnp.float32))
+            s = jnp.where(causal[None], s, np.float32(-1e30))
+            s = s - jnp.max(s, axis=-1, keepdims=True)
+            w_ = jnp.exp(s)
+            w_ = w_ / jnp.sum(w_, axis=-1, keepdims=True)
+            o = jnp.einsum("hqk,khd->qhd", w_, v.astype(jnp.float32))
+            x = x + o.reshape(lp, self.units).astype(x.dtype) \
+                @ p[f"l{i}_wo"]
+            x = x + self._mlp(self._ln(x, f"l{i}_ln2"), i)
+        h_last = x[length - 1]
+        logits = self._ln(h_last, "lnf") @ p["head"]
+        return jnp.argmax(logits).astype(jnp.int32), caches
+
+    # -- decode -------------------------------------------------------------
+    def _decode_impl(self, caches, ids, positions, tables):
+        """One continuous-batch iteration: ids/positions (R,) int32,
+        tables (R, W) int32 page-table rows. Each row writes its new
+        K/V at ``positions[r]`` and attends over its own pages up to
+        ``positions[r] + 1`` — rows are numerically independent, which
+        is what makes join/leave invisible to the sequences already
+        running (the solo-parity contract)."""
+        import jax.numpy as jnp
+
+        from ..ops import pallas as _pallas
+        from ..ops.pallas.flash_attention import (
+            paged_attention_reference, paged_flash_attention)
+
+        p = self.params
+        r = ids.shape[0]
+        pos_c = jnp.minimum(positions, np.int32(self.max_len - 1))
+        x = p["embed"][ids] + p["pos"][pos_c]       # (R, U)
+        page_size = caches[0].shape[2]
+        phys = jnp.take_along_axis(
+            tables, (positions // np.int32(page_size))[:, None],
+            axis=1)[:, 0]
+        off = positions % np.int32(page_size)
+        kvl = positions + np.int32(1)
+        attend = (paged_flash_attention if _pallas.pallas_enabled()
+                  else paged_attention_reference)
+        for i in range(self.layers):
+            h = self._ln(x, f"l{i}_ln1")
+            q, k, v = self._qkv(h, i)               # (R, H, D)
+            caches = self._write(caches, i, phys, off, k, v)
+            o = attend(q[:, :, None, :], caches[2 * i],
+                       caches[2 * i + 1], tables, kvl)
+            x = x + o[:, :, 0, :].reshape(r, self.units).astype(x.dtype) \
+                @ p[f"l{i}_wo"]
+            x = x + self._mlp(self._ln(x, f"l{i}_ln2"), i)
+        logits = self._ln(x, "lnf") @ p["head"]
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32), caches
+
+    # -- public steps -------------------------------------------------------
+    def prefill(self, caches, ids, length, phys, off):
+        import jax.numpy as jnp
+
+        return self._prefill(caches, jnp.asarray(ids, jnp.int32),
+                             jnp.asarray(length, jnp.int32),
+                             jnp.asarray(phys, jnp.int32),
+                             jnp.asarray(off, jnp.int32))
+
+    def decode_step(self, caches, ids, positions, tables):
+        import jax.numpy as jnp
+
+        return self._decode(caches, jnp.asarray(ids, jnp.int32),
+                            jnp.asarray(positions, jnp.int32),
+                            jnp.asarray(tables, jnp.int32))

@@ -1,0 +1,272 @@
+"""Paged KV cache: the decode engine's attention memory.
+
+The vLLM idea, sized for this serving engine: instead of one
+contiguous (max_len) KV buffer per sequence — whose worst-case
+reservation wastes most of the pool on short chats — the cache is a
+POOL of fixed-size pages (``MXNET_TPU_KV_PAGE_SIZE`` tokens each,
+``MXNET_TPU_KV_PAGES`` total), preallocated once per layer as
+``(P, H, page_size, D)`` device arrays. Each live sequence owns a
+PAGE TABLE (an ordered list of physical page ids); growing past a
+page boundary allocates exactly one more page, and a finished
+sequence returns its pages to the free list the same iteration it
+leaves the batch — memory fragmentation is impossible by construction
+(every page is the same size) and occupancy is a first-class metric.
+
+Isolation is per-page OWNER ATTRIBUTION: a page belongs to exactly
+one sequence for its whole allocation (pages are never shared), the
+pool records the owner, and :meth:`PagedKVPool.check_isolated`
+asserts the invariant (disjoint tables, free pages unowned) — the
+decode analog of the packed encoder path's segment ids. The decode
+kernel (``ops.pallas.flash_attention.paged_flash_attention``) then
+reads K/V through the table with per-row ``kv_len`` masking, so one
+sequence can never attend into another's pages even though they share
+the physical pool.
+
+The pool's arrays flow THROUGH the jitted decode/prefill steps as
+donated buffers (``jax.jit(..., donate_argnums=...)``): the step
+consumes the old cache arrays and returns the updated ones, XLA
+reuses the storage, and steady-state decode performs no per-step
+cache-sized allocation (the resource-watermark assertion in
+tests/test_decode.py pins this).
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from .. import envvars
+from ..telemetry.registry import REGISTRY
+from .queue import ServingError
+
+__all__ = ["KVPagesExhaustedError", "PagedKVPool"]
+
+
+class KVPagesExhaustedError(ServingError):
+    """The page pool cannot hold another page: backpressure for the
+    decode admission path (the engine defers the join — the request
+    waits in the prefill queue until pages recycle)."""
+
+
+def _kv_pages_gauge(registry=None):
+    reg = registry if registry is not None else REGISTRY
+    return reg.gauge(
+        "mxnet_tpu_serving_kv_pages",
+        "paged KV cache pool pages by state (used/free), per engine",
+        ("engine_id", "state"))
+
+
+def _kv_events_counter(registry=None):
+    reg = registry if registry is not None else REGISTRY
+    return reg.counter(
+        "mxnet_tpu_serving_kv_page_events_total",
+        "paged KV cache pool events: alloc/free (pages) and exhausted "
+        "(refused allocations), per engine", ("engine_id", "event"))
+
+
+class PagedKVPool:
+    """Fixed-size-page KV pool with per-sequence page tables.
+
+    Parameters
+    ----------
+    n_layers / n_heads / head_dim : the model's KV geometry — one
+        (K, V) page array pair per layer, shaped
+        ``(n_pages, n_heads, page_size, head_dim)``.
+    page_size : tokens per page (default ``MXNET_TPU_KV_PAGE_SIZE``).
+    n_pages : pool capacity (default ``MXNET_TPU_KV_PAGES``).
+    dtype : cache dtype (the model's activation dtype).
+    engine_id : label for the pool's metric families.
+
+    ``caches`` is a flat tuple ``(k0, v0, k1, v1, ...)`` — the pytree
+    the jitted decode step takes as its DONATED first argument and
+    returns updated; the engine writes the returned tuple back with
+    :meth:`swap`. All bookkeeping (free list, tables, owners) is
+    host-side and thread-safe; array contents are only ever touched
+    inside the jitted steps.
+    """
+
+    def __init__(self, n_layers, n_heads, head_dim, page_size=None,
+                 n_pages=None, dtype="float32", engine_id="default",
+                 registry=None):
+        import jax.numpy as jnp
+
+        self.page_size = int(page_size if page_size is not None
+                             else envvars.get("MXNET_TPU_KV_PAGE_SIZE"))
+        self.n_pages = int(n_pages if n_pages is not None
+                           else envvars.get("MXNET_TPU_KV_PAGES"))
+        if self.page_size < 1 or self.n_pages < 1:
+            raise ValueError(
+                f"bad page pool geometry: {self.n_pages} pages of "
+                f"{self.page_size} tokens")
+        self.n_layers = int(n_layers)
+        self.engine_id = str(engine_id)
+        # one extra SCRATCH page (id n_pages, never allocated): padded
+        # decode-batch rows and prefill tail padding write there, so a
+        # dummy row can never clobber a live sequence's page
+        self.scratch_page = self.n_pages
+        shape = (self.n_pages + 1, int(n_heads), self.page_size,
+                 int(head_dim))
+        self.caches = tuple(
+            jnp.zeros(shape, dtype=jnp.dtype(dtype))
+            for _ in range(2 * self.n_layers))
+        self._lock = threading.Lock()
+        # LIFO free list: a just-freed (cache-warm) page is reused first
+        self._free = list(range(self.n_pages - 1, -1, -1))
+        self._tables = {}               # owner -> [page ids] in order
+        # per-page attribution (+1: the scratch page, never owned)
+        self._owner = [None] * (self.n_pages + 1)
+        ev = _kv_events_counter(registry)
+        self._c_alloc = ev.labels(engine_id=self.engine_id, event="alloc")
+        self._c_free = ev.labels(engine_id=self.engine_id, event="free")
+        self._c_exhausted = ev.labels(engine_id=self.engine_id,
+                                      event="exhausted")
+        g = _kv_pages_gauge(registry)
+        # pull gauges: scrape-time reads, zero hot-path cost
+        g.labels(engine_id=self.engine_id, state="used") \
+            .set_function(lambda: self.n_pages - len(self._free))
+        g.labels(engine_id=self.engine_id, state="free") \
+            .set_function(lambda: len(self._free))
+
+    # -- geometry ----------------------------------------------------------
+    def pages_for(self, kv_len):
+        """Pages needed to hold ``kv_len`` tokens."""
+        return -(-int(kv_len) // self.page_size)
+
+    @property
+    def bytes_total(self):
+        return sum(int(np.prod(c.shape)) * c.dtype.itemsize
+                   for c in self.caches)
+
+    # -- allocation --------------------------------------------------------
+    def ensure(self, owner, kv_len):
+        """Grow ``owner``'s table to hold ``kv_len`` tokens; returns
+        the table. Atomic: either every page needed is allocated or
+        none is (:class:`KVPagesExhaustedError`) — a half-grown
+        sequence could never run its next step."""
+        need_pages = self.pages_for(kv_len)
+        with self._lock:
+            table = self._tables.setdefault(owner, [])
+            grow = need_pages - len(table)
+            if grow <= 0:
+                return list(table)
+            if grow > len(self._free):
+                self._c_exhausted.inc()
+                raise KVPagesExhaustedError(
+                    f"KV pool exhausted: need {grow} more pages for "
+                    f"{owner!r}, {len(self._free)} free of "
+                    f"{self.n_pages}")
+            for _ in range(grow):
+                page = self._free.pop()
+                self._owner[page] = owner
+                table.append(page)
+            self._c_alloc.inc(grow)
+            return list(table)
+
+    def release(self, owner):
+        """Recycle every page ``owner`` holds (the sequence left the
+        batch); returns the number freed. Unknown owners free 0 —
+        release is idempotent by design (leave paths can race stop)."""
+        with self._lock:
+            table = self._tables.pop(owner, None)
+            if not table:
+                return 0
+            for page in table:
+                self._owner[page] = None
+                self._free.append(page)
+            self._c_free.inc(len(table))
+            return len(table)
+
+    # -- inspection --------------------------------------------------------
+    def table(self, owner):
+        """``owner``'s page table (a copy), or None."""
+        with self._lock:
+            t = self._tables.get(owner)
+            return list(t) if t is not None else None
+
+    def owner_of(self, page):
+        with self._lock:
+            return self._owner[int(page)]
+
+    def occupancy(self):
+        """Pool occupancy snapshot — the /stats + bench number."""
+        with self._lock:
+            used = self.n_pages - len(self._free)
+            owners = len(self._tables)
+        return {"pages_total": self.n_pages, "pages_used": used,
+                "pages_free": self.n_pages - used, "sequences": owners,
+                "page_size": self.page_size,
+                "occupancy": round(used / float(self.n_pages), 4)}
+
+    def check_isolated(self):
+        """Assert the attribution invariants: live tables are pairwise
+        disjoint, every table page is attributed to its owner, free
+        pages are unowned, and used + free == total. Raises
+        ``AssertionError`` on violation (tests and drills call this;
+        production code paths maintain it by construction)."""
+        with self._lock:
+            seen = {}
+            for owner, table in self._tables.items():
+                for page in table:
+                    assert page not in seen, (
+                        f"page {page} shared by {seen[page]!r} and "
+                        f"{owner!r}")
+                    seen[page] = owner
+                    assert self._owner[page] == owner, (
+                        f"page {page} attributed to "
+                        f"{self._owner[page]!r}, tabled by {owner!r}")
+            for page in self._free:
+                assert self._owner[page] is None, (
+                    f"free page {page} still attributed to "
+                    f"{self._owner[page]!r}")
+                assert page not in seen, f"free page {page} is tabled"
+            assert len(seen) + len(self._free) == self.n_pages
+        return True
+
+    # -- batch views -------------------------------------------------------
+    def padded_tables(self, owners, width):
+        """(R, width) int32 page-table batch for the decode step: row
+        r is ``owners[r]``'s table padded with the scratch page (the
+        kernel's per-row kv_len mask keeps padding slots dead — but a
+        PAD ROW's write must land somewhere no live sequence owns)."""
+        out = np.full((len(owners), int(width)), self.scratch_page,
+                      np.int32)
+        with self._lock:
+            for r, owner in enumerate(owners):
+                table = self._tables.get(owner, ())
+                if len(table) > out.shape[1]:
+                    raise ValueError(
+                        f"table width {width} cannot hold {owner!r}'s "
+                        f"{len(table)} pages")
+                out[r, :len(table)] = table
+        return out
+
+    def scatter_indices(self, owner, valid, padded=None):
+        """(physical_page, offset) int32 arrays addressing logical
+        positions ``0 .. padded-1`` of ``owner``'s sequence — the
+        prefill writer's scatter coordinates. Positions at/after
+        ``valid`` (the padded tail of a bucketed prefill) map to the
+        scratch page, so one compile per padded length serves every
+        request in the bucket. The table must already cover ``valid``
+        tokens (call :meth:`ensure` first)."""
+        padded = int(valid) if padded is None else int(padded)
+        pos = np.arange(padded)
+        logical = pos // self.page_size
+        with self._lock:
+            table = np.asarray(self._tables.get(owner, ()), np.int64)
+        need = self.pages_for(valid)
+        if need > len(table):
+            raise ValueError(
+                f"{owner!r}'s table ({len(table)} pages) does not "
+                f"cover {valid} tokens")
+        phys = np.full(padded, self.scratch_page, np.int64)
+        live = pos < int(valid)
+        phys[live] = table[logical[live]]
+        return phys.astype(np.int32), (pos % self.page_size).astype(
+            np.int32)
+
+    def swap(self, caches):
+        """Install the jitted step's returned cache arrays (the donated
+        inputs are dead after the call)."""
+        if len(caches) != len(self.caches):
+            raise ValueError("cache arity mismatch")
+        self.caches = tuple(caches)

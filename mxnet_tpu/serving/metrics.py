@@ -36,8 +36,8 @@ from ..telemetry import spans as _spans
 from ..telemetry.registry import REGISTRY
 
 __all__ = ["LatencySummary", "ServingStats", "CostLedger",
-           "DispatchOverhead", "nearest_rank", "merge_cost_buckets",
-           "exemplar_gate", "slow_exemplar",
+           "DispatchOverhead", "DecodeStats", "nearest_rank",
+           "merge_cost_buckets", "exemplar_gate", "slow_exemplar",
            "wire_frames_counter", "wire_bytes_counter",
            "wire_connections_gauge", "wire_refusals_counter",
            "wire_fallback_counter"]
@@ -153,6 +153,111 @@ class DispatchOverhead:
         with self._lock:
             items = list(self._summaries.items())
         return {t: s.snapshot() for t, s in items}
+
+
+# inter-token latency boundaries (ms): steady-state decode iterations
+# are model-forward-sized — finer than the default request buckets,
+# coarser than the wire-overhead ones
+_INTER_TOKEN_BUCKETS = (1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
+                        500.0, 1000.0, 2500.0, 10000.0)
+
+
+class DecodeStats:
+    """Decode-loop observability bundle for one ``DecodeEngine`` —
+    the token-level numbers the request-level :class:`ServingStats`
+    has no axis for: inter-token latency (THE decode SLI — the default
+    ``decode_inter_token`` LatencySLO judges its histogram), time to
+    first token, generated-token throughput, and slot churn
+    (join/leave events at iteration boundaries). KV-page occupancy
+    lives on the pool's own gauges (``serving/kvcache.py``)."""
+
+    def __init__(self, engine_id, window=4096, registry=None):
+        reg = registry if registry is not None else REGISTRY
+        self.engine_id = str(engine_id)
+        self.window = window          # public: reset_stats reads this
+        eid = self.engine_id
+        self.inter_token_ms = LatencySummary(
+            window, reg.histogram(
+                "mxnet_tpu_serving_inter_token_latency_ms",
+                "wall time between consecutive generated tokens of one "
+                "sequence (the decode-path SLI), per engine",
+                ("engine_id",), buckets=_INTER_TOKEN_BUCKETS)
+            .labels(engine_id=eid))
+        self.ttft_ms = LatencySummary(
+            window, reg.histogram(
+                "mxnet_tpu_serving_ttft_ms",
+                "time to first token: submit to the prefill's first "
+                "generated token, per engine", ("engine_id",))
+            .labels(engine_id=eid))
+        self._c_tokens = reg.counter(
+            "mxnet_tpu_serving_decode_tokens_total",
+            "generated tokens, per engine", ("engine_id",)) \
+            .labels(engine_id=eid)
+        self._c_iters = reg.counter(
+            "mxnet_tpu_serving_decode_iterations_total",
+            "decode-loop iterations dispatched, per engine",
+            ("engine_id",)).labels(engine_id=eid)
+        slot = reg.counter(
+            "mxnet_tpu_serving_decode_slot_events_total",
+            "decode-batch slot churn: sequences joining at an "
+            "iteration boundary and leaving on EOS/max-tokens, per "
+            "engine", ("engine_id", "event"))
+        self._c_join = slot.labels(engine_id=eid, event="join")
+        self._c_leave = slot.labels(engine_id=eid, event="leave")
+        self._q_split = reg.gauge(
+            "mxnet_tpu_serving_decode_queue_split",
+            "decode scheduler population by phase: requests waiting "
+            "for prefill vs sequences in the decode batch, per engine",
+            ("engine_id", "phase"))
+        self._lock = threading.Lock()
+        self._tokens = 0
+        self._iters = 0
+        self._joins = 0
+        self._leaves = 0
+        self._slot_steps = 0      # rows dispatched across iterations
+        self._active_steps = 0    # live rows among them (utilization)
+
+    def set_split_fns(self, prefill_fn, decode_fn):
+        """Wire the phase-split pull gauges (scrape-time reads)."""
+        self._q_split.labels(engine_id=self.engine_id,
+                             phase="prefill").set_function(prefill_fn)
+        self._q_split.labels(engine_id=self.engine_id,
+                             phase="decode").set_function(decode_fn)
+
+    def observe_token(self, n=1):
+        """One generated token (prefill's first token and every
+        iteration token land here, at emission)."""
+        with self._lock:
+            self._tokens += n
+        self._c_tokens.inc(n)
+
+    def observe_iteration(self, rows, active):
+        with self._lock:
+            self._iters += 1
+            self._slot_steps += rows
+            self._active_steps += active
+        self._c_iters.inc()
+
+    def observe_join(self, n=1):
+        with self._lock:
+            self._joins += n
+        self._c_join.inc(n)
+
+    def observe_leave(self, n=1):
+        with self._lock:
+            self._leaves += n
+        self._c_leave.inc(n)
+
+    def snapshot(self):
+        with self._lock:
+            out = {"tokens": self._tokens, "iterations": self._iters,
+                   "joins": self._joins, "leaves": self._leaves,
+                   "slot_utilization": (
+                       round(self._active_steps / self._slot_steps, 4)
+                       if self._slot_steps else None)}
+        out["inter_token"] = self.inter_token_ms.snapshot()
+        out["ttft"] = self.ttft_ms.snapshot()
+        return out
 
 
 def nearest_rank(sorted_xs, p):
@@ -307,6 +412,36 @@ class CostLedger:
         if valid_tokens:
             self._tok.labels(engine_id=self.engine_id,
                              bucket=bucket_len).inc(valid_tokens)
+
+    def observe_decode(self, rows_bucket, seconds, tokens, completed,
+                       compiled):
+        """One decode-loop iteration, keyed by the NEGATED rows bucket
+        (decode batches have no row length; the sign keeps the decode
+        key space disjoint from prefill prompt-length buckets even
+        when ``max_rows`` overlaps a bucket value — ``-8`` reads as "a
+        decode batch of 8 rows"). Every iteration carries live
+        requests by construction, so its wall lands in ``request_s`` —
+        the engine amortizes the same seconds across the member
+        sequences' bills, keeping the sum(bills) == request_s
+        exactness contract. ``completed`` counts the sequences that
+        FINISHED this iteration (requests are counted once, at leave,
+        not once per token)."""
+        kind = "compile" if compiled else "device"
+        with self._lock:
+            row = self._row(rows_bucket)
+            row["compile_s" if compiled else "device_s"] += seconds
+            row["request_s"] += seconds
+            row["requests"] += completed
+            row["valid_tokens"] += tokens
+            row["batches"] += 1
+        self._sec.labels(engine_id=self.engine_id, bucket=rows_bucket,
+                         kind=kind).inc(seconds)
+        if completed:
+            self._req.labels(engine_id=self.engine_id,
+                             bucket=rows_bucket).inc(completed)
+        if tokens:
+            self._tok.labels(engine_id=self.engine_id,
+                             bucket=rows_bucket).inc(tokens)
 
     def observe_warmup(self, bucket_len, seconds, compiled):
         """A dummy warmup forward (no requests): compile seconds count

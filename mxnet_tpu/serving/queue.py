@@ -63,6 +63,17 @@ class InferenceFuture:
     the batch forward, ``compiled`` (first-visit batch), ``tokens``
     and ``batch_requests`` — None until dispatched (sheds and
     pre-dispatch expiries never ran, so they cost nothing).
+
+    STREAMING: a decode request's future also carries the token
+    stream. The engine (or the router/wire relaying for a remote one)
+    delivers each generated token with :meth:`push_part`; consumers
+    either iterate :meth:`stream` (blocking generator — the client
+    shape) or register :meth:`add_part_callback` (the relay shape:
+    wire listeners and routers forward parts without a thread per
+    request). Parts are ADVISORY latency signal — ``result()`` always
+    returns the complete, authoritative output, so a consumer that
+    lost parts (killed connection) misses nothing by waiting for the
+    final result instead.
     """
 
     def __init__(self):
@@ -70,7 +81,18 @@ class InferenceFuture:
         self._value = None
         self._exc = None
         self._lock = threading.Lock()
+        # one condition over the same lock wakes stream() readers on
+        # both new parts and completion
+        self._parts_cv = threading.Condition(self._lock)
         self._callbacks = []
+        self._parts = []
+        # part-callback entries are [fn, cursor] pairs: deliveries are
+        # driven by a SINGLE drainer at a time (the _part_draining
+        # flag), so every callback sees parts strictly in order even
+        # when a registration's replay races fresh pushes from the
+        # engine worker — and no lock is ever held across a callback
+        self._part_callbacks = []
+        self._part_draining = False
         self.cost = None
 
     def done(self):
@@ -92,6 +114,8 @@ class InferenceFuture:
             self._exc = exc
             self._event.set()
             callbacks, self._callbacks = self._callbacks, []
+            self._part_callbacks = []
+            self._parts_cv.notify_all()
         for cb in callbacks:
             self._run_callback(cb)
 
@@ -121,6 +145,92 @@ class InferenceFuture:
                 self._callbacks.append(fn)
                 return
         self._run_callback(fn)
+
+    # -- streaming (decode token parts) ------------------------------------
+    def _drain_parts(self):
+        """Deliver pending parts to registered part callbacks, in
+        order, from exactly ONE thread at a time. Callers must have
+        set ``_part_draining`` under the lock before calling; the
+        drain releases it when no work remains. Callbacks run OUTSIDE
+        the lock (same contract as done-callbacks); the single-drainer
+        discipline is what keeps a registration's replay from racing a
+        fresh push into out-of-order delivery."""
+        while True:
+            with self._lock:
+                work = []
+                for entry in self._part_callbacks:
+                    cur = entry[1]
+                    if cur < len(self._parts):
+                        work.append((entry[0], self._parts[cur]))
+                        entry[1] = cur + 1
+                if not work:
+                    self._part_draining = False
+                    return
+            for fn, part in work:
+                try:
+                    fn(self, part)
+                except Exception as e:
+                    _events.emit("future_callback_error",
+                                 trace_id=getattr(self, "trace_id",
+                                                  None),
+                                 error=repr(e))
+
+    def push_part(self, part):
+        """Deliver one streamed partial (a generated-token dict).
+        Returns False once the future is resolved — late parts from a
+        racing completion are dropped, never delivered out of order
+        after the final result."""
+        with self._lock:
+            if self._event.is_set():
+                return False
+            self._parts.append(part)
+            self._parts_cv.notify_all()
+            if self._part_draining or not self._part_callbacks:
+                return True
+            self._part_draining = True
+        self._drain_parts()
+        return True
+
+    def add_part_callback(self, fn):
+        """Call ``fn(self, part)`` for every streamed part — parts
+        already received are replayed first (even on a resolved
+        future: a relay attached late misses nothing), and replay vs
+        concurrent pushes stays strictly ordered (the single-drainer
+        discipline above)."""
+        with self._lock:
+            self._part_callbacks.append([fn, 0])
+            if self._part_draining:
+                return              # the active drainer picks it up
+            self._part_draining = True
+        self._drain_parts()
+
+    def parts(self):
+        """Snapshot of the parts received so far."""
+        with self._lock:
+            return list(self._parts)
+
+    def stream(self, timeout=None):
+        """Blocking generator over the token parts, ending when the
+        future resolves. ``timeout`` bounds each WAIT for the next
+        part (inter-token patience), not the whole stream. The
+        request's failure — deadline, shutdown, model error — re-
+        raises after the received parts have been yielded, exactly as
+        ``result()`` would raise it."""
+        i = 0
+        while True:
+            with self._parts_cv:
+                while i >= len(self._parts) and not self._event.is_set():
+                    if not self._parts_cv.wait(timeout):
+                        raise TimeoutError(
+                            "no decode token within the stream timeout")
+                if i < len(self._parts):
+                    part = self._parts[i]
+                    i += 1
+                else:
+                    break               # resolved and fully drained
+            yield part
+        if self._exc is not None:
+            raise self._exc
 
     def exception(self, timeout=None):
         if not self._event.wait(timeout):
@@ -270,6 +380,15 @@ class RequestQueue:
             for r in out:
                 r.t_drain = now
             return out
+
+    def requeue(self, request):
+        """Put an already-admitted request back at the FRONT of the
+        line (the decode engine defers a join when the KV page pool is
+        momentarily exhausted). Bypasses the depth bound — the request
+        was admitted once and must not be shed for coming back."""
+        with self._lock:
+            self._dq.appendleft(request)
+            self._not_empty.notify()
 
     def close(self):
         """Refuse new work; queued requests stay drainable (the engine

@@ -166,9 +166,11 @@ class RouterRequest:
 
     __slots__ = ("tokens", "token_types", "deadline", "future",
                  "trace_id", "span", "t_submit", "tried", "engine_id",
-                 "requeues", "cid", "adopted")
+                 "requeues", "cid", "adopted", "decode", "stream",
+                 "parts_seen", "relay_lock")
 
-    def __init__(self, tokens, token_types=None, deadline_ms=None):
+    def __init__(self, tokens, token_types=None, deadline_ms=None,
+                 decode=None, stream=False):
         self.tokens, self.token_types = validate_tokens(tokens,
                                                         token_types)
         self.trace_id = new_trace_id("req")
@@ -190,12 +192,40 @@ class RouterRequest:
         # routers) or minted from the trace id when journaling
         self.cid = None
         self.adopted = False
+        # decode pass-through: generation params riding the dispatch
+        # payload unchanged, and the streamed-parts relay state.
+        # parts_seen is the next part index the CLIENT has not yet
+        # seen: a failover re-run of a (deterministic) decode request
+        # replays indices the client already has — the relay drops
+        # them, so a killed connection mid-stream loses and duplicates
+        # NOTHING
+        self.decode = dict(decode) if decode else None
+        self.stream = bool(stream)
+        self.parts_seen = 0
+        self.relay_lock = threading.Lock()
 
     def remaining_ms(self, now=None):
         if self.deadline is None:
             return None
         return (self.deadline - (now if now is not None
                                  else time.monotonic())) * 1e3
+
+    def relay_part(self, index, token):
+        """Deliver one streamed token to the caller's future, deduped
+        by part index (see ``parts_seen`` above). Seats call this from
+        their transport threads; a request rides one transport at a
+        time, but a FAILOVER's first relays can race a late in-flight
+        partial from the old transport's reader — the lock makes the
+        dedupe check-and-push atomic so no index delivers twice."""
+        if index is None:
+            return
+        index = int(index)
+        with self.relay_lock:
+            if index < self.parts_seen:
+                return
+            self.parts_seen = index + 1
+            self.future.push_part({"index": index, "token": token,
+                                   "final": False})
 
     def expired(self, now=None):
         return (self.deadline is not None
@@ -358,10 +388,23 @@ class _LocalSeat(_Seat):
             done(self, req, EngineStoppedError(
                 f"engine {self.engine_id} seat was removed"), None)
             return
-        fut = self._engine.submit(req.tokens, req.token_types,
-                                  deadline_ms=req.remaining_ms(),
-                                  trace_id=req.trace_id,
-                                  parent_span_id=req.span.span_id)
+        submit_payload = getattr(self._engine, "submit_payload", None)
+        if submit_payload is not None and (req.decode or req.stream):
+            # decode engine: generation params + streaming ride the
+            # payload dict (the same shape the wire/HTTP dispatch uses)
+            fut, _streamed = submit_payload(dict(
+                req.decode or {}, tokens=req.tokens,
+                deadline_ms=req.remaining_ms(), stream=req.stream,
+                trace_id=req.trace_id, span_id=req.span.span_id))
+        else:
+            fut = self._engine.submit(req.tokens, req.token_types,
+                                      deadline_ms=req.remaining_ms(),
+                                      trace_id=req.trace_id,
+                                      parent_span_id=req.span.span_id)
+        if req.stream:
+            fut.add_part_callback(
+                lambda _f, part: req.relay_part(part.get("index"),
+                                                part.get("token")))
 
         def _cb(f):
             exc = f.exception(timeout=0)
@@ -492,7 +535,14 @@ class _RemoteSeat(_Seat):
                    "deadline_ms": req.remaining_ms(),
                    "trace_id": req.trace_id,
                    "span_id": req.span.span_id}
+        if req.decode:
+            payload.update(req.decode)
+        if req.stream:
+            payload["stream"] = True
         t0 = time.perf_counter()
+
+        def _on_part(body):
+            req.relay_part(body.get("seq"), body.get("token"))
 
         def _on_wire(exc, body):
             rt_ms = (time.perf_counter() - t0) * 1e3
@@ -524,7 +574,8 @@ class _RemoteSeat(_Seat):
                            or f"engine {self.engine_id} error")
             done(self, req, exc2, None)
 
-        wire.dispatch(payload, _on_wire, timeout_s)
+        wire.dispatch(payload, _on_wire, timeout_s,
+                      on_part=_on_part if req.stream else None)
 
     # -- dispatch (wire preferred, bounded HTTP/JSON fallback) --------------
     def dispatch(self, req, timeout_s, done):
@@ -554,6 +605,10 @@ class _RemoteSeat(_Seat):
                    "trace_id": req.trace_id,
                    "span_id": req.span.span_id,
                    "timeout_s": timeout_s}
+        if req.decode:
+            payload.update(req.decode)
+        if req.stream:
+            payload["stream"] = True
         t0 = time.perf_counter()
 
         # the /submit long-poll blocks for the whole request; a BOUNDED
@@ -571,9 +626,29 @@ class _RemoteSeat(_Seat):
                     headers={"Content-Type": "application/json"})
                 with urllib.request.urlopen(
                         http_req, timeout=timeout_s + self._timeout) as r:
-                    raw = r.read()
-                    self._b_in_json.inc(len(raw))
-                    body = json.loads(raw.decode())
+                    if req.stream:
+                        # chunked JSON lines: one per generated token,
+                        # final body last (the decode engine's HTTP
+                        # fallback for wire-less routers)
+                        body = None
+                        for line in r:
+                            self._b_in_json.inc(len(line))
+                            if not line.strip():
+                                continue
+                            part = json.loads(line.decode())
+                            if part.get("final", True):
+                                body = part
+                                break
+                            req.relay_part(part.get("seq"),
+                                           part.get("token"))
+                        if body is None:
+                            raise RemoteEngineError(
+                                f"engine {self.engine_id} stream ended "
+                                "without a final body")
+                    else:
+                        raw = r.read()
+                        self._b_in_json.inc(len(raw))
+                        body = json.loads(raw.decode())
             except urllib.error.HTTPError as e:
                 try:
                     body = json.loads(e.read().decode())
@@ -585,7 +660,16 @@ class _RemoteSeat(_Seat):
                     f"engine {self.engine_id} unreachable: {e!r}")
             if exc is None:
                 if body.get("ok"):
-                    value = np.asarray(body["result"], np.float32)
+                    # decode results are token ids (the engine tags
+                    # its reply, covering requests that rode engine
+                    # defaults); the encoder path keeps its historical
+                    # float JSON round trip
+                    value = np.asarray(body["result"],
+                                       np.int32 if (req.decode
+                                                    or req.stream
+                                                    or body.get(
+                                                        "decode"))
+                                       else np.float32)
                     cost = body.get("cost")
                     engine_ms = body.get("engine_ms")
                     if self._overhead is not None \
@@ -1097,7 +1181,8 @@ class ServingRouter:
 
     # -- client surface ----------------------------------------------------
     def submit(self, tokens, token_types=None, deadline_ms=None,
-               cid=None):
+               cid=None, max_new_tokens=None, eos_id=None,
+               stream=False):
         """Admit one request; returns an :class:`InferenceFuture`
         whose ``trace_id`` names the request fleet-wide. Sheds loudly:
         :class:`QueueFullError` (router queue at bound),
@@ -1109,16 +1194,31 @@ class ServingRouter:
         the already-adopted/live request instead of duplicating work.
         With an HA peer configured, every admitted request is
         journaled (cid + payload) to the peer before it becomes
-        dispatchable, so a router death orphans nothing."""
+        dispatchable, so a router death orphans nothing.
+
+        ``max_new_tokens``/``eos_id``/``stream`` are the DECODE
+        pass-through (seats fronting a :class:`~.decode.DecodeEngine`):
+        generation params ride the dispatch payload unchanged, and
+        with ``stream=True`` the returned future's :meth:`~.queue.
+        InferenceFuture.stream` yields each generated token as the
+        engine produces it — over the wire as partial RESULT frames,
+        over HTTP as chunked JSON lines, in-process as direct part
+        relays, deduped by index across failover."""
         if deadline_ms is None:
             deadline_ms = self._default_deadline_ms
         if cid is not None and self._c_ha is not None:
             existing = self._ha_lookup(str(cid))
             if existing is not None:
                 return existing
+        decode = {}
+        if max_new_tokens is not None:
+            decode["max_new_tokens"] = int(max_new_tokens)
+        if eos_id is not None:
+            decode["eos_id"] = int(eos_id)
         # validate FIRST (same invariant as the engine: submitted ==
         # sum of outcome counters, malformed requests touch nothing)
-        req = RouterRequest(tokens, token_types, deadline_ms)
+        req = RouterRequest(tokens, token_types, deadline_ms,
+                            decode=decode or None, stream=stream)
         self._bump("submitted")
         # journal only requests that LOOK admittable: shedding must
         # stay cheap under overload (no peer round trip per refusal).
@@ -1672,6 +1772,8 @@ class ServingRouter:
             entry = {"tokens": payload.get("tokens"),
                      "token_types": payload.get("token_types"),
                      "deadline_ms": payload.get("deadline_ms"),
+                     "decode": payload.get("decode"),
+                     "stream": bool(payload.get("stream")),
                      "router_id": payload.get("router_id"),
                      "t": time.monotonic()}
             dropped = 0
@@ -1743,6 +1845,8 @@ class ServingRouter:
                            "tokens": req.tokens,
                            "token_types": req.token_types,
                            "deadline_ms": req.remaining_ms(),
+                           "decode": req.decode,
+                           "stream": req.stream,
                            "router_id": self.router_id},
                           _on_ack, self._ha_ack_s)
         except WireError:
@@ -1883,7 +1987,9 @@ class ServingRouter:
                     continue
             try:
                 req = RouterRequest(e["tokens"], e.get("token_types"),
-                                    deadline_ms)
+                                    deadline_ms,
+                                    decode=e.get("decode"),
+                                    stream=bool(e.get("stream")))
             except Exception as exc:
                 fut.set_exception(ServingError(
                     f"adopted journal entry {cid} unusable: {exc!r}"))

@@ -323,6 +323,24 @@ class _FrameWriter:
                 self._on_sent(tag, n)
 
 
+def _hard_close(sock):
+    """shutdown(SHUT_RDWR) + close. A bare ``close()`` on a socket
+    whose OWN reader thread is blocked in ``recv`` does not release
+    the kernel socket on Linux (the in-flight syscall holds the file
+    reference) — no FIN is sent, the PEER never sees EOF, and a
+    killed connection looks alive from the other side forever.
+    ``shutdown`` tears the TCP stream down immediately and wakes the
+    blocked reader regardless."""
+    try:
+        sock.shutdown(socket.SHUT_RDWR)
+    except OSError:
+        pass
+    try:
+        sock.close()
+    except OSError:
+        pass
+
+
 def _safe_callback(cb, *args):
     """Invoke a completion callback; a broken observer must not kill
     the wire thread that delivered its result (same contract as
@@ -427,10 +445,7 @@ class WireListener:
         except OSError:
             pass
         for conn in conns:
-            try:
-                conn.close()          # unblocks the reader threads
-            except OSError:
-                pass
+            _hard_close(conn)         # unblocks readers, FINs peers
 
     def kill_connections(self):
         """Abruptly close every ACCEPTED connection (the listener keeps
@@ -440,10 +455,11 @@ class WireListener:
         with self._lock:
             conns = list(self._open)
         for conn in conns:
-            try:
-                conn.close()
-            except OSError:
-                pass
+            # shutdown, not just close: this conn's own reader thread
+            # is blocked in recv, and without SHUT_RDWR no FIN ever
+            # reaches the peer — the "killed" connection would look
+            # alive from the router side indefinitely
+            _hard_close(conn)
         return len(conns)
 
     def _count_in(self, tag, n):
@@ -576,12 +592,19 @@ class WireListener:
                          dict(body or {}, engine_id=self._owner_id)))
             return
         t0 = time.perf_counter()
+        submit_payload = getattr(self._engine, "submit_payload", None)
         try:
-            fut = self._engine.submit(
-                payload.get("tokens"), payload.get("token_types"),
-                deadline_ms=payload.get("deadline_ms"),
-                trace_id=payload.get("trace_id"),
-                parent_span_id=payload.get("span_id"))
+            if submit_payload is not None:
+                # decode engines take the whole payload (generation
+                # params + the stream flag ride the same dict)
+                fut, streamed = submit_payload(payload)
+            else:
+                fut = self._engine.submit(
+                    payload.get("tokens"), payload.get("token_types"),
+                    deadline_ms=payload.get("deadline_ms"),
+                    trace_id=payload.get("trace_id"),
+                    parent_span_id=payload.get("span_id"))
+                streamed = False
         except Exception as e:
             # admission failure (queue full, too long, stopped,
             # malformed tokens): the class name rides back so the
@@ -591,6 +614,22 @@ class WireListener:
                           "error": str(e),
                           "engine_id": self._engine.engine_id}))
             return
+
+        if streamed:
+            # one partial RESULT frame per generated token, demuxed by
+            # the SAME correlation id ("seq" orders, "final": False
+            # marks the partial; the frame stays MINIMAL — the
+            # correlation id already names the request, trace id and
+            # cost ride the final body). A peer that never asked for
+            # streaming gets exactly one RESULT with no "final" key —
+            # the pre-streaming protocol, so old peers keep working.
+            def _part(_f, part):
+                writer.send((FRAME_RESULT, corr,
+                             {"seq": int(part.get("index", 0)),
+                              "token": part.get("token"),
+                              "final": False}))
+
+            fut.add_part_callback(_part)
 
         def _done(f):
             engine_ms = round((time.perf_counter() - t0) * 1e3, 3)
@@ -602,12 +641,19 @@ class WireListener:
                               "engine_ms": engine_ms,
                               "engine_id": self._engine.engine_id}))
                 return
-            writer.send((FRAME_RESULT, corr,
-                         {"result": np.asarray(f.result(timeout=0)),
-                          "cost": f.cost,
-                          "trace_id": f.trace_id,
-                          "engine_ms": engine_ms,
-                          "engine_id": self._engine.engine_id}))
+            body = {"result": np.asarray(f.result(timeout=0)),
+                    "cost": f.cost,
+                    "trace_id": f.trace_id,
+                    "engine_ms": engine_ms,
+                    "engine_id": self._engine.engine_id}
+            if streamed:
+                # the final frame carries the AUTHORITATIVE full
+                # sequence: a client that lost partials (killed
+                # connection) misses nothing, one that has them can
+                # verify seq count
+                body["final"] = True
+                body["seq"] = len(f.parts())
+            writer.send((FRAME_RESULT, corr, body))
 
         fut.add_done_callback(_done)
 
@@ -624,7 +670,10 @@ class _WireConn:
         self.sock = sock
         self.writer = None
         self.reader = None
-        self.pending = {}             # corr_id -> (on_done, deadline)
+        # corr_id -> (on_done, deadline, on_part, timeout_s); a
+        # streamed partial refreshes the deadline (token progress IS
+        # liveness)
+        self.pending = {}
         self.plock = threading.Lock()
         self.alive = True
         self.pongs = {}               # ping nonce -> Event
@@ -830,10 +879,7 @@ class WireClient:
         if not was_alive and not orphans:
             return
         conn.writer.close()
-        try:
-            conn.sock.close()         # unblocks the reader
-        except OSError:
-            pass
+        _hard_close(conn.sock)        # FIN + wake the blocked reader
         if was_alive:
             self._conns_g.dec()
         for evt in pongs:
@@ -842,15 +888,19 @@ class WireClient:
             f"wire connection to {self._host}:{self._port} lost"
             + (f": {error!r}" if error is not None else "")
             + (f" ({len(orphans)} in flight)" if orphans else ""))
-        for _corr, (on_done, _deadline) in orphans:
-            _safe_callback(on_done, exc, None)
+        for _corr, entry in orphans:
+            _safe_callback(entry[0], exc, None)
 
     # -- dispatch (router dispatcher thread) --------------------------------
-    def dispatch(self, payload, on_done, timeout_s):
+    def dispatch(self, payload, on_done, timeout_s, on_part=None):
         """Queue one SUBMIT on a live connection. ``on_done(exc, body)``
         fires exactly once: with the RESULT/ERROR frame body (exc None)
         on the connection's reader thread, or with a :class:`WireError`
         when the connection dies or the reply outlives ``timeout_s``.
+        ``on_part(body)`` (optional) fires once per streamed partial
+        RESULT frame (``final: False``) BEFORE the final delivery;
+        each partial refreshes the reply deadline — a long generation
+        making token progress is alive, only a silent one times out.
         Raises :class:`WireError` when no live connection exists — the
         caller falls back (HTTP) or fails over."""
         deadline = time.monotonic() + float(timeout_s) + self._timeout
@@ -864,7 +914,8 @@ class WireClient:
             with conn.plock:
                 if not conn.alive:
                     continue
-                conn.pending[corr] = (on_done, deadline)
+                conn.pending[corr] = (on_done, deadline, on_part,
+                                      float(timeout_s))
             if not conn.writer.send((FRAME_SUBMIT, corr, payload)):
                 with conn.plock:
                     delivered = conn.pending.pop(corr, None) is None
@@ -915,10 +966,9 @@ class WireClient:
                 continue
             expired = []
             with conn.plock:
-                for corr, (on_done, deadline) in list(
-                        conn.pending.items()):
-                    if now > deadline:
-                        expired.append((corr, on_done))
+                for corr, entry in list(conn.pending.items()):
+                    if now > entry[1]:
+                        expired.append((corr, entry[0]))
                         del conn.pending[corr]
             for corr, on_done in expired:
                 _safe_callback(on_done, WireError(
@@ -941,6 +991,31 @@ class WireClient:
                 if tag in (FRAME_RESULT, FRAME_ERROR) \
                         and len(frame) >= 3:
                     corr = frame[1]
+                    body = frame[2] if isinstance(frame[2], dict) \
+                        else {"error_type": "WireError",
+                              "error": "malformed reply body"}
+                    if tag == FRAME_RESULT \
+                            and body.get("final") is False:
+                        # streamed partial: deliver to the part hook,
+                        # KEEP the pending entry, refresh its deadline
+                        # (token progress is liveness). A peer
+                        # streaming at a non-streaming entry (no
+                        # on_part) is ignored — the final RESULT still
+                        # resolves it.
+                        on_part = None
+                        with conn.plock:
+                            entry = (conn.pending.get(corr)
+                                     if isinstance(corr, int) else None)
+                            if entry is not None \
+                                    and entry[2] is not None:
+                                on_done, _dl, on_part, t_s = entry
+                                conn.pending[corr] = (
+                                    on_done,
+                                    time.monotonic() + t_s
+                                    + self._timeout, on_part, t_s)
+                        if on_part is not None:
+                            _safe_callback(on_part, body)
+                        continue
                     with conn.plock:
                         entry = (conn.pending.pop(corr, None)
                                  if isinstance(corr, int) else None)
@@ -952,11 +1027,7 @@ class WireClient:
                                      host=self._host, port=self._port,
                                      corr=repr(corr))
                         continue
-                    on_done, _deadline = entry
-                    body = frame[2] if isinstance(frame[2], dict) \
-                        else {"error_type": "WireError",
-                              "error": "malformed reply body"}
-                    _safe_callback(on_done, None, body)
+                    _safe_callback(entry[0], None, body)
                 elif tag == FRAME_PONG and len(frame) >= 2:
                     with conn.plock:
                         evt = conn.pongs.pop(frame[1], None)

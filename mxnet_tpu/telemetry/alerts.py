@@ -621,6 +621,24 @@ def default_serving_objectives(evaluator, engine_id):
     return names
 
 
+def default_decode_objectives(evaluator, engine_id):
+    """The decode-engine objective set: the serving defaults PLUS the
+    inter-token latency quantile — the SLI that makes a stuttering
+    token stream page even while whole-request latency still looks
+    fine. Returns the added SLO names."""
+    names = default_serving_objectives(evaluator, engine_id)
+    evaluator.add(LatencySLO(
+        "decode_inter_token",
+        threshold_ms=envvars.get("MXNET_TPU_SLO_INTER_TOKEN_MS"),
+        target=envvars.get("MXNET_TPU_SLO_LATENCY_TARGET"),
+        family="mxnet_tpu_serving_inter_token_latency_ms",
+        match={"engine_id": engine_id},
+        description="generated tokens arriving under the inter-token "
+                    "latency bound"))
+    names.append("decode_inter_token")
+    return names
+
+
 def default_router_objectives(evaluator, router):
     """The default fleet objective set: availability across failover
     (router outcomes), fleet latency quantile, and the routable-engine

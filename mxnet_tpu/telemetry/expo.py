@@ -135,6 +135,13 @@ class TelemetryServer:
         server = self
 
         class Handler(BaseHTTPRequestHandler):
+            # HTTP/1.1: chunked transfer (the streamed /submit path)
+            # does not exist in 1.0 — a spec-following client/proxy
+            # would ignore the header and see raw chunk framing. Every
+            # non-chunked reply sets Content-Length, so 1.1 keep-alive
+            # semantics stay correct.
+            protocol_version = "HTTP/1.1"
+
             def log_message(self, *a):      # scrapes must not spam stderr
                 pass
 
@@ -314,8 +321,30 @@ class TelemetryServer:
             code, body = 500, {"ok": False,
                                "error_type": type(e).__name__,
                                "error": str(e)}
-        self._reply(handler, code, "application/json",
-                    json.dumps(body, default=str).encode())
+        if isinstance(body, dict):
+            self._reply(handler, code, "application/json",
+                        json.dumps(body, default=str).encode())
+            return
+        # a PART ITERATOR (streamed decode dispatch): chunked JSON
+        # lines, one per generated token, the final body last — the
+        # HTTP fallback for peers without the binary wire. A client
+        # hanging up mid-stream closes the generator; the engine keeps
+        # generating (parts are advisory, the future is authoritative).
+        handler.send_response(code)
+        handler.send_header("Content-Type", "application/jsonl")
+        handler.send_header("Transfer-Encoding", "chunked")
+        handler.end_headers()
+        try:
+            for part in body:
+                data = (json.dumps(part, default=str) + "\n").encode()
+                handler.wfile.write(
+                    f"{len(data):x}\r\n".encode() + data + b"\r\n")
+                handler.wfile.flush()
+            handler.wfile.write(b"0\r\n\r\n")
+        finally:
+            close = getattr(body, "close", None)
+            if close is not None:
+                close()
 
     @staticmethod
     def _reply(handler, code, ctype, body):

@@ -865,6 +865,261 @@ def run_load(engine, n_clients=8, requests_per_client=16,
     return report
 
 
+def run_decode_load(engine, n_clients=8, requests_per_client=8,
+                    min_prompt=4, max_prompt=16, vocab=64,
+                    min_new=4, max_new=16, deadline_ms=None,
+                    result_timeout_s=600.0, seed=0, metrics_url=None,
+                    stream=True, watch_engines=None):
+    """Closed-loop GENERATION traffic against a ``DecodeEngine`` (or a
+    ``ServingRouter`` fronting decode engines): each client submits a
+    random prompt with a random ``max_new_tokens``, consumes the
+    TOKEN STREAM (``future.stream()``) stamping a perf-counter
+    timestamp per token, and verifies the streamed tokens are
+    byte-identical to the final authoritative result — the zero
+    lost/duplicated-token check running on every single request.
+
+    The report's decode-specific numbers: generated ``tokens_per_sec``
+    over the loaded wall, client-observed TTFT (submit → first token)
+    and inter-token-gap percentiles, stream consistency, and (with
+    ``watch_engines``) the peak KV-page occupancy + slot churn
+    observed during the window. ``metrics_url`` adds the same
+    server-side reconciliation as :func:`run_load` — request counters,
+    cost ledger (canary-billed SYNTHETIC traffic excluded, exactly as
+    for encoder loads — streamed bills carry the same
+    device_s/requests/tokens fields), and SLO compliance.
+
+    ``stream=False`` drives the same traffic through plain
+    ``result()`` waits — the streamed-vs-unstreamed parity axis (the
+    token sequences must match bit-for-bit; generation is greedy).
+    """
+    import threading
+
+    import numpy as np
+
+    from mxnet_tpu.serving import (DeadlineExceededError,
+                                   NoEngineAvailableError, QueueFullError)
+
+    is_router = hasattr(engine, "scoreboard")
+    costs_before = _fetch_costs(metrics_url) if metrics_url else None
+    before = scrape_metrics(metrics_url) if metrics_url else None
+
+    latencies = []           # (total_ms, trace_id)
+    ttfts = []               # ms
+    gaps = []                # inter-token gaps, ms
+    outcomes = {"ok": 0, "expired": 0, "shed": 0, "error": 0}
+    tokens_out = [0]
+    stream_bad = [0]
+    client_cost = {"device_s": 0.0, "requests": 0, "tokens": 0,
+                   "compiled": 0, "missing": 0}
+    lock = threading.Lock()
+
+    def client(cid):
+        rs = np.random.RandomState(seed + cid)
+        for _ in range(requests_per_client):
+            n = int(rs.randint(min_prompt, max_prompt + 1))
+            n_new = int(rs.randint(min_new, max_new + 1))
+            toks = rs.randint(1, vocab, n).astype(np.int32)
+            t0 = time.perf_counter()
+            try:
+                fut = engine.submit(toks, deadline_ms=deadline_ms,
+                                    max_new_tokens=n_new, stream=stream)
+                if stream:
+                    stamps = []       # per-token arrival timestamps
+                    parts = []
+                    for part in fut.stream(timeout=result_timeout_s):
+                        stamps.append(time.perf_counter())
+                        parts.append(int(part["token"]))
+                    out = fut.result(timeout=0)
+                else:
+                    out = fut.result(timeout=result_timeout_s)
+                    stamps = [time.perf_counter()]
+                    parts = None
+            except DeadlineExceededError:
+                with lock:
+                    outcomes["expired"] += 1
+                continue
+            except (QueueFullError, NoEngineAvailableError):
+                with lock:
+                    outcomes["shed"] += 1
+                time.sleep(0.005)
+                continue
+            except Exception:
+                with lock:
+                    outcomes["error"] += 1
+                continue
+            t_end = time.perf_counter()
+            out = np.asarray(out).tolist()
+            cost = getattr(fut, "cost", None)
+            with lock:
+                outcomes["ok"] += 1
+                tokens_out[0] += len(out)
+                latencies.append(((t_end - t0) * 1e3, fut.trace_id))
+                if stamps:
+                    ttfts.append((stamps[0] - t0) * 1e3)
+                    gaps.extend((b - a) * 1e3 for a, b in
+                                zip(stamps, stamps[1:]))
+                if parts is not None and parts != out:
+                    # the streamed partials and the final result
+                    # disagree: lost or duplicated tokens — the one
+                    # thing the streaming path must never do
+                    stream_bad[0] += 1
+                if cost:
+                    client_cost["device_s"] += cost.get("device_s", 0.0)
+                    client_cost["requests"] += 1
+                    client_cost["tokens"] += cost.get("tokens", 0)
+                    if cost.get("compiled"):
+                        client_cost["compiled"] += 1
+                else:
+                    client_cost["missing"] += 1
+
+    threads = [threading.Thread(target=client, args=(c,),
+                                name=f"loadgen_decode_{c}", daemon=True)
+               for c in range(n_clients)]
+    # occupancy watcher: peak KV-page usage + slot churn during the
+    # window (in-process engines only — remote ones report via their
+    # own /stats)
+    occupancy = {"peak": 0.0, "peak_slots": 0}
+    stop_watch = watcher = None
+    if watch_engines:
+        stop_watch = threading.Event()
+
+        def _watch():
+            while not stop_watch.wait(0.02):
+                for eng in watch_engines:
+                    occ = eng.pool.occupancy()["occupancy"]
+                    occupancy["peak"] = max(occupancy["peak"], occ)
+                    occupancy["peak_slots"] = max(
+                        occupancy["peak_slots"], len(eng._active))
+
+        watcher = threading.Thread(target=_watch, daemon=True,
+                                   name="loadgen_decode_watch")
+        watcher.start()
+    t_start = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t_start
+    if watcher is not None:
+        stop_watch.set()
+        watcher.join(timeout=5.0)
+
+    from mxnet_tpu.serving.metrics import nearest_rank
+
+    xs = sorted(ms for ms, _ in latencies)
+    ttft_xs = sorted(ttfts)
+    gap_xs = sorted(gaps)
+
+    def pct(samples, p):
+        v = nearest_rank(samples, p)
+        return None if v is None else round(v, 3)
+
+    report = {"clients": n_clients,
+              "requests_per_client": requests_per_client,
+              "wall_s": round(wall, 3),
+              "completed": outcomes["ok"],
+              "expired": outcomes["expired"],
+              "shed": outcomes["shed"],
+              "errors": outcomes["error"],
+              "streamed": bool(stream),
+              "stream_mismatches": stream_bad[0],
+              "generated_tokens": tokens_out[0],
+              "tokens_per_sec":
+                  round(tokens_out[0] / wall, 2) if wall else 0,
+              "requests_per_sec":
+                  round(outcomes["ok"] / wall, 2) if wall else 0,
+              "p50_ms": pct(xs, 50), "p99_ms": pct(xs, 99),
+              "ttft_p50_ms": pct(ttft_xs, 50),
+              "ttft_p95_ms": pct(ttft_xs, 95),
+              "inter_token_p50_ms": pct(gap_xs, 50),
+              "inter_token_p99_ms": pct(gap_xs, 99),
+              "engine": engine.snapshot()}
+    if watch_engines:
+        report["kv_occupancy_peak"] = round(occupancy["peak"], 4)
+        report["peak_slots"] = occupancy["peak_slots"]
+        churn = {"joins": 0, "leaves": 0}
+        for eng in watch_engines:
+            snap = eng.decode_stats.snapshot()
+            churn["joins"] += snap["joins"]
+            churn["leaves"] += snap["leaves"]
+        report["churn"] = churn
+    if is_router:
+        snap = report["engine"]
+        report["per_engine"] = {eid: row["dispatched"]
+                                for eid, row in snap["engines"].items()}
+        report["failovers"] = snap["counters"].get("requeued", 0)
+        report["engines_up"] = snap.get("engines_up")
+    if metrics_url:
+        after = scrape_metrics(metrics_url)
+        attempts = n_clients * requests_per_client
+        if is_router:
+            delta = _requests_total_delta(
+                before, after, family="mxnet_tpu_router_requests_total",
+                events=_ROUTER_EVENTS)
+            reconciled, mismatches = cross_check_router(
+                outcomes, attempts, delta)
+        else:
+            delta = _requests_total_delta(before, after)
+            reconciled, mismatches = cross_check(
+                outcomes, attempts, delta)
+        report["server"] = {"requests_total_delta": delta,
+                            "reconciled": reconciled,
+                            "mismatches": mismatches}
+        costs_after = _fetch_costs(metrics_url)
+        canary = _canary_delta(before, after)
+        cost_slack = outcomes["error"] + report.get("failovers", 0)
+        if canary:
+            seats = len(report.get("per_engine") or {}) or 1
+            cost_slack += 2 * seats
+        cost_ok, cost_mismatches, cost_delta = cross_check_costs(
+            client_cost, costs_before, costs_after, slack=cost_slack,
+            exclude=canary["excluded"] if canary else None,
+            counters=(before, after))
+        if not cost_ok and canary and cost_delta:
+            # decode probe-edge tolerance: an encoder probe's ledger
+            # entries land at ONE dispatch instant (≈ its bill), but a
+            # DECODE probe spreads them across its whole generation —
+            # a probe straddling a scrape edge splits its per-
+            # iteration ledger entries from its bill, skewing the
+            # delta either way. Allow up to 2 in-flight probes per
+            # seat of skew (the same edge budget run_load's request
+            # slack uses), sized from the observed per-probe averages.
+            exc = canary["excluded"]
+            n = max(1, exc["requests"])
+            seats_ = len(report.get("per_engine") or {}) or 1
+            tol_t = -(-exc["tokens"] // n) * 2 * seats_
+            tol_s = exc["device_s"] / n * 2 * seats_
+            ok_t = abs(client_cost["tokens"]
+                       - cost_delta["valid_tokens"]) <= tol_t
+            led = cost_delta["request_s"]
+            ok_s = (abs(client_cost["device_s"] - led)
+                    <= 0.05 * max(led, 1e-9) + tol_s)
+            ok_r = abs(client_cost["requests"]
+                       - cost_delta["requests"]) <= 2 * seats_
+            if ok_t and ok_s and ok_r:
+                cost_ok, cost_mismatches = True, [
+                    "within decode probe-edge tolerance: "
+                    + "; ".join(cost_mismatches)]
+        if canary:
+            report["canary"] = canary
+        report["cost"] = {
+            "client_device_s": round(client_cost["device_s"], 6),
+            "client_requests": client_cost["requests"],
+            "client_tokens": client_cost["tokens"],
+            "missing_bills": client_cost["missing"],
+            "ledger_delta": cost_delta,
+            "reconciled": cost_ok,
+            "mismatches": cost_mismatches}
+        if cost_delta and cost_delta.get("valid_tokens"):
+            report["cost"]["device_s_per_1k_tokens"] = round(
+                cost_delta["request_s"] * 1e3
+                / cost_delta["valid_tokens"], 6)
+        slo = _fetch_slo(metrics_url)
+        if slo is not None:
+            report["slo"] = slo
+    return report
+
+
 def overload_drill(target, alerts_fn=None, get_trace=None, alert=None,
                    n_clients=8, min_len=16, max_len=64, vocab=1000,
                    deadline_ms=None, fire_timeout_s=60.0,
@@ -1575,6 +1830,24 @@ def _main():
                     "MXNET_TPU_SLO_EVAL_S=0.2 "
                     "MXNET_TPU_SLO_LATENCY_MS=40 "
                     "MXNET_TPU_CANARY_INTERVAL_S=0.2")
+    ap.add_argument("--decode", action="store_true",
+                    help="GENERATION traffic against DecodeEngine(s) "
+                    "(a small paged-KV causal LM instead of the BERT "
+                    "encoder): closed-loop clients consume the token "
+                    "STREAM with per-token timestamps — the report "
+                    "carries TTFT + inter-token p50/p99, generated "
+                    "tokens/sec, peak KV-page occupancy and slot "
+                    "churn, and every stream is verified byte-"
+                    "identical to its final result. Composes with "
+                    "--router N (decode engines behind the router, "
+                    "streams relayed through it)")
+    ap.add_argument("--max-new", type=int, default=16,
+                    help="--decode: max_new_tokens upper bound "
+                    "(per-request draw is U[max(1, max_new//4), "
+                    "max_new])")
+    ap.add_argument("--no-stream", action="store_true",
+                    help="--decode: wait for full results instead of "
+                    "consuming token streams (the parity axis)")
     ap.add_argument("--drill-overload", nargs="?", const="auto",
                     default=None, metavar="ALERT",
                     help="instead of the measured run, flood the "
@@ -1601,6 +1874,16 @@ def _main():
     wedge_gates = {}
 
     def make_engine(engine_id=None):
+        if args.decode:
+            from mxnet_tpu.serving import DecodeEngine, PagedCausalLM
+            lm = PagedCausalLM(vocab=args.vocab, units=args.units,
+                               layers=args.layers, heads=args.heads,
+                               max_len=max(4 * max(buckets), 128),
+                               seed=0)
+            return DecodeEngine(lm, prefill_bucket_lens=buckets,
+                                max_rows=args.max_rows,
+                                max_new_tokens=args.max_new,
+                                engine_id=engine_id)
         net = BERTModel(vocab_size=args.vocab, units=args.units,
                         hidden_size=4 * args.units,
                         num_layers=args.layers, num_heads=args.heads,
@@ -1613,6 +1896,12 @@ def _main():
         return ServingEngine(model, bucket_lens=buckets,
                              max_rows=args.max_rows, pool=args.pool,
                              engine_id=engine_id)
+
+    if args.decode and args.router_url:
+        # RouterClient speaks the encoder submit surface only; decode
+        # params would be silently swallowed into the error column
+        ap.error("--decode drives in-process engines (optionally with "
+                 "--router N); --router-url is not supported yet")
 
     if args.drill_chaos:
         from mxnet_tpu import envvars
@@ -1758,14 +2047,35 @@ def _main():
                   f"({drill['exemplar_trace_spans']} spans)",
                   file=sys.stderr)
             return 0
-        report = run_load(target, n_clients=args.clients,
-                          requests_per_client=args.requests,
-                          min_len=args.min_len, max_len=args.max_len,
-                          vocab=args.vocab, deadline_ms=args.deadline_ms,
-                          metrics_url=metrics_url)
+        if args.decode:
+            report = run_decode_load(
+                target, n_clients=args.clients,
+                requests_per_client=args.requests,
+                min_prompt=args.min_len,
+                max_prompt=min(args.max_len, max(buckets)),
+                vocab=args.vocab, deadline_ms=args.deadline_ms,
+                min_new=max(1, args.max_new // 4),
+                max_new=args.max_new, stream=not args.no_stream,
+                metrics_url=metrics_url, watch_engines=engines)
+        else:
+            report = run_load(target, n_clients=args.clients,
+                              requests_per_client=args.requests,
+                              min_len=args.min_len,
+                              max_len=args.max_len,
+                              vocab=args.vocab,
+                              deadline_ms=args.deadline_ms,
+                              metrics_url=metrics_url)
         if args.router_url:
             report["client_failovers"] = target.failovers
     print(json.dumps(report, indent=2))
+    if report.get("streamed") is not None:
+        print(f"# decode: {report['generated_tokens']} tokens at "
+              f"{report['tokens_per_sec']}/s, ttft p50 "
+              f"{report.get('ttft_p50_ms')} ms, inter-token p50/p99 "
+              f"{report.get('inter_token_p50_ms')}/"
+              f"{report.get('inter_token_p99_ms')} ms, "
+              f"{report['stream_mismatches']} stream mismatches",
+              file=sys.stderr)
     if report.get("per_engine"):
         total = max(1, sum(report["per_engine"].values()))
         print("# per-engine distribution: "

@@ -206,6 +206,41 @@ def compile_cache_split(metrics_text):
     return out
 
 
+def decode_split(metrics_text):
+    """Per-engine DECODE serving view from an exposition scrape:
+    KV-page occupancy (used/free off ``mxnet_tpu_serving_kv_pages``),
+    generated-token + slot-churn totals, and the inter-token latency
+    p99 estimated from the cumulative
+    ``mxnet_tpu_serving_inter_token_latency_ms`` histogram. Empty for
+    a fleet with no decode engines."""
+    from mxnet_tpu.telemetry.expo import (histogram_quantile,
+                                          parse_labels,
+                                          parse_prometheus_text)
+
+    parsed = parse_prometheus_text(metrics_text)
+    out = {}
+    for key, val in parsed.items():
+        name, labels = parse_labels(key)
+        eid = labels.get("engine_id", "?")
+        if name == "mxnet_tpu_serving_kv_pages":
+            out.setdefault(eid, {})[
+                f"pages_{labels.get('state', '?')}"] = int(val)
+        elif name == "mxnet_tpu_serving_decode_tokens_total":
+            out.setdefault(eid, {})["tokens"] = int(val)
+        elif name == "mxnet_tpu_serving_decode_slot_events_total":
+            out.setdefault(eid, {})[labels.get("event", "?")] = int(val)
+    for eid, row in out.items():
+        used = row.get("pages_used", 0)
+        total = used + row.get("pages_free", 0)
+        row["occupancy"] = round(used / total, 4) if total else None
+        p99 = histogram_quantile(
+            parsed, "mxnet_tpu_serving_inter_token_latency_ms", 99,
+            match={"engine_id": eid})
+        row["inter_token_p99_ms"] = (round(p99, 3)
+                                     if p99 is not None else None)
+    return out
+
+
 def dump_fleet(base, out=None, top=5):
     """One-screen fleet view from a router endpoint: scoreboard +
     counters + slowest cross-engine traces (with serving engines)."""
@@ -241,13 +276,27 @@ def dump_fleet(base, out=None, top=5):
     print(f"  fleet warmup manifest: "
           f"{stats.get('manifest_shapes', 0)} shape buckets", file=out)
     try:
-        cc = compile_cache_split(_fetch(base + "/metrics"))
+        metrics_text = _fetch(base + "/metrics")
+        cc = compile_cache_split(metrics_text)
+        dec = decode_split(metrics_text)
     except Exception:
-        cc = {}
+        cc, dec = {}, {}
     for eid, split in sorted(cc.items()):
         print("  compile-cache "
               + f"{eid}: " + " ".join(f"{k}={int(v)}" for k, v in
                                       sorted(split.items())), file=out)
+    for eid, row in sorted(dec.items()):
+        occ = row.get("occupancy")
+        p99 = row.get("inter_token_p99_ms")
+        print(f"  decode {eid}: kv "
+              f"{(f'{occ:.0%}' if occ is not None else '-')} "
+              f"({row.get('pages_used', 0)}/"
+              f"{row.get('pages_used', 0) + row.get('pages_free', 0)} "
+              f"pages), inter-token p99 "
+              f"{(f'~{p99} ms' if p99 is not None else '-')}, "
+              f"tokens={row.get('tokens', 0)} "
+              f"join/leave={row.get('join', 0)}/{row.get('leave', 0)}",
+              file=out)
     try:
         traces = json.loads(_fetch(base + "/traces"))
     except Exception as e:
