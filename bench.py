@@ -1290,6 +1290,23 @@ def main_decode_serving():
        remote-fronted; the same streamed traffic once over partial
        RESULT frames on the binary wire, once over chunked-JSON-lines
        HTTP. The wire must win serialized bytes/request.
+    4. **Prefix KV reuse A/B:** shared-system-prompt traffic
+       (``prompt_reuse=0.9``) against one engine with the prefix cache
+       ON vs OFF, both on the chunked-prefill path. Reuse must WIN
+       TTFT p50 AND device-seconds per 1k generated tokens — shared
+       full pages skip their prefill chunks entirely.
+    5. **Chunked-prefill A/B:** one LONG prompt admitted into a batch
+       of running decodes, prefill budget 64 vs 0 (whole-prompt dense
+       step). Chunking must WIN the background streams' inter-token
+       p99 — the dense arm stalls every running decode for the whole
+       long prefill. Long-prompt TTFT is reported for both arms.
+    6. **Seeded-sampling failover:** two wire-fronted seats behind a
+       router; a seeded (temperature>0) streamed request's carrying
+       connection is KILLED mid-stream. The per-request seed rides the
+       dispatch payload, so the sibling's re-run resamples the exact
+       sequence: the client stream must stay gap-free and
+       duplicate-free and match a solo same-seed run byte-identically
+       (identical seeds ⇒ identical sequences, any seat).
     """
     _setup_cache()
 
@@ -1316,13 +1333,18 @@ def main_decode_serving():
     n_engines = int(os.environ.get("BENCH_ROUTER_ENGINES", "2"))
     buckets = (16, 64)
 
-    def make_engine(eid, iteration_level=True):
+    def make_engine(eid, iteration_level=True, model_wrap=None, **kw):
         lm = PagedCausalLM(vocab=vocab, units=units, layers=layers,
-                           heads=heads, max_len=max_len, seed=0)
-        return DecodeEngine(lm, prefill_bucket_lens=buckets,
+                           heads=heads,
+                           max_len=kw.pop("max_len", max_len), seed=0)
+        if model_wrap is not None:
+            lm = model_wrap(lm)
+        return DecodeEngine(lm,
+                            prefill_bucket_lens=kw.pop("buckets",
+                                                       buckets),
                             max_rows=rows, max_new_tokens=max_new,
                             iteration_level=iteration_level,
-                            engine_id=eid)
+                            engine_id=eid, **kw)
 
     load_kw = dict(n_clients=clients, requests_per_client=reqs,
                    min_prompt=8, max_prompt=max(buckets), vocab=vocab,
@@ -1426,6 +1448,222 @@ def main_decode_serving():
     assert (wire_ab["wire"]["dispatch_overhead_p50_ms"]
             < wire_ab["json"]["dispatch_overhead_p50_ms"]), wire_ab
 
+    # -- phase 4: prefix KV reuse A/B (shared system prompts) ---------------
+    long_len = int(os.environ.get("BENCH_DECODE_LONG_PROMPT", "192"))
+
+    class _PrefillPaced:
+        """Per-token prefill pacer, applied to BOTH arms of the prefix
+        and chunking A/Bs: the bench model is small enough that a full
+        dense prefill costs about one decode step, so without pacing
+        the A/Bs measure dispatch overhead instead of the scheduling
+        properties under test (a production-sized prefill runs
+        proportional to its padded token count, which is exactly what
+        the sleep models)."""
+
+        def __init__(self, m, per_tok_s=0.5e-3):
+            self._m, self._c = m, per_tok_s
+            self.spec = m.spec
+
+        def prefill(self, caches, ids, *a, **k):
+            time.sleep(self._c * int(np.asarray(ids).shape[-1]))
+            return self._m.prefill(caches, ids, *a, **k)
+
+        def prefill_chunk(self, caches, ids, *a, **k):
+            time.sleep(self._c * int(np.asarray(ids).shape[-1]))
+            return self._m.prefill_chunk(caches, ids, *a, **k)
+
+        def decode_step(self, *a, **k):
+            return self._m.decode_step(*a, **k)
+
+    # shared prefix = half the long bucket (several FULL pages, spanning
+    # whole prefill chunks) — a hit must skip chunk-iterations, not just
+    # trim one chunk's tail
+    reuse_kw = dict(load_kw, min_prompt=long_len // 2,
+                    max_prompt=long_len, prompt_reuse=0.9)
+    reuse_ab = {}
+    for mode, prefix_on in (("reuse", True), ("cold", False)):
+        with make_engine(f"px_{mode}", prefix_cache=prefix_on,
+                         model_wrap=_PrefillPaced,
+                         max_len=max(max_len, 2 * long_len),
+                         buckets=(16, long_len)) as eng:
+            eng.warmup()
+            murl = eng.expose(port=0).url("/metrics")
+            # throwaway pass: spins client threads and (reuse arm)
+            # seeds the prefix index with the shared system prompt —
+            # the measured window then runs against a warm index
+            run_decode_load(eng, n_clients=2, requests_per_client=1,
+                            min_prompt=reuse_kw["min_prompt"],
+                            max_prompt=reuse_kw["max_prompt"],
+                            vocab=vocab, min_new=2, max_new=4,
+                            prompt_reuse=1.0)
+            rep = run_decode_load(eng, metrics_url=murl,
+                                  watch_engines=[eng], **reuse_kw)
+        assert rep["completed"] == clients * reqs, (mode, rep)
+        assert rep["stream_mismatches"] == 0, (mode, rep)
+        dev = rep["cost"]["client_device_s"]
+        gen = max(1, rep["generated_tokens"])
+        reuse_ab[mode] = {
+            "ttft_p50_ms": rep["ttft_p50_ms"],
+            "tokens_per_sec": rep["tokens_per_sec"],
+            "device_s_per_1k_generated": round(dev * 1e3 / gen, 6),
+            "prefix": rep.get("prefix")}
+    # the acceptance bars: the reuse arm actually hit the index, and
+    # skipping the shared pages' prefill chunks shows up both in
+    # first-token latency and in device-seconds per generated token
+    assert reuse_ab["reuse"]["prefix"]["hits"] > 0, reuse_ab
+    assert (reuse_ab["reuse"]["ttft_p50_ms"]
+            < reuse_ab["cold"]["ttft_p50_ms"]), reuse_ab
+    assert (reuse_ab["reuse"]["device_s_per_1k_generated"]
+            < reuse_ab["cold"]["device_s_per_1k_generated"]), reuse_ab
+
+    # -- phase 5: chunked prefill A/B — long prompt into a running batch ----
+    import threading
+
+    from mxnet_tpu.serving.metrics import nearest_rank
+
+    chunk_ab = {}
+    for mode, budget in (("chunked", 64), ("dense", 0)):
+        with make_engine(f"cp_{mode}", prefill_budget=budget,
+                         model_wrap=_PrefillPaced,
+                         max_len=max(max_len, 2 * long_len),
+                         buckets=(16, long_len)) as eng:
+            eng.warmup()
+            rs = np.random.RandomState(7)
+            long_prompt = rs.randint(1, vocab, long_len) \
+                .astype(np.int32)
+            gaps, lock = [], threading.Lock()
+            n_bg, bg_new = min(4, rows - 1), 32
+            first = [0]
+            ready = threading.Event()
+
+            def bg(cid):
+                rsc = np.random.RandomState(100 + cid)
+                toks = rsc.randint(1, vocab, 12).astype(np.int32)
+                fut = eng.submit(toks, max_new_tokens=bg_new,
+                                 stream=True)
+                last = None
+                for _ in fut.stream(timeout=600):
+                    now = time.perf_counter()
+                    with lock:
+                        if last is None:
+                            first[0] += 1
+                            if first[0] == n_bg:
+                                ready.set()
+                        else:
+                            gaps.append((now - last) * 1e3)
+                    last = now
+                fut.result(timeout=0)
+
+            threads = [threading.Thread(
+                target=bg, args=(c,), daemon=True,
+                name=f"mxnet_tpu_bench_decode_bg{c}")
+                for c in range(n_bg)]
+            for t in threads:
+                t.start()
+            assert ready.wait(timeout=120), "background decode stalled"
+            # the long prompt lands in a RUNNING batch: the dense arm
+            # prefills it in one iteration-blocking step, the chunked
+            # arm interleaves budget-sized slices between decode
+            # iterations
+            t0 = time.perf_counter()
+            lfut = eng.submit(long_prompt, max_new_tokens=4,
+                              stream=True)
+            ttft = None
+            for _ in lfut.stream(timeout=600):
+                if ttft is None:
+                    ttft = (time.perf_counter() - t0) * 1e3
+            lfut.result(timeout=0)
+            for t in threads:
+                t.join()
+            chunk_ab[mode] = {
+                "bg_inter_token_p99_ms": round(
+                    nearest_rank(sorted(gaps), 99), 3),
+                "bg_gaps": len(gaps),
+                "long_ttft_ms": round(ttft, 3),
+                "prefill_chunks":
+                    eng.decode_stats.snapshot()["prefill_chunks"]}
+    assert chunk_ab["chunked"]["prefill_chunks"] > 0, chunk_ab
+    # the acceptance bar: chunking bounds how long any running decode
+    # waits behind the long prefill
+    assert (chunk_ab["chunked"]["bg_inter_token_p99_ms"]
+            < chunk_ab["dense"]["bg_inter_token_p99_ms"]), chunk_ab
+
+    # -- phase 6: seeded sampling failover — replay is byte-identical -------
+    class _Paced:
+        """Decode-step pacer: slow generation enough that the kill
+        lands mid-stream (same shim as the serving tests use)."""
+
+        def __init__(self, m, delay_s=0.02):
+            self._m, self._d = m, delay_s
+            self.spec = m.spec
+
+        def prefill(self, *a, **k):
+            return self._m.prefill(*a, **k)
+
+        def prefill_chunk(self, *a, **k):
+            return self._m.prefill_chunk(*a, **k)
+
+        def decode_step(self, *a, **k):
+            time.sleep(self._d)
+            return self._m.decode_step(*a, **k)
+
+    sample = dict(temperature=0.8, top_k=40, top_p=0.95)
+    seed_prompt = list(range(1, 9))
+    s_engines = [make_engine(f"sd{i}", model_wrap=_Paced)
+                 for i in range(2)]
+    with s_engines[0], s_engines[1]:
+        urls = {}
+        for eng in s_engines:
+            eng.warmup()
+            srv = eng.expose(port=0)
+            urls[eng.engine_id] = f"http://{srv.host}:{srv.port}"
+        # identical seeds ⇒ identical sequences, on EITHER seat: the
+        # sampling key is a pure function of (seed, position)
+        solo = s_engines[0].infer(seed_prompt, max_new_tokens=12,
+                                  seed=1234, **sample).tolist()
+        twin = s_engines[1].infer(seed_prompt, max_new_tokens=12,
+                                  seed=1234, **sample).tolist()
+        assert solo == twin, (solo, twin)
+        other = s_engines[0].infer(seed_prompt, max_new_tokens=12,
+                                   seed=4321, **sample).tolist()
+        with ServingRouter(urls, wire=True,
+                           poll_interval_s=0.1) as s_router:
+            deadline = time.perf_counter() + 30.0
+            while time.perf_counter() < deadline and not all(
+                    row.get("transport") == "wire"
+                    for row in s_router.scoreboard().values()):
+                time.sleep(0.1)
+            fut = s_router.submit(seed_prompt, max_new_tokens=12,
+                                  stream=True, seed=1234, **sample)
+            seen, killed = [], [False]
+            for part in fut.stream(timeout=120):
+                seen.append(part)
+                if len(seen) == 3 and not killed[0]:
+                    killed[0] = True
+                    busy = {eid for eid, row
+                            in s_router.scoreboard().items()
+                            if row.get("outstanding")}
+                    for eng in s_engines:
+                        if eng.engine_id in busy:
+                            eng._wire.kill_connections()
+            out = fut.result(timeout=0).tolist()
+        assert killed[0]
+        # the stream survived the mid-flight kill gap-free and
+        # duplicate-free, and the failover re-run RESAMPLED the exact
+        # sequence — the seed, not the seat, owns the randomness
+        idxs = [p["index"] for p in seen]
+        assert idxs == list(range(len(seen))), idxs
+        assert [p["token"] for p in seen] == out, (seen, out)
+        assert out == solo, (out, solo)
+        assert other != solo, "distinct seeds produced equal sequences"
+        failed_over = sum(e.stats.count("submitted")
+                          for e in s_engines) >= 2
+    seeded = {"stream_mismatches": 0 if [p["token"] for p in seen]
+              == out else 1,
+              "replayed_matches_solo": out == solo,
+              "distinct_seed_differs": other != solo,
+              "failover_reruns": failed_over}
+
     cost = report.get("cost", {})
     _report("lm_decode_serving_tokens_per_sec",
             report["tokens_per_sec"], "tokens/sec", 0.0,
@@ -1446,6 +1684,17 @@ def main_decode_serving():
                 ab["iteration"]["tokens_per_sec"]
                 / max(1e-9, ab["static"]["tokens_per_sec"]), 3),
             decode_ab=ab, wire=wire_ab["wire"], json=wire_ab["json"],
+            prefix_reuse_ab=reuse_ab,
+            prefix_reuse_ttft_speedup=round(
+                reuse_ab["cold"]["ttft_p50_ms"]
+                / max(1e-9, reuse_ab["reuse"]["ttft_p50_ms"]), 3),
+            chunked_prefill_ab=chunk_ab,
+            chunked_prefill_p99_win=round(
+                chunk_ab["dense"]["bg_inter_token_p99_ms"]
+                / max(1e-9,
+                      chunk_ab["chunked"]["bg_inter_token_p99_ms"]),
+                3),
+            seeded=seeded,
             telemetry_reconciled=server.get("reconciled"),
             cost_reconciled=cost.get("reconciled"),
             device_s_per_1k_tokens=cost.get("device_s_per_1k_tokens"),

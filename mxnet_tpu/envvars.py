@@ -266,6 +266,37 @@ register("MXNET_TPU_DECODE_PREFILLS_PER_ITER", "int", 1,
          "how long the running decode batch can stall behind prefill "
          "work (the prefill/decode split-scheduling knob)",
          scope="decode")
+register("MXNET_TPU_DECODE_PREFILL_BUDGET", "int", 64,
+         "prompt tokens prefilled per decode-loop iteration: prompts "
+         "are split into kernel-sized chunks interleaved at iteration "
+         "boundaries, so a long prompt never stalls the running batch "
+         "for more than one chunk; ``0`` restores whole-prompt dense "
+         "prefill (the chunked-prefill A/B baseline)", scope="decode")
+register("MXNET_TPU_KV_PREFIX", "bool", True,
+         "prefix KV cache reuse (``serving/kvcache.py``): prompts "
+         "sharing a token prefix share its full KV pages read-only "
+         "(refcounted, copy-on-write on divergence); ``0`` disables — "
+         "the prefix-reuse A/B knob. Needs chunked prefill "
+         "(``MXNET_TPU_DECODE_PREFILL_BUDGET`` > 0) to take effect",
+         scope="decode")
+register("MXNET_TPU_KV_PREFIX_PAGES", "int", 64,
+         "bounded LRU capacity of the prefix-KV index, in entries "
+         "(one full page each); eviction unpins the page, which "
+         "recycles once no live sequence references it",
+         scope="decode")
+register("MXNET_TPU_DECODE_TEMPERATURE", "float", 0.0,
+         "default decode sampling temperature for requests that bring "
+         "none: ``0`` is greedy argmax — deterministic by "
+         "construction, the byte-reproducible solo-parity lever",
+         scope="decode")
+register("MXNET_TPU_DECODE_TOP_K", "int", 0,
+         "default top-k sampling cutoff for decode requests (``0`` = "
+         "no top-k truncation; only applies when temperature > 0)",
+         scope="decode")
+register("MXNET_TPU_DECODE_TOP_P", "float", 1.0,
+         "default nucleus (top-p) sampling mass for decode requests "
+         "(``1.0`` = no truncation; only applies when temperature "
+         "> 0)", scope="decode")
 register("MXNET_TPU_SLO_INTER_TOKEN_MS", "float", 250.0,
          "decode inter-token latency bound for the default "
          "``decode_inter_token`` LatencySLO (p-target reuses "

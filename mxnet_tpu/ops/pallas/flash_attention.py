@@ -16,7 +16,15 @@ golden tests.
 
 Shapes: q (B, H, Sq, D), k/v (B, H, Skv, D). ``q_offset`` is the
 global position of q row 0 relative to k row 0 (ring attention passes
-the rotating chunk offset; 0 for vanilla causal).
+the rotating chunk offset; 0 for vanilla causal). The same
+Sq != Skv + offset geometry is what the decode engine's CHUNKED
+PREFILL steps (serving/decode_model.py ``prefill_chunk``) produce —
+a small q block at global position ``start`` attending to the paged
+KV written so far. That path runs the composed jnp attention over
+gathered cache pages today (small Sq keeps the score block trivially
+VMEM-resident), but the masking convention is deliberately identical
+(``col <= q_offset + row``) so the chunk loop can be pointed at this
+kernel without changing results.
 
 Variable-length batches ARE handled natively: ``kv_lens`` (B,) int32
 gives each example's valid key/value length. The per-example length

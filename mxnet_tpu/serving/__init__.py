@@ -35,7 +35,8 @@ Quickstart::
 """
 from .queue import (ServingError, QueueFullError, DeadlineExceededError,
                     RequestTooLongError, EngineStoppedError,
-                    InferenceFuture, Request, RequestQueue)
+                    InvalidSamplingError, InferenceFuture, Request,
+                    RequestQueue, validate_sampling)
 from .batcher import ContinuousBatcher, DecodeSlots, PackedPlan
 from .metrics import DecodeStats, LatencySummary, ServingStats
 from .engine import ServingEngine
@@ -55,4 +56,5 @@ __all__ = ["ServingEngine", "DecodeEngine", "ServingRouter",
            "LatencySummary", "ServingStats", "DecodeStats",
            "ServingError", "QueueFullError", "DeadlineExceededError",
            "RequestTooLongError", "EngineStoppedError",
+           "InvalidSamplingError", "validate_sampling",
            "NoEngineAvailableError", "RemoteEngineError"]

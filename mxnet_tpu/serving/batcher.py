@@ -24,7 +24,8 @@ import numpy as np
 
 from ..io.packing import pack_sequences
 
-__all__ = ["PackedPlan", "ContinuousBatcher", "DecodeSlots"]
+__all__ = ["PackedPlan", "ContinuousBatcher", "DecodeSlots",
+           "PrefillChunks"]
 
 
 class PackedPlan:
@@ -207,3 +208,49 @@ class DecodeSlots:
         """Every (rows, width) the decode loop can emit — the compile
         budget, enumerable for warmup."""
         return [(r, w) for r in self._rows for w in self._widths]
+
+
+class PrefillChunks:
+    """Closed (chunk × table-width) bucket set for CHUNKED prefill.
+
+    The chunked-prefill step's shape axes are the padded chunk length
+    Sq (the kernel's query-block size) and the padded page-table
+    WIDTH — the width axis power-of-two quantized like
+    :class:`DecodeSlots`, the chunk axis a SINGLE bucket: the
+    pow2-padded per-iteration prefill budget. A ladder of smaller
+    chunk rungs would pad less for short takes, but every rung
+    multiplies the compile universe by the whole width ladder, and
+    the kernel's valid-row mask makes the padding free anyway —
+    measured on the CPU suite, the ladder tripled warmup-heavy tests.
+    Widths reuse the decode slots' ladder, so the warmup manifest
+    absorbs the new buckets through the same (rows × width) machinery.
+    Bucket keys are ``(-chunk, width)`` — the NEGATED first element
+    keeps chunk shapes disjoint from dense-prefill ``(0, bucket)`` and
+    decode ``(rows, width)`` keys in the one shape-universe namespace.
+    """
+
+    def __init__(self, budget=64, max_pages=8):
+        if budget < 1 or max_pages < 1:
+            raise ValueError(
+                f"bad chunk geometry: budget {budget}, pages "
+                f"{max_pages}")
+        self.budget = int(budget)
+        self._chunk = 1 << (self.budget - 1).bit_length()
+        self._widths = _pow2_up_to(int(max_pages))
+        self.max_pages = int(max_pages)
+
+    def bucket(self, n_tokens, n_pages):
+        """The (-chunk, width) bucket for a slice of ``n_tokens``
+        prompt tokens whose sequence spans ``n_pages`` pages so far."""
+        if n_tokens < 1 or n_tokens > self.budget:
+            raise ValueError(
+                f"{n_tokens} chunk tokens outside 1..{self.budget}")
+        if n_pages < 1 or n_pages > self.max_pages:
+            raise ValueError(
+                f"{n_pages} pages outside 1..{self.max_pages}")
+        width = next(w for w in self._widths if w >= n_pages)
+        return -self._chunk, width
+
+    def shape_universe(self):
+        """Every (-chunk, width) the chunked-prefill path can emit."""
+        return [(-self._chunk, w) for w in self._widths]

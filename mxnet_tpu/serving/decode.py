@@ -7,12 +7,23 @@ REQUEST; a decode server must re-form it per TOKEN. The
 
 1. **Join at any iteration boundary.** Queued prompts are admitted
    between decode iterations — at most
-   ``MXNET_TPU_DECODE_PREFILLS_PER_ITER`` prefills per boundary, so a
-   long prompt can never stall the running decode batch for more than
-   one prefill (the prefill/decode split schedule). Admission reserves
-   each request's WORST-CASE page budget up front, so the decode loop
-   can never deadlock on an exhausted pool mid-generation — a join
-   that doesn't fit is deferred (front of queue), not failed.
+   ``MXNET_TPU_DECODE_PREFILLS_PER_ITER`` prefills in flight per
+   boundary. Prompts are NOT prefilled in one dense step: they are cut
+   into kernel-sized chunks (``batcher.PrefillChunks`` buckets) and
+   interleaved at iteration boundaries under a per-iteration token
+   budget (``MXNET_TPU_DECODE_PREFILL_BUDGET``), so a 2k-token prompt
+   never stalls the running batch for more than one chunk — the
+   long-prompt TTFT vs everyone-else inter-token-p99 trade, both
+   measured (``0`` restores whole-prompt dense prefill, the A/B
+   baseline). Admission first asks the pool for a cached PREFIX match
+   (``MXNET_TPU_KV_PREFIX``): full prompt-prefix pages computed by an
+   earlier same-prefix request attach read-only (refcounted owner
+   sets, copy-on-write at the divergence page), and the chunk loop
+   starts at the first unmatched token — prefix hits cut both TTFT
+   and device-s/1k-tokens. Admission reserves each request's
+   WORST-CASE page budget up front, so the decode loop can never
+   deadlock on an exhausted pool mid-generation — a join that doesn't
+   fit is deferred (front of queue), not failed.
 2. **One decode iteration** advances every live sequence by one token:
    a single compiled step over the (rows × table-width) bucket
    (``batcher.DecodeSlots``), each row reading its own KV history
@@ -28,9 +39,17 @@ REQUEST; a decode server must re-form it per TOKEN. The
    (``mxnet_tpu_serving_inter_token_latency_ms`` + the default
    ``decode_inter_token`` LatencySLO).
 
+Token selection is greedy argmax by default (deterministic — the
+solo-parity lever); a request may carry ``temperature``/``top_k``/
+``top_p``/``seed`` (validated at submit, carried in wire SUBMIT
+frames, HTTP ``/submit`` and the router's HA journal), and the PRNG
+key is a pure function of (seed, position) — a stream replayed on
+another seat after failover resamples byte-identically.
+
 ``iteration_level=False`` degrades the scheduler to classic STATIC
-batching (joins only when the batch has fully drained) — the bench
-leg's A/B baseline, kept deliberately so the win stays measurable.
+batching (joins only when the batch has fully drained, whole-prompt
+dense prefill) — the bench leg's A/B baseline, kept deliberately so
+the win stays measurable.
 """
 from __future__ import annotations
 
@@ -47,13 +66,14 @@ from ..telemetry import incidents as _incidents
 from ..telemetry import profiling as _profiling
 from ..telemetry import recorder as _recorder
 from ..telemetry.registry import REGISTRY as _REGISTRY
-from .batcher import DecodeSlots
+from .batcher import DecodeSlots, PrefillChunks
 from .engine import _SUBMIT_ERROR_STATUS
 from .kvcache import PagedKVPool
 from .metrics import (CostLedger, DecodeStats, ServingStats,
                       exemplar_gate, slow_exemplar)
 from .queue import (DeadlineExceededError, EngineStoppedError, Request,
-                    RequestQueue, RequestTooLongError, ServingError)
+                    RequestQueue, RequestTooLongError, ServingError,
+                    validate_sampling)
 
 __all__ = ["DecodeEngine", "DecodeRequest"]
 
@@ -62,14 +82,18 @@ _engine_seq = itertools.count()
 
 class DecodeRequest(Request):
     """One generation request: the prompt plus decode bookkeeping —
-    generated tokens so far, the sequence's write position, and the
-    per-token timing stamps the inter-token SLI reads."""
+    generated tokens so far, the sequence's write position, chunked-
+    prefill progress, sampling parameters, and the per-token timing
+    stamps the inter-token SLI reads."""
 
     __slots__ = ("max_new_tokens", "eos_id", "stream", "generated",
-                 "pos", "t_first", "t_last", "device_s", "prompt_len")
+                 "pos", "t_first", "t_last", "device_s", "prompt_len",
+                 "temperature", "top_k", "top_p", "seed",
+                 "prefill_pos", "reused_tokens")
 
     def __init__(self, tokens, max_new_tokens, eos_id=None, stream=False,
-                 deadline_ms=None, trace_id=None, parent_span_id=None):
+                 deadline_ms=None, trace_id=None, parent_span_id=None,
+                 temperature=0.0, top_k=0, top_p=1.0, seed=0):
         super().__init__(tokens, None, deadline_ms, trace_id=trace_id,
                          parent_span_id=parent_span_id)
         self.prompt_len = int(self.tokens.size)
@@ -78,8 +102,14 @@ class DecodeRequest(Request):
             raise ValueError("max_new_tokens must be >= 1")
         self.eos_id = int(eos_id) if eos_id is not None else None
         self.stream = bool(stream)
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        self.seed = int(seed)
         self.generated = []
         self.pos = self.prompt_len     # where the NEXT token's KV goes
+        self.prefill_pos = 0           # prompt tokens already in pages
+        self.reused_tokens = 0         # of them, served by prefix reuse
         self.t_first = self.t_last = None
         self.device_s = 0.0            # amortized decode wall share
 
@@ -102,8 +132,19 @@ class DecodeEngine:
     eos_id : default end-of-sequence token id (None = generate to the
         cap).
     iteration_level : True (default) = Orca-style joins at iteration
-        boundaries; False = static cohort batching (the A/B baseline).
+        boundaries; False = static cohort batching (the A/B baseline —
+        whole-prompt dense prefill, no prefix reuse).
     engine_id : metric/scoreboard label, as on ``ServingEngine``.
+    prefill_budget : prompt tokens prefilled per iteration boundary
+        (``MXNET_TPU_DECODE_PREFILL_BUDGET``); 0 = whole-prompt dense
+        prefill (the chunked-prefill A/B baseline).
+    prefix_cache / prefix_pages : prefix-KV reuse knobs forwarded to
+        the pool (``MXNET_TPU_KV_PREFIX`` / ``_PAGES``); reuse needs
+        chunked prefill (the dense prefill step cannot resume
+        mid-prompt) and is forced off without it.
+    temperature / top_k / top_p : engine-default sampling params for
+        requests that carry none (``MXNET_TPU_DECODE_TEMPERATURE`` /
+        ``_TOP_K`` / ``_TOP_P``; temperature 0 = greedy argmax).
     """
 
     def __init__(self, model, prefill_bucket_lens=(16, 64, 256),
@@ -111,7 +152,9 @@ class DecodeEngine:
                  max_queue_depth=256, default_deadline_ms=None,
                  max_new_tokens=None, eos_id=None, iteration_level=True,
                  stats_window=4096, engine_id=None,
-                 prefills_per_iter=None):
+                 prefills_per_iter=None, prefill_budget=None,
+                 prefix_cache=None, prefix_pages=None,
+                 temperature=None, top_k=None, top_p=None):
         self._model = model
         spec = dict(model.spec)
         self.engine_id = str(engine_id) if engine_id is not None \
@@ -133,13 +176,38 @@ class DecodeEngine:
             prefills_per_iter if prefills_per_iter is not None
             else envvars.get("MXNET_TPU_DECODE_PREFILLS_PER_ITER")))
         self._default_deadline_ms = default_deadline_ms
+        t, k, p, _ = validate_sampling(
+            temperature if temperature is not None
+            else envvars.get("MXNET_TPU_DECODE_TEMPERATURE"),
+            top_k if top_k is not None
+            else envvars.get("MXNET_TPU_DECODE_TOP_K"),
+            top_p if top_p is not None
+            else envvars.get("MXNET_TPU_DECODE_TOP_P"), None)
+        self._default_temp, self._default_top_k, self._default_top_p = \
+            t, k, p
+        budget = int(prefill_budget if prefill_budget is not None
+                     else envvars.get("MXNET_TPU_DECODE_PREFILL_BUDGET"))
+        # chunked prefill rides the iteration loop; the static cohort
+        # scheduler (the A/B baseline) keeps whole-prompt dense prefill
+        self._prefill_budget = budget if self._iteration_level else 0
         self.pool = PagedKVPool(
             spec["n_layers"], spec["n_heads"], spec["head_dim"],
             page_size=page_size, n_pages=n_pages,
-            engine_id=self.engine_id)
+            engine_id=self.engine_id,
+            # the dense prefill step recomputes the WHOLE prompt and
+            # rewrites its pages — it cannot start mid-sequence, so
+            # prefix reuse is only sound on the chunked path
+            prefix_cache=(False if self._prefill_budget <= 0
+                          else prefix_cache),
+            prefix_pages=prefix_pages)
         self._slots = DecodeSlots(
             max_rows=self._max_rows,
             max_pages=self.pool.pages_for(self.max_len))
+        self._chunks = (PrefillChunks(
+            budget=self._prefill_budget,
+            max_pages=self.pool.pages_for(self.max_len))
+            if self._prefill_budget > 0 else None)
+        self._prefilling = []          # worker-owned: mid-prefill reqs
         self._queue = RequestQueue(max_queue_depth)
         self._active = []              # worker-owned slot list
         # static (cohort) mode only: the cohort's row count, pinned at
@@ -289,14 +357,34 @@ class DecodeEngine:
     # -- client surface ----------------------------------------------------
     def submit(self, tokens, token_types=None, deadline_ms=None,
                max_new_tokens=None, eos_id=None, stream=False,
-               trace_id=None, parent_span_id=None):
+               trace_id=None, parent_span_id=None, temperature=None,
+               top_k=None, top_p=None, seed=None):
         """Enqueue one generation request; returns a STREAMING
         :class:`~.queue.InferenceFuture` — ``result()`` is the full
         (max_new_tokens,) int32 token array, ``stream()`` yields each
         token as it is generated. ``token_types`` is accepted for
         submit-surface compatibility (canaries, generic loadgen) and
-        ignored — decode prompts are plain token ids."""
+        ignored — decode prompts are plain token ids.
+
+        ``temperature``/``top_k``/``top_p``/``seed`` select seeded
+        sampling (None = the engine defaults; temperature 0 = greedy).
+        Out-of-range values raise
+        :class:`~.queue.InvalidSamplingError` here — before any
+        compiled step. A sampled request with no seed gets one minted
+        at submit, so replay (stream(), failover re-dispatch) draws
+        the same tokens."""
         del token_types
+        temperature, top_k, top_p, seed = validate_sampling(
+            temperature, top_k, top_p, seed)
+        if temperature is None:
+            temperature = self._default_temp
+        if top_k is None:
+            top_k = self._default_top_k
+        if top_p is None:
+            top_p = self._default_top_p
+        if seed is None:
+            seed = (int.from_bytes(os.urandom(4), "little") & 0x7FFFFFFF
+                    if temperature > 0 else 0)
         if deadline_ms is None:
             deadline_ms = self._default_deadline_ms
         if max_new_tokens is None:
@@ -306,7 +394,9 @@ class DecodeEngine:
         req = DecodeRequest(tokens, max_new_tokens, eos_id=eos_id,
                             stream=stream, deadline_ms=deadline_ms,
                             trace_id=trace_id,
-                            parent_span_id=parent_span_id)
+                            parent_span_id=parent_span_id,
+                            temperature=temperature, top_k=top_k,
+                            top_p=top_p, seed=seed)
         req.span.set_attr(engine=self.engine_id, decode=True)
         self.stats.bump("submitted")
         if not self._started or self._queue.closed:
@@ -350,26 +440,34 @@ class DecodeEngine:
         return req.future
 
     def infer(self, tokens, max_new_tokens=None, eos_id=None,
-              deadline_ms=None, timeout=None):
+              deadline_ms=None, timeout=None, temperature=None,
+              top_k=None, top_p=None, seed=None):
         """Synchronous convenience: submit + wait for the full
         generated sequence."""
         return self.submit(tokens, deadline_ms=deadline_ms,
                            max_new_tokens=max_new_tokens,
-                           eos_id=eos_id).result(timeout)
+                           eos_id=eos_id, temperature=temperature,
+                           top_k=top_k, top_p=top_p,
+                           seed=seed).result(timeout)
 
     def submit_payload(self, payload):
         """Dispatch-surface adapter (wire listener + HTTP ``/submit``):
         one payload dict in, ``(future, streamed)`` out. The payload's
-        decode fields (``max_new_tokens``, ``eos_id``, ``stream``)
-        ride the same dict the encoder dispatch uses, so old routers
-        that know none of them still work."""
+        decode fields (``max_new_tokens``, ``eos_id``, ``stream``,
+        ``temperature``/``top_k``/``top_p``/``seed``) ride the same
+        dict the encoder dispatch uses, so old routers that know none
+        of them still work."""
         fut = self.submit(payload.get("tokens"),
                           deadline_ms=payload.get("deadline_ms"),
                           max_new_tokens=payload.get("max_new_tokens"),
                           eos_id=payload.get("eos_id"),
                           stream=bool(payload.get("stream")),
                           trace_id=payload.get("trace_id"),
-                          parent_span_id=payload.get("span_id"))
+                          parent_span_id=payload.get("span_id"),
+                          temperature=payload.get("temperature"),
+                          top_k=payload.get("top_k"),
+                          top_p=payload.get("top_p"),
+                          seed=payload.get("seed"))
         return fut, bool(payload.get("stream"))
 
     # -- warmup ------------------------------------------------------------
@@ -392,17 +490,24 @@ class DecodeEngine:
         for shape in shapes:
             if shape[0] == 0:
                 self._forward_prefill_shape(shape[1])
+            elif shape[0] < 0:
+                self._forward_chunk_shape(-shape[0], shape[1])
             else:
                 self._forward_decode_shape(*shape)
         return self
 
     def _shape_universe(self):
         """Manifest key space: prefill buckets as (0, padded_len),
-        decode buckets as (rows, table_width) — int pairs, so the
-        fleet manifest machinery (union/persist/replay) carries them
-        unchanged and encoder engines skip them as incompatible."""
+        decode buckets as (rows, table_width), chunked-prefill buckets
+        as (-chunk, table_width) — int pairs, so the fleet manifest
+        machinery (union/persist/replay) carries them unchanged and
+        encoder engines skip them as incompatible. Dense prefill
+        buckets stay in the universe even when chunking is on: the
+        static/dense A/B arm and manifest replay both need them."""
         return ([(0, b) for b in self.prefill_bucket_lens]
-                + list(self._slots.shape_universe()))
+                + list(self._slots.shape_universe())
+                + (list(self._chunks.shape_universe())
+                   if self._chunks is not None else []))
 
     def warmup_manifest(self):
         with self._shapes_lock:
@@ -429,6 +534,7 @@ class DecodeEngine:
         out["running"] = self.running
         out["decode"] = self.decode_stats.snapshot()
         out["kv"] = self.pool.occupancy()
+        out["kv"]["prefix"] = self.pool.prefix_stats()
         out["prefill_buckets"] = list(self.prefill_bucket_lens)
         out["max_rows"] = self._max_rows
         out["iteration_level"] = self._iteration_level
@@ -449,14 +555,23 @@ class DecodeEngine:
         active = [{"trace_id": r.trace_id, "prompt": r.prompt_len,
                    "generated": len(r.generated), "pos": r.pos,
                    "max_new_tokens": r.max_new_tokens,
+                   "reused_tokens": r.reused_tokens,
                    "pages": len(self.pool.table(r.id) or ())}
                   for r in list(self._active)]
+        prefilling = [{"trace_id": r.trace_id, "prompt": r.prompt_len,
+                       "prefill_pos": r.prefill_pos,
+                       "reused_tokens": r.reused_tokens}
+                      for r in list(self._prefilling)]
         return {"engine_id": self.engine_id,
                 "iteration_level": self._iteration_level,
+                "prefill_budget": self._prefill_budget,
                 "active": active,
+                "prefilling": prefilling,
                 "prefill_queue_depth": len(self._queue),
                 "reserved_pages": self._reserved_pages,
                 "kv": self.pool.occupancy(),
+                "prefix": self.pool.prefix_stats(),
+                "page_refcounts": self.pool.page_refcounts(),
                 "decode": self.decode_stats.snapshot()}
 
     def slo_snapshot(self):
@@ -682,6 +797,23 @@ class DecodeEngine:
         _out, dt, compiled = self._step_compiled((0, bucket), run)
         self.costs.observe_warmup(bucket, dt, compiled=compiled)
 
+    def _forward_chunk_shape(self, chunk, width):
+        ids = np.zeros(chunk, np.int32)
+        table = np.full(width, self.pool.scratch_page, np.int32)
+
+        def run():
+            with self._forward_lock:
+                tok, caches = self._model.prefill_chunk(
+                    self.pool.caches, ids, 0, chunk, table)
+                self.pool.swap(caches)
+            return tok
+
+        _out, dt, compiled = self._step_compiled((-chunk, width), run)
+        # chunk warmups bill into the positive token-count bucket —
+        # they may merge with a same-sized dense prefill bucket, which
+        # is fine: both are "prompt tokens prefilled" entries
+        self.costs.observe_warmup(chunk, dt, compiled=compiled)
+
     def _forward_decode_shape(self, rows, width):
         ids = np.zeros(rows, np.int32)
         positions = np.zeros(rows, np.int32)
@@ -707,8 +839,10 @@ class DecodeEngine:
                     "engine stopped before generation finished"))
                 return
             self._admit()
+            self._advance_prefills()
             if not self._active:
-                if self._queue.closed and not len(self._queue):
+                if (self._queue.closed and not len(self._queue)
+                        and not self._prefilling):
                     return
                 continue
             try:
@@ -726,28 +860,46 @@ class DecodeEngine:
         for req in self._active:
             self._leave(req, error=exc, counter="cancelled")
         self._active = []
+        for req in self._prefilling:
+            self._leave(req, error=exc, counter="cancelled",
+                        joined=False)
+        self._prefilling = []
         for req in self._queue.drain_all():
             self.stats.bump("cancelled")
             req.span.end(error="cancelled: engine stopped")
             req.future.set_exception(exc)
 
     def _admit(self):
-        """Join queued prompts at this iteration boundary. Static mode
+        """Join queued prompts at this iteration boundary. Chunked
+        mode moves them into the PREFILLING set (pages reserved,
+        prefix index consulted) for the chunk scheduler to advance;
+        dense mode runs the whole prefill here. Static mode
         (``iteration_level=False``) admits only into an EMPTY batch
         and pins the cohort's row count until it fully drains — the
         classic cohort scheduler the A/B leg measures against."""
         if not self._iteration_level and self._active:
             return
-        if not self._active:
+        if not self._active and not self._prefilling:
             self._static_rows = 0
-        budget = (self._prefills_per_iter if self._active
-                  else self._max_rows)
+        chunked = self._chunks is not None
         admitted = 0
-        while len(self._active) < self._max_rows and admitted < budget:
+        while True:
+            live = len(self._active) + len(self._prefilling)
+            if live >= self._max_rows:
+                break
+            if chunked:
+                # cap CONCURRENT chunked prefills — more would just
+                # time-slice the same per-iteration token budget
+                if len(self._prefilling) >= self._prefills_per_iter:
+                    break
+            elif admitted >= (self._prefills_per_iter if self._active
+                              else self._max_rows):
+                break
             # idle engines park on the queue poll; a running batch
             # polls without waiting (the decode loop must not linger)
-            timeout = 0.05 if not self._active and not admitted else 0.0
-            reqs = self._queue.poll(1, timeout=timeout)
+            idle = not self._active and not self._prefilling \
+                and not admitted
+            reqs = self._queue.poll(1, timeout=0.05 if idle else 0.0)
             if not reqs:
                 break
             req = reqs[0]
@@ -779,7 +931,10 @@ class DecodeEngine:
                                  pool=self.pool.n_pages)
                 break
             try:
-                self._prefill(req, worst)
+                if chunked:
+                    self._admit_chunked(req, worst)
+                else:
+                    self._prefill(req, worst)
             except Exception as e:
                 self.pool.release(req.id)
                 self._unreserve(req)
@@ -789,19 +944,164 @@ class DecodeEngine:
                 continue
             admitted += 1
 
+    def _admit_chunked(self, req, worst_pages):
+        """Reserve the worst case, consult the prefix index, and hand
+        the request to the chunk scheduler. A prefix hit attaches the
+        matched read-only pages to the request's table (COW copies
+        materialized before anything reads them) and fast-forwards
+        ``prefill_pos`` past the reused tokens — those positions'
+        K/V are already in the pool."""
+        self._reserved[req.id] = worst_pages
+        self._reserved_pages += worst_pages
+        matched, copies = self.pool.match_prefix(req.id, req.tokens)
+        if copies:
+            with self._forward_lock:
+                self.pool.copy_pages(copies)
+        req.prefill_pos = req.reused_tokens = matched
+        self.stats.queue_ms.observe((req.t_drain - req.t_submit) * 1e3)
+        self._prefilling.append(req)
+        if matched:
+            _events.emit("decode_prefix_hit", engine_id=self.engine_id,
+                         trace_id=req.trace_id, matched=matched,
+                         prompt=req.prompt_len, cow_pages=len(copies))
+
+    def _advance_prefills(self):
+        """Spend this iteration boundary's prefill-token budget
+        (``MXNET_TPU_DECODE_PREFILL_BUDGET``) advancing mid-prefill
+        prompts, FIFO — the running decode batch waits for at most
+        one budget's worth of chunk steps, however long the prompts
+        are. A prompt whose last chunk lands emits its first token
+        and joins the decode batch."""
+        if self._chunks is None or not self._prefilling:
+            return
+        budget = self._prefill_budget
+        done = []
+        for req in self._prefilling:
+            if budget <= 0:
+                break
+            if req.expired():
+                done.append(req)
+                self.stats.bump("expired")
+                _events.emit("request_expired", trace_id=req.trace_id,
+                             waited_ms=round(
+                                 (time.monotonic() - req.t_submit)
+                                 * 1e3, 3))
+                self._leave(req, error=DeadlineExceededError(
+                    f"request {req.id} deadline exceeded during "
+                    "chunked prefill"), counter="expired", joined=False)
+                continue
+            try:
+                tok = None
+                while budget > 0 and req.prefill_pos < req.prompt_len:
+                    take = min(budget,
+                               req.prompt_len - req.prefill_pos)
+                    tok = self._prefill_chunk(req, take)
+                    budget -= take
+                if req.prefill_pos >= req.prompt_len:
+                    done.append(req)
+                    self._finish_prefill(req, tok)
+            except Exception as e:
+                if req not in done:
+                    done.append(req)
+                self._active = [r for r in self._active
+                                if r.id != req.id]
+                self.stats.bump("failed")
+                self._leave(req, error=e, joined=False)
+        if done:
+            left = {r.id for r in done}
+            self._prefilling = [r for r in self._prefilling
+                                if r.id not in left]
+
+    def _prefill_chunk(self, req, take):
+        """One kernel-sized prompt slice through the paged chunk step.
+        Returns the step's next-token sample — meaningful only for
+        the chunk that completes the prompt (sampled at the prompt's
+        last position); earlier chunks' is discarded."""
+        start = req.prefill_pos
+        self.pool.ensure(req.id, start + take)
+        pages_now = self.pool.pages_for(start + take)
+        neg_chunk, width = self._chunks.bucket(take, pages_now)
+        chunk = -neg_chunk
+        ids = np.zeros(chunk, np.int32)
+        ids[:take] = req.tokens[start:start + take]
+        # the chunk's first write page could be a shared page at this
+        # sequence's write frontier (a prefix hit whose match ended
+        # exactly on a page boundary that is still index-pinned from
+        # another chain) — copy-on-write before writing into it
+        pairs = []
+        cow = self.pool.prepare_write(req.id, start)
+        if cow is not None:
+            pairs.append(cow)
+        table = self.pool.padded_tables([req.id], width)[0]
+
+        def run():
+            with self._forward_lock:
+                if pairs:
+                    self.pool.copy_pages(pairs)
+                tok, caches = self._model.prefill_chunk(
+                    self.pool.caches, ids, start, take, table,
+                    temperature=req.temperature, top_k=req.top_k,
+                    top_p=req.top_p, seed=req.seed)
+                self.pool.swap(caches)
+            return int(tok)
+
+        tok, dt, compiled = self._step_compiled((neg_chunk, width), run)
+        now = time.monotonic()
+        self._beat = now
+        self._last_dispatch = now
+        req.prefill_pos += take
+        req.device_s += dt
+        final = req.prefill_pos >= req.prompt_len
+        done_now = final and (
+            req.max_new_tokens == 1
+            or (req.eos_id is not None and tok == req.eos_id))
+        self.decode_stats.observe_chunk(take)
+        # chunk steps bill by their REAL token count (the final chunk
+        # adds the first generated token), so per-request bills —
+        # (prompt - reused) + generated — reconcile against the
+        # ledger token-for-token, exactly as the dense path does
+        self.costs.observe_decode(chunk, dt, tokens=take + int(final),
+                                  completed=int(done_now),
+                                  compiled=compiled)
+        return tok
+
+    def _finish_prefill(self, req, tok):
+        """The prompt's last chunk just ran: index its full pages for
+        future prefix hits, emit the first generated token, and join
+        the decode batch (or finish outright on EOS / a 1-token
+        cap)."""
+        self.pool.register_prefix(req.id, req.tokens)
+        now = time.monotonic()
+        req.t_first = req.t_last = now
+        self.decode_stats.ttft_ms.observe((now - req.t_submit) * 1e3)
+        self._emit_token(req, tok)
+        if self._done_after_token(req, tok):
+            self._leave(req, reason=self._leave_reason(req, tok),
+                        joined=False)
+            return
+        self._active.append(req)
+        self.decode_stats.observe_join()
+        _events.emit("decode_join", engine_id=self.engine_id,
+                     trace_id=req.trace_id, prompt=req.prompt_len,
+                     reused_tokens=req.reused_tokens,
+                     max_new_tokens=req.max_new_tokens,
+                     active=len(self._active))
+
     def _unreserve(self, req):
         worst = self._reserved.pop(req.id, 0)
         self._reserved_pages -= worst
 
     def _prefill(self, req, worst_pages):
-        """Run one prompt through the prefill step, emit the first
-        token, and either finish the request (max_new_tokens=1 / EOS
-        on token one) or JOIN it to the decode batch."""
+        """Run one prompt through the DENSE prefill step (static mode
+        and the chunked-prefill A/B baseline), emit the first token,
+        and either finish the request (max_new_tokens=1 / EOS on token
+        one) or JOIN it to the decode batch."""
         self._reserved[req.id] = worst_pages
         self._reserved_pages += worst_pages
         bucket = next(b for b in self.prefill_bucket_lens
                       if b >= req.prompt_len)
         self.pool.ensure(req.id, req.prompt_len)
+        req.prefill_pos = req.prompt_len
         ids = np.zeros(bucket, np.int32)
         ids[:req.prompt_len] = req.tokens
         phys, off = self.pool.scatter_indices(req.id, req.prompt_len,
@@ -810,7 +1110,9 @@ class DecodeEngine:
         def run():
             with self._forward_lock:
                 tok, caches = self._model.prefill(
-                    self.pool.caches, ids, req.prompt_len, phys, off)
+                    self.pool.caches, ids, req.prompt_len, phys, off,
+                    temperature=req.temperature, top_k=req.top_k,
+                    top_p=req.top_p, seed=req.seed)
                 self.pool.swap(caches)
             return int(tok)
 
@@ -868,9 +1170,16 @@ class DecodeEngine:
         token through the bucketed paged step; EOS/max-token leavers
         recycle their pages the same iteration."""
         active = self._active
+        cow_pairs = []
         for req in active:
             # guaranteed by the admission reservation: never raises
             self.pool.ensure(req.id, req.pos + 1)
+            # a shared prefix page at this row's write frontier gets a
+            # private copy before the step writes into it (no-op for
+            # private pages — one set lookup)
+            cow = self.pool.prepare_write(req.id, req.pos)
+            if cow is not None:
+                cow_pairs.append(cow)
         # ensure() just covered pos+1 for every row, so the page count
         # is pure arithmetic — no pool lock or table copy per token
         max_pages = max(self.pool.pages_for(req.pos + 1)
@@ -885,17 +1194,29 @@ class DecodeEngine:
         rows_b, width_b = self._slots.bucket(n_rows, max_pages)
         ids = np.zeros(rows_b, np.int32)
         positions = np.zeros(rows_b, np.int32)
+        temps = np.zeros(rows_b, np.float32)
+        top_ks = np.zeros(rows_b, np.int32)
+        top_ps = np.ones(rows_b, np.float32)
+        seeds = np.zeros(rows_b, np.int32)
         for i, req in enumerate(active):
             ids[i] = req.generated[-1]
             positions[i] = req.pos
+            temps[i] = req.temperature
+            top_ks[i] = req.top_k
+            top_ps[i] = req.top_p
+            seeds[i] = req.seed
         owners = [req.id for req in active] \
             + ["__pad__"] * (rows_b - len(active))
         tables = self.pool.padded_tables(owners, width_b)
 
         def run():
             with self._forward_lock:
+                if cow_pairs:
+                    self.pool.copy_pages(cow_pairs)
                 toks, caches = self._model.decode_step(
-                    self.pool.caches, ids, positions, tables)
+                    self.pool.caches, ids, positions, tables,
+                    temperatures=temps, top_ks=top_ks, top_ps=top_ps,
+                    seeds=seeds)
                 toks = np.asarray(toks)
                 self.pool.swap(caches)
             return toks
@@ -953,15 +1274,18 @@ class DecodeEngine:
                                              self._exemplars))
         self.stats.bump("completed")
         # "tokens" mirrors the ledger's accounting unit (prompt tokens
-        # prefilled + tokens generated) so client-summed bills
-        # reconcile against the /costs delta token-for-token
+        # PREFILLED — prefix-reused ones never hit the device — plus
+        # tokens generated) so client-summed bills reconcile against
+        # the /costs delta token-for-token
         req.future.cost = {"engine_id": self.engine_id,
                            "bucket": "decode",
                            "device_s": req.device_s,
                            "compiled": False,
-                           "tokens": req.prompt_len + len(req.generated),
+                           "tokens": (req.prompt_len - req.reused_tokens
+                                      + len(req.generated)),
                            "generated_tokens": len(req.generated),
                            "prompt_tokens": req.prompt_len,
+                           "reused_tokens": req.reused_tokens,
                            "batch_requests": 1}
         _events.emit("decode_leave", engine_id=self.engine_id,
                      trace_id=req.trace_id, reason=reason,

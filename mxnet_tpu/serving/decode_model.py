@@ -12,6 +12,13 @@ engine, bench leg and tests drive:
   into the paged pool THROUGH the precomputed page coordinates
   (``serving/kvcache.py`` emits them; tail padding lands on the
   scratch page).
+- ``prefill_chunk(caches, ids, start, valid, table, ...)`` — one
+  kernel-sized SLICE of a prompt: ``valid`` tokens at positions
+  ``start..start+valid-1`` (front-aligned in the padded ``ids`` row),
+  K/V scattered into the sequence's pages, attention over the whole
+  written history through the paged kernel (Sq = chunk length). The
+  decode engine interleaves these at iteration boundaries so a long
+  prompt never stalls the running batch for more than one chunk.
 - ``decode_step(caches, ids, positions, tables)`` — one iteration of
   the continuous decode batch: (R,) current tokens in, (R,) next
   tokens out, each row reading its own history through its page-table
@@ -19,14 +26,21 @@ engine, bench leg and tests drive:
   interpret, the dense reference off it) and writing its new K/V page
   slot in place.
 
-Both are ``jax.jit`` steps with ``donate_argnums=(0,)`` on the cache
+All are ``jax.jit`` steps with ``donate_argnums=(0,)`` on the cache
 pytree — the decode analog of the encoder path's per-shape CachedOp
 executables (one compile per (rows, table-width) bucket, cached by
 jax) — so the page pool updates IN PLACE: steady-state decode performs
 no per-step cache-sized allocation (``MXNET_TPU_DECODE_DONATE=0``
 disables donation for A/B; the resource-watermark test pins the
-default). Sampling is greedy argmax, deterministic by construction —
-what makes the solo-parity goldens byte-exact.
+default).
+
+Sampling is greedy argmax by DEFAULT — deterministic by construction,
+what makes the solo-parity goldens byte-exact — with seeded
+temperature/top-k/top-p layered on per request: the PRNG key is
+``fold_in(PRNGKey(seed), position)``, a pure function of the request's
+seed and the sampled position, NEVER of batch composition or iteration
+timing — so a stream replayed on another seat after failover resamples
+the identical tokens (the part-index dedupe / canary-golden contract).
 """
 from __future__ import annotations
 
@@ -50,6 +64,41 @@ def _layer_norm(x, g, b, eps=1e-5):
     m = jnp.mean(x, axis=-1, keepdims=True)
     v = jnp.mean(jnp.square(x - m), axis=-1, keepdims=True)
     return (x - m) / jnp.sqrt(v + eps) * g + b
+
+
+def _sample_row(logits, temp, top_k, top_p, seed, pos):
+    """Draw ONE token from one logits row. ``temp <= 0`` is greedy
+    argmax — bitwise the pre-sampling behavior, kept as the default
+    and the solo-parity lever. Otherwise: temperature-scale, keep the
+    ``top_k`` highest logits (0 = all), keep the smallest
+    highest-probability set whose mass reaches ``top_p``, draw from
+    the rest. The PRNG key is ``fold_in(PRNGKey(seed), pos)`` — a pure
+    function of the request's seed and the SEQUENCE position the
+    logits came from, so the draw is independent of batch composition,
+    chunking and which seat runs it: deterministic replay under
+    failover and identical sequences for identical seeds."""
+    import jax
+    import jax.numpy as jnp
+
+    vocab = logits.shape[-1]
+    greedy = jnp.argmax(logits).astype(jnp.int32)
+    lg = logits.astype(jnp.float32) \
+        / jnp.maximum(temp.astype(jnp.float32), np.float32(1e-6))
+    order = jnp.argsort(-lg)                    # token ids, best first
+    ranks = jnp.argsort(order)                  # rank of each token
+    kk = jnp.where(top_k > 0, top_k.astype(jnp.int32), np.int32(vocab))
+    lg = jnp.where(ranks < kk, lg, np.float32(-1e30))
+    probs = jax.nn.softmax(lg)
+    sp = probs[order]                           # descending by rank
+    cum = jnp.cumsum(sp)
+    # a token survives top-p if the mass STRICTLY above it is < top_p
+    # (the best token always survives, whatever its probability)
+    keep = jnp.maximum(
+        jnp.sum((cum - sp) < top_p.astype(jnp.float32)), 1)
+    lg = jnp.where(ranks < keep, lg, np.float32(-1e30))
+    key = jax.random.fold_in(jax.random.PRNGKey(seed), pos)
+    sampled = jax.random.categorical(key, lg).astype(jnp.int32)
+    return jnp.where(temp > np.float32(0.0), sampled, greedy)
 
 
 class PagedCausalLM:
@@ -109,6 +158,7 @@ class PagedCausalLM:
         self.params = p
         kw = {"donate_argnums": (0,)} if donate else {}
         self._prefill = jax.jit(self._prefill_impl, **kw)
+        self._chunk = jax.jit(self._prefill_chunk_impl, **kw)
         self._decode = jax.jit(self._decode_impl, **kw)
 
     @property
@@ -149,7 +199,8 @@ class PagedCausalLM:
         return caches[:2 * i] + (kc, vc) + caches[2 * i + 2:]
 
     # -- prefill ------------------------------------------------------------
-    def _prefill_impl(self, caches, ids, length, phys, off):
+    def _prefill_impl(self, caches, ids, length, phys, off,
+                      temp, top_k, top_p, seed):
         """One padded prompt row: ids (Lp,) int32, length scalar int32,
         phys/off (Lp,) page coordinates. Returns (first generated
         token (), updated caches). Dense causal self-attention (the
@@ -182,10 +233,71 @@ class PagedCausalLM:
             x = x + self._mlp(self._ln(x, f"l{i}_ln2"), i)
         h_last = x[length - 1]
         logits = self._ln(h_last, "lnf") @ p["head"]
-        return jnp.argmax(logits).astype(jnp.int32), caches
+        tok = _sample_row(logits, temp, top_k, top_p, seed, length - 1)
+        return tok, caches
+
+    # -- chunked prefill ----------------------------------------------------
+    def _prefill_chunk_impl(self, caches, ids, start, valid, table,
+                            temp, top_k, top_p, seed):
+        """One prompt SLICE through the paged kernel: ids (C,) int32
+        with the ``valid`` real tokens FRONT-aligned (positions
+        ``start..start+valid-1``; the tail is padding), table (W,)
+        int32 the sequence's padded page-table row. Each token's K/V
+        is scattered into its page slot (padding to the scratch page),
+        then the whole chunk attends over the written history with
+        Sq = C and ``kv_len = start + C`` — row ``i`` of the chunk is
+        position ``start + i``, so the kernel's causal mask
+        ``col <= kv_len - Sq + row`` lands exactly on ``col <=
+        start + i``: a chunk token sees every earlier position
+        (including earlier tokens of its own chunk, already written
+        this step) and nothing later. Padding rows attend into
+        unwritten columns — garbage in, but row-wise ops keep it in
+        the discarded rows. Returns (token sampled at position
+        ``start + valid - 1``, caches) — only the chunk containing
+        the prompt's last token turns that into the first generated
+        token; earlier chunks' is dropped by the engine."""
+        import jax.numpy as jnp
+
+        from ..ops import pallas as _pallas
+        from ..ops.pallas.flash_attention import (
+            paged_attention_reference, paged_flash_attention)
+
+        p = self.params
+        c = ids.shape[0]
+        width = table.shape[0]
+        page_size = caches[0].shape[2]
+        scratch = np.int32(caches[0].shape[0] - 1)
+        idx = jnp.arange(c, dtype=jnp.int32)
+        pos = start + idx
+        live = idx < valid
+        pos_c = jnp.minimum(pos, np.int32(self.max_len - 1))
+        x = p["embed"][ids] + p["pos"][pos_c]       # (C, U)
+        page_idx = jnp.minimum(pos // np.int32(page_size),
+                               np.int32(width - 1))
+        phys = jnp.where(live, table[page_idx], scratch)
+        off = pos % np.int32(page_size)
+        kvl = (start + np.int32(c))[None]           # (1,)
+        attend = (paged_flash_attention if _pallas.pallas_enabled()
+                  else paged_attention_reference)
+        for i in range(self.layers):
+            h = self._ln(x, f"l{i}_ln1")
+            q, k, v = self._qkv(h, i)               # (C, H, D)
+            caches = self._write(caches, i, phys, off, k, v)
+            o = attend(jnp.transpose(q, (1, 0, 2))[None],   # (1,H,C,D)
+                       caches[2 * i], caches[2 * i + 1],
+                       table[None], kvl)
+            o = jnp.transpose(o[0], (1, 0, 2)).reshape(c, self.units)
+            x = x + o.astype(x.dtype) @ p[f"l{i}_wo"]
+            x = x + self._mlp(self._ln(x, f"l{i}_ln2"), i)
+        h_last = x[valid - 1]
+        logits = self._ln(h_last, "lnf") @ p["head"]
+        tok = _sample_row(logits, temp, top_k, top_p, seed,
+                          start + valid - 1)
+        return tok, caches
 
     # -- decode -------------------------------------------------------------
-    def _decode_impl(self, caches, ids, positions, tables):
+    def _decode_impl(self, caches, ids, positions, tables,
+                     temps, top_ks, top_ps, seeds):
         """One continuous-batch iteration: ids/positions (R,) int32,
         tables (R, W) int32 page-table rows. Each row writes its new
         K/V at ``positions[r]`` and attends over its own pages up to
@@ -220,20 +332,56 @@ class PagedCausalLM:
                 @ p[f"l{i}_wo"]
             x = x + self._mlp(self._ln(x, f"l{i}_ln2"), i)
         logits = self._ln(x, "lnf") @ p["head"]
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32), caches
+        import jax
+
+        toks = jax.vmap(_sample_row)(logits, temps, top_ks, top_ps,
+                                     seeds, positions)
+        return toks.astype(jnp.int32), caches
 
     # -- public steps -------------------------------------------------------
-    def prefill(self, caches, ids, length, phys, off):
+    def prefill(self, caches, ids, length, phys, off,
+                temperature=0.0, top_k=0, top_p=1.0, seed=0):
         import jax.numpy as jnp
 
         return self._prefill(caches, jnp.asarray(ids, jnp.int32),
                              jnp.asarray(length, jnp.int32),
                              jnp.asarray(phys, jnp.int32),
-                             jnp.asarray(off, jnp.int32))
+                             jnp.asarray(off, jnp.int32),
+                             jnp.asarray(temperature, jnp.float32),
+                             jnp.asarray(top_k, jnp.int32),
+                             jnp.asarray(top_p, jnp.float32),
+                             jnp.asarray(seed, jnp.int32))
 
-    def decode_step(self, caches, ids, positions, tables):
+    def prefill_chunk(self, caches, ids, start, valid, table,
+                      temperature=0.0, top_k=0, top_p=1.0, seed=0):
         import jax.numpy as jnp
 
-        return self._decode(caches, jnp.asarray(ids, jnp.int32),
+        return self._chunk(caches, jnp.asarray(ids, jnp.int32),
+                           jnp.asarray(start, jnp.int32),
+                           jnp.asarray(valid, jnp.int32),
+                           jnp.asarray(table, jnp.int32),
+                           jnp.asarray(temperature, jnp.float32),
+                           jnp.asarray(top_k, jnp.int32),
+                           jnp.asarray(top_p, jnp.float32),
+                           jnp.asarray(seed, jnp.int32))
+
+    def decode_step(self, caches, ids, positions, tables,
+                    temperatures=None, top_ks=None, top_ps=None,
+                    seeds=None):
+        import jax.numpy as jnp
+
+        ids = jnp.asarray(ids, jnp.int32)
+        r = ids.shape[0]
+
+        def _vec(v, fill, dt):
+            if v is None:
+                return jnp.full((r,), fill, dt)
+            return jnp.asarray(v, dt)
+
+        return self._decode(caches, ids,
                             jnp.asarray(positions, jnp.int32),
-                            jnp.asarray(tables, jnp.int32))
+                            jnp.asarray(tables, jnp.int32),
+                            _vec(temperatures, 0.0, jnp.float32),
+                            _vec(top_ks, 0, jnp.int32),
+                            _vec(top_ps, 1.0, jnp.float32),
+                            _vec(seeds, 0, jnp.int32))

@@ -60,6 +60,9 @@ _SUBMIT_ERROR_STATUS = {
     # new work while this process may still be executing it
     "TimeoutError": 504,
     "EngineStoppedError": 503,
+    # out-of-range sampling params are a malformed request, refused at
+    # admission — before the compiled step could turn them into NaNs
+    "InvalidSamplingError": 400,
 }
 
 

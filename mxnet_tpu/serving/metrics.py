@@ -204,6 +204,12 @@ class DecodeStats:
             "engine", ("engine_id", "event"))
         self._c_join = slot.labels(engine_id=eid, event="join")
         self._c_leave = slot.labels(engine_id=eid, event="leave")
+        self._c_chunks = reg.counter(
+            "mxnet_tpu_serving_decode_prefill_chunks_total",
+            "chunked-prefill steps interleaved at decode iteration "
+            "boundaries (rate vs decode_iterations_total = the share "
+            "of loop turns spent prefilling), per engine",
+            ("engine_id",)).labels(engine_id=eid)
         self._q_split = reg.gauge(
             "mxnet_tpu_serving_decode_queue_split",
             "decode scheduler population by phase: requests waiting "
@@ -216,6 +222,8 @@ class DecodeStats:
         self._leaves = 0
         self._slot_steps = 0      # rows dispatched across iterations
         self._active_steps = 0    # live rows among them (utilization)
+        self._chunks = 0
+        self._chunk_tokens = 0
 
     def set_split_fns(self, prefill_fn, decode_fn):
         """Wire the phase-split pull gauges (scrape-time reads)."""
@@ -248,10 +256,20 @@ class DecodeStats:
             self._leaves += n
         self._c_leave.inc(n)
 
+    def observe_chunk(self, tokens):
+        """One chunked-prefill step (``tokens`` real prompt tokens)
+        interleaved at an iteration boundary."""
+        with self._lock:
+            self._chunks += 1
+            self._chunk_tokens += tokens
+        self._c_chunks.inc()
+
     def snapshot(self):
         with self._lock:
             out = {"tokens": self._tokens, "iterations": self._iters,
                    "joins": self._joins, "leaves": self._leaves,
+                   "prefill_chunks": self._chunks,
+                   "prefill_chunk_tokens": self._chunk_tokens,
                    "slot_utilization": (
                        round(self._active_steps / self._slot_steps, 4)
                        if self._slot_steps else None)}

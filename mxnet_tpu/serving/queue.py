@@ -24,8 +24,9 @@ from ..telemetry import spans as _spans
 from ..telemetry.trace import new_trace_id
 
 __all__ = ["ServingError", "QueueFullError", "DeadlineExceededError",
-           "RequestTooLongError", "EngineStoppedError", "InferenceFuture",
-           "Request", "RequestQueue", "validate_tokens"]
+           "RequestTooLongError", "EngineStoppedError",
+           "InvalidSamplingError", "InferenceFuture", "Request",
+           "RequestQueue", "validate_tokens", "validate_sampling"]
 
 
 class ServingError(MXNetError):
@@ -47,6 +48,12 @@ class RequestTooLongError(ServingError):
 
 class EngineStoppedError(ServingError):
     """The engine is stopped (or stopping) and admits no new work."""
+
+
+class InvalidSamplingError(ServingError):
+    """The request's sampling parameters are out of range — refused at
+    admission (HTTP 400 / wire error frame), never inside the compiled
+    step where a bad ``top_p`` would surface as NaN tokens."""
 
 
 class InferenceFuture:
@@ -262,6 +269,54 @@ def validate_tokens(tokens, token_types):
                 f"token_types length {token_types.size} != tokens "
                 f"length {tokens.size}")
     return tokens, token_types
+
+
+def validate_sampling(temperature=None, top_k=None, top_p=None,
+                      seed=None):
+    """Shared sampling-parameter admission validation (decode engine
+    submit, wire SUBMIT, HTTP ``/submit``, router): range-check and
+    normalize, raising :class:`InvalidSamplingError` up front so a bad
+    request is a typed 4xx, not a NaN inside the compiled step.
+    Returns ``(temperature, top_k, top_p, seed)`` with Nones preserved
+    (None means "engine default")."""
+    if temperature is not None:
+        try:
+            temperature = float(temperature)
+        except (TypeError, ValueError):
+            raise InvalidSamplingError(
+                f"temperature must be a number, got {temperature!r}")
+        if not np.isfinite(temperature) or temperature < 0.0:
+            raise InvalidSamplingError(
+                f"temperature must be finite and >= 0, got "
+                f"{temperature}")
+    if top_k is not None:
+        try:
+            ok = float(top_k) == int(top_k)
+        except (TypeError, ValueError):
+            ok = False
+        if not ok or int(top_k) < 0:
+            raise InvalidSamplingError(
+                f"top_k must be an integer >= 0, got {top_k!r}")
+        top_k = int(top_k)
+    if top_p is not None:
+        try:
+            top_p = float(top_p)
+        except (TypeError, ValueError):
+            raise InvalidSamplingError(
+                f"top_p must be a number, got {top_p!r}")
+        if not np.isfinite(top_p) or not 0.0 < top_p <= 1.0:
+            raise InvalidSamplingError(
+                f"top_p must be in (0, 1], got {top_p}")
+    if seed is not None:
+        try:
+            ok = float(seed) == int(seed)
+        except (TypeError, ValueError):
+            ok = False
+        if not ok:
+            raise InvalidSamplingError(
+                f"seed must be an integer, got {seed!r}")
+        seed = int(seed) & 0x7FFFFFFF
+    return temperature, top_k, top_p, seed
 
 
 class Request:

@@ -116,7 +116,7 @@ from .metrics import (DispatchOverhead, LatencySummary, exemplar_gate,
                       wire_bytes_counter, wire_fallback_counter)
 from .queue import (DeadlineExceededError, EngineStoppedError,
                     InferenceFuture, QueueFullError, ServingError,
-                    validate_tokens)
+                    validate_sampling, validate_tokens)
 from .wire import WireClient, WireError
 
 __all__ = ["ServingRouter", "NoEngineAvailableError", "RemoteEngineError"]
@@ -1182,7 +1182,8 @@ class ServingRouter:
     # -- client surface ----------------------------------------------------
     def submit(self, tokens, token_types=None, deadline_ms=None,
                cid=None, max_new_tokens=None, eos_id=None,
-               stream=False):
+               stream=False, temperature=None, top_k=None, top_p=None,
+               seed=None):
         """Admit one request; returns an :class:`InferenceFuture`
         whose ``trace_id`` names the request fleet-wide. Sheds loudly:
         :class:`QueueFullError` (router queue at bound),
@@ -1203,18 +1204,43 @@ class ServingRouter:
         InferenceFuture.stream` yields each generated token as the
         engine produces it — over the wire as partial RESULT frames,
         over HTTP as chunked JSON lines, in-process as direct part
-        relays, deduped by index across failover."""
+        relays, deduped by index across failover.
+
+        ``temperature``/``top_k``/``top_p``/``seed`` select seeded
+        sampling on the serving seat (validated HERE, the typed
+        :class:`~.queue.InvalidSamplingError` before any journaling or
+        dispatch). A sampled request with no seed gets one MINTED at
+        admission — the seed then rides the dispatch payload and the
+        HA journal entry, so a failover re-dispatch (this router's
+        retry or the peer's adoption) resamples the identical tokens
+        and the stream dedupe stays byte-exact."""
         if deadline_ms is None:
             deadline_ms = self._default_deadline_ms
         if cid is not None and self._c_ha is not None:
             existing = self._ha_lookup(str(cid))
             if existing is not None:
                 return existing
+        temperature, top_k, top_p, seed = validate_sampling(
+            temperature, top_k, top_p, seed)
         decode = {}
         if max_new_tokens is not None:
             decode["max_new_tokens"] = int(max_new_tokens)
         if eos_id is not None:
             decode["eos_id"] = int(eos_id)
+        if temperature is not None:
+            decode["temperature"] = temperature
+            if seed is None and temperature > 0:
+                # mint the replay seed at the ROUTER so every
+                # dispatch of this request — first try, retry on a
+                # dead seat, HA-peer adoption — samples identically
+                seed = int.from_bytes(os.urandom(4),
+                                      "little") & 0x7FFFFFFF
+        if top_k is not None:
+            decode["top_k"] = top_k
+        if top_p is not None:
+            decode["top_p"] = top_p
+        if seed is not None:
+            decode["seed"] = seed
         # validate FIRST (same invariant as the engine: submitted ==
         # sum of outcome counters, malformed requests touch nothing)
         req = RouterRequest(tokens, token_types, deadline_ms,
@@ -2361,7 +2387,13 @@ class ServingRouter:
             fut = self.submit(payload["tokens"],
                               payload.get("token_types"),
                               deadline_ms=payload.get("deadline_ms"),
-                              cid=payload.get("cid"))
+                              cid=payload.get("cid"),
+                              max_new_tokens=payload.get("max_new_tokens"),
+                              eos_id=payload.get("eos_id"),
+                              temperature=payload.get("temperature"),
+                              top_k=payload.get("top_k"),
+                              top_p=payload.get("top_p"),
+                              seed=payload.get("seed"))
         except (ServingError, ValueError, KeyError, TypeError) as e:
             name = type(e).__name__
             status = {"NoEngineAvailableError": 503}.get(

@@ -34,11 +34,22 @@ Two things live here:
 
        ("HELLO",  {client/engine identity, "version": 1})
        ("SUBMIT", corr_id, {"tokens": int32 ndarray, "token_types",
-                            "deadline_ms", "trace_id", "span_id"})
+                            "deadline_ms", "trace_id", "span_id",
+                            decode: "max_new_tokens", "eos_id",
+                            "stream", "temperature", "top_k", "top_p",
+                            "seed"})
        ("RESULT", corr_id, {"result": ndarray, "cost", "engine_ms",
                             "trace_id"})
        ("ERROR",  corr_id, {"error_type", "error"})
        ("PING", n) / ("PONG", n)
+
+   The decode sampling fields ride the SUBMIT frame itself (validated
+   at engine admission — an out-of-range value comes back as an ERROR
+   frame with ``error_type: InvalidSamplingError``, never a NaN from
+   the compiled step), so a router re-dispatching the request after a
+   seat failure replays the SAME seed: the replacement seat resamples
+   the identical token sequence and the part-index dedupe works on
+   sampled streams exactly as on greedy ones.
 
    Raw typed ndarray payloads — no ``tolist()`` — are the point: the
    dominant per-request overhead at high QPS was serialization.

@@ -395,12 +395,15 @@ class _SlowStep:
         self._delay = delay_s
         self.spec = model.spec
 
-    def prefill(self, *a):
-        return self._m.prefill(*a)
+    def prefill(self, *a, **k):
+        return self._m.prefill(*a, **k)
 
-    def decode_step(self, *a):
+    def prefill_chunk(self, *a, **k):
+        return self._m.prefill_chunk(*a, **k)
+
+    def decode_step(self, *a, **k):
         time.sleep(self._delay)
-        return self._m.decode_step(*a)
+        return self._m.decode_step(*a, **k)
 
 
 def test_kill_connection_mid_stream_zero_lost_zero_dup(monkeypatch):

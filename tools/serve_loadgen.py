@@ -869,7 +869,9 @@ def run_decode_load(engine, n_clients=8, requests_per_client=8,
                     min_prompt=4, max_prompt=16, vocab=64,
                     min_new=4, max_new=16, deadline_ms=None,
                     result_timeout_s=600.0, seed=0, metrics_url=None,
-                    stream=True, watch_engines=None):
+                    stream=True, watch_engines=None, prompt_reuse=0.0,
+                    temperature=None, top_k=None, top_p=None,
+                    sample_seed=None):
     """Closed-loop GENERATION traffic against a ``DecodeEngine`` (or a
     ``ServingRouter`` fronting decode engines): each client submits a
     random prompt with a random ``max_new_tokens``, consumes the
@@ -891,6 +893,22 @@ def run_decode_load(engine, n_clients=8, requests_per_client=8,
     ``stream=False`` drives the same traffic through plain
     ``result()`` waits — the streamed-vs-unstreamed parity axis (the
     token sequences must match bit-for-bit; generation is greedy).
+
+    ``prompt_reuse=FRAC`` prepends a SHARED system prompt (a fixed
+    token prefix, identical across clients) to that fraction of
+    requests — the traffic shape the prefix KV cache exists for. With
+    ``watch_engines`` the report adds the observed prefix-cache hit
+    rate and reused-token total off the pools' ``prefix_stats()``
+    delta.
+
+    ``temperature``/``top_k``/``top_p`` turn on SEEDED sampling: each
+    request carries a deterministic per-request seed (derived from
+    ``sample_seed``, or minted server-side when None). The existing
+    streamed-vs-final byte-identity check then doubles as the replay
+    check: across a ``--router`` failover the relay re-runs the
+    request on a sibling seat and drops already-seen part indices, so
+    ``stream_mismatches == 0`` proves the resampled continuation was
+    byte-identical — the seed, not the seat, owns the randomness.
     """
     import threading
 
@@ -902,6 +920,27 @@ def run_decode_load(engine, n_clients=8, requests_per_client=8,
     is_router = hasattr(engine, "scoreboard")
     costs_before = _fetch_costs(metrics_url) if metrics_url else None
     before = scrape_metrics(metrics_url) if metrics_url else None
+
+    # the shared system prompt: one fixed token prefix every reusing
+    # request starts with (page-aligned sharing is the pool's job —
+    # the loadgen just makes the traffic look like production)
+    sys_prompt = None
+    if prompt_reuse > 0:
+        sys_len = max(min_prompt, max_prompt // 2)
+        sys_prompt = np.random.RandomState(seed ^ 0x5F5F) \
+            .randint(1, vocab, sys_len).astype(np.int32)
+
+    def _prefix_totals():
+        if not watch_engines:
+            return None
+        tot = {}
+        for eng in watch_engines:
+            for k, v in eng.pool.prefix_stats().items():
+                if isinstance(v, (int, float)):
+                    tot[k] = tot.get(k, 0) + v
+        return tot
+
+    prefix_before = _prefix_totals()
 
     latencies = []           # (total_ms, trace_id)
     ttfts = []               # ms
@@ -915,14 +954,27 @@ def run_decode_load(engine, n_clients=8, requests_per_client=8,
 
     def client(cid):
         rs = np.random.RandomState(seed + cid)
-        for _ in range(requests_per_client):
+        for i in range(requests_per_client):
             n = int(rs.randint(min_prompt, max_prompt + 1))
             n_new = int(rs.randint(min_new, max_new + 1))
             toks = rs.randint(1, vocab, n).astype(np.int32)
+            if sys_prompt is not None and rs.rand() < prompt_reuse:
+                tail = max(1, n - len(sys_prompt))
+                toks = np.concatenate(
+                    [sys_prompt, toks[:tail]]).astype(np.int32)
+                toks = toks[:max_prompt]
+            kw = {}
+            if temperature is not None:
+                kw["temperature"] = temperature
+                kw["top_k"] = top_k
+                kw["top_p"] = top_p
+                if sample_seed is not None:
+                    kw["seed"] = sample_seed + cid * 1009 + i
             t0 = time.perf_counter()
             try:
                 fut = engine.submit(toks, deadline_ms=deadline_ms,
-                                    max_new_tokens=n_new, stream=stream)
+                                    max_new_tokens=n_new, stream=stream,
+                                    **kw)
                 if stream:
                     stamps = []       # per-token arrival timestamps
                     parts = []
@@ -1034,6 +1086,12 @@ def run_decode_load(engine, n_clients=8, requests_per_client=8,
               "inter_token_p50_ms": pct(gap_xs, 50),
               "inter_token_p99_ms": pct(gap_xs, 99),
               "engine": engine.snapshot()}
+    if temperature is not None:
+        report["sampling"] = {"temperature": temperature,
+                              "top_k": top_k, "top_p": top_p,
+                              "seed_base": sample_seed}
+    if prompt_reuse > 0:
+        report["prompt_reuse"] = prompt_reuse
     if watch_engines:
         report["kv_occupancy_peak"] = round(occupancy["peak"], 4)
         report["peak_slots"] = occupancy["peak_slots"]
@@ -1043,6 +1101,20 @@ def run_decode_load(engine, n_clients=8, requests_per_client=8,
             churn["joins"] += snap["joins"]
             churn["leaves"] += snap["leaves"]
         report["churn"] = churn
+        prefix_after = _prefix_totals()
+        if prefix_before is not None and prefix_after is not None:
+            delta = {k: prefix_after.get(k, 0) - prefix_before.get(k, 0)
+                     for k in prefix_after}
+            looks = delta.get("lookups", 0)
+            report["prefix"] = {
+                "lookups": looks,
+                "hits": delta.get("hits", 0),
+                "hit_rate": (round(delta.get("hits", 0) / looks, 4)
+                             if looks else None),
+                "pages_reused": delta.get("pages_reused", 0),
+                "tokens_reused": delta.get("tokens_reused", 0),
+                "cow_pages": delta.get("cow_pages", 0),
+                "evictions": delta.get("evictions", 0)}
     if is_router:
         snap = report["engine"]
         report["per_engine"] = {eid: row["dispatched"]
@@ -1848,6 +1920,21 @@ def _main():
     ap.add_argument("--no-stream", action="store_true",
                     help="--decode: wait for full results instead of "
                     "consuming token streams (the parity axis)")
+    ap.add_argument("--prompt-reuse", type=float, default=0.0,
+                    metavar="FRAC",
+                    help="--decode: prepend a SHARED system prompt to "
+                    "FRAC of requests (0..1) — the traffic shape the "
+                    "prefix KV cache serves; the report adds the "
+                    "observed prefix-cache hit rate and reused-token "
+                    "total")
+    ap.add_argument("--sample", default=None,
+                    metavar="TEMP[,TOPK[,TOPP[,SEED]]]",
+                    help="--decode: seeded sampling instead of greedy "
+                    "— e.g. '0.8,40,0.95,7'. Each request carries a "
+                    "deterministic per-request seed derived from SEED "
+                    "(omitted: the server mints one), so streams "
+                    "replay byte-identical across --router failover "
+                    "(stream_mismatches stays 0)")
     ap.add_argument("--drill-overload", nargs="?", const="auto",
                     default=None, metavar="ALERT",
                     help="instead of the measured run, flood the "
@@ -2048,6 +2135,16 @@ def _main():
                   file=sys.stderr)
             return 0
         if args.decode:
+            sample_kw = {}
+            if args.sample:
+                parts = [p.strip() for p in args.sample.split(",")]
+                sample_kw["temperature"] = float(parts[0])
+                if len(parts) > 1:
+                    sample_kw["top_k"] = int(parts[1])
+                if len(parts) > 2:
+                    sample_kw["top_p"] = float(parts[2])
+                if len(parts) > 3:
+                    sample_kw["sample_seed"] = int(parts[3])
             report = run_decode_load(
                 target, n_clients=args.clients,
                 requests_per_client=args.requests,
@@ -2056,7 +2153,8 @@ def _main():
                 vocab=args.vocab, deadline_ms=args.deadline_ms,
                 min_new=max(1, args.max_new // 4),
                 max_new=args.max_new, stream=not args.no_stream,
-                metrics_url=metrics_url, watch_engines=engines)
+                metrics_url=metrics_url, watch_engines=engines,
+                prompt_reuse=args.prompt_reuse, **sample_kw)
         else:
             report = run_load(target, n_clients=args.clients,
                               requests_per_client=args.requests,
@@ -2076,6 +2174,24 @@ def _main():
               f"{report.get('inter_token_p99_ms')} ms, "
               f"{report['stream_mismatches']} stream mismatches",
               file=sys.stderr)
+        if report.get("prefix"):
+            pfx = report["prefix"]
+            rate = pfx.get("hit_rate")
+            print(f"# prefix cache: hit rate "
+                  f"{(f'{rate:.0%}' if rate is not None else 'n/a')} "
+                  f"({pfx['hits']}/{pfx['lookups']} lookups), "
+                  f"{pfx['tokens_reused']} tokens reused across "
+                  f"{pfx['pages_reused']} pages, {pfx['cow_pages']} "
+                  f"copy-on-writes, {pfx['evictions']} evictions",
+                  file=sys.stderr)
+        if report.get("sampling"):
+            print(f"# sampling: temp={report['sampling']['temperature']} "
+                  f"top_k={report['sampling']['top_k']} "
+                  f"top_p={report['sampling']['top_p']} — streams "
+                  "verified byte-identical to final results "
+                  f"({report['stream_mismatches']} mismatches; with "
+                  "--router failover this is the seeded replay check)",
+                  file=sys.stderr)
     if report.get("per_engine"):
         total = max(1, sum(report["per_engine"].values()))
         print("# per-engine distribution: "

@@ -208,11 +208,12 @@ def compile_cache_split(metrics_text):
 
 def decode_split(metrics_text):
     """Per-engine DECODE serving view from an exposition scrape:
-    KV-page occupancy (used/free off ``mxnet_tpu_serving_kv_pages``),
-    generated-token + slot-churn totals, and the inter-token latency
-    p99 estimated from the cumulative
-    ``mxnet_tpu_serving_inter_token_latency_ms`` histogram. Empty for
-    a fleet with no decode engines."""
+    KV-page occupancy (used/free plus the shared/private/cached split
+    off ``mxnet_tpu_serving_kv_pages``), prefix-cache hit rate (off
+    ``mxnet_tpu_serving_kv_prefix_events_total``), generated-token +
+    slot-churn totals, and the inter-token latency p99 estimated from
+    the cumulative ``mxnet_tpu_serving_inter_token_latency_ms``
+    histogram. Empty for a fleet with no decode engines."""
     from mxnet_tpu.telemetry.expo import (histogram_quantile,
                                           parse_labels,
                                           parse_prometheus_text)
@@ -225,14 +226,22 @@ def decode_split(metrics_text):
         if name == "mxnet_tpu_serving_kv_pages":
             out.setdefault(eid, {})[
                 f"pages_{labels.get('state', '?')}"] = int(val)
+        elif name == "mxnet_tpu_serving_kv_prefix_events_total":
+            out.setdefault(eid, {})[
+                f"prefix_{labels.get('event', '?')}"] = int(val)
         elif name == "mxnet_tpu_serving_decode_tokens_total":
             out.setdefault(eid, {})["tokens"] = int(val)
         elif name == "mxnet_tpu_serving_decode_slot_events_total":
             out.setdefault(eid, {})[labels.get("event", "?")] = int(val)
     for eid, row in out.items():
         used = row.get("pages_used", 0)
-        total = used + row.get("pages_free", 0)
+        total = used + row.get("pages_free", 0) \
+            + row.get("pages_cached", 0)
         row["occupancy"] = round(used / total, 4) if total else None
+        looks = row.get("prefix_hit", 0) + row.get("prefix_miss", 0)
+        row["prefix_hit_rate"] = (
+            round(row.get("prefix_hit", 0) / looks, 4) if looks
+            else None)
         p99 = histogram_quantile(
             parsed, "mxnet_tpu_serving_inter_token_latency_ms", 99,
             match={"engine_id": eid})
@@ -288,11 +297,17 @@ def dump_fleet(base, out=None, top=5):
     for eid, row in sorted(dec.items()):
         occ = row.get("occupancy")
         p99 = row.get("inter_token_p99_ms")
+        hit = row.get("prefix_hit_rate")
+        total = (row.get("pages_used", 0) + row.get("pages_free", 0)
+                 + row.get("pages_cached", 0))
         print(f"  decode {eid}: kv "
               f"{(f'{occ:.0%}' if occ is not None else '-')} "
-              f"({row.get('pages_used', 0)}/"
-              f"{row.get('pages_used', 0) + row.get('pages_free', 0)} "
-              f"pages), inter-token p99 "
+              f"({row.get('pages_used', 0)}/{total} pages, "
+              f"{row.get('pages_shared', 0)} shared/"
+              f"{row.get('pages_private', 0)} private/"
+              f"{row.get('pages_cached', 0)} cached), prefix hit "
+              f"{(f'{hit:.0%}' if hit is not None else '-')}, "
+              f"inter-token p99 "
               f"{(f'~{p99} ms' if p99 is not None else '-')}, "
               f"tokens={row.get('tokens', 0)} "
               f"join/leave={row.get('join', 0)}/{row.get('leave', 0)}",
