@@ -1266,6 +1266,154 @@ def main_serving_router():
                 / max(1e-9, wire_ab["dispatch_overhead_p50_ms"]), 2))
 
 
+def main_serving_multitenant():
+    """Multi-tenant multi-model serving bench
+    (`bert_serving_multitenant`): two named models on every engine of
+    a 2-seat router fleet, driven to OVERLOAD by a weighted tenant mix
+    (priority:standard:best-effort closed-loop clients), with a live
+    hot-swap of one model mid-load.
+
+    The acceptance shape: best-effort absorbs the shedding while
+    priority takes none and holds the tightest p99; every named
+    tenant's bill reconciles against the server's tenant-slice
+    counters; and the mid-load ``swap_model`` loses ZERO requests and
+    leaves the new version warm (a post-swap probe answers in
+    compile-free milliseconds)."""
+    _setup_cache()
+
+    import contextlib
+    import threading
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo.bert import BERTModel, bert_serving_entry
+    from mxnet_tpu.serving import (ModelRegistry, ServingEngine,
+                                   ServingRouter)
+
+    tools_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "tools")
+    if tools_dir not in sys.path:
+        sys.path.insert(0, tools_dir)
+    from serve_loadgen import parse_tenant_spec, run_load
+
+    seqlen = int(os.environ.get("BENCH_SEQLEN", "128"))
+    vocab = int(os.environ.get("BENCH_VOCAB", "30522"))
+    units = int(os.environ.get("BENCH_SERVE_UNITS", "128"))
+    layers = int(os.environ.get("BENCH_SERVE_LAYERS", "2"))
+    heads = int(os.environ.get("BENCH_SERVE_HEADS", "4"))
+    reqs = int(os.environ.get("BENCH_SERVE_REQS", "10"))
+    max_rows = int(os.environ.get("BENCH_SERVE_ROWS", "2"))
+    # small queue + rows ON PURPOSE: the tenant mix must overrun the
+    # fleet (clients > queues + in-flight) so the WFQ eviction order
+    # (best-effort first, priority never) is actually exercised, not
+    # just plausible
+    queue_depth = int(os.environ.get("BENCH_SERVE_QUEUE", "2"))
+    tenants = parse_tenant_spec(os.environ.get(
+        "BENCH_TENANTS", "priority:2,standard:4,best-effort:10"))
+    p99_bound_ms = float(os.environ.get("BENCH_TENANT_P99_MS", "5000"))
+    buckets = tuple(int(b) for b in os.environ.get(
+        "BENCH_SERVE_BUCKETS",
+        f"{max(1, seqlen // 4)},{seqlen}").split(","))
+    model_ids = ("m-a", "m-b")
+    ctx = mx.current_context()
+
+    def make_entry():
+        net = BERTModel(vocab_size=vocab, units=units,
+                        hidden_size=4 * units, num_layers=layers,
+                        num_heads=heads, max_length=seqlen, dropout=0.0,
+                        attention_dropout=0.0, use_pooler=False)
+        net.initialize(init=mx.initializer.Normal(0.02), ctx=ctx)
+        if DTYPE != "float32":
+            net.cast(DTYPE)
+        return bert_serving_entry(net)
+
+    with contextlib.ExitStack() as stack:
+        engines = []
+        for i in range(2):
+            reg = ModelRegistry()
+            entry = make_entry()
+            for mid in model_ids:
+                reg.register(mid, entry, version="v1")
+            engines.append(stack.enter_context(ServingEngine(
+                reg, ctx=ctx, bucket_lens=buckets, max_rows=max_rows,
+                max_queue_depth=queue_depth, pool="mean",
+                engine_id=f"e{i}")))
+        router = stack.enter_context(ServingRouter(engines=engines))
+        metrics_url = router.expose().url("/metrics")
+        for eng in engines:
+            eng.warmup()
+        run_load(router, n_clients=4, requests_per_client=2,
+                 min_len=max(4, seqlen // 8), max_len=seqlen,
+                 vocab=vocab, model_ids=list(model_ids))
+        for eng in engines:
+            eng.reset_stats()
+
+        # mid-load hot-swap: a fresh m-b v2 is warm-replayed and cut
+        # over on BOTH seats while the tenant mix is in full flight
+        swap = {"ms": None, "error": None}
+
+        def swapper():
+            time.sleep(0.5)
+            try:
+                entry2 = make_entry()
+                t0 = time.perf_counter()
+                for eng in engines:
+                    eng.swap_model(entry2, model_id="m-b",
+                                   version="v2")
+                swap["ms"] = round((time.perf_counter() - t0) * 1e3, 3)
+            except Exception as e:       # surfaced in the assert below
+                swap["error"] = repr(e)
+
+        th = threading.Thread(target=swapper,
+                              name="bench_hot_swap", daemon=True)
+        th.start()
+        report = run_load(router, requests_per_client=reqs,
+                          min_len=max(4, seqlen // 8), max_len=seqlen,
+                          vocab=vocab, metrics_url=metrics_url,
+                          tenants=tenants, model_ids=list(model_ids))
+        th.join(timeout=600.0)
+        # post-swap warmth: one direct v2 probe per seat — warm means
+        # NO compile on the user path (milliseconds, not seconds)
+        probe_ms = []
+        for eng in engines:
+            assert eng.snapshot()["models"]["m-b"] == "v2", \
+                eng.snapshot()["models"]
+            t0 = time.perf_counter()
+            eng.submit(np.arange(1, min(buckets) + 1, dtype=np.int32),
+                       model_id="m-b").result(timeout=600.0)
+            probe_ms.append(round((time.perf_counter() - t0) * 1e3, 3))
+    report.pop("engine")
+    trep = report["tenants"]
+    pri = trep["t-priority"]
+    be = trep["t-best-effort"]
+    # zero-loss through the swap: nothing errored; shedding is the
+    # WFQ's deliberate overload answer, and it lands on best-effort
+    # while priority takes none
+    assert swap["error"] is None, swap
+    assert report["errors"] == 0, report
+    assert be["shed"] > 0, trep
+    assert pri["shed"] == 0, trep
+    assert pri["p99_ms"] is not None and pri["p99_ms"] <= p99_bound_ms, \
+        trep
+    assert report.get("tenants_reconciled", True), \
+        report.get("tenant_mismatches")
+    _report("bert_serving_multitenant_requests_per_sec",
+            report["requests_per_sec"], "requests/sec", 0.0,
+            seqlen=seqlen, clients=len(tenants),
+            requests=report["completed"], dtype=DTYPE, engines=2,
+            models=len(model_ids),
+            p50_ms=report["p50_ms"], p99_ms=report["p99_ms"],
+            tenants={t: {k: row[k] for k in
+                         ("class", "completed", "shed", "p50_ms",
+                          "p99_ms", "client_tokens")}
+                     for t, row in sorted(trep.items())},
+            priority_p99_ms=pri["p99_ms"],
+            best_effort_shed=be["shed"],
+            tenants_reconciled=report.get("tenants_reconciled"),
+            swap_ms=swap["ms"], post_swap_probe_ms=probe_ms,
+            cost_reconciled=report.get("cost", {}).get("reconciled"),
+            slo_compliance=_slo_compliance(report))
+
+
 def main_decode_serving():
     """Autoregressive decode serving bench (`lm_decode_serving`): a
     paged-KV causal LM behind the continuous-batching
@@ -2066,6 +2214,12 @@ _SUITE = (
     # 2 engines behind the front-door router: req/s, per-engine share,
     # failover count, aggregated-/metrics reconciliation
     ("bert_serving_router", "serving_router", {"BENCH_WINDOWS": "1"}),
+    # multi-tenant multi-model: 2 models × 3 WFQ tenant classes driven
+    # to overload behind the router — priority p99 holds while
+    # best-effort sheds, per-tenant bills reconcile, and a mid-load
+    # hot-swap loses nothing and lands warm
+    ("bert_serving_multitenant", "serving_multitenant",
+     {"BENCH_WINDOWS": "1"}),
     # autoregressive DECODE serving: paged-KV causal LM, iteration-
     # level continuous batching, streamed tokens router-fronted —
     # tokens/s + TTFT + inter-token p50/p99 + KV occupancy + churn,
@@ -2122,7 +2276,9 @@ _SUMMARY_KEYS = ("metric", "value", "unit", "mfu", "hbm_frac", "hbm_est",
                  "adopted", "incidents", "ttft_p50_ms",
                  "inter_token_p50_ms", "inter_token_p99_ms",
                  "kv_occupancy", "churn", "stream_mismatches",
-                 "static_tokens_per_sec", "iteration_speedup")
+                 "static_tokens_per_sec", "iteration_speedup",
+                 "tenants", "priority_p99_ms", "best_effort_shed",
+                 "tenants_reconciled", "swap_ms", "post_swap_probe_ms")
 
 
 def _compact(rec):
@@ -2266,6 +2422,8 @@ def _dispatch():
         main_serving()
     elif _model == "serving_router":
         main_serving_router()
+    elif _model == "serving_multitenant":
+        main_serving_multitenant()
     elif _model == "serving_restart":
         main_serving_restart()
     elif _model == "serving_chaos":

@@ -457,6 +457,33 @@ register("MXNET_TPU_ROUTER_WEIGHT_GAIN", "float", 0.4,
          "per-poll smoothing gain toward the weight target (1.0 = "
          "jump immediately, small = glacial)", scope="routing")
 
+# -- multi-tenant, multi-model serving --------------------------------------
+register("MXNET_TPU_TENANT_WEIGHTS", "str", None,
+         "WFQ admission-class weights as ``class:weight`` pairs "
+         "(overlays the 4/2/1 default, e.g. "
+         "``priority:8,best-effort:1``): the queue dequeues classes "
+         "in proportion to weight under contention", scope="tenancy")
+register("MXNET_TPU_TENANT_DEPTH_SHARES", "str", None,
+         "per-class admission-queue depth budgets as fractions of "
+         "``max_depth`` (``class:share`` pairs, default 1.0 each — "
+         "e.g. ``best-effort:0.5`` caps best-effort at half the "
+         "queue even before WFQ eviction kicks in)", scope="tenancy")
+register("MXNET_TPU_TENANT_DEADLINE_MS", "str", None,
+         "per-class DEFAULT deadlines (ms) for requests that bring "
+         "none (``class:ms`` pairs, e.g. ``best-effort:2000``): "
+         "under overload, expiry consumes the short-deadline classes "
+         "first", scope="tenancy")
+register("MXNET_TPU_TENANT_SLO_MS", "str", None,
+         "per-class total-latency SLO thresholds (ms) for the "
+         "``default_tenant_objectives`` set (``class:ms`` pairs; "
+         "classes not listed default to 0.5x / 1x / 4x the serving "
+         "latency bound for priority/standard/best-effort)",
+         scope="tenancy")
+register("MXNET_TPU_MODEL_DEFAULT", "str", "default",
+         "model id a single-model engine registers under and a "
+         "model-less submit targets — the backward-compat identity "
+         "of the pre-registry fleet", scope="tenancy")
+
 # -- router active/active HA ------------------------------------------------
 register("MXNET_TPU_ROUTER_HA", "bool", True,
          "router active/active HA: with a peer configured, every "
@@ -630,6 +657,7 @@ _SCOPE_TITLES = OrderedDict([
     ("telemetry", "Telemetry / observability"),
     ("slo", "SLOs & alerting"),
     ("routing", "SLO-aware routing"),
+    ("tenancy", "Multi-tenant, multi-model serving"),
     ("ha", "Router active/active HA"),
     ("autoscale", "Autoscaler"),
     ("chaos", "Chaos injection"),

@@ -18,6 +18,13 @@ least-outstanding routing, failover requeue, engine-labeled metric
 aggregation, cross-engine trace merging, and a per-engine health
 scoreboard — see ``router.py``.
 
+Multi-tenancy: a :class:`~.tenancy.ModelRegistry` lets one engine
+host several named models (hot-swappable via ``swap_model``), the
+queue runs weighted-fair admission over tenant classes
+(priority/standard/best-effort), and every request carries
+``model_id``/``tenant``/``tenant_class`` through the router, wire
+protocol and HA journal — see ``tenancy.py``.
+
 Quickstart::
 
     from mxnet_tpu.gluon.model_zoo import bert_base
@@ -37,6 +44,9 @@ from .queue import (ServingError, QueueFullError, DeadlineExceededError,
                     RequestTooLongError, EngineStoppedError,
                     InvalidSamplingError, InferenceFuture, Request,
                     RequestQueue, validate_sampling)
+from .tenancy import (TENANT_CLASSES, ModelRegistry, TenantStats,
+                      UnknownModelError, class_weights,
+                      normalize_class)
 from .batcher import ContinuousBatcher, DecodeSlots, PackedPlan
 from .metrics import DecodeStats, LatencySummary, ServingStats
 from .engine import ServingEngine
@@ -57,4 +67,6 @@ __all__ = ["ServingEngine", "DecodeEngine", "ServingRouter",
            "ServingError", "QueueFullError", "DeadlineExceededError",
            "RequestTooLongError", "EngineStoppedError",
            "InvalidSamplingError", "validate_sampling",
-           "NoEngineAvailableError", "RemoteEngineError"]
+           "NoEngineAvailableError", "RemoteEngineError",
+           "TENANT_CLASSES", "ModelRegistry", "TenantStats",
+           "UnknownModelError", "class_weights", "normalize_class"]

@@ -108,6 +108,7 @@ class FleetAutoscaler:
         self._idle_since = None
         self._down_since = {}       # engine_id -> first-seen-down t
         self._last_action_t = None
+        self._census = {}           # model_id -> routable seats
         self.actions = []           # action records (drill surface)
         self._g_seats = reg.gauge(
             "mxnet_tpu_autoscaler_seats",
@@ -187,6 +188,32 @@ class FleetAutoscaler:
         return (max_short_burn(slo), snap.get("queue_depth") or 0,
                 snap["engines"])
 
+    @staticmethod
+    def _model_seats(board):
+        """model_id -> count of ROUTABLE seats hosting it, off the
+        scoreboard's per-seat ``models`` maps. Attached to every
+        action record so a drill can see WHICH model's capacity an
+        action changed (a fleet serving two models at 3:1 seat split
+        scales them 3:1, not blindly)."""
+        census = {}
+        for row in board.values():
+            if not row.get("routable"):
+                continue
+            models = row.get("models")
+            if isinstance(models, dict):
+                for mid in models:
+                    census[mid] = census.get(mid, 0) + 1
+        return census
+
+    @staticmethod
+    def _engine_models(engine):
+        """Model ids one engine hosts (best effort, for records)."""
+        try:
+            models = engine.snapshot().get("models")
+            return sorted(models) if isinstance(models, dict) else None
+        except Exception:
+            return None
+
     # -- one tick -----------------------------------------------------------
     def evaluate_once(self, now=None):
         """One evaluation: replacement first (availability), then the
@@ -197,6 +224,7 @@ class FleetAutoscaler:
         routable = [eid for eid, row in board.items()
                     if row.get("routable")]
         self._g_seats.set(len(routable))
+        self._census = self._model_seats(board)
 
         # -- replace dead seats (cooldown-exempt) ---------------------------
         for eid, row in board.items():
@@ -275,7 +303,8 @@ class FleetAutoscaler:
 
     def _record(self, action, engine_id, now, **extra):
         self._last_action_t = now
-        rec = dict(action=action, engine_id=engine_id, **extra)
+        rec = dict(action=action, engine_id=engine_id,
+                   model_seats=dict(self._census), **extra)
         self.actions.append(rec)
         self._c_actions.labels(action=action).inc()
         _events.emit("autoscale_action", **rec)
@@ -302,6 +331,7 @@ class FleetAutoscaler:
         self._add_everywhere(engine_id, engine)
         return self._record("scale_up", engine_id, now,
                             ttft_ms=ttft_ms, manifest_shapes=shapes,
+                            models=self._engine_models(engine),
                             burn=(round(burn, 3)
                                   if burn is not None else None),
                             queue_depth=queue_depth)
@@ -344,4 +374,5 @@ class FleetAutoscaler:
                 pass
         self._add_everywhere(engine_id, engine)
         return self._record("replace", engine_id, now,
-                            ttft_ms=ttft_ms, manifest_shapes=shapes)
+                            ttft_ms=ttft_ms, manifest_shapes=shapes,
+                            models=self._engine_models(engine))

@@ -66,14 +66,16 @@ from ..telemetry import incidents as _incidents
 from ..telemetry import profiling as _profiling
 from ..telemetry import recorder as _recorder
 from ..telemetry.registry import REGISTRY as _REGISTRY
+from . import tenancy
 from .batcher import DecodeSlots, PrefillChunks
 from .engine import _SUBMIT_ERROR_STATUS
 from .kvcache import PagedKVPool
 from .metrics import (CostLedger, DecodeStats, ServingStats,
                       exemplar_gate, slow_exemplar)
-from .queue import (DeadlineExceededError, EngineStoppedError, Request,
-                    RequestQueue, RequestTooLongError, ServingError,
-                    validate_sampling)
+from .queue import (DeadlineExceededError, EngineStoppedError,
+                    QueueFullError, Request, RequestQueue,
+                    RequestTooLongError, ServingError,
+                    UnknownModelError, validate_sampling)
 
 __all__ = ["DecodeEngine", "DecodeRequest"]
 
@@ -93,9 +95,11 @@ class DecodeRequest(Request):
 
     def __init__(self, tokens, max_new_tokens, eos_id=None, stream=False,
                  deadline_ms=None, trace_id=None, parent_span_id=None,
-                 temperature=0.0, top_k=0, top_p=1.0, seed=0):
+                 temperature=0.0, top_k=0, top_p=1.0, seed=0,
+                 tenant=None, tenant_class=None, model_id=None):
         super().__init__(tokens, None, deadline_ms, trace_id=trace_id,
-                         parent_span_id=parent_span_id)
+                         parent_span_id=parent_span_id, tenant=tenant,
+                         tenant_class=tenant_class, model_id=model_id)
         self.prompt_len = int(self.tokens.size)
         self.max_new_tokens = int(max_new_tokens)
         if self.max_new_tokens < 1:
@@ -154,11 +158,21 @@ class DecodeEngine:
                  stats_window=4096, engine_id=None,
                  prefills_per_iter=None, prefill_budget=None,
                  prefix_cache=None, prefix_pages=None,
-                 temperature=None, top_k=None, top_p=None):
+                 temperature=None, top_k=None, top_p=None,
+                 model_id=None, model_version=None):
         self._model = model
         spec = dict(model.spec)
         self.engine_id = str(engine_id) if engine_id is not None \
             else f"d{os.getpid():x}-{next(_engine_seq)}"
+        # a decode engine hosts ONE paged-KV LM (the page pool is
+        # sized to its geometry) but still names it, so model_id rides
+        # its wire frames / journal entries / bills exactly as on the
+        # multi-model encoder engine — and a request addressed to a
+        # model this engine does not host is a typed 404, not silence
+        self.model_id = (str(model_id) if model_id is not None
+                         else tenancy.default_model_id())
+        self.model_version = (str(model_version)
+                              if model_version is not None else "v0")
         self.max_len = int(spec["max_len"])
         lens = sorted(set(int(b) for b in prefill_bucket_lens))
         if not lens or lens[0] < 1:
@@ -225,6 +239,12 @@ class DecodeEngine:
                                         window=stats_window)
         self.decode_stats.set_split_fns(lambda: len(self._queue),
                                         lambda: len(self._active))
+        self.tenants = tenancy.TenantStats(self.engine_id)
+        wfq = tenancy.wfq_depth_gauge()
+        for cls in tenancy.TENANT_CLASSES:
+            wfq.labels(engine_id=self.engine_id,
+                       tenant_class=cls).set_function(
+                lambda c=cls: self._queue.depths().get(c, 0))
         self.costs = CostLedger(self.engine_id)
         cc = _REGISTRY.counter(
             "mxnet_tpu_serving_compile_cache_total",
@@ -285,10 +305,12 @@ class DecodeEngine:
         if envvars.get("MXNET_TPU_SLO"):
             from ..telemetry.alerts import (AlertDaemon,
                                             default_burn_rules,
-                                            default_decode_objectives)
+                                            default_decode_objectives,
+                                            default_tenant_objectives)
             from ..telemetry.slo import SloEvaluator
             evaluator = SloEvaluator(self.engine_id)
             names = default_decode_objectives(evaluator, self.engine_id)
+            names += default_tenant_objectives(evaluator, self.engine_id)
             self._slo = AlertDaemon(evaluator)
             default_burn_rules(self._slo, names)
             self._slo.start()
@@ -323,6 +345,8 @@ class DecodeEngine:
             timed_out = worker.is_alive()
         for r in self._queue.drain_all():
             self.stats.bump("cancelled")
+            self.tenants.observe_event(r.tenant, r.tenant_class,
+                                       self.model_id, "cancelled")
             r.span.end(error="cancelled: engine stopped")
             r.future.set_exception(
                 EngineStoppedError("engine stopped before request ran"))
@@ -358,7 +382,8 @@ class DecodeEngine:
     def submit(self, tokens, token_types=None, deadline_ms=None,
                max_new_tokens=None, eos_id=None, stream=False,
                trace_id=None, parent_span_id=None, temperature=None,
-               top_k=None, top_p=None, seed=None):
+               top_k=None, top_p=None, seed=None, model_id=None,
+               tenant=None, tenant_class=None):
         """Enqueue one generation request; returns a STREAMING
         :class:`~.queue.InferenceFuture` — ``result()`` is the full
         (max_new_tokens,) int32 token array, ``stream()`` yields each
@@ -372,7 +397,12 @@ class DecodeEngine:
         :class:`~.queue.InvalidSamplingError` here — before any
         compiled step. A sampled request with no seed gets one minted
         at submit, so replay (stream(), failover re-dispatch) draws
-        the same tokens."""
+        the same tokens.
+
+        ``model_id`` must name THIS engine's model when given (a
+        decode engine hosts exactly one — unknown ids are a typed
+        404); ``tenant``/``tenant_class`` attribute the request to an
+        owner and its WFQ admission class, as on the encoder engine."""
         del token_types
         temperature, top_k, top_p, seed = validate_sampling(
             temperature, top_k, top_p, seed)
@@ -396,9 +426,28 @@ class DecodeEngine:
                             trace_id=trace_id,
                             parent_span_id=parent_span_id,
                             temperature=temperature, top_k=top_k,
-                            top_p=top_p, seed=seed)
+                            top_p=top_p, seed=seed, tenant=tenant,
+                            tenant_class=tenant_class,
+                            model_id=model_id)
         req.span.set_attr(engine=self.engine_id, decode=True)
         self.stats.bump("submitted")
+        if req.model_id is not None and req.model_id != self.model_id:
+            self.stats.bump("rejected_unknown_model")
+            self.tenants.observe_event(
+                req.tenant, req.tenant_class, str(req.model_id),
+                "rejected_unknown_model")
+            _events.emit("request_shed", reason="unknown_model",
+                         engine_id=self.engine_id,
+                         model=str(req.model_id),
+                         trace_id=req.trace_id, tokens=req.prompt_len)
+            req.span.set_attr(shed="unknown_model").force_keep() \
+               .end(error="shed: unknown_model")
+            raise UnknownModelError(
+                f"unknown model {req.model_id!r}: this decode engine "
+                f"hosts {self.model_id!r}")
+        req.model_id = self.model_id
+        self.tenants.observe_event(req.tenant, req.tenant_class,
+                                   req.model_id, "submitted")
         if not self._started or self._queue.closed:
             self.stats.bump("rejected_stopped")
             req.span.end(error="rejected: engine not running")
@@ -418,6 +467,8 @@ class DecodeEngine:
                         "the whole page pool")
         if too_long is not None:
             self.stats.bump("rejected_too_long")
+            self.tenants.observe_event(req.tenant, req.tenant_class,
+                                       req.model_id, "rejected_too_long")
             _events.emit("request_shed", reason="too_long",
                          engine_id=self.engine_id,
                          trace_id=req.trace_id, tokens=req.prompt_len)
@@ -425,19 +476,43 @@ class DecodeEngine:
                .end(error="shed: too_long")
             raise RequestTooLongError(too_long)
         try:
-            self._queue.put(req)
+            victim = self._queue.put(req)
         except ServingError as e:
             full = not self._queue.closed
             reason = "queue_full" if full else "stopped"
             self.stats.bump("rejected_queue_full"
                             if full else "rejected_stopped")
+            self.tenants.observe_event(
+                req.tenant, req.tenant_class, req.model_id,
+                "rejected_queue_full" if full else "rejected_stopped")
             _events.emit("request_shed", reason=reason,
                          engine_id=self.engine_id,
                          trace_id=req.trace_id, tokens=req.prompt_len)
             req.span.set_attr(shed=reason).force_keep() \
                .end(error=f"shed: {reason}")
             raise e
+        if victim is not None:
+            self._shed_victim(victim)
         return req.future
+
+    def _shed_victim(self, victim):
+        """Fail a request the WFQ queue evicted to admit a
+        higher-class arrival under overload — same contract as the
+        encoder engine's shed path."""
+        self.stats.bump("rejected_queue_full")
+        self.tenants.observe_event(victim.tenant, victim.tenant_class,
+                                   victim.model_id or self.model_id,
+                                   "shed")
+        _events.emit("request_shed", reason="wfq_evicted",
+                     engine_id=self.engine_id,
+                     trace_id=victim.trace_id,
+                     tenant_class=victim.tenant_class,
+                     tokens=victim.prompt_len)
+        victim.span.set_attr(shed="wfq_evicted").force_keep() \
+              .end(error="shed: wfq_evicted")
+        victim.future.set_exception(QueueFullError(
+            f"shed by weighted-fair admission: queue full and a "
+            f"higher class arrived (class {victim.tenant_class})"))
 
     def infer(self, tokens, max_new_tokens=None, eos_id=None,
               deadline_ms=None, timeout=None, temperature=None,
@@ -467,7 +542,10 @@ class DecodeEngine:
                           temperature=payload.get("temperature"),
                           top_k=payload.get("top_k"),
                           top_p=payload.get("top_p"),
-                          seed=payload.get("seed"))
+                          seed=payload.get("seed"),
+                          model_id=payload.get("model_id"),
+                          tenant=payload.get("tenant"),
+                          tenant_class=payload.get("tenant_class"))
         return fut, bool(payload.get("stream"))
 
     # -- warmup ------------------------------------------------------------
@@ -538,6 +616,9 @@ class DecodeEngine:
         out["prefill_buckets"] = list(self.prefill_bucket_lens)
         out["max_rows"] = self._max_rows
         out["iteration_level"] = self._iteration_level
+        out["models"] = {self.model_id: self.model_version}
+        out["queue_classes"] = self._queue.depths()
+        out["tenants"] = self.tenants.bills()
         out["active_slots"] = len(self._active)
         out["seconds_since_beat"] = round(
             time.monotonic() - self._beat, 3)
@@ -565,9 +646,11 @@ class DecodeEngine:
         return {"engine_id": self.engine_id,
                 "iteration_level": self._iteration_level,
                 "prefill_budget": self._prefill_budget,
+                "models": {self.model_id: self.model_version},
                 "active": active,
                 "prefilling": prefilling,
                 "prefill_queue_depth": len(self._queue),
+                "queue_classes": self._queue.depths(),
                 "reserved_pages": self._reserved_pages,
                 "kv": self.pool.occupancy(),
                 "prefix": self.pool.prefix_stats(),
@@ -619,6 +702,7 @@ class DecodeEngine:
                 wire = self._wire
                 return (alive and not closed,
                         {"engine_id": self.engine_id, "decode": True,
+                         "models": {self.model_id: self.model_version},
                          "worker_alive": alive, "queue_closed": closed,
                          "queue_depth": len(self._queue),
                          "active_slots": len(self._active),
@@ -665,7 +749,7 @@ class DecodeEngine:
         t0 = time.perf_counter()
         try:
             fut, streamed = self.submit_payload(payload)
-        except (ServingError, ValueError, KeyError, TypeError) as e:
+        except (ServingError, ValueError, LookupError, TypeError) as e:
             name = type(e).__name__
             return (_SUBMIT_ERROR_STATUS.get(name, 400),
                     {"ok": False, "error_type": name, "error": str(e),
@@ -866,6 +950,8 @@ class DecodeEngine:
         self._prefilling = []
         for req in self._queue.drain_all():
             self.stats.bump("cancelled")
+            self.tenants.observe_event(req.tenant, req.tenant_class,
+                                       self.model_id, "cancelled")
             req.span.end(error="cancelled: engine stopped")
             req.future.set_exception(exc)
 
@@ -906,6 +992,8 @@ class DecodeEngine:
             now = time.monotonic()
             if req.expired(now):
                 self.stats.bump("expired")
+                self.tenants.observe_event(req.tenant, req.tenant_class,
+                                           self.model_id, "expired")
                 _events.emit("request_expired", trace_id=req.trace_id,
                              waited_ms=round(
                                  (now - req.t_submit) * 1e3, 3))
@@ -1262,6 +1350,8 @@ class DecodeEngine:
             self.decode_stats.observe_leave()
         if error is not None:
             self.stats.bump(counter)
+            self.tenants.observe_event(req.tenant, req.tenant_class,
+                                       self.model_id, counter)
             req.span.end(error=repr(error))
             req.future.set_exception(error)
             return
@@ -1273,12 +1363,22 @@ class DecodeEngine:
             total_ms, exemplar=slow_exemplar(req.trace_id, total_ms,
                                              self._exemplars))
         self.stats.bump("completed")
+        self.tenants.observe_event(req.tenant, req.tenant_class,
+                                   self.model_id, "completed")
+        self.tenants.observe_latency(req.tenant, req.tenant_class,
+                                     self.model_id, total_ms)
+        self.tenants.observe_cost(
+            req.tenant, req.tenant_class, self.model_id, req.device_s,
+            req.prompt_len - req.reused_tokens + len(req.generated))
         # "tokens" mirrors the ledger's accounting unit (prompt tokens
         # PREFILLED — prefix-reused ones never hit the device — plus
         # tokens generated) so client-summed bills reconcile against
         # the /costs delta token-for-token
         req.future.cost = {"engine_id": self.engine_id,
                            "bucket": "decode",
+                           "model": self.model_id,
+                           "tenant": req.tenant,
+                           "tenant_class": req.tenant_class,
                            "device_s": req.device_s,
                            "compiled": False,
                            "tokens": (req.prompt_len - req.reused_tokens

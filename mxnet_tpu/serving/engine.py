@@ -39,11 +39,14 @@ from ..telemetry import recorder as _recorder
 from ..telemetry import spans as _spans
 from ..telemetry.registry import REGISTRY as _REGISTRY
 from ..telemetry.trace import trace_context as _trace_context
+from . import tenancy
 from .batcher import ContinuousBatcher
 from .metrics import (CostLedger, ServingStats, exemplar_gate,
                       slow_exemplar)
-from .queue import (DeadlineExceededError, EngineStoppedError, Request,
-                    RequestQueue, RequestTooLongError, ServingError)
+from .queue import (DeadlineExceededError, EngineStoppedError,
+                    QueueFullError, Request, RequestQueue,
+                    RequestTooLongError, ServingError,
+                    UnknownModelError)
 
 __all__ = ["ServingEngine"]
 
@@ -63,6 +66,10 @@ _SUBMIT_ERROR_STATUS = {
     # out-of-range sampling params are a malformed request, refused at
     # admission — before the compiled step could turn them into NaNs
     "InvalidSamplingError": 400,
+    # the named model is not hosted by this engine — the multi-model
+    # fleet's 404 (a router retries another seat; a client fixes its
+    # model id)
+    "UnknownModelError": 404,
 }
 
 
@@ -100,8 +107,11 @@ class ServingEngine:
 
     Parameters
     ----------
-    model : callable
-        The packed forward (see module docstring).
+    model : callable or :class:`~.tenancy.ModelRegistry`
+        The packed forward (see module docstring), or a registry of
+        several — a multi-model engine dispatches each batch through
+        the model its requests named (``submit(model_id=...)``), and
+        ``swap_model`` hot-swaps any entry live.
     bucket_lens : row-length buckets (ascending); a request longer
         than the last one is rejected at submit.
     max_rows : packed rows per dispatched batch (row counts are
@@ -127,7 +137,12 @@ class ServingEngine:
                  max_rows=8, max_queue_depth=256, default_deadline_ms=None,
                  batch_wait_ms=0.0, max_batch_requests=None, pool="tokens",
                  pad_value=0, stats_window=4096, engine_id=None):
-        self._model = model
+        # model identity: a plain callable becomes a one-entry
+        # registry under the default model id — the pre-tenancy API
+        # unchanged. Dispatch resolves the fn through the registry per
+        # batch, so a hot-swap (or a chaos wrap via the _model
+        # property) takes effect at the next batch boundary.
+        self._models = tenancy.ModelRegistry.of(model)
         self.engine_id = str(engine_id) if engine_id is not None \
             else f"e{os.getpid():x}-{next(_engine_seq)}"
         self._ctx = ctx if ctx is not None else current_context()
@@ -144,6 +159,14 @@ class ServingEngine:
         self._pool = _POOLERS[pool] if isinstance(pool, str) else pool
         self.stats = ServingStats(stats_window, engine_id=self.engine_id)
         self.stats.set_queue_depth_fn(lambda: len(self._queue))
+        # per-tenant/per-model observability slice + the per-class WFQ
+        # depth pull gauges (scrape-time reads, zero hot-path cost)
+        self.tenants = tenancy.TenantStats(self.engine_id)
+        wfq = tenancy.wfq_depth_gauge()
+        for cls in tenancy.TENANT_CLASSES:
+            wfq.labels(engine_id=self.engine_id, tenant_class=cls) \
+               .set_function(
+                   lambda c=cls: self._queue.depths().get(c, 0))
         # per-bucket cost ledger: device/compile seconds + requests +
         # tokens, cumulative for the process lifetime (reset_stats
         # swaps the stats WINDOW, never the ledger — /costs scrapers
@@ -159,6 +182,9 @@ class ServingEngine:
             r: cc.labels(engine_id=self.engine_id, result=r)
             for r in ("memory_hit", "persistent_hit", "miss")}
         self._cc_counts = {r: 0 for r in self._compile_cache}
+        # visited shape buckets, keyed (model_id, rows, row_len): each
+        # hosted model owns its compile universe; the exported warmup
+        # manifest stays the plain (rows, row_len) union
         self._seen_shapes = set()
         # guards _seen_shapes + the compile-cache tallies: the worker
         # dispatches while warmup()/warmup_manifest() run on caller
@@ -197,6 +223,23 @@ class ServingEngine:
         self._last_dispatch = self._beat
         self._probe_name = f"serving_engine_{id(self):x}"
 
+    @property
+    def _model(self):
+        """The DEFAULT model's entry point — the pre-registry
+        attribute the chaos harness wraps/unwraps in place."""
+        return self._models.resolve(None)[1]
+
+    @_model.setter
+    def _model(self, fn):
+        # in-place fn replacement keeps the version: chaos wraps must
+        # not look like a new model version (no canary re-TOFU)
+        self._models.swap(self._models.default_id(), fn)
+
+    @property
+    def models(self):
+        """The engine's :class:`~.tenancy.ModelRegistry`."""
+        return self._models
+
     # -- lifecycle ---------------------------------------------------------
     def start(self):
         with self._lock:
@@ -218,6 +261,11 @@ class ServingEngine:
         # flight-recorder crash hooks + the stall watchdog ride along
         _recorder.install()
         _recorder.register_probe(self._probe_name, self._watchdog_probe)
+        # flight bundles carry the scheduler's WFQ view: per-class
+        # queue split + hosted model versions at crash time
+        self._bundle_name = f"engine_scheduler_{self.engine_id}"
+        _recorder.add_bundle_section(self._bundle_name,
+                                     self.scheduler_state)
         # ... and narrate it: the incident tracker folds alert
         # firings, watchdog trips and scoreboard transitions into the
         # /incidents timeline (thread-free — an events tap)
@@ -232,10 +280,12 @@ class ServingEngine:
         # opts out of evaluation, exemplars and the endpoints)
         if envvars.get("MXNET_TPU_SLO"):
             from ..telemetry.alerts import (AlertDaemon, default_burn_rules,
-                                            default_serving_objectives)
+                                            default_serving_objectives,
+                                            default_tenant_objectives)
             from ..telemetry.slo import SloEvaluator
             evaluator = SloEvaluator(self.engine_id)
             names = default_serving_objectives(evaluator, self.engine_id)
+            names += default_tenant_objectives(evaluator, self.engine_id)
             self._slo = AlertDaemon(evaluator)
             default_burn_rules(self._slo, names)
             self._slo.start()
@@ -257,6 +307,9 @@ class ServingEngine:
         _events.emit("engine_abort" if not drain else "engine_stop",
                      engine_id=self.engine_id, drain=drain)
         _recorder.unregister_probe(self._probe_name)
+        _recorder.remove_bundle_section(
+            getattr(self, "_bundle_name", f"engine_scheduler_"
+                                          f"{self.engine_id}"))
         if self._slo is not None:
             self._slo.stop()
         with self._lock:
@@ -308,10 +361,16 @@ class ServingEngine:
 
     # -- client surface ----------------------------------------------------
     def submit(self, tokens, token_types=None, deadline_ms=None,
-               trace_id=None, parent_span_id=None):
+               trace_id=None, parent_span_id=None, model_id=None,
+               tenant=None, tenant_class=None):
         """Enqueue one request; returns an :class:`InferenceFuture`.
         Raises the admission errors directly (queue full, too long,
-        stopped) so callers can tell shedding from failure.
+        stopped, unknown model) so callers can tell shedding from
+        failure.
+
+        ``model_id`` names the hosted model to run (None = the
+        default); ``tenant``/``tenant_class`` attribute the request to
+        an owner and its WFQ admission class (None = ``standard``).
 
         ``trace_id``/``parent_span_id`` adopt an upstream trace (the
         router's dispatch, or a remote ``/submit`` payload): the
@@ -320,13 +379,33 @@ class ServingEngine:
         if deadline_ms is None:
             deadline_ms = self._default_deadline_ms
         # validate FIRST: a malformed request (empty tokens, mismatched
-        # token_types) raises to the caller without touching any
-        # counter, so submitted always equals the sum of the outcome
-        # counters (the invariant the loadgen cross-check reconciles)
+        # token_types, unknown class) raises to the caller without
+        # touching any counter, so submitted always equals the sum of
+        # the outcome counters (the invariant the loadgen cross-check
+        # reconciles)
         req = Request(tokens, token_types, deadline_ms,
-                      trace_id=trace_id, parent_span_id=parent_span_id)
+                      trace_id=trace_id, parent_span_id=parent_span_id,
+                      tenant=tenant, tenant_class=tenant_class,
+                      model_id=model_id)
         req.span.set_attr(engine=self.engine_id)
         self.stats.bump("submitted")
+        try:
+            # canonicalize up front: dispatch and billing then never
+            # re-resolve, and an unknown model is a typed 404 here
+            req.model_id = self._models.resolve_id(req.model_id)
+        except UnknownModelError:
+            self.stats.bump("rejected_unknown_model")
+            self.tenants.observe_event(
+                req.tenant, req.tenant_class, str(model_id),
+                "rejected_unknown_model")
+            _events.emit("request_shed", reason="unknown_model",
+                         engine_id=self.engine_id, model=str(model_id),
+                         trace_id=req.trace_id, tokens=len(req))
+            req.span.set_attr(shed="unknown_model").force_keep() \
+               .end(error="shed: unknown_model")
+            raise
+        self.tenants.observe_event(req.tenant, req.tenant_class,
+                                   req.model_id, "submitted")
         if not self._started or self._queue.closed:
             self.stats.bump("rejected_stopped")
             req.span.end(error="rejected: engine not running")
@@ -342,12 +421,15 @@ class ServingEngine:
                 f"request of {len(req)} tokens exceeds the largest row "
                 f"bucket ({self._batcher.max_len})")
         try:
-            self._queue.put(req)
+            victim = self._queue.put(req)
         except ServingError as e:
             full = not self._queue.closed
             reason = "queue_full" if full else "stopped"
             self.stats.bump("rejected_queue_full"
                             if full else "rejected_stopped")
+            self.tenants.observe_event(
+                req.tenant, req.tenant_class, req.model_id,
+                "rejected_queue_full" if full else "rejected_stopped")
             _events.emit("request_shed", reason=reason,
                          engine_id=self.engine_id,
                          trace_id=req.trace_id, tokens=len(req))
@@ -356,14 +438,37 @@ class ServingEngine:
             req.span.set_attr(shed=reason).force_keep() \
                .end(error=f"shed: {reason}")
             raise e
+        if victim is not None:
+            self._shed_victim(victim)
         return req.future
+
+    def _shed_victim(self, victim):
+        """Fail a request the WFQ queue EVICTED to admit a
+        higher-class arrival under overload — best-effort sheds
+        first, priority last, and the shed is loud on every surface
+        (counter, tenant slice, event, kept trace)."""
+        self.stats.bump("rejected_queue_full")
+        self.tenants.observe_event(victim.tenant, victim.tenant_class,
+                                   victim.model_id
+                                   or self._models.default_id(),
+                                   "shed")
+        _events.emit("request_shed", reason="wfq_evicted",
+                     engine_id=self.engine_id,
+                     trace_id=victim.trace_id,
+                     tenant_class=victim.tenant_class,
+                     tokens=len(victim))
+        victim.span.set_attr(shed="wfq_evicted").force_keep() \
+              .end(error="shed: wfq_evicted")
+        victim.future.set_exception(QueueFullError(
+            f"shed by weighted-fair admission: queue full and a "
+            f"higher class arrived (class {victim.tenant_class})"))
 
     def infer(self, tokens, token_types=None, deadline_ms=None,
               timeout=None):
         """Synchronous convenience: submit + wait."""
         return self.submit(tokens, token_types, deadline_ms).result(timeout)
 
-    def warmup(self, shapes=None, manifest=None):
+    def warmup(self, shapes=None, manifest=None, model_id=None):
         """Compile ahead of traffic: run one dummy forward per
         (rows, row_len) shape the batcher can emit (or the given
         subset). Serving latency then never pays a trace+compile.
@@ -393,18 +498,54 @@ class ServingEngine:
         if shapes is None:
             shapes = self._batcher.shape_universe()
         for rows, row_len in shapes:
-            self._forward_shape(rows, row_len)
+            self._forward_shape(rows, row_len, model_id=model_id)
         return self
 
     def warmup_manifest(self):
         """This engine's visited-shape warmup manifest (exported at
         ``/warmup`` by :meth:`expose`; the fronting router unions the
-        fleet's and persists it for restarts)."""
+        fleet's and persists it for restarts). Shapes are the plain
+        (rows, row_len) union across hosted models — the manifest
+        format predates the model axis and a replay re-warms every
+        registered model through :meth:`warmup` anyway."""
         with self._shapes_lock:
-            shapes = sorted(self._seen_shapes)
+            shapes = sorted({(r, l) for _m, r, l in self._seen_shapes})
         return compile_cache.new_manifest(
             self.engine_id, self._batcher.bucket_lens,
             self._batcher.max_rows, shapes)
+
+    def swap_model(self, model, model_id=None, version=None,
+                   shapes=None):
+        """Live hot-swap: cut ``model_id`` (None = the default model)
+        over to the new ``model`` entry point with ZERO lost requests.
+
+        The new fn is first warm-replayed over the model's visited
+        shape buckets (or the explicit ``shapes``) on the caller's
+        thread — each replay traces+compiles the new version's
+        executables under the forward lock, exactly like ``warmup`` —
+        and only then does the registry flip atomically. Queued and
+        in-flight requests are untouched: a batch dispatched before
+        the flip finishes on the old fn, the next batch resolves the
+        new one, and post-swap traffic runs warm. The version change
+        is advertised at ``/healthz``, so a fronting router's canary
+        targets change token and the canary re-TOFUs its golden."""
+        mid = self._models.resolve_id(model_id)
+        if shapes is None:
+            with self._shapes_lock:
+                shapes = sorted((r, l) for m, r, l in self._seen_shapes
+                                if m == mid)
+        _events.emit("model_swap_begin", engine_id=self.engine_id,
+                     model=mid, version=version, shapes=len(shapes))
+        t0 = time.monotonic()
+        for rows, row_len in shapes:
+            self._forward_shape(rows, row_len, fn=model)
+        old = self._models.swap(mid, model, version)
+        _events.emit("model_swap", engine_id=self.engine_id, model=mid,
+                     from_version=old,
+                     to_version=self._models.versions().get(mid),
+                     warmed_shapes=len(shapes),
+                     ms=round((time.monotonic() - t0) * 1e3, 3))
+        return self
 
     def reset_stats(self):
         """Swap in a fresh ServingStats (compile cache untouched):
@@ -462,6 +603,10 @@ class ServingEngine:
                          "compiling": compiling is not None,
                          "wire_port": (wire.port if wire is not None
                                        else None),
+                         # hosted models + versions: the router's seat
+                         # model filter AND the canary re-TOFU trigger
+                         # (a version flip changes the target token)
+                         "models": self._models.versions(),
                          "seconds_since_beat":
                              round(time.monotonic() - self._beat, 3)})
 
@@ -513,6 +658,9 @@ class ServingEngine:
             out["manifest_shapes"] = len(self._seen_shapes)
         out["compiling"] = self._compiling_since is not None
         out["costs"] = self.costs.totals()
+        out["models"] = self._models.versions()
+        out["queue_classes"] = self._queue.depths()
+        out["tenants"] = self.tenants.bills()
         return out
 
     @property
@@ -541,6 +689,15 @@ class ServingEngine:
                     "rules": []}
         return self._slo.snapshot()
 
+    def scheduler_state(self):
+        """Flight-bundle scheduler section: the WFQ per-class queue
+        split + hosted model versions — what was queued for whom when
+        the process needed explaining."""
+        return {"engine_id": self.engine_id,
+                "queue_classes": self._queue.depths(),
+                "queue_depth": len(self._queue),
+                "models": self._models.versions()}
+
     def cost_table(self):
         """The ``/costs`` body: this engine's per-bucket cost ledger
         (device/compile seconds, requests, valid tokens, derived
@@ -565,8 +722,11 @@ class ServingEngine:
                               payload.get("token_types"),
                               deadline_ms=payload.get("deadline_ms"),
                               trace_id=payload.get("trace_id"),
-                              parent_span_id=payload.get("span_id"))
-        except (ServingError, ValueError, KeyError, TypeError) as e:
+                              parent_span_id=payload.get("span_id"),
+                              model_id=payload.get("model_id"),
+                              tenant=payload.get("tenant"),
+                              tenant_class=payload.get("tenant_class"))
+        except (ServingError, ValueError, LookupError, TypeError) as e:
             name = type(e).__name__
             return (_SUBMIT_ERROR_STATUS.get(name, 400),
                     {"ok": False, "error_type": name, "error": str(e),
@@ -649,6 +809,10 @@ class ServingEngine:
             for r in reqs:
                 if r.expired(now):
                     self.stats.bump("expired")
+                    self.tenants.observe_event(
+                        r.tenant, r.tenant_class,
+                        r.model_id or self._models.default_id(),
+                        "expired")
                     _events.emit("request_expired", trace_id=r.trace_id,
                                  waited_ms=round((now - r.t_submit) * 1e3,
                                                  3))
@@ -661,30 +825,46 @@ class ServingEngine:
                     live.append(r)
             if not live:
                 continue
-            try:
-                t0 = time.perf_counter()
-                with _trace_context(_join_trace_ids(live)):
-                    with profiler.Scope("serving/pack"):
-                        plan, carry = self._batcher.plan(live)
-                pack_t1 = time.perf_counter()
-                self.stats.pack_ms.observe((pack_t1 - t0) * 1e3)
-            except Exception as e:  # packing failure: fail this drain
-                self._fail(live, e, "failed")
-                carry = []
-                continue
-            try:
-                self._dispatch(plan, pack_interval=(t0, pack_t1))
-            except Exception as e:  # model failure: fail ONLY the
-                # dispatched batch's unfulfilled requests and keep
-                # serving — carry was never in this batch and gets its
-                # try next iteration (one poison batch must not take
-                # the engine or innocent leftovers down)
-                self._fail([r for r, _ in plan.entries
-                            if not r.future.done()], e, "failed")
+            # one packed batch per MODEL, in first-arrival order: a
+            # compiled executable exists per (model, shape), so a
+            # batch never mixes models — the WFQ drain order above is
+            # preserved within each group
+            groups, index = [], {}
+            for r in live:
+                mid = r.model_id or self._models.default_id()
+                if mid not in index:
+                    index[mid] = len(groups)
+                    groups.append((mid, []))
+                groups[index[mid]][1].append(r)
+            for mid, members in groups:
+                try:
+                    t0 = time.perf_counter()
+                    with _trace_context(_join_trace_ids(members)):
+                        with profiler.Scope("serving/pack"):
+                            plan, leftover = self._batcher.plan(members)
+                    carry.extend(leftover)
+                    pack_t1 = time.perf_counter()
+                    self.stats.pack_ms.observe((pack_t1 - t0) * 1e3)
+                except Exception as e:  # packing failure: fail the group
+                    self._fail(members, e, "failed")
+                    continue
+                try:
+                    self._dispatch(plan, model_id=mid,
+                                   pack_interval=(t0, pack_t1))
+                except Exception as e:  # model failure: fail ONLY the
+                    # dispatched batch's unfulfilled requests and keep
+                    # serving — carry was never in this batch and gets
+                    # its try next iteration (one poison batch must not
+                    # take the engine or innocent leftovers down)
+                    self._fail([r for r, _ in plan.entries
+                                if not r.future.done()], e, "failed")
 
     def _fail(self, requests, exc, counter):
         for r in requests:
             self.stats.bump(counter)
+            self.tenants.observe_event(
+                r.tenant, r.tenant_class,
+                r.model_id or self._models.default_id(), counter)
             r.span.end(error=repr(exc))
             r.future.set_exception(exc)
 
@@ -702,7 +882,7 @@ class ServingEngine:
             self._cc_counts[result] += 1
         self._compile_cache[result].inc()
 
-    def _compile_forward(self, plan):
+    def _compile_forward(self, plan, fn=None):
         """First-visit forward: open the compile window (watchdog
         grace) and classify the outcome against the jax cache events
         — a disk-served compile (persistent_hit: trace + cache fetch)
@@ -715,7 +895,7 @@ class ServingEngine:
         self._compiling_since = time.monotonic()
         t0 = time.perf_counter()
         try:
-            seq = self._forward(plan)
+            seq = self._forward(plan, fn)
         finally:
             # refresh the heartbeat IN the same step that closes the
             # window: a probe (or the router's wedge check) must never
@@ -729,21 +909,23 @@ class ServingEngine:
         self._bump_cc(result)
         return seq, result, t0, t1
 
-    def _dispatch(self, plan, pack_interval=None):
-        shape = (plan.rows, plan.row_len)
+    def _dispatch(self, plan, model_id=None, pack_interval=None):
+        mid, fn = self._models.resolve(model_id)
+        shape = (mid, plan.rows, plan.row_len)
         with self._shapes_lock:
             hit = shape in self._seen_shapes
         if hit:
             self._bump_cc("memory_hit")
             t0 = time.perf_counter()
-            seq = self._forward(plan)
+            seq = self._forward(plan, fn)
             t1 = time.perf_counter()
             dt_ms = (t1 - t0) * 1e3
             self.stats.compute_ms.observe(dt_ms)
         else:
             _events.emit("compile_begin", engine_id=self.engine_id,
-                         rows=plan.rows, row_len=plan.row_len)
-            seq, result, t0, t1 = self._compile_forward(plan)
+                         model=mid, rows=plan.rows,
+                         row_len=plan.row_len)
+            seq, result, t0, t1 = self._compile_forward(plan, fn)
             dt_ms = (t1 - t0) * 1e3
             # first visit pays trace+compile; report it as compile
             # latency, not as a (wildly misleading) compute sample
@@ -752,7 +934,8 @@ class ServingEngine:
             self.stats.bump("compiles")
             self.stats.compile_ms.observe(dt_ms)
             _events.emit("compile_end", engine_id=self.engine_id,
-                         rows=plan.rows, row_len=plan.row_len,
+                         model=mid, rows=plan.rows,
+                         row_len=plan.row_len,
                          result=result, ms=round(dt_ms, 3))
         dt_s = t1 - t0
         self.costs.observe_batch(plan.row_len, dt_s, len(plan.entries),
@@ -763,7 +946,7 @@ class ServingEngine:
         # one line per batch (not per request): every served request's
         # trace id is findable in the event log without per-request spam
         _events.emit("batch_dispatch", engine_id=self.engine_id,
-                     rows=plan.rows,
+                     model=mid, rows=plan.rows,
                      row_len=plan.row_len, requests=len(plan.entries),
                      valid_tokens=plan.valid_tokens, ms=round(dt_ms, 3),
                      trace_ids=[r.trace_id for r, _ in plan.entries])
@@ -788,10 +971,15 @@ class ServingEngine:
                      if plan.valid_tokens else 0.0)
             req.future.cost = {"engine_id": self.engine_id,
                                "bucket": plan.row_len,
+                               "model": mid,
+                               "tenant": req.tenant,
+                               "tenant_class": req.tenant_class,
                                "device_s": dt_s * share,
                                "compiled": not hit,
                                "tokens": pl.length,
                                "batch_requests": len(plan.entries)}
+            self.tenants.observe_cost(req.tenant, req.tenant_class,
+                                      mid, dt_s * share, pl.length)
             record_spans = req.span.span_id is not None
             if record_spans:
                 self._queue_span(req)
@@ -825,6 +1013,10 @@ class ServingEngine:
                 total_ms, exemplar=slow_exemplar(
                     req.trace_id, total_ms, self._exemplars))
             self.stats.bump("completed")
+            self.tenants.observe_event(req.tenant, req.tenant_class,
+                                       mid, "completed")
+            self.tenants.observe_latency(req.tenant, req.tenant_class,
+                                         mid, total_ms)
             if record_spans:
                 _spans.record_span("serving/complete", req.trace_id,
                                    parent_id=req.span.span_id,
@@ -833,12 +1025,13 @@ class ServingEngine:
             req.span.end()
             req.future.set_result(out)
 
-    def _forward(self, plan):
+    def _forward(self, plan, fn=None):
         ids = nd.array(plan.data, dtype="int32", ctx=self._ctx)
         tt = nd.array(plan.token_types, dtype="int32", ctx=self._ctx)
         vl = nd.array(plan.valid_length, dtype="int32", ctx=self._ctx)
         seg = nd.array(plan.segment_ids, dtype="int32", ctx=self._ctx)
         pos = nd.array(plan.positions, dtype="int32", ctx=self._ctx)
+        model = fn if fn is not None else self._model
         # the batch adopts its requests' trace ids so the forward span
         # in the Chrome trace / xprof names every request it served
         with self._forward_lock:
@@ -846,16 +1039,22 @@ class ServingEngine:
                     _join_trace_ids(r for r, _ in plan.entries)):
                 with autograd.predict_mode():
                     with profiler.Scope("serving/forward"):
-                        out = self._model(ids, tt, vl, seg, pos)
+                        out = model(ids, tt, vl, seg, pos)
         if isinstance(out, (list, tuple)):
             out = out[0]
         return out.asnumpy()   # host sync: per-request slicing follows
 
-    def _forward_shape(self, rows, row_len):
+    def _forward_shape(self, rows, row_len, model_id=None, fn=None):
         """One dummy forward at (rows, row_len) — warmup helper.
         Counts in the compile-cache split like a live dispatch (a
         manifest replay against a primed persistent cache records
-        ``persistent_hit``s — the warm-restart acceptance signal)."""
+        ``persistent_hit``s — the warm-restart acceptance signal).
+
+        With an explicit ``fn`` (the hot-swap warm-replay: a NEW model
+        version not yet in the registry) the forward always takes the
+        compile path and the shape is NOT marked seen — it already is
+        under its model id, and the incoming version must not poison
+        the seen-set if its replay fails mid-swap."""
         from .batcher import PackedPlan
 
         data = np.zeros((rows, row_len), np.int32)
@@ -864,20 +1063,26 @@ class ServingEngine:
         plan = PackedPlan(data, np.zeros_like(data), seg,
                           np.zeros_like(data), np.ones(rows, np.int32),
                           entries=[], pad_rows=rows)
+        if fn is not None:
+            _seq, _result, t0, t1 = self._compile_forward(plan, fn)
+            self.costs.observe_warmup(row_len, t1 - t0, compiled=True)
+            return
+        mid, _fn = self._models.resolve(model_id)
+        shape = (mid, rows, row_len)
         with self._shapes_lock:
-            seen = (rows, row_len) in self._seen_shapes
+            seen = shape in self._seen_shapes
         if seen:
             t0 = time.perf_counter()
-            self._forward(plan)
+            self._forward(plan, _fn)
             self.costs.observe_warmup(row_len, time.perf_counter() - t0,
                                       compiled=False)
             self._bump_cc("memory_hit")
         else:
-            _seq, _result, t0, t1 = self._compile_forward(plan)
+            _seq, _result, t0, t1 = self._compile_forward(plan, _fn)
             self.costs.observe_warmup(row_len, t1 - t0, compiled=True)
             # mark seen only AFTER the forward succeeded: a failed
             # warmup replay must leave the shape cold so the first
             # live dispatch still gets the compile path (grace window
             # + compile_ms accounting), not a phantom memory_hit
             with self._shapes_lock:
-                self._seen_shapes.add((rows, row_len))
+                self._seen_shapes.add(shape)

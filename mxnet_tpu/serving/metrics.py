@@ -523,7 +523,8 @@ class ServingStats:
     """
 
     COUNTERS = ("submitted", "completed", "failed", "rejected_queue_full",
-                "rejected_too_long", "rejected_stopped", "expired",
+                "rejected_too_long", "rejected_stopped",
+                "rejected_unknown_model", "expired",
                 "cancelled", "batches", "compiles")
 
     def __init__(self, window=4096, registry=None, engine_id="default"):

@@ -8,6 +8,21 @@ rejected with :class:`DeadlineExceededError`, a DISTINCT error, not a
 silent drop), and close() fails fast instead of accepting work that
 will never run. The reference lineage is MXNet Model Server's bounded
 job queue in front of its backend workers.
+
+Since the tenancy subsystem (``serving/tenancy.py``) the queue is
+CLASS-AWARE: every request lands in its admission class's deque
+(``priority``/``standard``/``best-effort``) and ``poll`` dequeues in
+weighted-fair order — each class ``c`` owns a virtual finish time
+``vft[c]``; the pop takes the backlogged class with the smallest
+``vft`` (ties break toward higher priority) and advances it by
+``1/weight[c]``, so sustained contention shares dequeues
+weight-proportionally while any lone class runs at full rate. A class
+waking from idle catches its ``vft`` up to the queue's virtual time
+so it cannot claim a retroactive backlog. Under overload, ``put``
+prefers EVICTING the newest request of a lower class over refusing
+the arrival (best-effort sheds first, priority last); the evicted
+request is returned to the caller, who fails its future loudly. A
+single-class workload reduces to the exact pre-tenancy bounded FIFO.
 """
 from __future__ import annotations
 
@@ -22,11 +37,14 @@ from ..base import MXNetError
 from ..telemetry import events as _events
 from ..telemetry import spans as _spans
 from ..telemetry.trace import new_trace_id
+from . import tenancy
+from .tenancy import UnknownModelError
 
 __all__ = ["ServingError", "QueueFullError", "DeadlineExceededError",
            "RequestTooLongError", "EngineStoppedError",
-           "InvalidSamplingError", "InferenceFuture", "Request",
-           "RequestQueue", "validate_tokens", "validate_sampling"]
+           "InvalidSamplingError", "UnknownModelError", "InferenceFuture",
+           "Request", "RequestQueue", "validate_tokens",
+           "validate_sampling"]
 
 
 class ServingError(MXNetError):
@@ -343,14 +361,24 @@ class Request:
 
     __slots__ = ("id", "trace_id", "span", "tokens", "token_types",
                  "deadline", "future", "t_submit", "t_drain",
-                 "t_dispatch", "t_done")
+                 "t_dispatch", "t_done", "tenant", "tenant_class",
+                 "model_id")
 
     def __init__(self, tokens, token_types=None, deadline_ms=None,
-                 trace_id=None, parent_span_id=None):
+                 trace_id=None, parent_span_id=None, tenant=None,
+                 tenant_class=None, model_id=None):
         self.id = next(_req_ids)
         self.trace_id = trace_id or new_trace_id("req")
         self.tokens, self.token_types = validate_tokens(tokens,
                                                         token_types)
+        self.tenant = str(tenant) if tenant is not None else None
+        self.tenant_class = tenancy.normalize_class(tenant_class)
+        self.model_id = str(model_id) if model_id is not None else None
+        if deadline_ms is None:
+            # per-class deadline budget: under overload, expiry then
+            # consumes the short-budget (best-effort) classes first
+            deadline_ms = tenancy.class_deadline_ms().get(
+                self.tenant_class)
         self.t_submit = time.monotonic()
         self.span = _spans.start_span(
             "serving/request", trace_id=self.trace_id,
@@ -374,29 +402,53 @@ class Request:
 
 
 class RequestQueue:
-    """Thread-safe bounded FIFO the continuous batcher drains.
+    """Thread-safe bounded admission queue the continuous batcher
+    drains in weighted-fair class order.
 
-    ``put`` never blocks and never grows past ``max_depth`` — the
-    caller eats :class:`QueueFullError` (that IS the flow control).
+    ``put`` never blocks and never grows past ``max_depth``; under
+    overload it sheds DOWNWARD — a higher-class arrival evicts the
+    newest request of the lowest backlogged class below it (returned
+    to the caller to fail loudly), and only an arrival with nobody
+    beneath it eats :class:`QueueFullError` (that IS the flow
+    control). Per-class depth budgets (fractions of ``max_depth``)
+    bound each class before the global bound is even reached.
     ``poll`` is the iteration-level drain: wait up to ``timeout`` for
     the queue to become non-empty, then take everything available (up
     to ``max_items``) WITHOUT waiting for stragglers — the Orca-style
     continuous-batching discipline (batch what is there, never hold a
-    batch open for latecomers).
+    batch open for latecomers) — in WFQ order, so the batcher's
+    first-fit packing draws weight-proportionally from the classes.
+
+    The WFQ state machine is deliberately deterministic (no wall
+    clock): ``vft[c]`` floats advanced by exact ``1/weight`` steps,
+    ties broken by class priority — tests/test_tenancy.py pins exact
+    dequeue orders as goldens.
     """
 
-    def __init__(self, max_depth=256):
+    def __init__(self, max_depth=256, class_weights=None,
+                 depth_shares=None):
         if max_depth < 1:
             raise ValueError("max_depth must be >= 1")
         self._max_depth = max_depth
-        self._dq = deque()
+        weights = dict(class_weights if class_weights is not None
+                       else tenancy.class_weights())
+        shares = dict(depth_shares if depth_shares is not None
+                      else tenancy.class_depth_shares())
+        self._weights = {c: float(weights.get(c, 1.0))
+                         for c in tenancy.TENANT_CLASSES}
+        self._budget = {
+            c: max(1, int(round(max_depth * float(shares.get(c, 1.0)))))
+            for c in tenancy.TENANT_CLASSES}
+        self._dqs = {c: deque() for c in tenancy.TENANT_CLASSES}
+        self._vft = {c: 0.0 for c in tenancy.TENANT_CLASSES}
+        self._vtime = 0.0
         self._lock = threading.Lock()
         self._not_empty = threading.Condition(self._lock)
         self._closed = False
 
     def __len__(self):
         with self._lock:
-            return len(self._dq)
+            return sum(len(dq) for dq in self._dqs.values())
 
     @property
     def max_depth(self):
@@ -407,42 +459,103 @@ class RequestQueue:
         with self._lock:
             return self._closed
 
+    def depths(self):
+        """Per-class queue depth ``{class: n}`` — the WFQ split the
+        ``/stats`` body, flight-bundle scheduler sections and the
+        ``mxnet_tpu_serving_wfq_queue_depth`` gauge expose."""
+        with self._lock:
+            return {c: len(dq) for c, dq in self._dqs.items()}
+
+    def _class_of(self, request):
+        cls = getattr(request, "tenant_class", None)
+        return cls if cls in self._dqs else "standard"
+
+    def _evict_locked(self, above):
+        """Pop the NEWEST request of the lowest-priority backlogged
+        class strictly below ``above`` (None when nothing beneath it
+        can be shed)."""
+        idx = tenancy.TENANT_CLASSES.index(above)
+        for cls in reversed(tenancy.TENANT_CLASSES[idx + 1:]):
+            if self._dqs[cls]:
+                return self._dqs[cls].pop()
+        return None
+
     def put(self, request):
+        """Admit ``request``; returns the lower-class victim it
+        EVICTED under overload (None normally) — the caller fails the
+        victim's future and counts the shed."""
         with self._lock:
             if self._closed:
                 raise EngineStoppedError(
                     "serving engine is stopped; request refused")
-            if len(self._dq) >= self._max_depth:
+            cls = self._class_of(request)
+            dq = self._dqs[cls]
+            if len(dq) >= self._budget[cls]:
                 raise QueueFullError(
-                    f"request queue full (depth {self._max_depth}); "
+                    f"request queue full for class {cls} (budget "
+                    f"{self._budget[cls]} of depth {self._max_depth}); "
                     "backpressure — retry later")
-            self._dq.append(request)
+            victim = None
+            if sum(len(d) for d in self._dqs.values()) \
+                    >= self._max_depth:
+                victim = self._evict_locked(cls)
+                if victim is None:
+                    raise QueueFullError(
+                        f"request queue full (depth {self._max_depth}); "
+                        "backpressure — retry later")
+            if not dq:
+                # waking from idle: catch up to the queue's virtual
+                # time — an idle class must not bank credit
+                self._vft[cls] = max(self._vft[cls], self._vtime)
+            dq.append(request)
             self._not_empty.notify()
+            return victim
+
+    def _pop_locked(self):
+        backlogged = [c for c in tenancy.TENANT_CLASSES
+                      if self._dqs[c]]
+        if not backlogged:
+            return None
+        # min virtual finish; ties go to the higher-priority class
+        # (TENANT_CLASSES order) — deterministic for the goldens
+        cls = min(backlogged,
+                  key=lambda c: (self._vft[c],
+                                 tenancy.TENANT_CLASSES.index(c)))
+        self._vtime = self._vft[cls]
+        self._vft[cls] += 1.0 / self._weights[cls]
+        return self._dqs[cls].popleft()
 
     def poll(self, max_items, timeout=0.0):
-        """Drain up to ``max_items`` requests; block up to ``timeout``
-        seconds only while the queue is empty."""
+        """Drain up to ``max_items`` requests in WFQ order; block up
+        to ``timeout`` seconds only while the queue is empty."""
         deadline = time.monotonic() + timeout
         with self._not_empty:
-            while not self._dq and not self._closed:
+            while not any(self._dqs.values()) and not self._closed:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0 or not self._not_empty.wait(remaining):
                     break
             out = []
-            while self._dq and len(out) < max_items:
-                out.append(self._dq.popleft())
+            while len(out) < max_items:
+                r = self._pop_locked()
+                if r is None:
+                    break
+                out.append(r)
             now = time.monotonic()
             for r in out:
                 r.t_drain = now
             return out
 
     def requeue(self, request):
-        """Put an already-admitted request back at the FRONT of the
-        line (the decode engine defers a join when the KV page pool is
-        momentarily exhausted). Bypasses the depth bound — the request
-        was admitted once and must not be shed for coming back."""
+        """Put an already-admitted request back at the FRONT of its
+        class (the decode engine defers a join when the KV page pool
+        is momentarily exhausted). Bypasses the depth bound — the
+        request was admitted once and must not be shed for coming
+        back — and rewinds the class's virtual finish so the carry is
+        immediately eligible again."""
         with self._lock:
-            self._dq.appendleft(request)
+            cls = self._class_of(request)
+            self._dqs[cls].appendleft(request)
+            self._vft[cls] = min(self._vft[cls], self._vtime)
             self._not_empty.notify()
 
     def close(self):
@@ -453,8 +566,11 @@ class RequestQueue:
             self._not_empty.notify_all()
 
     def drain_all(self):
-        """Take every queued request (shutdown path)."""
+        """Take every queued request (shutdown path), priority class
+        first, FIFO within a class."""
         with self._lock:
-            out = list(self._dq)
-            self._dq.clear()
+            out = []
+            for cls in tenancy.TENANT_CLASSES:
+                out.extend(self._dqs[cls])
+                self._dqs[cls].clear()
             return out

@@ -110,13 +110,15 @@ from ..telemetry import recorder as _recorder
 from ..telemetry import spans as _spans
 from ..telemetry.registry import REGISTRY as _REGISTRY
 from ..telemetry.trace import new_trace_id
+from . import tenancy
 from .engine import _SUBMIT_ERROR_STATUS, ServingEngine
 from .metrics import (DispatchOverhead, LatencySummary, exemplar_gate,
                       merge_cost_buckets, slow_exemplar,
                       wire_bytes_counter, wire_fallback_counter)
 from .queue import (DeadlineExceededError, EngineStoppedError,
                     InferenceFuture, QueueFullError, ServingError,
-                    validate_sampling, validate_tokens)
+                    UnknownModelError, validate_sampling,
+                    validate_tokens)
 from .wire import WireClient, WireError
 
 __all__ = ["ServingRouter", "NoEngineAvailableError", "RemoteEngineError"]
@@ -154,6 +156,7 @@ _ERROR_CLASSES = {
     "QueueFullError": QueueFullError,
     "DeadlineExceededError": DeadlineExceededError,
     "EngineStoppedError": EngineStoppedError,
+    "UnknownModelError": UnknownModelError,
 }
 
 
@@ -167,12 +170,22 @@ class RouterRequest:
     __slots__ = ("tokens", "token_types", "deadline", "future",
                  "trace_id", "span", "t_submit", "tried", "engine_id",
                  "requeues", "cid", "adopted", "decode", "stream",
-                 "parts_seen", "relay_lock")
+                 "parts_seen", "relay_lock", "model_id", "tenant",
+                 "tenant_class")
 
     def __init__(self, tokens, token_types=None, deadline_ms=None,
-                 decode=None, stream=False):
+                 decode=None, stream=False, model_id=None, tenant=None,
+                 tenant_class=None):
         self.tokens, self.token_types = validate_tokens(tokens,
                                                         token_types)
+        # tenancy attribution: validated HERE (an unknown class is a
+        # ValueError before any counter/journal), carried verbatim on
+        # every dispatch payload + the HA journal entry so the serving
+        # seat — first pick, failover sibling, peer adoption — bills
+        # and WFQ-classes the request identically
+        self.model_id = str(model_id) if model_id is not None else None
+        self.tenant = str(tenant) if tenant is not None else None
+        self.tenant_class = tenancy.normalize_class(tenant_class)
         self.trace_id = new_trace_id("req")
         self.t_submit = time.monotonic()
         self.deadline = (self.t_submit + deadline_ms / 1e3
@@ -315,6 +328,10 @@ class _Seat:
         self.queue_depth = None
         self.p95_ms = None
         self.qps = 0.0
+        # hosted models (model_id -> version) learned off the health
+        # poll; None = unknown (an old peer that advertises nothing) —
+        # treated as hosting anything so mixed fleets keep routing
+        self.models = None
         self.last_error = None
         self.last_picked = 0        # round-robin tie-break stamp
         self._prev_completed = None
@@ -342,6 +359,7 @@ class _Seat:
                 "dispatched": self.dispatched,
                 "queue_depth": self.queue_depth,
                 "p95_ms": self.p95_ms, "qps": self.qps,
+                "models": self.models,
                 "weight": round(self.weight, 3),
                 "burn": (round(self.burn, 3)
                          if self.burn is not None else None),
@@ -351,6 +369,13 @@ class _Seat:
                 "consecutive_failures": self.consecutive_failures,
                 "last_change": round(self.last_change, 3),
                 "last_error": self.last_error}
+
+    def hosts(self, model_id):
+        """True when this seat can serve ``model_id`` (None names the
+        seat's default model; a seat whose hosted set is unknown — an
+        old peer — routes optimistically and 404s would fail over)."""
+        return (model_id is None or self.models is None
+                or model_id in self.models)
 
     def warmup_manifest(self):
         return None
@@ -395,12 +420,17 @@ class _LocalSeat(_Seat):
             fut, _streamed = submit_payload(dict(
                 req.decode or {}, tokens=req.tokens,
                 deadline_ms=req.remaining_ms(), stream=req.stream,
-                trace_id=req.trace_id, span_id=req.span.span_id))
+                trace_id=req.trace_id, span_id=req.span.span_id,
+                model_id=req.model_id, tenant=req.tenant,
+                tenant_class=req.tenant_class))
         else:
             fut = self._engine.submit(req.tokens, req.token_types,
                                       deadline_ms=req.remaining_ms(),
                                       trace_id=req.trace_id,
-                                      parent_span_id=req.span.span_id)
+                                      parent_span_id=req.span.span_id,
+                                      model_id=req.model_id,
+                                      tenant=req.tenant,
+                                      tenant_class=req.tenant_class)
         if req.stream:
             fut.add_part_callback(
                 lambda _f, part: req.relay_part(part.get("index"),
@@ -534,7 +564,10 @@ class _RemoteSeat(_Seat):
                    "token_types": req.token_types,
                    "deadline_ms": req.remaining_ms(),
                    "trace_id": req.trace_id,
-                   "span_id": req.span.span_id}
+                   "span_id": req.span.span_id,
+                   "model_id": req.model_id,
+                   "tenant": req.tenant,
+                   "tenant_class": req.tenant_class}
         if req.decode:
             payload.update(req.decode)
         if req.stream:
@@ -604,6 +637,9 @@ class _RemoteSeat(_Seat):
                    "deadline_ms": req.remaining_ms(),
                    "trace_id": req.trace_id,
                    "span_id": req.span.span_id,
+                   "model_id": req.model_id,
+                   "tenant": req.tenant,
+                   "tenant_class": req.tenant_class,
                    "timeout_s": timeout_s}
         if req.decode:
             payload.update(req.decode)
@@ -1183,7 +1219,8 @@ class ServingRouter:
     def submit(self, tokens, token_types=None, deadline_ms=None,
                cid=None, max_new_tokens=None, eos_id=None,
                stream=False, temperature=None, top_k=None, top_p=None,
-               seed=None):
+               seed=None, model_id=None, tenant=None,
+               tenant_class=None):
         """Admit one request; returns an :class:`InferenceFuture`
         whose ``trace_id`` names the request fleet-wide. Sheds loudly:
         :class:`QueueFullError` (router queue at bound),
@@ -1213,7 +1250,14 @@ class ServingRouter:
         admission — the seed then rides the dispatch payload and the
         HA journal entry, so a failover re-dispatch (this router's
         retry or the peer's adoption) resamples the identical tokens
-        and the stream dedupe stays byte-exact."""
+        and the stream dedupe stays byte-exact.
+
+        ``model_id`` routes the request to a seat advertising that
+        hosted model (None = each seat's default); ``tenant``/
+        ``tenant_class`` attribute it to an owner and its WFQ
+        admission class on the serving seat. All three ride every
+        dispatch payload and the HA journal, so failover and peer
+        adoption preserve the attribution."""
         if deadline_ms is None:
             deadline_ms = self._default_deadline_ms
         if cid is not None and self._c_ha is not None:
@@ -1244,7 +1288,9 @@ class ServingRouter:
         # validate FIRST (same invariant as the engine: submitted ==
         # sum of outcome counters, malformed requests touch nothing)
         req = RouterRequest(tokens, token_types, deadline_ms,
-                            decode=decode or None, stream=stream)
+                            decode=decode or None, stream=stream,
+                            model_id=model_id, tenant=tenant,
+                            tenant_class=tenant_class)
         self._bump("submitted")
         # journal only requests that LOOK admittable: shedding must
         # stay cheap under overload (no peer round trip per refusal).
@@ -1336,7 +1382,7 @@ class ServingRouter:
                 req = self._queue.popleft()
                 seat = None
                 if not req.expired():
-                    seat = self._pick_locked(req.tried)
+                    seat = self._pick_locked(req.tried, req.model_id)
                     if seat is not None:
                         seat.outstanding += 1
                         seat.dispatched += 1
@@ -1367,16 +1413,19 @@ class ServingRouter:
     def _exit_locked(self):
         return self._closed and (self._abort or self._pending == 0)
 
-    def _pick_locked(self, exclude):
+    def _pick_locked(self, exclude, model_id=None):
         # WEIGHTED least outstanding: score = (outstanding + 1) /
         # weight, ties break round-robin (least recently picked). With
         # every weight at 1.0 (weights off, or a healthy fleet) the
         # order is exactly the classic least-outstanding; a seat shed
         # to weight w gets ~w of a full share under load and only
-        # overflow traffic when idle.
+        # overflow traffic when idle. A request naming a model only
+        # considers seats advertising it (unknown hosted sets route
+        # optimistically — a 404 there is typed and propagates).
         best = best_score = None
         for seat in self._seats.values():
-            if not seat.routable or seat.token in exclude:
+            if not seat.routable or seat.token in exclude \
+                    or not seat.hosts(model_id):
                 continue
             score = ((seat.outstanding + 1.0)
                      / max(seat.weight, self._w_floor))
@@ -1554,6 +1603,12 @@ class ServingRouter:
             if ok:
                 seat.consecutive_failures = 0
                 seat.queue_depth = snap.get("queue_depth")
+                models = snap.get("models")
+                if isinstance(models, dict):
+                    # the hosted-model advertisement: feeds the
+                    # model-aware pick and the canary's version
+                    # fingerprint (a hot-swap re-TOFUs the golden)
+                    seat.models = dict(models)
                 lat = (snap.get("latency") or {}).get("total") or {}
                 seat.p95_ms = lat.get("p95_ms")
                 completed = (snap.get("counters") or {}).get("completed")
@@ -1800,6 +1855,9 @@ class ServingRouter:
                      "deadline_ms": payload.get("deadline_ms"),
                      "decode": payload.get("decode"),
                      "stream": bool(payload.get("stream")),
+                     "model_id": payload.get("model_id"),
+                     "tenant": payload.get("tenant"),
+                     "tenant_class": payload.get("tenant_class"),
                      "router_id": payload.get("router_id"),
                      "t": time.monotonic()}
             dropped = 0
@@ -1873,6 +1931,9 @@ class ServingRouter:
                            "deadline_ms": req.remaining_ms(),
                            "decode": req.decode,
                            "stream": req.stream,
+                           "model_id": req.model_id,
+                           "tenant": req.tenant,
+                           "tenant_class": req.tenant_class,
                            "router_id": self.router_id},
                           _on_ack, self._ha_ack_s)
         except WireError:
@@ -2015,7 +2076,10 @@ class ServingRouter:
                 req = RouterRequest(e["tokens"], e.get("token_types"),
                                     deadline_ms,
                                     decode=e.get("decode"),
-                                    stream=bool(e.get("stream")))
+                                    stream=bool(e.get("stream")),
+                                    model_id=e.get("model_id"),
+                                    tenant=e.get("tenant"),
+                                    tenant_class=e.get("tenant_class"))
             except Exception as exc:
                 fut.set_exception(ServingError(
                     f"adopted journal entry {cid} unusable: {exc!r}"))
@@ -2351,9 +2415,17 @@ class ServingRouter:
         for seat in seats:
             # the generation token lets the prober re-pin its TOFU
             # golden when a REPLACEMENT seat reuses an id (new model,
-            # new golden — not a forever checksum_mismatch page)
+            # new golden — not a forever checksum_mismatch page). The
+            # hosted model VERSIONS ride the token too: a live
+            # hot-swap (same seat, new weights) legitimately changes
+            # the canary's answer, so the golden re-pins instead of
+            # paging checksum_mismatch forever
+            token = seat.token
+            if seat.models:
+                token += "@" + ",".join(
+                    f"{m}={v}" for m, v in sorted(seat.models.items()))
             t = {"engine_id": seat.engine_id, "kind": seat.kind,
-                 "token": seat.token}
+                 "token": token}
             if isinstance(seat, _RemoteSeat):
                 t["url"] = seat.base_url
                 # advertised (port, REAL engine id) from the health
@@ -2393,8 +2465,11 @@ class ServingRouter:
                               temperature=payload.get("temperature"),
                               top_k=payload.get("top_k"),
                               top_p=payload.get("top_p"),
-                              seed=payload.get("seed"))
-        except (ServingError, ValueError, KeyError, TypeError) as e:
+                              seed=payload.get("seed"),
+                              model_id=payload.get("model_id"),
+                              tenant=payload.get("tenant"),
+                              tenant_class=payload.get("tenant_class"))
+        except (ServingError, ValueError, LookupError, TypeError) as e:
             name = type(e).__name__
             status = {"NoEngineAvailableError": 503}.get(
                 name, _SUBMIT_ERROR_STATUS.get(name, 400))

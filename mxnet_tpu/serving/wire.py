@@ -35,6 +35,8 @@ Two things live here:
        ("HELLO",  {client/engine identity, "version": 1})
        ("SUBMIT", corr_id, {"tokens": int32 ndarray, "token_types",
                             "deadline_ms", "trace_id", "span_id",
+                            tenancy: "model_id", "tenant",
+                            "tenant_class",
                             decode: "max_new_tokens", "eos_id",
                             "stream", "temperature", "top_k", "top_p",
                             "seed"})
@@ -614,7 +616,10 @@ class WireListener:
                     payload.get("tokens"), payload.get("token_types"),
                     deadline_ms=payload.get("deadline_ms"),
                     trace_id=payload.get("trace_id"),
-                    parent_span_id=payload.get("span_id"))
+                    parent_span_id=payload.get("span_id"),
+                    model_id=payload.get("model_id"),
+                    tenant=payload.get("tenant"),
+                    tenant_class=payload.get("tenant_class"))
                 streamed = False
         except Exception as e:
             # admission failure (queue full, too long, stopped,

@@ -59,8 +59,8 @@ from .slo import LatencySLO, ThresholdSLO, _match_labels
 
 __all__ = ["AlertRule", "BurnRateRule", "ThresholdRule", "AbsenceRule",
            "AlertDaemon", "default_serving_objectives",
-           "default_router_objectives", "default_burn_rules",
-           "PAGE", "TICKET"]
+           "default_tenant_objectives", "default_router_objectives",
+           "default_burn_rules", "PAGE", "TICKET"]
 
 PAGE = "page"
 TICKET = "ticket"
@@ -618,6 +618,45 @@ def default_serving_objectives(evaluator, engine_id):
             "serving_cost", budget, match={"engine_id": engine_id},
             description="device seconds per 1k valid tokens"))
         names.append("serving_cost")
+    return names
+
+
+#: Per-class latency-bound multipliers over MXNET_TPU_SLO_LATENCY_MS:
+#: priority buys a tighter bound than the engine-wide objective,
+#: best-effort a much looser one (it exists to be shed first, not to
+#: page first).
+_TENANT_SLO_FACTORS = {"priority": 0.5, "standard": 1.0,
+                       "best-effort": 4.0}
+
+
+def default_tenant_objectives(evaluator, engine_id, classes=None):
+    """Per-admission-class latency objectives over the tenant slice
+    family: one ``LatencySLO`` per class on
+    ``mxnet_tpu_serving_tenant_latency_ms`` with
+    ``match={engine_id, tenant_class}`` (label SUBSET matching — the
+    tenant/model labels stay free axes). Thresholds default to the
+    engine latency bound scaled by class (0.5x / 1x / 4x for
+    priority / standard / best-effort), overridable per class with
+    ``MXNET_TPU_TENANT_SLO_MS``. Returns the added SLO names."""
+    from ..serving import tenancy
+
+    base = float(envvars.get("MXNET_TPU_SLO_LATENCY_MS"))
+    overrides = tenancy.class_slo_ms()
+    names = []
+    for cls in (classes if classes is not None
+                else tenancy.TENANT_CLASSES):
+        cls = tenancy.normalize_class(cls)
+        threshold = overrides.get(
+            cls, base * _TENANT_SLO_FACTORS.get(cls, 1.0))
+        name = f"tenant_{cls.replace('-', '_')}_latency"
+        evaluator.add(LatencySLO(
+            name, threshold_ms=threshold,
+            target=envvars.get("MXNET_TPU_SLO_LATENCY_TARGET"),
+            family="mxnet_tpu_serving_tenant_latency_ms",
+            match={"engine_id": engine_id, "tenant_class": cls},
+            description=f"{cls}-class requests completing under "
+                        f"{threshold:g} ms"))
+        names.append(name)
     return names
 
 

@@ -1,0 +1,55 @@
+"""Subprocess helper for the tenancy cross-process goldens: one
+ServingEngine hosting TWO models behind a :class:`ModelRegistry`
+(``m-a``: identity, ``m-b``: identity + 100) with the binary dispatch
+wire and the HTTP telemetry endpoint set up.
+
+Prints ``PORT <http> WIRE <wire>`` on stdout once serving, then reads
+stdin line commands until EOF (the parent test owns the lifetime):
+
+- ``SWAP`` — live hot-swap ``m-b`` to v2 (identity + 200) and print
+  ``SWAPPED`` — the parent verifies the /healthz version flip (the
+  router-canary re-TOFU surface) and that post-swap wire traffic runs
+  the new fn.
+
+Usage: python tenancy_engine_worker.py <engine_id>
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("MXNET_TPU_WATCHDOG", "0")
+
+import numpy as np  # noqa: E402
+
+from mxnet_tpu import nd  # noqa: E402
+from mxnet_tpu.serving import ModelRegistry, ServingEngine  # noqa: E402
+
+
+def _offset_model(off):
+    def model(ids, token_types, valid_length, segment_ids, positions):
+        return nd.array(
+            ids.asnumpy().astype(np.float32)[..., None] + off)
+    return model
+
+
+def main():
+    engine_id = sys.argv[1] if len(sys.argv) > 1 else "tenancy-worker"
+    reg = ModelRegistry()
+    reg.register("m-a", _offset_model(0.0), version="v1")
+    reg.register("m-b", _offset_model(100.0), version="v1")
+    eng = ServingEngine(reg, bucket_lens=(32,), max_rows=2,
+                        engine_id=engine_id)
+    with eng:
+        srv = eng.expose(port=0)
+        print(f"PORT {srv.port} WIRE {eng._wire.port}", flush=True)
+        for line in sys.stdin:
+            if line.strip() == "SWAP":
+                eng.swap_model(_offset_model(200.0), model_id="m-b",
+                               version="v2")
+                print("SWAPPED", flush=True)
+
+
+if __name__ == "__main__":
+    main()

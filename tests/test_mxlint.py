@@ -107,10 +107,14 @@ def test_fixture_telemetry_consistency():
     assert _rules(project) == [
         "metric-engine-label",
         "metric-labels",
+        "metric-tenant-label",
         "span-leak",
     ]
     leak = [f for f in project.findings if f.rule == "span-leak"]
     assert _line_mentions_rule(source, leak[0])
+    tenant = [f for f in project.findings
+              if f.rule == "metric-tenant-label"]
+    assert "model" in tenant[0].message
 
 
 def test_fixture_env_registry():

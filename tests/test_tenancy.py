@@ -1,0 +1,567 @@
+"""Multi-tenant, multi-model serving (mxnet_tpu/serving/tenancy.py):
+WFQ dequeue goldens, overload shed order, the model registry and live
+hot-swap, per-tenant observability slices, and the model-id/tenant
+round trip across every dispatch surface (engine submit, router,
+binary wire to another process, router HA journal).
+
+The WFQ state machine is deliberately deterministic (virtual finish
+times advanced by exact 1/weight steps, no wall clock), so the
+fairness tests pin EXACT dequeue orders as goldens, not statistical
+shares.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx  # noqa: F401  (backend/env init)
+from mxnet_tpu import nd
+from mxnet_tpu.serving import (ModelRegistry, QueueFullError, Request,
+                               RequestQueue, ServingEngine,
+                               ServingRouter, TENANT_CLASSES,
+                               TenantStats, UnknownModelError)
+from mxnet_tpu.serving import tenancy
+from mxnet_tpu.telemetry.registry import MetricsRegistry
+
+
+class OffsetModel:
+    """out[b, s, 0] == ids[b, s] + off: which MODEL served a request
+    is readable off the response values."""
+
+    def __init__(self, off=0.0, delay=0.0):
+        self.off = float(off)
+        self.delay = delay
+        self.started = threading.Event()
+        self.seen = []
+
+    def __call__(self, ids, token_types, valid_length, segment_ids,
+                 positions):
+        self.started.set()
+        if self.delay:
+            time.sleep(self.delay)
+        raw = ids.asnumpy()
+        self.seen.append(raw.copy())
+        return nd.array(raw.astype(np.float32)[..., None] + self.off)
+
+
+def _req(cls, toks=(1,)):
+    return Request(list(toks), tenant_class=cls)
+
+
+def _drain_classes(q, n=64):
+    return [r.tenant_class for r in q.poll(max_items=n, timeout=0.0)]
+
+
+# ---------------------------------------------------------------------------
+# class vocabulary + knob parsing
+# ---------------------------------------------------------------------------
+
+def test_normalize_class_and_parse_class_map():
+    assert tenancy.normalize_class(None) == "standard"
+    assert tenancy.normalize_class(" Priority ") == "priority"
+    assert tenancy.normalize_class("best_effort") == "best-effort"
+    with pytest.raises(ValueError):
+        # a typo must NEVER silently demote to best-effort
+        tenancy.normalize_class("premium")
+    spec = "priority:4, standard:2 ,best-effort:1"
+    assert tenancy.parse_class_map(spec) == {
+        "priority": 4.0, "standard": 2.0, "best-effort": 1.0}
+    assert tenancy.parse_class_map(None) == {}
+    with pytest.raises(ValueError):
+        tenancy.parse_class_map("priority")        # no value
+    with pytest.raises(ValueError):
+        tenancy.parse_class_map("platinum:9")      # unknown class
+
+
+def test_class_knobs_env_overrides(monkeypatch):
+    assert tenancy.class_weights() == tenancy.DEFAULT_CLASS_WEIGHTS
+    monkeypatch.setenv("MXNET_TPU_TENANT_WEIGHTS", "best-effort:0.5")
+    w = tenancy.class_weights()
+    assert w["best-effort"] == 0.5 and w["priority"] == 4.0
+    monkeypatch.setenv("MXNET_TPU_TENANT_WEIGHTS", "standard:0")
+    with pytest.raises(ValueError):
+        tenancy.class_weights()                    # weights stay > 0
+    monkeypatch.setenv("MXNET_TPU_TENANT_DEPTH_SHARES",
+                       "best-effort:1.5")
+    with pytest.raises(ValueError):
+        tenancy.class_depth_shares()               # shares in (0, 1]
+    monkeypatch.setenv("MXNET_TPU_TENANT_DEADLINE_MS",
+                       "best-effort:250")
+    assert tenancy.class_deadline_ms() == {"best-effort": 250.0}
+    # the class default lands on requests that bring no deadline
+    r = Request([1, 2], tenant_class="best-effort")
+    assert r.deadline is not None
+    assert Request([1, 2], tenant_class="priority").deadline is None
+
+
+# ---------------------------------------------------------------------------
+# WFQ dequeue goldens
+# ---------------------------------------------------------------------------
+
+def test_wfq_golden_order_at_default_weights():
+    """4 requests per class at weights 4/2/1 drain in EXACTLY
+    p,s,b,p,p,s,p,s,b,s,b,b — weight-proportional interleave, ties to
+    the higher class, FIFO within a class."""
+    q = RequestQueue(max_depth=32)
+    by_cls = {c: [] for c in TENANT_CLASSES}
+    for i in range(4):
+        for cls in ("best-effort", "standard", "priority"):
+            r = _req(cls, [i + 1])
+            by_cls[cls].append(r.id)
+            q.put(r)
+    got = q.poll(max_items=32, timeout=0.0)
+    assert [r.tenant_class for r in got] == [
+        "priority", "standard", "best-effort", "priority", "priority",
+        "standard", "priority", "standard", "best-effort", "standard",
+        "best-effort", "best-effort"]
+    for cls in TENANT_CLASSES:              # FIFO within each class
+        assert [r.id for r in got
+                if r.tenant_class == cls] == by_cls[cls]
+
+
+def test_wfq_equal_weights_round_robin_and_single_class_fifo():
+    q = RequestQueue(max_depth=16, class_weights={
+        "priority": 1.0, "standard": 1.0, "best-effort": 1.0})
+    for _ in range(2):
+        for cls in TENANT_CLASSES:
+            q.put(_req(cls))
+    assert _drain_classes(q) == ["priority", "standard", "best-effort",
+                                 "priority", "standard", "best-effort"]
+    # a lone class reduces to the exact pre-tenancy bounded FIFO
+    rs = [_req("best-effort", [i + 1]) for i in range(5)]
+    for r in rs:
+        q.put(r)
+    assert [r.id for r in q.poll(16, 0.0)] == [r.id for r in rs]
+
+
+def test_wfq_idle_class_cannot_bank_credit():
+    """A class waking from idle catches its virtual finish up to the
+    queue's virtual time: best-effort arriving after a priority-only
+    stretch gets its fair next turn, NOT a retroactive backlog."""
+    q = RequestQueue(max_depth=16)
+    for _ in range(4):
+        q.put(_req("priority"))
+    assert _drain_classes(q) == ["priority"] * 4
+    for _ in range(2):
+        q.put(_req("priority"))
+    for _ in range(2):
+        q.put(_req("best-effort"))
+    # with banked credit this would be b,b,p,p; caught-up it is not
+    assert _drain_classes(q) == ["best-effort", "priority", "priority",
+                                 "best-effort"]
+
+
+def test_wfq_requeue_goes_front_and_stays_eligible():
+    q = RequestQueue(max_depth=8)
+    carry = _req("priority", [7])
+    q.put(carry)
+    q.put(_req("best-effort"))
+    assert q.poll(1, 0.0)[0].id == carry.id
+    q.requeue(carry)                 # KV-pool defer: re-admit in front
+    got = q.poll(8, 0.0)
+    assert [r.id for r in got][0] == carry.id
+    assert [r.tenant_class for r in got] == ["priority", "best-effort"]
+
+
+# ---------------------------------------------------------------------------
+# overload: eviction order + per-class depth budgets
+# ---------------------------------------------------------------------------
+
+def test_wfq_eviction_sheds_downward_never_priority():
+    """Under overload ``put`` evicts the NEWEST request of the lowest
+    backlogged class below the arrival: best-effort sheds first,
+    standard next, priority never — and an arrival with nobody
+    beneath it eats QueueFullError itself."""
+    q = RequestQueue(max_depth=4)
+    b1, b2 = _req("best-effort", [1]), _req("best-effort", [2])
+    s1, s2 = _req("standard", [3]), _req("standard", [4])
+    for r in (b1, b2, s1, s2):
+        assert q.put(r) is None
+    assert q.put(_req("priority")).id == b2.id      # newest b first
+    assert q.put(_req("priority")).id == b1.id
+    assert q.put(_req("priority")).id == s2.id      # then newest s
+    with pytest.raises(QueueFullError):
+        q.put(_req("best-effort"))   # nothing beneath best-effort
+    with pytest.raises(QueueFullError):
+        q.put(_req("standard"))      # best-effort deque already empty
+    assert q.put(_req("priority")).id == s1.id
+    # queue is now all-priority: a priority arrival has nobody to
+    # shed — priority is refused, never evicted
+    with pytest.raises(QueueFullError):
+        q.put(_req("priority"))
+    assert q.depths() == {"priority": 4, "standard": 0,
+                          "best-effort": 0}
+
+
+def test_wfq_class_depth_budget_caps_before_global_bound():
+    q = RequestQueue(max_depth=8, depth_shares={"best-effort": 0.25})
+    q.put(_req("best-effort"))
+    q.put(_req("best-effort"))
+    with pytest.raises(QueueFullError) as ei:
+        q.put(_req("best-effort"))  # class budget 2 of depth 8
+    assert "best-effort" in str(ei.value)
+    assert len(q) == 2              # the global bound was never near
+    q.put(_req("standard"))         # other classes unaffected
+
+
+# ---------------------------------------------------------------------------
+# ModelRegistry units
+# ---------------------------------------------------------------------------
+
+def test_model_registry_register_resolve_swap():
+    reg = ModelRegistry()
+    with pytest.raises(UnknownModelError):
+        reg.resolve()               # empty registry has no default
+    fa, fb = OffsetModel(0), OffsetModel(100)
+    reg.register("m-a", fa, version="v1")
+    reg.register("m-b", fb, version="v1")
+    assert reg.ids() == ["m-a", "m-b"]
+    assert reg.default_id() == "m-a"        # first registered
+    assert reg.resolve() == ("m-a", fa)     # None -> default
+    assert reg.resolve("m-b") == ("m-b", fb)
+    assert reg.resolve_id("m-b") == "m-b"
+    with pytest.raises(UnknownModelError):
+        reg.resolve("m-c")
+    with pytest.raises(UnknownModelError):
+        reg.swap("m-c", fa)         # swap cannot create models
+    with pytest.raises(TypeError):
+        reg.register("m-c", "not-callable")
+    fb2 = OffsetModel(200)
+    assert reg.swap("m-b", fb2, version="v2") == "v1"  # old version
+    assert reg.resolve("m-b") == ("m-b", fb2)
+    assert reg.versions() == {"m-a": "v1", "m-b": "v2"}
+    # of(): plain callable wraps into a one-model registry, an
+    # existing registry passes through untouched
+    assert ModelRegistry.of(reg) is reg
+    one = ModelRegistry.of(fa)
+    assert one.ids() == [tenancy.default_model_id()]
+
+
+# ---------------------------------------------------------------------------
+# engine: multi-model dispatch, typed unknown model, batch isolation
+# ---------------------------------------------------------------------------
+
+def test_engine_multi_model_dispatch_and_unknown_model():
+    fa, fb = OffsetModel(0, delay=0.2), OffsetModel(100)
+    reg = ModelRegistry()
+    reg.register("m-a", fa, version="v1")
+    reg.register("m-b", fb, version="v1")
+    eng = ServingEngine(reg, bucket_lens=(16,), max_rows=2,
+                        max_queue_depth=16, engine_id="mm-1")
+    with eng:
+        hold = eng.submit([9, 9, 9])          # m-a (default) in flight
+        assert fa.started.wait(10)
+        f_a = eng.submit([1, 2, 3], model_id="m-a")
+        f_b = eng.submit([4, 5], model_id="m-b", tenant="acme",
+                         tenant_class="priority")
+        assert np.array_equal(hold.result(timeout=30)[:, 0], [9, 9, 9])
+        assert np.array_equal(f_a.result(timeout=30)[:, 0], [1, 2, 3])
+        assert np.array_equal(f_b.result(timeout=30)[:, 0], [104, 105])
+        # a batch never mixes models: m-b's fn saw ONLY its request
+        assert len(fb.seen) == 1
+        assert 4 in fb.seen[0] and 9 not in fb.seen[0]
+        # cost attribution carries the model + tenant axes
+        assert f_b.cost["model"] == "m-b"
+        assert f_b.cost["tenant"] == "acme"
+        assert f_b.cost["tenant_class"] == "priority"
+        with pytest.raises(UnknownModelError):
+            eng.submit([1], model_id="m-zzz")
+    assert eng.stats.count("rejected_unknown_model") == 1
+    assert eng.stats.count("completed") == 3
+    snap = eng.snapshot()
+    assert snap["models"] == {"m-a": "v1", "m-b": "v1"}
+    assert set(snap["queue_classes"]) == set(TENANT_CLASSES)
+    bills = snap["tenants"]
+    assert bills["acme"]["tenant_class"] == "priority"
+    assert bills["acme"]["events"]["completed"] == 1
+    assert bills["acme"]["tokens"] == 2
+    assert "m-b" in bills["acme"]["by_model"]
+    # the unknown-model refusal is attributed too (anonymous tenant)
+    assert bills["anonymous"]["events"]["rejected_unknown_model"] == 1
+
+
+def test_engine_wfq_eviction_fails_victim_loudly():
+    """The engine-level shed drill: a priority arrival under overload
+    evicts the newest best-effort request, whose future fails with
+    QueueFullError (a typed shed, not a silent drop), counted on the
+    victim's tenant slice."""
+    slow = OffsetModel(0, delay=0.4)
+    eng = ServingEngine(slow, bucket_lens=(16,), max_rows=1,
+                        max_queue_depth=2, engine_id="evict-1")
+    with eng:
+        hold = eng.submit([1])
+        assert slow.started.wait(10)
+        kept = eng.submit([2], tenant="b1", tenant_class="best-effort")
+        victim = eng.submit([3], tenant="b2",
+                            tenant_class="best-effort")
+        vip = eng.submit([4], tenant="gold", tenant_class="priority")
+        with pytest.raises(QueueFullError):
+            victim.result(timeout=10)
+        assert hold.result(timeout=30)[0, 0] == 1
+        assert kept.result(timeout=30)[0, 0] == 2
+        assert vip.result(timeout=30)[0, 0] == 4
+    bills = eng.tenants.bills()
+    assert bills["b2"]["events"]["shed"] == 1
+    assert bills["gold"]["events"]["completed"] == 1
+    assert eng.stats.count("rejected_queue_full") == 1
+
+
+# ---------------------------------------------------------------------------
+# live hot-swap: zero lost requests, version flip, swap event
+# ---------------------------------------------------------------------------
+
+def test_engine_hot_swap_zero_loss_under_load():
+    from mxnet_tpu.telemetry import events as _events
+
+    records = []
+    _events.add_tap(records.append)
+    try:
+        eng = ServingEngine(OffsetModel(0, delay=0.01),
+                            bucket_lens=(16,), max_rows=2,
+                            max_queue_depth=64, engine_id="swap-1")
+        outs, errors = [], []
+
+        def client():
+            try:
+                for i in range(30):
+                    toks = [i % 7 + 1] * 3
+                    outs.append((toks,
+                                 eng.infer(toks, timeout=60)[:, 0]))
+            except Exception as e:   # any loss fails the drill below
+                errors.append(e)
+
+        with eng:
+            t = threading.Thread(target=client)
+            t.start()
+            while len(outs) < 8:     # traffic established, mid-stream
+                time.sleep(0.005)
+            eng.swap_model(OffsetModel(1000, delay=0.01),
+                           version="v2")
+            post = eng.infer([5, 5], timeout=60)
+            t.join(120)
+        assert not errors, errors
+        assert len(outs) == 30       # ZERO lost requests across the swap
+        for toks, got in outs:       # each served wholly by v1 OR v2
+            base = np.asarray(toks, np.float32)
+            assert (np.array_equal(got, base)
+                    or np.array_equal(got, base + 1000)), (toks, got)
+        # traffic after the swap returned runs the new version
+        assert np.array_equal(post[:, 0], [1005, 1005])
+        assert any(np.array_equal(g, np.asarray(t0, np.float32) + 1000)
+                   for t0, g in outs)
+        assert eng.snapshot()["models"] == {
+            tenancy.default_model_id(): "v2"}
+        assert eng.stats.count("completed") == 31
+        swaps = [r for r in records if r["event"] == "model_swap"]
+        assert swaps and swaps[0]["engine_id"] == "swap-1"
+        assert swaps[0]["to_version"] == "v2"
+    finally:
+        _events.remove_tap(records.append)
+
+
+# ---------------------------------------------------------------------------
+# TenantStats: slices, bills, the four-label metric contract
+# ---------------------------------------------------------------------------
+
+def test_tenant_stats_bills_and_label_contract():
+    reg = MetricsRegistry()
+    ts = TenantStats("ts-1", registry=reg)
+    ts.observe_event("acme", "priority", "m-a", "submitted")
+    ts.observe_event("acme", "priority", "m-a", "completed")
+    ts.observe_latency("acme", "priority", "m-a", 12.5)
+    ts.observe_cost("acme", "priority", "m-a", 0.5, 250)
+    ts.observe_cost("acme", "priority", "m-b", 0.25, 250)
+    ts.observe_event(None, "standard", "m-a", "submitted")  # anonymous
+    bills = ts.bills()
+    acme = bills["acme"]
+    assert acme["tenant_class"] == "priority"
+    assert acme["device_s"] == 0.75 and acme["tokens"] == 500
+    assert acme["device_s_per_1k_tokens"] == 1.5
+    assert acme["by_model"]["m-a"]["device_s_per_1k_tokens"] == 2.0
+    assert acme["events"] == {"submitted": 1, "completed": 1}
+    assert bills["anonymous"]["events"] == {"submitted": 1}
+    # every tenant_* family line carries all four attribution labels
+    text = reg.render_prometheus()
+    for fam in ("mxnet_tpu_serving_tenant_requests_total",
+                "mxnet_tpu_serving_tenant_cost_seconds_total",
+                "mxnet_tpu_serving_tenant_tokens_total"):
+        line = next(ln for ln in text.splitlines()
+                    if ln.startswith(fam + "{") and 'tenant="acme"' in ln)
+        for frag in ('engine_id="ts-1"', 'tenant_class="priority"',
+                     'model="m-'):
+            assert frag in line, (fam, line)
+
+
+# ---------------------------------------------------------------------------
+# router: model-aware seat pick + HA journal carries the identity axes
+# ---------------------------------------------------------------------------
+
+def _wait(pred, timeout=30.0, what="condition", poll=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        v = pred()
+        if v:
+            return v
+        time.sleep(poll)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def test_router_routes_by_hosted_model():
+    ra, rb = ModelRegistry(), ModelRegistry()
+    ra.register("m-a", OffsetModel(0), version="v1")
+    rb.register("m-b", OffsetModel(100), version="v1")
+    ea = ServingEngine(ra, bucket_lens=(16,), max_rows=2,
+                       engine_id="host-a")
+    eb = ServingEngine(rb, bucket_lens=(16,), max_rows=2,
+                       engine_id="host-b")
+    router = ServingRouter(engines=[ea, eb], poll_interval_s=0.1)
+    with ea, eb, router:
+        _wait(lambda: all(r.get("models")
+                          for r in router.scoreboard().values()),
+              what="seat model maps")
+        board = router.scoreboard()
+        assert board["host-a"]["models"] == {"m-a": "v1"}
+        assert board["host-b"]["models"] == {"m-b": "v1"}
+        for _ in range(3):
+            out = router.submit([1, 2], model_id="m-b",
+                                tenant="acme").result(timeout=30)
+            assert np.array_equal(out[:, 0], [101, 102])
+            out = router.submit([3], model_id="m-a").result(timeout=30)
+            assert out[0, 0] == 3
+        snap = router.snapshot()
+        assert snap["counters"]["completed"] == 6
+        # the model constraint pinned every m-b request to its host
+        assert snap["engines"]["host-b"]["dispatched"] >= 3
+
+
+def test_router_ha_journal_carries_model_and_tenant():
+    """The HA journal entry (what a surviving peer adopts) must carry
+    the full identity: model_id + tenant + tenant_class — an adopted
+    orphan re-dispatched without them would run the wrong model and
+    bill the wrong party."""
+    import contextlib
+
+    with contextlib.ExitStack() as stack:
+        engines = [ServingEngine(OffsetModel(0, delay=0.25),
+                                 bucket_lens=(16,), max_rows=1,
+                                 engine_id=f"haj-e{i}")
+                   for i in range(2)]
+        for eng in engines:
+            eng.start()
+            stack.callback(lambda e=eng: e.stop(drain=False))
+        fleet = {e.engine_id: e for e in engines}
+        r_a = ServingRouter(engines=dict(fleet), poll_interval_s=0.15,
+                            router_id="haj-a")
+        r_b = ServingRouter(engines=dict(fleet), poll_interval_s=0.15,
+                            router_id="haj-b")
+        stack.callback(lambda: r_b.stop(drain=False))
+        stack.callback(lambda: r_a.stop(drain=False))
+        sa, sb = r_a.expose(), r_b.expose()
+        r_a.set_peer(f"http://{sb.host}:{sb.port}")
+        r_b.set_peer(f"http://{sa.host}:{sa.port}")
+        r_a.start()
+        r_b.start()
+        _wait(lambda: (r_a._peer is not None and r_a._peer.has_live()
+                       and r_b._peer is not None
+                       and r_b._peer.has_live()),
+              what="HA journal links")
+        fut = r_a.submit([1, 2, 3], cid="cid-tenancy-1",
+                         model_id=tenancy.default_model_id(),
+                         tenant="acme", tenant_class="priority")
+        with r_b._lock:              # ack-before-enqueue: visible now
+            entry = dict(r_b._journal["cid-tenancy-1"])
+        assert entry["model_id"] == tenancy.default_model_id()
+        assert entry["tenant"] == "acme"
+        assert entry["tenant_class"] == "priority"
+        assert np.array_equal(fut.result(timeout=60)[:, 0], [1, 2, 3])
+        _wait(lambda: "cid-tenancy-1" not in r_b._journal,
+              what="journal release on completion")
+
+
+# ---------------------------------------------------------------------------
+# cross-process: model id + tenant over the binary wire, hot-swap
+# visible at /healthz (the canary re-TOFU surface)
+# ---------------------------------------------------------------------------
+
+def test_model_id_round_trip_over_wire_cross_process():
+    import json
+    import os
+    import socket
+    import subprocess
+    import sys
+    import urllib.request
+
+    from mxnet_tpu.serving.wire import recv_frame, send_frame
+
+    worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "tenancy_engine_worker.py")
+    proc = subprocess.Popen([sys.executable, worker, "xproc-1"],
+                            stdin=subprocess.PIPE,
+                            stdout=subprocess.PIPE, text=True)
+    try:
+        head = proc.stdout.readline().split()
+        assert head[0] == "PORT", head
+        http_port, wire_port = int(head[1]), int(head[3])
+
+        def healthz():
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{http_port}/healthz",
+                    timeout=10) as r:
+                return json.loads(r.read())
+
+        assert healthz()["models"] == {"m-a": "v1", "m-b": "v1"}
+
+        s = socket.create_connection(("127.0.0.1", wire_port),
+                                     timeout=10.0)
+        try:
+            send_frame(s, ("SUBMIT", 1,
+                           {"tokens": np.arange(1, 5, dtype=np.int32),
+                            "model_id": "m-b", "tenant": "acme",
+                            "tenant_class": "priority"}))
+            frame, _ = recv_frame(s)
+            assert frame[0] == "RESULT" and frame[1] == 1
+            body = frame[2]
+            out = np.asarray(body["result"])
+            assert np.array_equal(out[:, 0], [101, 102, 103, 104])
+            # the bill rode back with the identity axes intact
+            assert body["cost"]["model"] == "m-b"
+            assert body["cost"]["tenant"] == "acme"
+            assert body["cost"]["tenant_class"] == "priority"
+            # unknown model: a TYPED error frame, connection survives
+            send_frame(s, ("SUBMIT", 2, {"tokens": np.arange(3),
+                                         "model_id": "m-nope"}))
+            frame, _ = recv_frame(s)
+            assert frame[0] == "ERROR" and frame[1] == 2
+            assert frame[2]["error_type"] == "UnknownModelError"
+
+            # live hot-swap in the OTHER process: /healthz version
+            # flips (the router canary re-TOFUs off this) and the
+            # same wire connection now gets the new fn
+            proc.stdin.write("SWAP\n")
+            proc.stdin.flush()
+            assert proc.stdout.readline().strip() == "SWAPPED"
+            assert healthz()["models"] == {"m-a": "v1", "m-b": "v2"}
+            send_frame(s, ("SUBMIT", 3,
+                           {"tokens": np.arange(1, 3, dtype=np.int32),
+                            "model_id": "m-b"}))
+            frame, _ = recv_frame(s)
+            assert frame[0] == "RESULT"
+            assert np.array_equal(
+                np.asarray(frame[2]["result"])[:, 0], [201, 202])
+        finally:
+            s.close()
+
+        # the tenant slice is scrapable from outside the process
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{http_port}/metrics",
+                timeout=10) as r:
+            text = r.read().decode()
+        line = next(
+            ln for ln in text.splitlines()
+            if ln.startswith("mxnet_tpu_serving_tenant_tokens_total{")
+            and 'tenant="acme"' in ln)
+        assert 'model="m-b"' in line and 'engine_id="xproc-1"' in line
+    finally:
+        proc.stdin.close()
+        proc.wait(timeout=30)

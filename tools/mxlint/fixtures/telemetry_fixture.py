@@ -24,6 +24,16 @@ def serving_with_engine_id(reg):
                   ("engine_id", "stage"))   # clean
 
 
+def tenant_without_model(reg):
+    reg.counter("mxnet_tpu_serving_tenant_fixture_total", "doc",
+                ("engine_id", "tenant"))    # metric-tenant-label
+
+
+def tenant_with_both_axes(reg):
+    reg.counter("mxnet_tpu_serving_tenant_fixture2_total", "doc",
+                ("engine_id", "tenant", "model"))    # clean
+
+
 def span_leak():
     sp = _spans.start_span("fixture/leak")  # span-leak: never ended
     return 1 + (0 if sp is None else 0)

@@ -8,6 +8,10 @@
   carry the ``engine_id`` label (the ISSUE-5 fleet contract: N engines
   in one process — or N engine processes scrape-merged at the router —
   must count disjointly);
+- ``metric-tenant-label`` — every ``mxnet_tpu_serving_tenant_*``
+  family must carry BOTH the ``tenant`` and ``model`` labels: the
+  tenant slice exists to attribute cost/SLO per tenant per model, and
+  a slice family missing either axis bills the wrong party;
 - ``span-leak``           — a span assigned to a LOCAL variable from
   ``start_span(...)`` must be ``.end()``-ed in the same function: an
   un-ended local root pins its trace in the active buffer forever.
@@ -55,8 +59,9 @@ def _is_family_arg(name):
 
 class TelemetryConsistencyPass(LintPass):
     name = "telemetry-consistency"
-    rules = ("metric-labels", "metric-engine-label", "span-leak",
-             "dashboard-family", "alert-rule-family")
+    rules = ("metric-labels", "metric-engine-label",
+             "metric-tenant-label", "span-leak", "dashboard-family",
+             "alert-rule-family")
 
     def __init__(self):
         # family -> list of (labels tuple | None, relpath, line)
@@ -92,13 +97,25 @@ class TelemetryConsistencyPass(LintPass):
             return []
         self.declared.setdefault(name, []).append(
             (labels, ctx.relpath, call.lineno))
+        out = []
         if (name.startswith("mxnet_tpu_serving_")
                 and (labels is None or "engine_id" not in labels)):
-            return [ctx.finding(
+            out.append(ctx.finding(
                 "metric-engine-label", call,
                 f"serving family {name} must carry the engine_id label "
-                f"(fleet contract: engines count disjointly)")]
-        return []
+                f"(fleet contract: engines count disjointly)"))
+        if name.startswith("mxnet_tpu_serving_tenant_"):
+            missing = [lab for lab in ("tenant", "model")
+                       if labels is None or lab not in labels]
+            if missing:
+                out.append(ctx.finding(
+                    "metric-tenant-label", call,
+                    f"tenant-slice family {name} must carry the "
+                    f"{' and '.join(missing)} label"
+                    f"{'s' if len(missing) > 1 else ''} — a slice "
+                    f"missing an attribution axis bills the wrong "
+                    f"party"))
+        return out
 
     def _labels_arg(self, call):
         node = None

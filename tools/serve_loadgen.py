@@ -92,6 +92,87 @@ def _per_engine_completed_delta(before, after):
     return {eid: int(v) for eid, v in out.items() if v}
 
 
+def parse_tenant_spec(spec):
+    """``--tenants 'priority:1,standard:4,best-effort:8'`` → the
+    per-client ``(tenant, tenant_class)`` assignment list. Each
+    ``class[:count]`` pair contributes ``count`` closed-loop clients
+    submitting as tenant ``t-<class>``; the list's length REPLACES
+    ``--clients`` (the spec IS the offered-load mix)."""
+    from mxnet_tpu.serving.tenancy import normalize_class
+
+    out = []
+    for part in str(spec).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        cls, _, count = part.partition(":")
+        cls = normalize_class(cls.strip())
+        n = int(count) if count.strip() else 1
+        if n <= 0:
+            raise ValueError(f"tenant spec count must be > 0: {part!r}")
+        out.extend([(f"t-{cls}", cls)] * n)
+    if not out:
+        raise ValueError(f"empty tenant spec: {spec!r}")
+    return out
+
+
+def _tenant_delta(before, after):
+    """Per-tenant deltas off the tenant-slice families: outcome events
+    from ``.._tenant_requests_total``, billed tokens/device seconds
+    from the cost counters. Canary probes carry no tenant (they bill
+    as ``anonymous``), so the loadgen's NAMED tenants reconcile
+    exactly even with a live prober."""
+    from mxnet_tpu.telemetry.expo import parse_labels
+
+    out = {}
+    for parsed, sign in ((before, -1), (after, 1)):
+        for key, val in parsed.items():
+            name, labels = parse_labels(key)
+            tenant = labels.get("tenant")
+            if tenant is None or not name.startswith(
+                    "mxnet_tpu_serving_tenant_"):
+                continue
+            slot = out.setdefault(tenant, {"events": {}, "tokens": 0.0,
+                                           "device_s": 0.0})
+            if name == "mxnet_tpu_serving_tenant_requests_total":
+                ev = labels.get("event", "?")
+                slot["events"][ev] = (slot["events"].get(ev, 0.0)
+                                      + sign * val)
+            elif name == "mxnet_tpu_serving_tenant_tokens_total":
+                slot["tokens"] += sign * val
+            elif name == "mxnet_tpu_serving_tenant_cost_seconds_total":
+                slot["device_s"] += sign * val
+    for slot in out.values():
+        slot["events"] = {ev: int(v) for ev, v in slot["events"].items()
+                          if int(v)}
+        slot["tokens"] = int(slot["tokens"])
+        slot["device_s"] = round(slot["device_s"], 6)
+    return {t: s for t, s in sorted(out.items())
+            if s["events"] or s["tokens"]}
+
+
+def cross_check_tenants(books, delta):
+    """Per-tenant reconciliation: every named tenant's client-side
+    completed count and token sum must equal the server's tenant-slice
+    delta — the billing contract, checked tenant by tenant (a fleet
+    that reconciles in AGGREGATE can still bill the wrong party)."""
+    mismatches = []
+    for tenant, b in sorted(books.items()):
+        srv = delta.get(tenant)
+        if srv is None:
+            if b["ok"]:
+                mismatches.append(f"{tenant}: no server-side slice")
+            continue
+        done = srv["events"].get("completed", 0)
+        if b["ok"] != done:
+            mismatches.append(f"{tenant}: completed client={b['ok']} "
+                              f"server={done}")
+        if b["tokens"] != srv["tokens"]:
+            mismatches.append(f"{tenant}: tokens client={b['tokens']} "
+                              f"server={srv['tokens']}")
+    return not mismatches, mismatches
+
+
 def cross_check(outcomes, attempts, delta):
     """Reconcile client-side accounting against the server-observed
     /metrics deltas — every submit must land in exactly one counter on
@@ -374,13 +455,20 @@ class RouterClient:
         return [(start + i) % len(self.urls)
                 for i in range(len(self.urls))]
 
-    def submit(self, tokens, token_types=None, deadline_ms=None):
+    def submit(self, tokens, token_types=None, deadline_ms=None,
+               model_id=None, tenant=None, tenant_class=None):
         import numpy as np
         payload = {"tokens": np.asarray(tokens).tolist(),
                    "token_types": (np.asarray(token_types).tolist()
                                    if token_types is not None else None),
                    "deadline_ms": deadline_ms,
                    "cid": f"{self._cid_base}-{next(self._cid_seq)}"}
+        if model_id is not None:
+            payload["model_id"] = model_id
+        if tenant is not None:
+            payload["tenant"] = tenant
+        if tenant_class is not None:
+            payload["tenant_class"] = tenant_class
         return self._Future(self, payload)
 
     def _request(self, fut, timeout):
@@ -624,7 +712,8 @@ def _watch_restarts(router, stop_evt, restarts, poll_s=0.05):
 
 def run_load(engine, n_clients=8, requests_per_client=16,
              min_len=16, max_len=512, vocab=30522, deadline_ms=None,
-             result_timeout_s=600.0, seed=0, metrics_url=None):
+             result_timeout_s=600.0, seed=0, metrics_url=None,
+             tenants=None, model_ids=None):
     """Drive ``engine`` — a ServingEngine OR a ServingRouter (same
     submit surface) — with n_clients closed-loop threads.
 
@@ -651,6 +740,14 @@ def run_load(engine, n_clients=8, requests_per_client=16,
     client requests); when a prober ran, a ``canary`` section reports
     its per-seat outcome counts, transport split and the excluded
     device_s/requests/tokens.
+
+    ``tenants`` (a ``parse_tenant_spec`` assignment list — its length
+    replaces ``n_clients``) tags every client with a tenant + WFQ
+    admission class; the report then carries a per-tenant section
+    (share, outcome counts, client p50/p99) and — with a
+    ``metrics_url`` — a per-tenant billing cross-check against the
+    server's tenant-slice counter deltas. ``model_ids`` round-robins
+    submits across named hosted models (the multi-model mix).
     """
     import threading
 
@@ -658,6 +755,9 @@ def run_load(engine, n_clients=8, requests_per_client=16,
 
     from mxnet_tpu.serving import (DeadlineExceededError,
                                    NoEngineAvailableError, QueueFullError)
+
+    if tenants:
+        n_clients = len(tenants)
 
     # a router reports against its OWN counter family and adds the
     # per-engine request distribution to the report
@@ -679,33 +779,59 @@ def run_load(engine, n_clients=8, requests_per_client=16,
     # future.cost — reconciled against the server's /costs delta
     client_cost = {"device_s": 0.0, "requests": 0, "tokens": 0,
                    "compiled": 0, "missing": 0}
+    # per-tenant client books (tenant runs only): the loadgen's side
+    # of the per-tenant billing cross-check + per-class percentiles
+    tenant_books = {}
+    if tenants:
+        for tenant, cls in tenants:
+            tenant_books.setdefault(
+                tenant, {"class": cls, "clients": 0, "ok": 0,
+                         "shed": 0, "expired": 0, "error": 0,
+                         "tokens": 0, "device_s": 0.0, "lat": []})
+            tenant_books[tenant]["clients"] += 1
     lock = threading.Lock()
 
     def client(cid):
         rs = np.random.RandomState(seed + cid)
-        for _ in range(requests_per_client):
+        tenant = cls = None
+        if tenants:
+            tenant, cls = tenants[cid]
+        for i in range(requests_per_client):
             n = int(rs.randint(min_len, max_len + 1))
             toks = rs.randint(1, vocab, n).astype(np.int32)
+            kwargs = {}
+            if tenant is not None:
+                kwargs.update(tenant=tenant, tenant_class=cls)
+            if model_ids:
+                kwargs["model_id"] = model_ids[(cid + i)
+                                               % len(model_ids)]
             t0 = time.perf_counter()
             try:
                 # submit + result (not infer) so every generated
                 # request is TAGGED with its server-side trace id —
                 # the report's slowest_traces hand the operator ids to
                 # paste straight into `telemetry_dump.py --trace <id>`
-                fut = engine.submit(toks, deadline_ms=deadline_ms)
+                fut = engine.submit(toks, deadline_ms=deadline_ms,
+                                    **kwargs)
                 fut.result(timeout=result_timeout_s)
             except DeadlineExceededError:
                 with lock:
                     outcomes["expired"] += 1
+                    if tenant:
+                        tenant_books[tenant]["expired"] += 1
                 continue
             except (QueueFullError, NoEngineAvailableError):
                 with lock:
                     outcomes["shed"] += 1
+                    if tenant:
+                        tenant_books[tenant]["shed"] += 1
                 time.sleep(0.005)       # polite backoff, stay closed-loop
                 continue
             except Exception:
                 with lock:
                     outcomes["error"] += 1
+                    if tenant:
+                        tenant_books[tenant]["error"] += 1
                 continue
             ms = (time.perf_counter() - t0) * 1e3
             cost = getattr(fut, "cost", None)
@@ -713,6 +839,14 @@ def run_load(engine, n_clients=8, requests_per_client=16,
                 outcomes["ok"] += 1
                 valid_tokens[0] += n
                 latencies.append((ms, fut.trace_id))
+                if tenant:
+                    tb = tenant_books[tenant]
+                    tb["ok"] += 1
+                    tb["lat"].append(ms)
+                    tb["tokens"] += (cost.get("tokens", n)
+                                     if cost else n)
+                    if cost:
+                        tb["device_s"] += cost.get("device_s", 0.0)
                 if cost:
                     client_cost["device_s"] += cost.get("device_s", 0.0)
                     client_cost["requests"] += 1
@@ -775,6 +909,28 @@ def run_load(engine, n_clients=8, requests_per_client=16,
               "slowest_traces": [{"trace_id": tid, "ms": round(ms, 3)}
                                  for ms, tid in slowest],
               "engine": engine.snapshot()}
+    if tenants:
+        # per-tenant client view: offered share, outcomes, latency
+        # percentiles — priority under overload must hold its p99
+        # while best-effort sheds (the WFQ acceptance shape)
+        tview = {}
+        for tenant, tb in sorted(tenant_books.items()):
+            ts = sorted(tb["lat"])
+
+            def tpct(p, _ts=ts):
+                v = nearest_rank(_ts, p)
+                return None if v is None else round(v, 3)
+
+            tview[tenant] = {
+                "class": tb["class"], "clients": tb["clients"],
+                "completed": tb["ok"], "shed": tb["shed"],
+                "expired": tb["expired"], "errors": tb["error"],
+                "p50_ms": tpct(50), "p99_ms": tpct(99),
+                "client_tokens": tb["tokens"],
+                "client_device_s": round(tb["device_s"], 6)}
+        report["tenants"] = tview
+    if model_ids:
+        report["models"] = list(model_ids)
     if is_router:
         snap = report["engine"]
         report["per_engine"] = {eid: row["dispatched"]
@@ -856,6 +1012,19 @@ def run_load(engine, n_clients=8, requests_per_client=16,
             if tokens:
                 report["cost"]["device_s_per_1k_tokens"] = round(
                     cost_delta["request_s"] * 1e3 / tokens, 6)
+        # per-tenant billing cross-check: the named tenants' completed
+        # counts and token sums must match the server's tenant-slice
+        # deltas tenant-for-tenant (aggregate reconciliation can hide
+        # a bill landing on the wrong party)
+        if tenants:
+            tdelta = _tenant_delta(before, after)
+            t_ok, t_mismatches = cross_check_tenants(
+                tenant_books, tdelta)
+            for tenant, srv in tdelta.items():
+                if tenant in report["tenants"]:
+                    report["tenants"][tenant]["server"] = srv
+            report["tenants_reconciled"] = t_ok
+            report["tenant_mismatches"] = t_mismatches
         # SLO compliance after the measured window: error-budget
         # remaining + burn rates per declared objective (the bench's
         # serving legs forward this as `slo_compliance`)
@@ -1935,6 +2104,20 @@ def _main():
                     "(omitted: the server mints one), so streams "
                     "replay byte-identical across --router failover "
                     "(stream_mismatches stays 0)")
+    ap.add_argument("--tenants", default=None, metavar="SPEC",
+                    help="tenant-class client mix, e.g. "
+                    "'priority:1,standard:4,best-effort:8' — each "
+                    "class:count pair runs count closed-loop clients "
+                    "as tenant t-<class> in that WFQ admission class "
+                    "(the total REPLACES --clients). The report adds "
+                    "per-tenant p50/p99 + shed counts and, with a "
+                    "scrapeable target, a per-tenant billing "
+                    "cross-check against the server's tenant slices")
+    ap.add_argument("--models", type=int, default=0, metavar="N",
+                    help="register N named models (m0..mN-1) on every "
+                    "engine and round-robin submits across them — the "
+                    "multi-model mix (per-model splits land in the "
+                    "tenant-slice families and /stats)")
     ap.add_argument("--drill-overload", nargs="?", const="auto",
                     default=None, metavar="ALERT",
                     help="instead of the measured run, flood the "
@@ -1980,6 +2163,15 @@ def _main():
         model = bert_serving_entry(net)
         if args.drill_wedge is not None:
             model = wedge_gates.setdefault(engine_id, WedgeGate(model))
+        if args.models > 1:
+            # N named models sharing one set of weights: exercises the
+            # whole model_id path (registry resolution, per-model
+            # dispatch groups, labeled slices) without N× parameters
+            from mxnet_tpu.serving import ModelRegistry
+            reg = ModelRegistry()
+            for i in range(args.models):
+                reg.register(f"m{i}", model, version="v1")
+            model = reg
         return ServingEngine(model, bucket_lens=buckets,
                              max_rows=args.max_rows, pool=args.pool,
                              engine_id=engine_id)
@@ -1989,6 +2181,13 @@ def _main():
         # params would be silently swallowed into the error column
         ap.error("--decode drives in-process engines (optionally with "
                  "--router N); --router-url is not supported yet")
+    if args.decode and (args.tenants or args.models > 1):
+        ap.error("--tenants/--models drive the encoder path (a decode "
+                 "engine hosts exactly one model)")
+    tenant_assign = (parse_tenant_spec(args.tenants)
+                     if args.tenants else None)
+    loadgen_models = ([f"m{i}" for i in range(args.models)]
+                      if args.models > 1 else None)
 
     if args.drill_chaos:
         from mxnet_tpu import envvars
@@ -2162,7 +2361,9 @@ def _main():
                               max_len=args.max_len,
                               vocab=args.vocab,
                               deadline_ms=args.deadline_ms,
-                              metrics_url=metrics_url)
+                              metrics_url=metrics_url,
+                              tenants=tenant_assign,
+                              model_ids=loadgen_models)
         if args.router_url:
             report["client_failovers"] = target.failovers
     print(json.dumps(report, indent=2))
@@ -2213,6 +2414,14 @@ def _main():
         for rec in report["slowest_traces"]:
             print(f"#   {rec['ms']:>10.2f} ms  {rec['trace_id']}",
                   file=sys.stderr)
+    if report.get("tenants"):
+        for tenant, row in sorted(report["tenants"].items()):
+            print(f"# tenant {tenant} ({row['class']}): "
+                  f"{row['completed']} completed, {row['shed']} shed, "
+                  f"{row['expired']} expired, p50/p99="
+                  f"{row['p50_ms']}/{row['p99_ms']} ms, "
+                  f"{row['client_tokens']} tokens billed",
+                  file=sys.stderr)
     cost = report.get("cost")
     if cost:
         delta = cost.get("ledger_delta") or {}
@@ -2251,6 +2460,11 @@ def _main():
     if cost and cost["reconciled"] is False:
         print("# WARNING: cost-ledger mismatch: "
               + "; ".join(cost["mismatches"]), file=sys.stderr)
+        rc = 1
+    if report.get("tenants_reconciled") is False:
+        print("# WARNING: per-tenant billing mismatch: "
+              + "; ".join(report["tenant_mismatches"]),
+              file=sys.stderr)
         rc = 1
     return rc
 

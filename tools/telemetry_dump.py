@@ -23,8 +23,10 @@ the stage that actually ate the request is visible at a glance.
 
 `--fleet` points at a ServingRouter endpoint and prints the one-screen
 fleet view: the per-engine scoreboard (up/routable, outstanding,
-queue depth, qps, p95), the router's outcome counters, and the
-slowest cross-engine traces with the engines that served each::
+queue depth, qps, p95), the router's outcome counters, the per-tenant
+/ per-model billing split with the live WFQ queue depths (when the
+fleet serves tenant-tagged traffic), and the slowest cross-engine
+traces with the engines that served each::
 
     python tools/telemetry_dump.py --fleet http://127.0.0.1:9200
 
@@ -250,6 +252,81 @@ def decode_split(metrics_text):
     return out
 
 
+def tenant_split(metrics_text):
+    """Per-tenant and per-model aggregates off the tenant-slice
+    families (fleet-wide: summed across engine_id). Returns
+    ``(tenants, models, wfq)`` — tenants keyed by (tenant, class) with
+    completed/shed/tokens/device_s, models keyed by model with
+    tokens/device_s/completed, wfq the live per-class queue depths."""
+    from mxnet_tpu.telemetry.expo import parse_labels, \
+        parse_prometheus_text
+
+    parsed = parse_prometheus_text(metrics_text)
+    tenants, models, wfq = {}, {}, {}
+    for key, val in parsed.items():
+        name, labels = parse_labels(key)
+        if name == "mxnet_tpu_serving_wfq_queue_depth":
+            cls = labels.get("tenant_class", "?")
+            wfq[cls] = wfq.get(cls, 0.0) + val
+            continue
+        if not name.startswith("mxnet_tpu_serving_tenant_"):
+            continue
+        tkey = (labels.get("tenant", "?"),
+                labels.get("tenant_class", "?"))
+        trow = tenants.setdefault(tkey, {"completed": 0, "shed": 0,
+                                         "tokens": 0, "device_s": 0.0})
+        mrow = models.setdefault(labels.get("model", "?"),
+                                 {"completed": 0, "tokens": 0,
+                                  "device_s": 0.0})
+        if name == "mxnet_tpu_serving_tenant_requests_total":
+            ev = labels.get("event")
+            if ev == "completed":
+                trow["completed"] += int(val)
+                mrow["completed"] += int(val)
+            elif ev == "shed":
+                trow["shed"] += int(val)
+        elif name == "mxnet_tpu_serving_tenant_tokens_total":
+            trow["tokens"] += int(val)
+            mrow["tokens"] += int(val)
+        elif name == "mxnet_tpu_serving_tenant_cost_seconds_total":
+            trow["device_s"] += val
+            mrow["device_s"] += val
+    return tenants, models, wfq
+
+
+def dump_tenants(metrics_text, out=None):
+    """Table the per-tenant / per-model fleet split (the multi-tenant
+    billing view of ``--fleet``). Silent when no tenant slice exists
+    (a pre-tenancy fleet)."""
+    out = out if out is not None else sys.stdout
+    tenants, models, wfq = tenant_split(metrics_text)
+    if not tenants and not wfq:
+        return
+    total_tok = sum(r["tokens"] for r in tenants.values()) or 1
+    print("-- tenants (fleet) " + "-" * 40, file=out)
+    print(f"  {'tenant':<20} {'class':<12} {'done':>7} {'shed':>6} "
+          f"{'tokens':>9} {'share':>6} {'device_s':>9} "
+          f"{'s/1k tok':>9}", file=out)
+    for (tenant, cls), r in sorted(tenants.items()):
+        per_1k = (r["device_s"] * 1e3 / r["tokens"]
+                  if r["tokens"] else None)
+        print(f"  {tenant:<20} {cls:<12} {r['completed']:>7} "
+              f"{r['shed']:>6} {r['tokens']:>9} "
+              f"{r['tokens'] / total_tok:>6.0%} {r['device_s']:>9.4f} "
+              f"{(f'{per_1k:.4f}' if per_1k is not None else '-'):>9}",
+              file=out)
+    if len(models) > 1 or (models and "?" not in models):
+        print("  per-model:", file=out)
+        for mid, r in sorted(models.items()):
+            print(f"    {mid:<18} completed={r['completed']} "
+                  f"tokens={r['tokens']} "
+                  f"device_s={r['device_s']:.4f}", file=out)
+    if wfq:
+        print("  wfq queue depth: "
+              + " ".join(f"{cls}={int(n)}"
+                         for cls, n in sorted(wfq.items())), file=out)
+
+
 def dump_fleet(base, out=None, top=5):
     """One-screen fleet view from a router endpoint: scoreboard +
     counters + slowest cross-engine traces (with serving engines)."""
@@ -289,7 +366,7 @@ def dump_fleet(base, out=None, top=5):
         cc = compile_cache_split(metrics_text)
         dec = decode_split(metrics_text)
     except Exception:
-        cc, dec = {}, {}
+        metrics_text, cc, dec = None, {}, {}
     for eid, split in sorted(cc.items()):
         print("  compile-cache "
               + f"{eid}: " + " ".join(f"{k}={int(v)}" for k, v in
@@ -312,6 +389,8 @@ def dump_fleet(base, out=None, top=5):
               f"tokens={row.get('tokens', 0)} "
               f"join/leave={row.get('join', 0)}/{row.get('leave', 0)}",
               file=out)
+    if metrics_text is not None:
+        dump_tenants(metrics_text, out=out)
     try:
         traces = json.loads(_fetch(base + "/traces"))
     except Exception as e:
