@@ -609,6 +609,35 @@ register("MXNET_TPU_INCIDENT_GAP_S", "float", 120.0,
          "into one incident, and a quiet incident with nothing firing "
          "and no seat down closes after it", scope="incidents")
 
+# -- retrospective history --------------------------------------------------
+register("MXNET_TPU_HISTORY", "bool", True,
+         "retrospective time-series history: engines/routers run a "
+         "scraper daemon sampling their exposition into a bounded "
+         "store served at ``/query_range`` + ``/series`` and frozen "
+         "into flight bundles on incident open; ``0`` disables the "
+         "whole subsystem (no thread, no store)", scope="history")
+register("MXNET_TPU_HISTORY_DIR", "path", None,
+         "persist history segments under this directory (append-only "
+         "JSONL segment files per family and tier, reloaded on the "
+         "next start); unset keeps the store in-memory only — same "
+         "bounds, no disk", scope="history")
+register("MXNET_TPU_HISTORY_RETAIN_S", "float", 86400.0,
+         "retention of the coarsest (60 s) downsampling tier in "
+         "seconds; the raw and 10 s tiers retain proportionally "
+         "shorter windows", scope="history")
+register("MXNET_TPU_HISTORY_MAX_MB", "float", 64.0,
+         "on-disk budget for ``MXNET_TPU_HISTORY_DIR`` (MB); past it "
+         "the oldest segment files are deleted, finest tier first",
+         scope="history")
+register("MXNET_TPU_HISTORY_SCRAPE_S", "float", 5.0,
+         "history scraper sampling interval in seconds (engines "
+         "sample the process registry, routers the fleet-merged "
+         "exposition)", scope="history")
+register("MXNET_TPU_HISTORY_SEGMENT_MB", "float", 4.0,
+         "history segment rotation size (MB): the active append-only "
+         "segment file rotates past it, so retention/budget deletes "
+         "operate on whole sealed segments", scope="history")
+
 # -- concurrency sanitizer --------------------------------------------------
 register("MXNET_TPU_SANITIZE", "bool", False,
          "runtime concurrency sanitizer: patches ``threading.Lock``/"
@@ -664,6 +693,7 @@ _SCOPE_TITLES = OrderedDict([
     ("canary", "Synthetic canaries"),
     ("egress", "Alert egress"),
     ("incidents", "Incident timeline"),
+    ("history", "Retrospective history"),
     ("sanitize", "Concurrency sanitizer"),
     ("bench", "Benchmarks"),
     ("tests", "Tests / dev harness"),

@@ -118,6 +118,13 @@ class FleetAutoscaler:
             "mxnet_tpu_autoscaler_actions_total",
             "autoscaler actions, by kind (scale_up / scale_down / "
             "replace)", ("action",))
+        # per-model seat census as a labeled gauge: the named input
+        # for per-model scaling, exported so it can be historied and
+        # graphed — not just read off action records after the fact
+        self._g_model_seats = reg.gauge(
+            "mxnet_tpu_autoscaler_model_seats",
+            "routable seats hosting each model on the primary router",
+            ("model",))
 
     # -- lifecycle ----------------------------------------------------------
     def start(self):
@@ -224,7 +231,14 @@ class FleetAutoscaler:
         routable = [eid for eid, row in board.items()
                     if row.get("routable")]
         self._g_seats.set(len(routable))
-        self._census = self._model_seats(board)
+        census = self._model_seats(board)
+        for model_id, seats in census.items():
+            self._g_model_seats.labels(model=str(model_id)).set(seats)
+        # a model whose last seat left must read 0, not its stale count
+        for model_id in self._census:
+            if model_id not in census:
+                self._g_model_seats.labels(model=str(model_id)).set(0)
+        self._census = census
 
         # -- replace dead seats (cooldown-exempt) ---------------------------
         for eid, row in board.items():

@@ -207,6 +207,9 @@ class ServingEngine:
         # engine's metric families + the alert daemon judging them —
         # built in start(), exposed at /slo + /alerts
         self._slo = None
+        # history scraper (MXNET_TPU_HISTORY): the retrospective
+        # time-series store behind /query_range — built in start()
+        self._history = None
         # exemplar gate, resolved once; the exemplar↔retrievable-trace
         # contract lives in metrics.slow_exemplar (shared with router)
         self._exemplars = exemplar_gate()
@@ -289,6 +292,18 @@ class ServingEngine:
             self._slo = AlertDaemon(evaluator)
             default_burn_rules(self._slo, names)
             self._slo.start()
+        # ... and remember: the history scraper samples this process's
+        # registry into the retrospective store — /query_range,
+        # incident forensics and retro SLO replay all read it
+        # (MXNET_TPU_HISTORY=0: no thread, no store)
+        if envvars.get("MXNET_TPU_HISTORY"):
+            from ..telemetry.history import HistoryScraper
+            self._history = HistoryScraper(
+                self.engine_id,
+                slo_fn=(self.slo_snapshot if self._slo is not None
+                        else None),
+                alerts_fn=(self.alerts_snapshot
+                           if self._slo is not None else None)).start()
         # chaos harness (MXNET_TPU_CHAOS): register as a fault target.
         # Off (the default) this is ONE env read — nothing is built,
         # patched or spawned.
@@ -312,6 +327,8 @@ class ServingEngine:
                                           f"{self.engine_id}"))
         if self._slo is not None:
             self._slo.stop()
+        if self._history is not None:
+            self._history.stop()
         with self._lock:
             self._queue.close()
             if not drain:
@@ -621,6 +638,9 @@ class ServingEngine:
                                   alerts_fn=(self.alerts_snapshot
                                              if self._slo is not None
                                              else None),
+                                  history_fn=(self._history.store
+                                              if self._history is not None
+                                              else None),
                                   port=port, host=host)
             self._expo = srv
             # the binary dispatch listener rides along with the HTTP

@@ -884,6 +884,9 @@ class ServingRouter:
         # start(), probes every seat from outside over wire + HTTP and
         # feeds the per-seat canary-absence page rules
         self._canary = None
+        # history scraper (MXNET_TPU_HISTORY): samples the fleet-merged
+        # exposition into the retrospective store — built in start()
+        self._history = None
         self._exemplars = exemplar_gate()
         self._pick_seq = itertools.count(1)
         # SLO-aware routing weights (MXNET_TPU_ROUTER_WEIGHTS): the
@@ -1125,6 +1128,17 @@ class ServingRouter:
                                         owner_id=self.router_id,
                                         alerts=self._slo)
             self._canary.start()
+        # retrospective history: the router's scraper samples the
+        # fleet-MERGED exposition (this registry + every routable
+        # remote seat), so one /query_range answers for the fleet
+        if envvars.get("MXNET_TPU_HISTORY"):
+            from ..telemetry.history import HistoryScraper
+            self._history = HistoryScraper(
+                self.router_id, text_fn=self.metrics_text,
+                slo_fn=(self.slo_snapshot if self._slo is not None
+                        else None),
+                alerts_fn=(self.alerts_snapshot
+                           if self._slo is not None else None)).start()
         # chaos harness (MXNET_TPU_CHAOS): register as a fault target
         # (kill_router / kill_wire) — one env read when off
         if envvars.get("MXNET_TPU_CHAOS"):
@@ -1183,6 +1197,8 @@ class ServingRouter:
                 self._canary.stop()
             if self._slo is not None:
                 self._slo.stop()
+            if self._history is not None:
+                self._history.stop()
         with self._lock:
             expo, self._expo = self._expo, None
             ha, self._ha = self._ha, None
@@ -2167,6 +2183,8 @@ class ServingRouter:
             self._canary.stop()
         if self._slo is not None:
             self._slo.stop()
+        if self._history is not None:
+            self._history.stop()
         with self._lock:
             seats = list(self._seats.values())
         for seat in seats:
@@ -2533,6 +2551,10 @@ class ServingRouter:
                                   slo_fn=self.slo_snapshot,
                                   alerts_fn=self.alerts_snapshot,
                                   incidents_fn=self.incidents_snapshot,
+                                  history_fn=(
+                                      self._history.store
+                                      if self._history is not None
+                                      else None),
                                   port=port, host=host)
             self._expo = srv
             # active/active HA journal listener: rides the exposition

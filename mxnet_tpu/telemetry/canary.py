@@ -155,6 +155,15 @@ class CanaryProber:
             "mxnet_tpu_canary_billed_tokens_total",
             "valid tokens billed to canary probes",
             ("engine_id", "traffic"))
+        # the routing-weight input, exported: the per-seat ok-probe
+        # latency EMA used to be internal-only, so the signal routing
+        # decisions hinge on could be neither historied nor graphed
+        self._g_lat_ema = reg.gauge(
+            "mxnet_tpu_canary_latency_ema_ms",
+            "per-seat successful-probe latency EMA (the black-box "
+            "hot-spot signal SLO-aware routing weights fold in); 0 "
+            "after a seat replacement resets the EMA",
+            ("engine_id", "traffic"))
         # the exemplar↔retrievable-trace contract is serving-owned;
         # imported lazily here (telemetry must stay importable without
         # serving) and resolved once per prober
@@ -252,8 +261,10 @@ class CanaryProber:
             # reads this as its black-box hot-spot signal
             with self._lock:
                 prev = self._lat_ema.get(eid)
-                self._lat_ema[eid] = (ms if prev is None
-                                      else 0.5 * prev + 0.5 * ms)
+                ema = ms if prev is None else 0.5 * prev + 0.5 * ms
+                self._lat_ema[eid] = ema
+            self._g_lat_ema.labels(engine_id=eid,
+                                   traffic="synthetic").set(ema)
         if outcome in ("ok", "checksum_mismatch"):
             exemplar = (self._slow_exemplar(trace_id, ms,
                                             self._exemplars)
@@ -353,7 +364,11 @@ class CanaryProber:
                 regolden = self._gen.get(eid) is not None
                 self._gen[eid] = token
                 self._goldens.pop(eid, None)
-                self._lat_ema.pop(eid, None)
+                if self._lat_ema.pop(eid, None) is not None:
+                    # children can't be deleted; zero beats a stale
+                    # EMA attributed to the replacement incarnation
+                    self._g_lat_ema.labels(
+                        engine_id=eid, traffic="synthetic").set(0.0)
             prev = self._goldens.get(eid)
             if prev is None:
                 # trust on first use, PER SEAT: this seat's first

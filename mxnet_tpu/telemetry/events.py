@@ -234,10 +234,16 @@ def emit(event, **fields):
         log.write_record(rec)
 
 
-def read_events(path, event=None):
+def read_events(path, event=None, skipped=None):
     """Parse an events JSONL file — including its rotated ``.N``
-    siblings, oldest first — tolerating a torn final line from a live
-    writer; optionally filter by event type."""
+    siblings, oldest first — tolerating torn lines from a live writer
+    or a hard kill; optionally filter by event type.
+
+    A process killed mid-``write`` leaves a truncated final line —
+    possibly cut inside a multi-byte UTF-8 sequence, which a strict
+    decode would raise on MID-POSTMORTEM. Unparseable lines are
+    skipped and counted instead: pass a dict as ``skipped`` to get
+    per-file skip counts back (only files with skips appear)."""
     rotated = []
     for i in range(1, _ROTATE_SCAN_MAX + 1):
         p = f"{path}.{i}"
@@ -248,7 +254,9 @@ def read_events(path, event=None):
         paths.append(str(path))
     out = []
     for p in paths:
-        with open(p) as f:
+        # errors="replace": a line torn inside a multi-byte sequence
+        # must land in the json.loads skip path, not raise on decode
+        with open(p, encoding="utf-8", errors="replace") as f:
             for line in f:
                 line = line.strip()
                 if not line:
@@ -256,6 +264,12 @@ def read_events(path, event=None):
                 try:
                     rec = json.loads(line)
                 except ValueError:
+                    if skipped is not None:
+                        skipped[p] = skipped.get(p, 0) + 1
+                    continue
+                if not isinstance(rec, dict):
+                    if skipped is not None:
+                        skipped[p] = skipped.get(p, 0) + 1
                     continue
                 if event is None or rec.get("event") == event:
                     out.append(rec)

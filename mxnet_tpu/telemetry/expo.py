@@ -42,7 +42,14 @@ dependencies, daemon threads — never blocks process exit):
   (:mod:`.incidents`): open incidents first, each folding the alert
   firings, watchdog trips, scoreboard transitions, restarts and
   flight bundles it correlates. Default: the process tracker; a
-  router attaches ``incidents_fn`` for the fleet merge.
+  router attaches ``incidents_fn`` for the fleet merge;
+- ``/query_range`` — retrospective range queries over the process
+  history store (:mod:`.history`): ``?family=...&start=&end=&step=``
+  with ``fn=value|rate|increase|quantile`` (+ ``q=99`` percentile,
+  ``window=`` trailing seconds, any other param a label matcher) —
+  what ``tools/mxtop.py`` polls;
+- ``/series`` — the history store's series listing (keys, labels,
+  per-tier point counts, covered range).
 
 A server constructed with ``metrics_fn``/``traces_fn``/``trace_fn``
 overrides serves those endpoints from the callables instead of the
@@ -106,6 +113,10 @@ class TelemetryServer:
     incidents_fn : ``() -> dict`` overriding ``/incidents`` (the
         router's fleet-merged incident timeline); None = the process
         incident tracker.
+    history_fn : a :class:`~.history.HistoryStore` (or ``() ->
+        store``) backing ``/query_range`` and ``/series``; None = the
+        process's first live history scraper's store (404 when the
+        history subsystem is off).
     profile_fn : ``() -> str | dict`` overriding ``/profile``; None =
         the process continuous profiler (:mod:`.profiling`) — a str
         serves as collapsed text, a dict as JSON.
@@ -118,7 +129,8 @@ class TelemetryServer:
                  metrics_fn=None, traces_fn=None, trace_fn=None,
                  submit_fn=None, warmup_fn=None, costs_fn=None,
                  profile_fn=None, slo_fn=None, alerts_fn=None,
-                 incidents_fn=None, port=0, host="127.0.0.1"):
+                 incidents_fn=None, history_fn=None, port=0,
+                 host="127.0.0.1"):
         self.registry = registry if registry is not None else REGISTRY
         self.healthz_fn = healthz_fn
         self.stats_fn = stats_fn
@@ -132,6 +144,7 @@ class TelemetryServer:
         self.slo_fn = slo_fn
         self.alerts_fn = alerts_fn
         self.incidents_fn = incidents_fn
+        self.history_fn = history_fn
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -277,11 +290,71 @@ class TelemetryServer:
             # server answers the on-call question, not just routers
             from . import incidents as _incidents
             self._json_fn(handler, _incidents.snapshot, "")
+        elif path == "/series":
+            store = self._history_store()
+            self._json_fn(handler,
+                          store.series if store is not None else None,
+                          "no history store")
+        elif path == "/query_range":
+            self._query_range(handler, query)
         else:
             self._reply(handler, 404, "text/plain",
                         b"try /metrics, /healthz, /stats, /traces, "
-                        b"/profile, /costs, /slo, /alerts, /incidents "
-                        b"or /warmup\n")
+                        b"/profile, /costs, /slo, /alerts, /incidents, "
+                        b"/query_range, /series or /warmup\n")
+
+    def _history_store(self):
+        """Resolve the ``/query_range``/``/series`` backing store:
+        the attached one (store or callable), else the process's
+        first live history scraper (mirrors ``/incidents``'s
+        process-default)."""
+        store = self.history_fn
+        if callable(store):
+            store = store()
+        if store is None:
+            from . import history as _history
+            store = _history.default_store()
+        return store
+
+    def _query_range(self, handler, query):
+        """``/query_range?family=...&start=&end=&step=&fn=rate&q=99&
+        window=&<label>=<value>`` — range evaluation over the history
+        store. Unknown params are label matchers, so tenant/engine
+        slicing needs no special syntax."""
+        store = self._history_store()
+        if store is None:
+            self._reply(handler, 404, "application/json",
+                        json.dumps({"error": "no history store"})
+                        .encode())
+            return
+        from urllib.parse import parse_qs
+        params = {k: v[-1] for k, v in parse_qs(query).items()}
+        name = params.pop("family", None) or params.pop("name", None)
+        if not name:
+            self._reply(handler, 400, "application/json",
+                        json.dumps({"error": "family= is required"})
+                        .encode())
+            return
+        try:
+            kw = {}
+            for key in ("start", "end", "step", "window", "q"):
+                if key in params:
+                    kw[key] = float(params.pop(key))
+            kw["fn"] = params.pop("fn", "value")
+            if kw["fn"] not in ("value", "rate", "increase",
+                                "quantile"):
+                raise ValueError(f"unknown fn {kw['fn']!r}")
+            body = store.query_range(name, match=params, **kw)
+        except ValueError as e:
+            self._reply(handler, 400, "application/json",
+                        json.dumps({"error": str(e)}).encode())
+            return
+        except Exception as e:
+            self._reply(handler, 500, "application/json",
+                        json.dumps({"error": repr(e)}).encode())
+            return
+        self._reply(handler, 200, "application/json",
+                    json.dumps(body).encode())
 
     def _json_fn(self, handler, fn, missing):
         """Serve an optional JSON endpoint off a callable: 404 when

@@ -1,0 +1,822 @@
+"""Retrospective fleet history: a bounded on-disk time-series store.
+
+Every other observability surface answers *what is happening now*:
+``/metrics`` is an instantaneous scrape, the SLO evaluator's
+:class:`~.slo.SampleStore` is an in-memory window that dies with the
+process, and flight bundles snapshot the moment of failure. The first
+postmortem question — *what did the fleet look like for the ten
+minutes before the page?* — needs history, so this module keeps one:
+
+- :class:`HistoryStore` — a stdlib-only time-series store: one sorted
+  ``(t, value)`` list per series key (``family{labels}``), kept in
+  three downsampling tiers (``raw`` → ``10s`` → ``60s``, each bucket
+  keeping the LAST cumulative sample so windowed rates stay exact),
+  bounded by per-tier retention and a per-series point cap. With
+  ``MXNET_TPU_HISTORY_DIR`` set, every tier also appends to
+  crash-safe segment files — plain JSONL, one chain per family and
+  tier, rotated by size (``MXNET_TPU_HISTORY_SEGMENT_MB``), swept by
+  retention and the ``MXNET_TPU_HISTORY_MAX_MB`` budget, and reloaded
+  on the next construction (a torn final line from a hard kill is
+  skipped and counted, never raised);
+- :class:`HistoryScraper` — the feeding daemon: engines sample the
+  process registry, routers their fleet-merged exposition, every
+  ``MXNET_TPU_HISTORY_SCRAPE_S`` seconds, keeping the families named
+  by the :data:`DEFAULT_RULES` recording rules (mxlint cross-checks
+  those names against declared families, like dashboards);
+- range queries — :meth:`HistoryStore.query_range` evaluates
+  ``value`` / ``rate()`` / ``increase()`` / quantile-over-time on the
+  stored series over a start/end/step grid; ``expo.TelemetryServer``
+  serves it at ``/query_range`` (and the key listing at ``/series``);
+- incident forensics — when the :mod:`.incidents` tracker opens an
+  incident it calls :func:`on_incident_open`; every live scraper
+  freezes its preceding window (series + the owner's SLO objective
+  table and alert-rule describes) and the flight bundle's
+  ``history_<owner>.json`` section carries the frozen windows —
+  exactly what :func:`~.slo.replay_history` re-judges after the fact.
+
+``MXNET_TPU_HISTORY=0`` disables the subsystem: no store, no thread,
+no endpoints. Timestamps are wall-clock (``time.time()``): history
+outlives processes and is merged across machines, so wall ordering —
+the events log's convention — is the honest axis.
+"""
+from __future__ import annotations
+
+import bisect
+import json
+import os
+import re
+import threading
+import time
+from collections import deque
+
+from .. import envvars
+from . import events as _events
+from . import recorder as _recorder
+from .expo import histogram_quantile, parse_labels, parse_prometheus_text
+from .registry import REGISTRY
+
+__all__ = ["RecordingRule", "DEFAULT_RULES", "HistoryStore",
+           "HistoryScraper", "default_store", "scrapers",
+           "on_incident_open"]
+
+#: tier spec: (label, bucket resolution seconds; 0 = raw)
+_TIER_RES = (("raw", 0.0), ("10s", 10.0), ("60s", 60.0))
+
+#: per-series point cap per tier (older half coarsened past it, like
+#: the SLO SampleStore — range queries need anchors, not every tick)
+_MAX_POINTS = 4096
+
+_SUFFIX = re.compile(r"_(bucket|sum|count)$")
+
+
+def family_of(key):
+    """Series key → base family name (histogram suffixes stripped)."""
+    return _SUFFIX.sub("", key.split("{", 1)[0])
+
+
+class RecordingRule:
+    """One named recording rule in the history config: capture this
+    family into the store, evaluated later as ``kind`` (``counter``
+    families answer rate/increase, ``gauge`` value-over-time,
+    ``histogram`` quantile-over-time). mxlint's telemetry-consistency
+    pass cross-checks every rule's family against the declared
+    families — a rule over a renamed family would record nothing and
+    every retro query over it would come back empty."""
+
+    __slots__ = ("name", "family", "kind")
+
+    def __init__(self, name, family, kind="counter"):
+        if kind not in ("counter", "gauge", "histogram"):
+            raise ValueError(f"unknown recording-rule kind {kind!r}")
+        self.name = str(name)
+        self.family = str(family)
+        self.kind = kind
+
+    def row(self):
+        return {"name": self.name, "family": self.family,
+                "kind": self.kind}
+
+
+#: the default history config: the headline families a postmortem (or
+#: mxtop) asks about. Kept deliberately curated — history is bounded,
+#: and every family here is one mxlint cross-checks against the
+#: declared set.
+DEFAULT_RULES = (
+    RecordingRule("serving_requests",
+                  family="mxnet_tpu_serving_requests_total"),
+    RecordingRule("serving_latency",
+                  family="mxnet_tpu_serving_latency_ms",
+                  kind="histogram"),
+    RecordingRule("inter_token_latency",
+                  family="mxnet_tpu_serving_inter_token_latency_ms",
+                  kind="histogram"),
+    RecordingRule("decode_tokens",
+                  family="mxnet_tpu_serving_decode_tokens_total"),
+    RecordingRule("cost_seconds",
+                  family="mxnet_tpu_serving_cost_seconds_total"),
+    RecordingRule("cost_tokens",
+                  family="mxnet_tpu_serving_cost_tokens_total"),
+    RecordingRule("queue_depth",
+                  family="mxnet_tpu_serving_queue_depth", kind="gauge"),
+    RecordingRule("kv_pages",
+                  family="mxnet_tpu_serving_kv_pages", kind="gauge"),
+    RecordingRule("tenant_requests",
+                  family="mxnet_tpu_serving_tenant_requests_total"),
+    RecordingRule("tenant_latency",
+                  family="mxnet_tpu_serving_tenant_latency_ms",
+                  kind="histogram"),
+    RecordingRule("tenant_cost_seconds",
+                  family="mxnet_tpu_serving_tenant_cost_seconds_total"),
+    RecordingRule("tenant_tokens",
+                  family="mxnet_tpu_serving_tenant_tokens_total"),
+    RecordingRule("router_requests",
+                  family="mxnet_tpu_router_requests_total"),
+    RecordingRule("router_latency",
+                  family="mxnet_tpu_router_latency_ms",
+                  kind="histogram"),
+    RecordingRule("router_engine_up",
+                  family="mxnet_tpu_router_engine_up", kind="gauge"),
+    RecordingRule("canary_requests",
+                  family="mxnet_tpu_canary_requests_total"),
+    RecordingRule("canary_latency_ema",
+                  family="mxnet_tpu_canary_latency_ema_ms",
+                  kind="gauge"),
+    RecordingRule("autoscaler_seats",
+                  family="mxnet_tpu_autoscaler_seats", kind="gauge"),
+    RecordingRule("autoscaler_model_seats",
+                  family="mxnet_tpu_autoscaler_model_seats",
+                  kind="gauge"),
+    RecordingRule("alerts_firing",
+                  family="mxnet_tpu_alerts_firing", kind="gauge"),
+    RecordingRule("slo_burn_rate",
+                  family="mxnet_tpu_slo_burn_rate", kind="gauge"),
+    RecordingRule("slo_error_budget",
+                  family="mxnet_tpu_slo_error_budget_remaining",
+                  kind="gauge"),
+    RecordingRule("incidents_open",
+                  family="mxnet_tpu_incidents_open", kind="gauge"),
+)
+
+
+class _Tier:
+    """One downsampling tier: ``{key: [(t, v), ...]}`` bounded by
+    retention + a per-series point cap. ``resolution_s > 0`` buckets
+    incoming samples on absolute boundaries and keeps each bucket's
+    LAST sample (cumulative counters diff exactly across bucket
+    edges; gauges keep their freshest reading)."""
+
+    __slots__ = ("label", "resolution_s", "retain_s", "series",
+                 "pending")
+
+    def __init__(self, label, resolution_s, retain_s):
+        self.label = label
+        self.resolution_s = float(resolution_s)
+        self.retain_s = float(retain_s)
+        self.series = {}        # key -> [(t, v), ...] sorted by t
+        self.pending = {}       # key -> [bucket_idx, t, v]
+
+    def add(self, key, t, v):
+        """Feed one sample; returns the (t, v) flushed into this tier
+        (None while the sample stays pending inside its bucket)."""
+        if self.resolution_s <= 0:
+            self._store(key, t, v)
+            return (t, v)
+        idx = int(t // self.resolution_s)
+        pend = self.pending.get(key)
+        out = None
+        if pend is not None and pend[0] != idx:
+            # bucket closed: flush its last sample at the bucket edge
+            out = ((pend[0] + 1) * self.resolution_s, pend[2])
+            self._store(key, out[0], out[1])
+        self.pending[key] = [idx, t, v]
+        return out
+
+    def _store(self, key, t, v):
+        arr = self.series.get(key)
+        if arr is None:
+            arr = self.series.setdefault(key, [])
+        arr.append((t, v))
+        horizon = t - self.retain_s
+        if len(arr) > 2 and arr[1][0] < horizon:
+            # keep ONE pre-horizon anchor so a full-width window can
+            # still diff against something
+            i = bisect.bisect_left(arr, (horizon, -1e308)) - 1
+            if i > 0:
+                del arr[:i]
+        if len(arr) > _MAX_POINTS:
+            half = len(arr) // 2
+            arr[:half] = arr[0:half:2]
+
+
+class HistoryStore:
+    """Bounded multi-tier time-series store, optionally disk-backed.
+
+    Parameters
+    ----------
+    dirpath : persist segments under this directory (default
+        ``MXNET_TPU_HISTORY_DIR``); None keeps the store memory-only
+        with the same bounds. Existing segments are reloaded (torn
+        final lines skipped and counted in ``load_skipped``).
+    retain_s : retention of the coarsest (60 s) tier (default
+        ``MXNET_TPU_HISTORY_RETAIN_S``); the raw and 10 s tiers
+        retain ``min(retain_s, 900)`` / ``min(retain_s, 10800)``.
+    max_mb / segment_mb : on-disk budget and segment rotation size
+        (``MXNET_TPU_HISTORY_MAX_MB`` / ``_SEGMENT_MB``).
+    """
+
+    def __init__(self, dirpath=None, retain_s=None, max_mb=None,
+                 segment_mb=None, now=None):
+        self.dir = (dirpath if dirpath is not None
+                    else envvars.get("MXNET_TPU_HISTORY_DIR"))
+        retain = (float(retain_s) if retain_s is not None
+                  else envvars.get("MXNET_TPU_HISTORY_RETAIN_S"))
+        self.retain_s = max(1.0, retain)
+        self.max_bytes = (float(max_mb) if max_mb is not None
+                          else envvars.get("MXNET_TPU_HISTORY_MAX_MB")
+                          ) * 1024 * 1024
+        self.segment_bytes = max(
+            4096.0,
+            (float(segment_mb) if segment_mb is not None
+             else envvars.get("MXNET_TPU_HISTORY_SEGMENT_MB"))
+            * 1024 * 1024)
+        self._lock = threading.Lock()
+        self.tiers = tuple(
+            _Tier(label, res, self._tier_retain(label))
+            for label, res in _TIER_RES)
+        self._files = {}        # (family, tier) -> [fh, path, size]
+        self._seq = {}          # (family, tier) -> next segment seq
+        self.load_skipped = 0
+        self.appended = 0
+        if self.dir:
+            os.makedirs(self.dir, exist_ok=True)
+            self._load(time.time() if now is None else now)
+
+    def _tier_retain(self, label):
+        if label == "raw":
+            return min(self.retain_s, 900.0)
+        if label == "10s":
+            return min(self.retain_s, 10800.0)
+        return self.retain_s
+
+    # -- write path ---------------------------------------------------------
+    def append(self, t, samples):
+        """Record one scrape: ``samples`` is ``{series_key: float}``
+        (the shape :func:`~.expo.parse_prometheus_text` returns)."""
+        t = float(t)
+        with self._lock:
+            rotated = False
+            for tier in self.tiers:
+                flushed = {}    # family -> {key: (t, v)}
+                for key, v in samples.items():
+                    out = tier.add(key, t, float(v))
+                    if out is not None:
+                        flushed.setdefault(family_of(key), {})[key] = out
+                if self.dir:
+                    for fam, entries in sorted(flushed.items()):
+                        # one line per (family, flush time): within a
+                        # tier every series flushed by THIS scrape
+                        # shares the bucket edge (absolute alignment)
+                        by_t = {}
+                        for key, (ft, fv) in entries.items():
+                            by_t.setdefault(ft, {})[key] = fv
+                        for ft in sorted(by_t):
+                            rotated |= self._write(fam, tier.label,
+                                                   ft, by_t[ft])
+            self.appended += 1
+            if rotated:
+                self._enforce_disk(t)
+
+    def _write(self, family, tier, t, keyvals):
+        rec = {"t": round(t, 3),
+               "s": {k: v for k, v in sorted(keyvals.items())}}
+        line = json.dumps(rec, separators=(",", ":")) + "\n"
+        slot = self._files.get((family, tier))
+        if slot is None:
+            slot = self._open_segment(family, tier)
+        fh, path, size = slot
+        try:
+            fh.write(line)
+            fh.flush()
+        except OSError:
+            return False        # disk trouble must not stop sampling
+        slot[2] = size + len(line)
+        if slot[2] >= self.segment_bytes:
+            try:
+                fh.close()
+            except OSError:
+                pass
+            del self._files[(family, tier)]
+            return True
+        return False
+
+    def _fam_dir(self, family):
+        return os.path.join(self.dir, family)
+
+    def _open_segment(self, family, tier):
+        d = self._fam_dir(family)
+        os.makedirs(d, exist_ok=True)
+        seq = self._seq.get((family, tier))
+        if seq is None:
+            seq = 1 + max(
+                [self._seg_seq(p) for p in os.listdir(d)
+                 if p.startswith(f"{tier}-")] or [0])
+        self._seq[(family, tier)] = seq + 1
+        path = os.path.join(d, f"{tier}-{seq:08d}.seg")
+        fh = open(path, "a", encoding="utf-8")
+        slot = [fh, path, 0]
+        self._files[(family, tier)] = slot
+        return slot
+
+    @staticmethod
+    def _seg_seq(name):
+        m = re.match(r"[a-z0-9]+-(\d+)\.seg$", name)
+        return int(m.group(1)) if m else 0
+
+    def _segments(self):
+        """Every segment file on disk: ``[(mtime, size, path,
+        tier_label), ...]``."""
+        out = []
+        try:
+            fams = os.listdir(self.dir)
+        except OSError:
+            return out
+        for fam in fams:
+            d = self._fam_dir(fam)
+            if not os.path.isdir(d):
+                continue
+            for name in os.listdir(d):
+                if not name.endswith(".seg"):
+                    continue
+                path = os.path.join(d, name)
+                try:
+                    st = os.stat(path)
+                except OSError:
+                    continue
+                out.append((st.st_mtime, st.st_size, path,
+                            name.split("-", 1)[0]))
+        return out
+
+    def _enforce_disk(self, now):
+        """Retention + budget sweep over SEALED segments (the active
+        handles keep writing; a fresh segment is never deleted)."""
+        active = {slot[1] for slot in self._files.values()}
+        retain = {t.label: t.retain_s for t in self.tiers}
+        segs = [s for s in self._segments() if s[2] not in active]
+        kept = []
+        for mtime, size, path, tier in segs:
+            if now - mtime > retain.get(tier, self.retain_s):
+                self._unlink(path)
+            else:
+                kept.append((mtime, size, path, tier))
+        total = sum(s[1] for s in kept) \
+            + sum(slot[2] for slot in self._files.values())
+        # over budget: drop the oldest sealed segments first (raw
+        # rotates fastest, so the finest tier naturally goes first)
+        for mtime, size, path, tier in sorted(kept):
+            if total <= self.max_bytes:
+                break
+            self._unlink(path)
+            total -= size
+
+    @staticmethod
+    def _unlink(path):
+        try:
+            os.unlink(path)
+            d = os.path.dirname(path)
+            if not os.listdir(d):
+                os.rmdir(d)
+        except OSError:
+            pass
+
+    def _load(self, now):
+        """Reload persisted segments into the memory tiers (crash
+        recovery: a torn final line after a hard kill is skipped and
+        counted, never raised — the postmortem reads on)."""
+        tiers = {t.label: t for t in self.tiers}
+        for fam in sorted(os.listdir(self.dir)):
+            d = self._fam_dir(fam)
+            if not os.path.isdir(d):
+                continue
+            by_tier = {}
+            for name in sorted(os.listdir(d)):
+                if name.endswith(".seg"):
+                    by_tier.setdefault(name.split("-", 1)[0],
+                                       []).append(name)
+            for label, names in by_tier.items():
+                tier = tiers.get(label)
+                if tier is None:
+                    continue
+                self._seq[(fam, label)] = 1 + max(
+                    self._seg_seq(n) for n in names)
+                for name in sorted(names, key=self._seg_seq):
+                    self._load_segment(tier, os.path.join(d, name), now)
+        for tier in self.tiers:
+            for arr in tier.series.values():
+                arr.sort()
+
+    def _load_segment(self, tier, path, now):
+        try:
+            fh = open(path, encoding="utf-8", errors="replace")
+        except OSError:
+            return
+        with fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                    t = float(rec["t"])
+                    samples = rec["s"]
+                except (ValueError, KeyError, TypeError):
+                    self.load_skipped += 1   # torn/corrupt: count, go on
+                    continue
+                if now - t > tier.retain_s:
+                    continue
+                for key, v in samples.items():
+                    try:
+                        tier._store(key, t, float(v))
+                    except (TypeError, ValueError):
+                        self.load_skipped += 1
+
+    def close(self):
+        """Seal the active segments (flush + close). The store stays
+        queryable from memory."""
+        with self._lock:
+            for slot in self._files.values():
+                try:
+                    slot[0].close()
+                except OSError:
+                    pass
+            self._files.clear()
+
+    # -- read path ----------------------------------------------------------
+    def keys(self):
+        with self._lock:
+            out = set()
+            for tier in self.tiers:
+                out.update(tier.series)
+            return sorted(out)
+
+    def _combined_locked(self, key):
+        """One stitched series per key: coarse history where only the
+        coarse tiers still cover, the finest available after that."""
+        raw = self.tiers[0].series.get(key, [])
+        t10 = self.tiers[1].series.get(key, [])
+        t60 = self.tiers[2].series.get(key, [])
+        raw_start = raw[0][0] if raw else float("inf")
+        t10_start = t10[0][0] if t10 else float("inf")
+        out = [p for p in t60 if p[0] < min(t10_start, raw_start)]
+        out += [p for p in t10 if p[0] < raw_start]
+        out += raw
+        return out
+
+    def points(self, key, start=None, end=None):
+        with self._lock:
+            arr = self._combined_locked(key)
+        if start is not None:
+            i = bisect.bisect_left(arr, (float(start), -1e308))
+            # keep one anchor before the range for rate windows
+            arr = arr[max(0, i - 1):]
+        if end is not None:
+            arr = arr[:bisect.bisect_right(arr, (float(end), 1e308))]
+        return arr
+
+    def series(self):
+        """The ``/series`` body: every stored key with its parsed
+        labels, tier point counts and covered time range."""
+        with self._lock:
+            keys = set()
+            for tier in self.tiers:
+                keys.update(tier.series)
+            rows = []
+            for key in sorted(keys):
+                name, labels = parse_labels(key)
+                comb = self._combined_locked(key)
+                rows.append({
+                    "key": key, "name": name,
+                    "family": family_of(key), "labels": labels,
+                    "tiers": {t.label: len(t.series.get(key, ()))
+                              for t in self.tiers},
+                    "first_t": round(comb[0][0], 3) if comb else None,
+                    "last_t": round(comb[-1][0], 3) if comb else None,
+                    "points": len(comb)})
+        return {"series": rows, "count": len(rows),
+                "appended": self.appended,
+                "load_skipped": self.load_skipped,
+                "dir": self.dir,
+                "retain_s": self.retain_s}
+
+    # -- range evaluation ---------------------------------------------------
+    @staticmethod
+    def _value_at(arr, t, staleness):
+        i = bisect.bisect_right(arr, (t, 1e308)) - 1
+        if i < 0:
+            return None
+        pt, pv = arr[i]
+        if t - pt > staleness:
+            return None
+        return pv
+
+    @staticmethod
+    def _increase(arr, t, window):
+        """Counter increase over ``(t - window, t]``: sum of positive
+        deltas, counter resets (a restarted process) re-anchored at
+        zero — partial coverage uses the oldest in-window anchor."""
+        i1 = bisect.bisect_right(arr, (t, 1e308)) - 1
+        if i1 < 1:
+            return None, 0.0
+        cut = t - window
+        i0 = bisect.bisect_right(arr, (cut, 1e308)) - 1
+        if i0 < 0:
+            i0 = 0
+        if i1 <= i0:
+            return None, 0.0
+        acc = 0.0
+        prev = arr[i0][1]
+        for j in range(i0 + 1, i1 + 1):
+            v = arr[j][1]
+            acc += (v - prev) if v >= prev else v
+            prev = v
+        span = arr[i1][0] - arr[i0][0]
+        return acc, span
+
+    def query_range(self, name, start=None, end=None, step=None,
+                    fn="value", q=None, window=None, match=None,
+                    now=None, max_points=2001):
+        """Evaluate one family over a time grid.
+
+        ``fn``: ``value`` (step-function sample), ``rate`` /
+        ``increase`` (reset-aware, over ``window`` trailing seconds,
+        default = ``step``), or ``quantile`` (quantile-over-time on a
+        histogram's ``_bucket`` series: windowed increase per bucket,
+        then the PromQL interpolation; ``q`` is the percentile,
+        e.g. 99). Returns the grid and one row per matching series
+        (``null`` where a point can't be evaluated)."""
+        now = time.time() if now is None else float(now)
+        end = now if end is None else float(end)
+        start = end - 300.0 if start is None else float(start)
+        if end < start:
+            start, end = end, start
+        step = float(step) if step else max(1.0, (end - start) / 240.0)
+        step = max(1e-3, step)
+        n = int((end - start) / step) + 1
+        if n > max_points:
+            step = (end - start) / (max_points - 1)
+            n = max_points
+        grid = [start + i * step for i in range(n)]
+        w = float(window) if window else max(step, 1e-3)
+        match = match or {}
+        name = str(name)
+        want = name + "_bucket" if fn == "quantile" else name
+        rows = []
+        with self._lock:
+            keys = set()
+            for tier in self.tiers:
+                keys.update(tier.series)
+            selected = {}
+            for key in sorted(keys):
+                kname, labels = parse_labels(key)
+                if kname != want:
+                    continue
+                if any(labels.get(k) != str(v)
+                       for k, v in match.items() if k != "le"):
+                    continue
+                selected[key] = (labels,
+                                 self._combined_locked(key))
+        if fn == "quantile":
+            rows = self._quantile_rows(name, selected, grid, w, q)
+        else:
+            staleness = max(2.0 * step, w, 60.0)
+            for key, (labels, arr) in selected.items():
+                pts = []
+                for t in grid:
+                    if fn == "value":
+                        v = self._value_at(arr, t, staleness)
+                    else:
+                        inc, span = self._increase(arr, t, w)
+                        if inc is None:
+                            v = None
+                        elif fn == "rate":
+                            v = inc / span if span > 0 else None
+                        else:
+                            v = inc
+                    pts.append([round(t, 3),
+                                None if v is None else round(v, 6)])
+                rows.append({"key": key, "labels": labels,
+                             "points": pts})
+        return {"name": name, "fn": fn, "q": q,
+                "start": round(start, 3), "end": round(end, 3),
+                "step": round(step, 3), "window_s": round(w, 3),
+                "series": rows}
+
+    def _quantile_rows(self, name, selected, grid, w, q):
+        """Quantile-over-time: group bucket series by their non-``le``
+        labels; per grid point take each bucket's windowed increase
+        and interpolate the quantile over the resulting (cumulative)
+        bucket counts."""
+        q = 99.0 if q is None else float(q)
+        groups = {}
+        for key, (labels, arr) in selected.items():
+            if "le" not in labels:
+                continue
+            gkey = tuple(sorted((k, v) for k, v in labels.items()
+                                if k != "le"))
+            groups.setdefault(gkey, []).append((labels["le"], arr))
+        rows = []
+        for gkey, buckets in sorted(groups.items()):
+            pts = []
+            for t in grid:
+                parsed = {}
+                for le, arr in buckets:
+                    inc, _ = self._increase(arr, t, w)
+                    if inc is not None:
+                        parsed[f'{name}_bucket{{le="{le}"}}'] = inc
+                v = histogram_quantile(parsed, name, q) \
+                    if parsed else None
+                pts.append([round(t, 3),
+                            None if v is None else round(v, 6)])
+            rows.append({"key": f"{name}_bucket", "labels": dict(gkey),
+                         "points": pts})
+        return rows
+
+    def forensics(self, window_s=None, end=None):
+        """Freeze the trailing window: ``{key: [[t, v], ...]}`` for
+        every stored series — the raw material
+        :func:`~.slo.replay_history` re-judges. Bounded by the raw
+        tier's retention (coarser tiers fill in where raw has aged
+        out)."""
+        end = time.time() if end is None else float(end)
+        window_s = (float(window_s) if window_s is not None
+                    else self.tiers[0].retain_s)
+        start = end - window_s
+        series = {}
+        for key in self.keys():
+            pts = self.points(key, start=start, end=end)
+            if pts:
+                series[key] = [[round(t, 3), v] for t, v in pts]
+        return {"start": round(start, 3), "end": round(end, 3),
+                "window_s": round(window_s, 3), "series": series}
+
+
+# -- the feeding daemon ------------------------------------------------------
+
+_REG_LOCK = threading.Lock()
+_SCRAPERS = []
+
+
+def scrapers():
+    """The live scrapers in this process (engine + router each run
+    one; the incident hook freezes them all)."""
+    with _REG_LOCK:
+        return list(_SCRAPERS)
+
+
+def default_store():
+    """The first live scraper's store — what an exposition server
+    without an explicit ``history_fn`` serves (None = 404)."""
+    with _REG_LOCK:
+        return _SCRAPERS[0].store if _SCRAPERS else None
+
+
+def on_incident_open(incident_id):
+    """Incident-path hook (called by :class:`~.incidents.
+    IncidentTracker` the moment an incident opens): every live
+    scraper freezes its PRECEDING window now, so the flight bundle —
+    written later, after the failure developed — still carries what
+    the fleet looked like before."""
+    for s in scrapers():
+        try:
+            s.freeze(incident_id)
+        except Exception:
+            pass                # forensics must not hurt the tracker
+
+
+class HistoryScraper:
+    """Samples an exposition into a :class:`HistoryStore` on a daemon
+    thread. Engines pass nothing (the process registry is sampled);
+    routers pass ``text_fn=self.metrics_text`` so history records the
+    fleet-MERGED view. ``slo_fn``/``alerts_fn`` (the owner's snapshot
+    callables) ride into every freeze so retro replay has the
+    objective table and rule describes next to the series."""
+
+    def __init__(self, owner_id, store=None, registry=None,
+                 text_fn=None, interval_s=None, rules=None,
+                 extra_families=(), slo_fn=None, alerts_fn=None,
+                 freeze_window_s=None):
+        self.owner_id = str(owner_id)
+        self.store = store if store is not None else HistoryStore()
+        self._registry = registry if registry is not None else REGISTRY
+        self._text_fn = text_fn
+        self.interval_s = (float(interval_s) if interval_s is not None
+                           else envvars.get("MXNET_TPU_HISTORY_SCRAPE_S"))
+        self.rules = tuple(rules if rules is not None else DEFAULT_RULES)
+        self._families = {r.family for r in self.rules}
+        self._families.update(str(f) for f in extra_families)
+        self._slo_fn = slo_fn
+        self._alerts_fn = alerts_fn
+        self._freeze_window_s = freeze_window_s
+        self._freezes = deque(maxlen=4)   # (incident_id, forensics)
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = None
+        self._section = f"history_{self.owner_id}"
+        self.scrapes = 0
+        self.errors = 0
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self):
+        with self._lock:
+            if self._thread is not None:
+                return self
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, daemon=True,
+                name=f"mxnet_tpu_history_{self.owner_id}")
+            self._thread.start()
+        with _REG_LOCK:
+            if self not in _SCRAPERS:
+                _SCRAPERS.append(self)
+        _recorder.add_bundle_section(self._section, self.bundle_section)
+        return self
+
+    def stop(self):
+        with self._lock:
+            self._stop.set()
+            t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5.0)
+        _recorder.remove_bundle_section(self._section)
+        with _REG_LOCK:
+            if self in _SCRAPERS:
+                _SCRAPERS.remove(self)
+        self.store.close()
+
+    def _run(self):
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.scrape_once()
+            except Exception as e:
+                self.errors += 1
+                _events.emit("history_scrape_error",
+                             owner=self.owner_id, error=repr(e))
+
+    # -- sampling -----------------------------------------------------------
+    def _keep(self, key):
+        return family_of(key) in self._families
+
+    def scrape_once(self, now=None):
+        """One sample: render (or fetch) the exposition, keep the
+        configured families, append. Returns the kept series count."""
+        text = (self._text_fn() if self._text_fn is not None
+                else self._registry.render_prometheus())
+        parsed = parse_prometheus_text(text)
+        kept = {k: v for k, v in parsed.items() if self._keep(k)}
+        self.store.append(time.time() if now is None else float(now),
+                          kept)
+        self.scrapes += 1
+        return len(kept)
+
+    # -- forensics ----------------------------------------------------------
+    def forensics(self, window_s=None):
+        """The freeze payload: the trailing series window plus the
+        owner's live objective table and alert-rule describes (what
+        retro replay needs to re-judge the page)."""
+        out = self.store.forensics(
+            window_s=(window_s if window_s is not None
+                      else self._freeze_window_s))
+        out.update(owner=self.owner_id,
+                   interval_s=self.interval_s,
+                   rules=[r.row() for r in self.rules])
+        for label, fn in (("objectives", self._slo_fn),
+                          ("alerts", self._alerts_fn)):
+            if fn is None:
+                continue
+            try:
+                out[label] = fn()
+            except Exception as e:
+                out[label] = {"error": repr(e)}
+        return out
+
+    def freeze(self, incident_id=None):
+        """Capture the preceding window NOW (incident open). Kept in a
+        small ring; the flight bundle's ``history_<owner>.json``
+        section carries it."""
+        snap = self.forensics()
+        with self._lock:
+            self._freezes.append(
+                {"incident_id": incident_id, **snap})
+        _events.emit("history_freeze", owner=self.owner_id,
+                     incident_id=incident_id,
+                     series=len(snap.get("series") or ()))
+        return snap
+
+    def bundle_section(self):
+        """Flight-bundle section: the frozen pre-incident windows
+        (or, when nothing froze — e.g. a watchdog bundle with no
+        incident — the live trailing window)."""
+        with self._lock:
+            frozen = list(self._freezes)
+        if not frozen:
+            frozen = [{"incident_id": None, **self.forensics()}]
+        return {"owner": self.owner_id, "freezes": frozen}

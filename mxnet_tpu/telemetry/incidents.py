@@ -252,6 +252,15 @@ class IncidentTracker:
         if opened:
             _events.emit("incident_open", incident_id=inc_id,
                          first_signal=kind)
+            # forensics: freeze the PRECEDING history window NOW, so
+            # the flight bundle written later — after the failure
+            # developed — still shows what the fleet looked like
+            # before the first signal
+            try:
+                from . import history as _history
+                _history.on_incident_open(inc_id)
+            except Exception:
+                pass            # history must never hurt the tracker
 
     def _classify(self, event, rec):
         """(kind, summary, opens) for one signal event — None kind

@@ -49,7 +49,7 @@ from .registry import REGISTRY
 __all__ = ["SampleStore", "SLO", "RatioSLO", "LatencySLO",
            "AvailabilitySLO", "ThresholdSLO", "CostSLO", "GaugeSLO",
            "SloEvaluator", "BURN_WINDOWS", "window_scale",
-           "max_short_burn"]
+           "max_short_burn", "replay_history"]
 
 
 def max_short_burn(snapshot, window="5m"):
@@ -331,7 +331,8 @@ class AvailabilitySLO(RatioSLO):
     def describe(self):
         return dict(super().describe(), family=self.family,
                     match=self.match, good_events=list(self.good_events),
-                    bad_events=list(self.bad_events))
+                    bad_events=list(self.bad_events),
+                    event_label=self.event_label)
 
 
 class ThresholdSLO(SLO):
@@ -618,3 +619,338 @@ class SloEvaluator:
                 "uptime_s": round(now - self._start_mono, 3),
                 "objectives": {slo.name: self.evaluate(slo, now)
                                for slo in objectives}}
+
+
+# -- retro replay ------------------------------------------------------------
+#
+# A page is a claim: "the budget was burning 14.4× too fast over both
+# windows". Retro replay AUDITS the claim after the fact: a frozen
+# history window (the forensics section :mod:`.history` puts in every
+# incident's flight bundle) is mounted as a read-only registry whose
+# clock can be set, the objectives and alert rules are reconstructed
+# from their own describe() rows, and the whole SLO pipeline re-runs
+# over the stored samples — if the live decision doesn't reproduce
+# from the persisted evidence, either the evidence or the alerting is
+# broken, and both are worth a postmortem of their own.
+
+
+class _ReplayChild:
+    """One labeled series mounted as a counter/gauge child: ``.value``
+    is the stored step-function value at the registry's current
+    replay time (0 before the first sample — a cumulative counter
+    that didn't exist yet had counted nothing)."""
+
+    def __init__(self, reg, points):
+        self._reg = reg
+        self._points = points       # sorted [(t, v), ...]
+
+    @property
+    def value(self):
+        i = bisect.bisect_right(self._points,
+                                (self._reg.now, 1e308)) - 1
+        return self._points[i][1] if i >= 0 else 0.0
+
+
+class _ReplayHistChild:
+    """One label-set's bucket series mounted as a histogram child:
+    mirrors the live ``Histogram._Child`` read API
+    (``cumulative()``/``count``) at the replay clock."""
+
+    def __init__(self, reg, bucket_points):
+        # bucket_points: [(le_float, sorted points)] ascending,
+        # +Inf LAST (the live cumulative() contract)
+        self._reg = reg
+        self._buckets = bucket_points
+
+    def _at(self, points):
+        i = bisect.bisect_right(points, (self._reg.now, 1e308)) - 1
+        return points[i][1] if i >= 0 else 0.0
+
+    def cumulative(self):
+        vals = [self._at(p) for _, p in self._buckets]
+        # stored scrapes can land mid-update; re-impose monotonicity
+        # so threshold reads never see cum[i] > cum[i+1]
+        for i in range(1, len(vals)):
+            vals[i] = max(vals[i], vals[i - 1])
+        return vals
+
+    @property
+    def count(self):
+        return self.cumulative()[-1]
+
+    def exemplars(self):
+        return {}                   # history stores values, not traces
+
+
+class _ReplayFamily:
+    def __init__(self, labelnames, children, buckets=None):
+        self.labelnames = labelnames
+        self._children = children   # [(values_tuple, child), ...]
+        if buckets is not None:
+            self.buckets = buckets  # histograms only (hasattr contract)
+
+    def _sorted_children(self):
+        return list(self._children)
+
+
+class _ReplayRegistry:
+    """A frozen history window mounted as a read-only registry with a
+    settable clock: ``get(family)`` returns families whose children
+    answer at ``self.now``, so the UNMODIFIED SLO/rule readers
+    (:meth:`LatencySLO.good_total` & co.) replay the past verbatim."""
+
+    def __init__(self, series):
+        from .expo import parse_labels
+        self.now = 0.0
+        groups = {}         # name -> {labels_tuple: points}
+        for key, pts in (series or {}).items():
+            name, labels = parse_labels(key)
+            points = sorted((float(t), float(v)) for t, v in pts)
+            groups.setdefault(name, {})[
+                tuple(sorted(labels.items()))] = points
+        self._families = {}
+        hist_bases = set()
+        for name, children in groups.items():
+            if name.endswith("_bucket") and any(
+                    "le" in dict(lab) for lab in children):
+                base = name[:-len("_bucket")]
+                hist_bases.add(base)
+                self._families[base] = self._build_hist(base, children)
+        for name, children in groups.items():
+            if name[:-len("_bucket")] in hist_bases \
+                    and name.endswith("_bucket"):
+                continue
+            self._families.setdefault(
+                name, self._build_flat(children))
+
+    @staticmethod
+    def _labelnames(children, drop=()):
+        names = set()
+        for lab in children:
+            names.update(k for k, _ in lab)
+        return tuple(sorted(names - set(drop)))
+
+    def _build_flat(self, children):
+        labelnames = self._labelnames(children)
+        rows = []
+        for lab, points in sorted(children.items()):
+            d = dict(lab)
+            values = tuple(d.get(k, "") for k in labelnames)
+            rows.append((values, _ReplayChild(self, points)))
+        return _ReplayFamily(labelnames, rows)
+
+    def _build_hist(self, base, children):
+        labelnames = self._labelnames(children, drop=("le",))
+        grouped = {}        # non-le values -> {le_float: points}
+        for lab, points in children.items():
+            d = dict(lab)
+            le = d.pop("le", None)
+            if le is None:
+                continue
+            try:
+                bound = float(le)
+            except ValueError:
+                continue
+            values = tuple(d.get(k, "") for k in labelnames)
+            grouped.setdefault(values, {})[bound] = points
+        finite = sorted({b for les in grouped.values() for b in les
+                         if b != float("inf")})
+        rows = []
+        for values, les in sorted(grouped.items()):
+            ordered = [(b, les.get(b, [])) for b in finite]
+            ordered.append((float("inf"), les.get(float("inf"), [])))
+            rows.append((values, _ReplayHistChild(self, ordered)))
+        return _ReplayFamily(labelnames, rows, buckets=tuple(finite))
+
+    def set_time(self, t):
+        self.now = float(t)
+
+    def get(self, name):
+        return self._families.get(name)
+
+    def times(self):
+        """Every distinct sample time in the window, ascending."""
+        out = set()
+        for fam in self._families.values():
+            for _, child in fam._children:
+                pts = (child._points if hasattr(child, "_points")
+                       else [p for _, ps in child._buckets for p in ps])
+                out.update(t for t, _ in pts)
+        return sorted(out)
+
+
+def _rebuild_objective(name, row, registry):
+    """One describe() row back into a live SLO object (None when the
+    kind can't replay — e.g. a value_fn-backed GaugeSLO whose callable
+    died with the process)."""
+    target = row.get("target")
+    match = dict(row.get("match") or {})
+    if row.get("threshold_ms") is not None:
+        return LatencySLO(name, row["threshold_ms"], target=target,
+                          family=row["family"], match=match,
+                          registry=registry)
+    if row.get("good_events") is not None:
+        return AvailabilitySLO(
+            name, target=target, family=row["family"], match=match,
+            good_events=tuple(row["good_events"]),
+            bad_events=tuple(row.get("bad_events") or ()),
+            event_label=row.get("event_label", "event"),
+            registry=registry)
+    if row.get("budget_s_per_1k_tokens") is not None:
+        return CostSLO(name, row["budget_s_per_1k_tokens"],
+                       seconds_family=row["family"],
+                       tokens_family=row["tokens_family"], match=match,
+                       kinds=tuple(row.get("kinds") or ("device",)),
+                       registry=registry)
+    return None
+
+
+def _rebuild_rule(row, registry):
+    from . import alerts as _alerts
+    kind = row.get("kind")
+    name = row.get("alert")
+    sev = row.get("severity", _alerts.TICKET)
+    for_s = float(row.get("for_s") or 0.0)
+    if kind == "burn_rate":
+        return _alerts.BurnRateRule(
+            name, row["slo"], long_window=row["long_window"],
+            short_window=row["short_window"], factor=row["factor"],
+            severity=sev, for_s=for_s)
+    if kind == "threshold":
+        return _alerts.ThresholdRule(
+            name, row["slo"], window=row["window"],
+            factor=row["factor"], severity=sev, for_s=for_s)
+    if kind == "absence":
+        return _alerts.AbsenceRule(
+            name, row["family"], window=row["window"],
+            match=dict(row.get("match") or {}), severity=sev,
+            for_s=for_s, registry=registry)
+    return None
+
+
+def _norm_window_spec(w):
+    """describe() stringifies windows; map back to a label the
+    evaluator resolves, or raw pre-scale seconds."""
+    w = str(w)
+    if w in BURN_WINDOWS:
+        return w
+    try:
+        return float(w)
+    except ValueError:
+        return w
+
+
+def replay_history(window, objectives=None, rules=None, at=None,
+                   scale=None, max_ticks=2000):
+    """Re-judge a frozen history window: did the alerting decision
+    reproduce from the persisted evidence?
+
+    ``window`` is a forensics freeze (what :meth:`~.history.
+    HistoryScraper.forensics` returns and the flight bundle's
+    ``history_<owner>.json`` section carries — a whole bundle section
+    replays its newest freeze). ``objectives``/``rules`` default to
+    the ``objectives``/``alerts`` snapshots frozen alongside the
+    series; pass explicit describe rows to replay what-if variants.
+    ``at`` is the judgment instant (default: the freeze end — the
+    moment the incident opened).
+
+    Returns per-objective evaluations at ``at`` plus, per rule, the
+    replayed ``active`` verdict against the frozen live state and a
+    ``reproduces`` bool; ``skipped`` lists what could not be
+    reconstructed (e.g. callable-backed gauges)."""
+    if isinstance(window, dict) and "freezes" in window:
+        if not window["freezes"]:
+            raise ValueError("bundle section has no freezes")
+        window = window["freezes"][-1]
+    series = (window or {}).get("series") or {}
+    obj_snap = objectives if objectives is not None \
+        else window.get("objectives")
+    rule_snap = rules if rules is not None else window.get("alerts")
+    obj_rows = obj_snap or {}
+    if isinstance(obj_rows, dict) and "objectives" in obj_rows:
+        obj_rows = obj_rows["objectives"]
+    rule_rows = rule_snap or ()
+    if isinstance(rule_rows, dict):
+        rule_rows = rule_rows.get("rules") or ()
+    if scale is None:
+        for snap in (obj_snap, rule_snap):
+            if isinstance(snap, dict) \
+                    and snap.get("window_scale") is not None:
+                scale = float(snap["window_scale"])
+                break
+    scale = 1.0 if scale is None else float(scale)
+
+    adapter = _ReplayRegistry(series)
+    owner = str((window or {}).get("owner") or "window")
+    # a private registry keeps replay's slo-gauge mirrors out of the
+    # live process exposition
+    from .registry import MetricsRegistry
+    evaluator = SloEvaluator(f"replay:{owner}",
+                             registry=MetricsRegistry(), scale=scale)
+    skipped = []
+    for name, row in dict(obj_rows).items():
+        slo = _rebuild_objective(name, dict(row or {}), adapter)
+        if slo is None:
+            skipped.append({"objective": name,
+                            "reason": "kind not replayable"})
+        else:
+            evaluator.add(slo)
+    built_rules = []
+    for row in rule_rows:
+        row = dict(row or {})
+        rule = _rebuild_rule(row, adapter)
+        if rule is None:
+            skipped.append({"rule": row.get("alert"),
+                            "reason": "kind not replayable"})
+            continue
+        for attr in ("long_window", "short_window", "window"):
+            if hasattr(rule, attr):
+                setattr(rule, attr,
+                        _norm_window_spec(getattr(rule, attr)))
+        built_rules.append((rule, row))
+
+    at = float(at) if at is not None \
+        else float((window or {}).get("end") or 0.0)
+    times = [t for t in adapter.times() if t <= at]
+    if len(times) > max_ticks:
+        stride = -(-len(times) // max_ticks)
+        times = times[::stride] + ([times[-1]]
+                                   if times[-1] not in times[::stride]
+                                   else [])
+    for t in times:
+        adapter.set_time(t)
+        evaluator.tick(t)
+        for rule, _ in built_rules:
+            rule.sample(evaluator, t)
+    adapter.set_time(at)
+
+    out_objectives = {}
+    with evaluator._lock:
+        live = list(evaluator.objectives.values())
+    for slo in live:
+        out_objectives[slo.name] = evaluator.evaluate(slo, at)
+    out_rules = []
+    reproduced = True
+    for rule, row in built_rules:
+        try:
+            active, detail = rule.condition(evaluator, at)
+        except Exception as e:
+            active, detail = None, {"error": repr(e)}
+        live_state = row.get("state")
+        entry = {"alert": rule.name, "kind": rule.kind,
+                 "severity": rule.severity,
+                 "active": active, "detail": detail,
+                 "live_state": live_state}
+        if live_state is not None:
+            live_active = live_state in ("pending", "firing")
+            entry["reproduces"] = bool(active) == live_active
+            reproduced = reproduced and entry["reproduces"]
+        out_rules.append(entry)
+    return {"owner": owner, "at": round(at, 3), "scale": scale,
+            "ticks": len(times),
+            "start": (window or {}).get("start"),
+            "end": (window or {}).get("end"),
+            "objectives": out_objectives,
+            "rules": out_rules,
+            "reproduces": reproduced,
+            "skipped": skipped}

@@ -339,6 +339,27 @@ def test_alert_rule_family_cross_check():
         assert _line_mentions_rule(source, f), f
 
 
+def test_history_rule_family_cross_check():
+    # the history config's recording rules cross-check the same way:
+    # capturing a renamed family stores nothing and every retro query
+    # over it comes back empty — that must fail lint, while a rule
+    # over a declared family stays clean
+    p = TelemetryConsistencyPass()
+    project = core.Project(root=ROOT, passes=[p])
+    with open(os.path.join(FIXTURES, "telemetry_fixture.py"),
+              encoding="utf-8") as fh:
+        source = fh.read()
+    project.lint_source(source, "fixtures/telemetry_fixture.py")
+    project.full_scan = True
+    findings = [f for f in project.finalize()
+                if f.rule == "history-rule-family"]
+    fams = sorted(f.message.split("family ")[1].split()[0]
+                  for f in findings)
+    assert fams == ["mxnet_tpu_fixture_history_gone_total"], findings
+    for f in findings:
+        assert _line_mentions_rule(source, f), f
+
+
 def test_dashboard_cross_check_fires_when_family_missing():
     # a full-scan project that declared NO families must flag every
     # family the committed Grafana dashboard queries

@@ -1,0 +1,222 @@
+"""bench_regress: a noise-aware perf-regression sentry over BENCH
+records.
+
+The bench trajectory (``BENCH_r*.json``, one record per run) had no
+machine-checked gate: a run that quietly lost 20% of resnet
+throughput would land as green. This tool diffs the NEWEST record's
+headline metrics against the prior trajectory and exits non-zero on
+regressions beyond a per-metric tolerance::
+
+    python tools/bench_regress.py                 # repo BENCH_r*.json
+    python tools/bench_regress.py --dir /tmp/run  # a directory of them
+    python tools/bench_regress.py r1.json r2.json r3.json
+
+Noise handling, because bench numbers are not SLO counters:
+
+- a record's ``tail`` may carry REPEATS of one metric (suite re-runs);
+  the best value per record is scored — best-of-N is the standard
+  noise floor for throughput benches;
+- the reference is the MEDIAN of the metric's prior-record values,
+  not the single previous run, so one lucky outlier run doesn't turn
+  every successor into a regression;
+- the tolerance per metric is ``max(--tolerance, 2 × median
+  successive relative change)`` over the history — a metric that
+  historically jitters 8% between runs is not flagged at 5%;
+- a metric the newest record MISSES is reported as skipped, not
+  flagged: partial records (rc=124 timeouts) happen and the sentry
+  must not turn a truncated run into a fake regression;
+- metric direction is inferred from the name (``*_per_sec*``, ``mfu``,
+  throughput → higher is better; ``*_ms``, latency, ``p99`` → lower);
+  undirectioned metrics (counts like ``suite_budget_skipped``) are
+  ignored.
+
+``--inject metric=value`` overrides one candidate metric in memory —
+the self-test hook proving the sentry actually fires. Exit codes:
+0 clean, 1 regressions found, 2 not enough records to judge.
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import statistics
+import sys
+
+_HIGHER = re.compile(r"(per_sec|per_chip|throughput|tokens_s|qps|"
+                     r"images_s|mfu|tflops|gbs)")
+_LOWER = re.compile(r"(_ms\b|_ms_|latency|p50|p95|p99|ttft|_s\b|"
+                    r"seconds|duration)")
+
+
+def direction(metric):
+    """+1 higher-better, -1 lower-better, 0 undirectioned (ignored)."""
+    m = str(metric)
+    if _HIGHER.search(m):
+        return 1
+    if _LOWER.search(m):
+        return -1
+    return 0
+
+
+def record_metrics(rec):
+    """``{metric: best value}`` for one BENCH record: every JSON
+    metric line in the tail (suite members, repeats) plus the parsed
+    headline; repeats keep the best value for the metric's
+    direction."""
+    found = {}
+
+    def _take(m):
+        name, value = m.get("metric"), m.get("value")
+        if not name or not isinstance(value, (int, float)):
+            return
+        d = direction(name)
+        if d == 0:
+            return
+        prev = found.get(name)
+        if prev is None or (d > 0 and value > prev) \
+                or (d < 0 and value < prev):
+            found[name] = float(value)
+
+    for line in (rec.get("tail") or "").splitlines():
+        line = line.strip()
+        if not (line.startswith("{") and '"metric"' in line):
+            continue
+        try:
+            m = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(m, dict):
+            _take(m)
+    parsed = rec.get("parsed")
+    if isinstance(parsed, dict):
+        _take(parsed)
+    return found
+
+
+def load_records(paths):
+    out = []
+    for p in paths:
+        try:
+            with open(p, encoding="utf-8") as fh:
+                rec = json.load(fh)
+        except (OSError, ValueError) as e:
+            print(f"bench_regress: skipping unreadable {p}: {e}",
+                  file=sys.stderr)
+            continue
+        out.append((os.path.basename(p), rec, record_metrics(rec)))
+    return out
+
+
+def tolerance_for(history, floor):
+    """Per-metric tolerance: the CLI floor, widened to twice the
+    median successive relative change when the history itself is
+    noisier than the floor."""
+    steps = [abs(b - a) / abs(a)
+             for a, b in zip(history, history[1:]) if a]
+    noise = 2.0 * statistics.median(steps) if steps else 0.0
+    return max(float(floor), noise)
+
+
+def judge(records, floor=0.10):
+    """Compare the newest record against the prior trajectory.
+    Returns ``(rows, regressions)`` — one row per candidate metric."""
+    *prior, (cand_name, cand_rec, cand) = records
+    rows = []
+    regressions = []
+    metrics = sorted(set(cand) | {m for _, _, vals in prior
+                                  for m in vals})
+    for metric in metrics:
+        d = direction(metric)
+        history = [vals[metric] for _, _, vals in prior
+                   if metric in vals]
+        row = {"metric": metric, "candidate": cand.get(metric),
+               "runs": len(history)}
+        if metric not in cand:
+            # rc=124 partials: a missing metric is a visibility gap,
+            # not a measured regression
+            row.update(status="skipped", reason="absent in candidate")
+            rows.append(row)
+            continue
+        if not history:
+            row.update(status="new", reason="no prior record has it")
+            rows.append(row)
+            continue
+        ref = statistics.median(history)
+        tol = tolerance_for(history, floor)
+        value = cand[metric]
+        change = (value - ref) / ref if ref else 0.0
+        regressed = (change < -tol) if d > 0 else (change > tol)
+        row.update(reference=round(ref, 4),
+                   change_pct=round(100.0 * change, 2),
+                   tolerance_pct=round(100.0 * tol, 2),
+                   direction="higher" if d > 0 else "lower",
+                   status="REGRESSION" if regressed else "ok")
+        rows.append(row)
+        if regressed:
+            regressions.append(row)
+    return rows, regressions
+
+
+def main(argv=None):
+    import argparse
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("records", nargs="*",
+                    help="BENCH record files, oldest..newest (default: "
+                         "BENCH_r*.json in --dir, sorted by name)")
+    ap.add_argument("--dir", default=os.path.dirname(os.path.dirname(
+                        os.path.abspath(__file__))),
+                    help="directory scanned for BENCH_r*.json")
+    ap.add_argument("--tolerance", type=float, default=0.10,
+                    help="regression tolerance floor as a fraction "
+                         "(default 0.10 = 10%%)")
+    ap.add_argument("--inject", action="append", default=[],
+                    metavar="METRIC=VALUE",
+                    help="override one candidate metric (self-test: "
+                         "prove the sentry fires)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report on stdout")
+    args = ap.parse_args(argv)
+
+    paths = args.records or sorted(
+        glob.glob(os.path.join(args.dir, "BENCH_r*.json")))
+    records = load_records(paths)
+    if len(records) < 2:
+        print("bench_regress: need at least two readable records "
+              f"(got {len(records)}) — nothing to diff",
+              file=sys.stderr)
+        return 2
+    for spec in args.inject:
+        metric, _, value = spec.partition("=")
+        records[-1][2][metric] = float(value)
+
+    rows, regressions = judge(records, floor=args.tolerance)
+    if args.json:
+        print(json.dumps({"candidate": records[-1][0],
+                          "prior": [n for n, _, _ in records[:-1]],
+                          "rows": rows,
+                          "regressions": len(regressions)}, indent=1))
+    else:
+        print(f"bench_regress: {records[-1][0]} vs "
+              f"{len(records) - 1} prior record(s)")
+        for row in rows:
+            if row["status"] in ("skipped", "new"):
+                print(f"  {row['status']:>10}  {row['metric']} "
+                      f"({row['reason']})")
+                continue
+            print(f"  {row['status']:>10}  {row['metric']}: "
+                  f"{row['candidate']:g} vs median {row['reference']:g} "
+                  f"({row['change_pct']:+.1f}%, tol "
+                  f"±{row['tolerance_pct']:.1f}%, {row['direction']} "
+                  f"is better)")
+    if regressions:
+        print(f"bench_regress: {len(regressions)} regression(s) beyond "
+              f"tolerance", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

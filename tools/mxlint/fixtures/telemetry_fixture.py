@@ -63,3 +63,13 @@ def rule_default_family(threshold_ms,
                         family="mxnet_tpu_fixture_default_gone_ms"):
     # alert-rule-family fires on the signature default (line above)
     return threshold_ms, family
+
+
+def history_rule_over_declared_family(RecordingRule):
+    return RecordingRule("fx", family="mxnet_tpu_fixture_total")   # clean
+
+
+def history_rule_over_renamed_family(RecordingRule):
+    return RecordingRule(
+        "fx",
+        family="mxnet_tpu_fixture_history_gone_total")  # history-rule-family

@@ -30,7 +30,13 @@
   in the scanned code — the same machinery as the dashboard check. A
   rule over a renamed family would evaluate over nothing and the
   alert it guards would never fire, which is strictly worse than no
-  alert: it reads as green.
+  alert: it reads as green;
+- ``history-rule-family`` — every family a history recording rule
+  captures (``RecordingRule(..., family=...)`` in the history config)
+  must be declared somewhere in the scanned code — same contract as
+  the dashboard and alert-rule checks. A rule over a renamed family
+  records NOTHING, and the gap only surfaces months later when a
+  postmortem queries empty history for the exact window it needed.
 """
 from __future__ import annotations
 
@@ -61,13 +67,14 @@ class TelemetryConsistencyPass(LintPass):
     name = "telemetry-consistency"
     rules = ("metric-labels", "metric-engine-label",
              "metric-tenant-label", "span-leak", "dashboard-family",
-             "alert-rule-family")
+             "alert-rule-family", "history-rule-family")
 
     def __init__(self):
         # family -> list of (labels tuple | None, relpath, line)
         self.declared = {}
         self.patterns = []          # (regex, relpath, line) f-string fams
         self.rule_refs = []         # (family, relpath, line) SLO/alert refs
+        self.history_refs = []      # (family, relpath, line) recording rules
 
     def check(self, ctx):
         out = []
@@ -138,7 +145,20 @@ class TelemetryConsistencyPass(LintPass):
         """``LatencySLO(..., family="mxnet_tpu_x")`` and friends: the
         family the rule will read, resolved against declarations in
         ``finalize`` (same machinery as the dashboard cross-check)."""
-        if terminal_attr(call.func) not in _SLO_CTORS:
+        term = terminal_attr(call.func)
+        if term == "RecordingRule":
+            # the history config: captured families cross-check like
+            # dashboard panels — recording a renamed family stores
+            # nothing and retro queries come back empty
+            for kw in call.keywords:
+                if not _is_family_arg(kw.arg):
+                    continue
+                fam = str_const(kw.value)
+                if fam is not None and fam.startswith("mxnet_tpu_"):
+                    self.history_refs.append(
+                        (fam, ctx.relpath, kw.value.lineno))
+            return
+        if term not in _SLO_CTORS:
             return
         for kw in call.keywords:
             if not _is_family_arg(kw.arg):
@@ -228,6 +248,7 @@ class TelemetryConsistencyPass(LintPass):
         out = self._check_label_consistency()
         if project.full_scan:
             out.extend(self._check_rule_refs())
+            out.extend(self._check_history_refs())
             dash_dir = os.path.join(project.root, "tools", "dashboards")
             for path in sorted(glob.glob(os.path.join(dash_dir,
                                                       "*.json"))):
@@ -248,6 +269,22 @@ class TelemetryConsistencyPass(LintPass):
                 f"code declares it — the rule would evaluate over "
                 f"nothing and its alert could never fire (renamed "
                 f"family?)"))
+        return out
+
+    def _check_history_refs(self):
+        out = []
+        for fam, rel, line in self.history_refs:
+            base = re.sub(r"_(bucket|sum|count)$", "", fam)
+            if base in self.declared:
+                continue
+            if any(p.match(base) for p, _, _ in self.patterns):
+                continue
+            out.append(Finding(
+                "history-rule-family", rel, line, 0,
+                f"history recording rule captures family {fam} but no "
+                f"scanned code declares it — nothing would be stored "
+                f"and every retro query over it would come back empty "
+                f"(renamed family?)"))
         return out
 
     def _check_label_consistency(self):

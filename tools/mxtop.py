@@ -1,0 +1,211 @@
+"""mxtop: a terminal fleet console off the retrospective history.
+
+Point it at any mxnet_tpu exposition endpoint (an engine's, or a
+router's for the fleet view)::
+
+    python tools/mxtop.py http://127.0.0.1:9200
+    python tools/mxtop.py --once http://127.0.0.1:9200
+    python tools/mxtop.py --window 600 --interval 2 http://127.0.0.1:9200
+
+Everything on screen is a RANGE query against ``/query_range`` (the
+history store fed by the owner's scraper daemon), not an instantaneous
+scrape — so each headline number comes with its trailing sparkline and
+the console keeps working against a process that just restarted (the
+store reloads persisted segments):
+
+- **tokens/s** — ``rate(mxnet_tpu_serving_decode_tokens_total)`` per
+  engine;
+- **inter-token p99** — quantile-over-time on
+  ``mxnet_tpu_serving_inter_token_latency_ms``;
+- **requests/s + queue depth + KV occupancy** — per engine;
+- **per-tenant bills** — windowed device-seconds and token rates off
+  the tenant cost slice, priciest first;
+- **alerts** — the ``/alerts`` rule table, firing/pending first.
+
+Curses-free by design: one ANSI home+clear per refresh (disabled when
+stdout is not a tty or with ``--once``), plain text otherwise — it
+works over ssh, in CI logs, and in a pipe. Exit code 4 while anything
+is firing (the ``telemetry_dump --alerts`` contract), 0 otherwise.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+SPARK = "▁▂▃▄▅▆▇█"
+
+
+def _fetch(url, timeout=5.0):
+    import urllib.request
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.read().decode()
+
+
+def _query(base, family, fn="value", q=None, window=None, start=None,
+           end=None, step=None, match=None):
+    from urllib.parse import urlencode
+    params = {"family": family, "fn": fn}
+    for k, v in (("q", q), ("window", window), ("start", start),
+                 ("end", end), ("step", step)):
+        if v is not None:
+            params[k] = v
+    params.update(match or {})
+    try:
+        return json.loads(_fetch(f"{base}/query_range?"
+                                 f"{urlencode(params)}"))
+    except Exception:
+        return None
+
+
+def sparkline(points, width=24):
+    """Unicode sparkline over the last ``width`` non-null values."""
+    vals = [v for _, v in points if v is not None][-width:]
+    if not vals:
+        return "·" * 4
+    lo, hi = min(vals), max(vals)
+    span = (hi - lo) or 1.0
+    return "".join(SPARK[min(len(SPARK) - 1,
+                             int((v - lo) / span * (len(SPARK) - 1)))]
+                   for v in vals)
+
+
+def _last(points):
+    for t, v in reversed(points or []):
+        if v is not None:
+            return v
+    return None
+
+
+def _rows(result, label_keys):
+    """(label string, last value, sparkline) per series, sorted."""
+    out = []
+    for row in (result or {}).get("series") or []:
+        labels = row.get("labels") or {}
+        tag = ",".join(str(labels.get(k, "")) for k in label_keys
+                       if labels.get(k)) or "-"
+        out.append((tag, _last(row["points"]), sparkline(row["points"])))
+    out.sort(key=lambda r: -(r[1] or 0))
+    return out
+
+
+def _fmt(v, unit=""):
+    if v is None:
+        return "  -"
+    if abs(v) >= 1e6:
+        return f"{v / 1e6:6.1f}M{unit}"
+    if abs(v) >= 1e3:
+        return f"{v / 1e3:6.1f}k{unit}"
+    return f"{v:7.1f}{unit}"
+
+
+def render(base, window, out=None):
+    out = out if out is not None else sys.stdout
+    now = time.time()
+    # history timestamps ARE wall clock (cross-process axis), so the
+    # query range is wall arithmetic, not a measured duration
+    start = now - window  # mxlint: disable=wall-clock-delta
+    step = max(1.0, window / 48.0)
+    q = lambda fam, **kw: _query(base, fam, start=start, end=now,
+                                 step=step, **kw)
+    lines = []
+    lines.append(f"mxtop — {base}  window {window:g}s  "
+                 f"{time.strftime('%H:%M:%S')}")
+
+    tok = q("mxnet_tpu_serving_decode_tokens_total", fn="rate",
+            window=4 * step)
+    lines.append("")
+    lines.append("-- decode tokens/s (per engine) " + "-" * 30)
+    rows = _rows(tok, ("engine_id",))
+    for tag, last, spark in rows or [("-", None, "")]:
+        lines.append(f"  {tag:<24} {_fmt(last, '/s')}  {spark}")
+
+    p99 = q("mxnet_tpu_serving_inter_token_latency_ms", fn="quantile",
+            q=99, window=4 * step)
+    lines.append("-- inter-token p99 ms " + "-" * 40)
+    for tag, last, spark in _rows(p99, ("engine_id",)) \
+            or [("-", None, "")]:
+        lines.append(f"  {tag:<24} {_fmt(last, 'ms')}  {spark}")
+
+    req = q("mxnet_tpu_serving_requests_total", fn="rate",
+            window=4 * step, match={"event": "completed"})
+    lines.append("-- completed req/s " + "-" * 43)
+    for tag, last, spark in _rows(req, ("engine_id",)) \
+            or [("-", None, "")]:
+        lines.append(f"  {tag:<24} {_fmt(last, '/s')}  {spark}")
+
+    depth = q("mxnet_tpu_serving_queue_depth")
+    kv = q("mxnet_tpu_serving_kv_pages", match={"state": "used"})
+    gauges = []
+    for label, res, keys in (("queue", depth, ("engine_id", "tenant_class")),
+                             ("kv used", kv, ("engine_id",))):
+        for tag, last, spark in _rows(res, keys):
+            gauges.append(f"  {label:<8} {tag:<20} {_fmt(last)}  {spark}")
+    if gauges:
+        lines.append("-- queue depth / KV occupancy " + "-" * 32)
+        lines.extend(gauges)
+
+    bills = q("mxnet_tpu_serving_tenant_cost_seconds_total", fn="rate",
+              window=window)
+    tenant_rows = _rows(bills, ("tenant", "model"))
+    if tenant_rows:
+        lines.append("-- tenant bills (device s/s over window) " + "-" * 21)
+        for tag, last, spark in tenant_rows[:8]:
+            lines.append(f"  {tag:<28} {last if last is None else round(last, 4)!s:>9}  {spark}")
+
+    firing = 0
+    try:
+        alerts = json.loads(_fetch(f"{base}/alerts"))
+        rules = alerts.get("rules") or []
+        active = [r for r in rules
+                  if r.get("state") in ("firing", "pending")]
+        firing = sum(1 for r in rules if r.get("state") == "firing")
+        lines.append(f"-- alerts: {firing} firing, "
+                     f"{len(active) - firing} pending "
+                     + "-" * 36)
+        for r in active[:10]:
+            lines.append(f"  [{r.get('state'):>7}] {r.get('severity')} "
+                         f"{r.get('alert')}")
+    except Exception:
+        lines.append("-- alerts: unavailable " + "-" * 39)
+
+    print("\n".join(lines), file=out)
+    return firing
+
+
+def main(argv=None):
+    import argparse
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("url", help="exposition base URL "
+                                "(engine or router)")
+    ap.add_argument("--once", action="store_true",
+                    help="render one frame and exit (exit 4 while "
+                         "anything is firing)")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="refresh interval seconds (default 2)")
+    ap.add_argument("--window", type=float, default=300.0,
+                    help="trailing query window seconds (default 300)")
+    args = ap.parse_args(argv)
+    base = args.url.rstrip("/")
+    if args.once:
+        firing = render(base, args.window)
+        return 4 if firing else 0
+    ansi = sys.stdout.isatty()
+    try:
+        while True:
+            if ansi:
+                sys.stdout.write("\x1b[H\x1b[2J")
+            render(base, args.window)
+            time.sleep(max(0.2, args.interval))
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
