@@ -1520,6 +1520,17 @@ def main_decode_serving():
     assert report["stream_mismatches"] == 0, report
     server = report.get("server", {})
     assert server.get("reconciled", True), server
+    # tail-latency attribution: every request's server-side critical
+    # path rides the reply; the decompositions must sum to >=95% of
+    # their own wall, the remainder explicitly unattributed
+    from mxnet_tpu.telemetry import attribution as _attribution
+    breakdown = report.get("breakdown")
+    if _attribution.enabled():
+        assert breakdown is not None, \
+            "attribution enabled but no request carried a breakdown"
+        assert breakdown["missing"] == 0, breakdown
+        share = breakdown.get("attributed_share")
+        assert share is not None and share >= 0.95, breakdown
 
     # -- phase 2: iteration-level vs static batching, equal rows ------------
     ab = {}
@@ -1843,6 +1854,9 @@ def main_decode_serving():
                       chunk_ab["chunked"]["bg_inter_token_p99_ms"]),
                 3),
             seeded=seeded,
+            attributed_share=(breakdown or {}).get("attributed_share"),
+            unattributed_ms=(breakdown or {}).get("unattributed_ms"),
+            stage_breakdown=breakdown,
             telemetry_reconciled=server.get("reconciled"),
             cost_reconciled=cost.get("reconciled"),
             device_s_per_1k_tokens=cost.get("device_s_per_1k_tokens"),

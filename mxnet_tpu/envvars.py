@@ -326,6 +326,18 @@ register("MXNET_TPU_TRACE_MAX_SPANS", "int", 256,
 register("MXNET_TPU_TRACE_MAX_ACTIVE", "int", 256,
          "in-flight (not yet sampled) trace buffer cap",
          scope="telemetry")
+register("MXNET_TPU_ATTRIBUTION", "bool", True,
+         "per-request critical-path stage attribution (stage spans, "
+         "``InferenceFuture.breakdown``, the ``/whyslow`` aggregator); "
+         "``0`` — or spans off — disables: no stamps, no families, no "
+         "threads", scope="telemetry")
+register("MXNET_TPU_ATTRIBUTION_WINDOW", "int", 2048,
+         "per-stage sample window behind the ``/whyslow`` windowed "
+         "p99 (per (stage, tenant_class, model) cell)",
+         scope="telemetry")
+register("MXNET_TPU_ATTRIBUTION_TOP", "int", 3,
+         "stages ranked in ``/whyslow``'s ``top`` table and attached "
+         "to firing latency alert payloads", scope="telemetry")
 
 # -- telemetry: continuous profiler / resource accounting -------------------
 register("MXNET_TPU_PROF", "bool", True,

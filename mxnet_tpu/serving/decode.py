@@ -61,6 +61,7 @@ import time
 import numpy as np
 
 from .. import compile_cache, envvars
+from ..telemetry import attribution as _attribution
 from ..telemetry import events as _events
 from ..telemetry import incidents as _incidents
 from ..telemetry import profiling as _profiling
@@ -678,6 +679,18 @@ class DecodeEngine:
                 "buckets": self.costs.table(),
                 "totals": self.costs.totals()}
 
+    def whyslow(self):
+        """The ``/whyslow`` body: this engine's per-stage attribution
+        table + top stages by share of attributed time. Present (with
+        ``enabled: false`` and empty tables) even when attribution is
+        off, so fleet scrapers never 404-branch."""
+        agg = _attribution.get_aggregator(self.engine_id)
+        if agg is None:
+            return {"owner": self.engine_id,
+                    "enabled": _attribution.enabled(),
+                    "requests": 0, "stages": [], "top": []}
+        return agg.snapshot()
+
     def expose(self, port=0, host="127.0.0.1"):
         """Telemetry + dispatch surface, mirroring
         ``ServingEngine.expose``; ``POST /submit`` additionally
@@ -725,6 +738,7 @@ class DecodeEngine:
                                   alerts_fn=(self.alerts_snapshot
                                              if self._slo is not None
                                              else None),
+                                  whyslow_fn=self.whyslow,
                                   port=port, host=host)
             self._expo = srv
             if envvars.get("MXNET_TPU_WIRE") and self._wire is None:
@@ -774,7 +788,8 @@ class DecodeEngine:
                          "engine_id": self.engine_id,
                          "engine_ms": round(
                              (time.perf_counter() - t0) * 1e3, 3),
-                         "cost": getattr(fut, "cost", None)}
+                         "cost": getattr(fut, "cost", None),
+                         "breakdown": getattr(fut, "breakdown", None)}
 
         def parts():
             n = 0
@@ -796,7 +811,8 @@ class DecodeEngine:
                    "engine_id": self.engine_id,
                    "engine_ms": round(
                        (time.perf_counter() - t0) * 1e3, 3),
-                   "cost": getattr(fut, "cost", None)}
+                   "cost": getattr(fut, "cost", None),
+                   "breakdown": getattr(fut, "breakdown", None)}
 
         return 200, parts()
 
@@ -1009,6 +1025,14 @@ class DecodeEngine:
                 # defer (front of line), never fail — pages recycle the
                 # moment any sequence leaves
                 self._queue.requeue(req)
+                # per-REQUEST defer breadcrumbs: the episode gets its
+                # own stage span once the re-admit finally lands, so a
+                # deferred request's TTFT outlier reads "defer", not
+                # noise (the event below stays once-per-pool-episode —
+                # the admit loop would re-emit it every poll otherwise)
+                if req.t_defer is None:
+                    req.t_defer = now
+                req.defers += 1
                 if not self._defer_logged:
                     self._defer_logged = True
                     _events.emit("decode_defer",
@@ -1018,6 +1042,18 @@ class DecodeEngine:
                                  reserved=self._reserved_pages,
                                  pool=self.pool.n_pages)
                 break
+            if req.t_defer is not None:
+                # the defer episode just ended: admission is about to
+                # succeed (or fail loudly) — stamp requeue -> now
+                _events.emit("decode_defer_end",
+                             engine_id=self.engine_id,
+                             trace_id=req.trace_id,
+                             deferrals=req.defers,
+                             waited_ms=round(
+                                 (now - req.t_defer) * 1e3, 3))
+                _attribution.stamp(req, "defer", req.t_defer, now,
+                                   attrs={"deferrals": req.defers})
+                req.t_defer = None
             try:
                 if chunked:
                     self._admit_chunked(req, worst)
@@ -1043,8 +1079,12 @@ class DecodeEngine:
         self._reserved_pages += worst_pages
         matched, copies = self.pool.match_prefix(req.id, req.tokens)
         if copies:
+            c0 = time.monotonic()
             with self._forward_lock:
                 self.pool.copy_pages(copies)
+            _attribution.stamp(req, "cow_copy", c0, time.monotonic(),
+                               attrs={"pages": len(copies),
+                                      "prefix_hit": True})
         req.prefill_pos = req.reused_tokens = matched
         self.stats.queue_ms.observe((req.t_drain - req.t_submit) * 1e3)
         self._prefilling.append(req)
@@ -1105,6 +1145,7 @@ class DecodeEngine:
         Returns the step's next-token sample — meaningful only for
         the chunk that completes the prompt (sampled at the prompt's
         last position); earlier chunks' is discarded."""
+        t_chunk0 = time.monotonic()
         start = req.prefill_pos
         self.pool.ensure(req.id, start + take)
         pages_now = self.pool.pages_for(start + take)
@@ -1122,10 +1163,14 @@ class DecodeEngine:
             pairs.append(cow)
         table = self.pool.padded_tables([req.id], width)[0]
 
+        cow_ival = [None]
+
         def run():
             with self._forward_lock:
                 if pairs:
+                    c0 = time.monotonic()
                     self.pool.copy_pages(pairs)
+                    cow_ival[0] = (c0, time.monotonic())
                 tok, caches = self._model.prefill_chunk(
                     self.pool.caches, ids, start, take, table,
                     temperature=req.temperature, top_k=req.top_k,
@@ -1137,6 +1182,15 @@ class DecodeEngine:
         now = time.monotonic()
         self._beat = now
         self._last_dispatch = now
+        # stage stamps: the chunk's full residency, with the COW copy
+        # nested inside it (the extractor bills the copy slice to
+        # cow_copy, the remainder to prefill_chunk — innermost wins)
+        _attribution.stamp(req, "prefill_chunk", t_chunk0, now,
+                           attrs={"tokens": take, "pos": start,
+                                  "compiled": compiled})
+        if cow_ival[0] is not None:
+            _attribution.stamp_interval(req, "cow_copy", cow_ival[0],
+                                        attrs={"pages": len(pairs)})
         req.prefill_pos += take
         req.device_s += dt
         final = req.prefill_pos >= req.prompt_len
@@ -1186,6 +1240,7 @@ class DecodeEngine:
         one) or JOIN it to the decode batch."""
         self._reserved[req.id] = worst_pages
         self._reserved_pages += worst_pages
+        t_pf0 = time.monotonic()
         bucket = next(b for b in self.prefill_bucket_lens
                       if b >= req.prompt_len)
         self.pool.ensure(req.id, req.prompt_len)
@@ -1222,6 +1277,9 @@ class DecodeEngine:
         self._last_dispatch = now
         req.t_first = req.t_last = now
         req.device_s += dt
+        _attribution.stamp(req, "prefill", t_pf0, now,
+                           attrs={"tokens": req.prompt_len,
+                                  "compiled": compiled})
         self.decode_stats.ttft_ms.observe((now - req.t_submit) * 1e3)
         self.stats.queue_ms.observe((req.t_drain - req.t_submit) * 1e3)
         self._emit_token(req, tok)
@@ -1258,7 +1316,9 @@ class DecodeEngine:
         token through the bucketed paged step; EOS/max-token leavers
         recycle their pages the same iteration."""
         active = self._active
+        t_iter0 = time.monotonic()
         cow_pairs = []
+        cow_reqs = []
         for req in active:
             # guaranteed by the admission reservation: never raises
             self.pool.ensure(req.id, req.pos + 1)
@@ -1268,6 +1328,7 @@ class DecodeEngine:
             cow = self.pool.prepare_write(req.id, req.pos)
             if cow is not None:
                 cow_pairs.append(cow)
+                cow_reqs.append(req)
         # ensure() just covered pos+1 for every row, so the page count
         # is pure arithmetic — no pool lock or table copy per token
         max_pages = max(self.pool.pages_for(req.pos + 1)
@@ -1297,10 +1358,14 @@ class DecodeEngine:
             + ["__pad__"] * (rows_b - len(active))
         tables = self.pool.padded_tables(owners, width_b)
 
+        cow_ival = [None]
+
         def run():
             with self._forward_lock:
                 if cow_pairs:
+                    c0 = time.monotonic()
                     self.pool.copy_pages(cow_pairs)
+                    cow_ival[0] = (c0, time.monotonic())
                 toks, caches = self._model.decode_step(
                     self.pool.caches, ids, positions, tables,
                     temperatures=temps, top_ks=top_ks, top_ps=top_ps,
@@ -1324,10 +1389,18 @@ class DecodeEngine:
             req.t_last = now
             req.pos += 1
             req.device_s += share
+            # iteration residency: every cohort member was resident
+            # for the whole step; a member whose row paid a COW copy
+            # gets the copy slice re-billed to cow_copy (nested stamp)
+            _attribution.stamp(req, "decode_iter", t_iter0, now)
             self._emit_token(req, tok)
             if self._done_after_token(req, tok):
                 leavers.append((req, self._leave_reason(req, tok)))
                 completed += 1
+        if cow_ival[0] is not None:
+            for req in cow_reqs:
+                _attribution.stamp_interval(req, "cow_copy",
+                                            cow_ival[0])
         self.decode_stats.observe_iteration(rows_b, n_active)
         self.stats.compute_ms.observe(dt * 1e3)
         self.costs.observe_decode(-rows_b, dt, tokens=n_active,
@@ -1391,6 +1464,16 @@ class DecodeEngine:
                      trace_id=req.trace_id, reason=reason,
                      tokens=len(req.generated), pages_freed=freed,
                      active=len(self._active))
+        # critical-path decomposition: the engine-measured numbers the
+        # router and loadgen will see verbatim (future.breakdown, the
+        # streamed-final RESULT frame) + the /whyslow fleet aggregate
+        if req.stages is not None:
+            breakdown = _attribution.breakdown_from_stamps(
+                req.stages, req.t_submit, now, trace_id=req.trace_id)
+            req.future.breakdown = breakdown
+            _attribution.aggregator(self.engine_id).observe(
+                breakdown, tenant_class=req.tenant_class,
+                model=self.model_id, trace_id=req.trace_id)
         req.span.set_attr(tokens=len(req.generated), reason=reason)
         req.span.end()
         req.future.set_result(out)

@@ -32,6 +32,7 @@ import numpy as np
 from .. import autograd, compile_cache, envvars, profiler
 from .. import ndarray as nd
 from ..context import current_context
+from ..telemetry import attribution as _attribution
 from ..telemetry import events as _events
 from ..telemetry import incidents as _incidents
 from ..telemetry import profiling as _profiling
@@ -641,6 +642,7 @@ class ServingEngine:
                                   history_fn=(self._history.store
                                               if self._history is not None
                                               else None),
+                                  whyslow_fn=self.whyslow,
                                   port=port, host=host)
             self._expo = srv
             # the binary dispatch listener rides along with the HTTP
@@ -727,6 +729,17 @@ class ServingEngine:
                 "buckets": self.costs.table(),
                 "totals": self.costs.totals()}
 
+    def whyslow(self):
+        """The ``/whyslow`` body: per-stage attribution table + top
+        stages by share of attributed time (empty, ``enabled:
+        false``, when attribution is off — never a 404)."""
+        agg = _attribution.get_aggregator(self.engine_id)
+        if agg is None:
+            return {"owner": self.engine_id,
+                    "enabled": _attribution.enabled(),
+                    "requests": 0, "stages": [], "top": []}
+        return agg.snapshot()
+
     def _remote_submit(self, payload):
         """``POST /submit`` handler (runs on an exposition-server
         thread): submit + block for the result, JSON-serializable
@@ -768,7 +781,8 @@ class ServingEngine:
                      # amortized cost attribution crosses the wire so
                      # a remote router's caller sees the same bill an
                      # in-process caller would
-                     "cost": getattr(fut, "cost", None)}
+                     "cost": getattr(fut, "cost", None),
+                     "breakdown": getattr(fut, "breakdown", None)}
 
     # -- watchdog ----------------------------------------------------------
     def _watchdog_probe(self):
@@ -1015,6 +1029,19 @@ class ServingEngine:
                                    start_us=int(t0 * 1e6),
                                    end_us=int(t1 * 1e6),
                                    attrs=fwd_attrs)
+            # stage stamps for the critical-path breakdown (wfq_wait
+            # was stamped at drain; perf_counter and monotonic share
+            # the CLOCK_MONOTONIC axis here, like the span mix above).
+            # The stage spans themselves are skipped — the legacy
+            # serving/pack + serving/forward children already carry
+            # the same intervals in the tree.
+            if req.stages is not None:
+                if pack_interval is not None:
+                    _attribution.stamp(req, "pack", pack_interval[0],
+                                       pack_interval[1], span=False)
+                _attribution.stamp(
+                    req, "compute" if hit else "compile", t0, t1,
+                    span=False)
             try:
                 out = self._pool(
                     seq[pl.row, pl.offset:pl.offset + pl.length], req)
@@ -1042,6 +1069,14 @@ class ServingEngine:
                                    parent_id=req.span.span_id,
                                    start_us=int(t1 * 1e6),
                                    attrs={"engine": self.engine_id})
+            if req.stages is not None:
+                breakdown = _attribution.breakdown_from_stamps(
+                    req.stages, req.t_submit, now,
+                    trace_id=req.trace_id)
+                req.future.breakdown = breakdown
+                _attribution.aggregator(self.engine_id).observe(
+                    breakdown, tenant_class=req.tenant_class,
+                    model=mid, trace_id=req.trace_id)
             req.span.end()
             req.future.set_result(out)
 

@@ -34,6 +34,7 @@ from collections import deque
 import numpy as np
 
 from ..base import MXNetError
+from ..telemetry import attribution as _attribution
 from ..telemetry import events as _events
 from ..telemetry import spans as _spans
 from ..telemetry.trace import new_trace_id
@@ -119,6 +120,11 @@ class InferenceFuture:
         self._part_callbacks = []
         self._part_draining = False
         self.cost = None
+        # critical-path decomposition of the request's wall time
+        # (telemetry.attribution), written by the engine at completion
+        # and relayed by router/wire exactly like cost — None until
+        # finished (or when attribution is off)
+        self.breakdown = None
 
     def done(self):
         return self._event.is_set()
@@ -362,7 +368,8 @@ class Request:
     __slots__ = ("id", "trace_id", "span", "tokens", "token_types",
                  "deadline", "future", "t_submit", "t_drain",
                  "t_dispatch", "t_done", "tenant", "tenant_class",
-                 "model_id")
+                 "model_id", "stages", "t_activity", "t_defer",
+                 "defers")
 
     def __init__(self, tokens, token_types=None, deadline_ms=None,
                  trace_id=None, parent_span_id=None, tenant=None,
@@ -391,6 +398,13 @@ class Request:
         # logs can name the request the server's telemetry names
         self.future.trace_id = self.trace_id
         self.t_drain = self.t_dispatch = self.t_done = None
+        # stage-attribution breadcrumbs (telemetry.attribution.stamp):
+        # (stage, t0, t1) monotonic tuples; None = attribution off, the
+        # whole subsystem then costs one attribute check per stamp site
+        self.stages = [] if _attribution.enabled() else None
+        self.t_activity = None      # end of the last stamped stage
+        self.t_defer = None         # first KV page-exhaustion defer
+        self.defers = 0
 
     def __len__(self):
         return int(self.tokens.size)
@@ -542,7 +556,15 @@ class RequestQueue:
                 out.append(r)
             now = time.monotonic()
             for r in out:
+                first = r.t_drain is None
                 r.t_drain = now
+                # first drain only: a requeued (KV-deferred) request's
+                # second wait is the DEFER episode, stamped by the
+                # decode engine when the re-admit finally lands
+                if first and r.stages is not None:
+                    _attribution.stamp(
+                        r, "wfq_wait", r.t_submit, now,
+                        attrs={"tenant_class": r.tenant_class})
             return out
 
     def requeue(self, request):

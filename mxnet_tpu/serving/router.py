@@ -103,6 +103,7 @@ import numpy as np
 
 from .. import compile_cache, envvars
 from ..retrying import Reconnector
+from ..telemetry import attribution as _attribution
 from ..telemetry import events as _events
 from ..telemetry import incidents as _incidents
 from ..telemetry import profiling as _profiling
@@ -171,7 +172,7 @@ class RouterRequest:
                  "trace_id", "span", "t_submit", "tried", "engine_id",
                  "requeues", "cid", "adopted", "decode", "stream",
                  "parts_seen", "relay_lock", "model_id", "tenant",
-                 "tenant_class")
+                 "tenant_class", "stages", "t_activity")
 
     def __init__(self, tokens, token_types=None, deadline_ms=None,
                  decode=None, stream=False, model_id=None, tenant=None,
@@ -216,6 +217,11 @@ class RouterRequest:
         self.stream = bool(stream)
         self.parts_seen = 0
         self.relay_lock = threading.Lock()
+        # router-side stage stamps (dispatch transit, HA-journal ack):
+        # the ENGINE's decomposition rides the reply; these feed the
+        # router's own /whyslow aggregator
+        self.stages = [] if _attribution.enabled() else None
+        self.t_activity = None
 
     def remaining_ms(self, now=None):
         if self.deadline is None:
@@ -388,6 +394,12 @@ class _Seat:
     def alerts_snapshot(self):
         return None
 
+    def whyslow(self):
+        """This seat's /whyslow body (None when the engine has no
+        stage attribution — MXNET_TPU_ATTRIBUTION=0, or an old
+        peer)."""
+        return None
+
     def maintain(self):
         """Poll-thread housekeeping (wire connection upkeep)."""
 
@@ -440,7 +452,8 @@ class _LocalSeat(_Seat):
             exc = f.exception(timeout=0)
             done(self, req, exc,
                  None if exc is not None else f.result(timeout=0),
-                 cost=f.cost)
+                 cost=f.cost,
+                 breakdown=getattr(f, "breakdown", None))
 
         fut.add_done_callback(_cb)
 
@@ -473,6 +486,12 @@ class _LocalSeat(_Seat):
             if self._engine.alerts is None:
                 return None
             return self._engine.alerts_snapshot()
+        except Exception:
+            return None
+
+    def whyslow(self):
+        try:
+            return self._engine.whyslow()
         except Exception:
             return None
 
@@ -573,6 +592,7 @@ class _RemoteSeat(_Seat):
         if req.stream:
             payload["stream"] = True
         t0 = time.perf_counter()
+        t0m = time.monotonic()
 
         def _on_part(body):
             req.relay_part(body.get("seq"), body.get("token"))
@@ -592,8 +612,19 @@ class _RemoteSeat(_Seat):
                 if self._overhead is not None and engine_ms is not None:
                     self._overhead.observe("wire",
                                            rt_ms - float(engine_ms))
+                # dispatch transit: the whole round trip as one span —
+                # the engine's own stage/* children start later, so the
+                # innermost-wins extractor bills them their slices and
+                # the remainder (serialize + queue + socket) to
+                # ``dispatch``
+                _attribution.stamp(
+                    req, "dispatch", t0m, time.monotonic(),
+                    attrs={"transport": "wire",
+                           "engine_id": self.engine_id,
+                           "engine_ms": engine_ms})
                 done(self, req, None, np.asarray(body.get("result")),
-                     cost=body.get("cost"))
+                     cost=body.get("cost"),
+                     breakdown=body.get("breakdown"))
                 return
             if err_type == "WireError":
                 # protocol-level refusal from the listener (bad frame
@@ -652,8 +683,9 @@ class _RemoteSeat(_Seat):
         # legacy thread-per-in-flight-request bomb (in-process seats
         # resolve via callbacks)
         def _run():
-            exc = value = cost = None
+            exc = value = cost = breakdown = None
             body = None
+            t0m = time.monotonic()
             try:
                 data = json.dumps(payload).encode()
                 self._b_out_json.inc(len(data))
@@ -707,18 +739,24 @@ class _RemoteSeat(_Seat):
                                                         "decode"))
                                        else np.float32)
                     cost = body.get("cost")
+                    breakdown = body.get("breakdown")
                     engine_ms = body.get("engine_ms")
                     if self._overhead is not None \
                             and engine_ms is not None:
                         self._overhead.observe(
                             "json", (time.perf_counter() - t0) * 1e3
                             - float(engine_ms))
+                    _attribution.stamp(
+                        req, "dispatch", t0m, time.monotonic(),
+                        attrs={"transport": "json",
+                               "engine_id": self.engine_id,
+                               "engine_ms": engine_ms})
                 else:
                     cls = _ERROR_CLASSES.get(body.get("error_type"),
                                              ServingError)
                     exc = cls(body.get("error")
                               or f"engine {self.engine_id} error")
-            done(self, req, exc, value, cost=cost)
+            done(self, req, exc, value, cost=cost, breakdown=breakdown)
 
         if not self._pool.submit(_run):
             done(self, req, RemoteEngineError(
@@ -814,6 +852,15 @@ class _RemoteSeat(_Seat):
             return None
         return snap if "open" in snap else None
 
+    def whyslow(self):
+        # a 404 body ({"error": "no stage attribution"}) parses but is
+        # not a snapshot: only stage-bearing replies count
+        try:
+            snap = json.loads(self._get("/whyslow"))
+        except Exception:
+            return None
+        return snap if "stages" in snap else None
+
 
 class ServingRouter:
     """Least-outstanding front door over N serving engines.
@@ -880,6 +927,9 @@ class ServingRouter:
         # /slo + /alerts; exemplar gate shared with the engine via
         # metrics.exemplar_gate/slow_exemplar
         self._slo = None
+        # memoized fleet top-stage attribution for alert payloads
+        # (ts, rows) — see _whyslow_top
+        self._whyslow_top_cache = None
         # black-box canary prober (MXNET_TPU_CANARY): built in
         # start(), probes every seat from outside over wire + HTTP and
         # feeds the per-seat canary-absence page rules
@@ -1116,6 +1166,12 @@ class ServingRouter:
             evaluator = SloEvaluator(self.router_id)
             names = default_router_objectives(evaluator, self)
             self._slo = AlertDaemon(evaluator)
+            # fleet "why slow" on the fleet page: the router's own
+            # aggregator only sees dispatch/ha_ack, so a firing
+            # fleet_latency payload attaches the MERGED top stages
+            # (short TTL cache — /alerts renders every rule's payload
+            # and must not re-scrape every seat per rule)
+            self._slo.attribution_fn = self._whyslow_top
             default_burn_rules(self._slo, names)
             self._slo.start()
         # black-box monitoring: the canary prober serves the product
@@ -1463,7 +1519,8 @@ class ServingRouter:
             + (f" (tried {sorted(req.tried)})" if req.tried else "")),
             None, force_keep=True)
 
-    def _on_done(self, seat, req, exc, value, cost=None):
+    def _on_done(self, seat, req, exc, value, cost=None,
+                 breakdown=None):
         with self._lock:
             seat.outstanding = max(0, seat.outstanding - 1)
         if exc is None:
@@ -1481,6 +1538,13 @@ class ServingRouter:
                 # router's caller (remote seats carry it in the
                 # /submit body) so cost attribution survives fronting
                 req.future.cost = cost
+            if breakdown is not None:
+                # the ENGINE's critical-path decomposition, relayed
+                # verbatim (wire and HTTP seats carry it in the reply
+                # body, local seats on the future) — the caller sees
+                # the same breakdown it would have engine-direct
+                req.future.breakdown = breakdown
+            self._observe_router_stages(req, total_ms)
             req.future.set_result(value)
             self._ha_release(req)
             self._resolve()
@@ -1534,6 +1598,30 @@ class ServingRouter:
         req.future.set_exception(exc)
         self._ha_release(req)
         self._resolve()
+
+    def _observe_router_stages(self, req, total_ms):
+        """Feed the ROUTER-owned stages (dispatch transit, HA-journal
+        ack) into this router's /whyslow aggregator. Only the stages
+        the router itself timed are billed here — the engine's own
+        decomposition aggregates engine-side and reaches the fleet
+        view through the /whyslow merge, so nothing double-counts."""
+        if not req.stages:
+            return
+        per = {}
+        for name, a, b in req.stages:
+            if name in ("dispatch", "ha_ack"):
+                per[name] = per.get(name, 0.0) + (b - a)
+        if not per:
+            return
+        rb = {"wall_ms": total_ms, "trace_id": req.trace_id,
+              "stages": [{"stage": s, "ms": round(v * 1e3, 3),
+                          "share": (round(v * 1e3 / total_ms, 4)
+                                    if total_ms > 0 else 0.0)}
+                         for s, v in per.items()],
+              "unattributed_ms": 0.0}
+        _attribution.aggregator(self.router_id).observe(
+            rb, tenant_class=req.tenant_class, model=req.model_id,
+            trace_id=req.trace_id)
 
     def _resolve(self):
         with self._cond:
@@ -1931,6 +2019,7 @@ class ServingRouter:
             return
         acked = threading.Event()
         box = {}
+        t_ack0 = time.monotonic()
 
         def _on_ack(exc, body):
             # the reader delivers ERROR frames with exc=None and the
@@ -1955,7 +2044,12 @@ class ServingRouter:
         except WireError:
             self._ha_count("skip")
             return
-        if acked.wait(self._ha_ack_s) and box.get("ok"):
+        ok = acked.wait(self._ha_ack_s) and box.get("ok")
+        # the durability wait is on the request's critical path — a
+        # slow peer surfaces as an ``ha_ack`` stage in /whyslow
+        _attribution.stamp(req, "ha_ack", t_ack0, time.monotonic(),
+                           attrs={"acked": bool(ok)})
+        if ok:
             self._ha_count("journal")
         else:
             self._ha_count("ack_miss")
@@ -2410,6 +2504,40 @@ class ServingRouter:
         out["fleet_pending"] = pending
         return out
 
+    def whyslow(self):
+        """The fleet ``/whyslow`` body: the router's own stage table
+        (dispatch transit, HA-journal ack) merged with every seat's
+        per-stage breakdown — one endpoint answers "the fleet is slow,
+        WHICH stage, on WHICH engine, and here is the worst trace".
+        Seats without attribution (disabled, old peers) simply
+        contribute nothing."""
+        parts = []
+        agg = _attribution.get_aggregator(self.router_id)
+        if agg is not None:
+            parts.append(agg.snapshot())
+        with self._lock:
+            seats = list(self._seats.values())
+        for seat in seats:
+            parts.append(seat.whyslow())
+        return _attribution.merge_whyslow(parts, owner=self.router_id)
+
+    def _whyslow_top(self):
+        """Fleet top-stage rows for firing alert payloads, memoized
+        for ~1s: /alerts renders every rule's payload in one pass and
+        must not re-scrape every remote seat's /whyslow per rule.
+        An EMPTY result only lives ~0.1s (one render pass): under a
+        fast-burn overload the fleet rule can fire within the long
+        TTL, and the page must not inherit a pre-traffic empty memo —
+        it exists to say WHERE the fleet is slow."""
+        now = time.monotonic()
+        cached = self._whyslow_top_cache
+        if cached is not None and \
+                now - cached[0] < (1.0 if cached[1] else 0.1):
+            return cached[1]
+        top = (self.whyslow() or {}).get("top") or None
+        self._whyslow_top_cache = (now, top)
+        return top
+
     def incidents_snapshot(self):
         """The fleet ``/incidents`` body: this process's incident
         tracker (the router's own signals + every in-process seat's —
@@ -2509,7 +2637,8 @@ class ServingRouter:
                      "router_id": self.router_id,
                      "router_ms": round(
                          (time.perf_counter() - t0) * 1e3, 3),
-                     "cost": getattr(fut, "cost", None)}
+                     "cost": getattr(fut, "cost", None),
+                     "breakdown": getattr(fut, "breakdown", None)}
 
     def _healthz(self):
         board = self.scoreboard()
@@ -2529,7 +2658,8 @@ class ServingRouter:
         engine is routable), ``/stats`` (scoreboard + counters), the
         merged ``/traces`` + ``/traces/<id>``, the fleet ``/costs``
         cost table, ``/slo`` + ``/alerts`` (fleet objectives + every
-        seat's seat-level view), and ``POST /submit`` so clients
+        seat's seat-level view), the fleet ``/whyslow`` stage
+        attribution table, and ``POST /submit`` so clients
         (e.g. ``serve_loadgen --router-url``) can drive this router
         from another process. Closed by :meth:`stop`."""
         from ..telemetry.expo import TelemetryServer
@@ -2551,6 +2681,7 @@ class ServingRouter:
                                   slo_fn=self.slo_snapshot,
                                   alerts_fn=self.alerts_snapshot,
                                   incidents_fn=self.incidents_snapshot,
+                                  whyslow_fn=self.whyslow,
                                   history_fn=(
                                       self._history.store
                                       if self._history is not None

@@ -40,8 +40,8 @@ Two things live here:
                             decode: "max_new_tokens", "eos_id",
                             "stream", "temperature", "top_k", "top_p",
                             "seed"})
-       ("RESULT", corr_id, {"result": ndarray, "cost", "engine_ms",
-                            "trace_id"})
+       ("RESULT", corr_id, {"result": ndarray, "cost", "breakdown",
+                            "engine_ms", "trace_id"})
        ("ERROR",  corr_id, {"error_type", "error"})
        ("PING", n) / ("PONG", n)
 
@@ -659,6 +659,10 @@ class WireListener:
                 return
             body = {"result": np.asarray(f.result(timeout=0)),
                     "cost": f.cost,
+                    # the engine-measured critical path rides the
+                    # final RESULT frame verbatim, like cost: router
+                    # and loadgen must see the same numbers
+                    "breakdown": getattr(f, "breakdown", None),
                     "trace_id": f.trace_id,
                     "engine_ms": engine_ms,
                     "engine_id": self._engine.engine_id}

@@ -293,6 +293,12 @@ class AlertDaemon:
         self._history_len = (int(history) if history is not None
                              else envvars.get("MXNET_TPU_ALERT_HISTORY"))
         self._on_page = on_page
+        # optional "why slow" override: a callable returning top-stage
+        # attribution rows for the payload of firing latency alerts.
+        # Defaults to this owner's own aggregator; a ROUTER points it
+        # at its fleet /whyslow merge so the fleet page names the
+        # bottleneck stage even when every seat is out-of-process.
+        self.attribution_fn = None
         self._rules = OrderedDict()     # name -> _AlertStatus
         self._listeners = []            # fn(transition_record)
         self._transitions = deque(maxlen=self._history_len)
@@ -569,6 +575,21 @@ class AlertDaemon:
                 except Exception:
                     live = []
                 out["exemplars"] = live or exemplars
+                # "why slow" rides the page: the owner's current
+                # top-stage attribution (lazy import + peek-no-create:
+                # a process without attribution never mints the stage
+                # families just because an alert was described)
+                try:
+                    if self.attribution_fn is not None:
+                        top = self.attribution_fn()
+                    else:
+                        from . import attribution as _attribution
+                        top = _attribution.top_stages_for(
+                            self.owner_id)
+                except Exception:
+                    top = None
+                if top:
+                    out["attribution"] = top
         return out
 
     def snapshot(self):

@@ -117,6 +117,10 @@ class TelemetryServer:
         store``) backing ``/query_range`` and ``/series``; None = the
         process's first live history scraper's store (404 when the
         history subsystem is off).
+    whyslow_fn : ``() -> dict`` enabling ``/whyslow`` (the owner's
+        per-stage latency-attribution table from
+        :mod:`.attribution`, or the router's fleet merge); None =
+        404.
     profile_fn : ``() -> str | dict`` overriding ``/profile``; None =
         the process continuous profiler (:mod:`.profiling`) — a str
         serves as collapsed text, a dict as JSON.
@@ -129,8 +133,8 @@ class TelemetryServer:
                  metrics_fn=None, traces_fn=None, trace_fn=None,
                  submit_fn=None, warmup_fn=None, costs_fn=None,
                  profile_fn=None, slo_fn=None, alerts_fn=None,
-                 incidents_fn=None, history_fn=None, port=0,
-                 host="127.0.0.1"):
+                 incidents_fn=None, history_fn=None, whyslow_fn=None,
+                 port=0, host="127.0.0.1"):
         self.registry = registry if registry is not None else REGISTRY
         self.healthz_fn = healthz_fn
         self.stats_fn = stats_fn
@@ -145,6 +149,7 @@ class TelemetryServer:
         self.alerts_fn = alerts_fn
         self.incidents_fn = incidents_fn
         self.history_fn = history_fn
+        self.whyslow_fn = whyslow_fn
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -282,6 +287,9 @@ class TelemetryServer:
             self._json_fn(handler, self.slo_fn, "no SLO evaluator")
         elif path == "/alerts":
             self._json_fn(handler, self.alerts_fn, "no alert daemon")
+        elif path == "/whyslow":
+            self._json_fn(handler, self.whyslow_fn,
+                          "no stage attribution")
         elif path == "/incidents":
             if self.incidents_fn is not None:
                 self._json_fn(handler, self.incidents_fn, "")
@@ -301,7 +309,7 @@ class TelemetryServer:
             self._reply(handler, 404, "text/plain",
                         b"try /metrics, /healthz, /stats, /traces, "
                         b"/profile, /costs, /slo, /alerts, /incidents, "
-                        b"/query_range, /series or /warmup\n")
+                        b"/whyslow, /query_range, /series or /warmup\n")
 
     def _history_store(self):
         """Resolve the ``/query_range``/``/series`` backing store:

@@ -155,6 +155,11 @@ DEFAULT_RULES = (
                   kind="gauge"),
     RecordingRule("incidents_open",
                   family="mxnet_tpu_incidents_open", kind="gauge"),
+    RecordingRule("stage_latency",
+                  family="mxnet_tpu_serving_stage_latency_ms",
+                  kind="histogram"),
+    RecordingRule("stage_seconds",
+                  family="mxnet_tpu_serving_stage_seconds_total"),
 )
 
 

@@ -1,0 +1,439 @@
+"""Tail-latency attribution (ISSUE 19): per-request critical paths
+through the decode loop and the fleet /whyslow engine.
+
+- stamp/extractor unit goldens: sched_gap backfill, innermost-wins
+  overlap resolution, clipping, the explicit ``unattributed``
+  remainder (attributed + unattributed == wall by construction);
+- span-tree → :func:`critical_path` golden incl. the legacy
+  synthesized children (``serving/forward`` → ``compute``);
+- live decode engine: a STREAMED request's ``InferenceFuture.
+  breakdown`` decomposes its own wall gap-free, and the engine's
+  ``/whyslow`` aggregator saw it;
+- router relay identity: the engine-computed breakdown arrives
+  UNCHANGED whether the dispatch rode the binary wire or chunked-JSON
+  HTTP, and the router's own ``dispatch`` stage lands in the router's
+  aggregator — never inside the engine's decomposition;
+- alert payloads: a firing latency rule attaches the owner's
+  top-stage attribution with a RETRIEVABLE exemplar trace;
+- the ``MXNET_TPU_ATTRIBUTION=0`` disabled path: no stamp lists, no
+  breakdowns, no stage metric families, stamps are no-ops.
+
+CPU-only: stub/toy models, scaled SLO windows.
+"""
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu  # noqa: F401  (configures jax for the CPU mesh)
+from mxnet_tpu.telemetry import alerts as alerts_mod
+from mxnet_tpu.telemetry import attribution as _attribution
+from mxnet_tpu.telemetry import slo as slo_mod
+from mxnet_tpu.telemetry import spans
+from mxnet_tpu.telemetry.registry import MetricsRegistry
+
+
+@pytest.fixture(autouse=True)
+def _fresh_attribution():
+    """Drop aggregators + cached gates around every test so one
+    test's observations (or a disabled-path override) never leak into
+    the next; restore the span recorder's slow threshold too."""
+    slow_ms = spans.RECORDER.slow_ms
+    _attribution.reset()
+    yield
+    _attribution.reset()
+    spans.RECORDER.slow_ms = slow_ms
+
+
+class _Req:
+    """The slots :func:`attribution.stamp` needs, nothing else."""
+
+    def __init__(self):
+        self.stages = []
+        self.t_activity = None
+        self.trace_id = "t-unit"
+        self.span = None
+
+
+# ---------------------------------------------------------------------------
+# stamp + extractor unit goldens
+# ---------------------------------------------------------------------------
+
+def test_stamp_gap_backfill_and_innermost_wins():
+    req = _Req()
+    t0 = 100.0
+    _attribution.stamp(req, "wfq_wait", t0, t0 + 0.010, span=False)
+    # 10ms idle before the prefill: backfilled as an explicit
+    # sched_gap interval, not smeared into unattributed
+    _attribution.stamp(req, "prefill", t0 + 0.020, t0 + 0.050,
+                       span=False)
+    _attribution.stamp(req, "decode_iter", t0 + 0.050, t0 + 0.100,
+                       span=False)
+    # nested COW copy inside the iteration: innermost wins, and the
+    # activity clock must NOT rewind (no phantom gap after it)
+    _attribution.stamp(req, "cow_copy", t0 + 0.060, t0 + 0.070,
+                       span=False)
+    _attribution.stamp(req, "decode_iter", t0 + 0.100, t0 + 0.120,
+                       span=False)
+    assert ("sched_gap", t0 + 0.010, t0 + 0.020) in req.stages
+
+    bd = _attribution.breakdown_from_stamps(req.stages, t0, t0 + 0.120,
+                                            trace_id=req.trace_id)
+    assert bd["trace_id"] == "t-unit"
+    assert bd["wall_ms"] == pytest.approx(120.0, abs=1e-6)
+    per = {s["stage"]: s["ms"] for s in bd["stages"]}
+    assert per["wfq_wait"] == pytest.approx(10.0, abs=1e-3)
+    assert per["sched_gap"] == pytest.approx(10.0, abs=1e-3)
+    assert per["prefill"] == pytest.approx(30.0, abs=1e-3)
+    # 70ms of iteration residency minus the 10ms billed to the copy
+    assert per["decode_iter"] == pytest.approx(60.0, abs=1e-3)
+    assert per["cow_copy"] == pytest.approx(10.0, abs=1e-3)
+    assert bd["unattributed_ms"] == pytest.approx(0.0, abs=1e-3)
+    assert bd["attributed_ms"] + bd["unattributed_ms"] == \
+        pytest.approx(bd["wall_ms"], abs=0.01)
+    # ordered by first occurrence on the timeline
+    assert [s["stage"] for s in bd["stages"]] == \
+        ["wfq_wait", "sched_gap", "prefill", "decode_iter", "cow_copy"]
+    # shares are of wall and sum to ~1 with nothing unattributed
+    assert sum(s["share"] for s in bd["stages"]) == \
+        pytest.approx(1.0, abs=0.01)
+
+
+def test_breakdown_clips_to_wall_and_reports_holes():
+    # first stamp overhangs the wall start, last overhangs the end,
+    # and a 30ms hole sits between them: clipped + explicit remainder
+    stamps = [("queue", 99.90, 100.02),
+              ("compute", 100.05, 100.12)]
+    bd = _attribution.breakdown_from_stamps(stamps, 100.0, 100.10)
+    per = {s["stage"]: s["ms"] for s in bd["stages"]}
+    assert per["queue"] == pytest.approx(20.0, abs=1e-3)
+    assert per["compute"] == pytest.approx(50.0, abs=1e-3)
+    assert bd["unattributed_ms"] == pytest.approx(30.0, abs=1e-3)
+    assert bd["attributed_ms"] + bd["unattributed_ms"] == \
+        pytest.approx(bd["wall_ms"], abs=0.01)
+    # degenerate wall: empty decomposition, never a crash
+    empty = _attribution.breakdown_from_stamps(stamps, 100.0, 100.0)
+    assert empty["stages"] == [] and empty["wall_ms"] == 0.0
+
+
+def test_stamp_rejects_unregistered_stage_and_noops_when_off():
+    req = _Req()
+    with pytest.raises(ValueError):
+        _attribution.stamp(req, "warmupp", 0.0, 1.0, span=False)
+    off = _Req()
+    off.stages = None           # the disabled-request shape
+    _attribution.stamp(off, "decode_iter", 0.0, 1.0, span=False)
+    assert off.stages is None and off.t_activity is None
+
+
+# ---------------------------------------------------------------------------
+# span tree -> critical path
+# ---------------------------------------------------------------------------
+
+def test_critical_path_span_tree_golden():
+    spans_list = [
+        {"span_id": "r", "trace_id": "t1", "name": "serving/request",
+         "ts_us": 0, "dur_us": 100_000},
+        {"span_id": "a", "parent_id": "r", "trace_id": "t1",
+         "name": "stage/wfq_wait", "ts_us": 0, "dur_us": 10_000},
+        {"span_id": "b", "parent_id": "r", "trace_id": "t1",
+         "name": "stage/decode_iter", "ts_us": 10_000,
+         "dur_us": 60_000},
+        # nested under the iteration: innermost wins
+        {"span_id": "c", "parent_id": "b", "trace_id": "t1",
+         "name": "stage/cow_copy", "ts_us": 20_000, "dur_us": 10_000},
+        # legacy synthesized child: maps onto the canonical stage
+        {"span_id": "d", "parent_id": "r", "trace_id": "t1",
+         "name": "serving/forward", "ts_us": 70_000, "dur_us": 20_000},
+        # structure, not a stage: ignored even though it spans the wall
+        {"span_id": "e", "parent_id": "r", "trace_id": "t1",
+         "name": "decode/loop", "ts_us": 0, "dur_us": 100_000},
+        # a stage span from ANOTHER tree (unresolvable parent): must
+        # not leak into this decomposition
+        {"span_id": "x", "parent_id": "zz", "trace_id": "t9",
+         "name": "stage/prefill", "ts_us": 0, "dur_us": 50_000},
+    ]
+    bd = _attribution.critical_path(spans_list)
+    assert bd["trace_id"] == "t1"
+    assert bd["wall_ms"] == pytest.approx(100.0)
+    per = {s["stage"]: s["ms"] for s in bd["stages"]}
+    assert per == {"wfq_wait": pytest.approx(10.0),
+                   "decode_iter": pytest.approx(50.0),
+                   "cow_copy": pytest.approx(10.0),
+                   "compute": pytest.approx(20.0)}
+    assert "prefill" not in per
+    assert bd["unattributed_ms"] == pytest.approx(10.0)
+    assert bd["attributed_ms"] + bd["unattributed_ms"] == \
+        pytest.approx(bd["wall_ms"], abs=0.01)
+    assert _attribution.critical_path([]) == {
+        "wall_ms": 0.0, "stages": [], "attributed_ms": 0.0,
+        "unattributed_ms": 0.0}
+
+
+# ---------------------------------------------------------------------------
+# live decode engine: streamed breakdown + /whyslow
+# ---------------------------------------------------------------------------
+
+def _mk_model(**kw):
+    from mxnet_tpu.serving import PagedCausalLM
+
+    args = dict(vocab=64, units=32, layers=2, heads=4, max_len=128,
+                seed=7)
+    args.update(kw)
+    return PagedCausalLM(**args)
+
+
+def _mk_engine(model=None, **kw):
+    from mxnet_tpu.serving import DecodeEngine
+
+    args = dict(prefill_bucket_lens=(8, 16), max_rows=4, page_size=8,
+                n_pages=24, max_new_tokens=6)
+    args.update(kw)
+    return DecodeEngine(model if model is not None else _mk_model(),
+                        **args)
+
+
+def test_decode_streamed_breakdown_sums_to_wall():
+    with _mk_engine(engine_id="bd0") as eng:
+        fut = eng.submit([1, 2, 3, 4], max_new_tokens=6, stream=True)
+        parts = list(fut.stream(timeout=60))
+        out = fut.result(timeout=0)
+        assert [p["token"] for p in parts] == np.asarray(out).tolist()
+
+        bd = fut.breakdown
+        assert bd is not None, "no breakdown on a completed future"
+        names = [s["stage"] for s in bd["stages"]]
+        assert set(names) <= set(_attribution.STAGES)
+        assert "decode_iter" in names
+        assert "wfq_wait" in names
+        assert bd["trace_id"]
+        # gap-free by construction...
+        assert bd["attributed_ms"] + bd["unattributed_ms"] == \
+            pytest.approx(bd["wall_ms"], abs=0.05)
+        # ...and the stages actually cover the wall (the bench leg
+        # holds the aggregate to >=95%; one quiet request clears 90%)
+        assert bd["attributed_ms"] >= 0.9 * bd["wall_ms"], bd
+
+        # the engine's /whyslow aggregator folded the same request in
+        ws = eng.whyslow()
+        assert ws["owner"] == "bd0" and ws["requests"] >= 1
+        assert any(r["stage"] == "decode_iter" for r in ws["stages"])
+        assert ws["top"] and ws["top"][0]["share"] > 0
+
+
+# ---------------------------------------------------------------------------
+# router relay: wire vs HTTP identity
+# ---------------------------------------------------------------------------
+
+def _drive_router(url, wire, prompt, n=3):
+    from mxnet_tpu.serving import ServingRouter
+
+    with ServingRouter({"bdx": url}, poll_interval_s=0.1,
+                       wire=wire) as router:
+        if wire:
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline and not all(
+                    row.get("transport") == "wire"
+                    for row in router.scoreboard().values()):
+                time.sleep(0.05)
+            assert all(row.get("transport") == "wire"
+                       for row in router.scoreboard().values()), \
+                router.scoreboard()
+        bds = []
+        for _ in range(n):
+            fut = router.submit(prompt, max_new_tokens=5)
+            fut.result(timeout=60)
+            bds.append(fut.breakdown)
+        router_ws = router.whyslow()
+        router_agg = _attribution.get_aggregator(router.router_id)
+        router_snap = (router_agg.snapshot()
+                       if router_agg is not None else None)
+    return bds, router_ws, router_snap
+
+
+def test_router_wire_vs_http_breakdown_identity(monkeypatch):
+    """The engine-computed decomposition rides the reply VERBATIM on
+    both transports — same shape, same canonical stages, summing to
+    its own wall — and the router's transit time lands in the
+    ROUTER's aggregator as ``dispatch``, never inside the engine's
+    breakdown (no double counting in the fleet merge)."""
+    monkeypatch.setenv("MXNET_TPU_WIRE", "1")
+    with _mk_engine(engine_id="bdx") as eng:
+        eng.expose()
+        url = f"http://127.0.0.1:{eng._expo.port}"
+        wire_bds, wire_ws, wire_snap = _drive_router(url, True,
+                                                     [5, 4, 3])
+        http_bds, _, http_snap = _drive_router(url, False, [5, 4, 3])
+
+    for bds in (wire_bds, http_bds):
+        for bd in bds:
+            assert bd is not None
+            assert set(bd) == {"wall_ms", "stages", "attributed_ms",
+                               "unattributed_ms", "trace_id"}
+            names = [s["stage"] for s in bd["stages"]]
+            assert set(names) <= set(_attribution.STAGES)
+            assert "decode_iter" in names
+            # router-side stages never leak into the ENGINE's numbers
+            assert "dispatch" not in names and "ha_ack" not in names
+            assert bd["attributed_ms"] + bd["unattributed_ms"] == \
+                pytest.approx(bd["wall_ms"], abs=0.05)
+    # both transports produced the identical decomposition SHAPE
+    assert set(wire_bds[0]) == set(http_bds[0])
+
+    # the routers' own aggregators saw ONLY router-owned stages
+    for snap in (wire_snap, http_snap):
+        assert snap is not None, "router never observed its dispatch"
+        stages = {r["stage"] for r in snap["stages"]}
+        assert "dispatch" in stages
+        assert stages <= {"dispatch", "ha_ack"}
+    # and the fleet /whyslow merge stitches engine + router tables
+    assert wire_ws.get("fleet") is True
+    merged = {r["stage"] for r in wire_ws["stages"]}
+    assert "decode_iter" in merged and "dispatch" in merged
+    assert wire_ws["top"], wire_ws
+
+
+# ---------------------------------------------------------------------------
+# alert payloads carry attribution with a retrievable trace
+# ---------------------------------------------------------------------------
+
+def test_firing_latency_alert_attaches_top_stage_attribution():
+    spans.configure(enabled=True, slow_ms=5.0)
+    # a real recorded trace: the exemplar the page must link to
+    sp = spans.start_span("serving/request", forced=True)
+    tid = sp.trace_id
+    time.sleep(0.002)
+    sp.end()
+    assert spans.get_trace(tid) is not None
+
+    reg = MetricsRegistry()
+    agg = _attribution.aggregator("ap-owner", registry=reg)
+    agg.observe({"wall_ms": 50.0, "trace_id": tid,
+                 "stages": [{"stage": "wfq_wait", "ms": 40.0,
+                             "share": 0.8},
+                            {"stage": "decode_iter", "ms": 8.0,
+                             "share": 0.16}],
+                 "unattributed_ms": 2.0},
+                tenant_class="standard", model="m1", trace_id=tid)
+
+    hist = reg.histogram("mxnet_tpu_t_ap_latency_ms", "t", ("stage",),
+                         buckets=(10.0, 100.0))
+    ev = slo_mod.SloEvaluator("ap-owner", registry=reg, scale=0.01,
+                              budget_s=100.0)
+    ev.add(slo_mod.LatencySLO("lat", threshold_ms=10.0,
+                              family="mxnet_tpu_t_ap_latency_ms",
+                              registry=reg))
+    pages = []
+    daemon = alerts_mod.AlertDaemon(ev, eval_s=3600.0, registry=reg,
+                                    on_page=pages.append)
+    daemon.add_rule(alerts_mod.BurnRateRule(
+        "lat_fast", "lat", long_window="1h", short_window="5m",
+        factor=14.4, severity=alerts_mod.PAGE, for_s=0.0))
+    # scripted clock: every request blows the 10ms objective -> burn
+    # 100x on both windows -> pending -> firing
+    now0 = time.monotonic()
+    daemon.evaluate_once(now0)
+    state = None
+    for i in range(1, 8):
+        for _ in range(5):
+            hist.labels(stage="total").observe(500.0, exemplar=tid)
+        state = daemon.evaluate_once(now0 + i)["lat_fast"]
+        if state == "firing":
+            break
+    assert state == "firing", daemon.snapshot()
+    assert pages, "firing page never emitted"
+    top = pages[-1].get("attribution")
+    assert top, pages[-1]
+    # ranked by share of attributed time: the injected bottleneck
+    # stage leads, carrying the retrievable exemplar
+    assert top[0]["stage"] == "wfq_wait"
+    assert top[0]["share"] > 0.5
+    assert top[0]["exemplar"] == tid
+    assert spans.get_trace(top[0]["exemplar"]) is not None
+    spans.reset()
+
+
+def test_alert_attribution_fn_override_wins():
+    """Routers point ``attribution_fn`` at the fleet /whyslow merge —
+    the hook's rows must win over the owner-keyed default lookup."""
+    reg = MetricsRegistry()
+    hist = reg.histogram("mxnet_tpu_t_ov_latency_ms", "t", ("stage",),
+                         buckets=(10.0, 100.0))
+    ev = slo_mod.SloEvaluator("ov-owner", registry=reg, scale=0.01,
+                              budget_s=100.0)
+    ev.add(slo_mod.LatencySLO("lat", threshold_ms=10.0,
+                              family="mxnet_tpu_t_ov_latency_ms",
+                              registry=reg))
+    pages = []
+    daemon = alerts_mod.AlertDaemon(ev, eval_s=3600.0, registry=reg,
+                                    on_page=pages.append)
+    daemon.attribution_fn = lambda: [
+        {"stage": "dispatch", "share": 0.9, "p99_ms": 12.0,
+         "count": 3, "total_ms": 36.0, "exemplar": None}]
+    daemon.add_rule(alerts_mod.BurnRateRule(
+        "lat_fast", "lat", long_window="1h", short_window="5m",
+        factor=14.4, severity=alerts_mod.PAGE, for_s=0.0))
+    now0 = time.monotonic()
+    daemon.evaluate_once(now0)
+    for i in range(1, 8):
+        for _ in range(5):
+            hist.labels(stage="total").observe(500.0)
+        if daemon.evaluate_once(now0 + i)["lat_fast"] == "firing":
+            break
+    assert pages and pages[-1]["attribution"][0]["stage"] == "dispatch"
+
+
+# ---------------------------------------------------------------------------
+# fleet merge
+# ---------------------------------------------------------------------------
+
+def test_merge_whyslow_recomputes_fleet_top():
+    a = _attribution.StageBreakdown("e0", registry=MetricsRegistry())
+    b = _attribution.StageBreakdown("e1", registry=MetricsRegistry())
+    a.observe({"wall_ms": 100.0,
+               "stages": [{"stage": "decode_iter", "ms": 90.0}],
+               "unattributed_ms": 10.0})
+    b.observe({"wall_ms": 100.0,
+               "stages": [{"stage": "wfq_wait", "ms": 60.0},
+                          {"stage": "decode_iter", "ms": 40.0}],
+               "unattributed_ms": 0.0})
+    merged = _attribution.merge_whyslow(
+        [a.snapshot(), None, b.snapshot()], owner="r0")
+    assert merged["owner"] == "r0" and merged["fleet"] is True
+    assert merged["engines"] == ["e0", "e1"]
+    assert merged["requests"] == 2
+    # decode_iter dominates fleet-wide (130ms vs 60ms wfq_wait)
+    assert merged["top"][0]["stage"] == "decode_iter"
+    assert merged["top"][0]["total_ms"] == pytest.approx(130.0)
+    rows_engines = {r["engine_id"] for r in merged["stages"]}
+    assert rows_engines == {"e0", "e1"}
+
+
+# ---------------------------------------------------------------------------
+# disabled path: MXNET_TPU_ATTRIBUTION=0 costs ~nothing
+# ---------------------------------------------------------------------------
+
+def test_disabled_path_no_families_no_breakdowns(monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_ATTRIBUTION", "0")
+    _attribution.reset()        # re-read the env gate
+    assert not _attribution.enabled()
+    with _mk_engine(engine_id="bdoff") as eng:
+        fut = eng.submit([1, 2, 3], max_new_tokens=4, stream=True)
+        list(fut.stream(timeout=60))
+        fut.result(timeout=0)
+        # no decomposition, no aggregator, no stage families minted
+        assert fut.breakdown is None
+        assert _attribution.get_aggregator("bdoff") is None
+        assert _attribution._families_cache is None
+        ws = eng.whyslow()
+        assert ws["enabled"] is False
+        assert ws.get("requests", 0) == 0 and not ws.get("stages")
+    # the disabled stamp is one attribute check: far under a µs —
+    # bound it loosely so a slow CI host never flakes
+    off = _Req()
+    off.stages = None
+    t0 = time.perf_counter()
+    for _ in range(10_000):
+        _attribution.stamp(off, "decode_iter", 0.0, 1.0, span=False)
+    per_call_us = (time.perf_counter() - t0) * 1e5 / 10_000 * 10
+    assert per_call_us < 50.0, per_call_us

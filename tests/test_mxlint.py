@@ -109,12 +109,20 @@ def test_fixture_telemetry_consistency():
         "metric-labels",
         "metric-tenant-label",
         "span-leak",
+        "stage-name-registry",      # .labels(stage="warmupp")
+        "stage-name-registry",      # match={"stage": "prefil"}
     ]
     leak = [f for f in project.findings if f.rule == "span-leak"]
     assert _line_mentions_rule(source, leak[0])
     tenant = [f for f in project.findings
               if f.rule == "metric-tenant-label"]
     assert "model" in tenant[0].message
+    stages = [f for f in project.findings
+              if f.rule == "stage-name-registry"]
+    assert {"'warmupp'" in f.message or "'prefil'" in f.message
+            for f in stages} == {True}
+    for f in stages:
+        assert _line_mentions_rule(source, f), f
 
 
 def test_fixture_env_registry():

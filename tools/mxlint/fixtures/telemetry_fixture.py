@@ -73,3 +73,18 @@ def history_rule_over_renamed_family(RecordingRule):
     return RecordingRule(
         "fx",
         family="mxnet_tpu_fixture_history_gone_total")  # history-rule-family
+
+
+def stage_label_canonical(lat):
+    lat.labels(engine_id="e0", stage="decode_iter").observe(1.0)  # clean
+
+
+def stage_label_unregistered(lat):
+    lat.labels(engine_id="e0",
+               stage="warmupp").observe(1.0)    # stage-name-registry
+
+
+def stage_match_unregistered(LatencySLO):
+    return LatencySLO(
+        "fx", 100, family="mxnet_tpu_fixture_total",
+        match={"stage": "prefil"})              # stage-name-registry

@@ -36,7 +36,15 @@
   must be declared somewhere in the scanned code — same contract as
   the dashboard and alert-rule checks. A rule over a renamed family
   records NOTHING, and the gap only surfaces months later when a
-  postmortem queries empty history for the exact window it needed.
+  postmortem queries empty history for the exact window it needed;
+- ``stage-name-registry`` — every ``stage=`` label literal (a
+  ``.labels(stage="...")`` call, a ``{"stage": "..."}`` SLO match
+  dict, or the stage argument of ``attribution.stamp`` /
+  ``stamp_interval``) must name a stage from the canonical
+  ``telemetry/attribution.py`` ``STAGES`` tuple. The stage axis joins
+  engine metrics, the router fleet merge, dashboards and the pager's
+  "why slow" attachment — one misspelled literal forks a stage into a
+  series nothing else aggregates, queries or pages on.
 """
 from __future__ import annotations
 
@@ -67,7 +75,8 @@ class TelemetryConsistencyPass(LintPass):
     name = "telemetry-consistency"
     rules = ("metric-labels", "metric-engine-label",
              "metric-tenant-label", "span-leak", "dashboard-family",
-             "alert-rule-family", "history-rule-family")
+             "alert-rule-family", "history-rule-family",
+             "stage-name-registry")
 
     def __init__(self):
         # family -> list of (labels tuple | None, relpath, line)
@@ -75,6 +84,7 @@ class TelemetryConsistencyPass(LintPass):
         self.patterns = []          # (regex, relpath, line) f-string fams
         self.rule_refs = []         # (family, relpath, line) SLO/alert refs
         self.history_refs = []      # (family, relpath, line) recording rules
+        self.stage_refs = []        # (stage, relpath, line) stage literals
 
     def check(self, ctx):
         out = []
@@ -82,6 +92,7 @@ class TelemetryConsistencyPass(LintPass):
             if isinstance(node, ast.Call):
                 out.extend(self._check_family_decl(ctx, node))
                 self._collect_rule_ref(ctx, node)
+                self._collect_stage_ref(ctx, node)
             elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 out.extend(self._check_span_pairing(ctx, node))
                 self._collect_sig_family_defaults(ctx, node)
@@ -184,6 +195,77 @@ class TelemetryConsistencyPass(LintPass):
             if fam is not None and fam.startswith("mxnet_tpu_"):
                 self.rule_refs.append((fam, ctx.relpath, default.lineno))
 
+    # -- stage-name registry -------------------------------------------------
+    def _collect_stage_ref(self, ctx, call):
+        """Every place a stage NAME appears as a literal: label values
+        on ``.labels(stage=...)``, SLO ``match={"stage": ...}`` dicts,
+        and the stage argument of ``attribution.stamp`` /
+        ``stamp_interval``. Resolved against the canonical ``STAGES``
+        tuple in ``finalize`` — dynamic values (variables, loop items)
+        are out of scope by construction; the registry itself feeds
+        those."""
+        term = terminal_attr(call.func)
+        if term == "labels":
+            for kw in call.keywords:
+                if kw.arg == "stage":
+                    val = str_const(kw.value)
+                    if val is not None:
+                        self.stage_refs.append(
+                            (val, ctx.relpath, kw.value.lineno))
+        elif term in ("stamp", "stamp_interval") and len(call.args) >= 2:
+            val = str_const(call.args[1])
+            if val is not None:
+                self.stage_refs.append(
+                    (val, ctx.relpath, call.args[1].lineno))
+        for kw in call.keywords:
+            if kw.arg == "match" and isinstance(kw.value, ast.Dict):
+                for k, v in zip(kw.value.keys, kw.value.values):
+                    if str_const(k) == "stage":
+                        val = str_const(v)
+                        if val is not None:
+                            self.stage_refs.append(
+                                (val, ctx.relpath, v.lineno))
+
+    def _canonical_stages(self, project):
+        """Parse the ``STAGES`` tuple out of telemetry/attribution.py
+        (AST, never imported — same discipline as the fixtures). None
+        when the registry module is absent or unreadable: the check
+        stands down rather than failing every literal."""
+        path = os.path.join(project.root, "mxnet_tpu", "telemetry",
+                            "attribution.py")
+        try:
+            with open(path, encoding="utf-8") as fh:
+                tree = ast.parse(fh.read())
+        except (OSError, SyntaxError):
+            return None
+        for node in tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and node.targets[0].id == "STAGES" \
+                    and isinstance(node.value, (ast.Tuple, ast.List)):
+                vals = [str_const(e) for e in node.value.elts]
+                if all(v is not None for v in vals):
+                    return frozenset(vals)
+        return None
+
+    def _check_stage_refs(self, project):
+        if not self.stage_refs:
+            return []
+        stages = self._canonical_stages(project)
+        if stages is None:
+            return []
+        out = []
+        for stage, rel, line in self.stage_refs:
+            if stage in stages:
+                continue
+            out.append(Finding(
+                "stage-name-registry", rel, line, 0,
+                f"stage label {stage!r} is not in the canonical "
+                f"STAGES registry (telemetry/attribution.py) — a "
+                f"misspelled stage forks a series nothing aggregates, "
+                f"graphs or pages on"))
+        return out
+
     def _fstring_pattern(self, node):
         if not isinstance(node, ast.JoinedStr):
             return None
@@ -246,6 +328,7 @@ class TelemetryConsistencyPass(LintPass):
     # -- dashboard cross-check ---------------------------------------------
     def finalize(self, project):
         out = self._check_label_consistency()
+        out.extend(self._check_stage_refs(project))
         if project.full_scan:
             out.extend(self._check_rule_refs())
             out.extend(self._check_history_refs())

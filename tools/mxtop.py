@@ -20,6 +20,9 @@ store reloads persisted segments):
 - **requests/s + queue depth + KV occupancy** — per engine;
 - **per-tenant bills** — windowed device-seconds and token rates off
   the tenant cost slice, priciest first;
+- **top stages** — the ``/whyslow`` stage-attribution ranking (which
+  stage of the request path the latency went to), slowest exemplar
+  trace ids inline;
 - **alerts** — the ``/alerts`` rule table, firing/pending first.
 
 Curses-free by design: one ANSI home+clear per refresh (disabled when
@@ -156,6 +159,26 @@ def render(base, window, out=None):
         lines.append("-- tenant bills (device s/s over window) " + "-" * 21)
         for tag, last, spark in tenant_rows[:8]:
             lines.append(f"  {tag:<28} {last if last is None else round(last, 4)!s:>9}  {spark}")
+
+    # "why slow": the owner's live stage-attribution ranking — which
+    # stage of the request path the window's latency actually went to
+    # (a router base answers for the whole fleet)
+    try:
+        ws = json.loads(_fetch(f"{base}/whyslow"))
+        top = ws.get("top") or []
+        if top:
+            lines.append("-- top stages (share of attributed time) "
+                         + "-" * 21)
+            for r in top:
+                share = r.get("share") or 0.0
+                p99v = r.get("p99_ms")
+                ex = r.get("exemplar")
+                lines.append(
+                    f"  {r.get('stage'):<16} {share * 100:5.1f}%  "
+                    f"p99 {_fmt(p99v, 'ms')}"
+                    + (f"  trace {ex}" if ex else ""))
+    except Exception:
+        lines.append("-- top stages: unavailable " + "-" * 35)
 
     firing = 0
     try:

@@ -191,6 +191,68 @@ def cross_check(outcomes, attempts, delta):
     return not mismatches, mismatches
 
 
+def summarize_breakdowns(samples, tolerance=0.25):
+    """The report's ``breakdown`` section off per-request critical
+    paths: ``samples`` is ``[(client_ms, breakdown|None, class), ...]``
+    for completed requests (the server's attributed decomposition
+    rides ``InferenceFuture.breakdown`` end to end — engine, wire,
+    router relay, HTTP /submit).
+
+    Reconciles the two clocks: the server-side decomposition must sum
+    to its own wall by construction (``attributed + unattributed ==
+    wall``), and the AGGREGATE server wall must agree with the
+    aggregate client wall within ``tolerance`` — that is what
+    ``reconciled`` judges. Per-request ratios are reported as
+    ``wall_mismatches`` but not gated on: the client adds an ADDITIVE
+    transport/relay/GIL overhead of a few ms, which on a short
+    request is a large fraction of a small number (a 3 ms overhead on
+    a 10 ms request is a 30% "skew" with both clocks perfectly
+    honest). Returns None when no sample carried a breakdown."""
+    rows = [(c_ms, bd, cls) for c_ms, bd, cls in samples
+            if bd is not None]
+    if not rows:
+        return None
+
+    def _table(sub):
+        wall = sum(bd["wall_ms"] for _, bd, _ in sub)
+        un = sum(bd.get("unattributed_ms") or 0.0 for _, bd, _ in sub)
+        stages = {}
+        for _, bd, _ in sub:
+            for s in bd.get("stages") or ():
+                stages[s["stage"]] = (stages.get(s["stage"], 0.0)
+                                      + (s.get("ms") or 0.0))
+        out = {"requests": len(sub),
+               "wall_ms": round(wall, 3),
+               "unattributed_ms": round(un, 3),
+               "attributed_share":
+                   round((wall - un) / wall, 4) if wall else None,
+               "stages": {k: round(v, 3) for k, v in sorted(
+                   stages.items(), key=lambda kv: -kv[1])}}
+        return out
+
+    out = _table(rows)
+    out["missing"] = len(samples) - len(rows)
+    mismatches = sum(
+        1 for c_ms, bd, _ in rows
+        if c_ms > 0 and not (1 - tolerance
+                             <= bd["wall_ms"] / c_ms
+                             <= 1 + tolerance))
+    out["wall_mismatches"] = mismatches
+    client_wall = sum(c_ms for c_ms, _, _ in rows)
+    server_wall = sum(bd["wall_ms"] for _, bd, _ in rows)
+    ratio = (server_wall / client_wall) if client_wall else None
+    out["server_client_wall_ratio"] = (round(ratio, 4)
+                                       if ratio is not None else None)
+    out["reconciled"] = (ratio is not None
+                         and 1 - tolerance <= ratio <= 1 + tolerance)
+    classes = {cls for _, _, cls in rows if cls}
+    if classes:
+        out["by_class"] = {cls: _table([r for r in rows
+                                        if r[2] == cls])
+                           for cls in sorted(classes)}
+    return out
+
+
 def _fetch_costs(metrics_url, timeout=10.0):
     """GET the sibling /costs of a /metrics URL; returns the
     cross-bucket totals row (router bodies carry a fleet ``totals``,
@@ -583,6 +645,7 @@ class RouterClient:
         fut.trace_id = body.get("trace_id")
         if body.get("ok"):
             fut.cost = body.get("cost")
+            fut.breakdown = body.get("breakdown")
             return np.asarray(body["result"], np.float32)
         cls = _ERROR_CLASSES.get(body.get("error_type"), ServingError)
         if body.get("error_type") == "NoEngineAvailableError":
@@ -775,6 +838,9 @@ def run_load(engine, n_clients=8, requests_per_client=16,
     latencies = []          # (ms, trace_id) — list.append is atomic
     outcomes = {"ok": 0, "expired": 0, "shed": 0, "error": 0}
     valid_tokens = [0]
+    # per-request critical paths: (client_ms, breakdown, class) for
+    # the report's breakdown section (see summarize_breakdowns)
+    breakdown_samples = []
     # client-side cost books: summed per-request amortized bills off
     # future.cost — reconciled against the server's /costs delta
     client_cost = {"device_s": 0.0, "requests": 0, "tokens": 0,
@@ -839,6 +905,8 @@ def run_load(engine, n_clients=8, requests_per_client=16,
                 outcomes["ok"] += 1
                 valid_tokens[0] += n
                 latencies.append((ms, fut.trace_id))
+                breakdown_samples.append(
+                    (ms, getattr(fut, "breakdown", None), cls))
                 if tenant:
                     tb = tenant_books[tenant]
                     tb["ok"] += 1
@@ -909,6 +977,9 @@ def run_load(engine, n_clients=8, requests_per_client=16,
               "slowest_traces": [{"trace_id": tid, "ms": round(ms, 3)}
                                  for ms, tid in slowest],
               "engine": engine.snapshot()}
+    breakdown = summarize_breakdowns(breakdown_samples)
+    if breakdown is not None:
+        report["breakdown"] = breakdown
     if tenants:
         # per-tenant client view: offered share, outcomes, latency
         # percentiles — priority under overload must hold its p99
@@ -1117,6 +1188,7 @@ def run_decode_load(engine, n_clients=8, requests_per_client=8,
     outcomes = {"ok": 0, "expired": 0, "shed": 0, "error": 0}
     tokens_out = [0]
     stream_bad = [0]
+    breakdown_samples = []   # (client_ms, breakdown, None)
     client_cost = {"device_s": 0.0, "requests": 0, "tokens": 0,
                    "compiled": 0, "missing": 0}
     lock = threading.Lock()
@@ -1175,6 +1247,9 @@ def run_decode_load(engine, n_clients=8, requests_per_client=8,
                 outcomes["ok"] += 1
                 tokens_out[0] += len(out)
                 latencies.append(((t_end - t0) * 1e3, fut.trace_id))
+                breakdown_samples.append(
+                    ((t_end - t0) * 1e3,
+                     getattr(fut, "breakdown", None), None))
                 if stamps:
                     ttfts.append((stamps[0] - t0) * 1e3)
                     gaps.extend((b - a) * 1e3 for a, b in
@@ -1255,6 +1330,9 @@ def run_decode_load(engine, n_clients=8, requests_per_client=8,
               "inter_token_p50_ms": pct(gap_xs, 50),
               "inter_token_p99_ms": pct(gap_xs, 99),
               "engine": engine.snapshot()}
+    breakdown = summarize_breakdowns(breakdown_samples)
+    if breakdown is not None:
+        report["breakdown"] = breakdown
     if temperature is not None:
         report["sampling"] = {"temperature": temperature,
                               "top_k": top_k, "top_p": top_p,
@@ -1376,6 +1454,12 @@ def overload_drill(target, alerts_fn=None, get_trace=None, alert=None,
     - the firing payload carries ≥1 OpenMetrics exemplar whose trace
       id resolves to a retrievable trace (``get_trace``), i.e. the
       alert links to evidence, not just a number;
+    - the firing payload carries top-stage ATTRIBUTION (the "why
+      slow" attachment): the page names the bottleneck stage of the
+      induced overload, and when the top stage carries an exemplar
+      trace id it too must be retrievable. Skipped automatically when
+      stage attribution is disabled in this process
+      (``MXNET_TPU_ATTRIBUTION=0``, or spans off);
     - after the load stops, the alert leaves ``firing`` (resolved).
 
     ``alerts_fn``/``get_trace`` default to the target's own in-process
@@ -1466,6 +1550,19 @@ def overload_drill(target, alerts_fn=None, get_trace=None, alert=None,
             t.join(timeout=10.0)
     t_fired = time.perf_counter() - t0
 
+    # re-read the firing row now the flood has drained: the bounded
+    # trace ring churns hard mid-flood, so the exemplar ids captured
+    # at first-firing may already be evicted — the post-flood payload
+    # references the freshest (surviving) traces
+    body = alerts_fn()
+    row = rule_row(body)
+    if row.get("state") == "firing":
+        fresh = dict(row)
+        fresh["transitions"] = [
+            t for t in body.get("transitions", ())
+            if t.get("alert") == alert]
+        fired = fresh
+
     # the pending dwell may be shorter than a poll period: the
     # transition LOG is the authoritative walk record
     walked = [(t.get("from"), t.get("to")) for t in fired["transitions"]]
@@ -1490,6 +1587,26 @@ def overload_drill(target, alerts_fn=None, get_trace=None, alert=None,
         f"none of the {len(exemplars)} exemplar trace ids resolved to "
         f"a kept trace (exemplars: {exemplars})")
 
+    # the page must ANSWER "why slow", not just report it: top-stage
+    # attribution rides the firing payload, naming the stage the
+    # flooded wall time went to, with its own retrievable trace
+    from mxnet_tpu.telemetry import attribution as _attribution
+    attribution = fired.get("attribution")
+    top_stage = None
+    if _attribution.enabled():
+        assert attribution, (
+            f"firing {alert!r} carries no stage attribution — the "
+            f"page says 'slow' without saying WHERE (did any request "
+            f"complete and feed the /whyslow aggregator?)")
+        top_stage = attribution[0]
+        assert top_stage.get("stage") in _attribution.STAGES, (
+            f"attribution names unregistered stage {top_stage!r}")
+        if top_stage.get("exemplar"):
+            st_trace = get_trace(top_stage["exemplar"])
+            assert st_trace is not None and st_trace.get("spans"), (
+                f"top-stage exemplar {top_stage['exemplar']!r} did "
+                f"not resolve to a kept trace")
+
     # recovery: with the load gone the alert must leave firing
     deadline = time.monotonic() + resolve_timeout_s
     resolved = False
@@ -1510,6 +1627,8 @@ def overload_drill(target, alerts_fn=None, get_trace=None, alert=None,
             "resolved_after_s": round(time.perf_counter() - t0, 3),
             "exemplar": exemplar,
             "exemplar_trace_spans": len(trace.get("spans", ())),
+            "attribution": attribution,
+            "top_stage": (top_stage or {}).get("stage"),
             "error_budget_remaining":
                 fired.get("error_budget_remaining"),
             "flood_errors": len(flood_errors),

@@ -50,6 +50,15 @@ incidents first: correlated signal counts (alerts / watchdog trips /
 scoreboard transitions / restarts), duration, the alerts and engines
 involved, and the linked flight-bundle path. Exit 5 while any
 incident is open — mirroring the `--alerts` exit-4 contract.
+
+`--whyslow` fetches the stage-attribution table `/whyslow` (an
+engine's own, or a router's fleet merge) and prints where the wall
+time of completed requests actually went: the top stages ranked by
+share of attributed time with their p99 and slowest exemplar trace
+(paste into `--trace <id>`), then the full per-(engine, stage,
+tenant-class, model) breakdown. Exit 4 when a FIRING alert carries
+stage attribution in its payload — the page already names its
+bottleneck, so scripts can gate on it like `--alerts`.
 """
 from __future__ import annotations
 
@@ -165,7 +174,7 @@ def _base_url(src):
     src = src.rstrip("/")
     for suffix in ("/metrics", "/stats", "/healthz", "/traces",
                    "/profile", "/costs", "/slo", "/alerts",
-                   "/incidents"):
+                   "/incidents", "/whyslow"):
         if src.endswith(suffix):
             return src[: -len(suffix)]
     return src
@@ -586,6 +595,67 @@ def dump_incidents(data, out=None, top=10):
     return len(opens)
 
 
+def dump_whyslow(data, alerts=None, out=None, top=10):
+    """One-screen /whyslow table — where completed requests' wall
+    time went, top stages first (an engine's own view, or a router's
+    fleet merge with every seat's rows). When the `/alerts` body is
+    supplied, returns the number of FIRING rules whose payload carries
+    stage attribution (the page names its bottleneck) so the CLI can
+    turn it into an exit code."""
+    out = out if out is not None else sys.stdout
+    owner = data.get("owner", "?")
+    scope = "fleet" if data.get("fleet") else "owner"
+    print(f"-- whyslow, {scope} {owner}: "
+          f"{data.get('requests', 0)} requests attributed "
+          + "-" * 10, file=out)
+    if not data.get("enabled", True) and not data.get("stages"):
+        print("  (attribution disabled — MXNET_TPU_ATTRIBUTION=0)",
+              file=out)
+    tops = data.get("top") or []
+    if not tops and not data.get("stages"):
+        print("  (no stages observed yet)", file=out)
+        return 0
+    if tops:
+        print(f"  {'stage':<16} {'share':>6} {'count':>8} "
+              f"{'total':>12} {'p99':>10}  exemplar", file=out)
+        for r in tops:
+            share = r.get("share") or 0.0
+            print(f"  {r.get('stage', '?'):<16} {share * 100:5.1f}% "
+                  f"{r.get('count', 0):>8} "
+                  f"{r.get('total_ms', 0):>10.1f}ms "
+                  f"{_n(r.get('p99_ms')):>8}ms  "
+                  f"{r.get('exemplar') or '-'}", file=out)
+    rows = data.get("stages") or []
+    if rows:
+        print(f"  {'engine':<14} {'stage':<16} {'class':<12} "
+              f"{'model':<10} {'count':>8} {'mean':>9} {'p99':>9}",
+              file=out)
+        for r in sorted(rows, key=lambda r: -(r.get("total_ms")
+                                              or 0.0))[:top]:
+            print(f"  {str(r.get('engine_id', '?')):<14} "
+                  f"{r.get('stage', '?'):<16} "
+                  f"{str(r.get('tenant_class') or '-'):<12} "
+                  f"{str(r.get('model') or '-'):<10} "
+                  f"{r.get('count', 0):>8} "
+                  f"{_n(r.get('mean_ms')):>7}ms "
+                  f"{_n(r.get('p99_ms')):>7}ms", file=out)
+    attributed_pages = 0
+    for rule in (alerts or {}).get("rules") or []:
+        if rule.get("state") == "firing" and rule.get("attribution"):
+            attributed_pages += 1
+            top_stage = rule["attribution"][0]
+            print(f"  FIRING {rule.get('alert', '?')}: "
+                  f"{top_stage.get('share', 0) * 100:.1f}% "
+                  f"{top_stage.get('stage')}"
+                  + (f", trace {top_stage.get('exemplar')}"
+                     if top_stage.get("exemplar") else ""), file=out)
+    for section in ((alerts or {}).get("engines") or {}).values():
+        for rule in section.get("rules") or []:
+            if rule.get("state") == "firing" and rule.get("attribution"):
+                attributed_pages += 1
+    return attributed_pages
+
+
 def dump_trace_tree(trace, out=None):
     """Indented span-tree render with per-span self-time."""
     out = out if out is not None else sys.stdout
@@ -665,6 +735,12 @@ def main(argv=None):
                     "the server's /incidents (open first, with signal "
                     "counts, duration and linked bundle paths); exit "
                     "5 while an incident is open")
+    ap.add_argument("--whyslow", action="store_true",
+                    help="table the stage-attribution /whyslow body "
+                    "(engine or router fleet merge): top stages by "
+                    "share of attributed time with exemplar traces; "
+                    "exit 4 when a firing alert's payload names its "
+                    "bottleneck stage")
     ap.add_argument("--top", type=int, default=10,
                     help="rows in the --traces/--profile tables")
     args = ap.parse_args(argv)
@@ -705,6 +781,17 @@ def main(argv=None):
                 json.loads(_fetch(base + "/incidents")), top=args.top)
             if n_open:
                 rc = max(rc, 5)
+            shown = True
+        if args.whyslow:
+            try:
+                alerts = json.loads(_fetch(base + "/alerts"))
+            except Exception:
+                alerts = None
+            paged = dump_whyslow(
+                json.loads(_fetch(base + "/whyslow")), alerts=alerts,
+                top=args.top)
+            if paged:
+                rc = max(rc, 4)
             shown = True
         if shown:
             pass
