@@ -1030,17 +1030,25 @@ class ServingEngine:
                                    end_us=int(t1 * 1e6),
                                    attrs=fwd_attrs)
             # stage stamps for the critical-path breakdown (wfq_wait
-            # was stamped at drain; perf_counter and monotonic share
-            # the CLOCK_MONOTONIC axis here, like the span mix above).
+            # was stamped at drain). pack/t0/t1 were timed with
+            # perf_counter for the span axis; the breakdown's wall
+            # endpoints are time.monotonic(), so map them across —
+            # the clocks share CLOCK_MONOTONIC on Linux but not
+            # everywhere, and a mismatched epoch clips every interval
+            # outside the wall (100% unattributed, silently).
             # The stage spans themselves are skipped — the legacy
             # serving/pack + serving/forward children already carry
             # the same intervals in the tree.
             if req.stages is not None:
                 if pack_interval is not None:
-                    _attribution.stamp(req, "pack", pack_interval[0],
-                                       pack_interval[1], span=False)
+                    _attribution.stamp(
+                        req, "pack",
+                        _spans.perf_to_mono(pack_interval[0]),
+                        _spans.perf_to_mono(pack_interval[1]),
+                        span=False)
                 _attribution.stamp(
-                    req, "compute" if hit else "compile", t0, t1,
+                    req, "compute" if hit else "compile",
+                    _spans.perf_to_mono(t0), _spans.perf_to_mono(t1),
                     span=False)
             try:
                 out = self._pool(

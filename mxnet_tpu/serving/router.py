@@ -2535,6 +2535,11 @@ class ServingRouter:
                 now - cached[0] < (1.0 if cached[1] else 0.1):
             return cached[1]
         top = (self.whyslow() or {}).get("top") or None
+        if top:
+            # the fleet merge ranks EVERY observed stage (so nothing
+            # is truncation-blind); the page payload only wants the
+            # leaders
+            top = top[:envvars.get("MXNET_TPU_ATTRIBUTION_TOP")]
         self._whyslow_top_cache = (now, top)
         return top
 

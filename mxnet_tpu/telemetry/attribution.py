@@ -46,8 +46,9 @@ the disabled hot path is one attribute check per call site.
 """
 from __future__ import annotations
 
+import heapq
 import threading
-from bisect import insort
+from collections import deque
 
 from .. import envvars
 from . import spans as _spans
@@ -184,19 +185,27 @@ def _decompose(intervals, w0, w1):
     unattributed = 0.0
     edges = sorted({w0, w1, *(c[0] for c in clipped),
                     *(c[1] for c in clipped)})
-    # active set managed by sweeping edge to edge; n is small (stamps
-    # per request), so a rescan per slice is fine and allocation-free
+    # single O(n log n) sweep: intervals enter a max-heap keyed
+    # (start, stamp order) as the edge walk reaches their start and
+    # are lazily expired once it passes their end, so the slice owner
+    # ("innermost wins": latest start, then latest stamped) is the
+    # heap top. A decode request stamps one decode_iter per generated
+    # token — a per-slice rescan is O(n^2) and froze the decode loop
+    # for seconds on 10k-token generations.
+    clipped.sort(key=lambda c: (c[0], c[2]))
+    active = []                   # (-t0, -i, t1, stage)
+    nxt = 0
     for a, b in zip(edges, edges[1:]):
-        owner = None
-        for t0, t1, i, stage in clipped:
-            if t0 <= a and t1 >= b:
-                # innermost wins: latest start, then latest stamped
-                if owner is None or (t0, i) > (owner[0], owner[1]):
-                    owner = (t0, i, stage)
-        if owner is None:
+        while nxt < len(clipped) and clipped[nxt][0] <= a:
+            t0, t1, i, stage = clipped[nxt]
+            heapq.heappush(active, (-t0, -i, t1, stage))
+            nxt += 1
+        while active and active[0][2] <= a:
+            heapq.heappop(active)
+        if not active:
             unattributed += b - a
         else:
-            stage = owner[2]
+            stage = active[0][3]
             totals[stage] = totals.get(stage, 0.0) + (b - a)
             first_seen.setdefault(stage, a)
     ordered = dict(sorted(totals.items(),
@@ -323,37 +332,39 @@ def _families(registry=None):
 
 class _StageStat:
     """One (stage, tenant_class, model) cell: count/total plus a
-    bounded window of per-request ms (windowed p99) and the slowest
-    retrievable exemplar."""
+    sliding window of the last N per-request ``(ms, trace)`` samples.
+    p99 and the slowest retrievable exemplar are computed over the
+    WINDOW on read, so both decay as an incident ages out — an
+    eviction policy that keeps extremes forever would converge on
+    all-time maxima and report a stale tail as current."""
 
-    __slots__ = ("count", "total_ms", "window", "capacity",
-                 "exemplar_ms", "exemplar_trace")
+    __slots__ = ("count", "total_ms", "window")
 
     def __init__(self, capacity):
         self.count = 0
         self.total_ms = 0.0
-        self.window = []            # sorted per-request ms
-        self.capacity = capacity
-        self.exemplar_ms = None
-        self.exemplar_trace = None
+        self.window = deque(maxlen=max(1, int(capacity or 1)))
 
     def observe(self, ms, trace_id=None):
         self.count += 1
         self.total_ms += ms
-        if len(self.window) >= self.capacity:
-            # drop a middling sample, keep the extremes the p99 needs
-            del self.window[len(self.window) // 2]
-        insort(self.window, ms)
-        if trace_id is not None and (self.exemplar_ms is None
-                                     or ms > self.exemplar_ms):
-            self.exemplar_ms = ms
-            self.exemplar_trace = trace_id
+        self.window.append((ms, trace_id))
 
     def p99(self):
         if not self.window:
             return None
-        i = max(0, int(0.99 * len(self.window) + 0.5) - 1)
-        return self.window[min(i, len(self.window) - 1)]
+        w = sorted(ms for ms, _ in self.window)
+        i = max(0, int(0.99 * len(w) + 0.5) - 1)
+        return w[min(i, len(w) - 1)]
+
+    def exemplar(self):
+        """``(ms, trace_id)`` of the slowest windowed sample carrying
+        a retrievable trace, or ``(None, None)``."""
+        best_ms, best_tr = None, None
+        for ms, tr in self.window:
+            if tr is not None and (best_ms is None or ms > best_ms):
+                best_ms, best_tr = ms, tr
+        return best_ms, best_tr
 
 
 class StageBreakdown:
@@ -417,27 +428,28 @@ class StageBreakdown:
             grand = 0.0
             for (stage, cls, mdl), st in sorted(self._stats.items()):
                 grand += st.total_ms
+                p99 = st.p99()
+                ex_ms, ex_tr = st.exemplar()
                 rows.append({"engine_id": self.owner, "stage": stage,
                              "tenant_class": cls, "model": mdl,
                              "count": st.count,
                              "total_ms": round(st.total_ms, 3),
                              "mean_ms": round(st.total_ms
                                               / max(1, st.count), 3),
-                             "p99_ms": (None if st.p99() is None
-                                        else round(st.p99(), 3)),
-                             "exemplar": st.exemplar_trace})
+                             "p99_ms": (None if p99 is None
+                                        else round(p99, 3)),
+                             "exemplar": ex_tr})
                 agg = by_stage.setdefault(
                     stage, {"stage": stage, "count": 0, "total_ms": 0.0,
                             "p99_ms": 0.0, "exemplar": None,
                             "_ex_ms": -1.0})
                 agg["count"] += st.count
                 agg["total_ms"] += st.total_ms
-                if st.p99() is not None:
-                    agg["p99_ms"] = max(agg["p99_ms"], st.p99())
-                if (st.exemplar_trace is not None
-                        and st.exemplar_ms > agg["_ex_ms"]):
-                    agg["_ex_ms"] = st.exemplar_ms
-                    agg["exemplar"] = st.exemplar_trace
+                if p99 is not None:
+                    agg["p99_ms"] = max(agg["p99_ms"], p99)
+                if ex_tr is not None and ex_ms > agg["_ex_ms"]:
+                    agg["_ex_ms"] = ex_ms
+                    agg["exemplar"] = ex_tr
             requests = self._requests
         ranked = sorted(by_stage.values(),
                         key=lambda r: -r["total_ms"])
@@ -468,9 +480,14 @@ def merge_whyslow(parts, owner="fleet"):
             continue
         owners.append(part.get("owner"))
         requests += part.get("requests") or 0
-        for row in part.get("stages") or ():
-            rows.append(row)
-        for t in part.get("top") or ():
+        part_rows = list(part.get("stages") or ())
+        rows.extend(part_rows)
+        # the fleet ranking is recomputed from the FULL per-stage rows
+        # — each part's own "top" table is pre-truncated to its local
+        # top-N, so ranking from those would hide a stage that is #4
+        # on every engine but #1 fleet-wide and overstate shares. Fall
+        # back to "top" only for parts that carry no stage rows.
+        for t in part_rows or part.get("top") or ():
             agg = by_stage.setdefault(
                 t["stage"], {"stage": t["stage"], "count": 0,
                              "total_ms": 0.0, "p99_ms": 0.0,

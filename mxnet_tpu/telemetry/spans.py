@@ -46,7 +46,8 @@ __all__ = ["Span", "SpanRecorder", "RECORDER", "span", "start_span",
            "record_span", "use_span", "current_span", "current_span_id",
            "configure", "enabled", "traces_summary", "get_trace",
            "slowest_traces", "export_chrome_events", "reset",
-           "merge_trace_records", "merge_trace_summaries"]
+           "merge_trace_records", "merge_trace_summaries",
+           "mono_to_us", "perf_to_mono"]
 
 _current_span = contextvars.ContextVar("mxnet_tpu_span", default=None)
 _counter = itertools.count()
@@ -66,6 +67,15 @@ def mono_to_us(mono_s):
     """Map a ``time.monotonic()`` stamp onto the span/profiler
     microsecond axis."""
     return int(mono_s * 1e6) + _MONO_OFFSET_US
+
+
+def perf_to_mono(perf_s):
+    """Map a ``time.perf_counter()`` stamp onto the ``time.monotonic()``
+    axis, in seconds. The two clocks share CLOCK_MONOTONIC on Linux
+    but differ elsewhere (Windows < 3.13), so intervals timed with
+    perf_counter must cross through this before being compared against
+    monotonic wall endpoints."""
+    return perf_s - _MONO_OFFSET_US / 1e6
 
 
 def _new_span_id():

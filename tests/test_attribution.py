@@ -384,6 +384,92 @@ def test_alert_attribution_fn_override_wins():
 
 
 # ---------------------------------------------------------------------------
+# review regressions: decompose stays O(n log n), windows decay,
+# fleet top is not truncation-blind, perf/mono stamps share one axis
+# ---------------------------------------------------------------------------
+
+def test_decompose_10k_stamps_is_fast():
+    """A 10k-token generation stamps one decode_iter per token; the
+    extractor runs inline on the decode-loop thread at completion, so
+    a quadratic sweep (6s at 10k stamps, measured) freezes token
+    emission for EVERY active stream. Bound it hard."""
+    stamps = []
+    t = 0.0
+    for i in range(10_000):
+        stamps.append(("decode_iter", t, t + 0.004))
+        if i % 7 == 0:      # nested COW copies keep overlap resolution hot
+            stamps.append(("cow_copy", t + 0.001, t + 0.002))
+        t += 0.0041
+    t0 = time.perf_counter()
+    bd = _attribution.breakdown_from_stamps(stamps, 0.0, t)
+    elapsed = time.perf_counter() - t0
+    assert elapsed < 0.5, f"decompose took {elapsed:.2f}s at 10k stamps"
+    assert bd["attributed_ms"] + bd["unattributed_ms"] == \
+        pytest.approx(bd["wall_ms"], abs=0.01)
+    per = {s["stage"]: s["ms"] for s in bd["stages"]}
+    assert per["cow_copy"] == pytest.approx(1429.0, abs=1.0)
+
+
+def test_stage_window_p99_and_exemplar_decay():
+    """The windowed p99 must reflect the RECENT window: once an
+    incident's slow samples age out, p99 (and the slowest-exemplar
+    trace) drop back — an eviction policy that keeps extremes forever
+    would report the stale tail as current indefinitely."""
+    st = _attribution._StageStat(capacity=100)
+    for _ in range(50):
+        st.observe(5000.0, trace_id="t-incident")
+    assert st.p99() == pytest.approx(5000.0)
+    assert st.exemplar() == (5000.0, "t-incident")
+    # incident resolves: 200 healthy requests push every slow sample
+    # out of the 100-deep window
+    for _ in range(200):
+        st.observe(10.0, trace_id="t-calm")
+    assert st.p99() == pytest.approx(10.0)
+    assert st.exemplar() == (10.0, "t-calm")
+    assert st.count == 250 and len(st.window) == 100
+
+
+def test_perf_counter_stamps_land_on_monotonic_axis():
+    """Engine pack/compute stamps are timed with perf_counter but
+    compared against time.monotonic() wall endpoints; perf_to_mono
+    must map between the axes (they differ on some platforms) so the
+    intervals don't clip outside the wall as 100% unattributed."""
+    p, m = time.perf_counter(), time.monotonic()
+    assert spans.perf_to_mono(p) == pytest.approx(m, abs=0.05)
+
+
+def test_merge_whyslow_sees_past_local_topn():
+    """A stage that is below every engine's local top-N cutoff can
+    still dominate fleet-wide; the merge must rank from the full
+    per-stage rows, not the parts' pre-truncated top tables, and
+    shares must be of ALL attributed time."""
+    parts = []
+    for e in range(4):
+        agg = _attribution.StageBreakdown(f"e{e}",
+                                          registry=MetricsRegistry())
+        agg.observe({"wall_ms": 100.0,
+                     "stages": [{"stage": "decode_iter", "ms": 31.0},
+                                {"stage": "prefill", "ms": 30.0},
+                                {"stage": "wfq_wait", "ms": 29.0},
+                                {"stage": "cow_copy", "ms": 28.0}],
+                     "unattributed_ms": 0.0})
+        # top=1: cow_copy is #4 locally on every engine
+        parts.append(agg.snapshot(top=1))
+    for part in parts:
+        assert [t["stage"] for t in part["top"]] == ["decode_iter"]
+    merged = _attribution.merge_whyslow(parts, owner="r0")
+    ranked = [t["stage"] for t in merged["top"]]
+    assert "cow_copy" in ranked, ranked
+    by = {t["stage"]: t for t in merged["top"]}
+    assert by["cow_copy"]["total_ms"] == pytest.approx(112.0)
+    # shares are over the fleet grand total, not the truncated tables
+    assert by["decode_iter"]["share"] == pytest.approx(124.0 / 472.0,
+                                                       abs=1e-3)
+    assert sum(t["share"] for t in merged["top"]) == \
+        pytest.approx(1.0, abs=0.01)
+
+
+# ---------------------------------------------------------------------------
 # fleet merge
 # ---------------------------------------------------------------------------
 
