@@ -650,6 +650,62 @@ register("MXNET_TPU_HISTORY_SEGMENT_MB", "float", 4.0,
          "segment file rotates past it, so retention/budget deletes "
          "operate on whole sealed segments", scope="history")
 
+# -- traffic capture & shadow validation ------------------------------------
+register("MXNET_TPU_CAPTURE", "bool", False,
+         "sampled production-traffic capture: engines record a "
+         "head-sampled fraction of admitted requests (prompt, "
+         "sampling params + seed, model/tenant identity, outcome, "
+         "output digest, latency + stage breakdown) into a bounded "
+         "crash-safe corpus for deterministic replay; canary traffic "
+         "is excluded; ``0`` (the default) builds nothing — no "
+         "thread, no ``mxnet_tpu_capture_*`` families, no files",
+         scope="capture")
+register("MXNET_TPU_CAPTURE_DIR", "path", None,
+         "persist the capture corpus under this directory "
+         "(length+CRC-framed wire-codec segment files, rotated and "
+         "reloadable across processes); unset keeps the corpus "
+         "in-memory only — same byte bound, no disk", scope="capture")
+register("MXNET_TPU_CAPTURE_RATE", "float", 1.0,
+         "head-sampling rate in 0..1: the fraction of admitted "
+         "non-synthetic requests recorded, by exact deterministic "
+         "credit accumulation (0.25 records every 4th request)",
+         scope="capture")
+register("MXNET_TPU_CAPTURE_MAX_MB", "float", 64.0,
+         "corpus byte budget (MB); past it the oldest SEALED segments "
+         "are evicted (the active segment keeps writing) — the "
+         "history-store discipline", scope="capture")
+register("MXNET_TPU_CAPTURE_PAYLOAD", "str", "tokens",
+         "what the record keeps of the prompt: ``tokens`` (the int32 "
+         "token array — the corpus is replayable) or ``digest`` "
+         "(only its digest — privacy mode; replay skips such records "
+         "and counts them)", scope="capture")
+register("MXNET_TPU_SHADOW", "bool", False,
+         "shadow-diff validation: the router mirrors a fraction of "
+         "completed live requests at a candidate seat "
+         "(fire-and-forget — live futures never wait on the shadow), "
+         "diffs output digests + latency, and exposes the "
+         "``/shadow`` verdict the ``swap_model`` gate consults; "
+         "``0`` (the default) builds nothing — no mirror branch, no "
+         "``mxnet_tpu_shadow_*`` families", scope="capture")
+register("MXNET_TPU_SHADOW_FRACTION", "float", 0.25,
+         "fraction of completed non-synthetic live requests mirrored "
+         "at the shadow seat (deterministic credit accumulation, "
+         "like the capture sampler)", scope="capture")
+register("MXNET_TPU_SHADOW_THRESHOLD", "float", 0.0,
+         "maximum tolerated shadow divergence rate: the swap gate "
+         "refuses the flip while ``divergences/compared`` exceeds "
+         "this (0.0 = any divergence blocks — the seeded-decode "
+         "byte-identical contract)", scope="capture")
+register("MXNET_TPU_SHADOW_MIN_REQUESTS", "int", 16,
+         "comparisons required before the shadow verdict may pass: "
+         "the gate refuses the flip until this many mirrored "
+         "requests have been diffed (a candidate must earn the "
+         "swap)", scope="capture")
+register("MXNET_TPU_SHADOW_TIMEOUT_S", "float", 30.0,
+         "per-mirrored-request timeout on the shadow leg (a wedged "
+         "candidate counts as an error, never blocks anything)",
+         scope="capture")
+
 # -- concurrency sanitizer --------------------------------------------------
 register("MXNET_TPU_SANITIZE", "bool", False,
          "runtime concurrency sanitizer: patches ``threading.Lock``/"
@@ -706,6 +762,7 @@ _SCOPE_TITLES = OrderedDict([
     ("egress", "Alert egress"),
     ("incidents", "Incident timeline"),
     ("history", "Retrospective history"),
+    ("capture", "Traffic capture & shadow validation"),
     ("sanitize", "Concurrency sanitizer"),
     ("bench", "Benchmarks"),
     ("tests", "Tests / dev harness"),

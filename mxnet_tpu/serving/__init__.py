@@ -57,8 +57,12 @@ from .router import (ServingRouter, NoEngineAvailableError,
                      RemoteEngineError)
 from .autoscaler import FleetAutoscaler
 from .chaos import ChaosController
+from .capture import CaptureStore, load_corpus, output_digest, replay
+from .shadow import ShadowMirror, SwapGateError
 
 __all__ = ["ServingEngine", "DecodeEngine", "ServingRouter",
+           "CaptureStore", "ShadowMirror", "SwapGateError",
+           "load_corpus", "output_digest", "replay",
            "FleetAutoscaler", "ChaosController", "ContinuousBatcher",
            "DecodeSlots", "PackedPlan", "PagedKVPool", "PagedCausalLM",
            "DecodeRequest", "KVPagesExhaustedError",

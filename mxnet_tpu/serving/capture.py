@@ -1,0 +1,636 @@
+"""Sampled production-traffic capture + deterministic replay.
+
+``CaptureStore`` records a head-sampled fraction of ADMITTED requests
+at their completion point (engine ``_dispatch`` / decode ``_leave``)
+into a bounded, crash-safe on-disk corpus:
+
+- one record per sampled request: prompt tokens (or only their digest,
+  per ``MXNET_TPU_CAPTURE_PAYLOAD``), sampling params + seed, model id
+  and version, tenant/class, arrival time (monotonic AND wall), the
+  outcome, the output's byte digest, total latency and the per-stage
+  critical-path breakdown;
+- records are :func:`~.wire.wire_encode` frames (the serving stack's
+  one typed codec — ndarrays ride raw, nothing is stringified) inside
+  length+CRC-framed append-only segment files, following the
+  ``telemetry/history.py`` segment discipline: seq-numbered segments
+  rotate at a size bound, sealed segments are evicted oldest-first
+  when the corpus exceeds ``MXNET_TPU_CAPTURE_MAX_MB``, and a torn
+  tail (crash mid-append) is skipped and COUNTED on reload, never a
+  load failure;
+- synthetic canary probes (trace ids minted ``canary-…``, billed
+  ``traffic="synthetic"``) are excluded BEFORE sampling, so a corpus
+  is real traffic only and loadgen's ledger reconciliation still
+  balances;
+- ``mxnet_tpu_capture_*`` metric families + the ``/capture`` summary
+  body exist only while capture is enabled (``MXNET_TPU_CAPTURE=0``
+  builds nothing: no thread, no families, no files).
+
+Because seeded sampling (``(seed, position)`` PRNG) makes every decode
+byte-reproducible, a captured corpus is an offline correctness oracle:
+:func:`replay` feeds it back through a live engine/router — original
+inter-arrival pacing or a ``speed`` multiplier — and asserts each
+replayed output against the recording, reporting every divergence
+with the replayed request's own stage breakdown. That is the
+regression harness for kernel/scheduler/model changes, and the corpus
+the shadow-diff validator (:mod:`~.shadow`) shares its digest
+contract with.
+
+Two comparison regimes, because the two output kinds have different
+reproducibility physics:
+
+- integer outputs (decode token streams) must be BYTE-IDENTICAL to
+  the captured digest — the seed owns the randomness, so any flip is
+  a real regression;
+- float outputs (pooled encoder embeddings) are bitwise-stable only
+  for an identical PACKING: the same request placed at a different
+  lane offset inside a packed row regroups the kernel's reductions
+  and moves the result by ~1 ulp (~1e-7). Since replay cannot
+  reproduce the original co-tenants of a row, small float outputs
+  ride in the record (``output_vals``) and replay accepts them within
+  ``allclose(rtol=atol=1e-5)`` — two orders looser than packing
+  noise, four orders tighter than any real numeric regression. A
+  bitwise digest match still short-circuits as the fast path.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import re
+import struct
+import threading
+import time
+import zlib
+
+import numpy as np
+
+from .. import envvars
+from ..telemetry import events as _events
+from ..telemetry.registry import REGISTRY as _REGISTRY
+from .wire import wire_decode, wire_encode
+
+__all__ = ["CaptureStore", "output_digest", "load_corpus", "replay"]
+
+#: per-record frame header: payload length + CRC32 of the payload
+_REC_HDR = struct.Struct("<II")
+#: refuse absurd record lengths on load (a corrupt header must not
+#: allocate gigabytes) — generous: prompts are token arrays, not blobs
+_REC_MAX = 64 << 20
+
+_SEG_RE = re.compile(r"corpus-(\d+)\.seg$")
+
+
+def output_digest(out):
+    """Canonical 16-hex-char digest of one request's output: dtype +
+    shape + raw bytes of the C-contiguous array. Decode outputs are
+    int32 token sequences (seeded sampling makes them byte-exact on
+    replay); encoder outputs are the pooled float arrays (bitwise
+    stable only for an identical packing — see the module docstring
+    for the tolerance regime replay applies). None digests to
+    ``"none"`` so failed requests still compare."""
+    if out is None:
+        return "none"
+    arr = np.ascontiguousarray(np.asarray(out))
+    h = hashlib.sha256()
+    h.update(str(arr.dtype).encode())
+    h.update(str(arr.shape).encode())
+    h.update(arr.tobytes())
+    return h.hexdigest()[:16]
+
+
+#: float outputs larger than this (elements) are digest-only — the
+#: corpus is a traffic record, not an activation dump
+_VALS_MAX = 4096
+
+
+def _capture_vals(out, payload):
+    """The float-output payload for tolerance replay: the output array
+    itself, when it is float-typed, small, and the corpus carries full
+    payloads (digest-only corpora are not replayable anyway)."""
+    if out is None or payload != "tokens":
+        return None
+    arr = np.asarray(out)
+    if arr.dtype.kind != "f" or arr.size > _VALS_MAX:
+        return None
+    return np.ascontiguousarray(arr)
+
+
+def is_synthetic(trace_id):
+    """True for synthetic canary traffic — the request-level face of
+    the ``traffic="synthetic"`` billing tag: canary probes mint their
+    trace ids with the ``canary`` prefix (``telemetry/canary.py``) and
+    must never enter a capture corpus."""
+    return bool(trace_id) and str(trace_id).startswith("canary")
+
+
+class CaptureStore:
+    """Bounded crash-safe corpus of sampled request records.
+
+    Built by an engine's ``start()`` only when ``MXNET_TPU_CAPTURE``
+    is on; ``record_request`` is called inline at the completion point
+    (one dict + one wire_encode per SAMPLED request — no extra
+    thread). With no ``MXNET_TPU_CAPTURE_DIR`` the corpus lives in
+    memory (bounded by the same byte budget) — tests and single-process
+    replay work without touching disk; a directory makes it durable
+    and shareable across processes."""
+
+    def __init__(self, owner_id, dir=None, rate=None, max_mb=None,
+                 payload=None):
+        self.owner_id = str(owner_id)
+        self.dir = (str(dir) if dir is not None
+                    else envvars.get("MXNET_TPU_CAPTURE_DIR"))
+        rate = (float(rate) if rate is not None
+                else envvars.get("MXNET_TPU_CAPTURE_RATE"))
+        self.rate = min(1.0, max(0.0, rate))
+        self.max_bytes = (float(max_mb) if max_mb is not None
+                          else envvars.get("MXNET_TPU_CAPTURE_MAX_MB")
+                          ) * 1024 * 1024
+        # rotation bound derived from the budget: eviction works on
+        # whole sealed segments, so ~8 per budget keeps it incremental
+        self.segment_bytes = max(4096.0, self.max_bytes / 8.0)
+        payload = (payload if payload is not None
+                   else envvars.get("MXNET_TPU_CAPTURE_PAYLOAD"))
+        self.payload = ("digest" if str(payload).lower() == "digest"
+                        else "tokens")
+        self._lock = threading.Lock()
+        self._accum = 0.0           # deterministic head-sampling credit
+        self._fh = None             # active segment [fh, path, size]
+        self._seq = None
+        self._mem = []              # dir-less fallback: raw frames
+        self._mem_bytes = 0
+        self.written = 0            # records this store appended
+        self.write_errors = 0
+        self._first_wall = None
+        self._last_wall = None
+        c = _REGISTRY.counter(
+            "mxnet_tpu_capture_requests_total",
+            "traffic-capture sampling outcomes per completed request: "
+            "sampled (recorded), skipped (head-sampled out), synthetic "
+            "(canary traffic, excluded), error (corpus write failed)",
+            ("owner", "result"))
+        self._c = {r: c.labels(owner=self.owner_id, result=r)
+                   for r in ("sampled", "skipped", "synthetic", "error")}
+        self._c_bytes = _REGISTRY.counter(
+            "mxnet_tpu_capture_bytes_total",
+            "corpus bytes appended (framed record payloads)",
+            ("owner",)).labels(owner=self.owner_id)
+        _REGISTRY.gauge(
+            "mxnet_tpu_capture_corpus_bytes",
+            "current corpus size in bytes (sealed + active segments, "
+            "after eviction)", ("owner",)) \
+            .labels(owner=self.owner_id).set_function(self.corpus_bytes)
+        _REGISTRY.gauge(
+            "mxnet_tpu_capture_sample_rate",
+            "configured head-sampling rate (0..1)", ("owner",)) \
+            .labels(owner=self.owner_id).set(self.rate)
+        _events.emit("capture_start", owner=self.owner_id,
+                     dir=self.dir, rate=self.rate, payload=self.payload)
+
+    # -- sampling ----------------------------------------------------------
+    def should_sample(self, trace_id=None):
+        """The head-based decision: made per admitted request, before
+        (and independent of) its outcome. Synthetic canary traffic is
+        excluded outright; real traffic is sampled deterministically
+        at ``rate`` by exact credit accumulation (rate 0.25 records
+        every 4th request — no RNG, so tests and cross-process
+        corpora are reproducible)."""
+        if is_synthetic(trace_id):
+            self._c["synthetic"].inc()
+            return False
+        with self._lock:
+            self._accum += self.rate
+            if self._accum >= 1.0:
+                self._accum -= 1.0
+                return True
+        self._c["skipped"].inc()
+        return False
+
+    # -- recording ---------------------------------------------------------
+    def record_request(self, req, out, outcome, total_ms, model=None,
+                       version=None, engine_id=None):
+        """Build + append one record for a completed (or failed)
+        request, if the head sampler elects it. Called inline on the
+        engine worker at the completion point, where outcome, cost and
+        breakdown are all known."""
+        if not self.should_sample(req.trace_id):
+            return False
+        now = time.monotonic()
+        tokens = getattr(req, "tokens", None)
+        decode = None
+        if hasattr(req, "seed"):        # DecodeRequest
+            decode = {"max_new_tokens": int(req.max_new_tokens),
+                      "eos_id": (int(req.eos_id)
+                                 if req.eos_id is not None else None),
+                      "temperature": float(req.temperature),
+                      "top_k": int(req.top_k),
+                      "top_p": float(req.top_p),
+                      "seed": int(req.seed)}
+        rec = {"v": 1,
+               "trace_id": req.trace_id,
+               "engine_id": str(engine_id) if engine_id else None,
+               "model": str(model) if model is not None else None,
+               "version": str(version) if version is not None else None,
+               "tenant": req.tenant,
+               "tenant_class": req.tenant_class,
+               # arrival on BOTH clocks: monotonic deltas drive replay
+               # pacing; wall anchors the corpus in operator time
+               "arrival_mono": float(req.t_submit),
+               "arrival_wall": time.time() - (now - req.t_submit),  # mxlint: disable=wall-clock-delta
+               "prompt_len": int(tokens.size) if tokens is not None
+               else 0,
+               "tokens": (np.asarray(tokens, np.int32)
+                          if self.payload == "tokens"
+                          and tokens is not None else None),
+               "prompt_digest": output_digest(tokens),
+               "decode": decode,
+               "outcome": str(outcome),
+               "output_digest": output_digest(out),
+               # small FLOAT outputs ride along: packed-row lane
+               # placement moves fp results by ~1 ulp, so replay
+               # needs the values (not just the digest) to compare
+               # within tolerance; int token streams stay digest-only
+               "output_vals": _capture_vals(out, self.payload),
+               "output_len": (int(np.asarray(out).size)
+                              if out is not None else 0),
+               "total_ms": float(total_ms),
+               "breakdown": getattr(req.future, "breakdown", None)}
+        return self.append(rec)
+
+    def append(self, rec):
+        """Frame + append one record dict (the typed wire codec, so
+        token arrays ride as raw int32 — and reload is bit-exact)."""
+        payload = wire_encode(rec)
+        frame = _REC_HDR.pack(len(payload),
+                              zlib.crc32(payload) & 0xFFFFFFFF) + payload
+        with self._lock:
+            ok = self._write(frame)
+            if ok:
+                self.written += 1
+                wall = rec.get("arrival_wall")
+                if wall is not None:
+                    if self._first_wall is None:
+                        self._first_wall = wall
+                    self._last_wall = wall
+        if ok:
+            self._c["sampled"].inc()
+            self._c_bytes.inc(len(frame))
+        else:
+            self.write_errors += 1
+            self._c["error"].inc()
+        return ok
+
+    def _write(self, frame):
+        if self.dir is None:
+            self._mem.append(frame)
+            self._mem_bytes += len(frame)
+            while self._mem_bytes > self.max_bytes and len(self._mem) > 1:
+                self._mem_bytes -= len(self._mem.pop(0))
+            return True
+        try:
+            if self._fh is None:
+                self._open_segment()
+            fh, _path, size = self._fh
+            fh.write(frame)
+            fh.flush()
+        except OSError:
+            return False        # disk trouble must not fail serving
+        self._fh[2] = size + len(frame)
+        if self._fh[2] >= self.segment_bytes:
+            try:
+                fh.close()
+            except OSError:
+                pass
+            self._fh = None     # sealed: now evictable
+            self._enforce_disk()
+        return True
+
+    def _open_segment(self):
+        os.makedirs(self.dir, exist_ok=True)
+        if self._seq is None:
+            self._seq = 1 + max(
+                [_seg_seq(p) for p in os.listdir(self.dir)
+                 if p.endswith(".seg")] or [0])
+        path = os.path.join(self.dir, f"corpus-{self._seq:08d}.seg")
+        self._seq += 1
+        self._fh = [open(path, "ab"), path, 0]
+
+    def _segments(self):
+        out = []
+        if self.dir is None or not os.path.isdir(self.dir):
+            return out
+        for name in os.listdir(self.dir):
+            if not name.endswith(".seg"):
+                continue
+            path = os.path.join(self.dir, name)
+            try:
+                st = os.stat(path)
+            except OSError:
+                continue
+            out.append((st.st_mtime, st.st_size, path))
+        return out
+
+    def _enforce_disk(self):
+        """Budget sweep over SEALED segments, oldest first — the
+        active handle keeps writing, exactly the history-store
+        discipline (a fresh segment is never deleted)."""
+        active = {self._fh[1]} if self._fh is not None else set()
+        segs = sorted(s for s in self._segments() if s[2] not in active)
+        total = sum(s[1] for s in segs)
+        for _mtime, size, path in segs:
+            if total <= self.max_bytes:
+                break
+            try:
+                os.unlink(path)
+            except OSError:
+                continue
+            total -= size
+            _events.emit("capture_evict", owner=self.owner_id,
+                         path=os.path.basename(path), bytes=size)
+
+    # -- reading -----------------------------------------------------------
+    def corpus_bytes(self):
+        with self._lock:
+            if self.dir is None:
+                return self._mem_bytes
+            return sum(s[1] for s in self._segments()) \
+                + (self._fh[2] if self._fh is not None else 0)
+
+    def records(self):
+        """Every readable record in arrival order (in-memory frames or
+        the on-disk segments). Returns ``(records, skipped)`` where
+        ``skipped`` counts torn/corrupt frames tolerated on load."""
+        with self._lock:
+            if self.dir is None:
+                records, skipped = [], 0
+                for frame in self._mem:
+                    rec = _decode_frame(frame)
+                    if rec is None:
+                        skipped += 1
+                    else:
+                        records.append(rec)
+                return records, skipped
+            if self._fh is not None:
+                try:
+                    self._fh[0].flush()
+                except OSError:
+                    pass
+        return load_corpus(self.dir)
+
+    def summary(self):
+        """The ``/capture`` exposition body (and the router's per-seat
+        merge input): configuration + corpus shape at a glance."""
+        with self._lock:
+            segs = self._segments()
+            active = self._fh[1] if self._fh is not None else None
+            written = self.written
+            first, last = self._first_wall, self._last_wall
+        now = time.time()
+        return {"owner": self.owner_id, "enabled": True,
+                "dir": self.dir, "rate": self.rate,
+                "payload": self.payload,
+                "records_written": written,
+                "write_errors": self.write_errors,
+                "corpus_bytes": self.corpus_bytes(),
+                "segments": len(segs) + (1 if self.dir is None
+                                         and self._mem else 0),
+                "active_segment": (os.path.basename(active)
+                                   if active else None),
+                "max_mb": round(self.max_bytes / 1024 / 1024, 3),
+                "oldest_wall": first, "newest_wall": last,
+                # corpus age is a delta between WALL stamps by design:
+                # records may come from other processes, whose
+                # monotonic clocks don't compare
+                "age_s": (round(now - first, 3)  # mxlint: disable=wall-clock-delta
+                          if first is not None else None)}
+
+    def close(self):
+        """Seal the active segment (flush + close). The corpus stays
+        readable on disk (or in memory)."""
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh[0].close()
+                except OSError:
+                    pass
+                self._fh = None
+        _events.emit("capture_close", owner=self.owner_id,
+                     records=self.written)
+
+
+def _seg_seq(name):
+    m = _SEG_RE.search(name)
+    return int(m.group(1)) if m else 0
+
+
+def _decode_frame(frame):
+    if len(frame) < _REC_HDR.size:
+        return None
+    n, crc = _REC_HDR.unpack_from(frame)
+    payload = frame[_REC_HDR.size:_REC_HDR.size + n]
+    if len(payload) != n or zlib.crc32(payload) & 0xFFFFFFFF != crc:
+        return None
+    try:
+        return wire_decode(payload)
+    except ValueError:
+        return None
+
+
+def load_corpus(dir):
+    """Load every record from a corpus directory, segments in sequence
+    order, records in append order. Crash-tolerant: a torn tail (or a
+    corrupt frame — bad CRC, bad length, undecodable payload) ends
+    THAT segment's scan and is counted, never raised. Returns
+    ``(records, skipped)``."""
+    records, skipped = [], 0
+    if not dir or not os.path.isdir(dir):
+        return records, skipped
+    names = sorted((n for n in os.listdir(dir) if n.endswith(".seg")),
+                   key=_seg_seq)
+    for name in names:
+        try:
+            with open(os.path.join(dir, name), "rb") as fh:
+                buf = fh.read()
+        except OSError:
+            skipped += 1
+            continue
+        pos = 0
+        while pos + _REC_HDR.size <= len(buf):
+            n, crc = _REC_HDR.unpack_from(buf, pos)
+            start = pos + _REC_HDR.size
+            if n > _REC_MAX or start + n > len(buf):
+                break           # torn tail / corrupt length
+            payload = buf[start:start + n]
+            if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+                break           # corrupt frame: resync is hopeless
+            try:
+                records.append(wire_decode(payload))
+            except ValueError:
+                break
+            pos = start + n
+        if pos < len(buf):
+            # anything after the last clean frame — a corrupt frame or
+            # a torn tail, even one shorter than a header — counts
+            skipped += 1
+    return records, skipped
+
+
+# -- deterministic replay ---------------------------------------------------
+def _submit_record(target, rec):
+    """Re-submit one captured record against a live target — a
+    :class:`~.engine.ServingEngine`, :class:`~.decode.DecodeEngine` or
+    :class:`~.router.ServingRouter` (their decode-parameter submit
+    surfaces are intentionally congruent). The captured seed rides
+    along, so a seeded decode replays byte-identically."""
+    tokens = np.asarray(rec["tokens"], np.int32)
+    decode = rec.get("decode")
+    common = dict(model_id=rec.get("model"), tenant=rec.get("tenant"),
+                  tenant_class=rec.get("tenant_class"))
+    if decode:
+        sp = getattr(target, "submit_payload", None)
+        if sp is not None:      # decode engine: payload-dict surface
+            fut, _streamed = sp(dict(decode, tokens=tokens, **common))
+            return fut
+        return target.submit(tokens,
+                             max_new_tokens=decode.get("max_new_tokens"),
+                             eos_id=decode.get("eos_id"),
+                             temperature=decode.get("temperature"),
+                             top_k=decode.get("top_k"),
+                             top_p=decode.get("top_p"),
+                             seed=decode.get("seed"), **common)
+    return target.submit(tokens, **common)
+
+
+def replay(records, target, speed=None, timeout_s=60.0):
+    """Deterministic re-execution: feed captured records back through
+    ``target`` in arrival order and assert each seeded stream is
+    byte-identical to its captured digest (float outputs: within the
+    packing-noise tolerance — module docstring).
+
+    ``speed`` — None/0 replays as fast as the target admits; ``1.0``
+    reproduces the original inter-arrival pacing, ``2.0`` runs it
+    twice as fast, etc.
+
+    Returns the divergence report::
+
+        {"replayed", "matched", "matched_bitwise",
+         "matched_within_tol", "divergences": [{trace_id, model,
+         expected, got, max_abs_diff, captured_ms, replay_ms,
+         breakdown}, ...],
+         "errors": [{trace_id, error}], "skipped": {...}, "wall_s"}
+
+    Only ``completed`` records with a recorded prompt payload are
+    replayable (digest-only corpora — ``MXNET_TPU_CAPTURE_PAYLOAD=
+    digest`` — and shed/failed requests are counted in ``skipped``).
+    Divergences carry the REPLAYED request's own stage breakdown, so
+    a regression is immediately attributable (which stage of the
+    diverging request's critical path changed)."""
+    speed = float(speed) if speed else 0.0
+    skipped = {"no_payload": 0, "not_completed": 0}
+    runnable = []
+    for rec in records:
+        if rec.get("outcome") != "completed":
+            skipped["not_completed"] += 1
+        elif rec.get("tokens") is None:
+            skipped["no_payload"] += 1
+        else:
+            runnable.append(rec)
+    runnable.sort(key=lambda r: r.get("arrival_mono") or 0.0)
+    t_wall0 = time.monotonic()
+    inflight = []
+    prev_arrival = None
+    for rec in runnable:
+        arrival = rec.get("arrival_mono")
+        if speed > 0 and prev_arrival is not None \
+                and arrival is not None:
+            gap = (arrival - prev_arrival) / speed
+            if gap > 0:
+                time.sleep(min(gap, 60.0))
+        if arrival is not None:
+            prev_arrival = arrival
+        t0 = time.monotonic()
+        try:
+            fut = _submit_record(target, rec)
+        except Exception as e:
+            inflight.append((rec, None, t0, e))
+            continue
+        inflight.append((rec, fut, t0, None))
+    divergences, errors = [], []
+    matched = bitwise = within_tol = 0
+    for rec, fut, t0, exc in inflight:
+        if exc is None:
+            try:
+                out = fut.result(timeout=timeout_s)
+            except Exception as e:
+                exc = e
+        if exc is not None:
+            errors.append({"trace_id": rec.get("trace_id"),
+                           "error": f"{type(exc).__name__}: {exc}"})
+            continue
+        got = output_digest(out)
+        replay_ms = (time.monotonic() - t0) * 1e3
+        if got == rec.get("output_digest"):
+            matched += 1
+            bitwise += 1
+            continue
+        # float fallback: packing noise moves fp outputs by ~1 ulp
+        # (module docstring) — compare the recorded VALUES within a
+        # tolerance far above that and far below any real regression
+        vals = rec.get("output_vals")
+        max_diff = None
+        if vals is not None and out is not None:
+            got_arr = np.asarray(out)
+            vals = np.asarray(vals)
+            if got_arr.shape == vals.shape \
+                    and got_arr.dtype.kind == "f":
+                max_diff = float(np.max(np.abs(
+                    got_arr.astype(np.float64)
+                    - vals.astype(np.float64)))) if vals.size else 0.0
+                if np.allclose(got_arr, vals, rtol=1e-5, atol=1e-5):
+                    matched += 1
+                    within_tol += 1
+                    continue
+        divergences.append({
+            "trace_id": rec.get("trace_id"),
+            "model": rec.get("model"),
+            "expected": rec.get("output_digest"),
+            "got": got,
+            "max_abs_diff": max_diff,
+            "captured_ms": rec.get("total_ms"),
+            "replay_ms": round(replay_ms, 3),
+            # the REPLAYED request's critical path — where the
+            # diverging request spent its time under the new code
+            "breakdown": getattr(fut, "breakdown", None)})
+    report = {"replayed": len(inflight), "matched": matched,
+              "matched_bitwise": bitwise,
+              "matched_within_tol": within_tol,
+              "divergences": divergences, "errors": errors,
+              "skipped": skipped, "speed": speed or None,
+              "wall_s": round(time.monotonic() - t_wall0, 3)}
+    _events.emit("capture_replay", replayed=report["replayed"],
+                 matched=matched, divergences=len(divergences),
+                 errors=len(errors))
+    return report
+
+
+def merge_summaries(parts, owner=None):
+    """The router's fleet ``/capture`` body: per-seat summaries under
+    ``engines`` plus fleet totals (records, bytes, write errors).
+    ``parts`` is ``[(engine_id, summary_or_None), ...]``; seats
+    without capture (disabled, old peers) land in ``missing``."""
+    engines, missing = {}, []
+    records = bytes_total = errors = 0
+    for eid, summ in parts:
+        if not summ:
+            missing.append(eid)
+            continue
+        engines[str(eid)] = summ
+        records += int(summ.get("records_written") or 0)
+        bytes_total += int(summ.get("corpus_bytes") or 0)
+        errors += int(summ.get("write_errors") or 0)
+    out = {"owner": owner, "enabled": bool(engines),
+           "engines": engines,
+           "fleet": {"records_written": records,
+                     "corpus_bytes": bytes_total,
+                     "write_errors": errors}}
+    if missing:
+        out["missing"] = missing
+    return out

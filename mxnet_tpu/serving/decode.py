@@ -268,6 +268,9 @@ class DecodeEngine:
         self._forward_lock = threading.Lock()  # mxsan: allow=long-hold
         self._exemplars = exemplar_gate()
         self._slo = None
+        # traffic capture (MXNET_TPU_CAPTURE): sampled request corpus
+        # behind /capture + deterministic replay — built in start()
+        self._capture = None
         self._worker = None
         self._expo = None
         self._wire = None
@@ -315,6 +318,12 @@ class DecodeEngine:
             self._slo = AlertDaemon(evaluator)
             default_burn_rules(self._slo, names)
             self._slo.start()
+        # sampled traffic capture: decode records carry the full
+        # sampling params + seed, so a corpus replays byte-identically
+        # (MXNET_TPU_CAPTURE=0: one env read, nothing built)
+        if envvars.get("MXNET_TPU_CAPTURE"):
+            from .capture import CaptureStore
+            self._capture = CaptureStore(self.engine_id)
         _events.emit("engine_start", engine_id=self.engine_id,
                      decode=True,
                      prefill_buckets=list(self.prefill_bucket_lens),
@@ -335,6 +344,8 @@ class DecodeEngine:
         _recorder.remove_bundle_section(self._bundle_name)
         if self._slo is not None:
             self._slo.stop()
+        if self._capture is not None:
+            self._capture.close()
         with self._lock:
             self._queue.close()
             if not drain:
@@ -378,6 +389,18 @@ class DecodeEngine:
     @property
     def alerts(self):
         return self._slo
+
+    @property
+    def capture(self):
+        """The engine's :class:`~.capture.CaptureStore` (None unless
+        ``MXNET_TPU_CAPTURE`` was on at start)."""
+        return self._capture
+
+    def capture_summary(self):
+        """The ``/capture`` body (None when capture is disabled) —
+        what a fronting router's fleet merge reads per seat."""
+        return (self._capture.summary()
+                if self._capture is not None else None)
 
     # -- client surface ----------------------------------------------------
     def submit(self, tokens, token_types=None, deadline_ms=None,
@@ -739,6 +762,9 @@ class DecodeEngine:
                                              if self._slo is not None
                                              else None),
                                   whyslow_fn=self.whyslow,
+                                  capture_fn=(self._capture.summary
+                                              if self._capture is not None
+                                              else None),
                                   port=port, host=host)
             self._expo = srv
             if envvars.get("MXNET_TPU_WIRE") and self._wire is None:
@@ -1426,6 +1452,12 @@ class DecodeEngine:
             self.tenants.observe_event(req.tenant, req.tenant_class,
                                        self.model_id, counter)
             req.span.end(error=repr(error))
+            if self._capture is not None:
+                self._capture.record_request(
+                    req, None, counter,
+                    (time.monotonic() - req.t_submit) * 1e3,
+                    model=self.model_id, version=self.model_version,
+                    engine_id=self.engine_id)
             req.future.set_exception(error)
             return
         now = time.monotonic()
@@ -1476,4 +1508,11 @@ class DecodeEngine:
                 model=self.model_id, trace_id=req.trace_id)
         req.span.set_attr(tokens=len(req.generated), reason=reason)
         req.span.end()
+        # capture after breakdown/cost landed (the record carries
+        # both) and before the result fires — a caller observing
+        # completion finds its record already durable
+        if self._capture is not None:
+            self._capture.record_request(
+                req, out, "completed", total_ms, model=self.model_id,
+                version=self.model_version, engine_id=self.engine_id)
         req.future.set_result(out)

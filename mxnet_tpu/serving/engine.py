@@ -211,6 +211,10 @@ class ServingEngine:
         # history scraper (MXNET_TPU_HISTORY): the retrospective
         # time-series store behind /query_range — built in start()
         self._history = None
+        # traffic capture (MXNET_TPU_CAPTURE): the sampled request
+        # corpus behind /capture and deterministic replay — built in
+        # start(); None means no record branch in _dispatch at all
+        self._capture = None
         # exemplar gate, resolved once; the exemplar↔retrievable-trace
         # contract lives in metrics.slow_exemplar (shared with router)
         self._exemplars = exemplar_gate()
@@ -305,6 +309,13 @@ class ServingEngine:
                         else None),
                 alerts_fn=(self.alerts_snapshot
                            if self._slo is not None else None)).start()
+        # ... and keep the receipts: sampled traffic capture records a
+        # head-sampled fraction of admitted requests into the bounded
+        # corpus deterministic replay re-executes (MXNET_TPU_CAPTURE=0:
+        # one env read — no thread, no families, no files)
+        if envvars.get("MXNET_TPU_CAPTURE"):
+            from .capture import CaptureStore
+            self._capture = CaptureStore(self.engine_id)
         # chaos harness (MXNET_TPU_CHAOS): register as a fault target.
         # Off (the default) this is ONE env read — nothing is built,
         # patched or spawned.
@@ -330,6 +341,8 @@ class ServingEngine:
             self._slo.stop()
         if self._history is not None:
             self._history.stop()
+        if self._capture is not None:
+            self._capture.close()
         with self._lock:
             self._queue.close()
             if not drain:
@@ -533,7 +546,7 @@ class ServingEngine:
             self._batcher.max_rows, shapes)
 
     def swap_model(self, model, model_id=None, version=None,
-                   shapes=None):
+                   shapes=None, gate=None):
         """Live hot-swap: cut ``model_id`` (None = the default model)
         over to the new ``model`` entry point with ZERO lost requests.
 
@@ -546,7 +559,24 @@ class ServingEngine:
         the flip finishes on the old fn, the next batch resolves the
         new one, and post-swap traffic runs warm. The version change
         is advertised at ``/healthz``, so a fronting router's canary
-        targets change token and the canary re-TOFUs its golden."""
+        targets change token and the canary re-TOFUs its golden.
+
+        ``gate`` (optional) is consulted BEFORE any warm-replay work:
+        a :class:`~.shadow.ShadowMirror` (its shadow-diff verdict
+        decides), or any callable returning ``(ok, reason)``. A
+        failing gate raises :class:`~.shadow.SwapGateError` and the
+        live model keeps serving — evidence first, flip second."""
+        if gate is not None:
+            gate_fn = getattr(gate, "gate", None) or gate
+            ok, reason = gate_fn()
+            if not ok:
+                from .shadow import SwapGateError
+                _events.emit("model_swap_refused",
+                             engine_id=self.engine_id,
+                             model=str(model_id), version=version,
+                             reason=reason)
+                raise SwapGateError(
+                    f"swap_model refused by gate: {reason}")
         mid = self._models.resolve_id(model_id)
         if shapes is None:
             with self._shapes_lock:
@@ -564,6 +594,18 @@ class ServingEngine:
                      warmed_shapes=len(shapes),
                      ms=round((time.monotonic() - t0) * 1e3, 3))
         return self
+
+    @property
+    def capture(self):
+        """The engine's :class:`~.capture.CaptureStore` (None unless
+        ``MXNET_TPU_CAPTURE`` was on at start)."""
+        return self._capture
+
+    def capture_summary(self):
+        """The ``/capture`` body (None when capture is disabled) —
+        what a fronting router's fleet merge reads per seat."""
+        return (self._capture.summary()
+                if self._capture is not None else None)
 
     def reset_stats(self):
         """Swap in a fresh ServingStats (compile cache untouched):
@@ -643,6 +685,9 @@ class ServingEngine:
                                               if self._history is not None
                                               else None),
                                   whyslow_fn=self.whyslow,
+                                  capture_fn=(self._capture.summary
+                                              if self._capture is not None
+                                              else None),
                                   port=port, host=host)
             self._expo = srv
             # the binary dispatch listener rides along with the HTTP
@@ -1057,6 +1102,12 @@ class ServingEngine:
                 # request, not the rest of the batch
                 self.stats.bump("failed")
                 req.span.end(error=repr(e))
+                if self._capture is not None:
+                    self._capture.record_request(
+                        req, None, "failed",
+                        (now - req.t_submit) * 1e3, model=mid,
+                        version=self._models.versions().get(mid),
+                        engine_id=self.engine_id)
                 req.future.set_exception(e)
                 continue
             req.t_done = now
@@ -1086,6 +1137,14 @@ class ServingEngine:
                     breakdown, tenant_class=req.tenant_class,
                     model=mid, trace_id=req.trace_id)
             req.span.end()
+            # capture AFTER breakdown/cost landed on the future (the
+            # record carries both) and BEFORE the result fires, so a
+            # caller observing completion finds its record durable
+            if self._capture is not None:
+                self._capture.record_request(
+                    req, out, "completed", total_ms, model=mid,
+                    version=self._models.versions().get(mid),
+                    engine_id=self.engine_id)
             req.future.set_result(out)
 
     def _forward(self, plan, fn=None):

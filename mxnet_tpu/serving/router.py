@@ -400,6 +400,11 @@ class _Seat:
         peer)."""
         return None
 
+    def capture_summary(self):
+        """This seat's /capture body (None when the engine has no
+        capture store — MXNET_TPU_CAPTURE=0, or an old peer)."""
+        return None
+
     def maintain(self):
         """Poll-thread housekeeping (wire connection upkeep)."""
 
@@ -492,6 +497,12 @@ class _LocalSeat(_Seat):
     def whyslow(self):
         try:
             return self._engine.whyslow()
+        except Exception:
+            return None
+
+    def capture_summary(self):
+        try:
+            return self._engine.capture_summary()
         except Exception:
             return None
 
@@ -861,6 +872,15 @@ class _RemoteSeat(_Seat):
             return None
         return snap if "stages" in snap else None
 
+    def capture_summary(self):
+        # a 404 body ({"error": "traffic capture disabled"}) parses
+        # but is not a summary: only record-bearing replies count
+        try:
+            snap = json.loads(self._get("/capture"))
+        except Exception:
+            return None
+        return snap if "records_written" in snap else None
+
 
 class ServingRouter:
     """Least-outstanding front door over N serving engines.
@@ -937,6 +957,11 @@ class ServingRouter:
         # history scraper (MXNET_TPU_HISTORY): samples the fleet-merged
         # exposition into the retrospective store — built in start()
         self._history = None
+        # shadow-diff mirror (MXNET_TPU_SHADOW): mirrors a fraction of
+        # completed live traffic at a candidate seat and keeps the
+        # /shadow verdict the swap gate consults — built in start();
+        # None means no mirror branch in _on_done at all
+        self._shadow = None
         self._exemplars = exemplar_gate()
         self._pick_seq = itertools.count(1)
         # SLO-aware routing weights (MXNET_TPU_ROUTER_WEIGHTS): the
@@ -1195,6 +1220,13 @@ class ServingRouter:
                         else None),
                 alerts_fn=(self.alerts_snapshot
                            if self._slo is not None else None)).start()
+        # shadow-diff validation (MXNET_TPU_SHADOW): the mirror is
+        # built DISARMED — set_shadow_target() arms it at a candidate.
+        # Off (the default) this is one env read: no mirror branch in
+        # the completion path, no mxnet_tpu_shadow_* families
+        if envvars.get("MXNET_TPU_SHADOW"):
+            from .shadow import ShadowMirror
+            self._shadow = ShadowMirror(self.router_id)
         # chaos harness (MXNET_TPU_CHAOS): register as a fault target
         # (kill_router / kill_wire) — one env read when off
         if envvars.get("MXNET_TPU_CHAOS"):
@@ -1255,6 +1287,8 @@ class ServingRouter:
                 self._slo.stop()
             if self._history is not None:
                 self._history.stop()
+            if self._shadow is not None:
+                self._shadow.close()
         with self._lock:
             expo, self._expo = self._expo, None
             ha, self._ha = self._ha, None
@@ -1546,6 +1580,16 @@ class ServingRouter:
                 req.future.breakdown = breakdown
             self._observe_router_stages(req, total_ms)
             req.future.set_result(value)
+            # shadow-diff mirror: strictly AFTER the live future has
+            # resolved — fire-and-forget at the candidate seat; the
+            # live caller never waits on (or sees) the shadow leg
+            if self._shadow is not None:
+                try:
+                    self._shadow.mirror(req, value, total_ms)
+                except Exception as e:
+                    _events.emit("shadow_mirror_error",
+                                 router_id=self.router_id,
+                                 trace_id=req.trace_id, error=repr(e))
             self._ha_release(req)
             self._resolve()
             return
@@ -1749,6 +1793,15 @@ class ServingRouter:
         if self._weights_on:
             self._update_weights(signals)
         self._g_fleet.set(up_count)
+        # the shadow mirror's wire connection rides the same poll
+        # cadence as the seats' — blocking connect work stays here,
+        # never on the dispatch or completion paths
+        if self._shadow is not None:
+            try:
+                self._shadow.maintain()
+            except Exception as e:
+                _events.emit("shadow_maintain_error",
+                             router_id=self.router_id, error=repr(e))
         self._maintain_peer()
 
     # -- SLO-aware routing weights (poll thread) ---------------------------
@@ -2543,6 +2596,50 @@ class ServingRouter:
         self._whyslow_top_cache = (now, top)
         return top
 
+    def capture_summary(self):
+        """The fleet ``/capture`` body: every seat's capture-corpus
+        summary under ``engines`` plus fleet record/byte totals (local
+        handles read directly, remote seats scraped; seats without
+        capture — disabled, old peers — land in ``missing``)."""
+        from .capture import merge_summaries
+        with self._lock:
+            seats = list(self._seats.values())
+        return merge_summaries(
+            [(seat.engine_id, seat.capture_summary()) for seat in seats],
+            owner=self.router_id)
+
+    @property
+    def shadow(self):
+        """The router's :class:`~.shadow.ShadowMirror` (None unless
+        ``MXNET_TPU_SHADOW`` was on at start) — drills arm it and
+        pass it as the ``swap_model`` gate."""
+        return self._shadow
+
+    def set_shadow_target(self, target, model_id=None, version=None,
+                          fraction=None):
+        """Arm the shadow mirror at a candidate seat (an in-process
+        engine handle or a ``"host:port"`` wire address). Raises
+        :class:`~.queue.ServingError` when shadow validation is off
+        (``MXNET_TPU_SHADOW=0``) — arming a mirror that cannot exist
+        should be loud, not a silent no-op."""
+        if self._shadow is None:
+            raise ServingError(
+                "shadow validation disabled (MXNET_TPU_SHADOW=0)")
+        self._shadow.set_target(target, model_id=model_id,
+                                version=version, fraction=fraction)
+        return self
+
+    def clear_shadow_target(self):
+        if self._shadow is not None:
+            self._shadow.clear_target()
+        return self
+
+    def shadow_verdict(self):
+        """The ``/shadow`` body (None when shadow validation is
+        off)."""
+        return (self._shadow.verdict()
+                if self._shadow is not None else None)
+
     def incidents_snapshot(self):
         """The fleet ``/incidents`` body: this process's incident
         tracker (the router's own signals + every in-process seat's —
@@ -2664,7 +2761,9 @@ class ServingRouter:
         merged ``/traces`` + ``/traces/<id>``, the fleet ``/costs``
         cost table, ``/slo`` + ``/alerts`` (fleet objectives + every
         seat's seat-level view), the fleet ``/whyslow`` stage
-        attribution table, and ``POST /submit`` so clients
+        attribution table, the fleet ``/capture`` corpus summary (and
+        ``/shadow`` verdict while shadow validation is on), and
+        ``POST /submit`` so clients
         (e.g. ``serve_loadgen --router-url``) can drive this router
         from another process. Closed by :meth:`stop`."""
         from ..telemetry.expo import TelemetryServer
@@ -2690,6 +2789,11 @@ class ServingRouter:
                                   history_fn=(
                                       self._history.store
                                       if self._history is not None
+                                      else None),
+                                  capture_fn=self.capture_summary,
+                                  shadow_fn=(
+                                      self._shadow.verdict
+                                      if self._shadow is not None
                                       else None),
                                   port=port, host=host)
             self._expo = srv

@@ -1,0 +1,363 @@
+"""Shadow-diff validation: mirror live traffic at a candidate, gate
+the hot-swap on the verdict.
+
+``ShadowMirror`` is the router's pre-swap evidence engine. While a
+candidate model version warms on a *shadow seat* (a local engine
+handle or a wire ``host:port`` peer that is NOT in the live rotation),
+the router mirrors a configured fraction of real traffic at it —
+strictly fire-and-forget off the hot path:
+
+- the mirror decision + dispatch happen AFTER the live request's
+  future has resolved; the live caller never waits on the shadow;
+- wire mirroring rides :class:`~.wire.WireClient` (``dispatch`` is
+  queue-a-frame, no blocking I/O; the blocking ``ensure()`` handshake
+  runs on the router's poll thread via :meth:`maintain`);
+- shadow failures are counted, never raised — a dead candidate makes
+  the verdict inconclusive, not the router unhealthy.
+
+Each mirrored completion is diffed against its primary: output byte
+digests (the :func:`~.capture.output_digest` contract shared with the
+capture/replay oracle — seeded decodes make a faithful candidate
+byte-identical; float outputs fall back to the same ~1e-5 tolerance
+replay uses, because the shadow seat's different packing moves fp
+results by ~1 ulp) and latency. The running verdict is exposed as
+``mxnet_tpu_shadow_*`` families + the ``/shadow`` body, and
+:meth:`gate` is the callable ``swap_model(..., gate=...)`` consults:
+the flip is REFUSED (:class:`SwapGateError`) while the divergence rate
+exceeds ``MXNET_TPU_SHADOW_THRESHOLD`` or fewer than
+``MXNET_TPU_SHADOW_MIN_REQUESTS`` comparisons have landed.
+
+``MXNET_TPU_SHADOW=0`` (default) builds nothing: no thread, no metric
+families, no mirror branch in the router's completion path.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+
+import numpy as np
+
+from .. import envvars
+from ..telemetry import events as _events
+from ..telemetry.registry import REGISTRY as _REGISTRY
+from .capture import is_synthetic, output_digest
+from .metrics import LatencySummary
+
+__all__ = ["ShadowMirror", "SwapGateError"]
+
+
+class SwapGateError(RuntimeError):
+    """``swap_model`` refused: the shadow-diff gate is not passing.
+    The live model keeps serving; the candidate stays shadowed."""
+
+
+class ShadowMirror:
+    """Mirrors a sampled fraction of completed live requests at a
+    candidate seat and keeps the divergence verdict.
+
+    Built by the router's ``start()`` only when ``MXNET_TPU_SHADOW``
+    is on; armed/disarmed at runtime with :meth:`set_target` /
+    :meth:`clear_target` (arming resets the verdict — each candidate
+    earns its own evidence)."""
+
+    def __init__(self, owner_id):
+        self.owner_id = str(owner_id)
+        self.fraction = min(1.0, max(
+            0.0, envvars.get("MXNET_TPU_SHADOW_FRACTION")))
+        self.threshold = max(
+            0.0, envvars.get("MXNET_TPU_SHADOW_THRESHOLD"))
+        self.min_requests = max(
+            1, envvars.get("MXNET_TPU_SHADOW_MIN_REQUESTS"))
+        self.timeout_s = max(
+            0.1, envvars.get("MXNET_TPU_SHADOW_TIMEOUT_S"))
+        self._lock = threading.Lock()
+        self._accum = 0.0           # deterministic mirror-fraction credit
+        self._target = None         # engine handle (duck-typed submit)
+        self._client = None         # or a WireClient to a remote seat
+        self.model_id = None
+        self.version = None
+        self._armed_at = None
+        self._reset_counts_locked()
+        c = _REGISTRY.counter(
+            "mxnet_tpu_shadow_requests_total",
+            "shadow-mirror outcomes per completed live request: "
+            "mirrored (dispatched to the candidate), match, divergence "
+            "(digest mismatch), error (candidate failed), skipped "
+            "(fraction-sampled out), synthetic (canary, excluded), "
+            "unavailable (no live shadow connection)",
+            ("owner", "result"))
+        self._c = {r: c.labels(owner=self.owner_id, result=r)
+                   for r in ("mirrored", "match", "divergence", "error",
+                             "skipped", "synthetic", "unavailable")}
+        self._c_div = _REGISTRY.counter(
+            "mxnet_tpu_shadow_divergences_total",
+            "mirrored requests whose candidate output digest differed "
+            "from the primary's", ("owner",)).labels(owner=self.owner_id)
+        hist = _REGISTRY.histogram(
+            "mxnet_tpu_shadow_latency_ms",
+            "end-to-end latency of compared request pairs, primary vs "
+            "shadow leg", ("owner", "which"))
+        self._lat = {
+            "primary": LatencySummary(
+                hist=hist.labels(owner=self.owner_id, which="primary")),
+            "shadow": LatencySummary(
+                hist=hist.labels(owner=self.owner_id, which="shadow"))}
+        _events.emit("shadow_start", owner=self.owner_id,
+                     fraction=self.fraction, threshold=self.threshold,
+                     min_requests=self.min_requests)
+
+    def _reset_counts_locked(self):
+        self.mirrored = 0
+        self.compared = 0
+        self.matched = 0
+        self.divergences = 0
+        self.errors = 0
+        self._recent = collections.deque(maxlen=8)
+
+    # -- arming ------------------------------------------------------------
+    def set_target(self, target, model_id=None, version=None,
+                   fraction=None):
+        """Arm the mirror at a candidate seat. ``target`` is either an
+        in-process engine handle (anything with ``submit`` /
+        ``submit_payload``) or a ``"host:port"`` wire address of a
+        remote engine's dispatch listener. Resets the verdict."""
+        client = None
+        if isinstance(target, str):
+            from .wire import WireClient
+            host, _, port = target.rpartition(":")
+            client = WireClient(host or "127.0.0.1", int(port),
+                                client_id=f"shadow:{self.owner_id}",
+                                timeout_s=self.timeout_s)
+            target = None
+        old = None
+        with self._lock:
+            old = self._client
+            self._target = target
+            self._client = client
+            self.model_id = str(model_id) if model_id else None
+            self.version = str(version) if version is not None else None
+            if fraction is not None:
+                self.fraction = min(1.0, max(0.0, float(fraction)))
+            self._armed_at = time.monotonic()
+            self._reset_counts_locked()
+            self._lat["primary"] = LatencySummary(
+                hist=self._lat["primary"]._hist)
+            self._lat["shadow"] = LatencySummary(
+                hist=self._lat["shadow"]._hist)
+        if old is not None:
+            old.close()
+        _events.emit("shadow_arm", owner=self.owner_id,
+                     model=self.model_id, version=self.version,
+                     remote=client is not None)
+
+    def clear_target(self):
+        """Disarm (candidate withdrawn or promoted). The verdict stays
+        readable until the next :meth:`set_target`."""
+        with self._lock:
+            old, self._client = self._client, None
+            self._target = None
+            self._armed_at = None
+        if old is not None:
+            old.close()
+        _events.emit("shadow_disarm", owner=self.owner_id)
+
+    @property
+    def active(self):
+        return self._target is not None or self._client is not None
+
+    def maintain(self):
+        """Blocking connection upkeep for a wire target — the router
+        calls this from its health-poll thread (never the dispatcher),
+        mirroring the seat clients' own ``ensure()`` discipline."""
+        client = self._client
+        if client is not None:
+            client.ensure()
+
+    # -- the mirror point (router completion path) -------------------------
+    def mirror(self, req, value, primary_ms):
+        """Fire-and-forget mirror of one COMPLETED live request.
+        Called after the live future has resolved; everything past
+        this line is invisible to the live caller. Synthetic canary
+        probes never mirror; real traffic is fraction-sampled by the
+        same deterministic credit accumulator capture uses."""
+        if not self.active:
+            return False
+        if is_synthetic(req.trace_id):
+            self._c["synthetic"].inc()
+            return False
+        with self._lock:
+            self._accum += self.fraction
+            if self._accum < 1.0:
+                sampled = False
+            else:
+                self._accum -= 1.0
+                sampled = True
+        if not sampled:
+            self._c["skipped"].inc()
+            return False
+        payload = dict(req.decode or {},
+                       tokens=np.asarray(req.tokens, np.int32),
+                       stream=False,
+                       trace_id=f"shadow-{req.trace_id}",
+                       model_id=self.model_id or req.model_id,
+                       tenant=req.tenant, tenant_class=req.tenant_class)
+        expected = output_digest(value)
+        # float primaries keep their VALUES for the comparison: the
+        # shadow seat packs the mirrored request differently, which
+        # moves fp outputs by ~1 ulp (capture.py module docstring) —
+        # digest equality stays the int/token contract
+        ref = None
+        if value is not None:
+            arr = np.asarray(value)
+            if arr.dtype.kind == "f":
+                ref = np.ascontiguousarray(arr)
+        t0 = time.monotonic()
+
+        def _done(exc, out):
+            self._observe(req.trace_id, expected, ref, exc, out,
+                          primary_ms, (time.monotonic() - t0) * 1e3)
+
+        client = self._client
+        if client is not None:
+            if not client.has_live():
+                self._c["unavailable"].inc()
+                return False
+            try:
+                client.dispatch(payload, on_done=lambda exc, body:
+                                _done(exc, (body or {}).get("result")
+                                      if exc is None else None),
+                                timeout_s=self.timeout_s)
+            except Exception as e:
+                self._c["error"].inc()
+                _events.emit("shadow_dispatch_error",
+                             owner=self.owner_id, error=repr(e))
+                return False
+        else:
+            target = self._target
+            try:
+                sp = getattr(target, "submit_payload", None)
+                if sp is not None and req.decode:
+                    fut, _streamed = sp(payload)
+                else:
+                    fut = target.submit(
+                        payload["tokens"], trace_id=payload["trace_id"],
+                        model_id=payload["model_id"], tenant=req.tenant,
+                        tenant_class=req.tenant_class)
+                # runs on the shadow engine's worker at completion —
+                # still nowhere near the live caller
+                def _cb(f):
+                    exc = f.exception(timeout=0)
+                    _done(exc, f.result(timeout=0) if exc is None
+                          else None)
+
+                fut.add_done_callback(_cb)
+            except Exception as e:
+                self._c["error"].inc()
+                _events.emit("shadow_submit_error",
+                             owner=self.owner_id, error=repr(e))
+                return False
+        with self._lock:
+            self.mirrored += 1
+        self._c["mirrored"].inc()
+        return True
+
+    def _observe(self, trace_id, expected, ref, exc, out, primary_ms,
+                 shadow_ms):
+        if exc is not None:
+            with self._lock:
+                self.errors += 1
+            self._c["error"].inc()
+            _events.emit("shadow_error", owner=self.owner_id,
+                         trace_id=trace_id, error=repr(exc))
+            return
+        got = output_digest(out)
+        self._lat["primary"].observe(primary_ms, exemplar=trace_id)
+        self._lat["shadow"].observe(shadow_ms, exemplar=trace_id)
+        diverged = got != expected
+        max_diff = None
+        if diverged and ref is not None and out is not None:
+            got_arr = np.asarray(out)
+            if got_arr.shape == ref.shape and got_arr.dtype.kind == "f":
+                max_diff = float(np.max(np.abs(
+                    got_arr.astype(np.float64)
+                    - ref.astype(np.float64)))) if ref.size else 0.0
+                diverged = not np.allclose(got_arr, ref,
+                                           rtol=1e-5, atol=1e-5)
+        with self._lock:
+            self.compared += 1
+            if diverged:
+                self.divergences += 1
+                self._recent.append(
+                    {"trace_id": trace_id, "expected": expected,
+                     "got": got, "max_abs_diff": max_diff,
+                     "primary_ms": round(primary_ms, 3),
+                     "shadow_ms": round(shadow_ms, 3)})
+            else:
+                self.matched += 1
+        if diverged:
+            self._c["divergence"].inc()
+            self._c_div.inc()
+            _events.emit("shadow_divergence", owner=self.owner_id,
+                         trace_id=trace_id, expected=expected, got=got)
+        else:
+            self._c["match"].inc()
+
+    # -- the verdict -------------------------------------------------------
+    def divergence_rate(self):
+        with self._lock:
+            return (self.divergences / self.compared
+                    if self.compared else None)
+
+    def verdict(self):
+        """The ``/shadow`` body: configuration, evidence so far, the
+        pass/fail call (None until ``min_requests`` comparisons have
+        landed), and the recent divergences for triage."""
+        with self._lock:
+            compared = self.compared
+            rate = (self.divergences / compared) if compared else None
+            body = {"owner": self.owner_id, "enabled": True,
+                    "active": self.active,
+                    "model": self.model_id, "version": self.version,
+                    "fraction": self.fraction,
+                    "threshold": self.threshold,
+                    "min_requests": self.min_requests,
+                    "mirrored": self.mirrored, "compared": compared,
+                    "matched": self.matched,
+                    "divergences": self.divergences,
+                    "errors": self.errors,
+                    "divergence_rate": (round(rate, 6)
+                                        if rate is not None else None),
+                    "armed_s": (round(time.monotonic()
+                                      - self._armed_at, 3)
+                                if self._armed_at else None),
+                    "recent_divergences": list(self._recent)}
+        body["passing"] = (None if compared < self.min_requests
+                           else rate <= self.threshold)
+        body["latency"] = {k: v.snapshot()
+                           for k, v in self._lat.items()}
+        return body
+
+    def gate(self):
+        """The ``swap_model`` gate contract: ``(ok, reason)``. Refuses
+        while evidence is insufficient or the divergence rate is over
+        threshold — a candidate must EARN the flip."""
+        with self._lock:
+            compared, divergences = self.compared, self.divergences
+        if not self.active and compared == 0:
+            return False, "shadow mirror not armed (no evidence)"
+        if compared < self.min_requests:
+            return False, (f"insufficient shadow sample: {compared}/"
+                           f"{self.min_requests} comparisons")
+        rate = divergences / compared
+        if rate > self.threshold:
+            return False, (f"shadow divergence rate {rate:.4f} exceeds "
+                           f"threshold {self.threshold:.4f} "
+                           f"({divergences}/{compared} diverged)")
+        return True, (f"shadow verdict passing: {divergences}/"
+                      f"{compared} diverged (rate {rate:.4f} <= "
+                      f"{self.threshold:.4f})")
+
+    def close(self):
+        self.clear_target()
+        _events.emit("shadow_stop", owner=self.owner_id)

@@ -121,6 +121,13 @@ class TelemetryServer:
         per-stage latency-attribution table from
         :mod:`.attribution`, or the router's fleet merge); None =
         404.
+    capture_fn : ``() -> dict`` enabling ``/capture`` (the owner's
+        traffic-capture corpus summary from
+        :mod:`~..serving.capture`, or the router's fleet merge);
+        None = 404 (capture disabled).
+    shadow_fn : ``() -> dict`` enabling ``/shadow`` (the router's
+        shadow-diff verdict from :mod:`~..serving.shadow`); None =
+        404 (shadow validation disabled).
     profile_fn : ``() -> str | dict`` overriding ``/profile``; None =
         the process continuous profiler (:mod:`.profiling`) — a str
         serves as collapsed text, a dict as JSON.
@@ -134,6 +141,7 @@ class TelemetryServer:
                  submit_fn=None, warmup_fn=None, costs_fn=None,
                  profile_fn=None, slo_fn=None, alerts_fn=None,
                  incidents_fn=None, history_fn=None, whyslow_fn=None,
+                 capture_fn=None, shadow_fn=None,
                  port=0, host="127.0.0.1"):
         self.registry = registry if registry is not None else REGISTRY
         self.healthz_fn = healthz_fn
@@ -150,6 +158,8 @@ class TelemetryServer:
         self.incidents_fn = incidents_fn
         self.history_fn = history_fn
         self.whyslow_fn = whyslow_fn
+        self.capture_fn = capture_fn
+        self.shadow_fn = shadow_fn
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -290,6 +300,12 @@ class TelemetryServer:
         elif path == "/whyslow":
             self._json_fn(handler, self.whyslow_fn,
                           "no stage attribution")
+        elif path == "/capture":
+            self._json_fn(handler, self.capture_fn,
+                          "traffic capture disabled")
+        elif path == "/shadow":
+            self._json_fn(handler, self.shadow_fn,
+                          "shadow validation disabled")
         elif path == "/incidents":
             if self.incidents_fn is not None:
                 self._json_fn(handler, self.incidents_fn, "")

@@ -1,0 +1,384 @@
+"""Traffic capture + deterministic replay (mxnet_tpu/serving/capture):
+corpus durability (torn tail, disk budget, cross-process reload),
+canary exclusion, payload modes, byte-identical replay against the
+same code and divergence detection against perturbed code, and the
+``MXNET_TPU_CAPTURE=0`` disabled-path guarantees. Marker-clean tier-1.
+"""
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mxnet_tpu import nd
+from mxnet_tpu.serving import (CaptureStore, ServingEngine, load_corpus,
+                               output_digest, replay)
+from mxnet_tpu.serving.capture import is_synthetic, merge_summaries
+from mxnet_tpu.serving.queue import Request
+from mxnet_tpu.telemetry.registry import REGISTRY
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class StubModel:
+    """out[b, s, 0] == ids[b, s] (+ optional bias): bit-deterministic,
+    so capture digests replay exactly — and a biased rebuild is the
+    injected perturbation replay must catch."""
+
+    def __init__(self, bias=0.0):
+        self.bias = bias
+
+    def __call__(self, ids, token_types, valid_length, segment_ids,
+                 positions):
+        out = ids.asnumpy().astype(np.float32)[..., None] + self.bias
+        return nd.array(out)
+
+
+def _req(tokens, trace_id=None, tenant=None, tenant_class=None):
+    r = Request(tokens, trace_id=trace_id, tenant=tenant,
+                tenant_class=tenant_class)
+    r.span.end()
+    return r
+
+
+def _record(store, tokens, out=None, trace_id=None, outcome="completed",
+            tenant=None, **kw):
+    req = _req(tokens, trace_id=trace_id, tenant=tenant)
+    if out is None:
+        out = np.asarray(tokens, np.float32)
+    return store.record_request(req, out, outcome, 12.5, **kw)
+
+
+# ---------------------------------------------------------------------------
+# store + corpus durability
+# ---------------------------------------------------------------------------
+
+def test_record_roundtrip_disk(tmp_path):
+    store = CaptureStore("e0", dir=str(tmp_path), rate=1.0, max_mb=4)
+    toks = np.array([3, 1, 4, 1, 5], np.int32)
+    out = np.array([0.5, -1.5], np.float32)
+    assert _record(store, toks, out, tenant="t-a", model="m0",
+                   version="v1", engine_id="e0")
+    store.close()
+
+    records, skipped = load_corpus(str(tmp_path))
+    assert skipped == 0 and len(records) == 1
+    rec = records[0]
+    assert rec["model"] == "m0" and rec["version"] == "v1"
+    assert rec["engine_id"] == "e0"
+    assert rec["outcome"] == "completed"
+    # tokens ride the typed wire codec: int32, bit-exact
+    got = np.asarray(rec["tokens"])
+    assert got.dtype == np.int32 and np.array_equal(got, toks)
+    assert rec["output_digest"] == output_digest(out)
+    # small float outputs ride along for tolerance replay
+    assert np.array_equal(np.asarray(rec["output_vals"]), out)
+    assert rec["total_ms"] == 12.5
+    assert rec["arrival_wall"] == pytest.approx(time.time(), abs=60.0)
+
+
+def test_payload_digest_mode_not_replayable(tmp_path):
+    store = CaptureStore("e0", dir=str(tmp_path), payload="digest")
+    toks = np.arange(6, dtype=np.int32)
+    assert _record(store, toks)
+    store.close()
+    records, _ = load_corpus(str(tmp_path))
+    rec = records[0]
+    assert rec["tokens"] is None and rec["output_vals"] is None
+    assert rec["prompt_digest"] == output_digest(toks)
+    assert rec["prompt_len"] == 6
+    report = replay(records, target=None)
+    assert report["replayed"] == 0
+    assert report["skipped"]["no_payload"] == 1
+
+
+def test_canary_traffic_never_enters_corpus(tmp_path):
+    store = CaptureStore("e0", dir=str(tmp_path))
+    assert is_synthetic("canary-abc") and not is_synthetic("req-abc")
+    assert not _record(store, [1, 2], trace_id="canary-e0-7")
+    assert _record(store, [1, 2], trace_id="req-real")
+    store.close()
+    records, _ = load_corpus(str(tmp_path))
+    assert [r["trace_id"] for r in records] == ["req-real"]
+
+
+def test_sampling_rate_deterministic_credit():
+    store = CaptureStore("e0", dir=None, rate=0.25)
+    picked = [store.should_sample(f"req-{i}") for i in range(12)]
+    assert sum(picked) == 3          # exactly rate * n, no RNG
+    assert store.should_sample("canary-x") is False
+
+
+def test_torn_tail_skipped_not_fatal(tmp_path):
+    store = CaptureStore("e0", dir=str(tmp_path))
+    for i in range(3):
+        assert _record(store, [i, i + 1], trace_id=f"req-{i}")
+    store.close()
+    segs = sorted(p for p in os.listdir(tmp_path) if p.endswith(".seg"))
+    # simulate a crash mid-append: garbage half-frame at the tail
+    with open(tmp_path / segs[-1], "ab") as fh:
+        fh.write(b"\xde\xad\xbe\xef\x01")
+    records, skipped = load_corpus(str(tmp_path))
+    assert len(records) == 3 and skipped >= 1
+
+
+def test_disk_budget_evicts_oldest_sealed_segments(tmp_path):
+    # tiny budget => segment_bytes floors at 4 KiB; ~60-record frames
+    # seal segments quickly and eviction must reclaim the oldest
+    store = CaptureStore("e0", dir=str(tmp_path), max_mb=0.01)
+    for i in range(600):
+        assert _record(store, [i % 50, 1, 2], trace_id=f"req-{i}")
+    store.close()
+    assert store.corpus_bytes() <= 0.01 * 1024 * 1024 + 4096
+    records, skipped = load_corpus(str(tmp_path))
+    assert skipped == 0
+    ids = [int(r["trace_id"].split("-")[1]) for r in records]
+    # oldest evicted, newest retained, survivors contiguous
+    assert 0 not in ids and 599 in ids
+    assert ids == sorted(ids)
+
+
+def test_in_memory_corpus_and_summary():
+    store = CaptureStore("e0", dir=None, rate=1.0, max_mb=1)
+    for i in range(4):
+        assert _record(store, [i], trace_id=f"req-{i}")
+    records, skipped = store.records()
+    assert skipped == 0 and len(records) == 4
+    s = store.summary()
+    assert s["enabled"] and s["records_written"] == 4
+    assert s["dir"] is None and s["corpus_bytes"] > 0
+    assert s["age_s"] is not None and s["age_s"] >= 0
+    store.close()
+
+
+def test_merge_summaries_fleet_totals():
+    a = {"records_written": 3, "corpus_bytes": 100, "write_errors": 0}
+    b = {"records_written": 5, "corpus_bytes": 250, "write_errors": 1}
+    merged = merge_summaries(
+        [("e0", a), ("e1", b), ("e2", None)], owner="r0")
+    assert merged["owner"] == "r0" and merged["enabled"]
+    assert merged["fleet"]["records_written"] == 8
+    assert merged["fleet"]["corpus_bytes"] == 350
+    assert merged["fleet"]["write_errors"] == 1
+    assert merged["missing"] == ["e2"]
+
+
+# ---------------------------------------------------------------------------
+# engine capture -> replay oracle
+# ---------------------------------------------------------------------------
+
+def _capture_engine(monkeypatch, tmp_path, bias=0.0, capture=True):
+    monkeypatch.setenv("MXNET_TPU_CAPTURE", "1" if capture else "0")
+    monkeypatch.setenv("MXNET_TPU_CAPTURE_DIR", str(tmp_path))
+    return ServingEngine(StubModel(bias=bias), bucket_lens=(16,),
+                         max_rows=2, engine_id="cap0")
+
+
+def test_engine_replay_byte_identical_zero_divergences(
+        monkeypatch, tmp_path):
+    rs = np.random.RandomState(3)
+    prompts = [rs.randint(1, 50, size=rs.randint(2, 14)).astype(np.int32)
+               for _ in range(6)]
+    with _capture_engine(monkeypatch, tmp_path / "c") as eng:
+        eng.warmup()
+        for p in prompts:
+            eng.submit(p).result(timeout=30)
+    records, skipped = load_corpus(str(tmp_path / "c"))
+    # warmup is synthetic-free but capture samples only REAL submits
+    assert skipped == 0 and len(records) == 6
+    assert all(r["breakdown"] for r in records)
+
+    # same code, fresh engine: zero divergences, all bitwise
+    with _capture_engine(monkeypatch, tmp_path / "unused",
+                         capture=False) as eng2:
+        eng2.warmup()
+        report = replay(records, eng2)
+    assert report["replayed"] == 6 and report["matched"] == 6
+    assert report["matched_bitwise"] == 6
+    assert report["divergences"] == [] and report["errors"] == []
+
+
+def test_engine_replay_detects_injected_perturbation(
+        monkeypatch, tmp_path):
+    with _capture_engine(monkeypatch, tmp_path / "c") as eng:
+        eng.warmup()
+        for i in range(4):
+            eng.submit([1 + i, 2, 3]).result(timeout=30)
+    records, _ = load_corpus(str(tmp_path / "c"))
+
+    with _capture_engine(monkeypatch, tmp_path / "unused", bias=0.5,
+                         capture=False) as bad:
+        bad.warmup()
+        report = replay(records, bad)
+    assert report["matched"] == 0
+    assert len(report["divergences"]) == 4
+    named = {d["trace_id"] for d in report["divergences"]}
+    assert named == {r["trace_id"] for r in records}
+    for d in report["divergences"]:
+        assert d["expected"] != d["got"]
+        # fp outputs carry the numeric evidence + the replayed
+        # request's own critical path
+        assert d["max_abs_diff"] == pytest.approx(0.5)
+        assert d["breakdown"] and d["breakdown"]["stages"]
+
+
+def test_float_tolerance_accepts_packing_noise_only():
+    # digest differs by sub-tolerance noise -> matched_within_tol;
+    # a real regression (>> 1e-5) -> divergence
+    out = np.linspace(-1, 1, 8, dtype=np.float32)
+    store = CaptureStore("e0", dir=None)
+    _record(store, [1, 2, 3], out, trace_id="req-tol")
+    rec = store.records()[0][0]
+
+    class OneShot:
+        def __init__(self, value):
+            self.value = value
+
+        def submit(self, tokens, **kw):
+            class F:
+                def result(_self, timeout=None):
+                    return self.value
+            return F()
+
+    noisy = out + np.float32(3e-6)          # ~packed-lane ulp noise
+    report = replay([rec], OneShot(noisy))
+    assert report["matched"] == 1 and report["matched_within_tol"] == 1
+    report = replay([rec], OneShot(out + np.float32(1e-3)))
+    assert report["matched"] == 0 and len(report["divergences"]) == 1
+    store.close()
+
+
+def test_decode_capture_replay_seeded_streams(monkeypatch, tmp_path):
+    from mxnet_tpu.serving import DecodeEngine, PagedCausalLM
+
+    monkeypatch.setenv("MXNET_TPU_CAPTURE", "1")
+    monkeypatch.setenv("MXNET_TPU_CAPTURE_DIR", str(tmp_path / "c"))
+
+    def mk(seed):
+        lm = PagedCausalLM(vocab=64, units=32, layers=2, heads=4,
+                           max_len=128, seed=seed)
+        return DecodeEngine(lm, prefill_bucket_lens=(8, 16), max_rows=4,
+                            page_size=8, n_pages=24, max_new_tokens=5)
+
+    rs = np.random.RandomState(5)
+    with mk(seed=7) as eng:
+        for i in range(4):
+            toks = rs.randint(1, 64, size=6).astype(np.int32)
+            fut, _ = eng.submit_payload(
+                {"tokens": toks, "temperature": 0.8, "top_k": 8,
+                 "seed": 100 + i, "stream": False})
+            fut.result(timeout=30)
+    records, _ = load_corpus(str(tmp_path / "c"))
+    assert len(records) == 4
+    assert all(r["decode"]["seed"] == 100 + i
+               for i, r in enumerate(records))
+
+    monkeypatch.setenv("MXNET_TPU_CAPTURE", "0")
+    # identical model + captured seeds: byte-identical token streams
+    with mk(seed=7) as same:
+        report = replay(records, same)
+    assert report["matched_bitwise"] == 4 and not report["divergences"]
+    # different weights: every seeded stream flips
+    with mk(seed=8) as other:
+        report = replay(records, other)
+    assert len(report["divergences"]) == 4
+
+
+def test_replay_pacing_speed(monkeypatch, tmp_path):
+    with _capture_engine(monkeypatch, tmp_path / "c") as eng:
+        eng.warmup()
+        eng.submit([1, 2]).result(timeout=30)
+        time.sleep(0.25)
+        eng.submit([3, 4]).result(timeout=30)
+    records, _ = load_corpus(str(tmp_path / "c"))
+    with _capture_engine(monkeypatch, tmp_path / "u",
+                         capture=False) as eng2:
+        eng2.warmup()
+        t0 = time.monotonic()
+        fast = replay(records, eng2, speed=0)     # no pacing
+        dt_fast = time.monotonic() - t0
+        paced = replay(records, eng2, speed=1.0)  # original gaps
+    assert fast["matched"] == 2 and paced["matched"] == 2
+    assert dt_fast < 0.2
+    assert paced["wall_s"] >= 0.2                 # ~the captured gap
+
+
+# ---------------------------------------------------------------------------
+# cross-process golden: corpus written THERE, replayed HERE
+# ---------------------------------------------------------------------------
+
+def test_cross_process_corpus_golden(tmp_path):
+    corpus = tmp_path / "corpus"
+    worker = subprocess.Popen(
+        [sys.executable,
+         os.path.join(ROOT, "tests", "serving_router_engine_worker.py"),
+         "proc-cap"],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True,
+        env=dict(os.environ, JAX_PLATFORMS="cpu",
+                 MXNET_TPU_CAPTURE="1",
+                 MXNET_TPU_CAPTURE_DIR=str(corpus)))
+    try:
+        line = worker.stdout.readline()
+        assert line.startswith("PORT "), line
+        port = int(line.split()[1])
+        import json
+        import urllib.request
+        for i in range(3):
+            body = json.dumps({"tokens": [1 + i, 2, 3]}).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/submit", data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                assert resp.status == 200
+    finally:
+        worker.stdin.close()
+        worker.wait(timeout=30)
+
+    records, skipped = load_corpus(str(corpus))
+    assert skipped == 0 and len(records) == 3
+    # replay the other process's corpus against an identical local
+    # engine (the worker serves the same identity model as StubModel)
+    with ServingEngine(StubModel(), bucket_lens=(32,), max_rows=2,
+                       engine_id="local") as eng:
+        eng.warmup()
+        report = replay(records, eng)
+    assert report["matched_bitwise"] == 3 and not report["divergences"]
+
+
+# ---------------------------------------------------------------------------
+# disabled path: MXNET_TPU_CAPTURE=0 builds nothing
+# ---------------------------------------------------------------------------
+
+def test_capture_disabled_no_files_no_families_no_threads(
+        monkeypatch, tmp_path):
+    monkeypatch.delenv("MXNET_TPU_CAPTURE", raising=False)
+    monkeypatch.setenv("MXNET_TPU_CAPTURE_DIR", str(tmp_path / "c"))
+    before = set(threading.enumerate())
+    eng = ServingEngine(StubModel(), bucket_lens=(16,), max_rows=2,
+                        engine_id="cap-off")
+    with eng:
+        eng.warmup()
+        eng.infer([1, 2, 3], timeout=30)
+        assert eng.capture is None and eng.capture_summary() is None
+        # no capture thread beyond the engine's own machinery
+        extra = [t.name for t in set(threading.enumerate()) - before]
+        assert not any("capture" in n.lower() for n in extra)
+    assert not (tmp_path / "c").exists()
+    # no owner-labeled capture series for this engine
+    text = REGISTRY.render_prometheus()
+    assert 'owner="cap-off"' not in text
+    # microbench guard: the per-request cost of capture-off is one
+    # attribute check — submit/result stays well under a millisecond
+    # of overhead per request on the StubModel
+    with ServingEngine(StubModel(), bucket_lens=(16,), max_rows=2,
+                       engine_id="cap-off-2") as eng2:
+        eng2.warmup()
+        n = 50
+        t0 = time.perf_counter()
+        for _ in range(n):
+            eng2.infer([1, 2, 3], timeout=30)
+        per = (time.perf_counter() - t0) / n
+    assert per < 0.25, f"disabled-path request cost {per * 1e3:.1f}ms"

@@ -1,0 +1,388 @@
+"""Shadow-diff validation (mxnet_tpu/serving/shadow): mirror diffing,
+the swap gate, fire-and-forget isolation from the live path, the
+2-engine router drill (divergent candidate detected and refused, zero
+lost live requests; faithful candidate admitted), the /capture +
+/shadow exposition bodies and telemetry_dump's exit-6 contract, and
+the ``MXNET_TPU_SHADOW=0`` disabled-path guarantees. Tier-1.
+"""
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mxnet_tpu import nd
+from mxnet_tpu.serving import (ServingEngine, ServingRouter, ServingError,
+                               ShadowMirror, SwapGateError)
+from mxnet_tpu.serving.queue import InferenceFuture
+from mxnet_tpu.telemetry.registry import REGISTRY
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class StubModel:
+    """out[b, s, 0] == ids[b, s] + bias — bias 0 is the faithful
+    candidate, any other bias is a DIVERGENT one (wrong outputs at
+    identical latency, the case only output diffing catches)."""
+
+    def __init__(self, bias=0.0):
+        self.bias = bias
+
+    def __call__(self, ids, token_types, valid_length, segment_ids,
+                 positions):
+        out = ids.asnumpy().astype(np.float32)[..., None] + self.bias
+        return nd.array(out)
+
+
+class FakeReq:
+    def __init__(self, trace_id, tokens=(1, 2, 3)):
+        self.trace_id = trace_id
+        self.tokens = np.asarray(tokens, np.int32)
+        self.decode = None
+        self.model_id = None
+        self.tenant = None
+        self.tenant_class = None
+
+
+class EchoTarget:
+    """In-process shadow seat stand-in: answers each mirrored submit
+    with fn(tokens) on the caller thread."""
+
+    def __init__(self, fn):
+        self.fn = fn
+        self.seen = []
+
+    def submit(self, tokens, trace_id=None, model_id=None, tenant=None,
+               tenant_class=None):
+        self.seen.append(trace_id)
+        fut = InferenceFuture()
+        fut.set_result(self.fn(np.asarray(tokens)))
+        return fut
+
+
+def _mirror(monkeypatch, min_requests=4, fraction=1.0, threshold=0.0):
+    monkeypatch.setenv("MXNET_TPU_SHADOW", "1")
+    monkeypatch.setenv("MXNET_TPU_SHADOW_MIN_REQUESTS",
+                       str(min_requests))
+    monkeypatch.setenv("MXNET_TPU_SHADOW_FRACTION", str(fraction))
+    monkeypatch.setenv("MXNET_TPU_SHADOW_THRESHOLD", str(threshold))
+    return ShadowMirror("r-test")
+
+
+def _drive(mirror, n, live_fn=lambda t: t.astype(np.float32)):
+    for i in range(n):
+        req = FakeReq(f"req-{i}")
+        mirror.mirror(req, live_fn(req.tokens), primary_ms=2.0)
+
+
+# ---------------------------------------------------------------------------
+# mirror diffing + verdict
+# ---------------------------------------------------------------------------
+
+def test_faithful_candidate_matches_and_gate_opens(monkeypatch):
+    m = _mirror(monkeypatch, min_requests=4)
+    m.set_target(EchoTarget(lambda t: t.astype(np.float32)),
+                 model_id="m0", version="v2")
+    _drive(m, 6)
+    v = m.verdict()
+    assert v["compared"] == 6 and v["divergences"] == 0
+    assert v["passing"] is True and v["divergence_rate"] == 0.0
+    assert v["latency"]["primary"]["count"] == 6
+    ok, reason = m.gate()
+    assert ok, reason
+    m.close()
+
+
+def test_divergent_candidate_fails_and_gate_refuses(monkeypatch):
+    m = _mirror(monkeypatch, min_requests=4)
+    m.set_target(EchoTarget(lambda t: t.astype(np.float32) + 7.0),
+                 model_id="m0", version="v2")
+    _drive(m, 6)
+    v = m.verdict()
+    assert v["divergences"] == 6 and v["passing"] is False
+    assert v["recent_divergences"]
+    assert v["recent_divergences"][-1]["max_abs_diff"] \
+        == pytest.approx(7.0)
+    ok, reason = m.gate()
+    assert not ok and "divergence rate" in reason
+    m.close()
+
+
+def test_float_packing_noise_is_not_a_divergence(monkeypatch):
+    m = _mirror(monkeypatch, min_requests=2)
+    m.set_target(EchoTarget(
+        lambda t: t.astype(np.float32) + np.float32(3e-6)),
+        model_id="m0", version="v2")
+    _drive(m, 4)
+    v = m.verdict()
+    assert v["divergences"] == 0 and v["passing"] is True
+    m.close()
+
+
+def test_verdict_inconclusive_below_min_requests(monkeypatch):
+    m = _mirror(monkeypatch, min_requests=8)
+    m.set_target(EchoTarget(lambda t: t.astype(np.float32)),
+                 model_id="m0", version="v2")
+    _drive(m, 3)
+    assert m.verdict()["passing"] is None
+    ok, reason = m.gate()
+    assert not ok and "3" in reason
+    m.close()
+
+
+def test_canary_and_fraction_sampling(monkeypatch):
+    m = _mirror(monkeypatch, min_requests=1, fraction=0.5)
+    tgt = EchoTarget(lambda t: t.astype(np.float32))
+    m.set_target(tgt, model_id="m0", version="v2")
+    assert m.mirror(FakeReq("canary-r0-1"), np.zeros(2, np.float32),
+                    1.0) is False
+    _drive(m, 8)
+    assert m.verdict()["mirrored"] == 4          # exactly fraction * n
+    # mirrored trace ids are namespaced off the live ones
+    assert all(t.startswith("shadow-req-") for t in tgt.seen)
+    m.close()
+
+
+def test_rearm_resets_verdict(monkeypatch):
+    m = _mirror(monkeypatch, min_requests=2)
+    m.set_target(EchoTarget(lambda t: t.astype(np.float32) + 1.0),
+                 model_id="m0", version="v2")
+    _drive(m, 4)
+    assert m.verdict()["passing"] is False
+    m.set_target(EchoTarget(lambda t: t.astype(np.float32)),
+                 model_id="m0", version="v3")
+    assert m.verdict()["compared"] == 0          # fresh evidence
+    _drive(m, 4)
+    assert m.verdict()["passing"] is True
+    m.close()
+
+
+def test_mirror_is_fire_and_forget(monkeypatch):
+    m = _mirror(monkeypatch, min_requests=1)
+
+    class NeverDone:
+        def __init__(self):
+            self.futs = []
+
+        def submit(self, tokens, **kw):
+            fut = InferenceFuture()
+            self.futs.append(fut)
+            return fut
+
+    tgt = NeverDone()
+    m.set_target(tgt, model_id="m0", version="v2")
+    t0 = time.perf_counter()
+    _drive(m, 20)
+    dt = time.perf_counter() - t0
+    # a shadow seat that never answers costs the live path ~nothing
+    assert dt < 0.5, f"mirror blocked the live path: {dt:.3f}s"
+    assert m.verdict()["compared"] == 0
+    # late completions still land (outside any live wait)
+    for fut in tgt.futs:
+        fut.set_result(np.asarray([1, 2, 3], np.float32))
+    assert m.verdict()["compared"] == 20
+    m.close()
+
+
+# ---------------------------------------------------------------------------
+# the swap gate
+# ---------------------------------------------------------------------------
+
+def test_swap_model_gate_refuses_then_admits(monkeypatch):
+    m = _mirror(monkeypatch, min_requests=2)
+    with ServingEngine(StubModel(), bucket_lens=(16,), max_rows=2,
+                       engine_id="gate0") as eng:
+        eng.warmup()
+        # an unarmed, evidence-free gate refuses (no silent flips)
+        with pytest.raises(SwapGateError):
+            eng.swap_model(StubModel(), version="v2", gate=m)
+        # divergent candidate: evidence says no
+        m.set_target(EchoTarget(lambda t: t.astype(np.float32) + 5.0),
+                     model_id="default", version="v2")
+        _drive(m, 4)
+        with pytest.raises(SwapGateError) as ei:
+            eng.swap_model(StubModel(bias=5.0), version="v2", gate=m)
+        assert "divergence rate" in str(ei.value)
+        assert eng.infer([1, 2, 3], timeout=30)[0] == 1.0  # still live
+        # faithful candidate: evidence says yes, flip proceeds
+        m.set_target(EchoTarget(lambda t: t.astype(np.float32)),
+                     model_id="default", version="v3")
+        _drive(m, 4)
+        eng.swap_model(StubModel(), version="v3", gate=m)
+        assert eng.infer([1, 2, 3], timeout=30)[0] == 1.0
+    m.close()
+
+
+# ---------------------------------------------------------------------------
+# the router drill: divergent candidate behind a 2-engine fleet
+# ---------------------------------------------------------------------------
+
+def _wait_for(pred, timeout, what):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def test_router_shadow_drill_refuse_then_admit(monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_SHADOW", "1")
+    monkeypatch.setenv("MXNET_TPU_SHADOW_MIN_REQUESTS", "6")
+    monkeypatch.setenv("MXNET_TPU_SHADOW_FRACTION", "1.0")
+    engines = [ServingEngine(StubModel(), bucket_lens=(16,), max_rows=2,
+                             engine_id=f"sd{i}") for i in range(2)]
+    for eng in engines:
+        eng.start()
+        eng.warmup()
+    router = ServingRouter(engines=engines, poll_interval_s=0.1)
+    router.start()
+    bad = ServingEngine(StubModel(bias=3.0), bucket_lens=(16,),
+                        max_rows=2, engine_id="cand-bad")
+    good = ServingEngine(StubModel(), bucket_lens=(16,), max_rows=2,
+                         engine_id="cand-good")
+    bad.start(), good.start()
+    try:
+        router.set_shadow_target(bad, model_id="default", version="v2")
+        futs = [router.submit([1 + (i % 5), 2, 3]) for i in range(10)]
+        outs = [f.result(timeout=60) for f in futs]
+        # ZERO lost live requests, correct live outputs throughout
+        assert len(outs) == 10
+        assert all(o[0] == 1 + (i % 5) for i, o in enumerate(outs))
+        _wait_for(lambda: router.shadow_verdict()["compared"] >= 10,
+                  30, "mirrored completions")
+        v = router.shadow_verdict()
+        assert v["passing"] is False and v["divergences"] >= 6
+        assert v["latency"]["shadow"]["count"] >= 6
+        # the flip is refused on EVERY seat while the verdict fails
+        for eng in engines:
+            with pytest.raises(SwapGateError):
+                eng.swap_model(StubModel(bias=3.0), version="v2",
+                               gate=router.shadow)
+
+        # faithful candidate: fresh evidence, gate opens, swap lands
+        router.set_shadow_target(good, model_id="default",
+                                 version="v2")
+        futs = [router.submit([2, 2, 3]) for _ in range(8)]
+        for f in futs:
+            f.result(timeout=60)
+        _wait_for(lambda: router.shadow_verdict()["compared"] >= 8,
+                  30, "faithful mirror completions")
+        assert router.shadow_verdict()["passing"] is True
+        for eng in engines:
+            eng.swap_model(StubModel(), version="v2",
+                           gate=router.shadow)
+        assert router.submit([7, 2]).result(timeout=60)[0] == 7.0
+    finally:
+        router.stop()
+        for eng in engines + [bad, good]:
+            eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# exposition + telemetry_dump
+# ---------------------------------------------------------------------------
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=30) as r:
+        return r.status, r.read().decode()
+
+
+def test_capture_and_shadow_endpoints(monkeypatch, tmp_path):
+    monkeypatch.setenv("MXNET_TPU_CAPTURE", "1")
+    monkeypatch.setenv("MXNET_TPU_CAPTURE_DIR", str(tmp_path / "c"))
+    monkeypatch.setenv("MXNET_TPU_SHADOW", "1")
+    monkeypatch.setenv("MXNET_TPU_SHADOW_MIN_REQUESTS", "2")
+    monkeypatch.setenv("MXNET_TPU_SHADOW_FRACTION", "1.0")
+    eng = ServingEngine(StubModel(), bucket_lens=(16,), max_rows=2,
+                        engine_id="ep0")
+    with eng:
+        eng.warmup()
+        router = ServingRouter(engines=[eng], poll_interval_s=0.1)
+        router.start()
+        try:
+            srv = router.expose()
+            router.submit([1, 2, 3]).result(timeout=30)
+            code, body = _get(srv.url("/capture"))
+            assert code == 200
+            cap = json.loads(body)
+            assert cap["fleet"]["records_written"] >= 1
+            assert "ep0" in cap["engines"]
+            code, body = _get(srv.url("/shadow"))
+            assert code == 200
+            shad = json.loads(body)
+            assert shad["enabled"] and shad["active"] is False
+
+            sys.path.insert(0, os.path.join(ROOT, "tools"))
+            import telemetry_dump
+            assert telemetry_dump.main(
+                ["--capture", srv.url("")]) == 0
+            # an inconclusive (unarmed) verdict is not a FAILING one
+            assert telemetry_dump.main(
+                ["--shadow", srv.url("")]) == 0
+            # arm at a divergent candidate, land divergences -> exit 6
+            bad = ServingEngine(StubModel(bias=2.0), bucket_lens=(16,),
+                                max_rows=2, engine_id="ep-bad")
+            bad.start()
+            try:
+                router.set_shadow_target(bad, model_id="default",
+                                         version="v9")
+                for i in range(4):
+                    router.submit([1 + i, 2]).result(timeout=30)
+                _wait_for(
+                    lambda: (router.shadow_verdict() or
+                             {}).get("compared", 0) >= 4,
+                    30, "mirror completions")
+                assert router.shadow_verdict()["passing"] is False
+                assert telemetry_dump.main(
+                    ["--shadow", srv.url("")]) == 6
+            finally:
+                bad.stop()
+        finally:
+            router.stop()
+
+
+def test_engine_capture_endpoint_disabled_404(monkeypatch):
+    monkeypatch.delenv("MXNET_TPU_CAPTURE", raising=False)
+    with ServingEngine(StubModel(), bucket_lens=(16,), max_rows=2,
+                       engine_id="ep-off") as eng:
+        srv = eng.expose()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(srv.url("/capture"))
+        assert ei.value.code == 404
+
+
+# ---------------------------------------------------------------------------
+# disabled path: MXNET_TPU_SHADOW=0 builds nothing
+# ---------------------------------------------------------------------------
+
+def test_shadow_disabled_builds_nothing(monkeypatch):
+    monkeypatch.delenv("MXNET_TPU_SHADOW", raising=False)
+    before = set(threading.enumerate())
+    with ServingEngine(StubModel(), bucket_lens=(16,), max_rows=2,
+                       engine_id="off0") as eng:
+        eng.warmup()
+        router = ServingRouter(engines=[eng], poll_interval_s=0.1)
+        router.start()
+        try:
+            assert router.shadow is None
+            assert router.shadow_verdict() is None
+            with pytest.raises(ServingError):
+                router.set_shadow_target(eng)
+            router.clear_shadow_target()        # no-op, never raises
+            router.submit([1, 2, 3]).result(timeout=30)
+            srv = router.expose()
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _get(srv.url("/shadow"))
+            assert ei.value.code == 404
+            extra = [t.name
+                     for t in set(threading.enumerate()) - before]
+            assert not any("shadow" in n.lower() for n in extra)
+        finally:
+            router.stop()
+    assert f'owner="{router.router_id}"' not in REGISTRY.render_prometheus()

@@ -2237,6 +2237,22 @@ def _main():
                     "engine and round-robin submits across them — the "
                     "multi-model mix (per-model splits land in the "
                     "tenant-slice families and /stats)")
+    ap.add_argument("--replay", default=None, metavar="DIR",
+                    help="instead of generating load, REPLAY a "
+                    "captured corpus (MXNET_TPU_CAPTURE_DIR) against "
+                    "the target: every completed record with a token "
+                    "payload is re-submitted with its captured "
+                    "sampling params + seed and the output is "
+                    "asserted byte-identical to the recorded digest. "
+                    "Build the target with the SAME flags as the "
+                    "capture run (--decode, --router N, --models N, "
+                    "...). Exits 1 on any divergence, printing the "
+                    "per-stage breakdown of the slowest diverging "
+                    "request")
+    ap.add_argument("--speed", type=float, default=None, metavar="X",
+                    help="--replay pacing: X times the captured "
+                    "arrival rate (1.0 = original pacing; default: "
+                    "as fast as the target admits)")
     ap.add_argument("--drill-overload", nargs="?", const="auto",
                     default=None, metavar="ALERT",
                     help="instead of the measured run, flood the "
@@ -2278,6 +2294,11 @@ def _main():
                         num_layers=args.layers, num_heads=args.heads,
                         max_length=args.max_len, dropout=0.0,
                         attention_dropout=0.0, use_pooler=False)
+        # fixed weight seed: capture digests must replay
+        # byte-identical across processes (--replay rebuilds the
+        # target) and across seats (--router N may place the replayed
+        # request on a different engine than the recording)
+        mx.random.seed(0xC0FFEE)
         net.initialize(init=mx.initializer.Normal(0.02))
         model = bert_serving_entry(net)
         if args.drill_wedge is not None:
@@ -2376,6 +2397,58 @@ def _main():
             print(f"# telemetry: {srv.url('/metrics')} "
                   f"{srv.url('/healthz')} {srv.url('/stats')}",
                   file=sys.stderr)
+        if args.replay:
+            from mxnet_tpu.serving.capture import load_corpus
+            from mxnet_tpu.serving.capture import replay as _replay
+
+            records, torn = load_corpus(args.replay)
+            if not records:
+                ap.error(f"--replay {args.replay}: no records loaded"
+                         + (f" ({torn} torn/corrupt frames skipped)"
+                            if torn else ""))
+            pacing = (f"pacing x{args.speed:g}" if args.speed
+                      else "max speed")
+            print(f"# replay: {len(records)} records from "
+                  f"{args.replay}"
+                  + (f" ({torn} torn/corrupt frames skipped)"
+                     if torn else "") + f", {pacing}",
+                  file=sys.stderr)
+            result = _replay(records, target, speed=args.speed)
+            print(json.dumps(result, indent=2))
+            div = result["divergences"]
+            print(f"# replay done: {result['replayed']} replayed in "
+                  f"{result['wall_s']}s, {result['matched']} matched "
+                  f"({result['matched_bitwise']} byte-identical, "
+                  f"{result['matched_within_tol']} float-tolerance), "
+                  f"{len(div)} divergences, "
+                  f"{len(result['errors'])} errors, "
+                  f"{result['skipped']['not_completed']} "
+                  "not-completed + "
+                  f"{result['skipped']['no_payload']} payload-less "
+                  "records skipped", file=sys.stderr)
+            if div:
+                slow = max(div, key=lambda d: d.get("replay_ms")
+                           or 0.0)
+                print("# slowest diverging request "
+                      f"{slow['trace_id']} (model {slow['model']}): "
+                      f"expected digest {slow['expected']}, got "
+                      f"{slow['got']}"
+                      + (f" (max |diff| {slow['max_abs_diff']:g})"
+                         if slow.get("max_abs_diff") is not None
+                         else "")
+                      + f"; captured {slow['captured_ms']} ms vs "
+                      f"replay {slow['replay_ms']} ms",
+                      file=sys.stderr)
+                bd = slow.get("breakdown") or {}
+                for row in bd.get("stages") or ():
+                    print(f"#   {row['stage']:<20} "
+                          f"{row['ms']:>10.3f} ms "
+                          f"({row['share']:.0%})", file=sys.stderr)
+                if bd.get("unattributed_ms") is not None:
+                    print(f"#   {'(unattributed)':<20} "
+                          f"{bd['unattributed_ms']:>10.3f} ms",
+                          file=sys.stderr)
+            return 1 if (div or result["errors"]) else 0
         if args.drill_wedge is not None:
             if not args.router or args.router < 2:
                 ap.error("--drill-wedge needs --router N with N >= 2 "

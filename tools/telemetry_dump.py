@@ -59,6 +59,16 @@ share of attributed time with their p99 and slowest exemplar trace
 tenant-class, model) breakdown. Exit 4 when a FIRING alert carries
 stage attribution in its payload — the page already names its
 bottleneck, so scripts can gate on it like `--alerts`.
+
+`--capture` fetches the traffic-capture corpus summary `/capture` (an
+engine's own store, or a router's fleet merge) and prints sampling
+rate, payload mode, records written, corpus size/segments/age and
+write errors per owner. `--shadow` fetches the shadow-diff verdict
+`/shadow` and prints the candidate under test, mirrored/compared
+counts, the divergence rate against its threshold, the primary-vs-
+shadow latency delta and the most recent divergences; exit 6 while
+the verdict is FAILING — the same scriptable-gate contract as
+`--alerts`/`--incidents`, used by the pre-swap drills.
 """
 from __future__ import annotations
 
@@ -174,7 +184,7 @@ def _base_url(src):
     src = src.rstrip("/")
     for suffix in ("/metrics", "/stats", "/healthz", "/traces",
                    "/profile", "/costs", "/slo", "/alerts",
-                   "/incidents", "/whyslow"):
+                   "/incidents", "/whyslow", "/capture", "/shadow"):
         if src.endswith(suffix):
             return src[: -len(suffix)]
     return src
@@ -656,6 +666,90 @@ def dump_whyslow(data, alerts=None, out=None, top=10):
     return attributed_pages
 
 
+def _capture_row(owner, s, out):
+    age = s.get("age_s")
+    print(f"  {str(owner):<14} {s.get('rate', 0):>5.2f} "
+          f"{s.get('payload', '?'):<7} "
+          f"{s.get('records_written', 0):>9} "
+          f"{(s.get('corpus_bytes', 0) or 0) / 1024:>9.1f}K "
+          f"{s.get('segments', 0):>4} "
+          f"{(_n(age) + 's') if age is not None else '-':>9} "
+          f"{s.get('write_errors', 0):>6} "
+          f"{s.get('dir') or '(memory)'}", file=out)
+
+
+def dump_capture(data, out=None):
+    """One-screen /capture summary — sampling rate, corpus size/age,
+    write errors; an engine's own store or a router's fleet merge."""
+    out = out if out is not None else sys.stdout
+    engines = data.get("engines")
+    if engines is None:                 # single engine body
+        engines = {data.get("owner", "?"): data}
+        fleet = None
+    else:
+        fleet = data.get("fleet") or {}
+    print(f"-- capture, {data.get('owner', '?')}: "
+          + ("enabled " if data.get("enabled") else "DISABLED ")
+          + "-" * 10, file=out)
+    if not engines:
+        print("  (no seat has a capture store — MXNET_TPU_CAPTURE=0 "
+              "everywhere)", file=out)
+    else:
+        print(f"  {'owner':<14} {'rate':>5} {'payload':<7} "
+              f"{'records':>9} {'corpus':>10} {'segs':>4} "
+              f"{'age':>9} {'werrs':>6} dir", file=out)
+        for eid, s in sorted(engines.items()):
+            _capture_row(eid, s, out)
+    if fleet:
+        print(f"  fleet: {fleet.get('records_written', 0)} records, "
+              f"{(fleet.get('corpus_bytes', 0) or 0) / 1024:.1f}K, "
+              f"{fleet.get('write_errors', 0)} write errors", file=out)
+    missing = data.get("missing")
+    if missing:
+        print(f"  (capture disabled on: {', '.join(missing)})", file=out)
+
+
+def dump_shadow(data, out=None):
+    """One-screen /shadow verdict — candidate, mirrored/compared
+    counts, divergence rate vs threshold, latency delta. Returns True
+    while the verdict is FAILING (the CLI turns that into exit 6)."""
+    out = out if out is not None else sys.stdout
+    passing = data.get("passing")
+    state = ("PASSING" if passing else
+             "FAILING" if passing is False else
+             "inconclusive" if data.get("active") else "disarmed")
+    print(f"-- shadow, {data.get('owner', '?')}: {state} "
+          + "-" * 10, file=out)
+    print(f"  candidate: {data.get('model') or '-'}"
+          f"@{data.get('version') or '?'}  "
+          f"fraction={data.get('fraction')}  "
+          f"threshold={data.get('threshold')}  "
+          f"min_requests={data.get('min_requests')}", file=out)
+    rate = data.get("divergence_rate")
+    print(f"  mirrored={data.get('mirrored', 0)} "
+          f"compared={data.get('compared', 0)} "
+          f"matched={data.get('matched', 0)} "
+          f"divergences={data.get('divergences', 0)} "
+          f"errors={data.get('errors', 0)} "
+          f"rate={_n(rate)}", file=out)
+    lat = data.get("latency") or {}
+    prim, shad = lat.get("primary") or {}, lat.get("shadow") or {}
+    if prim.get("count") and shad.get("count"):
+        delta = ((shad.get("mean_ms") or 0.0)
+                 - (prim.get("mean_ms") or 0.0))
+        print(f"  latency: primary p50={_n(prim.get('p50_ms'))}ms "
+              f"p99={_n(prim.get('p99_ms'))}ms | shadow "
+              f"p50={_n(shad.get('p50_ms'))}ms "
+              f"p99={_n(shad.get('p99_ms'))}ms | mean delta "
+              f"{delta:+.2f}ms", file=out)
+    for d in (data.get("recent_divergences") or [])[-5:]:
+        print(f"  DIVERGED {d.get('trace_id', '?')}: "
+              f"expected {d.get('expected')} got {d.get('got')} "
+              f"({_n(d.get('primary_ms'))}ms vs "
+              f"{_n(d.get('shadow_ms'))}ms)", file=out)
+    return passing is False
+
+
 def dump_trace_tree(trace, out=None):
     """Indented span-tree render with per-span self-time."""
     out = out if out is not None else sys.stdout
@@ -741,6 +835,16 @@ def main(argv=None):
                     "share of attributed time with exemplar traces; "
                     "exit 4 when a firing alert's payload names its "
                     "bottleneck stage")
+    ap.add_argument("--capture", action="store_true",
+                    help="table the traffic-capture corpus summary "
+                    "from the server's /capture (sample rate, corpus "
+                    "size/age, write errors; engine or router fleet "
+                    "merge)")
+    ap.add_argument("--shadow", action="store_true",
+                    help="print the shadow-diff verdict from the "
+                    "server's /shadow (candidate, divergence rate vs "
+                    "threshold, latency delta); exit 6 while the "
+                    "verdict is failing")
     ap.add_argument("--top", type=int, default=10,
                     help="rows in the --traces/--profile tables")
     args = ap.parse_args(argv)
@@ -792,6 +896,14 @@ def main(argv=None):
                 top=args.top)
             if paged:
                 rc = max(rc, 4)
+            shown = True
+        if args.capture:
+            dump_capture(json.loads(_fetch(base + "/capture")))
+            shown = True
+        if args.shadow:
+            failing = dump_shadow(json.loads(_fetch(base + "/shadow")))
+            if failing:
+                rc = max(rc, 6)
             shown = True
         if shown:
             pass
